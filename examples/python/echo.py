@@ -1,0 +1,20 @@
+#!/usr/bin/env python3
+"""Echo node: replies to echo requests with the same payload.
+The role of the reference's demo/python/echo.py."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from node import Node  # noqa: E402
+
+node = Node()
+
+
+@node.on("echo")
+def echo(msg):
+    node.reply(msg, {"type": "echo_ok", "echo": msg["body"]["echo"]})
+
+
+if __name__ == "__main__":
+    node.run()

@@ -1,0 +1,241 @@
+#!/usr/bin/env python3
+"""A minimal Python node SDK for writing workload nodes.
+
+Speaks the newline-delimited JSON protocol over STDIN/STDOUT, logs to
+STDERR. Provides: handler registration per message type, automatic ``init``
+handling, reply helpers, async RPC with callbacks/futures, periodic tasks,
+and a client for the built-in KV services (lin-kv / seq-kv / lww-kv).
+
+This fills the role of the reference's demo node libraries
+(demo/python/maelstrom.py, demo/ruby/node.rb, demo/go/node.go +
+demo/go/kv.go) with a thread-based design: one reader thread dispatches each
+message to a worker thread; timers run on daemon threads.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+
+
+class RPCError(Exception):
+    def __init__(self, code, text):
+        self.code = code
+        self.text = text
+        super().__init__(f"RPC error {code}: {text}")
+
+    @classmethod
+    def timeout(cls, text="timed out"):
+        return cls(0, text)
+
+    @classmethod
+    def not_supported(cls, text):
+        return cls(10, text)
+
+    @classmethod
+    def temporarily_unavailable(cls, text):
+        return cls(11, text)
+
+    @classmethod
+    def abort(cls, text):
+        return cls(14, text)
+
+    @classmethod
+    def key_does_not_exist(cls, text):
+        return cls(20, text)
+
+    @classmethod
+    def precondition_failed(cls, text):
+        return cls(22, text)
+
+    @classmethod
+    def txn_conflict(cls, text):
+        return cls(30, text)
+
+    def to_body(self):
+        return {"type": "error", "code": self.code, "text": self.text}
+
+
+class Node:
+    def __init__(self):
+        self.node_id = None
+        self.node_ids = []
+        self.handlers = {}          # type -> fn(msg)
+        self.callbacks = {}         # msg_id -> fn(body)
+        self._next_msg_id = 0
+        # lock ordering: `lock` serializes handler execution and is held
+        # while a handler runs; callbacks + stdout use their own small
+        # locks so the reply path never needs `lock` (otherwise a handler
+        # blocking in sync_rpc would deadlock the reply dispatch).
+        self.lock = threading.RLock()
+        self._cb_lock = threading.Lock()
+        self._io_lock = threading.Lock()
+        self._timers = []
+
+        def handle_init(msg):
+            body = msg["body"]
+            self.node_id = body["node_id"]
+            self.node_ids = body["node_ids"]
+            self.log(f"node {self.node_id} initialized")
+            self.reply(msg, {"type": "init_ok"})
+            for interval, fn in self._timers:
+                t = threading.Thread(target=self._timer_loop,
+                                     args=(interval, fn), daemon=True)
+                t.start()
+
+        self.handlers["init"] = handle_init
+
+    # --- plumbing ---------------------------------------------------------
+
+    def log(self, *args):
+        print(*args, file=sys.stderr, flush=True)
+
+    def send(self, dest, body):
+        with self._io_lock:
+            msg = {"src": self.node_id, "dest": dest, "body": body}
+            print(json.dumps(msg), flush=True)
+
+    def reply(self, req, body):
+        body = dict(body)
+        body["in_reply_to"] = req["body"]["msg_id"]
+        self.send(req["src"], body)
+
+    def reply_error(self, req, err: RPCError):
+        self.reply(req, err.to_body())
+
+    def new_msg_id(self):
+        with self._cb_lock:
+            self._next_msg_id += 1
+            return self._next_msg_id
+
+    def rpc(self, dest, body, callback):
+        """Async RPC: callback(body) is invoked with the reply body on a
+        dispatch thread WITHOUT the node lock held; callbacks that touch
+        node state should take ``node.lock`` themselves."""
+        msg_id = self.new_msg_id()
+        with self._cb_lock:
+            self.callbacks[msg_id] = callback
+        body = dict(body)
+        body["msg_id"] = msg_id
+        self.send(dest, body)
+        return msg_id
+
+    def sync_rpc(self, dest, body, timeout=1.0):
+        """Blocking RPC; raises RPCError on error reply or timeout."""
+        event = threading.Event()
+        result = {}
+
+        def cb(reply):
+            result["body"] = reply
+            event.set()
+
+        self.rpc(dest, body, cb)
+        if not event.wait(timeout):
+            raise RPCError.timeout(f"RPC to {dest} timed out")
+        reply = result["body"]
+        if reply.get("type") == "error":
+            raise RPCError(reply.get("code", 13), reply.get("text", ""))
+        return reply
+
+    # --- API --------------------------------------------------------------
+
+    def on(self, type_):
+        """Decorator: register a handler for a message type."""
+        def register(fn):
+            self.handlers[type_] = fn
+            return fn
+        return register
+
+    def every(self, interval_s):
+        """Decorator: run fn periodically once initialized."""
+        def register(fn):
+            self._timers.append((interval_s, fn))
+            return fn
+        return register
+
+    def _timer_loop(self, interval, fn):
+        while True:
+            time.sleep(interval)
+            try:
+                with self.lock:
+                    fn()
+            except Exception as e:
+                self.log(f"timer error: {e!r}")
+
+    def other_node_ids(self):
+        return [n for n in self.node_ids if n != self.node_id]
+
+    def _dispatch(self, msg):
+        body = msg["body"]
+        in_reply_to = body.get("in_reply_to")
+        if in_reply_to is not None:
+            with self._cb_lock:
+                cb = self.callbacks.pop(in_reply_to, None)
+            if cb is not None:
+                try:
+                    cb(body)
+                except Exception as e:
+                    self.log(f"callback error: {e!r}")
+            return
+        handler = self.handlers.get(body.get("type"))
+        if handler is None:
+            self.reply_error(msg, RPCError.not_supported(
+                f"no handler for {body.get('type')!r}"))
+            return
+        try:
+            with self.lock:
+                handler(msg)
+        except RPCError as e:
+            self.reply_error(msg, e)
+        except Exception as e:
+            self.log(f"handler error: {e!r}")
+            self.reply_error(msg, RPCError(13, repr(e)))
+
+    def run(self):
+        """Main loop: one thread per incoming message keeps slow handlers
+        from blocking the pipe; the node lock serializes state access."""
+        for line in sys.stdin:
+            line = line.strip()
+            if not line:
+                continue
+            msg = json.loads(line)
+            threading.Thread(target=self._dispatch, args=(msg,),
+                             daemon=True).start()
+
+
+class KV:
+    """Client for the built-in KV services, like demo/go/kv.go."""
+
+    LIN = "lin-kv"
+    SEQ = "seq-kv"
+    LWW = "lww-kv"
+
+    def __init__(self, node: Node, service: str = "lin-kv",
+                 timeout: float = 1.0):
+        self.node = node
+        self.service = service
+        self.timeout = timeout
+
+    def read(self, key, default=KeyError):
+        try:
+            return self.node.sync_rpc(
+                self.service, {"type": "read", "key": key},
+                self.timeout)["value"]
+        except RPCError as e:
+            if e.code == 20 and default is not KeyError:
+                return default
+            raise
+
+    def write(self, key, value):
+        self.node.sync_rpc(self.service,
+                           {"type": "write", "key": key, "value": value},
+                           self.timeout)
+
+    def cas(self, key, frm, to, create_if_not_exists=False):
+        self.node.sync_rpc(
+            self.service,
+            {"type": "cas", "key": key, "from": frm, "to": to,
+             "create_if_not_exists": create_if_not_exists},
+            self.timeout)

@@ -1,0 +1,18 @@
+"""maelstrom_tpu: a TPU-native distributed-systems testing workbench.
+
+Two runtimes behind one workload boundary:
+
+- **process runtime**: spawns user nodes as child processes speaking
+  newline-delimited JSON over STDIN/STDOUT against an in-process simulated
+  network with latency, loss, and partition fault injection.
+- **TPU runtime**: workload protocol instances vectorized as rows of
+  device-resident JAX state tensors; message delivery is a batched masked
+  exchange inside a ``lax.scan``, sharded over chips with ``shard_map``.
+
+See SURVEY.md for the structural map of the reference system
+(jepsen-io/maelstrom) this framework reproduces.
+"""
+
+__version__ = "0.1.0"
+
+from .runner import run_test  # noqa: F401

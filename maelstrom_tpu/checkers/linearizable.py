@@ -1,0 +1,152 @@
+"""Linearizability checker for register histories (read / write / cas).
+
+Implements the Wing & Gong / Lowe (WGL) algorithm with memoization: search
+for an order of linearization points, one per completed operation, that (a)
+lies within each op's real-time interval and (b) is legal for a sequential
+register. Indeterminate (``info``) ops may take effect at any point after
+their invocation *or never*; failed ops are assumed not to have happened
+(they carry definite errors).
+
+This fills the role Knossos plays for the reference's lin-kv workload
+(src/maelstrom/workload/lin_kv.clj via jepsen.tests.linearizable-register).
+Histories are checked *per key*; a register op's value is ``[k, v]`` for
+read/write and ``[k, [from, to]]`` for cas, matching the reference's op
+encoding.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+INF = float("inf")
+
+
+@dataclass
+class _Op:
+    idx: int          # dense index for bitmask
+    f: str            # read / write / cas
+    args: Any         # read: None; write: v; cas: (frm, to)
+    ret: Any          # read: observed value; others: None
+    inv: float        # invocation time
+    end: float        # completion time (INF for info ops)
+    required: bool    # must be linearized (ok) vs optional (info)
+
+
+def _apply(state, op: _Op) -> Tuple[bool, Any]:
+    """Sequential register semantics. Returns (legal, new_state)."""
+    if op.f == "read":
+        if op.required:
+            return (op.ret == state), state
+        return True, state  # info read: any return possible
+    if op.f == "write":
+        return True, op.args
+    if op.f == "cas":
+        frm, to = op.args
+        if state == frm:
+            return True, to
+        # cas that returned ok must have matched; an info cas may simply
+        # have failed server-side -> also allow "no effect" via skip branch
+        return False, state
+    raise ValueError(f"unknown register op {op.f}")
+
+
+def check_register_history(ops: List[_Op], init_state=None) -> bool:
+    """WGL search. True iff linearizable."""
+    n = len(ops)
+    required_mask = 0
+    for o in ops:
+        if o.required:
+            required_mask |= 1 << o.idx
+    full = (1 << n) - 1
+    seen = set()
+
+    def min_end(linearized: int) -> float:
+        m = INF
+        for o in ops:
+            if not (linearized >> o.idx) & 1:
+                if o.end < m:
+                    m = o.end
+        return m
+
+    # iterative DFS over (linearized_mask, state)
+    stack = [(0, init_state)]
+    while stack:
+        linearized, state = stack.pop()
+        if (linearized & required_mask) == required_mask:
+            return True
+        key = (linearized, state)
+        if key in seen:
+            continue
+        seen.add(key)
+        bound = min_end(linearized)
+        for o in ops:
+            if (linearized >> o.idx) & 1:
+                continue
+            if o.inv > bound:
+                continue  # real-time order violated
+            legal, new_state = _apply(state, o)
+            if legal:
+                stack.append((linearized | (1 << o.idx), new_state))
+    return False
+
+
+def _collect_ops(history, key) -> Optional[List[_Op]]:
+    """Build per-key op list from invoke/complete pairs."""
+    from ..gen.history import pairs
+    ops: List[_Op] = []
+    for p in pairs(history):
+        inv, comp = p["invoke"], p["complete"]
+        if inv.get("process") == "nemesis":
+            continue
+        v = inv["value"]
+        if not (isinstance(v, (list, tuple)) and len(v) == 2):
+            continue
+        k, arg = v
+        if k != key:
+            continue
+        f = inv["f"]
+        ctype = comp["type"] if comp is not None else "info"
+        if ctype == "fail":
+            continue  # definitely didn't happen
+        required = ctype == "ok"
+        end = comp["time"] if required else INF
+        if f == "read":
+            ret = comp["value"][1] if (required and
+                                       isinstance(comp["value"],
+                                                  (list, tuple))) else None
+            ops.append(_Op(0, "read", None, ret, inv["time"], end, required))
+        elif f == "write":
+            ops.append(_Op(0, "write", arg, None, inv["time"], end,
+                           required))
+        elif f == "cas":
+            ops.append(_Op(0, "cas", tuple(arg), None, inv["time"], end,
+                           required))
+    for i, o in enumerate(ops):
+        o.idx = i
+    return ops
+
+
+def linearizable_kv_checker(history, max_ops_per_key: int = 400) -> dict:
+    """Check a multi-key register history key by key."""
+    keys = set()
+    for r in history:
+        if r["type"] == "invoke" and isinstance(r.get("value"),
+                                                (list, tuple)) \
+                and len(r["value"]) == 2:
+            keys.add(r["value"][0])
+    bad_keys = []
+    skipped = []
+    for key in sorted(keys, key=repr):
+        ops = _collect_ops(history, key)
+        if len(ops) > max_ops_per_key:
+            skipped.append(key)
+            continue
+        if not check_register_history(ops):
+            bad_keys.append(key)
+    return {
+        "valid?": not bad_keys,
+        "key-count": len(keys),
+        "bad-keys": bad_keys,
+        "skipped-keys": skipped,
+    }

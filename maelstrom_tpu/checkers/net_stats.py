@@ -1,0 +1,21 @@
+"""Network statistics checker: message counts from the journal, split by
+all/clients/servers, plus msgs-per-op (server messages per client
+invocation) — the headline efficiency number in the broadcast guide.
+
+Parity: reference src/maelstrom/net/checker.clj:28-70.
+"""
+
+from __future__ import annotations
+
+from ..gen.history import client_invokes
+
+
+def net_stats_checker(journal, history) -> dict:
+    stats = journal.stats()
+    ops = len(client_invokes(history))
+    servers_msgs = stats["servers"]["msg-count"]
+    return {
+        "valid?": True,
+        "stats": stats,
+        "msgs-per-op": (servers_msgs / ops) if ops else None,
+    }

@@ -1,0 +1,58 @@
+"""Message model.
+
+A message is ``{id, src, dest, body}`` where ``body`` is a JSON-serializable
+dict carrying at least a ``type`` field; requests carry ``msg_id`` and replies
+``in_reply_to``. Parity: reference src/maelstrom/net/message.clj:8-25 and
+resources/protocol-intro.md.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+@dataclass
+class Message:
+    id: int                      # globally unique, harness-assigned
+    src: str                     # node id, e.g. "n1", "c3", "lin-kv"
+    dest: str
+    body: Dict[str, Any] = field(default_factory=dict)
+
+    @property
+    def type(self) -> Optional[str]:
+        return self.body.get("type")
+
+    @property
+    def msg_id(self) -> Optional[int]:
+        return self.body.get("msg_id")
+
+    @property
+    def in_reply_to(self) -> Optional[int]:
+        return self.body.get("in_reply_to")
+
+    def to_wire(self) -> Dict[str, Any]:
+        """The JSON dict a node sees on its stdin (id is harness-internal)."""
+        return {"id": self.id, "src": self.src, "dest": self.dest,
+                "body": self.body}
+
+    @staticmethod
+    def from_wire(d: Dict[str, Any], id: int = -1) -> "Message":
+        return Message(id=id, src=d["src"], dest=d["dest"], body=d["body"])
+
+    def validate(self) -> "Message":
+        if not isinstance(self.src, str) or not self.src:
+            raise ValueError(f"message src must be a non-empty string: {self}")
+        if not isinstance(self.dest, str) or not self.dest:
+            raise ValueError(f"message dest must be a non-empty string: {self}")
+        if not isinstance(self.body, dict):
+            raise ValueError(f"message body must be a dict: {self}")
+        return self
+
+
+def reply_body(request_body: Dict[str, Any], **fields) -> Dict[str, Any]:
+    """Build a reply body, wiring in_reply_to from the request's msg_id."""
+    body = dict(fields)
+    if "msg_id" in request_body:
+        body["in_reply_to"] = request_body["msg_id"]
+    return body

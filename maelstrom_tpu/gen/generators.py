@@ -1,0 +1,82 @@
+"""Operation generators.
+
+A generator is any iterable/iterator producing *op templates*: dicts with at
+least ``{"f": ...}`` and usually ``{"value": ...}``; entries may also be
+callables ``(rng) -> op`` for per-draw randomness. The scheduler pulls ops
+from a shared generator across worker threads, staggering pulls so the whole
+test averages ``rate`` ops/sec, until the time limit; then each worker runs
+the per-thread ``final`` generator (e.g. final reads).
+
+Parity: reference generator assembly at src/maelstrom/core.clj:67-80
+(stagger 1/rate -> nemesis interleave -> time-limit -> final phase) built on
+jepsen.generator; the combinators here (mix, each_thread, repeat_op,
+stagger semantics) mirror the jepsen.generator ops the workloads use.
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+import threading
+from typing import Callable, Iterable, Iterator, Optional
+
+
+def op(f, value=None, **extra):
+    d = {"f": f, "value": value}
+    d.update(extra)
+    return d
+
+
+def repeat_op(f, value=None):
+    """Infinite stream of identical op templates (e.g. unique-ids
+    generate)."""
+    return itertools.repeat(op(f, value))
+
+
+def mix(*makers: Callable[[random.Random], dict]):
+    """Infinite random mix of op makers, like jepsen.generator/mix."""
+    def gen(rng: random.Random) -> Iterator[dict]:
+        while True:
+            yield rng.choice(makers)(rng)
+    return gen
+
+
+class OpSource:
+    """Thread-safe shared pull point over a generator.
+
+    The generator may be: an iterator/iterable of ops, or a callable
+    ``(rng) -> iterator``. Ops may themselves be callables ``(rng) -> op``.
+    """
+
+    def __init__(self, gen, seed: Optional[int] = None):
+        self.rng = random.Random(seed)
+        if callable(gen):
+            gen = gen(self.rng)
+        self._it = iter(gen) if gen is not None else iter(())
+        self._lock = threading.Lock()
+
+    def next_op(self) -> Optional[dict]:
+        with self._lock:
+            try:
+                item = next(self._it)
+            except StopIteration:
+                return None
+        if callable(item):
+            item = item(self.rng)
+        return dict(item)
+
+
+def stagger_delay(rate: float, concurrency: int, rng: random.Random) -> float:
+    """Per-worker sleep before each op so the *aggregate* op rate across all
+    workers averages ``rate`` ops/sec, with exponential jitter (the
+    equivalent of jepsen's (gen/stagger (/ rate)))."""
+    if rate <= 0:
+        return 0.0
+    mean = concurrency / rate
+    return rng.expovariate(1.0 / mean) if mean > 0 else 0.0
+
+
+def each_thread(make_ops: Callable[[], Iterable[dict]]):
+    """A final-phase generator: every worker thread independently runs its
+    own copy of make_ops() (like jepsen's gen/each-thread)."""
+    return ("each-thread", make_ops)

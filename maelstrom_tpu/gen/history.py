@@ -1,0 +1,105 @@
+"""Operation history.
+
+A history is an ordered list of records, one per client invoke/completion
+and nemesis event, in Jepsen's shape::
+
+    {"index": 0, "time": <ns>, "process": 0, "type": "invoke",
+     "f": "read", "value": None}
+    {"index": 1, "time": <ns>, "process": 0, "type": "ok",
+     "f": "read", "value": 5}
+
+``type`` is one of invoke / ok / fail / info. Checkers consume histories;
+they are also serialized to the store dir as ``history.jsonl`` (and
+optionally Jepsen-compatible EDN for external checkers).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Dict, Iterable, List, Optional
+
+
+class History:
+    def __init__(self):
+        self._records: List[dict] = []
+        self._lock = threading.Lock()
+        self._t0 = time.monotonic_ns()
+
+    def now(self) -> int:
+        return time.monotonic_ns() - self._t0
+
+    def append(self, record: dict) -> dict:
+        with self._lock:
+            record = dict(record)
+            record["index"] = len(self._records)
+            record.setdefault("time", self.now())
+            self._records.append(record)
+            return record
+
+    def invoke(self, process, f, value, **extra) -> dict:
+        return self.append({"process": process, "type": "invoke",
+                            "f": f, "value": value, **extra})
+
+    def complete(self, invocation: dict, type: str, value=None,
+                 **extra) -> dict:
+        rec = {"process": invocation["process"], "type": type,
+               "f": invocation["f"],
+               "value": invocation["value"] if value is None else value}
+        rec.update(extra)
+        return self.append(rec)
+
+    def records(self) -> List[dict]:
+        with self._lock:
+            return list(self._records)
+
+    def __len__(self):
+        with self._lock:
+            return len(self._records)
+
+    def __iter__(self):
+        return iter(self.records())
+
+    def write_jsonl(self, path: str):
+        with open(path, "w") as f:
+            for r in self.records():
+                f.write(json.dumps(r) + "\n")
+
+    @staticmethod
+    def from_records(records: Iterable[dict]) -> "History":
+        h = History()
+        for i, r in enumerate(records):
+            r = dict(r)
+            r.setdefault("index", i)
+            r.setdefault("time", i)
+            h._records.append(r)
+        return h
+
+
+# --- analysis helpers used by checkers ------------------------------------
+
+def ok_ops(history, f: Optional[str] = None) -> List[dict]:
+    return [r for r in history
+            if r["type"] == "ok" and (f is None or r["f"] == f)]
+
+
+def client_invokes(history) -> List[dict]:
+    return [r for r in history
+            if r["type"] == "invoke" and r.get("process") != "nemesis"]
+
+
+def pairs(history) -> List[Dict[str, Optional[dict]]]:
+    """Match invokes with their completions per process. An invoke with no
+    completion (still pending at test end) pairs with None."""
+    open_ops: Dict = {}
+    out = []
+    for r in history:
+        p = r.get("process")
+        if r["type"] == "invoke":
+            entry = {"invoke": r, "complete": None}
+            open_ops[p] = entry
+            out.append(entry)
+        elif r["type"] in ("ok", "fail", "info") and p in open_ops:
+            open_ops.pop(p)["complete"] = r
+    return out

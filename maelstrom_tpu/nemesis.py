@@ -1,0 +1,129 @@
+"""Fault injection: network partition nemesis.
+
+The nemesis runs on its own thread alongside the client workers: every
+``interval`` seconds it alternately starts a partition (computing a *grudge*
+— a map of receiver -> blocked sources — and applying it receiver-side via
+``net.drop``) and heals it. Nemesis activity is recorded in the history as
+``info`` ops from process "nemesis". At the end of the main phase the
+runner invokes :meth:`PartitionNemesis.heal_final` so final reads run on a
+healthy network.
+
+Parity: reference src/maelstrom/nemesis.clj:10-16 composing jepsen's
+partition-package (random halves / majorities-ring / isolated-node grudges
+on an interval, with a final heal), enforced by net.clj drop!/heal!.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import Dict, List, Optional, Set
+
+from .net.net import Net
+from .gen.history import History
+
+
+def grudge_random_halves(nodes: List[str], rng: random.Random
+                         ) -> Dict[str, Set[str]]:
+    """Split nodes into two halves; each side blocks the other."""
+    ns = list(nodes)
+    rng.shuffle(ns)
+    mid = len(ns) // 2
+    a, b = set(ns[:mid]), set(ns[mid:])
+    grudge = {}
+    for n in a:
+        grudge[n] = set(b)
+    for n in b:
+        grudge[n] = set(a)
+    return grudge
+
+
+def grudge_isolated_node(nodes: List[str], rng: random.Random
+                         ) -> Dict[str, Set[str]]:
+    """Isolate one random node from everyone else."""
+    victim = rng.choice(list(nodes))
+    rest = set(nodes) - {victim}
+    grudge = {victim: set(rest)}
+    for n in rest:
+        grudge[n] = {victim}
+    return grudge
+
+
+def grudge_majorities_ring(nodes: List[str], rng: random.Random
+                           ) -> Dict[str, Set[str]]:
+    """Each node can see a distinct majority arranged around a ring; every
+    node is cut off from the remaining minority (jepsen's
+    partition-majorities-ring shape)."""
+    ns = list(nodes)
+    rng.shuffle(ns)
+    n = len(ns)
+    maj = n // 2 + 1
+    grudge: Dict[str, Set[str]] = {}
+    for i, node in enumerate(ns):
+        visible = {ns[(i + d) % n] for d in range(-(maj - 1) // 2,
+                                                  maj // 2 + 1)}
+        grudge[node] = set(ns) - visible
+    return grudge
+
+
+GRUDGES = {
+    "random-halves": grudge_random_halves,
+    "isolated-node": grudge_isolated_node,
+    "majorities-ring": grudge_majorities_ring,
+}
+
+
+class PartitionNemesis:
+    """Alternates start-partition / stop-partition every ``interval``
+    seconds."""
+
+    def __init__(self, net: Net, nodes: List[str], history: History,
+                 interval: float = 10.0, kinds: Optional[List[str]] = None,
+                 seed: Optional[int] = None):
+        self.net = net
+        self.nodes = nodes
+        self.history = history
+        self.interval = interval
+        self.kinds = kinds or list(GRUDGES)
+        self.rng = random.Random(seed)
+        self._stop = threading.Event()
+        self.thread = threading.Thread(target=self._run, name="nemesis",
+                                       daemon=True)
+        self.partitioned = False
+
+    def start(self):
+        self.thread.start()
+
+    def _apply(self, grudge: Dict[str, Set[str]]):
+        for dest, srcs in grudge.items():
+            for src in srcs:
+                self.net.drop(src, dest)
+
+    def _run(self):
+        while not self._stop.wait(self.interval):
+            if self.partitioned:
+                self.net.heal()
+                self.partitioned = False
+                self.history.append({"process": "nemesis", "type": "info",
+                                     "f": "stop-partition", "value": None})
+            else:
+                kind = self.rng.choice(self.kinds)
+                grudge = GRUDGES[kind](self.nodes, self.rng)
+                self._apply(grudge)
+                self.partitioned = True
+                self.history.append(
+                    {"process": "nemesis", "type": "info",
+                     "f": "start-partition",
+                     "value": {k: sorted(v) for k, v in grudge.items()}})
+
+    def heal_final(self):
+        """Stop injecting and heal — the final-phase recovery
+        (core.clj:74-80)."""
+        self._stop.set()
+        if self.thread.is_alive():
+            self.thread.join(timeout=2.0)
+        self.net.heal()
+        if self.partitioned:
+            self.partitioned = False
+            self.history.append({"process": "nemesis", "type": "info",
+                                 "f": "stop-partition", "value": None})

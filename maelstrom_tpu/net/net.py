@@ -1,0 +1,215 @@
+"""Host-side simulated network.
+
+One in-process network connects all simulated nodes (user node processes,
+built-in services, and harness clients). Each node has a priority queue of
+pending messages ordered by delivery deadline; a message's deadline is
+``send_time + latency`` with latency drawn per-message from a configurable
+distribution. Messages may be probabilistically lost, and a receiver-side
+partition map silently drops messages from blocked sources at delivery time.
+Client traffic (either endpoint a client) always has zero latency so that
+injected faults can't be masked by client-link delays.
+
+Parity: reference src/maelstrom/net.clj — constructor :79-103, latency
+distributions :42-77, client zero-latency :178-187, send! :189-221 (journal,
+loss, deadline enqueue), recv! :223-247 (poll, partition drop, wait until
+deadline), Jepsen Net adapter drop!/heal!/slow!/fast!/flaky! :105-122.
+"""
+
+from __future__ import annotations
+
+import heapq
+import random
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, Optional, Set
+
+from ..core.message import Message
+from ..core import errors
+from ..utils.ids import is_client
+from .journal import Journal
+
+
+@dataclass
+class Latency:
+    """Per-message latency distribution, mean in milliseconds.
+
+    dist: 'constant' (always mean), 'uniform' (0..2*mean),
+    'exponential' (mean mean). Parity: net.clj:42-77.
+    """
+    mean: float = 0.0
+    dist: str = "exponential"
+
+    def draw(self, rng: random.Random) -> float:
+        if self.mean <= 0:
+            return 0.0
+        if self.dist == "constant":
+            return self.mean
+        if self.dist == "uniform":
+            return rng.uniform(0, 2 * self.mean)
+        if self.dist == "exponential":
+            return rng.expovariate(1.0 / self.mean)
+        raise ValueError(f"unknown latency distribution {self.dist!r}")
+
+
+class _Queue:
+    """Deadline-ordered message queue for one node."""
+
+    def __init__(self):
+        self.heap = []            # (deadline_ns, seq, Message)
+        self.cond = threading.Condition()
+        self.seq = 0
+
+
+class Net:
+    """The simulated network."""
+
+    def __init__(self, latency: Optional[Latency] = None, p_loss: float = 0.0,
+                 log_send: bool = False, log_recv: bool = False,
+                 journal: Optional[Journal] = None, seed: Optional[int] = None):
+        self.base_latency = latency or Latency()
+        self.latency = self.base_latency      # mutable via slow/fast
+        self.p_loss = p_loss
+        self.base_p_loss = p_loss
+        self.log_send = log_send
+        self.log_recv = log_recv
+        self.journal = journal or Journal(None)
+        self.rng = random.Random(seed)
+        self._next_id = 0
+        self._id_lock = threading.Lock()
+        self._queues: Dict[str, _Queue] = {}
+        self._queues_lock = threading.Lock()
+        # client-id allocation (used by runtime.client.Client.open)
+        self._client_ctr = 0
+        self._client_ctr_lock = threading.Lock()
+        # receiver-side blocklists: dest -> set of blocked srcs (net.clj:234)
+        self.partitions: Dict[str, Set[str]] = {}
+        self._part_lock = threading.Lock()
+
+    # --- topology ---------------------------------------------------------
+
+    def add_node(self, node_id: str):
+        with self._queues_lock:
+            if node_id in self._queues:
+                raise ValueError(f"node {node_id} already exists")
+            self._queues[node_id] = _Queue()
+
+    def remove_node(self, node_id: str):
+        with self._queues_lock:
+            self._queues.pop(node_id, None)
+
+    def has_node(self, node_id: str) -> bool:
+        with self._queues_lock:
+            return node_id in self._queues
+
+    def nodes(self):
+        with self._queues_lock:
+            return list(self._queues)
+
+    def _queue_for(self, node_id: str) -> _Queue:
+        with self._queues_lock:
+            q = self._queues.get(node_id)
+        if q is None:
+            raise errors.node_not_found(
+                f"no node with id {node_id!r} exists; known nodes are "
+                f"{sorted(self._queues)}")
+        return q
+
+    # --- fault injection (Jepsen Net protocol parity, net.clj:105-122) ----
+
+    def drop(self, src: str, dest: str):
+        """Block messages from src as seen by dest (receiver-side)."""
+        with self._part_lock:
+            self.partitions.setdefault(dest, set()).add(src)
+
+    def heal(self):
+        with self._part_lock:
+            self.partitions = {}
+
+    def slow(self, factor: float = 10.0):
+        self.latency = Latency(self.base_latency.mean * factor,
+                               self.base_latency.dist)
+
+    def fast(self):
+        self.latency = self.base_latency
+
+    def flaky(self, p: float = 0.5):
+        self.p_loss = p
+
+    def reliable(self):
+        self.p_loss = self.base_p_loss
+
+    def _blocked(self, src: str, dest: str) -> bool:
+        with self._part_lock:
+            return src in self.partitions.get(dest, ())
+
+    # --- send / recv ------------------------------------------------------
+
+    def new_id(self) -> int:
+        with self._id_lock:
+            i = self._next_id
+            self._next_id += 1
+            return i
+
+    def send(self, src: str, dest: str, body: dict) -> Message:
+        """Send a message: assigns a fresh id, journals the send, may drop it
+        (loss), otherwise enqueues at ``now + latency``. Raises
+        node-not-found if src isn't on the network (dest may be absent —
+        the message is just lost, as with a real network)."""
+        if not self.has_node(src):
+            raise errors.node_not_found(
+                f"cannot send from unknown node {src!r}")
+        m = Message(id=self.new_id(), src=src, dest=dest, body=body).validate()
+        self.journal.log_send(m)
+        if self.log_send:
+            print(f":net :send {m.to_wire()}", flush=True)
+        # lost?
+        if self.p_loss > 0 and self.rng.random() < self.p_loss:
+            return m
+        # client links have zero latency (net.clj:178-187)
+        if is_client(src) or is_client(dest):
+            lat_ms = 0.0
+        else:
+            lat_ms = self.latency.draw(self.rng)
+        deadline = time.monotonic_ns() + int(lat_ms * 1e6)
+        with self._queues_lock:
+            q = self._queues.get(dest)
+        if q is None:
+            return m  # dest not on the network: message vanishes
+        with q.cond:
+            heapq.heappush(q.heap, (deadline, q.seq, m))
+            q.seq += 1
+            q.cond.notify_all()
+        return m
+
+    def recv(self, node_id: str, timeout: Optional[float] = None
+             ) -> Optional[Message]:
+        """Receive the next deliverable message for node_id, waiting up to
+        ``timeout`` seconds (None = forever). Messages whose source is
+        partitioned away from this node are silently dropped at delivery
+        time (net.clj:234). Returns None on timeout."""
+        q = self._queue_for(node_id)
+        deadline_wait = (None if timeout is None
+                         else time.monotonic() + timeout)
+        with q.cond:
+            while True:
+                now_ns = time.monotonic_ns()
+                if q.heap:
+                    d, _, m = q.heap[0]
+                    if d <= now_ns:
+                        heapq.heappop(q.heap)
+                        if self._blocked(m.src, node_id):
+                            continue  # dropped by partition
+                        self.journal.log_recv(m)
+                        if self.log_recv:
+                            print(f":net :recv {m.to_wire()}", flush=True)
+                        return m
+                    wait = (d - now_ns) / 1e9
+                else:
+                    wait = None
+                if deadline_wait is not None:
+                    remaining = deadline_wait - time.monotonic()
+                    if remaining <= 0:
+                        return None
+                    wait = remaining if wait is None else min(wait, remaining)
+                q.cond.wait(wait)

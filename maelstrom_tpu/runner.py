@@ -1,0 +1,286 @@
+"""Test assembly and execution.
+
+``run_test`` wires everything together: build the simulated network +
+journal, bring up services and node processes (or the TPU runtime), drive
+concurrent client workers from the workload's generator with rate
+staggering, interleave the partition nemesis, run the final phase (heal ->
+recovery sleep -> final reads), tear down, then run the composed checkers
+and write artifacts to the store directory.
+
+Parity: reference src/maelstrom/core.clj maelstrom-test :53-102 (generator
+assembly :67-80, checker composition :91-100) + jepsen.core/run!'s worker
+loop, and doc/results.md for the store layout.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import traceback
+from datetime import datetime
+from typing import Any, Dict, List, Optional
+
+from .core.message import Message  # noqa: F401  (re-export convenience)
+from .net.net import Latency, Net
+from .net.journal import Journal
+from .runtime.db import DB
+from .gen.history import History, client_invokes
+from .gen.generators import OpSource, stagger_delay
+from .nemesis import PartitionNemesis
+from .checkers.perf import perf_checker, stats_checker
+from .checkers.availability import availability_checker
+from .checkers.net_stats import net_stats_checker
+from .utils.ids import node_names
+
+
+DEFAULTS = dict(
+    node_count=1,
+    concurrency=5,          # parsed from e.g. "4n" by the CLI
+    rate=10.0,              # ops/sec across all workers
+    time_limit=20.0,        # seconds of main phase
+    latency=0.0,            # mean inter-node latency, ms
+    latency_dist="exponential",
+    p_loss=0.0,
+    nemesis=[],             # e.g. ["partition"]
+    nemesis_interval=10.0,
+    recovery_time=10.0,     # post-heal quiesce before final reads
+    availability=None,      # None | "total" | float fraction
+    log_stderr=False,
+    log_net_send=False,
+    log_net_recv=False,
+    seed=None,
+    store_root="store",
+    snapshot_store=True,
+)
+
+
+class Worker(threading.Thread):
+    def __init__(self, idx: int, runner: "TestRunner"):
+        super().__init__(name=f"worker-{idx}", daemon=True)
+        self.idx = idx
+        self.runner = runner
+        self.error: Optional[BaseException] = None
+
+    def run(self):
+        try:
+            self.runner._worker_loop(self.idx)
+        except BaseException as e:  # surfaced after join
+            self.error = e
+            traceback.print_exc()
+
+
+class TestRunner:
+    def __init__(self, workload_name: str, workload: Dict[str, Any],
+                 opts: Dict[str, Any]):
+        self.opts = {**DEFAULTS, **opts}
+        self.workload_name = workload_name
+        self.workload = workload
+        self.node_ids = node_names(self.opts["node_count"])
+        # store dir
+        ts = datetime.now().strftime("%Y%m%d-%H%M%S-%f")
+        self.store_dir = None
+        if self.opts.get("snapshot_store"):
+            self.store_dir = os.path.join(self.opts["store_root"],
+                                          workload_name, ts)
+            os.makedirs(self.store_dir, exist_ok=True)
+        self.journal = Journal(self.store_dir)
+        self.net = Net(latency=Latency(self.opts["latency"],
+                                       self.opts["latency_dist"]),
+                       p_loss=self.opts["p_loss"],
+                       log_send=self.opts["log_net_send"],
+                       log_recv=self.opts["log_net_recv"],
+                       journal=self.journal,
+                       seed=self.opts["seed"])
+        self.history = History()
+        self.deadline = None
+        self._final_phase = threading.Event()
+        self.rngs = {}
+
+    # --- worker loop ------------------------------------------------------
+
+    def _worker_loop(self, idx: int):
+        import random
+        rng = random.Random(None if self.opts["seed"] is None
+                            else self.opts["seed"] + 1000 + idx)
+        node = self.node_ids[idx % len(self.node_ids)]
+        make_client = self.workload["client"]
+        wclient = make_client(self.net, node, self.opts)
+        try:
+            # main phase
+            while time.monotonic() < self.deadline:
+                delay = stagger_delay(self.opts["rate"],
+                                      self.opts["concurrency"], rng)
+                if delay:
+                    end = min(time.monotonic() + delay, self.deadline)
+                    while time.monotonic() < end:
+                        time.sleep(min(0.05, end - time.monotonic()))
+                if time.monotonic() >= self.deadline:
+                    break
+                op = self.source.next_op()
+                if op is None:
+                    break
+                self._invoke(idx, wclient, op)
+            # final phase barrier: runner heals + sleeps, then sets event
+            self._final_phase.wait()
+            final = self.workload.get("final_generator")
+            if final is not None:
+                tag, make_ops = final
+                assert tag == "each-thread"
+                for op in make_ops():
+                    if callable(op):
+                        op = op(rng)
+                    self._invoke(idx, wclient, {**op, "final": True})
+        finally:
+            try:
+                wclient.close()
+            except Exception:
+                pass
+
+    def _invoke(self, process: int, wclient, op: dict):
+        inv_extra = {"final": True} if op.pop("final", False) else {}
+        inv = self.history.invoke(process, op["f"], op.get("value"),
+                                  **inv_extra)
+        try:
+            completed = wclient.invoke(dict(op))
+        except Exception as e:
+            completed = {**op, "type": "info",
+                         "error": ["exception", repr(e)]}
+        ctype = completed.get("type", "info")
+        if ctype == "invoke":  # client forgot to set outcome
+            ctype = "info"
+        extra = {k: v for k, v in completed.items()
+                 if k not in ("f", "value", "type", "process", "index",
+                              "time")}
+        self.history.complete(inv, ctype, value=completed.get("value"),
+                              **extra)
+
+    # --- run --------------------------------------------------------------
+
+    def run(self) -> Dict[str, Any]:
+        opts = self.opts
+        log_dir = (os.path.join(self.store_dir, "node-logs")
+                   if self.store_dir else None)
+        runtime = self.workload.get("runtime")  # None => process runtime
+        db = None
+        if runtime is None:
+            db = DB(self.net, self.node_ids, opts["bin"],
+                    opts.get("bin_args", []), log_dir=log_dir,
+                    log_stderr=opts["log_stderr"], seed=opts["seed"])
+            db.setup()
+        else:
+            runtime.setup(self)
+
+        self.source = OpSource(self.workload.get("generator"),
+                               seed=opts["seed"])
+        nemesis = None
+        if "partition" in (opts["nemesis"] or []):
+            nemesis = PartitionNemesis(
+                self.net, self.node_ids, self.history,
+                interval=opts["nemesis_interval"], seed=opts["seed"])
+
+        workers = [Worker(i, self) for i in range(opts["concurrency"])]
+        self.deadline = time.monotonic() + opts["time_limit"]
+        crash = None
+        try:
+            for w in workers:
+                w.start()
+            if nemesis:
+                nemesis.start()
+            # wait out the main phase
+            while time.monotonic() < self.deadline:
+                time.sleep(0.05)
+            # final phase: heal, quiesce, then final reads
+            if nemesis:
+                nemesis.heal_final()
+            if self.workload.get("final_generator") is not None:
+                time.sleep(opts["recovery_time"])
+            self._final_phase.set()
+            for w in workers:
+                w.join(timeout=max(60.0, opts["time_limit"]))
+        finally:
+            self._final_phase.set()
+            if nemesis:
+                nemesis.heal_final()
+            try:
+                if db is not None:
+                    db.teardown()
+                elif runtime is not None:
+                    runtime.teardown(self)
+            except Exception as e:
+                crash = e
+        results = self.check()
+        worker_errors = [repr(w.error) for w in workers
+                         if w.error is not None]
+        if worker_errors:
+            # keep the history/artifacts: a broken worker invalidates the
+            # run but everything recorded is still written and analyzed
+            results["worker-errors"] = worker_errors
+            results["valid?"] = False
+        if crash is not None:
+            results["crashed"] = repr(crash)
+            results["valid?"] = False
+        self.write_store(results)
+        return results
+
+    # --- analysis ---------------------------------------------------------
+
+    def check(self) -> Dict[str, Any]:
+        history = self.history.records()
+        results = {
+            "perf": perf_checker(history),
+            "stats": stats_checker(history),
+            "net": net_stats_checker(self.journal, history),
+            "availability": availability_checker(
+                history, self.opts["availability"]),
+        }
+        checker = self.workload.get("checker")
+        if checker is not None:
+            try:
+                results["workload"] = checker(history, self.opts)
+            except Exception as e:
+                traceback.print_exc()
+                results["workload"] = {"valid?": False,
+                                       "error": repr(e)}
+        results["valid?"] = all(
+            r.get("valid?", True) is not False
+            for r in results.values() if isinstance(r, dict))
+        return results
+
+    def write_store(self, results: Dict[str, Any]):
+        if not self.store_dir:
+            self.journal.close()
+            return
+        self.history.write_jsonl(os.path.join(self.store_dir,
+                                              "history.jsonl"))
+        with open(os.path.join(self.store_dir, "results.json"), "w") as f:
+            json.dump(results, f, indent=2, default=repr)
+        try:
+            from .net.viz import plot_lamport
+            plot_lamport(self.journal,
+                         os.path.join(self.store_dir, "messages.svg"))
+        except Exception:
+            traceback.print_exc()
+        try:
+            from .checkers.perf import plot_perf
+            plot_perf(self.history.records(), self.store_dir)
+        except Exception:
+            traceback.print_exc()
+        self.journal.close()
+        # maintain store/<workload>/latest symlink (doc/results.md:7-9)
+        latest = os.path.join(os.path.dirname(self.store_dir), "latest")
+        try:
+            if os.path.islink(latest):
+                os.unlink(latest)
+            os.symlink(os.path.basename(self.store_dir), latest)
+        except OSError:
+            pass
+
+
+def run_test(workload_name: str, opts: Dict[str, Any]) -> Dict[str, Any]:
+    """Look up the workload by name, build it with opts, and run."""
+    from .workloads import get_workload
+    merged = {**DEFAULTS, **opts}
+    workload = get_workload(workload_name)(merged)
+    return TestRunner(workload_name, workload, merged).run()

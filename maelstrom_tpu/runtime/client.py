@@ -1,0 +1,140 @@
+"""Client / RPC layer.
+
+A :class:`Client` occupies a network node id ``c<N>`` and issues synchronous,
+one-outstanding-request RPCs to nodes: send a request with a fresh ``msg_id``,
+then receive until a reply with matching ``in_reply_to`` arrives or the
+timeout elapses. ``error`` replies raise :class:`~..core.errors.RPCError`;
+:func:`with_errors` maps exceptions to operation outcomes the way checkers
+expect (timeouts / indefinite errors -> ``info`` unless the op is idempotent,
+definite errors -> ``fail``).
+
+Parity: reference src/maelstrom/client.clj — open!/close! :41-59, send!
+:66-79, recv! :81-117, rpc! :140-151, with-errors :153-172, defrpc
+schema-checking :228-270 (here :func:`rpc_call` + the schema registry).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional, Set
+
+from ..core import errors, schema
+from ..core.errors import RPCError
+from ..net.net import Net
+
+DEFAULT_TIMEOUT = 5.0   # seconds (client.clj:18-20)
+
+
+class Client:
+    def __init__(self, net: Net, node_id: str,
+                 timeout: float = DEFAULT_TIMEOUT):
+        self.net = net
+        self.node_id = node_id
+        self.timeout = timeout
+        self._next_msg_id = 0
+        self._lock = threading.Lock()
+
+    @classmethod
+    def open(cls, net: Net, timeout: float = DEFAULT_TIMEOUT) -> "Client":
+        """Allocate a fresh client node id c0, c1, ... on this network."""
+        with net._client_ctr_lock:
+            n = net._client_ctr
+            net._client_ctr = n + 1
+        node_id = f"c{n}"
+        net.add_node(node_id)
+        return cls(net, node_id, timeout)
+
+    def close(self):
+        self.net.remove_node(self.node_id)
+
+    def new_msg_id(self) -> int:
+        with self._lock:
+            i = self._next_msg_id
+            self._next_msg_id = i + 1
+            return i
+
+    def send(self, dest: str, body: dict) -> int:
+        """Send a request with a fresh msg_id; returns the msg_id."""
+        body = dict(body)
+        msg_id = self.new_msg_id()
+        body["msg_id"] = msg_id
+        self.net.send(self.node_id, dest, body)
+        return msg_id
+
+    def recv_reply(self, msg_id: int, timeout: Optional[float] = None) -> dict:
+        """Receive until a reply to msg_id arrives; unrelated messages are
+        discarded (one outstanding request at a time, client.clj:81-117)."""
+        timeout = self.timeout if timeout is None else timeout
+        deadline = time.monotonic() + timeout
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise errors.timeout(
+                    f"timed out after {timeout}s waiting for reply to "
+                    f"msg {msg_id} on {self.node_id}")
+            m = self.net.recv(self.node_id, remaining)
+            if m is None:
+                raise errors.timeout(
+                    f"timed out after {timeout}s waiting for reply to "
+                    f"msg {msg_id} on {self.node_id}")
+            if m.body.get("in_reply_to") == msg_id:
+                return self._throw_errors(m.body)
+
+    @staticmethod
+    def _throw_errors(body: dict) -> dict:
+        if body.get("type") == "error":
+            code = body.get("code")
+            if not isinstance(code, int):
+                raise errors.malformed_request(
+                    f"error body without integer code: {body!r}")
+            raise RPCError(code, body.get("text", ""))
+        return body
+
+    def rpc(self, dest: str, body: dict,
+            timeout: Optional[float] = None) -> dict:
+        msg_id = self.send(dest, body)
+        return self.recv_reply(msg_id, timeout)
+
+
+def rpc_call(client: Client, dest: str, namespace: str, rpc_type: str,
+             timeout: Optional[float] = None, **fields) -> dict:
+    """Schema-checked RPC using the registry (the defrpc equivalent).
+
+    Validates the request body against the registered request schema, issues
+    the RPC, and validates the reply against the response schema.
+    """
+    d = schema.get_rpc(namespace, rpc_type)
+    body = dict(fields)
+    body["type"] = rpc_type
+    if d is not None:
+        schema.check(d.full_request_schema(), {**body, "msg_id": 0},
+                     f"{rpc_type} request")
+    resp = client.rpc(dest, body, timeout)
+    if d is not None:
+        schema.check(d.full_response_schema(), resp, f"{rpc_type} response")
+    return resp
+
+
+def with_errors(op: dict, idempotent: Set[str], fn):
+    """Run fn() (which completes ``op`` and returns it); map errors to
+    Jepsen-style outcomes (client.clj:153-172):
+
+    - timeout / indefinite error -> type ``fail`` if op's :f is idempotent
+      (safe to treat an unknown outcome as failure), else ``info``
+    - definite RPC error -> type ``fail`` with the error attached
+    """
+    try:
+        return fn()
+    except RPCError as e:
+        out = dict(op)
+        if e.code == 0:  # timeout
+            out["type"] = "fail" if op.get("f") in idempotent else "info"
+            out["error"] = ["timeout", e.text]
+        elif e.definite:
+            out["type"] = "fail"
+            out["error"] = [e.name, e.text]
+        else:
+            out["type"] = "fail" if op.get("f") in idempotent else "info"
+            out["error"] = [e.name, e.text]
+        return out

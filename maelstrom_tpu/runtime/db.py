@@ -1,0 +1,86 @@
+"""Cluster lifecycle: bring up services + node processes, init handshake,
+teardown with crash diagnostics.
+
+Parity: reference src/maelstrom/db.clj — setup :24-69 (services on primary,
+spawn nodes, ``init`` RPC with 10s timeout requiring ``init_ok``), teardown
+:71-82 (kill processes then services).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+from ..core import errors
+from ..net.net import Net
+from .client import Client
+from .process import NodeProcess, start_node
+from .services import Service, default_services, start_services, stop_services
+
+INIT_TIMEOUT = 10.0  # seconds (db.clj:60)
+
+
+class DB:
+    def __init__(self, net: Net, node_ids: List[str], bin: str,
+                 args: Optional[List[str]] = None,
+                 log_dir: Optional[str] = None, log_stderr: bool = False,
+                 seed: Optional[int] = None):
+        self.net = net
+        self.node_ids = node_ids
+        self.bin = bin
+        self.args = args or []
+        self.log_dir = log_dir
+        self.log_stderr = log_stderr
+        self.seed = seed
+        self.processes: Dict[str, NodeProcess] = {}
+        self.services: List[Service] = []
+
+    def setup(self):
+        self.services = start_services(default_services(self.net,
+                                                        seed=self.seed))
+        try:
+            for node_id in self.node_ids:
+                self.processes[node_id] = start_node(
+                    node_id, self.bin, self.args, self.net,
+                    log_dir=self.log_dir, log_stderr=self.log_stderr)
+            self._init_all()
+        except Exception:
+            self.teardown(raise_crashes=False)
+            raise
+
+    def _init_all(self):
+        """Send the init RPC to every node (db.clj:46-69)."""
+        client = Client.open(self.net, timeout=INIT_TIMEOUT)
+        try:
+            for node_id in self.node_ids:
+                body = {"type": "init", "node_id": node_id,
+                        "node_ids": list(self.node_ids)}
+                try:
+                    reply = client.rpc(node_id, body, timeout=INIT_TIMEOUT)
+                except errors.RPCError as e:
+                    proc = self.processes.get(node_id)
+                    extra = ""
+                    if proc is not None and not proc.alive():
+                        extra = "\n\n" + proc._crash_report(proc.proc.poll())
+                    raise RuntimeError(
+                        f"node {node_id} did not acknowledge the init "
+                        f"message within {INIT_TIMEOUT}s: {e}{extra}"
+                    ) from None
+                if reply.get("type") != "init_ok":
+                    raise RuntimeError(
+                        f"expected init_ok from {node_id}, got {reply!r}")
+        finally:
+            client.close()
+
+    def teardown(self, raise_crashes: bool = True):
+        crash_errors = []
+        for node_id, proc in self.processes.items():
+            try:
+                proc.stop()
+            except Exception as e:
+                crash_errors.append(e)
+        self.processes = {}
+        stop_services(self.services)
+        self.services = []
+        if crash_errors and raise_crashes:
+            raise crash_errors[0]

@@ -1,0 +1,210 @@
+"""Process runtime: runs user nodes as child processes.
+
+Each node is an arbitrary binary speaking newline-delimited JSON over
+STDIN/STDOUT and logging to STDERR. Three daemon threads bridge it to the
+simulated network:
+
+- stdin thread:  ``net.recv(node)`` -> JSON line -> child stdin
+- stdout thread: child stdout line -> parse/validate -> ``net.send``
+- stderr thread: child stderr line -> per-node log file (+ optional console)
+
+The last 32 lines of stdout/stderr are kept in ring buffers so that crashes
+produce useful diagnostics. Malformed output produces teaching-quality error
+messages, since this framework is a learning tool first.
+
+Parity: reference src/maelstrom/process.clj — io threads :68-166, ring
+buffers :22-24, parse-msg :35-66, start-node! :168-215, stop-node! :217-256.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shlex
+import subprocess
+import threading
+from collections import deque
+from typing import List, Optional
+
+from ..core.message import Message
+from ..net.net import Net
+
+RING_BUFFER_LINES = 32
+
+
+class NodeCrashed(RuntimeError):
+    pass
+
+
+def parse_msg(node_id: str, line: str) -> Message:
+    """Parse one stdout line from a node into a Message, with helpful
+    errors (process.clj:35-66)."""
+    try:
+        d = json.loads(line)
+    except json.JSONDecodeError as e:
+        raise ValueError(
+            f"node {node_id} printed a line to STDOUT which was not "
+            f"well-formed JSON:\n\n  {line!r}\n\nParse error: {e}. Remember "
+            f"that every line printed to STDOUT must be a JSON message; use "
+            f"STDERR for debugging output.") from None
+    if not isinstance(d, dict):
+        raise ValueError(
+            f"node {node_id} printed a JSON value to STDOUT which was not "
+            f"an object:\n\n  {line!r}\n\nMessages must be JSON objects with "
+            f"src, dest, and body fields.")
+    for k in ("src", "dest", "body"):
+        if k not in d:
+            raise ValueError(
+                f"node {node_id} printed a message missing its {k!r} "
+                f"field:\n\n  {line!r}")
+    if not isinstance(d["body"], dict):
+        raise ValueError(
+            f"node {node_id} printed a message whose body is not an "
+            f"object:\n\n  {line!r}")
+    if not isinstance(d["body"].get("type"), str):
+        raise ValueError(
+            f"node {node_id} printed a message whose body has no string "
+            f"'type' field:\n\n  {line!r}")
+    return Message(id=-1, src=d["src"], dest=d["dest"], body=d["body"])
+
+
+class NodeProcess:
+    """A running node child process bridged to the network."""
+
+    def __init__(self, node_id: str, cmd: List[str], net: Net,
+                 log_path: Optional[str] = None, log_stderr: bool = False):
+        self.node_id = node_id
+        self.cmd = cmd
+        self.net = net
+        self.log_stderr = log_stderr
+        self.stdout_ring = deque(maxlen=RING_BUFFER_LINES)
+        self.stderr_ring = deque(maxlen=RING_BUFFER_LINES)
+        self.error: Optional[Exception] = None
+        self._stop = threading.Event()
+        self._log_file = open(log_path, "w") if log_path else None
+
+        self.proc = subprocess.Popen(
+            cmd, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True, bufsize=1)
+        self._threads = [
+            threading.Thread(target=self._stdin_loop,
+                             name=f"{node_id}-stdin", daemon=True),
+            threading.Thread(target=self._stdout_loop,
+                             name=f"{node_id}-stdout", daemon=True),
+            threading.Thread(target=self._stderr_loop,
+                             name=f"{node_id}-stderr", daemon=True),
+        ]
+        for t in self._threads:
+            t.start()
+
+    # --- io threads -------------------------------------------------------
+
+    def _stdin_loop(self):
+        """Pump network deliveries into the child's stdin
+        (process.clj:154-166)."""
+        try:
+            while not self._stop.is_set():
+                m = self.net.recv(self.node_id, timeout=1.0)
+                if m is None:
+                    continue
+                line = json.dumps(m.to_wire())
+                self.proc.stdin.write(line + "\n")
+                self.proc.stdin.flush()
+        except (BrokenPipeError, OSError, ValueError):
+            pass  # child exited
+        except Exception as e:  # node removed from net etc.
+            if not self._stop.is_set():
+                self.error = self.error or e
+
+    def _stdout_loop(self):
+        """Parse the child's stdout lines and put them on the network
+        (process.clj:136-152)."""
+        try:
+            for line in self.proc.stdout:
+                line = line.rstrip("\n")
+                if not line:
+                    continue
+                self.stdout_ring.append(line)
+                try:
+                    m = parse_msg(self.node_id, line)
+                except ValueError as e:
+                    self.error = self.error or e
+                    continue
+                try:
+                    self.net.send(m.src, m.dest, m.body)
+                except Exception as e:
+                    self.error = self.error or e
+        except (OSError, ValueError):
+            pass
+
+    def _stderr_loop(self):
+        """Copy the child's stderr to the node log (process.clj:115-134)."""
+        try:
+            for line in self.proc.stderr:
+                line = line.rstrip("\n")
+                self.stderr_ring.append(line)
+                if self._log_file:
+                    self._log_file.write(line + "\n")
+                    self._log_file.flush()
+                if self.log_stderr:
+                    print(f"[{self.node_id}] {line}", flush=True)
+        except (OSError, ValueError):
+            pass
+
+    # --- lifecycle --------------------------------------------------------
+
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+    def stop(self, timeout: float = 5.0):
+        """Stop the node; raise NodeCrashed with diagnostics if it had
+        already died or misbehaved (process.clj:217-256)."""
+        crashed = not self.alive()
+        exit_code = self.proc.poll()
+        self._stop.set()
+        if not crashed:
+            try:
+                self.proc.terminate()
+                self.proc.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait()
+        for t in self._threads:
+            t.join(timeout=2.0)
+        for pipe in (self.proc.stdin, self.proc.stdout, self.proc.stderr):
+            try:
+                pipe and pipe.close()
+            except Exception:
+                pass
+        if self._log_file:
+            self._log_file.close()
+            self._log_file = None
+        if crashed:
+            raise NodeCrashed(self._crash_report(exit_code))
+        if self.error:
+            e, self.error = self.error, None
+            raise NodeCrashed(
+                f"node {self.node_id} emitted invalid output:\n{e}")
+
+    def _crash_report(self, exit_code) -> str:
+        out = "\n".join(self.stdout_ring) or "(none)"
+        err = "\n".join(self.stderr_ring) or "(none)"
+        return (f"node {self.node_id} ({shlex.join(self.cmd)}) exited with "
+                f"status {exit_code} before the test finished.\n\n"
+                f"Last lines of STDOUT:\n{out}\n\n"
+                f"Last lines of STDERR:\n{err}")
+
+
+def start_node(node_id: str, bin: str, args: List[str], net: Net,
+               log_dir: Optional[str] = None,
+               log_stderr: bool = False) -> NodeProcess:
+    """Register node_id on the network and spawn its binary
+    (process.clj:168-215)."""
+    net.add_node(node_id)
+    log_path = None
+    if log_dir:
+        os.makedirs(log_dir, exist_ok=True)
+        log_path = os.path.join(log_dir, f"{node_id}.log")
+    cmd = [bin] + list(args)
+    return NodeProcess(node_id, cmd, net, log_path=log_path,
+                       log_stderr=log_stderr)

@@ -1,0 +1,292 @@
+"""Built-in network services.
+
+Services are harness-provided nodes that user nodes can call as primitives:
+``lin-kv`` (linearizable KV), ``seq-kv`` (sequentially-consistent KV),
+``lww-kv`` (eventually-consistent last-write-wins KV), and ``lin-tso`` (a
+linearizable monotonic timestamp oracle). Each service is a *pure state
+machine* wrapped in a *consistency wrapper* and run as a network node on its
+own thread.
+
+Parity: reference src/maelstrom/service.clj — PersistentKV :31-56, LWWKV
+:65-114, PersistentTSO :116-132, Linearizable :141-155, Sequential :161-210,
+Eventual :214-243, worker loop :245-263, default services :290-296.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+from ..core import errors
+from ..core.errors import RPCError
+from ..core.message import reply_body
+from ..net.net import Net
+
+
+# --- pure state machines --------------------------------------------------
+
+class PersistentKV:
+    """read / write / cas over an immutable map (service.clj:31-56)."""
+
+    name = "persistent-kv"
+
+    def initial(self):
+        return {}
+
+    def read_only(self, body: dict) -> bool:
+        return body.get("type") == "read"
+
+    def handle(self, state: dict, body: dict) -> Tuple[dict, dict]:
+        t = body.get("type")
+        if t == "read":
+            k = body.get("key")
+            if k not in state:
+                raise errors.key_does_not_exist(f"key {k!r} does not exist")
+            return state, reply_body(body, type="read_ok", value=state[k])
+        if t == "write":
+            s = dict(state)
+            s[body.get("key")] = body.get("value")
+            return s, reply_body(body, type="write_ok")
+        if t == "cas":
+            k = body.get("key")
+            if k not in state:
+                if body.get("create_if_not_exists"):
+                    s = dict(state)
+                    s[k] = body.get("to")
+                    return s, reply_body(body, type="cas_ok")
+                raise errors.key_does_not_exist(f"key {k!r} does not exist")
+            if state[k] != body.get("from"):
+                raise errors.precondition_failed(
+                    f"expected {body.get('from')!r}, but had {state[k]!r}")
+            s = dict(state)
+            s[k] = body.get("to")
+            return s, reply_body(body, type="cas_ok")
+        raise errors.not_supported(f"unknown op type {t!r}")
+
+
+class LWWKV(PersistentKV):
+    """Last-write-wins KV: every value carries a Lamport clock; states are
+    mergeable by pointwise max of (clock, value) (service.clj:65-114)."""
+
+    name = "lww-kv"
+
+    def initial(self):
+        # key -> (clock, value); plus a local clock under key ``"__clock__"``
+        return {"__clock__": 0}
+
+    def _tick(self, state):
+        return state["__clock__"] + 1
+
+    def handle(self, state, body):
+        t = body.get("type")
+        clock = self._tick(state)
+        if t == "read":
+            k = body.get("key")
+            if k == "__clock__" or k not in state:
+                raise errors.key_does_not_exist(f"key {k!r} does not exist")
+            _, v = state[k]
+            return state, reply_body(body, type="read_ok", value=v)
+        if t == "write":
+            s = dict(state)
+            s[body.get("key")] = (clock, body.get("value"))
+            s["__clock__"] = clock
+            return s, reply_body(body, type="write_ok")
+        if t == "cas":
+            k = body.get("key")
+            if k == "__clock__" or k not in state:
+                if body.get("create_if_not_exists"):
+                    s = dict(state)
+                    s[k] = (clock, body.get("to"))
+                    s["__clock__"] = clock
+                    return s, reply_body(body, type="cas_ok")
+                raise errors.key_does_not_exist(f"key {k!r} does not exist")
+            _, v = state[k]
+            if v != body.get("from"):
+                raise errors.precondition_failed(
+                    f"expected {body.get('from')!r}, but had {v!r}")
+            s = dict(state)
+            s[k] = (clock, body.get("to"))
+            s["__clock__"] = clock
+            return s, reply_body(body, type="cas_ok")
+        raise errors.not_supported(f"unknown op type {t!r}")
+
+    def merge(self, a, b):
+        """Pointwise last-write-wins merge: higher clock wins; equal clocks
+        tie-break deterministically on the value's repr (values may be
+        mutually incomparable JSON)."""
+        def newer(x, y):
+            return (y[0], repr(y[1])) > (x[0], repr(x[1]))
+
+        out = dict(a)
+        for k, v in b.items():
+            if k == "__clock__":
+                out[k] = max(out.get(k, 0), v)
+            elif k not in out or newer(out[k], v):
+                out[k] = v
+        return out
+
+
+class PersistentTSO:
+    """Monotonic timestamp oracle (service.clj:116-132)."""
+
+    name = "lin-tso"
+
+    def initial(self):
+        return 0
+
+    def read_only(self, body):
+        return False
+
+    def handle(self, state, body):
+        if body.get("type") == "ts":
+            return state + 1, reply_body(body, type="ts_ok", ts=state + 1)
+        raise errors.not_supported(f"unknown op type {body.get('type')!r}")
+
+
+# --- consistency wrappers -------------------------------------------------
+
+class Linearizable:
+    """All ops applied to a single current state under a lock
+    (service.clj:141-155)."""
+
+    def __init__(self, machine):
+        self.machine = machine
+        self.state = machine.initial()
+        self.lock = threading.Lock()
+
+    def handle(self, client: str, body: dict) -> dict:
+        with self.lock:
+            self.state, reply = self.machine.handle(self.state, body)
+            return reply
+
+
+class Sequential:
+    """Keeps a ring of recent states. Read-only ops from a client may be
+    served by *any* state at least as new as that client's watermark (then
+    advance the watermark); mutations always apply to the newest state. This
+    yields a per-client-monotonic total order without real-time recency
+    (service.clj:161-210)."""
+
+    RING = 16
+
+    def __init__(self, machine, seed: Optional[int] = None):
+        self.machine = machine
+        self.states = [machine.initial()]   # index 0 is oldest retained
+        self.base = 0                       # absolute index of states[0]
+        self.watermarks: Dict[str, int] = {}
+        self.lock = threading.Lock()
+        self.rng = random.Random(seed)
+
+    def newest_index(self) -> int:
+        return self.base + len(self.states) - 1
+
+    def handle(self, client: str, body: dict) -> dict:
+        with self.lock:
+            wm = self.watermarks.get(client, self.base)
+            wm = max(wm, self.base)
+            if self.machine.read_only(body):
+                idx = self.rng.randint(wm, self.newest_index())
+                state = self.states[idx - self.base]
+                _, reply = self.machine.handle(state, body)
+                self.watermarks[client] = idx
+                return reply
+            state = self.states[-1]
+            new_state, reply = self.machine.handle(state, body)
+            self.states.append(new_state)
+            if len(self.states) > self.RING:
+                self.states.pop(0)
+                self.base += 1
+            self.watermarks[client] = self.newest_index()
+            return reply
+
+
+class Eventual:
+    """n independent replicas; each op is applied at a random replica, and
+    random pairs of replicas merge over time (service.clj:214-243). Requires
+    a mergeable machine (LWWKV)."""
+
+    def __init__(self, machine, n: int = 5, merge_prob: float = 0.5,
+                 seed: Optional[int] = None):
+        self.machine = machine
+        self.replicas = [machine.initial() for _ in range(n)]
+        self.lock = threading.Lock()
+        self.merge_prob = merge_prob
+        self.rng = random.Random(seed)
+
+    def handle(self, client: str, body: dict) -> dict:
+        with self.lock:
+            if len(self.replicas) > 1 and self.rng.random() < self.merge_prob:
+                i, j = self.rng.sample(range(len(self.replicas)), 2)
+                self.replicas[i] = self.machine.merge(self.replicas[i],
+                                                      self.replicas[j])
+            i = self.rng.randrange(len(self.replicas))
+            self.replicas[i], reply = self.machine.handle(self.replicas[i],
+                                                          body)
+            return reply
+
+
+# --- service worker -------------------------------------------------------
+
+class Service:
+    """A wrapped state machine running as a network node on its own thread
+    (service.clj:245-263)."""
+
+    def __init__(self, name: str, wrapper, net: Net):
+        self.name = name
+        self.wrapper = wrapper
+        self.net = net
+        self._stop = threading.Event()
+        self.thread = threading.Thread(target=self._run, name=f"svc-{name}",
+                                       daemon=True)
+
+    def start(self):
+        self.net.add_node(self.name)
+        self.thread.start()
+
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                m = self.net.recv(self.name, timeout=0.2)
+            except Exception:
+                break
+            if m is None:
+                continue
+            try:
+                reply = self.wrapper.handle(m.src, m.body)
+            except RPCError as e:
+                reply = e.to_body(in_reply_to=m.body.get("msg_id"))
+            except Exception as e:
+                reply = RPCError(13, f"service {self.name} crashed: {e}"
+                                 ).to_body(in_reply_to=m.body.get("msg_id"))
+            try:
+                self.net.send(self.name, m.src, reply)
+            except Exception:
+                pass
+
+    def stop(self):
+        self._stop.set()
+        self.thread.join(timeout=2.0)
+        self.net.remove_node(self.name)
+
+
+def default_services(net: Net, seed: Optional[int] = None):
+    """lww-kv (eventual), seq-kv (sequential), lin-kv (linearizable),
+    lin-tso (linearizable TSO) — service.clj:290-296."""
+    return [
+        Service("lww-kv", Eventual(LWWKV(), seed=seed), net),
+        Service("seq-kv", Sequential(PersistentKV(), seed=seed), net),
+        Service("lin-kv", Linearizable(PersistentKV()), net),
+        Service("lin-tso", Linearizable(PersistentTSO()), net),
+    ]
+
+
+def start_services(services):
+    for s in services:
+        s.start()
+    return services
+
+
+def stop_services(services):
+    for s in services:
+        s.stop()

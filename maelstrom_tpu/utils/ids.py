@@ -1,0 +1,32 @@
+"""Node-id helpers. Parity: reference src/maelstrom/util.clj:7-28."""
+
+from __future__ import annotations
+
+import re
+from typing import Iterable, List
+
+
+def is_client(node_id: str) -> bool:
+    """Client node ids begin with 'c' (e.g. c1, c2...)."""
+    return isinstance(node_id, str) and node_id.startswith("c")
+
+
+def involves_client(msg) -> bool:
+    return is_client(msg.src) or is_client(msg.dest)
+
+
+_NAT = re.compile(r"(\d+)")
+
+
+def _natural_key(s: str):
+    return [int(p) if p.isdigit() else p for p in _NAT.split(s)]
+
+
+def sort_ids(ids: Iterable[str]) -> List[str]:
+    """Natural sort: n2 < n10, c1 < c2 < n0."""
+    return sorted(ids, key=_natural_key)
+
+
+def node_names(count: int, prefix: str = "n") -> List[str]:
+    """Node names n0..n(count-1). Parity: core.clj:231-238."""
+    return [f"{prefix}{i}" for i in range(count)]

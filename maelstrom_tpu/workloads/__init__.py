@@ -1,0 +1,24 @@
+"""Workload registry. Parity: reference src/maelstrom/core.clj:36-47."""
+
+from __future__ import annotations
+
+from . import broadcast, echo, g_set, lin_kv, pn_counter, unique_ids
+
+
+WORKLOADS = {
+    "echo": echo.workload,
+    "broadcast": broadcast.workload,
+    "g-set": g_set.workload,
+    "g-counter": pn_counter.g_counter_workload,
+    "pn-counter": pn_counter.workload,
+    "lin-kv": lin_kv.workload,
+    "unique-ids": unique_ids.workload,
+}
+
+
+def get_workload(name: str):
+    try:
+        return WORKLOADS[name]
+    except KeyError:
+        raise ValueError(f"unknown workload {name!r}; known workloads: "
+                         f"{sorted(WORKLOADS)}") from None

@@ -1,0 +1,46 @@
+"""Shared workload-client scaffolding.
+
+Every workload provides a client factory ``(net, node, opts) -> client``
+whose ``invoke(op) -> completed-op`` issues schema-checked RPCs against its
+assigned node, mapping errors to outcomes via
+:func:`~..runtime.client.with_errors`. This mirrors the reference's shared
+client lifecycle (SURVEY §2.2: open!/invoke!/with-errors/idempotent sets).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Set
+
+from ..runtime.client import Client, rpc_call, with_errors
+
+
+class WorkloadClient:
+    namespace = ""              # schema registry namespace
+    idempotent: Set[str] = frozenset()
+
+    def __init__(self, net, node: str, opts: dict,
+                 timeout: Optional[float] = None):
+        self.net = net
+        self.node = node
+        self.opts = opts
+        self.client = Client.open(net)
+        if timeout is not None:
+            self.client.timeout = timeout
+        self.setup()
+
+    def setup(self):
+        pass
+
+    def call(self, rpc_type: str, timeout: Optional[float] = None, **fields
+             ) -> dict:
+        return rpc_call(self.client, self.node, self.namespace, rpc_type,
+                        timeout=timeout, **fields)
+
+    def invoke(self, op: dict) -> dict:
+        return with_errors(op, self.idempotent, lambda: self.apply(op))
+
+    def apply(self, op: dict) -> dict:
+        raise NotImplementedError
+
+    def close(self):
+        self.client.close()

@@ -1,0 +1,87 @@
+"""Broadcast workload: a gossip protocol. Clients broadcast integers to
+single nodes; every node must eventually see every broadcast message.
+
+Nodes receive a ``topology`` message suggesting a neighbor graph (selected
+by ``--topology``: grid / line / total / tree2-4), ``broadcast`` messages to
+propagate, and ``read`` requests returning all messages seen so far.
+
+Parity: reference src/maelstrom/workload/broadcast.clj (RPCs :19-38,
+topologies :40-178, checker = jepsen set-full with broadcast->add rename
+:216-228, generator :237-240).
+"""
+
+from __future__ import annotations
+
+import itertools
+
+from ..core import schema
+from ..gen.generators import each_thread, op
+from ..checkers.set_full import set_full_checker
+from ..utils.ids import node_names
+from .base import WorkloadClient
+from .topology import make_topology
+
+schema.rpc(
+    "broadcast", "topology",
+    "A topology message is sent at the start of the test, after initial "
+    "setup, and informs the node of an optional network topology: a map of "
+    "nodes to neighbors.",
+    request={"topology": schema.MapOf(str, [str])},
+    response={})
+
+schema.rpc(
+    "broadcast", "broadcast",
+    "Sends a single message into the broadcast system, and requests that it "
+    "be broadcast to everyone. Nodes respond with a simple acknowledgement "
+    "message.",
+    request={"message": schema.Any},
+    response={})
+
+schema.rpc(
+    "broadcast", "read",
+    "Requests all messages present on a node.",
+    request={},
+    response={"messages": [schema.Any]})
+
+
+class BroadcastClient(WorkloadClient):
+    namespace = "broadcast"
+    idempotent = frozenset({"read"})
+
+    def setup(self):
+        # every node gets the topology, not just this worker's assigned
+        # node — with concurrency < node_count some nodes have no client
+        nodes = node_names(self.opts["node_count"])
+        topo = make_topology(self.opts.get("topology") or "grid", nodes)
+        from ..runtime.client import rpc_call
+        for n in nodes:
+            rpc_call(self.client, n, self.namespace, "topology",
+                     topology=topo)
+
+    def apply(self, o):
+        if o["f"] == "broadcast":
+            self.call("broadcast", message=o["value"])
+            return {**o, "type": "ok"}
+        if o["f"] == "read":
+            resp = self.call("read")
+            return {**o, "type": "ok", "value": resp["messages"]}
+        raise ValueError(f"unknown op {o['f']!r}")
+
+
+def workload(opts):
+    counter = itertools.count()
+
+    def gen(rng):
+        while True:
+            if rng.random() < 0.5:
+                yield op("broadcast", next(counter))
+            else:
+                yield op("read")
+
+    return {
+        "client": lambda net, node, o: BroadcastClient(net, node, o),
+        "generator": gen,
+        "final_generator": each_thread(lambda: [op("read")]),
+        "checker": lambda h, o: set_full_checker(h, add_f="broadcast",
+                                                 read_f="read"),
+    }

@@ -1,0 +1,52 @@
+"""Echo workload: a "hello world" — clients send a unique string, nodes must
+echo it back verbatim.
+
+Parity: reference src/maelstrom/workload/echo.clj (RPC schema :15-22,
+checker :44-63, generator :72-76).
+"""
+
+from __future__ import annotations
+
+from ..core import schema
+from ..gen.generators import each_thread, op
+from .base import WorkloadClient
+
+schema.rpc(
+    "echo", "echo",
+    "Clients send `echo` messages to servers with an `echo` field containing "
+    "an arbitrary payload they'd like to have sent back. Servers should "
+    "respond with `echo_ok` messages containing that same payload.",
+    request={"echo": schema.Any},
+    response={"echo": schema.Any})
+
+
+class EchoClient(WorkloadClient):
+    namespace = "echo"
+    idempotent = frozenset({"echo"})
+
+    def apply(self, o):
+        resp = self.call("echo", echo=o["value"])
+        return {**o, "type": "ok", "echo": resp.get("echo")}
+
+
+def echo_checker(history, opts) -> dict:
+    bad = [r for r in history
+           if r["type"] == "ok" and r["f"] == "echo"
+           and r.get("echo") != r["value"]]
+    return {"valid?": not bad, "errors": bad[:16],
+            "ok-count": sum(1 for r in history
+                            if r["type"] == "ok" and r["f"] == "echo")}
+
+
+def workload(opts):
+    def make_op(rng):
+        return op("echo", f"Please echo {rng.randrange(128)}")
+    def gen(rng):
+        while True:
+            yield make_op(rng)
+    return {
+        "client": lambda net, node, o: EchoClient(net, node, o),
+        "generator": gen,
+        "final_generator": None,
+        "checker": echo_checker,
+    }

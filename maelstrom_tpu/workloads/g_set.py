@@ -1,0 +1,61 @@
+"""Grow-only set workload: clients add unique elements to single nodes and
+read the full set; the checker verifies no acknowledged add is lost.
+
+Parity: reference src/maelstrom/workload/g_set.clj (RPCs :13-26, generator
+:59-61, checker = jepsen set-full :62).
+"""
+
+from __future__ import annotations
+
+import itertools
+
+from ..core import schema
+from ..gen.generators import each_thread, op
+from ..checkers.set_full import set_full_checker
+from .base import WorkloadClient
+
+schema.rpc(
+    "g-set", "add",
+    "Requests that a server add a single element to the set.",
+    request={"element": schema.Any},
+    response={})
+
+schema.rpc(
+    "g-set", "read",
+    "Requests the current set of all elements. Servers respond with a "
+    "message containing an `elements` key, whose `value` is a JSON array of "
+    "added elements.",
+    request={},
+    response={"value": [schema.Any]})
+
+
+class GSetClient(WorkloadClient):
+    namespace = "g-set"
+    idempotent = frozenset({"read"})
+
+    def apply(self, o):
+        if o["f"] == "add":
+            self.call("add", element=o["value"])
+            return {**o, "type": "ok"}
+        if o["f"] == "read":
+            resp = self.call("read")
+            return {**o, "type": "ok", "value": resp["value"]}
+        raise ValueError(f"unknown op {o['f']!r}")
+
+
+def workload(opts):
+    counter = itertools.count()
+
+    def gen(rng):
+        while True:
+            if rng.random() < 0.5:
+                yield op("add", next(counter))
+            else:
+                yield op("read")
+
+    return {
+        "client": lambda net, node, o: GSetClient(net, node, o),
+        "generator": gen,
+        "final_generator": each_thread(lambda: [op("read")]),
+        "checker": lambda h, o: set_full_checker(h),
+    }

@@ -1,0 +1,93 @@
+"""Linearizable key-value workload: per-key read / write / compare-and-set
+against a shared register namespace, checked for linearizability.
+
+Op values follow the reference's register encoding: ``[k, v]`` for
+read/write, ``[k, [from, to]]`` for cas. The per-op timeout scales with
+simulated latency: ``max(10 * latency, 1s)``.
+
+Parity: reference src/maelstrom/workload/lin_kv.clj (RPCs :12-38, timeout
+:54, generator via jepsen.tests.linearizable-register :78-85; the checker
+role of Knossos is played by checkers/linearizable.py).
+"""
+
+from __future__ import annotations
+
+from ..core import errors, schema
+from ..checkers.linearizable import linearizable_kv_checker
+from ..gen.generators import op
+from .base import WorkloadClient
+
+schema.rpc(
+    "lin-kv", "read",
+    "Reads the current value of a single key. Clients send a read request "
+    "with the key they'd like to observe, and expect a response with the "
+    "current value of that key.",
+    request={"key": schema.Any},
+    response={"value": schema.Any})
+
+schema.rpc(
+    "lin-kv", "write",
+    "Blindly overwrites the value of a key. Creates keys if they do not "
+    "presently exist.",
+    request={"key": schema.Any, "value": schema.Any},
+    response={})
+
+schema.rpc(
+    "lin-kv", "cas",
+    "Atomically compare-and-sets a single key: if the value of `key` is "
+    "currently `from`, sets it to `to`. Returns error 20 if the key doesn't "
+    "exist, and 22 if the `from` value doesn't match.",
+    request={"key": schema.Any, "from": schema.Any, "to": schema.Any},
+    response={})
+
+
+class LinKVClient(WorkloadClient):
+    namespace = "lin-kv"
+    idempotent = frozenset({"read"})
+
+    def __init__(self, net, node, opts):
+        timeout = max(10 * opts.get("latency", 0) / 1000.0, 1.0)
+        super().__init__(net, node, opts, timeout=timeout)
+
+    def apply(self, o):
+        k, arg = o["value"]
+        if o["f"] == "read":
+            try:
+                resp = self.call("read", key=k)
+                return {**o, "type": "ok", "value": [k, resp["value"]]}
+            except errors.RPCError as e:
+                if e.code == 20:  # missing key reads as nil
+                    return {**o, "type": "ok", "value": [k, None]}
+                raise
+        if o["f"] == "write":
+            self.call("write", key=k, value=arg)
+            return {**o, "type": "ok"}
+        if o["f"] == "cas":
+            frm, to = arg
+            self.call("cas", key=k, **{"from": frm, "to": to})
+            return {**o, "type": "ok"}
+        raise ValueError(f"unknown op {o['f']!r}")
+
+
+def workload(opts):
+    key_count = opts.get("key_count") or 5
+    max_val = 5
+
+    def gen(rng):
+        while True:
+            k = rng.randrange(key_count)
+            r = rng.random()
+            if r < 1 / 3:
+                yield op("read", [k, None])
+            elif r < 2 / 3:
+                yield op("write", [k, rng.randrange(max_val)])
+            else:
+                yield op("cas", [k, [rng.randrange(max_val),
+                                     rng.randrange(max_val)]])
+
+    return {
+        "client": lambda net, node, o: LinKVClient(net, node, o),
+        "generator": gen,
+        "final_generator": None,
+        "checker": lambda h, o: linearizable_kv_checker(h),
+    }

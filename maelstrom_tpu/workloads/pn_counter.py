@@ -1,0 +1,69 @@
+"""Eventually-consistent counter workloads.
+
+``pn-counter``: clients add arbitrary (possibly negative) deltas and read
+the counter; the checker uses interval arithmetic over definite and
+indeterminate adds. ``g-counter`` is the same with non-negative deltas.
+
+Parity: reference src/maelstrom/workload/pn_counter.clj (RPCs :20-33,
+checker :79-125, generator :133-136) and g_counter.clj :15-40.
+"""
+
+from __future__ import annotations
+
+from ..core import schema
+from ..gen.generators import each_thread, op
+from ..checkers.pn_counter import pn_counter_checker
+from .base import WorkloadClient
+
+for ns in ("pn-counter", "g-counter"):
+    schema.rpc(
+        ns, "add",
+        "Adds a (possibly negative) integer to the counter."
+        if ns == "pn-counter" else
+        "Adds a non-negative integer to the counter.",
+        request={"delta": int},
+        response={})
+    schema.rpc(
+        ns, "read",
+        "Reads the current value of the counter.",
+        request={},
+        response={"value": int})
+
+
+class CounterClient(WorkloadClient):
+    namespace = "pn-counter"
+    idempotent = frozenset({"read"})
+
+    def apply(self, o):
+        if o["f"] == "add":
+            self.call("add", delta=o["value"])
+            return {**o, "type": "ok"}
+        if o["f"] == "read":
+            resp = self.call("read")
+            return {**o, "type": "ok", "value": resp["value"]}
+        raise ValueError(f"unknown op {o['f']!r}")
+
+
+def _workload(opts, negative: bool):
+    def gen(rng):
+        while True:
+            if rng.random() < 0.5:
+                delta = rng.randint(-5, 5) if negative else rng.randint(0, 5)
+                yield op("add", delta)
+            else:
+                yield op("read")
+
+    return {
+        "client": lambda net, node, o: CounterClient(net, node, o),
+        "generator": gen,
+        "final_generator": each_thread(lambda: [op("read")]),
+        "checker": lambda h, o: pn_counter_checker(h),
+    }
+
+
+def workload(opts):
+    return _workload(opts, negative=True)
+
+
+def g_counter_workload(opts):
+    return _workload(opts, negative=False)

@@ -1,0 +1,83 @@
+"""Topology generators for the broadcast workload.
+
+A topology maps each node id to the list of neighbors the node *should*
+gossip with. Selected by ``--topology``; the default is grid.
+
+Parity: reference src/maelstrom/workload/broadcast.clj — grid :40-65,
+line :67-80, total :82-89, tree :144-167, registry :169-178.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List
+
+from ..utils.ids import sort_ids
+
+
+def line(nodes: List[str]) -> Dict[str, List[str]]:
+    ns = sort_ids(nodes)
+    topo = {}
+    for i, n in enumerate(ns):
+        nbrs = []
+        if i > 0:
+            nbrs.append(ns[i - 1])
+        if i < len(ns) - 1:
+            nbrs.append(ns[i + 1])
+        topo[n] = nbrs
+    return topo
+
+
+def grid(nodes: List[str]) -> Dict[str, List[str]]:
+    """Arrange nodes in a rough square grid; neighbors up/down/left/right."""
+    ns = sort_ids(nodes)
+    n = len(ns)
+    cols = max(1, int(math.ceil(math.sqrt(n))))
+    coord = {i: (i // cols, i % cols) for i in range(n)}
+    index = {v: k for k, v in coord.items()}
+    topo = {}
+    for i, node in enumerate(ns):
+        r, c = coord[i]
+        nbrs = []
+        for dr, dc in ((-1, 0), (1, 0), (0, -1), (0, 1)):
+            j = index.get((r + dr, c + dc))
+            if j is not None and j < n:
+                nbrs.append(ns[j])
+        topo[node] = nbrs
+    return topo
+
+
+def total(nodes: List[str]) -> Dict[str, List[str]]:
+    ns = sort_ids(nodes)
+    return {n: [m for m in ns if m != n] for n in ns}
+
+
+def tree(branching: int):
+    def make(nodes: List[str]) -> Dict[str, List[str]]:
+        ns = sort_ids(nodes)
+        topo: Dict[str, List[str]] = {n: [] for n in ns}
+        for i, node in enumerate(ns):
+            for k in range(1, branching + 1):
+                j = i * branching + k
+                if j < len(ns):
+                    topo[node].append(ns[j])
+                    topo[ns[j]].append(node)
+        return topo
+    return make
+
+
+TOPOLOGIES = {
+    "line": line,
+    "grid": grid,
+    "total": total,
+    "tree2": tree(2),
+    "tree3": tree(3),
+    "tree4": tree(4),
+}
+
+
+def make_topology(name: str, nodes: List[str]) -> Dict[str, List[str]]:
+    if name not in TOPOLOGIES:
+        raise ValueError(f"unknown topology {name!r}; known: "
+                         f"{sorted(TOPOLOGIES)}")
+    return TOPOLOGIES[name](nodes)

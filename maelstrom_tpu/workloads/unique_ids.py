@@ -1,0 +1,40 @@
+"""Unique-IDs workload: nodes must generate globally unique identifiers
+under concurrency and faults.
+
+Parity: reference src/maelstrom/workload/unique_ids.clj (RPC :31-37,
+generator :71, checker = jepsen unique-ids :72).
+"""
+
+from __future__ import annotations
+
+from ..core import schema
+from ..gen.generators import repeat_op
+from ..checkers.unique_ids import unique_ids_checker
+from .base import WorkloadClient
+
+schema.rpc(
+    "unique-ids", "generate",
+    "Asks a node to generate a new ID. Servers respond with a generate_ok "
+    "message containing an `id` field, which should be a globally unique "
+    "identifier. IDs may be of any type--strings, booleans, integers, "
+    "floats, compound JSON values, etc.",
+    request={},
+    response={"id": schema.Any})
+
+
+class UniqueIdsClient(WorkloadClient):
+    namespace = "unique-ids"
+    idempotent = frozenset()
+
+    def apply(self, o):
+        resp = self.call("generate")
+        return {**o, "type": "ok", "value": resp["id"]}
+
+
+def workload(opts):
+    return {
+        "client": lambda net, node, o: UniqueIdsClient(net, node, o),
+        "generator": repeat_op("generate"),
+        "final_generator": None,
+        "checker": lambda h, o: unique_ids_checker(h),
+    }

@@ -1,0 +1,19 @@
+import os
+import sys
+
+# TPU-runtime tests run on a virtual 8-device CPU mesh; must be set before
+# jax import anywhere in the test session.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def example_bin(name: str) -> list:
+    """Command line for a bundled example node."""
+    return [sys.executable, os.path.join(REPO, "examples", "python", name)]

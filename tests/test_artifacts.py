@@ -1,0 +1,32 @@
+"""Store-dir artifact tests: history/results/journal/plots land on disk
+(reference doc/results.md store layout)."""
+
+import json
+import os
+
+from conftest import example_bin
+from maelstrom_tpu.runner import run_test
+
+
+def test_store_artifacts(tmp_path):
+    bin_cmd = example_bin("echo.py")
+    res = run_test("echo", dict(
+        bin=bin_cmd[0], bin_args=bin_cmd[1:], node_count=1,
+        time_limit=1.0, rate=20.0, concurrency=2, seed=1,
+        store_root=str(tmp_path), snapshot_store=True))
+    assert res["valid?"] is True
+    d = os.path.join(str(tmp_path), "echo")
+    runs = [p for p in os.listdir(d) if p != "latest"]
+    assert len(runs) == 1
+    run_dir = os.path.join(d, runs[0])
+    for artifact in ("history.jsonl", "results.json", "messages.svg",
+                     "latency-raw.svg", "rate.svg", "net-journal",
+                     "node-logs"):
+        assert os.path.exists(os.path.join(run_dir, artifact)), artifact
+    assert os.path.islink(os.path.join(d, "latest"))
+    with open(os.path.join(run_dir, "results.json")) as f:
+        assert json.load(f)["valid?"] is True
+    with open(os.path.join(run_dir, "history.jsonl")) as f:
+        lines = [json.loads(l) for l in f]
+    assert lines and lines[0]["index"] == 0
+    assert {"invoke", "ok"} <= {l["type"] for l in lines}

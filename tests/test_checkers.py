@@ -1,0 +1,155 @@
+"""Checker unit tests on literal histories — the reference's test pattern
+(SURVEY §4: checkers are history->verdict functions)."""
+
+from maelstrom_tpu.checkers.linearizable import (
+    linearizable_kv_checker)
+from maelstrom_tpu.checkers.pn_counter import pn_counter_checker
+from maelstrom_tpu.checkers.set_full import set_full_checker
+from maelstrom_tpu.checkers.unique_ids import unique_ids_checker
+from maelstrom_tpu.checkers.availability import availability_checker
+from maelstrom_tpu.gen.history import History
+
+
+def H(*recs):
+    """Build a history from (process, type, f, value[, extra]) tuples."""
+    out = []
+    for i, r in enumerate(recs):
+        rec = {"process": r[0], "type": r[1], "f": r[2], "value": r[3],
+               "index": i, "time": i}
+        if len(r) > 4:
+            rec.update(r[4])
+        out.append(rec)
+    return out
+
+
+def test_set_full_ok():
+    h = H((0, "invoke", "add", 1), (0, "ok", "add", 1),
+          (1, "invoke", "add", 2), (1, "ok", "add", 2),
+          (0, "invoke", "read", None), (0, "ok", "read", [1, 2]))
+    r = set_full_checker(h)
+    assert r["valid?"] is True
+    assert r["lost-count"] == 0
+    assert r["stable-count"] == 2
+
+
+def test_set_full_lost():
+    h = H((0, "invoke", "add", 1), (0, "ok", "add", 1),
+          (0, "invoke", "read", None), (0, "ok", "read", []))
+    r = set_full_checker(h)
+    assert r["valid?"] is False
+    assert r["lost"] == [1]
+
+
+def test_set_full_indeterminate_add_never_lost():
+    h = H((0, "invoke", "add", 1), (0, "info", "add", 1),
+          (0, "invoke", "read", None), (0, "ok", "read", []))
+    assert set_full_checker(h)["valid?"] is True
+
+
+def test_unique_ids():
+    ok = H((0, "invoke", "generate", None), (0, "ok", "generate", "a"),
+           (1, "invoke", "generate", None), (1, "ok", "generate", "b"))
+    assert unique_ids_checker(ok)["valid?"] is True
+    dup = H((0, "invoke", "generate", None), (0, "ok", "generate", "a"),
+            (1, "invoke", "generate", None), (1, "ok", "generate", "a"))
+    r = unique_ids_checker(dup)
+    assert r["valid?"] is False and r["duplicated-count"] == 1
+
+
+def test_pn_counter_definite_only():
+    h = H((0, "invoke", "add", 3), (0, "ok", "add", 3),
+          (1, "invoke", "add", -1), (1, "ok", "add", -1),
+          (0, "invoke", "read", None), (0, "ok", "read", 2))
+    assert pn_counter_checker(h)["valid?"] is True
+
+
+def test_pn_counter_indeterminate_subset():
+    # definite +3; indeterminate +5 -> reads of 3 or 8 both fine, 5 is not
+    h = H((0, "invoke", "add", 3), (0, "ok", "add", 3),
+          (1, "invoke", "add", 5), (1, "info", "add", 5),
+          (0, "invoke", "read", None), (0, "ok", "read", 8))
+    assert pn_counter_checker(h)["valid?"] is True
+    h_bad = H((0, "invoke", "add", 3), (0, "ok", "add", 3),
+              (1, "invoke", "add", 5), (1, "info", "add", 5),
+              (0, "invoke", "read", None), (0, "ok", "read", 5))
+    assert pn_counter_checker(h_bad)["valid?"] is False
+
+
+def test_availability():
+    h = H((0, "invoke", "read", None), (0, "ok", "read", 1),
+          (1, "invoke", "read", None), (1, "info", "read", None))
+    assert availability_checker(h, None)["valid?"] is True
+    assert availability_checker(h, "total")["valid?"] is False
+    assert availability_checker(h, 0.5)["valid?"] is True
+    assert availability_checker(h, 0.9)["valid?"] is False
+
+
+def test_linearizable_ok():
+    h = H((0, "invoke", "write", [0, 1]), (0, "ok", "write", [0, 1]),
+          (1, "invoke", "read", [0, None]), (1, "ok", "read", [0, 1]),
+          (0, "invoke", "cas", [0, [1, 2]]), (0, "ok", "cas", [0, [1, 2]]),
+          (1, "invoke", "read", [0, None]), (1, "ok", "read", [0, 2]))
+    assert linearizable_kv_checker(h)["valid?"] is True
+
+
+def test_linearizable_violation():
+    # read returns a value that was never written
+    h = H((0, "invoke", "write", [0, 1]), (0, "ok", "write", [0, 1]),
+          (1, "invoke", "read", [0, None]), (1, "ok", "read", [0, 7]))
+    r = linearizable_kv_checker(h)
+    assert r["valid?"] is False and r["bad-keys"] == [0]
+
+
+def test_linearizable_stale_read_violation():
+    # sequential writes 1 then 2 (non-overlapping), then a read of 1: stale
+    h = H((0, "invoke", "write", [0, 1]), (0, "ok", "write", [0, 1]),
+          (0, "invoke", "write", [0, 2]), (0, "ok", "write", [0, 2]),
+          (1, "invoke", "read", [0, None]), (1, "ok", "read", [0, 1]))
+    assert linearizable_kv_checker(h)["valid?"] is False
+
+
+def test_linearizable_concurrent_ok():
+    # concurrent write may linearize before or after the read
+    h = [
+        {"process": 0, "type": "invoke", "f": "write", "value": [0, 1],
+         "index": 0, "time": 0},
+        {"process": 1, "type": "invoke", "f": "read", "value": [0, None],
+         "index": 1, "time": 1},
+        {"process": 1, "type": "ok", "f": "read", "value": [0, None],
+         "index": 2, "time": 2},
+        {"process": 0, "type": "ok", "f": "write", "value": [0, 1],
+         "index": 3, "time": 3},
+    ]
+    assert linearizable_kv_checker(h)["valid?"] is True
+
+
+def test_linearizable_info_op_may_or_may_not_apply():
+    # an info write may have taken effect: read of its value is legal...
+    h = H((0, "invoke", "write", [0, 1]), (0, "info", "write", [0, 1]),
+          (1, "invoke", "read", [0, None]), (1, "ok", "read", [0, 1]))
+    assert linearizable_kv_checker(h)["valid?"] is True
+    # ...and so is never seeing it
+    h2 = H((0, "invoke", "write", [0, 1]), (0, "info", "write", [0, 1]),
+           (1, "invoke", "read", [0, None]), (1, "ok", "read", [0, None]))
+    assert linearizable_kv_checker(h2)["valid?"] is True
+
+
+def test_set_full_vanished_element_is_lost():
+    # element seen once, then permanently missing from later reads -> lost
+    h = H((0, "invoke", "add", 5), (0, "ok", "add", 5),
+          (0, "invoke", "read", None), (0, "ok", "read", [5]),
+          (1, "invoke", "read", None), (1, "ok", "read", []),
+          (1, "invoke", "read", None), (1, "ok", "read", []))
+    r = set_full_checker(h)
+    assert r["valid?"] is False
+    assert r["lost"] == [5]
+
+
+def test_pn_counter_prefers_final_tagged_reads():
+    # mid-test stale read of 3 would be wrong vs the end state, but the
+    # tagged final read of 10 is the one that's judged
+    h = H((0, "invoke", "read", None), (0, "ok", "read", 3),
+          (1, "invoke", "add", 10), (1, "ok", "add", 10),
+          (0, "invoke", "read", None, {"final": True}),
+          (0, "ok", "read", 10))
+    assert pn_counter_checker(h)["valid?"] is True

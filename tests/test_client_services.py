@@ -1,0 +1,129 @@
+"""Unit tests for the client/RPC layer and built-in services (SURVEY §2.1
+client.clj / service.clj semantics)."""
+
+import pytest
+
+from maelstrom_tpu.core.errors import RPCError
+from maelstrom_tpu.net.net import Net
+from maelstrom_tpu.runtime.client import Client, with_errors
+from maelstrom_tpu.runtime.services import (
+    Eventual, Linearizable, LWWKV, PersistentKV, PersistentTSO, Sequential,
+    Service, default_services, start_services, stop_services)
+
+
+def test_client_rpc_roundtrip_with_service():
+    net = Net(seed=0)
+    svc = Service("lin-kv", Linearizable(PersistentKV()), net)
+    svc.start()
+    try:
+        c = Client.open(net)
+        assert c.node_id == "c0"
+        c2 = Client.open(net)
+        assert c2.node_id == "c1"
+        resp = c.rpc("lin-kv", {"type": "write", "key": "x", "value": 5})
+        assert resp["type"] == "write_ok"
+        resp = c.rpc("lin-kv", {"type": "read", "key": "x"})
+        assert resp["value"] == 5
+        with pytest.raises(RPCError) as ei:
+            c.rpc("lin-kv", {"type": "read", "key": "nope"})
+        assert ei.value.code == 20
+        with pytest.raises(RPCError) as ei:
+            c.rpc("lin-kv", {"type": "cas", "key": "x", "from": 9, "to": 1})
+        assert ei.value.code == 22
+        resp = c.rpc("lin-kv", {"type": "cas", "key": "x", "from": 5,
+                                "to": 6})
+        assert resp["type"] == "cas_ok"
+        resp = c.rpc("lin-kv", {"type": "cas", "key": "new", "from": 0,
+                                "to": 1, "create_if_not_exists": True})
+        assert resp["type"] == "cas_ok"
+    finally:
+        svc.stop()
+
+
+def test_tso_monotonic():
+    net = Net(seed=0)
+    svc = Service("lin-tso", Linearizable(PersistentTSO()), net)
+    svc.start()
+    try:
+        c = Client.open(net)
+        ts = [c.rpc("lin-tso", {"type": "ts"})["ts"] for _ in range(5)]
+        assert ts == sorted(ts) and len(set(ts)) == 5
+    finally:
+        svc.stop()
+
+
+def test_with_errors_mapping():
+    op = {"f": "read", "value": None}
+
+    def boom_timeout():
+        raise RPCError(0, "timed out")
+
+    # timeout on idempotent op -> fail; non-idempotent -> info
+    assert with_errors(dict(op), {"read"}, boom_timeout)["type"] == "fail"
+    assert with_errors(dict(op), set(), boom_timeout)["type"] == "info"
+
+    def boom_definite():
+        raise RPCError(22, "nope")
+
+    out = with_errors(dict(op), set(), boom_definite)
+    assert out["type"] == "fail"
+    assert out["error"][0] == "precondition-failed"
+
+    def boom_indefinite():
+        raise RPCError(13, "crash")
+
+    assert with_errors(dict(op), set(), boom_indefinite)["type"] == "info"
+
+
+def test_sequential_wrapper_per_client_monotonic():
+    """Mirrors the reference's service_test.clj: a fresh client may read a
+    stale state, a write forces recency, repeated reads converge."""
+    seq = Sequential(PersistentKV(), seed=7)
+    # build up some history via one client
+    for i in range(10):
+        seq.handle("c1", {"type": "write", "key": "x", "value": i})
+    # a fresh client may see any historical state; values must be
+    # non-decreasing per client across repeated reads
+    last = -1
+    for _ in range(50):
+        v = seq.handle("c2", {"type": "read", "key": "x"})["value"]
+        assert v >= last
+        last = v
+    # after the client writes, its reads must reflect at least that state
+    seq.handle("c2", {"type": "write", "key": "x", "value": 99})
+    assert seq.handle("c2", {"type": "read", "key": "x"})["value"] == 99
+
+
+def test_lww_merge():
+    kv = LWWKV()
+    a = kv.initial()
+    b = kv.initial()
+    a, _ = kv.handle(a, {"type": "write", "key": "k", "value": "a"})
+    b, _ = kv.handle(b, {"type": "write", "key": "k", "value": "b"})
+    b, _ = kv.handle(b, {"type": "write", "key": "k", "value": "b2"})
+    m = kv.merge(a, b)
+    # b2 has the higher clock
+    _, reply = kv.handle(m, {"type": "read", "key": "k"})
+    assert reply["value"] == "b2"
+
+
+def test_eventual_wrapper_converges_on_merge():
+    ev = Eventual(LWWKV(), n=3, merge_prob=1.0, seed=3)
+    ev.handle("c1", {"type": "write", "key": "x", "value": 1})
+    # eventually every replica should learn x via merges
+    seen = 0
+    for _ in range(200):
+        try:
+            ev.handle("c1", {"type": "read", "key": "x"})
+            seen += 1
+        except RPCError:
+            pass
+    assert seen > 150
+
+
+def test_default_services_start_stop():
+    net = Net(seed=0)
+    svcs = start_services(default_services(net, seed=0))
+    assert set(net.nodes()) == {"lww-kv", "seq-kv", "lin-kv", "lin-tso"}
+    stop_services(svcs)
+    assert net.nodes() == []

@@ -1,0 +1,30 @@
+"""End-to-end tests of the process runtime against bundled example nodes —
+the equivalent of the reference's `demo` self-test (core.clj:104-126)."""
+
+import pytest
+
+from conftest import example_bin
+from maelstrom_tpu.runner import run_test
+
+
+def run(workload, node, **opts):
+    bin_cmd = example_bin(node)
+    base = dict(bin=bin_cmd[0], bin_args=bin_cmd[1:], snapshot_store=False,
+                time_limit=2.0, rate=30.0, concurrency=4, recovery_time=0.5,
+                seed=42)
+    base.update(opts)
+    return run_test(workload, base)
+
+
+def test_echo_e2e():
+    res = run("echo", "echo.py", node_count=1)
+    assert res["workload"]["valid?"] is True
+    assert res["workload"]["ok-count"] > 10
+    assert res["valid?"] is True
+    assert res["net"]["stats"]["all"]["send-count"] > 0
+
+
+def test_echo_availability_total():
+    res = run("echo", "echo.py", node_count=2, availability="total")
+    assert res["availability"]["valid?"] is True
+    assert res["availability"]["ok-fraction"] == 1.0

@@ -1,0 +1,99 @@
+"""Unit tests for the host simulated network (SURVEY §2.1 net.clj
+semantics: deadline ordering, client zero-latency, receiver-side partition
+drop, loss)."""
+
+import time
+
+from maelstrom_tpu.net.net import Latency, Net
+
+
+def make_net(**kw):
+    net = Net(**kw)
+    for n in ("n0", "n1", "c0"):
+        net.add_node(n)
+    return net
+
+
+def test_send_recv_roundtrip():
+    net = make_net(seed=0)
+    net.send("n0", "n1", {"type": "hi", "msg_id": 1})
+    m = net.recv("n1", timeout=1.0)
+    assert m is not None
+    assert m.src == "n0" and m.dest == "n1" and m.body["type"] == "hi"
+
+
+def test_recv_timeout_returns_none():
+    net = make_net(seed=0)
+    t0 = time.monotonic()
+    assert net.recv("n1", timeout=0.05) is None
+    assert time.monotonic() - t0 >= 0.04
+
+
+def test_latency_delays_server_traffic_but_not_clients():
+    net = make_net(latency=Latency(50, "constant"), seed=0)
+    # server->server takes ~50ms
+    t0 = time.monotonic()
+    net.send("n0", "n1", {"type": "x"})
+    assert net.recv("n1", timeout=1.0) is not None
+    assert time.monotonic() - t0 >= 0.045
+    # client traffic is always zero-latency (net.clj:178-187)
+    t0 = time.monotonic()
+    net.send("c0", "n1", {"type": "x"})
+    assert net.recv("n1", timeout=1.0) is not None
+    assert time.monotonic() - t0 < 0.04
+
+
+def test_deadline_ordering_not_fifo():
+    net = make_net(seed=0)
+    # manually enqueue with distinct latencies by toggling the latency dist
+    net.latency = Latency(100, "constant")
+    net.send("n0", "n1", {"type": "slow"})
+    net.latency = Latency(0, "constant")
+    net.send("n0", "n1", {"type": "fast"})
+    m1 = net.recv("n1", timeout=1.0)
+    m2 = net.recv("n1", timeout=1.0)
+    assert m1.body["type"] == "fast"
+    assert m2.body["type"] == "slow"
+
+
+def test_partition_drops_at_delivery():
+    net = make_net(seed=0)
+    net.drop("n0", "n1")  # n1 refuses messages from n0
+    net.send("n0", "n1", {"type": "x"})
+    assert net.recv("n1", timeout=0.1) is None
+    # other direction unaffected
+    net.send("n1", "n0", {"type": "y"})
+    assert net.recv("n0", timeout=1.0) is not None
+    net.heal()
+    net.send("n0", "n1", {"type": "z"})
+    assert net.recv("n1", timeout=1.0) is not None
+
+
+def test_loss():
+    net = make_net(p_loss=1.0, seed=0)
+    net.send("n0", "n1", {"type": "x"})
+    assert net.recv("n1", timeout=0.1) is None
+
+
+def test_journal_counts():
+    net = make_net(seed=0)
+    net.send("n0", "n1", {"type": "x"})
+    net.recv("n1", timeout=1.0)
+    net.send("c0", "n0", {"type": "y"})
+    net.recv("n0", timeout=1.0)
+    s = net.journal.stats()
+    assert s["all"]["send-count"] == 2
+    assert s["all"]["recv-count"] == 2
+    assert s["servers"]["msg-count"] == 1
+    assert s["clients"]["msg-count"] == 1
+
+
+def test_flaky_and_slow_adapters():
+    net = make_net(seed=0)
+    net.flaky()
+    assert net.p_loss == 0.5
+    net.reliable()
+    assert net.p_loss == 0.0
+    net.slow()
+    assert net.latency.mean == 0.0  # base was 0
+    net.fast()
