@@ -1,0 +1,67 @@
+#!/usr/bin/env python3
+"""Broadcast node: gossips messages along the topology with retries, so
+broadcasts survive partitions. The role of the reference's
+demo/ruby/broadcast.rb (retry loop) for the broadcast workload."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from node import Node  # noqa: E402
+
+node = Node()
+messages = set()
+neighbors = []
+# pending[(dest, msg)] until acked
+pending = set()
+
+
+@node.on("topology")
+def topology(msg):
+    global neighbors
+    neighbors = msg["body"]["topology"].get(node.node_id, [])
+    node.log(f"topology: neighbors = {neighbors}")
+    node.reply(msg, {"type": "topology_ok"})
+
+
+def gossip(m, exclude):
+    for nbr in neighbors:
+        if nbr == exclude:
+            continue
+        pending.add((nbr, m))
+
+
+@node.on("broadcast")
+def broadcast(msg):
+    m = msg["body"]["message"]
+    if m not in messages:
+        messages.add(m)
+        gossip(m, exclude=msg["src"])
+    node.reply(msg, {"type": "broadcast_ok"})
+
+
+@node.on("gossip")
+def handle_gossip(msg):
+    m = msg["body"]["message"]
+    if m not in messages:
+        messages.add(m)
+        gossip(m, exclude=msg["src"])
+    node.reply(msg, {"type": "gossip_ok"})
+
+
+@node.on("read")
+def read(msg):
+    node.reply(msg, {"type": "read_ok", "messages": sorted(messages)})
+
+
+@node.every(0.2)
+def retry():
+    # re-send every unacked gossip; acks prune the pending set
+    for dest, m in list(pending):
+        def on_ack(reply, key=(dest, m)):
+            pending.discard(key)
+        node.rpc(dest, {"type": "gossip", "message": m}, on_ack)
+
+
+if __name__ == "__main__":
+    node.run()

@@ -1,0 +1,39 @@
+#!/usr/bin/env python3
+"""G-set CRDT node: a grow-only set, periodically gossiping the full state
+to all peers; merge = set union. The role of the reference's
+demo/ruby/g_set.rb / demo/js/crdt_gset.js."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from node import Node  # noqa: E402
+
+node = Node()
+elements = set()
+
+
+@node.on("add")
+def add(msg):
+    elements.add(msg["body"]["element"])
+    node.reply(msg, {"type": "add_ok"})
+
+
+@node.on("read")
+def read(msg):
+    node.reply(msg, {"type": "read_ok", "value": sorted(elements)})
+
+
+@node.on("replicate")
+def replicate(msg):
+    elements.update(msg["body"]["value"])
+
+
+@node.every(0.2)
+def gossip():
+    for peer in node.other_node_ids():
+        node.send(peer, {"type": "replicate", "value": sorted(elements)})
+
+
+if __name__ == "__main__":
+    node.run()

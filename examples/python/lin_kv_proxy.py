@@ -1,0 +1,44 @@
+#!/usr/bin/env python3
+"""Trivially linearizable KV node: proxies every op to the built-in lin-kv
+service. The role of the reference's demo/ruby/lin_kv_proxy.rb — exercises
+the service path end-to-end."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from node import KV, Node, RPCError  # noqa: E402
+
+node = Node()
+kv = KV(node, KV.LIN, timeout=2.0)
+
+
+@node.on("read")
+def read(msg):
+    try:
+        value = kv.read(msg["body"]["key"])
+    except RPCError as e:
+        node.reply_error(msg, e)
+        return
+    node.reply(msg, {"type": "read_ok", "value": value})
+
+
+@node.on("write")
+def write(msg):
+    kv.write(msg["body"]["key"], msg["body"]["value"])
+    node.reply(msg, {"type": "write_ok"})
+
+
+@node.on("cas")
+def cas(msg):
+    b = msg["body"]
+    try:
+        kv.cas(b["key"], b["from"], b["to"], create_if_not_exists=False)
+    except RPCError as e:
+        node.reply_error(msg, e)
+        return
+    node.reply(msg, {"type": "cas_ok"})
+
+
+if __name__ == "__main__":
+    node.run()

@@ -1,0 +1,53 @@
+#!/usr/bin/env python3
+"""PN-counter CRDT node: one [plus, minus] pair per node, gossiped and
+merged pointwise-max; value = sum(plus) - sum(minus). The role of the
+reference's demo/ruby/pn_counter.rb / demo/js/crdt_pn_counter.js."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from node import Node  # noqa: E402
+
+node = Node()
+# node_id -> [plus, minus]
+counters = {}
+
+
+def merge(other):
+    for n, (p, m) in other.items():
+        cp, cm = counters.get(n, (0, 0))
+        counters[n] = [max(cp, p), max(cm, m)]
+
+
+@node.on("add")
+def add(msg):
+    delta = msg["body"]["delta"]
+    p, m = counters.setdefault(node.node_id, [0, 0])
+    if delta >= 0:
+        counters[node.node_id] = [p + delta, m]
+    else:
+        counters[node.node_id] = [p, m - delta]
+    node.reply(msg, {"type": "add_ok"})
+
+
+@node.on("read")
+def read(msg):
+    value = sum(p for p, _ in counters.values()) - \
+        sum(m for _, m in counters.values())
+    node.reply(msg, {"type": "read_ok", "value": value})
+
+
+@node.on("replicate")
+def replicate(msg):
+    merge({n: tuple(v) for n, v in msg["body"]["value"].items()})
+
+
+@node.every(0.2)
+def gossip():
+    for peer in node.other_node_ids():
+        node.send(peer, {"type": "replicate", "value": counters})
+
+
+if __name__ == "__main__":
+    node.run()

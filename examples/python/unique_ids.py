@@ -1,0 +1,24 @@
+#!/usr/bin/env python3
+"""Flake-style unique-ID node: ids are [node_id, counter], unique without
+coordination. The role of the reference's demo/clojure/flake_ids.clj."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from node import Node  # noqa: E402
+
+node = Node()
+counter = 0
+
+
+@node.on("generate")
+def generate(msg):
+    global counter
+    counter += 1
+    node.reply(msg, {"type": "generate_ok",
+                     "id": [node.node_id, counter]})
+
+
+if __name__ == "__main__":
+    node.run()

@@ -83,9 +83,16 @@ class NodeProcess:
         self._stop = threading.Event()
         self._log_file = open(log_path, "w") if log_path else None
 
+        # Node processes are plain protocol programs: make sure they never
+        # initialize an accelerator runtime, even on machines where a
+        # sitecustomize hook registers one in every interpreter (concurrent
+        # child startups would otherwise contend for the device and hang).
+        env = {k: v for k, v in os.environ.items()
+               if k not in ("PALLAS_AXON_POOL_IPS",)}
+        env.setdefault("JAX_PLATFORMS", "cpu")
         self.proc = subprocess.Popen(
             cmd, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
-            stderr=subprocess.PIPE, text=True, bufsize=1)
+            stderr=subprocess.PIPE, text=True, bufsize=1, env=env)
         self._threads = [
             threading.Thread(target=self._stdin_loop,
                              name=f"{node_id}-stdin", daemon=True),

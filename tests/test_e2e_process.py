@@ -28,3 +28,50 @@ def test_echo_availability_total():
     res = run("echo", "echo.py", node_count=2, availability="total")
     assert res["availability"]["valid?"] is True
     assert res["availability"]["ok-fraction"] == 1.0
+
+
+def test_broadcast_e2e():
+    res = run("broadcast", "broadcast.py", node_count=5, topology="grid",
+              time_limit=3.0, recovery_time=1.0)
+    w = res["workload"]
+    assert w["valid?"] is True, w
+    assert w["acknowledged-count"] > 0
+    assert w["lost-count"] == 0
+    assert res["net"]["msgs-per-op"] > 0
+
+
+def test_broadcast_partition_e2e():
+    res = run("broadcast", "broadcast.py", node_count=5, topology="tree4",
+              time_limit=4.0, recovery_time=2.0,
+              nemesis=["partition"], nemesis_interval=1.0)
+    w = res["workload"]
+    assert w["lost-count"] == 0, w
+
+
+def test_g_set_partition_e2e():
+    res = run("g-set", "g_set.py", node_count=3, time_limit=3.0,
+              recovery_time=1.5, nemesis=["partition"],
+              nemesis_interval=1.0)
+    w = res["workload"]
+    assert w["valid?"] is True, w
+    assert w["lost-count"] == 0
+
+
+def test_pn_counter_e2e():
+    res = run("pn-counter", "pn_counter.py", node_count=3, time_limit=3.0,
+              recovery_time=1.0)
+    assert res["workload"]["valid?"] is True, res["workload"]
+
+
+def test_unique_ids_e2e():
+    res = run("unique-ids", "unique_ids.py", node_count=3, time_limit=2.0)
+    w = res["workload"]
+    assert w["valid?"] is True
+    assert w["acknowledged-count"] > 10
+
+
+def test_lin_kv_proxy_e2e():
+    res = run("lin-kv", "lin_kv_proxy.py", node_count=2, time_limit=3.0)
+    w = res["workload"]
+    assert w["valid?"] is True, w
+    assert w["key-count"] > 0
