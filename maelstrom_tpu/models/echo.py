@@ -1,0 +1,77 @@
+"""Vectorized echo model: the TPU-runtime counterpart of the echo workload
+(reference src/maelstrom/workload/echo.clj + demo echo nodes).
+
+Stateless servers; an ``echo`` request is answered with an ``echo_ok``
+carrying the same payload lane. This is the minimal end-to-end slice of the
+device loop (SURVEY §7 step 5): it proves delivery, client op injection,
+history extraction, and checker integration with near-zero protocol logic.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..tpu import wire
+from ..tpu.runtime import (EV_FAIL, EV_INFO, EV_OK, Model, OP_LANES)
+
+TYPE_ECHO = 1
+TYPE_ECHO_OK = 2
+
+F_ECHO = 1
+
+
+class EchoModel(Model):
+    name = "echo"
+    body_lanes = 2
+    max_out = 1
+    tick_out = 0
+    idempotent_fs = (F_ECHO,)
+
+    def init_row(self, n_nodes, node_idx, key, params):
+        return jnp.zeros((), dtype=jnp.int32)   # stateless
+
+    def handle(self, row, node_idx, msg, t, key, cfg, params):
+        is_echo = msg[wire.TYPE] == TYPE_ECHO
+        out = jnp.zeros((1, cfg.lanes), dtype=jnp.int32)
+        out = out.at[0, wire.VALID].set(jnp.where(is_echo, 1, 0))
+        out = out.at[0, wire.DEST].set(msg[wire.SRC])
+        out = out.at[0, wire.TYPE].set(TYPE_ECHO_OK)
+        out = out.at[0, wire.REPLYTO].set(msg[wire.MSGID])
+        out = out.at[0, wire.BODY].set(msg[wire.BODY])
+        return row, out
+
+    # --- client side ------------------------------------------------------
+
+    def sample_op(self, key, cfg, params):
+        payload = jax.random.randint(key, (), 0, 1_000_000, dtype=jnp.int32)
+        return jnp.array([F_ECHO, 0, 0, 0], jnp.int32).at[1].set(payload)
+
+    def encode_request(self, op, msg_id, client_idx, key, cfg, params):
+        dest = jax.random.randint(key, (), 0, cfg.n_nodes, dtype=jnp.int32)
+        return wire.make_msg(src=0, dest=dest, type_=TYPE_ECHO,
+                             msg_id=msg_id, body=(op[1],),
+                             body_lanes=self.body_lanes)
+
+    def decode_reply(self, op, msg, cfg, params):
+        ok = msg[wire.TYPE] == TYPE_ECHO_OK
+        etype = jnp.where(ok, EV_OK, EV_INFO)
+        # value lanes: (received payload, sent payload, -)
+        value = jnp.array([0, 0, 0], jnp.int32)
+        value = value.at[0].set(msg[wire.BODY])
+        value = value.at[1].set(op[1])
+        return etype, value
+
+    # --- host-side history decoding --------------------------------------
+
+    def invoke_record(self, f, a, b, c):
+        return {"f": "echo", "value": int(a)}
+
+    def complete_record(self, f, a, b, c, etype):
+        if etype == EV_OK:
+            return {"f": "echo", "value": int(b), "echo": int(a)}
+        return {"f": "echo", "value": None}
+
+    def checker(self):
+        from ..workloads.echo import echo_checker
+        return lambda history, opts: echo_checker(history, opts)

@@ -1,0 +1,152 @@
+"""Host-side harness for the TPU runtime: configure, run, decode, check.
+
+``run_tpu_test`` mirrors ``runner.run_test``'s contract for the device
+runtime: build a :class:`SimConfig` from CLI-style opts, run the jitted
+scan, decode the recorded instances' event streams into per-instance
+op histories, run the workload checker on every recorded instance, and
+aggregate — plus whole-fleet message statistics from the device counters.
+
+The virtual clock maps wall-clock knobs onto ticks: 1 tick == 1 simulated
+millisecond (so ``--latency 100`` is 100 ticks and a 5s RPC timeout is
+5000 ticks). Rates are converted from ops/sec to per-tick client firing
+probabilities.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .netsim import LATENCY_DISTS, NetConfig
+from .runtime import (ClientConfig, EV_FAIL, EV_INFO, EV_INVOKE, EV_NONE,
+                      EV_OK, Model, NemesisConfig, SimConfig, run_sim)
+
+MS_PER_TICK = 1  # virtual clock resolution
+
+ETYPE_NAMES = {EV_OK: "ok", EV_FAIL: "fail", EV_INFO: "info"}
+
+
+TPU_DEFAULTS = dict(
+    node_count=1,
+    concurrency=2,           # clients per instance
+    rate=100.0,              # ops/sec per instance
+    time_limit=2.0,          # simulated seconds
+    latency=10.0,            # mean inter-node latency, ms (= ticks)
+    latency_dist="exponential",
+    p_loss=0.0,
+    nemesis=[],
+    nemesis_interval=0.5,    # simulated seconds between phase flips
+    rpc_timeout=1.0,         # simulated seconds
+    n_instances=64,
+    record_instances=8,
+    pool_slots=128,
+    inbox_k=8,
+    seed=0,
+)
+
+
+def make_sim_config(model: Model, opts: Dict[str, Any]) -> SimConfig:
+    o = {**TPU_DEFAULTS, **opts}
+    n_ticks = int(o["time_limit"] * 1000 / MS_PER_TICK)
+    net = NetConfig(
+        n_nodes=o["node_count"],
+        n_clients=o["concurrency"],
+        pool_slots=o["pool_slots"],
+        inbox_k=o["inbox_k"],
+        body_lanes=model.body_lanes,
+        latency_mean=float(o["latency"]) / MS_PER_TICK,
+        latency_dist=LATENCY_DISTS[o["latency_dist"]],
+        p_loss=float(o["p_loss"]),
+    )
+    client = ClientConfig(
+        n_clients=o["concurrency"],
+        rate=min(1.0, float(o["rate"]) / o["concurrency"] / 1000.0
+                 * MS_PER_TICK),
+        timeout_ticks=int(o["rpc_timeout"] * 1000 / MS_PER_TICK),
+    )
+    nemesis = NemesisConfig(
+        enabled="partition" in (o["nemesis"] or []),
+        interval=max(1, int(o["nemesis_interval"] * 1000 / MS_PER_TICK)),
+        kind=o.get("nemesis_kind", "random-halves"),
+    )
+    return SimConfig(net=net, client=client, nemesis=nemesis,
+                     n_instances=o["n_instances"], n_ticks=n_ticks,
+                     record_instances=min(o["record_instances"],
+                                          o["n_instances"]))
+
+
+def events_to_histories(model: Model, events: np.ndarray
+                        ) -> List[List[dict]]:
+    """Decode the [T, R, C, 2, EV_LANES] device event tensor into one
+    Jepsen-style history per recorded instance."""
+    T, R, C, _, _ = events.shape
+    histories: List[List[dict]] = [[] for _ in range(R)]
+    # vectorized scan for nonzero events to avoid python-looping over T*R*C
+    etypes = events[..., 0]
+    nz = np.argwhere(etypes != EV_NONE)
+    # ensure order: by tick, then slot 0 (completions) before slot 1
+    nz = nz[np.lexsort((nz[:, 3], nz[:, 2], nz[:, 1], nz[:, 0]))]
+    for t, r, c, slot in nz:
+        ev = events[t, r, c, slot]
+        etype = int(ev[0])
+        f, a, b, cc = int(ev[1]), int(ev[2]), int(ev[3]), int(ev[4])
+        time_ns = int(t) * MS_PER_TICK * 1_000_000
+        if etype == EV_INVOKE:
+            rec = model.invoke_record(f, a, b, cc)
+            rec.update({"process": int(c), "type": "invoke",
+                        "time": time_ns})
+        else:
+            rec = model.complete_record(f, a, b, cc, etype)
+            rec.update({"process": int(c), "type": ETYPE_NAMES[etype],
+                        "time": time_ns})
+        h = histories[r]
+        rec["index"] = len(h)
+        h.append(rec)
+    return histories
+
+
+def run_tpu_test(model: Model, opts: Optional[Dict[str, Any]] = None,
+                 params=None) -> Dict[str, Any]:
+    opts = {**TPU_DEFAULTS, **(opts or {})}
+    sim = make_sim_config(model, opts)
+    t0 = time.monotonic()
+    carry, events = run_sim(model, sim, opts["seed"], params)
+    events = np.asarray(events)
+    wall = time.monotonic() - t0
+
+    histories = events_to_histories(model, events)
+    checker = model.checker()
+    per_instance = []
+    for h in histories:
+        try:
+            per_instance.append(checker(h, opts))
+        except Exception as e:  # checker blow-up is a result, not a crash
+            per_instance.append({"valid?": False, "error": repr(e)})
+    n_valid = sum(1 for r in per_instance
+                  if r.get("valid?") in (True, "unknown"))
+    stats = carry.stats
+    total_msgs = int(stats.delivered)
+    results = {
+        "valid?": n_valid == len(per_instance),
+        "instance-count": sim.n_instances,
+        "checked-instances": len(per_instance),
+        "valid-instances": n_valid,
+        "instances": per_instance[:8],
+        "net": {
+            "sent": int(stats.sent),
+            "delivered": int(stats.delivered),
+            "dropped-partition": int(stats.dropped_partition),
+            "dropped-loss": int(stats.dropped_loss),
+            "dropped-overflow": int(stats.dropped_overflow),
+        },
+        "perf": {
+            "wall-s": wall,
+            "ticks": sim.n_ticks,
+            "msgs-per-sec": total_msgs / wall if wall > 0 else 0.0,
+            "instance-ticks-per-sec": (sim.n_instances * sim.n_ticks / wall
+                                       if wall > 0 else 0.0),
+        },
+    }
+    return results

@@ -1,0 +1,188 @@
+"""Device-resident simulated network: the TPU-native equivalent of
+``net.clj``'s priority queues.
+
+Per protocol instance, in-flight messages live in a fixed pool of ``S``
+slots. Each virtual-clock tick:
+
+- :func:`deliver` hands every node up to ``K`` deliverable messages
+  (deadline <= t, destined to it, not blocked by the receiver-side
+  partition matrix). Blocked-but-due messages are *dropped*, matching the
+  reference's recv-side silent drop (net.clj:234). Excess deliverable
+  messages simply stay queued for the next tick.
+- :func:`enqueue` inserts newly sent messages into free pool slots with a
+  sampled latency deadline (constant / uniform / exponential, in ticks),
+  probabilistic loss, and zero latency on client links (net.clj:178-187).
+  Pool overflow drops messages and counts them (an explicit, journaled
+  form of packet loss — SURVEY §7 hard parts).
+
+Everything is pure, fixed-shape, and vmappable over the instance axis;
+`vmap(deliver)` / `vmap(enqueue)` are the hot ops of the whole TPU runtime.
+No scalar loops: slot selection is argsort/top_k over lane masks, which XLA
+lowers to vectorized sort networks.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from . import wire
+
+LATENCY_CONSTANT = 0
+LATENCY_UNIFORM = 1
+LATENCY_EXPONENTIAL = 2
+
+LATENCY_DISTS = {"constant": LATENCY_CONSTANT, "uniform": LATENCY_UNIFORM,
+                 "exponential": LATENCY_EXPONENTIAL}
+
+
+class NetConfig(NamedTuple):
+    """Static network parameters (python-level, closed over at trace time)."""
+    n_nodes: int            # server nodes
+    n_clients: int
+    pool_slots: int         # S
+    inbox_k: int            # max deliveries per node per tick
+    body_lanes: int
+    latency_mean: float     # mean latency in ticks
+    latency_dist: int       # LATENCY_* enum
+    p_loss: float
+
+    @property
+    def n_total(self) -> int:
+        return self.n_nodes + self.n_clients
+
+    @property
+    def lanes(self) -> int:
+        return wire.lanes(self.body_lanes)
+
+
+class NetStats(NamedTuple):
+    """Per-instance counters (int32)."""
+    sent: jnp.ndarray
+    delivered: jnp.ndarray
+    dropped_partition: jnp.ndarray
+    dropped_loss: jnp.ndarray
+    dropped_overflow: jnp.ndarray
+
+    @staticmethod
+    def zeros():
+        z = jnp.int32(0)
+        return NetStats(z, z, z, z, z)
+
+
+def empty_pool(cfg: NetConfig) -> jnp.ndarray:
+    return jnp.zeros((cfg.pool_slots, cfg.lanes), dtype=jnp.int32)
+
+
+def no_partitions(cfg: NetConfig) -> jnp.ndarray:
+    """partitions[dest, src] True = dest refuses traffic from src."""
+    return jnp.zeros((cfg.n_total, cfg.n_total), dtype=bool)
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def deliver(pool: jnp.ndarray, partitions: jnp.ndarray, t: jnp.ndarray,
+            cfg: NetConfig) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray,
+                                     jnp.ndarray]:
+    """One delivery round for a single instance.
+
+    Returns ``(pool', inbox, n_delivered, n_dropped_partition)`` where
+    ``inbox`` is ``[n_total, K, lanes]`` (invalid rows zeroed).
+    """
+    S = cfg.pool_slots
+    valid = pool[:, wire.VALID] == 1
+    due = valid & (pool[:, wire.DTICK] <= t)
+    dest = pool[:, wire.DEST]
+    src = pool[:, wire.SRC]
+    blocked = partitions[dest, src]           # [S]
+
+    # drop due+blocked messages now (recv-side partition drop)
+    drop_mask = due & blocked
+    # candidate deliveries per node: [NT, S]
+    node_ids = jnp.arange(cfg.n_total, dtype=jnp.int32)
+    cand = (due & ~blocked)[None, :] & (dest[None, :] == node_ids[:, None])
+
+    # pick K due slots per node, oldest deadline first (prevents parked
+    # high-index slots from being starved by fresh low-index arrivals),
+    # tie-broken by slot index for determinism
+    slot_order = jnp.arange(S, dtype=jnp.int32)
+    age_rank = ((1 << 20) - pool[:, wire.DTICK]) * S
+    prio = jnp.where(cand, age_rank[None, :] + (S - slot_order)[None, :], 0)
+    topv, topi = jax.lax.top_k(prio, cfg.inbox_k)       # [NT, K]
+    take = topv > 0
+    inbox = jnp.where(take[:, :, None], pool[topi], 0)
+
+    # clear delivered + dropped slots from the pool
+    taken_slots = jnp.zeros((S,), dtype=bool)
+    taken_slots = taken_slots.at[topi.reshape(-1)].max(take.reshape(-1))
+    cleared = taken_slots | drop_mask
+    pool = jnp.where(cleared[:, None], 0, pool)
+    return pool, inbox, jnp.sum(take).astype(jnp.int32), \
+        jnp.sum(drop_mask).astype(jnp.int32)
+
+
+def _sample_latency(key, n, cfg: NetConfig) -> jnp.ndarray:
+    if cfg.latency_mean <= 0:
+        return jnp.zeros((n,), dtype=jnp.int32)
+    if cfg.latency_dist == LATENCY_CONSTANT:
+        return jnp.full((n,), round(cfg.latency_mean), dtype=jnp.int32)
+    u = jax.random.uniform(key, (n,), minval=1e-6, maxval=1.0)
+    if cfg.latency_dist == LATENCY_UNIFORM:
+        lat = u * (2.0 * cfg.latency_mean)
+    else:  # exponential
+        lat = -cfg.latency_mean * jnp.log(u)
+    return lat.astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def enqueue(pool: jnp.ndarray, msgs: jnp.ndarray, t: jnp.ndarray,
+            key: jnp.ndarray, cfg: NetConfig
+            ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Insert outgoing messages (``[M, lanes]``, invalid rows ignored) into
+    the pool. Returns ``(pool', n_sent, n_lost, n_overflow)``."""
+    M = msgs.shape[0]
+    msg_valid = msgs[:, wire.VALID] == 1
+
+    k_lat, k_loss = jax.random.split(key)
+    # latency: zero on client links
+    is_client_edge = ((msgs[:, wire.SRC] >= cfg.n_nodes) |
+                      (msgs[:, wire.DEST] >= cfg.n_nodes))
+    lat = _sample_latency(k_lat, M, cfg)
+    lat = jnp.where(is_client_edge, 0, lat)
+    # deliverable no earlier than the next tick
+    msgs = msgs.at[:, wire.DTICK].set(t + 1 + lat)
+
+    # loss
+    if cfg.p_loss > 0:
+        lost = (jax.random.uniform(k_loss, (M,)) < cfg.p_loss) & msg_valid
+    else:
+        lost = jnp.zeros((M,), dtype=bool)
+    live = msg_valid & ~lost
+
+    # free-slot assignment: argsort puts empty slots first (stable)
+    pool_valid = pool[:, wire.VALID] == 1
+    order = jnp.argsort(pool_valid)                  # empty slots first
+    free_count = jnp.sum(~pool_valid)
+    # compact live messages to the front so slot j gets the j-th live msg
+    live_order = jnp.argsort(~live)                  # live msgs first
+    msgs_c = msgs[live_order]
+    live_c = live[live_order]
+    n_live = jnp.sum(live)
+
+    j = jnp.arange(M)
+    can_place = live_c & (j < free_count)
+    # rows that don't place scatter to an out-of-bounds index and are
+    # dropped, so they can never collide with a placed row's slot
+    target = jnp.where(can_place, order[jnp.minimum(j, cfg.pool_slots - 1)],
+                       cfg.pool_slots)
+    pool = pool.at[target].set(msgs_c, mode="drop")
+    n_placed = jnp.sum(can_place)
+    overflow = n_live - n_placed
+    # sent counts every valid message, including ones the network then
+    # loses — matching the reference, which journals the send before the
+    # loss roll (net.clj:208-215)
+    n_sent = jnp.sum(msg_valid)
+    return pool, n_sent.astype(jnp.int32), jnp.sum(lost).astype(jnp.int32), \
+        overflow.astype(jnp.int32)

@@ -1,0 +1,423 @@
+"""The device tick loop: vectorized protocol instances under ``lax.scan``.
+
+One *instance* = one simulated cluster (N server nodes + C clients) with its
+own message pool, partition matrix, and RNG stream. The runtime stacks
+``n_instances`` of them along a leading batch axis and steps them all in
+lockstep:
+
+    tick t:
+      nemesis   : recompute per-instance partition matrices from schedule
+      deliver   : vmap(netsim.deliver)   -> per-node inboxes
+      node step : vmap over instances, vmap over nodes, scan over inbox
+      client step: decode replies -> history events; sample/encode new ops
+      enqueue   : vmap(netsim.enqueue)   -> pool with latency/loss applied
+
+The whole loop is a single ``lax.scan`` over ticks, jitted once; the only
+host traffic is the initial state upload and the final history/stat
+download. History events are recorded for the first ``record_instances``
+instances only (checker input); aggregate counters cover all instances
+(SURVEY §7: cheap vectorized invariants everywhere, full checkers on
+samples).
+
+This module replaces the reference's thread-per-pipe + sleep-per-message
+hot path (net.clj:189-247, process.clj:136-166) — the design is the
+batched exchange sketched in SURVEY §5 "Distributed communication backend".
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from . import netsim, wire
+from .netsim import NetConfig, NetStats
+
+# --- history events -------------------------------------------------------
+
+# event lanes: [etype, f, a, b, c, msg_id]
+EV_TYPE = 0
+EV_F = 1
+EV_A = 2
+EV_B = 3
+EV_C = 4
+EV_MSGID = 5
+EV_LANES = 6
+
+EV_NONE = 0
+EV_INVOKE = 1
+EV_OK = 2
+EV_FAIL = 3
+EV_INFO = 4
+
+# client op lanes: [f, a, b, c]
+OP_LANES = 4
+
+
+class ClientConfig(NamedTuple):
+    n_clients: int
+    rate: float              # P(new op per idle client per tick)
+    timeout_ticks: int
+
+
+class Model:
+    """A vectorized node state machine (one per TPU workload).
+
+    Subclasses define the node automaton *and* the client-side op
+    vocabulary. All methods are traced; shapes must be static. ``row`` is
+    the model's per-node state pytree (arrays without the node axis —
+    the runtime vmaps over nodes and instances).
+    """
+
+    name: str = "?"
+    body_lanes: int = 6
+    max_out: int = 1          # messages emitted per handled message
+    tick_out: int = 0         # messages emitted by the per-tick hook
+    idempotent_fs: Tuple[int, ...] = ()   # f codes safe to fail on timeout
+
+    # models are stateless singletons: hash by type so fresh instances hit
+    # the jit cache instead of forcing a recompile per Model()
+    def __hash__(self):
+        return hash(type(self))
+
+    def __eq__(self, other):
+        return type(self) is type(other)
+
+    def init_row(self, n_nodes: int, node_idx, key, params) -> Any:
+        raise NotImplementedError
+
+    def handle(self, row, node_idx, msg, t, key, cfg: NetConfig, params
+               ) -> Tuple[Any, jnp.ndarray]:
+        """Process one (valid) message; return (row', outs[max_out, L])."""
+        raise NotImplementedError
+
+    def tick(self, row, node_idx, t, key, cfg: NetConfig, params
+             ) -> Tuple[Any, jnp.ndarray]:
+        """Per-tick hook (timers, gossip). Default: no-op."""
+        return row, jnp.zeros((self.tick_out, cfg.lanes), dtype=jnp.int32)
+
+    # --- client side ------------------------------------------------------
+
+    def sample_op(self, key, cfg: NetConfig, params) -> jnp.ndarray:
+        """Draw an op [OP_LANES] (f, a, b, c)."""
+        raise NotImplementedError
+
+    def encode_request(self, op, msg_id, client_idx, key, cfg: NetConfig,
+                       params) -> jnp.ndarray:
+        """Encode an op as a request message row (src/dest/type/body)."""
+        raise NotImplementedError
+
+    def decode_reply(self, op, msg, cfg: NetConfig, params
+                     ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """Given the op and its reply message, return
+        (etype in {EV_OK, EV_FAIL, EV_INFO}, value[3] result lanes)."""
+        raise NotImplementedError
+
+
+# generic error reply handling: error type code shared by all models
+TYPE_ERROR = 127
+# error body lane 0 = code; definite codes -> EV_FAIL, else EV_INFO
+_DEFINITE_CODES = jnp.array([1, 10, 11, 12, 14, 20, 21, 22, 30],
+                            dtype=jnp.int32)
+
+
+def decode_error_reply(msg) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    code = msg[wire.BODY]
+    definite = jnp.any(_DEFINITE_CODES == code)
+    etype = jnp.where(definite, EV_FAIL, EV_INFO)
+    return etype, jnp.zeros((3,), dtype=jnp.int32)
+
+
+# --- client machine -------------------------------------------------------
+
+class ClientState(NamedTuple):
+    status: jnp.ndarray        # [C] 0 idle / 1 waiting
+    op: jnp.ndarray            # [C, OP_LANES]
+    msg_id: jnp.ndarray        # [C] current outstanding msg id
+    next_msg_id: jnp.ndarray   # [C]
+    invoked: jnp.ndarray       # [C] tick of invocation
+
+    @staticmethod
+    def init(C: int):
+        return ClientState(
+            status=jnp.zeros((C,), jnp.int32),
+            op=jnp.zeros((C, OP_LANES), jnp.int32),
+            msg_id=jnp.full((C,), -1, jnp.int32),
+            next_msg_id=jnp.zeros((C,), jnp.int32),
+            invoked=jnp.zeros((C,), jnp.int32),
+        )
+
+
+def client_step(model: Model, cs: ClientState, inbox_clients, t, key,
+                cfg: NetConfig, ccfg: ClientConfig, params):
+    """One tick for all C clients of one instance.
+
+    Returns (cs', requests [C, L], events [C, 2, EV_LANES]).
+    Event slot 0 = completion, slot 1 = invocation. A client that completes
+    this tick goes idle immediately and MAY fire a new op in the same tick;
+    the history decoder orders slot 0 before slot 1, so the completion
+    always precedes the next invocation.
+    """
+    C = ccfg.n_clients
+    L = cfg.lanes
+    events = jnp.zeros((C, 2, EV_LANES), dtype=jnp.int32)
+
+    # --- completions: find a reply matching our outstanding msg_id
+    def find_reply(client_idx):
+        msgs = inbox_clients[client_idx]            # [K, L]
+        match = ((msgs[:, wire.VALID] == 1) &
+                 (msgs[:, wire.REPLYTO] == cs.msg_id[client_idx]) &
+                 (cs.status[client_idx] == 1))
+        has = jnp.any(match)
+        idx = jnp.argmax(match)
+        return has, msgs[idx]
+
+    has_reply, reply = jax.vmap(find_reply)(jnp.arange(C))
+
+    def decode_one(op, msg):
+        is_err = msg[wire.TYPE] == TYPE_ERROR
+        et_err, val_err = decode_error_reply(msg)
+        et_ok, val_ok = model.decode_reply(op, msg, cfg, params)
+        etype = jnp.where(is_err, et_err, et_ok)
+        value = jnp.where(is_err, val_err, val_ok)
+        return etype, value
+
+    etype_r, value_r = jax.vmap(decode_one)(cs.op, reply)
+
+    # timeouts -> EV_INFO (EV_FAIL when the op's f is idempotent)
+    timed_out = ((cs.status == 1) & ~has_reply &
+                 (t - cs.invoked >= ccfg.timeout_ticks))
+    idem = jnp.zeros((C,), dtype=bool)
+    for f in model.idempotent_fs:
+        idem = idem | (cs.op[:, 0] == f)
+    etype_t = jnp.where(idem, EV_FAIL, EV_INFO)
+
+    completed = has_reply | timed_out
+    comp_etype = jnp.where(has_reply, etype_r, etype_t)
+    comp_value = jnp.where(has_reply[:, None], value_r, 0)
+    events = events.at[:, 0, EV_TYPE].set(
+        jnp.where(completed, comp_etype, EV_NONE))
+    events = events.at[:, 0, EV_F].set(cs.op[:, 0])
+    events = events.at[:, 0, EV_A].set(
+        jnp.where(has_reply, comp_value[:, 0], cs.op[:, 1]))
+    events = events.at[:, 0, EV_B].set(
+        jnp.where(has_reply, comp_value[:, 1], cs.op[:, 2]))
+    events = events.at[:, 0, EV_C].set(
+        jnp.where(has_reply, comp_value[:, 2], cs.op[:, 3]))
+    events = events.at[:, 0, EV_MSGID].set(cs.msg_id)
+
+    status = jnp.where(completed, 0, cs.status)
+
+    # --- new invocations from idle clients
+    k_rate, k_ops, k_enc = jax.random.split(key, 3)
+    idle = status == 0
+    fire = idle & (jax.random.uniform(k_rate, (C,)) < ccfg.rate)
+    op_keys = jax.random.split(k_ops, C)
+    new_ops = jax.vmap(lambda k: model.sample_op(k, cfg, params))(op_keys)
+    op = jnp.where(fire[:, None], new_ops, cs.op)
+    msg_id = jnp.where(fire, cs.next_msg_id, cs.msg_id)
+    next_msg_id = jnp.where(fire, cs.next_msg_id + 1, cs.next_msg_id)
+    invoked = jnp.where(fire, t, cs.invoked)
+    status = jnp.where(fire, 1, status)
+
+    enc_keys = jax.random.split(k_enc, C)
+    reqs = jax.vmap(lambda o, m, ci, k: model.encode_request(
+        o, m, ci, k, cfg, params))(op, msg_id,
+                                   jnp.arange(C, dtype=jnp.int32), enc_keys)
+    reqs = reqs.at[:, wire.VALID].set(jnp.where(fire, 1, 0))
+    reqs = reqs.at[:, wire.SRC].set(cfg.n_nodes +
+                                    jnp.arange(C, dtype=jnp.int32))
+    reqs = reqs.at[:, wire.MSGID].set(msg_id)
+
+    events = events.at[:, 1, EV_TYPE].set(
+        jnp.where(fire, EV_INVOKE, EV_NONE))
+    events = events.at[:, 1, EV_F].set(op[:, 0])
+    events = events.at[:, 1, EV_A].set(op[:, 1])
+    events = events.at[:, 1, EV_B].set(op[:, 2])
+    events = events.at[:, 1, EV_C].set(op[:, 3])
+    events = events.at[:, 1, EV_MSGID].set(msg_id)
+
+    cs = ClientState(status=status, op=op, msg_id=msg_id,
+                     next_msg_id=next_msg_id, invoked=invoked)
+    return cs, reqs, events
+
+
+# --- nemesis --------------------------------------------------------------
+
+class NemesisConfig(NamedTuple):
+    enabled: bool = False
+    interval: int = 50         # ticks between phase flips
+    kind: str = "random-halves"
+
+
+def partition_matrix(nem: NemesisConfig, cfg: NetConfig, t, instance_key
+                     ) -> jnp.ndarray:
+    """Per-instance partition matrix at tick t: alternating heal/partition
+    phases every ``interval`` ticks, a fresh random grudge each phase.
+    Clients are never partitioned (grudges cover server nodes only,
+    nemesis.clj semantics)."""
+    NT = cfg.n_total
+    if not nem.enabled:
+        return jnp.zeros((NT, NT), dtype=bool)
+    phase = t // nem.interval
+    active = (phase % 2) == 1
+    key = jax.random.fold_in(instance_key, phase)
+    n = cfg.n_nodes
+    if nem.kind == "isolated-node":
+        victim = jax.random.randint(key, (), 0, n)
+        ids = jnp.arange(NT)
+        isolated = ids == victim
+        blocked = isolated[:, None] ^ isolated[None, :]
+    else:  # random-halves
+        side = jax.random.bernoulli(key, 0.5, (NT,))
+        blocked = side[:, None] != side[None, :]
+    server = jnp.arange(NT) < n
+    blocked = blocked & server[:, None] & server[None, :]
+    return jnp.where(active, blocked, False)
+
+
+# --- node phase -----------------------------------------------------------
+
+def node_phase(model: Model, node_state, inbox_nodes, t, key,
+               cfg: NetConfig, params):
+    """All nodes of one instance handle their inboxes then run tick hooks.
+
+    node_state: pytree with leading node axis [N, ...].
+    inbox_nodes: [N, K, L]. Returns (state', outs [N*(K*max_out+tick_out), L]).
+    """
+    N = cfg.n_nodes
+    L = cfg.lanes
+
+    def per_node(row, inbox_row, nkey, node_idx):
+        def step(r, x):
+            msg, i = x
+            # distinct key per handled message — a shared key would
+            # correlate every random draw a model makes within a tick
+            mkey = jax.random.fold_in(nkey, i)
+            r2, outs = model.handle(r, node_idx, msg, t, mkey, cfg, params)
+            ok = msg[wire.VALID] == 1
+            r = jax.tree.map(lambda a, b: jnp.where(ok, b, a), r, r2)
+            outs = jnp.where(ok, outs, 0)
+            return r, outs
+
+        k_idx = jnp.arange(inbox_row.shape[0], dtype=jnp.int32)
+        row, outs_k = jax.lax.scan(step, row, (inbox_row, k_idx))
+        tkey = jax.random.fold_in(nkey, inbox_row.shape[0])
+        row, outs_t = model.tick(row, node_idx, t, tkey, cfg, params)
+        outs = jnp.concatenate(
+            [outs_k.reshape(-1, L), outs_t.reshape(-1, L)], axis=0)
+        # stamp src + valid gating on body-declared validity
+        outs = outs.at[:, wire.SRC].set(node_idx)
+        return row, outs
+
+    keys = jax.random.split(key, N)
+    idx = jnp.arange(N, dtype=jnp.int32)
+    return jax.vmap(per_node)(node_state, inbox_nodes, keys, idx)
+
+
+# --- the scan loop --------------------------------------------------------
+
+class SimConfig(NamedTuple):
+    net: NetConfig
+    client: ClientConfig
+    nemesis: NemesisConfig
+    n_instances: int
+    n_ticks: int
+    record_instances: int
+
+
+class Carry(NamedTuple):
+    pool: jnp.ndarray          # [I, S, L]
+    node_state: Any            # pytree [I, N, ...]
+    client_state: ClientState  # arrays [I, C...]
+    stats: NetStats            # scalars (summed over instances)
+    key: jnp.ndarray
+
+
+def init_carry(model: Model, sim: SimConfig, seed: int, params) -> Carry:
+    I = sim.n_instances
+    cfg = sim.net
+    key = jax.random.PRNGKey(seed)
+    k_nodes, key = jax.random.split(key)
+
+    def init_instance(ikey):
+        nkeys = jax.random.split(ikey, cfg.n_nodes)
+        return jax.vmap(
+            lambda nk, ni: model.init_row(cfg.n_nodes, ni, nk, params))(
+                nkeys, jnp.arange(cfg.n_nodes, dtype=jnp.int32))
+
+    node_state = jax.vmap(init_instance)(jax.random.split(k_nodes, I))
+    return Carry(
+        pool=jnp.zeros((I, cfg.pool_slots, cfg.lanes), jnp.int32),
+        node_state=node_state,
+        client_state=jax.tree.map(
+            lambda a: jnp.broadcast_to(a, (I,) + a.shape),
+            ClientState.init(sim.client.n_clients)),
+        stats=NetStats.zeros(),
+        key=key,
+    )
+
+
+def make_tick_fn(model: Model, sim: SimConfig, params) -> Callable:
+    cfg = sim.net
+    ccfg = sim.client
+    nem = sim.nemesis
+    N = cfg.n_nodes
+    I = sim.n_instances
+
+    def tick_fn(carry: Carry, t):
+        key, k_nem, k_node, k_client, k_enq = jax.random.split(carry.key, 5)
+
+        ikeys = jax.random.split(k_nem, I)
+        partitions = jax.vmap(
+            lambda ik: partition_matrix(nem, cfg, t, ik))(ikeys)
+
+        pool, inbox, n_del, n_dropp = jax.vmap(
+            lambda p, pa: netsim.deliver(p, pa, t, cfg))(carry.pool,
+                                                         partitions)
+
+        node_keys = jax.random.split(k_node, I)
+        node_state, node_outs = jax.vmap(
+            lambda st, ib, k: node_phase(model, st, ib, t, k, cfg, params))(
+                carry.node_state, inbox[:, :N], node_keys)
+
+        client_keys = jax.random.split(k_client, I)
+        client_state, reqs, events = jax.vmap(
+            lambda cs, ib, k: client_step(model, cs, ib, t, k, cfg, ccfg,
+                                          params))(
+                carry.client_state, inbox[:, N:], client_keys)
+
+        outs = jnp.concatenate(
+            [node_outs.reshape(I, -1, cfg.lanes), reqs], axis=1)
+        enq_keys = jax.random.split(k_enq, I)
+        pool, n_sent, n_lost, n_ovf = jax.vmap(
+            lambda p, m, k: netsim.enqueue(p, m, t, k, cfg))(pool, outs,
+                                                             enq_keys)
+
+        stats = NetStats(
+            sent=carry.stats.sent + jnp.sum(n_sent),
+            delivered=carry.stats.delivered + jnp.sum(n_del),
+            dropped_partition=carry.stats.dropped_partition
+            + jnp.sum(n_dropp),
+            dropped_loss=carry.stats.dropped_loss + jnp.sum(n_lost),
+            dropped_overflow=carry.stats.dropped_overflow + jnp.sum(n_ovf),
+        )
+        new_carry = Carry(pool=pool, node_state=node_state,
+                          client_state=client_state, stats=stats, key=key)
+        return new_carry, events[:sim.record_instances]
+
+    return tick_fn
+
+
+@partial(jax.jit, static_argnames=("model", "sim"))
+def run_sim(model: Model, sim: SimConfig, seed: int, params=None
+            ) -> Tuple[Carry, jnp.ndarray]:
+    """Run the full simulation; returns (final carry, events
+    [T, R, C, 2, EV_LANES])."""
+    carry = init_carry(model, sim, seed, params)
+    tick_fn = make_tick_fn(model, sim, params)
+    carry, events = jax.lax.scan(tick_fn, carry,
+                                 jnp.arange(sim.n_ticks, dtype=jnp.int32))
+    return carry, events

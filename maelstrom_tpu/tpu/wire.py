@@ -1,0 +1,60 @@
+"""Fixed-width message encoding for the device-resident network.
+
+The process runtime ships JSON; the TPU runtime ships int32 lanes. Every
+message is one row of ``MSG_LANES + body_lanes`` int32s:
+
+====  ===========================================================
+lane  meaning
+====  ===========================================================
+0     valid (0/1)
+1     src   (node index; clients follow server nodes)
+2     dest
+3     deliver_tick (virtual-clock deadline, the net.clj ns deadline)
+4     type  (workload-specific enum)
+5     msg_id
+6     in_reply_to (-1 if none)
+7+    body lanes (workload-specific payload encoding)
+====  ===========================================================
+
+Workload vocabularies (the ``defrpc`` schemas of SURVEY §2.2) map onto the
+body lanes per workload; capped body width is a stated design constraint of
+the TPU runtime (SURVEY §7 hard parts: fixed shapes vs dynamic protocols).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+VALID = 0
+SRC = 1
+DEST = 2
+DTICK = 3
+TYPE = 4
+MSGID = 5
+REPLYTO = 6
+BODY = 7          # first body lane
+
+HDR_LANES = 7
+
+
+def lanes(body_lanes: int) -> int:
+    return HDR_LANES + body_lanes
+
+
+def empty_msgs(n: int, body_lanes: int) -> jnp.ndarray:
+    return jnp.zeros((n, lanes(body_lanes)), dtype=jnp.int32)
+
+
+def make_msg(src, dest, type_, msg_id=-1, reply_to=-1, body=(),
+             body_lanes: int = 6):
+    """Build one message row (traced-friendly)."""
+    m = jnp.zeros((lanes(body_lanes),), dtype=jnp.int32)
+    m = m.at[VALID].set(1)
+    m = m.at[SRC].set(src)
+    m = m.at[DEST].set(dest)
+    m = m.at[TYPE].set(type_)
+    m = m.at[MSGID].set(msg_id)
+    m = m.at[REPLYTO].set(reply_to)
+    for i, b in enumerate(body):
+        m = m.at[BODY + i].set(b)
+    return m
