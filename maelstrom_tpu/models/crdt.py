@@ -1,0 +1,339 @@
+"""Vectorized CRDT gossip models: broadcast, g-set, pn-counter.
+
+These are the TPU-runtime counterparts of the broadcast / g-set /
+pn-counter workloads (reference src/maelstrom/workload/{broadcast,g_set,
+pn_counter}.clj and the demo CRDT nodes demo/ruby/{broadcast,g_set,
+pn_counter}.rb). The device design is anti-entropy state exchange rather
+than per-message flooding: each node keeps its full CRDT state in fixed
+lanes and periodically sends it to a random topology neighbor; merge is a
+lattice join (bitwise OR for sets, pointwise max for counters). That makes
+every protocol action a fixed-shape vector op and is naturally
+partition-tolerant — exactly the style the reference teaches in its CRDT
+chapters (doc/04-crdts).
+
+Element domains are capped (``n_values`` distinct broadcast messages /
+set elements per instance) — the fixed-shape constraint of SURVEY §7.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..tpu import wire
+from ..tpu.runtime import EV_INFO, EV_OK, Model
+from ..workloads.topology import make_topology
+from ..utils.ids import node_names
+
+# message types
+T_ADD = 1        # broadcast / add(element) / add(delta)
+T_ADD_OK = 2
+T_READ = 3
+T_READ_OK = 4
+T_GOSSIP = 5     # anti-entropy state push (no reply)
+
+F_ADD = 1
+F_READ = 2
+
+
+def gossip_out(row_body: jnp.ndarray, node_idx, key, cfg, params,
+               gossip_prob: float) -> jnp.ndarray:
+    """One anti-entropy push: with probability ``gossip_prob``, a T_GOSSIP
+    message carrying ``row_body`` lanes to one random topology neighbor
+    (gumbel-max draw over the adjacency row). Shared by all CRDT models."""
+    out = jnp.zeros((1, cfg.lanes), dtype=jnp.int32)
+    k_fire, k_peer = jax.random.split(key)
+    fire = jax.random.uniform(k_fire) < gossip_prob
+    nbrs = params[node_idx]                      # [N] bool
+    has_nbr = jnp.any(nbrs)
+    g = jax.random.uniform(k_peer, (cfg.n_nodes,))
+    peer = jnp.argmax(jnp.where(nbrs, g, -1.0))
+    out = out.at[0, wire.VALID].set(jnp.where(fire & has_nbr, 1, 0))
+    out = out.at[0, wire.DEST].set(peer)
+    out = out.at[0, wire.TYPE].set(T_GOSSIP)
+    out = jax.lax.dynamic_update_slice(out, row_body[None, :],
+                                       (0, wire.BODY))
+    return out
+
+
+def adjacency(topology_name: str, n_nodes: int) -> jnp.ndarray:
+    """[N, N] bool adjacency matrix from a named workload topology."""
+    names = node_names(n_nodes)
+    topo = make_topology(topology_name, names)
+    idx = {n: i for i, n in enumerate(names)}
+    a = jnp.zeros((n_nodes, n_nodes), dtype=bool)
+    rows, cols = [], []
+    for n, nbrs in topo.items():
+        for m in nbrs:
+            rows.append(idx[n])
+            cols.append(idx[m])
+    if rows:
+        a = a.at[jnp.array(rows), jnp.array(cols)].set(True)
+    return a
+
+
+class GossipSetModel(Model):
+    """Grow-only set over a 64-element domain held as a 2-word bitmask.
+
+    Base for both the g-set and broadcast TPU workloads (they differ only
+    in op naming and checker wiring).
+    """
+
+    name = "g-set"
+    n_values = 64              # element domain (2 x int32 bitmask words)
+    body_lanes = 2
+    max_out = 1
+    tick_out = 1
+    gossip_prob = 0.5          # P(gossip to one random neighbor per tick)
+    idempotent_fs = (F_READ,)
+    add_f_name = "add"
+    read_value_key = "value"
+
+    def __init__(self, topology: str = "grid"):
+        self.topology = topology
+
+    def __hash__(self):
+        return hash((type(self), self.topology))
+
+    def __eq__(self, other):
+        return (type(self) is type(other)
+                and self.topology == other.topology)
+
+    # params = adjacency matrix [N, N] (built by make_params)
+    def make_params(self, n_nodes: int):
+        return adjacency(self.topology, n_nodes)
+
+    def init_row(self, n_nodes, node_idx, key, params):
+        return jnp.zeros((2,), dtype=jnp.int32)    # seen-bitmask words
+
+    @staticmethod
+    def _set_bit(words, v):
+        word = v // 32
+        bit = v % 32
+        return words.at[word].set(words[word] | (1 << bit).astype(jnp.int32))
+
+    def handle(self, row, node_idx, msg, t, key, cfg, params):
+        mtype = msg[wire.TYPE]
+        body = msg[wire.BODY:wire.BODY + 2]
+        out = jnp.zeros((1, cfg.lanes), dtype=jnp.int32)
+
+        added = self._set_bit(row, jnp.clip(msg[wire.BODY], 0,
+                                            self.n_values - 1))
+        merged = row | body
+        row = jnp.where(mtype == T_ADD, added,
+                        jnp.where(mtype == T_GOSSIP, merged, row))
+
+        is_req = (mtype == T_ADD) | (mtype == T_READ)
+        out = out.at[0, wire.VALID].set(jnp.where(is_req, 1, 0))
+        out = out.at[0, wire.DEST].set(msg[wire.SRC])
+        out = out.at[0, wire.TYPE].set(
+            jnp.where(mtype == T_ADD, T_ADD_OK, T_READ_OK))
+        out = out.at[0, wire.REPLYTO].set(msg[wire.MSGID])
+        read_body = jnp.where(mtype == T_READ, row, 0)
+        out = out.at[0, wire.BODY].set(read_body[0])
+        out = out.at[0, wire.BODY + 1].set(read_body[1])
+        return row, out
+
+    def tick(self, row, node_idx, t, key, cfg, params):
+        return row, gossip_out(row, node_idx, key, cfg, params,
+                               self.gossip_prob)
+
+    # --- client side ------------------------------------------------------
+
+    def sample_op(self, key, uniq, cfg, params):
+        k1, k2 = jax.random.split(key)
+        is_add = jax.random.uniform(k1) < 0.5
+        # distinct-ish element per (client op counter); collisions wrap the
+        # domain and just re-add an existing element, which is harmless
+        element = (uniq * cfg.n_clients
+                   + jax.random.randint(k2, (), 0, cfg.n_clients)
+                   ) % self.n_values
+        return jnp.where(
+            is_add,
+            jnp.array([F_ADD, 0, 0, 0], jnp.int32).at[1].set(element),
+            jnp.array([F_READ, 0, 0, 0], jnp.int32))
+
+    def sample_final_op(self, key, uniq, cfg, params):
+        return jnp.array([F_READ, 0, 0, 0], jnp.int32)
+
+    def encode_request(self, op, msg_id, client_idx, key, cfg, params):
+        dest = jax.random.randint(key, (), 0, cfg.n_nodes, dtype=jnp.int32)
+        is_add = op[0] == F_ADD
+        return wire.make_msg(
+            src=0, dest=dest,
+            type_=jnp.where(is_add, T_ADD, T_READ),
+            msg_id=msg_id, body=(jnp.where(is_add, op[1], 0),),
+            body_lanes=self.body_lanes)
+
+    def decode_reply(self, op, msg, cfg, params):
+        mtype = msg[wire.TYPE]
+        ok = (mtype == T_ADD_OK) | (mtype == T_READ_OK)
+        etype = jnp.where(ok, EV_OK, EV_INFO)
+        value = jnp.array([0, 0, 0], jnp.int32)
+        # reads: bitmask words in A,B; adds: echo the element in A
+        value = value.at[0].set(
+            jnp.where(mtype == T_READ_OK, msg[wire.BODY], op[1]))
+        value = value.at[1].set(
+            jnp.where(mtype == T_READ_OK, msg[wire.BODY + 1], 0))
+        return etype, value
+
+    # --- host-side decoding ----------------------------------------------
+
+    @staticmethod
+    def _decode_bitmask(a, b):
+        out = []
+        for w, word in enumerate((a, b)):
+            word &= 0xFFFFFFFF
+            for bit in range(32):
+                if word & (1 << bit):
+                    out.append(w * 32 + bit)
+        return out
+
+    def invoke_record(self, f, a, b, c):
+        if f == F_ADD:
+            return {"f": self.add_f_name, "value": int(a)}
+        return {"f": "read", "value": None}
+
+    def complete_record(self, f, a, b, c, etype):
+        if f == F_ADD:
+            return {"f": self.add_f_name, "value": int(a)}
+        if etype == EV_OK:
+            return {"f": "read", "value": self._decode_bitmask(int(a),
+                                                               int(b))}
+        return {"f": "read", "value": None}
+
+    def checker(self):
+        from ..checkers.set_full import set_full_checker
+        add_f = self.add_f_name
+        return lambda history, opts: set_full_checker(history, add_f=add_f)
+
+
+class BroadcastModel(GossipSetModel):
+    """Broadcast-workload face of the gossip set (messages == elements)."""
+    name = "broadcast"
+    add_f_name = "broadcast"
+
+
+class PNCounterModel(Model):
+    """PN-counter: per-node (plus, minus) pairs, gossiped and merged by
+    pointwise max; read returns sum(plus) - sum(minus)."""
+
+    name = "pn-counter"
+    max_out = 1
+    tick_out = 1
+    gossip_prob = 0.5
+    idempotent_fs = (F_READ,)
+    allow_negative = True
+
+    def __init__(self, n_nodes_hint: int = 5, topology: str = "total"):
+        # body must carry the full counter table: 2 lanes per node
+        self.n_nodes_hint = n_nodes_hint
+        self.topology = topology
+        self.body_lanes = max(2, 2 * n_nodes_hint)
+
+    def __hash__(self):
+        return hash((type(self), self.n_nodes_hint, self.topology))
+
+    def __eq__(self, other):
+        return (type(self) is type(other)
+                and self.n_nodes_hint == other.n_nodes_hint
+                and self.topology == other.topology)
+
+    def make_params(self, n_nodes: int):
+        assert n_nodes == self.n_nodes_hint, \
+            "PNCounterModel(n_nodes_hint) must match node_count"
+        return adjacency(self.topology, n_nodes)
+
+    def init_row(self, n_nodes, node_idx, key, params):
+        return jnp.zeros((n_nodes, 2), dtype=jnp.int32)  # [N, (plus,minus)]
+
+    def handle(self, row, node_idx, msg, t, key, cfg, params):
+        N = cfg.n_nodes
+        mtype = msg[wire.TYPE]
+        out = jnp.zeros((1, cfg.lanes), dtype=jnp.int32)
+
+        # add: bump own (plus, minus)
+        delta = msg[wire.BODY]
+        plus = jnp.maximum(delta, 0)
+        minus = jnp.maximum(-delta, 0)
+        added = row.at[node_idx].set(row[node_idx]
+                                     + jnp.stack([plus, minus]))
+
+        # gossip: pointwise max merge of the full table
+        table = msg[wire.BODY:wire.BODY + 2 * N].reshape(N, 2)
+        merged = jnp.maximum(row, table)
+
+        row = jnp.where(mtype == T_ADD, added,
+                        jnp.where(mtype == T_GOSSIP, merged, row))
+
+        is_req = (mtype == T_ADD) | (mtype == T_READ)
+        value = jnp.sum(row[:, 0]) - jnp.sum(row[:, 1])
+        out = out.at[0, wire.VALID].set(jnp.where(is_req, 1, 0))
+        out = out.at[0, wire.DEST].set(msg[wire.SRC])
+        out = out.at[0, wire.TYPE].set(
+            jnp.where(mtype == T_ADD, T_ADD_OK, T_READ_OK))
+        out = out.at[0, wire.REPLYTO].set(msg[wire.MSGID])
+        out = out.at[0, wire.BODY].set(
+            jnp.where(mtype == T_READ, value, 0))
+        return row, out
+
+    def tick(self, row, node_idx, t, key, cfg, params):
+        return row, gossip_out(row.reshape(-1), node_idx, key, cfg, params,
+                               self.gossip_prob)
+
+    # --- client side ------------------------------------------------------
+
+    def sample_op(self, key, uniq, cfg, params):
+        k1, k2 = jax.random.split(key)
+        is_add = jax.random.uniform(k1) < 0.5
+        lo = -5 if self.allow_negative else 0
+        delta = jax.random.randint(k2, (), lo, 6, dtype=jnp.int32)
+        return jnp.where(
+            is_add,
+            jnp.array([F_ADD, 0, 0, 0], jnp.int32).at[1].set(delta),
+            jnp.array([F_READ, 0, 0, 0], jnp.int32))
+
+    def sample_final_op(self, key, uniq, cfg, params):
+        return jnp.array([F_READ, 0, 0, 0], jnp.int32)
+
+    def encode_request(self, op, msg_id, client_idx, key, cfg, params):
+        dest = jax.random.randint(key, (), 0, cfg.n_nodes, dtype=jnp.int32)
+        is_add = op[0] == F_ADD
+        return wire.make_msg(
+            src=0, dest=dest,
+            type_=jnp.where(is_add, T_ADD, T_READ),
+            msg_id=msg_id, body=(jnp.where(is_add, op[1], 0),),
+            body_lanes=self.body_lanes)
+
+    def decode_reply(self, op, msg, cfg, params):
+        mtype = msg[wire.TYPE]
+        ok = (mtype == T_ADD_OK) | (mtype == T_READ_OK)
+        etype = jnp.where(ok, EV_OK, EV_INFO)
+        value = jnp.array([0, 0, 0], jnp.int32)
+        value = value.at[0].set(
+            jnp.where(mtype == T_READ_OK, msg[wire.BODY], op[1]))
+        return etype, value
+
+    def invoke_record(self, f, a, b, c):
+        if f == F_ADD:
+            return {"f": "add", "value": int(a)}
+        return {"f": "read", "value": None}
+
+    def complete_record(self, f, a, b, c, etype):
+        if f == F_ADD:
+            return {"f": "add", "value": int(a)}
+        from ..tpu.runtime import EV_OK as _OK
+        if etype == _OK:
+            return {"f": "read", "value": int(a)}
+        return {"f": "read", "value": None}
+
+    def checker(self):
+        from ..checkers.pn_counter import pn_counter_checker
+        return lambda history, opts: pn_counter_checker(history)
+
+
+class GCounterModel(PNCounterModel):
+    name = "g-counter"
+    allow_negative = False

@@ -43,7 +43,7 @@ class EchoModel(Model):
 
     # --- client side ------------------------------------------------------
 
-    def sample_op(self, key, cfg, params):
+    def sample_op(self, key, uniq, cfg, params):
         payload = jax.random.randint(key, (), 0, 1_000_000, dtype=jnp.int32)
         return jnp.array([F_ECHO, 0, 0, 0], jnp.int32).at[1].set(payload)
 

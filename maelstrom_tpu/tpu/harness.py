@@ -39,6 +39,7 @@ TPU_DEFAULTS = dict(
     nemesis=[],
     nemesis_interval=0.5,    # simulated seconds between phase flips
     rpc_timeout=1.0,         # simulated seconds
+    recovery_time=0.5,       # final heal + quiesce window (simulated s)
     n_instances=64,
     record_instances=8,
     pool_slots=128,
@@ -60,16 +61,26 @@ def make_sim_config(model: Model, opts: Dict[str, Any]) -> SimConfig:
         latency_dist=LATENCY_DISTS[o["latency_dist"]],
         p_loss=float(o["p_loss"]),
     )
+    # final window layout (the reference's heal -> quiesce -> final reads,
+    # core.clj:74-80): partitions stop at stop_tick, clients keep running
+    # the main mix through a quiesce gap of half the window, then switch to
+    # final reads. Clamped so a short run can't degenerate into a
+    # final-phase-only test with the nemesis silently disabled.
+    recovery_ticks = min(int(o["recovery_time"] * 1000 / MS_PER_TICK),
+                         n_ticks // 2)
+    stop_tick = n_ticks - recovery_ticks
     client = ClientConfig(
         n_clients=o["concurrency"],
         rate=min(1.0, float(o["rate"]) / o["concurrency"] / 1000.0
                  * MS_PER_TICK),
         timeout_ticks=int(o["rpc_timeout"] * 1000 / MS_PER_TICK),
+        final_start=stop_tick + recovery_ticks // 2,
     )
     nemesis = NemesisConfig(
         enabled="partition" in (o["nemesis"] or []),
         interval=max(1, int(o["nemesis_interval"] * 1000 / MS_PER_TICK)),
         kind=o.get("nemesis_kind", "random-halves"),
+        stop_tick=stop_tick,
     )
     return SimConfig(net=net, client=client, nemesis=nemesis,
                      n_instances=o["n_instances"], n_ticks=n_ticks,
@@ -77,10 +88,11 @@ def make_sim_config(model: Model, opts: Dict[str, Any]) -> SimConfig:
                                           o["n_instances"]))
 
 
-def events_to_histories(model: Model, events: np.ndarray
-                        ) -> List[List[dict]]:
+def events_to_histories(model: Model, events: np.ndarray,
+                        final_start: int = 1 << 30) -> List[List[dict]]:
     """Decode the [T, R, C, 2, EV_LANES] device event tensor into one
-    Jepsen-style history per recorded instance."""
+    Jepsen-style history per recorded instance. Invocations at/after
+    ``final_start`` are tagged ``final`` (post-heal final reads)."""
     T, R, C, _, _ = events.shape
     histories: List[List[dict]] = [[] for _ in range(R)]
     # vectorized scan for nonzero events to avoid python-looping over T*R*C
@@ -97,6 +109,8 @@ def events_to_histories(model: Model, events: np.ndarray
             rec = model.invoke_record(f, a, b, cc)
             rec.update({"process": int(c), "type": "invoke",
                         "time": time_ns})
+            if t >= final_start:
+                rec["final"] = True
         else:
             rec = model.complete_record(f, a, b, cc, etype)
             rec.update({"process": int(c), "type": ETYPE_NAMES[etype],
@@ -111,12 +125,15 @@ def run_tpu_test(model: Model, opts: Optional[Dict[str, Any]] = None,
                  params=None) -> Dict[str, Any]:
     opts = {**TPU_DEFAULTS, **(opts or {})}
     sim = make_sim_config(model, opts)
+    if params is None:
+        params = model.make_params(sim.net.n_nodes)
     t0 = time.monotonic()
     carry, events = run_sim(model, sim, opts["seed"], params)
     events = np.asarray(events)
     wall = time.monotonic() - t0
 
-    histories = events_to_histories(model, events)
+    histories = events_to_histories(model, events,
+                                    final_start=sim.client.final_start)
     checker = model.checker()
     per_instance = []
     for h in histories:

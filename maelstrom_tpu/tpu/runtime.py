@@ -60,6 +60,9 @@ class ClientConfig(NamedTuple):
     n_clients: int
     rate: float              # P(new op per idle client per tick)
     timeout_ticks: int
+    final_start: int = 1 << 30   # from this tick on, clients issue only
+                                 # final-phase ops (reference final
+                                 # generator: post-heal reads)
 
 
 class Model:
@@ -85,6 +88,11 @@ class Model:
     def __eq__(self, other):
         return type(self) is type(other)
 
+    def make_params(self, n_nodes: int):
+        """Build the model's static parameter pytree (e.g. a topology
+        adjacency matrix); passed to every traced method as ``params``."""
+        return None
+
     def init_row(self, n_nodes: int, node_idx, key, params) -> Any:
         raise NotImplementedError
 
@@ -100,9 +108,17 @@ class Model:
 
     # --- client side ------------------------------------------------------
 
-    def sample_op(self, key, cfg: NetConfig, params) -> jnp.ndarray:
-        """Draw an op [OP_LANES] (f, a, b, c)."""
+    def sample_op(self, key, uniq, cfg: NetConfig, params) -> jnp.ndarray:
+        """Draw an op [OP_LANES] (f, a, b, c). ``uniq`` is a monotonically
+        increasing per-client int (the op counter) for allocating distinct
+        values (e.g. broadcast message ids)."""
         raise NotImplementedError
+
+    def sample_final_op(self, key, uniq, cfg: NetConfig, params
+                        ) -> jnp.ndarray:
+        """Op drawn during the final (post-heal) phase; workloads with
+        final reads override this to return their read op."""
+        return self.sample_op(key, uniq, cfg, params)
 
     def encode_request(self, op, msg_id, client_idx, key, cfg: NetConfig,
                        params) -> jnp.ndarray:
@@ -215,7 +231,12 @@ def client_step(model: Model, cs: ClientState, inbox_clients, t, key,
     idle = status == 0
     fire = idle & (jax.random.uniform(k_rate, (C,)) < ccfg.rate)
     op_keys = jax.random.split(k_ops, C)
-    new_ops = jax.vmap(lambda k: model.sample_op(k, cfg, params))(op_keys)
+    in_final = t >= ccfg.final_start
+    new_ops = jax.vmap(
+        lambda k, u: jnp.where(
+            in_final,
+            model.sample_final_op(k, u, cfg, params),
+            model.sample_op(k, u, cfg, params)))(op_keys, cs.next_msg_id)
     op = jnp.where(fire[:, None], new_ops, cs.op)
     msg_id = jnp.where(fire, cs.next_msg_id, cs.msg_id)
     next_msg_id = jnp.where(fire, cs.next_msg_id + 1, cs.next_msg_id)
@@ -250,6 +271,9 @@ class NemesisConfig(NamedTuple):
     enabled: bool = False
     interval: int = 50         # ticks between phase flips
     kind: str = "random-halves"
+    stop_tick: int = 1 << 30   # final heal: no partitions at/after this
+                               # tick (the reference's final-generator heal
+                               # + quiesce phase, core.clj:74-80)
 
 
 def partition_matrix(nem: NemesisConfig, cfg: NetConfig, t, instance_key
@@ -262,7 +286,7 @@ def partition_matrix(nem: NemesisConfig, cfg: NetConfig, t, instance_key
     if not nem.enabled:
         return jnp.zeros((NT, NT), dtype=bool)
     phase = t // nem.interval
-    active = (phase % 2) == 1
+    active = ((phase % 2) == 1) & (t < nem.stop_tick)
     key = jax.random.fold_in(instance_key, phase)
     n = cfg.n_nodes
     if nem.kind == "isolated-node":

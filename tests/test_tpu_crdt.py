@@ -1,0 +1,45 @@
+"""TPU-runtime CRDT models: broadcast / g-set / pn-counter end-to-end on
+the virtual CPU mesh, including partition-nemesis runs (SURVEY §7 step 6)."""
+
+from maelstrom_tpu.models.crdt import (BroadcastModel, GCounterModel,
+                                       GossipSetModel, PNCounterModel)
+from maelstrom_tpu.tpu.harness import run_tpu_test
+
+
+def test_tpu_g_set():
+    res = run_tpu_test(GossipSetModel("grid"), dict(
+        node_count=5, concurrency=2, n_instances=8, record_instances=4,
+        time_limit=2.0, rate=20.0, latency=5.0, rpc_timeout=0.5, seed=7))
+    assert res["valid?"] is True, res["instances"]
+    inst = res["instances"][0]
+    assert inst["acknowledged-count"] > 0
+    assert inst["lost-count"] == 0
+
+
+def test_tpu_broadcast_partition():
+    res = run_tpu_test(BroadcastModel("grid"), dict(
+        node_count=5, concurrency=2, n_instances=8, record_instances=4,
+        time_limit=3.0, rate=20.0, latency=5.0, rpc_timeout=0.5,
+        nemesis=["partition"], nemesis_interval=0.3, seed=9))
+    # partitions must actually bite (server gossip dropped)...
+    assert res["net"]["dropped-partition"] > 0
+    # ...and anti-entropy must still deliver every acknowledged broadcast
+    assert res["valid?"] is True, res["instances"]
+
+
+def test_tpu_pn_counter():
+    res = run_tpu_test(PNCounterModel(n_nodes_hint=3, topology="total"),
+                       dict(node_count=3, concurrency=2, n_instances=8,
+                            record_instances=4, time_limit=2.0, rate=20.0,
+                            latency=5.0, rpc_timeout=0.5, seed=11))
+    assert res["valid?"] is True, res["instances"]
+    inst = res["instances"][0]
+    assert inst["final-reads"], inst
+
+
+def test_tpu_g_counter():
+    res = run_tpu_test(GCounterModel(n_nodes_hint=3, topology="total"),
+                       dict(node_count=3, concurrency=2, n_instances=4,
+                            record_instances=2, time_limit=1.5, rate=20.0,
+                            latency=5.0, rpc_timeout=0.5, seed=13))
+    assert res["valid?"] is True, res["instances"]
