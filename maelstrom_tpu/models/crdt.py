@@ -324,8 +324,7 @@ class PNCounterModel(Model):
     def complete_record(self, f, a, b, c, etype):
         if f == F_ADD:
             return {"f": "add", "value": int(a)}
-        from ..tpu.runtime import EV_OK as _OK
-        if etype == _OK:
+        if etype == EV_OK:
             return {"f": "read", "value": int(a)}
         return {"f": "read", "value": None}
 

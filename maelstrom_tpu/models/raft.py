@@ -1,0 +1,535 @@
+"""Vectorized Raft: a linearizable KV store as a fixed-shape JAX automaton.
+
+The TPU-runtime flagship (SURVEY §7 step 7) — the north-star config where
+one chip fuzzes thousands of independent Raft clusters in parallel. The
+protocol follows the reference's teaching Raft (demo/python/raft.py:
+elections :274-343, log replication :391-445, commit via median
+match-index :382-389) re-expressed as pure per-node step functions over
+int32 lanes:
+
+- leader election with randomized timeouts, vote bitmasks, term step-down
+- log replication one entry per AppendEntries, with conflict truncation
+  and next/match index backoff
+- commit = median match index, guarded to current-term entries
+- all client ops (read/write/cas) go through the log; the leader replies
+  at apply time; non-leaders reject with error 11 (temporarily-available),
+  which clients treat as a definite failure and retry as fresh ops
+- fixed-capacity log (``log_cap``); a full log rejects client ops with
+  error 11 (explicit, visible backpressure instead of dynamic growth)
+
+Checked per instance by the WGL linearizability checker
+(checkers/linearizable.py), the same boundary the reference's lin-kv
+workload hands to Knossos.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..tpu import wire
+from ..tpu.runtime import EV_INFO, EV_OK, Model, TYPE_ERROR
+
+# message types
+T_READ = 1
+T_WRITE = 2
+T_CAS = 3
+T_READ_OK = 4
+T_WRITE_OK = 5
+T_CAS_OK = 6
+T_REQ_VOTE = 10
+T_VOTE_REPLY = 11
+T_APPEND = 12
+T_APPEND_REPLY = 13
+
+F_READ = 1
+F_WRITE = 2
+F_CAS = 3
+
+NIL = -1     # missing KV value
+
+# log entry body lanes: (f, key, a, b, client, client_msg_id)
+ENTRY_LANES = 6
+
+
+class RaftRow(NamedTuple):
+    """Per-node Raft state (the lanes of one row of the cluster tensor)."""
+    term: jnp.ndarray
+    voted_for: jnp.ndarray
+    role: jnp.ndarray            # 0 follower / 1 candidate / 2 leader
+    votes: jnp.ndarray           # bitmask of granted votes
+    commit_idx: jnp.ndarray      # number of committed entries
+    last_applied: jnp.ndarray
+    log_term: jnp.ndarray        # [LOGN]
+    log_body: jnp.ndarray        # [LOGN, ENTRY_LANES]
+    log_len: jnp.ndarray
+    kv: jnp.ndarray              # [KEYS]
+    next_idx: jnp.ndarray        # [N] entries known replicated per peer
+    match_idx: jnp.ndarray       # [N]
+    election_deadline: jnp.ndarray
+    last_hb: jnp.ndarray
+    leader_hint: jnp.ndarray     # last known leader (for client proxying,
+                                 # the role of raft.py:552-571); -1 unknown
+
+
+class RaftModel(Model):
+    name = "lin-kv"
+    body_lanes = 12
+    max_out = 1
+    idempotent_fs = (F_READ,)
+
+    def __init__(self, n_nodes_hint: int = 5, log_cap: int = 96,
+                 n_keys: int = 8, n_vals: int = 8,
+                 elect_min: int = 60, elect_jitter: int = 60,
+                 heartbeat: int = 15, apply_max: int = 2):
+        self.n_nodes_hint = n_nodes_hint
+        self.log_cap = log_cap
+        self.n_keys = n_keys
+        self.n_vals = n_vals
+        self.elect_min = elect_min
+        self.elect_jitter = elect_jitter
+        self.heartbeat = heartbeat
+        self.apply_max = apply_max
+        # tick emits: (N-1) vote-or-append sends + apply_max client replies
+        self.tick_out = (n_nodes_hint - 1) + apply_max
+
+    def _config(self):
+        return (self.n_nodes_hint, self.log_cap, self.n_keys, self.n_vals,
+                self.elect_min, self.elect_jitter, self.heartbeat,
+                self.apply_max)
+
+    def __hash__(self):
+        return hash((type(self), self._config()))
+
+    def __eq__(self, other):
+        return (type(self) is type(other)
+                and self._config() == other._config())
+
+    def init_row(self, n_nodes, node_idx, key, params):
+        assert n_nodes == self.n_nodes_hint
+        jitter = jax.random.randint(key, (), 0, self.elect_jitter)
+        return RaftRow(
+            term=jnp.int32(0),
+            voted_for=jnp.int32(-1),
+            role=jnp.int32(0),
+            votes=jnp.int32(0),
+            commit_idx=jnp.int32(0),
+            last_applied=jnp.int32(0),
+            log_term=jnp.zeros((self.log_cap,), jnp.int32),
+            log_body=jnp.zeros((self.log_cap, ENTRY_LANES), jnp.int32),
+            log_len=jnp.int32(0),
+            kv=jnp.full((self.n_keys,), NIL, jnp.int32),
+            next_idx=jnp.zeros((n_nodes,), jnp.int32),
+            match_idx=jnp.zeros((n_nodes,), jnp.int32),
+            election_deadline=(self.elect_min + jitter).astype(jnp.int32),
+            last_hb=jnp.int32(0),
+            leader_hint=jnp.int32(-1),
+        )
+
+    # --- helpers ----------------------------------------------------------
+
+    def _last_log_term(self, row: RaftRow):
+        return jnp.where(row.log_len > 0,
+                         row.log_term[jnp.maximum(row.log_len - 1, 0)], 0)
+
+    def _step_down(self, row: RaftRow, new_term, t):
+        """Adopt a higher term as follower."""
+        higher = new_term > row.term
+        return row._replace(
+            term=jnp.where(higher, new_term, row.term),
+            role=jnp.where(higher, 0, row.role),
+            voted_for=jnp.where(higher, -1, row.voted_for),
+            votes=jnp.where(higher, 0, row.votes),
+        )
+
+    def _reset_election(self, row: RaftRow, t, key):
+        jitter = jax.random.randint(key, (), 0, self.elect_jitter)
+        return row._replace(
+            election_deadline=(t + self.elect_min + jitter).astype(
+                jnp.int32))
+
+    @staticmethod
+    def _reply(cfg, dest, type_, reply_to, body_vals):
+        out = jnp.zeros((1, cfg.lanes), dtype=jnp.int32)
+        out = out.at[0, wire.VALID].set(1)
+        out = out.at[0, wire.DEST].set(dest)
+        out = out.at[0, wire.TYPE].set(type_)
+        out = out.at[0, wire.REPLYTO].set(reply_to)
+        for i, v in enumerate(body_vals):
+            out = out.at[0, wire.BODY + i].set(v)
+        return out
+
+    # --- message handlers -------------------------------------------------
+
+    def handle(self, row: RaftRow, node_idx, msg, t, key, cfg, params):
+        mtype = msg[wire.TYPE]
+
+        row_v, out_v = self._handle_req_vote(row, node_idx, msg, t, key,
+                                             cfg)
+        row_vr = self._handle_vote_reply(row, node_idx, msg, cfg)
+        row_a, out_a = self._handle_append(row, node_idx, msg, t, key, cfg)
+        row_ar = self._handle_append_reply(row, msg)
+        row_c, out_c = self._handle_client(row, node_idx, msg, cfg)
+
+        def pick(a, b, cond):
+            return jax.tree.map(lambda x, y: jnp.where(cond, y, x), a, b)
+
+        out = jnp.zeros((1, cfg.lanes), dtype=jnp.int32)
+        new = row
+        new = pick(new, row_v, mtype == T_REQ_VOTE)
+        new = pick(new, row_vr, mtype == T_VOTE_REPLY)
+        new = pick(new, row_a, mtype == T_APPEND)
+        new = pick(new, row_ar, mtype == T_APPEND_REPLY)
+        is_client = (mtype == T_READ) | (mtype == T_WRITE) | (mtype == T_CAS)
+        new = pick(new, row_c, is_client)
+        out = jnp.where(mtype == T_REQ_VOTE, out_v, out)
+        out = jnp.where(mtype == T_APPEND, out_a, out)
+        out = jnp.where(is_client, out_c, out)
+        return new, out
+
+    def _handle_req_vote(self, row, node_idx, msg, t, key, cfg):
+        c_term = msg[wire.BODY]
+        c_lli = msg[wire.BODY + 1]      # candidate log length
+        c_llt = msg[wire.BODY + 2]      # candidate last log term
+        src = msg[wire.SRC]
+
+        row = self._step_down(row, c_term, t)
+        my_llt = self._last_log_term(row)
+        log_ok = (c_llt > my_llt) | ((c_llt == my_llt)
+                                     & (c_lli >= row.log_len))
+        grant = ((c_term == row.term)
+                 & ((row.voted_for == -1) | (row.voted_for == src))
+                 & log_ok)
+        row = row._replace(
+            voted_for=jnp.where(grant, src, row.voted_for))
+        row = jax.tree.map(
+            lambda a, b: jnp.where(grant, b, a), row,
+            self._reset_election(row, t, key))
+        out = self._reply(cfg, src, T_VOTE_REPLY, msg[wire.MSGID],
+                          [row.term, grant.astype(jnp.int32)])
+        return row, out
+
+    def _handle_vote_reply(self, row, node_idx, msg, cfg):
+        r_term = msg[wire.BODY]
+        granted = msg[wire.BODY + 1] == 1
+        src = msg[wire.SRC]
+        n = cfg.n_nodes
+
+        row = self._step_down(row, r_term, 0)
+        count_it = (row.role == 1) & (r_term == row.term) & granted
+        votes = jnp.where(count_it,
+                          row.votes | (1 << src).astype(jnp.int32),
+                          row.votes)
+        n_votes = jnp.sum((votes[None] >> jnp.arange(n)) & 1) + 1  # + self
+        win = count_it & (n_votes > n // 2)
+        row = row._replace(
+            votes=votes,
+            role=jnp.where(win, 2, row.role),
+            # next_idx starts at log_len (send from the tip, back off on
+            # conflict); own match is everything
+            next_idx=jnp.where(win, row.log_len, row.next_idx),
+            match_idx=jnp.where(
+                win, jnp.zeros_like(row.match_idx), row.match_idx
+            ).at[node_idx].set(jnp.where(win, row.log_len,
+                                         row.match_idx[node_idx])),
+            last_hb=jnp.where(win, -self.heartbeat, row.last_hb),
+        )
+        return row
+
+    def _handle_append(self, row, node_idx, msg, t, key, cfg):
+        l_term = msg[wire.BODY]
+        prev_idx = msg[wire.BODY + 1]
+        prev_term = msg[wire.BODY + 2]
+        l_commit = msg[wire.BODY + 3]
+        n_entries = msg[wire.BODY + 4]
+        e_term = msg[wire.BODY + 5]
+        e_body = msg[wire.BODY + 6:wire.BODY + 6 + ENTRY_LANES]
+        src = msg[wire.SRC]
+
+        row = self._step_down(row, l_term, t)
+        current = l_term == row.term
+        # a current-term AppendEntries always comes from the legitimate
+        # leader: candidates step back down, election timer resets, and
+        # the sender becomes the leader hint for client proxying
+        row = row._replace(
+            role=jnp.where(current & (row.role == 1), 0, row.role),
+            leader_hint=jnp.where(current, src, row.leader_hint))
+        row = jax.tree.map(
+            lambda a, b: jnp.where(current, b, a), row,
+            self._reset_election(row, t, key))
+
+        prev_ok = (prev_idx == 0) | (
+            (prev_idx <= row.log_len)
+            & (row.log_term[jnp.maximum(prev_idx - 1, 0)] == prev_term))
+        fits = prev_idx < self.log_cap
+        accept = current & prev_ok & ((n_entries == 0) | fits)
+
+        # append/overwrite the entry at prev_idx
+        do_write = accept & (n_entries == 1)
+        widx = jnp.clip(prev_idx, 0, self.log_cap - 1)
+        same = (row.log_len > prev_idx) & (row.log_term[widx] == e_term)
+        new_len = jnp.where(
+            do_write,
+            jnp.where(same, jnp.maximum(row.log_len, prev_idx + 1),
+                      prev_idx + 1),
+            row.log_len)
+        log_term = jnp.where(do_write,
+                             row.log_term.at[widx].set(e_term),
+                             row.log_term)
+        log_body = jnp.where(do_write,
+                             row.log_body.at[widx].set(e_body),
+                             row.log_body)
+        match = jnp.where(accept, prev_idx + n_entries, 0)
+        commit = jnp.where(accept,
+                           jnp.maximum(row.commit_idx,
+                                       jnp.minimum(l_commit, new_len)),
+                           row.commit_idx)
+        row = row._replace(log_term=log_term, log_body=log_body,
+                           log_len=new_len, commit_idx=commit)
+        out = self._reply(cfg, src, T_APPEND_REPLY, msg[wire.MSGID],
+                          [row.term, accept.astype(jnp.int32), match])
+        return row, out
+
+    def _handle_append_reply(self, row, msg):
+        r_term = msg[wire.BODY]
+        success = msg[wire.BODY + 1] == 1
+        match = msg[wire.BODY + 2]
+        src = msg[wire.SRC]
+
+        row = self._step_down(row, r_term, 0)
+        mine = (row.role == 2) & (r_term == row.term)
+        ok = mine & success
+        fail = mine & ~success
+        next_idx = row.next_idx
+        next_idx = jnp.where(ok, next_idx.at[src].set(
+            jnp.maximum(next_idx[src], match)), next_idx)
+        next_idx = jnp.where(fail, next_idx.at[src].set(
+            jnp.maximum(next_idx[src] - 1, 0)), next_idx)
+        match_idx = jnp.where(ok, row.match_idx.at[src].set(
+            jnp.maximum(row.match_idx[src], match)), row.match_idx)
+        return row._replace(next_idx=next_idx, match_idx=match_idx)
+
+    def _handle_client(self, row, node_idx, msg, cfg):
+        mtype = msg[wire.TYPE]
+        src = msg[wire.SRC]
+        is_leader = row.role == 2
+        full = row.log_len >= self.log_cap
+        accept = is_leader & ~full
+        # non-leaders proxy to the last known leader, preserving the
+        # client src so the leader replies straight to the client; body
+        # lane 3 counts hops to stop forwarding loops
+        hops = msg[wire.BODY + 3]
+        forward = (~accept & (row.leader_hint >= 0)
+                   & (row.leader_hint != node_idx) & (hops < 3))
+
+        f = jnp.where(mtype == T_READ, F_READ,
+                      jnp.where(mtype == T_WRITE, F_WRITE, F_CAS))
+        entry = jnp.stack([f, msg[wire.BODY], msg[wire.BODY + 1],
+                           msg[wire.BODY + 2], src, msg[wire.MSGID]])
+        widx = jnp.clip(row.log_len, 0, self.log_cap - 1)
+        row = row._replace(
+            log_term=jnp.where(accept,
+                               row.log_term.at[widx].set(row.term),
+                               row.log_term),
+            log_body=jnp.where(accept,
+                               row.log_body.at[widx].set(entry),
+                               row.log_body),
+            log_len=jnp.where(accept, row.log_len + 1, row.log_len),
+            match_idx=jnp.where(
+                accept,
+                row.match_idx.at[node_idx].set(row.log_len + 1),
+                row.match_idx),
+        )
+        # forward: re-emit the request toward the leader hint; otherwise
+        # reject with error 11 temporarily-unavailable (definite -> client
+        # fails the op and moves on, like the reference's non-leader nodes)
+        fwd = msg.at[wire.DEST].set(row.leader_hint)
+        fwd = fwd.at[wire.BODY + 3].set(hops + 1)
+        err = self._reply(cfg, src, TYPE_ERROR, msg[wire.MSGID], [11])[0]
+        out = jnp.where(forward, fwd, err)[None]
+        out = out.at[0, wire.VALID].set(jnp.where(accept, 0, 1))
+        return row, out
+
+    # --- per-tick behavior ------------------------------------------------
+
+    def tick(self, row: RaftRow, node_idx, t, key, cfg, params):
+        n = cfg.n_nodes
+        k_elect, k_jit = jax.random.split(key)
+
+        # 1) election timeout -> candidacy
+        timeout = (row.role != 2) & (t >= row.election_deadline)
+        row = row._replace(
+            term=jnp.where(timeout, row.term + 1, row.term),
+            role=jnp.where(timeout, 1, row.role),
+            voted_for=jnp.where(timeout, node_idx, row.voted_for),
+            votes=jnp.where(timeout, 0, row.votes),
+            # make the first vote solicitation fire immediately
+            last_hb=jnp.where(timeout, t - self.heartbeat, row.last_hb),
+            # suspected-dead leader: stop proxying to it
+            leader_hint=jnp.where(timeout, -1, row.leader_hint),
+        )
+        row = jax.tree.map(
+            lambda a, b: jnp.where(timeout, b, a), row,
+            self._reset_election(row, t, k_jit))
+
+        # 2) leader: advance commit to the median match index (current
+        # term only), then apply
+        is_leader = row.role == 2
+        match = row.match_idx.at[node_idx].set(row.log_len)
+        sorted_match = jnp.sort(match)               # ascending
+        majority_match = sorted_match[(n - 1) // 2]  # value >= on majority
+        guard_idx = jnp.clip(majority_match - 1, 0, self.log_cap - 1)
+        current_term_ok = row.log_term[guard_idx] == row.term
+        new_commit = jnp.where(
+            is_leader & (majority_match > row.commit_idx)
+            & current_term_ok,
+            majority_match, row.commit_idx)
+        row = row._replace(commit_idx=new_commit, match_idx=match)
+
+        # 3) apply up to apply_max committed entries; leader replies
+        outs = []
+        for _ in range(self.apply_max):
+            row, reply = self._apply_one(row, cfg)
+            outs.append(reply)
+
+        # 4) peer sends: candidates solicit votes (re-solicit on the same
+        # cadence to survive loss), leaders replicate
+        is_leader = row.role == 2
+        solicit = (row.role == 1) & (t - row.last_hb >= self.heartbeat)
+        hb_due = is_leader & (t - row.last_hb >= self.heartbeat)
+        row = row._replace(
+            last_hb=jnp.where(hb_due | solicit, t, row.last_hb))
+        peer_msgs = self._peer_sends(row, node_idx, t, solicit, hb_due, cfg)
+        outs.append(peer_msgs)
+        return row, jnp.concatenate(outs, axis=0)
+
+    def _apply_one(self, row: RaftRow, cfg):
+        do = row.last_applied < row.commit_idx
+        aidx = jnp.clip(row.last_applied, 0, self.log_cap - 1)
+        entry = row.log_body[aidx]
+        f, k, a, b, client, cmsg = (entry[0], entry[1], entry[2], entry[3],
+                                    entry[4], entry[5])
+        k = jnp.clip(k, 0, self.n_keys - 1)
+        cur = row.kv[k]
+        cas_ok = cur == a
+        new_val = jnp.where(f == F_WRITE, a,
+                            jnp.where((f == F_CAS) & cas_ok, b, cur))
+        kv = jnp.where(do, row.kv.at[k].set(new_val), row.kv)
+        row = row._replace(
+            kv=kv, last_applied=jnp.where(do, row.last_applied + 1,
+                                          row.last_applied))
+
+        # leader replies to the waiting client
+        reply_type = jnp.where(
+            f == F_READ, T_READ_OK,
+            jnp.where(f == F_WRITE, T_WRITE_OK,
+                      jnp.where(cas_ok, T_CAS_OK, TYPE_ERROR)))
+        err_code = jnp.where(cur == NIL, 20, 22)
+        out = jnp.zeros((1, cfg.lanes), dtype=jnp.int32)
+        out = out.at[0, wire.VALID].set(
+            jnp.where(do & (row.role == 2), 1, 0))
+        out = out.at[0, wire.DEST].set(client)
+        out = out.at[0, wire.TYPE].set(reply_type)
+        out = out.at[0, wire.REPLYTO].set(cmsg)
+        # read replies carry (key, value); cas errors carry the code
+        out = out.at[0, wire.BODY].set(
+            jnp.where(reply_type == TYPE_ERROR, err_code, k))
+        out = out.at[0, wire.BODY + 1].set(cur)
+        return row, out
+
+    def _peer_sends(self, row: RaftRow, node_idx, t, solicit, hb_due, cfg):
+        """One message per peer slot (N-1 rows): RequestVote when a
+        soliciting candidate, AppendEntries on the leader's heartbeat
+        cadence."""
+        n = cfg.n_nodes
+        # peers = all nodes except self, packed into n-1 slots
+        slots = jnp.arange(n - 1, dtype=jnp.int32)
+        peers = jnp.where(slots >= node_idx, slots + 1, slots)
+
+        def per_peer(peer):
+            vote_body = [row.term, row.log_len, self._last_log_term(row)]
+            prev_idx = row.next_idx[peer]
+            has_entry = row.log_len > prev_idx
+            eidx = jnp.clip(prev_idx, 0, self.log_cap - 1)
+            pidx = jnp.clip(prev_idx - 1, 0, self.log_cap - 1)
+            append_body = [row.term, prev_idx,
+                           jnp.where(prev_idx > 0, row.log_term[pidx], 0),
+                           row.commit_idx,
+                           has_entry.astype(jnp.int32),
+                           row.log_term[eidx]]
+            out = jnp.zeros((cfg.lanes,), dtype=jnp.int32)
+            send_vote = solicit
+            send_append = hb_due
+            out = out.at[wire.VALID].set(
+                jnp.where(send_vote | send_append, 1, 0))
+            out = out.at[wire.DEST].set(peer)
+            out = out.at[wire.TYPE].set(
+                jnp.where(send_vote, T_REQ_VOTE, T_APPEND))
+            for i, v in enumerate(vote_body):
+                out = out.at[wire.BODY + i].set(
+                    jnp.where(send_vote, v, append_body[i]))
+            for i in range(len(vote_body), len(append_body)):
+                out = out.at[wire.BODY + i].set(
+                    jnp.where(send_vote, 0, append_body[i]))
+            entry = row.log_body[eidx] * has_entry.astype(jnp.int32)
+            out = jax.lax.dynamic_update_slice(
+                out, jnp.where(send_vote, 0, entry),
+                (wire.BODY + 6,))
+            return out
+
+        return jax.vmap(per_peer)(peers)
+
+    # --- client side ------------------------------------------------------
+
+    def sample_op(self, key, uniq, cfg, params):
+        k1, k2, k3, k4 = jax.random.split(key, 4)
+        r = jax.random.uniform(k1)
+        kk = jax.random.randint(k2, (), 0, self.n_keys, dtype=jnp.int32)
+        v1 = jax.random.randint(k3, (), 0, self.n_vals, dtype=jnp.int32)
+        v2 = jax.random.randint(k4, (), 0, self.n_vals, dtype=jnp.int32)
+        f = jnp.where(r < 1 / 3, F_READ,
+                      jnp.where(r < 2 / 3, F_WRITE, F_CAS))
+        return jnp.stack([f, kk, v1, v2])
+
+    def encode_request(self, op, msg_id, client_idx, key, cfg, params):
+        dest = jax.random.randint(key, (), 0, cfg.n_nodes, dtype=jnp.int32)
+        mtype = jnp.where(op[0] == F_READ, T_READ,
+                          jnp.where(op[0] == F_WRITE, T_WRITE, T_CAS))
+        return wire.make_msg(src=0, dest=dest, type_=mtype, msg_id=msg_id,
+                             body=(op[1], op[2], op[3]),
+                             body_lanes=self.body_lanes)
+
+    def decode_reply(self, op, msg, cfg, params):
+        mtype = msg[wire.TYPE]
+        ok = ((mtype == T_READ_OK) | (mtype == T_WRITE_OK)
+              | (mtype == T_CAS_OK))
+        etype = jnp.where(ok, EV_OK, EV_INFO)
+        value = jnp.stack([op[1],
+                           jnp.where(mtype == T_READ_OK,
+                                     msg[wire.BODY + 1], op[2]),
+                           op[3]])
+        return etype, value
+
+    # --- host-side decoding ----------------------------------------------
+
+    def invoke_record(self, f, a, b, c):
+        if f == F_READ:
+            return {"f": "read", "value": [a, None]}
+        if f == F_WRITE:
+            return {"f": "write", "value": [a, b]}
+        return {"f": "cas", "value": [a, [b, c]]}
+
+    def complete_record(self, f, a, b, c, etype):
+        if etype != EV_OK:
+            return self.invoke_record(f, a, b, c)
+        if f == F_READ:
+            return {"f": "read", "value": [a, None if b == NIL else b]}
+        if f == F_WRITE:
+            return {"f": "write", "value": [a, b]}
+        return {"f": "cas", "value": [a, [b, c]]}
+
+    def checker(self):
+        from ..checkers.linearizable import linearizable_kv_checker
+        return lambda history, opts: linearizable_kv_checker(history)

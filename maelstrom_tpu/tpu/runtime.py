@@ -332,8 +332,15 @@ def node_phase(model: Model, node_state, inbox_nodes, t, key,
         row, outs_t = model.tick(row, node_idx, t, tkey, cfg, params)
         outs = jnp.concatenate(
             [outs_k.reshape(-1, L), outs_t.reshape(-1, L)], axis=0)
-        # stamp src + valid gating on body-declared validity
-        outs = outs.at[:, wire.SRC].set(node_idx)
+        # stamp src = this node on rows whose SRC lane is still the zero
+        # default. Contract: models either leave SRC unset (ordinary
+        # sends/replies) or copy a CLIENT src (>= n_nodes) when proxying a
+        # client request toward the leader. Forwarding a *server*-origin
+        # message is not supported — node 0's id collides with the unset
+        # sentinel and would be re-stamped.
+        outs = outs.at[:, wire.SRC].set(
+            jnp.where(outs[:, wire.SRC] == 0, node_idx,
+                      outs[:, wire.SRC]))
         return row, outs
 
     keys = jax.random.split(key, N)
