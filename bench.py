@@ -1,0 +1,66 @@
+"""Benchmark: simulated network throughput of the TPU runtime.
+
+Runs the flagship vectorized Raft workload (512 concurrent 3-node
+clusters, partitions + loss enabled) for a fixed horizon on the available
+accelerator, timing the steady-state (post-compile) run, and prints ONE
+JSON line:
+
+    {"metric": "simulated_msgs_per_sec", "value": N, "unit": "msgs/s",
+     "vs_baseline": N / 60000}
+
+Baseline: the reference's peak simulated-network throughput of ~60,000
+msgs/sec on a 48-way Xeon (reference README.md:39-42; BASELINE.md row 1).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+BASELINE_MSGS_PER_SEC = 60_000.0
+
+
+def main():
+    import jax
+
+    from maelstrom_tpu.models.raft import RaftModel
+    from maelstrom_tpu.tpu.harness import make_sim_config
+    from maelstrom_tpu.tpu.runtime import run_sim
+
+    model = RaftModel(n_nodes_hint=3, log_cap=64)
+    opts = dict(node_count=3, concurrency=3,
+                n_instances=int(os.environ.get("BENCH_INSTANCES", 512)),
+                record_instances=1,
+                time_limit=float(os.environ.get("BENCH_SIM_SECONDS", 2.0)),
+                rate=30.0, latency=10.0, rpc_timeout=1.0,
+                nemesis=["partition"], nemesis_interval=0.4, p_loss=0.05,
+                recovery_time=0.3, seed=7)
+    sim = make_sim_config(model, opts)
+    params = model.make_params(sim.net.n_nodes)
+
+    # compile + warm-up
+    carry, events = run_sim(model, sim, 7, params)
+    jax.block_until_ready(carry.stats.delivered)
+
+    # steady-state timing
+    t0 = time.monotonic()
+    carry, events = run_sim(model, sim, 8, params)
+    jax.block_until_ready(carry.stats.delivered)
+    wall = time.monotonic() - t0
+
+    delivered = int(carry.stats.delivered)
+    value = delivered / wall if wall > 0 else 0.0
+    print(json.dumps({
+        "metric": "simulated_msgs_per_sec",
+        "value": round(value, 1),
+        "unit": "msgs/s",
+        "vs_baseline": round(value / BASELINE_MSGS_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

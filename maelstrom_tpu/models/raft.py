@@ -150,16 +150,10 @@ class RaftModel(Model):
             election_deadline=(t + self.elect_min + jitter).astype(
                 jnp.int32))
 
-    @staticmethod
-    def _reply(cfg, dest, type_, reply_to, body_vals):
-        out = jnp.zeros((1, cfg.lanes), dtype=jnp.int32)
-        out = out.at[0, wire.VALID].set(1)
-        out = out.at[0, wire.DEST].set(dest)
-        out = out.at[0, wire.TYPE].set(type_)
-        out = out.at[0, wire.REPLYTO].set(reply_to)
-        for i, v in enumerate(body_vals):
-            out = out.at[0, wire.BODY + i].set(v)
-        return out
+    def _reply(self, cfg, dest, type_, reply_to, body_vals):
+        return wire.make_msg(src=0, dest=dest, type_=type_,
+                             reply_to=reply_to, body=body_vals,
+                             body_lanes=self.body_lanes)[None]
 
     # --- message handlers -------------------------------------------------
 
@@ -282,9 +276,12 @@ class RaftModel(Model):
                              row.log_body.at[widx].set(e_body),
                              row.log_body)
         match = jnp.where(accept, prev_idx + n_entries, 0)
+        # Raft §5.3: commit = min(leaderCommit, index of last NEW entry) —
+        # NOT the local log length, which may include an unverified
+        # divergent tail kept past prev_idx+1
         commit = jnp.where(accept,
                            jnp.maximum(row.commit_idx,
-                                       jnp.minimum(l_commit, new_len)),
+                                       jnp.minimum(l_commit, match)),
                            row.commit_idx)
         row = row._replace(log_term=log_term, log_body=log_body,
                            log_len=new_len, commit_idx=commit)

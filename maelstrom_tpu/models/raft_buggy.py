@@ -14,6 +14,11 @@ linearizability bugs at scale).
 - :class:`RaftNoTermGuard` — the leader commits by match-index count
   alone, without the current-term guard (the Raft §5.4.2 trap): an entry
   replicated by an old-term leader can be committed and then overwritten.
+  NOTE: this one requires the full Figure-8 schedule (old-term entry
+  replicated to a majority, leader deposed, entry overwritten after
+  commit) — rare enough that 32 instances x 3s have not yet tripped it;
+  it is in the corpus as a hard target for large-fleet time-to-anomaly
+  runs, not in the must-catch CI test.
 """
 
 from __future__ import annotations
@@ -21,9 +26,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from ..tpu import wire
-from ..tpu.runtime import EV_OK, Model  # noqa: F401  (re-export context)
-from .raft import (F_READ, NIL, RaftModel, RaftRow, T_READ, T_READ_OK,
-                   TYPE_ERROR)
+from .raft import RaftModel, RaftRow, T_READ, T_READ_OK, T_VOTE_REPLY
 
 
 class RaftDoubleVote(RaftModel):
@@ -39,7 +42,7 @@ class RaftDoubleVote(RaftModel):
         # voted_for or log recency
         grant = c_term == row.term
         row = row._replace(voted_for=jnp.where(grant, src, row.voted_for))
-        out = self._reply(cfg, src, 11, msg[wire.MSGID],
+        out = self._reply(cfg, src, T_VOTE_REPLY, msg[wire.MSGID],
                           [row.term, grant.astype(jnp.int32)])
         return row, out
 

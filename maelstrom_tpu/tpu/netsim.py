@@ -95,8 +95,10 @@ def deliver(pool: jnp.ndarray, partitions: jnp.ndarray, t: jnp.ndarray,
     valid = pool[:, wire.VALID] == 1
     due = valid & (pool[:, wire.DTICK] <= t)
     dest = pool[:, wire.DEST]
-    src = pool[:, wire.SRC]
-    blocked = partitions[dest, src]           # [S]
+    # partitions and physics key on the PHYSICAL sender (origin), so a
+    # node proxying a client request cannot tunnel through a partition
+    origin = pool[:, wire.ORIGIN]
+    blocked = partitions[dest, origin]        # [S]
 
     # drop due+blocked messages now (recv-side partition drop)
     drop_mask = due & blocked
@@ -147,7 +149,7 @@ def enqueue(pool: jnp.ndarray, msgs: jnp.ndarray, t: jnp.ndarray,
 
     k_lat, k_loss = jax.random.split(key)
     # latency: zero on client links
-    is_client_edge = ((msgs[:, wire.SRC] >= cfg.n_nodes) |
+    is_client_edge = ((msgs[:, wire.ORIGIN] >= cfg.n_nodes) |
                       (msgs[:, wire.DEST] >= cfg.n_nodes))
     lat = _sample_latency(k_lat, M, cfg)
     lat = jnp.where(is_client_edge, 0, lat)

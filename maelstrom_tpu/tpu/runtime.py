@@ -247,9 +247,10 @@ def client_step(model: Model, cs: ClientState, inbox_clients, t, key,
     reqs = jax.vmap(lambda o, m, ci, k: model.encode_request(
         o, m, ci, k, cfg, params))(op, msg_id,
                                    jnp.arange(C, dtype=jnp.int32), enc_keys)
+    client_ids = cfg.n_nodes + jnp.arange(C, dtype=jnp.int32)
     reqs = reqs.at[:, wire.VALID].set(jnp.where(fire, 1, 0))
-    reqs = reqs.at[:, wire.SRC].set(cfg.n_nodes +
-                                    jnp.arange(C, dtype=jnp.int32))
+    reqs = reqs.at[:, wire.SRC].set(client_ids)
+    reqs = reqs.at[:, wire.ORIGIN].set(client_ids)
     reqs = reqs.at[:, wire.MSGID].set(msg_id)
 
     events = events.at[:, 1, EV_TYPE].set(
@@ -341,6 +342,9 @@ def node_phase(model: Model, node_state, inbox_nodes, t, key,
         outs = outs.at[:, wire.SRC].set(
             jnp.where(outs[:, wire.SRC] == 0, node_idx,
                       outs[:, wire.SRC]))
+        # ORIGIN is always the emitting node — the physical link the
+        # message leaves on — regardless of any proxied logical src
+        outs = outs.at[:, wire.ORIGIN].set(node_idx)
         return row, outs
 
     keys = jax.random.split(key, N)
@@ -442,13 +446,18 @@ def make_tick_fn(model: Model, sim: SimConfig, params) -> Callable:
     return tick_fn
 
 
+def simulate(model: Model, sim: SimConfig, seed, params=None
+             ) -> Tuple[Carry, jnp.ndarray]:
+    """Traceable simulation body (used directly inside shard_map);
+    returns (final carry, events [T, R, C, 2, EV_LANES])."""
+    carry = init_carry(model, sim, seed, params)
+    tick_fn = make_tick_fn(model, sim, params)
+    return jax.lax.scan(tick_fn, carry,
+                        jnp.arange(sim.n_ticks, dtype=jnp.int32))
+
+
 @partial(jax.jit, static_argnames=("model", "sim"))
 def run_sim(model: Model, sim: SimConfig, seed: int, params=None
             ) -> Tuple[Carry, jnp.ndarray]:
-    """Run the full simulation; returns (final carry, events
-    [T, R, C, 2, EV_LANES])."""
-    carry = init_carry(model, sim, seed, params)
-    tick_fn = make_tick_fn(model, sim, params)
-    carry, events = jax.lax.scan(tick_fn, carry,
-                                 jnp.arange(sim.n_ticks, dtype=jnp.int32))
-    return carry, events
+    """Jitted single-device entry point around :func:`simulate`."""
+    return simulate(model, sim, seed, params)

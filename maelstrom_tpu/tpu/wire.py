@@ -7,13 +7,17 @@ message is one row of ``MSG_LANES + body_lanes`` int32s:
 lane  meaning
 ====  ===========================================================
 0     valid (0/1)
-1     src   (node index; clients follow server nodes)
+1     src   (logical sender: node index; clients follow server nodes)
 2     dest
 3     deliver_tick (virtual-clock deadline, the net.clj ns deadline)
 4     type  (workload-specific enum)
 5     msg_id
 6     in_reply_to (-1 if none)
-7+    body lanes (workload-specific payload encoding)
+7     origin (PHYSICAL sender — the node/client that put the message on
+      the wire; differs from src when a node proxies a client request.
+      Latency sampling and partition drops key on origin, reply routing
+      on src)
+8+    body lanes (workload-specific payload encoding)
 ====  ===========================================================
 
 Workload vocabularies (the ``defrpc`` schemas of SURVEY §2.2) map onto the
@@ -32,9 +36,10 @@ DTICK = 3
 TYPE = 4
 MSGID = 5
 REPLYTO = 6
-BODY = 7          # first body lane
+ORIGIN = 7
+BODY = 8          # first body lane
 
-HDR_LANES = 7
+HDR_LANES = 8
 
 
 def lanes(body_lanes: int) -> int:

@@ -1,13 +1,20 @@
 import os
 import sys
 
-# TPU-runtime tests run on a virtual 8-device CPU mesh; must be set before
-# jax import anywhere in the test session.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# TPU-runtime tests run on a virtual 8-device CPU mesh. A sitecustomize
+# hook may have imported jax (pointing at a real accelerator) before this
+# file runs, so updating os.environ alone is not enough — override the
+# already-imported config too. Backends are initialized lazily, so this
+# works as long as no device was touched yet.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

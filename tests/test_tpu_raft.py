@@ -36,6 +36,9 @@ BUG_OPTS = dict(node_count=3, concurrency=3, n_instances=24,
                 seed=2)
 
 
+# RaftNoTermGuard is deliberately absent: the §5.4.2 commit bug needs the
+# Figure-8 schedule, which these shapes don't reliably produce (see
+# models/raft_buggy.py) — asserting it's caught here would be a lie.
 @pytest.mark.parametrize("buggy", [RaftDoubleVote, RaftStaleRead])
 def test_raft_injected_bugs_are_caught(buggy):
     res = run_tpu_test(buggy(n_nodes_hint=3), BUG_OPTS)
