@@ -1,0 +1,244 @@
+"""Command-line interface.
+
+Commands (parity: reference src/maelstrom/core.clj -main :267-284 and
+option specs :136-229):
+
+- ``test``  — run one workload test (process or TPU runtime)
+- ``demo``  — the built-in self-test matrix over the bundled example nodes
+- ``serve`` — browse the store directory over HTTP
+- ``doc``   — regenerate doc/workloads.md + doc/protocol.md from schemas
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import http.server
+import json
+import os
+import sys
+from typing import List
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _bin_cmd(bin_path: str, args: List[str]):
+    """Resolve --bin into (bin, argv): .py files run under this python."""
+    if bin_path.endswith(".py"):
+        return sys.executable, [bin_path] + args
+    return bin_path, args
+
+
+def parse_concurrency(value: str, node_count: int) -> int:
+    """'10' -> 10, '4n' -> 4 * node_count (core.clj opt-spec parity)."""
+    if value.endswith("n"):
+        return int(value[:-1]) * node_count
+    return int(value)
+
+
+def add_test_options(p: argparse.ArgumentParser):
+    p.add_argument("-w", "--workload", required=True,
+                   help="workload name (echo, broadcast, g-set, "
+                        "g-counter, pn-counter, lin-kv, unique-ids, ...)")
+    p.add_argument("--bin", help="node binary (process runtime)")
+    p.add_argument("--runtime", choices=["process", "tpu"],
+                   default="process")
+    p.add_argument("--node-count", type=int, default=1)
+    p.add_argument("--concurrency", default="1n",
+                   help="client count; '4n' means 4 per node")
+    p.add_argument("--rate", type=float, default=10.0,
+                   help="expected ops/sec across all clients")
+    p.add_argument("--time-limit", type=float, default=20.0)
+    p.add_argument("--latency", type=float, default=0.0,
+                   help="mean inter-node latency in ms")
+    p.add_argument("--latency-dist", default="exponential",
+                   choices=["constant", "uniform", "exponential"])
+    p.add_argument("--nemesis", action="append", default=[],
+                   choices=["partition"])
+    p.add_argument("--nemesis-interval", type=float, default=10.0)
+    p.add_argument("--topology", default="grid",
+                   choices=["grid", "line", "total", "tree2", "tree3",
+                            "tree4"])
+    p.add_argument("--availability", default=None,
+                   help="'total' or a fraction like 0.9")
+    p.add_argument("--key-count", type=int, default=None)
+    p.add_argument("--log-stderr", action="store_true")
+    p.add_argument("--log-net-send", action="store_true")
+    p.add_argument("--log-net-recv", action="store_true")
+    p.add_argument("--seed", type=int, default=None)
+    p.add_argument("--store", default="store")
+    # TPU-runtime knobs
+    p.add_argument("--n-instances", type=int, default=64)
+    p.add_argument("--record-instances", type=int, default=8)
+    p.add_argument("--p-loss", type=float, default=0.0)
+
+
+def _availability(v):
+    if v is None or v == "total":
+        return v
+    return float(v)
+
+
+def cmd_test(args) -> int:
+    node_count = args.node_count
+    concurrency = parse_concurrency(args.concurrency, node_count)
+    if args.runtime == "process":
+        if not args.bin:
+            print("error: --bin is required for the process runtime",
+                  file=sys.stderr)
+            return 2
+        from .runner import run_test
+        bin_, bin_args = _bin_cmd(args.bin, [])
+        results = run_test(args.workload, dict(
+            bin=bin_, bin_args=bin_args, node_count=node_count,
+            concurrency=concurrency, rate=args.rate,
+            time_limit=args.time_limit, latency=args.latency,
+            latency_dist=args.latency_dist, p_loss=args.p_loss,
+            nemesis=args.nemesis, nemesis_interval=args.nemesis_interval,
+            topology=args.topology,
+            availability=_availability(args.availability),
+            key_count=args.key_count, log_stderr=args.log_stderr,
+            log_net_send=args.log_net_send,
+            log_net_recv=args.log_net_recv, seed=args.seed,
+            store_root=args.store))
+    else:
+        from .models import get_model
+        from .tpu.harness import run_tpu_test
+        for flag, name in ((args.log_stderr, "--log-stderr"),
+                           (args.log_net_send, "--log-net-send"),
+                           (args.log_net_recv, "--log-net-recv")):
+            if flag:
+                print(f"note: {name} has no effect on the TPU runtime "
+                      f"(no node processes / host wire log)",
+                      file=sys.stderr)
+        model = get_model(args.workload, node_count, args.topology)
+        if args.key_count and hasattr(model, "n_keys"):
+            model.n_keys = args.key_count
+        results = run_tpu_test(model, dict(
+            node_count=node_count, concurrency=concurrency,
+            rate=args.rate, time_limit=args.time_limit,
+            latency=args.latency, latency_dist=args.latency_dist,
+            p_loss=args.p_loss, nemesis=args.nemesis,
+            nemesis_interval=args.nemesis_interval,
+            availability=_availability(args.availability),
+            n_instances=args.n_instances,
+            record_instances=args.record_instances,
+            store_root=args.store,
+            seed=args.seed or 0))
+    print(json.dumps(results, indent=2, default=repr))
+    print()
+    if results.get("valid?") is True:
+        print("Everything looks good! ヽ(‘ー`)ノ")
+        return 0
+    print("Analysis invalid! (ノಥ益ಥ）ノ ┻━┻")
+    return 1
+
+
+DEMOS = [
+    # (workload, node, extra opts) — core.clj:104-126's matrix, over the
+    # bundled python nodes
+    ("echo", "echo.py", {}),
+    ("echo", "echo.py", {"node_count": 2}),
+    ("broadcast", "broadcast.py", {"node_count": 5, "topology": "grid"}),
+    ("broadcast", "broadcast.py",
+     {"node_count": 5, "topology": "tree4", "nemesis": ["partition"],
+      "nemesis_interval": 2.0, "recovery_time": 2.0}),
+    ("g-set", "g_set.py",
+     {"node_count": 3, "nemesis": ["partition"], "nemesis_interval": 2.0,
+      "recovery_time": 2.0}),
+    ("pn-counter", "pn_counter.py", {"node_count": 3,
+                                     "recovery_time": 1.0}),
+    ("g-counter", "pn_counter.py", {"node_count": 3,
+                                    "recovery_time": 1.0}),
+    ("unique-ids", "unique_ids.py",
+     {"node_count": 3, "availability": "total"}),
+    ("lin-kv", "lin_kv_proxy.py", {"node_count": 2}),
+]
+
+
+def cmd_demo(args) -> int:
+    """Self-test: the full matrix against the bundled example nodes."""
+    from .runner import run_test
+    failures = []
+    for workload, node, extra in DEMOS:
+        bin_, bin_args = _bin_cmd(
+            os.path.join(REPO, "examples", "python", node), [])
+        opts = dict(bin=bin_, bin_args=bin_args, node_count=1,
+                    concurrency=4, rate=10.0, time_limit=args.time_limit,
+                    recovery_time=1.0, store_root=args.store, seed=1)
+        opts.update(extra)
+        if "availability" in opts:
+            opts["availability"] = _availability(opts["availability"])
+        label = f"{workload} / {node} {extra or ''}"
+        print(f"== {label}")
+        try:
+            results = run_test(workload, opts)
+            ok = results.get("valid?") is True
+        except Exception as e:
+            print(f"   crashed: {e!r}")
+            ok = False
+        print("   valid!" if ok else "   INVALID")
+        if not ok:
+            failures.append(label)
+    print()
+    if failures:
+        print(f"{len(failures)} demo(s) failed:")
+        for f in failures:
+            print(f"  - {f}")
+        return 1
+    print(f"All {len(DEMOS)} demos passed. ヽ(‘ー`)ノ")
+    return 0
+
+
+def cmd_serve(args) -> int:
+    os.makedirs(args.store, exist_ok=True)
+    handler = functools.partial(http.server.SimpleHTTPRequestHandler,
+                                directory=args.store)
+    with http.server.ThreadingHTTPServer(("", args.port), handler) as srv:
+        print(f"Serving {args.store}/ on http://localhost:{args.port}")
+        try:
+            srv.serve_forever()
+        except KeyboardInterrupt:
+            pass
+    return 0
+
+
+def cmd_doc(args) -> int:
+    from .doc import write_docs
+    for path in write_docs(args.out):
+        print(f"wrote {path}")
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="maelstrom_tpu",
+        description="A TPU-native workbench for learning and testing "
+                    "distributed systems.")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p_test = sub.add_parser("test", help="run one workload test")
+    add_test_options(p_test)
+
+    p_demo = sub.add_parser("demo", help="run the self-test demo matrix")
+    p_demo.add_argument("--time-limit", type=float, default=5.0)
+    p_demo.add_argument("--store", default="store")
+
+    p_serve = sub.add_parser("serve", help="browse the store over HTTP")
+    p_serve.add_argument("--port", type=int, default=8080)
+    p_serve.add_argument("--store", default="store")
+
+    p_doc = sub.add_parser("doc", help="regenerate schema-driven docs")
+    p_doc.add_argument("--out", default="doc")
+
+    args = parser.parse_args(argv)
+    try:
+        return {"test": cmd_test, "demo": cmd_demo, "serve": cmd_serve,
+                "doc": cmd_doc}[args.command](args)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,36 @@
+"""TPU-runtime model registry: workload name -> vectorized model.
+
+The device-side counterpart of workloads/__init__.py's registry; the CLI's
+``--runtime tpu`` resolves through here.
+"""
+
+from __future__ import annotations
+
+
+def get_model(workload: str, node_count: int, topology: str = "grid"):
+    from .crdt import (BroadcastModel, GCounterModel, GossipSetModel,
+                       PNCounterModel)
+    from .echo import EchoModel
+    from .raft import RaftModel
+    from .raft_buggy import BUGGY_MODELS
+
+    if workload == "echo":
+        return EchoModel()
+    if workload == "broadcast":
+        return BroadcastModel(topology)
+    if workload == "g-set":
+        return GossipSetModel(topology)
+    if workload == "pn-counter":
+        return PNCounterModel(n_nodes_hint=node_count, topology="total")
+    if workload == "g-counter":
+        return GCounterModel(n_nodes_hint=node_count, topology="total")
+    if workload == "lin-kv":
+        return RaftModel(n_nodes_hint=node_count)
+    if workload.startswith("lin-kv-bug-"):
+        kind = workload[len("lin-kv-bug-"):]
+        if kind in BUGGY_MODELS:
+            return BUGGY_MODELS[kind](n_nodes_hint=node_count)
+    raise ValueError(
+        f"no TPU model for workload {workload!r}; available: echo, "
+        f"broadcast, g-set, g-counter, pn-counter, lin-kv, "
+        f"lin-kv-bug-{{{', '.join(BUGGY_MODELS)}}}")

@@ -80,6 +80,14 @@ class RaftModel(Model):
     max_out = 1
     idempotent_fs = (F_READ,)
 
+    # correctness switches — the bug-injection corpus (models/raft_buggy)
+    # flips these to produce broken-but-plausible variants; they are
+    # python bools, so each variant compiles to its own specialized graph
+    vote_check_voted_for = True    # False: grants multiple votes per term
+    vote_check_log = True          # False: ignores log recency in votes
+    serve_reads_locally = False    # True: reads bypass the log (stale)
+    commit_term_guard = True       # False: Raft §5.4.2 commit bug
+
     def __init__(self, n_nodes_hint: int = 5, log_cap: int = 96,
                  n_keys: int = 8, n_vals: int = 8,
                  elect_min: int = 60, elect_jitter: int = 60,
@@ -158,195 +166,208 @@ class RaftModel(Model):
     # --- message handlers -------------------------------------------------
 
     def handle(self, row: RaftRow, node_idx, msg, t, key, cfg, params):
+        """Fused single-pass handler: every RaftRow field is computed once
+        across all message types, and the log is touched by exactly ONE
+        drop-mode scatter — no full-log selects. (The per-type pick()
+        formulation cost ~5 full-state wheres per inbox slot and dominated
+        the tick; this shape is ~2x faster end-to-end.) Self-gating: an
+        invalid message has type 0, which matches no branch, so state is
+        unchanged and the out row stays invalid."""
         mtype = msg[wire.TYPE]
-
-        row_v, out_v = self._handle_req_vote(row, node_idx, msg, t, key,
-                                             cfg)
-        row_vr = self._handle_vote_reply(row, node_idx, msg, cfg)
-        row_a, out_a = self._handle_append(row, node_idx, msg, t, key, cfg)
-        row_ar = self._handle_append_reply(row, msg)
-        row_c, out_c = self._handle_client(row, node_idx, msg, cfg)
-
-        def pick(a, b, cond):
-            return jax.tree.map(lambda x, y: jnp.where(cond, y, x), a, b)
-
-        out = jnp.zeros((1, cfg.lanes), dtype=jnp.int32)
-        new = row
-        new = pick(new, row_v, mtype == T_REQ_VOTE)
-        new = pick(new, row_vr, mtype == T_VOTE_REPLY)
-        new = pick(new, row_a, mtype == T_APPEND)
-        new = pick(new, row_ar, mtype == T_APPEND_REPLY)
-        is_client = (mtype == T_READ) | (mtype == T_WRITE) | (mtype == T_CAS)
-        new = pick(new, row_c, is_client)
-        out = jnp.where(mtype == T_REQ_VOTE, out_v, out)
-        out = jnp.where(mtype == T_APPEND, out_a, out)
-        out = jnp.where(is_client, out_c, out)
-        return new, out
-
-    def _handle_req_vote(self, row, node_idx, msg, t, key, cfg):
-        c_term = msg[wire.BODY]
-        c_lli = msg[wire.BODY + 1]      # candidate log length
-        c_llt = msg[wire.BODY + 2]      # candidate last log term
         src = msg[wire.SRC]
+        body0 = msg[wire.BODY]
+        n = cfg.n_nodes
 
-        row = self._step_down(row, c_term, t)
+        is_vote = mtype == T_REQ_VOTE
+        is_vrep = mtype == T_VOTE_REPLY
+        is_ae = mtype == T_APPEND
+        is_arep = mtype == T_APPEND_REPLY
+        is_cli = (mtype == T_READ) | (mtype == T_WRITE) | (mtype == T_CAS)
+        is_proto = is_vote | is_vrep | is_ae | is_arep
+
+        # --- term adoption / step-down (every protocol message carries
+        # the sender term in body lane 0)
+        higher = is_proto & (body0 > row.term)
+        term = jnp.where(higher, body0, row.term)
+        role = jnp.where(higher, 0, row.role)
+        voted_for = jnp.where(higher, -1, row.voted_for)
+        votes = jnp.where(higher, 0, row.votes)
+
+        # --- RequestVote
+        c_lli = msg[wire.BODY + 1]
+        c_llt = msg[wire.BODY + 2]
         my_llt = self._last_log_term(row)
         log_ok = (c_llt > my_llt) | ((c_llt == my_llt)
                                      & (c_lli >= row.log_len))
-        grant = ((c_term == row.term)
-                 & ((row.voted_for == -1) | (row.voted_for == src))
-                 & log_ok)
-        row = row._replace(
-            voted_for=jnp.where(grant, src, row.voted_for))
-        row = jax.tree.map(
-            lambda a, b: jnp.where(grant, b, a), row,
-            self._reset_election(row, t, key))
-        out = self._reply(cfg, src, T_VOTE_REPLY, msg[wire.MSGID],
-                          [row.term, grant.astype(jnp.int32)])
-        return row, out
+        grant = is_vote & (body0 == term)
+        if self.vote_check_voted_for:
+            grant = grant & ((voted_for == -1) | (voted_for == src))
+        if self.vote_check_log:
+            grant = grant & log_ok
+        voted_for = jnp.where(grant, src, voted_for)
 
-    def _handle_vote_reply(self, row, node_idx, msg, cfg):
-        r_term = msg[wire.BODY]
-        granted = msg[wire.BODY + 1] == 1
-        src = msg[wire.SRC]
-        n = cfg.n_nodes
-
-        row = self._step_down(row, r_term, 0)
-        count_it = (row.role == 1) & (r_term == row.term) & granted
+        # --- VoteReply
+        granted = is_vrep & (msg[wire.BODY + 1] == 1)
+        count_it = (role == 1) & (body0 == term) & granted
         votes = jnp.where(count_it,
-                          row.votes | (1 << src).astype(jnp.int32),
-                          row.votes)
+                          votes | (1 << src).astype(jnp.int32), votes)
         n_votes = jnp.sum((votes[None] >> jnp.arange(n)) & 1) + 1  # + self
         win = count_it & (n_votes > n // 2)
-        row = row._replace(
-            votes=votes,
-            role=jnp.where(win, 2, row.role),
-            # next_idx starts at log_len (send from the tip, back off on
-            # conflict); own match is everything
-            next_idx=jnp.where(win, row.log_len, row.next_idx),
-            match_idx=jnp.where(
-                win, jnp.zeros_like(row.match_idx), row.match_idx
-            ).at[node_idx].set(jnp.where(win, row.log_len,
-                                         row.match_idx[node_idx])),
-            last_hb=jnp.where(win, -self.heartbeat, row.last_hb),
-        )
-        return row
+        role = jnp.where(win, 2, role)
 
-    def _handle_append(self, row, node_idx, msg, t, key, cfg):
-        l_term = msg[wire.BODY]
+        # --- AppendEntries
         prev_idx = msg[wire.BODY + 1]
         prev_term = msg[wire.BODY + 2]
         l_commit = msg[wire.BODY + 3]
         n_entries = msg[wire.BODY + 4]
         e_term = msg[wire.BODY + 5]
         e_body = msg[wire.BODY + 6:wire.BODY + 6 + ENTRY_LANES]
-        src = msg[wire.SRC]
-
-        row = self._step_down(row, l_term, t)
-        current = l_term == row.term
-        # a current-term AppendEntries always comes from the legitimate
-        # leader: candidates step back down, election timer resets, and
-        # the sender becomes the leader hint for client proxying
-        row = row._replace(
-            role=jnp.where(current & (row.role == 1), 0, row.role),
-            leader_hint=jnp.where(current, src, row.leader_hint))
-        row = jax.tree.map(
-            lambda a, b: jnp.where(current, b, a), row,
-            self._reset_election(row, t, key))
-
+        ae_current = is_ae & (body0 == term)
+        # current-term AE: candidate steps down, sender is the leader hint
+        role = jnp.where(ae_current & (role == 1), 0, role)
+        leader_hint = jnp.where(ae_current, src, row.leader_hint)
         prev_ok = (prev_idx == 0) | (
             (prev_idx <= row.log_len)
-            & (row.log_term[jnp.maximum(prev_idx - 1, 0)] == prev_term))
+            & (row.log_term[jnp.clip(prev_idx - 1, 0, self.log_cap - 1)]
+               == prev_term))
         fits = prev_idx < self.log_cap
-        accept = current & prev_ok & ((n_entries == 0) | fits)
-
-        # append/overwrite the entry at prev_idx
-        do_write = accept & (n_entries == 1)
-        widx = jnp.clip(prev_idx, 0, self.log_cap - 1)
-        same = (row.log_len > prev_idx) & (row.log_term[widx] == e_term)
-        new_len = jnp.where(
-            do_write,
+        accept = ae_current & prev_ok & ((n_entries == 0) | fits)
+        ae_write = accept & (n_entries == 1)
+        ae_widx = jnp.clip(prev_idx, 0, self.log_cap - 1)
+        same = (row.log_len > prev_idx) & (row.log_term[ae_widx] == e_term)
+        ae_len = jnp.where(
+            ae_write,
             jnp.where(same, jnp.maximum(row.log_len, prev_idx + 1),
                       prev_idx + 1),
             row.log_len)
-        log_term = jnp.where(do_write,
-                             row.log_term.at[widx].set(e_term),
-                             row.log_term)
-        log_body = jnp.where(do_write,
-                             row.log_body.at[widx].set(e_body),
-                             row.log_body)
-        match = jnp.where(accept, prev_idx + n_entries, 0)
-        # Raft §5.3: commit = min(leaderCommit, index of last NEW entry) —
-        # NOT the local log length, which may include an unverified
-        # divergent tail kept past prev_idx+1
-        commit = jnp.where(accept,
-                           jnp.maximum(row.commit_idx,
-                                       jnp.minimum(l_commit, match)),
-                           row.commit_idx)
-        row = row._replace(log_term=log_term, log_body=log_body,
-                           log_len=new_len, commit_idx=commit)
-        out = self._reply(cfg, src, T_APPEND_REPLY, msg[wire.MSGID],
-                          [row.term, accept.astype(jnp.int32), match])
-        return row, out
+        match_ack = jnp.where(accept, prev_idx + n_entries, 0)
 
-    def _handle_append_reply(self, row, msg):
-        r_term = msg[wire.BODY]
-        success = msg[wire.BODY + 1] == 1
-        match = msg[wire.BODY + 2]
-        src = msg[wire.SRC]
-
-        row = self._step_down(row, r_term, 0)
-        mine = (row.role == 2) & (r_term == row.term)
-        ok = mine & success
-        fail = mine & ~success
-        next_idx = row.next_idx
-        next_idx = jnp.where(ok, next_idx.at[src].set(
-            jnp.maximum(next_idx[src], match)), next_idx)
-        next_idx = jnp.where(fail, next_idx.at[src].set(
-            jnp.maximum(next_idx[src] - 1, 0)), next_idx)
-        match_idx = jnp.where(ok, row.match_idx.at[src].set(
-            jnp.maximum(row.match_idx[src], match)), row.match_idx)
-        return row._replace(next_idx=next_idx, match_idx=match_idx)
-
-    def _handle_client(self, row, node_idx, msg, cfg):
-        mtype = msg[wire.TYPE]
-        src = msg[wire.SRC]
-        is_leader = row.role == 2
-        full = row.log_len >= self.log_cap
-        accept = is_leader & ~full
-        # non-leaders proxy to the last known leader, preserving the
-        # client src so the leader replies straight to the client; body
-        # lane 3 counts hops to stop forwarding loops
-        hops = msg[wire.BODY + 3]
-        forward = (~accept & (row.leader_hint >= 0)
-                   & (row.leader_hint != node_idx) & (hops < 3))
-
+        # --- client request (append to own log as leader, else proxy)
+        is_leader = role == 2
+        cli_accept = is_cli & is_leader & (row.log_len < self.log_cap)
+        stale_read = jnp.bool_(False)
+        if self.serve_reads_locally:
+            # BUG variant: reads bypass the log entirely
+            stale_read = is_cli & (mtype == T_READ)
+            cli_accept = cli_accept & ~stale_read
         f = jnp.where(mtype == T_READ, F_READ,
                       jnp.where(mtype == T_WRITE, F_WRITE, F_CAS))
-        entry = jnp.stack([f, msg[wire.BODY], msg[wire.BODY + 1],
-                           msg[wire.BODY + 2], src, msg[wire.MSGID]])
-        widx = jnp.clip(row.log_len, 0, self.log_cap - 1)
-        row = row._replace(
-            log_term=jnp.where(accept,
-                               row.log_term.at[widx].set(row.term),
-                               row.log_term),
-            log_body=jnp.where(accept,
-                               row.log_body.at[widx].set(entry),
-                               row.log_body),
-            log_len=jnp.where(accept, row.log_len + 1, row.log_len),
-            match_idx=jnp.where(
-                accept,
-                row.match_idx.at[node_idx].set(row.log_len + 1),
-                row.match_idx),
-        )
-        # forward: re-emit the request toward the leader hint; otherwise
-        # reject with error 11 temporarily-unavailable (definite -> client
-        # fails the op and moves on, like the reference's non-leader nodes)
-        fwd = msg.at[wire.DEST].set(row.leader_hint)
-        fwd = fwd.at[wire.BODY + 3].set(hops + 1)
-        err = self._reply(cfg, src, TYPE_ERROR, msg[wire.MSGID], [11])[0]
-        out = jnp.where(forward, fwd, err)[None]
-        out = out.at[0, wire.VALID].set(jnp.where(accept, 0, 1))
+        cli_entry = jnp.stack([f, msg[wire.BODY], msg[wire.BODY + 1],
+                               msg[wire.BODY + 2], src, msg[wire.MSGID]])
+        hops = msg[wire.BODY + 3]
+        forward = (is_cli & ~cli_accept & ~stale_read
+                   & (row.leader_hint >= 0)
+                   & (row.leader_hint != node_idx) & (hops < 3))
+
+        # --- the single log write (AE entry or client append; exclusive)
+        write = ae_write | cli_accept
+        widx = jnp.where(ae_write, ae_widx, row.log_len)
+        slot = jnp.where(write, jnp.clip(widx, 0, self.log_cap - 1),
+                         self.log_cap)
+        w_term = jnp.where(ae_write, e_term, term)
+        w_body = jnp.where(ae_write, e_body, cli_entry)
+        log_term = row.log_term.at[slot].set(w_term, mode="drop")
+        log_body = row.log_body.at[slot].set(w_body, mode="drop")
+        log_len = jnp.where(cli_accept, row.log_len + 1, ae_len)
+
+        # --- commit advance (Raft §5.3: min(leaderCommit, last new entry))
+        commit_idx = jnp.where(
+            accept,
+            jnp.maximum(row.commit_idx,
+                        jnp.minimum(l_commit, match_ack)),
+            row.commit_idx)
+
+        # --- AppendEntriesReply bookkeeping (leader side)
+        r_success = msg[wire.BODY + 1] == 1
+        r_match = msg[wire.BODY + 2]
+        mine = is_arep & is_leader & (body0 == term)
+        src_c = jnp.clip(src, 0, n - 1)
+        nxt = row.next_idx[src_c]
+        nxt = jnp.where(mine & r_success, jnp.maximum(nxt, r_match),
+                        jnp.where(mine & ~r_success,
+                                  jnp.maximum(nxt - 1, 0), nxt))
+        next_idx = row.next_idx.at[src_c].set(nxt)
+        # on winning an election: reset replication state
+        next_idx = jnp.where(win, row.log_len, next_idx)
+        mtch = jnp.where(mine & r_success,
+                         jnp.maximum(row.match_idx[src_c], r_match),
+                         row.match_idx[src_c])
+        match_idx = row.match_idx.at[src_c].set(mtch)
+        match_idx = jnp.where(win, jnp.zeros_like(match_idx), match_idx)
+        match_idx = match_idx.at[node_idx].set(
+            jnp.where(win, row.log_len, match_idx[node_idx]))
+        match_idx = jnp.where(
+            cli_accept,
+            match_idx.at[node_idx].set(row.log_len + 1), match_idx)
+        last_hb = jnp.where(win, t - self.heartbeat, row.last_hb)
+
+        # --- election timer: reset on vote grant or current-term AE
+        jitter = jax.random.randint(key, (), 0, self.elect_jitter)
+        election_deadline = jnp.where(
+            grant | ae_current,
+            (t + self.elect_min + jitter).astype(jnp.int32),
+            row.election_deadline)
+
+        row = RaftRow(term=term, voted_for=voted_for, role=role,
+                      votes=votes, commit_idx=commit_idx,
+                      last_applied=row.last_applied, log_term=log_term,
+                      log_body=log_body, log_len=log_len, kv=row.kv,
+                      next_idx=next_idx, match_idx=match_idx,
+                      election_deadline=election_deadline,
+                      last_hb=last_hb, leader_hint=leader_hint)
+
+        # --- the single out row
+        out = jnp.zeros((1, cfg.lanes), dtype=jnp.int32)
+        reply_needed = is_vote | is_ae | (is_cli & ~cli_accept)
+        out = out.at[0, wire.VALID].set(
+            jnp.where(reply_needed, 1, 0))
+        out = out.at[0, wire.DEST].set(
+            jnp.where(forward, row.leader_hint, src))
+        out = out.at[0, wire.TYPE].set(
+            jnp.where(is_vote, T_VOTE_REPLY,
+                      jnp.where(is_ae, T_APPEND_REPLY,
+                                jnp.where(forward, mtype, TYPE_ERROR))))
+        out = out.at[0, wire.REPLYTO].set(
+            jnp.where(forward, -1, msg[wire.MSGID]))
+        # body lanes by reply kind
+        out = out.at[0, wire.BODY].set(
+            jnp.where(is_vote | is_ae, term,
+                      jnp.where(forward, msg[wire.BODY], 11)))
+        out = out.at[0, wire.BODY + 1].set(
+            jnp.where(is_vote, grant.astype(jnp.int32),
+                      jnp.where(is_ae, accept.astype(jnp.int32),
+                                jnp.where(forward, msg[wire.BODY + 1],
+                                          0))))
+        out = out.at[0, wire.BODY + 2].set(
+            jnp.where(is_ae, match_ack,
+                      jnp.where(forward, msg[wire.BODY + 2], 0)))
+        out = out.at[0, wire.BODY + 3].set(
+            jnp.where(forward, hops + 1, 0))
+        # a forwarded request keeps the client's msg_id and logical src
+        out = out.at[0, wire.MSGID].set(
+            jnp.where(forward, msg[wire.MSGID], -1))
+        out = out.at[0, wire.SRC].set(jnp.where(forward, src, 0))
+        if self.serve_reads_locally:
+            kk = jnp.clip(msg[wire.BODY], 0, self.n_keys - 1)
+            out = out.at[0, wire.VALID].set(
+                jnp.where(stale_read, 1, out[0, wire.VALID]))
+            out = out.at[0, wire.DEST].set(
+                jnp.where(stale_read, src, out[0, wire.DEST]))
+            out = out.at[0, wire.TYPE].set(
+                jnp.where(stale_read, T_READ_OK, out[0, wire.TYPE]))
+            out = out.at[0, wire.REPLYTO].set(
+                jnp.where(stale_read, msg[wire.MSGID],
+                          out[0, wire.REPLYTO]))
+            out = out.at[0, wire.MSGID].set(
+                jnp.where(stale_read, -1, out[0, wire.MSGID]))
+            out = out.at[0, wire.SRC].set(
+                jnp.where(stale_read, 0, out[0, wire.SRC]))
+            out = out.at[0, wire.BODY].set(
+                jnp.where(stale_read, kk, out[0, wire.BODY]))
+            out = out.at[0, wire.BODY + 1].set(
+                jnp.where(stale_read, row.kv[kk], out[0, wire.BODY + 1]))
+            out = out.at[0, wire.BODY + 3].set(
+                jnp.where(stale_read, 0, out[0, wire.BODY + 3]))
         return row, out
 
     # --- per-tick behavior ------------------------------------------------
@@ -377,8 +398,12 @@ class RaftModel(Model):
         match = row.match_idx.at[node_idx].set(row.log_len)
         sorted_match = jnp.sort(match)               # ascending
         majority_match = sorted_match[(n - 1) // 2]  # value >= on majority
-        guard_idx = jnp.clip(majority_match - 1, 0, self.log_cap - 1)
-        current_term_ok = row.log_term[guard_idx] == row.term
+        if self.commit_term_guard:
+            guard_idx = jnp.clip(majority_match - 1, 0, self.log_cap - 1)
+            current_term_ok = row.log_term[guard_idx] == row.term
+        else:
+            # BUG variant (Raft §5.4.2): commit on replication count alone
+            current_term_ok = jnp.bool_(True)
         new_commit = jnp.where(
             is_leader & (majority_match > row.commit_idx)
             & current_term_ok,

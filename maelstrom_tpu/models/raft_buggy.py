@@ -4,90 +4,47 @@ The whole point of the workbench is catching consistency bugs; these
 mutants prove the TPU runtime + checkers actually do (SURVEY §7 step 8:
 "bug-injection corpus (mutated Raft variants) for time-to-first-anomaly",
 and the north-star requirement that checkers still find injected
-linearizability bugs at scale).
+linearizability bugs at scale). Each mutant flips one of
+:class:`~.raft.RaftModel`'s static correctness switches, so every variant
+compiles to its own specialized graph with the bug baked in.
 
-- :class:`RaftDoubleVote` — nodes ignore ``voted_for`` and grant every
-  vote request: two leaders per term, divergent logs, lost writes.
+- :class:`RaftDoubleVote` — nodes ignore ``voted_for`` and log recency
+  when granting votes: two leaders per term, divergent logs, lost writes.
 - :class:`RaftStaleRead` — nodes answer reads immediately from their
   local KV instead of through the log: a deposed leader (or lagging
   follower) serves stale values during partitions.
 - :class:`RaftNoTermGuard` — the leader commits by match-index count
   alone, without the current-term guard (the Raft §5.4.2 trap): an entry
   replicated by an old-term leader can be committed and then overwritten.
-  NOTE: this one requires the full Figure-8 schedule (old-term entry
+  NOTE: tripping this needs the full Figure-8 schedule (old-term entry
   replicated to a majority, leader deposed, entry overwritten after
-  commit) — rare enough that 32 instances x 3s have not yet tripped it;
+  commit) — rare enough that 32 instances x 3s have not yet produced it;
   it is in the corpus as a hard target for large-fleet time-to-anomaly
   runs, not in the must-catch CI test.
 """
 
 from __future__ import annotations
 
-import jax.numpy as jnp
-
-from ..tpu import wire
-from .raft import RaftModel, RaftRow, T_READ, T_READ_OK, T_VOTE_REPLY
+from .raft import RaftModel
 
 
 class RaftDoubleVote(RaftModel):
-    """Election safety broken: voted_for is never consulted."""
-
+    """Election safety broken: voted_for / log recency never consulted."""
     name = "lin-kv-bug-double-vote"
-
-    def _handle_req_vote(self, row, node_idx, msg, t, key, cfg):
-        c_term = msg[wire.BODY]
-        src = msg[wire.SRC]
-        row = self._step_down(row, c_term, t)
-        # BUG: grant to anyone with a current term, regardless of
-        # voted_for or log recency
-        grant = c_term == row.term
-        row = row._replace(voted_for=jnp.where(grant, src, row.voted_for))
-        out = self._reply(cfg, src, T_VOTE_REPLY, msg[wire.MSGID],
-                          [row.term, grant.astype(jnp.int32)])
-        return row, out
+    vote_check_voted_for = False
+    vote_check_log = False
 
 
 class RaftStaleRead(RaftModel):
     """Linearizable reads broken: any node answers reads locally."""
-
     name = "lin-kv-bug-stale-read"
-
-    def _handle_client(self, row: RaftRow, node_idx, msg, cfg):
-        is_read = msg[wire.TYPE] == T_READ
-        # BUG: serve reads from the local (possibly stale) KV immediately
-        k = jnp.clip(msg[wire.BODY], 0, self.n_keys - 1)
-        out_read = self._reply(cfg, msg[wire.SRC], T_READ_OK,
-                               msg[wire.MSGID], [k, row.kv[k]])
-        row2, out_rest = super()._handle_client(row, node_idx, msg, cfg)
-        import jax
-        row = jax.tree.map(lambda a, b: jnp.where(is_read, a, b), row, row2)
-        out = jnp.where(is_read, out_read, out_rest)
-        return row, out
+    serve_reads_locally = True
 
 
 class RaftNoTermGuard(RaftModel):
     """Commit safety broken: no current-term guard on the median commit."""
-
     name = "lin-kv-bug-no-term-guard"
-
-    def tick(self, row: RaftRow, node_idx, t, key, cfg, params):
-        # monkey-see implementation: run the correct tick but first
-        # falsify the guard by rewriting log terms the leader checks.
-        # Simpler and fully equivalent: pretend every entry is from the
-        # current term when computing the guard, by overriding the
-        # commit-advance piece. We reuse the parent tick with a patched
-        # log_term view for the guard only.
-        n = cfg.n_nodes
-        is_leader = row.role == 2
-        match = row.match_idx.at[node_idx].set(row.log_len)
-        sorted_match = jnp.sort(match)
-        majority_match = sorted_match[(n - 1) // 2]
-        # BUG: advance commit on replication count alone
-        new_commit = jnp.where(
-            is_leader & (majority_match > row.commit_idx),
-            majority_match, row.commit_idx)
-        row = row._replace(commit_idx=new_commit)
-        return super().tick(row, node_idx, t, key, cfg, params)
+    commit_term_guard = False
 
 
 BUGGY_MODELS = {

@@ -6,14 +6,16 @@ scan, decode the recorded instances' event streams into per-instance
 op histories, run the workload checker on every recorded instance, and
 aggregate — plus whole-fleet message statistics from the device counters.
 
-The virtual clock maps wall-clock knobs onto ticks: 1 tick == 1 simulated
-millisecond (so ``--latency 100`` is 100 ticks and a 5s RPC timeout is
-5000 ticks). Rates are converted from ops/sec to per-tick client firing
-probabilities.
+The virtual clock maps wall-clock knobs onto ticks: by default 1 tick ==
+1 simulated millisecond (so ``--latency 100`` is 100 ticks and a 5s RPC
+timeout is 5000 ticks); the ``ms_per_tick`` option coarsens the clock as
+a fidelity/throughput trade. Rates are converted from ops/sec to per-tick
+client firing probabilities.
 """
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Any, Dict, List, Optional
 
@@ -23,7 +25,7 @@ from .netsim import LATENCY_DISTS, NetConfig
 from .runtime import (ClientConfig, EV_FAIL, EV_INFO, EV_INVOKE, EV_NONE,
                       EV_OK, Model, NemesisConfig, SimConfig, run_sim)
 
-MS_PER_TICK = 1  # virtual clock resolution
+MS_PER_TICK = 1  # default virtual clock resolution (override per run)
 
 ETYPE_NAMES = {EV_OK: "ok", EV_FAIL: "fail", EV_INFO: "info"}
 
@@ -44,20 +46,22 @@ TPU_DEFAULTS = dict(
     record_instances=8,
     pool_slots=128,
     inbox_k=8,
+    ms_per_tick=MS_PER_TICK,  # virtual-clock resolution (fidelity knob)
     seed=0,
 )
 
 
 def make_sim_config(model: Model, opts: Dict[str, Any]) -> SimConfig:
     o = {**TPU_DEFAULTS, **opts}
-    n_ticks = int(o["time_limit"] * 1000 / MS_PER_TICK)
+    mpt = o["ms_per_tick"]
+    n_ticks = int(o["time_limit"] * 1000 / mpt)
     net = NetConfig(
         n_nodes=o["node_count"],
         n_clients=o["concurrency"],
         pool_slots=o["pool_slots"],
         inbox_k=o["inbox_k"],
         body_lanes=model.body_lanes,
-        latency_mean=float(o["latency"]) / MS_PER_TICK,
+        latency_mean=float(o["latency"]) / mpt,
         latency_dist=LATENCY_DISTS[o["latency_dist"]],
         p_loss=float(o["p_loss"]),
     )
@@ -66,19 +70,19 @@ def make_sim_config(model: Model, opts: Dict[str, Any]) -> SimConfig:
     # the main mix through a quiesce gap of half the window, then switch to
     # final reads. Clamped so a short run can't degenerate into a
     # final-phase-only test with the nemesis silently disabled.
-    recovery_ticks = min(int(o["recovery_time"] * 1000 / MS_PER_TICK),
+    recovery_ticks = min(int(o["recovery_time"] * 1000 / mpt),
                          n_ticks // 2)
     stop_tick = n_ticks - recovery_ticks
     client = ClientConfig(
         n_clients=o["concurrency"],
         rate=min(1.0, float(o["rate"]) / o["concurrency"] / 1000.0
-                 * MS_PER_TICK),
-        timeout_ticks=int(o["rpc_timeout"] * 1000 / MS_PER_TICK),
+                 * mpt),
+        timeout_ticks=int(o["rpc_timeout"] * 1000 / mpt),
         final_start=stop_tick + recovery_ticks // 2,
     )
     nemesis = NemesisConfig(
         enabled="partition" in (o["nemesis"] or []),
-        interval=max(1, int(o["nemesis_interval"] * 1000 / MS_PER_TICK)),
+        interval=max(1, int(o["nemesis_interval"] * 1000 / mpt)),
         kind=o.get("nemesis_kind", "random-halves"),
         stop_tick=stop_tick,
     )
@@ -89,7 +93,9 @@ def make_sim_config(model: Model, opts: Dict[str, Any]) -> SimConfig:
 
 
 def events_to_histories(model: Model, events: np.ndarray,
-                        final_start: int = 1 << 30) -> List[List[dict]]:
+                        final_start: int = 1 << 30,
+                        ms_per_tick: float = MS_PER_TICK
+                        ) -> List[List[dict]]:
     """Decode the [T, R, C, 2, EV_LANES] device event tensor into one
     Jepsen-style history per recorded instance. Invocations at/after
     ``final_start`` are tagged ``final`` (post-heal final reads)."""
@@ -104,7 +110,7 @@ def events_to_histories(model: Model, events: np.ndarray,
         ev = events[t, r, c, slot]
         etype = int(ev[0])
         f, a, b, cc = int(ev[1]), int(ev[2]), int(ev[3]), int(ev[4])
-        time_ns = int(t) * MS_PER_TICK * 1_000_000
+        time_ns = int(int(t) * ms_per_tick * 1_000_000)
         if etype == EV_INVOKE:
             rec = model.invoke_record(f, a, b, cc)
             rec.update({"process": int(c), "type": "invoke",
@@ -133,9 +139,15 @@ def run_tpu_test(model: Model, opts: Optional[Dict[str, Any]] = None,
     wall = time.monotonic() - t0
 
     histories = events_to_histories(model, events,
-                                    final_start=sim.client.final_start)
+                                    final_start=sim.client.final_start,
+                                    ms_per_tick=opts["ms_per_tick"])
     checker = model.checker()
     per_instance = []
+    availability = None
+    if opts.get("availability") is not None:
+        from ..checkers.availability import availability_checker
+        availability = availability_checker(
+            [r for h in histories for r in h], opts["availability"])
     for h in histories:
         try:
             per_instance.append(checker(h, opts))
@@ -166,4 +178,36 @@ def run_tpu_test(model: Model, opts: Optional[Dict[str, Any]] = None,
                                        if wall > 0 else 0.0),
         },
     }
+    if availability is not None:
+        results["availability"] = availability
+        if availability["valid?"] is False:
+            results["valid?"] = False
+    if opts.get("store_root"):
+        _write_store(model.name, opts["store_root"], results, histories)
     return results
+
+
+def _write_store(name: str, store_root: str, results: Dict[str, Any],
+                 histories) -> None:
+    """Store artifacts for a TPU run: results.json + one history per
+    recorded instance (the store layout of doc/results.md, minus node
+    logs — there are no node processes)."""
+    import json
+    from datetime import datetime
+    ts = datetime.now().strftime("%Y%m%d-%H%M%S-%f")
+    d = os.path.join(store_root, f"{name}-tpu", ts)
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, "results.json"), "w") as f:
+        json.dump(results, f, indent=2, default=repr)
+    for i, h in enumerate(histories):
+        with open(os.path.join(d, f"history-{i}.jsonl"), "w") as f:
+            for r in h:
+                f.write(json.dumps(r) + "\n")
+    latest = os.path.join(os.path.dirname(d), "latest")
+    try:
+        if os.path.islink(latest):
+            os.unlink(latest)
+        os.symlink(os.path.basename(d), latest)
+    except OSError:
+        pass
+    results["store-dir"] = d

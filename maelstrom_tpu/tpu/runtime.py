@@ -98,7 +98,14 @@ class Model:
 
     def handle(self, row, node_idx, msg, t, key, cfg: NetConfig, params
                ) -> Tuple[Any, jnp.ndarray]:
-        """Process one (valid) message; return (row', outs[max_out, L])."""
+        """Process one message; return (row', outs[max_out, L]).
+
+        CONTRACT: must be a no-op (state unchanged, outs invalid) when the
+        message is invalid — ``msg`` is all zeros then, so gating every
+        state change and out-VALID lane on the message type being one of
+        the model's types suffices. The runtime does NOT mask the result
+        (a full-pytree where per inbox slot would dominate the tick cost).
+        """
         raise NotImplementedError
 
     def tick(self, row, node_idx, t, key, cfg: NetConfig, params
@@ -321,11 +328,9 @@ def node_phase(model: Model, node_state, inbox_nodes, t, key,
             # distinct key per handled message — a shared key would
             # correlate every random draw a model makes within a tick
             mkey = jax.random.fold_in(nkey, i)
-            r2, outs = model.handle(r, node_idx, msg, t, mkey, cfg, params)
-            ok = msg[wire.VALID] == 1
-            r = jax.tree.map(lambda a, b: jnp.where(ok, b, a), r, r2)
-            outs = jnp.where(ok, outs, 0)
-            return r, outs
+            # models self-gate on invalid (all-zero) messages — see the
+            # Model.handle contract
+            return model.handle(r, node_idx, msg, t, mkey, cfg, params)
 
         k_idx = jnp.arange(inbox_row.shape[0], dtype=jnp.int32)
         row, outs_k = jax.lax.scan(step, row, (inbox_row, k_idx))

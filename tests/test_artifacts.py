@@ -30,3 +30,29 @@ def test_store_artifacts(tmp_path):
         lines = [json.loads(l) for l in f]
     assert lines and lines[0]["index"] == 0
     assert {"invoke", "ok"} <= {l["type"] for l in lines}
+
+
+def test_cli_test_command(tmp_path):
+    from maelstrom_tpu.cli import main
+    import conftest
+    bin_cmd = conftest.example_bin("echo.py")
+    rc = main(["test", "-w", "echo", "--bin", bin_cmd[1],
+               "--node-count", "1", "--time-limit", "1", "--rate", "20",
+               "--store", str(tmp_path)])
+    assert rc == 0
+
+
+def test_cli_doc_command(tmp_path):
+    from maelstrom_tpu.cli import main
+    rc = main(["doc", "--out", str(tmp_path)])
+    assert rc == 0
+    text = (tmp_path / "workloads.md").read_text()
+    assert "## lin-kv" in text and "### cas" in text
+    proto = (tmp_path / "protocol.md").read_text()
+    assert "precondition-failed" in proto
+
+
+def test_cli_concurrency_parsing():
+    from maelstrom_tpu.cli import parse_concurrency
+    assert parse_concurrency("10", 5) == 10
+    assert parse_concurrency("4n", 5) == 20
