@@ -1,0 +1,73 @@
+#!/usr/bin/env python3
+"""Multi-node strict-serializable transactor over the lin-kv service.
+
+Every node executes transactions optimistically against a database value
+stored under a single key in the built-in lin-kv service: read the root,
+apply the micro-ops, compare-and-set the root. A CAS conflict aborts the
+transaction with error 30 (txn-conflict), which is definite — the client
+may safely retry. Strict serializability follows from the linearizable
+root pointer.
+
+The role of the reference's demo/ruby/datomic_list_append.rb (root CAS in
+lin-kv, :3-40), simplified to a whole-database value instead of
+persistent hash-tree pages.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from node import KV, Node, RPCError  # noqa: E402
+
+node = Node()
+kv = KV(node, KV.LIN, timeout=2.0)
+
+ROOT = "datomic-root"
+
+
+def init_root():
+    """The first node creates the root before any client op runs — a
+    concurrent cas-create race between nodes would lose a transaction."""
+    if node.node_ids and node.node_id == node.node_ids[0]:
+        try:
+            kv.write(ROOT, {"__init__": True})
+        except RPCError as e:
+            node.log(f"root init failed: {e}")
+
+
+node.init_callbacks.append(init_root)
+
+
+@node.on("txn")
+def txn(msg):
+    ops = msg["body"]["txn"]
+    db = kv.read(ROOT, default=None) or {}
+    new_db = dict(db)
+    out = []
+    for f, k, v in ops:
+        k = str(k)
+        kk = int(k) if k.isdigit() else k
+        if f == "r":
+            out.append(["r", kk, new_db.get(k)])
+        elif f == "append":
+            new_db[k] = list(new_db.get(k) or []) + [v]
+            out.append(["append", kk, v])
+        elif f == "w":
+            new_db[k] = v
+            out.append(["w", kk, v])
+        else:
+            raise RPCError(12, f"unknown micro-op {f!r}")
+    if new_db != db:
+        try:
+            kv.cas(ROOT, db or None, new_db,
+                   create_if_not_exists=(not db))
+        except RPCError as e:
+            if e.code in (20, 22):
+                raise RPCError.txn_conflict(
+                    "root CAS failed; transaction aborted") from None
+            raise
+    node.reply(msg, {"type": "txn_ok", "txn": out})
+
+
+if __name__ == "__main__":
+    node.run()

@@ -1,0 +1,53 @@
+#!/usr/bin/env python3
+"""Single-node kafka-style log server: per-key append-only logs with
+offsets, client poll positions supplied by the client, committed offsets.
+The role of the reference's demo/clojure/kafka_single_node.clj."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from node import Node  # noqa: E402
+
+node = Node()
+logs = {}        # key -> list of values (offset = index)
+committed = {}   # key -> offset
+
+
+@node.on("send")
+def send(msg):
+    k = msg["body"]["key"]
+    log = logs.setdefault(k, [])
+    log.append(msg["body"]["msg"])
+    node.reply(msg, {"type": "send_ok", "offset": len(log) - 1})
+
+
+@node.on("poll")
+def poll(msg):
+    offsets = msg["body"].get("offsets") or {}
+    out = {}
+    for k, log in logs.items():
+        start = offsets.get(k, 0)
+        msgs = [[i, v] for i, v in enumerate(log[start:start + 16], start)]
+        if msgs:
+            out[k] = msgs
+    node.reply(msg, {"type": "poll_ok", "msgs": out})
+
+
+@node.on("commit_offsets")
+def commit_offsets(msg):
+    for k, off in (msg["body"].get("offsets") or {}).items():
+        committed[k] = max(committed.get(k, -1), off)
+    node.reply(msg, {"type": "commit_offsets_ok"})
+
+
+@node.on("list_committed_offsets")
+def list_committed_offsets(msg):
+    keys = msg["body"].get("keys") or []
+    node.reply(msg, {"type": "list_committed_offsets_ok",
+                     "offsets": {k: committed[k] for k in keys
+                                 if k in committed}})
+
+
+if __name__ == "__main__":
+    node.run()

@@ -74,11 +74,15 @@ class Node:
         self._io_lock = threading.Lock()
         self._timers = []
 
+        self.init_callbacks = []    # run after init, before init_ok
+
         def handle_init(msg):
             body = msg["body"]
             self.node_id = body["node_id"]
             self.node_ids = body["node_ids"]
             self.log(f"node {self.node_id} initialized")
+            for fn in self.init_callbacks:
+                fn()
             self.reply(msg, {"type": "init_ok"})
             for interval, fn in self._timers:
                 t = threading.Thread(target=self._timer_loop,

@@ -1,0 +1,37 @@
+#!/usr/bin/env python3
+"""Single-node transactional store: executes txn micro-ops (r / w /
+append) atomically against local state. Trivially strict-serializable
+with one node. The role of the reference's demo/clojure/single_key_txn /
+datomic walk-up starting point."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from node import Node  # noqa: E402
+
+node = Node()
+store = {}
+
+
+@node.on("txn")
+def txn(msg):
+    ops = msg["body"]["txn"]
+    out = []
+    for f, k, v in ops:
+        k = str(k)
+        if f == "r":
+            out.append(["r", int(k) if k.isdigit() else k, store.get(k)])
+        elif f == "append":
+            store.setdefault(k, []).append(v)
+            out.append(["append", int(k) if k.isdigit() else k, v])
+        elif f == "w":
+            store[k] = v
+            out.append(["w", int(k) if k.isdigit() else k, v])
+        else:
+            raise ValueError(f"unknown micro-op {f!r}")
+    node.reply(msg, {"type": "txn_ok", "txn": out})
+
+
+if __name__ == "__main__":
+    node.run()

@@ -1,0 +1,370 @@
+"""Transactional anomaly detection — an Elle-style checker.
+
+Fills the role jepsen's Elle plays for the reference's txn workloads
+(src/maelstrom/workload/txn_list_append.clj via jepsen.tests.cycle.append,
+txn_rw_register.clj via cycle.wr): infer per-key version orders from
+observed reads, build a transaction dependency graph, and hunt for
+anomalies.
+
+Implemented (the core of Elle's catalogue for these workloads):
+
+- **G1a aborted read** — a committed read observes a value from a failed
+  transaction.
+- **G1b intermediate read** — a read observes a non-final state of a key
+  written multiple times by one transaction.
+- **duplicate / reorder inconsistencies** in list-append reads (two reads
+  of a key disagree on the order of their common prefix, or an element
+  appears twice) — these invalidate the version-order inference and are
+  reported as ``incompatible-order``.
+- **lost append** — an acknowledged append absent from the longest
+  observed read of its key when later reads exist.
+- **dependency cycles** — Tarjan SCC over the union of:
+  ``wr`` (T2 read something T1 wrote), ``ww`` (version order, list-append
+  only), ``rw`` anti-dependency (T1 read a state missing v, T2 wrote v as
+  its successor), per-process session order, and (for strict
+  serializability) real-time order. Cycles are classified G0/G1c/G2-item
+  by their edge mix, and which classes *fail* the check depends on
+  ``consistency_models`` (read-committed < read-atomic < serializable <
+  strict-serializable), mirroring the reference's
+  ``--consistency-models`` flag (core.clj:160-165).
+
+Histories use the reference's micro-op encoding: op value is a list of
+``[f, k, v]`` with f in {"append", "r"} (list-append) or {"w", "r"}
+(rw-register).
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+# anomaly class -> weakest consistency model that forbids it
+_FORBIDDEN_BY = {
+    "G0": "read-uncommitted",        # ww cycles
+    "G1a": "read-committed",
+    "G1b": "read-committed",
+    "G1c": "read-committed",         # ww/wr cycles
+    "G-single": "serializable",      # one rw edge in the cycle
+    "G2-item": "serializable",       # >=1 rw edge
+    "realtime": "strict-serializable",
+    "incompatible-order": "read-uncommitted",
+    "lost-append": "read-uncommitted",
+}
+
+_MODEL_ORDER = ["read-uncommitted", "read-committed", "read-atomic",
+                "serializable", "strict-serializable"]
+
+
+def _model_leq(a: str, b: str) -> bool:
+    return _MODEL_ORDER.index(a) <= _MODEL_ORDER.index(b)
+
+
+class _Graph:
+    def __init__(self):
+        self.edges: Dict[int, Dict[int, Set[str]]] = defaultdict(
+            lambda: defaultdict(set))
+
+    def add(self, a: int, b: int, kind: str):
+        if a != b:
+            self.edges[a][b].add(kind)
+
+    def sccs(self) -> List[List[int]]:
+        """Tarjan's strongly-connected components (iterative)."""
+        index: Dict[int, int] = {}
+        low: Dict[int, int] = {}
+        on_stack: Set[int] = set()
+        stack: List[int] = []
+        out: List[List[int]] = []
+        counter = [0]
+        nodes = set(self.edges)
+        for tos in self.edges.values():
+            nodes.update(tos)
+
+        for root in nodes:
+            if root in index:
+                continue
+            work = [(root, iter(self.edges.get(root, {})))]
+            index[root] = low[root] = counter[0]
+            counter[0] += 1
+            stack.append(root)
+            on_stack.add(root)
+            while work:
+                v, it = work[-1]
+                advanced = False
+                for w in it:
+                    if w not in index:
+                        index[w] = low[w] = counter[0]
+                        counter[0] += 1
+                        stack.append(w)
+                        on_stack.add(w)
+                        work.append((w, iter(self.edges.get(w, {}))))
+                        advanced = True
+                        break
+                    elif w in on_stack:
+                        low[v] = min(low[v], index[w])
+                if advanced:
+                    continue
+                work.pop()
+                if work:
+                    pv = work[-1][0]
+                    low[pv] = min(low[pv], low[v])
+                if low[v] == index[v]:
+                    comp = []
+                    while True:
+                        w = stack.pop()
+                        on_stack.discard(w)
+                        comp.append(w)
+                        if w == v:
+                            break
+                    if len(comp) > 1:
+                        out.append(comp)
+        return out
+
+    def cycle_kinds(self, comp: List[int]) -> Set[str]:
+        cset = set(comp)
+        kinds: Set[str] = set()
+        for a in comp:
+            for b, ks in self.edges.get(a, {}).items():
+                if b in cset:
+                    kinds.update(ks)
+        return kinds
+
+
+def _classify_cycle(kinds: Set[str]) -> str:
+    rw = "rw" in kinds
+    realtime_only = kinds <= {"realtime", "process"}
+    if realtime_only:
+        return "realtime"
+    if rw:
+        return "G2-item"
+    if "wr" in kinds:
+        return "G1c"
+    return "G0"
+
+
+def _collect_txns(history) -> Tuple[List[dict], List[dict]]:
+    """Returns (committed, failed) txns: each a dict with
+    index/process/ops (completed micro-ops for committed, invoked for
+    failed/info)."""
+    from ..gen.history import pairs
+    committed, failed = [], []
+    for p in pairs(history):
+        inv, comp = p["invoke"], p["complete"]
+        if inv.get("process") == "nemesis" or inv.get("f") != "txn":
+            continue
+        if comp is not None and comp["type"] == "ok":
+            committed.append({"id": len(committed), "index": inv["index"],
+                              "end": comp["index"],
+                              "process": inv["process"],
+                              "ops": comp["value"]})
+        elif comp is None or comp["type"] in ("fail", "info"):
+            failed.append({"process": inv["process"],
+                           "ops": inv["value"],
+                           "definite_fail": (comp is not None
+                                             and comp["type"] == "fail")})
+    return committed, failed
+
+
+def check_list_append(history, consistency_model: str = "strict-serializable"
+                      ) -> dict:
+    committed, failed = _collect_txns(history)
+    anomalies: Dict[str, List[Any]] = defaultdict(list)
+
+    # appended values must be unique per key for inference; the workload
+    # generator guarantees this
+    writer: Dict[Tuple[Any, Any], Tuple[int, int]] = {}   # (k,v)->(txn,pos)
+    failed_writes: Set[Tuple[Any, Any]] = set()
+    for t in failed:
+        if t["definite_fail"]:
+            for op in t["ops"] or []:
+                if op[0] == "append":
+                    failed_writes.add((op[1], op[2]))
+    for t in committed:
+        for pos, op in enumerate(t["ops"]):
+            if op[0] == "append":
+                writer[(op[1], op[2])] = (t["id"], pos)
+
+    # per-key longest read; order compatibility between reads
+    longest: Dict[Any, List[Any]] = {}
+    for t in committed:
+        for op in t["ops"]:
+            if op[0] != "r" or op[2] is None:
+                continue
+            k, vs = op[1], list(op[2])
+            if len(set(map(repr, vs))) != len(vs):
+                anomalies["incompatible-order"].append(
+                    {"key": k, "read": vs, "why": "duplicate element"})
+                continue
+            cur = longest.get(k, [])
+            shorter, longer = sorted([vs, cur], key=len)
+            if longer[:len(shorter)] != shorter:
+                anomalies["incompatible-order"].append(
+                    {"key": k, "read": vs, "longest": cur})
+            if len(vs) > len(cur):
+                longest[k] = vs
+
+    # G1a: reads observing failed appends; G1b: intermediate reads — a
+    # read list that contains another txn's append to k but NOT that
+    # txn's LATER append to the same k saw a mid-transaction state
+    for t in committed:
+        for op in t["ops"]:
+            if op[0] != "r" or op[2] is None:
+                continue
+            k = op[1]
+            seen = set(map(repr, op[2]))
+            for v in op[2]:
+                if (k, v) in failed_writes:
+                    anomalies["G1a"].append({"key": k, "value": v,
+                                             "txn": t["ops"]})
+                w = writer.get((k, v))
+                if w is not None and w[0] != t["id"]:
+                    wt = committed[w[0]]
+                    later = [o[2] for i, o in enumerate(wt["ops"])
+                             if i > w[1] and o[0] == "append"
+                             and o[1] == k]
+                    if any(repr(v2) not in seen for v2 in later):
+                        anomalies["G1b"].append({"key": k, "value": v})
+
+    # lost appends: acked append missing from reads that *began* after
+    # the append completed (a read overlapping the append in real time
+    # may legally serialize before it, so it owes us nothing)
+    reads_by_key = defaultdict(list)
+    for t in committed:
+        for op in t["ops"]:
+            if op[0] == "r" and op[2] is not None:
+                reads_by_key[op[1]].append((t["index"], list(op[2])))
+    for (k, v), (tid, _) in writer.items():
+        t = committed[tid]
+        later = [vs for (inv, vs) in reads_by_key.get(k, [])
+                 if inv > t["end"]]
+        if later:
+            newest = max(later, key=len)
+            if v not in newest:
+                anomalies["lost-append"].append({"key": k, "value": v})
+
+    # dependency graph
+    g = _Graph()
+    version_pos: Dict[Tuple[Any, Any], int] = {}
+    for k, vs in longest.items():
+        for i, v in enumerate(vs):
+            version_pos[(k, v)] = i
+    # ww: consecutive appends in a key's version order
+    for k, vs in longest.items():
+        for i in range(len(vs) - 1):
+            a = writer.get((k, vs[i]))
+            b = writer.get((k, vs[i + 1]))
+            if a and b:
+                g.add(a[0], b[0], "ww")
+    for t in committed:
+        for op in t["ops"]:
+            if op[0] != "r" or op[2] is None:
+                continue
+            k, vs = op[1], op[2]
+            # wr: we read the last element's writer
+            if vs:
+                w = writer.get((k, vs[-1]))
+                if w:
+                    g.add(w[0], t["id"], "wr")
+            # rw: the next version after our read state was written by
+            # someone else
+            order = longest.get(k, [])
+            if len(vs) < len(order):
+                nxt = writer.get((k, order[len(vs)]))
+                if nxt:
+                    g.add(t["id"], nxt[0], "rw")
+    return _finish(g, committed, anomalies, consistency_model)
+
+
+def _finish(g: _Graph, committed: List[dict],
+            anomalies: Dict[str, List[Any]], consistency_model: str
+            ) -> dict:
+    """Shared tail of both checkers: session + realtime edges, SCC cycle
+    classification, model-filtered verdict."""
+    by_process = defaultdict(list)
+    for t in committed:
+        by_process[t["process"]].append(t)
+    for ts in by_process.values():
+        ts.sort(key=lambda t: t["index"])
+        for a, b in zip(ts, ts[1:]):
+            g.add(a["id"], b["id"], "process")
+    # realtime order (strict serializability only): a -> b iff a
+    # completed before b was invoked. All such pairs are added (capped),
+    # because a reduction that only links each txn to its first successor
+    # misses edges to successors concurrent with that one.
+    if consistency_model == "strict-serializable":
+        cap = 2000
+        pool = (committed if len(committed) <= cap
+                else sorted(committed, key=lambda t: t["end"])[-cap:])
+        ordered = sorted(pool, key=lambda t: t["end"])
+        invokes = sorted(pool, key=lambda t: t["index"])
+        import bisect
+        ends = [a["end"] for a in ordered]
+        for b in invokes:
+            hi = bisect.bisect_left(ends, b["index"])
+            for a in ordered[:hi]:
+                if a["id"] != b["id"]:
+                    g.add(a["id"], b["id"], "realtime")
+
+    for comp in g.sccs():
+        kinds = g.cycle_kinds(comp)
+        cls = _classify_cycle(kinds)
+        anomalies[cls].append(
+            {"txns": [committed[i]["ops"] for i in comp[:6]],
+             "edges": sorted(kinds)})
+
+    bad = {a: v for a, v in anomalies.items()
+           if _model_leq(_FORBIDDEN_BY.get(a, "read-uncommitted"),
+                         consistency_model)}
+    return {
+        "valid?": not bad,
+        "anomaly-types": sorted(anomalies),
+        "anomalies": {k: v[:8] for k, v in bad.items()},
+        "txn-count": len(committed),
+        "consistency-model": consistency_model,
+    }
+
+
+def check_rw_register(history,
+                      consistency_model: str = "strict-serializable"
+                      ) -> dict:
+    """rw-register anomalies. Writes are unique per key, so wr edges are
+    exact; version order per key is inferred from wr + session + realtime
+    information only where unambiguous, so this is a sound (never
+    false-positive) subset of Elle's rw-register analysis."""
+    committed, failed = _collect_txns(history)
+    anomalies: Dict[str, List[Any]] = defaultdict(list)
+
+    writer: Dict[Tuple[Any, Any], int] = {}
+    failed_writes: Set[Tuple[Any, Any]] = set()
+    for t in failed:
+        if t["definite_fail"]:
+            for op in t["ops"] or []:
+                if op[0] == "w":
+                    failed_writes.add((op[1], op[2]))
+    for t in committed:
+        for op in t["ops"]:
+            if op[0] == "w":
+                writer[(op[1], op[2])] = t["id"]
+
+    g = _Graph()
+    # G1b: reading a non-final write of another txn
+    final_write: Dict[Tuple[int, Any], Any] = {}
+    for w_t in committed:
+        for op in w_t["ops"]:
+            if op[0] == "w":
+                final_write[(w_t["id"], op[1])] = op[2]
+    for t in committed:
+        for op in t["ops"]:
+            if op[0] != "r" or op[2] is None:
+                continue
+            k, v = op[1], op[2]
+            if (k, v) in failed_writes:
+                anomalies["G1a"].append({"key": k, "value": v})
+            w = writer.get((k, v))
+            if w is not None:
+                if w != t["id"]:
+                    g.add(w, t["id"], "wr")
+                    if final_write.get((w, k)) != v:
+                        anomalies["G1b"].append({"key": k, "value": v})
+
+    return _finish(g, committed, anomalies, consistency_model)

@@ -1,0 +1,111 @@
+"""Kafka-style log checker.
+
+Verifies per-key append-only log semantics over send / poll /
+commit_offsets / list_committed_offsets histories:
+
+- **duplicate offsets** — two acknowledged sends share (key, offset)
+- **inconsistent offsets** — two polls disagree about the value at
+  (key, offset)
+- **internal nonmonotonic** — offsets within one poll op for a key go
+  backwards
+- **external nonmonotonic** — a process's successive polls of a key go
+  backwards (it re-reads earlier offsets without a reassignment)
+- **lost write** — an acknowledged send whose offset is below some
+  later-polled offset for its key but which never appears in any poll
+- **commit regression** — committed offsets for a key move backwards
+
+Parity: the anomaly families of jepsen.tests.kafka's checker as used by
+reference src/maelstrom/workload/kafka.clj (docstring :1-71).
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Any, Dict, List
+
+
+def kafka_checker(history) -> dict:
+    from ..gen.history import pairs
+    anomalies: Dict[str, List[Any]] = defaultdict(list)
+
+    acked = defaultdict(dict)       # key -> offset -> value
+    polled = defaultdict(dict)      # key -> offset -> value
+    max_polled = defaultdict(lambda: -1)
+    last_poll_pos = defaultdict(lambda: -1)   # (process, key) -> offset
+    commits = defaultdict(lambda: -1)         # (process, key) -> offset
+    server_commits = defaultdict(lambda: -1)  # key -> reported offset
+
+    for p in pairs(history):
+        inv, comp = p["invoke"], p["complete"]
+        if inv.get("process") == "nemesis":
+            continue
+        f = inv["f"]
+        if comp is None or comp["type"] != "ok":
+            continue
+        if f == "send":
+            k, v = comp["value"][0], comp["value"][1]
+            off = comp["value"][2]
+            if off in acked[k] and acked[k][off] != v:
+                anomalies["duplicate-offset"].append(
+                    {"key": k, "offset": off, "values": [acked[k][off],
+                                                         v]})
+            acked[k][off] = v
+        elif f == "poll":
+            # value: {key: [[offset, value], ...]}
+            for k, msgs in (comp["value"] or {}).items():
+                prev = -1
+                for off, v in msgs:
+                    if off <= prev:
+                        anomalies["internal-nonmonotonic"].append(
+                            {"key": k, "offsets": [prev, off]})
+                    prev = off
+                    if off in polled[k] and polled[k][off] != v:
+                        anomalies["inconsistent-offset"].append(
+                            {"key": k, "offset": off,
+                             "values": [polled[k][off], v]})
+                    polled[k][off] = v
+                    max_polled[k] = max(max_polled[k], off)
+                if msgs:
+                    pk = (inv["process"], k)
+                    if msgs[0][0] <= last_poll_pos[pk] \
+                            and not inv.get("reassigned"):
+                        anomalies["external-nonmonotonic"].append(
+                            {"key": k, "process": inv["process"],
+                             "offsets": [last_poll_pos[pk], msgs[0][0]]})
+                    last_poll_pos[pk] = msgs[-1][0]
+        elif f == "commit_offsets":
+            # the client fills the committed offsets on the completion
+            # record (the invoke value is a placeholder). A lagging
+            # *other* client may legitimately commit lower offsets, so
+            # monotonicity is judged per process...
+            for k, off in (comp["value"] or {}).items():
+                pk = (inv["process"], k)
+                if off < commits[pk]:
+                    anomalies["commit-regression"].append(
+                        {"key": k, "process": inv["process"],
+                         "offsets": [commits[pk], off]})
+                commits[pk] = max(commits[pk], off)
+        elif f == "list_committed_offsets":
+            # ...and globally on what the SERVER reports back
+            for k, off in (comp["value"] or {}).items():
+                if off < server_commits[k]:
+                    anomalies["commit-regression"].append(
+                        {"key": k, "server-reported": True,
+                         "offsets": [server_commits[k], off]})
+                server_commits[k] = max(server_commits[k], off)
+
+    # lost writes: acked offset below the key's max polled offset but
+    # never observed by any poll
+    for k, offs in acked.items():
+        for off, v in offs.items():
+            if off < max_polled[k] and off not in polled[k]:
+                anomalies["lost-write"].append(
+                    {"key": k, "offset": off, "value": v})
+
+    return {
+        "valid?": not anomalies,
+        "anomaly-types": sorted(anomalies),
+        "anomalies": {k: v[:8] for k, v in anomalies.items()},
+        "send-count": sum(len(v) for v in acked.values()),
+        "poll-count": sum(len(v) for v in polled.values()),
+    }

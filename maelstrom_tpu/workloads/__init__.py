@@ -2,7 +2,8 @@
 
 from __future__ import annotations
 
-from . import broadcast, echo, g_set, lin_kv, pn_counter, unique_ids
+from . import (broadcast, echo, g_set, kafka, lin_kv, pn_counter,
+               txn_list_append, txn_rw_register, unique_ids)
 
 
 WORKLOADS = {
@@ -13,6 +14,9 @@ WORKLOADS = {
     "pn-counter": pn_counter.workload,
     "lin-kv": lin_kv.workload,
     "unique-ids": unique_ids.workload,
+    "txn-list-append": txn_list_append.workload,
+    "txn-rw-register": txn_rw_register.workload,
+    "kafka": kafka.workload,
 }
 
 

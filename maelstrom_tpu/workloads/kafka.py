@@ -1,0 +1,116 @@
+"""Kafka-style replicated log workload.
+
+Clients append messages to keyed logs (``send``), fetch messages from
+assigned keys (``poll``), commit read offsets (``commit_offsets``), and
+query committed offsets (``list_committed_offsets``). The checker hunts
+for lost/duplicated writes and nonmonotonic polls.
+
+Parity: reference src/maelstrom/workload/kafka.clj (RPCs :89-154,
+generator via jepsen.tests.kafka with assign-based subscriptions).
+"""
+
+from __future__ import annotations
+
+from ..core import schema
+from ..checkers.kafka import kafka_checker
+from ..gen.generators import op
+from .base import WorkloadClient
+
+schema.rpc(
+    "kafka", "send",
+    "Requests that a single message with the given `msg` value be "
+    "appended to the log for key `key`. The response includes the "
+    "`offset` the message was assigned.",
+    request={"key": str, "msg": schema.Any},
+    response={"offset": int})
+
+schema.rpc(
+    "kafka", "poll",
+    "Requests messages from the node. The response `msgs` maps keys to "
+    "arrays of [offset, msg] pairs, in ascending offset order, resuming "
+    "after the client's previous position for each key.",
+    request={schema.Opt("offsets"): schema.MapOf(str, int)},
+    response={"msgs": schema.MapOf(str, [[schema.Any]])})
+
+schema.rpc(
+    "kafka", "commit_offsets",
+    "Informs the node that the client has successfully processed "
+    "messages up to and including the given offset for each key.",
+    request={"offsets": schema.MapOf(str, int)},
+    response={})
+
+schema.rpc(
+    "kafka", "list_committed_offsets",
+    "Requests the latest committed offsets for the given keys.",
+    request={"keys": [str]},
+    response={"offsets": schema.MapOf(str, int)})
+
+
+class KafkaClient(WorkloadClient):
+    namespace = "kafka"
+    idempotent = frozenset({"poll", "list_committed_offsets"})
+
+    def __init__(self, net, node, opts):
+        super().__init__(net, node, opts)
+        self.positions = {}   # key -> next offset to poll from
+
+    def apply(self, o):
+        if o["f"] == "send":
+            k, v = o["value"]
+            resp = self.call("send", key=k, msg=v)
+            return {**o, "type": "ok", "value": [k, v, resp["offset"]]}
+        if o["f"] == "poll":
+            resp = self.call("poll", offsets=self.positions)
+            msgs = resp["msgs"]
+            for k, pairs in msgs.items():
+                if pairs:
+                    self.positions[k] = pairs[-1][0] + 1
+            return {**o, "type": "ok", "value": msgs}
+        if o["f"] == "commit_offsets":
+            self.call("commit_offsets", offsets=o["value"])
+            return {**o, "type": "ok"}
+        if o["f"] == "list_committed_offsets":
+            resp = self.call("list_committed_offsets", keys=o["value"])
+            return {**o, "type": "ok", "value": resp["offsets"]}
+        raise ValueError(f"unknown op {o['f']!r}")
+
+
+def make_generator(key_count: int):
+    def gen(rng):
+        counter = [0]
+        while True:
+            r = rng.random()
+            k = str(rng.randrange(key_count))
+            if r < 0.45:
+                counter[0] += 1
+                yield op("send", [k, counter[0]])
+            elif r < 0.85:
+                yield op("poll", None)
+            elif r < 0.95:
+                # placeholder value; the client commits its own current
+                # positions and records them on the completion
+                yield op("commit_offsets", {})
+            else:
+                yield op("list_committed_offsets",
+                         [str(i) for i in range(key_count)])
+    return gen
+
+
+class KafkaClientWithCommits(KafkaClient):
+    def apply(self, o):
+        if o["f"] == "commit_offsets":
+            offsets = {k: pos - 1 for k, pos in self.positions.items()
+                       if pos > 0}
+            if not offsets:
+                return {**o, "type": "ok", "value": {}}
+            o = {**o, "value": offsets}
+        return super().apply(o)
+
+
+def workload(opts):
+    return {
+        "client": lambda net, node, o: KafkaClientWithCommits(net, node, o),
+        "generator": make_generator(opts.get("key_count") or 4),
+        "final_generator": None,
+        "checker": lambda h, o: kafka_checker(h),
+    }

@@ -1,0 +1,78 @@
+"""Transactional list-append workload (Elle's bread and butter).
+
+Transactions are lists of micro-ops ``["r", k, null]`` / ``["append", k,
+v]`` executed atomically; reads return the full list of values appended
+to the key. Appended values are unique per key, which is what lets the
+checker infer version orders. Keys rotate out of the active pool after
+``max_writes_per_key`` appends.
+
+Parity: reference src/maelstrom/workload/txn_list_append.clj (micro-op
+schema :74-85, generator via jepsen.tests.cycle.append with --key-count /
+--max-txn-length / --max-writes-per-key :131-143, Elle checker with
+--consistency-models).
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+
+from ..core import schema
+from ..checkers.elle import check_list_append
+from ..gen.generators import op
+from .base import WorkloadClient
+
+schema.rpc(
+    "txn-list-append", "txn",
+    "Requests that the node execute a single transaction: a list of "
+    "micro-operations [f, k, v]. `[\"r\", k, null]` reads the list of "
+    "elements appended to key k; `[\"append\", k, v]` appends v to key "
+    "k. The response contains the same micro-ops with read values "
+    "filled in. Transactions are atomic: all micro-ops apply, or none "
+    "do (error 30 indicates a conflict abort).",
+    request={"txn": [[schema.Any]]},
+    response={"txn": [[schema.Any]]})
+
+
+class TxnClient(WorkloadClient):
+    namespace = "txn-list-append"
+    idempotent = frozenset()
+
+    def apply(self, o):
+        resp = self.call("txn", txn=o["value"])
+        return {**o, "type": "ok", "value": resp["txn"]}
+
+
+def make_generator(key_count: int, max_txn_length: int,
+                   max_writes_per_key: int, read_prob: float = 0.5):
+    def gen(rng):
+        next_key = [key_count]
+        active = list(range(key_count))
+        appends = defaultdict(int)
+        while True:
+            ops = []
+            for _ in range(rng.randint(1, max_txn_length)):
+                i = rng.randrange(len(active))
+                k = active[i]
+                if rng.random() < read_prob:
+                    ops.append(["r", k, None])
+                else:
+                    appends[k] += 1
+                    ops.append(["append", k, appends[k]])
+                    if appends[k] >= max_writes_per_key:
+                        active[i] = next_key[0]   # retire the key
+                        next_key[0] += 1
+            yield op("txn", ops)
+    return gen
+
+
+def workload(opts):
+    return {
+        "client": lambda net, node, o: TxnClient(net, node, o),
+        "generator": make_generator(
+            opts.get("key_count") or 10,
+            opts.get("max_txn_length") or 4,
+            opts.get("max_writes_per_key") or 16),
+        "final_generator": None,
+        "checker": lambda h, o: check_list_append(
+            h, o.get("consistency_models") or "strict-serializable"),
+    }

@@ -1,0 +1,64 @@
+"""Transactional read-write register workload.
+
+Transactions are lists of ``["r", k, null]`` / ``["w", k, v]`` micro-ops;
+writes are unique per key so write-read dependencies are unambiguous.
+
+Parity: reference src/maelstrom/workload/txn_rw_register.clj (micro-ops
+:83-92, generator via jepsen.tests.cycle.wr :162-168, Elle rw-register
+checker).
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+
+from ..core import schema
+from ..checkers.elle import check_rw_register
+from ..gen.generators import op
+from .base import WorkloadClient
+
+schema.rpc(
+    "txn-rw-register", "txn",
+    "Requests that the node execute a single transaction: a list of "
+    "micro-operations [f, k, v]. `[\"r\", k, null]` reads the current "
+    "value of key k; `[\"w\", k, v]` sets key k to v. The response "
+    "contains the same micro-ops with read values filled in. "
+    "Transactions are atomic (error 30 indicates a conflict abort).",
+    request={"txn": [[schema.Any]]},
+    response={"txn": [[schema.Any]]})
+
+
+class RWClient(WorkloadClient):
+    namespace = "txn-rw-register"
+    idempotent = frozenset()
+
+    def apply(self, o):
+        resp = self.call("txn", txn=o["value"])
+        return {**o, "type": "ok", "value": resp["txn"]}
+
+
+def make_generator(key_count: int, max_txn_length: int):
+    def gen(rng):
+        counters = defaultdict(int)
+        while True:
+            ops = []
+            for _ in range(rng.randint(1, max_txn_length)):
+                k = rng.randrange(key_count)
+                if rng.random() < 0.5:
+                    ops.append(["r", k, None])
+                else:
+                    counters[k] += 1
+                    ops.append(["w", k, counters[k]])
+            yield op("txn", ops)
+    return gen
+
+
+def workload(opts):
+    return {
+        "client": lambda net, node, o: RWClient(net, node, o),
+        "generator": make_generator(opts.get("key_count") or 10,
+                                    opts.get("max_txn_length") or 4),
+        "final_generator": None,
+        "checker": lambda h, o: check_rw_register(
+            h, o.get("consistency_models") or "strict-serializable"),
+    }

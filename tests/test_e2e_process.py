@@ -75,3 +75,27 @@ def test_lin_kv_proxy_e2e():
     w = res["workload"]
     assert w["valid?"] is True, w
     assert w["key-count"] > 0
+
+
+def test_txn_list_append_single_node_e2e():
+    res = run("txn-list-append", "txn_single.py", node_count=1,
+              time_limit=3.0, rate=30.0)
+    w = res["workload"]
+    assert w["valid?"] is True, w
+    assert w["txn-count"] > 20
+
+
+def test_txn_rw_register_single_node_e2e():
+    res = run("txn-rw-register", "txn_single.py", node_count=1,
+              time_limit=3.0, rate=30.0)
+    w = res["workload"]
+    assert w["valid?"] is True, w
+    assert w["txn-count"] > 20
+
+
+def test_datomic_txn_multi_node_e2e():
+    res = run("txn-list-append", "datomic_txn.py", node_count=3,
+              time_limit=4.0, rate=20.0)
+    w = res["workload"]
+    assert w["valid?"] is True, w
+    assert w["txn-count"] > 10

@@ -1,0 +1,125 @@
+"""Elle-style checker unit tests on literal histories (the reference's
+checker-test pattern, SURVEY §4)."""
+
+from maelstrom_tpu.checkers.elle import check_list_append, check_rw_register
+
+
+def H(*recs):
+    out = []
+    for i, r in enumerate(recs):
+        out.append({"process": r[0], "type": r[1], "f": "txn",
+                    "value": r[2], "index": i, "time": i})
+    return out
+
+
+def test_list_append_clean_serial():
+    h = H((0, "invoke", [["append", 1, 1]]),
+          (0, "ok",     [["append", 1, 1]]),
+          (1, "invoke", [["r", 1, None]]),
+          (1, "ok",     [["r", 1, [1]]]),
+          (0, "invoke", [["append", 1, 2]]),
+          (0, "ok",     [["append", 1, 2]]),
+          (1, "invoke", [["r", 1, None]]),
+          (1, "ok",     [["r", 1, [1, 2]]]))
+    r = check_list_append(h)
+    assert r["valid?"] is True, r
+
+
+def test_list_append_lost_append():
+    h = H((0, "invoke", [["append", 1, 1]]),
+          (0, "ok",     [["append", 1, 1]]),
+          (1, "invoke", [["r", 1, None]]),
+          (1, "ok",     [["r", 1, []]]))
+    r = check_list_append(h)
+    assert r["valid?"] is False
+    assert "lost-append" in r["anomalies"]
+
+
+def test_list_append_g1a_aborted_read():
+    h = H((0, "invoke", [["append", 1, 9]]),
+          (0, "fail",   [["append", 1, 9]]),
+          (1, "invoke", [["r", 1, None]]),
+          (1, "ok",     [["r", 1, [9]]]))
+    r = check_list_append(h)
+    assert r["valid?"] is False
+    assert "G1a" in r["anomalies"]
+
+
+def test_list_append_incompatible_order():
+    h = H((0, "invoke", [["r", 1, None]]),
+          (0, "ok",     [["r", 1, [1, 2]]]),
+          (1, "invoke", [["r", 1, None]]),
+          (1, "ok",     [["r", 1, [2, 1]]]))
+    r = check_list_append(h)
+    assert r["valid?"] is False
+    assert "incompatible-order" in r["anomalies"]
+
+
+def test_list_append_wr_cycle_g1c():
+    # T1 reads T2's append; T2 reads T1's append: wr cycle
+    h = [
+        {"process": 0, "type": "invoke", "f": "txn",
+         "value": [["append", 1, 1], ["r", 2, None]], "index": 0,
+         "time": 0},
+        {"process": 1, "type": "invoke", "f": "txn",
+         "value": [["append", 2, 1], ["r", 1, None]], "index": 1,
+         "time": 1},
+        {"process": 0, "type": "ok", "f": "txn",
+         "value": [["append", 1, 1], ["r", 2, [1]]], "index": 2,
+         "time": 2},
+        {"process": 1, "type": "ok", "f": "txn",
+         "value": [["append", 2, 1], ["r", 1, [1]]], "index": 3,
+         "time": 3},
+    ]
+    r = check_list_append(h, "serializable")
+    assert r["valid?"] is False
+    assert any(k in r["anomalies"] for k in ("G1c", "G2-item")), r
+
+
+def test_list_append_realtime_stale_read():
+    # append completes, then a later txn reads the old state: under
+    # strict serializability that's an rw/realtime cycle; serializable
+    # alone accepts it
+    h = H((0, "invoke", [["append", 1, 1]]),
+          (0, "ok",     [["append", 1, 1]]),
+          (0, "invoke", [["append", 1, 2]]),
+          (0, "ok",     [["append", 1, 2]]),
+          (1, "invoke", [["r", 1, None]]),
+          (1, "ok",     [["r", 1, [1]]]),
+          (1, "invoke", [["r", 1, None]]),
+          (1, "ok",     [["r", 1, [1, 2]]]))
+    assert check_list_append(h, "strict-serializable")["valid?"] is False
+    assert check_list_append(h, "serializable")["valid?"] is True
+
+
+def test_rw_register_clean():
+    h = H((0, "invoke", [["w", 1, 1]]),
+          (0, "ok",     [["w", 1, 1]]),
+          (1, "invoke", [["r", 1, None]]),
+          (1, "ok",     [["r", 1, 1]]))
+    assert check_rw_register(h)["valid?"] is True
+
+
+def test_rw_register_g1a():
+    h = H((0, "invoke", [["w", 1, 5]]),
+          (0, "fail",   [["w", 1, 5]]),
+          (1, "invoke", [["r", 1, None]]),
+          (1, "ok",     [["r", 1, 5]]))
+    r = check_rw_register(h)
+    assert r["valid?"] is False
+    assert "G1a" in r["anomalies"]
+
+
+def test_rw_register_wr_cycle():
+    h = [
+        {"process": 0, "type": "invoke", "f": "txn",
+         "value": [["w", 1, 1], ["r", 2, None]], "index": 0, "time": 0},
+        {"process": 1, "type": "invoke", "f": "txn",
+         "value": [["w", 2, 1], ["r", 1, None]], "index": 1, "time": 1},
+        {"process": 0, "type": "ok", "f": "txn",
+         "value": [["w", 1, 1], ["r", 2, 1]], "index": 2, "time": 2},
+        {"process": 1, "type": "ok", "f": "txn",
+         "value": [["w", 2, 1], ["r", 1, 1]], "index": 3, "time": 3},
+    ]
+    r = check_rw_register(h, "serializable")
+    assert r["valid?"] is False

@@ -1,0 +1,61 @@
+"""Kafka workload: checker unit tests + single-node e2e."""
+
+from maelstrom_tpu.checkers.kafka import kafka_checker
+from conftest import example_bin
+from maelstrom_tpu.runner import run_test
+
+
+def H(*recs):
+    out = []
+    for i, r in enumerate(recs):
+        out.append({"process": r[0], "type": r[1], "f": r[2],
+                    "value": r[3], "index": i, "time": i})
+    return out
+
+
+def test_kafka_clean():
+    h = H((0, "invoke", "send", ["k", 1]),
+          (0, "ok", "send", ["k", 1, 0]),
+          (1, "invoke", "poll", None),
+          (1, "ok", "poll", {"k": [[0, 1]]}))
+    assert kafka_checker(h)["valid?"] is True
+
+
+def test_kafka_lost_write():
+    h = H((0, "invoke", "send", ["k", 1]),
+          (0, "ok", "send", ["k", 1, 0]),
+          (0, "invoke", "send", ["k", 2]),
+          (0, "ok", "send", ["k", 2, 1]),
+          (1, "invoke", "poll", None),
+          (1, "ok", "poll", {"k": [[1, 2]]}))
+    r = kafka_checker(h)
+    assert r["valid?"] is False
+    assert "lost-write" in r["anomalies"]
+
+
+def test_kafka_internal_nonmonotonic():
+    h = H((1, "invoke", "poll", None),
+          (1, "ok", "poll", {"k": [[3, "a"], [2, "b"]]}))
+    r = kafka_checker(h)
+    assert "internal-nonmonotonic" in r["anomalies"]
+
+
+def test_kafka_inconsistent_offset():
+    h = H((0, "invoke", "poll", None),
+          (0, "ok", "poll", {"k": [[0, "a"]]}),
+          (1, "invoke", "poll", None),
+          (1, "ok", "poll", {"k": [[0, "b"]]}))
+    r = kafka_checker(h)
+    assert "inconsistent-offset" in r["anomalies"]
+
+
+def test_kafka_single_node_e2e():
+    bin_cmd = example_bin("kafka_single.py")
+    res = run_test("kafka", dict(
+        bin=bin_cmd[0], bin_args=bin_cmd[1:], node_count=1,
+        snapshot_store=False, time_limit=3.0, rate=40.0, concurrency=4,
+        recovery_time=0.5, seed=42))
+    w = res["workload"]
+    assert w["valid?"] is True, w
+    assert w["send-count"] > 10
+    assert w["poll-count"] > 10
