@@ -1,0 +1,331 @@
+// Minimal JSON value / parser / serializer for the node SDK.
+// Self-contained (no external deps; the environment ships no JSON lib).
+// Covers the full JSON grammar; numbers are held as int64 when integral,
+// double otherwise, matching what the wire protocol needs.
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <sstream>
+#include <stdexcept>
+#include <string>
+#include <variant>
+#include <vector>
+
+namespace maelstrom {
+namespace json {
+
+class Value;
+using Array = std::vector<Value>;
+using Object = std::map<std::string, Value>;
+
+class Value {
+ public:
+  using Storage = std::variant<std::nullptr_t, bool, int64_t, double,
+                               std::string, Array, Object>;
+
+  Value() : v_(nullptr) {}
+  Value(std::nullptr_t) : v_(nullptr) {}
+  Value(bool b) : v_(b) {}
+  Value(int i) : v_(static_cast<int64_t>(i)) {}
+  Value(int64_t i) : v_(i) {}
+  Value(double d) : v_(d) {}
+  Value(const char* s) : v_(std::string(s)) {}
+  Value(std::string s) : v_(std::move(s)) {}
+  Value(Array a) : v_(std::move(a)) {}
+  Value(Object o) : v_(std::move(o)) {}
+
+  bool is_null() const { return std::holds_alternative<std::nullptr_t>(v_); }
+  bool is_bool() const { return std::holds_alternative<bool>(v_); }
+  bool is_int() const { return std::holds_alternative<int64_t>(v_); }
+  bool is_double() const { return std::holds_alternative<double>(v_); }
+  bool is_number() const { return is_int() || is_double(); }
+  bool is_string() const { return std::holds_alternative<std::string>(v_); }
+  bool is_array() const { return std::holds_alternative<Array>(v_); }
+  bool is_object() const { return std::holds_alternative<Object>(v_); }
+
+  bool as_bool() const { return std::get<bool>(v_); }
+  int64_t as_int() const {
+    if (is_double()) return static_cast<int64_t>(std::get<double>(v_));
+    return std::get<int64_t>(v_);
+  }
+  double as_double() const {
+    if (is_int()) return static_cast<double>(std::get<int64_t>(v_));
+    return std::get<double>(v_);
+  }
+  const std::string& as_string() const { return std::get<std::string>(v_); }
+  const Array& as_array() const { return std::get<Array>(v_); }
+  Array& as_array() { return std::get<Array>(v_); }
+  const Object& as_object() const { return std::get<Object>(v_); }
+  Object& as_object() { return std::get<Object>(v_); }
+
+  // object conveniences
+  bool contains(const std::string& k) const {
+    return is_object() && as_object().count(k) > 0;
+  }
+  const Value& at(const std::string& k) const { return as_object().at(k); }
+  Value& operator[](const std::string& k) {
+    if (is_null()) v_ = Object{};
+    return as_object()[k];
+  }
+  Value get(const std::string& k, Value dflt = Value()) const {
+    if (!is_object()) return dflt;
+    auto it = as_object().find(k);
+    return it == as_object().end() ? dflt : it->second;
+  }
+
+  bool operator==(const Value& o) const { return v_ == o.v_; }
+  bool operator!=(const Value& o) const { return !(*this == o); }
+
+  std::string dump() const {
+    std::ostringstream out;
+    write(out);
+    return out.str();
+  }
+
+  void write(std::ostream& out) const {
+    if (is_null()) { out << "null"; return; }
+    if (is_bool()) { out << (as_bool() ? "true" : "false"); return; }
+    if (is_int()) { out << std::get<int64_t>(v_); return; }
+    if (is_double()) {
+      std::ostringstream tmp;
+      tmp.precision(17);
+      tmp << std::get<double>(v_);
+      out << tmp.str();
+      return;
+    }
+    if (is_string()) { write_string(out, as_string()); return; }
+    if (is_array()) {
+      out << '[';
+      bool first = true;
+      for (const auto& e : as_array()) {
+        if (!first) out << ',';
+        first = false;
+        e.write(out);
+      }
+      out << ']';
+      return;
+    }
+    out << '{';
+    bool first = true;
+    for (const auto& [k, val] : as_object()) {
+      if (!first) out << ',';
+      first = false;
+      write_string(out, k);
+      out << ':';
+      val.write(out);
+    }
+    out << '}';
+  }
+
+ private:
+  static void write_string(std::ostream& out, const std::string& s) {
+    out << '"';
+    for (char c : s) {
+      switch (c) {
+        case '"': out << "\\\""; break;
+        case '\\': out << "\\\\"; break;
+        case '\n': out << "\\n"; break;
+        case '\r': out << "\\r"; break;
+        case '\t': out << "\\t"; break;
+        case '\b': out << "\\b"; break;
+        case '\f': out << "\\f"; break;
+        default:
+          if (static_cast<unsigned char>(c) < 0x20) {
+            char buf[8];
+            snprintf(buf, sizeof buf, "\\u%04x", c);
+            out << buf;
+          } else {
+            out << c;
+          }
+      }
+    }
+    out << '"';
+  }
+
+  Storage v_;
+};
+
+class ParseError : public std::runtime_error {
+ public:
+  explicit ParseError(const std::string& msg) : std::runtime_error(msg) {}
+};
+
+class Parser {
+ public:
+  explicit Parser(const std::string& text) : s_(text) {}
+
+  Value parse() {
+    Value v = value();
+    ws();
+    if (pos_ != s_.size()) fail("trailing characters after JSON value");
+    return v;
+  }
+
+ private:
+  [[noreturn]] void fail(const std::string& why) {
+    throw ParseError(why + " at byte " + std::to_string(pos_));
+  }
+
+  void ws() {
+    while (pos_ < s_.size() && (s_[pos_] == ' ' || s_[pos_] == '\t' ||
+                                s_[pos_] == '\n' || s_[pos_] == '\r'))
+      ++pos_;
+  }
+
+  char peek() {
+    if (pos_ >= s_.size()) fail("unexpected end of input");
+    return s_[pos_];
+  }
+
+  char next() {
+    char c = peek();
+    ++pos_;
+    return c;
+  }
+
+  void expect(const std::string& word, Value v, Value* out) {
+    if (s_.compare(pos_, word.size(), word) != 0)
+      fail("invalid literal");
+    pos_ += word.size();
+    *out = std::move(v);
+  }
+
+  Value value() {
+    ws();
+    char c = peek();
+    Value out;
+    switch (c) {
+      case '{': return object();
+      case '[': return array();
+      case '"': return Value(string());
+      case 't': expect("true", Value(true), &out); return out;
+      case 'f': expect("false", Value(false), &out); return out;
+      case 'n': expect("null", Value(nullptr), &out); return out;
+      default: return number();
+    }
+  }
+
+  Value object() {
+    next();  // {
+    Object obj;
+    ws();
+    if (peek() == '}') { next(); return Value(std::move(obj)); }
+    while (true) {
+      ws();
+      if (peek() != '"') fail("expected object key string");
+      std::string k = string();
+      ws();
+      if (next() != ':') fail("expected ':' in object");
+      obj[std::move(k)] = value();
+      ws();
+      char c = next();
+      if (c == '}') break;
+      if (c != ',') fail("expected ',' or '}' in object");
+    }
+    return Value(std::move(obj));
+  }
+
+  Value array() {
+    next();  // [
+    Array arr;
+    ws();
+    if (peek() == ']') { next(); return Value(std::move(arr)); }
+    while (true) {
+      arr.push_back(value());
+      ws();
+      char c = next();
+      if (c == ']') break;
+      if (c != ',') fail("expected ',' or ']' in array");
+    }
+    return Value(std::move(arr));
+  }
+
+  std::string string() {
+    next();  // "
+    std::string out;
+    while (true) {
+      char c = next();
+      if (c == '"') return out;
+      if (c == '\\') {
+        char e = next();
+        switch (e) {
+          case '"': out += '"'; break;
+          case '\\': out += '\\'; break;
+          case '/': out += '/'; break;
+          case 'n': out += '\n'; break;
+          case 't': out += '\t'; break;
+          case 'r': out += '\r'; break;
+          case 'b': out += '\b'; break;
+          case 'f': out += '\f'; break;
+          case 'u': {
+            if (pos_ + 4 > s_.size()) fail("bad \\u escape");
+            unsigned cp = std::stoul(s_.substr(pos_, 4), nullptr, 16);
+            pos_ += 4;
+            // encode UTF-8 (surrogate pairs for completeness)
+            if (cp >= 0xD800 && cp <= 0xDBFF && pos_ + 6 <= s_.size() &&
+                s_[pos_] == '\\' && s_[pos_ + 1] == 'u') {
+              unsigned lo = std::stoul(s_.substr(pos_ + 2, 4), nullptr, 16);
+              pos_ += 6;
+              cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+            }
+            if (cp < 0x80) {
+              out += static_cast<char>(cp);
+            } else if (cp < 0x800) {
+              out += static_cast<char>(0xC0 | (cp >> 6));
+              out += static_cast<char>(0x80 | (cp & 0x3F));
+            } else if (cp < 0x10000) {
+              out += static_cast<char>(0xE0 | (cp >> 12));
+              out += static_cast<char>(0x80 | ((cp >> 6) & 0x3F));
+              out += static_cast<char>(0x80 | (cp & 0x3F));
+            } else {
+              out += static_cast<char>(0xF0 | (cp >> 18));
+              out += static_cast<char>(0x80 | ((cp >> 12) & 0x3F));
+              out += static_cast<char>(0x80 | ((cp >> 6) & 0x3F));
+              out += static_cast<char>(0x80 | (cp & 0x3F));
+            }
+            break;
+          }
+          default: fail("bad escape character");
+        }
+      } else {
+        out += c;
+      }
+    }
+  }
+
+  Value number() {
+    size_t start = pos_;
+    if (peek() == '-') next();
+    while (pos_ < s_.size() && isdigit(s_[pos_])) ++pos_;
+    bool integral = true;
+    if (pos_ < s_.size() && s_[pos_] == '.') {
+      integral = false;
+      ++pos_;
+      while (pos_ < s_.size() && isdigit(s_[pos_])) ++pos_;
+    }
+    if (pos_ < s_.size() && (s_[pos_] == 'e' || s_[pos_] == 'E')) {
+      integral = false;
+      ++pos_;
+      if (pos_ < s_.size() && (s_[pos_] == '+' || s_[pos_] == '-')) ++pos_;
+      while (pos_ < s_.size() && isdigit(s_[pos_])) ++pos_;
+    }
+    std::string tok = s_.substr(start, pos_ - start);
+    if (tok.empty() || tok == "-") fail("invalid number");
+    try {
+      if (integral) return Value(static_cast<int64_t>(std::stoll(tok)));
+      return Value(std::stod(tok));
+    } catch (const std::exception&) {
+      fail("number out of range");
+    }
+  }
+
+  const std::string& s_;
+  size_t pos_ = 0;
+};
+
+inline Value parse(const std::string& text) { return Parser(text).parse(); }
+
+}  // namespace json
+}  // namespace maelstrom

@@ -1,0 +1,350 @@
+// C++ node SDK: write workload nodes against the STDIN/STDOUT JSON
+// protocol in C++17 with no external dependencies.
+//
+// Provides: message parsing/serialization, handler registration per
+// message type, built-in init handling, reply helpers, async RPC with
+// callbacks + blocking sync_rpc, periodic timers, and a KV client for the
+// built-in services (lin-kv / seq-kv / lww-kv).
+//
+// Fills the role of the reference's demo/c++/maelstrom.{h,cpp} (Message +
+// MessageHandler + Node run loop) and the Rust maelstrom-node crate's
+// async node + kv::Storage client (the environment has no Rust
+// toolchain; SURVEY §2.3 native components #1 and #2).
+//
+// Threading model: the main thread reads STDIN and dispatches each
+// message on a worker thread (like the reference's std::async dispatch,
+// maelstrom.cpp:80-112). Handlers run holding the node mutex; RPC reply
+// callbacks run WITHOUT it (so a handler may block in sync_rpc without
+// deadlocking the reply path) and must lock via with_lock() if they
+// touch shared state.
+#pragma once
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <functional>
+#include <iostream>
+#include <map>
+#include <mutex>
+#include <optional>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "json.hpp"
+
+namespace maelstrom {
+
+using json::Value;
+
+struct Message {
+  std::string src;
+  std::string dest;
+  Value body;
+
+  static Message from_json(const Value& v) {
+    Message m;
+    m.src = v.at("src").as_string();
+    m.dest = v.at("dest").as_string();
+    m.body = v.at("body");
+    return m;
+  }
+};
+
+struct RPCError : public std::runtime_error {
+  int code;
+  RPCError(int code, const std::string& text)
+      : std::runtime_error("RPC error " + std::to_string(code) + ": " +
+                           text),
+        code(code) {}
+
+  static RPCError timeout(const std::string& t = "timed out") {
+    return RPCError(0, t);
+  }
+  static RPCError not_supported(const std::string& t) {
+    return RPCError(10, t);
+  }
+  static RPCError temporarily_unavailable(const std::string& t) {
+    return RPCError(11, t);
+  }
+  static RPCError key_does_not_exist(const std::string& t) {
+    return RPCError(20, t);
+  }
+  static RPCError precondition_failed(const std::string& t) {
+    return RPCError(22, t);
+  }
+  static RPCError txn_conflict(const std::string& t) {
+    return RPCError(30, t);
+  }
+
+  Value to_body() const {
+    Value b;
+    b["type"] = "error";
+    b["code"] = code;
+    b["text"] = std::string(what());
+    return b;
+  }
+};
+
+class Node {
+ public:
+  using Handler = std::function<void(const Message&)>;
+  using Callback = std::function<void(const Value&)>;
+
+  std::string node_id;
+  std::vector<std::string> node_ids;
+
+  Node() {
+    on("init", [this](const Message& msg) {
+      node_id = msg.body.at("node_id").as_string();
+      node_ids.clear();
+      for (const auto& n : msg.body.at("node_ids").as_array())
+        node_ids.push_back(n.as_string());
+      log("node " + node_id + " initialized");
+      for (auto& fn : init_callbacks_) fn();
+      Value b;
+      b["type"] = "init_ok";
+      reply(msg, b);
+      start_timers();
+    });
+  }
+
+  // --- registration -----------------------------------------------------
+
+  void on(const std::string& type, Handler h) { handlers_[type] = h; }
+
+  void on_init(std::function<void()> fn) {
+    init_callbacks_.push_back(std::move(fn));
+  }
+
+  void every(double interval_s, std::function<void()> fn) {
+    timers_.push_back({interval_s, std::move(fn)});
+  }
+
+  // --- io ---------------------------------------------------------------
+
+  void log(const std::string& s) {
+    std::lock_guard<std::mutex> g(err_mutex_);
+    std::cerr << s << "\n" << std::flush;
+  }
+
+  void send(const std::string& dest, Value body) {
+    Value m;
+    m["src"] = node_id;
+    m["dest"] = dest;
+    m["body"] = std::move(body);
+    std::lock_guard<std::mutex> g(out_mutex_);
+    std::cout << m.dump() << "\n" << std::flush;
+  }
+
+  void reply(const Message& req, Value body) {
+    // inter-node sends may carry no msg_id; a reply to one is still
+    // routable, just uncorrelated (never throw from the reply path)
+    Value msg_id = req.body.get("msg_id");
+    if (!msg_id.is_null()) body["in_reply_to"] = msg_id;
+    send(req.src, std::move(body));
+  }
+
+  void reply_error(const Message& req, const RPCError& e) {
+    reply(req, e.to_body());
+  }
+
+  // --- rpc --------------------------------------------------------------
+
+  int64_t rpc(const std::string& dest, Value body, Callback cb) {
+    int64_t msg_id;
+    {
+      std::lock_guard<std::mutex> g(cb_mutex_);
+      msg_id = ++next_msg_id_;
+      callbacks_[msg_id] = std::move(cb);
+    }
+    body["msg_id"] = msg_id;
+    send(dest, std::move(body));
+    return msg_id;
+  }
+
+  Value sync_rpc(const std::string& dest, Value body,
+                 double timeout_s = 1.0) {
+    auto state = std::make_shared<SyncState>();
+    int64_t msg_id = rpc(dest, std::move(body),
+                         [state](const Value& reply) {
+      std::lock_guard<std::mutex> g(state->m);
+      state->reply = reply;
+      state->done = true;
+      state->cv.notify_all();
+    });
+    std::unique_lock<std::mutex> lk(state->m);
+    if (!state->cv.wait_for(lk,
+                            std::chrono::duration<double>(timeout_s),
+                            [&] { return state->done; })) {
+      // drop the pending callback or it (and its SyncState) leaks for
+      // every reply the network lost
+      std::lock_guard<std::mutex> g(cb_mutex_);
+      callbacks_.erase(msg_id);
+      throw RPCError::timeout("RPC to " + dest + " timed out");
+    }
+    const Value& r = state->reply;
+    if (r.get("type") == Value("error"))
+      throw RPCError(static_cast<int>(r.get("code", Value(13)).as_int()),
+                     r.get("text", Value("")).as_string());
+    return r;
+  }
+
+  // handlers run holding this; reply callbacks don't (see header docs)
+  template <typename F>
+  auto with_lock(F&& f) {
+    std::lock_guard<std::mutex> g(node_mutex_);
+    return f();
+  }
+
+  // --- run loop ---------------------------------------------------------
+
+  void run() {
+    std::string line;
+    while (std::getline(std::cin, line)) {
+      if (line.empty()) continue;
+      Message m;
+      try {
+        m = Message::from_json(json::parse(line));
+      } catch (const std::exception& e) {
+        log(std::string("malformed message: ") + e.what());
+        continue;
+      }
+      // detached, like the Python SDK's daemon threads: joining would
+      // block stdin intake while a handler is parked in sync_rpc, which
+      // starves that very handler of its reply
+      std::thread([this, m] { dispatch(m); }).detach();
+    }
+    // brief grace for in-flight handlers before the process exits
+    std::this_thread::sleep_for(std::chrono::milliseconds(50));
+  }
+
+ private:
+  struct SyncState {
+    std::mutex m;
+    std::condition_variable cv;
+    Value reply;
+    bool done = false;
+  };
+
+  void dispatch(const Message& m) {
+    Value irt = m.body.get("in_reply_to");
+    if (!irt.is_null()) {
+      Callback cb;
+      {
+        std::lock_guard<std::mutex> g(cb_mutex_);
+        auto it = callbacks_.find(irt.as_int());
+        if (it == callbacks_.end()) return;
+        cb = it->second;
+        callbacks_.erase(it);
+      }
+      try {
+        cb(m.body);
+      } catch (const std::exception& e) {
+        log(std::string("callback error: ") + e.what());
+      }
+      return;
+    }
+    std::string type = m.body.get("type", Value("")).as_string();
+    auto it = handlers_.find(type);
+    try {
+      if (it == handlers_.end()) {
+        reply_error(m, RPCError::not_supported("no handler for '" + type +
+                                               "'"));
+        return;
+      }
+      try {
+        std::lock_guard<std::mutex> g(node_mutex_);
+        it->second(m);
+      } catch (const RPCError& e) {
+        reply_error(m, e);
+      } catch (const std::exception& e) {
+        log(std::string("handler error: ") + e.what());
+        reply_error(m, RPCError(13, e.what()));
+      }
+    } catch (const std::exception& e) {
+      // never let an exception escape a worker thread: that would
+      // std::terminate the whole node
+      log(std::string("reply error: ") + e.what());
+    }
+  }
+
+  void start_timers() {
+    for (auto& [interval, fn] : timers_) {
+      double iv = interval;
+      auto f = fn;
+      std::thread([this, iv, f] {
+        while (true) {
+          std::this_thread::sleep_for(std::chrono::duration<double>(iv));
+          try {
+            std::lock_guard<std::mutex> g(node_mutex_);
+            f();
+          } catch (const std::exception& e) {
+            log(std::string("timer error: ") + e.what());
+          }
+        }
+      }).detach();
+    }
+  }
+
+  std::map<std::string, Handler> handlers_;
+  std::map<int64_t, Callback> callbacks_;
+  std::vector<std::function<void()>> init_callbacks_;
+  std::vector<std::pair<double, std::function<void()>>> timers_;
+  std::mutex node_mutex_, cb_mutex_, out_mutex_, err_mutex_;
+  int64_t next_msg_id_ = 0;
+};
+
+// Client for the built-in KV services (the role of demo/go/kv.go and the
+// Rust crate's kv::Storage).
+class KV {
+ public:
+  static constexpr const char* LIN = "lin-kv";
+  static constexpr const char* SEQ = "seq-kv";
+  static constexpr const char* LWW = "lww-kv";
+
+  KV(Node& node, std::string service = LIN, double timeout_s = 1.0)
+      : node_(node), service_(std::move(service)), timeout_(timeout_s) {}
+
+  Value read(const Value& key) {
+    Value b;
+    b["type"] = "read";
+    b["key"] = key;
+    return node_.sync_rpc(service_, std::move(b), timeout_).at("value");
+  }
+
+  std::optional<Value> read_or_null(const Value& key) {
+    try {
+      return read(key);
+    } catch (const RPCError& e) {
+      if (e.code == 20) return std::nullopt;
+      throw;
+    }
+  }
+
+  void write(const Value& key, const Value& value) {
+    Value b;
+    b["type"] = "write";
+    b["key"] = key;
+    b["value"] = value;
+    node_.sync_rpc(service_, std::move(b), timeout_);
+  }
+
+  void cas(const Value& key, const Value& from, const Value& to,
+           bool create_if_not_exists = false) {
+    Value b;
+    b["type"] = "cas";
+    b["key"] = key;
+    b["from"] = from;
+    b["to"] = to;
+    if (create_if_not_exists) b["create_if_not_exists"] = true;
+    node_.sync_rpc(service_, std::move(b), timeout_);
+  }
+
+ private:
+  Node& node_;
+  std::string service_;
+  double timeout_;
+};
+
+}  // namespace maelstrom

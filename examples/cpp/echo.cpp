@@ -1,0 +1,19 @@
+// Echo node in C++ against the native SDK — the role of the reference's
+// demo/c++/echo.cpp.
+#include "maelstrom/node.hpp"
+
+using maelstrom::Message;
+using maelstrom::Node;
+using maelstrom::Value;
+
+int main() {
+  Node node;
+  node.on("echo", [&](const Message& msg) {
+    Value b;
+    b["type"] = "echo_ok";
+    b["echo"] = msg.body.at("echo");
+    node.reply(msg, b);
+  });
+  node.run();
+  return 0;
+}

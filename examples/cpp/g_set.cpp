@@ -1,0 +1,54 @@
+// G-set CRDT node in C++: grow-only set with periodic full-state gossip
+// (merge = union). Exercises timers, inter-node sends, and JSON arrays.
+#include <set>
+
+#include "maelstrom/node.hpp"
+
+using maelstrom::Message;
+using maelstrom::Node;
+using maelstrom::Value;
+
+int main() {
+  Node node;
+  // elements keyed by serialized form so arbitrary JSON values dedupe
+  std::map<std::string, Value> elements;
+
+  auto element_array = [&] {
+    maelstrom::json::Array arr;
+    for (const auto& [k, v] : elements) arr.push_back(v);
+    return Value(arr);
+  };
+
+  node.on("add", [&](const Message& msg) {
+    Value e = msg.body.at("element");
+    elements[e.dump()] = e;
+    Value b;
+    b["type"] = "add_ok";
+    node.reply(msg, b);
+  });
+
+  node.on("read", [&](const Message& msg) {
+    Value b;
+    b["type"] = "read_ok";
+    b["value"] = element_array();
+    node.reply(msg, b);
+  });
+
+  node.on("replicate", [&](const Message& msg) {
+    for (const auto& e : msg.body.at("value").as_array())
+      elements[e.dump()] = e;
+  });
+
+  node.every(0.2, [&] {
+    for (const auto& peer : node.node_ids) {
+      if (peer == node.node_id) continue;
+      Value b;
+      b["type"] = "replicate";
+      b["value"] = element_array();
+      node.send(peer, b);
+    }
+  });
+
+  node.run();
+  return 0;
+}

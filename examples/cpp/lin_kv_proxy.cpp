@@ -1,0 +1,40 @@
+// Linearizable KV node in C++: proxies ops to the built-in lin-kv
+// service — exercises the SDK's sync_rpc + KV client end-to-end (the
+// role of the Rust crate's lin_kv Storage usage, demo/rust/src/bin/
+// lin_kv.rs).
+#include "maelstrom/node.hpp"
+
+using maelstrom::KV;
+using maelstrom::Message;
+using maelstrom::Node;
+using maelstrom::RPCError;
+using maelstrom::Value;
+
+int main() {
+  Node node;
+  KV kv(node, KV::LIN, 2.0);
+
+  node.on("read", [&](const Message& msg) {
+    Value b;
+    b["type"] = "read_ok";
+    b["value"] = kv.read(msg.body.at("key"));
+    node.reply(msg, b);
+  });
+
+  node.on("write", [&](const Message& msg) {
+    kv.write(msg.body.at("key"), msg.body.at("value"));
+    Value b;
+    b["type"] = "write_ok";
+    node.reply(msg, b);
+  });
+
+  node.on("cas", [&](const Message& msg) {
+    kv.cas(msg.body.at("key"), msg.body.at("from"), msg.body.at("to"));
+    Value b;
+    b["type"] = "cas_ok";
+    node.reply(msg, b);
+  });
+
+  node.run();
+  return 0;
+}

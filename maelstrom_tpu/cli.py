@@ -62,6 +62,12 @@ def add_test_options(p: argparse.ArgumentParser):
     p.add_argument("--availability", default=None,
                    help="'total' or a fraction like 0.9")
     p.add_argument("--key-count", type=int, default=None)
+    p.add_argument("--max-txn-length", type=int, default=None)
+    p.add_argument("--max-writes-per-key", type=int, default=None)
+    p.add_argument("--consistency-models", default=None,
+                   choices=["read-uncommitted", "read-committed",
+                            "read-atomic", "serializable",
+                            "strict-serializable"])
     p.add_argument("--log-stderr", action="store_true")
     p.add_argument("--log-net-send", action="store_true")
     p.add_argument("--log-net-recv", action="store_true")
@@ -97,7 +103,11 @@ def cmd_test(args) -> int:
             nemesis=args.nemesis, nemesis_interval=args.nemesis_interval,
             topology=args.topology,
             availability=_availability(args.availability),
-            key_count=args.key_count, log_stderr=args.log_stderr,
+            key_count=args.key_count,
+            max_txn_length=args.max_txn_length,
+            max_writes_per_key=args.max_writes_per_key,
+            consistency_models=args.consistency_models,
+            log_stderr=args.log_stderr,
             log_net_send=args.log_net_send,
             log_net_recv=args.log_net_recv, seed=args.seed,
             store_root=args.store))

@@ -267,6 +267,12 @@ class TestRunner:
             plot_perf(self.history.records(), self.store_dir)
         except Exception:
             traceback.print_exc()
+        try:
+            from .checkers.timeline import render_timeline
+            render_timeline(self.history.records(),
+                            os.path.join(self.store_dir, "timeline.html"))
+        except Exception:
+            traceback.print_exc()
         self.journal.close()
         # maintain store/<workload>/latest symlink (doc/results.md:7-9)
         latest = os.path.join(os.path.dirname(self.store_dir), "latest")

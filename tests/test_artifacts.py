@@ -19,7 +19,7 @@ def test_store_artifacts(tmp_path):
     runs = [p for p in os.listdir(d) if p != "latest"]
     assert len(runs) == 1
     run_dir = os.path.join(d, runs[0])
-    for artifact in ("history.jsonl", "results.json", "messages.svg",
+    for artifact in ("history.jsonl", "results.json", "messages.svg", "timeline.html",
                      "latency-raw.svg", "rate.svg", "net-journal",
                      "node-logs"):
         assert os.path.exists(os.path.join(run_dir, artifact)), artifact

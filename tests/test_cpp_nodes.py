@@ -1,0 +1,54 @@
+"""End-to-end tests of the C++ node SDK: build the example nodes with
+make, then run them through the full harness (SURVEY §2.3 native
+components #1/#2)."""
+
+import os
+import shutil
+import subprocess
+
+import pytest
+
+from conftest import REPO
+from maelstrom_tpu.runner import run_test
+
+CPP_DIR = os.path.join(REPO, "examples", "cpp")
+
+
+@pytest.fixture(scope="module")
+def cpp_bins():
+    if shutil.which("g++") is None:
+        pytest.skip("no g++ toolchain")
+    subprocess.run(["make", "-C", CPP_DIR], check=True,
+                   capture_output=True)
+    return os.path.join(CPP_DIR, "bin")
+
+
+def run(workload, binary, cpp_bins, **opts):
+    base = dict(bin=os.path.join(cpp_bins, binary), bin_args=[],
+                snapshot_store=False, time_limit=2.0, rate=30.0,
+                concurrency=4, recovery_time=0.5, seed=42)
+    base.update(opts)
+    return run_test(workload, base)
+
+
+def test_cpp_echo(cpp_bins):
+    res = run("echo", "echo", cpp_bins, node_count=2)
+    assert res["valid?"] is True, res["workload"]
+    assert res["workload"]["ok-count"] > 10
+
+
+def test_cpp_g_set_with_partitions(cpp_bins):
+    res = run("g-set", "g_set", cpp_bins, node_count=3, time_limit=3.0,
+              recovery_time=1.5, nemesis=["partition"],
+              nemesis_interval=1.0)
+    w = res["workload"]
+    assert w["valid?"] is True, w
+    assert w["lost-count"] == 0
+
+
+def test_cpp_lin_kv_proxy(cpp_bins):
+    res = run("lin-kv", "lin_kv_proxy", cpp_bins, node_count=2,
+              time_limit=3.0)
+    w = res["workload"]
+    assert w["valid?"] is True, w
+    assert w["key-count"] > 0
