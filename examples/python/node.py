@@ -114,13 +114,20 @@ class Node:
             self._next_msg_id += 1
             return self._next_msg_id
 
-    def rpc(self, dest, body, callback):
+    def rpc(self, dest, body, callback, timeout=10.0):
         """Async RPC: callback(body) is invoked with the reply body on a
         dispatch thread WITHOUT the node lock held; callbacks that touch
-        node state should take ``node.lock`` themselves."""
+        node state should take ``node.lock`` themselves. Callbacks whose
+        reply never arrives (lost messages, partitions) are dropped after
+        ``timeout`` seconds — otherwise every heartbeat into a partition
+        would leak an entry forever."""
         msg_id = self.new_msg_id()
+        now = time.monotonic()
         with self._cb_lock:
-            self.callbacks[msg_id] = callback
+            self.callbacks[msg_id] = (callback, now + timeout)
+            if len(self.callbacks) > 512:
+                self.callbacks = {m: (cb, dl) for m, (cb, dl)
+                                  in self.callbacks.items() if dl > now}
         body = dict(body)
         body["msg_id"] = msg_id
         self.send(dest, body)
@@ -145,11 +152,14 @@ class Node:
 
     # --- API --------------------------------------------------------------
 
-    def on(self, type_):
-        """Decorator: register a handler for a message type."""
-        def register(fn):
-            self.handlers[type_] = fn
-            return fn
+    def on(self, type_, fn=None):
+        """Register a handler: decorator form ``@node.on("echo")`` or
+        direct form ``node.on("echo", handler)``."""
+        def register(f):
+            self.handlers[type_] = f
+            return f
+        if fn is not None:
+            return register(fn)
         return register
 
     def every(self, interval_s):
@@ -176,10 +186,10 @@ class Node:
         in_reply_to = body.get("in_reply_to")
         if in_reply_to is not None:
             with self._cb_lock:
-                cb = self.callbacks.pop(in_reply_to, None)
-            if cb is not None:
+                entry = self.callbacks.pop(in_reply_to, None)
+            if entry is not None:
                 try:
-                    cb(body)
+                    entry[0](body)
                 except Exception as e:
                     self.log(f"callback error: {e!r}")
             return

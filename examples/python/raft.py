@@ -1,0 +1,370 @@
+#!/usr/bin/env python3
+"""Raft consensus node for the process runtime: a linearizable KV store
+behind the lin-kv workload, written against the bundled node SDK.
+
+The canonical process-runtime reference implementation — the role of the
+reference's demo/python/raft.py (elections :274-343, log replication
+:391-445, commit via median match index :382-389, leader proxying
+:552-571). Written from scratch on this SDK's threading model: all state
+mutations run under node.lock (handlers and timers hold it; RPC
+callbacks take it explicitly).
+
+Usage: --bin examples/python/raft.py with the lin-kv workload.
+"""
+
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from node import Node, RPCError  # noqa: E402
+
+ELECTION_MIN_S = 0.30
+ELECTION_JITTER_S = 0.30
+HEARTBEAT_S = 0.08
+STEP_DOWN_S = 1.0   # leader steps down without majority contact this long
+
+node = Node()
+
+
+class Log:
+    """1-indexed log of entries {term, op} (op None for the init entry),
+    like the reference's 1-indexed Log (raft.py:114-156)."""
+
+    def __init__(self):
+        self.entries = [{"term": 0, "op": None}]
+
+    def __len__(self):
+        return len(self.entries)
+
+    def get(self, i):
+        if i < 1:
+            raise IndexError(f"log indices are 1-based, got {i}")
+        return self.entries[i - 1]
+
+    def append(self, *entries):
+        self.entries.extend(entries)
+
+    def last_term(self):
+        return self.entries[-1]["term"]
+
+    def from_index(self, i):
+        return self.entries[i - 1:]
+
+    def truncate(self, length):
+        del self.entries[length:]
+
+
+class Raft:
+    def __init__(self):
+        self.term = 0
+        self.voted_for = None
+        self.role = "follower"
+        self.log = Log()
+        self.commit_index = 1
+        self.last_applied = 1
+        self.leader = None          # leader hint for proxying
+        self.kv = {}
+        self.votes = set()
+        self.next_index = {}
+        self.match_index = {}
+        self.election_deadline = time.monotonic() + self._timeout()
+        self.last_acks = {}         # peer -> last reply time (any kind)
+        self.last_replication = 0.0
+        # client requests waiting for their log entry to commit:
+        # log index -> (term, original message)
+        self.waiting = {}
+
+    @staticmethod
+    def _timeout():
+        return ELECTION_MIN_S + random.random() * ELECTION_JITTER_S
+
+    def reset_election_deadline(self):
+        self.election_deadline = time.monotonic() + self._timeout()
+
+    # --- role transitions -------------------------------------------------
+
+    def advance_term(self, term):
+        if term < self.term:
+            raise RuntimeError("terms never go backwards")
+        self.term = term
+        self.voted_for = None
+
+    def become_follower(self):
+        self.role = "follower"
+        self.votes = set()
+        self.fail_waiting()
+        self.reset_election_deadline()
+        node.log(f"became follower in term {self.term}")
+
+    def become_candidate(self):
+        self.role = "candidate"
+        self.advance_term(self.term + 1)
+        self.voted_for = node.node_id
+        self.votes = {node.node_id}
+        self.leader = None
+        self.reset_election_deadline()
+        node.log(f"became candidate in term {self.term}")
+        self.request_votes()
+
+    def become_leader(self):
+        self.role = "leader"
+        self.leader = None
+        self.next_index = {p: len(self.log) + 1
+                           for p in node.other_node_ids()}
+        self.match_index = {p: 0 for p in node.other_node_ids()}
+        self.last_acks = {p: time.monotonic()
+                          for p in node.other_node_ids()}
+        self.last_replication = 0.0
+        node.log(f"became leader in term {self.term}")
+
+    def fail_waiting(self):
+        """A deposed leader fails its in-flight client requests with an
+        indefinite error (they may still commit later)."""
+        for idx, (term, msg) in list(self.waiting.items()):
+            node.reply_error(msg, RPCError(13,
+                                           "leadership lost; outcome "
+                                           "unknown"))
+        self.waiting = {}
+
+    # --- elections --------------------------------------------------------
+
+    def request_votes(self):
+        term = self.term
+
+        def on_reply(body):
+            with node.lock:
+                self.maybe_step_down(body["term"])
+                if (self.role == "candidate" and self.term == term
+                        and body["term"] == term
+                        and body.get("vote_granted")):
+                    self.votes.add(body["__src"])
+                    if len(self.votes) * 2 > len(node.node_ids):
+                        self.become_leader()
+
+        for peer in node.other_node_ids():
+            self._rpc_with_src(peer, {
+                "type": "request_vote",
+                "term": term,
+                "candidate_id": node.node_id,
+                "last_log_index": len(self.log),
+                "last_log_term": self.log.last_term(),
+            }, on_reply)
+
+    def _rpc_with_src(self, dest, body, cb):
+        def wrapped(reply):
+            reply = dict(reply)
+            reply["__src"] = dest
+            cb(reply)
+        node.rpc(dest, body, wrapped)
+
+    def maybe_step_down(self, remote_term):
+        if remote_term > self.term:
+            self.advance_term(remote_term)
+            if self.role != "follower":
+                self.become_follower()
+
+    # --- replication ------------------------------------------------------
+
+    def replicate(self, force=False):
+        if self.role != "leader":
+            return
+        now = time.monotonic()
+        if not force and now - self.last_replication < HEARTBEAT_S:
+            return
+        self.last_replication = now
+        term = self.term
+        for peer in node.other_node_ids():
+            ni = self.next_index[peer]
+            entries = self.log.from_index(ni)[:16]
+
+            def on_reply(body, peer=peer, ni=ni, n=len(entries)):
+                with node.lock:
+                    self.last_acks[peer] = time.monotonic()
+                    self.maybe_step_down(body["term"])
+                    if self.role != "leader" or self.term != term:
+                        return
+                    if body.get("success"):
+                        self.next_index[peer] = max(
+                            self.next_index[peer], ni + n)
+                        self.match_index[peer] = max(
+                            self.match_index[peer], ni + n - 1)
+                        self.advance_commit()
+                    else:
+                        self.next_index[peer] = max(1,
+                                                    self.next_index[peer]
+                                                    - 1)
+
+            self._rpc_with_src(peer, {
+                "type": "append_entries",
+                "term": term,
+                "leader_id": node.node_id,
+                "prev_log_index": ni - 1,
+                "prev_log_term": (self.log.get(ni - 1)["term"]
+                                  if ni > 1 else 0),
+                "entries": entries,
+                "leader_commit": self.commit_index,
+            }, on_reply)
+
+    def advance_commit(self):
+        """Median match index, current term only (raft.py:382-389)."""
+        if self.role != "leader":
+            return
+        matches = sorted(list(self.match_index.values())
+                         + [len(self.log)])
+        n = matches[(len(matches) - 1) // 2]
+        if n > self.commit_index and self.log.get(n)["term"] == self.term:
+            self.commit_index = n
+            self.apply_committed()
+
+    def apply_committed(self):
+        while self.last_applied < self.commit_index:
+            self.last_applied += 1
+            entry = self.log.get(self.last_applied)
+            op = entry["op"]
+            if op is None:
+                continue
+            reply = self.apply_op(op)
+            waiter = self.waiting.pop(self.last_applied, None)
+            if waiter is not None and self.role == "leader":
+                term, msg = waiter
+                if isinstance(reply, RPCError):
+                    node.reply_error(msg, reply)
+                else:
+                    node.reply(msg, reply)
+
+    def apply_op(self, op):
+        t = op["type"]
+        k = str(op["key"])
+        if t == "read":
+            if k not in self.kv:
+                return RPCError.key_does_not_exist(f"key {k!r} not found")
+            return {"type": "read_ok", "value": self.kv[k]}
+        if t == "write":
+            self.kv[k] = op["value"]
+            return {"type": "write_ok"}
+        if t == "cas":
+            if k not in self.kv:
+                return RPCError.key_does_not_exist(f"key {k!r} not found")
+            if self.kv[k] != op["from"]:
+                return RPCError.precondition_failed(
+                    f"expected {op['from']!r} but had {self.kv[k]!r}")
+            self.kv[k] = op["to"]
+            return {"type": "cas_ok"}
+        return RPCError(12, f"unknown op type {t!r}")
+
+
+raft = Raft()
+
+
+# --- message handlers -----------------------------------------------------
+
+@node.on("request_vote")
+def request_vote(msg):
+    b = msg["body"]
+    raft.maybe_step_down(b["term"])
+    grant = False
+    if (b["term"] == raft.term
+            and raft.voted_for in (None, b["candidate_id"])
+            and (b["last_log_term"] > raft.log.last_term()
+                 or (b["last_log_term"] == raft.log.last_term()
+                     and b["last_log_index"] >= len(raft.log)))):
+        grant = True
+        raft.voted_for = b["candidate_id"]
+        raft.reset_election_deadline()
+    node.reply(msg, {"type": "request_vote_res", "term": raft.term,
+                     "vote_granted": grant})
+
+
+@node.on("append_entries")
+def append_entries(msg):
+    b = msg["body"]
+    raft.maybe_step_down(b["term"])
+    res = {"type": "append_entries_res", "term": raft.term,
+           "success": False}
+    if b["term"] < raft.term:
+        node.reply(msg, res)
+        return
+    # a current-term AppendEntries is from the legitimate leader
+    raft.leader = b["leader_id"]
+    if raft.role == "candidate":
+        raft.become_follower()
+    raft.reset_election_deadline()
+    prev_i = b["prev_log_index"]
+    if prev_i > 0 and (prev_i > len(raft.log)
+                       or raft.log.get(prev_i)["term"]
+                       != b["prev_log_term"]):
+        node.reply(msg, res)
+        return
+    # truncate conflicts, append new entries
+    for j, e in enumerate(b["entries"]):
+        i = prev_i + 1 + j
+        if i <= len(raft.log):
+            if raft.log.get(i)["term"] != e["term"]:
+                raft.log.truncate(i - 1)
+                raft.log.append(e)
+        else:
+            raft.log.append(e)
+    if b["leader_commit"] > raft.commit_index:
+        # Raft §5.3: bound by the last entry this AppendEntries verified,
+        # not the local log length (which may hold an unverified tail)
+        bound = prev_i + len(b["entries"])
+        raft.commit_index = max(raft.commit_index,
+                                min(b["leader_commit"], bound))
+        raft.apply_committed()
+    res["success"] = True
+    node.reply(msg, res)
+
+
+def client_op(msg):
+    if raft.role == "leader":
+        raft.log.append({"term": raft.term, "op": msg["body"]})
+        raft.waiting[len(raft.log)] = (raft.term, msg)
+        raft.replicate(force=True)
+    elif raft.leader is not None:
+        # proxy to the current leader (raft.py:552-571): re-send the
+        # client's body; the leader replies to us and we relay back
+        body = dict(msg["body"])
+
+        def relay(reply):
+            out = dict(reply)
+            out.pop("in_reply_to", None)
+            out["in_reply_to"] = msg["body"]["msg_id"]
+            node.send(msg["src"], out)
+
+        node.rpc(raft.leader, body, relay)
+    else:
+        node.reply_error(msg, RPCError.temporarily_unavailable(
+            "not a leader, and no known leader"))
+
+
+for t in ("read", "write", "cas"):
+    node.on(t, client_op)
+
+
+# --- timers ----------------------------------------------------------------
+
+@node.every(0.05)
+def election_tick():
+    now = time.monotonic()
+    if raft.role != "leader" and now >= raft.election_deadline:
+        raft.become_candidate()
+    elif raft.role == "leader":
+        # step down if we've lost contact with a majority (a stale
+        # leader in a minority partition must stop stringing clients
+        # along; the reference's step-down deadline plays this role)
+        recent = sum(1 for t in raft.last_acks.values()
+                     if now - t < STEP_DOWN_S)
+        if (recent + 1) * 2 <= len(node.node_ids):
+            node.log("stepping down: lost contact with majority")
+            raft.become_follower()
+
+
+@node.every(HEARTBEAT_S / 2)
+def replication_tick():
+    raft.replicate()
+
+
+if __name__ == "__main__":
+    node.run()

@@ -141,14 +141,15 @@ class Model:
 
 # generic error reply handling: error type code shared by all models
 TYPE_ERROR = 127
-# error body lane 0 = code; definite codes -> EV_FAIL, else EV_INFO
-_DEFINITE_CODES = jnp.array([1, 10, 11, 12, 14, 20, 21, 22, 30],
-                            dtype=jnp.int32)
+# error body lane 0 = code; definite codes -> EV_FAIL, else EV_INFO.
+# Plain tuple: a module-level jnp.array would initialize the accelerator
+# backend at import time.
+_DEFINITE_CODES = (1, 10, 11, 12, 14, 20, 21, 22, 30)
 
 
 def decode_error_reply(msg) -> Tuple[jnp.ndarray, jnp.ndarray]:
     code = msg[wire.BODY]
-    definite = jnp.any(_DEFINITE_CODES == code)
+    definite = jnp.any(jnp.array(_DEFINITE_CODES, dtype=jnp.int32) == code)
     etype = jnp.where(definite, EV_FAIL, EV_INFO)
     return etype, jnp.zeros((3,), dtype=jnp.int32)
 

@@ -99,3 +99,14 @@ def test_datomic_txn_multi_node_e2e():
     w = res["workload"]
     assert w["valid?"] is True, w
     assert w["txn-count"] > 10
+
+
+def test_raft_node_lin_kv_with_partitions_e2e():
+    """The canonical Raft demo config (reference doc/06-raft): lin-kv
+    over the bundled raft.py, partitions during the run."""
+    res = run("lin-kv", "raft.py", node_count=3, concurrency=6,
+              rate=20.0, time_limit=10.0, nemesis=["partition"],
+              nemesis_interval=2.5, recovery_time=2.0, seed=7)
+    w = res["workload"]
+    assert w["valid?"] is True, w
+    assert res["stats"]["ok-count"] > 30
