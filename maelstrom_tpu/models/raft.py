@@ -503,6 +503,37 @@ class RaftModel(Model):
 
         return jax.vmap(per_peer)(peers)
 
+    # --- on-device invariants --------------------------------------------
+
+    def invariants(self, node_state: RaftRow, cfg, params):
+        """Election safety + committed-log agreement, checked every tick
+        for every instance (not just the recorded sample):
+
+        - at most one leader per term
+        - any two nodes' committed log prefixes agree (terms and bodies)
+
+        These catch the double-vote and no-term-guard corruptions
+        on-device even in instances whose histories are never decoded.
+        """
+        n = cfg.n_nodes
+        leaders = node_state.role == 2                     # [N]
+        same_term = node_state.term[:, None] == node_state.term[None, :]
+        pair = (leaders[:, None] & leaders[None, :] & same_term
+                & ~jnp.eye(n, dtype=bool))
+        two_leaders = jnp.any(pair)
+
+        commit = node_state.commit_idx                     # [N]
+        m = jnp.minimum(commit[:, None], commit[None, :])  # [N, N]
+        in_prefix = (jnp.arange(self.log_cap)[None, None, :]
+                     < m[:, :, None])
+        lt = node_state.log_term                           # [N, LOGN]
+        term_diff = (lt[:, None, :] != lt[None, :, :]) & in_prefix
+        lb = node_state.log_body                           # [N, LOGN, E]
+        body_diff = jnp.any(lb[:, None] != lb[None, :], axis=-1) \
+            & in_prefix
+        log_mismatch = jnp.any(term_diff | body_diff)
+        return two_leaders | log_mismatch
+
     # --- client side ------------------------------------------------------
 
     def sample_op(self, key, uniq, cfg, params):

@@ -157,8 +157,16 @@ def run_tpu_test(model: Model, opts: Optional[Dict[str, Any]] = None,
                   if r.get("valid?") in (True, "unknown"))
     stats = carry.stats
     total_msgs = int(stats.delivered)
+    violations = np.asarray(carry.violations)
+    n_violating = int((violations > 0).sum())
     results = {
-        "valid?": n_valid == len(per_instance),
+        "valid?": (n_valid == len(per_instance)) and n_violating == 0,
+        "invariants": {
+            "violating-instances": n_violating,
+            "violating-instance-ids": np.nonzero(violations)[0][:16]
+            .tolist(),
+            "total-violation-ticks": int(violations.sum()),
+        },
         "instance-count": sim.n_instances,
         "checked-instances": len(per_instance),
         "valid-instances": n_valid,

@@ -113,6 +113,14 @@ class Model:
         """Per-tick hook (timers, gossip). Default: no-op."""
         return row, jnp.zeros((self.tick_out, cfg.lanes), dtype=jnp.int32)
 
+    def invariants(self, node_state, cfg: NetConfig, params) -> jnp.ndarray:
+        """Cheap whole-cluster safety invariants, evaluated on-device every
+        tick for EVERY instance (SURVEY §7: vectorized invariants
+        everywhere, full checkers on samples). ``node_state`` is the
+        instance's full per-node state pytree ([N, ...] leading axis).
+        Returns a scalar bool: True = violated this tick."""
+        return jnp.bool_(False)
+
     # --- client side ------------------------------------------------------
 
     def sample_op(self, key, uniq, cfg: NetConfig, params) -> jnp.ndarray:
@@ -374,6 +382,8 @@ class Carry(NamedTuple):
     node_state: Any            # pytree [I, N, ...]
     client_state: ClientState  # arrays [I, C...]
     stats: NetStats            # scalars (summed over instances)
+    violations: jnp.ndarray    # [I] int32: ticks each instance violated
+                               # a model invariant (0 = clean)
     key: jnp.ndarray
 
 
@@ -397,6 +407,7 @@ def init_carry(model: Model, sim: SimConfig, seed: int, params) -> Carry:
             lambda a: jnp.broadcast_to(a, (I,) + a.shape),
             ClientState.init(sim.client.n_clients)),
         stats=NetStats.zeros(),
+        violations=jnp.zeros((I,), jnp.int32),
         key=key,
     )
 
@@ -445,8 +456,13 @@ def make_tick_fn(model: Model, sim: SimConfig, params) -> Callable:
             dropped_loss=carry.stats.dropped_loss + jnp.sum(n_lost),
             dropped_overflow=carry.stats.dropped_overflow + jnp.sum(n_ovf),
         )
+        violated = jax.vmap(
+            lambda st: model.invariants(st, cfg, params))(node_state)
         new_carry = Carry(pool=pool, node_state=node_state,
-                          client_state=client_state, stats=stats, key=key)
+                          client_state=client_state, stats=stats,
+                          violations=carry.violations
+                          + violated.astype(jnp.int32),
+                          key=key)
         return new_carry, events[:sim.record_instances]
 
     return tick_fn

@@ -51,8 +51,10 @@ def empty_msgs(n: int, body_lanes: int) -> jnp.ndarray:
 
 
 def make_msg(src, dest, type_, msg_id=-1, reply_to=-1, body=(),
-             body_lanes: int = 6):
-    """Build one message row (traced-friendly)."""
+             body_lanes: int = 6, origin=None):
+    """Build one message row (traced-friendly). ``origin`` defaults to
+    ``src``; the runtime's node phase re-stamps it with the emitting
+    node's index anyway."""
     m = jnp.zeros((lanes(body_lanes),), dtype=jnp.int32)
     m = m.at[VALID].set(1)
     m = m.at[SRC].set(src)
@@ -60,6 +62,7 @@ def make_msg(src, dest, type_, msg_id=-1, reply_to=-1, body=(),
     m = m.at[TYPE].set(type_)
     m = m.at[MSGID].set(msg_id)
     m = m.at[REPLYTO].set(reply_to)
+    m = m.at[ORIGIN].set(src if origin is None else origin)
     for i, b in enumerate(body):
         m = m.at[BODY + i].set(b)
     return m

@@ -51,3 +51,18 @@ def test_raft_correct_same_config_as_bug_hunt():
     mutants — otherwise the bug tests prove nothing."""
     res = run_tpu_test(RaftModel(n_nodes_hint=3), BUG_OPTS)
     assert res["valid?"] is True, res["instances"]
+
+
+def test_on_device_invariants_catch_double_vote_fleet_wide():
+    """Election-safety + committed-log-agreement run on EVERY instance
+    on-device; detection rate beats history sampling by an order of
+    magnitude (SURVEY §7: cheap vectorized invariants everywhere)."""
+    opts = dict(BUG_OPTS, n_instances=32, record_instances=4)
+    res = run_tpu_test(RaftDoubleVote(n_nodes_hint=3), opts)
+    inv = res["invariants"]
+    assert inv["violating-instances"] >= 3, inv
+    assert res["valid?"] is False
+
+    res_ok = run_tpu_test(RaftModel(n_nodes_hint=3), opts)
+    assert res_ok["invariants"]["violating-instances"] == 0
+    assert res_ok["valid?"] is True, res_ok["instances"]
