@@ -209,14 +209,25 @@ class Node:
 
     def run(self):
         """Main loop: one thread per incoming message keeps slow handlers
-        from blocking the pipe; the node lock serializes state access."""
+        from blocking the pipe; the node lock serializes state access.
+        On stdin EOF, in-flight handlers get a brief grace period so
+        their replies still reach stdout before the process exits."""
+        threads = []
         for line in sys.stdin:
             line = line.strip()
             if not line:
                 continue
             msg = json.loads(line)
-            threading.Thread(target=self._dispatch, args=(msg,),
-                             daemon=True).start()
+            t = threading.Thread(target=self._dispatch, args=(msg,),
+                                 daemon=True)
+            t.start()
+            threads.append(t)
+            if len(threads) > 128:
+                threads = [t for t in threads if t.is_alive()]
+        # shared deadline: total grace is ~1s, not 1s per thread
+        deadline = time.monotonic() + 1.0
+        for t in threads:
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
 
 
 class KV:

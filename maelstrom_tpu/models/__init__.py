@@ -13,9 +13,12 @@ def get_model(workload: str, node_count: int, topology: str = "grid"):
     from .echo import EchoModel
     from .raft import RaftModel
     from .raft_buggy import BUGGY_MODELS
+    from .unique_ids import UniqueIdsModel
 
     if workload == "echo":
         return EchoModel()
+    if workload == "unique-ids":
+        return UniqueIdsModel()
     if workload == "broadcast":
         return BroadcastModel(topology)
     if workload == "g-set":

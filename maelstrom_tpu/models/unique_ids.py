@@ -1,0 +1,64 @@
+"""Vectorized unique-ids model: flake-style ids ``node_idx * 2^20 +
+counter`` — coordination-free uniqueness (the TPU face of the unique-ids
+workload; reference src/maelstrom/workload/unique_ids.clj and
+demo/clojure/flake_ids.clj)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..tpu import wire
+from ..tpu.runtime import EV_INFO, EV_OK, Model
+
+TYPE_GEN = 1
+TYPE_GEN_OK = 2
+
+F_GENERATE = 1
+
+
+class UniqueIdsModel(Model):
+    name = "unique-ids"
+    body_lanes = 1
+    max_out = 1
+    tick_out = 0
+    idempotent_fs = ()
+
+    def init_row(self, n_nodes, node_idx, key, params):
+        return jnp.int32(0)     # per-node counter
+
+    def handle(self, row, node_idx, msg, t, key, cfg, params):
+        is_gen = msg[wire.TYPE] == TYPE_GEN
+        row = jnp.where(is_gen, row + 1, row)
+        out = jnp.zeros((1, cfg.lanes), dtype=jnp.int32)
+        out = out.at[0, wire.VALID].set(jnp.where(is_gen, 1, 0))
+        out = out.at[0, wire.DEST].set(msg[wire.SRC])
+        out = out.at[0, wire.TYPE].set(TYPE_GEN_OK)
+        out = out.at[0, wire.REPLYTO].set(msg[wire.MSGID])
+        out = out.at[0, wire.BODY].set(node_idx * (1 << 20) + row)
+        return row, out
+
+    def sample_op(self, key, uniq, cfg, params):
+        return jnp.array([F_GENERATE, 0, 0, 0], jnp.int32)
+
+    def encode_request(self, op, msg_id, client_idx, key, cfg, params):
+        dest = jax.random.randint(key, (), 0, cfg.n_nodes, dtype=jnp.int32)
+        return wire.make_msg(src=0, dest=dest, type_=TYPE_GEN,
+                             msg_id=msg_id, body_lanes=self.body_lanes)
+
+    def decode_reply(self, op, msg, cfg, params):
+        ok = msg[wire.TYPE] == TYPE_GEN_OK
+        etype = jnp.where(ok, EV_OK, EV_INFO)
+        value = jnp.array([0, 0, 0], jnp.int32).at[0].set(msg[wire.BODY])
+        return etype, value
+
+    def invoke_record(self, f, a, b, c):
+        return {"f": "generate", "value": None}
+
+    def complete_record(self, f, a, b, c, etype):
+        return {"f": "generate", "value": int(a) if etype == EV_OK
+                else None}
+
+    def checker(self):
+        from ..checkers.unique_ids import unique_ids_checker
+        return lambda history, opts: unique_ids_checker(history)

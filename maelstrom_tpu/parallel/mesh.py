@@ -49,7 +49,7 @@ def _run_sharded(model: Model, sim: SimConfig, mesh: Mesh, seeds, params):
     def shard_body(seed_shard, params_rep):
         carry, events = simulate(model, sim, seed_shard[0], params_rep)
         stats = jax.tree.map(lambda x: jax.lax.psum(x, AXIS), carry.stats)
-        return stats, events
+        return stats, carry.violations, events
 
     # zero-initialized carry components are unvaried constants while the
     # seed-derived ones vary per shard; check_vma would reject the scan
@@ -57,19 +57,21 @@ def _run_sharded(model: Model, sim: SimConfig, mesh: Mesh, seeds, params):
     return jax.shard_map(
         shard_body, mesh=mesh,
         in_specs=(P(AXIS), P()),
-        out_specs=(P(), P(None, AXIS)),
+        out_specs=(P(), P(AXIS), P(None, AXIS)),
         check_vma=False,
     )(seeds, params)
 
 
 def run_sim_sharded(model: Model, sim: SimConfig, seed: int, params=None,
                     mesh: Optional[Mesh] = None
-                    ) -> Tuple[NetStats, jnp.ndarray]:
+                    ) -> Tuple[NetStats, jnp.ndarray, jnp.ndarray]:
     """Run ``n_devices`` shards of ``sim`` (each simulating
     ``sim.n_instances`` clusters) across the mesh.
 
-    Returns (fleet-wide NetStats summed over devices, events
-    [T, R * n_devices, C, 2, EV_LANES]).
+    Returns (fleet-wide NetStats summed over devices, per-instance
+    on-device invariant-violation tick counts
+    [n_instances * n_devices], events [T, R * n_devices, C, 2,
+    EV_LANES]).
     """
     mesh = mesh or make_mesh()
     n = mesh.devices.size

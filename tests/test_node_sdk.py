@@ -1,0 +1,80 @@
+"""Unit tests for the bundled node SDKs with injected pipes — the
+reference's demo-library test pattern (demo/go/node_test.go:19-37 injects
+fake Stdin/Stdout; SURVEY §4)."""
+
+import json
+import os
+import subprocess
+import sys
+
+from conftest import REPO
+
+PY_DIR = os.path.join(REPO, "examples", "python")
+
+
+def drive(script: str, messages):
+    """Run a node script, feed it JSON messages, return its stdout
+    replies keyed by in_reply_to (dispatch is threaded, so stdout order
+    is nondeterministic)."""
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(PY_DIR, script)],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True)
+    stdin = "\n".join(json.dumps(m) for m in messages) + "\n"
+    try:
+        out, err = proc.communicate(stdin, timeout=10)
+    finally:
+        proc.kill()
+    replies = {}
+    for line in out.splitlines():
+        if line.strip():
+            m = json.loads(line)
+            replies[m["body"].get("in_reply_to")] = m
+    return replies
+
+
+def msg(src, dest, body):
+    return {"id": 0, "src": src, "dest": dest, "body": body}
+
+
+INIT = msg("c0", "n0", {"type": "init", "msg_id": 1, "node_id": "n0",
+                        "node_ids": ["n0", "n1"]})
+
+
+def test_sdk_init_handshake():
+    out = drive("echo.py", [INIT])
+    m = out[1]
+    assert m["body"]["type"] == "init_ok"
+    assert m["src"] == "n0" and m["dest"] == "c0"
+
+
+def test_sdk_echo_roundtrip():
+    out = drive("echo.py", [
+        INIT,
+        msg("c0", "n0", {"type": "echo", "msg_id": 2,
+                         "echo": {"nested": [1, None, "x"]}}),
+    ])
+    body = out[2]["body"]
+    assert body["type"] == "echo_ok"
+    assert body["echo"] == {"nested": [1, None, "x"]}
+
+
+def test_sdk_unknown_type_replies_not_supported():
+    out = drive("echo.py", [
+        INIT,
+        msg("c0", "n0", {"type": "zorp", "msg_id": 3}),
+    ])
+    body = out[3]["body"]
+    assert body["type"] == "error"
+    assert body["code"] == 10
+
+
+def test_sdk_handler_exception_becomes_crash_error():
+    # broadcast with a missing field forces a handler error
+    out = drive("broadcast.py", [
+        INIT,
+        msg("c0", "n0", {"type": "broadcast", "msg_id": 4}),  # no message
+    ])
+    body = out[4]["body"]
+    assert body["type"] == "error"
+    assert body["code"] == 13

@@ -22,9 +22,11 @@ def test_echo_sharded_over_8_devices():
                 latency=5.0, seed=3)
     sim = make_sim_config(model, opts)
     mesh = make_mesh()
-    stats, events = run_sim_sharded(model, sim, seed=3, mesh=mesh)
+    stats, violations, events = run_sim_sharded(model, sim, seed=3, mesh=mesh)
     # events gathered across shards: R_total = 2 * 8
     assert events.shape[1] == 16
+    # violations cover ALL instances (4 per shard x 8), not just recorded
+    assert violations.shape == (32,) and int(violations.sum()) == 0
     assert int(stats.delivered) > 0
     # every shard produced distinct traffic (decorrelated seeds)
     hists = events_to_histories(model, np.asarray(events))
@@ -39,7 +41,7 @@ def test_raft_sharded_runs_and_checks():
                 record_instances=1, time_limit=1.5, rate=20.0,
                 latency=5.0, rpc_timeout=0.8, recovery_time=0.2, seed=5)
     sim = make_sim_config(model, opts)
-    stats, events = run_sim_sharded(model, sim, seed=5)
+    stats, violations, events = run_sim_sharded(model, sim, seed=5)
     hists = events_to_histories(model, np.asarray(events),
                                 sim.client.final_start)
     assert len(hists) == 8

@@ -37,3 +37,12 @@ def test_tpu_echo_deterministic():
     r1 = run_tpu_test(EchoModel(), opts)
     r2 = run_tpu_test(EchoModel(), opts)
     assert r1["net"] == r2["net"]
+
+
+def test_tpu_unique_ids():
+    from maelstrom_tpu.models.unique_ids import UniqueIdsModel
+    res = run_tpu_test(UniqueIdsModel(), dict(
+        node_count=3, concurrency=2, n_instances=8, record_instances=4,
+        time_limit=1.0, rate=100.0, latency=5.0, seed=9))
+    assert res["valid?"] is True, res["instances"]
+    assert res["instances"][0]["acknowledged-count"] > 10
