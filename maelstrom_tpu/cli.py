@@ -56,6 +56,11 @@ def add_test_options(p: argparse.ArgumentParser):
     p.add_argument("--nemesis", action="append", default=[],
                    choices=["partition"])
     p.add_argument("--nemesis-interval", type=float, default=10.0)
+    p.add_argument("--nemesis-kind", default="random-halves",
+                   choices=["random-halves", "isolated-node",
+                            "majorities-ring"],
+                   help="partition grudge shape (TPU runtime; the "
+                        "process runtime mixes all kinds randomly)")
     p.add_argument("--topology", default="grid",
                    choices=["grid", "line", "total", "tree2", "tree3",
                             "tree4"])
@@ -130,6 +135,7 @@ def cmd_test(args) -> int:
             latency=args.latency, latency_dist=args.latency_dist,
             p_loss=args.p_loss, nemesis=args.nemesis,
             nemesis_interval=args.nemesis_interval,
+            nemesis_kind=args.nemesis_kind,
             availability=_availability(args.availability),
             n_instances=args.n_instances,
             record_instances=args.record_instances,

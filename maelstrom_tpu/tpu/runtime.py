@@ -311,6 +311,15 @@ def partition_matrix(nem: NemesisConfig, cfg: NetConfig, t, instance_key
         ids = jnp.arange(NT)
         isolated = ids == victim
         blocked = isolated[:, None] ^ isolated[None, :]
+    elif nem.kind == "majorities-ring":
+        # each node sees a distinct majority around a random ring
+        # (nemesis.py grudge_majorities_ring semantics)
+        perm = jax.random.permutation(key, n)
+        pos = jnp.zeros((NT,), jnp.int32).at[perm].set(jnp.arange(n))
+        maj = n // 2 + 1
+        dist = jnp.mod(pos[None, :] - pos[:, None], n)   # [dst, src]
+        visible = (dist <= maj // 2) | (dist >= n - (maj - 1) // 2)
+        blocked = ~visible
     else:  # random-halves
         side = jax.random.bernoulli(key, 0.5, (NT,))
         blocked = side[:, None] != side[None, :]

@@ -66,3 +66,13 @@ def test_on_device_invariants_catch_double_vote_fleet_wide():
     res_ok = run_tpu_test(RaftModel(n_nodes_hint=3), opts)
     assert res_ok["invariants"]["violating-instances"] == 0
     assert res_ok["valid?"] is True, res_ok["instances"]
+
+
+def test_raft_majorities_ring_nemesis():
+    res = run_tpu_test(RaftModel(n_nodes_hint=5), dict(
+        node_count=5, concurrency=3, n_instances=4, record_instances=4,
+        time_limit=3.0, rate=20.0, latency=5.0, rpc_timeout=1.0,
+        nemesis=["partition"], nemesis_kind="majorities-ring",
+        nemesis_interval=0.4, recovery_time=0.5, seed=3))
+    assert res["net"]["dropped-partition"] > 0
+    assert res["valid?"] is True, res["instances"]
