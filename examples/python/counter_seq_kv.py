@@ -1,0 +1,67 @@
+#!/usr/bin/env python3
+"""G-counter/PN-counter node over the built-in seq-kv service.
+
+Adds are CAS retry loops against a single counter key. seq-kv is only
+sequentially consistent, so a plain read may be stale; before reading we
+write a per-node sync key, which forces our session's watermark to the
+newest state (mutations always apply to the freshest state in the
+Sequential wrapper) — the classic recency trick from the reference's
+CRDT chapter (doc/04-crdts, seq-kv counter).
+
+The role of the reference's demo/clojure/gcounter.clj.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from node import KV, Node, RPCError  # noqa: E402
+
+node = Node()
+kv = KV(node, KV.SEQ, timeout=2.0)
+
+KEY = "counter"
+
+
+def init_counter():
+    """First node seeds the key before any client op: a concurrent
+    cas-create race between nodes could lose an add."""
+    if node.node_ids and node.node_id == node.node_ids[0]:
+        kv.write(KEY, 0)
+
+
+node.init_callbacks.append(init_counter)
+
+
+@node.on("add")
+def add(msg):
+    delta = msg["body"]["delta"]
+    while True:
+        cur = kv.read(KEY, default=None)
+        if cur is None:
+            try:
+                kv.cas(KEY, None, delta, create_if_not_exists=True)
+                break
+            except RPCError as e:
+                if e.code not in (20, 22):
+                    raise
+        else:
+            try:
+                kv.cas(KEY, cur, cur + delta)
+                break
+            except RPCError as e:
+                if e.code not in (20, 22):
+                    raise
+    node.reply(msg, {"type": "add_ok"})
+
+
+@node.on("read")
+def read(msg):
+    # force recency: a write bumps this session to the newest state
+    kv.write(f"sync-{node.node_id}", msg["body"].get("msg_id", 0))
+    value = kv.read(KEY, default=0)
+    node.reply(msg, {"type": "read_ok", "value": value})
+
+
+if __name__ == "__main__":
+    node.run()

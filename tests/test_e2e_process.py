@@ -110,3 +110,12 @@ def test_raft_node_lin_kv_with_partitions_e2e():
     w = res["workload"]
     assert w["valid?"] is True, w
     assert res["stats"]["ok-count"] > 30
+
+
+def test_counter_over_seq_kv_service_e2e():
+    """Exercises the Sequential consistency wrapper end-to-end: CAS retry
+    adds + the write-to-force-recency read trick (reference doc/04-crdts
+    seq-kv counter)."""
+    res = run("g-counter", "counter_seq_kv.py", node_count=3,
+              time_limit=3.0, recovery_time=1.0)
+    assert res["workload"]["valid?"] is True, res["workload"]
