@@ -1,0 +1,110 @@
+#!/usr/bin/env python3
+"""Multi-node kafka-style log over the built-in lin-kv service.
+
+Each key's log lives under ``log-<k>`` in lin-kv; appends are CAS retry
+loops, so offsets are consistent across nodes. Committed offsets live
+under ``commit-<k>`` with monotonic CAS. Linearizable storage makes the
+whole thing trivially free of lost/reordered writes — the multi-node
+counterpart of kafka_single.py (the role of the reference's
+demo/clojure/kafka.clj).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from node import KV, Node, RPCError  # noqa: E402
+
+node = Node()
+kv = KV(node, KV.LIN, timeout=2.0)
+
+
+def log_key(k):
+    return f"log-{k}"
+
+
+def register_key(k):
+    """Track the known key set so polls can discover keys a client has
+    never read (CAS retry on a shared registry key)."""
+    while True:
+        cur = kv.read("all-keys", default=None)
+        if cur is not None and k in cur:
+            return
+        new = sorted(set(cur or []) | {k})
+        try:
+            if cur is None:
+                kv.cas("all-keys", None, new, create_if_not_exists=True)
+            else:
+                kv.cas("all-keys", cur, new)
+            return
+        except RPCError as e:
+            if e.code not in (20, 22):
+                raise
+
+
+@node.on("send")
+def send(msg):
+    k = msg["body"]["key"]
+    v = msg["body"]["msg"]
+    register_key(k)
+    while True:
+        cur = kv.read(log_key(k), default=None)
+        new = (cur or []) + [v]
+        try:
+            if cur is None:
+                kv.cas(log_key(k), None, new, create_if_not_exists=True)
+            else:
+                kv.cas(log_key(k), cur, new)
+            break
+        except RPCError as e:
+            if e.code not in (20, 22):
+                raise
+    node.reply(msg, {"type": "send_ok", "offset": len(new) - 1})
+
+
+@node.on("poll")
+def poll(msg):
+    offsets = msg["body"].get("offsets") or {}
+    out = {}
+    for k in kv.read("all-keys", default=[]):
+        start = offsets.get(k, 0)
+        log = kv.read(log_key(k), default=[])
+        msgs = [[i, v] for i, v in
+                enumerate(log[start:start + 16], start)]
+        if msgs:
+            out[k] = msgs
+    node.reply(msg, {"type": "poll_ok", "msgs": out})
+
+
+@node.on("commit_offsets")
+def commit_offsets(msg):
+    for k, off in (msg["body"].get("offsets") or {}).items():
+        ck = f"commit-{k}"
+        while True:
+            cur = kv.read(ck, default=None)
+            if cur is not None and cur >= off:
+                break
+            try:
+                if cur is None:
+                    kv.cas(ck, None, off, create_if_not_exists=True)
+                else:
+                    kv.cas(ck, cur, off)
+                break
+            except RPCError as e:
+                if e.code not in (20, 22):
+                    raise
+    node.reply(msg, {"type": "commit_offsets_ok"})
+
+
+@node.on("list_committed_offsets")
+def list_committed_offsets(msg):
+    out = {}
+    for k in msg["body"].get("keys") or []:
+        v = kv.read(f"commit-{k}", default=None)
+        if v is not None:
+            out[k] = v
+    node.reply(msg, {"type": "list_committed_offsets_ok", "offsets": out})
+
+
+if __name__ == "__main__":
+    node.run()

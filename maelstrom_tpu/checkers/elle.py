@@ -135,13 +135,15 @@ class _Graph:
         return kinds
 
 
-def _classify_cycle(kinds: Set[str]) -> str:
+def _classify_cycle(kinds: Set[str], rw_edge_count: int = 2) -> str:
     rw = "rw" in kinds
     realtime_only = kinds <= {"realtime", "process"}
     if realtime_only:
         return "realtime"
     if rw:
-        return "G2-item"
+        # Elle distinguishes exactly-one-rw cycles (G-single, forbidden
+        # at snapshot isolation and above) from multi-rw G2-item
+        return "G-single" if rw_edge_count == 1 else "G2-item"
     if "wr" in kinds:
         return "G1c"
     return "G0"
@@ -323,7 +325,11 @@ def _finish(g: _Graph, committed: List[dict],
 
     for comp in g.sccs():
         kinds = g.cycle_kinds(comp)
-        cls = _classify_cycle(kinds)
+        cset = set(comp)
+        rw_edges = sum(1 for a in comp
+                       for b, ks in g.edges.get(a, {}).items()
+                       if b in cset and "rw" in ks)
+        cls = _classify_cycle(kinds, rw_edges)
         anomalies[cls].append(
             {"txns": [committed[i]["ops"] for i in comp[:6]],
              "edges": sorted(kinds)})

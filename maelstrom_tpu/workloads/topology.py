@@ -70,6 +70,7 @@ TOPOLOGIES = {
     "line": line,
     "grid": grid,
     "total": total,
+    "tree": tree(2),     # alias, matching the reference registry
     "tree2": tree(2),
     "tree3": tree(3),
     "tree4": tree(4),

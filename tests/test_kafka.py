@@ -59,3 +59,14 @@ def test_kafka_single_node_e2e():
     assert w["valid?"] is True, w
     assert w["send-count"] > 10
     assert w["poll-count"] > 10
+
+
+def test_kafka_multi_node_over_lin_kv_e2e():
+    bin_cmd = example_bin("kafka_lin_kv.py")
+    res = run_test("kafka", dict(
+        bin=bin_cmd[0], bin_args=bin_cmd[1:], node_count=3,
+        snapshot_store=False, time_limit=3.0, rate=20.0, concurrency=4,
+        recovery_time=0.5, seed=11))
+    w = res["workload"]
+    assert w["valid?"] is True, w
+    assert w["send-count"] > 5
