@@ -19,27 +19,42 @@ node = Node()
 kv = KV(node, KV.LIN, timeout=2.0)
 
 
+def cas_update(key, update, done=lambda cur: False):
+    """Linearizable read-modify-write retry loop: read key, stop if
+    ``done(cur)``, else CAS to ``update(cur)`` (creating if missing).
+    Returns the new value."""
+    while True:
+        cur = kv.read(key, default=None)
+        if done(cur):
+            return cur
+        new = update(cur)
+        try:
+            if cur is None:
+                kv.cas(key, None, new, create_if_not_exists=True)
+            else:
+                kv.cas(key, cur, new)
+            return new
+        except RPCError as e:
+            if e.code not in (20, 22):
+                raise
+
+
 def log_key(k):
     return f"log-{k}"
 
 
+# keys this node already registered (registry entries are never removed,
+# so a local hit skips a linearizable round trip on the send hot path)
+registered = set()
+
+
 def register_key(k):
-    """Track the known key set so polls can discover keys a client has
-    never read (CAS retry on a shared registry key)."""
-    while True:
-        cur = kv.read("all-keys", default=None)
-        if cur is not None and k in cur:
-            return
-        new = sorted(set(cur or []) | {k})
-        try:
-            if cur is None:
-                kv.cas("all-keys", None, new, create_if_not_exists=True)
-            else:
-                kv.cas("all-keys", cur, new)
-            return
-        except RPCError as e:
-            if e.code not in (20, 22):
-                raise
+    if k in registered:
+        return
+    cas_update("all-keys",
+               update=lambda cur: sorted(set(cur or []) | {k}),
+               done=lambda cur: cur is not None and k in cur)
+    registered.add(k)
 
 
 @node.on("send")
@@ -47,18 +62,7 @@ def send(msg):
     k = msg["body"]["key"]
     v = msg["body"]["msg"]
     register_key(k)
-    while True:
-        cur = kv.read(log_key(k), default=None)
-        new = (cur or []) + [v]
-        try:
-            if cur is None:
-                kv.cas(log_key(k), None, new, create_if_not_exists=True)
-            else:
-                kv.cas(log_key(k), cur, new)
-            break
-        except RPCError as e:
-            if e.code not in (20, 22):
-                raise
+    new = cas_update(log_key(k), update=lambda cur: (cur or []) + [v])
     node.reply(msg, {"type": "send_ok", "offset": len(new) - 1})
 
 
@@ -79,20 +83,10 @@ def poll(msg):
 @node.on("commit_offsets")
 def commit_offsets(msg):
     for k, off in (msg["body"].get("offsets") or {}).items():
-        ck = f"commit-{k}"
-        while True:
-            cur = kv.read(ck, default=None)
-            if cur is not None and cur >= off:
-                break
-            try:
-                if cur is None:
-                    kv.cas(ck, None, off, create_if_not_exists=True)
-                else:
-                    kv.cas(ck, cur, off)
-                break
-            except RPCError as e:
-                if e.code not in (20, 22):
-                    raise
+        cas_update(f"commit-{k}",
+                   update=lambda cur, off=off: off,
+                   done=lambda cur, off=off: (cur is not None
+                                              and cur >= off))
     node.reply(msg, {"type": "commit_offsets_ok"})
 
 

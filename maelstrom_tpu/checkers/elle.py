@@ -141,8 +141,11 @@ def _classify_cycle(kinds: Set[str], rw_edge_count: int = 2) -> str:
     if realtime_only:
         return "realtime"
     if rw:
-        # Elle distinguishes exactly-one-rw cycles (G-single, forbidden
-        # at snapshot isolation and above) from multi-rw G2-item
+        # Elle distinguishes exactly-one-rw cycles (G-single) from
+        # multi-rw G2-item. We count rw edges over the whole SCC, so an
+        # SCC merging several one-rw cycles is conservatively labeled
+        # G2-item; both classes are forbidden at the same models here,
+        # so only the label (not the verdict) is approximate.
         return "G-single" if rw_edge_count == 1 else "G2-item"
     if "wr" in kinds:
         return "G1c"

@@ -33,7 +33,8 @@ def kafka_checker(history) -> dict:
     max_polled = defaultdict(lambda: -1)
     last_poll_pos = defaultdict(lambda: -1)   # (process, key) -> offset
     commits = defaultdict(lambda: -1)         # (process, key) -> offset
-    server_commits = defaultdict(lambda: -1)  # key -> reported offset
+    # key -> (max reported offset, completion index of that report)
+    server_commits = defaultdict(lambda: (-1, -1))
 
     for p in pairs(history):
         inv, comp = p["invoke"], p["complete"]
@@ -86,13 +87,17 @@ def kafka_checker(history) -> dict:
                          "offsets": [commits[pk], off]})
                 commits[pk] = max(commits[pk], off)
         elif f == "list_committed_offsets":
-            # ...and globally on what the SERVER reports back
+            # ...and globally on what the SERVER reports back — but only
+            # between non-overlapping ops: a query that overlapped an
+            # earlier one in real time may legally have read first
             for k, off in (comp["value"] or {}).items():
-                if off < server_commits[k]:
+                prev_off, prev_end = server_commits[k]
+                if off < prev_off and inv["index"] > prev_end:
                     anomalies["commit-regression"].append(
                         {"key": k, "server-reported": True,
-                         "offsets": [server_commits[k], off]})
-                server_commits[k] = max(server_commits[k], off)
+                         "offsets": [prev_off, off]})
+                if off > prev_off:
+                    server_commits[k] = (off, comp["index"])
 
     # lost writes: acked offset below the key's max polled offset but
     # never observed by any poll

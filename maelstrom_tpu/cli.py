@@ -61,9 +61,9 @@ def add_test_options(p: argparse.ArgumentParser):
                             "majorities-ring"],
                    help="partition grudge shape (TPU runtime; the "
                         "process runtime mixes all kinds randomly)")
+    from .workloads.topology import TOPOLOGIES
     p.add_argument("--topology", default="grid",
-                   choices=["grid", "line", "total", "tree2", "tree3",
-                            "tree4"])
+                   choices=sorted(TOPOLOGIES))
     p.add_argument("--availability", default=None,
                    help="'total' or a fraction like 0.9")
     p.add_argument("--key-count", type=int, default=None)
