@@ -1,0 +1,77 @@
+// Broadcast node in C++: topology-aware gossip with retries, so
+// broadcasts survive partitions (the role of demo/ruby/broadcast.rb's
+// retry loop, in the native SDK).
+#include <map>
+#include <set>
+#include <string>
+#include <vector>
+
+#include "maelstrom/node.hpp"
+
+using maelstrom::Message;
+using maelstrom::Node;
+using maelstrom::Value;
+
+int main() {
+  Node node;
+  std::set<int64_t> messages;
+  std::vector<std::string> neighbors;
+  // unacked gossip: (peer, message value)
+  std::set<std::pair<std::string, int64_t>> pending;
+
+  auto gossip = [&](int64_t m, const std::string& exclude) {
+    for (const auto& nbr : neighbors)
+      if (nbr != exclude) pending.insert({nbr, m});
+  };
+
+  node.on("topology", [&](const Message& msg) {
+    neighbors.clear();
+    const auto& topo = msg.body.at("topology").as_object();
+    auto it = topo.find(node.node_id);
+    if (it != topo.end())
+      for (const auto& n : it->second.as_array())
+        neighbors.push_back(n.as_string());
+    Value b;
+    b["type"] = "topology_ok";
+    node.reply(msg, b);
+  });
+
+  auto accept = [&](const Message& msg, const char* ok_type) {
+    int64_t m = msg.body.at("message").as_int();
+    if (messages.insert(m).second) gossip(m, msg.src);
+    Value b;
+    b["type"] = ok_type;
+    node.reply(msg, b);
+  };
+
+  node.on("broadcast",
+          [&](const Message& msg) { accept(msg, "broadcast_ok"); });
+  node.on("gossip",
+          [&](const Message& msg) { accept(msg, "gossip_ok"); });
+
+  node.on("read", [&](const Message& msg) {
+    maelstrom::json::Array arr;
+    for (int64_t m : messages) arr.push_back(Value(m));
+    Value b;
+    b["type"] = "read_ok";
+    b["messages"] = Value(arr);
+    node.reply(msg, b);
+  });
+
+  node.every(0.2, [&] {
+    // re-send every unacked gossip; an ack erases the pending entry
+    std::vector<std::pair<std::string, int64_t>> snapshot(
+        pending.begin(), pending.end());
+    for (const auto& pm : snapshot) {
+      Value b;
+      b["type"] = "gossip";
+      b["message"] = pm.second;
+      node.rpc(pm.first, b, [&node, &pending, pm](const Value&) {
+        node.with_lock([&] { pending.erase(pm); });
+      });
+    }
+  });
+
+  node.run();
+  return 0;
+}

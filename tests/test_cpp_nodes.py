@@ -52,3 +52,13 @@ def test_cpp_lin_kv_proxy(cpp_bins):
     w = res["workload"]
     assert w["valid?"] is True, w
     assert w["key-count"] > 0
+
+
+def test_cpp_broadcast_with_partitions(cpp_bins):
+    res = run("broadcast", "broadcast", cpp_bins, node_count=5,
+              topology="grid", time_limit=3.0, recovery_time=1.5,
+              nemesis=["partition"], nemesis_interval=1.0)
+    w = res["workload"]
+    assert w["valid?"] is True, w
+    assert w["lost-count"] == 0
+    assert w["acknowledged-count"] > 0
