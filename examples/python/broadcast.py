@@ -1,7 +1,10 @@
 #!/usr/bin/env python3
-"""Broadcast node: gossips messages along the topology with retries, so
-broadcasts survive partitions. The role of the reference's
-demo/ruby/broadcast.rb (retry loop) for the broadcast workload."""
+"""Broadcast node: gossips messages along the topology with batched,
+acknowledged retries, so broadcasts survive partitions while keeping
+msgs-per-op low (one gossip message per peer per retry tick carries ALL
+unacked values). The role of the reference's demo/ruby/broadcast.rb
+retry loop, plus the batching optimization its performance chapter works
+toward (doc/03-broadcast/02-performance.md)."""
 
 import os
 import sys
@@ -12,23 +15,38 @@ from node import Node  # noqa: E402
 node = Node()
 messages = set()
 neighbors = []
-# pending[(dest, msg)] until acked
-pending = set()
+# peer -> set of values not yet acknowledged by that peer
+pending = {}
 
 
 @node.on("topology")
 def topology(msg):
     global neighbors
     neighbors = msg["body"]["topology"].get(node.node_id, [])
+    for nbr in neighbors:
+        pending.setdefault(nbr, set())
     node.log(f"topology: neighbors = {neighbors}")
     node.reply(msg, {"type": "topology_ok"})
 
 
 def gossip(m, exclude):
     for nbr in neighbors:
-        if nbr == exclude:
+        if nbr != exclude:
+            pending.setdefault(nbr, set()).add(m)
+
+
+def flush():
+    """One batched gossip per peer carrying everything it hasn't acked."""
+    for dest, vals in pending.items():
+        if not vals:
             continue
-        pending.add((nbr, m))
+        batch = sorted(vals)
+
+        def on_ack(reply, dest=dest, batch=batch):
+            with node.lock:
+                pending.get(dest, set()).difference_update(batch)
+
+        node.rpc(dest, {"type": "gossip", "messages": batch}, on_ack)
 
 
 @node.on("broadcast")
@@ -37,15 +55,18 @@ def broadcast(msg):
     if m not in messages:
         messages.add(m)
         gossip(m, exclude=msg["src"])
+        flush()   # propagate immediately; the timer only covers losses
     node.reply(msg, {"type": "broadcast_ok"})
 
 
 @node.on("gossip")
 def handle_gossip(msg):
-    m = msg["body"]["message"]
-    if m not in messages:
-        messages.add(m)
+    new = set(msg["body"]["messages"]) - messages
+    messages.update(new)
+    for m in new:
         gossip(m, exclude=msg["src"])
+    if new:
+        flush()
     node.reply(msg, {"type": "gossip_ok"})
 
 
@@ -56,11 +77,7 @@ def read(msg):
 
 @node.every(0.2)
 def retry():
-    # re-send every unacked gossip; acks prune the pending set
-    for dest, m in list(pending):
-        def on_ack(reply, key=(dest, m)):
-            pending.discard(key)
-        node.rpc(dest, {"type": "gossip", "message": m}, on_ack)
+    flush()
 
 
 if __name__ == "__main__":
