@@ -123,3 +123,30 @@ def test_rw_register_wr_cycle():
     ]
     r = check_rw_register(h, "serializable")
     assert r["valid?"] is False
+
+
+def test_list_append_g_single_label():
+    # classic fractured read: T1 sees T2's append to key B but misses
+    # T2's append to key A (whose version order a third read pins) —
+    # wr T2->T1 plus rw T1->T2, a single-rw cycle -> G-single
+    h = [
+        {"process": 0, "type": "invoke", "f": "txn",
+         "value": [["append", 1, 1], ["append", 2, 1]], "index": 0,
+         "time": 0},
+        {"process": 1, "type": "invoke", "f": "txn",
+         "value": [["r", 1, None], ["r", 2, None]], "index": 1,
+         "time": 1},
+        {"process": 0, "type": "ok", "f": "txn",
+         "value": [["append", 1, 1], ["append", 2, 1]], "index": 2,
+         "time": 2},
+        {"process": 1, "type": "ok", "f": "txn",
+         "value": [["r", 1, []], ["r", 2, [1]]], "index": 3,
+         "time": 3},
+        {"process": 2, "type": "invoke", "f": "txn",
+         "value": [["r", 1, None]], "index": 4, "time": 4},
+        {"process": 2, "type": "ok", "f": "txn",
+         "value": [["r", 1, [1]]], "index": 5, "time": 5},
+    ]
+    r = check_list_append(h, "serializable")
+    assert r["valid?"] is False
+    assert "G-single" in r["anomalies"], r["anomaly-types"]
