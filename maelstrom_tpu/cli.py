@@ -166,9 +166,21 @@ DEMOS = [
                                      "recovery_time": 1.0}),
     ("g-counter", "pn_counter.py", {"node_count": 3,
                                     "recovery_time": 1.0}),
+    ("g-counter", "counter_seq_kv.py", {"node_count": 3,
+                                        "recovery_time": 1.0}),
     ("unique-ids", "unique_ids.py",
      {"node_count": 3, "availability": "total"}),
     ("lin-kv", "lin_kv_proxy.py", {"node_count": 2}),
+    ("lin-kv", "raft.py",
+     {"node_count": 3, "rate": 20.0, "nemesis": ["partition"],
+      "nemesis_interval": 3.0, "recovery_time": 2.0}),
+    ("txn-list-append", "txn_single.py", {"node_count": 1, "rate": 20.0}),
+    ("txn-list-append", "datomic_txn.py", {"node_count": 3,
+                                           "rate": 15.0}),
+    ("txn-rw-register", "txn_single.py", {"node_count": 1,
+                                          "rate": 20.0}),
+    ("kafka", "kafka_single.py", {"node_count": 1, "rate": 20.0}),
+    ("kafka", "kafka_lin_kv.py", {"node_count": 3, "rate": 15.0}),
 ]
 
 
