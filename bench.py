@@ -1,15 +1,23 @@
 """Benchmark: simulated network throughput of the TPU runtime.
 
-Runs the flagship vectorized Raft workload (512 concurrent 3-node
-clusters, partitions + loss enabled) for a fixed horizon on the available
-accelerator, timing the steady-state (post-compile) run, and prints ONE
-JSON line:
+Runs the flagship vectorized Raft workload (default 4096 concurrent
+3-node clusters, partitions + loss enabled) for a fixed horizon, timing
+the steady-state (post-compile) run, and prints ONE JSON line on stdout:
 
     {"metric": "simulated_msgs_per_sec", "value": N, "unit": "msgs/s",
-     "vs_baseline": N / 60000}
+     "vs_baseline": N / 60000, ...diagnostics...}
 
 Baseline: the reference's peak simulated-network throughput of ~60,000
 msgs/sec on a 48-way Xeon (reference README.md:39-42; BASELINE.md row 1).
+
+Hardening (round 2): JAX backend init can wedge forever on a flaky
+accelerator tunnel — even before user code runs (sitecustomize plugin
+registration). The parent process therefore does NOT import jax at all;
+it runs the measurement in child processes with hard deadlines and
+retries (a fresh process usually un-wedges an intermittent tunnel), and
+falls back to a pure-CPU child (tunnel gate env removed) so the driver
+always captures a nonzero number. All progress goes to stderr; stdout
+carries exactly one JSON line.
 """
 
 from __future__ import annotations
@@ -22,74 +30,153 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 BASELINE_MSGS_PER_SEC = 60_000.0
+TAG = "bench"
 
 
-def _arm_watchdog(seconds: int):
-    """If the accelerator tunnel is wedged, device init can hang forever;
-    emit a zero-valued metric line instead of hanging the driver."""
-    import signal
+# --------------------------------------------------------------------------
+# child: the actual measurement (runs under a parent-enforced deadline)
+# --------------------------------------------------------------------------
 
-    def bail(signum, frame):
-        print(json.dumps({
-            "metric": "simulated_msgs_per_sec", "value": 0.0,
-            "unit": "msgs/s", "vs_baseline": 0.0,
-            "error": f"watchdog: no result within {seconds}s "
-                     f"(accelerator unavailable?)"}), flush=True)
-        os._exit(3)
+def child_main() -> None:
+    from maelstrom_tpu.utils.driver_guard import log
 
-    signal.signal(signal.SIGALRM, bail)
-    signal.alarm(seconds)
-
-
-def main():
-    _arm_watchdog(int(os.environ.get("BENCH_WATCHDOG_S", 600)))
+    log(TAG, "phase: importing jax")
     import jax
+
+    log(TAG, f"phase: backend init (JAX_PLATFORMS="
+             f"{os.environ.get('JAX_PLATFORMS', '<unset>')})")
+    devs = jax.devices()
+    platform = devs[0].platform
+    log(TAG, f"phase: devices ok — {len(devs)} x {platform}")
 
     from maelstrom_tpu.models.raft import RaftModel
     from maelstrom_tpu.tpu.harness import make_sim_config
-    from maelstrom_tpu.tpu.runtime import run_sim
+    from maelstrom_tpu.tpu.runtime import init_carry, run_sim
+
+    on_cpu = platform == "cpu"
+    n_instances = int(os.environ.get(
+        "BENCH_INSTANCES", 64 if on_cpu else 4096))
+    sim_seconds = float(os.environ.get(
+        "BENCH_SIM_SECONDS", 1.0 if on_cpu else 2.0))
 
     model = RaftModel(n_nodes_hint=3, log_cap=64)
     opts = dict(node_count=3, concurrency=3,
-                n_instances=int(os.environ.get("BENCH_INSTANCES", 512)),
+                n_instances=n_instances,
                 record_instances=1,
-                time_limit=float(os.environ.get("BENCH_SIM_SECONDS", 2.0)),
+                time_limit=sim_seconds,
                 rate=30.0, latency=10.0, rpc_timeout=1.0,
                 nemesis=["partition"], nemesis_interval=0.4, p_loss=0.05,
                 recovery_time=0.3, seed=7)
     sim = make_sim_config(model, opts)
     params = model.make_params(sim.net.n_nodes)
 
-    # compile + warm-up
+    # memory accounting: device bytes per instance (carry) + event stream
+    carry0 = init_carry(model, sim, 0, params)
+    carry_bytes = sum(x.nbytes for x in jax.tree.leaves(carry0))
+    bytes_per_instance = carry_bytes // max(1, n_instances)
+    log(TAG, f"phase: sim built — {n_instances} instances x "
+             f"{sim.net.n_nodes} nodes, {sim.n_ticks} ticks, "
+             f"{bytes_per_instance} B/instance "
+             f"({carry_bytes / 1e6:.1f} MB carry total)")
+
+    log(TAG, "phase: compile + warm-up")
+    t0 = time.monotonic()
     carry, events = run_sim(model, sim, 7, params)
     jax.block_until_ready(carry.stats.delivered)
+    log(TAG, f"phase: compiled in {time.monotonic() - t0:.1f}s; "
+             f"timed run")
 
-    # steady-state timing
     t0 = time.monotonic()
     carry, events = run_sim(model, sim, 8, params)
     jax.block_until_ready(carry.stats.delivered)
     wall = time.monotonic() - t0
 
     delivered = int(carry.stats.delivered)
+    sent = int(carry.stats.sent)
     value = delivered / wall if wall > 0 else 0.0
-    import signal
-    signal.alarm(0)
+    log(TAG, f"phase: done — {delivered} delivered / {wall:.3f}s wall")
     print(json.dumps({
         "metric": "simulated_msgs_per_sec",
         "value": round(value, 1),
         "unit": "msgs/s",
         "vs_baseline": round(value / BASELINE_MSGS_PER_SEC, 3),
-    }))
+        "platform": platform,
+        "instances": n_instances,
+        "sim_ticks": sim.n_ticks,
+        "sent": sent,
+        "wall_s": round(wall, 3),
+        "bytes_per_instance": int(bytes_per_instance),
+    }), flush=True)
+
+
+# --------------------------------------------------------------------------
+# parent: deadline + retry orchestration (never imports jax)
+# --------------------------------------------------------------------------
+
+def _emit_failure(reason: str) -> None:
+    print(json.dumps({
+        "metric": "simulated_msgs_per_sec", "value": 0.0,
+        "unit": "msgs/s", "vs_baseline": 0.0,
+        "error": reason[:400]}), flush=True)
+
+
+def parent_main() -> int:
+    from maelstrom_tpu.utils.driver_guard import (cpu_child_env, log,
+                                                  run_child)
+
+    budget = float(os.environ.get("BENCH_WATCHDOG_S", 570))
+    t_start = time.monotonic()
+    child_cmd = [sys.executable, os.path.abspath(__file__), "--child"]
+
+    accel_env = dict(os.environ)
+    attempts = [
+        ("accelerator#1", accel_env, 280.0),
+        ("accelerator#2", accel_env, 130.0),
+        ("cpu-fallback", cpu_child_env(1), 110.0),
+    ]
+
+    last_err = "no attempts ran"
+    for name, env, deadline in attempts:
+        remaining = budget - (time.monotonic() - t_start) - 10.0
+        if remaining <= 20.0:
+            log(TAG, f"skipping {name}: only {remaining:.0f}s of "
+                     f"budget left")
+            break
+        deadline = min(deadline, remaining)
+        log(TAG, f"attempt {name}")
+        rc, out, tail = run_child(child_cmd, env, deadline, TAG)
+        if rc == 0:
+            for line in out.splitlines():
+                line = line.strip()
+                if line.startswith("{"):
+                    try:
+                        result = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    if result.get("value", 0) > 0:
+                        result["attempt"] = name
+                        print(json.dumps(result), flush=True)
+                        return 0
+            last_err = f"{name}: child rc=0 but no metric line"
+        elif rc is None:
+            last_err = (f"{name}: deadline {deadline:.0f}s exceeded "
+                        f"(tail: {' | '.join(tail[-3:])})")
+        else:
+            last_err = (f"{name}: child rc={rc} "
+                        f"(tail: {' | '.join(tail[-3:])})")
+        log(TAG, f"attempt {name} failed: {last_err}")
+
+    _emit_failure(last_err)
+    return 3
 
 
 if __name__ == "__main__":
-    try:
-        main()
-    except Exception as e:  # emit a valid metric line even on failure
-        import traceback
-        traceback.print_exc()   # keep the full diagnostic on stderr
-        print(json.dumps({
-            "metric": "simulated_msgs_per_sec", "value": 0.0,
-            "unit": "msgs/s", "vs_baseline": 0.0,
-            "error": repr(e)[:300]}), flush=True)
-        raise SystemExit(3)
+    if "--child" in sys.argv:
+        try:
+            child_main()
+        except Exception as e:
+            import traceback
+            traceback.print_exc()
+            raise SystemExit(4)
+    else:
+        raise SystemExit(parent_main())

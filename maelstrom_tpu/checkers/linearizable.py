@@ -7,8 +7,24 @@ register. Indeterminate (``info``) ops may take effect at any point after
 their invocation *or never*; failed ops are assumed not to have happened
 (they carry definite errors).
 
-This fills the role Knossos plays for the reference's lin-kv workload
-(src/maelstrom/workload/lin_kv.clj via jepsen.tests.linearizable-register).
+Scalability (beyond per-key P-compositionality):
+
+* **Quiescent-cut segmentation** — at any instant where every earlier op
+  has completed and no pending (info) op spans it, every linearization
+  puts all earlier ops before all later ones, so the history splits into
+  independent segments. Each segment is checked with the full WGL search
+  but propagates the *set* of reachable final register states into the
+  next segment (bounded by the number of distinct written values), which
+  keeps the search sound and complete while making cost roughly linear
+  in segment count for well-behaved histories.
+* **Explicit search budget** — the DFS counts visited states; a key that
+  exhausts the budget yields ``"unknown"`` rather than a silent pass.
+  Likewise histories previously skipped by the op-count guard now make
+  the whole result ``"unknown"`` (never ``valid? true``), matching
+  Knossos's behavior of reporting indeterminate analyses
+  (reference src/maelstrom/workload/lin_kv.clj:78-85 via
+  jepsen.tests.linearizable-register).
+
 Histories are checked *per key*; a register op's value is ``[k, v]`` for
 read/write and ``[k, [from, to]]`` for cas, matching the reference's op
 encoding.
@@ -17,14 +33,17 @@ encoding.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, List, Optional, Set, Tuple
 
 INF = float("inf")
+
+# Sentinel for "budget exhausted / can't tell".
+UNKNOWN = "unknown"
 
 
 @dataclass
 class _Op:
-    idx: int          # dense index for bitmask
+    idx: int          # dense index for bitmask (within its segment)
     f: str            # read / write / cas
     args: Any         # read: None; write: v; cas: (frm, to)
     ret: Any          # read: observed value; others: None
@@ -51,47 +70,103 @@ def _apply(state, op: _Op) -> Tuple[bool, Any]:
     raise ValueError(f"unknown register op {op.f}")
 
 
-def check_register_history(ops: List[_Op], init_state=None) -> bool:
-    """WGL search. True iff linearizable."""
+def _final_states(ops: List[_Op], init_states: Set[Any],
+                  budget: List[int]) -> Optional[Set[Any]]:
+    """WGL search over one segment from each possible initial state.
+
+    Returns the set of register states reachable at the end of a
+    complete linearization (all required ops placed; pending info ops
+    optionally placed) — empty set means the segment is NOT
+    linearizable from any given initial state. ``None`` means the
+    search budget ran out (indeterminate). ``budget`` is a one-element
+    mutable cell of remaining visited-state credits shared across
+    segments of a key.
+    """
     n = len(ops)
     required_mask = 0
     for o in ops:
         if o.required:
             required_mask |= 1 << o.idx
-    full = (1 << n) - 1
-    seen = set()
+
+    ends = sorted({o.end for o in ops if o.end < INF})
 
     def min_end(linearized: int) -> float:
         m = INF
         for o in ops:
-            if not (linearized >> o.idx) & 1:
-                if o.end < m:
-                    m = o.end
+            if not (linearized >> o.idx) & 1 and o.end < m:
+                m = o.end
         return m
 
+    out: Set[Any] = set()
+    seen = set()
     # iterative DFS over (linearized_mask, state)
-    stack = [(0, init_state)]
-    while stack:
-        linearized, state = stack.pop()
-        if (linearized & required_mask) == required_mask:
-            return True
-        key = (linearized, state)
-        if key in seen:
-            continue
-        seen.add(key)
-        bound = min_end(linearized)
-        for o in ops:
-            if (linearized >> o.idx) & 1:
+    for init in init_states:
+        stack = [(0, init)]
+        while stack:
+            linearized, state = stack.pop()
+            key = (linearized, state)
+            if key in seen:
                 continue
-            if o.inv > bound:
-                continue  # real-time order violated
-            legal, new_state = _apply(state, o)
-            if legal:
-                stack.append((linearized | (1 << o.idx), new_state))
-    return False
+            seen.add(key)
+            budget[0] -= 1
+            if budget[0] <= 0:
+                return None
+            if (linearized & required_mask) == required_mask:
+                # complete linearization: pending info ops may or may
+                # not have taken effect, but writes/cas among them can
+                # still change the final state. Record this state; the
+                # DFS will also explore placing remaining info ops.
+                out.add(state)
+            bound = min_end(linearized)
+            for o in ops:
+                if (linearized >> o.idx) & 1:
+                    continue
+                if o.inv > bound:
+                    continue  # real-time order violated
+                legal, new_state = _apply(state, o)
+                if legal:
+                    stack.append((linearized | (1 << o.idx), new_state))
+    return out
 
 
-def _collect_ops(history, key) -> Optional[List[_Op]]:
+def _segments(ops: List[_Op]) -> List[List[_Op]]:
+    """Split ops at quiescent cuts: boundaries T where every op invoked
+    before T completed before T (pending/info ops bar all later cuts)."""
+    ops = sorted(ops, key=lambda o: o.inv)
+    segs: List[List[_Op]] = []
+    cur: List[_Op] = []
+    frontier = -INF  # max completion time of ops in current segment
+    for o in ops:
+        if cur and frontier < o.inv:
+            segs.append(cur)
+            cur = []
+        cur.append(o)
+        frontier = max(frontier, o.end)
+    if cur:
+        segs.append(cur)
+    # reindex per segment for compact bitmasks
+    for seg in segs:
+        for i, o in enumerate(seg):
+            o.idx = i
+    return segs
+
+
+def check_register_history(ops: List[_Op], init_state=None,
+                           budget_states: int = 2_000_000):
+    """Segmented WGL search. True / False / UNKNOWN (budget exhausted)."""
+    budget = [budget_states]
+    states: Set[Any] = {init_state}
+    for seg in _segments(ops):
+        nxt = _final_states(seg, states, budget)
+        if nxt is None:
+            return UNKNOWN
+        if not nxt:
+            return False
+        states = nxt
+    return True
+
+
+def _collect_ops(history, key) -> List[_Op]:
     """Build per-key op list from invoke/complete pairs."""
     from ..gen.history import pairs
     ops: List[_Op] = []
@@ -127,8 +202,14 @@ def _collect_ops(history, key) -> Optional[List[_Op]]:
     return ops
 
 
-def linearizable_kv_checker(history, max_ops_per_key: int = 400) -> dict:
-    """Check a multi-key register history key by key."""
+def linearizable_kv_checker(history, max_ops_per_key: int = 10_000,
+                            budget_states: int = 2_000_000) -> dict:
+    """Check a multi-key register history key by key.
+
+    Verdict: ``False`` if any key is non-linearizable; ``"unknown"`` if
+    none is but some key was indeterminate (over the op cap or out of
+    search budget); ``True`` only when every key fully checked clean.
+    """
     keys = set()
     for r in history:
         if r["type"] == "invoke" and isinstance(r.get("value"),
@@ -136,17 +217,27 @@ def linearizable_kv_checker(history, max_ops_per_key: int = 400) -> dict:
                 and len(r["value"]) == 2:
             keys.add(r["value"][0])
     bad_keys = []
-    skipped = []
+    unknown_keys = []
     for key in sorted(keys, key=repr):
         ops = _collect_ops(history, key)
         if len(ops) > max_ops_per_key:
-            skipped.append(key)
+            unknown_keys.append(key)
             continue
-        if not check_register_history(ops):
+        verdict = check_register_history(ops, budget_states=budget_states)
+        if verdict is False:
             bad_keys.append(key)
+        elif verdict is UNKNOWN:
+            unknown_keys.append(key)
+    valid: Any
+    if bad_keys:
+        valid = False
+    elif unknown_keys:
+        valid = UNKNOWN
+    else:
+        valid = True
     return {
-        "valid?": not bad_keys,
+        "valid?": valid,
         "key-count": len(keys),
         "bad-keys": bad_keys,
-        "skipped-keys": skipped,
+        "unknown-keys": unknown_keys,
     }

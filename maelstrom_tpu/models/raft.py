@@ -72,6 +72,11 @@ class RaftRow(NamedTuple):
     last_hb: jnp.ndarray
     leader_hint: jnp.ndarray     # last known leader (for client proxying,
                                  # the role of raft.py:552-571); -1 unknown
+    truncated_committed: jnp.ndarray  # sticky witness: this node once
+                                      # overwrote an entry below its own
+                                      # commit index (impossible in
+                                      # correct Raft; the local signature
+                                      # of the §5.4.2 commit bug)
 
 
 class RaftModel(Model):
@@ -134,6 +139,7 @@ class RaftModel(Model):
             election_deadline=(self.elect_min + jitter).astype(jnp.int32),
             last_hb=jnp.int32(0),
             leader_hint=jnp.int32(-1),
+            truncated_committed=jnp.int32(0),
         )
 
     # --- helpers ----------------------------------------------------------
@@ -270,6 +276,13 @@ class RaftModel(Model):
         log_body = row.log_body.at[slot].set(w_body, mode="drop")
         log_len = jnp.where(cli_accept, row.log_len + 1, ae_len)
 
+        # Leader-Completeness witness: a conflicting AppendEntries write
+        # below this node's own commit index overwrites a committed
+        # entry. Correct Raft can never do this; the no-term-guard
+        # mutant does, on the Figure-8 schedule.
+        truncated_committed = row.truncated_committed | (
+            ae_write & ~same & (ae_widx < row.commit_idx)).astype(jnp.int32)
+
         # --- commit advance (Raft §5.3: min(leaderCommit, last new entry))
         commit_idx = jnp.where(
             accept,
@@ -314,7 +327,8 @@ class RaftModel(Model):
                       log_body=log_body, log_len=log_len, kv=row.kv,
                       next_idx=next_idx, match_idx=match_idx,
                       election_deadline=election_deadline,
-                      last_hb=last_hb, leader_hint=leader_hint)
+                      last_hb=last_hb, leader_hint=leader_hint,
+                      truncated_committed=truncated_committed)
 
         # --- the single out row
         out = jnp.zeros((1, cfg.lanes), dtype=jnp.int32)
@@ -511,6 +525,8 @@ class RaftModel(Model):
 
         - at most one leader per term
         - any two nodes' committed log prefixes agree (terms and bodies)
+        - no node ever overwrote an entry below its own commit index
+          (sticky per-node witness set in :meth:`handle`)
 
         These catch the double-vote and no-term-guard corruptions
         on-device even in instances whose histories are never decoded.
@@ -532,7 +548,8 @@ class RaftModel(Model):
         body_diff = jnp.any(lb[:, None] != lb[None, :], axis=-1) \
             & in_prefix
         log_mismatch = jnp.any(term_diff | body_diff)
-        return two_leaders | log_mismatch
+        overwrote = jnp.any(node_state.truncated_committed > 0)
+        return two_leaders | log_mismatch | overwrote
 
     # --- client side ------------------------------------------------------
 

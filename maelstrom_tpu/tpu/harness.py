@@ -85,6 +85,9 @@ def make_sim_config(model: Model, opts: Dict[str, Any]) -> SimConfig:
         interval=max(1, int(o["nemesis_interval"] * 1000 / mpt)),
         kind=o.get("nemesis_kind", "random-halves"),
         stop_tick=stop_tick,
+        schedule=tuple(
+            (int(until), tuple((int(d), int(s)) for d, s in pairs))
+            for until, pairs in o.get("nemesis_schedule", ())),
     )
     return SimConfig(net=net, client=client, nemesis=nemesis,
                      n_instances=o["n_instances"], n_ticks=n_ticks,

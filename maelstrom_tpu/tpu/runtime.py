@@ -291,6 +291,34 @@ class NemesisConfig(NamedTuple):
     stop_tick: int = 1 << 30   # final heal: no partitions at/after this
                                # tick (the reference's final-generator heal
                                # + quiesce phase, core.clj:74-80)
+    schedule: tuple = ()       # kind="scripted": ((until_tick,
+                               # ((dst, src), ...)), ...) phases ordered by
+                               # until_tick — deterministic per-tick
+                               # partition control for constructed
+                               # scenarios (e.g. the Raft Figure-8);
+                               # healed after the last phase. Plain nested
+                               # tuples so SimConfig stays hashable/static.
+
+
+def scripted_isolate_groups(until_tick: int, groups, n_nodes: int
+                            ) -> tuple:
+    """Build one scripted-schedule phase where traffic is allowed only
+    WITHIN each group in ``groups``; every cross-group server pair is
+    blocked. Returns ``(until_tick, pairs)`` for
+    :attr:`NemesisConfig.schedule`."""
+    member = {}
+    for gi, g in enumerate(groups):
+        for node in g:
+            member[node] = gi
+    pairs = []
+    for dst in range(n_nodes):
+        for src in range(n_nodes):
+            if dst == src:
+                continue
+            if member.get(dst) is None or member.get(src) is None \
+                    or member[dst] != member[src]:
+                pairs.append((dst, src))
+    return (until_tick, tuple(pairs))
 
 
 def partition_matrix(nem: NemesisConfig, cfg: NetConfig, t, instance_key
@@ -302,10 +330,26 @@ def partition_matrix(nem: NemesisConfig, cfg: NetConfig, t, instance_key
     NT = cfg.n_total
     if not nem.enabled:
         return jnp.zeros((NT, NT), dtype=bool)
+    n = cfg.n_nodes
+    if nem.kind == "scripted":
+        # deterministic per-tick schedule: constant per-phase matrices
+        # baked into the graph, phase selected by searchsorted on t
+        import numpy as np
+        P = len(nem.schedule)
+        mats = np.zeros((P + 1, NT, NT), dtype=bool)  # last = healed
+        untils = np.full((P + 1,), np.iinfo(np.int32).max, dtype=np.int32)
+        for i, (until, pairs) in enumerate(nem.schedule):
+            untils[i] = until
+            for dst, src in pairs:
+                mats[i, dst, src] = True
+        phase_i = jnp.searchsorted(jnp.asarray(untils), t, side="right")
+        blocked = jnp.asarray(mats)[jnp.clip(phase_i, 0, P)]
+        server = jnp.arange(NT) < n
+        blocked = blocked & server[:, None] & server[None, :]
+        return jnp.where(t < nem.stop_tick, blocked, False)
     phase = t // nem.interval
     active = ((phase % 2) == 1) & (t < nem.stop_tick)
     key = jax.random.fold_in(instance_key, phase)
-    n = cfg.n_nodes
     if nem.kind == "isolated-node":
         victim = jax.random.randint(key, (), 0, n)
         ids = jnp.arange(NT)

@@ -1,0 +1,116 @@
+"""Defenses for driver-facing entry points against flaky accelerator init.
+
+The round-1 driver artifacts (BENCH_r01/MULTICHIP_r01) both timed out
+because JAX backend init can wedge on the accelerator tunnel *before any
+user code runs*: a sitecustomize hook registers the PJRT plugin at
+interpreter startup whenever ``PALLAS_AXON_POOL_IPS`` is set, so even a
+``JAX_PLATFORMS=cpu`` child can park forever in the plugin's remote
+loop.  Two defenses, composable:
+
+1. ``cpu_child_env(n)`` — an environment for a *pure CPU* child process:
+   the plugin gate variable is removed entirely (the hook is a no-op
+   without it), ``JAX_PLATFORMS=cpu`` forced, and the XLA host-platform
+   device count pinned to ``n`` virtual devices.
+2. ``run_child(...)`` — run a child with a hard deadline, streaming its
+   stderr through (so the driver's log tail localizes the phase that
+   hung) and killing the whole process group on timeout.  A fresh
+   process frequently un-wedges an intermittently bad tunnel, so callers
+   retry with fresh children instead of hoping in-process.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+# The sitecustomize gate: when present, interpreter startup dials the
+# accelerator tunnel. CPU-only children must not inherit it.
+_PLUGIN_GATES = ("PALLAS_AXON_POOL_IPS",)
+
+
+def merge_xla_flags(existing: str, n_devices: int) -> str:
+    """Force ``--xla_force_host_platform_device_count=n`` in an XLA_FLAGS
+    string, replacing any prior setting of that flag."""
+    kept = [f for f in existing.split()
+            if not f.startswith("--xla_force_host_platform_device_count")]
+    kept.append(f"--xla_force_host_platform_device_count={n_devices}")
+    return " ".join(kept)
+
+
+def cpu_child_env(n_devices: int = 1) -> Dict[str, str]:
+    """Environment for a child that must init a pure-CPU JAX backend
+    without ever touching the accelerator tunnel."""
+    env = dict(os.environ)
+    for gate in _PLUGIN_GATES:
+        env.pop(gate, None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = merge_xla_flags(env.get("XLA_FLAGS", ""), n_devices)
+    return env
+
+
+def log(tag: str, msg: str) -> None:
+    print(f"{tag}: {msg}", file=sys.stderr, flush=True)
+
+
+def run_child(cmd: List[str], env: Dict[str, str], deadline_s: float,
+              tag: str) -> Tuple[Optional[int], str, List[str]]:
+    """Run ``cmd`` with a hard deadline.
+
+    Streams the child's stderr to our stderr live (prefixed), captures
+    stdout. Returns ``(returncode, stdout, last_stderr_lines)``;
+    returncode is ``None`` on timeout (child killed).
+    """
+    import threading
+
+    log(tag, f"spawning child (deadline {deadline_s:.0f}s): "
+             f"{' '.join(cmd)}")
+    proc = subprocess.Popen(
+        cmd, env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True, start_new_session=True)
+
+    tail: List[str] = []
+
+    def pump_stderr():
+        assert proc.stderr is not None
+        for line in proc.stderr:
+            line = line.rstrip("\n")
+            tail.append(line)
+            del tail[:-40]
+            print(f"{tag}|child| {line}", file=sys.stderr, flush=True)
+
+    t = threading.Thread(target=pump_stderr, daemon=True)
+    t.start()
+
+    out_parts: List[str] = []
+
+    def pump_stdout():
+        assert proc.stdout is not None
+        for line in proc.stdout:
+            out_parts.append(line)
+
+    t2 = threading.Thread(target=pump_stdout, daemon=True)
+    t2.start()
+
+    t0 = time.monotonic()
+    try:
+        proc.wait(timeout=deadline_s)
+    except subprocess.TimeoutExpired:
+        log(tag, f"child exceeded {deadline_s:.0f}s — killing process "
+                 f"group (accelerator init wedged?)")
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            proc.kill()
+        proc.wait()
+        t.join(timeout=5)
+        t2.join(timeout=5)
+        return None, "".join(out_parts), tail
+    t.join(timeout=5)
+    t2.join(timeout=5)
+    log(tag, f"child exited rc={proc.returncode} "
+             f"in {time.monotonic() - t0:.1f}s")
+    return proc.returncode, "".join(out_parts), tail

