@@ -1,0 +1,15 @@
+"""History checkers (history -> verdict maps with a three-valued
+``valid?``: True / False / "unknown", reference doc/results.md:58-64)."""
+
+
+def compose_valid(verdicts) -> object:
+    """Combine sub-checker verdicts: False dominates, then "unknown",
+    then True — the composition rule of the reference's composed checker
+    (jepsen checker/compose semantics)."""
+    out = True
+    for v in verdicts:
+        if v is False:
+            return False
+        if v == "unknown":
+            out = "unknown"
+    return out

@@ -82,13 +82,10 @@ def _final_states(ops: List[_Op], init_states: Set[Any],
     mutable cell of remaining visited-state credits shared across
     segments of a key.
     """
-    n = len(ops)
     required_mask = 0
     for o in ops:
         if o.required:
             required_mask |= 1 << o.idx
-
-    ends = sorted({o.end for o in ops if o.end < INF})
 
     def min_end(linearized: int) -> float:
         m = INF
@@ -108,7 +105,10 @@ def _final_states(ops: List[_Op], init_states: Set[Any],
             if key in seen:
                 continue
             seen.add(key)
-            budget[0] -= 1
+            # budget counts WORK (successor scans ~ n per state), not
+            # just states, so a wide segment can't run for hours before
+            # yielding unknown
+            budget[0] -= max(1, len(ops))
             if budget[0] <= 0:
                 return None
             if (linearized & required_mask) == required_mask:

@@ -143,9 +143,14 @@ def cmd_test(args) -> int:
             seed=args.seed or 0))
     print(json.dumps(results, indent=2, default=repr))
     print()
-    if results.get("valid?") is True:
+    verdict = results.get("valid?")
+    if verdict is True:
         print("Everything looks good! ヽ(‘ー`)ノ")
         return 0
+    if verdict == "unknown":
+        # exit 2 = indeterminate analysis (reference doc/results.md:66-69)
+        print("Errors occurred during analysis, but no anomalies found. ಠ~ಠ")
+        return 2
     print("Analysis invalid! (ノಥ益ಥ）ノ ┻━┻")
     return 1
 
@@ -201,11 +206,14 @@ def cmd_demo(args) -> int:
         print(f"== {label}")
         try:
             results = run_test(workload, opts)
-            ok = results.get("valid?") is True
+            verdict = results.get("valid?")
         except Exception as e:
             print(f"   crashed: {e!r}")
-            ok = False
-        print("   valid!" if ok else "   INVALID")
+            verdict = False
+        ok = verdict is True
+        print("   valid!" if ok else
+              ("   UNKNOWN (indeterminate analysis)"
+               if verdict == "unknown" else "   INVALID"))
         if not ok:
             failures.append(label)
     print()

@@ -11,8 +11,11 @@ def get_model(workload: str, node_count: int, topology: str = "grid"):
     from .crdt import (BroadcastModel, GCounterModel, GossipSetModel,
                        PNCounterModel)
     from .echo import EchoModel
+    from .kafka import KafkaModel, KAFKA_BUGGY_MODELS
     from .raft import RaftModel
     from .raft_buggy import BUGGY_MODELS
+    from .txn_raft import (TXN_BUGGY_MODELS, TxnListAppendModel,
+                           TxnRwRegisterModel)
     from .unique_ids import UniqueIdsModel
 
     if workload == "echo":
@@ -33,7 +36,23 @@ def get_model(workload: str, node_count: int, topology: str = "grid"):
         kind = workload[len("lin-kv-bug-"):]
         if kind in BUGGY_MODELS:
             return BUGGY_MODELS[kind](n_nodes_hint=node_count)
+    if workload == "txn-list-append":
+        return TxnListAppendModel(n_nodes_hint=node_count)
+    if workload == "txn-rw-register":
+        return TxnRwRegisterModel(n_nodes_hint=node_count)
+    if workload.startswith("txn-list-append-bug-"):
+        kind = workload[len("txn-list-append-bug-"):]
+        if kind in TXN_BUGGY_MODELS:
+            return TXN_BUGGY_MODELS[kind](n_nodes_hint=node_count)
+    if workload == "kafka":
+        return KafkaModel()
+    if workload.startswith("kafka-bug-"):
+        kind = workload[len("kafka-bug-"):]
+        if kind in KAFKA_BUGGY_MODELS:
+            return KAFKA_BUGGY_MODELS[kind]()
     raise ValueError(
         f"no TPU model for workload {workload!r}; available: echo, "
-        f"broadcast, g-set, g-counter, pn-counter, lin-kv, "
-        f"lin-kv-bug-{{{', '.join(BUGGY_MODELS)}}}")
+        f"broadcast, g-set, g-counter, pn-counter, lin-kv, kafka, "
+        f"txn-list-append, txn-rw-register, "
+        f"lin-kv-bug-{{{', '.join(BUGGY_MODELS)}}}, "
+        f"txn-list-append-bug-{{{', '.join(TXN_BUGGY_MODELS)}}}")

@@ -1,0 +1,289 @@
+"""Device-side kafka workload: keyed append-only logs in fixed slots.
+
+The reference's kafka workload (src/maelstrom/workload/kafka.clj:89-154:
+send/send_ok{offset}, poll/poll_ok{msgs}, commit_offsets,
+list_committed_offsets) against the single-node log node
+(demo kafka_single_node semantics). Vectorized: each instance is one
+node plus C clients; per-key logs live in ``[n_keys, log_cap]`` value
+slots, consumer positions are tracked server-side per client id (the
+role of the reference client's ``positions`` map — on-device clients are
+stateless, so the broker holds the cursor, preserving the same
+per-process poll monotonicity the checker verifies).
+
+Fixed-shape encodings: a poll returns up to ``poll_max`` messages for
+every key (``n_keys * poll_max * 2`` body lanes of ``[offset+1, value]``
+pairs, 0 = absent); commit/list replies carry ``n_keys`` offset+1 lanes.
+
+Bug corpus: :class:`KafkaOffsetReuse` hands out the same offset twice
+under concurrent sends (the classic non-atomic fetch-and-add) — caught
+by the checker as duplicate-offset / inconsistent-offset / lost-write.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..tpu import wire
+from ..tpu.runtime import EV_INFO, EV_OK, Model, TYPE_ERROR
+
+F_SEND = 1
+F_POLL = 2
+F_COMMIT = 3
+F_LIST = 4
+
+T_SEND = 30
+T_SEND_OK = 31
+T_POLL = 32
+T_POLL_OK = 33
+T_COMMIT = 34
+T_COMMIT_OK = 35
+T_LIST = 36
+T_LIST_OK = 37
+
+
+class KafkaRow(NamedTuple):
+    log_vals: jnp.ndarray    # [K, cap]
+    log_len: jnp.ndarray     # [K]
+    committed: jnp.ndarray   # [K] highest committed offset (-1 none)
+    positions: jnp.ndarray   # [C, K] next offset each client polls from
+
+
+class KafkaModel(Model):
+    name = "kafka"
+    max_out = 1
+    idempotent_fs = (F_POLL, F_LIST)
+
+    # bug switch: non-atomic offset assignment (see KafkaOffsetReuse)
+    reuse_offsets = False
+
+    def __init__(self, n_keys: int = 4, log_cap: int = 64,
+                 poll_max: int = 3):
+        self.n_keys = n_keys
+        self.log_cap = log_cap
+        self.poll_max = poll_max
+        self.body_lanes = max(n_keys * poll_max * 2, n_keys, 3)
+        self.ev_vals = 1 + self.body_lanes
+        self.op_lanes = 4
+
+    def _config(self):
+        return (self.n_keys, self.log_cap, self.poll_max)
+
+    def __hash__(self):
+        return hash((type(self), self._config()))
+
+    def __eq__(self, other):
+        return (type(self) is type(other)
+                and self._config() == other._config())
+
+    # consumer cursors are a fixed [MAX_CLIENTS, K] block (init_row has
+    # no access to the client count); concurrency must stay <= this
+    MAX_CLIENTS = 8
+
+    def init_row(self, n_nodes, node_idx, key, params):
+        del node_idx, key, params
+        return KafkaRow(
+            log_vals=jnp.zeros((self.n_keys, self.log_cap), jnp.int32),
+            log_len=jnp.zeros((self.n_keys,), jnp.int32),
+            committed=jnp.full((self.n_keys,), -1, jnp.int32),
+            positions=jnp.zeros((self.MAX_CLIENTS, self.n_keys),
+                                jnp.int32),
+        )
+
+    def make_params(self, n_nodes: int):
+        return None
+
+    def handle(self, row: KafkaRow, node_idx, msg, t, key, cfg, params):
+        assert cfg.n_clients <= self.MAX_CLIENTS, (
+            f"kafka model tracks {self.MAX_CLIENTS} consumer cursors; "
+            f"concurrency {cfg.n_clients} would alias them")
+        mtype = msg[wire.TYPE]
+        src = msg[wire.SRC]
+        positions = row.positions
+        ci = jnp.clip(src - cfg.n_nodes, 0, self.MAX_CLIENTS - 1)
+
+        is_send = mtype == T_SEND
+        is_poll = mtype == T_POLL
+        is_commit = mtype == T_COMMIT
+        is_list = mtype == T_LIST
+        is_any = is_send | is_poll | is_commit | is_list
+
+        k = jnp.clip(msg[wire.BODY], 0, self.n_keys - 1)
+        v = msg[wire.BODY + 1]
+
+        # --- send: assign offset = log length, append
+        off = row.log_len[k]
+        if self.reuse_offsets:
+            # BUG: hand out the previous offset again (non-atomic
+            # fetch-and-add): two sends share (key, offset)
+            off = jnp.maximum(off - 1, 0)
+        fits = off < self.log_cap
+        do_send = is_send & fits
+        log_vals = jnp.where(
+            do_send,
+            row.log_vals.at[k, jnp.clip(off, 0, self.log_cap - 1)].set(v),
+            row.log_vals)
+        log_len = jnp.where(do_send,
+                            row.log_len.at[k].set(
+                                jnp.maximum(row.log_len[k], off + 1)),
+                            row.log_len)
+
+        # --- poll: up to poll_max messages per key from this client's
+        # cursor; cursor advances past what was returned
+        poll_body = jnp.zeros((self.body_lanes,), jnp.int32)
+        new_pos = positions[ci]
+        for kk in range(self.n_keys):
+            pos = positions[ci, kk]
+            base = kk * self.poll_max * 2
+            for j in range(self.poll_max):
+                o = pos + j
+                have = o < log_len[kk]
+                poll_body = poll_body.at[base + 2 * j].set(
+                    jnp.where(have, o + 1, 0))
+                poll_body = poll_body.at[base + 2 * j + 1].set(
+                    jnp.where(have, log_vals[kk, jnp.clip(
+                        o, 0, self.log_cap - 1)], 0))
+            new_pos = new_pos.at[kk].set(
+                jnp.minimum(pos + self.poll_max, log_len[kk]))
+        positions = jnp.where(is_poll,
+                              positions.at[ci].set(new_pos), positions)
+
+        # --- commit_offsets: committed[k] advances to this client's
+        # processed position - 1 (never regresses)
+        my_pos = row.positions[ci]
+        commit_vals = my_pos  # offset+1 encoding (0 = nothing polled)
+        committed = jnp.where(
+            is_commit,
+            jnp.maximum(row.committed, my_pos - 1), row.committed)
+
+        # --- reply
+        out = jnp.zeros((1, cfg.lanes), dtype=jnp.int32)
+        out = out.at[0, wire.VALID].set(jnp.where(is_any, 1, 0))
+        out = out.at[0, wire.DEST].set(src)
+        out = out.at[0, wire.TYPE].set(
+            jnp.where(is_send & fits, T_SEND_OK,
+            jnp.where(is_send, TYPE_ERROR,
+            jnp.where(is_poll, T_POLL_OK,
+            jnp.where(is_commit, T_COMMIT_OK, T_LIST_OK)))))
+        out = out.at[0, wire.REPLYTO].set(msg[wire.MSGID])
+        body = jnp.zeros((self.body_lanes,), jnp.int32)
+        # send_ok: offset; full log: error 11 (definite, retryable)
+        body = body.at[0].set(
+            jnp.where(is_send & fits, off,
+                      jnp.where(is_send, 11, 0)))
+        body = jnp.where(is_poll, poll_body, body)
+        kmask = jnp.arange(self.body_lanes) < self.n_keys
+        body = jnp.where(is_commit & kmask,
+                         jnp.pad(commit_vals,
+                                 (0, self.body_lanes - self.n_keys)),
+                         body)
+        body = jnp.where(is_list & kmask,
+                         jnp.pad(row.committed + 1,
+                                 (0, self.body_lanes - self.n_keys)),
+                         body)
+        out = jax.lax.dynamic_update_slice(out, body[None], (0, wire.BODY))
+
+        row = KafkaRow(log_vals=log_vals, log_len=log_len,
+                       committed=committed, positions=positions)
+        return row, out
+
+    def invariants(self, node_state: KafkaRow, cfg, params):
+        # committed offsets never exceed the log end
+        return jnp.any(node_state.committed >= node_state.log_len)
+
+    # --- client side ------------------------------------------------------
+
+    def sample_op(self, key, uniq, cfg, params):
+        kf, kk = jax.random.split(key)
+        r = jax.random.uniform(kf)
+        k = jax.random.randint(kk, (), 0, self.n_keys, dtype=jnp.int32)
+        f = jnp.where(r < 0.45, F_SEND,
+                      jnp.where(r < 0.85, F_POLL,
+                                jnp.where(r < 0.95, F_COMMIT, F_LIST)))
+        v = 1 + uniq  # unique message value per instance
+        return jnp.stack([f, k, jnp.where(f == F_SEND, v, 0),
+                          jnp.int32(0)])
+
+    def encode_request(self, op, msg_id, client_idx, key, cfg, params):
+        del key
+        mtype = jnp.where(op[0] == F_SEND, T_SEND,
+                          jnp.where(op[0] == F_POLL, T_POLL,
+                                    jnp.where(op[0] == F_COMMIT, T_COMMIT,
+                                              T_LIST)))
+        return wire.make_msg(src=0, dest=0, type_=mtype, msg_id=msg_id,
+                             body=(op[1], op[2]),
+                             body_lanes=self.body_lanes)
+
+    def decode_reply_wide(self, op, msg, cfg, params):
+        mtype = msg[wire.TYPE]
+        ok = ((mtype == T_SEND_OK) | (mtype == T_POLL_OK)
+              | (mtype == T_COMMIT_OK) | (mtype == T_LIST_OK))
+        etype = jnp.where(ok, EV_OK, EV_INFO)
+        vals = jnp.zeros((self.ev_vals,), jnp.int32)
+        vals = vals.at[0].set(op[0])
+        body = jax.lax.dynamic_slice(msg, (wire.BODY,),
+                                     (self.body_lanes,))
+        # send_ok: (k, v, offset+1); others: raw body
+        send_vals = jnp.zeros((self.body_lanes,), jnp.int32)
+        send_vals = send_vals.at[0].set(op[1]).at[1].set(op[2])
+        send_vals = send_vals.at[2].set(body[0] + 1)
+        payload = jnp.where(mtype == T_SEND_OK, send_vals, body)
+        vals = jax.lax.dynamic_update_slice(vals, payload, (1,))
+        return etype, vals
+
+    # --- host-side decoding ----------------------------------------------
+
+    def invoke_record(self, *vals):
+        f = vals[0]
+        if f == F_SEND:
+            return {"f": "send", "value": [vals[1], vals[2]]}
+        if f == F_POLL:
+            return {"f": "poll", "value": None}
+        if f == F_COMMIT:
+            return {"f": "commit_offsets", "value": {}}
+        return {"f": "list_committed_offsets",
+                "value": list(range(self.n_keys))}
+
+    def complete_record(self, *vals_etype):
+        vals, etype = vals_etype[:-1], vals_etype[-1]
+        f = vals[0]
+        if etype != EV_OK:
+            return self.invoke_record(*vals)
+        if f == F_SEND:
+            return {"f": "send",
+                    "value": [vals[1], vals[2], vals[3] - 1]}
+        if f == F_POLL:
+            msgs = {}
+            for kk in range(self.n_keys):
+                base = 1 + kk * self.poll_max * 2
+                pairs = []
+                for j in range(self.poll_max):
+                    off1, v = vals[base + 2 * j], vals[base + 2 * j + 1]
+                    if off1 > 0:
+                        pairs.append([off1 - 1, v])
+                if pairs:
+                    msgs[kk] = pairs
+            return {"f": "poll", "value": msgs}
+        offsets = {kk: vals[1 + kk] - 1 for kk in range(self.n_keys)
+                   if vals[1 + kk] > 0}
+        name = ("commit_offsets" if f == F_COMMIT
+                else "list_committed_offsets")
+        return {"f": name, "value": offsets}
+
+    def checker(self):
+        from ..checkers.kafka import kafka_checker
+        return lambda history, opts: kafka_checker(history)
+
+
+class KafkaOffsetReuse(KafkaModel):
+    """BUG: non-atomic offset assignment — concurrent sends to a key can
+    be acked with the same offset, silently overwriting each other."""
+    name = "kafka-bug-offset-reuse"
+    reuse_offsets = True
+
+
+KAFKA_BUGGY_MODELS = {
+    "offset-reuse": KafkaOffsetReuse,
+}

@@ -50,7 +50,8 @@ F_CAS = 3
 
 NIL = -1     # missing KV value
 
-# log entry body lanes: (f, key, a, b, client, client_msg_id)
+# base log entry body lanes: (f, key, a, b, client, client_msg_id);
+# subclasses widen via the ``entry_lanes`` class attribute
 ENTRY_LANES = 6
 
 
@@ -81,9 +82,15 @@ class RaftRow(NamedTuple):
 
 class RaftModel(Model):
     name = "lin-kv"
-    body_lanes = 12
+    body_lanes = 12           # AppendEntries header (6) + entry_lanes
+    entry_lanes = ENTRY_LANES  # log entry width; replicated-state-machine
+                               # subclasses (txn models) widen this
     max_out = 1
     idempotent_fs = (F_READ,)
+
+    # body lane used as the proxy-forward hop counter in client requests
+    # (a lane the workload's request encoding leaves free)
+    proxy_hops_lane = 3
 
     # correctness switches — the bug-injection corpus (models/raft_buggy)
     # flips these to produce broken-but-plausible variants; they are
@@ -92,6 +99,8 @@ class RaftModel(Model):
     vote_check_log = True          # False: ignores log recency in votes
     serve_reads_locally = False    # True: reads bypass the log (stale)
     commit_term_guard = True       # False: Raft §5.4.2 commit bug
+    apply_uncommitted = False      # True: apply+reply at append, not
+                                   # commit (dirty apply — txn mutant)
 
     def __init__(self, n_nodes_hint: int = 5, log_cap: int = 96,
                  n_keys: int = 8, n_vals: int = 8,
@@ -131,9 +140,10 @@ class RaftModel(Model):
             commit_idx=jnp.int32(0),
             last_applied=jnp.int32(0),
             log_term=jnp.zeros((self.log_cap,), jnp.int32),
-            log_body=jnp.zeros((self.log_cap, ENTRY_LANES), jnp.int32),
+            log_body=jnp.zeros((self.log_cap, self.entry_lanes),
+                               jnp.int32),
             log_len=jnp.int32(0),
-            kv=jnp.full((self.n_keys,), NIL, jnp.int32),
+            kv=self._init_kv(),
             next_idx=jnp.zeros((n_nodes,), jnp.int32),
             match_idx=jnp.zeros((n_nodes,), jnp.int32),
             election_deadline=(self.elect_min + jitter).astype(jnp.int32),
@@ -141,6 +151,23 @@ class RaftModel(Model):
             leader_hint=jnp.int32(-1),
             truncated_committed=jnp.int32(0),
         )
+
+    # --- replicated-state-machine hooks (overridden by txn models) -------
+
+    def _init_kv(self):
+        """The applied-state tensor living in RaftRow.kv."""
+        return jnp.full((self.n_keys,), NIL, jnp.int32)
+
+    def _is_client_request(self, mtype):
+        return (mtype == T_READ) | (mtype == T_WRITE) | (mtype == T_CAS)
+
+    def _encode_entry(self, msg, src):
+        """Client request message -> log entry row [entry_lanes]."""
+        mtype = msg[wire.TYPE]
+        f = jnp.where(mtype == T_READ, F_READ,
+                      jnp.where(mtype == T_WRITE, F_WRITE, F_CAS))
+        return jnp.stack([f, msg[wire.BODY], msg[wire.BODY + 1],
+                          msg[wire.BODY + 2], src, msg[wire.MSGID]])
 
     # --- helpers ----------------------------------------------------------
 
@@ -188,7 +215,7 @@ class RaftModel(Model):
         is_vrep = mtype == T_VOTE_REPLY
         is_ae = mtype == T_APPEND
         is_arep = mtype == T_APPEND_REPLY
-        is_cli = (mtype == T_READ) | (mtype == T_WRITE) | (mtype == T_CAS)
+        is_cli = self._is_client_request(mtype)
         is_proto = is_vote | is_vrep | is_ae | is_arep
 
         # --- term adoption / step-down (every protocol message carries
@@ -227,7 +254,7 @@ class RaftModel(Model):
         l_commit = msg[wire.BODY + 3]
         n_entries = msg[wire.BODY + 4]
         e_term = msg[wire.BODY + 5]
-        e_body = msg[wire.BODY + 6:wire.BODY + 6 + ENTRY_LANES]
+        e_body = msg[wire.BODY + 6:wire.BODY + 6 + self.entry_lanes]
         ae_current = is_ae & (body0 == term)
         # current-term AE: candidate steps down, sender is the leader hint
         role = jnp.where(ae_current & (role == 1), 0, role)
@@ -256,11 +283,8 @@ class RaftModel(Model):
             # BUG variant: reads bypass the log entirely
             stale_read = is_cli & (mtype == T_READ)
             cli_accept = cli_accept & ~stale_read
-        f = jnp.where(mtype == T_READ, F_READ,
-                      jnp.where(mtype == T_WRITE, F_WRITE, F_CAS))
-        cli_entry = jnp.stack([f, msg[wire.BODY], msg[wire.BODY + 1],
-                               msg[wire.BODY + 2], src, msg[wire.MSGID]])
-        hops = msg[wire.BODY + 3]
+        cli_entry = self._encode_entry(msg, src)
+        hops = msg[wire.BODY + self.proxy_hops_lane]
         forward = (is_cli & ~cli_accept & ~stale_read
                    & (row.leader_hint >= 0)
                    & (row.leader_hint != node_idx) & (hops < 3))
@@ -343,20 +367,23 @@ class RaftModel(Model):
                                 jnp.where(forward, mtype, TYPE_ERROR))))
         out = out.at[0, wire.REPLYTO].set(
             jnp.where(forward, -1, msg[wire.MSGID]))
-        # body lanes by reply kind
-        out = out.at[0, wire.BODY].set(
-            jnp.where(is_vote | is_ae, term,
-                      jnp.where(forward, msg[wire.BODY], 11)))
-        out = out.at[0, wire.BODY + 1].set(
+        # body lanes: a forward echoes the full request body (hops lane
+        # bumped); protocol replies use lanes 0..2; rejections carry
+        # error code 11 in lane 0
+        fwd_body = jax.lax.dynamic_slice(
+            msg, (wire.BODY,), (self.body_lanes,)
+        ).at[self.proxy_hops_lane].add(1)
+        proto_body = jnp.zeros((self.body_lanes,), jnp.int32)
+        proto_body = proto_body.at[0].set(
+            jnp.where(is_vote | is_ae, term, 11))
+        proto_body = proto_body.at[1].set(
             jnp.where(is_vote, grant.astype(jnp.int32),
-                      jnp.where(is_ae, accept.astype(jnp.int32),
-                                jnp.where(forward, msg[wire.BODY + 1],
-                                          0))))
-        out = out.at[0, wire.BODY + 2].set(
-            jnp.where(is_ae, match_ack,
-                      jnp.where(forward, msg[wire.BODY + 2], 0)))
-        out = out.at[0, wire.BODY + 3].set(
-            jnp.where(forward, hops + 1, 0))
+                      jnp.where(is_ae, accept.astype(jnp.int32), 0)))
+        proto_body = proto_body.at[2].set(
+            jnp.where(is_ae, match_ack, 0))
+        out = jax.lax.dynamic_update_slice(
+            out, jnp.where(forward, fwd_body, proto_body)[None],
+            (0, wire.BODY))
         # a forwarded request keeps the client's msg_id and logical src
         out = out.at[0, wire.MSGID].set(
             jnp.where(forward, msg[wire.MSGID], -1))
@@ -441,10 +468,17 @@ class RaftModel(Model):
         outs.append(peer_msgs)
         return row, jnp.concatenate(outs, axis=0)
 
-    def _apply_one(self, row: RaftRow, cfg):
-        do = row.last_applied < row.commit_idx
+    def _apply_frontier(self, row: RaftRow):
+        """(do, aidx, entry) for the next entry to apply; the dirty-apply
+        mutant's frontier is the raw log end instead of the commit index."""
+        frontier = (row.log_len if self.apply_uncommitted
+                    else row.commit_idx)
+        do = row.last_applied < frontier
         aidx = jnp.clip(row.last_applied, 0, self.log_cap - 1)
-        entry = row.log_body[aidx]
+        return do, aidx, row.log_body[aidx]
+
+    def _apply_one(self, row: RaftRow, cfg):
+        do, aidx, entry = self._apply_frontier(row)
         f, k, a, b, client, cmsg = (entry[0], entry[1], entry[2], entry[3],
                                     entry[4], entry[5])
         k = jnp.clip(k, 0, self.n_keys - 1)

@@ -16,11 +16,13 @@ compiles to its own specialized graph with the bug baked in.
 - :class:`RaftNoTermGuard` — the leader commits by match-index count
   alone, without the current-term guard (the Raft §5.4.2 trap): an entry
   replicated by an old-term leader can be committed and then overwritten.
-  NOTE: tripping this needs the full Figure-8 schedule (old-term entry
-  replicated to a majority, leader deposed, entry overwritten after
-  commit) — rare enough that 32 instances x 3s have not yet produced it;
-  it is in the corpus as a hard target for large-fleet time-to-anomaly
-  runs, not in the must-catch CI test.
+  Tripping it needs the Figure-8 schedule (old-term entry replicated to
+  a majority, leader deposed, entry overwritten after commit); the
+  scripted rotating-majorities nemesis constructs exactly that across a
+  fleet of seeds, and the on-device truncated-committed witness flags
+  every occurrence (tests/test_tpu_raft.py::
+  test_raft_no_term_guard_caught_on_figure8 — caught in ~27% of 128
+  instances at 3s horizon; correct Raft stays clean).
 """
 
 from __future__ import annotations

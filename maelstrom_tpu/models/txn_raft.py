@@ -1,0 +1,326 @@
+"""Device-side transactional workloads over the vectorized Raft log.
+
+The reference's txn-list-append / txn-rw-register workloads
+(src/maelstrom/workload/txn_list_append.clj:74-143,
+txn_rw_register.clj:83-168) run lists of micro-ops atomically and hand
+the history to Elle. Here the replicated state machine is the vectorized
+:class:`~.raft.RaftModel` — a whole transaction is ONE log entry, applied
+atomically at commit on every node, with the leader replying read
+results at apply time. This completes the north-star config #5
+(BASELINE.json: txn-list-append over Raft, Elle strict-serializability).
+
+Fixed-shape encodings (SURVEY §7 hard parts):
+
+- a txn is ``txn_max`` micro-op slots ``(f, k, v)`` plus a length lane;
+- request body  = ``[len, (f,k,v)*txn_max]`` (+ a proxy-hops lane);
+- log entry     = ``[len, (f,k,v)*txn_max, client, client_msg_id]``;
+- reply body    = the request echo plus per-micro-op read results
+  (list-append: ``txn_max * list_cap`` value lanes; rw-register: read
+  values folded into the echoed ``v`` lanes);
+- appended/written values are minted unique per instance from the
+  client-striped op counter (``uniq``), which is what lets Elle infer
+  version orders (unique elements per key, txn_list_append.clj:30-38).
+
+A list-append txn whose appends would overflow a key's fixed value slots
+aborts whole with error 30 (txn-conflict, definite) — atomicity is
+preserved and the checker sees a clean :fail.
+
+Bug corpus: :class:`TxnDirtyApply` flips ``apply_uncommitted`` — nodes
+apply and the leader replies at *append* time instead of commit, so a
+leader change truncates acked transactions (lost appends, fractured
+reads) — caught by the Elle checker on recorded instances.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..tpu import wire
+from ..tpu.runtime import EV_INFO, EV_OK, TYPE_ERROR
+from .raft import RaftModel, RaftRow
+
+# micro-op f codes
+MF_R = 1
+MF_APPEND = 2    # list-append write
+MF_W = 2         # rw-register write (same slot, different semantics)
+
+# message types (distinct from the Raft protocol's 10-13)
+T_TXN = 20
+T_TXN_OK = 21
+
+
+class _TxnRaftBase(RaftModel):
+    """Shared txn-over-Raft machinery; subclasses set the state-machine
+    semantics (list-append vs rw-register)."""
+
+    idempotent_fs = ()          # txns are never idempotent
+    write_f = MF_APPEND
+
+    def __init__(self, n_nodes_hint: int = 3, log_cap: int = 96,
+                 n_keys: int = 8, txn_max: int = 3, list_cap: int = 16,
+                 read_prob: float = 0.5, **kw):
+        self.txn_max = txn_max
+        self.list_cap = list_cap
+        self.read_prob = read_prob
+        super().__init__(n_nodes_hint=n_nodes_hint, log_cap=log_cap,
+                         n_keys=n_keys, **kw)
+        # [len, (f,k,v)*txn_max, client, cmsg]
+        self.entry_lanes = 1 + 3 * txn_max + 2
+        self.op_lanes = 1 + 3 * txn_max
+        self.proxy_hops_lane = 1 + 3 * txn_max
+        self.ev_vals = self._reply_width()
+        self.body_lanes = max(6 + self.entry_lanes,
+                              self._reply_width(),
+                              self.proxy_hops_lane + 1)
+
+    def _config(self):
+        return super()._config() + (self.txn_max, self.list_cap,
+                                    self.read_prob)
+
+    def _reply_width(self) -> int:
+        raise NotImplementedError
+
+    # --- request / entry encoding ----------------------------------------
+
+    def _is_client_request(self, mtype):
+        return mtype == T_TXN
+
+    def _encode_entry(self, msg, src):
+        body = jax.lax.dynamic_slice(msg, (wire.BODY,),
+                                     (1 + 3 * self.txn_max,))
+        return jnp.concatenate(
+            [body, jnp.stack([src, msg[wire.MSGID]])])
+
+    # --- client side ------------------------------------------------------
+
+    def sample_op(self, key, uniq, cfg, params):
+        kf, kk, kl = jax.random.split(key, 3)
+        ln = jax.random.randint(kl, (), 1, self.txn_max + 1,
+                                dtype=jnp.int32)
+        fs = jnp.where(
+            jax.random.uniform(kf, (self.txn_max,)) < self.read_prob,
+            MF_R, self.write_f)
+        ks = jax.random.randint(kk, (self.txn_max,), 0, self.n_keys,
+                                dtype=jnp.int32)
+        # unique positive write values per instance (uniq is striped
+        # across clients by the runtime)
+        vs = 1 + uniq * self.txn_max + jnp.arange(self.txn_max,
+                                                  dtype=jnp.int32)
+        op = jnp.zeros((self.op_lanes,), jnp.int32).at[0].set(ln)
+        idx = 1 + 3 * jnp.arange(self.txn_max)
+        op = op.at[idx].set(fs).at[idx + 1].set(ks).at[idx + 2].set(vs)
+        return op
+
+    def sample_final_op(self, key, uniq, cfg, params):
+        """Post-heal phase: all-read txns over random keys, giving the
+        lost-append / version-order analysis dense read coverage (the
+        role of the reference's final reads in set-like workloads)."""
+        kk = jax.random.split(key, 1)[0]
+        ks = jax.random.randint(kk, (self.txn_max,), 0, self.n_keys,
+                                dtype=jnp.int32)
+        op = jnp.zeros((self.op_lanes,), jnp.int32).at[0].set(self.txn_max)
+        idx = 1 + 3 * jnp.arange(self.txn_max)
+        op = op.at[idx].set(MF_R).at[idx + 1].set(ks)
+        return op
+
+    def encode_request(self, op, msg_id, client_idx, key, cfg, params):
+        dest = jax.random.randint(key, (), 0, cfg.n_nodes, dtype=jnp.int32)
+        m = wire.make_msg(src=0, dest=dest, type_=T_TXN, msg_id=msg_id,
+                          body_lanes=self.body_lanes)
+        return jax.lax.dynamic_update_slice(m, op, (wire.BODY,))
+
+    def decode_reply_wide(self, op, msg, cfg, params):
+        ok = msg[wire.TYPE] == T_TXN_OK
+        etype = jnp.where(ok, EV_OK, EV_INFO)
+        vals = jax.lax.dynamic_slice(msg, (wire.BODY,), (self.ev_vals,))
+        return etype, vals
+
+    # --- host-side decoding ----------------------------------------------
+
+    def _micro_ops(self, vals):
+        ln = max(0, min(int(vals[0]), self.txn_max))
+        return [(int(vals[1 + 3 * i]), int(vals[2 + 3 * i]),
+                 int(vals[3 + 3 * i])) for i in range(ln)]
+
+    def invoke_record(self, *vals):
+        txn = []
+        for f, k, v in self._micro_ops(vals):
+            if f == MF_R:
+                txn.append(["r", k, None])
+            else:
+                txn.append([self.write_f_name, k, v])
+        return {"f": "txn", "value": txn}
+
+
+class TxnListAppendModel(_TxnRaftBase):
+    """txn-list-append: reads return the full per-key append list."""
+
+    name = "txn-list-append"
+    write_f_name = "append"
+    write_f = MF_APPEND
+
+    def _reply_width(self):
+        # request echo + txn_max read-result blocks of list_cap values
+        return 1 + 3 * self.txn_max + self.txn_max * self.list_cap
+
+    def _init_kv(self):
+        # [n_keys, 1 + list_cap]: lane 0 = length, 1.. = appended values
+        return jnp.zeros((self.n_keys, 1 + self.list_cap), jnp.int32)
+
+    def _apply_one(self, row: RaftRow, cfg):
+        do, aidx, entry = self._apply_frontier(row)
+        ln, client, cmsg = entry[0], entry[-2], entry[-1]
+
+        kv = row.kv
+        reply = jnp.zeros((self.ev_vals,), jnp.int32)
+        reply = reply.at[0].set(ln)
+        reply = jax.lax.dynamic_update_slice(
+            reply, entry[1:1 + 3 * self.txn_max], (1,))
+        rbase = 1 + 3 * self.txn_max
+        overflow = jnp.bool_(False)
+        for i in range(self.txn_max):
+            active = i < ln
+            f = entry[1 + 3 * i]
+            k = jnp.clip(entry[2 + 3 * i], 0, self.n_keys - 1)
+            v = entry[3 + 3 * i]
+            is_rd = active & (f == MF_R)
+            is_app = active & (f == MF_APPEND)
+            # read: snapshot k's list (sees earlier appends in this txn)
+            reply = jax.lax.dynamic_update_slice(
+                reply, jnp.where(is_rd, kv[k, 1:], 0),
+                (rbase + i * self.list_cap,))
+            # append: push v
+            lk = kv[k, 0]
+            fits = lk < self.list_cap
+            overflow = overflow | (is_app & ~fits)
+            new_kv = kv.at[k, 1 + jnp.clip(lk, 0, self.list_cap - 1)
+                           ].set(v).at[k, 0].add(1)
+            kv = jnp.where(is_app & fits, new_kv, kv)
+
+        ok = ~overflow
+        row = row._replace(
+            kv=jnp.where(do & ok, kv, row.kv),
+            last_applied=jnp.where(do, row.last_applied + 1,
+                                   row.last_applied))
+
+        out = jnp.zeros((1, cfg.lanes), dtype=jnp.int32)
+        out = out.at[0, wire.VALID].set(
+            jnp.where(do & (row.role == 2), 1, 0))
+        out = out.at[0, wire.DEST].set(client)
+        out = out.at[0, wire.TYPE].set(
+            jnp.where(ok, T_TXN_OK, TYPE_ERROR))
+        out = out.at[0, wire.REPLYTO].set(cmsg)
+        body = jnp.where(
+            ok, reply,
+            jnp.zeros_like(reply).at[0].set(30))  # 30 = txn-conflict
+        out = jax.lax.dynamic_update_slice(out, body[None],
+                                           (0, wire.BODY))
+        return row, out
+
+    def complete_record(self, *vals_etype):
+        vals, etype = vals_etype[:-1], vals_etype[-1]
+        if etype != EV_OK:
+            return self.invoke_record(*vals)
+        rbase = 1 + 3 * self.txn_max
+        txn = []
+        for i, (f, k, v) in enumerate(self._micro_ops(vals)):
+            if f == MF_R:
+                block = vals[rbase + i * self.list_cap:
+                             rbase + (i + 1) * self.list_cap]
+                lst = []
+                for x in block:
+                    if x == 0:
+                        break
+                    lst.append(int(x))
+                txn.append(["r", k, lst])
+            else:
+                txn.append(["append", k, v])
+        return {"f": "txn", "value": txn}
+
+    def checker(self):
+        from ..checkers.elle import check_list_append
+        return lambda history, opts: check_list_append(
+            history, (opts or {}).get("consistency_models")
+            or "strict-serializable")
+
+
+class TxnRwRegisterModel(_TxnRaftBase):
+    """txn-rw-register: read/write register micro-ops; reads fold their
+    value into the echoed ``v`` lane."""
+
+    name = "txn-rw-register"
+    write_f_name = "w"
+    write_f = MF_W
+
+    def _reply_width(self):
+        return 1 + 3 * self.txn_max
+
+    def _init_kv(self):
+        return jnp.zeros((self.n_keys,), jnp.int32)   # 0 = unwritten
+
+    def _apply_one(self, row: RaftRow, cfg):
+        do, aidx, entry = self._apply_frontier(row)
+        ln, client, cmsg = entry[0], entry[-2], entry[-1]
+
+        kv = row.kv
+        reply = jnp.zeros((self.ev_vals,), jnp.int32)
+        reply = reply.at[0].set(ln)
+        reply = jax.lax.dynamic_update_slice(
+            reply, entry[1:1 + 3 * self.txn_max], (1,))
+        for i in range(self.txn_max):
+            active = i < ln
+            f = entry[1 + 3 * i]
+            k = jnp.clip(entry[2 + 3 * i], 0, self.n_keys - 1)
+            v = entry[3 + 3 * i]
+            is_rd = active & (f == MF_R)
+            is_wr = active & (f == MF_W)
+            # read result replaces the echoed v lane
+            reply = reply.at[3 + 3 * i].set(
+                jnp.where(is_rd, kv[k], reply[3 + 3 * i]))
+            kv = jnp.where(is_wr, kv.at[k].set(v), kv)
+
+        row = row._replace(
+            kv=jnp.where(do, kv, row.kv),
+            last_applied=jnp.where(do, row.last_applied + 1,
+                                   row.last_applied))
+
+        out = jnp.zeros((1, cfg.lanes), dtype=jnp.int32)
+        out = out.at[0, wire.VALID].set(
+            jnp.where(do & (row.role == 2), 1, 0))
+        out = out.at[0, wire.DEST].set(client)
+        out = out.at[0, wire.TYPE].set(T_TXN_OK)
+        out = out.at[0, wire.REPLYTO].set(cmsg)
+        out = jax.lax.dynamic_update_slice(out, reply[None],
+                                           (0, wire.BODY))
+        return row, out
+
+    def complete_record(self, *vals_etype):
+        vals, etype = vals_etype[:-1], vals_etype[-1]
+        if etype != EV_OK:
+            return self.invoke_record(*vals)
+        txn = []
+        for f, k, v in self._micro_ops(vals):
+            if f == MF_R:
+                txn.append(["r", k, None if v == 0 else v])
+            else:
+                txn.append(["w", k, v])
+        return {"f": "txn", "value": txn}
+
+    def checker(self):
+        from ..checkers.elle import check_rw_register
+        return lambda history, opts: check_rw_register(
+            history, (opts or {}).get("consistency_models")
+            or "strict-serializable")
+
+
+class TxnDirtyApply(TxnListAppendModel):
+    """BUG: apply + reply at append time instead of commit — a leader
+    change truncates acked txns (lost appends / fractured reads)."""
+    name = "txn-list-append-bug-dirty-apply"
+    apply_uncommitted = True
+
+
+TXN_BUGGY_MODELS = {
+    "dirty-apply": TxnDirtyApply,
+}

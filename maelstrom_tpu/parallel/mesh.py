@@ -71,7 +71,7 @@ def run_sim_sharded(model: Model, sim: SimConfig, seed: int, params=None,
     Returns (fleet-wide NetStats summed over devices, per-instance
     on-device invariant-violation tick counts
     [n_instances * n_devices], events [T, R * n_devices, C, 2,
-    EV_LANES]).
+    2 + model.ev_vals]).
     """
     mesh = mesh or make_mesh()
     n = mesh.devices.size

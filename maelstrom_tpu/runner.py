@@ -243,8 +243,9 @@ class TestRunner:
                 traceback.print_exc()
                 results["workload"] = {"valid?": False,
                                        "error": repr(e)}
-        results["valid?"] = all(
-            r.get("valid?", True) is not False
+        from .checkers import compose_valid
+        results["valid?"] = compose_valid(
+            r.get("valid?", True)
             for r in results.values() if isinstance(r, dict))
         return results
 

@@ -37,14 +37,12 @@ from .netsim import NetConfig, NetStats
 
 # --- history events -------------------------------------------------------
 
-# event lanes: [etype, f, a, b, c, msg_id]
+# event lanes: [etype, vals[model.ev_vals], msg_id] — width 2 + ev_vals.
+# Default models record 4 value lanes (f, a, b, c); wide-payload models
+# (transactions, kafka) raise Model.ev_vals and the last lane is always
+# the msg id.
 EV_TYPE = 0
-EV_F = 1
-EV_A = 2
-EV_B = 3
-EV_C = 4
-EV_MSGID = 5
-EV_LANES = 6
+EV_VALS = 1          # first value lane; msg_id lives at lane 1 + ev_vals
 
 EV_NONE = 0
 EV_INVOKE = 1
@@ -79,6 +77,10 @@ class Model:
     max_out: int = 1          # messages emitted per handled message
     tick_out: int = 0         # messages emitted by the per-tick hook
     idempotent_fs: Tuple[int, ...] = ()   # f codes safe to fail on timeout
+    op_lanes: int = OP_LANES  # width of a client op row (default f,a,b,c)
+    ev_vals: int = 4          # value lanes per history event; models with
+                              # wide payloads (transactions) raise this and
+                              # implement decode_reply_wide
 
     # models are stateless singletons: hash by type so fresh instances hit
     # the jit cache instead of forcing a recompile per Model()
@@ -143,7 +145,16 @@ class Model:
     def decode_reply(self, op, msg, cfg: NetConfig, params
                      ) -> Tuple[jnp.ndarray, jnp.ndarray]:
         """Given the op and its reply message, return
-        (etype in {EV_OK, EV_FAIL, EV_INFO}, value[3] result lanes)."""
+        (etype in {EV_OK, EV_FAIL, EV_INFO}, value[3] result lanes).
+        Used when ``ev_vals == 4``; the completion event records
+        ``(op[0], value[0], value[1], value[2])``."""
+        raise NotImplementedError
+
+    def decode_reply_wide(self, op, msg, cfg: NetConfig, params
+                          ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """Wide-payload models (``ev_vals != 4``): return
+        (etype, vals[ev_vals]) — the FULL value-lane row recorded for the
+        completion event (the invocation records the op row, padded)."""
         raise NotImplementedError
 
 
@@ -172,10 +183,10 @@ class ClientState(NamedTuple):
     invoked: jnp.ndarray       # [C] tick of invocation
 
     @staticmethod
-    def init(C: int):
+    def init(C: int, op_lanes: int = OP_LANES):
         return ClientState(
             status=jnp.zeros((C,), jnp.int32),
-            op=jnp.zeros((C, OP_LANES), jnp.int32),
+            op=jnp.zeros((C, op_lanes), jnp.int32),
             msg_id=jnp.full((C,), -1, jnp.int32),
             next_msg_id=jnp.zeros((C,), jnp.int32),
             invoked=jnp.zeros((C,), jnp.int32),
@@ -186,7 +197,7 @@ def client_step(model: Model, cs: ClientState, inbox_clients, t, key,
                 cfg: NetConfig, ccfg: ClientConfig, params):
     """One tick for all C clients of one instance.
 
-    Returns (cs', requests [C, L], events [C, 2, EV_LANES]).
+    Returns (cs', requests [C, L], events [C, 2, 2 + model.ev_vals]).
     Event slot 0 = completion, slot 1 = invocation. A client that completes
     this tick goes idle immediately and MAY fire a new op in the same tick;
     the history decoder orders slot 0 before slot 1, so the completion
@@ -194,7 +205,14 @@ def client_step(model: Model, cs: ClientState, inbox_clients, t, key,
     """
     C = ccfg.n_clients
     L = cfg.lanes
-    events = jnp.zeros((C, 2, EV_LANES), dtype=jnp.int32)
+    V = model.ev_vals
+    events = jnp.zeros((C, 2, 2 + V), dtype=jnp.int32)
+
+    def pad_op(op_rows):
+        """[C, op_lanes] -> [C, V] (truncate or zero-pad)."""
+        if op_rows.shape[1] >= V:
+            return op_rows[:, :V]
+        return jnp.pad(op_rows, ((0, 0), (0, V - op_rows.shape[1])))
 
     # --- completions: find a reply matching our outstanding msg_id
     def find_reply(client_idx):
@@ -208,13 +226,28 @@ def client_step(model: Model, cs: ClientState, inbox_clients, t, key,
 
     has_reply, reply = jax.vmap(find_reply)(jnp.arange(C))
 
-    def decode_one(op, msg):
-        is_err = msg[wire.TYPE] == TYPE_ERROR
-        et_err, val_err = decode_error_reply(msg)
-        et_ok, val_ok = model.decode_reply(op, msg, cfg, params)
-        etype = jnp.where(is_err, et_err, et_ok)
-        value = jnp.where(is_err, val_err, val_ok)
-        return etype, value
+    if V == 4:
+        def decode_one(op, msg):
+            is_err = msg[wire.TYPE] == TYPE_ERROR
+            et_err, val_err = decode_error_reply(msg)
+            et_ok, val_ok = model.decode_reply(op, msg, cfg, params)
+            etype = jnp.where(is_err, et_err, et_ok)
+            value = jnp.where(is_err, val_err, val_ok)
+            # completion vals = (f, value lanes)
+            return etype, jnp.concatenate([op[0:1], value])
+    else:
+        def decode_one(op, msg):
+            is_err = msg[wire.TYPE] == TYPE_ERROR
+            et_err, _ = decode_error_reply(msg)
+            et_ok, vals_ok = model.decode_reply_wide(op, msg, cfg, params)
+            etype = jnp.where(is_err, et_err, et_ok)
+            # errors echo the op row (the invocation's value)
+            if op.shape[0] >= V:
+                op_pad = op[:V]
+            else:
+                op_pad = jnp.zeros((V,), jnp.int32).at[:op.shape[0]].set(op)
+            vals = jnp.where(is_err, op_pad, vals_ok)
+            return etype, vals
 
     etype_r, value_r = jax.vmap(decode_one)(cs.op, reply)
 
@@ -228,17 +261,11 @@ def client_step(model: Model, cs: ClientState, inbox_clients, t, key,
 
     completed = has_reply | timed_out
     comp_etype = jnp.where(has_reply, etype_r, etype_t)
-    comp_value = jnp.where(has_reply[:, None], value_r, 0)
+    comp_vals = jnp.where(has_reply[:, None], value_r, pad_op(cs.op))
     events = events.at[:, 0, EV_TYPE].set(
         jnp.where(completed, comp_etype, EV_NONE))
-    events = events.at[:, 0, EV_F].set(cs.op[:, 0])
-    events = events.at[:, 0, EV_A].set(
-        jnp.where(has_reply, comp_value[:, 0], cs.op[:, 1]))
-    events = events.at[:, 0, EV_B].set(
-        jnp.where(has_reply, comp_value[:, 1], cs.op[:, 2]))
-    events = events.at[:, 0, EV_C].set(
-        jnp.where(has_reply, comp_value[:, 2], cs.op[:, 3]))
-    events = events.at[:, 0, EV_MSGID].set(cs.msg_id)
+    events = events.at[:, 0, 1:1 + V].set(comp_vals)
+    events = events.at[:, 0, 1 + V].set(cs.msg_id)
 
     status = jnp.where(completed, 0, cs.status)
 
@@ -248,11 +275,14 @@ def client_step(model: Model, cs: ClientState, inbox_clients, t, key,
     fire = idle & (jax.random.uniform(k_rate, (C,)) < ccfg.rate)
     op_keys = jax.random.split(k_ops, C)
     in_final = t >= ccfg.final_start
+    # uniq: instance-globally-unique op counter (client-striped), so
+    # models can mint distinct values (e.g. unique appended elements)
+    uniq = cs.next_msg_id * C + jnp.arange(C, dtype=jnp.int32)
     new_ops = jax.vmap(
         lambda k, u: jnp.where(
             in_final,
             model.sample_final_op(k, u, cfg, params),
-            model.sample_op(k, u, cfg, params)))(op_keys, cs.next_msg_id)
+            model.sample_op(k, u, cfg, params)))(op_keys, uniq)
     op = jnp.where(fire[:, None], new_ops, cs.op)
     msg_id = jnp.where(fire, cs.next_msg_id, cs.msg_id)
     next_msg_id = jnp.where(fire, cs.next_msg_id + 1, cs.next_msg_id)
@@ -271,11 +301,8 @@ def client_step(model: Model, cs: ClientState, inbox_clients, t, key,
 
     events = events.at[:, 1, EV_TYPE].set(
         jnp.where(fire, EV_INVOKE, EV_NONE))
-    events = events.at[:, 1, EV_F].set(op[:, 0])
-    events = events.at[:, 1, EV_A].set(op[:, 1])
-    events = events.at[:, 1, EV_B].set(op[:, 2])
-    events = events.at[:, 1, EV_C].set(op[:, 3])
-    events = events.at[:, 1, EV_MSGID].set(msg_id)
+    events = events.at[:, 1, 1:1 + V].set(pad_op(op))
+    events = events.at[:, 1, 1 + V].set(msg_id)
 
     cs = ClientState(status=status, op=op, msg_id=msg_id,
                      next_msg_id=next_msg_id, invoked=invoked)
@@ -458,7 +485,7 @@ def init_carry(model: Model, sim: SimConfig, seed: int, params) -> Carry:
         node_state=node_state,
         client_state=jax.tree.map(
             lambda a: jnp.broadcast_to(a, (I,) + a.shape),
-            ClientState.init(sim.client.n_clients)),
+            ClientState.init(sim.client.n_clients, model.op_lanes)),
         stats=NetStats.zeros(),
         violations=jnp.zeros((I,), jnp.int32),
         key=key,
@@ -524,7 +551,7 @@ def make_tick_fn(model: Model, sim: SimConfig, params) -> Callable:
 def simulate(model: Model, sim: SimConfig, seed, params=None
              ) -> Tuple[Carry, jnp.ndarray]:
     """Traceable simulation body (used directly inside shard_map);
-    returns (final carry, events [T, R, C, 2, EV_LANES])."""
+    returns (final carry, events [T, R, C, 2, 2 + model.ev_vals])."""
     carry = init_carry(model, sim, seed, params)
     tick_fn = make_tick_fn(model, sim, params)
     return jax.lax.scan(tick_fn, carry,

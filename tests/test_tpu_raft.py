@@ -4,8 +4,11 @@ faults, and injected-bug detection (SURVEY §7 steps 7-8)."""
 import pytest
 
 from maelstrom_tpu.models.raft import RaftModel
-from maelstrom_tpu.models.raft_buggy import RaftDoubleVote, RaftStaleRead
+from maelstrom_tpu.models.raft_buggy import (RaftDoubleVote,
+                                             RaftNoTermGuard,
+                                             RaftStaleRead)
 from maelstrom_tpu.tpu.harness import run_tpu_test
+from maelstrom_tpu.tpu.runtime import scripted_isolate_groups
 
 
 def test_raft_linearizable_happy_path():
@@ -36,14 +39,52 @@ BUG_OPTS = dict(node_count=3, concurrency=3, n_instances=24,
                 seed=2)
 
 
-# RaftNoTermGuard is deliberately absent: the §5.4.2 commit bug needs the
-# Figure-8 schedule, which these shapes don't reliably produce (see
-# models/raft_buggy.py) — asserting it's caught here would be a lie.
+# RaftNoTermGuard needs the Figure-8 schedule — see
+# test_raft_no_term_guard_caught_on_figure8 below; all three corpus
+# mutants are now demonstrably caught.
 @pytest.mark.parametrize("buggy", [RaftDoubleVote, RaftStaleRead])
 def test_raft_injected_bugs_are_caught(buggy):
     res = run_tpu_test(buggy(n_nodes_hint=3), BUG_OPTS)
     assert res["valid?"] is False, \
         f"{buggy.__name__}: checker failed to catch the injected bug"
+
+
+def _rotating_majorities_schedule(n=5, phase_len=200, horizon_ticks=3500):
+    """Scripted rotating 3-node majorities over a 5-node cluster: each
+    phase only one majority group can talk, and the pivot node rotates —
+    the repeated partial-replication / leader-change pattern that
+    realizes the Raft §5.4.2 Figure-8 scenario across a fleet of seeds."""
+    groups_cycle = [({0, 1, 2},), ({2, 3, 4},), ({4, 0, 1},),
+                    ({1, 2, 3},), ({3, 4, 0},)]
+    sched, t, i = [], 0, 0
+    while t < horizon_ticks - 500:
+        t += phase_len
+        sched.append(scripted_isolate_groups(t, groups_cycle[i % 5], n))
+        i += 1
+    return tuple(sched)
+
+
+def test_raft_no_term_guard_caught_on_figure8():
+    """The §5.4.2 commit bug: an old-term entry committed on replication
+    count alone gets overwritten after a leader change. The on-device
+    truncated-committed witness (a node overwriting below its own commit
+    index) catches it fleet-wide under the rotating-majorities schedule;
+    correct Raft stays clean on the identical schedule."""
+    opts = dict(node_count=5, concurrency=4, n_instances=64,
+                record_instances=1, time_limit=3.5, rate=60.0,
+                latency=5.0, rpc_timeout=0.8, nemesis=["partition"],
+                nemesis_kind="scripted",
+                nemesis_schedule=_rotating_majorities_schedule(),
+                recovery_time=0.5, seed=11)
+    res = run_tpu_test(RaftNoTermGuard(n_nodes_hint=5, log_cap=64), opts)
+    inv = res["invariants"]
+    assert inv["violating-instances"] >= 3, inv
+    assert res["valid?"] is False
+
+    res_ok = run_tpu_test(RaftModel(n_nodes_hint=5, log_cap=64), opts)
+    assert res_ok["invariants"]["violating-instances"] == 0, \
+        res_ok["invariants"]
+    assert res_ok["valid?"] is True, res_ok["instances"]
 
 
 def test_raft_correct_same_config_as_bug_hunt():
