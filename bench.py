@@ -81,13 +81,13 @@ def child_main() -> None:
 
     log(TAG, "phase: compile + warm-up")
     t0 = time.monotonic()
-    carry, events = run_sim(model, sim, 7, params)
+    carry, _ = run_sim(model, sim, 7, params)
     jax.block_until_ready(carry.stats.delivered)
     log(TAG, f"phase: compiled in {time.monotonic() - t0:.1f}s; "
              f"timed run")
 
     t0 = time.monotonic()
-    carry, events = run_sim(model, sim, 8, params)
+    carry, _ = run_sim(model, sim, 8, params)
     jax.block_until_ready(carry.stats.delivered)
     wall = time.monotonic() - t0
 

@@ -1,0 +1,61 @@
+#!/usr/bin/env node
+// Broadcast node (JS): fire-and-forget gossip along the topology with a
+// periodic anti-entropy retry of unacked values (partition tolerant).
+"use strict";
+const { Node } = require(require("path").join(__dirname, "node"));
+
+const node = new Node();
+const messages = new Set();
+let neighbors = [];
+const pending = new Map();   // peer -> Set of unacked values
+
+node.on("topology", (msg) => {
+  neighbors = (msg.body.topology || {})[node.nodeId] || [];
+  for (const n of neighbors) if (!pending.has(n)) pending.set(n, new Set());
+  node.reply(msg, { type: "topology_ok" });
+});
+
+function gossipTo(dest) {
+  const vals = [...(pending.get(dest) || [])];
+  if (!vals.length) return;
+  node.rpc(dest, { type: "gossip", messages: vals, ack: true }, 1000)
+    .then(() => {
+      const p = pending.get(dest);
+      if (p) for (const v of vals) p.delete(v);
+    })
+    .catch(() => {});   // retry timer re-sends
+}
+
+function propagate(vals, exclude) {
+  for (const nbr of neighbors) {
+    if (nbr === exclude) continue;
+    const p = pending.get(nbr) || new Set();
+    for (const v of vals) p.add(v);
+    pending.set(nbr, p);
+    gossipTo(nbr);
+  }
+}
+
+node.on("broadcast", (msg) => {
+  const m = msg.body.message;
+  if (!messages.has(m)) {
+    messages.add(m);
+    propagate([m], msg.src);
+  }
+  node.reply(msg, { type: "broadcast_ok" });
+});
+
+node.on("gossip", (msg) => {
+  const fresh = (msg.body.messages || []).filter((m) => !messages.has(m));
+  for (const m of fresh) messages.add(m);
+  if (fresh.length) propagate(fresh, msg.src);
+  if (msg.body.ack) node.reply(msg, { type: "gossip_ok" });
+});
+
+node.on("read", (msg) =>
+  node.reply(msg, { type: "read_ok",
+                    messages: [...messages].sort((a, b) => a - b) }));
+
+node.every(200, () => { for (const n of neighbors) gossipTo(n); });
+
+node.run();

@@ -1,0 +1,9 @@
+#!/usr/bin/env node
+// Echo node (JS): the smallest complete workload node.
+"use strict";
+const { Node } = require(require("path").join(__dirname, "node"));
+
+const node = new Node();
+node.on("echo", (msg) =>
+  node.reply(msg, { type: "echo_ok", echo: msg.body.echo }));
+node.run();

@@ -1,0 +1,30 @@
+#!/usr/bin/env node
+// Grow-only set CRDT node (JS): periodic full-state gossip merge.
+"use strict";
+const { Node } = require(require("path").join(__dirname, "node"));
+
+const node = new Node();
+const elements = new Set();
+
+node.on("add", (msg) => {
+  elements.add(msg.body.element);
+  node.reply(msg, { type: "add_ok" });
+});
+
+node.on("read", (msg) =>
+  node.reply(msg, { type: "read_ok", value: [...elements].sort() }));
+
+node.on("merge", (msg) => {
+  for (const e of msg.body.value || []) elements.add(e);
+  node.reply(msg, { type: "merge_ok" });
+});
+
+node.every(300, () => {
+  const peers = node.nodeIds.filter((n) => n !== node.nodeId);
+  if (!peers.length) return;
+  const peer = peers[Math.floor(Math.random() * peers.length)];
+  node.rpc(peer, { type: "merge", value: [...elements] }, 1000)
+    .catch(() => {});
+});
+
+node.run();

@@ -1,0 +1,27 @@
+#!/usr/bin/env node
+// lin-kv proxy node (JS): serves read/write/cas by delegating to the
+// built-in linearizable lin-kv service (the service-client demo).
+"use strict";
+const path = require("path");
+const { Node, KV, RPCError } = require(path.join(__dirname, "node"));
+
+const node = new Node();
+const kv = new KV(node, "lin-kv", 2000);
+
+node.on("read", async (msg) => {
+  const value = await kv.read(msg.body.key, null);
+  node.reply(msg, { type: "read_ok", value });
+});
+
+node.on("write", async (msg) => {
+  await kv.write(msg.body.key, msg.body.value);
+  node.reply(msg, { type: "write_ok" });
+});
+
+node.on("cas", async (msg) => {
+  await kv.cas(msg.body.key, msg.body.from, msg.body.to,
+               !!msg.body.create_if_not_exists);
+  node.reply(msg, { type: "cas_ok" });
+});
+
+node.run();

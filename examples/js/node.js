@@ -1,0 +1,175 @@
+// Node SDK for the maelstrom-tpu process runtime (JavaScript edition).
+//
+// Line-delimited JSON over STDIN/STDOUT, handler registry, async RPC
+// with promises, periodic tasks, and a KV client for the built-in
+// services — the same node-framework role as examples/python/node.py
+// and cpp/maelstrom/node.hpp (reference counterpart: the demo-language
+// node libraries surveyed in SURVEY.md §2.3).
+//
+// Usage:
+//   const { Node } = require("./node");
+//   const node = new Node();
+//   node.on("echo", (msg) => node.reply(msg, { type: "echo_ok",
+//                                              echo: msg.body.echo }));
+//   node.run();
+"use strict";
+
+const readline = require("readline");
+
+class RPCError extends Error {
+  constructor(code, text) {
+    super(`RPC error ${code}: ${text}`);
+    this.code = code;
+    this.text = text;
+  }
+  body() {
+    return { type: "error", code: this.code, text: this.text };
+  }
+  static timeout(t) { return new RPCError(0, t); }
+  static notSupported(t) { return new RPCError(10, t); }
+  static tempUnavailable(t) { return new RPCError(11, t); }
+  static malformed(t) { return new RPCError(12, t); }
+  static abort(t) { return new RPCError(14, t); }
+  static keyDoesNotExist(t) { return new RPCError(20, t); }
+  static preconditionFailed(t) { return new RPCError(22, t); }
+  static txnConflict(t) { return new RPCError(30, t); }
+}
+
+class Node {
+  constructor() {
+    this.nodeId = null;
+    this.nodeIds = [];
+    this.handlers = new Map();     // type -> fn(msg)
+    this.callbacks = new Map();    // msg_id -> {resolve, reject, timer}
+    this.nextMsgId = 0;
+    this.initCallbacks = [];
+    this.timers = [];
+  }
+
+  log(...args) {
+    process.stderr.write(args.join(" ") + "\n");
+  }
+
+  send(dest, body) {
+    process.stdout.write(
+      JSON.stringify({ src: this.nodeId, dest, body }) + "\n");
+  }
+
+  reply(req, body) {
+    this.send(req.src, { ...body, in_reply_to: req.body.msg_id });
+  }
+
+  // Promise-based RPC; rejects with RPCError on error replies/timeouts.
+  rpc(dest, body, timeoutMs = 5000) {
+    const msgId = this.nextMsgId++;
+    return new Promise((resolve, reject) => {
+      const timer = setTimeout(() => {
+        this.callbacks.delete(msgId);
+        reject(RPCError.timeout(`no reply to ${body.type} within ` +
+                                `${timeoutMs}ms`));
+      }, timeoutMs);
+      this.callbacks.set(msgId, { resolve, reject, timer });
+      this.send(dest, { ...body, msg_id: msgId });
+    });
+  }
+
+  on(type, fn) {
+    this.handlers.set(type, fn);
+    return this;
+  }
+
+  every(intervalMs, fn) {
+    this.timers.push([intervalMs, fn]);
+  }
+
+  _dispatch(msg) {
+    const body = msg.body || {};
+    if (body.in_reply_to !== undefined && body.in_reply_to !== null) {
+      const cb = this.callbacks.get(body.in_reply_to);
+      if (cb) {
+        this.callbacks.delete(body.in_reply_to);
+        clearTimeout(cb.timer);
+        if (body.type === "error") {
+          cb.reject(new RPCError(body.code, body.text));
+        } else {
+          cb.resolve(body);
+        }
+      }
+      return;
+    }
+    if (body.type === "init") {
+      this.nodeId = body.node_id;
+      this.nodeIds = body.node_ids;
+      this.log(`node ${this.nodeId} initialized`);
+      this.reply(msg, { type: "init_ok" });
+      for (const [interval, fn] of this.timers) setInterval(fn, interval);
+      for (const fn of this.initCallbacks) fn();
+      return;
+    }
+    const handler = this.handlers.get(body.type);
+    if (!handler) {
+      this.reply(msg, RPCError.notSupported(
+        `unknown message type ${body.type}`).body());
+      return;
+    }
+    Promise.resolve()
+      .then(() => handler(msg))
+      .catch((e) => {
+        const err = e instanceof RPCError
+          ? e : new RPCError(13, String(e && e.stack || e));
+        this.reply(msg, err.body());
+      });
+  }
+
+  run() {
+    const rl = readline.createInterface({ input: process.stdin });
+    rl.on("line", (line) => {
+      line = line.trim();
+      if (!line) return;
+      let msg;
+      try {
+        msg = JSON.parse(line);
+      } catch (e) {
+        this.log(`malformed input line: ${line}`);
+        return;
+      }
+      this._dispatch(msg);
+    });
+  }
+}
+
+// Client for the built-in KV services (lin-kv / seq-kv / lww-kv).
+class KV {
+  constructor(node, service = "lin-kv", timeoutMs = 1000) {
+    this.node = node;
+    this.service = service;
+    this.timeoutMs = timeoutMs;
+  }
+
+  async read(key, dflt) {
+    try {
+      const body = await this.node.rpc(
+        this.service, { type: "read", key }, this.timeoutMs);
+      return body.value;
+    } catch (e) {
+      if (e instanceof RPCError && e.code === 20 && dflt !== undefined) {
+        return dflt;
+      }
+      throw e;
+    }
+  }
+
+  async write(key, value) {
+    await this.node.rpc(this.service,
+                        { type: "write", key, value }, this.timeoutMs);
+  }
+
+  async cas(key, from, to, createIfNotExists = false) {
+    await this.node.rpc(this.service, {
+      type: "cas", key, from, to,
+      create_if_not_exists: createIfNotExists,
+    }, this.timeoutMs);
+  }
+}
+
+module.exports = { Node, KV, RPCError };

@@ -1,10 +1,17 @@
 #!/usr/bin/env python3
-"""Broadcast node: gossips messages along the topology with batched,
-acknowledged retries, so broadcasts survive partitions while keeping
-msgs-per-op low (one gossip message per peer per retry tick carries ALL
-unacked values). The role of the reference's demo/ruby/broadcast.rb
-retry loop, plus the batching optimization its performance chapter works
-toward (doc/03-broadcast/02-performance.md)."""
+"""Broadcast node with two gossip disciplines:
+
+- default (acked): batched, acknowledged retries — broadcasts survive
+  partitions (the retry-until-ack design the reference's performance
+  chapter builds for fault tolerance,
+  doc/03-broadcast/02-performance.md:513-545), at the cost of an ack per
+  gossip.
+- ``--ff`` (fire-and-forget): each new value crosses every topology edge
+  exactly once, no acks, no retries — the minimal-traffic discipline the
+  chapter's efficiency sections measure (2.94 msgs/op on 5 nodes,
+  ~12.0 on 25-node tree4, doc/03-broadcast/02-performance.md:71-76,
+  249-254). Not partition-tolerant; pair with a healed network.
+"""
 
 import os
 import sys
@@ -12,10 +19,12 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 from node import Node  # noqa: E402
 
+FIRE_AND_FORGET = "--ff" in sys.argv[1:]
+
 node = Node()
 messages = set()
 neighbors = []
-# peer -> set of values not yet acknowledged by that peer
+# peer -> set of values not yet acknowledged by that peer (acked mode)
 pending = {}
 
 
@@ -25,18 +34,27 @@ def topology(msg):
     neighbors = msg["body"]["topology"].get(node.node_id, [])
     for nbr in neighbors:
         pending.setdefault(nbr, set())
-    node.log(f"topology: neighbors = {neighbors}")
+    node.log(f"topology: neighbors = {neighbors} "
+             f"({'ff' if FIRE_AND_FORGET else 'acked'} gossip)")
     node.reply(msg, {"type": "topology_ok"})
 
 
-def gossip(m, exclude):
+def propagate(new_vals, exclude):
+    """Hand new values to the active gossip discipline."""
+    if FIRE_AND_FORGET:
+        batch = sorted(new_vals)
+        for nbr in neighbors:
+            if nbr != exclude:
+                node.send(nbr, {"type": "gossip", "messages": batch})
+        return
     for nbr in neighbors:
         if nbr != exclude:
-            pending.setdefault(nbr, set()).add(m)
+            pending.setdefault(nbr, set()).update(new_vals)
+    flush()
 
 
 def flush():
-    """One batched gossip per peer carrying everything it hasn't acked."""
+    """One batched acked gossip per peer with everything it hasn't acked."""
     for dest, vals in pending.items():
         if not vals:
             continue
@@ -46,7 +64,8 @@ def flush():
             with node.lock:
                 pending.get(dest, set()).difference_update(batch)
 
-        node.rpc(dest, {"type": "gossip", "messages": batch}, on_ack)
+        node.rpc(dest, {"type": "gossip", "messages": batch, "ack": True},
+                 on_ack)
 
 
 @node.on("broadcast")
@@ -54,8 +73,7 @@ def broadcast(msg):
     m = msg["body"]["message"]
     if m not in messages:
         messages.add(m)
-        gossip(m, exclude=msg["src"])
-        flush()   # propagate immediately; the timer only covers losses
+        propagate({m}, exclude=msg["src"])
     node.reply(msg, {"type": "broadcast_ok"})
 
 
@@ -63,11 +81,10 @@ def broadcast(msg):
 def handle_gossip(msg):
     new = set(msg["body"]["messages"]) - messages
     messages.update(new)
-    for m in new:
-        gossip(m, exclude=msg["src"])
     if new:
-        flush()
-    node.reply(msg, {"type": "gossip_ok"})
+        propagate(new, exclude=msg["src"])
+    if msg["body"].get("ack"):
+        node.reply(msg, {"type": "gossip_ok"})
 
 
 @node.on("read")
@@ -77,7 +94,8 @@ def read(msg):
 
 @node.every(0.2)
 def retry():
-    flush()
+    if not FIRE_AND_FORGET:
+        flush()
 
 
 if __name__ == "__main__":

@@ -68,8 +68,12 @@ def kafka_checker(history) -> dict:
                     max_polled[k] = max(max_polled[k], off)
                 if msgs:
                     pk = (inv["process"], k)
-                    if msgs[0][0] <= last_poll_pos[pk] \
-                            and not inv.get("reassigned"):
+                    # a reassigned consumer (fresh client resuming from
+                    # committed offsets after a crash) may legally jump
+                    # backwards; the flag can ride either record
+                    reassigned = (inv.get("reassigned")
+                                  or comp.get("reassigned"))
+                    if msgs[0][0] <= last_poll_pos[pk] and not reassigned:
                         anomalies["external-nonmonotonic"].append(
                             {"key": k, "process": inv["process"],
                              "offsets": [last_poll_pos[pk], msgs[0][0]]})

@@ -69,6 +69,10 @@ def add_test_options(p: argparse.ArgumentParser):
     p.add_argument("--key-count", type=int, default=None)
     p.add_argument("--max-txn-length", type=int, default=None)
     p.add_argument("--max-writes-per-key", type=int, default=None)
+    p.add_argument("--crash-clients", action="store_true",
+                   help="kafka: inject client crash ops; crashed "
+                        "clients are discarded and reopened, resuming "
+                        "from committed offsets")
     p.add_argument("--consistency-models", default=None,
                    choices=["read-uncommitted", "read-committed",
                             "read-atomic", "serializable",
@@ -81,6 +85,9 @@ def add_test_options(p: argparse.ArgumentParser):
     # TPU-runtime knobs
     p.add_argument("--n-instances", type=int, default=64)
     p.add_argument("--record-instances", type=int, default=8)
+    p.add_argument("--journal-instances", type=int, default=1,
+                   help="TPU runtime: instances with full per-message "
+                        "journals (messages.svg + msgs-per-op)")
     p.add_argument("--p-loss", type=float, default=0.0)
 
 
@@ -112,6 +119,7 @@ def cmd_test(args) -> int:
             max_txn_length=args.max_txn_length,
             max_writes_per_key=args.max_writes_per_key,
             consistency_models=args.consistency_models,
+            crash_clients=args.crash_clients,
             log_stderr=args.log_stderr,
             log_net_send=args.log_net_send,
             log_net_recv=args.log_net_recv, seed=args.seed,
@@ -139,6 +147,7 @@ def cmd_test(args) -> int:
             availability=_availability(args.availability),
             n_instances=args.n_instances,
             record_instances=args.record_instances,
+            journal_instances=args.journal_instances,
             store_root=args.store,
             seed=args.seed or 0))
     print(json.dumps(results, indent=2, default=repr))
@@ -182,9 +191,13 @@ DEMOS = [
     ("txn-list-append", "txn_single.py", {"node_count": 1, "rate": 20.0}),
     ("txn-list-append", "datomic_txn.py", {"node_count": 3,
                                            "rate": 15.0}),
+    ("txn-list-append", "datomic_list_append.py",
+     {"node_count": 3, "rate": 15.0}),
     ("txn-rw-register", "txn_single.py", {"node_count": 1,
                                           "rate": 20.0}),
     ("kafka", "kafka_single.py", {"node_count": 1, "rate": 20.0}),
+    ("kafka", "kafka_single.py",
+     {"node_count": 1, "rate": 20.0, "crash_clients": True}),
     ("kafka", "kafka_lin_kv.py", {"node_count": 3, "rate": 15.0}),
 ]
 
@@ -227,9 +240,9 @@ def cmd_demo(args) -> int:
 
 
 def cmd_serve(args) -> int:
+    from .serve import ResultsHandler
     os.makedirs(args.store, exist_ok=True)
-    handler = functools.partial(http.server.SimpleHTTPRequestHandler,
-                                directory=args.store)
+    handler = functools.partial(ResultsHandler, directory=args.store)
     with http.server.ThreadingHTTPServer(("", args.port), handler) as srv:
         print(f"Serving {args.store}/ on http://localhost:{args.port}")
         try:

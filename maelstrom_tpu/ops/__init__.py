@@ -1,0 +1,12 @@
+"""Hand-written TPU kernels (Pallas) for the runtime's hot ops.
+
+The tick loop's dominant op is message delivery — a per-instance masked
+top-k over the message pool (netsim.deliver). :mod:`delivery` provides a
+Pallas version that keeps the whole pool block in VMEM and fuses
+mask/priority/selection into one kernel, gated behind
+``MAELSTROM_TPU_PALLAS=1`` (XLA's fused top_k is the default; the kernel
+exists for chips/shapes where the gather/scatter lowering dominates —
+SURVEY §7 step 8).
+"""
+
+from .delivery import deliver_pallas, pallas_enabled  # noqa: F401

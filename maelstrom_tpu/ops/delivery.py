@@ -1,0 +1,146 @@
+"""Pallas delivery kernel: the batched message-exchange hot op.
+
+Per instance the simulated network must hand every node up to ``K`` due,
+unblocked messages from the ``S``-slot pool, oldest-deadline first
+(netsim.deliver's contract, mirroring net.clj:223-247's priority-queue
+poll + receiver-side partition drop). The XLA path does this with a
+``top_k`` over an ``[NT, S]`` priority matrix per instance; this kernel
+fuses the mask construction, priority computation, and K-round argmax
+selection into one VMEM-resident pass over a block of instances, so the
+pool is read from HBM exactly once per tick.
+
+Correctness contract is bit-identical to :func:`..tpu.netsim.deliver`
+(cross-validated in tests/test_pallas_delivery.py on the interpreter);
+enable on hardware with ``MAELSTROM_TPU_PALLAS=1``.
+"""
+
+from __future__ import annotations
+
+import os
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ..tpu import wire
+
+
+def pallas_enabled() -> bool:
+    """Use the kernel in the tick loop? ``MAELSTROM_TPU_PALLAS=1`` on a
+    TPU backend, or ``=interpret`` anywhere (testing; runs the Pallas
+    interpreter, slow). XLA's top_k path stays the default."""
+    mode = os.environ.get("MAELSTROM_TPU_PALLAS", "0")
+    if mode == "interpret":
+        return True
+    return mode == "1" and jax.default_backend() == "tpu"
+
+
+def _interpret() -> bool:
+    return (os.environ.get("MAELSTROM_TPU_PALLAS") == "interpret"
+            or jax.default_backend() != "tpu")
+
+
+def _deliver_kernel(pool_ref, part_ref, t_ref, pool_out_ref, inbox_ref,
+                    ndel_ref, ndrop_ref, *, cfg):
+    """One grid step = one instance. Block shapes keep the gridded axis:
+    pool [1, S, L], part [1, NT, NT], t [1, 1]; outs pool' [1, S, L],
+    inbox [1, NT, K, L], ndel [1, 1], ndrop [1, 1]. All compute is
+    elementwise + broadcast-reduce (VPU), no gathers, no int matmuls."""
+    S = cfg.pool_slots
+    NT = cfg.n_total
+    K = cfg.inbox_k
+    t = t_ref[0, 0]
+
+    pool = pool_ref[0]                       # [S, L]
+    valid = pool[:, wire.VALID] == 1
+    due = valid & (pool[:, wire.DTICK] <= t)
+    dest = pool[:, wire.DEST]
+    origin = pool[:, wire.ORIGIN]
+
+    # blocked[s] = part[dest[s], origin[s]] — gather-free via one-hots
+    # (NT is small, so the [S, NT, NT] intermediate stays tiny in VMEM)
+    ids = jax.lax.broadcasted_iota(jnp.int32, (S, NT), 1)
+    dest_oh = dest[:, None] == ids           # [S, NT]
+    orig_oh = origin[:, None] == ids         # [S, NT]
+    part = part_ref[0] != 0                  # [NT, NT]
+    part_rows = jnp.sum(
+        jnp.where(orig_oh[:, None, :], part[None, :, :], False)
+        .astype(jnp.int32), axis=2)          # [S, NT] = part[:, origin[s]]
+    blocked = jnp.sum(
+        jnp.where(dest_oh, part_rows, 0), axis=1) > 0   # [S]
+
+    drop_mask = due & blocked
+    deliverable = due & ~blocked
+
+    # priority per (node, slot): oldest deadline first, slot-index
+    # tie-break — identical to netsim.deliver's ranking
+    slot_order = jax.lax.broadcasted_iota(jnp.int32, (S,), 0)
+    age_rank = ((1 << 20) - pool[:, wire.DTICK]) * S
+    base_prio = age_rank + (S - slot_order)  # [S]
+    cand = deliverable[None, :] & dest_oh.T  # [NT, S]
+    prio = jnp.where(cand, base_prio[None, :], 0)
+
+    taken = jnp.zeros((S,), dtype=jnp.bool_)
+    n_del = jnp.int32(0)
+    # K selection rounds: per round take each node's current best slot
+    for k in range(K):
+        best = jnp.argmax(prio, axis=1)          # [NT]
+        bestv = jnp.max(prio, axis=1)            # [NT]
+        take = bestv > 0
+        best_oh = (best[:, None] ==
+                   jax.lax.broadcasted_iota(jnp.int32, (NT, S), 1))
+        # rows[n] = pool[best[n]] via masked broadcast-reduce
+        rows = jnp.sum(
+            jnp.where(best_oh[:, :, None], pool[None, :, :], 0),
+            axis=1)                              # [NT, L]
+        inbox_ref[0, :, k, :] = jnp.where(take[:, None], rows, 0)
+        # clear the taken slots from every node's priority row
+        taken_now = jnp.any(take[:, None] & best_oh, axis=0)   # [S]
+        prio = jnp.where(taken_now[None, :], 0, prio)
+        taken = taken | taken_now
+        n_del = n_del + jnp.sum(take.astype(jnp.int32))
+
+    cleared = taken | drop_mask
+    pool_out_ref[0] = jnp.where(cleared[:, None], 0, pool)
+    ndel_ref[0, 0] = n_del
+    ndrop_ref[0, 0] = jnp.sum(drop_mask.astype(jnp.int32))
+
+
+@partial(jax.jit, static_argnames=("cfg", "interpret"))
+def deliver_pallas(pool: jnp.ndarray, partitions: jnp.ndarray,
+                   t: jnp.ndarray, cfg, interpret: bool = False):
+    """Batched delivery for ``[I, S, L]`` pools. Same returns as
+    ``vmap(netsim.deliver)``: (pool', inbox [I, NT, K, L], n_delivered
+    [I], n_dropped_partition [I])."""
+    from jax.experimental import pallas as pl
+
+    I, S, L = pool.shape
+    NT = cfg.n_total
+    K = cfg.inbox_k
+    t_arr = jnp.broadcast_to(jnp.asarray(t, jnp.int32), (I, 1))
+
+    grid = (I,)
+    out_shape = (
+        jax.ShapeDtypeStruct((I, S, L), jnp.int32),
+        jax.ShapeDtypeStruct((I, NT, K, L), jnp.int32),
+        jax.ShapeDtypeStruct((I, 1), jnp.int32),
+        jax.ShapeDtypeStruct((I, 1), jnp.int32),
+    )
+    pool_out, inbox, ndel, ndrop = pl.pallas_call(
+        partial(_deliver_kernel, cfg=cfg),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, S, L), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, NT, NT), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, 1), lambda i: (i, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, S, L), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, NT, K, L), lambda i: (i, 0, 0, 0)),
+            pl.BlockSpec((1, 1), lambda i: (i, 0)),
+            pl.BlockSpec((1, 1), lambda i: (i, 0)),
+        ),
+        out_shape=out_shape,
+        interpret=interpret,
+    )(pool, partitions.astype(jnp.int32), t_arr)
+    return pool_out, inbox, ndel[:, 0], ndrop[:, 0]

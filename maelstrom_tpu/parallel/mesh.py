@@ -47,9 +47,9 @@ def _run_sharded(model: Model, sim: SimConfig, mesh: Mesh, seeds, params):
     """seeds: int32 [n_devices]; sim describes the PER-DEVICE shard."""
 
     def shard_body(seed_shard, params_rep):
-        carry, events = simulate(model, sim, seed_shard[0], params_rep)
+        carry, ys = simulate(model, sim, seed_shard[0], params_rep)
         stats = jax.tree.map(lambda x: jax.lax.psum(x, AXIS), carry.stats)
-        return stats, carry.violations, events
+        return stats, carry.violations, ys.events
 
     # zero-initialized carry components are unvaried constants while the
     # seed-derived ones vary per shard; check_vma would reject the scan
@@ -74,6 +74,10 @@ def run_sim_sharded(model: Model, sim: SimConfig, seed: int, params=None,
     2 + model.ev_vals]).
     """
     mesh = mesh or make_mesh()
+    # the per-message journal is a single-device feature; shard_body
+    # drops TickOutputs.journal_* — refuse silently-ignored config
+    assert sim.journal_instances == 0, \
+        "journal_instances is not supported under shard_map"
     n = mesh.devices.size
     seeds = jnp.arange(n, dtype=jnp.int32) * 1_000_003 + seed
     if params is None:

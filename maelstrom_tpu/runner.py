@@ -122,6 +122,14 @@ class TestRunner:
                 if op is None:
                     break
                 self._invoke(idx, wclient, op)
+                if getattr(wclient, "crashed", False):
+                    # crash-client mode: discard and reopen (the
+                    # non-Reusable client lifecycle, kafka.clj:238-241)
+                    try:
+                        wclient.close()
+                    except Exception:
+                        pass
+                    wclient = make_client(self.net, node, self.opts)
             # final phase barrier: runner heals + sleeps, then sets event
             self._final_phase.wait()
             final = self.workload.get("final_generator")
@@ -145,8 +153,13 @@ class TestRunner:
         try:
             completed = wclient.invoke(dict(op))
         except Exception as e:
-            completed = {**op, "type": "info",
-                         "error": ["exception", repr(e)]}
+            from .workloads.base import ClientCrashed
+            if isinstance(e, ClientCrashed):
+                wclient.crashed = True
+                completed = {**op, "type": "info", "error": ["crash"]}
+            else:
+                completed = {**op, "type": "info",
+                             "error": ["exception", repr(e)]}
         ctype = completed.get("type", "info")
         if ctype == "invoke":  # client forgot to set outcome
             ctype = "info"

@@ -1,0 +1,147 @@
+"""Results browser: the ``serve`` command's web UI.
+
+Renders the store directory as browsable test results instead of a raw
+listing — test runs grouped per workload, colored by verdict (blue =
+valid, orange = unknown, pink = invalid — the color scheme of reference
+doc/results.md:66-69), with per-run pages linking results.json,
+histories, node logs, and rendered SVG artifacts inline.
+
+Parity: reference ``serve`` (src/maelstrom/core.clj:273, backed by
+jepsen's web UI per doc/results.md:7-9).
+"""
+
+from __future__ import annotations
+
+import html
+import http.server
+import json
+import os
+from typing import Optional
+from urllib.parse import unquote
+
+STYLE = """
+body { font-family: sans-serif; margin: 2em auto; max-width: 72em; }
+a { text-decoration: none; }
+table { border-collapse: collapse; }
+td, th { padding: .3em .8em; text-align: left; }
+tr:nth-child(even) { background: #f6f6f6; }
+.valid { background: #cfe0f5; }
+.unknown { background: #f5e0c0; }
+.invalid { background: #f5c8d0; }
+.badge { padding: .1em .5em; border-radius: .4em; font-size: .85em; }
+pre { background: #f4f4f4; padding: 1em; overflow-x: auto; }
+img { max-width: 100%; border: 1px solid #ddd; }
+"""
+
+
+def _verdict(run_dir: str) -> Optional[object]:
+    for name in ("results.json",):
+        p = os.path.join(run_dir, name)
+        if os.path.exists(p):
+            try:
+                with open(p) as f:
+                    return json.load(f).get("valid?")
+            except (OSError, json.JSONDecodeError):
+                return None
+    return None
+
+
+def _cls(verdict) -> str:
+    if verdict is True:
+        return "valid"
+    if verdict == "unknown":
+        return "unknown"
+    if verdict is False:
+        return "invalid"
+    return ""
+
+
+def _page(title: str, body: str) -> bytes:
+    return (f"<!doctype html><html><head><meta charset='utf-8'>"
+            f"<title>{html.escape(title)}</title><style>{STYLE}</style>"
+            f"</head><body><h1>{html.escape(title)}</h1>{body}"
+            f"</body></html>").encode()
+
+
+def _index(store: str) -> bytes:
+    rows = []
+    for wl in sorted(os.listdir(store)):
+        wl_dir = os.path.join(store, wl)
+        if not os.path.isdir(wl_dir):
+            continue
+        runs = sorted((r for r in os.listdir(wl_dir)
+                       if r != "latest"
+                       and os.path.isdir(os.path.join(wl_dir, r))),
+                      reverse=True)
+        for run in runs:
+            v = _verdict(os.path.join(wl_dir, run))
+            label = ("valid" if v is True else
+                     "unknown" if v == "unknown" else
+                     "invalid" if v is False else "?")
+            rows.append(
+                f"<tr class='{_cls(v)}'><td><a href='/{wl}/{run}/'>"
+                f"{html.escape(wl)}</a></td>"
+                f"<td><a href='/{wl}/{run}/'>{html.escape(run)}</a></td>"
+                f"<td><span class='badge'>{label}</span></td></tr>")
+    body = ("<table><tr><th>workload</th><th>run</th><th>valid?</th></tr>"
+            + "".join(rows) + "</table>") if rows else "<p>No runs yet.</p>"
+    return _page("maelstrom-tpu results", body)
+
+
+def _run_page(store: str, wl: str, run: str) -> bytes:
+    d = os.path.join(store, wl, run)
+    v = _verdict(d)
+    parts = [f"<p>verdict: <span class='badge {_cls(v)}'>{v}</span> "
+             f"&middot; <a href='/'>&larr; all runs</a></p>"]
+    files = sorted(os.listdir(d))
+    svgs = [f for f in files if f.endswith(".svg")]
+    others = [f for f in files if not f.endswith(".svg")]
+    if others:
+        parts.append("<h2>Artifacts</h2><ul>")
+        for f in others:
+            parts.append(f"<li><a href='/{wl}/{run}/{f}'>"
+                         f"{html.escape(f)}</a></li>")
+        parts.append("</ul>")
+    rp = os.path.join(d, "results.json")
+    if os.path.exists(rp):
+        with open(rp) as f:
+            try:
+                content = json.dumps(json.load(f), indent=2)[:20000]
+            except json.JSONDecodeError:
+                content = "(unreadable)"
+        parts.append(f"<h2>results.json</h2><pre>"
+                     f"{html.escape(content)}</pre>")
+    for f in svgs:
+        parts.append(f"<h2>{html.escape(f)}</h2>"
+                     f"<img src='/{wl}/{run}/{f}'>")
+    return _page(f"{wl} / {run}", "".join(parts))
+
+
+class ResultsHandler(http.server.SimpleHTTPRequestHandler):
+    """Routes: / -> index; /<wl>/<run>/ -> run page; deeper paths serve
+    raw files from the store directory."""
+
+    def do_GET(self):  # noqa: N802 (stdlib naming)
+        store = self.directory
+        path = unquote(self.path.split("?", 1)[0])
+        parts = [p for p in path.split("/") if p]
+        if any(p in ("..", ".") or os.sep in p for p in parts):
+            self.send_error(404)   # no escaping the store directory
+            return
+        if not parts:
+            return self._send(_index(store))
+        if len(parts) == 2:
+            d = os.path.join(store, *parts)
+            if os.path.isdir(d):
+                return self._send(_run_page(store, parts[0], parts[1]))
+        return super().do_GET()
+
+    def _send(self, payload: bytes):
+        self.send_response(200)
+        self.send_header("Content-Type", "text/html; charset=utf-8")
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def log_message(self, *args):
+        pass

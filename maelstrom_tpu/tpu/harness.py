@@ -47,6 +47,9 @@ TPU_DEFAULTS = dict(
     pool_slots=128,
     inbox_k=8,
     ms_per_tick=MS_PER_TICK,  # virtual-clock resolution (fidelity knob)
+    journal_instances=0,      # instances with full per-message journals
+                              # (Lamport SVG + msgs-per-op; costs device
+                              # output bandwidth, so opt-in)
     seed=0,
 )
 
@@ -93,7 +96,9 @@ def make_sim_config(model: Model, opts: Dict[str, Any]) -> SimConfig:
     return SimConfig(net=net, client=client, nemesis=nemesis,
                      n_instances=o["n_instances"], n_ticks=n_ticks,
                      record_instances=min(o["record_instances"],
-                                          o["n_instances"]))
+                                          o["n_instances"]),
+                     journal_instances=min(o["journal_instances"],
+                                           o["n_instances"]))
 
 
 def events_to_histories(model: Model, events: np.ndarray,
@@ -138,8 +143,8 @@ def run_tpu_test(model: Model, opts: Optional[Dict[str, Any]] = None,
     if params is None:
         params = model.make_params(sim.net.n_nodes)
     t0 = time.monotonic()
-    carry, events = run_sim(model, sim, opts["seed"], params)
-    events = np.asarray(events)
+    carry, ys = run_sim(model, sim, opts["seed"], params)
+    events = np.asarray(ys.events)
     wall = time.monotonic() - t0
 
     histories = events_to_histories(model, events,
@@ -200,21 +205,41 @@ def run_tpu_test(model: Model, opts: Optional[Dict[str, Any]] = None,
         results["availability"] = availability
         if availability["valid?"] is False:
             results["valid?"] = False
+    journal = None
+    if sim.journal_instances > 0:
+        from .journal import TpuJournal
+        journal = TpuJournal(model, sim.net, np.asarray(ys.journal_sends),
+                             np.asarray(ys.journal_recvs), instance=0,
+                             ms_per_tick=opts["ms_per_tick"])
+        ops = sum(1 for r in (histories[0] if histories else [])
+                  if r["type"] == "invoke")
+        jstats = journal.stats()
+        results["net"]["journal"] = {
+            "stats": jstats,
+            "msgs-per-op": (jstats["servers"]["msg-count"] / ops
+                            if ops else None),
+            "instance": 0,
+        }
     if opts.get("store_root"):
-        _write_store(model.name, opts["store_root"], results, histories)
+        _write_store(model.name, opts["store_root"], results, histories,
+                     journal)
     return results
 
 
 def _write_store(name: str, store_root: str, results: Dict[str, Any],
-                 histories) -> None:
+                 histories, journal=None) -> None:
     """Store artifacts for a TPU run: results.json + one history per
     recorded instance (the store layout of doc/results.md, minus node
-    logs — there are no node processes)."""
+    logs — there are no node processes), plus the Lamport diagram when a
+    per-message journal was recorded."""
     import json
     from datetime import datetime
     ts = datetime.now().strftime("%Y%m%d-%H%M%S-%f")
     d = os.path.join(store_root, f"{name}-tpu", ts)
     os.makedirs(d, exist_ok=True)
+    if journal is not None:
+        from ..net.viz import plot_lamport
+        plot_lamport(journal, os.path.join(d, "messages.svg"))
     with open(os.path.join(d, "results.json"), "w") as f:
         json.dump(results, f, indent=2, default=repr)
     for i, h in enumerate(histories):

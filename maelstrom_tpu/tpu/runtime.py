@@ -455,6 +455,19 @@ class SimConfig(NamedTuple):
     n_instances: int
     n_ticks: int
     record_instances: int
+    journal_instances: int = 0   # instances whose raw message traffic is
+                                 # streamed back for the per-message
+                                 # journal (Lamport diagrams, msgs-per-op
+                                 # — net/journal.clj's role device-side)
+
+
+class TickOutputs(NamedTuple):
+    """Per-tick scan outputs: history events for the recorded instances,
+    plus (when journal_instances > 0) the raw sent rows and delivered
+    inboxes of the journaled instances."""
+    events: jnp.ndarray          # [R, C, 2, 2 + ev_vals]
+    journal_sends: jnp.ndarray   # [J, M, L] outgoing rows (pre-enqueue)
+    journal_recvs: jnp.ndarray   # [J, NT, K, L] delivered this tick
 
 
 class Carry(NamedTuple):
@@ -506,9 +519,18 @@ def make_tick_fn(model: Model, sim: SimConfig, params) -> Callable:
         partitions = jax.vmap(
             lambda ik: partition_matrix(nem, cfg, t, ik))(ikeys)
 
-        pool, inbox, n_del, n_dropp = jax.vmap(
-            lambda p, pa: netsim.deliver(p, pa, t, cfg))(carry.pool,
-                                                         partitions)
+        from ..ops.delivery import _interpret, deliver_pallas, \
+            pallas_enabled
+        if pallas_enabled():
+            # hand-fused VMEM kernel for the delivery hot op (ops/)
+            pool, inbox, n_del_i, n_dropp_i = deliver_pallas(
+                carry.pool, partitions, t, cfg,
+                interpret=_interpret())
+            n_del, n_dropp = n_del_i, n_dropp_i
+        else:
+            pool, inbox, n_del, n_dropp = jax.vmap(
+                lambda p, pa: netsim.deliver(p, pa, t, cfg))(carry.pool,
+                                                             partitions)
 
         node_keys = jax.random.split(k_node, I)
         node_state, node_outs = jax.vmap(
@@ -523,6 +545,11 @@ def make_tick_fn(model: Model, sim: SimConfig, params) -> Callable:
 
         outs = jnp.concatenate(
             [node_outs.reshape(I, -1, cfg.lanes), reqs], axis=1)
+        # stamp network-unique message ids (send-time allocation, the
+        # role of net.clj:196-201's ID counter): unique per instance
+        M = outs.shape[1]
+        outs = outs.at[:, :, wire.NETID].set(
+            t * M + jnp.arange(M, dtype=jnp.int32)[None, :])
         enq_keys = jax.random.split(k_enq, I)
         pool, n_sent, n_lost, n_ovf = jax.vmap(
             lambda p, m, k: netsim.enqueue(p, m, t, k, cfg))(pool, outs,
@@ -543,15 +570,23 @@ def make_tick_fn(model: Model, sim: SimConfig, params) -> Callable:
                           violations=carry.violations
                           + violated.astype(jnp.int32),
                           key=key)
-        return new_carry, events[:sim.record_instances]
+        J = sim.journal_instances
+        ys = TickOutputs(
+            events=events[:sim.record_instances],
+            journal_sends=outs[:J],
+            journal_recvs=inbox[:J],
+        )
+        return new_carry, ys
 
     return tick_fn
 
 
 def simulate(model: Model, sim: SimConfig, seed, params=None
-             ) -> Tuple[Carry, jnp.ndarray]:
+             ) -> Tuple[Carry, TickOutputs]:
     """Traceable simulation body (used directly inside shard_map);
-    returns (final carry, events [T, R, C, 2, 2 + model.ev_vals])."""
+    returns (final carry, TickOutputs with a leading T axis — events
+    [T, R, C, 2, 2 + model.ev_vals], journal sends/recvs for the first
+    ``journal_instances`` instances)."""
     carry = init_carry(model, sim, seed, params)
     tick_fn = make_tick_fn(model, sim, params)
     return jax.lax.scan(tick_fn, carry,
@@ -560,6 +595,6 @@ def simulate(model: Model, sim: SimConfig, seed, params=None
 
 @partial(jax.jit, static_argnames=("model", "sim"))
 def run_sim(model: Model, sim: SimConfig, seed: int, params=None
-            ) -> Tuple[Carry, jnp.ndarray]:
+            ) -> Tuple[Carry, TickOutputs]:
     """Jitted single-device entry point around :func:`simulate`."""
     return simulate(model, sim, seed, params)

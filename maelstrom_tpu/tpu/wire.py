@@ -37,9 +37,13 @@ TYPE = 4
 MSGID = 5
 REPLYTO = 6
 ORIGIN = 7
-BODY = 8          # first body lane
+NETID = 8         # network-unique message id, stamped by the runtime at
+                  # send time (tick * fanout + row) — the journal's
+                  # send/recv pairing key (role of net.clj's message-ID
+                  # allocator, net.clj:196-201)
+BODY = 9          # first body lane
 
-HDR_LANES = 8
+HDR_LANES = 9
 
 
 def lanes(body_lanes: int) -> int:

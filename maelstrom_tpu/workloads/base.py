@@ -14,6 +14,14 @@ from typing import Optional, Set
 from ..runtime.client import Client, rpc_call, with_errors
 
 
+class ClientCrashed(Exception):
+    """Raised by a client's ``apply`` to simulate a client crash: the op
+    completes as :info (it may or may not have happened) and the worker
+    discards this client and opens a fresh one — the role of
+    jepsen.tests.kafka's ``:crash-clients?`` / non-Reusable clients
+    (reference src/maelstrom/workload/kafka.clj:238-241)."""
+
+
 class WorkloadClient:
     namespace = ""              # schema registry namespace
     idempotent: Set[str] = frozenset()

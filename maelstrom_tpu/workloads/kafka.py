@@ -53,8 +53,35 @@ class KafkaClient(WorkloadClient):
     def __init__(self, net, node, opts):
         super().__init__(net, node, opts)
         self.positions = {}   # key -> next offset to poll from
+        # a fresh client resumes from the server's committed offsets and
+        # marks its first poll "reassigned" (consumer-group rebalance
+        # semantics; the checker then allows the position jump)
+        self.fresh = True
+
+    def _resume_from_committed(self):
+        key_count = self.opts.get("key_count") or 4
+        resp = self.call("list_committed_offsets",
+                         keys=[str(i) for i in range(key_count)])
+        self.positions = {k: off + 1
+                          for k, off in (resp["offsets"] or {}).items()}
 
     def apply(self, o):
+        if o["f"] == "crash":
+            from .base import ClientCrashed
+            raise ClientCrashed()
+        if o["f"] == "poll" and self.fresh:
+            self._resume_from_committed()
+            out = self._apply_inner(o)
+            # only a *successful* poll consumes the reassignment: if the
+            # resume or poll fails (timeout under a partition), the next
+            # poll must re-resume and still carry the marker, or the
+            # checker would flag its legal backward jump
+            self.fresh = False
+            out["reassigned"] = True
+            return out
+        return self._apply_inner(o)
+
+    def _apply_inner(self, o):
         if o["f"] == "send":
             k, v = o["value"]
             resp = self.call("send", key=k, msg=v)
@@ -75,13 +102,17 @@ class KafkaClient(WorkloadClient):
         raise ValueError(f"unknown op {o['f']!r}")
 
 
-def make_generator(key_count: int):
+def make_generator(key_count: int, crash_clients: bool = False):
     def gen(rng):
         counter = [0]
         while True:
             r = rng.random()
             k = str(rng.randrange(key_count))
-            if r < 0.45:
+            if crash_clients and r > 0.97:
+                # jepsen.tests.kafka :crash-clients? — the worker
+                # discards this client and opens a fresh one
+                yield op("crash", None)
+            elif r < 0.45:
                 counter[0] += 1
                 yield op("send", [k, counter[0]])
             elif r < 0.85:
@@ -110,7 +141,9 @@ class KafkaClientWithCommits(KafkaClient):
 def workload(opts):
     return {
         "client": lambda net, node, o: KafkaClientWithCommits(net, node, o),
-        "generator": make_generator(opts.get("key_count") or 4),
+        "generator": make_generator(
+            opts.get("key_count") or 4,
+            crash_clients=bool(opts.get("crash_clients", False))),
         "final_generator": None,
         "checker": lambda h, o: kafka_checker(h),
     }

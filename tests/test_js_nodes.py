@@ -1,0 +1,45 @@
+"""JS node SDK end-to-end (third demo language; reference ships 8 — we
+bundle Python, C++, JS). Skipped when no `node` runtime exists in the
+image; the SDK is exercised the same way as the Python/C++ ones."""
+
+import os
+import shutil
+
+import pytest
+
+from maelstrom_tpu import run_test
+
+NODE_BIN = shutil.which("node") or shutil.which("nodejs")
+pytestmark = pytest.mark.skipif(NODE_BIN is None,
+                                reason="no JS runtime in image")
+
+JS = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "examples", "js")
+
+
+def test_js_echo():
+    res = run_test("echo", dict(
+        bin=NODE_BIN, bin_args=[f"{JS}/echo.js"], node_count=2,
+        time_limit=3.0, rate=20.0, concurrency=4, seed=7))
+    assert res["valid?"] is True
+
+
+def test_js_broadcast_grid():
+    res = run_test("broadcast", dict(
+        bin=NODE_BIN, bin_args=[f"{JS}/broadcast.js"], node_count=5,
+        topology="grid", time_limit=5.0, rate=20.0, concurrency=4,
+        seed=7))
+    assert res["valid?"] is True
+
+
+def test_js_g_set():
+    res = run_test("g-set", dict(
+        bin=NODE_BIN, bin_args=[f"{JS}/g_set.js"], node_count=3,
+        time_limit=5.0, rate=20.0, concurrency=4, seed=7))
+    assert res["valid?"] is True
+
+
+def test_js_lin_kv_proxy():
+    res = run_test("lin-kv", dict(
+        bin=NODE_BIN, bin_args=[f"{JS}/lin_kv_proxy.js"], node_count=2,
+        time_limit=4.0, rate=15.0, concurrency=4, seed=7))
+    assert res["valid?"] is True

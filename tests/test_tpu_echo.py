@@ -1,6 +1,8 @@
 """TPU runtime MVP: vectorized echo instances end-to-end on the virtual
 CPU mesh (SURVEY §7 step 5)."""
 
+import os
+
 import numpy as np
 
 from maelstrom_tpu.models.echo import EchoModel
@@ -46,3 +48,27 @@ def test_tpu_unique_ids():
         time_limit=1.0, rate=100.0, latency=5.0, seed=9))
     assert res["valid?"] is True, res["instances"]
     assert res["instances"][0]["acknowledged-count"] > 10
+
+
+def test_tpu_journal_and_lamport_svg(tmp_path):
+    """VERDICT r1 missing #5: TPU runs get per-message journals —
+    send/recv pairing, all/clients/servers stats, messages.svg."""
+    from maelstrom_tpu.models.echo import EchoModel
+    from maelstrom_tpu.tpu.harness import run_tpu_test
+
+    res = run_tpu_test(EchoModel(), dict(
+        node_count=2, concurrency=2, n_instances=4, record_instances=2,
+        journal_instances=1, time_limit=1.0, rate=30.0, latency=5.0,
+        rpc_timeout=0.5, recovery_time=0.2, seed=5,
+        store_root=str(tmp_path)))
+    assert res["valid?"] is True
+    j = res["net"]["journal"]
+    st = j["stats"]
+    # every recv pairs with a send; some sends may be lost/in flight
+    assert 0 < st["all"]["recv-count"] <= st["all"]["send-count"]
+    assert st["all"]["msg-count"] > 0
+    # echo is pure client<->server RPC: all traffic involves a client
+    assert st["servers"]["msg-count"] == 0
+    assert j["msgs-per-op"] is not None
+    svg = os.path.join(res["store-dir"], "messages.svg")
+    assert os.path.exists(svg) and os.path.getsize(svg) > 1000
