@@ -55,16 +55,19 @@ def child_main() -> None:
 
     on_cpu = platform == "cpu"
     n_instances = int(os.environ.get(
-        "BENCH_INSTANCES", 64 if on_cpu else 4096))
+        "BENCH_INSTANCES", 128 if on_cpu else 4096))
     sim_seconds = float(os.environ.get(
         "BENCH_SIM_SECONDS", 1.0 if on_cpu else 2.0))
 
-    model = RaftModel(n_nodes_hint=3, log_cap=64)
-    opts = dict(node_count=3, concurrency=3,
+    # dense-traffic flagship: 6 clients at rate 200 + 8-tick heartbeats
+    # saturate the simulated network (checker-validated clean: zero pool
+    # overflow, partition/loss drops fully accounted)
+    model = RaftModel(n_nodes_hint=3, log_cap=64, heartbeat=8)
+    opts = dict(node_count=3, concurrency=6,
                 n_instances=n_instances,
                 record_instances=1,
                 time_limit=sim_seconds,
-                rate=30.0, latency=10.0, rpc_timeout=1.0,
+                rate=200.0, latency=5.0, rpc_timeout=1.0,
                 nemesis=["partition"], nemesis_interval=0.4, p_loss=0.05,
                 recovery_time=0.3, seed=7)
     sim = make_sim_config(model, opts)

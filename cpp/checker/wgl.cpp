@@ -1,0 +1,172 @@
+// Native WGL linearizability core.
+//
+// The segmented Wing & Gong / Lowe search of
+// maelstrom_tpu/checkers/linearizable.py, in C++ for checker
+// throughput: at fleet scale the history checkers are the bottleneck
+// (SURVEY §7 hard parts — the role Knossos's optimized search plays for
+// the reference's lin-kv workload, lin_kv.clj:78-85). Exact same
+// semantics as the Python implementation:
+//
+//   - quiescent-cut segmentation with reachable-state-set propagation
+//   - required (ok) ops must linearize inside [inv, end]; info ops may
+//     take effect any time after inv or never
+//   - sequential register semantics for read / write / cas
+//   - work-based budget; exhaustion reports UNKNOWN, never valid
+//
+// Exposed as a C ABI for ctypes (no pybind11 in this image). One call
+// checks one key's op list. Values are densified to non-negative ints
+// by the Python caller; -1 encodes nil.
+//
+// Build: make -C cpp/checker   (g++ -O2 -shared -fPIC)
+
+#include <cstddef>
+#include <cstdint>
+#include <unordered_set>
+#include <vector>
+
+using std::size_t;
+
+namespace {
+
+constexpr int F_READ = 1;
+constexpr int F_WRITE = 2;
+constexpr int F_CAS = 3;
+
+constexpr int64_t T_INF = INT64_MAX;
+
+struct Op {
+  int32_t f;
+  int32_t a;        // write value / cas from
+  int32_t b;        // cas to
+  int32_t ret;      // read result (-1 = nil); unused otherwise
+  int64_t inv;
+  int64_t end;      // T_INF for info ops
+  bool required;
+  int idx;          // dense index within its segment
+};
+
+// (mask, state) memo key packed into one 128-bit value: masks are
+// capped at 64 ops per segment (the caller falls back to Python above
+// that), states are small dense ints.
+struct Key {
+  uint64_t mask;
+  int32_t state;
+  bool operator==(const Key& o) const {
+    return mask == o.mask && state == o.state;
+  }
+};
+struct KeyHash {
+  size_t operator()(const Key& k) const {
+    uint64_t h = k.mask * 0x9E3779B97F4A7C15ULL;
+    h ^= (uint64_t)(uint32_t)k.state * 0xC2B2AE3D27D4EB4FULL;
+    return (size_t)(h ^ (h >> 29));
+  }
+};
+
+// apply sequential register semantics; returns legal?, writes new state
+inline bool apply(int32_t state, const Op& op, int32_t* out) {
+  switch (op.f) {
+    case F_READ:
+      *out = state;
+      return !op.required || op.ret == state;
+    case F_WRITE:
+      *out = op.a;
+      return true;
+    case F_CAS:
+      if (state == op.a) { *out = op.b; return true; }
+      *out = state;
+      return false;
+  }
+  *out = state;
+  return false;
+}
+
+// DFS over one segment from every initial state in `init`; collects the
+// register states reachable at complete linearizations into `out`.
+// Returns false if the work budget ran out.
+bool final_states(const std::vector<Op>& ops,
+                  const std::vector<int32_t>& init,
+                  std::vector<int32_t>* out, int64_t* budget) {
+  const int n = (int)ops.size();
+  uint64_t required_mask = 0;
+  for (const Op& o : ops)
+    if (o.required) required_mask |= 1ULL << o.idx;
+
+  std::unordered_set<Key, KeyHash> seen;
+  std::unordered_set<int32_t> out_set;
+  std::vector<Key> stack;
+  for (int32_t s : init) stack.push_back({0, s});
+
+  while (!stack.empty()) {
+    Key cur = stack.back();
+    stack.pop_back();
+    if (!seen.insert(cur).second) continue;
+    *budget -= n > 0 ? n : 1;   // work-based: successor scan costs ~n
+    if (*budget <= 0) return false;
+    if ((cur.mask & required_mask) == required_mask)
+      out_set.insert(cur.state);
+    // min end among un-linearized ops bounds which ops may go next
+    int64_t bound = T_INF;
+    for (const Op& o : ops)
+      if (!((cur.mask >> o.idx) & 1) && o.end < bound) bound = o.end;
+    for (const Op& o : ops) {
+      if ((cur.mask >> o.idx) & 1) continue;
+      if (o.inv > bound) continue;
+      int32_t ns;
+      if (apply(cur.state, o, &ns))
+        stack.push_back({cur.mask | (1ULL << o.idx), ns});
+    }
+  }
+  out->assign(out_set.begin(), out_set.end());
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// ops: n rows of 7 int64 lanes [f, a, b, ret, inv, end(-1 = inf),
+// required]. Returns 1 linearizable, 0 not, -1 unknown (budget), -2
+// unsupported shape (a segment exceeds 64 ops -> caller falls back).
+int64_t wgl_check(const int64_t* ops_flat, int64_t n, int64_t init_state,
+                  int64_t budget_in) {
+  std::vector<Op> all(n);
+  for (int64_t i = 0; i < n; i++) {
+    const int64_t* r = ops_flat + i * 7;
+    all[i] = Op{(int32_t)r[0], (int32_t)r[1], (int32_t)r[2],
+                (int32_t)r[3], r[4], r[5] < 0 ? T_INF : r[5],
+                r[6] != 0, 0};
+  }
+  // sort by invocation (stable insertion: histories arrive ordered, but
+  // don't rely on it)
+  for (int64_t i = 1; i < n; i++)       // tiny n per key: insertion sort
+    for (int64_t j = i; j > 0 && all[j].inv < all[j - 1].inv; j--)
+      std::swap(all[j], all[j - 1]);
+
+  // quiescent-cut segmentation
+  std::vector<std::vector<Op>> segs;
+  int64_t frontier = INT64_MIN;
+  for (const Op& o : all) {
+    if (!segs.empty() && !segs.back().empty() && frontier < o.inv)
+      segs.emplace_back();
+    if (segs.empty()) segs.emplace_back();
+    segs.back().push_back(o);
+    if (o.end > frontier) frontier = o.end;
+  }
+  for (auto& seg : segs) {
+    if (seg.size() > 64) return -2;
+    for (size_t i = 0; i < seg.size(); i++) seg[i].idx = (int)i;
+  }
+
+  int64_t budget = budget_in;
+  std::vector<int32_t> states{(int32_t)init_state};
+  std::vector<int32_t> next;
+  for (const auto& seg : segs) {
+    if (!final_states(seg, states, &next, &budget)) return -1;
+    if (next.empty()) return 0;
+    states.swap(next);
+  }
+  return 1;
+}
+
+}  // extern "C"

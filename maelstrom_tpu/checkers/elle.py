@@ -134,6 +134,94 @@ class _Graph:
                     kinds.update(ks)
         return kinds
 
+    def shortest_path(self, src: int, dst: int, cset: Set[int],
+                      avoid_kind: Optional[str] = None
+                      ) -> Optional[List[int]]:
+        """BFS path src -> dst inside ``cset``; edges whose ONLY kinds
+        include ``avoid_kind`` are usable iff they also carry another
+        kind (an edge is excluded only when avoid_kind is its sole
+        justification)."""
+        from collections import deque
+        prev: Dict[int, int] = {src: src}
+        q = deque([src])
+        while q:
+            v = q.popleft()
+            if v == dst:
+                path = [v]
+                while path[-1] != src:
+                    path.append(prev[path[-1]])
+                return path[::-1]
+            for w, ks in self.edges.get(v, {}).items():
+                if w not in cset or w in prev:
+                    continue
+                if avoid_kind is not None and ks <= {avoid_kind}:
+                    continue
+                prev[w] = v
+                q.append(w)
+        return None
+
+    def minimal_cycle(self, comp: List[int]
+                      ) -> Optional[Tuple[List[int], List[Set[str]]]]:
+        """Find a short explanatory cycle in the SCC, preferring the
+        *weakest* anomaly shape (Elle's discipline: report the most
+        specific cycle, not the whole SCC): first a cycle with no rw
+        edges, then exactly-one-rw (G-single witness), else any cycle.
+        Returns (nodes, edge kinds between consecutive nodes, cyclic)."""
+        cset = set(comp)
+
+        def close(path):
+            kinds = []
+            for a, b in zip(path, path[1:] + path[:1]):
+                kinds.append(set(self.edges[a][b]))
+            return path, kinds
+
+        # Bounded search: one BFS per candidate edge is O(V+E); cap the
+        # candidates per class so a dense worst-case SCC (badly broken
+        # system -> most txns in one component) stays O(K*(V+E)) instead
+        # of O(E*(V+E)). The first cycle found in the strongest class
+        # wins — any witness cycle explains the anomaly.
+        MAX_TRIES = 64
+
+        # (a) rw-free cycle: edge (u, v) without rw + path v -> u
+        # avoiding rw-only edges
+        tries = 0
+        for u in comp:
+            for v, ks in self.edges.get(u, {}).items():
+                if v not in cset or ks <= {"rw"}:
+                    continue
+                tries += 1
+                if tries > MAX_TRIES:
+                    break
+                p = self.shortest_path(v, u, cset, avoid_kind="rw")
+                if p is not None:
+                    return close([u] + p[:-1])
+            if tries > MAX_TRIES:
+                break
+        # (b) exactly one rw edge: for each rw edge (u, v), rw-free
+        # path v -> u
+        tries = 0
+        for u in comp:
+            for v, ks in self.edges.get(u, {}).items():
+                if v not in cset or "rw" not in ks:
+                    continue
+                tries += 1
+                if tries > MAX_TRIES:
+                    break
+                p = self.shortest_path(v, u, cset, avoid_kind="rw")
+                if p is not None:
+                    return close([u] + p[:-1])
+            if tries > MAX_TRIES:
+                break
+        # (c) any cycle at all (>= 2 rw edges)
+        for u in comp:
+            for v in self.edges.get(u, {}):
+                if v not in cset:
+                    continue
+                p = self.shortest_path(v, u, cset)
+                if p is not None:
+                    return close([u] + p[:-1])
+        return None
+
 
 def _classify_cycle(kinds: Set[str], rw_edge_count: int = 2) -> str:
     rw = "rw" in kinds
@@ -327,15 +415,35 @@ def _finish(g: _Graph, committed: List[dict],
                 j += 1
 
     for comp in g.sccs():
-        kinds = g.cycle_kinds(comp)
-        cset = set(comp)
-        rw_edges = sum(1 for a in comp
-                       for b, ks in g.edges.get(a, {}).items()
-                       if b in cset and "rw" in ks)
-        cls = _classify_cycle(kinds, rw_edges)
+        cyc = g.minimal_cycle(comp)
+        if cyc is None:   # unreachable for a real SCC; keep the old path
+            kinds = g.cycle_kinds(comp)
+            cls = _classify_cycle(kinds, 2)
+            anomalies[cls].append(
+                {"txns": [committed[i]["ops"] for i in comp[:6]],
+                 "edges": sorted(kinds)})
+            continue
+        nodes, edge_kinds = cyc
+        # an edge "needs" rw only when rw is its sole justification; a
+        # cycle needing no rw edge classifies by its other kinds even if
+        # some edge also happens to carry rw
+        rw_needed = sum(1 for ks in edge_kinds if ks <= {"rw"})
+        all_kinds = set().union(*edge_kinds)
+        eff_kinds = all_kinds - {"rw"} if rw_needed == 0 else all_kinds
+        cls = _classify_cycle(eff_kinds, max(rw_needed, 1)
+                              if "rw" in eff_kinds else rw_needed)
+        # minimal cycle with per-edge explanations (Elle-style: each
+        # step says WHY txn a must precede txn b)
+        steps = []
+        for i, ks in enumerate(edge_kinds):
+            a = nodes[i]
+            b = nodes[(i + 1) % len(nodes)]
+            steps.append({"txn": committed[a]["ops"],
+                          "then": committed[b]["ops"],
+                          "because": sorted(ks)})
         anomalies[cls].append(
-            {"txns": [committed[i]["ops"] for i in comp[:6]],
-             "edges": sorted(kinds)})
+            {"cycle-length": len(nodes), "steps": steps[:8],
+             "edges": sorted(all_kinds)})
 
     bad = {a: v for a, v in anomalies.items()
            if _model_leq(_FORBIDDEN_BY.get(a, "read-uncommitted"),

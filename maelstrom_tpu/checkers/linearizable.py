@@ -216,6 +216,7 @@ def linearizable_kv_checker(history, max_ops_per_key: int = 10_000,
                                                 (list, tuple)) \
                 and len(r["value"]) == 2:
             keys.add(r["value"][0])
+    from .native import check_register_history_native
     bad_keys = []
     unknown_keys = []
     for key in sorted(keys, key=repr):
@@ -223,11 +224,18 @@ def linearizable_kv_checker(history, max_ops_per_key: int = 10_000,
         if len(ops) > max_ops_per_key:
             unknown_keys.append(key)
             continue
-        verdict = check_register_history(ops, budget_states=budget_states)
+        # native WGL core first (cpp/checker); its per-unit cost is ~10x
+        # cheaper than the Python search, so it gets 10x the work budget
+        # for the same wall-clock ceiling. None = unavailable/unsupported
+        # -> Python fallback
+        verdict = check_register_history_native(ops, budget_states * 10)
+        if verdict is None:
+            verdict = check_register_history(ops,
+                                             budget_states=budget_states)
         if verdict is False:
             bad_keys.append(key)
-        elif verdict is UNKNOWN:
-            unknown_keys.append(key)
+        elif verdict == UNKNOWN:   # == not is: native.py returns its own
+            unknown_keys.append(key)   # "unknown" literal
     valid: Any
     if bad_keys:
         valid = False

@@ -1,0 +1,100 @@
+"""ctypes binding for the native WGL linearizability core (cpp/checker).
+
+Builds ``libwgl.so`` on first use when a C++ toolchain is present (no
+pybind11 in the image — plain C ABI via ctypes); every caller falls back
+to the pure-Python search when the library is unavailable or reports an
+unsupported shape, so the native path is a pure accelerator, never a
+requirement.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import List, Optional
+
+_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "cpp", "checker")
+_LIB_PATH = os.path.join(_DIR, "libwgl.so")
+
+_lib = None
+_lib_tried = False
+
+F_CODES = {"read": 1, "write": 2, "cas": 3}
+
+
+def _load():
+    global _lib, _lib_tried
+    if _lib_tried:
+        return _lib
+    _lib_tried = True
+    if os.environ.get("MAELSTROM_TPU_NO_NATIVE") == "1":
+        return None
+    if not os.path.exists(_LIB_PATH):
+        try:
+            subprocess.run(["make", "-C", _DIR, "libwgl.so"],
+                           capture_output=True, timeout=120, check=True)
+        except (OSError, subprocess.SubprocessError):
+            return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+        lib.wgl_check.restype = ctypes.c_int64
+        lib.wgl_check.argtypes = [ctypes.POINTER(ctypes.c_int64),
+                                  ctypes.c_int64, ctypes.c_int64,
+                                  ctypes.c_int64]
+        _lib = lib
+    except OSError:
+        _lib = None
+    return _lib
+
+
+def check_register_history_native(ops, budget_states: int
+                                  ) -> Optional[object]:
+    """Run one key's WGL check natively.
+
+    ``ops`` is the Python checker's ``_Op`` list. Returns True / False /
+    "unknown", or None when the native path can't handle it (library
+    missing, non-int values, oversized segment) — the caller then uses
+    the Python search.
+    """
+    lib = _load()
+    if lib is None:
+        return None
+
+    # densify values to non-negative ints; nil -> -1
+    table = {}
+
+    def vid(v) -> Optional[int]:
+        if v is None:
+            return -1
+        if v not in table:
+            table[v] = len(table)
+        return table[v]
+
+    flat: List[int] = []
+    try:
+        for o in ops:
+            f = F_CODES[o.f]
+            if o.f == "cas":
+                a, b = vid(o.args[0]), vid(o.args[1])
+                ret = -1
+            elif o.f == "write":
+                a, b, ret = vid(o.args), -1, -1
+            else:
+                a, b = -1, -1
+                ret = vid(o.ret) if o.required else -1
+            end = -1 if o.end == float("inf") else int(o.end)
+            flat += [f, a, b, ret, int(o.inv), end, 1 if o.required else 0]
+    except (TypeError, KeyError):
+        return None   # unhashable/odd values: Python handles those
+
+    arr = (ctypes.c_int64 * len(flat))(*flat)
+    rc = lib.wgl_check(arr, len(ops), -1, budget_states)
+    if rc == 1:
+        return True
+    if rc == 0:
+        return False
+    if rc == -1:
+        return "unknown"
+    return None   # -2: unsupported shape

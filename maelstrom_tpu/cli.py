@@ -85,9 +85,10 @@ def add_test_options(p: argparse.ArgumentParser):
     # TPU-runtime knobs
     p.add_argument("--n-instances", type=int, default=64)
     p.add_argument("--record-instances", type=int, default=8)
-    p.add_argument("--journal-instances", type=int, default=1,
+    p.add_argument("--journal-instances", type=int, default=0,
                    help="TPU runtime: instances with full per-message "
-                        "journals (messages.svg + msgs-per-op)")
+                        "journals (messages.svg + msgs-per-op); costs "
+                        "device output bandwidth, so opt-in")
     p.add_argument("--p-loss", type=float, default=0.0)
 
 

@@ -150,3 +150,36 @@ def test_list_append_g_single_label():
     r = check_list_append(h, "serializable")
     assert r["valid?"] is False
     assert "G-single" in r["anomalies"], r["anomaly-types"]
+
+
+def test_minimal_cycle_steps_reported():
+    """r2: anomalies carry a minimal explanatory cycle with per-edge
+    reasons (Elle's explanation discipline), not a whole-SCC dump."""
+    from maelstrom_tpu.checkers.elle import check_list_append
+    # classic G0: two txns that ww-conflict in both orders on two keys
+    h = []
+    i = 0
+
+    def rec(p, t, f, v, tm):
+        nonlocal i
+        r = {"process": p, "type": t, "f": f, "value": v, "index": i,
+             "time": tm}
+        i += 1
+        return r
+
+    h.append(rec(0, "invoke", "txn", [["append", 0, 1], ["append", 1, 2]], 0))
+    h.append(rec(1, "invoke", "txn", [["append", 1, 1], ["append", 0, 2]], 0))
+    h.append(rec(0, "ok", "txn", [["append", 0, 1], ["append", 1, 2]], 5))
+    h.append(rec(1, "ok", "txn", [["append", 1, 1], ["append", 0, 2]], 5))
+    # reads fixing the version orders: key0 = [1, 2] puts txn0 before
+    # txn1; key1 = [1, 2] puts txn1 (which appended 1) before txn0
+    # (which appended 2) -> ww cycle
+    h.append(rec(2, "invoke", "txn", [["r", 0, None], ["r", 1, None]], 6))
+    h.append(rec(2, "ok", "txn", [["r", 0, [1, 2]], ["r", 1, [1, 2]]], 7))
+    res = check_list_append(h, "serializable")
+    assert res["valid?"] is False
+    g0 = res["anomalies"].get("G0") or res["anomalies"].get("G1c")
+    assert g0, res["anomalies"]
+    cyc = g0[0]
+    assert cyc["cycle-length"] >= 2
+    assert all("because" in s and s["because"] for s in cyc["steps"])

@@ -1,0 +1,55 @@
+"""Native WGL core (cpp/checker/libwgl.so, ctypes) differential-tested
+against the pure-Python search on randomized register histories — same
+cross-validation discipline as the device netsim vs host oracle."""
+
+import random
+
+import pytest
+
+from maelstrom_tpu.checkers import native
+from maelstrom_tpu.checkers.linearizable import (
+    _collect_ops, check_register_history)
+
+pytestmark = pytest.mark.skipif(native._load() is None,
+                                reason="no C++ toolchain")
+
+
+def _random_history(rng, n_ops=14, n_procs=4, n_vals=3,
+                    corrupt=False):
+    h, i, t = [], 0, 0
+    pending = {}
+    for _ in range(n_ops):
+        t += 1
+        p = rng.randrange(n_procs)
+        if p in pending:
+            f, v = pending.pop(p)
+            ctype = rng.choice(["ok", "ok", "ok", "info", "fail"])
+            if f == "read" and ctype == "ok":
+                v = [v[0], rng.randrange(n_vals) if corrupt or
+                     rng.random() < 0.7 else None]
+            h.append({"process": p, "type": ctype, "f": f, "value": v,
+                      "index": i, "time": t})
+        else:
+            f = rng.choice(["read", "write", "cas"])
+            if f == "read":
+                v = [0, None]
+            elif f == "write":
+                v = [0, rng.randrange(n_vals)]
+            else:
+                v = [0, [rng.randrange(n_vals), rng.randrange(n_vals)]]
+            h.append({"process": p, "type": "invoke", "f": f, "value": v,
+                      "index": i, "time": t})
+            pending[p] = (f, v)
+        i += 1
+    return h
+
+
+@pytest.mark.parametrize("seed", range(40))
+def test_native_matches_python_verdict(seed):
+    rng = random.Random(seed)
+    h = _random_history(rng, corrupt=(seed % 2 == 0))
+    ops = _collect_ops(h, 0)
+    py = check_register_history(ops, budget_states=10_000_000)
+    nat = native.check_register_history_native(ops, 10_000_000)
+    assert nat is not None, "native path unexpectedly unavailable"
+    assert nat == py, (seed, nat, py)
