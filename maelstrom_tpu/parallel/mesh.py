@@ -44,11 +44,18 @@ def make_mesh(n_devices: Optional[int] = None) -> Mesh:
 
 @partial(jax.jit, static_argnames=("model", "sim", "mesh"))
 def _run_sharded(model: Model, sim: SimConfig, mesh: Mesh, seeds, params):
-    """seeds: int32 [n_devices]; sim describes the PER-DEVICE shard."""
+    """seeds: int32 shaped like ``mesh.devices``; ``sim`` describes the
+    PER-DEVICE shard. Works for any mesh rank — stats psum over every
+    mesh axis, sharded outputs split over all axes jointly (so a 1-D
+    ICI mesh and a 2-D DCN x ICI hybrid mesh share this code path)."""
+    axes = mesh.axis_names
 
     def shard_body(seed_shard, params_rep):
-        carry, ys = simulate(model, sim, seed_shard[0], params_rep)
-        stats = jax.tree.map(lambda x: jax.lax.psum(x, AXIS), carry.stats)
+        carry, ys = simulate(model, sim, seed_shard.reshape(()),
+                             params_rep)
+        stats = carry.stats
+        for ax in axes:
+            stats = jax.tree.map(lambda x: jax.lax.psum(x, ax), stats)
         return stats, carry.violations, ys.events
 
     # zero-initialized carry components are unvaried constants while the
@@ -56,8 +63,8 @@ def _run_sharded(model: Model, sim: SimConfig, mesh: Mesh, seeds, params):
     # carry mix, and everything here is embarrassingly parallel anyway
     return jax.shard_map(
         shard_body, mesh=mesh,
-        in_specs=(P(AXIS), P()),
-        out_specs=(P(), P(AXIS), P(None, AXIS)),
+        in_specs=(P(*axes), P()),
+        out_specs=(P(), P(axes), P(None, axes)),
         check_vma=False,
     )(seeds, params)
 
@@ -65,8 +72,8 @@ def _run_sharded(model: Model, sim: SimConfig, mesh: Mesh, seeds, params):
 def run_sim_sharded(model: Model, sim: SimConfig, seed: int, params=None,
                     mesh: Optional[Mesh] = None
                     ) -> Tuple[NetStats, jnp.ndarray, jnp.ndarray]:
-    """Run ``n_devices`` shards of ``sim`` (each simulating
-    ``sim.n_instances`` clusters) across the mesh.
+    """Run one ``sim``-sized shard per device across the mesh (any
+    rank; default the 1-D local-device mesh).
 
     Returns (fleet-wide NetStats summed over devices, per-instance
     on-device invariant-violation tick counts
@@ -78,8 +85,9 @@ def run_sim_sharded(model: Model, sim: SimConfig, seed: int, params=None,
     # drops TickOutputs.journal_* — refuse silently-ignored config
     assert sim.journal_instances == 0, \
         "journal_instances is not supported under shard_map"
-    n = mesh.devices.size
-    seeds = jnp.arange(n, dtype=jnp.int32) * 1_000_003 + seed
+    shape = mesh.devices.shape
+    seeds = (jnp.arange(mesh.devices.size, dtype=jnp.int32)
+             .reshape(shape) * 1_000_003 + seed)
     if params is None:
         params = model.make_params(sim.net.n_nodes)
     if params is None:

@@ -1,0 +1,66 @@
+"""Multi-host scaling: DCN x ICI hybrid meshes for the instance axis.
+
+Single-host runs shard instances over one 1-D ICI mesh (:mod:`.mesh`).
+At pod/multi-host scale the same data parallelism factors over two axes
+— hosts over DCN, chips-per-host over ICI — so the collectives that
+matter (the psum'd fleet counters) reduce over ICI within a host and
+only the tiny reduced scalars cross DCN. Protocol instances never
+communicate with each other, so there is no cross-instance traffic at
+all; this is the TPU-native analogue of the reference's scale model
+(more JVM threads/processes on one box, SURVEY §2.4), lifted to a pod.
+
+The sharded execution itself is :func:`.mesh.run_sim_sharded`, which is
+mesh-rank-agnostic — this module only provides process bring-up and the
+hybrid mesh constructor::
+
+    from maelstrom_tpu.parallel import mesh, multihost
+    multihost.init()                       # jax.distributed from env
+    m = multihost.make_hybrid_mesh()       # ("dcn", "ici") axes
+    stats, violations, events = mesh.run_sim_sharded(
+        model, sim, seed=0, mesh=m)
+
+Degenerate single-host form (1 process) builds a (1, n_devices) mesh —
+what the tests exercise on the virtual CPU mesh; the sharding compiles
+and runs identically, only the DCN axis size changes.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+DCN_AXIS = "dcn"
+ICI_AXIS = "ici"
+
+
+def init(**kw) -> None:
+    """Initialize jax.distributed from the environment (coordinator
+    address / process id / process count env vars or explicit kwargs).
+    No-op when already initialized or single-process."""
+    try:
+        jax.distributed.initialize(**kw)
+    except (RuntimeError, ValueError):
+        pass   # already initialized, or single-process local run
+
+
+def make_hybrid_mesh() -> Mesh:
+    """(n_hosts, chips_per_host) mesh named ("dcn", "ici"). On one
+    process this degenerates to (1, n_devices); on a pod each host's
+    process-local devices form one ICI row (``process_is_granule`` —
+    hosts on a shared slice still granulate by process, so the ICI axis
+    never crosses a host boundary)."""
+    n_procs = jax.process_count()
+    devs = jax.devices()
+    per_host = len(devs) // n_procs
+    if n_procs > 1:
+        from jax.experimental import mesh_utils
+        arr = mesh_utils.create_hybrid_device_mesh(
+            (per_host,), (n_procs,), devices=devs,
+            process_is_granule=True)
+        arr = np.asarray(arr).reshape(n_procs, per_host)
+    else:
+        arr = np.asarray(devs).reshape(1, per_host)
+    return Mesh(arr, (DCN_AXIS, ICI_AXIS))

@@ -49,3 +49,26 @@ def test_raft_sharded_runs_and_checks():
     for h in hists:
         if h:
             assert checker(h, opts)["valid?"] is True
+
+
+def test_hybrid_mesh_single_host_degenerate():
+    """run_sim_sharded over the (1, 8) degenerate DCN x ICI hybrid mesh:
+    the two-axis sharding compiles and runs; only the axis sizes change
+    on a real pod."""
+    from maelstrom_tpu.models.raft import RaftModel
+    from maelstrom_tpu.parallel import multihost
+    from maelstrom_tpu.tpu.harness import make_sim_config
+
+    model = RaftModel(n_nodes_hint=3, log_cap=16)
+    opts = dict(node_count=3, concurrency=2, n_instances=4,
+                record_instances=2, time_limit=0.5, rate=30.0,
+                latency=5.0, rpc_timeout=0.4, recovery_time=0.1, seed=2)
+    sim = make_sim_config(model, opts)._replace(n_ticks=40)
+    mesh = multihost.make_hybrid_mesh()
+    assert mesh.devices.shape == (1, 8)
+    assert mesh.axis_names == (multihost.DCN_AXIS, multihost.ICI_AXIS)
+    stats, violations, events = run_sim_sharded(
+        model, sim, seed=4, mesh=mesh)
+    assert violations.shape[0] == 4 * 8
+    assert events.shape[1] == 2 * 8
+    assert int(stats.sent) > 0
