@@ -1,0 +1,71 @@
+// PN-counter CRDT node in C++: per-node increment/decrement registers
+// merged by pairwise max — the G-Counter pair construction. Periodic
+// full-state gossip; reads sum both registers across all nodes.
+// Exercises timers, numeric JSON, and nested-object merge in the native
+// SDK (the role of the reference's pn_counter demo nodes).
+#include <map>
+#include <string>
+
+#include "maelstrom/node.hpp"
+
+using maelstrom::Message;
+using maelstrom::Node;
+using maelstrom::Value;
+
+int main() {
+  Node node;
+  // node id -> accumulated positive / negative increments
+  std::map<std::string, long> inc, dec;
+
+  auto merge = [&](const Value& v, std::map<std::string, long>& into) {
+    for (const auto& [k, amt] : v.as_object()) {
+      long a = (long)amt.as_int();
+      if (!into.count(k) || a > into[k]) into[k] = a;
+    }
+  };
+
+  auto dump = [&](const std::map<std::string, long>& m) {
+    Value v = Value(maelstrom::json::Object{});
+    for (const auto& [k, a] : m) v[k] = (int64_t)a;
+    return v;
+  };
+
+  node.on("add", [&](const Message& msg) {
+    long delta = (long)msg.body.at("delta").as_int();
+    if (delta >= 0)
+      inc[node.node_id] += delta;
+    else
+      dec[node.node_id] += -delta;
+    Value b;
+    b["type"] = "add_ok";
+    node.reply(msg, b);
+  });
+
+  node.on("read", [&](const Message& msg) {
+    long total = 0;
+    for (const auto& [k, a] : inc) total += a;
+    for (const auto& [k, a] : dec) total -= a;
+    Value b;
+    b["type"] = "read_ok";
+    b["value"] = (int64_t)total;
+    node.reply(msg, b);
+  });
+
+  node.on("replicate", [&](const Message& msg) {
+    merge(msg.body.at("inc"), inc);
+    merge(msg.body.at("dec"), dec);
+  });
+
+  node.every(0.2, [&] {
+    for (const auto& peer : node.node_ids) {
+      if (peer == node.node_id) continue;
+      Value b;
+      b["type"] = "replicate";
+      b["inc"] = dump(inc);
+      b["dec"] = dump(dec);
+      node.send(peer, b);
+    }
+  });
+
+  node.run();
+}

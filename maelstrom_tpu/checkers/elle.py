@@ -46,6 +46,7 @@ _FORBIDDEN_BY = {
     "G1c": "read-committed",         # ww/wr cycles
     "G-single": "serializable",      # one rw edge in the cycle
     "G2-item": "serializable",       # >=1 rw edge
+    "internal": "read-atomic",       # a txn contradicting its own writes
     "realtime": "strict-serializable",
     "incompatible-order": "read-uncommitted",
     # detection of lost appends relies on real-time ordering ("a read
@@ -461,9 +462,14 @@ def check_rw_register(history,
                       consistency_model: str = "strict-serializable"
                       ) -> dict:
     """rw-register anomalies. Writes are unique per key, so wr edges are
-    exact; version order per key is inferred from wr + session + realtime
-    information only where unambiguous, so this is a sound (never
-    false-positive) subset of Elle's rw-register analysis."""
+    exact. Version order per key is inferred only from sound facts —
+    write-follows-read within a committed txn (the reference's
+    ``:wfr-keys? true``, txn_rw_register.clj:162-168) and the initial
+    nil version preceding every written version — from which ww and
+    generalized anti-dependency (rw) edges follow. Session + realtime
+    edges are added in :func:`_finish`. Never false-positive; a sound
+    subset of Elle's rw-register analysis (it won't invent version
+    orders it cannot prove)."""
     committed, failed = _collect_txns(history)
     anomalies: Dict[str, List[Any]] = defaultdict(list)
 
@@ -486,18 +492,73 @@ def check_rw_register(history,
         for op in w_t["ops"]:
             if op[0] == "w":
                 final_write[(w_t["id"], op[1])] = op[2]
+    # readers[(k, v)] = txns that externally observed version v of k
+    # (v None = the initial unwritten version); a fractured txn that
+    # observes several versions of one key is recorded against each
+    readers: Dict[Tuple[Any, Any], Set[int]] = defaultdict(set)
+    vo_pairs: Set[Tuple[Any, Any, Any]] = set()   # (k, v1, v2): v1 < v2
     for t in committed:
+        # single pass per txn: external reads (before own writes), the
+        # write-follows-read version-order pairs (wfr: last external
+        # read of k before this txn's FIRST write of k orders those
+        # versions), and internal consistency (a read after this txn's
+        # own write must see it)
+        last_read: Dict[Any, Any] = {}
+        wrote: Dict[Any, Any] = {}
         for op in t["ops"]:
-            if op[0] != "r" or op[2] is None:
-                continue
-            k, v = op[1], op[2]
-            if (k, v) in failed_writes:
-                anomalies["G1a"].append({"key": k, "value": v})
-            w = writer.get((k, v))
-            if w is not None:
-                if w != t["id"]:
-                    g.add(w, t["id"], "wr")
-                    if final_write.get((w, k)) != v:
-                        anomalies["G1b"].append({"key": k, "value": v})
+            f, k, v = op[0], op[1], op[2]
+            if f == "r":
+                if k in wrote:
+                    if v != wrote[k]:
+                        anomalies["internal"].append(
+                            {"key": k, "expected": wrote[k],
+                             "read": v, "txn": t["ops"]})
+                    continue
+                last_read[k] = v
+                readers[(k, v)].add(t["id"])
+                if v is not None:
+                    if (k, v) in failed_writes:
+                        anomalies["G1a"].append({"key": k, "value": v})
+                    w = writer.get((k, v))
+                    if w is not None and w != t["id"]:
+                        g.add(w, t["id"], "wr")
+                        if final_write.get((w, k)) != v:
+                            anomalies["G1b"].append({"key": k,
+                                                     "value": v})
+            else:
+                if k not in wrote and k in last_read:
+                    vo_pairs.add((k, last_read[k], v))
+                wrote[k] = v
+
+    # version-order inference (sound, never guessed):
+    #   - wfr: a txn that read version v1 of k and then wrote v2 orders
+    #     v1 < v2
+    #   - the initial (nil) version precedes every written version
+    # From v1 < v2 follow ww (writer(v1) -> writer(v2)) and the
+    # generalized anti-dependency rw: ANY txn that observed v1 must
+    # precede the writer of any later version (it would have seen it
+    # otherwise) — this is what exposes write skew and other G2-item /
+    # G-single cycles the wr/session edges alone cannot.
+    writers_by_key: Dict[Any, Set[int]] = defaultdict(set)
+    for (k, v), w in writer.items():
+        writers_by_key[k].add(w)
+    for k, v1, v2 in vo_pairs:
+        w2 = writer.get((k, v2))
+        if w2 is None:
+            continue
+        w1 = writer.get((k, v1)) if v1 is not None else None
+        if w1 is not None and w1 != w2:
+            g.add(w1, w2, "ww")
+        for r in readers.get((k, v1), ()):
+            if r != w2:
+                g.add(r, w2, "rw")
+    # nil precedes everything: its readers anti-depend on every writer
+    for (k, v), rs in list(readers.items()):
+        if v is not None:
+            continue
+        for w2 in writers_by_key.get(k, ()):
+            for r in rs:
+                if r != w2:
+                    g.add(r, w2, "rw")
 
     return _finish(g, committed, anomalies, consistency_model)

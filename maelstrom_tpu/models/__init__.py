@@ -40,10 +40,13 @@ def get_model(workload: str, node_count: int, topology: str = "grid"):
         return TxnListAppendModel(n_nodes_hint=node_count)
     if workload == "txn-rw-register":
         return TxnRwRegisterModel(n_nodes_hint=node_count)
-    if workload.startswith("txn-list-append-bug-"):
-        kind = workload[len("txn-list-append-bug-"):]
-        if kind in TXN_BUGGY_MODELS:
-            return TXN_BUGGY_MODELS[kind](n_nodes_hint=node_count)
+    for prefix in ("txn-list-append-bug-", "txn-rw-register-bug-"):
+        if workload.startswith(prefix):
+            kind = workload[len(prefix):]
+            if prefix.startswith("txn-rw-register"):
+                kind = "rw-" + kind
+            if kind in TXN_BUGGY_MODELS:
+                return TXN_BUGGY_MODELS[kind](n_nodes_hint=node_count)
     if workload == "kafka":
         return KafkaModel()
     if workload.startswith("kafka-bug-"):

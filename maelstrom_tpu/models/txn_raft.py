@@ -321,6 +321,15 @@ class TxnDirtyApply(TxnListAppendModel):
     apply_uncommitted = True
 
 
+class TxnRwDirtyApply(TxnRwRegisterModel):
+    """BUG: the same dirty apply on the rw-register workload — caught
+    via the checker's wfr/initial-version order inference as G-single
+    cycles (stale reads of truncated acked writes)."""
+    name = "txn-rw-register-bug-dirty-apply"
+    apply_uncommitted = True
+
+
 TXN_BUGGY_MODELS = {
     "dirty-apply": TxnDirtyApply,
+    "rw-dirty-apply": TxnRwDirtyApply,
 }

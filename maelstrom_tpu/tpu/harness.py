@@ -240,6 +240,14 @@ def _write_store(name: str, store_root: str, results: Dict[str, Any],
     if journal is not None:
         from ..net.viz import plot_lamport
         plot_lamport(journal, os.path.join(d, "messages.svg"))
+    if histories:
+        # latency/rate plots + timeline from the first recorded
+        # instance's history (store artifact parity with the process
+        # runner, doc/results.md)
+        from ..checkers.perf import plot_perf
+        from ..checkers.timeline import render_timeline
+        plot_perf(histories[0], d)
+        render_timeline(histories[0], os.path.join(d, "timeline.html"))
     with open(os.path.join(d, "results.json"), "w") as f:
         json.dump(results, f, indent=2, default=repr)
     for i, h in enumerate(histories):

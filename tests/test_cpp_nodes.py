@@ -62,3 +62,16 @@ def test_cpp_broadcast_with_partitions(cpp_bins):
     assert w["valid?"] is True, w
     assert w["lost-count"] == 0
     assert w["acknowledged-count"] > 0
+
+
+def test_cpp_pn_counter(cpp_bins):
+    res = run("pn-counter", "pn_counter", cpp_bins, node_count=3,
+              time_limit=4.0, recovery_time=1.0)
+    assert res["valid?"] is True, res["workload"]
+    assert res["stats"]["ok-count"] > 30
+
+
+def test_cpp_pn_counter_as_g_counter(cpp_bins):
+    res = run("g-counter", "pn_counter", cpp_bins, node_count=3,
+              time_limit=4.0, recovery_time=1.0)
+    assert res["valid?"] is True, res["workload"]

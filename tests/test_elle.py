@@ -183,3 +183,73 @@ def test_minimal_cycle_steps_reported():
     cyc = g0[0]
     assert cyc["cycle-length"] >= 2
     assert all("because" in s and s["because"] for s in cyc["steps"])
+
+
+def _rw_rec(recs):
+    h = []
+    for i, (p, t, f, v, tm) in enumerate(recs):
+        h.append({"process": p, "type": t, "f": f, "value": v,
+                  "index": i, "time": tm})
+    return h
+
+
+def test_rw_register_write_skew_caught():
+    """Classic write skew: T1 reads x, writes y; T2 reads y, writes x —
+    both read the initial state. Two generalized anti-dependencies form
+    a G2-item cycle (r2: the rw-register checker now infers version
+    orders from wfr + initial-version facts, not wr edges alone)."""
+    from maelstrom_tpu.checkers.elle import check_rw_register
+    h = _rw_rec([
+        (0, "invoke", "txn", [["r", "x", None], ["w", "y", 1]], 0),
+        (1, "invoke", "txn", [["r", "y", None], ["w", "x", 2]], 0),
+        (0, "ok", "txn", [["r", "x", None], ["w", "y", 1]], 5),
+        (1, "ok", "txn", [["r", "y", None], ["w", "x", 2]], 5),
+    ])
+    res = check_rw_register(h, "serializable")
+    assert res["valid?"] is False
+    assert "G2-item" in res["anomaly-types"], res["anomaly-types"]
+
+
+def test_rw_register_internal_anomaly():
+    from maelstrom_tpu.checkers.elle import check_rw_register
+    h = _rw_rec([
+        (0, "invoke", "txn", [["w", "x", 1], ["r", "x", None]], 0),
+        (0, "ok", "txn", [["w", "x", 1], ["r", "x", 7]], 2),
+    ])
+    res = check_rw_register(h, "read-atomic")
+    assert res["valid?"] is False
+    assert "internal" in res["anomaly-types"]
+
+
+def test_rw_register_serializable_history_clean():
+    from maelstrom_tpu.checkers.elle import check_rw_register
+    # sequential: T1 writes x=1; T2 reads x=1 writes x=2; T3 reads x=2
+    h = _rw_rec([
+        (0, "invoke", "txn", [["w", "x", 1]], 0),
+        (0, "ok", "txn", [["w", "x", 1]], 1),
+        (1, "invoke", "txn", [["r", "x", None], ["w", "x", 2]], 2),
+        (1, "ok", "txn", [["r", "x", 1], ["w", "x", 2]], 3),
+        (2, "invoke", "txn", [["r", "x", None]], 4),
+        (2, "ok", "txn", [["r", "x", 2]], 5),
+    ])
+    res = check_rw_register(h, "strict-serializable")
+    assert res["valid?"] is True, res
+
+
+def test_rw_register_fractured_read_second_observation():
+    """A txn that externally observes TWO versions of one key must
+    contribute anti-dependency edges for each observation (r2 review
+    fix: readers records every observed version, not just the first)."""
+    from maelstrom_tpu.checkers.elle import check_rw_register
+    h = _rw_rec([
+        (0, "invoke", "txn", [["r", "x", None], ["r", "x", None]], 0),
+        (0, "ok", "txn", [["r", "x", None], ["r", "x", 1]], 1),
+        (1, "invoke", "txn", [["w", "x", 1]], 2),
+        (1, "ok", "txn", [["w", "x", 1]], 3),
+        (2, "invoke", "txn", [["r", "x", None], ["w", "x", 2]], 4),
+        (2, "ok", "txn", [["r", "x", 1], ["w", "x", 2]], 5),
+    ])
+    res = check_rw_register(h, "serializable")
+    assert res["valid?"] is False
+    assert any(k in res["anomaly-types"]
+               for k in ("G-single", "G2-item")), res["anomaly-types"]

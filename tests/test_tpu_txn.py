@@ -84,3 +84,23 @@ def test_kafka_offset_reuse_caught():
     for b in bad:
         kinds.update(b.get("anomaly-types") or [])
     assert "duplicate-offset" in kinds, kinds
+
+
+def test_txn_rw_dirty_apply_caught():
+    """rw-register dirty-apply mutant: stale reads of truncated acked
+    writes surface as G-single cycles through the checker's
+    wfr/initial-version order inference; correct model clean on the
+    identical schedule."""
+    from maelstrom_tpu.models.txn_raft import TxnRwDirtyApply
+    sched, horizon = _leader_isolation_schedule()
+    opts = dict(node_count=3, concurrency=4, n_instances=8,
+                record_instances=8, time_limit=horizon, rate=60.0,
+                latency=5.0, rpc_timeout=0.8, nemesis=["partition"],
+                nemesis_kind="scripted", nemesis_schedule=sched,
+                recovery_time=0.5, seed=3)
+    res = run_tpu_test(TxnRwDirtyApply(n_nodes_hint=3, log_cap=96), opts)
+    assert res["valid?"] is False, "rw dirty-apply mutant not caught"
+
+    res_ok = run_tpu_test(TxnRwRegisterModel(n_nodes_hint=3, log_cap=96),
+                          opts)
+    assert res_ok["valid?"] is True, res_ok["instances"]
