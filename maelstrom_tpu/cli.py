@@ -29,6 +29,13 @@ def _bin_cmd(bin_path: str, args: List[str]):
     return bin_path, args
 
 
+def _positive_int(value: str) -> int:
+    n = int(value)
+    if n <= 0:
+        raise argparse.ArgumentTypeError(f"must be a positive int: {value}")
+    return n
+
+
 def parse_concurrency(value: str, node_count: int) -> int:
     """'10' -> 10, '4n' -> 4 * node_count (core.clj opt-spec parity)."""
     if value.endswith("n"):
@@ -89,6 +96,9 @@ def add_test_options(p: argparse.ArgumentParser):
                    help="TPU runtime: instances with full per-message "
                         "journals (messages.svg + msgs-per-op); costs "
                         "device output bandwidth, so opt-in")
+    p.add_argument("--ms-per-tick", type=_positive_int, default=1,
+                   help="TPU runtime: virtual-clock resolution "
+                        "(fidelity vs throughput trade)")
     p.add_argument("--p-loss", type=float, default=0.0)
 
 
@@ -146,6 +156,8 @@ def cmd_test(args) -> int:
             nemesis_interval=args.nemesis_interval,
             nemesis_kind=args.nemesis_kind,
             availability=_availability(args.availability),
+            consistency_models=args.consistency_models,
+            ms_per_tick=args.ms_per_tick,
             n_instances=args.n_instances,
             record_instances=args.record_instances,
             journal_instances=args.journal_instances,
