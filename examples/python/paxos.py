@@ -1,0 +1,303 @@
+#!/usr/bin/env python3
+"""lin-kv node driven by per-key multi-slot single-decree Paxos.
+
+Every client operation (read / write / cas — reads included, for
+linearizability) is decided into the next free slot of its key's log by
+a full two-phase single-decree Paxos round (prepare/promise with
+accepted-value adoption, then accept/accepted on a majority, then a
+decide broadcast). No stable leader, no leases: competing proposers
+collide, adopt each other's values, and retry with higher ballots —
+the classic teaching construction (BASELINE.json config #4's
+"single-decree Paxos demo node"; protocol-equivalent role to the
+reference's Raft chapter nodes, built on the plain node SDK).
+
+Partition-tolerant: ops proposed on the majority side commit; minority
+proposers exhaust their ballot budget and fail definite (error 11), so
+clients retry safely.
+"""
+
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from node import Node, RPCError  # noqa: E402
+
+node = Node()
+
+MAX_ROUNDS = 10          # ballot retries before giving up (definite 11)
+PHASE_TIMEOUT = 0.6      # seconds to wait for a quorum per phase
+
+state = threading.RLock()
+# acceptor: (key, slot) -> {"promised": ballot, "accepted": (ballot, v)}
+acceptor = {}
+# learner: key -> list of chosen ops (the key's command log)
+chosen = {}
+# applied kv: key -> register value, and how many slots are applied
+kv = {}
+applied = {}
+_ballot_counter = [0]
+
+
+def _majority():
+    return len(node.node_ids) // 2 + 1
+
+
+def _next_ballot():
+    with state:
+        _ballot_counter[0] += 1
+        return [_ballot_counter[0], node.node_id]
+
+
+def _bump_ballot(seen):
+    with state:
+        _ballot_counter[0] = max(_ballot_counter[0], seen[0])
+
+
+def _acc(key, slot):
+    return acceptor.setdefault((key, slot), {"promised": None,
+                                             "accepted": None})
+
+
+def _quorum_call(body, collect):
+    """Send ``body`` to every node (self included, via loopback call),
+    wait for a majority of positive replies within PHASE_TIMEOUT.
+    ``collect(reply_body)`` returns True if the reply counts toward the
+    quorum. Returns True on quorum."""
+    need = _majority()
+    got = [0]
+    done = threading.Event()
+
+    def on_reply(reply):
+        if collect(reply):
+            with state:
+                got[0] += 1
+                if got[0] >= need:
+                    done.set()
+
+    for peer in node.node_ids:
+        if peer == node.node_id:
+            on_reply(_handle_local(dict(body)))
+        else:
+            node.rpc(peer, dict(body), on_reply, timeout=PHASE_TIMEOUT)
+    done.wait(PHASE_TIMEOUT)
+    return got[0] >= need
+
+
+def _handle_local(body):
+    """Run our own acceptor for a loopback phase message."""
+    if body["type"] == "prepare":
+        return _prepare(body)
+    return _accept(body)
+
+
+def _prepare(b):
+    with state:
+        a = _acc(b["key"], b["slot"])
+        if a["promised"] is None or b["ballot"] >= a["promised"]:
+            a["promised"] = list(b["ballot"])
+            return {"type": "promise", "ok": True,
+                    "accepted": a["accepted"]}
+        return {"type": "promise", "ok": False,
+                "promised": a["promised"]}
+
+
+def _accept(b):
+    with state:
+        a = _acc(b["key"], b["slot"])
+        if a["promised"] is None or b["ballot"] >= a["promised"]:
+            a["promised"] = list(b["ballot"])
+            a["accepted"] = [list(b["ballot"]), b["value"]]
+            return {"type": "accepted", "ok": True}
+        return {"type": "accepted", "ok": False,
+                "promised": a["promised"]}
+
+
+@node.on("prepare")
+def on_prepare(msg):
+    node.reply(msg, _prepare(msg["body"]))
+
+
+@node.on("accept")
+def on_accept(msg):
+    node.reply(msg, _accept(msg["body"]))
+
+
+@node.on("decide")
+def on_decide(msg):
+    b = msg["body"]
+    _learn(b["key"], b["slot"], b["value"])
+    node.reply(msg, {"type": "decide_ok"})
+
+
+def _learn(key, slot, value):
+    with state:
+        log = chosen.setdefault(key, {})
+        log[slot] = value
+        # apply any now-contiguous prefix
+        kv.setdefault(key, None)
+        n = applied.setdefault(key, 0)
+        while n in log:
+            op = log[n]
+            if op["f"] == "write":
+                kv[key] = op["value"]
+            elif op["f"] == "cas" and kv[key] == op["from"]:
+                kv[key] = op["to"]
+            # reads leave state untouched
+            n += 1
+        applied[key] = n
+
+
+def _decide_all(key, slot, value):
+    _learn(key, slot, value)
+    for peer in node.node_ids:
+        if peer != node.node_id:
+            node.rpc(peer, {"type": "decide", "key": key, "slot": slot,
+                            "value": value}, lambda r: None,
+                     timeout=PHASE_TIMEOUT)
+
+
+def _propose(key, my_op):
+    """Decide ``my_op`` into some slot of ``key``; returns the slot it
+    was chosen in (driving competing values to completion on the way)."""
+    exposed = False   # once our value reached ANY acceptor, a later
+                      # proposer may adopt and commit it, so giving up
+                      # must be INDEFINITE (the op may still happen)
+    for _ in range(MAX_ROUNDS):
+        with state:
+            log = chosen.get(key, {})
+            # adoption dedupe: a competing proposer may have adopted and
+            # committed OUR value after a partial accept — proposing it
+            # again would apply the op twice (linearizability violation)
+            for s_done, v_done in log.items():
+                if v_done.get("id") == my_op["id"]:
+                    return s_done
+            slot = applied.get(key, 0)
+            while slot in log:
+                slot += 1
+        ballot = _next_ballot()
+        adopted = [None]   # highest-ballot accepted value seen
+
+        def on_promise(r):
+            if r.get("type") != "promise":
+                return False
+            if not r.get("ok"):
+                if r.get("promised"):
+                    _bump_ballot(r["promised"])
+                return False
+            acc = r.get("accepted")
+            if acc:
+                with state:
+                    if adopted[0] is None or acc[0] > adopted[0][0]:
+                        adopted[0] = acc
+            return True
+
+        if not _quorum_call({"type": "prepare", "key": key,
+                             "slot": slot, "ballot": ballot},
+                            on_promise):
+            time.sleep(0.02)
+            continue
+        value = adopted[0][1] if adopted[0] else my_op
+
+        def on_accepted(r):
+            if r.get("type") != "accepted" or not r.get("ok"):
+                if r.get("promised"):
+                    _bump_ballot(r["promised"])
+                return False
+            return True
+
+        if value.get("id") == my_op["id"]:
+            exposed = True
+        if not _quorum_call({"type": "accept", "key": key, "slot": slot,
+                             "ballot": ballot, "value": value},
+                            on_accepted):
+            time.sleep(0.02)
+            continue
+        _decide_all(key, slot, value)
+        if value.get("id") == my_op["id"]:
+            return slot
+        # our slot was taken by an adopted value; drive on to the next
+    if exposed:
+        # indefinite: an accepted copy of our value may yet be chosen
+        raise RPCError.timeout("gave up mid-accept; op may still apply")
+    raise RPCError(11, "could not reach consensus (partitioned?)")
+
+
+_op_counter = [0]
+
+
+def _run_client_op(msg, f, extra):
+    key = str(msg["body"]["key"])
+    with state:
+        _op_counter[0] += 1
+        op_id = f"{node.node_id}-{_op_counter[0]}"
+    my_op = {"f": f, "id": op_id, **extra}
+    slot = _propose(key, my_op)
+    with state:
+        # compute the op's result from the log prefix (_propose returns
+        # only once every slot <= ours is chosen and learned locally)
+        val = None
+        for s in range(slot + 1):
+            op = chosen[key][s]
+            if op["f"] == "write":
+                val = op["value"]
+            elif op["f"] == "cas" and val == op["from"]:
+                val = op["to"]
+        if f == "read":
+            node.reply(msg, {"type": "read_ok", "value": val})
+        elif f == "write":
+            node.reply(msg, {"type": "write_ok"})
+        else:
+            # recompute whether OUR cas succeeded: state just before it
+            pre = None
+            for s in range(slot):
+                op = chosen[key][s]
+                if op["f"] == "write":
+                    pre = op["value"]
+                elif op["f"] == "cas" and pre == op["from"]:
+                    pre = op["to"]
+            if pre == my_op["from"]:
+                node.reply(msg, {"type": "cas_ok"})
+            elif pre is None:
+                node.reply_error(msg, RPCError(20, "key does not exist"))
+            else:
+                node.reply_error(msg, RPCError(
+                    22, f"expected {my_op['from']!r}, had {pre!r}"))
+
+
+def _client_op_async(msg, f, extra):
+    """The SDK dispatches handlers under node.lock; a multi-round Paxos
+    proposal blocks for seconds, which would stall this node's acceptor
+    (prepare/accept queue behind the lock) and livelock competing
+    proposers. Run the proposal on a worker thread instead — the
+    acceptor handlers stay quick — and map errors to replies ourselves
+    (the SDK's auto-reply only covers in-handler exceptions)."""
+    def work():
+        try:
+            _run_client_op(msg, f, extra)
+        except RPCError as e:
+            node.reply_error(msg, e)
+        except Exception as e:  # noqa: BLE001
+            node.reply_error(msg, RPCError(13, repr(e)))
+    threading.Thread(target=work, daemon=True).start()
+
+
+@node.on("read")
+def on_read(msg):
+    _client_op_async(msg, "read", {})
+
+
+@node.on("write")
+def on_write(msg):
+    _client_op_async(msg, "write", {"value": msg["body"]["value"]})
+
+
+@node.on("cas")
+def on_cas(msg):
+    _client_op_async(msg, "cas", {"from": msg["body"]["from"],
+                                  "to": msg["body"]["to"]})
+
+
+if __name__ == "__main__":
+    node.run()

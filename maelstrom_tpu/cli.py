@@ -201,6 +201,9 @@ DEMOS = [
     ("lin-kv", "raft.py",
      {"node_count": 3, "rate": 20.0, "nemesis": ["partition"],
       "nemesis_interval": 3.0, "recovery_time": 2.0}),
+    ("lin-kv", "paxos.py",
+     {"node_count": 5, "rate": 10.0, "nemesis": ["partition"],
+      "nemesis_interval": 3.0, "recovery_time": 2.0}),
     ("txn-list-append", "txn_single.py", {"node_count": 1, "rate": 20.0}),
     ("txn-list-append", "datomic_txn.py", {"node_count": 3,
                                            "rate": 15.0}),

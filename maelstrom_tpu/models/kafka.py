@@ -56,8 +56,9 @@ class KafkaModel(Model):
     max_out = 1
     idempotent_fs = (F_POLL, F_LIST)
 
-    # bug switch: non-atomic offset assignment (see KafkaOffsetReuse)
-    reuse_offsets = False
+    # bug switches (see KafkaOffsetReuse / KafkaCommitRegression)
+    reuse_offsets = False     # non-atomic offset assignment
+    commit_monotonic = True   # False: commits blindly overwrite
 
     def __init__(self, n_keys: int = 4, log_cap: int = 64,
                  poll_max: int = 3):
@@ -154,9 +155,14 @@ class KafkaModel(Model):
         # processed position - 1 (never regresses)
         my_pos = row.positions[ci]
         commit_vals = my_pos  # offset+1 encoding (0 = nothing polled)
-        committed = jnp.where(
-            is_commit,
-            jnp.maximum(row.committed, my_pos - 1), row.committed)
+        if self.commit_monotonic:
+            new_committed = jnp.maximum(row.committed, my_pos - 1)
+        else:
+            # BUG variant: blind overwrite — a lagging client's commit
+            # drags the group's committed offsets backwards
+            new_committed = jnp.where(my_pos > 0, my_pos - 1,
+                                      row.committed)
+        committed = jnp.where(is_commit, new_committed, row.committed)
 
         # --- reply
         out = jnp.zeros((1, cfg.lanes), dtype=jnp.int32)
@@ -284,6 +290,15 @@ class KafkaOffsetReuse(KafkaModel):
     reuse_offsets = True
 
 
+class KafkaCommitRegression(KafkaModel):
+    """BUG: commit_offsets blindly overwrites instead of taking the max,
+    so a lagging consumer drags the group's committed offsets backwards
+    — caught by the checker's server-reported commit-regression rule."""
+    name = "kafka-bug-commit-regression"
+    commit_monotonic = False
+
+
 KAFKA_BUGGY_MODELS = {
     "offset-reuse": KafkaOffsetReuse,
+    "commit-regression": KafkaCommitRegression,
 }

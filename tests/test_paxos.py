@@ -1,0 +1,31 @@
+"""Single-decree-Paxos lin-kv node (BASELINE.json config #4): per-key
+multi-slot Paxos with full two-phase rounds per op, linearizable with
+and without partitions."""
+
+import os
+import sys
+
+from maelstrom_tpu import run_test
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BIN_ARGS = [os.path.join(REPO, "examples", "python", "paxos.py")]
+
+
+def test_paxos_lin_kv_5n():
+    res = run_test("lin-kv", dict(
+        bin=sys.executable, bin_args=BIN_ARGS, node_count=5,
+        time_limit=8.0, rate=10.0, concurrency=4, recovery_time=1.0,
+        seed=21))
+    assert res["valid?"] is True, res["workload"]
+    assert res["stats"]["ok-count"] > 30
+
+
+def test_paxos_lin_kv_partitions():
+    res = run_test("lin-kv", dict(
+        bin=sys.executable, bin_args=BIN_ARGS, node_count=5,
+        time_limit=12.0, rate=10.0, concurrency=4, latency=5.0,
+        nemesis=["partition"], nemesis_interval=3.0, recovery_time=2.0,
+        seed=22))
+    assert res["valid?"] is True, res["workload"]
+    assert res["workload"]["bad-keys"] == []
+    assert res["stats"]["ok-count"] > 10

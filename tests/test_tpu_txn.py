@@ -104,3 +104,13 @@ def test_txn_rw_dirty_apply_caught():
     res_ok = run_tpu_test(TxnRwRegisterModel(n_nodes_hint=3, log_cap=96),
                           opts)
     assert res_ok["valid?"] is True, res_ok["instances"]
+
+
+def test_kafka_commit_regression_caught():
+    from maelstrom_tpu.models.kafka import KafkaCommitRegression
+    res = run_tpu_test(KafkaCommitRegression(), KAFKA_OPTS)
+    assert res["valid?"] is False, "commit-regression mutant not caught"
+    kinds = set()
+    for b in res["instances"]:
+        kinds.update(b.get("anomaly-types") or [])
+    assert "commit-regression" in kinds, kinds
