@@ -114,8 +114,14 @@ class TestRunner:
                                       self.opts["concurrency"], rng)
                 if delay:
                     end = min(time.monotonic() + delay, self.deadline)
-                    while time.monotonic() < end:
-                        time.sleep(min(0.05, end - time.monotonic()))
+                    while True:
+                        # clamp: time may pass between the loop check
+                        # and computing the remainder (negative sleep
+                        # raised ValueError and killed the worker)
+                        remaining = end - time.monotonic()
+                        if remaining <= 0:
+                            break
+                        time.sleep(min(0.05, remaining))
                 if time.monotonic() >= self.deadline:
                     break
                 op = self.source.next_op()
