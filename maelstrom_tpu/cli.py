@@ -65,9 +65,21 @@ def add_test_options(p: argparse.ArgumentParser):
     p.add_argument("--nemesis-interval", type=float, default=10.0)
     p.add_argument("--nemesis-kind", default="random-halves",
                    choices=["random-halves", "isolated-node",
-                            "majorities-ring"],
+                            "majorities-ring", "scripted"],
                    help="partition grudge shape (TPU runtime; the "
                         "process runtime mixes all kinds randomly)")
+    p.add_argument("--nemesis-schedule-file", default=None,
+                   help="TPU runtime: JSON file of phases [[until_tick,"
+                        " [[node...], ...]], ...] — traffic allowed "
+                        "only within each listed group until the "
+                        "phase's tick (node ids are 0-based ints; "
+                        "implies --nemesis partition --nemesis-kind "
+                        "scripted). Phases are force-healed from "
+                        "time_limit - recovery_time onward (the final "
+                        "heal window)")
+    p.add_argument("--recovery-time", type=float, default=None,
+                   help="final heal + quiesce window in seconds "
+                        "(default: runtime-specific)")
     from .workloads.topology import TOPOLOGIES
     p.add_argument("--topology", default="grid",
                    choices=sorted(TOPOLOGIES))
@@ -118,7 +130,10 @@ def cmd_test(args) -> int:
             return 2
         from .runner import run_test
         bin_, bin_args = _bin_cmd(args.bin, [])
+        proc_extra = ({} if args.recovery_time is None
+                      else {"recovery_time": args.recovery_time})
         results = run_test(args.workload, dict(
+            **proc_extra,
             bin=bin_, bin_args=bin_args, node_count=node_count,
             concurrency=concurrency, rate=args.rate,
             time_limit=args.time_limit, latency=args.latency,
@@ -148,7 +163,35 @@ def cmd_test(args) -> int:
         model = get_model(args.workload, node_count, args.topology)
         if args.key_count and hasattr(model, "n_keys"):
             model.n_keys = args.key_count
-        results = run_tpu_test(model, dict(
+        schedule = ()
+        if args.nemesis_schedule_file:
+            from .tpu.runtime import scripted_isolate_groups
+            with open(args.nemesis_schedule_file) as f:
+                phases = json.load(f)
+            for until, groups in phases:
+                for g in groups:
+                    for m in g:
+                        if not isinstance(m, int) \
+                                or not 0 <= m < node_count:
+                            print(f"error: schedule group member {m!r} "
+                                  f"is not a node index in "
+                                  f"[0, {node_count})", file=sys.stderr)
+                            return 2
+            schedule = tuple(
+                scripted_isolate_groups(until, [set(g) for g in groups],
+                                        node_count)
+                for until, groups in phases)
+            # a schedule file implies the scripted partition nemesis;
+            # silently running healed would be a lie
+            if "partition" not in args.nemesis:
+                args.nemesis = list(args.nemesis) + ["partition"]
+            args.nemesis_kind = "scripted"
+        elif args.nemesis_kind == "scripted":
+            print("error: --nemesis-kind scripted needs "
+                  "--nemesis-schedule-file", file=sys.stderr)
+            return 2
+        tpu_opts = dict(
+            nemesis_schedule=schedule,
             node_count=node_count, concurrency=concurrency,
             rate=args.rate, time_limit=args.time_limit,
             latency=args.latency, latency_dist=args.latency_dist,
@@ -162,7 +205,10 @@ def cmd_test(args) -> int:
             record_instances=args.record_instances,
             journal_instances=args.journal_instances,
             store_root=args.store,
-            seed=args.seed or 0))
+            seed=args.seed or 0)
+        if args.recovery_time is not None:
+            tpu_opts["recovery_time"] = args.recovery_time
+        results = run_tpu_test(model, tpu_opts)
     print(json.dumps(results, indent=2, default=repr))
     print()
     verdict = results.get("valid?")

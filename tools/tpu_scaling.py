@@ -1,0 +1,71 @@
+#!/usr/bin/env python3
+"""Instance-scaling curve on whatever accelerator is available.
+
+Runs the bench flagship (dense-traffic vectorized Raft, partitions +
+loss) at a ladder of instance counts and prints one JSON line per
+point: msgs/s, wall per tick, bytes/instance, overflow. The tool for
+producing the BASELINE north-star evidence (100k instances / >=1M
+msgs/s) the moment a healthy TPU is attached; also runs on CPU for
+regression tracking (small ladder).
+
+Usage:
+    python tools/tpu_scaling.py                 # auto ladder by platform
+    python tools/tpu_scaling.py 512 4096 16384  # explicit ladder
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main() -> None:
+    import jax
+
+    from maelstrom_tpu.models.raft import RaftModel
+    from maelstrom_tpu.tpu.harness import make_sim_config
+    from maelstrom_tpu.tpu.runtime import init_carry, run_sim
+
+    platform = jax.devices()[0].platform
+    if len(sys.argv) > 1:
+        ladder = [int(a) for a in sys.argv[1:]]
+    elif platform == "cpu":
+        ladder = [64, 256, 1024]
+    else:
+        ladder = [512, 2048, 8192, 32768, 65536, 98304]
+
+    model = RaftModel(n_nodes_hint=3, log_cap=64, heartbeat=8)
+    for n in ladder:
+        opts = dict(node_count=3, concurrency=6, n_instances=n,
+                    record_instances=1, inbox_k=3, pool_slots=48,
+                    time_limit=1.0, rate=200.0, latency=5.0,
+                    rpc_timeout=1.0, nemesis=["partition"],
+                    nemesis_interval=0.4, p_loss=0.05,
+                    recovery_time=0.3, seed=7)
+        sim = make_sim_config(model, opts)
+        params = model.make_params(3)
+        carry0 = init_carry(model, sim, 0, params)
+        bpi = sum(x.nbytes for x in jax.tree.leaves(carry0)) // n
+        carry, _ = run_sim(model, sim, 7, params)
+        jax.block_until_ready(carry.stats.delivered)
+        t0 = time.monotonic()
+        carry, _ = run_sim(model, sim, 8, params)
+        jax.block_until_ready(carry.stats.delivered)
+        wall = time.monotonic() - t0
+        d = int(carry.stats.delivered)
+        print(json.dumps({
+            "platform": platform, "instances": n,
+            "msgs_per_sec": round(d / wall, 1),
+            "wall_per_tick_ms": round(wall / sim.n_ticks * 1000, 3),
+            "bytes_per_instance": int(bpi),
+            "dropped_overflow": int(carry.stats.dropped_overflow),
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()
