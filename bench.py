@@ -51,7 +51,7 @@ def child_main() -> None:
     log(TAG, "phase: importing jax")
     import jax
     import jax.numpy as jnp
-    from functools import partial
+    from functools import lru_cache, partial
 
     log(TAG, f"phase: backend init (JAX_PLATFORMS="
              f"{os.environ.get('JAX_PLATFORMS', '<unset>')})")
@@ -92,7 +92,21 @@ def child_main() -> None:
     carry = init_carry(model, sim, 7, params)
     carry_bytes = sum(x.nbytes for x in jax.tree.leaves(carry))
     bytes_per_instance = carry_bytes // max(1, n_instances)
-    seg_ticks = max(1, sim.n_ticks // n_segments)
+    # segment boundaries covering exactly [0, n_ticks). The first
+    # segment is the warm-up at the shared timed length (so its compile
+    # is reused by every timed segment); a nonzero remainder runs as a
+    # SECOND warm-up segment, putting its one-off compile before the
+    # timed window too. A degenerate n_ticks still emits the warm-up
+    # line.
+    n_segments = max(1, min(n_segments, sim.n_ticks))
+    seg_ticks = sim.n_ticks // n_segments
+    rem = sim.n_ticks - n_segments * seg_ticks
+    bounds = [0, seg_ticks]
+    if rem:
+        bounds.append(seg_ticks + rem)
+    while bounds[-1] < sim.n_ticks:
+        bounds.append(bounds[-1] + seg_ticks)
+    n_warm = len(bounds) - n_segments  # 1, or 2 when rem > 0
     log(TAG, f"phase: sim built — {n_instances} instances x "
              f"{sim.net.n_nodes} nodes, {sim.n_ticks} ticks in "
              f"{n_segments} segments of {seg_ticks}, "
@@ -105,11 +119,18 @@ def child_main() -> None:
     # zeros); donation requires each argument buffer to be distinct.
     carry = jax.tree.map(lambda x: x.copy(), carry)
 
-    @partial(jax.jit, donate_argnums=0)
-    def run_segment(c, t0):
-        c, _ = jax.lax.scan(
-            tick_fn, c, t0 + jnp.arange(seg_ticks, dtype=jnp.int32))
-        return c
+    @lru_cache(maxsize=None)
+    def segment_fn(length: int):
+        @partial(jax.jit, donate_argnums=0)
+        def run(c, t0):
+            c, _ = jax.lax.scan(
+                tick_fn, c, t0 + jnp.arange(length, dtype=jnp.int32))
+            return c
+        return run
+
+    def run_segment(c, s: int):
+        return segment_fn(bounds[s + 1] - bounds[s])(
+            c, jnp.int32(bounds[s]))
 
     def emit(delivered_timed: int, delivered: int, sent: int, ovf: int,
              ticks_done: int, wall: float) -> None:
@@ -138,29 +159,30 @@ def child_main() -> None:
     # warm-up segment: includes compile. Emit a provisional (compile-
     # inclusive, pessimistic) number the moment it lands so a tunnel
     # that wedges later still leaves an accelerator measurement.
-    log(TAG, "phase: compile + warm-up segment")
+    log(TAG, "phase: compile + warm-up segment(s)")
     t0 = time.monotonic()
-    carry = run_segment(carry, jnp.int32(0))
+    for s in range(n_warm):
+        carry = run_segment(carry, s)
     delivered0 = int(carry.stats.delivered)
     warm_wall = time.monotonic() - t0
-    log(TAG, f"phase: warm-up segment done in {warm_wall:.1f}s "
+    log(TAG, f"phase: warm-up done in {warm_wall:.1f}s "
              f"({delivered0} delivered incl. compile)")
     emit(delivered0, delivered0, int(carry.stats.sent),
-         int(carry.stats.dropped_overflow), seg_ticks, warm_wall)
+         int(carry.stats.dropped_overflow), bounds[n_warm], warm_wall)
 
     # timed segments: steady-state throughput, cumulative, re-emitted
     # after every segment (the parent keeps the last line it saw).
     t_start = time.monotonic()
-    for s in range(1, n_segments):
-        carry = run_segment(carry, jnp.int32(s * seg_ticks))
+    for s in range(n_warm, len(bounds) - 1):
+        carry = run_segment(carry, s)
         delivered = int(carry.stats.delivered)  # blocks until ready
         wall = time.monotonic() - t_start
         value = (delivered - delivered0) / wall if wall > 0 else 0.0
-        log(TAG, f"phase: segment {s}/{n_segments - 1} done — "
+        log(TAG, f"phase: segment {s - n_warm + 1}/"
+                 f"{len(bounds) - 1 - n_warm} done — "
                  f"cumulative {value:,.0f} msgs/s over {wall:.2f}s")
         emit(delivered - delivered0, delivered, int(carry.stats.sent),
-             int(carry.stats.dropped_overflow),
-             (s + 1) * seg_ticks, wall)
+             int(carry.stats.dropped_overflow), bounds[s + 1], wall)
     log(TAG, "phase: done")
 
 

@@ -106,6 +106,32 @@ def plot_perf(history, store_dir: str):
     svg.scatter_plot(series, title="latency (ms)", xlabel="time (s)",
                      ylabel="latency (ms)", log_y=True,
                      path=os.path.join(store_dir, "latency-raw.svg"))
+
+    # latency-quantiles.svg: p50/p95/p99/max per 1s window over all
+    # completed ops (the reference's latency-quantiles.png); windows
+    # with no completed ops break the polyline instead of interpolating
+    lat_by_sec = defaultdict(list)
+    for pts in points_by_type.values():
+        for t_s, lat_ms in pts:
+            lat_by_sec[int(t_s)].append(lat_ms)
+    window_qs = {sec: _quantiles(xs) for sec, xs in lat_by_sec.items()}
+    q_styles = [("0.5", "p50", "#4477aa"), ("0.95", "p95", "#228833"),
+                ("0.99", "p99", "#ff9900"), ("1.0", "max", "#dd2222")]
+    q_series = []
+    secs = sorted(lat_by_sec)
+    for q_key, label, color in q_styles:
+        pts, prev = [], None
+        for sec in secs:
+            if prev is not None and sec != prev + 1:
+                pts.append(None)
+            pts.append((sec + 0.5, window_qs[sec][q_key]))
+            prev = sec
+        if pts:
+            q_series.append(svg.Series(name=label, points=pts,
+                                       color=color))
+    svg.line_plot(q_series, title="latency quantiles (ms)",
+                  xlabel="time (s)", ylabel="latency (ms)", log_y=True,
+                  path=os.path.join(store_dir, "latency-quantiles.svg"))
     palette = ["#4477aa", "#ee6677", "#228833", "#ccbb44", "#66ccee",
                "#aa3377"]
     rate_series = []

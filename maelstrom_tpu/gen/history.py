@@ -77,6 +77,27 @@ class History:
         return h
 
 
+def write_txt(records: Iterable[dict], path: str) -> None:
+    """Condensed human-readable history — the reference's ``history.txt``
+    (doc/results.md:24-26): columns process, type, f, value, error."""
+    rows = []
+    for r in records:
+        val = r.get("value")
+        rows.append((str(r.get("process", "")),
+                     str(r.get("type", "")),
+                     str(r.get("f", "")),
+                     "" if val is None else json.dumps(val),
+                     str(r.get("error", "") or "")))
+    widths = [max((len(row[c]) for row in rows), default=0)
+              for c in range(4)]
+    with open(path, "w") as f:
+        for row in rows:
+            line = "  ".join(row[c].ljust(widths[c]) for c in range(4))
+            if row[4]:
+                line += "  " + row[4]
+            f.write(line.rstrip() + "\n")
+
+
 # --- analysis helpers used by checkers ------------------------------------
 
 def ok_ops(history, f: Optional[str] = None) -> List[dict]:

@@ -274,6 +274,9 @@ class TestRunner:
             return
         self.history.write_jsonl(os.path.join(self.store_dir,
                                               "history.jsonl"))
+        from .gen.history import write_txt
+        write_txt(self.history.records(),
+                  os.path.join(self.store_dir, "history.txt"))
         with open(os.path.join(self.store_dir, "results.json"), "w") as f:
             json.dump(results, f, indent=2, default=repr)
         try:

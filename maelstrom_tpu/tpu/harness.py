@@ -250,10 +250,12 @@ def _write_store(name: str, store_root: str, results: Dict[str, Any],
         render_timeline(histories[0], os.path.join(d, "timeline.html"))
     with open(os.path.join(d, "results.json"), "w") as f:
         json.dump(results, f, indent=2, default=repr)
+    from ..gen.history import write_txt
     for i, h in enumerate(histories):
         with open(os.path.join(d, f"history-{i}.jsonl"), "w") as f:
             for r in h:
                 f.write(json.dumps(r) + "\n")
+        write_txt(h, os.path.join(d, f"history-{i}.txt"))
     latest = os.path.join(os.path.dirname(d), "latest")
     try:
         if os.path.islink(latest):

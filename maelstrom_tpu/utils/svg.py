@@ -119,8 +119,8 @@ def _legend(parts, series: List[Series]):
 
 
 def _bounds(series):
-    xs = [p[0] for s in series for p in s.points]
-    ys = [p[1] for s in series for p in s.points]
+    xs = [p[0] for s in series for p in s.points if p is not None]
+    ys = [p[1] for s in series for p in s.points if p is not None]
     if not xs:
         return 0, 1, 0, 1
     return min(xs), max(xs), min(ys), max(ys)
@@ -135,7 +135,10 @@ def scatter_plot(series: List[Series], title: str, xlabel: str, ylabel: str,
              f'height="{H}">']
     _axes(parts, fr, title, xlabel, ylabel, log_y)
     for s in series:
-        for x, y in s.points:
+        for p in s.points:
+            if p is None:  # gap markers are meaningless in a scatter
+                continue
+            x, y = p
             parts.append(f'<circle cx="{fr.x(x):.1f}" cy="{fr.y(y):.1f}" '
                          f'r="2" fill="{s.color}" fill-opacity="0.6"/>')
     _legend(parts, series)
@@ -153,12 +156,29 @@ def line_plot(series: List[Series], title: str, xlabel: str, ylabel: str,
              f'height="{H}">']
     _axes(parts, fr, title, xlabel, ylabel, log_y)
     for s in series:
-        if not s.points:
-            continue
-        pts = " ".join(f"{fr.x(x):.1f},{fr.y(y):.1f}"
-                       for x, y in sorted(s.points))
-        parts.append(f'<polyline points="{pts}" fill="none" '
-                     f'stroke="{s.color}" stroke-width="1.5"/>')
+        # a None point breaks the line (a window with no data); each
+        # contiguous run renders as its own polyline
+        runs, cur = [], []
+        for p in s.points:
+            if p is None:
+                if cur:
+                    runs.append(cur)
+                cur = []
+            else:
+                cur.append(p)
+        if cur:
+            runs.append(cur)
+        for run in runs:
+            if len(run) == 1:  # a one-point polyline draws nothing
+                x, y = run[0]
+                parts.append(f'<circle cx="{fr.x(x):.1f}" '
+                             f'cy="{fr.y(y):.1f}" r="2" '
+                             f'fill="{s.color}"/>')
+                continue
+            pts = " ".join(f"{fr.x(x):.1f},{fr.y(y):.1f}"
+                           for x, y in sorted(run))
+            parts.append(f'<polyline points="{pts}" fill="none" '
+                         f'stroke="{s.color}" stroke-width="1.5"/>')
     _legend(parts, series)
     parts.append("</svg>")
     with open(path, "w") as f:

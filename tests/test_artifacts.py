@@ -19,8 +19,10 @@ def test_store_artifacts(tmp_path):
     runs = [p for p in os.listdir(d) if p != "latest"]
     assert len(runs) == 1
     run_dir = os.path.join(d, runs[0])
-    for artifact in ("history.jsonl", "results.json", "messages.svg", "timeline.html",
-                     "latency-raw.svg", "rate.svg", "net-journal",
+    for artifact in ("history.jsonl", "history.txt", "results.json",
+                     "messages.svg", "timeline.html",
+                     "latency-raw.svg", "latency-quantiles.svg",
+                     "rate.svg", "net-journal",
                      "node-logs"):
         assert os.path.exists(os.path.join(run_dir, artifact)), artifact
     assert os.path.islink(os.path.join(d, "latest"))
