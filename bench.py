@@ -210,10 +210,10 @@ def _last_metric(out: str):
 
 
 def _preference(result) -> tuple:
-    """Sort key: accelerator > cpu, nonzero > zero, complete > partial,
-    then value (a partial-but-positive beats a completed zero)."""
-    return (result.get("platform") != "cpu",
-            result.get("value", 0.0) > 0,
+    """Sort key: nonzero > zero (a real measurement on any platform
+    beats a zero), then accelerator > cpu, complete > partial, value."""
+    return (result.get("value", 0.0) > 0,
+            result.get("platform") != "cpu",
             not result.get("partial", False),
             result.get("value", 0.0))
 

@@ -321,6 +321,103 @@ def cmd_doc(args) -> int:
     return 0
 
 
+def cmd_check(args) -> int:
+    """Re-run checkers offline on a stored history — the role of
+    re-running jepsen's analysis from a store dir (doc/results.md)."""
+    import glob
+
+    from .checkers import compose_valid
+    from .checkers.availability import availability_checker
+    from .checkers.perf import perf_checker, stats_checker
+    from .runner import DEFAULTS
+    from .workloads import get_workload
+
+    path = os.path.realpath(args.path)
+    tpu_store = False
+    if os.path.isdir(path):
+        paths = sorted(glob.glob(os.path.join(path, "history*.jsonl")))
+        if not paths:
+            print(f"error: no history*.jsonl under {path}",
+                  file=sys.stderr)
+            return 2
+        # store layout is store/<workload>[-bug-<mutant>][-tpu]/<ts>/;
+        # bug-corpus mutants check with their base workload's checker
+        inferred = os.path.basename(os.path.dirname(path))
+        if inferred.endswith("-tpu"):
+            inferred, tpu_store = inferred[:-len("-tpu")], True
+        inferred = inferred.split("-bug-")[0]
+    else:
+        paths, inferred = [path], None
+    workload_name = args.workload or inferred
+    if not workload_name:
+        print("error: pass -w/--workload when checking a bare history "
+              "file", file=sys.stderr)
+        return 2
+
+    opts = dict(DEFAULTS)
+    opts["availability"] = _availability(args.availability)
+    if args.consistency_models:
+        opts["consistency_models"] = args.consistency_models
+    workload = get_workload(workload_name)(opts)
+    checker = workload.get("checker")
+
+    histories = []
+    for p in paths:
+        with open(p) as f:
+            histories.append([json.loads(line) for line in f
+                              if line.strip()])
+
+    if len(histories) == 1 and not tpu_store:
+        history = histories[0]
+        results = {
+            "perf": perf_checker(history),
+            "stats": stats_checker(history),
+            "availability": availability_checker(
+                history, opts["availability"]),
+        }
+        if checker is not None:
+            results["workload"] = checker(history, opts)
+        results["valid?"] = compose_valid(
+            r.get("valid?", True)
+            for r in results.values() if isinstance(r, dict))
+    else:
+        # multi-instance (TPU) run: the workload checker runs per
+        # instance; stats/availability are fleet-wide over the union —
+        # matching the live harness (tpu/harness.py), where a short
+        # instance without e.g. a single ok cas is not a failure
+        per_history = []
+        for h in histories:
+            if checker is None:
+                per_history.append({"valid?": True})
+                continue
+            try:
+                per_history.append(checker(h, opts))
+            except Exception as e:
+                per_history.append({"valid?": False, "error": repr(e)})
+        union = [r for h in histories for r in h]
+        # fleet stats are informational here (the live TPU harness does
+        # not gate on them: a recorded instance that never completed an
+        # ok cas under a hostile schedule is not a safety failure)
+        stats = stats_checker(union)
+        stats.pop("valid?", None)
+        results = {
+            "instances": {os.path.basename(p): r
+                          for p, r in zip(paths, per_history)},
+            "stats": stats,
+            "availability": availability_checker(
+                union, opts["availability"]),
+        }
+        results["valid?"] = compose_valid(
+            [r.get("valid?", True) for r in per_history]
+            + [results["availability"].get("valid?", True)])
+    results["workload-name"] = workload_name
+    print(json.dumps(results, indent=2, default=repr))
+    verdict = results["valid?"]
+    if verdict is True:
+        return 0
+    return 2 if verdict == "unknown" else 1
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="maelstrom_tpu",
@@ -342,10 +439,24 @@ def main(argv=None) -> int:
     p_doc = sub.add_parser("doc", help="regenerate schema-driven docs")
     p_doc.add_argument("--out", default="doc")
 
+    p_check = sub.add_parser(
+        "check", help="re-run checkers offline on a stored history")
+    p_check.add_argument("path",
+                         help="a store run dir (e.g. store/lin-kv/latest)"
+                              " or a history.jsonl file")
+    p_check.add_argument("-w", "--workload", default=None,
+                         help="workload name (inferred from a store dir"
+                              " path)")
+    p_check.add_argument("--availability", default=None)
+    p_check.add_argument("--consistency-models", default=None,
+                         choices=["read-uncommitted", "read-committed",
+                                  "read-atomic", "serializable",
+                                  "strict-serializable"])
+
     args = parser.parse_args(argv)
     try:
         return {"test": cmd_test, "demo": cmd_demo, "serve": cmd_serve,
-                "doc": cmd_doc}[args.command](args)
+                "doc": cmd_doc, "check": cmd_check}[args.command](args)
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2

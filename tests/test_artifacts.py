@@ -58,3 +58,40 @@ def test_cli_concurrency_parsing():
     from maelstrom_tpu.cli import parse_concurrency
     assert parse_concurrency("10", 5) == 10
     assert parse_concurrency("4n", 5) == 20
+
+
+def test_offline_check_command(tmp_path):
+    """`check` re-runs checkers on a stored history: a clean run
+    re-checks valid (rc 0); a history with a planted safety violation
+    fails (rc 1)."""
+    from maelstrom_tpu.cli import main
+
+    bin_cmd = example_bin("echo.py")
+    run_test("echo", dict(
+        bin=bin_cmd[0], bin_args=bin_cmd[1:], node_count=1,
+        time_limit=1.0, rate=20.0, concurrency=2, seed=1,
+        store_root=str(tmp_path), snapshot_store=True))
+    run_dir = os.path.join(str(tmp_path), "echo", "latest")
+    assert main(["check", run_dir]) == 0
+    # workload inference from the store path: no -w needed above; a bare
+    # file needs it
+    hist = os.path.join(run_dir, "history.jsonl")
+    assert main(["check", hist]) == 2  # no workload inferable
+    assert main(["check", hist, "-w", "echo"]) == 0
+
+    # planted violation: a broadcast value acknowledged but never read
+    bad = tmp_path / "bad.jsonl"
+    records = [
+        {"index": 0, "time": 0, "process": 0, "type": "invoke",
+         "f": "broadcast", "value": 7},
+        {"index": 1, "time": 1, "process": 0, "type": "ok",
+         "f": "broadcast", "value": 7},
+        {"index": 2, "time": 2, "process": 1, "type": "invoke",
+         "f": "read", "value": None},
+        {"index": 3, "time": 3, "process": 1, "type": "ok",
+         "f": "read", "value": []},
+    ]
+    with open(bad, "w") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+    assert main(["check", str(bad), "-w", "broadcast"]) == 1
