@@ -158,6 +158,62 @@ def _decide_all(key, slot, value):
                      timeout=PHASE_TIMEOUT)
 
 
+def _paxos_round(key, slot, my_op):
+    """One full prepare+accept round for (key, slot).
+
+    Returns ``(decided, value)``. ``value`` is the value this round
+    carried as far as it got: None when the PREPARE phase failed (our
+    op never left this node), the accepted-phase value when the ACCEPT
+    quorum failed (it may have reached a minority — the caller MUST
+    treat a matching op id as exposed/indefinite, never definite-fail),
+    and the decided value on success. Defined as a FUNCTION (not inline in the
+    retry loop) so every round gets fresh closure cells: a late promise
+    reply from round k — its callback survives in the SDK's table after
+    the phase timeout — must never write into round k+1's ``adopted``.
+    (With loop-local closures, rebinding ``adopted`` each iteration
+    shares one cell across all rounds; a delayed high-ballot promise
+    from the previous slot's round then overwrites the current round's
+    adoption and the proposer accepts the WRONG value — an actual
+    linearizability violation this framework's own WGL checker + net
+    journal caught: same-slot conflicting decides, divergent logs.)"""
+    ballot = _next_ballot()
+    adopted = [None]   # highest-ballot accepted value seen THIS round
+
+    def on_promise(r):
+        if r.get("type") != "promise":
+            return False
+        if not r.get("ok"):
+            if r.get("promised"):
+                _bump_ballot(r["promised"])
+            return False
+        acc = r.get("accepted")
+        if acc:
+            with state:
+                if adopted[0] is None or acc[0] > adopted[0][0]:
+                    adopted[0] = acc
+        return True
+
+    if not _quorum_call({"type": "prepare", "key": key,
+                         "slot": slot, "ballot": ballot},
+                        on_promise):
+        return False, None
+    value = adopted[0][1] if adopted[0] else my_op
+
+    def on_accepted(r):
+        if r.get("type") != "accepted" or not r.get("ok"):
+            if r.get("promised"):
+                _bump_ballot(r["promised"])
+            return False
+        return True
+
+    if not _quorum_call({"type": "accept", "key": key, "slot": slot,
+                         "ballot": ballot, "value": value},
+                        on_accepted):
+        return False, value
+    _decide_all(key, slot, value)
+    return True, value
+
+
 def _propose(key, my_op):
     """Decide ``my_op`` into some slot of ``key``; returns the slot it
     was chosen in (driving competing values to completion on the way)."""
@@ -176,45 +232,12 @@ def _propose(key, my_op):
             slot = applied.get(key, 0)
             while slot in log:
                 slot += 1
-        ballot = _next_ballot()
-        adopted = [None]   # highest-ballot accepted value seen
-
-        def on_promise(r):
-            if r.get("type") != "promise":
-                return False
-            if not r.get("ok"):
-                if r.get("promised"):
-                    _bump_ballot(r["promised"])
-                return False
-            acc = r.get("accepted")
-            if acc:
-                with state:
-                    if adopted[0] is None or acc[0] > adopted[0][0]:
-                        adopted[0] = acc
-            return True
-
-        if not _quorum_call({"type": "prepare", "key": key,
-                             "slot": slot, "ballot": ballot},
-                            on_promise):
-            time.sleep(0.02)
-            continue
-        value = adopted[0][1] if adopted[0] else my_op
-
-        def on_accepted(r):
-            if r.get("type") != "accepted" or not r.get("ok"):
-                if r.get("promised"):
-                    _bump_ballot(r["promised"])
-                return False
-            return True
-
-        if value.get("id") == my_op["id"]:
+        decided, value = _paxos_round(key, slot, my_op)
+        if value is not None and value.get("id") == my_op["id"]:
             exposed = True
-        if not _quorum_call({"type": "accept", "key": key, "slot": slot,
-                             "ballot": ballot, "value": value},
-                            on_accepted):
+        if not decided:
             time.sleep(0.02)
             continue
-        _decide_all(key, slot, value)
         if value.get("id") == my_op["id"]:
             return slot
         # our slot was taken by an adopted value; drive on to the next

@@ -326,9 +326,9 @@ def cmd_check(args) -> int:
     re-running jepsen's analysis from a store dir (doc/results.md)."""
     import glob
 
-    from .checkers import compose_valid
+    from .checkers import check_history, compose_valid
     from .checkers.availability import availability_checker
-    from .checkers.perf import perf_checker, stats_checker
+    from .checkers.perf import stats_checker
     from .runner import DEFAULTS
     from .workloads import get_workload
 
@@ -363,23 +363,24 @@ def cmd_check(args) -> int:
 
     histories = []
     for p in paths:
+        records, bad = [], 0
         with open(p) as f:
-            histories.append([json.loads(line) for line in f
-                              if line.strip()])
+            for line in f:
+                if not line.strip():
+                    continue
+                # tolerate a truncated tail (run killed mid-write):
+                # checking the surviving prefix beats a traceback
+                try:
+                    records.append(json.loads(line))
+                except json.JSONDecodeError:
+                    bad += 1
+        if bad:
+            print(f"warning: {p}: skipped {bad} unparseable line(s)",
+                  file=sys.stderr)
+        histories.append(records)
 
     if len(histories) == 1 and not tpu_store:
-        history = histories[0]
-        results = {
-            "perf": perf_checker(history),
-            "stats": stats_checker(history),
-            "availability": availability_checker(
-                history, opts["availability"]),
-        }
-        if checker is not None:
-            results["workload"] = checker(history, opts)
-        results["valid?"] = compose_valid(
-            r.get("valid?", True)
-            for r in results.values() if isinstance(r, dict))
+        results = check_history(histories[0], opts, checker)
     else:
         # multi-instance (TPU) run: the workload checker runs per
         # instance; stats/availability are fleet-wide over the union —

@@ -29,8 +29,6 @@ from .runtime.db import DB
 from .gen.history import History, client_invokes
 from .gen.generators import OpSource, stagger_delay
 from .nemesis import PartitionNemesis
-from .checkers.perf import perf_checker, stats_checker
-from .checkers.availability import availability_checker
 from .checkers.net_stats import net_stats_checker
 from .utils.ids import node_names
 
@@ -246,27 +244,11 @@ class TestRunner:
     # --- analysis ---------------------------------------------------------
 
     def check(self) -> Dict[str, Any]:
+        from .checkers import check_history
         history = self.history.records()
-        results = {
-            "perf": perf_checker(history),
-            "stats": stats_checker(history),
-            "net": net_stats_checker(self.journal, history),
-            "availability": availability_checker(
-                history, self.opts["availability"]),
-        }
-        checker = self.workload.get("checker")
-        if checker is not None:
-            try:
-                results["workload"] = checker(history, self.opts)
-            except Exception as e:
-                traceback.print_exc()
-                results["workload"] = {"valid?": False,
-                                       "error": repr(e)}
-        from .checkers import compose_valid
-        results["valid?"] = compose_valid(
-            r.get("valid?", True)
-            for r in results.values() if isinstance(r, dict))
-        return results
+        return check_history(
+            history, self.opts, self.workload.get("checker"),
+            extra={"net": net_stats_checker(self.journal, history)})
 
     def write_store(self, results: Dict[str, Any]):
         if not self.store_dir:
