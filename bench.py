@@ -64,12 +64,20 @@ def child_main() -> None:
     from maelstrom_tpu.tpu.runtime import init_carry, make_tick_fn
 
     on_cpu = platform == "cpu"
+    # 4096 is the measured sweet spot on a single v5e chip: per-tick
+    # wall grows superlinearly with instances (20.8 ms @ 4096 -> ~45 ms
+    # @ 8192), so 8192 is slower per message AND blows the driver's
+    # child deadline at the 4-sim-second horizon
     n_instances = int(os.environ.get(
         "BENCH_INSTANCES", 256 if on_cpu else 4096))
     sim_seconds = float(os.environ.get(
         "BENCH_SIM_SECONDS", 1.0 if on_cpu else 4.0))
-    # at least 2: one warm-up (compile-inclusive) + one timed segment
-    n_segments = max(2, int(os.environ.get("BENCH_SEGMENTS", 8)))
+    # hard ceiling on seconds per device dispatch: single XLA dispatches
+    # that run for minutes fault the TPU tunnel ("worker crashed" after
+    # ~60-70s observed; a 250-tick scan at 32k instances dies, the same
+    # ticks in 25-tick dispatches run fine), so the scan is issued in
+    # chunks sized from the measured per-tick wall to stay well under it
+    dispatch_budget = float(os.environ.get("BENCH_DISPATCH_S", 8.0))
 
     # dense-traffic flagship: 6 clients at rate 200 + 8-tick heartbeats
     # saturate the simulated network; inbox_k/pool_slots sized to the
@@ -92,24 +100,8 @@ def child_main() -> None:
     carry = init_carry(model, sim, 7, params)
     carry_bytes = sum(x.nbytes for x in jax.tree.leaves(carry))
     bytes_per_instance = carry_bytes // max(1, n_instances)
-    # segment boundaries covering exactly [0, n_ticks). The first
-    # segment is the warm-up at the shared timed length (so its compile
-    # is reused by every timed segment); a nonzero remainder runs as a
-    # SECOND warm-up segment, putting its one-off compile before the
-    # timed window too. A degenerate n_ticks still emits the warm-up
-    # line.
-    n_segments = max(1, min(n_segments, sim.n_ticks))
-    seg_ticks = sim.n_ticks // n_segments
-    rem = sim.n_ticks - n_segments * seg_ticks
-    bounds = [0, seg_ticks]
-    if rem:
-        bounds.append(seg_ticks + rem)
-    while bounds[-1] < sim.n_ticks:
-        bounds.append(bounds[-1] + seg_ticks)
-    n_warm = len(bounds) - n_segments  # 1, or 2 when rem > 0
     log(TAG, f"phase: sim built — {n_instances} instances x "
-             f"{sim.net.n_nodes} nodes, {sim.n_ticks} ticks in "
-             f"{n_segments} segments of {seg_ticks}, "
+             f"{sim.net.n_nodes} nodes, {sim.n_ticks} ticks, "
              f"{bytes_per_instance} B/instance "
              f"({carry_bytes / 1e6:.1f} MB carry total)")
 
@@ -120,17 +112,13 @@ def child_main() -> None:
     carry = jax.tree.map(lambda x: x.copy(), carry)
 
     @lru_cache(maxsize=None)
-    def segment_fn(length: int):
+    def chunk_fn(length: int):
         @partial(jax.jit, donate_argnums=0)
         def run(c, t0):
             c, _ = jax.lax.scan(
                 tick_fn, c, t0 + jnp.arange(length, dtype=jnp.int32))
             return c
         return run
-
-    def run_segment(c, s: int):
-        return segment_fn(bounds[s + 1] - bounds[s])(
-            c, jnp.int32(bounds[s]))
 
     def emit(delivered_timed: int, delivered: int, sent: int, ovf: int,
              ticks_done: int, wall: float) -> None:
@@ -156,33 +144,67 @@ def child_main() -> None:
             "bytes_per_instance": int(bytes_per_instance),
         }), flush=True)
 
-    # warm-up segment: includes compile. Emit a provisional (compile-
-    # inclusive, pessimistic) number the moment it lands so a tunnel
-    # that wedges later still leaves an accelerator measurement.
-    log(TAG, "phase: compile + warm-up segment(s)")
+    # Warm-up: compile + run one small chunk, then a second chunk on the
+    # warm compile to measure steady per-tick wall. Emit a provisional
+    # (compile-inclusive, pessimistic) line the moment the first chunk
+    # lands so a tunnel that wedges later still leaves a measurement.
+    n_ticks = sim.n_ticks
+    W = min(32, n_ticks)
+    log(TAG, f"phase: compile + warm-up ({W} ticks)")
     t0 = time.monotonic()
-    for s in range(n_warm):
-        carry = run_segment(carry, s)
-    delivered0 = int(carry.stats.delivered)
+    carry = chunk_fn(W)(carry, jnp.int32(0))
+    ticks = W
+    delivered = int(carry.stats.delivered)  # blocks until ready
     warm_wall = time.monotonic() - t0
-    log(TAG, f"phase: warm-up done in {warm_wall:.1f}s "
-             f"({delivered0} delivered incl. compile)")
-    emit(delivered0, delivered0, int(carry.stats.sent),
-         int(carry.stats.dropped_overflow), bounds[n_warm], warm_wall)
+    log(TAG, f"phase: warm-up chunk done in {warm_wall:.1f}s "
+             f"({delivered} delivered incl. compile)")
+    emit(delivered, delivered, int(carry.stats.sent),
+         int(carry.stats.dropped_overflow), ticks, warm_wall)
+    if ticks + W <= n_ticks:
+        t1 = time.monotonic()
+        carry = chunk_fn(W)(carry, jnp.int32(ticks))
+        delivered = int(carry.stats.delivered)
+        per_tick = (time.monotonic() - t1) / W
+        ticks += W
+    else:
+        per_tick = warm_wall / W  # compile-inclusive overestimate
+    # dispatch chunk: largest power-of-two tick count that keeps one
+    # device dispatch under the budget (tunnel-fault ceiling, see above)
+    L = W
+    while (L * 2 <= 1024 and L * 2 * per_tick <= dispatch_budget
+           and ticks + L * 2 <= n_ticks):
+        L *= 2
+    log(TAG, f"phase: {per_tick * 1e3:.1f} ms/tick steady -> "
+             f"{L}-tick dispatches (~{L * per_tick:.1f}s each)")
+    if L > W and ticks + L <= n_ticks:
+        t1 = time.monotonic()
+        carry = chunk_fn(L)(carry, jnp.int32(ticks))
+        delivered = int(carry.stats.delivered)
+        ticks += L
+        log(TAG, f"phase: {L}-tick chunk compiled + run in "
+                 f"{time.monotonic() - t1:.1f}s")
 
-    # timed segments: steady-state throughput, cumulative, re-emitted
-    # after every segment (the parent keeps the last line it saw).
+    # Timed window: chunked dispatches, cumulative metric re-emitted
+    # after every chunk (the parent keeps the last line it saw, so a
+    # mid-run tunnel death still yields a real number). A tail shorter
+    # than W is dropped rather than compiled-for; sim_ticks reports the
+    # ticks actually run.
+    delivered0 = delivered
     t_start = time.monotonic()
-    for s in range(n_warm, len(bounds) - 1):
-        carry = run_segment(carry, s)
-        delivered = int(carry.stats.delivered)  # blocks until ready
+    while ticks < n_ticks:
+        rem = n_ticks - ticks
+        use = L if rem >= L else (W if rem >= W else 0)
+        if use == 0:
+            break
+        carry = chunk_fn(use)(carry, jnp.int32(ticks))
+        ticks += use
+        delivered = int(carry.stats.delivered)
         wall = time.monotonic() - t_start
         value = (delivered - delivered0) / wall if wall > 0 else 0.0
-        log(TAG, f"phase: segment {s - n_warm + 1}/"
-                 f"{len(bounds) - 1 - n_warm} done — "
-                 f"cumulative {value:,.0f} msgs/s over {wall:.2f}s")
+        log(TAG, f"phase: tick {ticks}/{n_ticks} — cumulative "
+                 f"{value:,.0f} msgs/s over {wall:.2f}s")
         emit(delivered - delivered0, delivered, int(carry.stats.sent),
-             int(carry.stats.dropped_overflow), bounds[s + 1], wall)
+             int(carry.stats.dropped_overflow), ticks, wall)
     log(TAG, "phase: done")
 
 
