@@ -116,9 +116,14 @@ def deliver(pool: jnp.ndarray, partitions: jnp.ndarray, t: jnp.ndarray,
     take = topv > 0
     inbox = jnp.where(take[:, :, None], pool[topi], 0)
 
-    # clear delivered + dropped slots from the pool
-    taken_slots = jnp.zeros((S,), dtype=bool)
-    taken_slots = taken_slots.at[topi.reshape(-1)].max(take.reshape(-1))
+    # clear delivered + dropped slots from the pool (scatter-free: slot s
+    # is taken iff some (node, k) selected it — see enqueue's note on
+    # vmapped scatters)
+    flat_i = topi.reshape(-1)
+    flat_take = take.reshape(-1)
+    taken_slots = jnp.any(
+        (flat_i[None, :] == slot_order[:, None]) & flat_take[None, :],
+        axis=1)
     cleared = taken_slots | drop_mask
     pool = jnp.where(cleared[:, None], 0, pool)
     return pool, inbox, jnp.sum(take).astype(jnp.int32), \
@@ -175,11 +180,20 @@ def enqueue(pool: jnp.ndarray, msgs: jnp.ndarray, t: jnp.ndarray,
 
     j = jnp.arange(M)
     can_place = live_c & (j < free_count)
-    # rows that don't place scatter to an out-of-bounds index and are
-    # dropped, so they can never collide with a placed row's slot
+    # rows that don't place target an out-of-bounds slot id and so can
+    # never collide with a placed row's slot
     target = jnp.where(can_place, order[jnp.minimum(j, cfg.pool_slots - 1)],
                        cfg.pool_slots)
-    pool = pool.at[target].set(msgs_c, mode="drop")
+    # placement as the INVERSE mapping — each slot gathers the one
+    # message that targets it (at most one: `order` is a permutation and
+    # can_place is a j-prefix). Gather + select instead of a batched
+    # scatter: vmapped scatters lower to serialized updates on TPU and
+    # dominated the whole tick at large instance counts (8.8x cost from
+    # 4k->16k instances, vs ~linear for every other phase).
+    hit = target[None, :] == jnp.arange(cfg.pool_slots)[:, None]  # [S, M]
+    has = jnp.any(hit, axis=1)
+    src = jnp.argmax(hit, axis=1)
+    pool = jnp.where(has[:, None], msgs_c[src], pool)
     n_placed = jnp.sum(can_place)
     overflow = n_live - n_placed
     # sent counts every valid message, including ones the network then
