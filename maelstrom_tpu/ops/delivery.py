@@ -9,9 +9,17 @@ fuses the mask construction, priority computation, and K-round argmax
 selection into one VMEM-resident pass over a block of instances, so the
 pool is read from HBM exactly once per tick.
 
-Correctness contract is bit-identical to :func:`..tpu.netsim.deliver`
-(cross-validated in tests/test_pallas_delivery.py on the interpreter);
-enable on hardware with ``MAELSTROM_TPU_PALLAS=1``.
+Correctness contract is bit-identical to :func:`..tpu.netsim.deliver` —
+cross-validated in tests/test_pallas_delivery.py on the interpreter AND
+verified bit-identical on real v5e hardware. Enable with
+``MAELSTROM_TPU_PALLAS=1``.
+
+Measured on v5e (4096 instances, S=16, K=1): ~9 ms standalone vs the
+XLA path's ~5 ms in-sim — the one-instance-per-grid-step layout is
+dispatch-bound, so XLA's top_k path stays the default. Making the
+kernel win requires blocking instances onto the lane axis (128+
+instances per grid step); until then this is the reference Pallas
+implementation of the op, not the fast path.
 """
 
 from __future__ import annotations
@@ -43,67 +51,77 @@ def _interpret() -> bool:
 def _deliver_kernel(pool_ref, part_ref, t_ref, pool_out_ref, inbox_ref,
                     ndel_ref, ndrop_ref, *, cfg):
     """One grid step = one instance. Block shapes keep the gridded axis:
-    pool [1, S, L], part [1, NT, NT], t [1, 1]; outs pool' [1, S, L],
-    inbox [1, NT, K, L], ndel [1, 1], ndrop [1, 1]. All compute is
-    elementwise + broadcast-reduce (VPU), no gathers, no int matmuls."""
+    pool [1, S, L], part [1, NT, NT], t [1, 1, 1]; outs pool' [1, S, L],
+    inbox [1, NT, K, L], ndel [1, 1, 1], ndrop [1, 1, 1]. Scalars ride
+    in [I, 1, 1] arrays because Mosaic requires each block's trailing
+    two dims to be (8, 128)-divisible or equal to the full array dims —
+    (1, 1) trailing blocks over an [I, 1] array fail to lower. All
+    compute is elementwise + broadcast-reduce (VPU), no gathers, no int
+    matmuls."""
     S = cfg.pool_slots
     NT = cfg.n_total
     K = cfg.inbox_k
-    t = t_ref[0, 0]
+    t = t_ref[0, 0, 0]
 
+    # All masks are int32 0/1: Mosaic rejects several i1-vector forms
+    # ("unsupported target bitwidth for truncation"), so selection is
+    # mask-multiply arithmetic rather than boolean where-chains.
     pool = pool_ref[0]                       # [S, L]
-    valid = pool[:, wire.VALID] == 1
-    due = valid & (pool[:, wire.DTICK] <= t)
+    valid_i = (pool[:, wire.VALID] == 1).astype(jnp.int32)
+    due_i = valid_i * (pool[:, wire.DTICK] <= t).astype(jnp.int32)
     dest = pool[:, wire.DEST]
     origin = pool[:, wire.ORIGIN]
 
-    # blocked[s] = part[dest[s], origin[s]] — gather-free via one-hots
-    # (NT is small, so the [S, NT, NT] intermediate stays tiny in VMEM)
+    # blocked[s] = part[dest[s], origin[s]] — gather-free via one-hot
+    # sum-products (NT is small, the [S, NT] intermediates stay tiny)
     ids = jax.lax.broadcasted_iota(jnp.int32, (S, NT), 1)
-    dest_oh = dest[:, None] == ids           # [S, NT]
-    orig_oh = origin[:, None] == ids         # [S, NT]
-    part = part_ref[0] != 0                  # [NT, NT]
-    part_rows = jnp.sum(
-        jnp.where(orig_oh[:, None, :], part[None, :, :], False)
-        .astype(jnp.int32), axis=2)          # [S, NT] = part[:, origin[s]]
-    blocked = jnp.sum(
-        jnp.where(dest_oh, part_rows, 0), axis=1) > 0   # [S]
+    dest_oh = (dest[:, None] == ids).astype(jnp.int32)   # [S, NT]
+    orig_oh = (origin[:, None] == ids).astype(jnp.int32)  # [S, NT]
+    part = part_ref[0]                       # [NT, NT] int32 0/1
+    part_rows = jnp.sum(part[None, :, :] * orig_oh[:, None, :],
+                        axis=2)              # [S, NT] = part[:, origin[s]]
+    blocked_i = jnp.minimum(jnp.sum(part_rows * dest_oh, axis=1), 1)
 
-    drop_mask = due & blocked
-    deliverable = due & ~blocked
+    drop_i = due_i * blocked_i               # [S]
+    deliverable_i = due_i * (1 - blocked_i)  # [S]
 
     # priority per (node, slot): oldest deadline first, slot-index
     # tie-break — identical to netsim.deliver's ranking
     slot_order = jax.lax.broadcasted_iota(jnp.int32, (S,), 0)
     age_rank = ((1 << 20) - pool[:, wire.DTICK]) * S
     base_prio = age_rank + (S - slot_order)  # [S]
-    cand = deliverable[None, :] & dest_oh.T  # [NT, S]
-    prio = jnp.where(cand, base_prio[None, :], 0)
+    cand = deliverable_i[None, :] * dest_oh.T            # [NT, S]
+    prio = cand * base_prio[None, :]
 
-    taken = jnp.zeros((S,), dtype=jnp.bool_)
+    taken_i = jnp.zeros((S,), dtype=jnp.int32)
     n_del = jnp.int32(0)
-    # K selection rounds: per round take each node's current best slot
+    # K selection rounds: per round take each node's current best slot.
+    # No argmax (Mosaic lowers integer argmax only for f32): candidate
+    # priorities are DISTINCT by construction — the slot index rides in
+    # the low bits (age_rank is a multiple of S, the tie-break term is
+    # in [1, S]) — so an equality mask against the row max selects
+    # exactly one slot.
     for k in range(K):
-        best = jnp.argmax(prio, axis=1)          # [NT]
         bestv = jnp.max(prio, axis=1)            # [NT]
-        take = bestv > 0
-        best_oh = (best[:, None] ==
-                   jax.lax.broadcasted_iota(jnp.int32, (NT, S), 1))
-        # rows[n] = pool[best[n]] via masked broadcast-reduce
-        rows = jnp.sum(
-            jnp.where(best_oh[:, :, None], pool[None, :, :], 0),
-            axis=1)                              # [NT, L]
-        inbox_ref[0, :, k, :] = jnp.where(take[:, None], rows, 0)
+        take_i = (bestv > 0).astype(jnp.int32)   # [NT]
+        best_oh = ((prio == bestv[:, None]).astype(jnp.int32)
+                   * (prio > 0).astype(jnp.int32))        # [NT, S]
+        # rows[n] = pool[best[n]] via one-hot sum-product
+        rows = jnp.sum(best_oh[:, :, None] * pool[None, :, :],
+                       axis=1)                   # [NT, L]
+        inbox_ref[0, :, k, :] = take_i[:, None] * rows
         # clear the taken slots from every node's priority row
-        taken_now = jnp.any(take[:, None] & best_oh, axis=0)   # [S]
-        prio = jnp.where(taken_now[None, :], 0, prio)
-        taken = taken | taken_now
-        n_del = n_del + jnp.sum(take.astype(jnp.int32))
+        taken_now = jnp.minimum(
+            jnp.sum(take_i[:, None] * best_oh, axis=0), 1)   # [S]
+        prio = prio * (1 - taken_now)[None, :]
+        taken_i = jnp.minimum(taken_i + taken_now, 1)
+        n_del = n_del + jnp.sum(take_i)
 
-    cleared = taken | drop_mask
-    pool_out_ref[0] = jnp.where(cleared[:, None], 0, pool)
-    ndel_ref[0, 0] = n_del
-    ndrop_ref[0, 0] = jnp.sum(drop_mask.astype(jnp.int32))
+    cleared_i = jnp.minimum(taken_i + drop_i, 1)
+    pool_out_ref[0] = (1 - cleared_i)[:, None] * pool
+    # 2D vector stores — Mosaic cannot store scalars to VMEM
+    ndel_ref[0] = n_del[None, None]
+    ndrop_ref[0] = jnp.sum(drop_i)[None, None]
 
 
 @partial(jax.jit, static_argnames=("cfg", "interpret"))
@@ -117,14 +135,14 @@ def deliver_pallas(pool: jnp.ndarray, partitions: jnp.ndarray,
     I, S, L = pool.shape
     NT = cfg.n_total
     K = cfg.inbox_k
-    t_arr = jnp.broadcast_to(jnp.asarray(t, jnp.int32), (I, 1))
+    t_arr = jnp.broadcast_to(jnp.asarray(t, jnp.int32), (I, 1, 1))
 
     grid = (I,)
     out_shape = (
         jax.ShapeDtypeStruct((I, S, L), jnp.int32),
         jax.ShapeDtypeStruct((I, NT, K, L), jnp.int32),
-        jax.ShapeDtypeStruct((I, 1), jnp.int32),
-        jax.ShapeDtypeStruct((I, 1), jnp.int32),
+        jax.ShapeDtypeStruct((I, 1, 1), jnp.int32),
+        jax.ShapeDtypeStruct((I, 1, 1), jnp.int32),
     )
     pool_out, inbox, ndel, ndrop = pl.pallas_call(
         partial(_deliver_kernel, cfg=cfg),
@@ -132,15 +150,15 @@ def deliver_pallas(pool: jnp.ndarray, partitions: jnp.ndarray,
         in_specs=[
             pl.BlockSpec((1, S, L), lambda i: (i, 0, 0)),
             pl.BlockSpec((1, NT, NT), lambda i: (i, 0, 0)),
-            pl.BlockSpec((1, 1), lambda i: (i, 0)),
+            pl.BlockSpec((1, 1, 1), lambda i: (i, 0, 0)),
         ],
         out_specs=(
             pl.BlockSpec((1, S, L), lambda i: (i, 0, 0)),
             pl.BlockSpec((1, NT, K, L), lambda i: (i, 0, 0, 0)),
-            pl.BlockSpec((1, 1), lambda i: (i, 0)),
-            pl.BlockSpec((1, 1), lambda i: (i, 0)),
+            pl.BlockSpec((1, 1, 1), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, 1, 1), lambda i: (i, 0, 0)),
         ),
         out_shape=out_shape,
         interpret=interpret,
     )(pool, partitions.astype(jnp.int32), t_arr)
-    return pool_out, inbox, ndel[:, 0], ndrop[:, 0]
+    return pool_out, inbox, ndel[:, 0, 0], ndrop[:, 0, 0]
