@@ -1,0 +1,140 @@
+"""Cross-platform trajectory validation: run the identical sim
+(config + seed) on two JAX backends and locate the first tick chunk
+where their carries diverge.
+
+Integer protocol state + threefry RNG means trajectories should be
+BIT-IDENTICAL across CPU and TPU — any divergence is a compiler/runtime
+defect (or an op with platform-defined tie-breaking that leaked into
+semantics). This is the same-seed cross-validation idea of SURVEY §7
+("keep the host simulator as the oracle"), applied platform-vs-platform
+to the full tick loop rather than netsim alone.
+
+Usage:
+    python tools/platform_xval.py run OUT.json          # current backend
+    python tools/platform_xval.py compare A.json B.json
+
+`run` executes the flagship Raft config in CHUNK-tick dispatches and
+after each chunk records a digest (two int32 folds) of every carry
+leaf. Environment knobs: XVAL_INSTANCES, XVAL_TICKS, XVAL_CHUNK,
+XVAL_SEED, and the usual JAX_PLATFORMS for backend selection.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def digest_tree(tree):
+    """Per-leaf digest: (sum, index-weighted sum) folded into int32 —
+    order-sensitive, cheap, device-side."""
+    import jax
+    import jax.numpy as jnp
+
+    out = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        name = "/".join(str(p) for p in path)
+        x = leaf.astype(jnp.int32).reshape(-1)
+        idx = jnp.arange(x.shape[0], dtype=jnp.int32)
+        out[name] = [int(jnp.sum(x)), int(jnp.sum(x * (idx % 9973)))]
+    return out
+
+
+def cmd_run(out_path: str) -> None:
+    import jax
+    import jax.numpy as jnp
+    from functools import partial
+
+    from maelstrom_tpu.models.raft import RaftModel
+    from maelstrom_tpu.tpu.harness import make_sim_config
+    from maelstrom_tpu.tpu.runtime import init_carry, make_tick_fn
+
+    I = int(os.environ.get("XVAL_INSTANCES", 1024))
+    n_ticks = int(os.environ.get("XVAL_TICKS", 225))
+    chunk = int(os.environ.get("XVAL_CHUNK", 25))
+    seed = int(os.environ.get("XVAL_SEED", 7))
+
+    platform = jax.devices()[0].platform
+    print(f"xval: {platform}, {I} instances, {n_ticks} ticks "
+          f"in {chunk}-tick chunks", file=sys.stderr, flush=True)
+
+    model = RaftModel(n_nodes_hint=3, log_cap=64, heartbeat=8)
+    opts = dict(node_count=3, concurrency=6, n_instances=I,
+                record_instances=2, inbox_k=1, pool_slots=16,
+                time_limit=n_ticks / 1000.0, rate=200.0, latency=5.0,
+                rpc_timeout=1.0, nemesis=["partition"],
+                nemesis_interval=0.4, p_loss=0.05, recovery_time=0.0,
+                seed=seed)
+    sim = make_sim_config(model, opts)
+    params = model.make_params(sim.net.n_nodes)
+    carry = init_carry(model, sim, seed, params)
+    tick = make_tick_fn(model, sim, params)
+
+    @partial(jax.jit, static_argnums=2)
+    def seg(c, t0, length):
+        return jax.lax.scan(
+            tick, c, t0 + jnp.arange(length, dtype=jnp.int32))[0]
+
+    checkpoints = []
+    t = 0
+    while t < n_ticks:
+        use = min(chunk, n_ticks - t)
+        carry = seg(carry, jnp.int32(t), use)
+        t += use
+        d = digest_tree(carry._replace(key=carry.key))  # key included
+        checkpoints.append({"tick": t, "digest": d})
+        print(f"xval: tick {t}/{n_ticks}", file=sys.stderr, flush=True)
+
+    result = {
+        "platform": platform,
+        "instances": I,
+        "ticks": n_ticks,
+        "chunk": chunk,
+        "seed": seed,
+        "violations": int((carry.violations > 0).sum()),
+        "stats": {k: int(v) for k, v in
+                  zip(carry.stats._fields, carry.stats)},
+        "checkpoints": checkpoints,
+    }
+    with open(out_path, "w") as f:
+        json.dump(result, f)
+    print(f"xval: wrote {out_path} (violations="
+          f"{result['violations']}, stats={result['stats']})",
+          file=sys.stderr, flush=True)
+
+
+def cmd_compare(a_path: str, b_path: str) -> int:
+    a = json.load(open(a_path))
+    b = json.load(open(b_path))
+    print(f"A: {a['platform']} violations={a['violations']} "
+          f"stats={a['stats']}")
+    print(f"B: {b['platform']} violations={b['violations']} "
+          f"stats={b['stats']}")
+    if (a["instances"], a["ticks"], a["seed"]) != \
+            (b["instances"], b["ticks"], b["seed"]):
+        print("configs differ — not comparable")
+        return 2
+    for ca, cb in zip(a["checkpoints"], b["checkpoints"]):
+        assert ca["tick"] == cb["tick"]
+        bad = [k for k in ca["digest"]
+               if ca["digest"][k] != cb["digest"].get(k)]
+        if bad:
+            print(f"FIRST DIVERGENCE at tick <= {ca['tick']}:")
+            for k in bad:
+                print(f"  {k}: A={ca['digest'][k]} B={cb['digest'][k]}")
+            return 1
+    print("trajectories IDENTICAL at every checkpoint")
+    return 0
+
+
+if __name__ == "__main__":
+    if len(sys.argv) >= 3 and sys.argv[1] == "run":
+        cmd_run(sys.argv[2])
+    elif len(sys.argv) >= 4 and sys.argv[1] == "compare":
+        raise SystemExit(cmd_compare(sys.argv[2], sys.argv[3]))
+    else:
+        print(__doc__)
+        raise SystemExit(2)
