@@ -14,8 +14,15 @@ commit_offsets / list_committed_offsets histories:
   later-polled offset for its key but which never appears in any poll
 - **commit regression** — committed offsets for a key move backwards
 
+Histories may mix single-mop ops (``send`` / ``poll``) with multi-mop
+``txn`` ops (``--txn`` mode: completion value = list of completed mops
+``["send", k, [offset, v]]`` / ``["poll", {k: [[offset, v], ...]}]``);
+txn mops run through the identical per-mop anomaly machinery.
+
 Parity: the anomaly families of jepsen.tests.kafka's checker as used by
-reference src/maelstrom/workload/kafka.clj (docstring :1-71).
+reference src/maelstrom/workload/kafka.clj (docstring :1-71); txn mode
+mirrors jepsen.tests.kafka's :txn? op shape, which the reference harness
+itself leaves disabled (kafka.clj:294).
 """
 
 from __future__ import annotations
@@ -36,6 +43,35 @@ def kafka_checker(history) -> dict:
     # key -> (max reported offset, completion index of that report)
     server_commits = defaultdict(lambda: (-1, -1))
 
+    def handle_send(k, v, off):
+        if off in acked[k] and acked[k][off] != v:
+            anomalies["duplicate-offset"].append(
+                {"key": k, "offset": off, "values": [acked[k][off], v]})
+        acked[k][off] = v
+
+    def handle_poll(value, process, reassigned):
+        # value: {key: [[offset, value], ...]}
+        for k, msgs in (value or {}).items():
+            prev = -1
+            for off, v in msgs:
+                if off <= prev:
+                    anomalies["internal-nonmonotonic"].append(
+                        {"key": k, "offsets": [prev, off]})
+                prev = off
+                if off in polled[k] and polled[k][off] != v:
+                    anomalies["inconsistent-offset"].append(
+                        {"key": k, "offset": off,
+                         "values": [polled[k][off], v]})
+                polled[k][off] = v
+                max_polled[k] = max(max_polled[k], off)
+            if msgs:
+                pk = (process, k)
+                if msgs[0][0] <= last_poll_pos[pk] and not reassigned:
+                    anomalies["external-nonmonotonic"].append(
+                        {"key": k, "process": process,
+                         "offsets": [last_poll_pos[pk], msgs[0][0]]})
+                last_poll_pos[pk] = msgs[-1][0]
+
     for p in pairs(history):
         inv, comp = p["invoke"], p["complete"]
         if inv.get("process") == "nemesis":
@@ -43,41 +79,27 @@ def kafka_checker(history) -> dict:
         f = inv["f"]
         if comp is None or comp["type"] != "ok":
             continue
+        # a reassigned consumer (fresh client resuming from committed
+        # offsets after a crash) may legally jump backwards; the flag
+        # can ride either record
+        reassigned = inv.get("reassigned") or comp.get("reassigned")
         if f == "send":
-            k, v = comp["value"][0], comp["value"][1]
-            off = comp["value"][2]
-            if off in acked[k] and acked[k][off] != v:
-                anomalies["duplicate-offset"].append(
-                    {"key": k, "offset": off, "values": [acked[k][off],
-                                                         v]})
-            acked[k][off] = v
+            handle_send(comp["value"][0], comp["value"][1],
+                        comp["value"][2])
         elif f == "poll":
-            # value: {key: [[offset, value], ...]}
-            for k, msgs in (comp["value"] or {}).items():
-                prev = -1
-                for off, v in msgs:
-                    if off <= prev:
-                        anomalies["internal-nonmonotonic"].append(
-                            {"key": k, "offsets": [prev, off]})
-                    prev = off
-                    if off in polled[k] and polled[k][off] != v:
-                        anomalies["inconsistent-offset"].append(
-                            {"key": k, "offset": off,
-                             "values": [polled[k][off], v]})
-                    polled[k][off] = v
-                    max_polled[k] = max(max_polled[k], off)
-                if msgs:
-                    pk = (inv["process"], k)
-                    # a reassigned consumer (fresh client resuming from
-                    # committed offsets after a crash) may legally jump
-                    # backwards; the flag can ride either record
-                    reassigned = (inv.get("reassigned")
-                                  or comp.get("reassigned"))
-                    if msgs[0][0] <= last_poll_pos[pk] and not reassigned:
-                        anomalies["external-nonmonotonic"].append(
-                            {"key": k, "process": inv["process"],
-                             "offsets": [last_poll_pos[pk], msgs[0][0]]})
-                    last_poll_pos[pk] = msgs[-1][0]
+            handle_poll(comp["value"], inv["process"], reassigned)
+        elif f == "txn":
+            # multi-mop transaction: completion value is the list of
+            # completed mops, ["send", k, [off, v]] / ["poll", msgs].
+            # Each mop feeds the same per-mop anomaly machinery; within
+            # one txn only the first poll may ride the reassignment.
+            for mop in (comp["value"] or []):
+                if mop[0] == "send":
+                    k, (off, v) = mop[1], mop[2]
+                    handle_send(k, v, off)
+                elif mop[0] == "poll":
+                    handle_poll(mop[1], inv["process"], reassigned)
+                    reassigned = False
         elif f == "commit_offsets":
             # the client fills the committed offsets on the completion
             # record (the invoke value is a placeholder). A lagging

@@ -92,6 +92,10 @@ def add_test_options(p: argparse.ArgumentParser):
                    help="kafka: inject client crash ops; crashed "
                         "clients are discarded and reopened, resuming "
                         "from committed offsets")
+    p.add_argument("--txn", action="store_true",
+                   help="kafka: issue multi-mop send/poll transactions "
+                        "(jepsen.tests.kafka :txn? op shape; length "
+                        "capped by --max-txn-length)")
     p.add_argument("--consistency-models", default=None,
                    choices=["read-uncommitted", "read-committed",
                             "read-atomic", "serializable",
@@ -146,6 +150,7 @@ def cmd_test(args) -> int:
             max_writes_per_key=args.max_writes_per_key,
             consistency_models=args.consistency_models,
             crash_clients=args.crash_clients,
+            txn=args.txn,
             log_stderr=args.log_stderr,
             log_net_send=args.log_net_send,
             log_net_recv=args.log_net_recv, seed=args.seed,

@@ -69,7 +69,10 @@ class KafkaClient(WorkloadClient):
         if o["f"] == "crash":
             from .base import ClientCrashed
             raise ClientCrashed()
-        if o["f"] == "poll" and self.fresh:
+        has_poll = (o["f"] == "poll"
+                    or (o["f"] == "txn"
+                        and any(m[0] == "poll" for m in o["value"])))
+        if has_poll and self.fresh:
             self._resume_from_committed()
             out = self._apply_inner(o)
             # only a *successful* poll consumes the reassignment: if the
@@ -82,6 +85,35 @@ class KafkaClient(WorkloadClient):
         return self._apply_inner(o)
 
     def _apply_inner(self, o):
+        if o["f"] == "txn":
+            # multi-mop transaction (jepsen.tests.kafka :txn? op shape):
+            # apply mops in order, then auto-commit the highest polled
+            # offsets (the reference client's post-mop commit,
+            # kafka.clj:225-231, generalized to several mops). The
+            # bundled nodes expose no atomic-txn RPC, so mop application
+            # is sequential; a definite mid-txn error fails the op with
+            # the prefix already applied — exactly the caveat jepsen
+            # documents for non-transactional stores, and why the
+            # checker asserts per-mop log anomalies, not atomicity.
+            done = []
+            polled_high = {}
+            for mop in o["value"]:
+                if mop[0] == "send":
+                    _, k, v = mop
+                    resp = self.call("send", key=k, msg=v)
+                    done.append(["send", k, [resp["offset"], v]])
+                else:
+                    resp = self.call("poll", offsets=self.positions)
+                    msgs = resp["msgs"] or {}
+                    for k, pairs in msgs.items():
+                        if pairs:
+                            self.positions[k] = pairs[-1][0] + 1
+                            polled_high[k] = max(polled_high.get(k, -1),
+                                                 pairs[-1][0])
+                    done.append(["poll", msgs])
+            if polled_high:
+                self.call("commit_offsets", offsets=polled_high)
+            return {**o, "type": "ok", "value": done}
         if o["f"] == "send":
             k, v = o["value"]
             resp = self.call("send", key=k, msg=v)
@@ -102,7 +134,8 @@ class KafkaClient(WorkloadClient):
         raise ValueError(f"unknown op {o['f']!r}")
 
 
-def make_generator(key_count: int, crash_clients: bool = False):
+def make_generator(key_count: int, crash_clients: bool = False,
+                   txn: bool = False, max_txn_length: int = 4):
     def gen(rng):
         counter = [0]
         while True:
@@ -112,6 +145,19 @@ def make_generator(key_count: int, crash_clients: bool = False):
                 # jepsen.tests.kafka :crash-clients? — the worker
                 # discards this client and opens a fresh one
                 yield op("crash", None)
+            elif txn:
+                # multi-mop transactions: 1..max_txn_length send/poll
+                # micro-ops (jepsen.tests.kafka :txn? true op shape)
+                mops = []
+                for _ in range(rng.randrange(1, max_txn_length + 1)):
+                    if rng.random() < 0.5:
+                        counter[0] += 1
+                        mops.append(["send",
+                                     str(rng.randrange(key_count)),
+                                     counter[0]])
+                    else:
+                        mops.append(["poll"])
+                yield op("txn", mops)
             elif r < 0.45:
                 counter[0] += 1
                 yield op("send", [k, counter[0]])
@@ -143,7 +189,9 @@ def workload(opts):
         "client": lambda net, node, o: KafkaClientWithCommits(net, node, o),
         "generator": make_generator(
             opts.get("key_count") or 4,
-            crash_clients=bool(opts.get("crash_clients", False))),
+            crash_clients=bool(opts.get("crash_clients", False)),
+            txn=bool(opts.get("txn", False)),
+            max_txn_length=opts.get("max_txn_length") or 4),
         "final_generator": None,
         "checker": lambda h, o: kafka_checker(h),
     }

@@ -70,3 +70,45 @@ def test_kafka_multi_node_over_lin_kv_e2e():
     w = res["workload"]
     assert w["valid?"] is True, w
     assert w["send-count"] > 5
+
+
+# --- txn mode (--txn: multi-mop send/poll transactions) -------------------
+
+def test_kafka_txn_mops_feed_anomaly_machinery():
+    # lost write planted inside txn mops: send off 0 and 1, later txn
+    # poll observes only offset 1
+    h = H((0, "invoke", "txn", [["send", "k", 1], ["send", "k", 2]]),
+          (0, "ok", "txn", [["send", "k", [0, 1]],
+                            ["send", "k", [1, 2]]]),
+          (1, "invoke", "txn", [["poll"]]),
+          (1, "ok", "txn", [["poll", {"k": [[1, 2]]}]]))
+    r = kafka_checker(h)
+    assert r["valid?"] is False
+    assert "lost-write" in r["anomalies"]
+    assert r["send-count"] == 2 and r["poll-count"] == 1
+
+
+def test_kafka_txn_external_nonmonotonic_and_reassignment():
+    # same process polls backwards across txns -> anomaly ...
+    h = H((0, "invoke", "txn", [["poll"]]),
+          (0, "ok", "txn", [["poll", {"k": [[0, "a"], [1, "b"]]}]]),
+          (0, "invoke", "txn", [["poll"]]),
+          (0, "ok", "txn", [["poll", {"k": [[0, "a"]]}]]))
+    r = kafka_checker(h)
+    assert "external-nonmonotonic" in r["anomalies"]
+    # ... unless the op carries the reassignment marker (fresh client)
+    h2 = h[:3] + [{"process": 0, "type": "ok", "f": "txn",
+                   "value": [["poll", {"k": [[0, "a"]]}]],
+                   "reassigned": True, "index": 3, "time": 3}]
+    assert "external-nonmonotonic" not in kafka_checker(h2)["anomalies"]
+
+
+def test_kafka_txn_e2e():
+    bin_cmd = example_bin("kafka_single.py")
+    res = run_test("kafka", dict(
+        bin=bin_cmd[0], bin_args=bin_cmd[1:], node_count=1,
+        snapshot_store=False, time_limit=6.0, rate=15.0, concurrency=4,
+        txn=True, max_txn_length=4, seed=5))
+    w = res["workload"]
+    assert w["valid?"] is True, w
+    assert w["send-count"] > 20
