@@ -97,8 +97,14 @@ class RaftModel(Model):
     # python bools, so each variant compiles to its own specialized graph
     vote_check_voted_for = True    # False: grants multiple votes per term
     vote_check_log = True          # False: ignores log recency in votes
+    vote_check_log_index = True    # False: recency compares terms only —
+                                   # a shorter-log candidate can win and
+                                   # overwrite committed entries
     serve_reads_locally = False    # True: reads bypass the log (stale)
     commit_term_guard = True       # False: Raft §5.4.2 commit bug
+    commit_quorum = True           # False: leader commits at the MAX
+                                   # match index (no majority), losing
+                                   # unreplicated entries on failover
     apply_uncommitted = False      # True: apply+reply at append, not
                                    # commit (dirty apply — txn mutant)
 
