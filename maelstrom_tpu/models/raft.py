@@ -236,8 +236,13 @@ class RaftModel(Model):
         c_lli = msg[wire.BODY + 1]
         c_llt = msg[wire.BODY + 2]
         my_llt = self._last_log_term(row)
-        log_ok = (c_llt > my_llt) | ((c_llt == my_llt)
-                                     & (c_lli >= row.log_len))
+        if self.vote_check_log_index:
+            log_ok = (c_llt > my_llt) | ((c_llt == my_llt)
+                                         & (c_lli >= row.log_len))
+        else:
+            # BUG variant: recency compares terms only — a shorter-log
+            # candidate at the same term can win and truncate entries
+            log_ok = c_llt >= my_llt
         grant = is_vote & (body0 == term)
         if self.vote_check_voted_for:
             grant = grant & ((voted_for == -1) | (voted_for == src))
@@ -443,8 +448,14 @@ class RaftModel(Model):
         # term only), then apply
         is_leader = row.role == 2
         match = row.match_idx.at[node_idx].set(row.log_len)
-        sorted_match = jnp.sort(match)               # ascending
-        majority_match = sorted_match[(n - 1) // 2]  # value >= on majority
+        if self.commit_quorum:
+            sorted_match = jnp.sort(match)               # ascending
+            majority_match = sorted_match[(n - 1) // 2]  # >= on majority
+        else:
+            # BUG variant: commit at the MAX match index — i.e. as soon
+            # as ANY single node (incl. the leader itself) holds the
+            # entry, no majority required; failover loses those entries
+            majority_match = jnp.max(match)
         if self.commit_term_guard:
             guard_idx = jnp.clip(majority_match - 1, 0, self.log_cap - 1)
             current_term_ok = row.log_term[guard_idx] == row.term

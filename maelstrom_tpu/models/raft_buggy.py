@@ -23,6 +23,12 @@ compiles to its own specialized graph with the bug baked in.
   every occurrence (tests/test_tpu_raft.py::
   test_raft_no_term_guard_caught_on_figure8 — caught in ~27% of 128
   instances at 3s horizon; correct Raft stays clean).
+- :class:`RaftShortLogWins` — vote recency compares last-log terms only,
+  never log length: a same-term shorter-log candidate wins and truncates
+  a committed suffix.
+- :class:`RaftEagerCommit` — the leader commits at the MAX match index
+  (no majority quorum): acknowledged writes it alone holds are lost on
+  failover.
 """
 
 from __future__ import annotations
@@ -49,8 +55,31 @@ class RaftNoTermGuard(RaftModel):
     commit_term_guard = False
 
 
+class RaftShortLogWins(RaftModel):
+    """Vote recency broken: candidates are judged on last-log TERM only,
+    never log length — a same-term shorter-log candidate can win an
+    election and truncate a majority-replicated (committed) suffix.
+    The on-device truncated-committed witness + committed-prefix
+    agreement invariant catch the resulting overwrite."""
+    name = "lin-kv-bug-short-log-wins"
+    vote_check_log_index = False
+
+
+class RaftEagerCommit(RaftModel):
+    """Commit quorum broken: the leader advances commit_idx to the MAX
+    match index instead of the majority median — entries are committed
+    (and replied to clients) the moment the leader appends them locally.
+    A failover to a node without the entry loses an acknowledged write;
+    WGL flags the lost update, and committed-prefix agreement trips
+    on-device."""
+    name = "lin-kv-bug-eager-commit"
+    commit_quorum = False
+
+
 BUGGY_MODELS = {
     "double-vote": RaftDoubleVote,
     "stale-read": RaftStaleRead,
     "no-term-guard": RaftNoTermGuard,
+    "short-log-wins": RaftShortLogWins,
+    "eager-commit": RaftEagerCommit,
 }

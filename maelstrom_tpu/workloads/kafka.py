@@ -145,6 +145,15 @@ def make_generator(key_count: int, crash_clients: bool = False,
                 # jepsen.tests.kafka :crash-clients? — the worker
                 # discards this client and opens a fresh one
                 yield op("crash", None)
+            elif txn and r < 0.08:
+                # keep the commit-regression / server-commit anomaly
+                # families exercised under --txn: the txn path's
+                # auto-commit is a direct RPC that never appears in the
+                # history, so explicit commit ops must still interleave
+                yield op("commit_offsets", {})
+            elif txn and r < 0.14:
+                yield op("list_committed_offsets",
+                         [str(i) for i in range(key_count)])
             elif txn:
                 # multi-mop transactions: 1..max_txn_length send/poll
                 # micro-ops (jepsen.tests.kafka :txn? true op shape)

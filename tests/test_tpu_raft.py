@@ -5,7 +5,9 @@ import pytest
 
 from maelstrom_tpu.models.raft import RaftModel
 from maelstrom_tpu.models.raft_buggy import (RaftDoubleVote,
+                                             RaftEagerCommit,
                                              RaftNoTermGuard,
+                                             RaftShortLogWins,
                                              RaftStaleRead)
 from maelstrom_tpu.tpu.harness import run_tpu_test
 from maelstrom_tpu.tpu.runtime import scripted_isolate_groups
@@ -64,26 +66,64 @@ def _rotating_majorities_schedule(n=5, phase_len=200, horizon_ticks=3500):
     return tuple(sched)
 
 
+FIGURE8_OPTS = dict(node_count=5, concurrency=4, n_instances=64,
+                    record_instances=1, time_limit=3.5, rate=60.0,
+                    latency=5.0, rpc_timeout=0.8, nemesis=["partition"],
+                    nemesis_kind="scripted",
+                    nemesis_schedule=_rotating_majorities_schedule(),
+                    recovery_time=0.5, seed=11)
+
+
 def test_raft_no_term_guard_caught_on_figure8():
     """The §5.4.2 commit bug: an old-term entry committed on replication
     count alone gets overwritten after a leader change. The on-device
     truncated-committed witness (a node overwriting below its own commit
     index) catches it fleet-wide under the rotating-majorities schedule;
     correct Raft stays clean on the identical schedule."""
-    opts = dict(node_count=5, concurrency=4, n_instances=64,
-                record_instances=1, time_limit=3.5, rate=60.0,
-                latency=5.0, rpc_timeout=0.8, nemesis=["partition"],
-                nemesis_kind="scripted",
-                nemesis_schedule=_rotating_majorities_schedule(),
-                recovery_time=0.5, seed=11)
-    res = run_tpu_test(RaftNoTermGuard(n_nodes_hint=5, log_cap=64), opts)
+    res = run_tpu_test(RaftNoTermGuard(n_nodes_hint=5, log_cap=64),
+                       FIGURE8_OPTS)
     inv = res["invariants"]
     assert inv["violating-instances"] >= 3, inv
     assert res["valid?"] is False
 
-    res_ok = run_tpu_test(RaftModel(n_nodes_hint=5, log_cap=64), opts)
+    res_ok = run_tpu_test(RaftModel(n_nodes_hint=5, log_cap=64),
+                          FIGURE8_OPTS)
     assert res_ok["invariants"]["violating-instances"] == 0, \
         res_ok["invariants"]
+    assert res_ok["valid?"] is True, res_ok["instances"]
+
+
+def test_raft_eager_commit_caught():
+    """Max-match commit (no majority quorum): the leader acknowledges
+    writes it alone holds; a failover to a node without them then
+    truncates the acknowledged suffix. The rotating-majorities schedule
+    forces exactly that partial-replication + leader-churn pattern;
+    caught by the truncated-committed witness / committed-prefix
+    invariant (or WGL on recorded instances). Correct Raft on the
+    identical schedule is covered by
+    test_raft_no_term_guard_caught_on_figure8."""
+    res = run_tpu_test(RaftEagerCommit(n_nodes_hint=5, log_cap=64),
+                       FIGURE8_OPTS)
+    caught = (res["valid?"] is False
+              or res["invariants"]["violating-instances"] > 0)
+    assert caught, (res["instances"], res["invariants"])
+
+
+def test_raft_short_log_wins_caught():
+    """Term-only vote recency: a same-term shorter-log candidate wins an
+    election and truncates a committed suffix. Needs churn (partitions +
+    loss force lagging followers into candidacy); the on-device
+    truncated-committed witness / committed-prefix agreement flags it,
+    while correct Raft stays clean under the identical config."""
+    opts = dict(BUG_OPTS, n_instances=48, record_instances=8,
+                time_limit=3.0, seed=5)
+    res = run_tpu_test(RaftShortLogWins(n_nodes_hint=3), opts)
+    caught = (res["valid?"] is False
+              or res["invariants"]["violating-instances"] > 0)
+    assert caught, (res["instances"], res["invariants"])
+
+    res_ok = run_tpu_test(RaftModel(n_nodes_hint=3), opts)
+    assert res_ok["invariants"]["violating-instances"] == 0
     assert res_ok["valid?"] is True, res_ok["instances"]
 
 

@@ -113,12 +113,19 @@ def cmd_compare(a_path: str, b_path: str) -> int:
           f"stats={a['stats']}")
     print(f"B: {b['platform']} violations={b['violations']} "
           f"stats={b['stats']}")
-    if (a["instances"], a["ticks"], a["seed"]) != \
-            (b["instances"], b["ticks"], b["seed"]):
+    if (a["instances"], a["ticks"], a["seed"], a["chunk"]) != \
+            (b["instances"], b["ticks"], b["seed"], b["chunk"]):
         print("configs differ — not comparable")
         return 2
+    if len(a["checkpoints"]) != len(b["checkpoints"]):
+        print(f"checkpoint counts differ ({len(a['checkpoints'])} vs "
+              f"{len(b['checkpoints'])}) — not comparable")
+        return 2
     for ca, cb in zip(a["checkpoints"], b["checkpoints"]):
-        assert ca["tick"] == cb["tick"]
+        if ca["tick"] != cb["tick"]:
+            print(f"checkpoint ticks differ ({ca['tick']} vs "
+                  f"{cb['tick']}) — not comparable")
+            return 2
         bad = [k for k in ca["digest"]
                if ca["digest"][k] != cb["digest"].get(k)]
         if bad:

@@ -1,0 +1,10 @@
+#!/bin/bash
+# Probe the TPU tunnel on a loop; log health transitions to /tmp/tpu_watch.log
+while true; do
+  if timeout 90 python -c "import jax; d=jax.devices(); assert d[0].platform=='tpu'; import jax.numpy as jnp; print((jnp.ones((8,8))@jnp.ones((8,8))).sum())" >/dev/null 2>&1; then
+    echo "$(date +%s) HEALTHY" >> /tmp/tpu_watch.log
+  else
+    echo "$(date +%s) down" >> /tmp/tpu_watch.log
+  fi
+  sleep 120
+done
