@@ -1,0 +1,198 @@
+"""Per-phase tick profiler: isolate which phase of the TPU tick loop
+goes superlinear in the instance count.
+
+Times each tick phase (nemesis / deliver / node / client / enqueue /
+invariants) as its own jitted dispatch, plus the fused full tick and a
+25-tick scan, at a sweep of instance counts, on whatever backend JAX
+selects. Inputs come from a burned-in carry (ticks of real traffic) so
+the pool occupancy is representative of steady state.
+
+Per-phase dispatches lose cross-phase fusion, so their absolute times
+overstate the fused cost — the *scaling* of each phase with instances is
+the signal (a phase whose ms/tick grows faster than the instance ratio
+is the superlinear culprit; VERDICT r2 weak #2).
+
+Usage:
+    PROF_INSTANCES=4096,16384,65536 python tools/tick_profile.py
+Env knobs: PROF_INSTANCES, PROF_BURNIN (default 64), PROF_REPS (10).
+Prints one JSON line per (instances, phase) and a summary table.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    from functools import partial
+
+    from maelstrom_tpu.models.raft import RaftModel
+    from maelstrom_tpu.tpu import netsim
+    from maelstrom_tpu.tpu.harness import make_sim_config
+    from maelstrom_tpu.tpu.runtime import (client_step, init_carry,
+                                           make_tick_fn, node_phase,
+                                           partition_matrix)
+
+    platform = jax.devices()[0].platform
+    sizes = [int(s) for s in os.environ.get(
+        "PROF_INSTANCES", "4096,16384").split(",")]
+    burnin = int(os.environ.get("PROF_BURNIN", 64))
+    reps = int(os.environ.get("PROF_REPS", 10))
+    print(f"# tick_profile: platform={platform} sizes={sizes}",
+          file=sys.stderr, flush=True)
+
+    model = RaftModel(n_nodes_hint=3, log_cap=64, heartbeat=8)
+    rows = []
+
+    for I in sizes:
+        opts = dict(node_count=3, concurrency=6, n_instances=I,
+                    record_instances=1, inbox_k=1, pool_slots=16,
+                    time_limit=4.0, rate=200.0, latency=5.0,
+                    rpc_timeout=1.0, nemesis=["partition"],
+                    nemesis_interval=0.4, p_loss=0.05,
+                    recovery_time=0.3, seed=7)
+        sim = make_sim_config(model, opts)
+        cfg, ccfg, nem = sim.net, sim.client, sim.nemesis
+        N = cfg.n_nodes
+        params = model.make_params(N)
+        tick_fn = make_tick_fn(model, sim, params)
+
+        # burn in so the pool carries steady-state traffic
+        @partial(jax.jit, donate_argnums=0)
+        def burn(c):
+            return jax.lax.scan(
+                tick_fn, c, jnp.arange(burnin, dtype=jnp.int32))[0]
+
+        carry = jax.tree.map(lambda x: x.copy(),
+                             init_carry(model, sim, 7, params))
+        carry = jax.block_until_ready(burn(carry))
+        t = jnp.int32(burnin)
+
+        # --- reconstruct one tick's intermediate inputs ------------------
+        key, k_nem, k_node, k_client, k_enq = jax.random.split(carry.key, 5)
+        ikeys = jax.random.split(k_nem, I)
+
+        @jax.jit
+        def f_nemesis(ik, tt):
+            return jax.vmap(
+                lambda k: partition_matrix(nem, cfg, tt, k))(ik)
+
+        partitions = jax.block_until_ready(f_nemesis(ikeys, t))
+
+        @jax.jit
+        def f_deliver(pool, parts, tt):
+            return jax.vmap(
+                lambda p, pa: netsim.deliver(p, pa, tt, cfg))(pool, parts)
+
+        pool2, inbox, _, _ = jax.block_until_ready(
+            f_deliver(carry.pool, partitions, t))
+
+        node_keys = jax.random.split(k_node, I)
+
+        @jax.jit
+        def f_node(st, ib, ks, tt):
+            return jax.vmap(
+                lambda s, i, k: node_phase(model, s, i, tt, k, cfg,
+                                           params))(st, ib, ks)
+
+        node_state2, node_outs = jax.block_until_ready(
+            f_node(carry.node_state, inbox[:, :N], node_keys, t))
+
+        client_keys = jax.random.split(k_client, I)
+
+        @jax.jit
+        def f_client(cs, ib, ks, tt):
+            return jax.vmap(
+                lambda c, i, k: client_step(model, c, i, tt, k, cfg, ccfg,
+                                            params))(cs, ib, ks)
+
+        _, reqs, _ = jax.block_until_ready(
+            f_client(carry.client_state, inbox[:, N:], client_keys, t))
+
+        outs = jnp.concatenate(
+            [node_outs.reshape(I, -1, cfg.lanes), reqs], axis=1)
+        enq_keys = jax.random.split(k_enq, I)
+
+        @jax.jit
+        def f_enqueue(pool, ms, ks, tt):
+            return jax.vmap(
+                lambda p, m, k: netsim.enqueue(p, m, tt, k, cfg))(
+                    pool, ms, ks)
+
+        jax.block_until_ready(f_enqueue(pool2, outs, enq_keys, t))
+
+        @jax.jit
+        def f_invariants(st):
+            return jax.vmap(
+                lambda s: model.invariants(s, cfg, params))(st)
+
+        jax.block_until_ready(f_invariants(node_state2))
+
+        @jax.jit
+        def f_full(c, tt):
+            return tick_fn(c, tt)[0]
+
+        jax.block_until_ready(f_full(carry, t))
+
+        @partial(jax.jit, static_argnums=2)
+        def f_scan(c, t0, length):
+            return jax.lax.scan(
+                tick_fn, c, t0 + jnp.arange(length, dtype=jnp.int32))[0]
+
+        jax.block_until_ready(f_scan(carry, t, 25))
+
+        phases = {
+            "nemesis": lambda: f_nemesis(ikeys, t),
+            "deliver": lambda: f_deliver(carry.pool, partitions, t),
+            "node": lambda: f_node(carry.node_state, inbox[:, :N],
+                                   node_keys, t),
+            "client": lambda: f_client(carry.client_state, inbox[:, N:],
+                                       client_keys, t),
+            "enqueue": lambda: f_enqueue(pool2, outs, enq_keys, t),
+            "invariants": lambda: f_invariants(node_state2),
+            "full_tick": lambda: f_full(carry, t),
+            "scan25": lambda: f_scan(carry, t, 25),
+        }
+
+        for name, fn in phases.items():
+            jax.block_until_ready(fn())        # warm
+            t0 = time.monotonic()
+            for _ in range(reps):
+                out = fn()
+            jax.block_until_ready(out)
+            per_call = (time.monotonic() - t0) / reps
+            per_tick = per_call / (25 if name == "scan25" else 1)
+            rows.append({"instances": I, "phase": name,
+                         "ms_per_tick": round(per_tick * 1e3, 3)})
+            print(json.dumps(rows[-1]), flush=True)
+
+    # summary: scaling exponent phase-by-phase between consecutive sizes
+    print(f"\n# {'phase':<12}" + "".join(f"{s:>12}" for s in sizes)
+          + "   scaling", file=sys.stderr)
+    import math
+    by_phase = {}
+    for r in rows:
+        by_phase.setdefault(r["phase"], {})[r["instances"]] = \
+            r["ms_per_tick"]
+    for phase, vals in by_phase.items():
+        cells = "".join(f"{vals.get(s, float('nan')):>12.3f}"
+                        for s in sizes)
+        exps = []
+        for a, b in zip(sizes, sizes[1:]):
+            if vals.get(a) and vals.get(b):
+                exps.append(math.log(vals[b] / vals[a])
+                            / math.log(b / a))
+        exp_s = "/".join(f"{e:.2f}" for e in exps) or "-"
+        print(f"# {phase:<12}{cells}   x^{exp_s}", file=sys.stderr,
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()
