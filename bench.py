@@ -178,7 +178,8 @@ def child_main(canary: bool = False) -> None:
 
         def emit(delivered_timed: int, delivered: int, sent: int,
                  ovf: int, ticks_done: int, wall: float,
-                 provisional: bool = False) -> None:
+                 provisional: bool = False,
+                 complete: bool = False) -> None:
             # `value` = delivered_timed / wall_s (both fields present, so
             # the metric is recomputable); `delivered`/`sent`/
             # `dropped_overflow`/`sim_ticks` are cumulative run totals
@@ -206,6 +207,10 @@ def child_main(canary: bool = False) -> None:
             }
             if provisional:
                 rec["provisional"] = True   # compile-inclusive window
+            if complete:
+                rec["complete"] = True      # this config ran its full
+                                            # horizon — a later child
+                                            # death is not ITS failure
             print(json.dumps(rec), flush=True)
 
         # Warm-up: compile + run one small chunk, then a second chunk on
@@ -245,11 +250,19 @@ def child_main(canary: bool = False) -> None:
                  f"(~{L * per_tick:.1f}s each)")
         if L > W and ticks + L <= n_ticks:
             t1 = time.monotonic()
+            base = delivered
             carry = chunk_fn(L)(carry, jnp.int32(ticks))
             delivered = int(carry.stats.delivered)
             ticks += L
+            wall = time.monotonic() - t1
             log(TAG, f"phase[{cfg_name}]: {L}-tick chunk compiled + run "
-                     f"in {time.monotonic() - t1:.1f}s")
+                     f"in {wall:.1f}s")
+            # compile-inclusive, but on a short horizon this may be the
+            # only post-warm-up measurement — emit it (the timed loop's
+            # lines, if any, supersede it as the last line per config)
+            emit(delivered - base, delivered, int(carry.stats.sent),
+                 int(carry.stats.dropped_overflow), ticks, wall,
+                 provisional=True, complete=(ticks + W > n_ticks))
 
         # Timed window: chunked dispatches, cumulative metric re-emitted
         # after every chunk (the parent keeps the last line per config,
@@ -272,7 +285,8 @@ def child_main(canary: bool = False) -> None:
                      f"cumulative {value:,.0f} msgs/s over {wall:.2f}s")
             emit(delivered - delivered0, delivered,
                  int(carry.stats.sent),
-                 int(carry.stats.dropped_overflow), ticks, wall)
+                 int(carry.stats.dropped_overflow), ticks, wall,
+                 complete=(ticks + W > n_ticks))
         log(TAG, f"phase[{cfg_name}]: done")
     log(TAG, "phase: done")
 
@@ -341,7 +355,10 @@ def parent_main() -> int:
         by_cfg, _ = _metric_lines(out)
         for cfg_name, rec in by_cfg.items():
             rec["attempt"] = name
-            if rc != 0:
+            if rc != 0 and not rec.get("complete"):
+                # the child died, but only configs that hadn't finished
+                # their horizon are partial (a completed k1 must not be
+                # mislabeled because the tunnel died mid-k3)
                 rec["partial"] = True
             if cfg_name == "k3":
                 if (secondary is None
@@ -380,10 +397,11 @@ def parent_main() -> int:
                 [sys.executable, here, "--child"], accel_env, deadline,
                 TAG)
             consider(out2, "accelerator", rc2)
-            if rc2 == 0 and best is not None \
-                    and best.get("platform") != "cpu" \
-                    and best.get("value", 0) > 0:
-                break  # completed accelerator run in hand
+            if best is not None and best.get("platform") != "cpu" \
+                    and best.get("value", 0) > 0 \
+                    and not best.get("partial"):
+                break  # completed accelerator headline in hand (even if
+                       # the child died later in the secondary config)
             last_err = f"accelerator full run rc={rc2}"
         elif rc == 0 and canary is not None \
                 and canary.get("platform") == "cpu":
@@ -402,7 +420,9 @@ def parent_main() -> int:
             # then stop probing — spend leftover budget on nothing else
             if best is not None and best.get("platform") != "cpu":
                 break
-            time.sleep(min(backoff, max(0.0, remaining() - reserve)))
+            # never let the sleep itself eat the direct-attempt window
+            time.sleep(min(backoff, max(0.0, remaining() - reserve
+                                        - direct_reserve)))
             backoff = min(backoff * 1.7, 90.0)
 
     # Phase 1b — direct full attempt: the canary never passed (wedged

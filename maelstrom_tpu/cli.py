@@ -3,10 +3,13 @@
 Commands (parity: reference src/maelstrom/core.clj -main :267-284 and
 option specs :136-229):
 
-- ``test``  — run one workload test (process or TPU runtime)
-- ``demo``  — the built-in self-test matrix over the bundled example nodes
-- ``serve`` — browse the store directory over HTTP
-- ``doc``   — regenerate doc/workloads.md + doc/protocol.md from schemas
+- ``test``   — run one workload test (process or TPU runtime)
+- ``demo``   — the built-in self-test matrix over the bundled example nodes
+- ``serve``  — browse the store directory over HTTP
+- ``doc``    — regenerate doc/workloads.md + doc/protocol.md from schemas
+- ``check``  — re-run checkers offline on a stored history
+- ``export`` — emit Jepsen-compatible EDN histories for adjudication by
+  stock Elle/Knossos outside this image
 """
 
 from __future__ import annotations
@@ -326,38 +329,67 @@ def cmd_doc(args) -> int:
     return 0
 
 
+def _resolve_history_paths(path: str, workload_arg, verb: str):
+    """Resolve a store run dir (or bare history file) into
+    ``(paths, workload_name, tpu_store)``; raises ValueError with a
+    user-facing message. Store layout is
+    ``store/<workload>[-bug-<mutant>][-tpu]/<ts>/`` — the mutant suffix
+    is preserved (callers strip it where they need the base workload)."""
+    import glob
+
+    path = os.path.realpath(path)
+    tpu_store = False
+    if os.path.isdir(path):
+        paths = sorted(glob.glob(os.path.join(path, "history*.jsonl")))
+        if not paths:
+            raise ValueError(f"no history*.jsonl under {path}")
+        inferred = os.path.basename(os.path.dirname(path))
+        if inferred.endswith("-tpu"):
+            inferred, tpu_store = inferred[:-len("-tpu")], True
+    else:
+        paths, inferred = [path], None
+    workload_name = workload_arg or inferred
+    if not workload_name:
+        raise ValueError(f"pass -w/--workload when {verb} a bare "
+                         f"history file")
+    return paths, workload_name, tpu_store
+
+
+def _load_history_records(p: str):
+    """Parse one history.jsonl, tolerating a truncated tail (run killed
+    mid-write): using the surviving prefix beats a traceback."""
+    records, bad = [], 0
+    with open(p) as f:
+        for line in f:
+            if not line.strip():
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError:
+                bad += 1
+    if bad:
+        print(f"warning: {p}: skipped {bad} unparseable line(s)",
+              file=sys.stderr)
+    return records
+
+
 def cmd_check(args) -> int:
     """Re-run checkers offline on a stored history — the role of
     re-running jepsen's analysis from a store dir (doc/results.md)."""
-    import glob
-
     from .checkers import check_history, compose_valid
     from .checkers.availability import availability_checker
     from .checkers.perf import stats_checker
     from .runner import DEFAULTS
     from .workloads import get_workload
 
-    path = os.path.realpath(args.path)
-    tpu_store = False
-    if os.path.isdir(path):
-        paths = sorted(glob.glob(os.path.join(path, "history*.jsonl")))
-        if not paths:
-            print(f"error: no history*.jsonl under {path}",
-                  file=sys.stderr)
-            return 2
-        # store layout is store/<workload>[-bug-<mutant>][-tpu]/<ts>/;
-        # bug-corpus mutants check with their base workload's checker
-        inferred = os.path.basename(os.path.dirname(path))
-        if inferred.endswith("-tpu"):
-            inferred, tpu_store = inferred[:-len("-tpu")], True
-        inferred = inferred.split("-bug-")[0]
-    else:
-        paths, inferred = [path], None
-    workload_name = args.workload or inferred
-    if not workload_name:
-        print("error: pass -w/--workload when checking a bare history "
-              "file", file=sys.stderr)
+    try:
+        paths, workload_name, tpu_store = _resolve_history_paths(
+            args.path, args.workload, "checking")
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
         return 2
+    # bug-corpus mutants check with their base workload's checker
+    workload_name = workload_name.split("-bug-")[0]
 
     opts = dict(DEFAULTS)
     opts["availability"] = _availability(args.availability)
@@ -366,23 +398,7 @@ def cmd_check(args) -> int:
     workload = get_workload(workload_name)(opts)
     checker = workload.get("checker")
 
-    histories = []
-    for p in paths:
-        records, bad = [], 0
-        with open(p) as f:
-            for line in f:
-                if not line.strip():
-                    continue
-                # tolerate a truncated tail (run killed mid-write):
-                # checking the surviving prefix beats a traceback
-                try:
-                    records.append(json.loads(line))
-                except json.JSONDecodeError:
-                    bad += 1
-        if bad:
-            print(f"warning: {p}: skipped {bad} unparseable line(s)",
-                  file=sys.stderr)
-        histories.append(records)
+    histories = [_load_history_records(p) for p in paths]
 
     if len(histories) == 1 and not tpu_store:
         results = check_history(histories[0], opts, checker)
@@ -424,6 +440,43 @@ def cmd_check(args) -> int:
     return 2 if verdict == "unknown" else 1
 
 
+def cmd_export(args) -> int:
+    """Export a stored history as Jepsen-compatible EDN op maps so a
+    disputed verdict can be adjudicated by stock Elle/Knossos outside
+    this image (SURVEY §7: "history export in Jepsen-compatible
+    EDN/JSON so the existing JVM checkers remain usable")."""
+    from .utils.edn import history_to_edn_lines
+
+    try:
+        paths, workload, _ = _resolve_history_paths(
+            args.path, args.workload, "exporting")
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if args.out and args.out.endswith(".edn") and len(paths) > 1:
+        print(f"error: -o {args.out} names one file but the run has "
+              f"{len(paths)} history shards; pass a directory (or "
+              f"'-' for stdout)", file=sys.stderr)
+        return 2
+
+    for p in paths:
+        records = _load_history_records(p)
+        if args.out == "-":
+            for line in history_to_edn_lines(records, workload):
+                print(line)
+        else:
+            base = os.path.basename(p).replace(".jsonl", ".edn")
+            dest = (args.out if args.out and args.out.endswith(".edn")
+                    else os.path.join(args.out or os.path.dirname(p),
+                                      base))
+            os.makedirs(os.path.dirname(dest) or ".", exist_ok=True)
+            with open(dest, "w") as f:
+                for line in history_to_edn_lines(records, workload):
+                    f.write(line + "\n")
+            print(f"wrote {dest} ({len(records)} ops)", file=sys.stderr)
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="maelstrom_tpu",
@@ -459,10 +512,25 @@ def main(argv=None) -> int:
                                   "read-atomic", "serializable",
                                   "strict-serializable"])
 
+    p_export = sub.add_parser(
+        "export", help="export a stored history as Jepsen-compatible "
+                       "EDN for adjudication by stock Elle/Knossos")
+    p_export.add_argument("path",
+                          help="a store run dir (e.g. "
+                               "store/txn-list-append/latest) or a "
+                               "history.jsonl file")
+    p_export.add_argument("-w", "--workload", default=None,
+                          help="workload name (inferred from a store "
+                               "dir path)")
+    p_export.add_argument("-o", "--out", default=None,
+                          help="output .edn file, directory, or '-' "
+                               "for stdout (default: next to the input)")
+
     args = parser.parse_args(argv)
     try:
         return {"test": cmd_test, "demo": cmd_demo, "serve": cmd_serve,
-                "doc": cmd_doc, "check": cmd_check}[args.command](args)
+                "doc": cmd_doc, "check": cmd_check,
+                "export": cmd_export}[args.command](args)
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2

@@ -1,0 +1,211 @@
+"""Minimal EDN (extensible data notation) writer/reader for Jepsen
+interop.
+
+The adjudication escape hatch (SURVEY §7: "via history export in
+Jepsen-compatible EDN/JSON so the existing JVM checkers remain usable"):
+histories exported with :func:`dumps` are the op-map shape Jepsen's
+``store/<test>/history.edn`` uses —
+
+    {:process 7, :type :invoke, :f :txn,
+     :value [[:append 4 1] [:r 5 nil]], :index 0, :time 168390535}
+
+— so a disputed verdict from the in-repo Elle/WGL reimplementations can
+be re-checked by stock Elle / Knossos outside this image
+(``elle.list-append/check`` consumes exactly these maps). The reader
+exists for round-trip differential tests; it covers the subset EDN this
+writer emits (maps, vectors, keywords, strings, ints, floats, nil,
+booleans), not the full EDN grammar (no tagged literals, sets, chars).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterator, List, Tuple
+
+
+class Keyword(str):
+    """An EDN keyword (``:foo``). Subclasses str so existing code that
+    compares against plain strings keeps working after a round-trip."""
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f":{str.__str__(self)}"
+
+
+def _dump(x: Any, out: List[str]) -> None:
+    if isinstance(x, Keyword):
+        out.append(":" + str.__str__(x))
+    elif x is None:
+        out.append("nil")
+    elif x is True:
+        out.append("true")
+    elif x is False:
+        out.append("false")
+    elif isinstance(x, str):
+        out.append('"' + x.replace("\\", "\\\\").replace('"', '\\"')
+                   .replace("\n", "\\n").replace("\t", "\\t")
+                   .replace("\r", "\\r") + '"')
+    elif isinstance(x, (int, float)):
+        out.append(repr(x))
+    elif isinstance(x, dict):
+        out.append("{")
+        first = True
+        for k, v in x.items():
+            if not first:
+                out.append(", ")
+            first = False
+            _dump(k, out)
+            out.append(" ")
+            _dump(v, out)
+        out.append("}")
+    elif isinstance(x, (list, tuple)):
+        out.append("[")
+        for i, v in enumerate(x):
+            if i:
+                out.append(" ")
+            _dump(v, out)
+        out.append("]")
+    else:
+        raise TypeError(f"cannot EDN-serialize {type(x).__name__}: {x!r}")
+
+
+def dumps(x: Any) -> str:
+    out: List[str] = []
+    _dump(x, out)
+    return "".join(out)
+
+
+# --- reader (writer-subset EDN) -------------------------------------------
+
+_WS = " \t\n\r,"            # comma is whitespace in EDN
+_DELIM = _WS + "{}[]()\""
+
+
+def _skip_ws(s: str, i: int) -> int:
+    while i < len(s) and s[i] in _WS:
+        i += 1
+    return i
+
+
+def _parse(s: str, i: int) -> Tuple[Any, int]:
+    i = _skip_ws(s, i)
+    if i >= len(s):
+        raise ValueError("unexpected end of EDN input")
+    c = s[i]
+    if c == "{":
+        i += 1
+        m = {}
+        while True:
+            i = _skip_ws(s, i)
+            if i >= len(s):
+                raise ValueError("unterminated map")
+            if s[i] == "}":
+                return m, i + 1
+            k, i = _parse(s, i)
+            v, i = _parse(s, i)
+            m[k] = v
+    if c == "[":
+        i += 1
+        vec = []
+        while True:
+            i = _skip_ws(s, i)
+            if i >= len(s):
+                raise ValueError("unterminated vector")
+            if s[i] == "]":
+                return vec, i + 1
+            v, i = _parse(s, i)
+            vec.append(v)
+    if c == '"':
+        i += 1
+        buf = []
+        while i < len(s):
+            ch = s[i]
+            if ch == "\\":
+                nxt = s[i + 1]
+                buf.append({"n": "\n", "t": "\t", "r": "\r",
+                            '"': '"', "\\": "\\"}.get(nxt, nxt))
+                i += 2
+            elif ch == '"':
+                return "".join(buf), i + 1
+            else:
+                buf.append(ch)
+                i += 1
+        raise ValueError("unterminated string")
+    if c == ":":
+        j = i + 1
+        while j < len(s) and s[j] not in _DELIM:
+            j += 1
+        return Keyword(s[i + 1:j]), j
+    # symbol-ish atom: nil / true / false / number
+    j = i
+    while j < len(s) and s[j] not in _DELIM:
+        j += 1
+    tok = s[i:j]
+    if tok == "nil":
+        return None, j
+    if tok == "true":
+        return True, j
+    if tok == "false":
+        return False, j
+    try:
+        return int(tok), j
+    except ValueError:
+        pass
+    try:
+        return float(tok), j
+    except ValueError:
+        raise ValueError(f"unparseable EDN token {tok!r} at offset {i}")
+
+
+def loads(s: str) -> Any:
+    v, i = _parse(s, 0)
+    if _skip_ws(s, i) != len(s):
+        raise ValueError(f"trailing EDN content at offset {i}")
+    return v
+
+
+# --- history conversion ---------------------------------------------------
+
+# workloads whose :value is a vector of [f k v] micro-op vectors whose
+# first element Jepsen/Elle expects as a keyword (:append/:r/:w,
+# kafka's :send/:poll)
+_MOP_WORKLOADS = ("txn-list-append", "txn-rw-register", "kafka")
+
+
+def op_to_edn_map(op: dict, workload: str) -> dict:
+    """One JSONL history record -> Jepsen EDN op map (Python form:
+    Keyword keys/values where Jepsen uses keywords)."""
+    out = {}
+    mops = workload.split("-bug-")[0] in _MOP_WORKLOADS
+    for k, v in op.items():
+        key = Keyword(k)
+        if k in ("type", "f"):
+            out[key] = Keyword(v)
+        elif k == "value" and mops and isinstance(v, list):
+            out[key] = [[Keyword(m[0])] + list(m[1:])
+                        if isinstance(m, list) and m
+                        and isinstance(m[0], str) else m
+                        for m in v]
+        else:
+            out[key] = v
+    return out
+
+
+def edn_map_to_op(m: dict) -> dict:
+    """Inverse of :func:`op_to_edn_map`: EDN op map -> plain-JSON form."""
+    out = {}
+    for k, v in m.items():
+        key = str.__str__(k) if isinstance(k, Keyword) else k
+        if key in ("type", "f"):
+            out[key] = str.__str__(v) if isinstance(v, Keyword) else v
+        elif key == "value" and isinstance(v, list):
+            out[key] = [[str.__str__(e[0])] + list(e[1:])
+                        if isinstance(e, list) and e
+                        and isinstance(e[0], Keyword) else e
+                        for e in v]
+        else:
+            out[key] = v
+    return out
+
+
+def history_to_edn_lines(records, workload: str) -> Iterator[str]:
+    for op in records:
+        yield dumps(op_to_edn_map(op, workload))

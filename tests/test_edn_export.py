@@ -1,0 +1,109 @@
+"""EDN history export — the Elle/Knossos adjudication escape hatch
+(SURVEY §7 / VERDICT r2 next #5): every stored history must round-trip
+through the Jepsen-compatible EDN op-map form losslessly, including
+mutant-generated anomaly histories, so a disputed in-repo verdict can be
+re-checked by the stock JVM checkers outside this image."""
+
+import glob
+import json
+import os
+
+import pytest
+
+from maelstrom_tpu.cli import main as cli_main
+from maelstrom_tpu.utils.edn import (Keyword, dumps, edn_map_to_op,
+                                     history_to_edn_lines, loads,
+                                     op_to_edn_map)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_edn_scalar_roundtrip():
+    for v in [None, True, False, 0, -7, 3.5, "plain",
+              'quo"te\\back\nnl', Keyword("append"),
+              [1, [2, None], {"k": Keyword("r")}],
+              {Keyword("f"): Keyword("txn"), "s": [1, 2]}]:
+        assert loads(dumps(v)) == v
+
+
+def test_edn_emits_jepsen_shapes():
+    op = {"process": 7, "type": "invoke", "f": "txn",
+          "value": [["append", 4, 1], ["r", 5, None]],
+          "index": 0, "time": 123}
+    line = dumps(op_to_edn_map(op, "txn-list-append"))
+    assert line == ('{:process 7, :type :invoke, :f :txn, '
+                    ':value [[:append 4 1] [:r 5 nil]], '
+                    ':index 0, :time 123}'), line
+
+
+def _roundtrip(records, workload):
+    for op in records:
+        line = dumps(op_to_edn_map(op, workload))
+        back = edn_map_to_op(loads(line))
+        # strict equality after JSON normalization (tuples/keywords out)
+        assert json.loads(json.dumps(back)) == op, (op, line)
+
+
+@pytest.mark.parametrize("run_dir", sorted(
+    glob.glob(os.path.join(REPO, "store", "*", "latest"))))
+def test_stored_histories_roundtrip(run_dir):
+    workload = os.path.basename(os.path.dirname(run_dir))
+    if workload.endswith("-tpu"):
+        workload = workload[:-len("-tpu")]
+    for p in sorted(glob.glob(os.path.join(run_dir, "history*.jsonl"))):
+        records = [json.loads(l) for l in open(p) if l.strip()]
+        assert records, p
+        _roundtrip(records, workload)
+
+
+def test_mutant_anomaly_history_roundtrips(tmp_path):
+    """An anomaly history from the bug-injection corpus (stale-read
+    mutant under partitions) exports and round-trips; the checker's
+    verdict on the re-imported history is unchanged."""
+    from maelstrom_tpu.models.raft_buggy import RaftStaleRead
+    from maelstrom_tpu.tpu.harness import run_tpu_test
+
+    res = run_tpu_test(RaftStaleRead(n_nodes_hint=3), dict(
+        node_count=3, concurrency=3, n_instances=24,
+        record_instances=24, time_limit=2.5, rate=40.0, latency=10.0,
+        rpc_timeout=0.8, nemesis=["partition"], nemesis_interval=0.25,
+        p_loss=0.05, recovery_time=0.3, seed=2,
+        store_root=str(tmp_path)))
+    assert res["valid?"] is False   # the mutant is caught
+    run_dir = os.path.join(str(tmp_path), "lin-kv-bug-stale-read-tpu",
+                           "latest")
+    paths = sorted(glob.glob(os.path.join(run_dir, "history*.jsonl")))
+    assert paths
+    total = 0
+    for p in paths:
+        records = [json.loads(l) for l in open(p) if l.strip()]
+        total += len(records)
+        _roundtrip(records, "lin-kv-bug-stale-read")
+    assert total > 50
+
+
+def test_cli_export_roundtrip(tmp_path, capsys):
+    src = os.path.join(REPO, "store", "txn-list-append", "latest")
+    out = str(tmp_path / "out")
+    rc = cli_main(["export", src, "-o", out])
+    assert rc == 0
+    edn_files = sorted(glob.glob(os.path.join(out, "history*.edn")))
+    assert edn_files
+    jsonl = sorted(glob.glob(os.path.join(src, "history*.jsonl")))
+    for ep, jp in zip(edn_files, jsonl):
+        records = [json.loads(l) for l in open(jp) if l.strip()]
+        lines = [l for l in open(ep).read().splitlines() if l.strip()]
+        assert len(lines) == len(records)
+        for line, op in zip(lines, records):
+            m = loads(line)
+            assert m[Keyword("type")] in ("invoke", "ok", "fail", "info")
+            assert json.loads(json.dumps(edn_map_to_op(m))) == op
+
+
+def test_cli_export_stdout(capsys):
+    src = os.path.join(REPO, "store", "lin-kv", "latest")
+    rc = cli_main(["export", src, "-o", "-"])
+    assert rc == 0
+    lines = [l for l in capsys.readouterr().out.splitlines()
+             if l.strip()]
+    assert lines and all(l.startswith("{:") for l in lines)
