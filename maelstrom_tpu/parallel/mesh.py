@@ -26,6 +26,19 @@ from ..tpu.runtime import Carry, Model, NetStats, SimConfig, simulate
 
 AXIS = "instances"
 
+# per-shard RNG decorrelation stride; device i simulates with seed
+# ``seed + i * SEED_STRIDE``. Exposed (with shard_seeds) so equivalence
+# oracles can replay individual shards unsharded.
+SEED_STRIDE = 1_000_003
+
+
+def shard_seeds(seed: int, n_shards: int):
+    """The deterministic per-shard seed list used by run_sim_sharded
+    (wrapped into int32 range so huge-but-valid seeds behave the same
+    here and on device)."""
+    return [(seed + i * SEED_STRIDE + 2**31) % 2**32 - 2**31
+            for i in range(n_shards)]
+
 
 def make_mesh(n_devices: Optional[int] = None) -> Mesh:
     """1-D mesh over the first n_devices (default: all) local devices."""
@@ -69,6 +82,31 @@ def _run_sharded(model: Model, sim: SimConfig, mesh: Mesh, seeds, params):
     )(seeds, params)
 
 
+def run_sim_unsharded(model: Model, sim: SimConfig, seed: int,
+                      n_shards: int, params=None
+                      ) -> Tuple[NetStats, "jnp.ndarray", "jnp.ndarray"]:
+    """The equivalence oracle for :func:`run_sim_sharded`: replay every
+    shard's ``simulate`` serially on one device with the identical
+    per-shard seeds and accumulate the same (stats, violations, events)
+    triple. A sharded run must match this bit-for-bit — shard_map and
+    collective placement may change performance, never results."""
+    import numpy as np
+
+    if params is None:
+        params = model.make_params(sim.net.n_nodes)
+    run_one = jax.jit(lambda s: simulate(model, sim, s, params))
+    stats, viol, evs = None, [], []
+    for s in shard_seeds(seed, n_shards):
+        carry_u, ys_u = run_one(jnp.int32(s))
+        st = jax.tree.map(int, carry_u.stats)
+        stats = st if stats is None else jax.tree.map(
+            lambda a, b: a + b, stats, st)
+        viol.append(np.asarray(carry_u.violations))
+        evs.append(np.asarray(ys_u.events))
+    return (NetStats(*stats), np.concatenate(viol, axis=0),
+            np.concatenate(evs, axis=1))
+
+
 def run_sim_sharded(model: Model, sim: SimConfig, seed: int, params=None,
                     mesh: Optional[Mesh] = None
                     ) -> Tuple[NetStats, jnp.ndarray, jnp.ndarray]:
@@ -86,8 +124,8 @@ def run_sim_sharded(model: Model, sim: SimConfig, seed: int, params=None,
     assert sim.journal_instances == 0, \
         "journal_instances is not supported under shard_map"
     shape = mesh.devices.shape
-    seeds = (jnp.arange(mesh.devices.size, dtype=jnp.int32)
-             .reshape(shape) * 1_000_003 + seed)
+    seeds = jnp.array(shard_seeds(seed, mesh.devices.size),
+                      dtype=jnp.int32).reshape(shape)
     if params is None:
         params = model.make_params(sim.net.n_nodes)
     if params is None:

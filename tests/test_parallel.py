@@ -51,6 +51,28 @@ def test_raft_sharded_runs_and_checks():
             assert checker(h, opts)["valid?"] is True
 
 
+def test_sharded_equals_unsharded_bitwise():
+    """Behavioral equivalence, not just execution (VERDICT r2 #4): the
+    same per-shard seeds run unsharded on one device reproduce the
+    8-way shard_map run bit-for-bit — stats, violation counters, and
+    recorded event streams."""
+    from maelstrom_tpu.parallel.mesh import run_sim_unsharded
+
+    model = RaftModel(n_nodes_hint=3, log_cap=16)
+    opts = dict(node_count=3, concurrency=2, n_instances=2,
+                record_instances=2, time_limit=0.5, rate=50.0,
+                latency=5.0, rpc_timeout=0.4, nemesis=["partition"],
+                nemesis_interval=0.1, p_loss=0.05, recovery_time=0.1,
+                seed=9)
+    sim = make_sim_config(model, opts)._replace(n_ticks=40)
+    stats, violations, events = run_sim_sharded(model, sim, seed=9)
+    u_stats, u_viol, u_events = run_sim_unsharded(model, sim, seed=9,
+                                                  n_shards=8)
+    assert tuple(jax.tree.map(int, stats)) == tuple(u_stats)
+    assert np.array_equal(np.asarray(violations), u_viol)
+    assert np.array_equal(np.asarray(events), u_events)
+
+
 def test_hybrid_mesh_single_host_degenerate():
     """run_sim_sharded over the (1, 8) degenerate DCN x ICI hybrid mesh:
     the two-axis sharding compiles and runs; only the axis sizes change
