@@ -1,0 +1,117 @@
+#!/usr/bin/env python3
+"""Highly Available Transactions over read-write registers.
+
+An adaptation of Bailis et al.'s HAT design (the reference's teaching
+variant demo/clojure/txn_rw_register_hat.clj:1-171, used here as the
+behavioral spec): every node executes transactions IMMEDIATELY against
+its local state — no coordination, total availability, even under full
+partitions — and asynchronously anti-entropies them to its peers.
+
+- Each transaction gets a globally unique timestamp ``[lamport, node]``.
+- Writes install ``(ts, value)`` per key, last-writer-wins by timestamp,
+  so replicas converge to the same versions regardless of arrival order.
+- An anti-entropy timer re-sends unacked transactions to the peers that
+  still need them; ``replicate_ack`` clears them. Re-delivery is safe:
+  applying a timestamped txn twice is idempotent under LWW.
+
+The teaching point (why this sits in the demo matrix next to the
+serializable transactors): total availability costs isolation. Per-key
+LWW makes the write order acyclic — ``read-uncommitted`` (G0) passes —
+but nothing orders reads with writes across keys, so long-fork /
+fractured-read shapes appear and ``serializable`` checking rightly
+fails it. Compare doc/05-txn chapter.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from node import Node  # noqa: E402
+
+node = Node()
+
+lamport = 0          # this node's Lamport clock
+kv = {}              # key -> (ts, value); ts = (lamport, node_id)
+unreplicated = {}    # ts -> {"ts":, "txn":, "nodes": set of peer ids}
+
+
+def apply_txn(txn, ts=None):
+    """Apply a txn at a timestamp (assigning one if None) against the
+    local state; returns (ts, completed txn). Caller holds node.lock
+    (the SDK serializes handlers)."""
+    global lamport
+    if ts is None:
+        ts = (lamport, node.node_id)
+        lamport += 1
+    else:
+        ts = tuple(ts)
+        lamport = max(lamport, ts[0] + 1)
+    out = []
+    for f, k, v in txn:
+        k = str(k)
+        kk = int(k) if k.isdigit() else k
+        if f == "r":
+            cur = kv.get(k)
+            out.append(["r", kk, cur[1] if cur else None])
+        else:  # "w"
+            cur = kv.get(k)
+            if cur is None or cur[0] < ts:
+                kv[k] = (ts, v)       # LWW install
+            out.append(["w", kk, v])
+    return ts, out
+
+
+@node.on("txn")
+def txn(msg):
+    ts, out = apply_txn(msg["body"]["txn"])
+    peers = set(node.other_node_ids())
+    if peers:
+        unreplicated[ts] = {"ts": list(ts), "txn": out, "nodes": peers}
+    node.reply(msg, {"type": "txn_ok", "txn": out})
+
+
+@node.on("replicate")
+def replicate(msg):
+    acked = []
+    for t in msg["body"]["txns"]:
+        ts = tuple(t["ts"])
+        apply_txn(t["txn"], ts)
+        acked.append(list(ts))
+        # help forward to peers the sender still lists (minus ourselves)
+        nodes = set(t["nodes"]) - {node.node_id}
+        if nodes and ts not in unreplicated:
+            unreplicated[ts] = {"ts": list(ts), "txn": t["txn"],
+                                "nodes": nodes}
+    # fire-and-forget: no reply — the ack broadcast below is what clears
+    # pending sets on every holder (incl. the original sender)
+    for peer in node.other_node_ids():
+        node.send(peer, {"type": "replicate_ack",
+                         "node": node.node_id, "tss": acked})
+
+
+@node.on("replicate_ack")
+def replicate_ack(msg):
+    who = msg["body"]["node"]
+    for ts in map(tuple, msg["body"]["tss"]):
+        ent = unreplicated.get(ts)
+        if ent is None:
+            continue
+        ent["nodes"].discard(who)
+        if not ent["nodes"]:
+            del unreplicated[ts]
+
+
+@node.every(0.1)
+def anti_entropy():
+    # the SDK's timer loop already holds node.lock here
+    if not unreplicated:
+        return
+    # pick the first pending peer, send it everything it's missing
+    peer = next(iter(next(iter(unreplicated.values()))["nodes"]))
+    txns = [{"ts": e["ts"], "txn": e["txn"], "nodes": sorted(e["nodes"])}
+            for e in unreplicated.values() if peer in e["nodes"]]
+    if txns:
+        node.send(peer, {"type": "replicate", "txns": txns})
+
+
+node.run()

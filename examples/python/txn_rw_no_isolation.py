@@ -1,0 +1,48 @@
+#!/usr/bin/env python3
+"""A single-node, completely UN-isolated rw-register transaction system.
+
+The reference's teaching foil (demo/clojure/
+txn_rw_register_no_isolation.clj:1-35, used as the behavioral spec):
+micro-ops apply directly to shared state with a deliberate sleep between
+each one, so concurrent transactions interleave mid-flight. Useful for
+demonstrating safety violations — the Elle rw-register checker flags
+the resulting intermediate/fractured reads (G1b and friends) even on
+one node with zero network faults, which is the whole lesson: isolation
+is a property of the *transaction system*, not of the network being
+healthy. tests/test_e2e_process.py asserts the checker catches it.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from node import Node, RPCError  # noqa: E402
+
+node = Node()
+state = {}
+
+# Handlers normally run under node.lock; this node *deliberately*
+# releases it around each micro-op so transactions interleave.
+
+
+@node.on("txn")
+def txn(msg):
+    out = []
+    for f, k, v in msg["body"]["txn"]:
+        k = str(k)
+        kk = int(k) if k.isdigit() else k
+        node.lock.release()
+        time.sleep(0.002)            # widen the interleaving window
+        node.lock.acquire()
+        if f == "r":
+            out.append(["r", kk, state.get(k)])
+        elif f == "w":
+            state[k] = v
+            out.append(["w", kk, v])
+        else:
+            raise RPCError(12, f"unknown micro-op {f!r}")
+    node.reply(msg, {"type": "txn_ok", "txn": out})
+
+
+node.run()

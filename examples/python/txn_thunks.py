@@ -1,0 +1,103 @@
+#!/usr/bin/env python3
+"""Multi-key list-append transactor: per-key immutable thunks + root CAS.
+
+The teaching midpoint between ``datomic_txn.py`` (whole database behind
+ONE lin-kv value — simple, but every transaction conflicts with every
+other) and ``datomic_list_append.py`` (persistent hash-tree pages).
+Design follows the reference's demo/clojure/multi_key_txn.clj:1-307
+(used as the behavioral spec):
+
+- the ROOT, stored in lin-kv, is just a map ``key -> thunk id``
+- each thunk is an IMMUTABLE value stored once in lww-kv under a fresh
+  globally unique id (``<node>-<counter>``); immutability is what makes
+  the eventually-consistent lww-kv service safe to read from — any copy
+  a replica returns is the right one, and thunks can be cached forever
+- a transaction reads the root, loads thunks for its read-set, applies
+  its micro-ops, writes fresh thunks for its write-set, then CASes the
+  root. Only the root CAS can conflict, and only on a real data race —
+  transactions touching disjoint keys still conflict on the shared root
+  map (the limitation the hash-tree transactor removes), but thunk
+  writes themselves never do.
+- a CAS mismatch aborts with error 30 (txn-conflict, definite — the
+  client may retry safely since nothing observable happened: the
+  orphaned thunks are garbage, not corruption).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from node import KV, Node, RPCError  # noqa: E402
+
+node = Node()
+root_kv = KV(node, KV.LIN, timeout=2.0)
+thunk_kv = KV(node, KV.LWW, timeout=2.0)
+
+ROOT = "thunks-root"
+
+thunk_cache = {}     # thunk id -> value (immutable, so cache freely)
+next_thunk = [0]
+
+
+def new_thunk_id():
+    next_thunk[0] += 1
+    return f"{node.node_id}-{next_thunk[0]}"
+
+
+def load_thunk(tid):
+    if tid is None:
+        return None
+    if tid not in thunk_cache:
+        # lww-kv is eventually consistent, but thunks are write-once:
+        # retry until the replica that answers has seen the write
+        for _ in range(20):
+            try:
+                thunk_cache[tid] = thunk_kv.read(tid)
+                break
+            except RPCError as e:
+                if e.code != 20:
+                    raise
+        else:
+            raise RPCError.txn_conflict(f"thunk {tid} never appeared")
+    return thunk_cache[tid]
+
+
+@node.on("txn")
+def txn(msg):
+    ops = msg["body"]["txn"]
+    root = root_kv.read(ROOT, default=None) or {}
+    new_root = dict(root)
+    out = []
+    dirty = {}                       # key -> new value (pending thunks)
+    for f, k, v in ops:
+        k = str(k)
+        kk = int(k) if k.isdigit() else k
+        if f == "r":
+            val = (dirty[k] if k in dirty
+                   else load_thunk(new_root.get(k)))
+            out.append(["r", kk, val])
+        elif f == "append":
+            cur = (dirty[k] if k in dirty
+                   else load_thunk(new_root.get(k))) or []
+            dirty[k] = list(cur) + [v]
+            out.append(["append", kk, v])
+        else:
+            raise RPCError(12, f"unknown micro-op {f!r}")
+    if dirty:
+        for k, val in dirty.items():
+            tid = new_thunk_id()
+            thunk_kv.write(tid, val)     # immutable, safe in lww-kv
+            thunk_cache[tid] = val
+            new_root[k] = tid
+        try:
+            root_kv.cas(ROOT, root or None, new_root,
+                        create_if_not_exists=(not root))
+        except RPCError as e:
+            if e.code in (20, 22):
+                raise RPCError.txn_conflict(
+                    "root CAS failed; transaction aborted") from None
+            raise
+    node.reply(msg, {"type": "txn_ok", "txn": out})
+
+
+node.run()

@@ -265,6 +265,19 @@ DEMOS = [
      {"node_count": 3, "rate": 15.0}),
     ("txn-rw-register", "txn_single.py", {"node_count": 1,
                                           "rate": 20.0}),
+    # HAT (Bailis et al.): totally available under partitions, weak
+    # isolation — passes read-uncommitted; serializable rightly fails it
+    # (tests/test_e2e_process.py::test_hat_isolation_tradeoff)
+    ("txn-rw-register", "txn_rw_hat.py",
+     {"node_count": 3, "rate": 15.0, "nemesis": ["partition"],
+      "nemesis_interval": 2.0, "recovery_time": 2.0,
+      "availability": "total",
+      "consistency_models": "read-uncommitted"}),
+    ("txn-list-append", "txn_thunks.py", {"node_count": 3,
+                                          "rate": 15.0}),
+    ("lin-kv", "raft.py",
+     {"node_count": 5, "rate": 15.0, "nemesis": ["partition"],
+      "nemesis_interval": 3.0, "recovery_time": 2.0}),
     ("kafka", "kafka_single.py", {"node_count": 1, "rate": 20.0}),
     ("kafka", "kafka_single.py",
      {"node_count": 1, "rate": 20.0, "crash_clients": True}),

