@@ -458,8 +458,13 @@ def cmd_export(args) -> int:
     disputed verdict can be adjudicated by stock Elle/Knossos outside
     this image (SURVEY §7: "history export in Jepsen-compatible
     EDN/JSON so the existing JVM checkers remain usable")."""
-    from .utils.edn import history_to_edn_lines
+    from .utils.edn import (history_to_edn_lines,
+                            history_to_edn_vector_lines)
 
+    # Jepsen's history.edn is one EDN vector; that's the default shape.
+    # --maps emits bare line-delimited op maps for line-oriented tooling.
+    to_lines = (history_to_edn_lines if getattr(args, "maps", False)
+                else history_to_edn_vector_lines)
     try:
         paths, workload, _ = _resolve_history_paths(
             args.path, args.workload, "exporting")
@@ -468,14 +473,22 @@ def cmd_export(args) -> int:
         return 2
     if args.out and args.out.endswith(".edn") and len(paths) > 1:
         print(f"error: -o {args.out} names one file but the run has "
-              f"{len(paths)} history shards; pass a directory (or "
-              f"'-' for stdout)", file=sys.stderr)
+              f"{len(paths)} history shards; pass a directory",
+              file=sys.stderr)
+        return 2
+    if args.out == "-" and len(paths) > 1 and \
+            not getattr(args, "maps", False):
+        # concatenated vectors are not one readable EDN form — a stock
+        # read-string would silently see only the first shard
+        print(f"error: the run has {len(paths)} history shards, which "
+              f"cannot share stdout as EDN vectors; pass a directory "
+              f"(one vector per file) or --maps", file=sys.stderr)
         return 2
 
     for p in paths:
         records = _load_history_records(p)
         if args.out == "-":
-            for line in history_to_edn_lines(records, workload):
+            for line in to_lines(records, workload):
                 print(line)
         else:
             base = os.path.basename(p).replace(".jsonl", ".edn")
@@ -484,7 +497,7 @@ def cmd_export(args) -> int:
                                       base))
             os.makedirs(os.path.dirname(dest) or ".", exist_ok=True)
             with open(dest, "w") as f:
-                for line in history_to_edn_lines(records, workload):
+                for line in to_lines(records, workload):
                     f.write(line + "\n")
             print(f"wrote {dest} ({len(records)} ops)", file=sys.stderr)
     return 0
@@ -538,6 +551,10 @@ def main(argv=None) -> int:
     p_export.add_argument("-o", "--out", default=None,
                           help="output .edn file, directory, or '-' "
                                "for stdout (default: next to the input)")
+    p_export.add_argument("--maps", action="store_true",
+                          help="emit line-delimited op maps instead of "
+                               "the default single EDN vector "
+                               "(history.edn shape)")
 
     args = parser.parse_args(argv)
     try:

@@ -19,6 +19,7 @@ booleans), not the full EDN grammar (no tagged literals, sets, chars).
 
 from __future__ import annotations
 
+import re
 from typing import Any, Iterator, List, Tuple
 
 
@@ -43,8 +44,19 @@ def _dump(x: Any, out: List[str]) -> None:
         out.append('"' + x.replace("\\", "\\\\").replace('"', '\\"')
                    .replace("\n", "\\n").replace("\t", "\\t")
                    .replace("\r", "\\r") + '"')
-    elif isinstance(x, (int, float)):
+    elif isinstance(x, int):
         out.append(repr(x))
+    elif isinstance(x, float):
+        # repr would emit 'inf'/'nan', which are not EDN tokens; the
+        # reader-macro forms are the portable spelling
+        if x != x:
+            out.append("##NaN")
+        elif x == float("inf"):
+            out.append("##Inf")
+        elif x == float("-inf"):
+            out.append("##-Inf")
+        else:
+            out.append(repr(x))
     elif isinstance(x, dict):
         out.append("{")
         first = True
@@ -134,6 +146,16 @@ def _parse(s: str, i: int) -> Tuple[Any, int]:
         while j < len(s) and s[j] not in _DELIM:
             j += 1
         return Keyword(s[i + 1:j]), j
+    if c == "#" and s[i:i + 2] == "##":
+        j = i + 2
+        while j < len(s) and s[j] not in _DELIM:
+            j += 1
+        tok = s[i + 2:j]
+        try:
+            return {"Inf": float("inf"), "-Inf": float("-inf"),
+                    "NaN": float("nan")}[tok], j
+        except KeyError:
+            raise ValueError(f"unknown EDN symbolic value ##{tok}")
     # symbol-ish atom: nil / true / false / number
     j = i
     while j < len(s) and s[j] not in _DELIM:
@@ -169,6 +191,10 @@ def loads(s: str) -> Any:
 # kafka's :send/:poll)
 _MOP_WORKLOADS = ("txn-list-append", "txn-rw-register", "kafka")
 
+# strings that are legal as EDN keyword names (subset of the spec's
+# symbol charset — enough for every error slug this codebase emits)
+_KW_SAFE = re.compile(r"^[A-Za-z*+!_?<>=.-][A-Za-z0-9*+!_?<>=.-]*$")
+
 
 def op_to_edn_map(op: dict, workload: str) -> dict:
     """One JSONL history record -> Jepsen EDN op map (Python form:
@@ -177,8 +203,22 @@ def op_to_edn_map(op: dict, workload: str) -> dict:
     mops = workload.split("-bug-")[0] in _MOP_WORKLOADS
     for k, v in op.items():
         key = Keyword(k)
-        if k in ("type", "f"):
+        if k in ("type", "f") and isinstance(v, str):
+            # only strings keywordize — a null f must stay nil, not
+            # become the nonsense keyword :None
             out[key] = Keyword(v)
+        elif k == "error":
+            # Jepsen spells error tags as keywords: :net-timeout, or
+            # [:precondition-failed "msg"] — tag keywordized, text kept.
+            # Only token-safe strings keywordize; prose ("timed out")
+            # would be syntactically invalid as a keyword.
+            if isinstance(v, str) and _KW_SAFE.match(v):
+                out[key] = Keyword(v)
+            elif isinstance(v, list) and v and isinstance(v[0], str) \
+                    and _KW_SAFE.match(v[0]):
+                out[key] = [Keyword(v[0])] + list(v[1:])
+            else:
+                out[key] = v
         elif k == "value" and mops and isinstance(v, list):
             out[key] = [[Keyword(m[0])] + list(m[1:])
                         if isinstance(m, list) and m
@@ -196,6 +236,13 @@ def edn_map_to_op(m: dict) -> dict:
         key = str.__str__(k) if isinstance(k, Keyword) else k
         if key in ("type", "f"):
             out[key] = str.__str__(v) if isinstance(v, Keyword) else v
+        elif key == "error":
+            if isinstance(v, Keyword):
+                out[key] = str.__str__(v)
+            elif isinstance(v, list) and v and isinstance(v[0], Keyword):
+                out[key] = [str.__str__(v[0])] + list(v[1:])
+            else:
+                out[key] = v
         elif key == "value" and isinstance(v, list):
             out[key] = [[str.__str__(e[0])] + list(e[1:])
                         if isinstance(e, list) and e
@@ -209,3 +256,15 @@ def edn_map_to_op(m: dict) -> dict:
 def history_to_edn_lines(records, workload: str) -> Iterator[str]:
     for op in records:
         yield dumps(op_to_edn_map(op, workload))
+
+
+def history_to_edn_vector_lines(records, workload: str) -> Iterator[str]:
+    """Jepsen's ``store/<test>/history.edn`` is a single EDN vector of op
+    maps — a stock ``read-string`` of a line-delimited export would see
+    only the first op. This form wraps the ops in ``[`` … ``]`` (one map
+    per line, so it stays diffable/grep-able) and is drop-in for JVM
+    tooling that slurps the whole file."""
+    yield "["
+    for op in records:
+        yield dumps(op_to_edn_map(op, workload))
+    yield "]"

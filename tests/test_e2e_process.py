@@ -125,13 +125,21 @@ def test_hat_isolation_tradeoff():
     assert res["workload"]["valid?"] is True, res["workload"]
     assert res["availability"]["valid?"] is True, res["availability"]
 
-    res2 = run("txn-rw-register", "txn_rw_hat.py", node_count=3,
-               concurrency=9, time_limit=6.0, rate=60.0, key_count=4,
-               nemesis=["partition"], nemesis_interval=1.5,
-               recovery_time=2.0, consistency_models="serializable",
-               seed=5)
-    assert res2["workload"]["valid?"] is False, \
-        "HAT should not pass serializable checking under load"
+    # anomaly production depends on real subprocess scheduling — retry a
+    # couple of seeds so a lightly-loaded host can't yield a spuriously
+    # clean history (ADVICE r3 #4)
+    verdicts = []
+    for seed in (5, 11, 23):
+        res2 = run("txn-rw-register", "txn_rw_hat.py", node_count=3,
+                   concurrency=9, time_limit=6.0, rate=60.0, key_count=4,
+                   nemesis=["partition"], nemesis_interval=1.5,
+                   recovery_time=2.0, consistency_models="serializable",
+                   seed=seed)
+        verdicts.append(res2["workload"]["valid?"])
+        if verdicts[-1] is False:
+            break
+    assert False in verdicts, \
+        f"HAT should not pass serializable checking under load: {verdicts}"
 
 
 def test_no_isolation_node_caught():
@@ -139,13 +147,18 @@ def test_no_isolation_node_caught():
     txn_rw_register_no_isolation.clj as spec) interleaves mid-txn; the
     Elle rw-register checker must flag intermediate reads / cycles with
     zero network faults."""
-    res = run("txn-rw-register", "txn_rw_no_isolation.py", node_count=1,
-              concurrency=16, time_limit=6.0, rate=120.0, key_count=4,
-              seed=3)
-    w = res["workload"]
-    assert w["valid?"] is False, "no-isolation anomalies not caught"
-    assert set(w.get("anomaly-types") or []) & {
-        "G1b", "G1c", "G-single", "G2-item", "internal"}, w
+    # retried across seeds: anomalies need real scheduling interleaves,
+    # which a lightly-loaded host may not produce first try (ADVICE r3 #4)
+    last = None
+    for seed in (3, 17, 29):
+        res = run("txn-rw-register", "txn_rw_no_isolation.py",
+                  node_count=1, concurrency=16, time_limit=6.0,
+                  rate=120.0, key_count=4, seed=seed)
+        last = w = res["workload"]
+        if w["valid?"] is False and set(w.get("anomaly-types") or []) & {
+                "G1b", "G1c", "G-single", "G2-item", "internal"}:
+            return
+    assert False, f"no-isolation anomalies not caught: {last}"
 
 
 def test_raft_node_lin_kv_with_partitions_e2e():

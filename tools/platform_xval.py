@@ -113,8 +113,8 @@ def cmd_compare(a_path: str, b_path: str) -> int:
           f"stats={a['stats']}")
     print(f"B: {b['platform']} violations={b['violations']} "
           f"stats={b['stats']}")
-    if (a["instances"], a["ticks"], a["seed"], a["chunk"]) != \
-            (b["instances"], b["ticks"], b["seed"], b["chunk"]):
+    if (a["instances"], a["ticks"], a["seed"], a.get("chunk")) != \
+            (b["instances"], b["ticks"], b["seed"], b.get("chunk")):
         print("configs differ — not comparable")
         return 2
     if len(a["checkpoints"]) != len(b["checkpoints"]):
