@@ -1,0 +1,189 @@
+#!/usr/bin/env python3
+"""Transactional kafka-style log: a single-root transactor over lin-kv.
+
+The whole broker state — every key's log plus committed offsets — lives
+as ONE value under the ``root`` key in the lin-kv service. A ``txn`` RPC
+applies its entire mop batch to a copy and installs it with a single
+root CAS, so either every send in the transaction becomes visible or
+none does (the atomicity jepsen.tests.kafka's ``:txn?`` mode exists to
+test — reference src/maelstrom/workload/kafka.clj:1-71). Polls inside a
+txn read from the same snapshot the sends commit against. The plain
+send/poll/commit RPCs route through the same root, so the node also
+serves non-txn workloads.
+
+Contention note: a single root serializes all writers (CAS retry loops)
+— the deliberate trade for atomicity without a lock service; compare
+datomic_list_append.py's hash-tree pages for the scalable variant.
+
+``--no-atomic`` is the bug-injection mutant: each send in a txn is
+installed with its OWN root CAS, and a multi-send txn then *aborts*
+(error 30, definite) after its sends are already durable. The checker's
+aborted-read anomaly (a poll observing a value whose send definitively
+failed) catches it.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from node import KV, Node, RPCError  # noqa: E402
+
+NO_ATOMIC = "--no-atomic" in sys.argv
+
+node = Node()
+kv = KV(node, KV.LIN, timeout=2.0)
+
+ROOT = "root"
+POLL_LIMIT = 16
+
+
+def read_root():
+    return kv.read(ROOT, default=None) or {"logs": {}, "commits": {}}
+
+
+def cas_root(cur_raw, new):
+    """Install ``new`` over the exact raw value read (None = absent).
+    Returns True on success, False on conflict."""
+    try:
+        if cur_raw is None:
+            kv.cas(ROOT, None, new, create_if_not_exists=True)
+        else:
+            kv.cas(ROOT, cur_raw, new)
+        return True
+    except RPCError as e:
+        if e.code in (20, 22):
+            return False
+        raise
+
+
+def with_root_retry(update):
+    """Linearizable read-modify-write loop on the root. ``update(root)``
+    returns (new_root_or_None, reply_payload); None = read-only."""
+    while True:
+        cur_raw = kv.read(ROOT, default=None)
+        root = cur_raw or {"logs": {}, "commits": {}}
+        new, payload = update(root)
+        if new is None or cas_root(cur_raw, new):
+            return payload
+
+
+def apply_mops(root, mops):
+    """Apply a txn's mops to (a copy of) root; returns
+    (new_root, completed_mops, mutated?). Successive polls within one
+    transaction consume FORWARD — the second poll resumes after what the
+    first returned, like a client issuing them back-to-back — so a
+    multi-poll txn never re-reads offsets (which the checker would flag
+    as an external-nonmonotonic position jump)."""
+    import copy
+    # read-only batches (polls) work straight off root — a deepcopy of
+    # every log ever sent on every poll would grow linearly with run
+    # length
+    mutated = any(m[0] == "send" for m in mops)
+    new = copy.deepcopy(root) if mutated else root
+    done = []
+    next_pos = {}
+    for mop in mops:
+        if mop[0] == "send":
+            _, k, v = mop
+            log = new["logs"].setdefault(k, [])
+            log.append(v)
+            done.append(["send", k, [len(log) - 1, v]])
+        else:  # ["poll", {key: from_offset}]
+            offsets = mop[1] if len(mop) > 1 and mop[1] else {}
+            out = {}
+            for k, log in new["logs"].items():
+                start = max(offsets.get(k, 0), next_pos.get(k, 0))
+                msgs = [[i, v] for i, v in
+                        enumerate(log[start:start + POLL_LIMIT], start)]
+                if msgs:
+                    out[k] = msgs
+                    next_pos[k] = msgs[-1][0] + 1
+            done.append(["poll", out])
+    return new, done, mutated
+
+
+@node.on("txn")
+def txn(msg):
+    mops = msg["body"]["txn"]
+    if NO_ATOMIC:
+        # MUTANT: per-send root CASes (each visible the moment it lands),
+        # then a definite abort if the txn had more than one send — a
+        # transactor that fails without rolling back its partial work
+        n_sends = 0
+        done = []
+        for mop in mops:
+            if mop[0] == "send":
+                n_sends += 1
+                _, k, v = mop
+
+                def upd(root, k=k, v=v):
+                    new, d, _ = apply_mops(root, [["send", k, v]])
+                    return new, d[0]
+                done.append(with_root_retry(upd))
+            else:
+                def upd(root, mop=mop):
+                    _, d, _ = apply_mops(root, [mop])
+                    return None, d[0]
+                done.append(with_root_retry(upd))
+        if n_sends >= 2:
+            node.reply_error(msg, RPCError(30, "txn aborted (conflict)"))
+            return
+        node.reply(msg, {"type": "txn_ok", "txn": done})
+        return
+
+    def upd(root):
+        new, done, mutated = apply_mops(root, mops)
+        return (new if mutated else None), done
+
+    done = with_root_retry(upd)
+    node.reply(msg, {"type": "txn_ok", "txn": done})
+
+
+@node.on("send")
+def send(msg):
+    k, v = msg["body"]["key"], msg["body"]["msg"]
+
+    def upd(root):
+        new, done, _ = apply_mops(root, [["send", k, v]])
+        return new, done[0][2][0]
+    off = with_root_retry(upd)
+    node.reply(msg, {"type": "send_ok", "offset": off})
+
+
+@node.on("poll")
+def poll(msg):
+    offsets = msg["body"].get("offsets") or {}
+    root = read_root()
+    _, done, _ = apply_mops(root, [["poll", offsets]])
+    node.reply(msg, {"type": "poll_ok", "msgs": done[0][1]})
+
+
+@node.on("commit_offsets")
+def commit_offsets(msg):
+    req = msg["body"].get("offsets") or {}
+
+    def upd(root):
+        commits = dict(root["commits"])
+        changed = False
+        for k, off in req.items():
+            if commits.get(k, -1) < off:
+                commits[k] = off
+                changed = True
+        if not changed:
+            return None, None
+        return {**root, "commits": commits}, None
+    with_root_retry(upd)
+    node.reply(msg, {"type": "commit_offsets_ok"})
+
+
+@node.on("list_committed_offsets")
+def list_committed_offsets(msg):
+    root = read_root()
+    out = {k: root["commits"][k]
+           for k in msg["body"].get("keys") or []
+           if k in root["commits"]}
+    node.reply(msg, {"type": "list_committed_offsets_ok", "offsets": out})
+
+
+if __name__ == "__main__":
+    node.run()

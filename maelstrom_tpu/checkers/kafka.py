@@ -13,6 +13,12 @@ commit_offsets / list_committed_offsets histories:
 - **lost write** — an acknowledged send whose offset is below some
   later-polled offset for its key but which never appears in any poll
 - **commit regression** — committed offsets for a key move backwards
+- **aborted read** — a poll observed a value whose send DEFINITIVELY
+  failed (a ``fail``-typed send or atomic txn): the G1a of the log
+  world, and the tell of a broken transaction — a txn that errored
+  after making some of its sends durable. Ops tagged ``non-atomic``
+  (the sequential per-mop fallback for nodes without a txn RPC) are
+  exempt, since partial prefixes are their documented semantics.
 
 Histories may mix single-mop ops (``send`` / ``poll``) with multi-mop
 ``txn`` ops (``--txn`` mode: completion value = list of completed mops
@@ -31,12 +37,23 @@ from collections import defaultdict
 from typing import Any, Dict, List
 
 
+def _hashable(v):
+    """Message values are ints in practice, but the protocol allows any
+    JSON value; fold unhashables to a stable repr for set membership."""
+    try:
+        hash(v)
+        return v
+    except TypeError:
+        return repr(v)
+
+
 def kafka_checker(history) -> dict:
     from ..gen.history import pairs
     anomalies: Dict[str, List[Any]] = defaultdict(list)
 
     acked = defaultdict(dict)       # key -> offset -> value
     polled = defaultdict(dict)      # key -> offset -> value
+    failed_sends = defaultdict(set)  # key -> values of definite-fail sends
     max_polled = defaultdict(lambda: -1)
     last_poll_pos = defaultdict(lambda: -1)   # (process, key) -> offset
     commits = defaultdict(lambda: -1)         # (process, key) -> offset
@@ -77,6 +94,18 @@ def kafka_checker(history) -> dict:
         if inv.get("process") == "nemesis":
             continue
         f = inv["f"]
+        if comp is not None and comp["type"] == "fail":
+            # definite failure: none of its sends may ever be observed
+            # (non-atomic sequential fallbacks are exempt — their
+            # documented semantics allow a durable prefix)
+            non_atomic = inv.get("non-atomic") or comp.get("non-atomic")
+            if f == "send":
+                failed_sends[inv["value"][0]].add(_hashable(
+                    inv["value"][1]))
+            elif f == "txn" and not non_atomic:
+                for mop in (inv["value"] or []):
+                    if mop[0] == "send":
+                        failed_sends[mop[1]].add(_hashable(mop[2]))
         if comp is None or comp["type"] != "ok":
             continue
         # a reassigned consumer (fresh client resuming from committed
@@ -131,6 +160,13 @@ def kafka_checker(history) -> dict:
         for off, v in offs.items():
             if off < max_polled[k] and off not in polled[k]:
                 anomalies["lost-write"].append(
+                    {"key": k, "offset": off, "value": v})
+
+    # aborted reads: polled values whose send definitively failed
+    for k, offs in polled.items():
+        for off, v in offs.items():
+            if _hashable(v) in failed_sends[k]:
+                anomalies["aborted-read"].append(
                     {"key": k, "offset": off, "value": v})
 
     return {

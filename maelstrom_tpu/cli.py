@@ -232,8 +232,10 @@ def cmd_test(args) -> int:
 
 
 DEMOS = [
-    # (workload, node, extra opts) — core.clj:104-126's matrix, over the
-    # bundled python nodes
+    # (workload, node [+ args], extra opts[, expect_valid]) —
+    # core.clj:104-126's matrix, over the bundled python nodes.
+    # expect_valid=False entries are the bug-injection corpus run
+    # end-to-end: the demo FAILS if the checker does NOT catch them
     ("echo", "echo.py", {}),
     ("echo", "echo.py", {"node_count": 2}),
     ("broadcast", "broadcast.py", {"node_count": 5, "topology": "grid"}),
@@ -282,6 +284,13 @@ DEMOS = [
     ("kafka", "kafka_single.py",
      {"node_count": 1, "rate": 20.0, "crash_clients": True}),
     ("kafka", "kafka_lin_kv.py", {"node_count": 3, "rate": 15.0}),
+    # atomic transactions end-to-end: the single-root transactor passes
+    # under multi-mop --txn load; its --no-atomic mutant (durable sends
+    # from aborted txns) must be CAUGHT via the aborted-read anomaly
+    ("kafka", "kafka_txn.py",
+     {"node_count": 3, "rate": 15.0, "txn": True}),
+    ("kafka", "kafka_txn.py --no-atomic",
+     {"node_count": 3, "rate": 25.0, "txn": True}, False),
 ]
 
 
@@ -289,9 +298,13 @@ def cmd_demo(args) -> int:
     """Self-test: the full matrix against the bundled example nodes."""
     from .runner import run_test
     failures = []
-    for workload, node, extra in DEMOS:
+    for entry in DEMOS:
+        workload, node, extra = entry[0], entry[1], entry[2]
+        expect_valid = entry[3] if len(entry) > 3 else True
+        node_file, *node_args = node.split()
         bin_, bin_args = _bin_cmd(
-            os.path.join(REPO, "examples", "python", node), [])
+            os.path.join(REPO, "examples", "python", node_file),
+            node_args)
         opts = dict(bin=bin_, bin_args=bin_args, node_count=1,
                     concurrency=4, rate=10.0, time_limit=args.time_limit,
                     recovery_time=1.0, store_root=args.store, seed=1)
@@ -305,11 +318,16 @@ def cmd_demo(args) -> int:
             verdict = results.get("valid?")
         except Exception as e:
             print(f"   crashed: {e!r}")
-            verdict = False
-        ok = verdict is True
-        print("   valid!" if ok else
-              ("   UNKNOWN (indeterminate analysis)"
-               if verdict == "unknown" else "   INVALID"))
+            verdict = None
+        if expect_valid:
+            ok = verdict is True
+            print("   valid!" if ok else
+                  ("   UNKNOWN (indeterminate analysis)"
+                   if verdict == "unknown" else "   INVALID"))
+        else:
+            ok = verdict is False
+            print("   caught (mutant flagged invalid)" if ok else
+                  "   NOT CAUGHT — mutant passed the checker")
         if not ok:
             failures.append(label)
     print()

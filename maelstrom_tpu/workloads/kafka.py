@@ -33,6 +33,19 @@ schema.rpc(
     response={"msgs": schema.MapOf(str, [[schema.Any]])})
 
 schema.rpc(
+    "kafka", "txn",
+    "Atomically applies a list of micro-operations: `[\"send\", key, "
+    "msg]` appends msg to key's log; `[\"poll\", offsets]` reads each "
+    "key from the given offset. Either every send in the transaction "
+    "becomes visible or none does. Completed mops are returned with "
+    "sends as `[\"send\", key, [offset, msg]]` and polls as "
+    "`[\"poll\", {key: [[offset, msg], ...]}]`. Nodes that do not "
+    "support transactions reply with error 10 (not supported) and "
+    "clients fall back to sequential per-mop RPCs.",
+    request={"txn": [[schema.Any]]},
+    response={"txn": [[schema.Any]]})
+
+schema.rpc(
     "kafka", "commit_offsets",
     "Informs the node that the client has successfully processed "
     "messages up to and including the given offset for each key.",
@@ -57,6 +70,11 @@ class KafkaClient(WorkloadClient):
         # marks its first poll "reassigned" (consumer-group rebalance
         # semantics; the checker then allows the position jump)
         self.fresh = True
+        # txn ops first try the atomic `txn` RPC; a node replying error
+        # 10 (not supported) demotes this client to sequential per-mop
+        # application, whose completions are tagged non-atomic so the
+        # checker exempts them from aborted-read accounting
+        self.txn_rpc = True
 
     def _resume_from_committed(self):
         key_count = self.opts.get("key_count") or 4
@@ -84,17 +102,60 @@ class KafkaClient(WorkloadClient):
             return out
         return self._apply_inner(o)
 
+    def _apply_txn_rpc(self, o):
+        """One atomic `txn` RPC carrying the whole mop batch; polls pass
+        the client's positions explicitly so the node can serve the
+        reads from the same snapshot the sends commit into."""
+        from ..runtime.client import RPCError
+        wire = []
+        for mop in o["value"]:
+            if mop[0] == "send":
+                wire.append(["send", mop[1], mop[2]])
+            else:
+                wire.append(["poll", self.positions])
+        try:
+            resp = self.call("txn", txn=wire)
+        except RPCError as e:
+            if e.code == 10:        # node has no txn support
+                self.txn_rpc = False
+                return None
+            raise
+        done = resp["txn"]
+        polled_high = {}
+        for mop in done:
+            if mop[0] == "poll":
+                for k, pairs in (mop[1] or {}).items():
+                    if pairs:
+                        self.positions[k] = pairs[-1][0] + 1
+                        polled_high[k] = max(polled_high.get(k, -1),
+                                             pairs[-1][0])
+        if polled_high:
+            # best-effort, like the reference's post-mop commit: the txn
+            # itself already committed atomically, so a failed offset
+            # commit must NOT mark the op failed — the checker would
+            # then read its durable sends as aborted (false positive)
+            try:
+                self.call("commit_offsets", offsets=polled_high)
+            except RPCError:
+                pass
+        return {**o, "type": "ok", "value": done}
+
     def _apply_inner(self, o):
         if o["f"] == "txn":
-            # multi-mop transaction (jepsen.tests.kafka :txn? op shape):
-            # apply mops in order, then auto-commit the highest polled
-            # offsets (the reference client's post-mop commit,
-            # kafka.clj:225-231, generalized to several mops). The
-            # bundled nodes expose no atomic-txn RPC, so mop application
-            # is sequential; a definite mid-txn error fails the op with
-            # the prefix already applied — exactly the caveat jepsen
-            # documents for non-transactional stores, and why the
-            # checker asserts per-mop log anomalies, not atomicity.
+            if self.txn_rpc:
+                out = self._apply_txn_rpc(o)
+                if out is not None:
+                    return out
+            # Sequential fallback (nodes without a txn RPC): apply mops
+            # in order, then auto-commit the highest polled offsets (the
+            # reference client's post-mop commit, kafka.clj:225-231,
+            # generalized to several mops). A definite mid-txn error
+            # fails the op with the prefix already applied — the caveat
+            # jepsen documents for non-transactional stores — so the op
+            # is tagged non-atomic (IN PLACE: with_errors snapshots this
+            # same dict into the fail record) and the checker exempts it
+            # from aborted-read accounting.
+            o["non-atomic"] = True
             done = []
             polled_high = {}
             for mop in o["value"]:
