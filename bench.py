@@ -452,6 +452,18 @@ def parent_main() -> int:
                 [sys.executable, here, "--child"], cpu_env, deadline, TAG)
             consider(out, "cpu-fallback", rc)
 
+    # A committed accelerator measurement from an earlier healthy-tunnel
+    # window (tools/tpu_opportunist.sh writes BENCH_TPU_BEST.json) rides
+    # along so a round whose tunnel is down at bench time still reports
+    # its best TPU-verified number next to the live attempt.
+    tpu_best = None
+    try:
+        with open(os.path.join(os.path.dirname(here),
+                               "BENCH_TPU_BEST.json")) as f:
+            tpu_best = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        pass
+
     if best is not None:
         if secondary is not None:
             best["secondary"] = {
@@ -461,6 +473,15 @@ def parent_main() -> int:
                  "sim_ticks", "delivered_timed", "wall_s",
                  "dropped_overflow")
                 if k in secondary}
+        if tpu_best is not None and best.get("platform") == "cpu":
+            line = tpu_best.get("metric_line", {})
+            best["tpu_best"] = {
+                k: line.get(k) for k in
+                ("value", "vs_baseline", "platform", "config",
+                 "instances", "partial", "provisional", "sim_ticks",
+                 "delivered_timed", "wall_s")
+                if k in line}
+            best["tpu_best"]["captured_at"] = tpu_best.get("iso")
         print(json.dumps(best), flush=True)
         return 0
     _emit_failure(last_err)

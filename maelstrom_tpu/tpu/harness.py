@@ -174,12 +174,12 @@ def run_tpu_test(model: Model, opts: Optional[Dict[str, Any]] = None,
     overall = compose_valid(r.get("valid?", True) for r in per_instance)
     if n_violating > 0:
         overall = False
+    violating_ids = np.nonzero(violations)[0]
     results = {
         "valid?": overall,
         "invariants": {
             "violating-instances": n_violating,
-            "violating-instance-ids": np.nonzero(violations)[0][:16]
-            .tolist(),
+            "violating-instance-ids": violating_ids[:1024].tolist(),
             "total-violation-ticks": int(violations.sum()),
         },
         "instance-count": sim.n_instances,
@@ -213,6 +213,21 @@ def run_tpu_test(model: Model, opts: Optional[Dict[str, Any]] = None,
         results["availability"] = availability
         if availability["valid?"] is False:
             results["valid?"] = False
+    # --- the invariant-trip funnel (SURVEY §7: full checkers on samples
+    # + any instance whose invariants trip). Instances are RNG-stable by
+    # id, so the violating ones — wherever they sit in a 100k-instance
+    # sweep — are re-simulated bit-exactly with recording enabled and
+    # put through the full workload checker, yielding a checkable
+    # history + explainable verdict per tripped instance.
+    funnel = None
+    if opts.get("funnel", True) and len(violating_ids) > 0:
+        funnel_max = int(opts.get("funnel_max", 32))
+        target_ids = [int(i) for i in violating_ids[:funnel_max]]
+        funnel = replay_instances(model, opts, target_ids, params=params,
+                                  checker=checker)
+        funnel["total-violating"] = n_violating
+        results["funnel"] = {k: v for k, v in funnel.items()
+                             if k != "histories"}
     journal = None
     if sim.journal_instances > 0:
         from .journal import TpuJournal
@@ -230,12 +245,58 @@ def run_tpu_test(model: Model, opts: Optional[Dict[str, Any]] = None,
         }
     if opts.get("store_root"):
         _write_store(model.name, opts["store_root"], results, histories,
-                     journal)
+                     journal, funnel=funnel)
     return results
 
 
+def replay_instances(model: Model, opts: Dict[str, Any],
+                     instance_ids: List[int], params=None,
+                     checker=None) -> Dict[str, Any]:
+    """Re-simulate exactly ``instance_ids`` (same seed/config) with full
+    history recording, run the workload checker on each, and return
+    ``{ids, verdicts, histories, replayed-violating}``. Bit-exactness
+    rests on the instance-stable RNG (runtime._instance_keys): each
+    re-simulated instance replays the identical trajectory it had in the
+    original batch, so its history IS the history of the violation."""
+    import jax.numpy as jnp
+
+    opts = {**TPU_DEFAULTS, **opts}
+    K = len(instance_ids)
+    sub_opts = {**opts, "n_instances": K, "record_instances": K,
+                "journal_instances": 0}
+    sim = make_sim_config(model, sub_opts)
+    if params is None:
+        params = model.make_params(sim.net.n_nodes)
+    if checker is None:
+        checker = model.checker()
+    carry, ys = run_sim(model, sim, opts["seed"], params,
+                        jnp.asarray(instance_ids, dtype=jnp.int32))
+    histories = events_to_histories(model, np.asarray(ys.events),
+                                    final_start=sim.client.final_start,
+                                    ms_per_tick=opts["ms_per_tick"])
+    verdicts = []
+    for iid, h in zip(instance_ids, histories):
+        try:
+            v = checker(h, opts)
+        except Exception as e:
+            v = {"valid?": False, "error": repr(e)}
+        v["instance"] = int(iid)
+        v["ops"] = sum(1 for r in h if r["type"] == "invoke")
+        verdicts.append(v)
+    replay_viol = np.asarray(carry.violations)
+    return {
+        "ids": [int(i) for i in instance_ids],
+        # self-check: the replay must trip the same instances' invariants
+        # — a mismatch would mean the replay was NOT bit-exact
+        "replayed-violating": int((replay_viol > 0).sum()),
+        "verdicts": verdicts,
+        "histories": {int(i): h
+                      for i, h in zip(instance_ids, histories)},
+    }
+
+
 def _write_store(name: str, store_root: str, results: Dict[str, Any],
-                 histories, journal=None) -> None:
+                 histories, journal=None, funnel=None) -> None:
     """Store artifacts for a TPU run: results.json + one history per
     recorded instance (the store layout of doc/results.md, minus node
     logs — there are no node processes), plus the Lamport diagram when a
@@ -264,6 +325,14 @@ def _write_store(name: str, store_root: str, results: Dict[str, Any],
             for r in h:
                 f.write(json.dumps(r) + "\n")
         write_txt(h, os.path.join(d, f"history-{i}.txt"))
+    # funnel: one checkable history per invariant-tripping instance,
+    # named by its ORIGINAL instance id in the big batch
+    if funnel:
+        for iid, h in funnel["histories"].items():
+            p = os.path.join(d, f"funnel-history-{iid}.jsonl")
+            with open(p, "w") as f:
+                for r in h:
+                    f.write(json.dumps(r) + "\n")
     latest = os.path.join(os.path.dirname(d), "latest")
     try:
         if os.path.islink(latest):

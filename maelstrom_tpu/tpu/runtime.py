@@ -477,14 +477,42 @@ class Carry(NamedTuple):
     stats: NetStats            # scalars (summed over instances)
     violations: jnp.ndarray    # [I] int32: ticks each instance violated
                                # a model invariant (0 = clean)
-    key: jnp.ndarray
+    key: jnp.ndarray           # the CONSTANT master key (never advanced)
 
 
-def init_carry(model: Model, sim: SimConfig, seed: int, params) -> Carry:
+# RNG purpose tags. Every random draw in the simulation derives from
+# (master key, purpose, [tick,] instance id) via fold_in — no key ever
+# chains through the carry. Consequence: an instance's full trajectory
+# is a pure function of (seed, its instance id), independent of which
+# other instances share the batch — so any subset of instances (e.g.
+# the violating ones from a 100k-instance sweep) can be re-simulated
+# bit-exactly on its own with recording enabled (SURVEY §7: "full
+# checkers on samples + any instance whose invariants trip").
+_RNG_INIT = 0
+_RNG_NEMESIS = 1
+_RNG_NODE = 2
+_RNG_CLIENT = 3
+_RNG_ENQUEUE = 4
+
+
+def _instance_keys(master, purpose: int, instance_ids, t=None):
+    k = jax.random.fold_in(master, purpose)
+    if t is not None:
+        k = jax.random.fold_in(k, t)
+    return jax.vmap(lambda i: jax.random.fold_in(k, i))(instance_ids)
+
+
+def default_instance_ids(sim: SimConfig) -> jnp.ndarray:
+    return jnp.arange(sim.n_instances, dtype=jnp.int32)
+
+
+def init_carry(model: Model, sim: SimConfig, seed: int, params,
+               instance_ids=None) -> Carry:
     I = sim.n_instances
     cfg = sim.net
     key = jax.random.PRNGKey(seed)
-    k_nodes, key = jax.random.split(key)
+    if instance_ids is None:
+        instance_ids = default_instance_ids(sim)
 
     def init_instance(ikey):
         nkeys = jax.random.split(ikey, cfg.n_nodes)
@@ -492,7 +520,8 @@ def init_carry(model: Model, sim: SimConfig, seed: int, params) -> Carry:
             lambda nk, ni: model.init_row(cfg.n_nodes, ni, nk, params))(
                 nkeys, jnp.arange(cfg.n_nodes, dtype=jnp.int32))
 
-    node_state = jax.vmap(init_instance)(jax.random.split(k_nodes, I))
+    node_state = jax.vmap(init_instance)(
+        _instance_keys(key, _RNG_INIT, instance_ids))
     return Carry(
         pool=jnp.zeros((I, cfg.pool_slots, cfg.lanes), jnp.int32),
         node_state=node_state,
@@ -505,17 +534,24 @@ def init_carry(model: Model, sim: SimConfig, seed: int, params) -> Carry:
     )
 
 
-def make_tick_fn(model: Model, sim: SimConfig, params) -> Callable:
+def make_tick_fn(model: Model, sim: SimConfig, params,
+                 instance_ids=None) -> Callable:
     cfg = sim.net
     ccfg = sim.client
     nem = sim.nemesis
     N = cfg.n_nodes
     I = sim.n_instances
+    if instance_ids is None:
+        instance_ids = default_instance_ids(sim)
 
     def tick_fn(carry: Carry, t):
-        key, k_nem, k_node, k_client, k_enq = jax.random.split(carry.key, 5)
+        key = carry.key
 
-        ikeys = jax.random.split(k_nem, I)
+        # nemesis keys are t-INdependent: partition_matrix folds in the
+        # phase index itself, so a grudge holds for its whole phase (the
+        # reference draws one grudge per nemesis op, nemesis.clj) instead
+        # of flapping every tick
+        ikeys = _instance_keys(key, _RNG_NEMESIS, instance_ids)
         partitions = jax.vmap(
             lambda ik: partition_matrix(nem, cfg, t, ik))(ikeys)
 
@@ -532,12 +568,12 @@ def make_tick_fn(model: Model, sim: SimConfig, params) -> Callable:
                 lambda p, pa: netsim.deliver(p, pa, t, cfg))(carry.pool,
                                                              partitions)
 
-        node_keys = jax.random.split(k_node, I)
+        node_keys = _instance_keys(key, _RNG_NODE, instance_ids, t)
         node_state, node_outs = jax.vmap(
             lambda st, ib, k: node_phase(model, st, ib, t, k, cfg, params))(
                 carry.node_state, inbox[:, :N], node_keys)
 
-        client_keys = jax.random.split(k_client, I)
+        client_keys = _instance_keys(key, _RNG_CLIENT, instance_ids, t)
         client_state, reqs, events = jax.vmap(
             lambda cs, ib, k: client_step(model, cs, ib, t, k, cfg, ccfg,
                                           params))(
@@ -550,7 +586,7 @@ def make_tick_fn(model: Model, sim: SimConfig, params) -> Callable:
         M = outs.shape[1]
         outs = outs.at[:, :, wire.NETID].set(
             t * M + jnp.arange(M, dtype=jnp.int32)[None, :])
-        enq_keys = jax.random.split(k_enq, I)
+        enq_keys = _instance_keys(key, _RNG_ENQUEUE, instance_ids, t)
         pool, n_sent, n_lost, n_ovf = jax.vmap(
             lambda p, m, k: netsim.enqueue(p, m, t, k, cfg))(pool, outs,
                                                              enq_keys)
@@ -581,20 +617,25 @@ def make_tick_fn(model: Model, sim: SimConfig, params) -> Callable:
     return tick_fn
 
 
-def simulate(model: Model, sim: SimConfig, seed, params=None
-             ) -> Tuple[Carry, TickOutputs]:
+def simulate(model: Model, sim: SimConfig, seed, params=None,
+             instance_ids=None) -> Tuple[Carry, TickOutputs]:
     """Traceable simulation body (used directly inside shard_map);
     returns (final carry, TickOutputs with a leading T axis — events
     [T, R, C, 2, 2 + model.ev_vals], journal sends/recvs for the first
-    ``journal_instances`` instances)."""
-    carry = init_carry(model, sim, seed, params)
-    tick_fn = make_tick_fn(model, sim, params)
+    ``journal_instances`` instances).
+
+    ``instance_ids`` ([sim.n_instances] int32, default ``arange``) names
+    the instances being simulated: instance ``i``'s trajectory depends
+    only on (seed, ``instance_ids[i]``), so passing the violating ids
+    from a big sweep replays exactly those clusters bit-for-bit."""
+    carry = init_carry(model, sim, seed, params, instance_ids)
+    tick_fn = make_tick_fn(model, sim, params, instance_ids)
     return jax.lax.scan(tick_fn, carry,
                         jnp.arange(sim.n_ticks, dtype=jnp.int32))
 
 
 @partial(jax.jit, static_argnames=("model", "sim"))
-def run_sim(model: Model, sim: SimConfig, seed: int, params=None
-            ) -> Tuple[Carry, TickOutputs]:
+def run_sim(model: Model, sim: SimConfig, seed: int, params=None,
+            instance_ids=None) -> Tuple[Carry, TickOutputs]:
     """Jitted single-device entry point around :func:`simulate`."""
-    return simulate(model, sim, seed, params)
+    return simulate(model, sim, seed, params, instance_ids)

