@@ -107,6 +107,102 @@ def run_sim_unsharded(model: Model, sim: SimConfig, seed: int,
             np.concatenate(evs, axis=1))
 
 
+def _carry_to_wire(c: Carry) -> Carry:
+    """Reshape a per-shard Carry so EVERY leaf has a leading
+    shard-divisible axis (scalars -> [1], key [2] -> [1, 2]) and can
+    cross a shard_map boundary under a uniform ``P(axes)`` spec."""
+    return Carry(
+        pool=c.pool, node_state=c.node_state,
+        client_state=c.client_state,
+        stats=jax.tree.map(lambda x: x.reshape(1), c.stats),
+        violations=c.violations,
+        key=c.key.reshape(1, *c.key.shape))
+
+
+def _carry_from_wire(w: Carry) -> Carry:
+    return Carry(
+        pool=w.pool, node_state=w.node_state,
+        client_state=w.client_state,
+        stats=jax.tree.map(lambda x: x.reshape(()), w.stats),
+        violations=w.violations,
+        key=w.key.reshape(*w.key.shape[1:]))
+
+
+def run_sim_sharded_chunked(model: Model, sim: SimConfig, seed: int,
+                            params=None, mesh: Optional[Mesh] = None,
+                            chunk: int = 100
+                            ) -> Tuple[NetStats, jnp.ndarray, jnp.ndarray]:
+    """:func:`run_sim_sharded` issued as a sequence of ``chunk``-tick
+    device dispatches — the production dispatch pattern (single giant
+    dispatches fault the TPU tunnel; see bench.py) — with the carry left
+    SHARDED across the mesh between dispatches. Bit-identical to the
+    single-scan path by construction (the tick function depends only on
+    (carry, t)), which :func:`run_sim_unsharded` then verifies.
+
+    Returns the same (psum'd NetStats, violations, events) triple;
+    events are concatenated on host along the tick axis.
+    """
+    import numpy as np
+
+    mesh = mesh or make_mesh()
+    mesh, seeds, params = _prepare(model, sim, seed, mesh, params)
+    axes = mesh.axis_names
+
+    from ..tpu.runtime import init_carry, make_tick_fn
+
+    # a trailing partial chunk would force a SECOND full compile of
+    # chunk_fn (scan length is static); prefer a nearby divisor of the
+    # horizon so every dispatch shares one compile
+    if sim.n_ticks % chunk:
+        for c in range(chunk, max(chunk // 2, 1), -1):
+            if sim.n_ticks % c == 0:
+                chunk = c
+                break
+
+    dummy_w = jax.eval_shape(
+        lambda p: _carry_to_wire(init_carry(model, sim, 0, p)), params)
+    wire_spec = jax.tree.map(lambda _: P(axes), dummy_w)
+
+    @jax.jit
+    def init_fn(seeds, params):
+        def body(seed_shard, params_rep):
+            return _carry_to_wire(init_carry(
+                model, sim, seed_shard.reshape(()), params_rep))
+        return jax.shard_map(
+            body, mesh=mesh, in_specs=(P(*axes), P()),
+            out_specs=wire_spec, check_vma=False)(seeds, params)
+
+    @partial(jax.jit, static_argnames=("length",), donate_argnums=0)
+    def chunk_fn(wire, t0, params, length):
+        def body(w, t0_rep, params_rep):
+            carry = _carry_from_wire(w)
+            tick = make_tick_fn(model, sim, params_rep)
+            carry, ys = jax.lax.scan(
+                tick, carry,
+                t0_rep.reshape(()) + jnp.arange(length, dtype=jnp.int32))
+            return _carry_to_wire(carry), ys.events
+        return jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(wire_spec, P(), P()),
+            out_specs=(wire_spec, P(None, axes)),
+            check_vma=False)(wire, t0, params)
+
+    wire = init_fn(seeds, params)
+    events_chunks = []
+    t = 0
+    while t < sim.n_ticks:
+        use = min(chunk, sim.n_ticks - t)
+        wire, events = chunk_fn(wire, jnp.int32(t), params, use)
+        events_chunks.append(np.asarray(events))
+        t += use
+
+    # final: per-shard stats summed on host (stats crossed the boundary
+    # as [n_shards]-length arrays, one slot per shard)
+    stats = NetStats(*(int(jnp.sum(x)) for x in wire.stats))
+    violations = np.asarray(wire.violations)
+    return stats, violations, np.concatenate(events_chunks, axis=0)
+
+
 def run_sim_sharded(model: Model, sim: SimConfig, seed: int, params=None,
                     mesh: Optional[Mesh] = None
                     ) -> Tuple[NetStats, jnp.ndarray, jnp.ndarray]:
@@ -119,15 +215,22 @@ def run_sim_sharded(model: Model, sim: SimConfig, seed: int, params=None,
     2 + model.ev_vals]).
     """
     mesh = mesh or make_mesh()
-    # the per-message journal is a single-device feature; shard_body
-    # drops TickOutputs.journal_* — refuse silently-ignored config
+    mesh, seeds, params = _prepare(model, sim, seed, mesh, params)
+    return _run_sharded(model, sim, mesh, seeds, params)
+
+
+def _prepare(model: Model, sim: SimConfig, seed: int, mesh: Mesh, params):
+    """Shared preamble of the sharded runners — MUST stay common so the
+    chunked path and the single-scan path (the equivalence oracle's
+    subject) can never drift in seed derivation or params fallback."""
+    # the per-message journal is a single-device feature; shard bodies
+    # drop TickOutputs.journal_* — refuse silently-ignored config
     assert sim.journal_instances == 0, \
         "journal_instances is not supported under shard_map"
-    shape = mesh.devices.shape
     seeds = jnp.array(shard_seeds(seed, mesh.devices.size),
-                      dtype=jnp.int32).reshape(shape)
+                      dtype=jnp.int32).reshape(mesh.devices.shape)
     if params is None:
         params = model.make_params(sim.net.n_nodes)
     if params is None:
         params = jnp.zeros((), jnp.int32)   # shard_map needs a pytree
-    return _run_sharded(model, sim, mesh, seeds, params)
+    return mesh, seeds, params

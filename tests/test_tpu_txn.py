@@ -108,7 +108,14 @@ def test_txn_rw_dirty_apply_caught():
 
 def test_kafka_commit_regression_caught():
     from maelstrom_tpu.models.kafka import KafkaCommitRegression
-    res = run_tpu_test(KafkaCommitRegression(), KAFKA_OPTS)
+    # needs a wider fleet than the other mutants: the regression only
+    # surfaces when a lagging consumer's blind overwrite is OBSERVED by
+    # later list ops — 32 instances catches it on every seed tried,
+    # where 8 is schedule-lottery (more instances = more schedules, the
+    # product's whole thesis)
+    res = run_tpu_test(KafkaCommitRegression(),
+                       dict(KAFKA_OPTS, n_instances=32,
+                            record_instances=32))
     assert res["valid?"] is False, "commit-regression mutant not caught"
     kinds = set()
     for b in res["instances"]:
