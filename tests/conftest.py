@@ -1,6 +1,8 @@
 import os
 import sys
 
+import pytest
+
 # TPU-runtime tests run on a virtual 8-device CPU mesh. A sitecustomize
 # hook may have imported jax (pointing at a real accelerator) before this
 # file runs, so updating os.environ alone is not enough — override the
@@ -15,6 +17,32 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# Persistent compilation cache: identical sim configs recompile in every
+# pytest process otherwise (the suite's dominant cost — VERDICT r3 weak
+# #7). Cached executables are keyed on HLO + compile options, so this is
+# purely a wall-clock lever.
+_CACHE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), ".jax_cache")
+jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: heavyweight test (big sims / long e2e runs); "
+                   "deselected by -m fast")
+    config.addinivalue_line(
+        "markers", "fast: auto-applied to every non-slow test; "
+                   "`pytest -m fast` is the <2-minute sweep — every "
+                   "component keeps at least one fast representative "
+                   "(meta-tests like time-to-anomaly are slow-only)")
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if "slow" not in item.keywords:
+            item.add_marker(pytest.mark.fast)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

@@ -37,6 +37,7 @@ def test_cpp_echo(cpp_bins):
     assert res["workload"]["ok-count"] > 10
 
 
+@pytest.mark.slow
 def test_cpp_g_set_with_partitions(cpp_bins):
     res = run("g-set", "g_set", cpp_bins, node_count=3, time_limit=3.0,
               recovery_time=1.5, nemesis=["partition"],
@@ -46,6 +47,7 @@ def test_cpp_g_set_with_partitions(cpp_bins):
     assert w["lost-count"] == 0
 
 
+@pytest.mark.slow
 def test_cpp_lin_kv_proxy(cpp_bins):
     res = run("lin-kv", "lin_kv_proxy", cpp_bins, node_count=2,
               time_limit=3.0)
@@ -54,6 +56,7 @@ def test_cpp_lin_kv_proxy(cpp_bins):
     assert w["key-count"] > 0
 
 
+@pytest.mark.slow
 def test_cpp_broadcast_with_partitions(cpp_bins):
     res = run("broadcast", "broadcast", cpp_bins, node_count=5,
               topology="grid", time_limit=3.0, recovery_time=1.5,
@@ -64,6 +67,7 @@ def test_cpp_broadcast_with_partitions(cpp_bins):
     assert w["acknowledged-count"] > 0
 
 
+@pytest.mark.slow
 def test_cpp_pn_counter(cpp_bins):
     res = run("pn-counter", "pn_counter", cpp_bins, node_count=3,
               time_limit=4.0, recovery_time=1.0)
@@ -71,6 +75,7 @@ def test_cpp_pn_counter(cpp_bins):
     assert res["stats"]["ok-count"] > 30
 
 
+@pytest.mark.slow
 def test_cpp_pn_counter_as_g_counter(cpp_bins):
     res = run("g-counter", "pn_counter", cpp_bins, node_count=3,
               time_limit=4.0, recovery_time=1.0)

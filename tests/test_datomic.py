@@ -7,6 +7,9 @@ import os
 import sys
 
 from maelstrom_tpu import run_test
+import pytest
+
+pytestmark = pytest.mark.slow
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 

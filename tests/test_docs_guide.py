@@ -60,6 +60,7 @@ def _check_range(actual, bound, label):
         assert actual == bound, f"{label}: {actual} != {bound}"
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("spec", SPECS, ids=lambda s: s["id"])
 def test_guide_embedded_config(spec):
     expect = spec["expect"]

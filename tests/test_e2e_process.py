@@ -40,6 +40,7 @@ def test_broadcast_e2e():
     assert res["net"]["msgs-per-op"] > 0
 
 
+@pytest.mark.slow
 def test_broadcast_partition_e2e():
     res = run("broadcast", "broadcast.py", node_count=5, topology="tree4",
               time_limit=4.0, recovery_time=2.0,
@@ -48,6 +49,7 @@ def test_broadcast_partition_e2e():
     assert w["lost-count"] == 0, w
 
 
+@pytest.mark.slow
 def test_g_set_partition_e2e():
     res = run("g-set", "g_set.py", node_count=3, time_limit=3.0,
               recovery_time=1.5, nemesis=["partition"],
@@ -57,6 +59,7 @@ def test_g_set_partition_e2e():
     assert w["lost-count"] == 0
 
 
+@pytest.mark.slow
 def test_pn_counter_e2e():
     res = run("pn-counter", "pn_counter.py", node_count=3, time_limit=3.0,
               recovery_time=1.0)
@@ -85,6 +88,7 @@ def test_txn_list_append_single_node_e2e():
     assert w["txn-count"] > 20
 
 
+@pytest.mark.slow
 def test_txn_rw_register_single_node_e2e():
     res = run("txn-rw-register", "txn_single.py", node_count=1,
               time_limit=3.0, rate=30.0)
@@ -93,6 +97,7 @@ def test_txn_rw_register_single_node_e2e():
     assert w["txn-count"] > 20
 
 
+@pytest.mark.slow
 def test_datomic_txn_multi_node_e2e():
     res = run("txn-list-append", "datomic_txn.py", node_count=3,
               time_limit=4.0, rate=20.0)
@@ -101,6 +106,7 @@ def test_datomic_txn_multi_node_e2e():
     assert w["txn-count"] > 10
 
 
+@pytest.mark.slow
 def test_txn_thunks_multi_node_e2e():
     """Per-key-thunk transactor (reference demo/clojure/
     multi_key_txn.clj as spec): immutable thunks in lww-kv + root map
@@ -112,6 +118,7 @@ def test_txn_thunks_multi_node_e2e():
     assert w["txn-count"] > 10
 
 
+@pytest.mark.slow
 def test_hat_isolation_tradeoff():
     """The HAT teaching point (reference demo/clojure/
     txn_rw_register_hat.clj as spec): total availability under
@@ -142,6 +149,7 @@ def test_hat_isolation_tradeoff():
         f"HAT should not pass serializable checking under load: {verdicts}"
 
 
+@pytest.mark.slow
 def test_no_isolation_node_caught():
     """The un-isolated single-node transactor (reference demo/clojure/
     txn_rw_register_no_isolation.clj as spec) interleaves mid-txn; the
@@ -161,6 +169,7 @@ def test_no_isolation_node_caught():
     assert False, f"no-isolation anomalies not caught: {last}"
 
 
+@pytest.mark.slow
 def test_raft_node_lin_kv_with_partitions_e2e():
     """The canonical Raft demo config (reference doc/06-raft): lin-kv
     over the bundled raft.py, partitions during the run."""
@@ -172,6 +181,7 @@ def test_raft_node_lin_kv_with_partitions_e2e():
     assert res["stats"]["ok-count"] > 30
 
 
+@pytest.mark.slow
 def test_counter_over_seq_kv_service_e2e():
     """Exercises the Sequential consistency wrapper end-to-end: CAS retry
     adds + the write-to-force-recency read trick (reference doc/04-crdts

@@ -56,6 +56,7 @@ def test_stored_histories_roundtrip(run_dir):
         _roundtrip(records, workload)
 
 
+@pytest.mark.slow
 def test_mutant_anomaly_history_roundtrips(tmp_path):
     """An anomaly history from the bug-injection corpus (stale-read
     mutant under partitions) exports and round-trips; the checker's

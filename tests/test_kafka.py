@@ -1,4 +1,5 @@
 """Kafka workload: checker unit tests + single-node e2e."""
+import pytest
 
 from maelstrom_tpu.checkers.kafka import kafka_checker
 from conftest import example_bin
@@ -49,6 +50,7 @@ def test_kafka_inconsistent_offset():
     assert "inconsistent-offset" in r["anomalies"]
 
 
+@pytest.mark.slow
 def test_kafka_single_node_e2e():
     bin_cmd = example_bin("kafka_single.py")
     res = run_test("kafka", dict(
@@ -61,6 +63,7 @@ def test_kafka_single_node_e2e():
     assert w["poll-count"] > 10
 
 
+@pytest.mark.slow
 def test_kafka_multi_node_over_lin_kv_e2e():
     bin_cmd = example_bin("kafka_lin_kv.py")
     res = run_test("kafka", dict(
@@ -103,6 +106,7 @@ def test_kafka_txn_external_nonmonotonic_and_reassignment():
     assert "external-nonmonotonic" not in kafka_checker(h2)["anomalies"]
 
 
+@pytest.mark.slow
 def test_kafka_txn_e2e():
     bin_cmd = example_bin("kafka_single.py")
     res = run_test("kafka", dict(
@@ -132,6 +136,7 @@ def test_kafka_aborted_read_unit():
     assert "aborted-read" not in r2["anomaly-types"], r2
 
 
+@pytest.mark.slow
 def test_kafka_atomic_txn_node_e2e():
     """The single-root transactor under multi-mop --txn load: atomic,
     clean; its --no-atomic mutant (durable sends from aborted txns) is

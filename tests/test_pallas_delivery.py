@@ -8,6 +8,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+
 from maelstrom_tpu.ops.delivery import deliver_pallas
 from maelstrom_tpu.tpu import netsim, wire
 from maelstrom_tpu.tpu.netsim import NetConfig
@@ -28,7 +29,9 @@ def _random_pool(rng, cfg, fill=0.6):
     return pool
 
 
-@pytest.mark.parametrize("seed", [0, 1, 2])
+@pytest.mark.parametrize(
+    "seed", [0, pytest.param(1, marks=pytest.mark.slow),
+             pytest.param(2, marks=pytest.mark.slow)])
 def test_pallas_deliver_matches_xla_reference(seed):
     import random
     rng = random.Random(seed)

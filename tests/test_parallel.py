@@ -1,5 +1,6 @@
 """Multi-device sharding: the instance axis over a virtual 8-device CPU
 mesh via shard_map, with psum'd fleet stats (SURVEY §7 step 8)."""
+import pytest
 
 import jax
 import numpy as np
@@ -35,6 +36,7 @@ def test_echo_sharded_over_8_devices():
     assert len(set(payload_sets)) > 1
 
 
+@pytest.mark.slow
 def test_raft_sharded_runs_and_checks():
     model = RaftModel(n_nodes_hint=3, log_cap=48)
     opts = dict(node_count=3, concurrency=2, n_instances=2,
@@ -51,6 +53,7 @@ def test_raft_sharded_runs_and_checks():
             assert checker(h, opts)["valid?"] is True
 
 
+@pytest.mark.slow
 def test_sharded_equals_unsharded_bitwise():
     """Behavioral equivalence, not just execution (VERDICT r2 #4): the
     same per-shard seeds run unsharded on one device reproduce the
@@ -73,6 +76,7 @@ def test_sharded_equals_unsharded_bitwise():
     assert np.array_equal(np.asarray(events), u_events)
 
 
+@pytest.mark.slow
 def test_hybrid_mesh_single_host_degenerate():
     """run_sim_sharded over the (1, 8) degenerate DCN x ICI hybrid mesh:
     the two-axis sharding compiles and runs; only the axis sizes change

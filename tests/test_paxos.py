@@ -6,6 +6,8 @@ import os
 import sys
 
 from maelstrom_tpu import run_test
+import pytest
+
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BIN_ARGS = [os.path.join(REPO, "examples", "python", "paxos.py")]
@@ -20,6 +22,7 @@ def test_paxos_lin_kv_5n():
     assert res["stats"]["ok-count"] > 30
 
 
+@pytest.mark.slow
 def test_paxos_lin_kv_partitions(tmp_path):
     """Regression for the cross-round closure-poisoning bug: under dense
     contention + partitions, a late promise reply from round k used to

@@ -13,6 +13,9 @@ import numpy as np
 from maelstrom_tpu.models.raft_buggy import RaftDoubleVote
 from maelstrom_tpu.tpu.harness import make_sim_config
 from maelstrom_tpu.tpu.runtime import run_sim
+import pytest
+
+pytestmark = pytest.mark.slow
 
 
 def _first_anomaly_tick(n_instances: int, seed: int = 9) -> int:

@@ -1,5 +1,6 @@
 """TPU-runtime CRDT models: broadcast / g-set / pn-counter end-to-end on
 the virtual CPU mesh, including partition-nemesis runs (SURVEY §7 step 6)."""
+import pytest
 
 from maelstrom_tpu.models.crdt import (BroadcastModel, GCounterModel,
                                        GossipSetModel, PNCounterModel)
@@ -16,6 +17,7 @@ def test_tpu_g_set():
     assert inst["lost-count"] == 0
 
 
+@pytest.mark.slow
 def test_tpu_broadcast_partition():
     res = run_tpu_test(BroadcastModel("grid"), dict(
         node_count=5, concurrency=2, n_instances=8, record_instances=4,
@@ -27,6 +29,7 @@ def test_tpu_broadcast_partition():
     assert res["valid?"] is True, res["instances"]
 
 
+@pytest.mark.slow
 def test_tpu_pn_counter():
     res = run_tpu_test(PNCounterModel(n_nodes_hint=3, topology="total"),
                        dict(node_count=3, concurrency=2, n_instances=8,
@@ -37,6 +40,7 @@ def test_tpu_pn_counter():
     assert inst["final-reads"], inst
 
 
+@pytest.mark.slow
 def test_tpu_g_counter():
     res = run_tpu_test(GCounterModel(n_nodes_hint=3, topology="total"),
                        dict(node_count=3, concurrency=2, n_instances=4,

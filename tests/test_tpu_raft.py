@@ -23,6 +23,7 @@ def test_raft_linearizable_happy_path():
     assert res["net"]["delivered"] > 1000
 
 
+@pytest.mark.slow
 def test_raft_linearizable_under_partitions_and_loss():
     res = run_tpu_test(RaftModel(n_nodes_hint=3), dict(
         node_count=3, concurrency=3, n_instances=4, record_instances=4,
@@ -45,6 +46,7 @@ BUG_OPTS = dict(node_count=3, concurrency=3, n_instances=24,
 # test_raft_no_term_guard_caught_on_figure8 below; all three corpus
 # mutants are now demonstrably caught.
 @pytest.mark.parametrize("buggy", [RaftDoubleVote, RaftStaleRead])
+@pytest.mark.slow
 def test_raft_injected_bugs_are_caught(buggy):
     res = run_tpu_test(buggy(n_nodes_hint=3), BUG_OPTS)
     assert res["valid?"] is False, \
@@ -74,6 +76,7 @@ FIGURE8_OPTS = dict(node_count=5, concurrency=4, n_instances=64,
                     recovery_time=0.5, seed=11)
 
 
+@pytest.mark.slow
 def test_raft_no_term_guard_caught_on_figure8():
     """The §5.4.2 commit bug: an old-term entry committed on replication
     count alone gets overwritten after a leader change. The on-device
@@ -93,6 +96,7 @@ def test_raft_no_term_guard_caught_on_figure8():
     assert res_ok["valid?"] is True, res_ok["instances"]
 
 
+@pytest.mark.slow
 def test_raft_eager_commit_caught():
     """Max-match commit (no majority quorum): the leader acknowledges
     writes it alone holds; a failover to a node without them then
@@ -109,6 +113,7 @@ def test_raft_eager_commit_caught():
     assert caught, (res["instances"], res["invariants"])
 
 
+@pytest.mark.slow
 def test_raft_short_log_wins_caught():
     """Term-only vote recency: a same-term shorter-log candidate wins an
     election and truncates a committed suffix. Needs churn (partitions +
@@ -127,6 +132,7 @@ def test_raft_short_log_wins_caught():
     assert res_ok["valid?"] is True, res_ok["instances"]
 
 
+@pytest.mark.slow
 def test_raft_correct_same_config_as_bug_hunt():
     """The correct model must pass the exact config that trips the
     mutants — otherwise the bug tests prove nothing."""
@@ -134,6 +140,7 @@ def test_raft_correct_same_config_as_bug_hunt():
     assert res["valid?"] is True, res["instances"]
 
 
+@pytest.mark.slow
 def test_on_device_invariants_catch_double_vote_fleet_wide():
     """Election-safety + committed-log-agreement run on EVERY instance
     on-device; detection rate beats history sampling by an order of
@@ -149,6 +156,7 @@ def test_on_device_invariants_catch_double_vote_fleet_wide():
     assert res_ok["valid?"] is True, res_ok["instances"]
 
 
+@pytest.mark.slow
 def test_raft_majorities_ring_nemesis():
     res = run_tpu_test(RaftModel(n_nodes_hint=5), dict(
         node_count=5, concurrency=3, n_instances=4, record_instances=4,

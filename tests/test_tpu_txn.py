@@ -16,6 +16,7 @@ TXN_OPTS = dict(node_count=3, concurrency=3, n_instances=4,
                 latency=5.0, rpc_timeout=1.0, recovery_time=0.3, seed=1)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("model_cls", [TxnListAppendModel,
                                        TxnRwRegisterModel])
 def test_txn_over_raft_clean(model_cls):
@@ -41,6 +42,7 @@ def _leader_isolation_schedule(cycles=2):
     return tuple(sched), (t + 600) / 1000
 
 
+@pytest.mark.slow
 def test_txn_dirty_apply_caught_by_elle():
     """Acked-at-append txns get truncated on leader change: Elle must
     flag lost-append / incompatible-order; the correct model must pass
@@ -76,6 +78,7 @@ def test_kafka_clean():
     assert res["net"]["delivered"] > 300
 
 
+@pytest.mark.slow
 def test_kafka_offset_reuse_caught():
     res = run_tpu_test(KafkaOffsetReuse(), KAFKA_OPTS)
     assert res["valid?"] is False, "offset-reuse mutant not caught"
@@ -86,6 +89,7 @@ def test_kafka_offset_reuse_caught():
     assert "duplicate-offset" in kinds, kinds
 
 
+@pytest.mark.slow
 def test_txn_rw_dirty_apply_caught():
     """rw-register dirty-apply mutant: stale reads of truncated acked
     writes surface as G-single cycles through the checker's
@@ -106,6 +110,7 @@ def test_txn_rw_dirty_apply_caught():
     assert res_ok["valid?"] is True, res_ok["instances"]
 
 
+@pytest.mark.slow
 def test_kafka_commit_regression_caught():
     from maelstrom_tpu.models.kafka import KafkaCommitRegression
     # needs a wider fleet than the other mutants: the regression only
