@@ -52,3 +52,17 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 def example_bin(name: str) -> list:
     """Command line for a bundled example node."""
     return [sys.executable, os.path.join(REPO, "examples", "python", name)]
+
+
+@pytest.fixture(scope="session")
+def cpp_bins():
+    """Build the C++ example nodes once per session; shared by the e2e
+    and wire-conformance suites."""
+    import shutil
+    import subprocess
+    if shutil.which("g++") is None:
+        pytest.skip("no g++ toolchain")
+    cpp_dir = os.path.join(REPO, "examples", "cpp")
+    subprocess.run(["make", "-C", cpp_dir], check=True,
+                   capture_output=True)
+    return os.path.join(cpp_dir, "bin")

@@ -3,24 +3,13 @@ make, then run them through the full harness (SURVEY §2.3 native
 components #1/#2)."""
 
 import os
-import shutil
-import subprocess
 
 import pytest
 
 from conftest import REPO
 from maelstrom_tpu.runner import run_test
 
-CPP_DIR = os.path.join(REPO, "examples", "cpp")
-
-
-@pytest.fixture(scope="module")
-def cpp_bins():
-    if shutil.which("g++") is None:
-        pytest.skip("no g++ toolchain")
-    subprocess.run(["make", "-C", CPP_DIR], check=True,
-                   capture_output=True)
-    return os.path.join(CPP_DIR, "bin")
+# cpp_bins fixture: session-scoped, in conftest.py
 
 
 def run(workload, binary, cpp_bins, **opts):

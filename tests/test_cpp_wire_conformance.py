@@ -1,0 +1,265 @@
+"""Executing wire conformance for the C++ SDK + nodes.
+
+Unlike the JS SDK (no runtime in this image — statically analyzed in
+test_js_wire_conformance.py), the C++ nodes COMPILE AND RUN here, so
+they get the stronger treatment: each binary is spawned directly and
+driven over its real STDIN/STDOUT — the injected-fake-stdio unit-test
+pattern of the reference's Go SDK tests
+(/root/reference/demo/go/node_test.go:19-37), with this harness playing
+BOTH the client and the built-in services a node calls. Replies are
+validated against the schema registry (reply type + field sets), plus
+the protocol edges: init handshake, in_reply_to plumbing, error 10 for
+unsupported types (VERDICT r3 next #10).
+
+No Go toolchain exists in this image (`which go` is empty), so the
+conditional Go-SDK half of that item does not apply.
+"""
+
+import json
+import os
+import queue
+import subprocess
+import threading
+
+import pytest
+
+import maelstrom_tpu.workloads  # noqa: F401 — populate the registry
+from maelstrom_tpu.core.schema import REGISTRY, Opt
+
+TIMEOUT = 10.0
+
+
+class FakeNet:
+    """Drive one node binary over its pipes: send messages as any src,
+    receive whatever the node emits (to us or to peers/services)."""
+
+    def __init__(self, path):
+        self.proc = subprocess.Popen(
+            [path], stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL, text=True, bufsize=1)
+        self.q = queue.Queue()
+        self.next_id = 100
+        t = threading.Thread(target=self._pump, daemon=True)
+        t.start()
+
+    def _pump(self):
+        for line in self.proc.stdout:
+            line = line.strip()
+            if line:
+                self.q.put(json.loads(line))
+
+    def send(self, src, dest, body):
+        msg = {"src": src, "dest": dest, "body": body}
+        self.proc.stdin.write(json.dumps(msg) + "\n")
+        self.proc.stdin.flush()
+
+    def rpc(self, src, dest, body):
+        body = dict(body)
+        self.next_id += 1
+        body["msg_id"] = self.next_id
+        self.send(src, dest, body)
+        return self.next_id
+
+    def recv(self, timeout=TIMEOUT):
+        return self.q.get(timeout=timeout)
+
+    def recv_reply(self, msg_id, service=None, timeout=TIMEOUT):
+        """Wait for the reply to ``msg_id``; meanwhile, answer any
+        service traffic the node emits via ``service(msg) -> body``."""
+        import time
+        deadline = time.monotonic() + timeout
+        while True:
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"no reply to msg_id {msg_id} within {timeout}s")
+            msg = self.recv(max(0.01, deadline - time.monotonic()))
+            if msg["body"].get("in_reply_to") == msg_id:
+                return msg
+            if service is not None:
+                reply = service(msg)
+                if reply is not None:
+                    reply = dict(reply)
+                    reply["in_reply_to"] = msg["body"]["msg_id"]
+                    self.send(msg["dest"], msg["src"], reply)
+
+    def pump(self, service, until, timeout=TIMEOUT):
+        """Answer node-emitted traffic via ``service`` until ``until()``
+        is true (e.g. gossip sent on a retry timer has shown up)."""
+        import time
+        deadline = time.monotonic() + timeout
+        while not until() and time.monotonic() < deadline:
+            try:
+                msg = self.recv(0.25)
+            except queue.Empty:
+                continue
+            reply = service(msg)
+            if reply is not None:
+                reply = dict(reply)
+                reply["in_reply_to"] = msg["body"]["msg_id"]
+                self.send(msg["dest"], msg["src"], reply)
+        assert until(), "pump timed out"
+
+    def init(self, node_id="n0", node_ids=("n0",)):
+        mid = self.rpc("c0", node_id, {
+            "type": "init", "node_id": node_id,
+            "node_ids": list(node_ids)})
+        reply = self.recv_reply(mid)
+        assert reply["body"]["type"] == "init_ok", reply
+        assert reply["src"] == node_id and reply["dest"] == "c0"
+        return reply
+
+    def close(self):
+        try:
+            self.proc.stdin.close()
+        except OSError:
+            pass
+        self.proc.terminate()
+        self.proc.wait(timeout=5)
+
+
+def check_against_registry(namespace, rpc_type, body):
+    """Reply body must be the registry's reply type and carry every
+    required response field (extra unknown fields are allowed only if
+    the schema says so — here: flag them)."""
+    spec = REGISTRY[namespace][rpc_type]
+    assert body["type"] == f"{rpc_type}_ok", body
+    required = {k for k in spec.response
+                if not isinstance(k, Opt)}
+    allowed = ({k.key if isinstance(k, Opt) else k
+                for k in spec.response}
+               | {"type", "in_reply_to", "msg_id"})
+    got = set(body)
+    assert required <= got, f"missing {required - got} in {body}"
+    assert got <= allowed, f"unexpected {got - allowed} in {body}"
+
+
+@pytest.fixture
+def net(request, cpp_bins):
+    nets = []
+
+    def make(binary, node_ids=("n0",)):
+        n = FakeNet(os.path.join(cpp_bins, binary))
+        nets.append(n)
+        n.init("n0", node_ids)
+        return n
+    yield make
+    for n in nets:
+        n.close()
+
+
+def test_cpp_echo_conformance(net):
+    n = net("echo")
+    mid = n.rpc("c1", "n0", {"type": "echo", "echo": "hello 42"})
+    reply = n.recv_reply(mid)
+    assert reply["body"]["echo"] == "hello 42"
+    check_against_registry("echo", "echo", reply["body"])
+
+
+def test_cpp_unsupported_type_is_error_10(net):
+    n = net("echo")
+    mid = n.rpc("c1", "n0", {"type": "frobnicate"})
+    reply = n.recv_reply(mid)
+    assert reply["body"]["type"] == "error", reply
+    assert reply["body"]["code"] == 10, reply
+
+
+def test_cpp_g_set_conformance(net):
+    n = net("g_set")
+    mid = n.rpc("c1", "n0", {"type": "add", "element": 7})
+    check_against_registry("g-set", "add", n.recv_reply(mid)["body"])
+    mid = n.rpc("c1", "n0", {"type": "read"})
+    body = n.recv_reply(mid)["body"]
+    check_against_registry("g-set", "read", body)
+    assert 7 in body["value"]
+
+
+def test_cpp_pn_counter_conformance(net):
+    n = net("pn_counter")
+    for delta in (5, -2):
+        mid = n.rpc("c1", "n0", {"type": "add", "delta": delta})
+        check_against_registry("pn-counter", "add",
+                               n.recv_reply(mid)["body"])
+    mid = n.rpc("c1", "n0", {"type": "read"})
+    body = n.recv_reply(mid)["body"]
+    check_against_registry("pn-counter", "read", body)
+    assert body["value"] == 3
+
+
+def test_cpp_broadcast_conformance(net):
+    n = net("broadcast", node_ids=("n0", "n1"))
+    mid = n.rpc("c1", "n0", {"type": "topology",
+                             "topology": {"n0": ["n1"], "n1": ["n0"]}})
+    check_against_registry("broadcast", "topology",
+                           n.recv_reply(mid)["body"])
+
+    peer_traffic = []
+
+    def peer_service(msg):
+        # n1: ack whatever gossip/broadcast arrives so retries stop
+        peer_traffic.append(msg)
+        t = msg["body"]["type"]
+        if "msg_id" in msg["body"]:
+            return {"type": f"{t}_ok"}
+        return None
+
+    mid = n.rpc("c1", "n0", {"type": "broadcast", "message": 123})
+    check_against_registry(
+        "broadcast", "broadcast",
+        n.recv_reply(mid, service=peer_service)["body"])
+    # gossip toward the peer rides the node's retry timer — pump until
+    # it shows up (and gets acked, stopping the retries)
+    n.pump(peer_service,
+           until=lambda: any(m["dest"] == "n1" for m in peer_traffic))
+    mid = n.rpc("c1", "n0", {"type": "read"})
+    body = n.recv_reply(mid, service=peer_service)["body"]
+    check_against_registry("broadcast", "read", body)
+    assert 123 in body["messages"]
+    gossip = [m for m in peer_traffic if m["dest"] == "n1"]
+    assert gossip and gossip[0]["body"]["message"] == 123
+
+
+def test_cpp_lin_kv_proxy_conformance(net):
+    """The SDK's service-KV client (the Rust crate's kv role): the proxy
+    must translate client read/write/cas into lin-kv service RPCs; the
+    fake service answers them."""
+    store = {}
+
+    def lin_kv(msg):
+        if msg["dest"] != "lin-kv":
+            return None
+        b = msg["body"]
+        if b["type"] == "read":
+            if b["key"] in store:
+                return {"type": "read_ok", "value": store[b["key"]]}
+            return {"type": "error", "code": 20,
+                    "text": "key does not exist"}
+        if b["type"] == "write":
+            store[b["key"]] = b["value"]
+            return {"type": "write_ok"}
+        if b["type"] == "cas":
+            cur = store.get(b["key"])
+            if cur is None and not b.get("create_if_not_exists"):
+                return {"type": "error", "code": 20, "text": "nope"}
+            if cur is not None and cur != b["from"]:
+                return {"type": "error", "code": 22,
+                        "text": f"expected {b['from']}, had {cur}"}
+            store[b["key"]] = b["to"]
+            return {"type": "cas_ok"}
+        return None
+
+    n = net("lin_kv_proxy")
+    mid = n.rpc("c1", "n0", {"type": "write", "key": 1, "value": 9})
+    check_against_registry(
+        "lin-kv", "write", n.recv_reply(mid, service=lin_kv)["body"])
+    mid = n.rpc("c1", "n0", {"type": "read", "key": 1})
+    body = n.recv_reply(mid, service=lin_kv)["body"]
+    check_against_registry("lin-kv", "read", body)
+    assert body["value"] == 9
+    mid = n.rpc("c1", "n0", {"type": "cas", "key": 1, "from": 9, "to": 10})
+    check_against_registry(
+        "lin-kv", "cas", n.recv_reply(mid, service=lin_kv)["body"])
+    assert store[1] == 10
+    # failing CAS surfaces the service's definite error to the client
+    mid = n.rpc("c1", "n0", {"type": "cas", "key": 1, "from": 9, "to": 11})
+    body = n.recv_reply(mid, service=lin_kv)["body"]
+    assert body["type"] == "error" and body["code"] == 22, body

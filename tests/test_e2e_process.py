@@ -97,7 +97,6 @@ def test_txn_rw_register_single_node_e2e():
     assert w["txn-count"] > 20
 
 
-@pytest.mark.slow
 def test_datomic_txn_multi_node_e2e():
     res = run("txn-list-append", "datomic_txn.py", node_count=3,
               time_limit=4.0, rate=20.0)
