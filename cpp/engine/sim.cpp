@@ -1,0 +1,655 @@
+// Native CPU simulation engine: the framework's C++ backend for hosts
+// without an accelerator.
+//
+// Role: the same simulated-cluster semantics as the JAX device runtime
+// (maelstrom_tpu/tpu/{netsim,runtime}.py + models/raft.py) — virtual
+// clock, per-instance mailbox pool with latency/loss/partitions,
+// fleets of Raft clusters driven by rate-limited clients, per-tick
+// invariants, recorded histories for the full checkers — implemented
+// as straight scalar loops, which on a CPU beat masked tensor ops by
+// an order of magnitude (no masked lanes, no materialized
+// intermediates). This is the "native runtime component" counterpart
+// of the reference's JVM engine (its simulated network, net.clj:79-247,
+// is likewise an in-process scalar engine); the JAX path remains the
+// TPU story.
+//
+// NOT bit-compatible with the JAX engine (different RNG: splitmix64
+// here, threefry there). The compatibility contract is semantic:
+// identical protocol behavior, histories checkable by the same WGL
+// checker, invariants with the same definitions, and the same
+// bug-injection mutants caught (tests/test_native_engine.py).
+//
+// C ABI for ctypes (no pybind11 in the image). Build:
+//   make -C cpp/engine   (g++ -O3 -shared -fPIC)
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+constexpr int32_t NIL = -1;
+
+// ---------------------------------------------------------------- rng
+struct Rng {
+  uint64_t s;
+  explicit Rng(uint64_t seed) : s(seed) {}
+  uint64_t next() {                       // splitmix64
+    uint64_t z = (s += 0x9e3779b97f4a7c15ull);
+    z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ull;
+    z = (z ^ (z >> 27)) * 0x94d049bb133111ebull;
+    return z ^ (z >> 31);
+  }
+  double uniform() { return (next() >> 11) * (1.0 / 9007199254740992.0); }
+  int32_t below(int32_t n) {
+    return n > 0 ? int32_t(next() % uint64_t(n)) : 0;
+  }
+};
+
+// ------------------------------------------------------------- config
+struct Cfg {
+  int64_t seed, n_instances, n_ticks, n_nodes, n_clients, record;
+  int64_t pool_slots, inbox_k;
+  double latency_mean;        // ticks (exponential)
+  double p_loss;
+  double rate;                // P(fire) per idle client per tick
+  int64_t timeout_ticks;
+  int64_t nemesis_enabled, nemesis_interval, stop_tick, final_start;
+  int64_t heartbeat, log_cap, elect_min, elect_jitter;
+  int64_t n_keys, n_vals;
+  int64_t flag_stale_read, flag_eager_commit, flag_no_term_guard;
+  int64_t max_events;         // per recorded instance
+};
+
+// ------------------------------------------------------------ message
+enum MType : int32_t {
+  M_NONE = 0, M_READ = 1, M_WRITE = 2, M_CAS = 3,
+  M_READ_OK = 4, M_WRITE_OK = 5, M_CAS_OK = 6,
+  M_REQ_VOTE = 7, M_VOTE_REPLY = 8, M_APPEND = 9, M_APPEND_REPLY = 10,
+  M_ERROR = 127
+};
+
+// body lanes: protocol lanes 0..5; AppendEntries carries its full
+// entry in lanes 6..11 (f, k, a, b, client, cmsg); client requests
+// keep their forward-hop counter in lane 12
+constexpr int BODY_LANES = 13;
+constexpr int L_ENTRY = 6;
+constexpr int L_HOPS = 12;
+
+struct Msg {
+  int32_t valid = 0;
+  int32_t src = 0, origin = 0, dest = 0;
+  int32_t type = 0;
+  int32_t msg_id = -1, reply_to = -1;
+  int32_t dtick = 0;
+  int32_t body[BODY_LANES] = {0};
+};
+
+// --------------------------------------------------------------- raft
+struct Entry {
+  int32_t f = 0, k = 0, a = 0, b = 0, client = -1, cmsg = -1;
+  bool operator==(const Entry& o) const {
+    return f == o.f && k == o.k && a == o.a && b == o.b &&
+           client == o.client && cmsg == o.cmsg;
+  }
+};
+
+struct Node {
+  int32_t term = 0, voted_for = -1, role = 0, votes = 0;
+  int32_t commit_idx = 0, last_applied = 0, log_len = 0;
+  int32_t leader_hint = -1;
+  int32_t election_deadline = 0, last_hb = 0;
+  int32_t truncated_committed = 0;
+  std::vector<int32_t> log_term;
+  std::vector<Entry> log_body;
+  std::vector<int32_t> kv;
+  std::vector<int32_t> next_idx, match_idx;
+};
+
+enum Etype : int32_t { EV_INVOKE = 1, EV_OK = 2, EV_FAIL = 3, EV_INFO = 4 };
+enum Fcode : int32_t { F_READ = 1, F_WRITE = 2, F_CAS = 3 };
+
+struct Client {
+  int32_t status = 0;           // 0 idle / 1 waiting
+  int32_t f = 0, k = 0, a = 0, b = 0;
+  int32_t msg_id = -1, next_msg_id = 0, invoked = 0;
+};
+
+struct Instance {
+  Rng rng;
+  std::vector<Msg> pool;
+  std::vector<Node> nodes;
+  std::vector<Client> clients;
+  std::vector<int8_t> side;     // nemesis halves assignment per node
+  int64_t cur_phase = -1;
+  int32_t violations = 0;
+  explicit Instance(uint64_t s) : rng(s) {}
+};
+
+struct Stats {
+  int64_t sent = 0, delivered = 0, dropped_partition = 0,
+          dropped_loss = 0, dropped_overflow = 0;
+};
+
+struct Recorder {
+  int32_t* out = nullptr;   // [cap * 7]: tick, client, etype, f, k, v, b
+  int64_t n = 0, cap = 0;
+  void event(int32_t tick, int32_t client, int32_t etype, int32_t f,
+             int32_t k, int32_t v, int32_t b) {
+    if (!out || n >= cap) return;
+    int32_t* p = out + n * 7;
+    p[0] = tick; p[1] = client; p[2] = etype; p[3] = f;
+    p[4] = k; p[5] = v; p[6] = b;
+    ++n;
+  }
+};
+
+struct Sim {
+  Cfg cfg;
+  std::vector<Instance> insts;
+  Stats stats;
+  std::vector<Recorder> recs;
+
+  int32_t last_log_term(const Node& nd) const {
+    return nd.log_len > 0 ? nd.log_term[nd.log_len - 1] : 0;
+  }
+
+  static void become_follower(Node& nd, int32_t term) {
+    nd.term = term; nd.role = 0; nd.voted_for = -1; nd.votes = 0;
+  }
+
+  void reset_election(Instance& in, Node& nd, int32_t t) const {
+    nd.election_deadline =
+        t + int32_t(cfg.elect_min) + in.rng.below(int32_t(cfg.elect_jitter));
+  }
+
+  bool blocked(const Instance& in, int32_t t, int32_t dest,
+               int32_t src) const {
+    if (!cfg.nemesis_enabled || t >= cfg.stop_tick) return false;
+    int32_t n = int32_t(cfg.n_nodes);
+    if (dest >= n || src >= n) return false;     // clients never cut
+    int64_t phase = t / cfg.nemesis_interval;
+    if (phase % 2 == 0) return false;            // heal phase
+    return in.side[dest] != in.side[src];
+  }
+
+  void refresh_nemesis(Instance& in, int32_t t) const {
+    if (!cfg.nemesis_enabled) return;
+    int64_t phase = t / cfg.nemesis_interval;
+    if (phase == in.cur_phase) return;
+    in.cur_phase = phase;
+    for (int32_t i = 0; i < cfg.n_nodes; ++i)
+      in.side[i] = int8_t(in.rng.below(2));
+  }
+
+  // enqueue with latency/loss (client edges at zero latency)
+  void send(Instance& in, int32_t t, Msg m) {
+    ++stats.sent;
+    bool client_edge = m.origin >= cfg.n_nodes || m.dest >= cfg.n_nodes;
+    int32_t lat = 0;
+    if (!client_edge && cfg.latency_mean > 0) {
+      double u = in.rng.uniform();
+      if (u < 1e-12) u = 1e-12;
+      lat = int32_t(-cfg.latency_mean * std::log(u));
+    }
+    if (cfg.p_loss > 0 && in.rng.uniform() < cfg.p_loss) {
+      ++stats.dropped_loss;
+      return;
+    }
+    m.dtick = t + 1 + lat;
+    for (auto& slot : in.pool) {
+      if (!slot.valid) { slot = m; slot.valid = 1; return; }
+    }
+    ++stats.dropped_overflow;
+  }
+
+  void node_reply(Instance& in, int32_t t, int32_t me, const Msg& req,
+                  int32_t type, int32_t b0, int32_t b1, int32_t b2) {
+    Msg r;
+    r.valid = 1; r.src = me; r.origin = me; r.dest = req.src;
+    r.type = type; r.reply_to = req.msg_id;
+    r.body[0] = b0; r.body[1] = b1; r.body[2] = b2;
+    send(in, t, r);
+  }
+
+  void handle(Instance& in, int32_t t, int32_t me, const Msg& m) {
+    Node& nd = in.nodes[me];
+    int32_t n = int32_t(cfg.n_nodes);
+    switch (m.type) {
+      case M_REQ_VOTE: {
+        int32_t c_term = m.body[0], c_len = m.body[1], c_llt = m.body[2];
+        if (c_term > nd.term) become_follower(nd, c_term);
+        int32_t my_llt = last_log_term(nd);
+        bool recent = c_llt > my_llt ||
+                      (c_llt == my_llt && c_len >= nd.log_len);
+        bool grant = c_term == nd.term && recent &&
+                     (nd.voted_for < 0 || nd.voted_for == m.src);
+        if (grant) { nd.voted_for = m.src; reset_election(in, nd, t); }
+        node_reply(in, t, me, m, M_VOTE_REPLY, nd.term, grant ? 1 : 0, 0);
+        break;
+      }
+      case M_VOTE_REPLY: {
+        if (m.body[0] > nd.term) { become_follower(nd, m.body[0]); break; }
+        if (nd.role == 1 && m.body[0] == nd.term && m.body[1] == 1) {
+          nd.votes |= 1 << m.src;
+          int32_t count = 1;  // self
+          for (int32_t i = 0; i < n; ++i) count += (nd.votes >> i) & 1;
+          if (count * 2 > n) {                        // won
+            nd.role = 2;
+            for (int32_t i = 0; i < n; ++i) {
+              nd.next_idx[i] = nd.log_len;
+              nd.match_idx[i] = 0;
+            }
+            nd.match_idx[me] = nd.log_len;
+            nd.last_hb = t - int32_t(cfg.heartbeat);
+          }
+        }
+        break;
+      }
+      case M_APPEND: {
+        int32_t l_term = m.body[0], prev = m.body[1], prev_term = m.body[2],
+                l_commit = m.body[3], has = m.body[4], e_term = m.body[5];
+        if (l_term > nd.term) become_follower(nd, l_term);
+        bool current = l_term == nd.term;
+        if (current) {
+          if (nd.role == 1) nd.role = 0;
+          nd.leader_hint = m.src;
+          reset_election(in, nd, t);
+        }
+        bool prev_ok = prev == 0 ||
+                       (prev <= nd.log_len &&
+                        nd.log_term[prev - 1] == prev_term);
+        bool accept = current && prev_ok && prev < cfg.log_cap;
+        int32_t match_ack = 0;
+        if (accept) {
+          if (has) {
+            bool same = prev < nd.log_len && nd.log_term[prev] == e_term;
+            if (!same) {
+              if (prev < nd.commit_idx) nd.truncated_committed = 1;
+              nd.log_term[prev] = e_term;
+              Entry e;
+              e.f = m.body[L_ENTRY + 0]; e.k = m.body[L_ENTRY + 1];
+              e.a = m.body[L_ENTRY + 2]; e.b = m.body[L_ENTRY + 3];
+              e.client = m.body[L_ENTRY + 4];
+              e.cmsg = m.body[L_ENTRY + 5];
+              nd.log_body[prev] = e;
+              nd.log_len = prev + 1;
+            } else {
+              nd.log_len = std::max(nd.log_len, prev + 1);
+            }
+            match_ack = prev + 1;
+          } else {
+            match_ack = prev;
+          }
+          nd.commit_idx = std::max(
+              nd.commit_idx, std::min(l_commit, match_ack));
+        }
+        node_reply(in, t, me, m, M_APPEND_REPLY, nd.term,
+                   accept ? 1 : 0, match_ack);
+        break;
+      }
+      case M_APPEND_REPLY: {
+        if (m.body[0] > nd.term) { become_follower(nd, m.body[0]); break; }
+        if (nd.role == 2 && m.body[0] == nd.term) {
+          int32_t peer = m.src;
+          if (m.body[1] == 1) {
+            nd.next_idx[peer] = std::max(nd.next_idx[peer], m.body[2]);
+            nd.match_idx[peer] = std::max(nd.match_idx[peer], m.body[2]);
+          } else {
+            nd.next_idx[peer] = std::max(nd.next_idx[peer] - 1, 0);
+          }
+        }
+        break;
+      }
+      case M_READ:
+        if (cfg.flag_stale_read) {   // BUG: serve reads from local state
+          int32_t k = std::min(std::max(m.body[0], 0),
+                               int32_t(cfg.n_keys) - 1);
+          node_reply(in, t, me, m, M_READ_OK, k, nd.kv[k], 0);
+          break;
+        }
+        [[fallthrough]];
+      case M_WRITE:
+      case M_CAS: {
+        bool leader = nd.role == 2;
+        if (leader && nd.log_len < cfg.log_cap) {
+          Entry e;
+          e.f = m.type == M_READ ? F_READ
+                : m.type == M_WRITE ? F_WRITE : F_CAS;
+          e.k = m.body[0]; e.a = m.body[1]; e.b = m.body[2];
+          e.client = m.src; e.cmsg = m.msg_id;
+          nd.log_term[nd.log_len] = nd.term;
+          nd.log_body[nd.log_len] = e;
+          nd.log_len += 1;
+          nd.match_idx[me] = nd.log_len;
+        } else if (!leader && nd.leader_hint >= 0 &&
+                   nd.leader_hint != me && m.body[L_HOPS] < 3) {
+          Msg f = m;                 // forward toward the leader
+          f.origin = me; f.dest = nd.leader_hint;
+          f.body[L_HOPS] += 1;
+          send(in, t, f);
+        } else {
+          node_reply(in, t, me, m, M_ERROR, 11, 0, 0);
+        }
+        break;
+      }
+      default:
+        break;
+    }
+  }
+
+  void node_tick(Instance& in, int32_t t, int32_t me) {
+    Node& nd = in.nodes[me];
+    int32_t n = int32_t(cfg.n_nodes);
+
+    // election timeout
+    if (nd.role != 2 && t >= nd.election_deadline) {
+      nd.term += 1; nd.role = 1; nd.voted_for = me; nd.votes = 0;
+      nd.leader_hint = -1;
+      nd.last_hb = t - int32_t(cfg.heartbeat);
+      reset_election(in, nd, t);
+    }
+
+    // leader: commit advance (median match, or BUG max-match)
+    if (nd.role == 2) {
+      nd.match_idx[me] = nd.log_len;
+      std::vector<int32_t> match(nd.match_idx);
+      int32_t maj;
+      if (cfg.flag_eager_commit) {
+        maj = *std::max_element(match.begin(), match.end());
+      } else {
+        std::sort(match.begin(), match.end());
+        maj = match[(n - 1) / 2];
+      }
+      bool guard_ok = true;
+      if (!cfg.flag_no_term_guard) {
+        guard_ok = maj > 0 && nd.log_term[maj - 1] == nd.term;
+      }
+      if (maj > nd.commit_idx && guard_ok) nd.commit_idx = maj;
+    }
+
+    // apply committed entries (leader replies to clients)
+    while (nd.last_applied < nd.commit_idx) {
+      const Entry& e = nd.log_body[nd.last_applied];
+      int32_t k = std::min(std::max(e.k, 0), int32_t(cfg.n_keys) - 1);
+      int32_t cur = nd.kv[k];
+      bool cas_ok = cur == e.a;
+      if (e.f == F_WRITE) nd.kv[k] = e.a;
+      else if (e.f == F_CAS && cas_ok) nd.kv[k] = e.b;
+      nd.last_applied += 1;
+      if (nd.role == 2 && e.client >= 0) {
+        Msg r;
+        r.valid = 1; r.src = me; r.origin = me; r.dest = e.client;
+        r.reply_to = e.cmsg;
+        if (e.f == F_READ) {
+          r.type = M_READ_OK; r.body[0] = k; r.body[1] = cur;
+        } else if (e.f == F_WRITE) {
+          r.type = M_WRITE_OK;
+        } else if (cas_ok) {
+          r.type = M_CAS_OK;
+        } else {
+          r.type = M_ERROR; r.body[0] = cur == NIL ? 20 : 22;
+        }
+        send(in, t, r);
+      }
+    }
+
+    // candidate solicitations / leader heartbeats
+    bool solicit = nd.role == 1 && t - nd.last_hb >= cfg.heartbeat;
+    bool hb = nd.role == 2 && t - nd.last_hb >= cfg.heartbeat;
+    if (solicit || hb) nd.last_hb = t;
+    if (solicit) {
+      for (int32_t p = 0; p < n; ++p) {
+        if (p == me) continue;
+        Msg v;
+        v.valid = 1; v.src = me; v.origin = me; v.dest = p;
+        v.type = M_REQ_VOTE;
+        v.body[0] = nd.term; v.body[1] = nd.log_len;
+        v.body[2] = last_log_term(nd);
+        send(in, t, v);
+      }
+    }
+    if (hb) {
+      for (int32_t p = 0; p < n; ++p) {
+        if (p == me) continue;
+        int32_t prev = nd.next_idx[p];
+        bool has = nd.log_len > prev && prev < cfg.log_cap;
+        Msg a;
+        a.valid = 1; a.src = me; a.origin = me; a.dest = p;
+        a.type = M_APPEND;
+        a.body[0] = nd.term;
+        a.body[1] = prev;
+        a.body[2] = prev > 0 ? nd.log_term[prev - 1] : 0;
+        a.body[3] = nd.commit_idx;
+        a.body[4] = has ? 1 : 0;
+        if (has) {
+          a.body[5] = nd.log_term[prev];
+          const Entry& e = nd.log_body[prev];
+          a.body[L_ENTRY + 0] = e.f; a.body[L_ENTRY + 1] = e.k;
+          a.body[L_ENTRY + 2] = e.a; a.body[L_ENTRY + 3] = e.b;
+          a.body[L_ENTRY + 4] = e.client;
+          a.body[L_ENTRY + 5] = e.cmsg;
+        }
+        send(in, t, a);
+      }
+    }
+  }
+
+  void check_invariants(Instance& in) const {
+    int32_t n = int32_t(cfg.n_nodes);
+    bool bad = false;
+    for (int32_t i = 0; i < n && !bad; ++i)
+      for (int32_t j = i + 1; j < n && !bad; ++j)
+        if (in.nodes[i].role == 2 && in.nodes[j].role == 2 &&
+            in.nodes[i].term == in.nodes[j].term)
+          bad = true;
+    if (!bad) {
+      int32_t ref = 0;
+      for (int32_t i = 1; i < n; ++i)
+        if (in.nodes[i].commit_idx > in.nodes[ref].commit_idx) ref = i;
+      const Node& r = in.nodes[ref];
+      for (int32_t i = 0; i < n && !bad; ++i) {
+        const Node& a = in.nodes[i];
+        for (int32_t x = 0; x < a.commit_idx && !bad; ++x)
+          if (a.log_term[x] != r.log_term[x] ||
+              !(a.log_body[x] == r.log_body[x]))
+            bad = true;
+      }
+    }
+    for (int32_t i = 0; i < n; ++i)
+      if (in.nodes[i].truncated_committed) bad = true;
+    if (bad) in.violations += 1;
+  }
+
+  void run() {
+    int64_t I = cfg.n_instances;
+    insts.reserve(I);
+    for (int64_t i = 0; i < I; ++i) {
+      insts.emplace_back(uint64_t(cfg.seed) * 0x9e3779b97f4a7c15ull +
+                         uint64_t(i) + 1);
+      Instance& in = insts.back();
+      in.pool.resize(cfg.pool_slots);
+      in.nodes.resize(cfg.n_nodes);
+      for (auto& nd : in.nodes) {
+        nd.log_term.assign(cfg.log_cap, 0);
+        nd.log_body.assign(cfg.log_cap, Entry{});
+        nd.kv.assign(cfg.n_keys, NIL);
+        nd.next_idx.assign(cfg.n_nodes, 0);
+        nd.match_idx.assign(cfg.n_nodes, 0);
+      }
+      for (int32_t m = 0; m < cfg.n_nodes; ++m)
+        reset_election(in, in.nodes[m], 0);
+      in.clients.resize(cfg.n_clients);
+      in.side.assign(cfg.n_nodes, 0);
+    }
+
+    std::vector<Msg> inbox;
+    inbox.reserve(size_t(cfg.inbox_k) * (cfg.n_nodes + cfg.n_clients));
+
+    for (int32_t t = 0; t < cfg.n_ticks; ++t) {
+      for (int64_t ii = 0; ii < I; ++ii) {
+        Instance& in = insts[ii];
+        Recorder* rec = ii < cfg.record ? &recs[ii] : nullptr;
+        refresh_nemesis(in, t);
+
+        // --- deliver: up to K per endpoint, oldest deadline first.
+        // Single pass over the pool collecting due slots, then a small
+        // per-destination selection — one slot scan instead of
+        // NT x K scans (the engine's hot loop).
+        inbox.clear();
+        int32_t due_slot[64];
+        int32_t n_due = 0;
+        for (int32_t s = 0; s < cfg.pool_slots; ++s) {
+          Msg& msg = in.pool[s];
+          if (!msg.valid || msg.dtick > t) continue;
+          if (blocked(in, t, msg.dest, msg.origin)) {
+            msg.valid = 0;
+            ++stats.dropped_partition;
+            continue;
+          }
+          if (n_due < 64) due_slot[n_due++] = s;
+        }
+        // stable oldest-first order among due slots (n_due is small)
+        std::sort(due_slot, due_slot + n_due,
+                  [&](int32_t x, int32_t y) {
+                    const Msg& a = in.pool[x];
+                    const Msg& b = in.pool[y];
+                    return a.dtick != b.dtick ? a.dtick < b.dtick : x < y;
+                  });
+        {
+          int32_t taken_for[64] = {0};
+          for (int32_t d = 0; d < n_due; ++d) {
+            Msg& msg = in.pool[due_slot[d]];
+            if (taken_for[msg.dest] >= cfg.inbox_k) continue;
+            ++taken_for[msg.dest];
+            inbox.push_back(msg);
+            msg.valid = 0;
+            ++stats.delivered;
+          }
+        }
+
+        // --- node handling + tick hooks
+        for (const Msg& m : inbox)
+          if (m.dest < cfg.n_nodes) handle(in, t, m.dest, m);
+        for (int32_t me = 0; me < cfg.n_nodes; ++me)
+          node_tick(in, t, me);
+
+        // --- clients: completions then timeouts then new ops
+        for (const Msg& m : inbox) {
+          if (m.dest < cfg.n_nodes) continue;
+          int32_t c = m.dest - int32_t(cfg.n_nodes);
+          Client& cl = in.clients[c];
+          if (cl.status != 1 || m.reply_to != cl.msg_id) continue;
+          int32_t etype, v;
+          if (m.type == M_ERROR) {
+            int32_t code = m.body[0];
+            bool definite = code == 1 || code == 10 || code == 11 ||
+                            code == 12 || code == 14 || code == 20 ||
+                            code == 21 || code == 22 || code == 30;
+            etype = definite ? EV_FAIL : EV_INFO;
+            v = cl.a;
+          } else {
+            etype = EV_OK;
+            v = m.type == M_READ_OK ? m.body[1] : cl.a;
+          }
+          if (rec) rec->event(t, c, etype, cl.f, cl.k, v, cl.b);
+          cl.status = 0;
+        }
+        for (int32_t c = 0; c < cfg.n_clients; ++c) {
+          Client& cl = in.clients[c];
+          if (cl.status == 1 && t - cl.invoked >= cfg.timeout_ticks) {
+            // reads are idempotent -> fail; others stay indefinite
+            int32_t etype = cl.f == F_READ ? EV_FAIL : EV_INFO;
+            if (rec) rec->event(t, c, etype, cl.f, cl.k, cl.a, cl.b);
+            cl.status = 0;
+          }
+          if (cl.status == 0 && in.rng.uniform() < cfg.rate) {
+            bool final_phase = t >= cfg.final_start;
+            double r = in.rng.uniform();
+            cl.f = final_phase ? F_READ
+                   : r < 1.0 / 3 ? F_READ
+                   : r < 2.0 / 3 ? F_WRITE : F_CAS;
+            cl.k = in.rng.below(int32_t(cfg.n_keys));
+            cl.a = in.rng.below(int32_t(cfg.n_vals));
+            cl.b = in.rng.below(int32_t(cfg.n_vals));
+            cl.msg_id = cl.next_msg_id++;
+            cl.invoked = t;
+            cl.status = 1;
+            if (rec) rec->event(t, c, EV_INVOKE, cl.f, cl.k,
+                                cl.f == F_READ ? NIL : cl.a, cl.b);
+            Msg q;
+            q.valid = 1;
+            q.src = int32_t(cfg.n_nodes) + c;
+            q.origin = q.src;
+            q.dest = in.rng.below(int32_t(cfg.n_nodes));
+            q.type = cl.f == F_READ ? M_READ
+                     : cl.f == F_WRITE ? M_WRITE : M_CAS;
+            q.msg_id = cl.msg_id;
+            q.body[0] = cl.k; q.body[1] = cl.a; q.body[2] = cl.b;
+            send(in, t, q);
+          }
+        }
+
+        check_invariants(in);
+      }
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// cfg layout (int64): seed, I, n_ticks, N, C, record, pool_slots,
+// inbox_k, latency_mean_milli, p_loss_micro, rate_micro, timeout_ticks,
+// nemesis_enabled, nemesis_interval, stop_tick, final_start, heartbeat,
+// log_cap, elect_min, elect_jitter, n_keys, n_vals, flag_stale_read,
+// flag_eager_commit, flag_no_term_guard, max_events
+int64_t native_sim_run(const int64_t* c, int64_t* stats_out,
+                       int32_t* violations_out, int32_t* events_out,
+                       int64_t* n_events_out) {
+  Cfg cfg;
+  cfg.seed = c[0]; cfg.n_instances = c[1]; cfg.n_ticks = c[2];
+  cfg.n_nodes = c[3]; cfg.n_clients = c[4]; cfg.record = c[5];
+  cfg.pool_slots = c[6]; cfg.inbox_k = c[7];
+  cfg.latency_mean = double(c[8]) / 1000.0;
+  cfg.p_loss = double(c[9]) / 1e6;
+  cfg.rate = double(c[10]) / 1e6;
+  cfg.timeout_ticks = c[11];
+  cfg.nemesis_enabled = c[12]; cfg.nemesis_interval = c[13];
+  cfg.stop_tick = c[14]; cfg.final_start = c[15];
+  cfg.heartbeat = c[16]; cfg.log_cap = c[17];
+  cfg.elect_min = c[18]; cfg.elect_jitter = c[19];
+  cfg.n_keys = c[20]; cfg.n_vals = c[21];
+  cfg.flag_stale_read = c[22]; cfg.flag_eager_commit = c[23];
+  cfg.flag_no_term_guard = c[24];
+  cfg.max_events = c[25];
+  if (cfg.nemesis_interval <= 0) cfg.nemesis_interval = 1;
+  if (cfg.n_nodes > 30) return -1;   // votes bitmask width
+  if (cfg.pool_slots > 64 || cfg.n_nodes + cfg.n_clients > 64)
+    return -1;                       // deliver scratch-array bounds
+
+  Sim sim;
+  sim.cfg = cfg;
+  sim.recs.resize(cfg.record);
+  for (int64_t i = 0; i < cfg.record; ++i) {
+    sim.recs[i].out = events_out + i * cfg.max_events * 7;
+    sim.recs[i].cap = cfg.max_events;
+  }
+  sim.run();
+
+  stats_out[0] = sim.stats.sent;
+  stats_out[1] = sim.stats.delivered;
+  stats_out[2] = sim.stats.dropped_partition;
+  stats_out[3] = sim.stats.dropped_loss;
+  stats_out[4] = sim.stats.dropped_overflow;
+  for (int64_t i = 0; i < cfg.n_instances; ++i)
+    violations_out[i] = sim.insts[i].violations;
+  for (int64_t i = 0; i < cfg.record; ++i)
+    n_events_out[i] = sim.recs[i].n;
+  return 0;
+}
+
+}  // extern "C"

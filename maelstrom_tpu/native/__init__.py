@@ -1,0 +1,1 @@
+from .engine import native_available, run_native_sim  # noqa: F401

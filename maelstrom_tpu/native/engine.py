@@ -1,0 +1,198 @@
+"""ctypes binding for the native CPU simulation engine (cpp/engine).
+
+The C++ scalar-loop counterpart of the JAX device runtime for hosts
+without an accelerator — same simulated-cluster semantics (virtual
+clock, mailbox pool with exponential latency / loss / halves
+partitions, Raft fleets, per-tick invariants, recorded histories), not
+bit-compatible (splitmix64 vs threefry). Built on first use when a C++
+toolchain is present; callers fall back to the JAX engine when the
+library is unavailable, so the native path is an accelerator, never a
+requirement (the pattern of checkers/native.py).
+
+Histories come back in the exact dict shape the workload checkers
+consume, so a native run is checkable by the same WGL linearizability
+checker as a device run.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "cpp", "engine")
+_LIB_PATH = os.path.join(_DIR, "libsim.so")
+
+_lib = None
+_lib_tried = False
+
+NIL = -1
+EV_INVOKE, EV_OK, EV_FAIL, EV_INFO = 1, 2, 3, 4
+F_NAMES = {1: "read", 2: "write", 3: "cas"}
+ETYPE_NAMES = {EV_OK: "ok", EV_FAIL: "fail", EV_INFO: "info"}
+
+
+def _load():
+    global _lib, _lib_tried
+    if _lib_tried:
+        return _lib
+    _lib_tried = True
+    if os.environ.get("MAELSTROM_TPU_NO_NATIVE") == "1":
+        return None
+    if not os.path.exists(_LIB_PATH):
+        try:
+            subprocess.run(["make", "-C", _DIR, "libsim.so"],
+                           capture_output=True, timeout=180, check=True)
+        except (OSError, subprocess.SubprocessError):
+            return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+        lib.native_sim_run.restype = ctypes.c_int64
+        lib.native_sim_run.argtypes = [
+            ctypes.POINTER(ctypes.c_int64),   # cfg
+            ctypes.POINTER(ctypes.c_int64),   # stats[5]
+            ctypes.POINTER(ctypes.c_int32),   # violations[I]
+            ctypes.POINTER(ctypes.c_int32),   # events[R*max_events*7]
+            ctypes.POINTER(ctypes.c_int64),   # n_events[R]
+        ]
+        _lib = lib
+    except OSError:
+        _lib = None
+    return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def _decode_history(ev: np.ndarray, ms_per_tick: float,
+                    final_start: int) -> List[dict]:
+    """events [n, 7] (tick, client, etype, f, k, v, b) -> the checker's
+    op-dict history (harness.events_to_histories's output shape)."""
+    hist: List[dict] = []
+    for tick, client, etype, f, k, v, b in ev:
+        fname = F_NAMES.get(int(f), "?")
+        if etype == EV_INVOKE:
+            if fname == "read":
+                value: Any = [int(k), None]
+            elif fname == "write":
+                value = [int(k), int(v)]
+            else:
+                value = [int(k), [int(v), int(b)]]
+            rec = {"process": int(client), "type": "invoke", "f": fname,
+                   "value": value}
+            if tick >= final_start:
+                rec["final"] = True
+        else:
+            if fname == "read":
+                value = [int(k), None if v == NIL else int(v)]
+            elif fname == "write":
+                value = [int(k), int(v)]
+            else:
+                value = [int(k), [int(v), int(b)]]
+            rec = {"process": int(client),
+                   "type": ETYPE_NAMES[int(etype)],
+                   "f": fname, "value": value}
+        rec["time"] = int(int(tick) * ms_per_tick * 1_000_000)
+        rec["index"] = len(hist)
+        hist.append(rec)
+    return hist
+
+
+def run_native_sim(opts: Optional[Dict[str, Any]] = None
+                   ) -> Optional[Dict[str, Any]]:
+    """Run the flagship Raft config on the native engine.
+
+    ``opts`` uses the TPU harness's option vocabulary (node_count,
+    concurrency, n_instances, time_limit, rate, latency, rpc_timeout,
+    nemesis, nemesis_interval, p_loss, recovery_time, record_instances,
+    seed, + mutant flags stale_read/eager_commit/no_term_guard).
+    Returns None when the native library is unavailable.
+    """
+    import time
+
+    lib = _load()
+    if lib is None:
+        return None
+    o = dict(
+        node_count=3, concurrency=6, n_instances=4096,
+        record_instances=8, pool_slots=16, inbox_k=1,
+        time_limit=4.0, rate=200.0, latency=5.0, rpc_timeout=1.0,
+        nemesis=["partition"], nemesis_interval=0.4, p_loss=0.05,
+        recovery_time=0.3, heartbeat=8, log_cap=64,
+        elect_min=30, elect_jitter=30, n_keys=5, n_vals=5,
+        ms_per_tick=1, seed=7,
+        stale_read=False, eager_commit=False, no_term_guard=False,
+    )
+    o.update(opts or {})
+    mpt = o["ms_per_tick"]
+    n_ticks = int(o["time_limit"] * 1000 / mpt)
+    recovery_ticks = min(int(o["recovery_time"] * 1000 / mpt),
+                         n_ticks // 2)
+    stop_tick = n_ticks - recovery_ticks
+    final_start = stop_tick + recovery_ticks // 2
+    I = int(o["n_instances"])
+    R = min(int(o["record_instances"]), I)
+    C = int(o["concurrency"])
+    rate = min(1.0, float(o["rate"]) / C / 1000.0 * mpt)
+    max_events = max(64, 2 * C * n_ticks // 4)
+
+    cfg = (ctypes.c_int64 * 26)(
+        int(o["seed"]), I, n_ticks, int(o["node_count"]), C, R,
+        int(o["pool_slots"]), int(o["inbox_k"]),
+        int(float(o["latency"]) / mpt * 1000),
+        int(float(o["p_loss"]) * 1e6),
+        int(rate * 1e6),
+        int(o["rpc_timeout"] * 1000 / mpt),
+        1 if "partition" in (o["nemesis"] or []) else 0,
+        max(1, int(o["nemesis_interval"] * 1000 / mpt)),
+        stop_tick, final_start,
+        int(o["heartbeat"]), int(o["log_cap"]),
+        int(o["elect_min"]), int(o["elect_jitter"]),
+        int(o["n_keys"]), int(o["n_vals"]),
+        1 if o["stale_read"] else 0,
+        1 if o["eager_commit"] else 0,
+        1 if o["no_term_guard"] else 0,
+        max_events)
+
+    stats = (ctypes.c_int64 * 5)()
+    violations = np.zeros(I, dtype=np.int32)
+    events = np.zeros((R, max_events, 7), dtype=np.int32)
+    n_events = np.zeros(R, dtype=np.int64)
+
+    t0 = time.monotonic()
+    rc = lib.native_sim_run(
+        cfg, stats,
+        violations.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        events.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        n_events.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+    wall = time.monotonic() - t0
+    if rc != 0:
+        return None
+
+    histories = [
+        _decode_history(events[i, :n_events[i]], mpt, final_start)
+        for i in range(R)]
+    return {
+        "engine": "native-cpp",
+        "stats": {
+            "sent": int(stats[0]), "delivered": int(stats[1]),
+            "dropped-partition": int(stats[2]),
+            "dropped-loss": int(stats[3]),
+            "dropped-overflow": int(stats[4]),
+        },
+        "violations": violations,
+        "violating-instances": int((violations > 0).sum()),
+        "histories": histories,
+        "events-truncated": bool((n_events >= max_events).any()),
+        "perf": {
+            "wall-s": wall,
+            "ticks": n_ticks,
+            "instances": I,
+            "msgs-per-sec": int(stats[1]) / wall if wall > 0 else 0.0,
+        },
+    }

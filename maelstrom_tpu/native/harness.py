@@ -1,0 +1,68 @@
+"""Harness around the native CPU engine: configure, run, check,
+aggregate — ``run_tpu_test``'s contract (tpu/harness.py) for the C++
+backend, so `--runtime native` produces the same results shape,
+checker verdicts, and store artifacts as a device run."""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+from .engine import native_available, run_native_sim
+
+
+def run_native_test(opts: Optional[Dict[str, Any]] = None
+                    ) -> Dict[str, Any]:
+    opts = dict(opts or {})
+    if not native_available():
+        raise RuntimeError(
+            "native engine unavailable (no C++ toolchain and no "
+            "prebuilt cpp/engine/libsim.so)")
+    t0 = time.monotonic()
+    res = run_native_sim(opts)
+    wall = time.monotonic() - t0
+
+    from ..checkers import compose_valid
+    from ..checkers.linearizable import linearizable_kv_checker
+
+    per_instance = []
+    for i, h in enumerate(res["histories"]):
+        try:
+            v = linearizable_kv_checker(h)
+        except Exception as e:   # checker blow-up is a result
+            v = {"valid?": False, "error": repr(e)}
+        v["instance"] = i
+        per_instance.append(v)
+    n_violating = res["violating-instances"]
+    overall = compose_valid(r.get("valid?", True) for r in per_instance)
+    if n_violating > 0:
+        overall = False
+    import numpy as np
+    violating_ids = np.nonzero(res["violations"])[0]
+
+    results = {
+        "valid?": overall,
+        "engine": "native-cpp",
+        "invariants": {
+            "violating-instances": n_violating,
+            "violating-instance-ids": violating_ids[:1024].tolist(),
+            "total-violation-ticks": int(res["violations"].sum()),
+        },
+        "instance-count": int(opts.get("n_instances", 4096)),
+        "checked-instances": len(per_instance),
+        "valid-instances": sum(1 for r in per_instance
+                               if r.get("valid?") in (True, "unknown")),
+        "instances": [r if r.get("valid?") is not True or i < 32
+                      else {"instance": i, "valid?": True}
+                      for i, r in enumerate(per_instance)],
+        "net": res["stats"],
+        "perf": {**res["perf"], "harness-wall-s": wall},
+    }
+    if res.get("events-truncated"):
+        results["events-truncated"] = True
+        results["valid?"] = "unknown" if overall is True else overall
+    if opts.get("store_root"):
+        from ..tpu.harness import _write_store
+        _write_store("lin-kv", opts["store_root"], results,
+                     res["histories"], suffix="-native")
+    return results
