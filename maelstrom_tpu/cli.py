@@ -51,7 +51,7 @@ def add_test_options(p: argparse.ArgumentParser):
                    help="workload name (echo, broadcast, g-set, "
                         "g-counter, pn-counter, lin-kv, unique-ids, ...)")
     p.add_argument("--bin", help="node binary (process runtime)")
-    p.add_argument("--runtime", choices=["process", "tpu"],
+    p.add_argument("--runtime", choices=["process", "tpu", "native"],
                    default="process")
     p.add_argument("--node-count", type=int, default=1)
     p.add_argument("--concurrency", default="1n",
@@ -158,6 +158,41 @@ def cmd_test(args) -> int:
             log_net_send=args.log_net_send,
             log_net_recv=args.log_net_recv, seed=args.seed,
             store_root=args.store))
+    elif args.runtime == "native":
+        # the C++ scalar engine (cpp/engine): lin-kv/Raft fleets on
+        # hosts without an accelerator — same checkers, same artifacts
+        if args.workload != "lin-kv":
+            print("error: --runtime native currently implements the "
+                  "lin-kv (Raft) workload only; use --runtime tpu for "
+                  "the full model set", file=sys.stderr)
+            return 2
+        if args.nemesis_schedule_file or args.nemesis_kind == "scripted":
+            print("error: the native engine has no scripted nemesis; "
+                  "use --runtime tpu for constructed schedules",
+                  file=sys.stderr)
+            return 2
+        for val, name, default in (
+                (args.nemesis_kind, "--nemesis-kind", "random-halves"),
+                (args.availability, "--availability", None),
+                (args.consistency_models, "--consistency-models", None),
+                (args.latency_dist, "--latency-dist", "exponential")):
+            if val != default:
+                print(f"note: {name} has no effect on the native "
+                      f"runtime (random-halves partitions, exponential "
+                      f"latency, WGL checking only)", file=sys.stderr)
+        from .native.harness import run_native_test
+        results = run_native_test(dict(
+            node_count=node_count, concurrency=concurrency,
+            rate=args.rate, time_limit=args.time_limit,
+            latency=args.latency, p_loss=args.p_loss,
+            nemesis=args.nemesis,
+            nemesis_interval=args.nemesis_interval,
+            n_instances=args.n_instances,
+            record_instances=args.record_instances,
+            seed=args.seed if args.seed is not None else 0,
+            store_root=args.store,
+            **({} if args.recovery_time is None
+               else {"recovery_time": args.recovery_time})))
     else:
         from .models import get_model
         from .tpu.harness import run_tpu_test
@@ -377,6 +412,10 @@ def _resolve_history_paths(path: str, workload_arg, verb: str):
         inferred = os.path.basename(os.path.dirname(path))
         if inferred.endswith("-tpu"):
             inferred, tpu_store = inferred[:-len("-tpu")], True
+        elif inferred.endswith("-native"):
+            # native-engine stores share the TPU store shape (one
+            # history per recorded instance, no node logs)
+            inferred, tpu_store = inferred[:-len("-native")], True
     else:
         paths, inferred = [path], None
     workload_name = workload_arg or inferred

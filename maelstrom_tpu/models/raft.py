@@ -440,9 +440,13 @@ class RaftModel(Model):
             # suspected-dead leader: stop proxying to it
             leader_hint=jnp.where(timeout, -1, row.leader_hint),
         )
-        row = jax.tree.map(
-            lambda a, b: jnp.where(timeout, b, a), row,
-            self._reset_election(row, t, k_jit))
+        # _reset_election only moves the deadline — select just that
+        # field rather than a full-pytree where (which would lean on
+        # XLA's select(p, x, x) simplification to avoid copying logs)
+        row = row._replace(election_deadline=jnp.where(
+            timeout,
+            self._reset_election(row, t, k_jit).election_deadline,
+            row.election_deadline))
 
         # 2) leader: advance commit to the median match index (current
         # term only), then apply
@@ -589,16 +593,22 @@ class RaftModel(Model):
                 & ~jnp.eye(n, dtype=bool))
         two_leaders = jnp.any(pair)
 
+        # Committed-prefix agreement, checked against the max-commit node
+        # instead of all pairs: equivalent detection (if i and j each
+        # match the reference on their own committed prefixes, they match
+        # each other on the min; conversely any i/ref mismatch IS a pair
+        # mismatch since ref's commit is the max) at N comparisons
+        # instead of N^2 — this was the tick's single largest
+        # intermediate ([N, N, log_cap, entry_lanes] per instance).
         commit = node_state.commit_idx                     # [N]
-        m = jnp.minimum(commit[:, None], commit[None, :])  # [N, N]
-        in_prefix = (jnp.arange(self.log_cap)[None, None, :]
-                     < m[:, :, None])
-        lt = node_state.log_term                           # [N, LOGN]
-        term_diff = (lt[:, None, :] != lt[None, :, :]) & in_prefix
-        lb = node_state.log_body                           # [N, LOGN, E]
-        body_diff = jnp.any(lb[:, None] != lb[None, :], axis=-1) \
-            & in_prefix
-        log_mismatch = jnp.any(term_diff | body_diff)
+        ref = jnp.argmax(commit)
+        ref_lt = node_state.log_term[ref]                  # [LOGN]
+        ref_lb = node_state.log_body[ref]                  # [LOGN, E]
+        in_prefix = (jnp.arange(self.log_cap)[None, :]
+                     < commit[:, None])                    # [N, LOGN]
+        diff = ((node_state.log_term != ref_lt[None, :])
+                | jnp.any(node_state.log_body != ref_lb[None], axis=-1))
+        log_mismatch = jnp.any(diff & in_prefix)
         overwrote = jnp.any(node_state.truncated_committed > 0)
         return two_leaders | log_mismatch | overwrote
 

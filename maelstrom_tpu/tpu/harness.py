@@ -296,15 +296,16 @@ def replay_instances(model: Model, opts: Dict[str, Any],
 
 
 def _write_store(name: str, store_root: str, results: Dict[str, Any],
-                 histories, journal=None, funnel=None) -> None:
-    """Store artifacts for a TPU run: results.json + one history per
-    recorded instance (the store layout of doc/results.md, minus node
-    logs — there are no node processes), plus the Lamport diagram when a
-    per-message journal was recorded."""
+                 histories, journal=None, funnel=None,
+                 suffix: str = "-tpu") -> None:
+    """Store artifacts for a TPU (or native-engine) run: results.json +
+    one history per recorded instance (the store layout of
+    doc/results.md, minus node logs — there are no node processes),
+    plus the Lamport diagram when a per-message journal was recorded."""
     import json
     from datetime import datetime
     ts = datetime.now().strftime("%Y%m%d-%H%M%S-%f")
-    d = os.path.join(store_root, f"{name}-tpu", ts)
+    d = os.path.join(store_root, f"{name}{suffix}", ts)
     os.makedirs(d, exist_ok=True)
     if journal is not None:
         from ..net.viz import plot_lamport

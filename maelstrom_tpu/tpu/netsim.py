@@ -112,7 +112,15 @@ def deliver(pool: jnp.ndarray, partitions: jnp.ndarray, t: jnp.ndarray,
     slot_order = jnp.arange(S, dtype=jnp.int32)
     age_rank = ((1 << 20) - pool[:, wire.DTICK]) * S
     prio = jnp.where(cand, age_rank[None, :] + (S - slot_order)[None, :], 0)
-    topv, topi = jax.lax.top_k(prio, cfg.inbox_k)       # [NT, K]
+    if cfg.inbox_k == 1:
+        # K=1 (the headline config): argmax beats top_k's general sort;
+        # identical selection incl. tie-breaking (prio values are unique
+        # by construction — the slot-index term — and both pick the
+        # first maximum)
+        topi = jnp.argmax(prio, axis=1)[:, None]         # [NT, 1]
+        topv = jnp.take_along_axis(prio, topi, axis=1)
+    else:
+        topv, topi = jax.lax.top_k(prio, cfg.inbox_k)    # [NT, K]
     take = topv > 0
     inbox = jnp.where(take[:, :, None], pool[topi], 0)
 

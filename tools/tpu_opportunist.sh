@@ -133,6 +133,22 @@ while true; do
         commit_artifacts artifacts/xval_tpu_32k.json "$HEALTH_LOG"
       fi
     fi
+    if [ ! -f artifacts/scaling_tpu.jsonl ]; then
+      echo "$(date +%s) scaling: starting ladder" >> "$HEALTH_LOG"
+      if timeout 600 python tools/tpu_scaling.py \
+           4096 16384 32768 65536 98304 \
+           > artifacts/scaling_tpu.jsonl.tmp \
+           2>>/tmp/tpu_scaling_err.log \
+         && [ -s artifacts/scaling_tpu.jsonl.tmp ]; then
+        mv artifacts/scaling_tpu.jsonl.tmp artifacts/scaling_tpu.jsonl
+        echo "$(date +%s) scaling: ladder captured" >> "$HEALTH_LOG"
+        commit_artifacts artifacts/scaling_tpu.jsonl "$HEALTH_LOG"
+      elif [ -s artifacts/scaling_tpu.jsonl.tmp ]; then
+        # partial ladder (tunnel died mid-run) still beats nothing
+        mv artifacts/scaling_tpu.jsonl.tmp artifacts/scaling_tpu_partial.jsonl
+        commit_artifacts artifacts/scaling_tpu_partial.jsonl "$HEALTH_LOG"
+      fi
+    fi
   fi
   [ "$state" != "$last_state" ] && last_state="$state"
   sleep "$SLEEP_S"

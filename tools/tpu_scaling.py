@@ -8,9 +8,15 @@ producing the BASELINE north-star evidence (100k instances / >=1M
 msgs/s) the moment a healthy TPU is attached; also runs on CPU for
 regression tracking (small ladder).
 
+The horizon is issued in chunked dispatches (single multi-minute XLA
+dispatches fault the TPU tunnel — see bench.py), so the 32k+ rungs are
+tunnel-safe.
+
 Usage:
     python tools/tpu_scaling.py                 # auto ladder by platform
     python tools/tpu_scaling.py 512 4096 16384  # explicit ladder
+Env: SCALING_K (inbox_k, default 1), SCALING_POOL (pool_slots, default
+16), SCALING_TICKS (default 1000), SCALING_CHUNK (default 100).
 """
 
 from __future__ import annotations
@@ -26,10 +32,12 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 def main() -> None:
     import jax
+    import jax.numpy as jnp
+    from functools import lru_cache, partial
 
     from maelstrom_tpu.models.raft import RaftModel
     from maelstrom_tpu.tpu.harness import make_sim_config
-    from maelstrom_tpu.tpu.runtime import init_carry, run_sim
+    from maelstrom_tpu.tpu.runtime import init_carry, make_tick_fn
 
     platform = jax.devices()[0].platform
     if len(sys.argv) > 1:
@@ -37,31 +45,65 @@ def main() -> None:
     elif platform == "cpu":
         ladder = [64, 256, 1024]
     else:
-        ladder = [512, 2048, 8192, 32768, 65536, 98304]
+        ladder = [4096, 16384, 32768, 65536, 98304]
+
+    inbox_k = int(os.environ.get("SCALING_K", 1))
+    pool_slots = int(os.environ.get("SCALING_POOL", 16))
+    n_ticks = int(os.environ.get("SCALING_TICKS", 1000))
+    chunk = int(os.environ.get("SCALING_CHUNK", 100))
+    # the timed window must reuse the warm-up's compile: keep >= 2
+    # chunks and make the chunk length divide the horizon
+    chunk = min(chunk, max(1, n_ticks // 2))
+    if n_ticks % chunk:
+        for c in range(chunk, max(chunk // 2, 1), -1):
+            if n_ticks % c == 0:
+                chunk = c
+                break
 
     model = RaftModel(n_nodes_hint=3, log_cap=64, heartbeat=8)
     for n in ladder:
         opts = dict(node_count=3, concurrency=6, n_instances=n,
-                    record_instances=1, inbox_k=3, pool_slots=48,
-                    time_limit=1.0, rate=200.0, latency=5.0,
+                    record_instances=1, inbox_k=inbox_k,
+                    pool_slots=pool_slots,
+                    time_limit=n_ticks / 1000.0, rate=200.0, latency=5.0,
                     rpc_timeout=1.0, nemesis=["partition"],
                     nemesis_interval=0.4, p_loss=0.05,
                     recovery_time=0.3, seed=7)
         sim = make_sim_config(model, opts)
         params = model.make_params(3)
-        carry0 = init_carry(model, sim, 0, params)
-        bpi = sum(x.nbytes for x in jax.tree.leaves(carry0)) // n
-        carry, _ = run_sim(model, sim, 7, params)
-        jax.block_until_ready(carry.stats.delivered)
+        tick_fn = make_tick_fn(model, sim, params)
+        carry = jax.tree.map(lambda x: x.copy(),
+                             init_carry(model, sim, 7, params))
+        bpi = sum(x.nbytes for x in jax.tree.leaves(carry)) // n
+
+        @lru_cache(maxsize=None)
+        def chunk_fn(length, _tick=tick_fn):
+            @partial(jax.jit, donate_argnums=0)
+            def run(c, t0):
+                return jax.lax.scan(
+                    _tick, c,
+                    t0 + jnp.arange(length, dtype=jnp.int32))[0]
+            return run
+
+        # warm-up chunk compiles; timed window covers the rest
+        t = min(chunk, sim.n_ticks)
+        carry = chunk_fn(t)(carry, jnp.int32(0))
+        d0 = int(carry.stats.delivered)     # blocks
         t0 = time.monotonic()
-        carry, _ = run_sim(model, sim, 8, params)
-        jax.block_until_ready(carry.stats.delivered)
+        while t < sim.n_ticks:
+            use = min(chunk, sim.n_ticks - t)
+            carry = chunk_fn(use)(carry, jnp.int32(t))
+            t += use
+        d = int(carry.stats.delivered)      # blocks
         wall = time.monotonic() - t0
-        d = int(carry.stats.delivered)
+        timed_ticks = t - min(chunk, sim.n_ticks)
         print(json.dumps({
             "platform": platform, "instances": n,
-            "msgs_per_sec": round(d / wall, 1),
-            "wall_per_tick_ms": round(wall / sim.n_ticks * 1000, 3),
+            "inbox_k": inbox_k, "pool_slots": pool_slots,
+            "msgs_per_sec": round((d - d0) / wall, 1),
+            "wall_per_tick_ms": round(wall / max(1, timed_ticks) * 1000,
+                                      3),
+            "sim_ticks": t,
             "bytes_per_instance": int(bpi),
             "dropped_overflow": int(carry.stats.dropped_overflow),
         }), flush=True)
