@@ -26,6 +26,7 @@
 #include <cmath>
 #include <cstdint>
 #include <cstring>
+#include <thread>
 #include <vector>
 
 namespace {
@@ -117,6 +118,11 @@ struct Client {
   int32_t msg_id = -1, next_msg_id = 0, invoked = 0;
 };
 
+struct Stats {
+  int64_t sent = 0, delivered = 0, dropped_partition = 0,
+          dropped_loss = 0, dropped_overflow = 0;
+};
+
 struct Instance {
   Rng rng;
   std::vector<Msg> pool;
@@ -125,12 +131,8 @@ struct Instance {
   std::vector<int8_t> side;     // nemesis halves assignment per node
   int64_t cur_phase = -1;
   int32_t violations = 0;
+  Stats stats;                  // per-instance: threads never share
   explicit Instance(uint64_t s) : rng(s) {}
-};
-
-struct Stats {
-  int64_t sent = 0, delivered = 0, dropped_partition = 0,
-          dropped_loss = 0, dropped_overflow = 0;
 };
 
 struct Recorder {
@@ -186,7 +188,7 @@ struct Sim {
 
   // enqueue with latency/loss (client edges at zero latency)
   void send(Instance& in, int32_t t, Msg m) {
-    ++stats.sent;
+    ++in.stats.sent;
     bool client_edge = m.origin >= cfg.n_nodes || m.dest >= cfg.n_nodes;
     int32_t lat = 0;
     if (!client_edge && cfg.latency_mean > 0) {
@@ -195,14 +197,14 @@ struct Sim {
       lat = int32_t(-cfg.latency_mean * std::log(u));
     }
     if (cfg.p_loss > 0 && in.rng.uniform() < cfg.p_loss) {
-      ++stats.dropped_loss;
+      ++in.stats.dropped_loss;
       return;
     }
     m.dtick = t + 1 + lat;
     for (auto& slot : in.pool) {
       if (!slot.valid) { slot = m; slot.valid = 1; return; }
     }
-    ++stats.dropped_overflow;
+    ++in.stats.dropped_overflow;
   }
 
   void node_reply(Instance& in, int32_t t, int32_t me, const Msg& req,
@@ -463,7 +465,7 @@ struct Sim {
     if (bad) in.violations += 1;
   }
 
-  void run() {
+  void init_instances() {
     int64_t I = cfg.n_instances;
     insts.reserve(I);
     for (int64_t i = 0; i < I; ++i) {
@@ -484,117 +486,153 @@ struct Sim {
       in.clients.resize(cfg.n_clients);
       in.side.assign(cfg.n_nodes, 0);
     }
+  }
 
+  // Instances never interact, so a worker owns a contiguous block of
+  // them end-to-end (all ticks) with its own Stats — per-instance
+  // trajectories are a pure function of (seed, id) and therefore
+  // IDENTICAL at any thread count; only the stats summation order
+  // differs, and sums commute.
+  void run_range(int64_t lo, int64_t hi) {
     std::vector<Msg> inbox;
     inbox.reserve(size_t(cfg.inbox_k) * (cfg.n_nodes + cfg.n_clients));
 
-    for (int32_t t = 0; t < cfg.n_ticks; ++t) {
-      for (int64_t ii = 0; ii < I; ++ii) {
-        Instance& in = insts[ii];
-        Recorder* rec = ii < cfg.record ? &recs[ii] : nullptr;
-        refresh_nemesis(in, t);
-
-        // --- deliver: up to K per endpoint, oldest deadline first.
-        // Single pass over the pool collecting due slots, then a small
-        // per-destination selection — one slot scan instead of
-        // NT x K scans (the engine's hot loop).
-        inbox.clear();
-        int32_t due_slot[64];
-        int32_t n_due = 0;
-        for (int32_t s = 0; s < cfg.pool_slots; ++s) {
-          Msg& msg = in.pool[s];
-          if (!msg.valid || msg.dtick > t) continue;
-          if (blocked(in, t, msg.dest, msg.origin)) {
-            msg.valid = 0;
-            ++stats.dropped_partition;
-            continue;
-          }
-          if (n_due < 64) due_slot[n_due++] = s;
-        }
-        // stable oldest-first order among due slots (n_due is small)
-        std::sort(due_slot, due_slot + n_due,
-                  [&](int32_t x, int32_t y) {
-                    const Msg& a = in.pool[x];
-                    const Msg& b = in.pool[y];
-                    return a.dtick != b.dtick ? a.dtick < b.dtick : x < y;
-                  });
-        {
-          int32_t taken_for[64] = {0};
-          for (int32_t d = 0; d < n_due; ++d) {
-            Msg& msg = in.pool[due_slot[d]];
-            if (taken_for[msg.dest] >= cfg.inbox_k) continue;
-            ++taken_for[msg.dest];
-            inbox.push_back(msg);
-            msg.valid = 0;
-            ++stats.delivered;
-          }
-        }
-
-        // --- node handling + tick hooks
-        for (const Msg& m : inbox)
-          if (m.dest < cfg.n_nodes) handle(in, t, m.dest, m);
-        for (int32_t me = 0; me < cfg.n_nodes; ++me)
-          node_tick(in, t, me);
-
-        // --- clients: completions then timeouts then new ops
-        for (const Msg& m : inbox) {
-          if (m.dest < cfg.n_nodes) continue;
-          int32_t c = m.dest - int32_t(cfg.n_nodes);
-          Client& cl = in.clients[c];
-          if (cl.status != 1 || m.reply_to != cl.msg_id) continue;
-          int32_t etype, v;
-          if (m.type == M_ERROR) {
-            int32_t code = m.body[0];
-            bool definite = code == 1 || code == 10 || code == 11 ||
-                            code == 12 || code == 14 || code == 20 ||
-                            code == 21 || code == 22 || code == 30;
-            etype = definite ? EV_FAIL : EV_INFO;
-            v = cl.a;
-          } else {
-            etype = EV_OK;
-            v = m.type == M_READ_OK ? m.body[1] : cl.a;
-          }
-          if (rec) rec->event(t, c, etype, cl.f, cl.k, v, cl.b);
-          cl.status = 0;
-        }
-        for (int32_t c = 0; c < cfg.n_clients; ++c) {
-          Client& cl = in.clients[c];
-          if (cl.status == 1 && t - cl.invoked >= cfg.timeout_ticks) {
-            // reads are idempotent -> fail; others stay indefinite
-            int32_t etype = cl.f == F_READ ? EV_FAIL : EV_INFO;
-            if (rec) rec->event(t, c, etype, cl.f, cl.k, cl.a, cl.b);
-            cl.status = 0;
-          }
-          if (cl.status == 0 && in.rng.uniform() < cfg.rate) {
-            bool final_phase = t >= cfg.final_start;
-            double r = in.rng.uniform();
-            cl.f = final_phase ? F_READ
-                   : r < 1.0 / 3 ? F_READ
-                   : r < 2.0 / 3 ? F_WRITE : F_CAS;
-            cl.k = in.rng.below(int32_t(cfg.n_keys));
-            cl.a = in.rng.below(int32_t(cfg.n_vals));
-            cl.b = in.rng.below(int32_t(cfg.n_vals));
-            cl.msg_id = cl.next_msg_id++;
-            cl.invoked = t;
-            cl.status = 1;
-            if (rec) rec->event(t, c, EV_INVOKE, cl.f, cl.k,
-                                cl.f == F_READ ? NIL : cl.a, cl.b);
-            Msg q;
-            q.valid = 1;
-            q.src = int32_t(cfg.n_nodes) + c;
-            q.origin = q.src;
-            q.dest = in.rng.below(int32_t(cfg.n_nodes));
-            q.type = cl.f == F_READ ? M_READ
-                     : cl.f == F_WRITE ? M_WRITE : M_CAS;
-            q.msg_id = cl.msg_id;
-            q.body[0] = cl.k; q.body[1] = cl.a; q.body[2] = cl.b;
-            send(in, t, q);
-          }
-        }
-
-        check_invariants(in);
+    for (int64_t ii = lo; ii < hi; ++ii) {
+      Instance& in = insts[ii];
+      Recorder* rec = ii < cfg.record ? &recs[ii] : nullptr;
+      for (int32_t t = 0; t < cfg.n_ticks; ++t) {
+        tick_instance(in, t, rec, inbox);
       }
     }
+  }
+
+  void run(int64_t n_threads) {
+    init_instances();
+    int64_t I = cfg.n_instances;
+    if (n_threads <= 1 || I < 2 * n_threads) {
+      run_range(0, I);
+    } else {
+      std::vector<std::thread> workers;
+      int64_t per = (I + n_threads - 1) / n_threads;
+      for (int64_t w = 0; w < n_threads; ++w) {
+        int64_t lo = w * per, hi = std::min(I, lo + per);
+        if (lo >= hi) break;
+        workers.emplace_back([this, lo, hi] { run_range(lo, hi); });
+      }
+      for (auto& th : workers) th.join();
+    }
+    for (const auto& in : insts) {
+      stats.sent += in.stats.sent;
+      stats.delivered += in.stats.delivered;
+      stats.dropped_partition += in.stats.dropped_partition;
+      stats.dropped_loss += in.stats.dropped_loss;
+      stats.dropped_overflow += in.stats.dropped_overflow;
+    }
+  }
+
+  void tick_instance(Instance& in, int32_t t, Recorder* rec,
+                 std::vector<Msg>& inbox) {
+    refresh_nemesis(in, t);
+
+    // --- deliver: up to K per endpoint, oldest deadline first.
+    // Single pass over the pool collecting due slots, then a small
+    // per-destination selection — one slot scan instead of
+    // NT x K scans (the engine's hot loop).
+    inbox.clear();
+    int32_t due_slot[64];
+    int32_t n_due = 0;
+    for (int32_t s = 0; s < cfg.pool_slots; ++s) {
+      Msg& msg = in.pool[s];
+      if (!msg.valid || msg.dtick > t) continue;
+      if (blocked(in, t, msg.dest, msg.origin)) {
+        msg.valid = 0;
+        ++in.stats.dropped_partition;
+        continue;
+      }
+      if (n_due < 64) due_slot[n_due++] = s;
+    }
+    // stable oldest-first order among due slots (n_due is small)
+    std::sort(due_slot, due_slot + n_due,
+              [&](int32_t x, int32_t y) {
+                const Msg& a = in.pool[x];
+                const Msg& b = in.pool[y];
+                return a.dtick != b.dtick ? a.dtick < b.dtick : x < y;
+              });
+    {
+      int32_t taken_for[64] = {0};
+      for (int32_t d = 0; d < n_due; ++d) {
+        Msg& msg = in.pool[due_slot[d]];
+        if (taken_for[msg.dest] >= cfg.inbox_k) continue;
+        ++taken_for[msg.dest];
+        inbox.push_back(msg);
+        msg.valid = 0;
+        ++in.stats.delivered;
+      }
+    }
+
+    // --- node handling + tick hooks
+    for (const Msg& m : inbox)
+      if (m.dest < cfg.n_nodes) handle(in, t, m.dest, m);
+    for (int32_t me = 0; me < cfg.n_nodes; ++me)
+      node_tick(in, t, me);
+
+    // --- clients: completions then timeouts then new ops
+    for (const Msg& m : inbox) {
+      if (m.dest < cfg.n_nodes) continue;
+      int32_t c = m.dest - int32_t(cfg.n_nodes);
+      Client& cl = in.clients[c];
+      if (cl.status != 1 || m.reply_to != cl.msg_id) continue;
+      int32_t etype, v;
+      if (m.type == M_ERROR) {
+        int32_t code = m.body[0];
+        bool definite = code == 1 || code == 10 || code == 11 ||
+                        code == 12 || code == 14 || code == 20 ||
+                        code == 21 || code == 22 || code == 30;
+        etype = definite ? EV_FAIL : EV_INFO;
+        v = cl.a;
+      } else {
+        etype = EV_OK;
+        v = m.type == M_READ_OK ? m.body[1] : cl.a;
+      }
+      if (rec) rec->event(t, c, etype, cl.f, cl.k, v, cl.b);
+      cl.status = 0;
+    }
+    for (int32_t c = 0; c < cfg.n_clients; ++c) {
+      Client& cl = in.clients[c];
+      if (cl.status == 1 && t - cl.invoked >= cfg.timeout_ticks) {
+        // reads are idempotent -> fail; others stay indefinite
+        int32_t etype = cl.f == F_READ ? EV_FAIL : EV_INFO;
+        if (rec) rec->event(t, c, etype, cl.f, cl.k, cl.a, cl.b);
+        cl.status = 0;
+      }
+      if (cl.status == 0 && in.rng.uniform() < cfg.rate) {
+        bool final_phase = t >= cfg.final_start;
+        double r = in.rng.uniform();
+        cl.f = final_phase ? F_READ
+               : r < 1.0 / 3 ? F_READ
+               : r < 2.0 / 3 ? F_WRITE : F_CAS;
+        cl.k = in.rng.below(int32_t(cfg.n_keys));
+        cl.a = in.rng.below(int32_t(cfg.n_vals));
+        cl.b = in.rng.below(int32_t(cfg.n_vals));
+        cl.msg_id = cl.next_msg_id++;
+        cl.invoked = t;
+        cl.status = 1;
+        if (rec) rec->event(t, c, EV_INVOKE, cl.f, cl.k,
+                            cl.f == F_READ ? NIL : cl.a, cl.b);
+        Msg q;
+        q.valid = 1;
+        q.src = int32_t(cfg.n_nodes) + c;
+        q.origin = q.src;
+        q.dest = in.rng.below(int32_t(cfg.n_nodes));
+        q.type = cl.f == F_READ ? M_READ
+                 : cl.f == F_WRITE ? M_WRITE : M_CAS;
+        q.msg_id = cl.msg_id;
+        q.body[0] = cl.k; q.body[1] = cl.a; q.body[2] = cl.b;
+        send(in, t, q);
+      }
+    }
+
+    check_invariants(in);
   }
 };
 
@@ -606,7 +644,7 @@ extern "C" {
 // inbox_k, latency_mean_milli, p_loss_micro, rate_micro, timeout_ticks,
 // nemesis_enabled, nemesis_interval, stop_tick, final_start, heartbeat,
 // log_cap, elect_min, elect_jitter, n_keys, n_vals, flag_stale_read,
-// flag_eager_commit, flag_no_term_guard, max_events
+// flag_eager_commit, flag_no_term_guard, max_events, n_threads
 int64_t native_sim_run(const int64_t* c, int64_t* stats_out,
                        int32_t* violations_out, int32_t* events_out,
                        int64_t* n_events_out) {
@@ -626,6 +664,7 @@ int64_t native_sim_run(const int64_t* c, int64_t* stats_out,
   cfg.flag_stale_read = c[22]; cfg.flag_eager_commit = c[23];
   cfg.flag_no_term_guard = c[24];
   cfg.max_events = c[25];
+  int64_t n_threads = c[26] > 0 ? c[26] : 1;
   if (cfg.nemesis_interval <= 0) cfg.nemesis_interval = 1;
   if (cfg.n_nodes > 30) return -1;   // votes bitmask width
   if (cfg.pool_slots > 64 || cfg.n_nodes + cfg.n_clients > 64)
@@ -638,7 +677,7 @@ int64_t native_sim_run(const int64_t* c, int64_t* stats_out,
     sim.recs[i].out = events_out + i * cfg.max_events * 7;
     sim.recs[i].cap = cfg.max_events;
   }
-  sim.run();
+  sim.run(n_threads);
 
   stats_out[0] = sim.stats.sent;
   stats_out[1] = sim.stats.delivered;

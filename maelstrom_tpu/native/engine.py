@@ -43,12 +43,20 @@ def _load():
     _lib_tried = True
     if os.environ.get("MAELSTROM_TPU_NO_NATIVE") == "1":
         return None
-    if not os.path.exists(_LIB_PATH):
+    src = os.path.join(_DIR, "sim.cpp")
+    stale = True
+    try:
+        stale = os.path.getmtime(_LIB_PATH) < os.path.getmtime(src)
+    except OSError:
+        pass
+    if stale:
+        # a stale .so would silently speak an older ABI (e.g. ignore
+        # newer cfg fields) — rebuild whenever the source is newer
         try:
-            subprocess.run(["make", "-C", _DIR, "libsim.so"],
+            subprocess.run(["make", "-C", _DIR, "-B", "libsim.so"],
                            capture_output=True, timeout=180, check=True)
         except (OSError, subprocess.SubprocessError):
-            return None
+            return None   # no toolchain; refuse a known-stale library
     try:
         lib = ctypes.CDLL(_LIB_PATH)
         lib.native_sim_run.restype = ctypes.c_int64
@@ -127,6 +135,12 @@ def run_native_sim(opts: Optional[Dict[str, Any]] = None
         elect_min=30, elect_jitter=30, n_keys=5, n_vals=5,
         ms_per_tick=1, seed=7,
         stale_read=False, eager_commit=False, no_term_guard=False,
+        # instances are independent, so worker threads each own a
+        # contiguous block end-to-end; per-instance trajectories are
+        # identical at ANY thread count (RNG is a pure function of
+        # seed + instance id) — pinned by
+        # test_native_thread_count_invariance
+        threads=0,   # 0 = all cores
     )
     o.update(opts or {})
     mpt = o["ms_per_tick"]
@@ -141,7 +155,8 @@ def run_native_sim(opts: Optional[Dict[str, Any]] = None
     rate = min(1.0, float(o["rate"]) / C / 1000.0 * mpt)
     max_events = max(64, 2 * C * n_ticks // 4)
 
-    cfg = (ctypes.c_int64 * 26)(
+    threads = int(o["threads"]) or (os.cpu_count() or 1)
+    cfg = (ctypes.c_int64 * 27)(
         int(o["seed"]), I, n_ticks, int(o["node_count"]), C, R,
         int(o["pool_slots"]), int(o["inbox_k"]),
         int(float(o["latency"]) / mpt * 1000),
@@ -157,7 +172,7 @@ def run_native_sim(opts: Optional[Dict[str, Any]] = None
         1 if o["stale_read"] else 0,
         1 if o["eager_commit"] else 0,
         1 if o["no_term_guard"] else 0,
-        max_events)
+        max_events, threads)
 
     stats = (ctypes.c_int64 * 5)()
     violations = np.zeros(I, dtype=np.int32)
@@ -193,6 +208,7 @@ def run_native_sim(opts: Optional[Dict[str, Any]] = None
             "wall-s": wall,
             "ticks": n_ticks,
             "instances": I,
+            "threads": threads,
             "msgs-per-sec": int(stats[1]) / wall if wall > 0 else 0.0,
         },
     }
