@@ -364,6 +364,7 @@ def _native_bench() -> bool:
         "sent": res["stats"]["sent"],
         "dropped_overflow": res["stats"]["dropped-overflow"],
         "wall_s": round(p["wall-s"], 3),
+        "threads": p.get("threads", 1),
         "violating_instances": res["violating-instances"],
         "recorded_checker_verdicts": verdicts,
         "events_truncated": bool(res.get("events-truncated")),

@@ -62,6 +62,10 @@ struct Cfg {
   int64_t n_keys, n_vals;
   int64_t flag_stale_read, flag_eager_commit, flag_no_term_guard;
   int64_t max_events;         // per recorded instance
+  int64_t instance_base;      // global id of instance 0 in this run —
+                              // per-instance RNG keys on the GLOBAL id,
+                              // so any contiguous (or singleton) slice
+                              // of a big fleet replays bit-exactly
 };
 
 // ------------------------------------------------------------ message
@@ -470,7 +474,7 @@ struct Sim {
     insts.reserve(I);
     for (int64_t i = 0; i < I; ++i) {
       insts.emplace_back(uint64_t(cfg.seed) * 0x9e3779b97f4a7c15ull +
-                         uint64_t(i) + 1);
+                         uint64_t(cfg.instance_base + i) + 1);
       Instance& in = insts.back();
       in.pool.resize(cfg.pool_slots);
       in.nodes.resize(cfg.n_nodes);
@@ -644,7 +648,8 @@ extern "C" {
 // inbox_k, latency_mean_milli, p_loss_micro, rate_micro, timeout_ticks,
 // nemesis_enabled, nemesis_interval, stop_tick, final_start, heartbeat,
 // log_cap, elect_min, elect_jitter, n_keys, n_vals, flag_stale_read,
-// flag_eager_commit, flag_no_term_guard, max_events, n_threads
+// flag_eager_commit, flag_no_term_guard, max_events, n_threads,
+// instance_base
 int64_t native_sim_run(const int64_t* c, int64_t* stats_out,
                        int32_t* violations_out, int32_t* events_out,
                        int64_t* n_events_out) {
@@ -665,6 +670,7 @@ int64_t native_sim_run(const int64_t* c, int64_t* stats_out,
   cfg.flag_no_term_guard = c[24];
   cfg.max_events = c[25];
   int64_t n_threads = c[26] > 0 ? c[26] : 1;
+  cfg.instance_base = c[27];
   if (cfg.nemesis_interval <= 0) cfg.nemesis_interval = 1;
   if (cfg.n_nodes > 30) return -1;   // votes bitmask width
   if (cfg.pool_slots > 64 || cfg.n_nodes + cfg.n_clients > 64)

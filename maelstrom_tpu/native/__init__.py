@@ -1,1 +1,2 @@
-from .engine import native_available, run_native_sim  # noqa: F401
+from .engine import (native_available, replay_native_instances,  # noqa: F401
+                     run_native_sim)

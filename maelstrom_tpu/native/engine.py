@@ -44,11 +44,13 @@ def _load():
     if os.environ.get("MAELSTROM_TPU_NO_NATIVE") == "1":
         return None
     src = os.path.join(_DIR, "sim.cpp")
-    stale = True
-    try:
+    if not os.path.exists(_LIB_PATH):
+        stale = True
+    elif os.path.exists(src):
+        # a .so older than its source would silently speak an older ABI
         stale = os.path.getmtime(_LIB_PATH) < os.path.getmtime(src)
-    except OSError:
-        pass
+    else:
+        stale = False   # prebuilt library shipped without sources
     if stale:
         # a stale .so would silently speak an older ABI (e.g. ignore
         # newer cfg fields) — rebuild whenever the source is newer
@@ -156,7 +158,7 @@ def run_native_sim(opts: Optional[Dict[str, Any]] = None
     max_events = max(64, 2 * C * n_ticks // 4)
 
     threads = int(o["threads"]) or (os.cpu_count() or 1)
-    cfg = (ctypes.c_int64 * 27)(
+    cfg = (ctypes.c_int64 * 28)(
         int(o["seed"]), I, n_ticks, int(o["node_count"]), C, R,
         int(o["pool_slots"]), int(o["inbox_k"]),
         int(float(o["latency"]) / mpt * 1000),
@@ -172,7 +174,7 @@ def run_native_sim(opts: Optional[Dict[str, Any]] = None
         1 if o["stale_read"] else 0,
         1 if o["eager_commit"] else 0,
         1 if o["no_term_guard"] else 0,
-        max_events, threads)
+        max_events, threads, int(o.get("instance_base", 0)))
 
     stats = (ctypes.c_int64 * 5)()
     violations = np.zeros(I, dtype=np.int32)
@@ -212,3 +214,31 @@ def run_native_sim(opts: Optional[Dict[str, Any]] = None
             "msgs-per-sec": int(stats[1]) / wall if wall > 0 else 0.0,
         },
     }
+
+
+def replay_native_instances(opts: Dict[str, Any], instance_ids
+                            ) -> Dict[str, Dict[int, Any]]:
+    """The native funnel: re-simulate exactly the given GLOBAL instance
+    ids of a big run (same seed/config) with recording on, one
+    single-instance run per id — bit-exact because per-instance RNG
+    keys on the global id (``instance_base``). Returns
+    ``{"histories": {id: history}, "violations": {id: tick-count},
+    "truncated": {id: bool}}``; a violating id must re-trip in its
+    replay (the caller's self-check that the replay really was
+    bit-exact). ``instance_ids`` are GLOBAL ids — if the batch itself
+    ran at a nonzero ``instance_base``, the caller must pass
+    base-offset ids."""
+    histories: Dict[int, Any] = {}
+    violations: Dict[int, int] = {}
+    truncated: Dict[int, bool] = {}
+    for iid in instance_ids:
+        res = run_native_sim(dict(opts, n_instances=1,
+                                  record_instances=1, threads=1,
+                                  instance_base=int(iid)))
+        if res is None:
+            break
+        histories[int(iid)] = res["histories"][0]
+        violations[int(iid)] = int(res["violations"][0])
+        truncated[int(iid)] = bool(res.get("events-truncated"))
+    return {"histories": histories, "violations": violations,
+            "truncated": truncated}

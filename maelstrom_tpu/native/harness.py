@@ -61,8 +61,58 @@ def run_native_test(opts: Optional[Dict[str, Any]] = None
     if res.get("events-truncated"):
         results["events-truncated"] = True
         results["valid?"] = "unknown" if overall is True else overall
+    # the invariant-trip funnel, same contract as the TPU harness: every
+    # tripped instance — wherever it sits in the fleet — yields a
+    # checkable history + full-checker verdict via bit-exact replay
+    funnel_hists = None
+    if opts.get("funnel", True) and len(violating_ids) > 0:
+        from .engine import replay_native_instances
+        funnel_max = int(opts.get("funnel_max", 32))
+        base = int(opts.get("instance_base", 0))
+        R = len(res["histories"])
+        local_ids = [int(i) for i in violating_ids[:funnel_max]]
+        # ids already recorded by the batch need no re-simulation —
+        # their histories (and checker verdicts) exist; only replay the
+        # ones outside the recorded window, at their GLOBAL ids
+        replay_local = [i for i in local_ids if i >= R]
+        rep = replay_native_instances(
+            opts, [base + i for i in replay_local])
+        funnel_hists = {}
+        verdicts = []
+        replayed_violating = 0
+        for i in local_ids:
+            if i < R:
+                h, trunc = res["histories"][i], bool(
+                    res.get("events-truncated"))
+                replayed_violating += 1   # recorded live, trivially so
+            else:
+                h = rep["histories"].get(base + i)
+                if h is None:
+                    continue
+                trunc = rep["truncated"].get(base + i, False)
+                if rep["violations"].get(base + i, 0) > 0:
+                    replayed_violating += 1
+            funnel_hists[base + i] = h
+            try:
+                v = linearizable_kv_checker(h)
+            except Exception as e:
+                v = {"valid?": False, "error": repr(e)}
+            if trunc and v.get("valid?") is True:
+                # a truncated history can't prove validity
+                v["valid?"] = "unknown"
+                v["events-truncated"] = True
+            v["instance"] = base + i
+            v["ops"] = sum(1 for r in h if r["type"] == "invoke")
+            verdicts.append(v)
+        results["funnel"] = {
+            "ids": [base + i for i in local_ids],
+            "replayed-violating": replayed_violating,
+            "verdicts": verdicts,
+        }
     if opts.get("store_root"):
         from ..tpu.harness import _write_store
         _write_store("lin-kv", opts["store_root"], results,
-                     res["histories"], suffix="-native")
+                     res["histories"], suffix="-native",
+                     funnel={"histories": funnel_hists}
+                     if funnel_hists else None)
     return results

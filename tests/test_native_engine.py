@@ -105,3 +105,40 @@ def test_native_throughput_beats_reference_baseline():
     assert res["perf"]["msgs-per-sec"] > 60_000, res["perf"]
     for h in res["histories"]:
         assert linearizable_kv_checker(h)["valid?"] is True
+
+
+def test_native_funnel_replays_tripped_instances(tmp_path):
+    """The eager-commit mutant trips invariants across the fleet; the
+    funnel must replay each tripped id bit-exactly (re-tripping its
+    invariants), produce checkable histories, and store them."""
+    import glob
+    import os
+
+    opts = dict(BASE, n_instances=256, record_instances=2,
+                time_limit=3.0, seed=3, eager_commit=True,
+                funnel_max=6, store_root=str(tmp_path))
+    res = run_native_test(opts)
+    assert res["invariants"]["violating-instances"] > 0
+    assert any(i >= 2 for i in
+               res["invariants"]["violating-instance-ids"])
+    fun = res["funnel"]
+    assert fun["replayed-violating"] == len(fun["ids"]), fun
+    assert len(fun["verdicts"]) == len(fun["ids"])
+    for v in fun["verdicts"]:
+        assert v["ops"] > 0
+    run_dir = os.path.join(str(tmp_path), "lin-kv-native", "latest")
+    stored = glob.glob(os.path.join(run_dir, "funnel-history-*.jsonl"))
+    assert {int(os.path.basename(p).split("-")[-1].split(".")[0])
+            for p in stored} == set(fun["ids"])
+
+
+def test_native_instance_base_bit_exact():
+    """A single-instance replay at instance_base=k must reproduce the
+    batch run's instance k exactly (stats and recorded history)."""
+    batch = run_native_sim(dict(BASE, n_instances=16,
+                                record_instances=16))
+    for k in (3, 11):
+        solo = run_native_sim(dict(BASE, n_instances=1,
+                                   record_instances=1,
+                                   instance_base=k))
+        assert solo["histories"][0] == batch["histories"][k], k
