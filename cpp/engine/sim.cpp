@@ -152,11 +152,19 @@ struct Recorder {
   }
 };
 
+struct SchedPhase {
+  int32_t until;       // active while t < until
+  uint64_t blocked;    // bit dst*N+src set = dst refuses src (N<=8)
+};
+
 struct Sim {
   Cfg cfg;
   std::vector<Instance> insts;
   Stats stats;
   std::vector<Recorder> recs;
+  std::vector<SchedPhase> sched;   // scripted nemesis (same for every
+                                   // instance, like the device runtime's
+                                   // kind="scripted")
 
   int32_t last_log_term(const Node& nd) const {
     return nd.log_len > 0 ? nd.log_term[nd.log_len - 1] : 0;
@@ -176,6 +184,14 @@ struct Sim {
     if (!cfg.nemesis_enabled || t >= cfg.stop_tick) return false;
     int32_t n = int32_t(cfg.n_nodes);
     if (dest >= n || src >= n) return false;     // clients never cut
+    if (!sched.empty()) {
+      // scripted: phases ordered by `until`; healed after the last
+      for (const auto& p : sched) {
+        if (t < p.until)
+          return (p.blocked >> (dest * n + src)) & 1;
+      }
+      return false;
+    }
     int64_t phase = t / cfg.nemesis_interval;
     if (phase % 2 == 0) return false;            // heal phase
     return in.side[dest] != in.side[src];
@@ -650,9 +666,28 @@ extern "C" {
 // log_cap, elect_min, elect_jitter, n_keys, n_vals, flag_stale_read,
 // flag_eager_commit, flag_no_term_guard, max_events, n_threads,
 // instance_base
+int64_t native_sim_run_sched(const int64_t* c, int64_t* stats_out,
+                             int32_t* violations_out,
+                             int32_t* events_out,
+                             int64_t* n_events_out,
+                             const int64_t* sched_flat,
+                             int64_t n_phases);
+
 int64_t native_sim_run(const int64_t* c, int64_t* stats_out,
                        int32_t* violations_out, int32_t* events_out,
                        int64_t* n_events_out) {
+  return native_sim_run_sched(c, stats_out, violations_out, events_out,
+                              n_events_out, nullptr, 0);
+}
+
+// sched_flat: n_phases x 2 int64s — (until_tick, blocked_bitmask) with
+// bit dst*N+src; requires n_nodes <= 8
+int64_t native_sim_run_sched(const int64_t* c, int64_t* stats_out,
+                             int32_t* violations_out,
+                             int32_t* events_out,
+                             int64_t* n_events_out,
+                             const int64_t* sched_flat,
+                             int64_t n_phases) {
   Cfg cfg;
   cfg.seed = c[0]; cfg.n_instances = c[1]; cfg.n_ticks = c[2];
   cfg.n_nodes = c[3]; cfg.n_clients = c[4]; cfg.record = c[5];
@@ -675,9 +710,14 @@ int64_t native_sim_run(const int64_t* c, int64_t* stats_out,
   if (cfg.n_nodes > 30) return -1;   // votes bitmask width
   if (cfg.pool_slots > 64 || cfg.n_nodes + cfg.n_clients > 64)
     return -1;                       // deliver scratch-array bounds
+  if (n_phases > 0 && cfg.n_nodes > 8)
+    return -1;                       // schedule bitmask width
 
   Sim sim;
   sim.cfg = cfg;
+  for (int64_t i = 0; i < n_phases; ++i)
+    sim.sched.push_back(SchedPhase{int32_t(sched_flat[i * 2]),
+                                   uint64_t(sched_flat[i * 2 + 1])});
   sim.recs.resize(cfg.record);
   for (int64_t i = 0; i < cfg.record; ++i) {
     sim.recs[i].out = events_out + i * cfg.max_events * 7;

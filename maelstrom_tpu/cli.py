@@ -127,6 +127,26 @@ def _availability(v):
     return float(v)
 
 
+def _parse_schedule_file(path: str, node_count: int):
+    """Load a scripted-nemesis JSON file ([[until_tick, [groups...]],
+    ...]) into NemesisConfig.schedule phases. Returns (error_message,
+    schedule) — exactly one is truthy."""
+    from .tpu.runtime import scripted_isolate_groups
+    with open(path) as f:
+        phases = json.load(f)
+    for until, groups in phases:
+        for g in groups:
+            for m in g:
+                if not isinstance(m, int) or not 0 <= m < node_count:
+                    return (f"error: schedule group member {m!r} is "
+                            f"not a node index in [0, {node_count})",
+                            ())
+    return None, tuple(
+        scripted_isolate_groups(until, [set(g) for g in groups],
+                                node_count)
+        for until, groups in phases)
+
+
 def cmd_test(args) -> int:
     node_count = args.node_count
     concurrency = parse_concurrency(args.concurrency, node_count)
@@ -166,20 +186,28 @@ def cmd_test(args) -> int:
                   "lin-kv (Raft) workload only; use --runtime tpu for "
                   "the full model set", file=sys.stderr)
             return 2
-        if args.nemesis_schedule_file or args.nemesis_kind == "scripted":
-            print("error: the native engine has no scripted nemesis; "
-                  "use --runtime tpu for constructed schedules",
-                  file=sys.stderr)
+        if args.nemesis_kind == "scripted" \
+                and not args.nemesis_schedule_file:
+            print("error: --nemesis-kind scripted needs "
+                  "--nemesis-schedule-file", file=sys.stderr)
             return 2
+        schedule = ()
+        if args.nemesis_schedule_file:
+            err, schedule = _parse_schedule_file(
+                args.nemesis_schedule_file, node_count)
+            if err:
+                print(err, file=sys.stderr)
+                return 2
+            if "partition" not in args.nemesis:
+                args.nemesis = list(args.nemesis) + ["partition"]
         for val, name, default in (
-                (args.nemesis_kind, "--nemesis-kind", "random-halves"),
                 (args.availability, "--availability", None),
                 (args.consistency_models, "--consistency-models", None),
                 (args.latency_dist, "--latency-dist", "exponential")):
             if val != default:
                 print(f"note: {name} has no effect on the native "
-                      f"runtime (random-halves partitions, exponential "
-                      f"latency, WGL checking only)", file=sys.stderr)
+                      f"runtime (exponential latency, WGL checking "
+                      f"only)", file=sys.stderr)
         from .native.harness import run_native_test
         results = run_native_test(dict(
             node_count=node_count, concurrency=concurrency,
@@ -187,6 +215,7 @@ def cmd_test(args) -> int:
             latency=args.latency, p_loss=args.p_loss,
             nemesis=args.nemesis,
             nemesis_interval=args.nemesis_interval,
+            nemesis_schedule=schedule,
             n_instances=args.n_instances,
             record_instances=args.record_instances,
             seed=args.seed if args.seed is not None else 0,
@@ -208,22 +237,11 @@ def cmd_test(args) -> int:
             model.n_keys = args.key_count
         schedule = ()
         if args.nemesis_schedule_file:
-            from .tpu.runtime import scripted_isolate_groups
-            with open(args.nemesis_schedule_file) as f:
-                phases = json.load(f)
-            for until, groups in phases:
-                for g in groups:
-                    for m in g:
-                        if not isinstance(m, int) \
-                                or not 0 <= m < node_count:
-                            print(f"error: schedule group member {m!r} "
-                                  f"is not a node index in "
-                                  f"[0, {node_count})", file=sys.stderr)
-                            return 2
-            schedule = tuple(
-                scripted_isolate_groups(until, [set(g) for g in groups],
-                                        node_count)
-                for until, groups in phases)
+            err, schedule = _parse_schedule_file(
+                args.nemesis_schedule_file, node_count)
+            if err:
+                print(err, file=sys.stderr)
+                return 2
             # a schedule file implies the scripted partition nemesis;
             # silently running healed would be a lie
             if "partition" not in args.nemesis:

@@ -61,16 +61,20 @@ def _load():
             return None   # no toolchain; refuse a known-stale library
     try:
         lib = ctypes.CDLL(_LIB_PATH)
-        lib.native_sim_run.restype = ctypes.c_int64
-        lib.native_sim_run.argtypes = [
+        lib.native_sim_run_sched.restype = ctypes.c_int64
+        lib.native_sim_run_sched.argtypes = [
             ctypes.POINTER(ctypes.c_int64),   # cfg
             ctypes.POINTER(ctypes.c_int64),   # stats[5]
             ctypes.POINTER(ctypes.c_int32),   # violations[I]
             ctypes.POINTER(ctypes.c_int32),   # events[R*max_events*7]
             ctypes.POINTER(ctypes.c_int64),   # n_events[R]
+            ctypes.POINTER(ctypes.c_int64),   # sched[n_phases*2]
+            ctypes.c_int64,                   # n_phases
         ]
         _lib = lib
-    except OSError:
+    except (OSError, AttributeError):
+        # AttributeError = a prebuilt library missing current symbols
+        # (older ABI): treat as unavailable, never crash the caller
         _lib = None
     return _lib
 
@@ -181,12 +185,36 @@ def run_native_sim(opts: Optional[Dict[str, Any]] = None
     events = np.zeros((R, max_events, 7), dtype=np.int32)
     n_events = np.zeros(R, dtype=np.int64)
 
+    # scripted nemesis: ((until_tick, ((dst, src), ...)), ...) — the
+    # device runtime's NemesisConfig.schedule shape — flattened to
+    # (until, blocked-bitmask) int64 pairs (needs n_nodes <= 8)
+    schedule = o.get("nemesis_schedule") or ()
+    n_phases = len(schedule)
+    flat = (ctypes.c_int64 * max(1, n_phases * 2))()
+    if n_phases:
+        N = int(o["node_count"])
+        if N > 8:
+            raise ValueError(
+                "the native engine's scripted nemesis supports at most "
+                "8 nodes (bitmask phases); use --runtime tpu")
+        # a schedule implies the partition nemesis — silently running
+        # healed would be a lie (same guard as the CLI's TPU path)
+        cfg[12] = 1
+        for i, (until, pairs) in enumerate(
+                sorted(schedule, key=lambda p: p[0])):
+            mask = 0
+            for dst, src in pairs:
+                mask |= 1 << (int(dst) * N + int(src))
+            flat[i * 2] = int(until)
+            flat[i * 2 + 1] = mask
+
     t0 = time.monotonic()
-    rc = lib.native_sim_run(
+    rc = lib.native_sim_run_sched(
         cfg, stats,
         violations.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
         events.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
-        n_events.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+        n_events.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        flat, n_phases)
     wall = time.monotonic() - t0
     if rc != 0:
         return None
@@ -194,8 +222,11 @@ def run_native_sim(opts: Optional[Dict[str, Any]] = None
     histories = [
         _decode_history(events[i, :n_events[i]], mpt, final_start)
         for i in range(R)]
+    truncated_per_instance = [bool(n_events[i] >= max_events)
+                              for i in range(R)]
     return {
         "engine": "native-cpp",
+        "truncated-per-instance": truncated_per_instance,
         "stats": {
             "sent": int(stats[0]), "delivered": int(stats[1]),
             "dropped-partition": int(stats[2]),

@@ -21,6 +21,10 @@ def run_native_test(opts: Optional[Dict[str, Any]] = None
     t0 = time.monotonic()
     res = run_native_sim(opts)
     wall = time.monotonic() - t0
+    if res is None:
+        raise ValueError(
+            "the native engine rejected this configuration (limits: "
+            "<=30 nodes, <=64 pool slots, <=64 endpoints)")
 
     from ..checkers import compose_valid
     from ..checkers.linearizable import linearizable_kv_checker
@@ -80,10 +84,12 @@ def run_native_test(opts: Optional[Dict[str, Any]] = None
         funnel_hists = {}
         verdicts = []
         replayed_violating = 0
+        per_trunc = res.get("truncated-per-instance") or []
         for i in local_ids:
             if i < R:
-                h, trunc = res["histories"][i], bool(
-                    res.get("events-truncated"))
+                h = res["histories"][i]
+                trunc = bool(per_trunc[i]) if i < len(per_trunc) else \
+                    bool(res.get("events-truncated"))
                 replayed_violating += 1   # recorded live, trivially so
             else:
                 h = rep["histories"].get(base + i)

@@ -142,3 +142,32 @@ def test_native_instance_base_bit_exact():
                                    record_instances=1,
                                    instance_base=k))
         assert solo["histories"][0] == batch["histories"][k], k
+
+
+@pytest.mark.slow
+def test_native_no_term_guard_caught_on_figure8():
+    """The Raft §5.4.2 commit bug needs the constructed
+    rotating-majorities schedule (as on the device runtime): the native
+    scripted nemesis must trip the truncated-committed witness on a
+    sizable fraction of instances, with correct Raft clean on the
+    IDENTICAL schedule."""
+    from maelstrom_tpu.tpu.runtime import scripted_isolate_groups
+
+    cycle = [({0, 1, 2},), ({2, 3, 4},), ({4, 0, 1},),
+             ({1, 2, 3},), ({3, 4, 0},)]
+    sched, t, i = [], 0, 0
+    while t < 3000:
+        t += 200
+        sched.append(scripted_isolate_groups(t, cycle[i % 5], 5))
+        i += 1
+    base = dict(node_count=5, concurrency=4, n_instances=96,
+                record_instances=4, time_limit=3.5, rate=60.0,
+                latency=5.0, rpc_timeout=0.8, nemesis=["partition"],
+                nemesis_schedule=tuple(sched), recovery_time=0.5,
+                seed=11)
+    bug = run_native_sim(dict(base, no_term_guard=True))
+    assert bug["violating-instances"] >= 5, bug["violating-instances"]
+    ok = run_native_sim(base)
+    assert ok["violating-instances"] == 0
+    assert all(linearizable_kv_checker(h)["valid?"] is True
+               for h in ok["histories"])
