@@ -105,6 +105,10 @@ def child_main(canary: bool = False) -> None:
         return
 
     on_cpu = platform == "cpu"
+    # (r4) the old "4096 is the sweet spot / superlinear past it" note
+    # is obsolete: the scaling profile (artifacts/tick_profile_cpu_r04)
+    # shows ~linear per-phase cost past 16k, and the bench now measures
+    # a 16k config alongside the 4k headline to keep that on record
     if on_cpu and os.environ.get("BENCH_NO_NATIVE") != "1":
         # CPU hosts get the C++ scalar engine (cpp/engine) — the
         # framework's native backend, ~25x the JAX-CPU path on the
@@ -113,10 +117,6 @@ def child_main(canary: bool = False) -> None:
         # to the JAX path when the toolchain/library is missing.
         if _native_bench():
             return
-    # 4096 is the measured sweet spot on a single v5e chip: per-tick
-    # wall grows superlinearly with instances (20.8 ms @ 4096 -> ~45 ms
-    # @ 8192), so 8192 is slower per message AND blows the driver's
-    # child deadline at the 4-sim-second horizon
     n_instances = int(os.environ.get(
         "BENCH_INSTANCES", 256 if on_cpu else 4096))
     sim_seconds = float(os.environ.get(

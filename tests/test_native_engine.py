@@ -171,3 +171,43 @@ def test_native_no_term_guard_caught_on_figure8():
     assert ok["violating-instances"] == 0
     assert all(linearizable_kv_checker(h)["valid?"] is True
                for h in ok["histories"])
+
+
+@pytest.mark.slow
+def test_native_vs_jax_engine_statistics_agree():
+    """The two engines are not bit-compatible (different RNG), but on
+    the identical config their AGGREGATE behavior must agree: similar
+    delivery ratios, loss fractions near p_loss, both invariant-clean,
+    both WGL-valid — the guard against semantic drift between backends."""
+    from maelstrom_tpu.models.raft import RaftModel
+    from maelstrom_tpu.tpu.harness import run_tpu_test
+
+    opts = dict(node_count=3, concurrency=6, n_instances=64,
+                record_instances=4, time_limit=1.0, rate=100.0,
+                latency=5.0, rpc_timeout=1.0, nemesis=["partition"],
+                nemesis_interval=0.4, p_loss=0.05, recovery_time=0.3,
+                seed=7)
+    nat = run_native_sim(opts)
+    jx = run_tpu_test(RaftModel(n_nodes_hint=3, log_cap=64, heartbeat=8),
+                      dict(opts, funnel=False))
+
+    assert nat["violating-instances"] == 0
+    assert jx["invariants"]["violating-instances"] == 0
+    assert jx["valid?"] is True
+    for h in nat["histories"]:
+        assert linearizable_kv_checker(h)["valid?"] is True
+
+    def ratios(sent, delivered, lost):
+        return delivered / sent, lost / sent
+
+    n_del, n_loss = ratios(nat["stats"]["sent"],
+                           nat["stats"]["delivered"],
+                           nat["stats"]["dropped-loss"])
+    j_del, j_loss = ratios(jx["net"]["sent"], jx["net"]["delivered"],
+                           jx["net"]["dropped-loss"])
+    # loss fraction must sit near p_loss * inter-node share on both
+    assert 0.01 < n_loss < 0.06 and 0.01 < j_loss < 0.06, \
+        (n_loss, j_loss)
+    # delivery ratios within 15 points of each other (protocol mixes
+    # differ slightly: heartbeat cadence vs elect timing constants)
+    assert abs(n_del - j_del) < 0.15, (n_del, j_del)
