@@ -131,6 +131,15 @@ while true; do
       if run_xval; then
         echo "$(date +%s) xval: captured 32k TPU trace" >> "$HEALTH_LOG"
         commit_artifacts artifacts/xval_tpu_32k.json "$HEALTH_LOG"
+        # the divergence hunt's verdict: first divergent tick chunk (or
+        # identical trajectories) vs the committed CPU capture
+        if [ -f artifacts/xval_cpu_32k.json ]; then
+          python tools/platform_xval.py compare \
+            artifacts/xval_cpu_32k.json artifacts/xval_tpu_32k.json \
+            > artifacts/xval_compare_32k.txt 2>&1
+          echo "$(date +%s) xval: compare rc=$? written" >> "$HEALTH_LOG"
+          commit_artifacts artifacts/xval_compare_32k.txt "$HEALTH_LOG"
+        fi
       fi
     fi
     if [ ! -f artifacts/scaling_tpu.jsonl ]; then
