@@ -66,7 +66,11 @@ def cmd_run(out_path: str) -> None:
                 record_instances=2, inbox_k=1, pool_slots=16,
                 time_limit=n_ticks / 1000.0, rate=200.0, latency=5.0,
                 rpc_timeout=1.0, nemesis=["partition"],
-                nemesis_interval=0.4, p_loss=0.05, recovery_time=0.0,
+                # phases must flip WITHIN the short capture horizon or
+                # the partition code path goes unexercised (the r3
+                # captures silently never partitioned: interval 400
+                # ticks vs a 150-225 tick horizon)
+                nemesis_interval=0.04, p_loss=0.05, recovery_time=0.0,
                 seed=seed)
     sim = make_sim_config(model, opts)
     params = model.make_params(sim.net.n_nodes)
