@@ -3,7 +3,7 @@
 # loop; the moment a probe passes, (a) run the full bench and commit
 # BENCH_TPU_BEST.json, (b) capture a 32k-instance platform_xval trace
 # for the >16k-instance divergence hunt, and append every health
-# transition to artifacts/tpu_health_r04.log (the committed outage log).
+# transition to artifacts/tpu_health_r05.log (the committed outage log).
 #
 # Probes run in deadline-guarded children: with the tunnel wedged even
 # `import jax` can hang when the sitecustomize gate env is present, so
@@ -14,7 +14,7 @@ set -u
 REPO="$(cd "$(dirname "$0")/.." && pwd)"
 cd "$REPO"
 mkdir -p artifacts
-HEALTH_LOG="artifacts/tpu_health_r04.log"
+HEALTH_LOG="artifacts/tpu_health_r05.log"
 PROBE_S="${TPU_PROBE_S:-75}"
 SLEEP_S="${TPU_SLEEP_S:-120}"
 BENCH_S="${TPU_BENCH_S:-600}"
