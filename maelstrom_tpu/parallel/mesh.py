@@ -107,10 +107,15 @@ def run_sim_unsharded(model: Model, sim: SimConfig, seed: int,
             np.concatenate(evs, axis=1))
 
 
-def _carry_to_wire(c: Carry) -> Carry:
+def _carry_to_wire(c: Carry, sim: SimConfig) -> Carry:
     """Reshape a per-shard Carry so EVERY leaf has a leading
     shard-divisible axis (scalars -> [1], key [2] -> [1, 2]) and can
-    cross a shard_map boundary under a uniform ``P(axes)`` spec."""
+    cross a shard_map boundary under a uniform ``P(axes)`` spec. The
+    wire format is canonical (instance axis LEADING) whatever the sim's
+    internal layout — one transpose per chunk dispatch, amortized over
+    the chunk's ticks."""
+    from ..tpu.runtime import canonical_carry
+    c = canonical_carry(c, sim)
     return Carry(
         pool=c.pool, node_state=c.node_state,
         client_state=c.client_state,
@@ -119,13 +124,15 @@ def _carry_to_wire(c: Carry) -> Carry:
         key=c.key.reshape(1, *c.key.shape))
 
 
-def _carry_from_wire(w: Carry) -> Carry:
-    return Carry(
+def _carry_from_wire(w: Carry, sim: SimConfig) -> Carry:
+    from ..tpu.runtime import carry_from_canonical
+    c = Carry(
         pool=w.pool, node_state=w.node_state,
         client_state=w.client_state,
         stats=jax.tree.map(lambda x: x.reshape(()), w.stats),
         violations=w.violations,
         key=w.key.reshape(*w.key.shape[1:]))
+    return carry_from_canonical(c, sim)
 
 
 def run_sim_sharded_chunked(model: Model, sim: SimConfig, seed: int,
@@ -160,14 +167,15 @@ def run_sim_sharded_chunked(model: Model, sim: SimConfig, seed: int,
                 break
 
     dummy_w = jax.eval_shape(
-        lambda p: _carry_to_wire(init_carry(model, sim, 0, p)), params)
+        lambda p: _carry_to_wire(init_carry(model, sim, 0, p), sim),
+        params)
     wire_spec = jax.tree.map(lambda _: P(axes), dummy_w)
 
     @jax.jit
     def init_fn(seeds, params):
         def body(seed_shard, params_rep):
             return _carry_to_wire(init_carry(
-                model, sim, seed_shard.reshape(()), params_rep))
+                model, sim, seed_shard.reshape(()), params_rep), sim)
         return jax.shard_map(
             body, mesh=mesh, in_specs=(P(*axes), P()),
             out_specs=wire_spec, check_vma=False)(seeds, params)
@@ -175,12 +183,12 @@ def run_sim_sharded_chunked(model: Model, sim: SimConfig, seed: int,
     @partial(jax.jit, static_argnames=("length",), donate_argnums=0)
     def chunk_fn(wire, t0, params, length):
         def body(w, t0_rep, params_rep):
-            carry = _carry_from_wire(w)
+            carry = _carry_from_wire(w, sim)
             tick = make_tick_fn(model, sim, params_rep)
             carry, ys = jax.lax.scan(
                 tick, carry,
                 t0_rep.reshape(()) + jnp.arange(length, dtype=jnp.int32))
-            return _carry_to_wire(carry), ys.events
+            return _carry_to_wire(carry, sim), ys.events
         return jax.shard_map(
             body, mesh=mesh,
             in_specs=(wire_spec, P(), P()),

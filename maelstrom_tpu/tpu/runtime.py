@@ -459,6 +459,15 @@ class SimConfig(NamedTuple):
                                  # streamed back for the per-message
                                  # journal (Lamport diagrams, msgs-per-op
                                  # — net/journal.clj's role device-side)
+    layout: str = "minor"        # instance-batch axis position in the
+                                 # carry: "minor" = batch-LAST (instances
+                                 # on the TPU 128-lane axis — tiling
+                                 # without padding), "lead" = batch-first
+                                 # (the original layout; kept as the
+                                 # bit-compat oracle and for the Pallas
+                                 # delivery kernel). Trajectories are
+                                 # bit-identical either way; see
+                                 # canonical_carry.
 
 
 class TickOutputs(NamedTuple):
@@ -513,6 +522,7 @@ def init_carry(model: Model, sim: SimConfig, seed: int, params,
     key = jax.random.PRNGKey(seed)
     if instance_ids is None:
         instance_ids = default_instance_ids(sim)
+    minor = sim.layout == "minor"
 
     def init_instance(ikey):
         nkeys = jax.random.split(ikey, cfg.n_nodes)
@@ -520,18 +530,48 @@ def init_carry(model: Model, sim: SimConfig, seed: int, params,
             lambda nk, ni: model.init_row(cfg.n_nodes, ni, nk, params))(
                 nkeys, jnp.arange(cfg.n_nodes, dtype=jnp.int32))
 
-    node_state = jax.vmap(init_instance)(
+    node_state = jax.vmap(init_instance, out_axes=-1 if minor else 0)(
         _instance_keys(key, _RNG_INIT, instance_ids))
+    pool_shape = ((cfg.pool_slots, cfg.lanes, I) if minor
+                  else (I, cfg.pool_slots, cfg.lanes))
     return Carry(
-        pool=jnp.zeros((I, cfg.pool_slots, cfg.lanes), jnp.int32),
+        pool=jnp.zeros(pool_shape, jnp.int32),
         node_state=node_state,
         client_state=jax.tree.map(
-            lambda a: jnp.broadcast_to(a, (I,) + a.shape),
+            (lambda a: jnp.broadcast_to(a[..., None], a.shape + (I,)))
+            if minor else
+            (lambda a: jnp.broadcast_to(a, (I,) + a.shape)),
             ClientState.init(sim.client.n_clients, model.op_lanes)),
         stats=NetStats.zeros(),
         violations=jnp.zeros((I,), jnp.int32),
         key=key,
     )
+
+
+def canonical_carry(carry: Carry, sim: SimConfig) -> Carry:
+    """Return the carry with the instance-batch axis LEADING on every
+    batched leaf, whatever ``sim.layout`` is — the canonical orientation
+    for digests (tools/platform_xval.py) and for crossing shard_map
+    boundaries (parallel/mesh.py wire format). Pure transpose: values
+    are untouched, so canonical digests are layout-independent."""
+    if sim.layout != "minor":
+        return carry
+    to_lead = lambda x: jnp.moveaxis(x, -1, 0)
+    return carry._replace(
+        pool=to_lead(carry.pool),
+        node_state=jax.tree.map(to_lead, carry.node_state),
+        client_state=jax.tree.map(to_lead, carry.client_state))
+
+
+def carry_from_canonical(carry: Carry, sim: SimConfig) -> Carry:
+    """Inverse of :func:`canonical_carry`."""
+    if sim.layout != "minor":
+        return carry
+    to_minor = lambda x: jnp.moveaxis(x, 0, -1)
+    return carry._replace(
+        pool=to_minor(carry.pool),
+        node_state=jax.tree.map(to_minor, carry.node_state),
+        client_state=jax.tree.map(to_minor, carry.client_state))
 
 
 def make_tick_fn(model: Model, sim: SimConfig, params,
@@ -543,6 +583,16 @@ def make_tick_fn(model: Model, sim: SimConfig, params,
     I = sim.n_instances
     if instance_ids is None:
         instance_ids = default_instance_ids(sim)
+
+    if sim.layout == "minor":
+        from ..ops.delivery import pallas_enabled
+        if pallas_enabled():
+            import warnings
+            warnings.warn(
+                "MAELSTROM_TPU_PALLAS is set but the batch-minor carry "
+                "layout has no Pallas delivery kernel — running the XLA "
+                "path; use layout='lead' to benchmark the Pallas kernel")
+        return _make_tick_fn_minor(model, sim, params, instance_ids)
 
     def tick_fn(carry: Carry, t):
         key = carry.key
@@ -606,6 +656,99 @@ def make_tick_fn(model: Model, sim: SimConfig, params,
                           violations=carry.violations
                           + violated.astype(jnp.int32),
                           key=key)
+        J = sim.journal_instances
+        ys = TickOutputs(
+            events=events[:sim.record_instances],
+            journal_sends=outs[:J],
+            journal_recvs=inbox[:J],
+        )
+        return new_carry, ys
+
+    return tick_fn
+
+
+def _make_tick_fn_minor(model: Model, sim: SimConfig, params,
+                        instance_ids) -> Callable:
+    """The batch-LAST tick: one composite per-instance tick function,
+    vmapped once with ``in_axes/out_axes=-1`` on every state array, so
+    the instance axis is minormost everywhere.
+
+    Why: on TPU, arrays tile on their last two dims in (8, 128) blocks.
+    The lead layout's per-instance trailing dims are tiny (lanes ~15,
+    pool slots ~16), so every HBM round-trip of pool/state/intermediates
+    pads the 128-lane axis ~8x. With instances minormost the lane axis
+    is the (large, 128-divisible) batch — no padding, and the whole tick
+    fuses into instance-parallel vector code. Per-instance math is the
+    SAME traced code as the lead path (same phases, same RNG fold
+    order), so trajectories are bit-identical; tests/test_layouts.py and
+    tools/platform_xval.py hold both paths to that.
+    """
+    cfg = sim.net
+    ccfg = sim.client
+    nem = sim.nemesis
+    N = cfg.n_nodes
+
+    def tick_one(pool, node_row, client_row, instance_id, master, t):
+        """One instance's full tick. pool [S, L]; returns the new
+        per-instance state plus this tick's outputs and stat deltas."""
+        nem_key = jax.random.fold_in(
+            jax.random.fold_in(master, _RNG_NEMESIS), instance_id)
+        partitions = partition_matrix(nem, cfg, t, nem_key)
+        pool, inbox, n_del, n_dropp = netsim.deliver(pool, partitions, t,
+                                                     cfg)
+
+        node_key = jax.random.fold_in(jax.random.fold_in(
+            jax.random.fold_in(master, _RNG_NODE), t), instance_id)
+        node_row, node_outs = node_phase(model, node_row, inbox[:N], t,
+                                         node_key, cfg, params)
+
+        client_key = jax.random.fold_in(jax.random.fold_in(
+            jax.random.fold_in(master, _RNG_CLIENT), t), instance_id)
+        client_row, reqs, events = client_step(model, client_row,
+                                               inbox[N:], t, client_key,
+                                               cfg, ccfg, params)
+
+        outs = jnp.concatenate(
+            [node_outs.reshape(-1, cfg.lanes), reqs], axis=0)
+        M = outs.shape[0]
+        outs = outs.at[:, wire.NETID].set(
+            t * M + jnp.arange(M, dtype=jnp.int32))
+        enq_key = jax.random.fold_in(jax.random.fold_in(
+            jax.random.fold_in(master, _RNG_ENQUEUE), t), instance_id)
+        pool, n_sent, n_lost, n_ovf = netsim.enqueue(pool, outs, t,
+                                                     enq_key, cfg)
+        violated = model.invariants(node_row, cfg, params)
+        return (pool, node_row, client_row,
+                (n_sent, n_del, n_dropp, n_lost, n_ovf),
+                violated, events, outs, inbox)
+
+    # state rides at axis -1; per-tick outputs (events/journal rows,
+    # stat deltas, violations) come out batch-LEADING so the downstream
+    # record slices ([:R], [:J]) and [I]-shaped accumulators are
+    # identical to the lead path's
+    batched = jax.vmap(
+        tick_one,
+        in_axes=(-1, -1, -1, 0, None, None),
+        out_axes=(-1, -1, -1, 0, 0, 0, 0, 0))
+
+    def tick_fn(carry: Carry, t):
+        (pool, node_state, client_state, deltas, violated, events, outs,
+         inbox) = batched(carry.pool, carry.node_state,
+                          carry.client_state, instance_ids, carry.key, t)
+        n_sent, n_del, n_dropp, n_lost, n_ovf = deltas
+        stats = NetStats(
+            sent=carry.stats.sent + jnp.sum(n_sent),
+            delivered=carry.stats.delivered + jnp.sum(n_del),
+            dropped_partition=carry.stats.dropped_partition
+            + jnp.sum(n_dropp),
+            dropped_loss=carry.stats.dropped_loss + jnp.sum(n_lost),
+            dropped_overflow=carry.stats.dropped_overflow + jnp.sum(n_ovf),
+        )
+        new_carry = Carry(pool=pool, node_state=node_state,
+                          client_state=client_state, stats=stats,
+                          violations=carry.violations
+                          + violated.astype(jnp.int32),
+                          key=carry.key)
         J = sim.journal_instances
         ys = TickOutputs(
             events=events[:sim.record_instances],

@@ -1,0 +1,129 @@
+"""Carry-layout equivalence: the batch-minor ("minor") tick path must be
+bit-identical to the batch-lead ("lead") oracle path.
+
+The minor layout exists purely for TPU tiling (instances on the 128-lane
+axis — see runtime._make_tick_fn_minor); it re-derives every RNG key and
+runs the same per-instance phase functions, so any divergence is a bug
+in the composite tick, not a tolerable reordering. These tests pin that
+across nemesis kinds, models, the replay (instance_ids) path, and the
+chunked sharded runner.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import jax.tree_util as tu
+import pytest
+
+from maelstrom_tpu.models.raft import RaftModel
+from maelstrom_tpu.tpu.harness import make_sim_config, resolve_layout
+from maelstrom_tpu.tpu.runtime import (canonical_carry,
+                                       carry_from_canonical, run_sim)
+
+BASE_OPTS = dict(node_count=3, concurrency=6, n_instances=64,
+                 record_instances=4, inbox_k=1, pool_slots=16,
+                 time_limit=0.12, rate=200.0, latency=5.0,
+                 rpc_timeout=1.0, nemesis=["partition"],
+                 nemesis_interval=0.04, p_loss=0.05, recovery_time=0.0,
+                 seed=7)
+
+
+def _model():
+    return RaftModel(n_nodes_hint=3, log_cap=64, heartbeat=8)
+
+
+def _run(model, opts, layout, instance_ids=None):
+    sim = make_sim_config(model, {**opts, "layout": layout})
+    params = model.make_params(sim.net.n_nodes)
+    ids = None if instance_ids is None else jnp.asarray(instance_ids,
+                                                        jnp.int32)
+    carry, ys = run_sim(model, sim, opts["seed"], params, ids)
+    return canonical_carry(carry, sim), ys
+
+
+def _assert_trees_equal(a, b):
+    for (path, x), (_, y) in zip(tu.tree_flatten_with_path(a)[0],
+                                 tu.tree_flatten_with_path(b)[0]):
+        name = "/".join(str(p) for p in path)
+        assert x.shape == y.shape, (name, x.shape, y.shape)
+        assert (np.asarray(x) == np.asarray(y)).all(), name
+
+
+@pytest.mark.parametrize("kind", ["random-halves", "isolated-node",
+                                  "majorities-ring"])
+def test_minor_layout_bit_identical(kind):
+    model = _model()
+    opts = {**BASE_OPTS, "nemesis_kind": kind}
+    cl, yl = _run(model, opts, "lead")
+    cm, ym = _run(model, opts, "minor")
+    _assert_trees_equal(cl, cm)
+    assert (np.asarray(yl.events) == np.asarray(ym.events)).all()
+    # the run must actually exercise traffic for the comparison to mean
+    # anything
+    assert int(cl.stats.delivered) > 100
+
+
+def test_minor_layout_inbox_k3():
+    # K>1 takes the top_k (not argmax) deliver path
+    model = _model()
+    opts = {**BASE_OPTS, "inbox_k": 3, "pool_slots": 24}
+    cl, yl = _run(model, opts, "lead")
+    cm, ym = _run(model, opts, "minor")
+    _assert_trees_equal(cl, cm)
+    assert (np.asarray(yl.events) == np.asarray(ym.events)).all()
+
+
+def test_minor_layout_replay_instance_ids():
+    # the funnel replays arbitrary instance-id subsets; RNG stability
+    # must hold in both layouts
+    model = _model()
+    ids = [3, 17, 42, 63]
+    opts = {**BASE_OPTS, "n_instances": len(ids),
+            "record_instances": len(ids)}
+    cl, yl = _run(model, opts, "lead", instance_ids=ids)
+    cm, ym = _run(model, opts, "minor", instance_ids=ids)
+    _assert_trees_equal(cl, cm)
+    assert (np.asarray(yl.events) == np.asarray(ym.events)).all()
+
+
+def test_canonical_roundtrip():
+    model = _model()
+    sim = make_sim_config(model, {**BASE_OPTS, "layout": "minor"})
+    params = model.make_params(sim.net.n_nodes)
+    carry, _ = run_sim(model, sim, 7, params)
+    back = carry_from_canonical(canonical_carry(carry, sim), sim)
+    _assert_trees_equal(carry, back)
+    # canonical pool really is batch-leading
+    assert canonical_carry(carry, sim).pool.shape[0] == sim.n_instances
+    assert carry.pool.shape[-1] == sim.n_instances
+
+
+def test_resolve_layout_auto_cpu():
+    # the suite runs on CPU, where auto must pick the lead layout
+    assert resolve_layout("auto") == "lead"
+    assert resolve_layout("minor") == "minor"
+    assert resolve_layout("lead") == "lead"
+
+
+def test_sharded_chunked_minor_matches_unsharded():
+    # the production dispatch pattern (chunked shard_map) with the minor
+    # layout inside the shard bodies, against the single-device oracle
+    from maelstrom_tpu.parallel.mesh import (make_mesh,
+                                             run_sim_sharded_chunked,
+                                             run_sim_unsharded)
+    if len(jax.devices()) < 4:
+        pytest.skip("needs a >=4-device virtual mesh")
+    model = _model()
+    opts = {**BASE_OPTS, "n_instances": 8, "record_instances": 2,
+            "layout": "minor"}
+    sim = make_sim_config(model, opts)
+    assert sim.layout == "minor"
+    mesh = make_mesh(4)
+    stats_s, viol_s, ev_s = run_sim_sharded_chunked(
+        model, sim, seed=7, mesh=mesh, chunk=40)
+    stats_u, viol_u, ev_u = run_sim_unsharded(model, sim, seed=7,
+                                              n_shards=4)
+    assert tuple(int(x) for x in stats_s) == \
+        tuple(int(x) for x in stats_u)
+    assert (viol_s == viol_u).all()
+    assert (ev_s == ev_u).all()

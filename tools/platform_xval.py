@@ -50,7 +50,8 @@ def cmd_run(out_path: str) -> None:
 
     from maelstrom_tpu.models.raft import RaftModel
     from maelstrom_tpu.tpu.harness import make_sim_config
-    from maelstrom_tpu.tpu.runtime import init_carry, make_tick_fn
+    from maelstrom_tpu.tpu.runtime import (canonical_carry, init_carry,
+                                           make_tick_fn)
 
     I = int(os.environ.get("XVAL_INSTANCES", 1024))
     n_ticks = int(os.environ.get("XVAL_TICKS", 225))
@@ -88,7 +89,10 @@ def cmd_run(out_path: str) -> None:
         use = min(chunk, n_ticks - t)
         carry = seg(carry, jnp.int32(t), use)
         t += use
-        d = digest_tree(carry._replace(key=carry.key))  # key included
+        # digest the CANONICAL (batch-leading) orientation: digests are
+        # index-weighted, so this keeps captures comparable across both
+        # carry layouts (runtime.SimConfig.layout) and across rounds
+        d = digest_tree(canonical_carry(carry, sim))
         checkpoints.append({"tick": t, "digest": d})
         print(f"xval: tick {t}/{n_ticks}", file=sys.stderr, flush=True)
 

@@ -52,12 +52,15 @@ def main() -> None:
     rows = []
 
     for I in sizes:
+        # phase decomposition reconstructs intermediates assuming the
+        # batch-LEAD carry layout; the minor layout is profiled as a
+        # whole (scan25_minor) since its tick is one fused vmap
         opts = dict(node_count=3, concurrency=6, n_instances=I,
                     record_instances=1, inbox_k=1, pool_slots=16,
                     time_limit=4.0, rate=200.0, latency=5.0,
                     rpc_timeout=1.0, nemesis=["partition"],
                     nemesis_interval=0.4, p_loss=0.05,
-                    recovery_time=0.3, seed=7)
+                    recovery_time=0.3, seed=7, layout="lead")
         sim = make_sim_config(model, opts)
         cfg, ccfg, nem = sim.net, sim.client, sim.nemesis
         N = cfg.n_nodes
@@ -148,7 +151,22 @@ def main() -> None:
 
         jax.block_until_ready(f_scan(carry, t, 25))
 
+        # the batch-minor layout, timed end-to-end (burned in separately
+        # so its pool carries the identical steady state)
+        sim_m = make_sim_config(model, {**opts, "layout": "minor"})
+        tick_m = make_tick_fn(model, sim_m, params)
+
+        @partial(jax.jit, static_argnums=2)
+        def f_scan_m(c, t0, length):
+            return jax.lax.scan(
+                tick_m, c, t0 + jnp.arange(length, dtype=jnp.int32))[0]
+
+        carry_m = init_carry(model, sim_m, 7, params)
+        carry_m = jax.block_until_ready(
+            f_scan_m(carry_m, jnp.int32(0), burnin))
+
         phases = {
+            "scan25_minor": lambda: f_scan_m(carry_m, t, 25),
             "nemesis": lambda: f_nemesis(ikeys, t),
             "deliver": lambda: f_deliver(carry.pool, partitions, t),
             "node": lambda: f_node(carry.node_state, inbox[:, :N],
@@ -168,7 +186,7 @@ def main() -> None:
                 out = fn()
             jax.block_until_ready(out)
             per_call = (time.monotonic() - t0) / reps
-            per_tick = per_call / (25 if name == "scan25" else 1)
+            per_tick = per_call / (25 if name.startswith("scan25") else 1)
             rows.append({"instances": I, "phase": name,
                          "ms_per_tick": round(per_tick * 1e3, 3)})
             print(json.dumps(rows[-1]), flush=True)

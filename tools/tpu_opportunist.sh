@@ -142,9 +142,10 @@ while true; do
         fi
       fi
     fi
-    if [ ! -f artifacts/scaling_tpu.jsonl ]; then
+    if [ ! -f artifacts/scaling_tpu.jsonl ] \
+        && [ ! -f artifacts/scaling_tpu_partial.jsonl ]; then
       echo "$(date +%s) scaling: starting ladder" >> "$HEALTH_LOG"
-      if timeout 600 python tools/tpu_scaling.py \
+      if SCALING_LAYOUTS=lead,minor timeout 900 python tools/tpu_scaling.py \
            4096 16384 32768 65536 98304 \
            > artifacts/scaling_tpu.jsonl.tmp \
            2>>/tmp/tpu_scaling_err.log \

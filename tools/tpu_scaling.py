@@ -16,7 +16,9 @@ Usage:
     python tools/tpu_scaling.py                 # auto ladder by platform
     python tools/tpu_scaling.py 512 4096 16384  # explicit ladder
 Env: SCALING_K (inbox_k, default 1), SCALING_POOL (pool_slots, default
-16), SCALING_TICKS (default 1000), SCALING_CHUNK (default 100).
+16), SCALING_TICKS (default 1000), SCALING_CHUNK (default 100),
+SCALING_LAYOUTS (comma list of carry layouts per rung; default "auto" —
+set "lead,minor" to A/B the batch-axis position on the accelerator).
 """
 
 from __future__ import annotations
@@ -60,15 +62,19 @@ def main() -> None:
                 chunk = c
                 break
 
+    layouts = [s.strip() for s in
+                os.environ.get("SCALING_LAYOUTS", "auto").split(",")]
+
     model = RaftModel(n_nodes_hint=3, log_cap=64, heartbeat=8)
     for n in ladder:
+      for layout in layouts:
         opts = dict(node_count=3, concurrency=6, n_instances=n,
                     record_instances=1, inbox_k=inbox_k,
                     pool_slots=pool_slots,
                     time_limit=n_ticks / 1000.0, rate=200.0, latency=5.0,
                     rpc_timeout=1.0, nemesis=["partition"],
                     nemesis_interval=0.4, p_loss=0.05,
-                    recovery_time=0.3, seed=7)
+                    recovery_time=0.3, seed=7, layout=layout)
         sim = make_sim_config(model, opts)
         params = model.make_params(3)
         tick_fn = make_tick_fn(model, sim, params)
@@ -99,6 +105,7 @@ def main() -> None:
         timed_ticks = t - min(chunk, sim.n_ticks)
         print(json.dumps({
             "platform": platform, "instances": n,
+            "layout": sim.layout,
             "inbox_k": inbox_k, "pool_slots": pool_slots,
             "msgs_per_sec": round((d - d0) / wall, 1),
             "wall_per_tick_ms": round(wall / max(1, timed_ticks) * 1000,
