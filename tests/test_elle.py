@@ -253,3 +253,76 @@ def test_rw_register_fractured_read_second_observation():
     assert res["valid?"] is False
     assert any(k in res["anomaly-types"]
                for k in ("G-single", "G2-item")), res["anomaly-types"]
+
+
+def test_cycle_search_timeout_is_unknown_for_list_append():
+    # wr cycle SCC, but a zero budget means it can't be searched: the
+    # verdict must be "unknown" (a skipped search proves nothing), with
+    # the pseudo-anomaly reported (Elle's cycle-search-timeout)
+    h = H((0, "invoke", [["append", 1, 1], ["r", 2, None]]),
+          (0, "ok",     [["append", 1, 1], ["r", 2, [2]]]),
+          (1, "invoke", [["append", 2, 2], ["r", 1, None]]),
+          (1, "ok",     [["append", 2, 2], ["r", 1, [1]]]))
+    full = check_list_append(h)
+    assert full["valid?"] is False   # searchable: a real G1c
+    r = check_list_append(h, cycle_search_budget=0)
+    assert r["valid?"] == "unknown"
+    assert "cycle-search-timeout" in r["anomaly-types"]
+
+
+def test_cycle_search_timeout_filtered_for_rw_register():
+    # reference parity (txn_rw_register.clj:138-150): the rw-register
+    # workload DROPS cycle-search timeouts entirely
+    h = H((0, "invoke", [["w", 1, 1], ["r", 2, None]]),
+          (0, "ok",     [["w", 1, 1], ["r", 2, 2]]),
+          (1, "invoke", [["w", 2, 2], ["r", 1, None]]),
+          (1, "ok",     [["w", 2, 2], ["r", 1, 1]]))
+    assert check_rw_register(h)["valid?"] is False
+    r = check_rw_register(h, cycle_search_budget=0)
+    assert r["valid?"] is True
+    assert "cycle-search-timeout" not in r["anomaly-types"]
+
+
+def test_list_append_internal_and_unwritten():
+    # a txn missing its OWN append is internally inconsistent
+    h = H((0, "invoke", [["append", 1, 5], ["r", 1, None]]),
+          (0, "ok",     [["append", 1, 5], ["r", 1, []]]))
+    r = check_list_append(h, consistency_model="read-atomic")
+    assert r["valid?"] is False and "internal" in r["anomalies"]
+    # reading a value nobody ever wrote is corruption at any model
+    h2 = H((0, "invoke", [["r", 1, None]]),
+           (0, "ok",     [["r", 1, [31337]]]))
+    r2 = check_list_append(h2, consistency_model="read-uncommitted")
+    assert r2["valid?"] is False and "unwritten-read" in r2["anomalies"]
+
+
+def test_rw_register_fractured_read():
+    # two external reads of one key in one txn disagree: fine at
+    # read-committed (non-repeatable reads allowed), fractured at
+    # read-atomic and up
+    h = H((0, "invoke", [["w", 1, 1]]),
+          (0, "ok",     [["w", 1, 1]]),
+          (1, "invoke", [["w", 1, 2]]),
+          (1, "ok",     [["w", 1, 2]]),
+          (2, "invoke", [["r", 1, None], ["r", 1, None]]),
+          (2, "ok",     [["r", 1, 1], ["r", 1, 2]]))
+    assert check_rw_register(
+        h, consistency_model="read-committed")["valid?"] is True
+    r = check_rw_register(h, consistency_model="read-atomic")
+    assert r["valid?"] is False and "fractured-read" in r["anomalies"]
+
+
+def test_nil_reader_inference_gated_below_serializable():
+    # two txns each read nil then write the same key: legal at
+    # read-committed (stale nil reads are permitted); the serializable
+    # "nil-reader writes the first version" inference must not leak ww
+    # edges into weaker models and fabricate a G0 there
+    h = H((0, "invoke", [["r", 1, None], ["w", 1, 1]]),
+          (0, "ok",     [["r", 1, None], ["w", 1, 1]]),
+          (1, "invoke", [["r", 1, None], ["w", 1, 2]]),
+          (1, "ok",     [["r", 1, None], ["w", 1, 2]]))
+    assert check_rw_register(
+        h, consistency_model="read-committed")["valid?"] is True
+    # at serializable the two nil reads are mutually impossible
+    assert check_rw_register(
+        h, consistency_model="serializable")["valid?"] is False

@@ -1,0 +1,289 @@
+"""Differential test: the Elle-style checker vs a brute-force
+serialization oracle (VERDICT r4 next #6).
+
+The oracle decides serializability EXACTLY for small histories: try
+every permutation of the committed transactions, simulate list-append /
+register semantics, and accept iff some permutation explains every
+committed read (for strict serializability, only permutations that are
+linear extensions of the real-time interval order count). Histories are
+generated from a simulated correct DB (always valid by construction),
+then corrupted with targeted mutations (lost append, stale read,
+aborted read, intermediate read, phantom value, reordered read); the
+ground truth on mutants comes from the oracle, not from the mutation's
+intent — a "stale read" of a concurrent txn can still be serializable.
+
+Checked both ways, per consistency model:
+- soundness: oracle-valid histories must pass the checker;
+- completeness: oracle-invalid histories must fail it (for these
+  generators every version order is observable — each key ends with a
+  full final read — which is the regime where Elle-style inference is
+  complete).
+"""
+
+import itertools
+import random
+
+import pytest
+
+from maelstrom_tpu.checkers.elle import check_list_append, check_rw_register
+
+MODELS = ("serializable", "strict-serializable")
+
+
+# --- brute-force oracle ---------------------------------------------------
+
+def _txns(history):
+    """(committed, failed_values) from a history; committed txns carry
+    (invoke_index, end_index, ops)."""
+    committed, open_by_proc = [], {}
+    for r in history:
+        p = r["process"]
+        if r["type"] == "invoke":
+            open_by_proc[p] = r
+        elif r["type"] in ("ok", "fail"):
+            inv = open_by_proc.pop(p)
+            if r["type"] == "ok":
+                committed.append({"invoke": inv["index"],
+                                  "end": r["index"],
+                                  "ops": r["value"]})
+    return committed
+
+
+def _replay_ok(perm, kind):
+    """Does executing ``perm`` (list of op-lists) in order explain every
+    read? kind: 'append' (state = list per key) or 'w' (register)."""
+    state = {}
+    for ops in perm:
+        for f, k, v in ops:
+            if f == "append":
+                state.setdefault(k, [])
+                state[k] = state[k] + [v]
+            elif f == "w":
+                state[k] = v
+            elif f == "r":
+                if kind == "append":
+                    if list(v or []) != state.get(k, []):
+                        return False
+                else:
+                    if v != state.get(k):
+                        return False
+    return True
+
+
+def oracle(history, model, kind="append"):
+    """True iff the committed txns have a (real-time-respecting, when
+    strict) serialization explaining all reads. Exponential — callers
+    keep histories <= 6 committed txns."""
+    committed = _txns(history)
+    n = len(committed)
+    order = range(n)
+    for perm in itertools.permutations(order):
+        if model == "strict-serializable":
+            pos = {t: i for i, t in enumerate(perm)}
+            if any(committed[a]["end"] < committed[b]["invoke"]
+                   and pos[a] > pos[b]
+                   for a in order for b in order if a != b):
+                continue
+        if _replay_ok([committed[t]["ops"] for t in perm], kind):
+            return True
+    return False
+
+
+# --- valid-history generator ----------------------------------------------
+
+def gen_history(rng, kind="append", n_txns=5, n_keys=2):
+    """Simulate a correct sequential DB, emitting overlapping intervals
+    (adjacent txns on distinct processes sometimes overlap — the
+    serialization order still respects real time). Every key gets a
+    final full read so version orders are fully observable. Some runs
+    include a definitely-failed txn whose writes never apply."""
+    state = {}
+    next_val = itertools.count(1)
+    execs = []          # ops per txn, in true execution order
+    for _ in range(n_txns):
+        ops = []
+        for _ in range(rng.randint(1, 3)):
+            k = rng.randrange(n_keys)
+            if rng.random() < 0.55:
+                v = next(next_val)
+                ops.append(["append", k, v] if kind == "append"
+                           else ["w", k, v])
+                if kind == "append":
+                    state.setdefault(k, [])
+                    state[k] = state[k] + [v]
+                else:
+                    state[k] = v
+            else:
+                ops.append(["r", k, list(state.get(k, []))
+                            if kind == "append" else state.get(k)])
+        execs.append(ops)
+    # final reads pin the complete version order of every key
+    execs.append([["r", k, list(state.get(k, []))
+                   if kind == "append" else state.get(k)]
+                  for k in range(n_keys)])
+
+    hist, idx = [], itertools.count()
+    i = 0
+    while i < len(execs):
+        overlap = i + 1 < len(execs) and rng.random() < 0.4
+        group = execs[i:i + 2] if overlap else execs[i:i + 1]
+        for j, ops in enumerate(group):
+            inv = [[f, k, None if f == "r" else v] for f, k, v in ops]
+            hist.append({"process": i + j, "type": "invoke", "f": "txn",
+                         "value": inv, "index": next(idx)})
+        for j, ops in enumerate(group):
+            hist.append({"process": i + j, "type": "ok", "f": "txn",
+                         "value": ops, "index": next(idx)})
+        i += len(group)
+    if rng.random() < 0.4:
+        # a definitely-failed append: its value must never be observed
+        k = rng.randrange(n_keys)
+        v = next(next_val)
+        op = [["append", k, v] if kind == "append" else ["w", k, v]]
+        hist.append({"process": 90, "type": "invoke", "f": "txn",
+                     "value": op, "index": next(idx)})
+        hist.append({"process": 90, "type": "fail", "f": "txn",
+                     "value": op, "index": next(idx)})
+    for n, r in enumerate(hist):
+        r["index"] = n
+        r["time"] = n
+    return hist
+
+
+# --- mutations -------------------------------------------------------------
+
+def _ok_reads(hist):
+    return [(ri, oi) for ri, r in enumerate(hist) if r["type"] == "ok"
+            for oi, op in enumerate(r["value"]) if op[0] == "r"]
+
+
+def mutate(hist, rng, kind="append"):
+    """Corrupt a committed read/write; returns None when the chosen
+    mutation has no applicable site."""
+    h = [dict(r, value=[list(op) for op in r["value"]]) for r in hist]
+    reads = _ok_reads(h)
+    if not reads:
+        return None
+    which = rng.choice(["lost", "stale", "aborted", "phantom", "reorder"]
+                       if kind == "append" else
+                       ["lost", "stale", "aborted", "phantom"])
+    if which == "lost":
+        # an acked append/write vanishes from every read
+        writes = [op for r in h if r["type"] == "ok"
+                  for op in r["value"] if op[0] != "r"]
+        if not writes:
+            return None
+        _, k, v = rng.choice(writes)
+        for r in h:
+            if r["type"] != "ok":
+                continue
+            for op in r["value"]:
+                if op[0] == "r" and op[1] == k:
+                    if kind == "append" and op[2] and v in op[2]:
+                        op[2] = [x for x in op[2] if x != v]
+                    elif kind != "append" and op[2] == v:
+                        op[2] = None
+        return h
+    ri, oi = rng.choice(reads)
+    op = h[ri]["value"][oi]
+    if which == "stale":
+        if kind == "append":
+            if not op[2]:
+                return None
+            op[2] = op[2][:rng.randrange(len(op[2]))]
+        else:
+            if op[2] is None:
+                return None
+            op[2] = None if op[2] == 1 else op[2] - 1
+    elif which == "aborted":
+        failed = [o for r in h if r["type"] == "fail"
+                  for o in r["value"] if o[0] != "r" and o[1] == op[1]]
+        if not failed:
+            return None
+        if kind == "append":
+            op[2] = (op[2] or []) + [failed[0][2]]
+        else:
+            op[2] = failed[0][2]
+    elif which == "phantom":
+        if kind == "append":
+            op[2] = (op[2] or []) + [7777]
+        else:
+            op[2] = 7777
+    elif which == "reorder":
+        if not op[2] or len(op[2]) < 2:
+            return None
+        op[2] = list(op[2])
+        op[2][0], op[2][1] = op[2][1], op[2][0]
+    return h
+
+
+# --- the differential property --------------------------------------------
+
+def _check(kind):
+    return check_list_append if kind == "append" else check_rw_register
+
+
+@pytest.mark.parametrize("kind", ["append", "w"])
+@pytest.mark.parametrize("seed", range(40))
+def test_valid_histories_pass(kind, seed):
+    rng = random.Random(seed)
+    hist = gen_history(rng, kind, n_txns=rng.randint(2, 5))
+    for model in MODELS:
+        assert oracle(hist, model, kind) is True, \
+            "generator produced an oracle-invalid history"
+        r = _check(kind)(hist, consistency_model=model)
+        assert r["valid?"] is True, (model, r)
+
+
+@pytest.mark.slow
+def test_wide_sweep_soundness_and_bounded_incompleteness():
+    """1000-seed sweep per workload. The checker must NEVER flag an
+    oracle-valid history (soundness, zero tolerance). For completeness:
+    list-append must catch every oracle-invalid mutant (version orders
+    are fully observable here — Elle-complete regime); rw-register may
+    miss the few mutants whose refutation needs a case split over
+    UNOBSERVED version orders — deciding register serializability is
+    NP-hard in general (Papadimitriou 1979), and the checker is
+    documented as sound-inference-only. The miss budget pins today's
+    count; improving inference may lower it, never raise it."""
+    false_pos, append_miss, register_miss = [], [], []
+    for kind in ("append", "w"):
+        chk = _check(kind)
+        for seed in range(1000):
+            rng = random.Random(5000 + seed)
+            hist = gen_history(rng, kind, n_txns=rng.randint(2, 6))
+            mut = mutate(hist, rng, kind)
+            for model in MODELS:
+                for h in (hist, mut):
+                    if h is None:
+                        continue
+                    truth = oracle(h, model, kind)
+                    ok = chk(h, consistency_model=model)["valid?"] is True
+                    if truth and not ok:
+                        false_pos.append((kind, seed, model))
+                    elif not truth and ok:
+                        (append_miss if kind == "append"
+                         else register_miss).append((seed, model))
+    assert not false_pos, f"checker flagged valid histories: {false_pos}"
+    assert not append_miss, f"list-append missed: {append_miss}"
+    assert len(register_miss) <= 4, \
+        f"register misses grew past the pinned budget: {register_miss}"
+
+
+@pytest.mark.parametrize("kind", ["append", "w"])
+@pytest.mark.parametrize("seed", range(60))
+def test_mutants_agree_with_oracle(kind, seed):
+    rng = random.Random(1000 + seed)
+    hist = gen_history(rng, kind, n_txns=rng.randint(2, 5))
+    mut = mutate(hist, rng, kind)
+    if mut is None:
+        pytest.skip("mutation had no applicable site")
+    for model in MODELS:
+        truth = oracle(mut, model, kind)
+        r = _check(kind)(mut, consistency_model=model)
+        if truth:
+            # soundness: the checker must not cry wolf on a history the
+            # oracle can serialize
+            assert r["valid?"] is True, (model, "false positive", r)
+        else:
+            assert r["valid?"] is False, (model, "missed anomaly", r)
