@@ -12,7 +12,12 @@ msgs/sec on a 48-way Xeon (reference README.md:39-42; BASELINE.md row 1).
 ``secondary`` (when the budget allowed it) is the same metric at an
 inbox_k=3 / pool_slots=48 config — real per-tick delivery pressure, so
 the headline K=1 figure can't be read as tuned-to-the-metric
-(VERDICT r2 weak #4).
+(VERDICT r2 weak #4). ``jax_engine`` carries the JAX engine's own line
+on rounds where the native C++ engine takes the headline, so both
+engines keep a round-over-round trend (VERDICT r4 weak #3). ``funnel``
+(on headline-config lines) reports the invariant-trip funnel at the
+measured scale: tripped + sampled instances replayed bit-exactly and
+full-checked (VERDICT r4 next #5).
 
 Hardening (round 2): JAX backend init can wedge forever on a flaky
 accelerator tunnel — even before user code runs (sitecustomize plugin
@@ -109,18 +114,25 @@ def child_main(canary: bool = False) -> None:
     # is obsolete: the scaling profile (artifacts/tick_profile_cpu_r04)
     # shows ~linear per-phase cost past 16k, and the bench now measures
     # a 16k config alongside the 4k headline to keep that on record
+    native_ran = False
     if on_cpu and os.environ.get("BENCH_NO_NATIVE") != "1":
         # CPU hosts get the C++ scalar engine (cpp/engine) — the
         # framework's native backend, ~25x the JAX-CPU path on the
         # identical semantics (same workload, partitions, loss,
         # per-tick invariants, WGL-checkable histories). Falls through
         # to the JAX path when the toolchain/library is missing.
-        if _native_bench():
-            return
+        native_ran = _native_bench()
     n_instances = int(os.environ.get(
         "BENCH_INSTANCES", 256 if on_cpu else 4096))
     sim_seconds = float(os.environ.get(
         "BENCH_SIM_SECONDS", 1.0 if on_cpu else 4.0))
+    if native_ran:
+        # the JAX engine is the TPU-portable artifact: its CPU number
+        # still ships every round (VERDICT r4 weak #3 — r4's metric
+        # line dropped it), on a shorter horizon so the native headline
+        # keeps the budget
+        n_instances = int(os.environ.get("BENCH_JAX_INSTANCES", 256))
+        sim_seconds = float(os.environ.get("BENCH_JAX_SIM_SECONDS", 0.5))
     # hard ceiling on seconds per device dispatch: single XLA dispatches
     # that run for minutes fault the TPU tunnel ("worker crashed" after
     # ~60-70s observed; a 250-tick scan at 32k instances dies, the same
@@ -151,6 +163,9 @@ def child_main(canary: bool = False) -> None:
     ]
     if on_cpu:
         configs = configs[:1]
+        if native_ran:
+            # distinct config key: the native engine already owns "k1"
+            configs = [("jax-k1",) + configs[0][1:]]
 
     model = RaftModel(n_nodes_hint=3, log_cap=64, heartbeat=8)
 
@@ -196,7 +211,7 @@ def child_main(canary: bool = False) -> None:
         def emit(delivered_timed: int, delivered: int, sent: int,
                  ovf: int, ticks_done: int, wall: float,
                  provisional: bool = False,
-                 complete: bool = False) -> None:
+                 complete: bool = False, funnel=None) -> None:
             # `value` = delivered_timed / wall_s (both fields present, so
             # the metric is recomputable); `delivered`/`sent`/
             # `dropped_overflow`/`sim_ticks` are cumulative run totals
@@ -210,6 +225,8 @@ def child_main(canary: bool = False) -> None:
                 "unit": "msgs/s",
                 "vs_baseline": round(value / BASELINE_MSGS_PER_SEC, 3),
                 "platform": platform,
+                "engine": "jax",
+                "layout": sim.layout,
                 "config": cfg_name,
                 "inbox_k": sim.net.inbox_k,
                 "pool_slots": sim.net.pool_slots,
@@ -228,6 +245,8 @@ def child_main(canary: bool = False) -> None:
                 rec["complete"] = True      # this config ran its full
                                             # horizon — a later child
                                             # death is not ITS failure
+            if funnel is not None:
+                rec["funnel"] = funnel
             print(json.dumps(rec), flush=True)
 
         # Warm-up: compile + run one small chunk, then a second chunk on
@@ -288,6 +307,7 @@ def child_main(canary: bool = False) -> None:
         # reports the ticks actually run.
         delivered0 = delivered
         t_start = time.monotonic()
+        wall = 0.0
         while ticks < n_ticks:
             rem = n_ticks - ticks
             use = L if rem >= L else (W if rem >= W else 0)
@@ -304,6 +324,41 @@ def child_main(canary: bool = False) -> None:
                  int(carry.stats.sent),
                  int(carry.stats.dropped_overflow), ticks, wall,
                  complete=(ticks + W > n_ticks))
+        # funnel at the headline config (VERDICT r4 next #5): replay
+        # tripped + sampled instances bit-exactly, full-check each, and
+        # re-emit the final line carrying the funnel block
+        if (cfg_name in ("k1", "jax-k1") and ticks + W > n_ticks
+                and wall > 0 and os.environ.get("BENCH_FUNNEL") != "0"):
+            log(TAG, f"phase[{cfg_name}]: funnel replay")
+            import numpy as np
+
+            def _jax_replay(ids, _opts=opts, _ticks=ticks):
+                from maelstrom_tpu.tpu.harness import events_to_histories
+                from maelstrom_tpu.tpu.runtime import run_sim
+                sub = make_sim_config(model, {
+                    **_opts, "n_instances": len(ids),
+                    "record_instances": len(ids),
+                    "journal_instances": 0})
+                # replay EXACTLY the ticks the fleet ran (the chunked
+                # loop drops a sub-chunk tail) or the violation-count
+                # self-check would compare different horizons
+                sub = sub._replace(n_ticks=_ticks)
+                c2, ys2 = run_sim(model, sub, _opts["seed"], params,
+                                  jnp.asarray(ids, jnp.int32))
+                hl = events_to_histories(
+                    model, np.asarray(ys2.events),
+                    final_start=sub.client.final_start)
+                v2 = np.asarray(c2.violations)
+                return ({i: hl[j] for j, i in enumerate(ids)},
+                        {i: int(v2[j]) for j, i in enumerate(ids)}, {})
+
+            chk = model.checker()
+            funnel = _funnel_block(np.asarray(carry.violations),
+                                   _jax_replay, lambda h: chk(h, opts))
+            emit(delivered - delivered0, delivered,
+                 int(carry.stats.sent),
+                 int(carry.stats.dropped_overflow), ticks, wall,
+                 complete=True, funnel=funnel)
         log(TAG, f"phase[{cfg_name}]: done")
     log(TAG, "phase: done")
 
@@ -357,6 +412,10 @@ def _native_bench() -> bool:
                 verdicts.append(linearizable_kv_checker(h)["valid?"])
             except Exception as e:
                 verdicts.append(f"checker-error: {e!r}"[:120])
+        funnel = _funnel_block(
+            res["violations"],
+            lambda ids: _native_replay_histories(opts, ids),
+            linearizable_kv_checker)
         p = res["perf"]
         value = p["msgs-per-sec"]
         print(json.dumps({
@@ -378,12 +437,74 @@ def _native_bench() -> bool:
             "threads": p.get("threads", 1),
             "violating_instances": res["violating-instances"],
             "recorded_checker_verdicts": verdicts,
+            "funnel": funnel,
             "events_truncated": bool(res.get("events-truncated")),
             "complete": True,
         }), flush=True)
         log(TAG, f"phase[native-{cfg_name}]: {value:,.0f} msgs/s, "
-                 f"verdicts={verdicts}")
+                 f"verdicts={verdicts}, funnel={funnel}")
     return ran_any
+
+
+def _native_replay_histories(opts, ids):
+    """(histories, violations, truncated) keyed by instance id, via the
+    native engine's bit-exact per-id replay."""
+    from maelstrom_tpu.native.engine import replay_native_instances
+    rep = replay_native_instances(opts, ids)
+    return rep["histories"], rep["violations"], rep["truncated"]
+
+
+def _funnel_block(violations, replay_fn, checker):
+    """The invariant-trip funnel, wired into the bench artifact
+    (VERDICT r4 next #5): every tripped instance in the fleet — plus a
+    deterministic healthy sample — is replayed bit-exactly at the
+    headline config and put through the full workload checker. The
+    metric line then carries checker coverage at the measured scale,
+    not just the pre-recorded instances.
+
+    ``violations``: per-instance violation-tick counts for the whole
+    fleet. ``replay_fn(ids) -> (histories, violations, truncated)``
+    dicts keyed by id. Never raises — a funnel failure is reported in
+    the block, not allowed to kill the metric line."""
+    import numpy as np
+    try:
+        violations = np.asarray(violations)
+        n = violations.shape[0]
+        violating_ids = [int(i) for i in np.nonzero(violations)[0]]
+        cap = int(os.environ.get("BENCH_FUNNEL_MAX", 8))
+        sample = [i for i in (n // 7, n // 3, n // 2 + 1, n - 2)
+                  if 0 <= i < n]
+        ids = list(dict.fromkeys(violating_ids[:cap] + sample))
+        hists, rviol, trunc = replay_fn(ids)
+        verdicts = {}
+        replayed_violating = 0
+        for i in ids:
+            h = hists.get(i)
+            if h is None:
+                verdicts[i] = "missing"
+                continue
+            if rviol.get(i, 0) > 0:
+                replayed_violating += 1
+            try:
+                v = checker(h)["valid?"]
+            except Exception as e:
+                v = f"checker-error: {e!r}"[:120]
+            if trunc.get(i) and v is True:
+                v = "unknown"   # a truncated history can't prove validity
+            verdicts[i] = v
+        return {
+            "total_violating": len(violating_ids),
+            "replayed": len(ids),
+            "sampled_ids": sample,
+            # replay self-check: the replayed trajectories must trip the
+            # same invariants the fleet run did (bit-exactness evidence)
+            "replayed_violating": replayed_violating,
+            "expected_violating": sum(
+                1 for i in ids if violations[i] > 0),
+            "verdicts": {str(i): v for i, v in verdicts.items()},
+        }
+    except Exception as e:
+        return {"error": repr(e)[:200]}
 
 
 # --------------------------------------------------------------------------
@@ -575,7 +696,8 @@ def parent_main() -> int:
         # the k1-family line that LOST the headline (the other instance
         # scale) rides along so the 4k-vs-16k comparison is on record
         for alt_name, alt in cfg_best.items():
-            if alt_name != "k3" and alt_name != best.get("config"):
+            if alt_name not in ("k3", "jax-k1") \
+                    and alt_name != best.get("config"):
                 best["alt_scale"] = {
                     k: alt.get(k) for k in
                     ("value", "vs_baseline", "config", "instances",
@@ -583,6 +705,17 @@ def parent_main() -> int:
                      "delivered_timed", "wall_s")
                     if k in alt}
                 break
+        # the JAX engine's own line (VERDICT r4 weak #3): on rounds where
+        # the native engine takes the headline, the TPU-portable engine's
+        # trend must stay visible in the driver record
+        jax_line = cfg_best.get("jax-k1")
+        if jax_line is not None and jax_line is not best:
+            best["jax_engine"] = {
+                k: jax_line.get(k) for k in
+                ("value", "vs_baseline", "config", "instances", "layout",
+                 "platform", "partial", "provisional", "sim_ticks",
+                 "delivered_timed", "wall_s", "funnel")
+                if k in jax_line}
         if tpu_best is not None and best.get("platform") == "cpu":
             line = tpu_best.get("metric_line", {})
             best["tpu_best"] = {
