@@ -16,7 +16,9 @@ Usage:
 `run` executes the flagship Raft config in CHUNK-tick dispatches and
 after each chunk records a digest (two int32 folds) of every carry
 leaf. Environment knobs: XVAL_INSTANCES, XVAL_TICKS, XVAL_CHUNK,
-XVAL_SEED, and the usual JAX_PLATFORMS for backend selection.
+XVAL_SEED, XVAL_LAYOUT (carry layout auto/lead/minor — digests are
+canonical, so captures compare across layouts), and the usual
+JAX_PLATFORMS for backend selection.
 """
 
 from __future__ import annotations
@@ -57,10 +59,12 @@ def cmd_run(out_path: str) -> None:
     n_ticks = int(os.environ.get("XVAL_TICKS", 225))
     chunk = int(os.environ.get("XVAL_CHUNK", 25))
     seed = int(os.environ.get("XVAL_SEED", 7))
+    layout = os.environ.get("XVAL_LAYOUT", "auto")
 
     platform = jax.devices()[0].platform
     print(f"xval: {platform}, {I} instances, {n_ticks} ticks "
-          f"in {chunk}-tick chunks", file=sys.stderr, flush=True)
+          f"in {chunk}-tick chunks, layout={layout}",
+          file=sys.stderr, flush=True)
 
     model = RaftModel(n_nodes_hint=3, log_cap=64, heartbeat=8)
     opts = dict(node_count=3, concurrency=6, n_instances=I,
@@ -72,7 +76,7 @@ def cmd_run(out_path: str) -> None:
                 # captures silently never partitioned: interval 400
                 # ticks vs a 150-225 tick horizon)
                 nemesis_interval=0.04, p_loss=0.05, recovery_time=0.0,
-                seed=seed)
+                seed=seed, layout=layout)
     sim = make_sim_config(model, opts)
     params = model.make_params(sim.net.n_nodes)
     carry = init_carry(model, sim, seed, params)
@@ -98,6 +102,7 @@ def cmd_run(out_path: str) -> None:
 
     result = {
         "platform": platform,
+        "layout": sim.layout,   # informational: digests are canonical
         "instances": I,
         "ticks": n_ticks,
         "chunk": chunk,
