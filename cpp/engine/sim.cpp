@@ -66,22 +66,41 @@ struct Cfg {
                               // per-instance RNG keys on the GLOBAL id,
                               // so any contiguous (or singleton) slice
                               // of a big fleet replays bit-exactly
+  // --- txn-list-append workload (models/txn_raft.py's role natively:
+  // a whole transaction is ONE Raft log entry, applied atomically at
+  // commit, leader replies read results at apply time — the
+  // reference's txn_list_append.clj:74-143 semantics over Raft)
+  int64_t workload;           // 0 = lin-kv, 1 = txn-list-append
+  int64_t txn_max;            // micro-ops per txn (<= TXN_CAP)
+  int64_t list_cap;           // per-key list capacity; an append txn
+                              // that would overflow aborts WHOLE with
+                              // error 30 (atomicity preserved)
+  double read_prob;           // P(micro-op is a read)
+  int64_t flag_txn_dirty_apply;  // BUG: apply + reply at APPEND time
+                                 // (uncommitted) — leader changes
+                                 // truncate acked txns; Elle catches
+                                 // lost appends / aborted reads
 };
+
+constexpr int TXN_CAP = 4;    // engine-wide micro-op slot bound
 
 // ------------------------------------------------------------ message
 enum MType : int32_t {
   M_NONE = 0, M_READ = 1, M_WRITE = 2, M_CAS = 3,
   M_READ_OK = 4, M_WRITE_OK = 5, M_CAS_OK = 6,
   M_REQ_VOTE = 7, M_VOTE_REPLY = 8, M_APPEND = 9, M_APPEND_REPLY = 10,
+  M_TXN = 20, M_TXN_OK = 21,
   M_ERROR = 127
 };
 
 // body lanes: protocol lanes 0..5; AppendEntries carries its full
-// entry in lanes 6..11 (f, k, a, b, client, cmsg); client requests
-// keep their forward-hop counter in lane 12
-constexpr int BODY_LANES = 13;
+// entry in lanes 6.. (lin-kv: f, k, a, b, client, cmsg; txn: len,
+// (f,k,v)*TXN_CAP, client, cmsg); client requests keep their
+// forward-hop counter in lane L_HOPS
+constexpr int BODY_LANES = 6 + 1 + 3 * TXN_CAP + 2;   // 21
 constexpr int L_ENTRY = 6;
-constexpr int L_HOPS = 12;
+constexpr int L_HOPS = 12;              // lin-kv request hop counter
+constexpr int L_THOPS = 1 + 3 * TXN_CAP;  // txn request hop counter (13)
 
 struct Msg {
   int32_t valid = 0;
@@ -90,14 +109,27 @@ struct Msg {
   int32_t msg_id = -1, reply_to = -1;
   int32_t dtick = 0;
   int32_t body[BODY_LANES] = {0};
+  // variable payload for txn read results (M_TXN_OK): the in-process
+  // "wire" models message COUNT and latency, not byte layout, so a
+  // reply may carry its read lists out of band (empty => no heap
+  // traffic on the lin-kv hot path)
+  std::vector<int32_t> ext;
 };
 
 // --------------------------------------------------------------- raft
 struct Entry {
   int32_t f = 0, k = 0, a = 0, b = 0, client = -1, cmsg = -1;
+  // txn workload: tlen > 0 marks a transaction entry of tlen micro-ops
+  int32_t tlen = 0;
+  int32_t top[TXN_CAP][3] = {};   // (f, k, v) per micro-op
   bool operator==(const Entry& o) const {
-    return f == o.f && k == o.k && a == o.a && b == o.b &&
-           client == o.client && cmsg == o.cmsg;
+    if (!(f == o.f && k == o.k && a == o.a && b == o.b &&
+          client == o.client && cmsg == o.cmsg && tlen == o.tlen))
+      return false;
+    for (int j = 0; j < TXN_CAP; ++j)
+      for (int x = 0; x < 3; ++x)
+        if (top[j][x] != o.top[j][x]) return false;
+    return true;
   }
 };
 
@@ -110,16 +142,21 @@ struct Node {
   std::vector<int32_t> log_term;
   std::vector<Entry> log_body;
   std::vector<int32_t> kv;
+  std::vector<std::vector<int32_t>> lists;   // txn workload state
   std::vector<int32_t> next_idx, match_idx;
 };
 
 enum Etype : int32_t { EV_INVOKE = 1, EV_OK = 2, EV_FAIL = 3, EV_INFO = 4 };
 enum Fcode : int32_t { F_READ = 1, F_WRITE = 2, F_CAS = 3 };
+// txn micro-op f codes (models/txn_raft.py MF_R / MF_APPEND)
+enum TxnF : int32_t { F_TXN_R = 1, F_TXN_APPEND = 2 };
 
 struct Client {
   int32_t status = 0;           // 0 idle / 1 waiting
   int32_t f = 0, k = 0, a = 0, b = 0;
   int32_t msg_id = -1, next_msg_id = 0, invoked = 0;
+  int32_t tlen = 0;             // txn workload: the outstanding txn
+  int32_t tops[TXN_CAP][3] = {};
 };
 
 struct Stats {
@@ -140,15 +177,27 @@ struct Instance {
 };
 
 struct Recorder {
-  int32_t* out = nullptr;   // [cap * 7]: tick, client, etype, f, k, v, b
+  // lin-kv rows [width=7]: tick, client, etype, f, k, v, b
+  // txn rows [width=4+3*txn_max+txn_max*list_cap]: tick, client,
+  //   etype, len, (f, k, v|rlen)*txn_max, then txn_max blocks of
+  //   list_cap read values
+  int32_t* out = nullptr;
   int64_t n = 0, cap = 0;
+  int32_t width = 7;
   void event(int32_t tick, int32_t client, int32_t etype, int32_t f,
              int32_t k, int32_t v, int32_t b) {
     if (!out || n >= cap) return;
-    int32_t* p = out + n * 7;
+    int32_t* p = out + n * width;
     p[0] = tick; p[1] = client; p[2] = etype; p[3] = f;
     p[4] = k; p[5] = v; p[6] = b;
     ++n;
+  }
+  int32_t* row() {              // txn rows: caller fills a zeroed row
+    if (!out || n >= cap) return nullptr;
+    int32_t* p = out + n * width;
+    std::memset(p, 0, sizeof(int32_t) * size_t(width));
+    ++n;
+    return p;
   }
 };
 
@@ -206,7 +255,9 @@ struct Sim {
       in.side[i] = int8_t(in.rng.below(2));
   }
 
-  // enqueue with latency/loss (client edges at zero latency)
+  // enqueue with latency/loss (client edges at zero latency).
+  // By value: callers std::move their Msg in, so a txn reply's ext
+  // payload is never copied on the hot path.
   void send(Instance& in, int32_t t, Msg m) {
     ++in.stats.sent;
     bool client_edge = m.origin >= cfg.n_nodes || m.dest >= cfg.n_nodes;
@@ -222,7 +273,11 @@ struct Sim {
     }
     m.dtick = t + 1 + lat;
     for (auto& slot : in.pool) {
-      if (!slot.valid) { slot = m; slot.valid = 1; return; }
+      if (!slot.valid) {
+        slot = std::move(m);   // txn replies carry a heap ext payload
+        slot.valid = 1;
+        return;
+      }
     }
     ++in.stats.dropped_overflow;
   }
@@ -233,13 +288,133 @@ struct Sim {
     r.valid = 1; r.src = me; r.origin = me; r.dest = req.src;
     r.type = type; r.reply_to = req.msg_id;
     r.body[0] = b0; r.body[1] = b1; r.body[2] = b2;
-    send(in, t, r);
+    send(in, t, std::move(r));
+  }
+
+  // --- txn-list-append state machine ---------------------------------
+  // Apply one committed txn entry atomically: capacity pre-check (an
+  // append set that would overflow any key's list_cap aborts the WHOLE
+  // txn, error 30 — models/txn_raft.py's documented semantics), then
+  // micro-ops in order with reads seeing the txn's own earlier appends.
+  // `reply` (leader only) sends M_TXN_OK carrying read results: body =
+  // [len, (f, k, v|rlen)*], ext = concatenated read values.
+  void apply_txn(Instance& in, int32_t t, int32_t me, Node& nd,
+                 const Entry& e, bool reply) {
+    int32_t grow[64] = {0};
+    bool abort = false;
+    for (int32_t j = 0; j < e.tlen && !abort; ++j) {
+      if (e.top[j][0] != F_TXN_R) {
+        int32_t k = e.top[j][1];
+        if (int64_t(nd.lists[k].size()) + grow[k] >= cfg.list_cap)
+          abort = true;
+        else
+          ++grow[k];
+      }
+    }
+    if (abort) {
+      if (reply && e.client >= 0) {
+        Msg r;
+        r.valid = 1; r.src = me; r.origin = me; r.dest = e.client;
+        r.type = M_ERROR; r.reply_to = e.cmsg;
+        r.body[0] = 30;   // txn-conflict, definite
+        send(in, t, std::move(r));
+      }
+      return;
+    }
+    Msg r;
+    r.body[0] = e.tlen;
+    for (int32_t j = 0; j < e.tlen; ++j) {
+      int32_t f = e.top[j][0], k = e.top[j][1], v = e.top[j][2];
+      r.body[1 + 3 * j] = f;
+      r.body[2 + 3 * j] = k;
+      if (f == F_TXN_R) {
+        r.body[3 + 3 * j] = int32_t(nd.lists[k].size());
+        if (reply)
+          r.ext.insert(r.ext.end(), nd.lists[k].begin(),
+                       nd.lists[k].end());
+      } else {
+        nd.lists[k].push_back(v);
+        r.body[3 + 3 * j] = v;
+      }
+    }
+    if (reply && e.client >= 0) {
+      r.valid = 1; r.src = me; r.origin = me; r.dest = e.client;
+      r.type = M_TXN_OK; r.reply_to = e.cmsg;
+      send(in, t, std::move(r));
+    }
+  }
+
+  // AppendEntries entry <-> wire lanes (L_ENTRY..): lin-kv entries use
+  // 6 lanes (f,k,a,b,client,cmsg); txn entries use 1+3*TXN_CAP+2
+  // (len, micro-ops, client, cmsg) — dispatch on cfg.workload
+  Entry entry_from_wire(const Msg& m) const {
+    Entry e;
+    if (cfg.workload == 1) {
+      e.tlen = m.body[L_ENTRY + 0];
+      for (int32_t j = 0; j < TXN_CAP; ++j)
+        for (int32_t x = 0; x < 3; ++x)
+          e.top[j][x] = m.body[L_ENTRY + 1 + 3 * j + x];
+      e.client = m.body[L_ENTRY + 1 + 3 * TXN_CAP];
+      e.cmsg = m.body[L_ENTRY + 2 + 3 * TXN_CAP];
+    } else {
+      e.f = m.body[L_ENTRY + 0]; e.k = m.body[L_ENTRY + 1];
+      e.a = m.body[L_ENTRY + 2]; e.b = m.body[L_ENTRY + 3];
+      e.client = m.body[L_ENTRY + 4];
+      e.cmsg = m.body[L_ENTRY + 5];
+    }
+    return e;
+  }
+
+  void entry_to_wire(Msg& a, const Entry& e) const {
+    if (cfg.workload == 1) {
+      a.body[L_ENTRY + 0] = e.tlen;
+      for (int32_t j = 0; j < TXN_CAP; ++j)
+        for (int32_t x = 0; x < 3; ++x)
+          a.body[L_ENTRY + 1 + 3 * j + x] = e.top[j][x];
+      a.body[L_ENTRY + 1 + 3 * TXN_CAP] = e.client;
+      a.body[L_ENTRY + 2 + 3 * TXN_CAP] = e.cmsg;
+    } else {
+      a.body[L_ENTRY + 0] = e.f; a.body[L_ENTRY + 1] = e.k;
+      a.body[L_ENTRY + 2] = e.a; a.body[L_ENTRY + 3] = e.b;
+      a.body[L_ENTRY + 4] = e.client;
+      a.body[L_ENTRY + 5] = e.cmsg;
+    }
   }
 
   void handle(Instance& in, int32_t t, int32_t me, const Msg& m) {
     Node& nd = in.nodes[me];
     int32_t n = int32_t(cfg.n_nodes);
     switch (m.type) {
+      case M_TXN: {
+        bool leader = nd.role == 2;
+        if (leader && nd.log_len < cfg.log_cap) {
+          Entry e;
+          e.tlen = std::min(m.body[0], int32_t(TXN_CAP));
+          for (int32_t j = 0; j < e.tlen; ++j)
+            for (int32_t x = 0; x < 3; ++x)
+              e.top[j][x] = m.body[1 + 3 * j + x];
+          e.client = m.src; e.cmsg = m.msg_id;
+          nd.log_term[nd.log_len] = nd.term;
+          nd.log_body[nd.log_len] = e;
+          nd.log_len += 1;
+          nd.match_idx[me] = nd.log_len;
+          if (cfg.flag_txn_dirty_apply) {
+            // BUG: apply + reply NOW, before any replication — an
+            // acked txn a new leader then truncates is simply gone
+            apply_txn(in, t, me, nd, e, true);
+            nd.last_applied = std::max(nd.last_applied, nd.log_len);
+          }
+        } else if (!leader && nd.leader_hint >= 0 &&
+                   nd.leader_hint != me && m.body[L_THOPS] < 3) {
+          Msg f = m;                 // forward toward the leader
+          f.origin = me; f.dest = nd.leader_hint;
+          f.body[L_THOPS] += 1;
+          send(in, t, std::move(f));
+        } else {
+          node_reply(in, t, me, m, M_ERROR, 11, 0, 0);
+        }
+        break;
+      }
       case M_REQ_VOTE: {
         int32_t c_term = m.body[0], c_len = m.body[1], c_llt = m.body[2];
         if (c_term > nd.term) become_follower(nd, c_term);
@@ -291,13 +466,14 @@ struct Sim {
             if (!same) {
               if (prev < nd.commit_idx) nd.truncated_committed = 1;
               nd.log_term[prev] = e_term;
-              Entry e;
-              e.f = m.body[L_ENTRY + 0]; e.k = m.body[L_ENTRY + 1];
-              e.a = m.body[L_ENTRY + 2]; e.b = m.body[L_ENTRY + 3];
-              e.client = m.body[L_ENTRY + 4];
-              e.cmsg = m.body[L_ENTRY + 5];
+              Entry e = entry_from_wire(m);
               nd.log_body[prev] = e;
               nd.log_len = prev + 1;
+              // BUG flag: followers install txn effects at APPEND time;
+              // a later truncation overwrites the log but the list
+              // state keeps the dirty appends (lost/aborted reads)
+              if (cfg.flag_txn_dirty_apply && e.tlen > 0)
+                apply_txn(in, t, me, nd, e, false);
             } else {
               nd.log_len = std::max(nd.log_len, prev + 1);
             }
@@ -351,7 +527,7 @@ struct Sim {
           Msg f = m;                 // forward toward the leader
           f.origin = me; f.dest = nd.leader_hint;
           f.body[L_HOPS] += 1;
-          send(in, t, f);
+          send(in, t, std::move(f));
         } else {
           node_reply(in, t, me, m, M_ERROR, 11, 0, 0);
         }
@@ -395,6 +571,14 @@ struct Sim {
     // apply committed entries (leader replies to clients)
     while (nd.last_applied < nd.commit_idx) {
       const Entry& e = nd.log_body[nd.last_applied];
+      if (e.tlen > 0) {
+        // txn entry: atomic apply at commit (dirty-apply already
+        // installed effects + replied at append time — don't redo)
+        if (!cfg.flag_txn_dirty_apply)
+          apply_txn(in, t, me, nd, e, nd.role == 2);
+        nd.last_applied += 1;
+        continue;
+      }
       int32_t k = std::min(std::max(e.k, 0), int32_t(cfg.n_keys) - 1);
       int32_t cur = nd.kv[k];
       bool cas_ok = cur == e.a;
@@ -414,7 +598,7 @@ struct Sim {
         } else {
           r.type = M_ERROR; r.body[0] = cur == NIL ? 20 : 22;
         }
-        send(in, t, r);
+        send(in, t, std::move(r));
       }
     }
 
@@ -430,7 +614,7 @@ struct Sim {
         v.type = M_REQ_VOTE;
         v.body[0] = nd.term; v.body[1] = nd.log_len;
         v.body[2] = last_log_term(nd);
-        send(in, t, v);
+        send(in, t, std::move(v));
       }
     }
     if (hb) {
@@ -448,13 +632,46 @@ struct Sim {
         a.body[4] = has ? 1 : 0;
         if (has) {
           a.body[5] = nd.log_term[prev];
-          const Entry& e = nd.log_body[prev];
-          a.body[L_ENTRY + 0] = e.f; a.body[L_ENTRY + 1] = e.k;
-          a.body[L_ENTRY + 2] = e.a; a.body[L_ENTRY + 3] = e.b;
-          a.body[L_ENTRY + 4] = e.client;
-          a.body[L_ENTRY + 5] = e.cmsg;
+          entry_to_wire(a, nd.log_body[prev]);
         }
-        send(in, t, a);
+        send(in, t, std::move(a));
+      }
+    }
+  }
+
+  // txn event row: [tick, client, etype, len, (f, k, v|rlen)*txn_max,
+  // txn_max blocks of list_cap read values]. OK rows take micro-ops +
+  // read results from the reply; invoke/fail/info echo the client's
+  // pending ops (v = NIL on reads).
+  void record_txn(Recorder& rec, int32_t t, int32_t c, int32_t etype,
+                  const Client& cl, const Msg* ok) const {
+    int32_t* p = rec.row();
+    if (!p) return;
+    p[0] = t; p[1] = c; p[2] = etype;
+    int32_t base = 4 + 3 * int32_t(cfg.txn_max);
+    if (ok) {
+      int32_t len = std::min(ok->body[0], int32_t(cfg.txn_max));
+      p[3] = len;
+      size_t off = 0;
+      for (int32_t j = 0; j < len; ++j) {
+        int32_t f = ok->body[1 + 3 * j];
+        p[4 + 3 * j] = f;
+        p[5 + 3 * j] = ok->body[2 + 3 * j];
+        p[6 + 3 * j] = ok->body[3 + 3 * j];
+        if (f == F_TXN_R) {
+          int32_t rlen = std::min(ok->body[3 + 3 * j],
+                                  int32_t(cfg.list_cap));
+          for (int32_t i = 0; i < rlen && off < ok->ext.size(); ++i)
+            p[base + j * int32_t(cfg.list_cap) + i] =
+                ok->ext[off++];
+        }
+      }
+    } else {
+      p[3] = cl.tlen;
+      for (int32_t j = 0; j < cl.tlen; ++j) {
+        p[4 + 3 * j] = cl.tops[j][0];
+        p[5 + 3 * j] = cl.tops[j][1];
+        p[6 + 3 * j] = cl.tops[j][2];
       }
     }
   }
@@ -498,6 +715,8 @@ struct Sim {
         nd.log_term.assign(cfg.log_cap, 0);
         nd.log_body.assign(cfg.log_cap, Entry{});
         nd.kv.assign(cfg.n_keys, NIL);
+        if (cfg.workload == 1)
+          nd.lists.assign(cfg.n_keys, {});
         nd.next_idx.assign(cfg.n_nodes, 0);
         nd.match_idx.assign(cfg.n_nodes, 0);
       }
@@ -584,7 +803,7 @@ struct Sim {
         Msg& msg = in.pool[due_slot[d]];
         if (taken_for[msg.dest] >= cfg.inbox_k) continue;
         ++taken_for[msg.dest];
-        inbox.push_back(msg);
+        inbox.push_back(std::move(msg));   // slot is dead after this
         msg.valid = 0;
         ++in.stats.delivered;
       }
@@ -614,19 +833,64 @@ struct Sim {
         etype = EV_OK;
         v = m.type == M_READ_OK ? m.body[1] : cl.a;
       }
-      if (rec) rec->event(t, c, etype, cl.f, cl.k, v, cl.b);
+      if (rec) {
+        if (cfg.workload == 1)
+          record_txn(*rec, t, c, etype, cl,
+                     m.type == M_TXN_OK ? &m : nullptr);
+        else
+          rec->event(t, c, etype, cl.f, cl.k, v, cl.b);
+      }
       cl.status = 0;
     }
     for (int32_t c = 0; c < cfg.n_clients; ++c) {
       Client& cl = in.clients[c];
       if (cl.status == 1 && t - cl.invoked >= cfg.timeout_ticks) {
         // reads are idempotent -> fail; others stay indefinite
-        int32_t etype = cl.f == F_READ ? EV_FAIL : EV_INFO;
-        if (rec) rec->event(t, c, etype, cl.f, cl.k, cl.a, cl.b);
+        // (whole transactions are never idempotent)
+        int32_t etype = (cfg.workload == 0 && cl.f == F_READ)
+                            ? EV_FAIL : EV_INFO;
+        if (rec) {
+          if (cfg.workload == 1)
+            record_txn(*rec, t, c, etype, cl, nullptr);
+          else
+            rec->event(t, c, etype, cl.f, cl.k, cl.a, cl.b);
+        }
         cl.status = 0;
       }
       if (cl.status == 0 && in.rng.uniform() < cfg.rate) {
         bool final_phase = t >= cfg.final_start;
+        if (cfg.workload == 1) {
+          cl.tlen = 1 + in.rng.below(int32_t(cfg.txn_max));
+          for (int32_t j = 0; j < cl.tlen; ++j) {
+            bool rd = final_phase || in.rng.uniform() < cfg.read_prob;
+            cl.tops[j][0] = rd ? F_TXN_R : F_TXN_APPEND;
+            cl.tops[j][1] = in.rng.below(int32_t(cfg.n_keys));
+            // unique positive append values per instance (Elle's
+            // version-order inference needs them,
+            // txn_list_append.clj:30-38): minted from the
+            // client-striped op counter like the device runtime
+            cl.tops[j][2] = rd ? NIL
+                : 1 + (cl.next_msg_id * int32_t(cfg.n_clients) + c)
+                      * int32_t(cfg.txn_max) + j;
+          }
+          cl.msg_id = cl.next_msg_id++;
+          cl.invoked = t;
+          cl.status = 1;
+          if (rec) record_txn(*rec, t, c, EV_INVOKE, cl, nullptr);
+          Msg q;
+          q.valid = 1;
+          q.src = int32_t(cfg.n_nodes) + c;
+          q.origin = q.src;
+          q.dest = in.rng.below(int32_t(cfg.n_nodes));
+          q.type = M_TXN;
+          q.msg_id = cl.msg_id;
+          q.body[0] = cl.tlen;
+          for (int32_t j = 0; j < cl.tlen; ++j)
+            for (int32_t x = 0; x < 3; ++x)
+              q.body[1 + 3 * j + x] = cl.tops[j][x];
+          send(in, t, std::move(q));
+          continue;
+        }
         double r = in.rng.uniform();
         cl.f = final_phase ? F_READ
                : r < 1.0 / 3 ? F_READ
@@ -648,7 +912,7 @@ struct Sim {
                  : cl.f == F_WRITE ? M_WRITE : M_CAS;
         q.msg_id = cl.msg_id;
         q.body[0] = cl.k; q.body[1] = cl.a; q.body[2] = cl.b;
-        send(in, t, q);
+        send(in, t, std::move(q));
       }
     }
 
@@ -665,7 +929,8 @@ extern "C" {
 // nemesis_enabled, nemesis_interval, stop_tick, final_start, heartbeat,
 // log_cap, elect_min, elect_jitter, n_keys, n_vals, flag_stale_read,
 // flag_eager_commit, flag_no_term_guard, max_events, n_threads,
-// instance_base
+// instance_base, workload, txn_max, list_cap, read_prob_micro,
+// flag_txn_dirty_apply  (33 fields)
 int64_t native_sim_run_sched(const int64_t* c, int64_t* stats_out,
                              int32_t* violations_out,
                              int32_t* events_out,
@@ -706,12 +971,26 @@ int64_t native_sim_run_sched(const int64_t* c, int64_t* stats_out,
   cfg.max_events = c[25];
   int64_t n_threads = c[26] > 0 ? c[26] : 1;
   cfg.instance_base = c[27];
+  cfg.workload = c[28];
+  cfg.txn_max = c[29];
+  cfg.list_cap = c[30];
+  cfg.read_prob = double(c[31]) / 1e6;
+  cfg.flag_txn_dirty_apply = c[32];
   if (cfg.nemesis_interval <= 0) cfg.nemesis_interval = 1;
   if (cfg.n_nodes > 30) return -1;   // votes bitmask width
   if (cfg.pool_slots > 64 || cfg.n_nodes + cfg.n_clients > 64)
     return -1;                       // deliver scratch-array bounds
   if (n_phases > 0 && cfg.n_nodes > 8)
     return -1;                       // schedule bitmask width
+  if (cfg.workload == 1) {
+    if (cfg.txn_max < 1 || cfg.txn_max > TXN_CAP) return -1;
+    if (cfg.list_cap < 1 || cfg.list_cap > 4096) return -1;
+    if (cfg.n_keys > 64) return -1;  // apply_txn grow-array bound
+  }
+
+  // event row width is workload-dependent (see Recorder)
+  int64_t ev_w = cfg.workload == 1
+      ? 4 + 3 * cfg.txn_max + cfg.txn_max * cfg.list_cap : 7;
 
   Sim sim;
   sim.cfg = cfg;
@@ -720,8 +999,9 @@ int64_t native_sim_run_sched(const int64_t* c, int64_t* stats_out,
                                    uint64_t(sched_flat[i * 2 + 1])});
   sim.recs.resize(cfg.record);
   for (int64_t i = 0; i < cfg.record; ++i) {
-    sim.recs[i].out = events_out + i * cfg.max_events * 7;
+    sim.recs[i].out = events_out + i * cfg.max_events * ev_w;
     sim.recs[i].cap = cfg.max_events;
+    sim.recs[i].width = int32_t(ev_w);
   }
   sim.run(n_threads);
 

@@ -179,12 +179,14 @@ def cmd_test(args) -> int:
             log_net_recv=args.log_net_recv, seed=args.seed,
             store_root=args.store))
     elif args.runtime == "native":
-        # the C++ scalar engine (cpp/engine): lin-kv/Raft fleets on
-        # hosts without an accelerator — same checkers, same artifacts
-        if args.workload != "lin-kv":
-            print("error: --runtime native currently implements the "
-                  "lin-kv (Raft) workload only; use --runtime tpu for "
-                  "the full model set", file=sys.stderr)
+        # the C++ scalar engine (cpp/engine): lin-kv and
+        # txn-list-append Raft fleets on hosts without an accelerator —
+        # same checkers, same artifacts
+        if args.workload not in ("lin-kv", "txn-list-append"):
+            print("error: --runtime native implements the lin-kv and "
+                  "txn-list-append (Raft) workloads only; use "
+                  "--runtime tpu for the full model set",
+                  file=sys.stderr)
             return 2
         if args.nemesis_kind == "scripted" \
                 and not args.nemesis_schedule_file:
@@ -200,16 +202,21 @@ def cmd_test(args) -> int:
                 return 2
             if "partition" not in args.nemesis:
                 args.nemesis = list(args.nemesis) + ["partition"]
-        for val, name, default in (
-                (args.availability, "--availability", None),
-                (args.consistency_models, "--consistency-models", None),
-                (args.latency_dist, "--latency-dist", "exponential")):
+        notes = [(args.availability, "--availability", None),
+                 (args.latency_dist, "--latency-dist", "exponential")]
+        if args.workload == "lin-kv":
+            # txn-list-append IS model-selectable (Elle); lin-kv is WGL
+            notes.append((args.consistency_models,
+                          "--consistency-models", None))
+        for val, name, default in notes:
             if val != default:
                 print(f"note: {name} has no effect on the native "
-                      f"runtime (exponential latency, WGL checking "
-                      f"only)", file=sys.stderr)
+                      f"{args.workload} runtime (exponential latency; "
+                      f"lin-kv is WGL-checked)", file=sys.stderr)
         from .native.harness import run_native_test
         results = run_native_test(dict(
+            workload=args.workload,
+            consistency_models=args.consistency_models,
             node_count=node_count, concurrency=concurrency,
             rate=args.rate, time_limit=args.time_limit,
             latency=args.latency, p_loss=args.p_loss,

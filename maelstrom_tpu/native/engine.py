@@ -83,6 +83,44 @@ def native_available() -> bool:
     return _load() is not None
 
 
+def _decode_txn_history(ev: np.ndarray, ms_per_tick: float,
+                        final_start: int, txn_max: int,
+                        list_cap: int) -> List[dict]:
+    """txn rows [n, 4 + 3*txn_max + txn_max*list_cap] -> Elle's
+    micro-op history: value = [[f, k, v], ...] with f in
+    {"append", "r"}; ok reads carry their lists, invoke reads None."""
+    hist: List[dict] = []
+    base = 4 + 3 * txn_max
+    for row in ev:
+        tick, client, etype, ln = (int(row[0]), int(row[1]),
+                                   int(row[2]), int(row[3]))
+        ops: List[Any] = []
+        for j in range(min(ln, txn_max)):
+            f, k, v = (int(row[4 + 3 * j]), int(row[5 + 3 * j]),
+                       int(row[6 + 3 * j]))
+            if f == 1:      # read
+                if etype == EV_OK:
+                    rlen = min(v, list_cap)
+                    vals = [int(x) for x in
+                            row[base + j * list_cap:
+                                base + j * list_cap + rlen]]
+                    ops.append(["r", k, vals])
+                else:
+                    ops.append(["r", k, None])
+            else:           # append
+                ops.append(["append", k, int(v)])
+        rec = {"process": client,
+               "type": ("invoke" if etype == EV_INVOKE
+                        else ETYPE_NAMES[etype]),
+               "f": "txn", "value": ops}
+        if etype == EV_INVOKE and tick >= final_start:
+            rec["final"] = True
+        rec["time"] = int(tick * ms_per_tick * 1_000_000)
+        rec["index"] = len(hist)
+        hist.append(rec)
+    return hist
+
+
 def _decode_history(ev: np.ndarray, ms_per_tick: float,
                     final_start: int) -> List[dict]:
     """events [n, 7] (tick, client, etype, f, k, v, b) -> the checker's
@@ -141,6 +179,10 @@ def run_native_sim(opts: Optional[Dict[str, Any]] = None
         elect_min=30, elect_jitter=30, n_keys=5, n_vals=5,
         ms_per_tick=1, seed=7,
         stale_read=False, eager_commit=False, no_term_guard=False,
+        # txn-list-append workload (cpp/engine txn mode — the native
+        # twin of models/txn_raft.py)
+        workload="lin-kv", txn_max=3, list_cap=16, read_prob=0.5,
+        txn_dirty_apply=False,
         # instances are independent, so worker threads each own a
         # contiguous block end-to-end; per-instance trajectories are
         # identical at ANY thread count (RNG is a pure function of
@@ -161,8 +203,16 @@ def run_native_sim(opts: Optional[Dict[str, Any]] = None
     rate = min(1.0, float(o["rate"]) / C / 1000.0 * mpt)
     max_events = max(64, 2 * C * n_ticks // 4)
 
+    _workloads = {"lin-kv": 0, "txn-list-append": 1}
+    if o["workload"] not in _workloads:
+        raise ValueError(f"unknown native workload {o['workload']!r} "
+                         f"(expected one of {sorted(_workloads)})")
+    workload = _workloads[o["workload"]]
+    txn_max, list_cap = int(o["txn_max"]), int(o["list_cap"])
+    ev_w = 4 + 3 * txn_max + txn_max * list_cap if workload == 1 else 7
+
     threads = int(o["threads"]) or (os.cpu_count() or 1)
-    cfg = (ctypes.c_int64 * 28)(
+    cfg = (ctypes.c_int64 * 33)(
         int(o["seed"]), I, n_ticks, int(o["node_count"]), C, R,
         int(o["pool_slots"]), int(o["inbox_k"]),
         int(float(o["latency"]) / mpt * 1000),
@@ -178,11 +228,14 @@ def run_native_sim(opts: Optional[Dict[str, Any]] = None
         1 if o["stale_read"] else 0,
         1 if o["eager_commit"] else 0,
         1 if o["no_term_guard"] else 0,
-        max_events, threads, int(o.get("instance_base", 0)))
+        max_events, threads, int(o.get("instance_base", 0)),
+        workload, txn_max, list_cap,
+        int(float(o["read_prob"]) * 1e6),
+        1 if o["txn_dirty_apply"] else 0)
 
     stats = (ctypes.c_int64 * 5)()
     violations = np.zeros(I, dtype=np.int32)
-    events = np.zeros((R, max_events, 7), dtype=np.int32)
+    events = np.zeros((R, max_events, ev_w), dtype=np.int32)
     n_events = np.zeros(R, dtype=np.int64)
 
     # scripted nemesis: ((until_tick, ((dst, src), ...)), ...) — the
@@ -219,9 +272,15 @@ def run_native_sim(opts: Optional[Dict[str, Any]] = None
     if rc != 0:
         return None
 
-    histories = [
-        _decode_history(events[i, :n_events[i]], mpt, final_start)
-        for i in range(R)]
+    if workload == 1:
+        histories = [
+            _decode_txn_history(events[i, :n_events[i]], mpt,
+                                final_start, txn_max, list_cap)
+            for i in range(R)]
+    else:
+        histories = [
+            _decode_history(events[i, :n_events[i]], mpt, final_start)
+            for i in range(R)]
     truncated_per_instance = [bool(n_events[i] >= max_events)
                               for i in range(R)]
     return {

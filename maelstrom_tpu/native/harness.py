@@ -11,6 +11,22 @@ from typing import Any, Dict, Optional
 from .engine import native_available, run_native_sim
 
 
+def _checker_for(workload: str, consistency_model: str = None):
+    """Full-history checker per native workload: WGL linearizability
+    for lin-kv, Elle for txn-list-append at the requested consistency
+    model (default strict-serializable — the reference's per-workload
+    checker split, txn_list_append.clj)."""
+    if workload == "txn-list-append":
+        from ..checkers.elle import check_list_append
+        model = consistency_model or "strict-serializable"
+        return lambda h: check_list_append(h, consistency_model=model)
+    if workload != "lin-kv":
+        raise ValueError(f"unknown native workload {workload!r} "
+                         "(expected lin-kv or txn-list-append)")
+    from ..checkers.linearizable import linearizable_kv_checker
+    return linearizable_kv_checker
+
+
 def run_native_test(opts: Optional[Dict[str, Any]] = None
                     ) -> Dict[str, Any]:
     opts = dict(opts or {})
@@ -27,12 +43,13 @@ def run_native_test(opts: Optional[Dict[str, Any]] = None
             "<=30 nodes, <=64 pool slots, <=64 endpoints)")
 
     from ..checkers import compose_valid
-    from ..checkers.linearizable import linearizable_kv_checker
 
+    checker = _checker_for(opts.get("workload", "lin-kv"),
+                           opts.get("consistency_models"))
     per_instance = []
     for i, h in enumerate(res["histories"]):
         try:
-            v = linearizable_kv_checker(h)
+            v = checker(h)
         except Exception as e:   # checker blow-up is a result
             v = {"valid?": False, "error": repr(e)}
         v["instance"] = i
@@ -100,7 +117,7 @@ def run_native_test(opts: Optional[Dict[str, Any]] = None
                     replayed_violating += 1
             funnel_hists[base + i] = h
             try:
-                v = linearizable_kv_checker(h)
+                v = checker(h)
             except Exception as e:
                 v = {"valid?": False, "error": repr(e)}
             if trunc and v.get("valid?") is True:
@@ -117,7 +134,8 @@ def run_native_test(opts: Optional[Dict[str, Any]] = None
         }
     if opts.get("store_root"):
         from ..tpu.harness import _write_store
-        _write_store("lin-kv", opts["store_root"], results,
+        _write_store(opts.get("workload", "lin-kv"),
+                     opts["store_root"], results,
                      res["histories"], suffix="-native",
                      funnel={"histories": funnel_hists}
                      if funnel_hists else None)

@@ -22,7 +22,7 @@ XVAL_S="${TPU_XVAL_S:-600}"
 REBENCH_AFTER_S="${TPU_REBENCH_AFTER_S:-2700}"
 
 probe() {
-  timeout "$PROBE_S" python -c "
+  timeout -k 10 "$PROBE_S" python -c "
 import jax
 d = jax.devices()
 assert d[0].platform == 'tpu', d
@@ -68,7 +68,7 @@ EOF
 
 run_bench() {
   echo "$(date +%s) bench: starting (deadline ${BENCH_S}s)" >> "$HEALTH_LOG"
-  out="$(timeout "$BENCH_S" python bench.py 2>/tmp/tpu_bench_err.log)"
+  out="$(timeout -k 15 "$BENCH_S" python bench.py 2>/tmp/tpu_bench_err.log)"
   rc=$?
   line="$(printf '%s\n' "$out" | grep '"metric"' | tail -1)"
   python - "$rc" "$line" <<'EOF'
@@ -106,7 +106,7 @@ EOF
 run_xval() {
   echo "$(date +%s) xval: starting (deadline ${XVAL_S}s)" >> "$HEALTH_LOG"
   XVAL_INSTANCES=32768 XVAL_TICKS=150 XVAL_CHUNK=25 XVAL_SEED=7 \
-    timeout "$XVAL_S" python tools/platform_xval.py run \
+    timeout -k 15 "$XVAL_S" python tools/platform_xval.py run \
     artifacts/xval_tpu_32k.json 2>>/tmp/tpu_xval_err.log
 }
 
@@ -145,7 +145,7 @@ while true; do
     if [ ! -f artifacts/scaling_tpu.jsonl ] \
         && [ ! -f artifacts/scaling_tpu_partial.jsonl ]; then
       echo "$(date +%s) scaling: starting ladder" >> "$HEALTH_LOG"
-      if SCALING_LAYOUTS=lead,minor timeout 900 python tools/tpu_scaling.py \
+      if SCALING_LAYOUTS=lead,minor timeout -k 15 900 python tools/tpu_scaling.py \
            4096 16384 32768 65536 98304 \
            > artifacts/scaling_tpu.jsonl.tmp \
            2>>/tmp/tpu_scaling_err.log \
