@@ -1,0 +1,107 @@
+// Broadcast node (workload: broadcast): gossip-on-receive with
+// periodic anti-entropy toward topology neighbors, so partitions heal.
+package main
+
+import (
+	"log"
+	"sync"
+	"time"
+
+	maelstrom "maelstrom-tpu/examples/go/maelstrom"
+)
+
+func main() {
+	n := maelstrom.New()
+	var mu sync.Mutex
+	seen := map[float64]bool{}
+	var neighbors []string
+
+	values := func() []float64 {
+		mu.Lock()
+		defer mu.Unlock()
+		out := make([]float64, 0, len(seen))
+		for v := range seen {
+			out = append(out, v)
+		}
+		return out
+	}
+
+	merge := func(vals any) []float64 {
+		mu.Lock()
+		defer mu.Unlock()
+		var fresh []float64
+		list, _ := vals.([]any)
+		for _, raw := range list {
+			if v, ok := raw.(float64); ok && !seen[v] {
+				seen[v] = true
+				fresh = append(fresh, v)
+			}
+		}
+		return fresh
+	}
+
+	gossip := func(vals []float64, except string) {
+		if len(vals) == 0 {
+			return
+		}
+		mu.Lock()
+		targets := append([]string(nil), neighbors...)
+		mu.Unlock()
+		for _, peer := range targets {
+			if peer != except {
+				n.Send(peer, map[string]any{
+					"type": "gossip", "values": vals})
+			}
+		}
+	}
+
+	n.Handle("topology", func(req maelstrom.Message,
+		body map[string]any) (map[string]any, error) {
+		mu.Lock()
+		neighbors = neighbors[:0]
+		if topo, ok := body["topology"].(map[string]any); ok {
+			if mine, ok := topo[n.ID()].([]any); ok {
+				for _, p := range mine {
+					if s, ok := p.(string); ok {
+						neighbors = append(neighbors, s)
+					}
+				}
+			}
+		}
+		mu.Unlock()
+		return map[string]any{"type": "topology_ok"}, nil
+	})
+
+	n.Handle("broadcast", func(req maelstrom.Message,
+		body map[string]any) (map[string]any, error) {
+		fresh := merge([]any{body["message"]})
+		gossip(fresh, "")
+		return map[string]any{"type": "broadcast_ok"}, nil
+	})
+
+	n.Handle("gossip", func(req maelstrom.Message,
+		body map[string]any) (map[string]any, error) {
+		gossip(merge(body["values"]), req.Src)
+		return nil, nil
+	})
+
+	n.Handle("read", func(req maelstrom.Message,
+		body map[string]any) (map[string]any, error) {
+		return map[string]any{"type": "read_ok",
+			"messages": values()}, nil
+	})
+
+	// anti-entropy: full-state gossip on a timer heals partitions the
+	// receive-time gossip missed
+	n.OnInit(func() {
+		go func() {
+			for range time.Tick(500 * time.Millisecond) {
+				gossip(values(), "")
+			}
+		}()
+	})
+
+	if err := n.Run(); err != nil {
+		log.Fatal(err)
+	}
+}

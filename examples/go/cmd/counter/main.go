@@ -1,0 +1,55 @@
+// Grow-only counter over seq-kv (workload: g-counter): CAS-increment
+// a per-node key, sum all keys on read — exercises the KV client
+// (kv.go) against the harness's Sequential service.
+package main
+
+import (
+	"log"
+
+	maelstrom "maelstrom-tpu/examples/go/maelstrom"
+)
+
+func main() {
+	n := maelstrom.New()
+	kv := maelstrom.NewSeqKV(n)
+
+	n.Handle("add", func(req maelstrom.Message,
+		body map[string]any) (map[string]any, error) {
+		delta, _ := body["delta"].(float64)
+		key := "counter-" + n.ID()
+		for {
+			cur, err := kv.ReadInt(key, 0)
+			if err != nil {
+				return nil, err
+			}
+			err = kv.CAS(key, cur, cur+int(delta), true)
+			if err == nil {
+				return map[string]any{"type": "add_ok"}, nil
+			}
+			var rpcErr *maelstrom.RPCError
+			if !maelstrom.AsRPCError(err, &rpcErr) ||
+				rpcErr.Code != maelstrom.ErrPreconditionFailed {
+				return nil, err
+			}
+		}
+	})
+
+	n.Handle("read", func(req maelstrom.Message,
+		body map[string]any) (map[string]any, error) {
+		// sum every node's counter key; seq-kv staleness is within the
+		// g-counter checker's interval tolerance
+		total := 0
+		for _, peer := range n.Peers() {
+			v, err := kv.ReadInt("counter-"+peer, 0)
+			if err != nil {
+				return nil, err
+			}
+			total += v
+		}
+		return map[string]any{"type": "read_ok", "value": total}, nil
+	})
+
+	if err := n.Run(); err != nil {
+		log.Fatal(err)
+	}
+}

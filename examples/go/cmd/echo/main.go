@@ -1,0 +1,20 @@
+// Echo node (workload: echo).
+package main
+
+import (
+	"log"
+
+	maelstrom "maelstrom-tpu/examples/go/maelstrom"
+)
+
+func main() {
+	n := maelstrom.New()
+	n.Handle("echo", func(req maelstrom.Message,
+		body map[string]any) (map[string]any, error) {
+		return map[string]any{"type": "echo_ok",
+			"echo": body["echo"]}, nil
+	})
+	if err := n.Run(); err != nil {
+		log.Fatal(err)
+	}
+}

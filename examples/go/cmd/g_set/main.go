@@ -1,0 +1,70 @@
+// Grow-only set CRDT node (workload: g-set): merge-on-gossip.
+package main
+
+import (
+	"encoding/json"
+	"log"
+	"sync"
+	"time"
+
+	maelstrom "maelstrom-tpu/examples/go/maelstrom"
+)
+
+func main() {
+	n := maelstrom.New()
+	var mu sync.Mutex
+	set := map[string]any{}   // canonical-JSON key -> value
+
+	add := func(v any) {
+		key, _ := json.Marshal(v)
+		mu.Lock()
+		set[string(key)] = v
+		mu.Unlock()
+	}
+	elements := func() []any {
+		mu.Lock()
+		defer mu.Unlock()
+		out := make([]any, 0, len(set))
+		for _, v := range set {
+			out = append(out, v)
+		}
+		return out
+	}
+
+	n.Handle("add", func(req maelstrom.Message,
+		body map[string]any) (map[string]any, error) {
+		add(body["element"])
+		return map[string]any{"type": "add_ok"}, nil
+	})
+	n.Handle("read", func(req maelstrom.Message,
+		body map[string]any) (map[string]any, error) {
+		return map[string]any{"type": "read_ok",
+			"value": elements()}, nil
+	})
+	n.Handle("merge", func(req maelstrom.Message,
+		body map[string]any) (map[string]any, error) {
+		if vals, ok := body["value"].([]any); ok {
+			for _, v := range vals {
+				add(v)
+			}
+		}
+		return nil, nil
+	})
+
+	n.OnInit(func() {
+		go func() {
+			for range time.Tick(500 * time.Millisecond) {
+				for _, peer := range n.Peers() {
+					if peer != n.ID() {
+						n.Send(peer, map[string]any{
+							"type": "merge", "value": elements()})
+					}
+				}
+			}
+		}()
+	})
+
+	if err := n.Run(); err != nil {
+		log.Fatal(err)
+	}
+}

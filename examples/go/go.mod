@@ -1,0 +1,3 @@
+module maelstrom-tpu/examples/go
+
+go 1.21

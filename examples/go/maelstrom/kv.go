@@ -1,0 +1,72 @@
+// KV client for the harness services (lin-kv / seq-kv / lww-kv /
+// lin-tso) — the role of the reference's demo/go/kv.go:144, on this
+// SDK's blocking-RPC surface instead of context callbacks.
+package maelstrom
+
+import "time"
+
+// KV speaks read/write/cas to one harness KV service node.
+type KV struct {
+	service string
+	node    *Node
+	Timeout time.Duration
+}
+
+func NewLinKV(n *Node) *KV { return &KV{"lin-kv", n, 5 * time.Second} }
+func NewSeqKV(n *Node) *KV { return &KV{"seq-kv", n, 5 * time.Second} }
+func NewLWWKV(n *Node) *KV { return &KV{"lww-kv", n, 5 * time.Second} }
+
+// Read returns the value of key (ErrKeyDoesNotExist as *RPCError when
+// absent).
+func (kv *KV) Read(key any) (any, error) {
+	reply, err := kv.node.RPC(kv.service,
+		map[string]any{"type": "read", "key": key}, kv.Timeout)
+	if err != nil {
+		return nil, err
+	}
+	return reply["value"], nil
+}
+
+// ReadInt reads key as an int, defaulting absent keys to dflt.
+func (kv *KV) ReadInt(key any, dflt int) (int, error) {
+	v, err := kv.Read(key)
+	if err != nil {
+		var rpcErr *RPCError
+		if AsRPCError(err, &rpcErr) && rpcErr.Code == ErrKeyDoesNotExist {
+			return dflt, nil
+		}
+		return 0, err
+	}
+	f, ok := v.(float64)
+	if !ok {
+		return 0, &RPCError{Code: ErrCrash, Text: "non-numeric value"}
+	}
+	return int(f), nil
+}
+
+// Write sets key to value.
+func (kv *KV) Write(key, value any) error {
+	_, err := kv.node.RPC(kv.service,
+		map[string]any{"type": "write", "key": key, "value": value},
+		kv.Timeout)
+	return err
+}
+
+// CAS swaps key from -> to; createIfNotExists initializes absent keys.
+// ErrPreconditionFailed (as *RPCError) reports a lost race.
+func (kv *KV) CAS(key, from, to any, createIfNotExists bool) error {
+	_, err := kv.node.RPC(kv.service, map[string]any{
+		"type": "cas", "key": key, "from": from, "to": to,
+		"create_if_not_exists": createIfNotExists}, kv.Timeout)
+	return err
+}
+
+// AsRPCError extracts an *RPCError from err (errors.As without the
+// interface dance for this concrete type).
+func AsRPCError(err error, target **RPCError) bool {
+	e, ok := err.(*RPCError)
+	if ok {
+		*target = e
+	}
+	return ok
+}

@@ -1,0 +1,260 @@
+// Package maelstrom is the Go node SDK for the maelstrom_tpu process
+// runtime: newline-delimited JSON envelopes {src, dest, body} on
+// stdin/stdout, an init handshake, handler dispatch by body type, and
+// request/reply RPC with msg_id / in_reply_to correlation.
+//
+// Counterpart of the reference's Go library (demo/go/node.go:339),
+// re-designed rather than ported: handlers RETURN their reply body
+// (nil = no reply) instead of calling reply themselves, error replies
+// fall out of returning *RPCError, and synchronous RPC is a plain
+// blocking call with a timeout instead of a context/callback pair.
+// Wire-compatible with every other SDK in examples/ (the runtime's
+// schema registry is the contract; tests/test_go_wire_conformance.py
+// holds this file to it).
+package maelstrom
+
+import (
+	"bufio"
+	"encoding/json"
+	"errors"
+	"fmt"
+	"io"
+	"os"
+	"sync"
+	"time"
+)
+
+// Message is one wire envelope.
+type Message struct {
+	Src  string          `json:"src"`
+	Dest string          `json:"dest"`
+	Body json.RawMessage `json:"body"`
+}
+
+// Handler processes one decoded request body and returns the reply
+// body (nil for no reply). Returning *RPCError sends an error reply;
+// any other error becomes a crash (code 13).
+type Handler func(req Message, body map[string]any) (map[string]any, error)
+
+// RPCError is the typed error of doc/protocol.md's error catalog.
+type RPCError struct {
+	Code int
+	Text string
+}
+
+func (e *RPCError) Error() string {
+	return fmt.Sprintf("rpc error %d: %s", e.Code, e.Text)
+}
+
+// Catalog codes used by SDK helpers (the full table lives in the
+// runtime's core/errors.py).
+const (
+	ErrTimeout            = 0
+	ErrNotSupported       = 10
+	ErrTemporarilyUnavail = 11
+	ErrCrash              = 13
+	ErrKeyDoesNotExist    = 20
+	ErrPreconditionFailed = 22
+	ErrTxnConflict        = 30
+)
+
+// Node runs the message loop for one simulated process.
+type Node struct {
+	mu       sync.Mutex // guards writes, pending, nextID, id, peers
+	r        io.Reader
+	w        io.Writer
+	id       string
+	peers    []string
+	handlers map[string]Handler
+	onInit   func()
+	pending  map[int]chan map[string]any
+	nextID   int
+	wg       sync.WaitGroup
+}
+
+// New returns a Node bound to stdin/stdout.
+func New() *Node { return NewWithIO(os.Stdin, os.Stdout) }
+
+// NewWithIO binds the node to explicit streams — the fake-stdio seam
+// the unit tests drive (reference node_test.go:19-37 pattern).
+func NewWithIO(r io.Reader, w io.Writer) *Node {
+	return &Node{
+		r:        r,
+		w:        w,
+		handlers: map[string]Handler{},
+		pending:  map[int]chan map[string]any{},
+	}
+}
+
+// ID is this node's identifier (valid once init has been handled).
+func (n *Node) ID() string {
+	n.mu.Lock()
+	defer n.mu.Unlock()
+	return n.id
+}
+
+// Peers is every node id in the cluster, this node included.
+func (n *Node) Peers() []string {
+	n.mu.Lock()
+	defer n.mu.Unlock()
+	return append([]string(nil), n.peers...)
+}
+
+// Handle registers the handler for one body type.
+func (n *Node) Handle(typ string, h Handler) {
+	if _, dup := n.handlers[typ]; dup {
+		panic("duplicate handler for " + typ)
+	}
+	n.handlers[typ] = h
+}
+
+// OnInit registers a hook run after the init handshake completes.
+func (n *Node) OnInit(f func()) { n.onInit = f }
+
+func (n *Node) writeEnvelope(dest string, body map[string]any) error {
+	n.mu.Lock()
+	defer n.mu.Unlock()
+	env := map[string]any{"src": n.id, "dest": dest, "body": body}
+	buf, err := json.Marshal(env)
+	if err != nil {
+		return err
+	}
+	_, err = n.w.Write(append(buf, '\n'))
+	return err
+}
+
+// Send ships a fire-and-forget body to dest.
+func (n *Node) Send(dest string, body map[string]any) error {
+	return n.writeEnvelope(dest, body)
+}
+
+// Reply answers req with body, stamping in_reply_to from the request's
+// msg_id.
+func (n *Node) Reply(req Message, body map[string]any) error {
+	var reqBody map[string]any
+	if err := json.Unmarshal(req.Body, &reqBody); err != nil {
+		return err
+	}
+	if id, ok := reqBody["msg_id"]; ok {
+		body["in_reply_to"] = id
+	}
+	return n.writeEnvelope(req.Src, body)
+}
+
+// RPC sends body to dest with a fresh msg_id and blocks for the reply
+// body or the timeout (ErrTimeout as an *RPCError).
+func (n *Node) RPC(dest string, body map[string]any,
+	timeout time.Duration) (map[string]any, error) {
+	n.mu.Lock()
+	n.nextID++
+	id := n.nextID
+	ch := make(chan map[string]any, 1)
+	n.pending[id] = ch
+	n.mu.Unlock()
+	defer func() {
+		n.mu.Lock()
+		delete(n.pending, id)
+		n.mu.Unlock()
+	}()
+	body["msg_id"] = id
+	if err := n.writeEnvelope(dest, body); err != nil {
+		return nil, err
+	}
+	select {
+	case reply := <-ch:
+		if reply["type"] == "error" {
+			code, _ := reply["code"].(float64)
+			text, _ := reply["text"].(string)
+			return nil, &RPCError{Code: int(code), Text: text}
+		}
+		return reply, nil
+	case <-time.After(timeout):
+		return nil, &RPCError{Code: ErrTimeout, Text: "RPC timeout"}
+	}
+}
+
+// Run is the main loop: decode envelopes, route replies to waiting
+// RPCs, dispatch requests to handlers (each on its own goroutine so a
+// handler may itself issue RPCs). Returns when stdin closes.
+func (n *Node) Run() error {
+	scanner := bufio.NewScanner(n.r)
+	scanner.Buffer(make([]byte, 0, 1<<20), 1<<24)
+	for scanner.Scan() {
+		line := scanner.Bytes()
+		if len(line) == 0 {
+			continue
+		}
+		var msg Message
+		if err := json.Unmarshal(line, &msg); err != nil {
+			fmt.Fprintf(os.Stderr, "bad envelope: %v\n", err)
+			continue
+		}
+		var body map[string]any
+		if err := json.Unmarshal(msg.Body, &body); err != nil {
+			fmt.Fprintf(os.Stderr, "bad body: %v\n", err)
+			continue
+		}
+		if irt, ok := body["in_reply_to"].(float64); ok {
+			n.mu.Lock()
+			ch := n.pending[int(irt)]
+			n.mu.Unlock()
+			if ch != nil {
+				ch <- body
+			}
+			continue
+		}
+		typ, _ := body["type"].(string)
+		if typ == "init" {
+			n.handleInit(msg, body)
+			continue
+		}
+		h, ok := n.handlers[typ]
+		if !ok {
+			n.Reply(msg, map[string]any{
+				"type": "error", "code": ErrNotSupported,
+				"text": "unknown type " + typ})
+			continue
+		}
+		n.wg.Add(1)
+		go func(msg Message, body map[string]any) {
+			defer n.wg.Done()
+			n.dispatch(h, msg, body)
+		}(msg, body)
+	}
+	n.wg.Wait()
+	return scanner.Err()
+}
+
+func (n *Node) dispatch(h Handler, msg Message, body map[string]any) {
+	reply, err := h(msg, body)
+	if err != nil {
+		var rpcErr *RPCError
+		if !errors.As(err, &rpcErr) {
+			rpcErr = &RPCError{Code: ErrCrash, Text: err.Error()}
+		}
+		n.Reply(msg, map[string]any{
+			"type": "error", "code": rpcErr.Code, "text": rpcErr.Text})
+		return
+	}
+	if reply != nil {
+		n.Reply(msg, reply)
+	}
+}
+
+func (n *Node) handleInit(msg Message, body map[string]any) {
+	n.mu.Lock()
+	n.id, _ = body["node_id"].(string)
+	n.peers = n.peers[:0]
+	if ids, ok := body["node_ids"].([]any); ok {
+		for _, v := range ids {
+			if s, ok := v.(string); ok {
+				n.peers = append(n.peers, s)
+			}
+		}
+	}
+	n.mu.Unlock()
+	n.Reply(msg, map[string]any{"type": "init_ok"})
+	if n.onInit != nil {
+		n.onInit()
+	}
+}

@@ -1,0 +1,57 @@
+"""End-to-end runs of the Go example nodes through the process
+runtime, plus the SDK's own fake-stdio `go test` suite. Skips cleanly
+when no Go toolchain is present (this image ships none — the static
+wire conformance in test_go_wire_conformance.py still runs)."""
+
+import os
+import shutil
+import subprocess
+
+import pytest
+
+from maelstrom_tpu import run_test
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GO_DIR = os.path.join(REPO, "examples", "go")
+
+pytestmark = pytest.mark.skipif(
+    shutil.which("go") is None, reason="no Go toolchain in image")
+
+
+@pytest.fixture(scope="session")
+def go_bins(tmp_path_factory):
+    out = tmp_path_factory.mktemp("go-bins")
+    for name in ("echo", "broadcast", "g_set", "counter"):
+        subprocess.run(
+            ["go", "build", "-o", str(out / name), f"./cmd/{name}"],
+            cwd=GO_DIR, check=True, capture_output=True)
+    return out
+
+
+def test_go_sdk_unit_suite():
+    # the SDK's fake-stdio tests (reference node_test.go:19-37 pattern)
+    subprocess.run(["go", "test", "./maelstrom/..."], cwd=GO_DIR,
+                   check=True, capture_output=True)
+
+
+def test_go_echo_e2e(go_bins, tmp_path):
+    res = run_test("echo", dict(
+        bin=str(go_bins / "echo"), node_count=2, time_limit=3.0,
+        rate=20.0, concurrency=4, store_root=str(tmp_path), seed=7))
+    assert res["valid?"] is True
+
+
+def test_go_broadcast_partition_e2e(go_bins, tmp_path):
+    res = run_test("broadcast", dict(
+        bin=str(go_bins / "broadcast"), node_count=3, time_limit=6.0,
+        rate=20.0, concurrency=4, nemesis=["partition"],
+        nemesis_interval=2.0, recovery_time=3.0,
+        store_root=str(tmp_path), seed=7))
+    assert res["valid?"] is True
+
+
+def test_go_counter_seq_kv_e2e(go_bins, tmp_path):
+    res = run_test("g-counter", dict(
+        bin=str(go_bins / "counter"), node_count=2, time_limit=5.0,
+        rate=10.0, concurrency=4, store_root=str(tmp_path), seed=7))
+    assert res["valid?"] is True

@@ -1,0 +1,96 @@
+"""Runtime-independent wire conformance for the Go SDK + nodes
+(VERDICT r4 next #8).
+
+No Go toolchain exists in this image, so — like the JS suite
+(test_js_wire_conformance.py) — the sources are validated STATICALLY
+against the wire protocol and the schema registry: envelope shape,
+init handshake, in_reply_to plumbing, error-code catalog membership,
+and every client-facing reply type a node emits. Behavioral testing
+runs in test_go_nodes.py whenever a `go` binary is present (and the
+SDK carries its own fake-stdio `go test` suite, the reference
+node_test.go pattern)."""
+
+import os
+import re
+
+import pytest
+
+import maelstrom_tpu.workloads  # noqa: F401 — populate the registry
+from maelstrom_tpu.core.errors import ERRORS_BY_CODE
+from maelstrom_tpu.core.schema import REGISTRY
+
+GO_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "examples", "go")
+
+SDK = open(os.path.join(GO_DIR, "maelstrom", "maelstrom.go")).read()
+KV = open(os.path.join(GO_DIR, "maelstrom", "kv.go")).read()
+
+# each Go node program -> (registry namespace, peer-internal RPC types)
+NODES = {
+    "echo": ("echo", set()),
+    "broadcast": ("broadcast", {"gossip"}),
+    "g_set": ("g-set", {"merge"}),
+    "counter": ("g-counter", set()),
+}
+
+
+def _node_src(name):
+    return open(os.path.join(GO_DIR, "cmd", name, "main.go")).read()
+
+
+def _literal_types(src):
+    """Every "type": "x" value in map[string]any literals."""
+    return set(re.findall(r'"type":\s*"([a-z_]+)"', src))
+
+
+def test_sdk_envelope_shape():
+    # envelopes are {src, dest, body}; replies stamp in_reply_to from
+    # the request's msg_id
+    assert '"src": n.id' in SDK and '"dest": dest' in SDK \
+        and '"body": body' in SDK
+    assert '"in_reply_to"' in SDK and '"msg_id"' in SDK
+
+
+def test_sdk_init_handshake():
+    # init -> init_ok, node_id + node_ids captured
+    assert '"init_ok"' in SDK
+    assert '"node_id"' in SDK and '"node_ids"' in SDK
+
+
+def test_sdk_error_codes_in_catalog():
+    codes = {int(c) for c in re.findall(
+        r"Err[A-Za-z]+\s*=\s*(\d+)", SDK)}
+    assert codes, "no error constants found"
+    assert codes <= set(ERRORS_BY_CODE), codes - set(ERRORS_BY_CODE)
+
+
+def test_kv_client_speaks_service_schema():
+    # the KV client's request bodies carry the service op vocabulary
+    for field in ('"type": "read"', '"type": "write"', '"type": "cas"',
+                  '"key"', '"value"', '"from"', '"to"',
+                  '"create_if_not_exists"'):
+        assert field in KV, field
+    assert '"lin-kv"' in KV and '"seq-kv"' in KV and '"lww-kv"' in KV
+
+
+@pytest.mark.parametrize("name", sorted(NODES))
+def test_node_reply_types_in_registry(name):
+    namespace, internal = NODES[name]
+    src = _node_src(name)
+    emitted = _literal_types(src)
+    rpcs = REGISTRY.get(namespace)
+    assert rpcs, f"no registry namespace {namespace}"
+    known = set()
+    for rpc in rpcs.values():
+        known.add(rpc.name)
+        known.add(rpc.response_type)
+    allowed = known | internal | {"error", "init_ok", "topology_ok",
+                                  "topology"}
+    # KV-service client ops ride through the SDK, not node literals
+    allowed |= {"read", "write", "cas"}
+    unknown = emitted - allowed
+    assert not unknown, (name, unknown)
+    # and the node actually serves its workload's replies
+    reply_types = {r.response_type for r in rpcs.values()}
+    assert emitted & reply_types, (name, "serves no workload reply",
+                                   emitted, reply_types)
