@@ -1,0 +1,53 @@
+#!/usr/bin/env ruby
+# Broadcast node (workload: broadcast): gossip-on-receive plus timed
+# anti-entropy toward topology neighbors so partitions heal.
+require_relative "maelstrom"
+require "set"
+
+node = Maelstrom::Node.new
+lock = Mutex.new
+seen = Set.new
+neighbors = []
+
+gossip = lambda do |values, except|
+  targets = lock.synchronize { neighbors.dup }
+  targets.each do |peer|
+    next if peer == except
+    node.send_msg(peer, { "type" => "gossip", "values" => values })
+  end
+end
+
+node.on("topology") do |_msg, body|
+  mine = (body["topology"] || {})[node.node_id] || []
+  lock.synchronize { neighbors = mine }
+  { "type" => "topology_ok" }
+end
+
+node.on("broadcast") do |_msg, body|
+  fresh = lock.synchronize { seen.add?(body["message"]) }
+  gossip.call([body["message"]], nil) if fresh
+  { "type" => "broadcast_ok" }
+end
+
+node.on("gossip") do |msg, body|
+  fresh = lock.synchronize do
+    (body["values"] || []).select { |v| seen.add?(v) }
+  end
+  gossip.call(fresh, msg["src"]) unless fresh.empty?
+  nil
+end
+
+node.on("read") do |_msg, _body|
+  { "type" => "read_ok", "messages" => lock.synchronize { seen.to_a } }
+end
+
+node.on_init do
+  Thread.new do
+    loop do
+      sleep 0.5
+      gossip.call(lock.synchronize { seen.to_a }, nil)
+    end
+  end
+end
+
+node.run

@@ -1,0 +1,29 @@
+#!/usr/bin/env ruby
+# Grow-only counter over seq-kv (workload: g-counter): CAS-increment a
+# per-node key, sum every node's key on read — exercises the KV client
+# against the harness's Sequential service.
+require_relative "maelstrom"
+
+node = Maelstrom::Node.new
+kv = Maelstrom::KV.seq(node)
+
+node.on("add") do |_msg, body|
+  key = "counter-#{node.node_id}"
+  loop do
+    cur = kv.read_default(key, 0)
+    begin
+      kv.cas(key, cur, cur + body["delta"].to_i, create: true)
+      break
+    rescue Maelstrom::RPCError => e
+      raise unless e.code == Maelstrom::RPCError::PRECONDITION_FAILED
+    end
+  end
+  { "type" => "add_ok" }
+end
+
+node.on("read") do |_msg, _body|
+  total = node.node_ids.sum { |peer| kv.read_default("counter-#{peer}", 0) }
+  { "type" => "read_ok", "value" => total }
+end
+
+node.run

@@ -1,0 +1,9 @@
+#!/usr/bin/env ruby
+# Echo node (workload: echo).
+require_relative "maelstrom"
+
+node = Maelstrom::Node.new
+node.on("echo") do |_msg, body|
+  { "type" => "echo_ok", "echo" => body["echo"] }
+end
+node.run

@@ -1,0 +1,37 @@
+#!/usr/bin/env ruby
+# Grow-only set CRDT node (workload: g-set): merge-on-gossip.
+require_relative "maelstrom"
+require "set"
+
+node = Maelstrom::Node.new
+lock = Mutex.new
+set = Set.new
+
+node.on("add") do |_msg, body|
+  lock.synchronize { set.add(body["element"]) }
+  { "type" => "add_ok" }
+end
+
+node.on("read") do |_msg, _body|
+  { "type" => "read_ok", "value" => lock.synchronize { set.to_a } }
+end
+
+node.on("merge") do |_msg, body|
+  lock.synchronize { (body["value"] || []).each { |v| set.add(v) } }
+  nil
+end
+
+node.on_init do
+  Thread.new do
+    loop do
+      sleep 0.5
+      snapshot = lock.synchronize { set.to_a }
+      node.node_ids.each do |peer|
+        next if peer == node.node_id
+        node.send_msg(peer, { "type" => "merge", "value" => snapshot })
+      end
+    end
+  end
+end
+
+node.run

@@ -1,0 +1,194 @@
+# Ruby node SDK for the maelstrom_tpu process runtime: JSON envelopes
+# {src, dest, body} per line on stdin/stdout, init handshake, handler
+# dispatch by body type, request/reply RPC via msg_id / in_reply_to.
+#
+# Counterpart of the reference's Ruby library (demo/ruby/, what its own
+# demo self-test runs — core.clj:104-126), re-designed rather than
+# ported: handlers are blocks that RETURN the reply body (nil = no
+# reply), raising RPCError sends the matching error reply, and
+# synchronous RPC blocks on a ConditionVariable instead of promises.
+# Wire-compatible with every other SDK in examples/;
+# tests/test_ruby_wire_conformance.py holds this file to the schema
+# registry without a Ruby runtime.
+
+require "json"
+
+module Maelstrom
+  # Typed error of the harness catalog (core/errors.py).
+  class RPCError < StandardError
+    attr_reader :code
+
+    TIMEOUT = 0
+    NOT_SUPPORTED = 10
+    TEMPORARILY_UNAVAILABLE = 11
+    CRASH = 13
+    KEY_DOES_NOT_EXIST = 20
+    PRECONDITION_FAILED = 22
+    TXN_CONFLICT = 30
+
+    def initialize(code, text)
+      @code = code
+      super(text)
+    end
+
+    def body
+      { "type" => "error", "code" => @code, "text" => message }
+    end
+  end
+
+  class Node
+    attr_reader :node_id, :node_ids
+
+    def initialize(input: $stdin, output: $stdout)
+      @in = input
+      @out = output
+      @lock = Mutex.new          # guards writes + rpc state
+      @handlers = {}
+      @init_hooks = []
+      @pending = {}              # msg_id => reply body (filled by loop)
+      @cv = ConditionVariable.new
+      @next_msg_id = 0
+      @node_id = nil
+      @node_ids = []
+    end
+
+    # Register a handler: on("echo") { |msg, body| {"type" => "echo_ok"} }
+    def on(type, &block)
+      raise "duplicate handler for #{type}" if @handlers.key?(type)
+      @handlers[type] = block
+    end
+
+    def on_init(&block)
+      @init_hooks << block
+    end
+
+    def send_msg(dest, body)
+      @lock.synchronize do
+        env = { "src" => @node_id, "dest" => dest, "body" => body }
+        @out.puts(JSON.generate(env))
+        @out.flush
+      end
+    end
+
+    def reply(msg, body)
+      b = body.dup
+      b["in_reply_to"] = msg["body"]["msg_id"]
+      send_msg(msg["src"], b)
+    end
+
+    # Blocking RPC: returns the reply body, raises RPCError on an error
+    # reply or timeout. Callable from handler threads (the main loop
+    # routes in_reply_to bodies here).
+    def rpc(dest, body, timeout = 5.0)
+      id = nil
+      @lock.synchronize do
+        @next_msg_id += 1
+        id = @next_msg_id
+        @pending[id] = nil
+      end
+      send_msg(dest, body.merge("msg_id" => id))
+      deadline = Time.now + timeout
+      @lock.synchronize do
+        while @pending[id].nil?
+          remaining = deadline - Time.now
+          if remaining <= 0
+            @pending.delete(id)
+            raise RPCError.new(RPCError::TIMEOUT, "RPC timeout")
+          end
+          @cv.wait(@lock, remaining)
+        end
+        reply_body = @pending.delete(id)
+        if reply_body["type"] == "error"
+          raise RPCError.new(reply_body["code"], reply_body["text"].to_s)
+        end
+        reply_body
+      end
+    end
+
+    # Main loop: route replies to waiting RPCs, dispatch requests on
+    # worker threads (handlers may themselves block in rpc).
+    def run
+      threads = []
+      @in.each_line do |line|
+        line = line.strip
+        next if line.empty?
+        msg = JSON.parse(line)
+        body = msg["body"]
+        if body["in_reply_to"]
+          @lock.synchronize do
+            id = body["in_reply_to"]
+            @pending[id] = body if @pending.key?(id)
+            @cv.broadcast
+          end
+          next
+        end
+        case body["type"]
+        when "init"
+          @node_id = body["node_id"]
+          @node_ids = body["node_ids"] || []
+          reply(msg, { "type" => "init_ok" })
+          @init_hooks.each(&:call)
+        else
+          threads << Thread.new { dispatch(msg, body) }
+        end
+      end
+      threads.each(&:join)
+    end
+
+    private
+
+    def dispatch(msg, body)
+      handler = @handlers[body["type"]]
+      unless handler
+        reply(msg, RPCError.new(RPCError::NOT_SUPPORTED,
+                                "unknown type #{body['type']}").body)
+        return
+      end
+      begin
+        out = handler.call(msg, body)
+        reply(msg, out) if out
+      rescue RPCError => e
+        reply(msg, e.body)
+      rescue => e
+        warn "handler crashed: #{e.class}: #{e.message}"
+        reply(msg, RPCError.new(RPCError::CRASH, e.message).body)
+      end
+    end
+  end
+
+  # KV client for the harness services (demo/ruby kv role).
+  class KV
+    def initialize(node, service)
+      @node = node
+      @service = service
+    end
+
+    def self.lin(node) = new(node, "lin-kv")
+    def self.seq(node) = new(node, "seq-kv")
+    def self.lww(node) = new(node, "lww-kv")
+
+    def read(key)
+      @node.rpc(@service, { "type" => "read", "key" => key })["value"]
+    end
+
+    def read_default(key, default)
+      read(key)
+    rescue RPCError => e
+      raise unless e.code == RPCError::KEY_DOES_NOT_EXIST
+      default
+    end
+
+    def write(key, value)
+      @node.rpc(@service,
+                { "type" => "write", "key" => key, "value" => value })
+      nil
+    end
+
+    def cas(key, from, to, create: false)
+      @node.rpc(@service,
+                { "type" => "cas", "key" => key, "from" => from,
+                  "to" => to, "create_if_not_exists" => create })
+      nil
+    end
+  end
+end

@@ -1,0 +1,44 @@
+"""End-to-end runs of the Ruby example nodes through the process
+runtime. Skips cleanly when no `ruby` interpreter is present (this
+image ships none — the static wire conformance in
+test_ruby_wire_conformance.py still runs)."""
+
+import os
+import shutil
+
+import pytest
+
+from maelstrom_tpu import run_test
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+RB = os.path.join(REPO, "examples", "ruby")
+
+pytestmark = pytest.mark.skipif(
+    shutil.which("ruby") is None, reason="no Ruby interpreter in image")
+
+
+def _bin(name):
+    return dict(bin="ruby", bin_args=[os.path.join(RB, name)])
+
+
+def test_ruby_echo_e2e(tmp_path):
+    res = run_test("echo", dict(
+        **_bin("echo.rb"), node_count=2, time_limit=3.0, rate=20.0,
+        concurrency=4, store_root=str(tmp_path), seed=7))
+    assert res["valid?"] is True
+
+
+def test_ruby_broadcast_partition_e2e(tmp_path):
+    res = run_test("broadcast", dict(
+        **_bin("broadcast.rb"), node_count=3, time_limit=6.0,
+        rate=20.0, concurrency=4, nemesis=["partition"],
+        nemesis_interval=2.0, recovery_time=3.0,
+        store_root=str(tmp_path), seed=7))
+    assert res["valid?"] is True
+
+
+def test_ruby_counter_seq_kv_e2e(tmp_path):
+    res = run_test("g-counter", dict(
+        **_bin("counter.rb"), node_count=2, time_limit=5.0, rate=10.0,
+        concurrency=4, store_root=str(tmp_path), seed=7))
+    assert res["valid?"] is True

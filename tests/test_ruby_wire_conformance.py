@@ -1,0 +1,79 @@
+"""Runtime-independent wire conformance for the Ruby SDK + nodes.
+
+No Ruby interpreter exists in this image, so — like the JS and Go
+suites — the sources are validated STATICALLY against the wire
+protocol and the schema registry: envelope shape, init handshake,
+in_reply_to plumbing, error-code catalog membership, and every
+client-facing reply type a node emits. The e2e suite
+(test_ruby_nodes.py) runs whenever a `ruby` binary appears."""
+
+import os
+import re
+
+import pytest
+
+import maelstrom_tpu.workloads  # noqa: F401 — populate the registry
+from maelstrom_tpu.core.errors import ERRORS_BY_CODE
+from maelstrom_tpu.core.schema import REGISTRY
+
+RB_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "examples", "ruby")
+
+SDK = open(os.path.join(RB_DIR, "maelstrom.rb")).read()
+
+NODES = {
+    "echo.rb": ("echo", set()),
+    "broadcast.rb": ("broadcast", {"gossip"}),
+    "g_set.rb": ("g-set", {"merge"}),
+    "counter.rb": ("g-counter", set()),
+}
+
+
+def _literal_types(src):
+    return set(re.findall(r'"type"\s*=>\s*"([a-z_]+)"', src))
+
+
+def test_sdk_envelope_shape():
+    assert '"src" => @node_id' in SDK and '"dest" => dest' in SDK \
+        and '"body" => body' in SDK
+    assert '"in_reply_to"' in SDK and '"msg_id"' in SDK
+
+
+def test_sdk_init_handshake():
+    assert '"init_ok"' in SDK
+    assert '"node_id"' in SDK and '"node_ids"' in SDK
+
+
+def test_sdk_error_codes_in_catalog():
+    codes = {int(c) for c in re.findall(
+        r"^\s+[A-Z_]+ = (\d+)$", SDK, re.M)}
+    assert codes, "no error constants found"
+    assert codes <= set(ERRORS_BY_CODE), codes - set(ERRORS_BY_CODE)
+
+
+def test_kv_client_speaks_service_schema():
+    for field in ('"type" => "read"', '"type" => "write"',
+                  '"type" => "cas"', '"key"', '"value"', '"from"',
+                  '"to"', '"create_if_not_exists"'):
+        assert field in SDK, field
+    assert '"lin-kv"' in SDK and '"seq-kv"' in SDK and '"lww-kv"' in SDK
+
+
+@pytest.mark.parametrize("name", sorted(NODES))
+def test_node_reply_types_in_registry(name):
+    namespace, internal = NODES[name]
+    src = open(os.path.join(RB_DIR, name)).read()
+    emitted = _literal_types(src)
+    rpcs = REGISTRY.get(namespace)
+    assert rpcs, f"no registry namespace {namespace}"
+    known = set()
+    for rpc in rpcs.values():
+        known.add(rpc.name)
+        known.add(rpc.response_type)
+    allowed = known | internal | {"error", "init_ok", "topology_ok",
+                                  "topology", "read", "write", "cas"}
+    unknown = emitted - allowed
+    assert not unknown, (name, unknown)
+    reply_types = {r.response_type for r in rpcs.values()}
+    assert emitted & reply_types, (name, "serves no workload reply",
+                                   emitted, reply_types)
