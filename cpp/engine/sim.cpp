@@ -27,6 +27,7 @@
 #include <cstdint>
 #include <cstring>
 #include <thread>
+#include <unordered_set>
 #include <vector>
 
 namespace {
@@ -70,16 +71,21 @@ struct Cfg {
   // a whole transaction is ONE Raft log entry, applied atomically at
   // commit, leader replies read results at apply time — the
   // reference's txn_list_append.clj:74-143 semantics over Raft)
-  int64_t workload;           // 0 = lin-kv, 1 = txn-list-append
+  int64_t workload;           // 0 = lin-kv, 1 = txn-list-append,
+                              // 2 = g-set (gossip CRDT, set-full)
   int64_t txn_max;            // micro-ops per txn (<= TXN_CAP)
   int64_t list_cap;           // per-key list capacity; an append txn
                               // that would overflow aborts WHOLE with
                               // error 30 (atomicity preserved)
-  double read_prob;           // P(micro-op is a read)
+  double read_prob;           // txn: P(micro-op is a read);
+                              // g-set: P(client op is a read)
   int64_t flag_txn_dirty_apply;  // BUG: apply + reply at APPEND time
                                  // (uncommitted) — leader changes
                                  // truncate acked txns; Elle catches
                                  // lost appends / aborted reads
+  int64_t flag_gset_no_gossip;   // BUG: g-set nodes never gossip —
+                                 // adds stay on one node; set-full
+                                 // reports them lost
 };
 
 constexpr int TXN_CAP = 4;    // engine-wide micro-op slot bound
@@ -90,6 +96,8 @@ enum MType : int32_t {
   M_READ_OK = 4, M_WRITE_OK = 5, M_CAS_OK = 6,
   M_REQ_VOTE = 7, M_VOTE_REPLY = 8, M_APPEND = 9, M_APPEND_REPLY = 10,
   M_TXN = 20, M_TXN_OK = 21,
+  M_GADD = 30, M_GADD_OK = 31, M_GREAD = 32, M_GREAD_OK = 33,
+  M_GMERGE = 34,
   M_ERROR = 127
 };
 
@@ -143,6 +151,8 @@ struct Node {
   std::vector<Entry> log_body;
   std::vector<int32_t> kv;
   std::vector<std::vector<int32_t>> lists;   // txn workload state
+  std::vector<int32_t> gset;                 // g-set workload state:
+  std::unordered_set<int32_t> gseen;         // insertion order + member
   std::vector<int32_t> next_idx, match_idx;
 };
 
@@ -150,6 +160,8 @@ enum Etype : int32_t { EV_INVOKE = 1, EV_OK = 2, EV_FAIL = 3, EV_INFO = 4 };
 enum Fcode : int32_t { F_READ = 1, F_WRITE = 2, F_CAS = 3 };
 // txn micro-op f codes (models/txn_raft.py MF_R / MF_APPEND)
 enum TxnF : int32_t { F_TXN_R = 1, F_TXN_APPEND = 2 };
+// g-set client op f codes
+enum GsetF : int32_t { F_GADD = 1, F_GREAD = 2 };
 
 struct Client {
   int32_t status = 0;           // 0 idle / 1 waiting
@@ -381,10 +393,35 @@ struct Sim {
     }
   }
 
+  // g-set merge: insertion-ordered, membership-deduped
+  static void gset_merge(Node& nd, const int32_t* vals, size_t n) {
+    for (size_t i = 0; i < n; ++i)
+      if (nd.gseen.insert(vals[i]).second)
+        nd.gset.push_back(vals[i]);
+  }
+
   void handle(Instance& in, int32_t t, int32_t me, const Msg& m) {
     Node& nd = in.nodes[me];
     int32_t n = int32_t(cfg.n_nodes);
     switch (m.type) {
+      case M_GADD: {
+        gset_merge(nd, &m.body[0], 1);
+        node_reply(in, t, me, m, M_GADD_OK, 0, 0, 0);
+        break;
+      }
+      case M_GREAD: {
+        Msg r;
+        r.valid = 1; r.src = me; r.origin = me; r.dest = m.src;
+        r.type = M_GREAD_OK; r.reply_to = m.msg_id;
+        r.body[0] = int32_t(nd.gset.size());
+        r.ext = nd.gset;
+        send(in, t, std::move(r));
+        break;
+      }
+      case M_GMERGE: {
+        gset_merge(nd, m.ext.data(), m.ext.size());
+        break;
+      }
       case M_TXN: {
         bool leader = nd.role == 2;
         if (leader && nd.log_len < cfg.log_cap) {
@@ -542,6 +579,23 @@ struct Sim {
     Node& nd = in.nodes[me];
     int32_t n = int32_t(cfg.n_nodes);
 
+    if (cfg.workload == 2) {
+      // g-set anti-entropy: full-state gossip to one rotating peer
+      // every heartbeat — dropped gossip (loss/partition) costs one
+      // round, never convergence. No Raft machinery runs.
+      if (n > 1 && !cfg.flag_gset_no_gossip &&
+          t % cfg.heartbeat == int64_t(me) % cfg.heartbeat) {
+        int32_t hop = 1 + int32_t((t / cfg.heartbeat) % (n - 1));
+        Msg g;
+        g.valid = 1; g.src = me; g.origin = me;
+        g.dest = (me + hop) % n;
+        g.type = M_GMERGE;
+        g.ext = nd.gset;
+        send(in, t, std::move(g));
+      }
+      return;
+    }
+
     // election timeout
     if (nd.role != 2 && t >= nd.election_deadline) {
       nd.term += 1; nd.role = 1; nd.voted_for = me; nd.votes = 0;
@@ -676,7 +730,29 @@ struct Sim {
     }
   }
 
+  // g-set read rows: a header [tick, client, EV_OK, F_GREAD, n, 0, 0]
+  // followed by ceil(n/7) rows of 7 raw values — variable-size reads
+  // on the fixed-width recorder. Written atomically: if the remaining
+  // capacity can't hold the whole read, the recorder saturates (n =
+  // cap) so the truncation is visible upstream.
+  void record_gset_read(Recorder& rec, int32_t t, int32_t c,
+                        const Msg& m) const {
+    int32_t nv = int32_t(m.ext.size());
+    int64_t need = 1 + (nv + 6) / 7;
+    if (!rec.out || rec.n + need > rec.cap) {
+      rec.n = rec.cap;
+      return;
+    }
+    rec.event(t, c, EV_OK, F_GREAD, nv, 0, 0);
+    for (int32_t i = 0; i < nv; i += 7) {
+      int32_t* p = rec.row();
+      for (int32_t j = 0; j < 7 && i + j < nv; ++j)
+        p[j] = m.ext[i + j];
+    }
+  }
+
   void check_invariants(Instance& in) const {
+    if (cfg.workload == 2) return;   // no Raft state to check
     int32_t n = int32_t(cfg.n_nodes);
     bool bad = false;
     for (int32_t i = 0; i < n && !bad; ++i)
@@ -837,6 +913,8 @@ struct Sim {
         if (cfg.workload == 1)
           record_txn(*rec, t, c, etype, cl,
                      m.type == M_TXN_OK ? &m : nullptr);
+        else if (cfg.workload == 2 && m.type == M_GREAD_OK)
+          record_gset_read(*rec, t, c, m);
         else
           rec->event(t, c, etype, cl.f, cl.k, v, cl.b);
       }
@@ -846,8 +924,10 @@ struct Sim {
       Client& cl = in.clients[c];
       if (cl.status == 1 && t - cl.invoked >= cfg.timeout_ticks) {
         // reads are idempotent -> fail; others stay indefinite
-        // (whole transactions are never idempotent)
-        int32_t etype = (cfg.workload == 0 && cl.f == F_READ)
+        // (whole transactions are never idempotent; g-set adds are
+        // indeterminate — set-full never counts info adds as lost)
+        int32_t etype = ((cfg.workload == 0 && cl.f == F_READ) ||
+                         (cfg.workload == 2 && cl.f == F_GREAD))
                             ? EV_FAIL : EV_INFO;
         if (rec) {
           if (cfg.workload == 1)
@@ -859,6 +939,28 @@ struct Sim {
       }
       if (cl.status == 0 && in.rng.uniform() < cfg.rate) {
         bool final_phase = t >= cfg.final_start;
+        if (cfg.workload == 2) {
+          bool rd = final_phase || in.rng.uniform() < cfg.read_prob;
+          cl.f = rd ? F_GREAD : F_GADD;
+          cl.k = 0;
+          // unique elements per instance (client-striped op counter)
+          cl.a = rd ? NIL
+                    : 1 + cl.next_msg_id * int32_t(cfg.n_clients) + c;
+          cl.msg_id = cl.next_msg_id++;
+          cl.invoked = t;
+          cl.status = 1;
+          if (rec) rec->event(t, c, EV_INVOKE, cl.f, 0, cl.a, 0);
+          Msg q;
+          q.valid = 1;
+          q.src = int32_t(cfg.n_nodes) + c;
+          q.origin = q.src;
+          q.dest = in.rng.below(int32_t(cfg.n_nodes));
+          q.type = rd ? M_GREAD : M_GADD;
+          q.msg_id = cl.msg_id;
+          q.body[0] = cl.a;
+          send(in, t, std::move(q));
+          continue;
+        }
         if (cfg.workload == 1) {
           cl.tlen = 1 + in.rng.below(int32_t(cfg.txn_max));
           for (int32_t j = 0; j < cl.tlen; ++j) {
@@ -930,7 +1032,7 @@ extern "C" {
 // log_cap, elect_min, elect_jitter, n_keys, n_vals, flag_stale_read,
 // flag_eager_commit, flag_no_term_guard, max_events, n_threads,
 // instance_base, workload, txn_max, list_cap, read_prob_micro,
-// flag_txn_dirty_apply  (33 fields)
+// flag_txn_dirty_apply, flag_gset_no_gossip  (34 fields)
 int64_t native_sim_run_sched(const int64_t* c, int64_t* stats_out,
                              int32_t* violations_out,
                              int32_t* events_out,
@@ -976,6 +1078,8 @@ int64_t native_sim_run_sched(const int64_t* c, int64_t* stats_out,
   cfg.list_cap = c[30];
   cfg.read_prob = double(c[31]) / 1e6;
   cfg.flag_txn_dirty_apply = c[32];
+  cfg.flag_gset_no_gossip = c[33];
+  if (cfg.workload < 0 || cfg.workload > 2) return -1;
   if (cfg.nemesis_interval <= 0) cfg.nemesis_interval = 1;
   if (cfg.n_nodes > 30) return -1;   // votes bitmask width
   if (cfg.pool_slots > 64 || cfg.n_nodes + cfg.n_clients > 64)

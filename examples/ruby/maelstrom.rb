@@ -129,6 +129,7 @@ module Maelstrom
           reply(msg, { "type" => "init_ok" })
           @init_hooks.each(&:call)
         else
+          threads.reject! { |th| !th.alive? }   # keep O(in-flight)
           threads << Thread.new { dispatch(msg, body) }
         end
       end

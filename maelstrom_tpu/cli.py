@@ -101,7 +101,7 @@ def add_test_options(p: argparse.ArgumentParser):
                         "capped by --max-txn-length)")
     p.add_argument("--consistency-models", default=None,
                    choices=["read-uncommitted", "read-committed",
-                            "read-atomic", "serializable",
+                            "read-atomic", "snapshot-isolation", "serializable",
                             "strict-serializable"])
     p.add_argument("--log-stderr", action="store_true")
     p.add_argument("--log-net-send", action="store_true")
@@ -182,10 +182,10 @@ def cmd_test(args) -> int:
         # the C++ scalar engine (cpp/engine): lin-kv and
         # txn-list-append Raft fleets on hosts without an accelerator —
         # same checkers, same artifacts
-        if args.workload not in ("lin-kv", "txn-list-append"):
-            print("error: --runtime native implements the lin-kv and "
-                  "txn-list-append (Raft) workloads only; use "
-                  "--runtime tpu for the full model set",
+        if args.workload not in ("lin-kv", "txn-list-append", "g-set"):
+            print("error: --runtime native implements the lin-kv, "
+                  "txn-list-append (Raft), and g-set workloads only; "
+                  "use --runtime tpu for the full model set",
                   file=sys.stderr)
             return 2
         if args.nemesis_kind == "scripted" \
@@ -204,8 +204,9 @@ def cmd_test(args) -> int:
                 args.nemesis = list(args.nemesis) + ["partition"]
         notes = [(args.availability, "--availability", None),
                  (args.latency_dist, "--latency-dist", "exponential")]
-        if args.workload == "lin-kv":
-            # txn-list-append IS model-selectable (Elle); lin-kv is WGL
+        if args.workload != "txn-list-append":
+            # only txn-list-append is model-selectable (Elle); lin-kv
+            # is WGL-checked, g-set is set-full-checked
             notes.append((args.consistency_models,
                           "--consistency-models", None))
         for val, name, default in notes:
@@ -617,7 +618,7 @@ def main(argv=None) -> int:
     p_check.add_argument("--availability", default=None)
     p_check.add_argument("--consistency-models", default=None,
                          choices=["read-uncommitted", "read-committed",
-                                  "read-atomic", "serializable",
+                                  "read-atomic", "snapshot-isolation", "serializable",
                                   "strict-serializable"])
 
     p_export = sub.add_parser(

@@ -121,6 +121,46 @@ def _decode_txn_history(ev: np.ndarray, ms_per_tick: float,
     return hist
 
 
+def _decode_gset_history(ev: np.ndarray, ms_per_tick: float,
+                         final_start: int) -> List[dict]:
+    """g-set rows -> set-full's history: add ops carry their element;
+    read-ok rows are a header [.., n, ..] followed by ceil(n/7) rows of
+    7 raw values (record_gset_read's layout)."""
+    hist: List[dict] = []
+    i = 0
+    while i < len(ev):
+        tick, client, etype, f = (int(ev[i][0]), int(ev[i][1]),
+                                  int(ev[i][2]), int(ev[i][3]))
+        if etype not in ETYPE_NAMES and etype != EV_INVOKE:
+            # a saturated recorder (record_gset_read) jumps its count
+            # to cap without writing — the remaining rows are zero
+            # padding; the events-truncated flag reports it upstream
+            break
+        fname = "add" if f == 1 else "read"
+        if fname == "read" and etype == EV_OK:
+            n = int(ev[i][4])
+            rows = (n + 6) // 7
+            vals = [int(v) for row in ev[i + 1:i + 1 + rows]
+                    for v in row][:n]
+            i += 1 + rows
+            value: Any = vals
+        else:
+            value = int(ev[i][5]) if fname == "add" else None
+            if fname == "add" and value == NIL:
+                value = None
+            i += 1
+        rec = {"process": client,
+               "type": ("invoke" if etype == EV_INVOKE
+                        else ETYPE_NAMES[etype]),
+               "f": fname, "value": value}
+        if etype == EV_INVOKE and tick >= final_start:
+            rec["final"] = True
+        rec["time"] = int(tick * ms_per_tick * 1_000_000)
+        rec["index"] = len(hist)
+        hist.append(rec)
+    return hist
+
+
 def _decode_history(ev: np.ndarray, ms_per_tick: float,
                     final_start: int) -> List[dict]:
     """events [n, 7] (tick, client, etype, f, k, v, b) -> the checker's
@@ -182,7 +222,7 @@ def run_native_sim(opts: Optional[Dict[str, Any]] = None
         # txn-list-append workload (cpp/engine txn mode — the native
         # twin of models/txn_raft.py)
         workload="lin-kv", txn_max=3, list_cap=16, read_prob=0.5,
-        txn_dirty_apply=False,
+        txn_dirty_apply=False, gset_no_gossip=False,
         # instances are independent, so worker threads each own a
         # contiguous block end-to-end; per-instance trajectories are
         # identical at ANY thread count (RNG is a pure function of
@@ -203,16 +243,21 @@ def run_native_sim(opts: Optional[Dict[str, Any]] = None
     rate = min(1.0, float(o["rate"]) / C / 1000.0 * mpt)
     max_events = max(64, 2 * C * n_ticks // 4)
 
-    _workloads = {"lin-kv": 0, "txn-list-append": 1}
+    _workloads = {"lin-kv": 0, "txn-list-append": 1, "g-set": 2}
     if o["workload"] not in _workloads:
         raise ValueError(f"unknown native workload {o['workload']!r} "
                          f"(expected one of {sorted(_workloads)})")
     workload = _workloads[o["workload"]]
     txn_max, list_cap = int(o["txn_max"]), int(o["list_cap"])
     ev_w = 4 + 3 * txn_max + txn_max * list_cap if workload == 1 else 7
+    if workload == 2:
+        # g-set reads stream their whole set as 7-value rows, so the
+        # event budget scales with ops^2/7 in the worst case; ops per
+        # client are rate-bounded by the horizon
+        max_events = max(256, 2 * C * n_ticks)
 
     threads = int(o["threads"]) or (os.cpu_count() or 1)
-    cfg = (ctypes.c_int64 * 33)(
+    cfg = (ctypes.c_int64 * 34)(
         int(o["seed"]), I, n_ticks, int(o["node_count"]), C, R,
         int(o["pool_slots"]), int(o["inbox_k"]),
         int(float(o["latency"]) / mpt * 1000),
@@ -231,7 +276,8 @@ def run_native_sim(opts: Optional[Dict[str, Any]] = None
         max_events, threads, int(o.get("instance_base", 0)),
         workload, txn_max, list_cap,
         int(float(o["read_prob"]) * 1e6),
-        1 if o["txn_dirty_apply"] else 0)
+        1 if o["txn_dirty_apply"] else 0,
+        1 if o["gset_no_gossip"] else 0)
 
     stats = (ctypes.c_int64 * 5)()
     violations = np.zeros(I, dtype=np.int32)
@@ -276,6 +322,11 @@ def run_native_sim(opts: Optional[Dict[str, Any]] = None
         histories = [
             _decode_txn_history(events[i, :n_events[i]], mpt,
                                 final_start, txn_max, list_cap)
+            for i in range(R)]
+    elif workload == 2:
+        histories = [
+            _decode_gset_history(events[i, :n_events[i]], mpt,
+                                 final_start)
             for i in range(R)]
     else:
         histories = [

@@ -20,9 +20,12 @@ def _checker_for(workload: str, consistency_model: str = None):
         from ..checkers.elle import check_list_append
         model = consistency_model or "strict-serializable"
         return lambda h: check_list_append(h, consistency_model=model)
+    if workload == "g-set":
+        from ..checkers.set_full import set_full_checker
+        return set_full_checker
     if workload != "lin-kv":
         raise ValueError(f"unknown native workload {workload!r} "
-                         "(expected lin-kv or txn-list-append)")
+                         "(expected lin-kv, txn-list-append, or g-set)")
     from ..checkers.linearizable import linearizable_kv_checker
     return linearizable_kv_checker
 

@@ -326,3 +326,44 @@ def test_nil_reader_inference_gated_below_serializable():
     # at serializable the two nil reads are mutually impossible
     assert check_rw_register(
         h, consistency_model="serializable")["valid?"] is False
+
+
+def test_write_skew_si_legal_but_not_serializable():
+    # classic write skew: T1 reads y writes x, T2 reads x writes y —
+    # a 2-cycle of two ADJACENT rw edges. Snapshot isolation admits it
+    # (Fekete et al.); serializable does not.
+    h = H((0, "invoke", [["r", 2, None], ["w", 1, 1]]),
+          (1, "invoke", [["r", 1, None], ["w", 2, 2]]),
+          (0, "ok",     [["r", 2, None], ["w", 1, 1]]),
+          (1, "ok",     [["r", 1, None], ["w", 2, 2]]))
+    assert check_rw_register(
+        h, consistency_model="snapshot-isolation")["valid?"] is True
+    r = check_rw_register(h, consistency_model="serializable")
+    assert r["valid?"] is False
+    assert "G2-item" in r["anomalies"], r
+
+
+def test_g_nonadjacent_refutes_snapshot_isolation():
+    # 4-cycle alternating rw / wr edges, all txns concurrent:
+    #   T0 -rw-> T1 -wr-> T2 -rw-> T3 -wr-> T0
+    # (T0 read k0=[] missing T1's append; T2 read T1's k1 append; T2
+    # read k2=[] missing T3's append; T0 read T3's k3 append.) The two
+    # rw edges sit at opposite corners — never adjacent — so even
+    # snapshot isolation forbids the cycle (G-nonadjacent).
+    h = H(
+        (0, "invoke", [["r", 0, None], ["r", 3, None]]),
+        (1, "invoke", [["append", 0, 1], ["append", 1, 2]]),
+        (2, "invoke", [["r", 1, None], ["r", 2, None]]),
+        (3, "invoke", [["append", 2, 3], ["append", 3, 4]]),
+        (0, "ok",     [["r", 0, []], ["r", 3, [4]]]),
+        (1, "ok",     [["append", 0, 1], ["append", 1, 2]]),
+        (2, "ok",     [["r", 1, [2]], ["r", 2, []]]),
+        (3, "ok",     [["append", 2, 3], ["append", 3, 4]]),
+    )
+    r = check_list_append(h, consistency_model="snapshot-isolation")
+    assert r["valid?"] is False, r
+    assert "G-nonadjacent" in r["anomalies"], r
+    # the same witness still fails serializable, and write-skew-style
+    # adjacent-rw cycles would not have been flagged at SI
+    assert check_list_append(
+        h, consistency_model="serializable")["valid?"] is False

@@ -279,3 +279,51 @@ def test_native_txn_instance_base_bit_exact():
     solo = run_native_sim(_txn_opts(n_instances=1, record_instances=1,
                                     instance_base=target))
     assert solo["histories"][0] == res["histories"][target]
+
+
+# --- g-set workload (third native family: gossip CRDT + set-full) ----
+
+def _gset_opts(**kw):
+    o = dict(workload="g-set", n_instances=64, record_instances=4,
+             time_limit=2.0, nemesis=["partition"],
+             nemesis_interval=0.3, p_loss=0.05, recovery_time=0.4,
+             seed=7, read_prob=0.1, threads=1)
+    o.update(kw)
+    return o
+
+
+def test_native_gset_clean_set_full_valid():
+    res = run_native_test(_gset_opts())
+    assert res["valid?"] is True
+    for inst in res["instances"][:4]:
+        assert inst.get("lost-count", 0) == 0, inst
+    # real load: elements actually stabilized across the fleet
+    assert sum(i.get("stable-count", 0)
+               for i in res["instances"]) > 100
+
+
+def test_native_gset_no_gossip_caught():
+    # adds stay on the receiving node; reads from other nodes miss
+    # them — set-full must report lost elements
+    res = run_native_test(_gset_opts(gset_no_gossip=True))
+    assert res["valid?"] is False
+    assert any(i.get("lost-count", 0) > 5 for i in res["instances"])
+
+
+def test_native_gset_instance_base_bit_exact():
+    from maelstrom_tpu.native import run_native_sim
+    res = run_native_sim(_gset_opts())
+    solo = run_native_sim(_gset_opts(n_instances=1, record_instances=1,
+                                     instance_base=2))
+    assert solo["histories"][0] == res["histories"][2]
+
+
+def test_native_gset_truncation_decodes_cleanly():
+    # a saturated recorder leaves zero padding rows; the decoder must
+    # stop at them (events-truncated reports it), never crash
+    import numpy as np
+    from maelstrom_tpu.native.engine import _decode_gset_history
+    ev = np.zeros((4, 7), dtype=np.int32)
+    ev[0] = [5, 0, 1, 1, 0, 42, 0]
+    h = _decode_gset_history(ev, 1, 1 << 30)
+    assert len(h) == 1 and h[0]["value"] == 42
