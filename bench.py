@@ -382,6 +382,55 @@ def _native_bench() -> bool:
     from maelstrom_tpu.checkers.linearizable import \
         linearizable_kv_checker
 
+    # workload breadth at bench time: quick checked runs of the other
+    # two native families (txn-list-append/Elle, g-set/set-full) ride
+    # on the headline line, so the artifact shows the engine posting
+    # the number is not a one-workload machine
+    # the one base config every native run below derives from — the
+    # headline regimes and the family runs must never drift apart
+    base_opts = dict(node_count=3, concurrency=6, inbox_k=1,
+                     pool_slots=16, rate=200.0, latency=5.0,
+                     rpc_timeout=1.0, nemesis=["partition"],
+                     nemesis_interval=0.4, p_loss=0.05,
+                     recovery_time=0.3, seed=7)
+
+    families = {}
+    if os.environ.get("BENCH_FAMILIES") != "0":
+        from maelstrom_tpu.checkers.elle import check_list_append
+        from maelstrom_tpu.checkers.set_full import set_full_checker
+        for wname, wopts, chk in (
+                ("txn-list-append", {}, check_list_append),
+                ("g-set", {"read_prob": 0.1}, set_full_checker)):
+            fam_opts = dict(base_opts, n_instances=1024,
+                            record_instances=2, time_limit=1.5,
+                            workload=wname, **wopts)
+            try:
+                fres = run_native_sim(fam_opts)
+            except Exception as e:
+                families[wname] = {"error": repr(e)[:160]}
+                continue
+            if fres is None:
+                # rc != 0 from the engine — must not read as coverage
+                families[wname] = {"error": "engine rejected config"}
+                continue
+            fverd = []
+            for h in fres["histories"]:
+                try:
+                    fverd.append(chk(h)["valid?"])
+                except Exception as e:
+                    fverd.append(f"checker-error: {e!r}"[:120])
+            p = fres["perf"]
+            families[wname] = {
+                "msgs_per_sec": round(p["msgs-per-sec"], 1),
+                "instances": fam_opts["n_instances"],
+                "sim_ticks": p["ticks"],
+                "violating_instances": fres["violating-instances"],
+                "recorded_checker_verdicts": fverd,
+            }
+            log(TAG, f"phase[native-family-{wname}]: "
+                     f"{p['msgs-per-sec']:,.0f} msgs/s, "
+                     f"verdicts={fverd}")
+
     # same two regimes as the accelerator path: the K=1 headline plus
     # the K=3/S=48 inbox-pressure secondary, so the native number can't
     # be read as tuned-to-the-metric either
@@ -389,14 +438,9 @@ def _native_bench() -> bool:
     for cfg_name, inbox_k, pool_slots, secs in (
             ("k1", 1, 16, sim_seconds),
             ("k3", 3, 48, sim_seconds / 2)):
-        opts = dict(node_count=3, concurrency=6,
-                    n_instances=n_instances,
+        opts = dict(base_opts, n_instances=n_instances,
                     record_instances=4, inbox_k=inbox_k,
-                    pool_slots=pool_slots,
-                    time_limit=secs, rate=200.0, latency=5.0,
-                    rpc_timeout=1.0, nemesis=["partition"],
-                    nemesis_interval=0.4, p_loss=0.05,
-                    recovery_time=0.3, seed=7)
+                    pool_slots=pool_slots, time_limit=secs)
         log(TAG, f"phase[native-{cfg_name}]: C++ engine, "
                  f"{n_instances} instances x {int(secs * 1000)} ticks")
         res = run_native_sim(opts)
@@ -438,6 +482,8 @@ def _native_bench() -> bool:
             "violating_instances": res["violating-instances"],
             "recorded_checker_verdicts": verdicts,
             "funnel": funnel,
+            **({"families": families}
+               if families and cfg_name == "k1" else {}),
             "events_truncated": bool(res.get("events-truncated")),
             "complete": True,
         }), flush=True)
