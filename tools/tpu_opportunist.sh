@@ -103,11 +103,70 @@ else:
 EOF
 }
 
+# run_xval OUT TICKS CHUNK DEADLINE [cpu] — one parameterized capture
+# path for the coarse run and both zoom legs (they must never drift in
+# config); deletes a partial output on failure so a truncated JSON can
+# never satisfy a file-existence gate downstream.
 run_xval() {
-  echo "$(date +%s) xval: starting (deadline ${XVAL_S}s)" >> "$HEALTH_LOG"
-  XVAL_INSTANCES=32768 XVAL_TICKS=150 XVAL_CHUNK=25 XVAL_SEED=7 \
-    timeout -k 15 "$XVAL_S" python tools/platform_xval.py run \
-    artifacts/xval_tpu_32k.json 2>>/tmp/tpu_xval_err.log
+  local out="$1" ticks="$2" chunk="$3" deadline="$4" plat="${5:-}"
+  echo "$(date +%s) xval: starting $out ticks=$ticks chunk=$chunk" \
+    "(deadline ${deadline}s)" >> "$HEALTH_LOG"
+  local rc
+  if [ "$plat" = cpu ]; then
+    # local CPU leg: tunnel gate env unset or import jax can hang
+    XVAL_INSTANCES=32768 XVAL_TICKS="$ticks" XVAL_CHUNK="$chunk" \
+      XVAL_SEED=7 timeout -k 15 "$deadline" \
+      env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+      python tools/platform_xval.py run "$out" \
+      2>>/tmp/tpu_xval_err.log
+  else
+    XVAL_INSTANCES=32768 XVAL_TICKS="$ticks" XVAL_CHUNK="$chunk" \
+      XVAL_SEED=7 timeout -k 15 "$deadline" \
+      python tools/platform_xval.py run "$out" \
+      2>>/tmp/tpu_xval_err.log
+  fi
+  rc=$?
+  [ "$rc" -ne 0 ] && rm -f "$out"
+  return "$rc"
+}
+
+# try_zoom: once the coarse compare has pinned a divergent 25-tick
+# chunk, recapture BOTH platforms at 1-tick digests up to that chunk's
+# end so the first divergent tick + carry leaf are on record. Runs on
+# EVERY healthy iteration until the fine compare lands (a tunnel drop
+# mid-zoom just retries next window); the CPU leg runs in the
+# background so scarce tunnel-healthy time is spent on the TPU side.
+try_zoom() {
+  grep -q "FIRST DIVERGENCE" artifacts/xval_compare_32k.txt \
+    2>/dev/null || return 0
+  [ -f artifacts/xval_compare_32k_fine.txt ] && return 0
+  local T
+  T="$(grep -o 'tick <= [0-9]*' artifacts/xval_compare_32k.txt \
+       | grep -o '[0-9]*' | head -1)"
+  [ -n "$T" ] || return 0
+  echo "$(date +%s) xval: ZOOM to tick $T (1-tick digests)" \
+    >> "$HEALTH_LOG"
+  local cpu_pid=""
+  if [ ! -f artifacts/xval_cpu_32k_fine.json ]; then
+    run_xval artifacts/xval_cpu_32k_fine.json "$T" 1 1800 cpu &
+    cpu_pid=$!
+  fi
+  if run_xval artifacts/xval_tpu_32k_fine.json "$T" 1 1500; then
+    [ -n "$cpu_pid" ] && wait "$cpu_pid"
+    if [ -f artifacts/xval_cpu_32k_fine.json ]; then
+      python tools/platform_xval.py compare \
+        artifacts/xval_cpu_32k_fine.json \
+        artifacts/xval_tpu_32k_fine.json \
+        > artifacts/xval_compare_32k_fine.txt 2>&1
+      echo "$(date +%s) xval: fine compare rc=$? written" \
+        >> "$HEALTH_LOG"
+      commit_artifacts artifacts/xval_cpu_32k_fine.json \
+        artifacts/xval_tpu_32k_fine.json \
+        artifacts/xval_compare_32k_fine.txt "$HEALTH_LOG"
+    fi
+  else
+    [ -n "$cpu_pid" ] && wait "$cpu_pid"
+  fi
 }
 
 last_state=""
@@ -128,7 +187,7 @@ while true; do
       fi
     fi
     if [ ! -f artifacts/xval_tpu_32k.json ]; then
-      if run_xval; then
+      if run_xval artifacts/xval_tpu_32k.json 150 25 "$XVAL_S"; then
         echo "$(date +%s) xval: captured 32k TPU trace" >> "$HEALTH_LOG"
         commit_artifacts artifacts/xval_tpu_32k.json "$HEALTH_LOG"
         # the divergence hunt's verdict: first divergent tick chunk (or
@@ -137,11 +196,13 @@ while true; do
           python tools/platform_xval.py compare \
             artifacts/xval_cpu_32k.json artifacts/xval_tpu_32k.json \
             > artifacts/xval_compare_32k.txt 2>&1
-          echo "$(date +%s) xval: compare rc=$? written" >> "$HEALTH_LOG"
+          echo "$(date +%s) xval: compare rc=$? written" \
+            >> "$HEALTH_LOG"
           commit_artifacts artifacts/xval_compare_32k.txt "$HEALTH_LOG"
         fi
       fi
     fi
+    try_zoom
     if [ ! -f artifacts/scaling_tpu.jsonl ] \
         && [ ! -f artifacts/scaling_tpu_partial.jsonl ]; then
       echo "$(date +%s) scaling: starting ladder" >> "$HEALTH_LOG"
