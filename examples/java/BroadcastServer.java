@@ -1,0 +1,90 @@
+// Broadcast node (workload: broadcast): gossip-on-receive plus timed
+// anti-entropy toward topology neighbors so partitions heal.
+package maelstrom;
+
+import java.util.ArrayList;
+import java.util.HashMap;
+import java.util.HashSet;
+import java.util.List;
+import java.util.Map;
+import java.util.Set;
+
+public final class BroadcastServer {
+    public static void main(String[] args) throws Exception {
+        Maelstrom.Node node = new Maelstrom.Node();
+        Set<Object> seen = new HashSet<>();
+        List<String> neighbors = new ArrayList<>();
+        Object lock = new Object();
+
+        Runnable[] gossipAll = new Runnable[1];
+        gossipAll[0] = () -> {
+            List<Object> values;
+            List<String> targets;
+            synchronized (lock) {
+                values = new ArrayList<>(seen);
+                targets = new ArrayList<>(neighbors);
+            }
+            if (values.isEmpty()) return;
+            for (String peer : targets) {
+                Map<String, Object> g = new HashMap<>();
+                g.put("type", "gossip");
+                g.put("values", values);
+                node.send(peer, g);
+            }
+        };
+
+        node.handle("topology", (msg, body) -> {
+            synchronized (lock) {
+                neighbors.clear();
+                @SuppressWarnings("unchecked")
+                Map<String, Object> topo =
+                    (Map<String, Object>) body.get("topology");
+                if (topo != null && topo.get(node.id()) != null) {
+                    for (Object p : (List<?>) topo.get(node.id()))
+                        neighbors.add((String) p);
+                }
+            }
+            Map<String, Object> rep = new HashMap<>();
+            rep.put("type", "topology_ok");
+            return rep;
+        });
+
+        node.handle("broadcast", (msg, body) -> {
+            boolean fresh;
+            synchronized (lock) { fresh = seen.add(body.get("message")); }
+            if (fresh) gossipAll[0].run();
+            Map<String, Object> rep = new HashMap<>();
+            rep.put("type", "broadcast_ok");
+            return rep;
+        });
+
+        node.handle("gossip", (msg, body) -> {
+            List<Object> freshVals = new ArrayList<>();
+            synchronized (lock) {
+                for (Object v : (List<?>) body.get("values"))
+                    if (seen.add(v)) freshVals.add(v);
+            }
+            if (!freshVals.isEmpty()) gossipAll[0].run();
+            return null;
+        });
+
+        node.handle("read", (msg, body) -> {
+            Map<String, Object> rep = new HashMap<>();
+            rep.put("type", "read_ok");
+            synchronized (lock) {
+                rep.put("messages", new ArrayList<>(seen));
+            }
+            return rep;
+        });
+
+        node.onInit(() -> new Thread(() -> {
+            while (true) {
+                try { Thread.sleep(500); }
+                catch (InterruptedException e) { return; }
+                gossipAll[0].run();
+            }
+        }).start());
+
+        node.run();
+    }
+}

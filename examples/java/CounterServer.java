@@ -1,0 +1,44 @@
+// Grow-only counter over seq-kv (workload: g-counter): CAS-increment a
+// per-node key, sum every node's key on read — exercises the KV
+// client against the harness's Sequential service.
+package maelstrom;
+
+import java.util.HashMap;
+import java.util.Map;
+
+public final class CounterServer {
+    public static void main(String[] args) throws Exception {
+        Maelstrom.Node node = new Maelstrom.Node();
+        Maelstrom.KV kv = Maelstrom.KV.seq(node);
+
+        node.handle("add", (msg, body) -> {
+            long delta = ((Number) body.get("delta")).longValue();
+            String key = "counter-" + node.id();
+            while (true) {
+                long cur = kv.readLong(key, 0);
+                try {
+                    kv.cas(key, cur, cur + delta, true);
+                    break;
+                } catch (Maelstrom.RpcException e) {
+                    if (e.code != Maelstrom.ERR_PRECONDITION_FAILED)
+                        throw e;
+                }
+            }
+            Map<String, Object> rep = new HashMap<>();
+            rep.put("type", "add_ok");
+            return rep;
+        });
+
+        node.handle("read", (msg, body) -> {
+            long total = 0;
+            for (String peer : node.peers())
+                total += kv.readLong("counter-" + peer, 0);
+            Map<String, Object> rep = new HashMap<>();
+            rep.put("type", "read_ok");
+            rep.put("value", total);
+            return rep;
+        });
+
+        node.run();
+    }
+}

@@ -1,0 +1,18 @@
+// Echo node (workload: echo).
+package maelstrom;
+
+import java.util.HashMap;
+import java.util.Map;
+
+public final class EchoServer {
+    public static void main(String[] args) throws Exception {
+        Maelstrom.Node node = new Maelstrom.Node();
+        node.handle("echo", (msg, body) -> {
+            Map<String, Object> rep = new HashMap<>();
+            rep.put("type", "echo_ok");
+            rep.put("echo", body.get("echo"));
+            return rep;
+        });
+        node.run();
+    }
+}

@@ -1,0 +1,398 @@
+// Java node SDK for the maelstrom_tpu process runtime: JSON envelopes
+// {src, dest, body} per line on stdin/stdout, init handshake, handler
+// dispatch by body type, request/reply RPC via msg_id / in_reply_to.
+//
+// Counterpart of the reference's Java lab (demo/java/lab/Node.java),
+// re-designed rather than ported: a single-file SDK with a tiny
+// recursive-descent JSON codec (no Jackson/Gson on the classpath),
+// handlers RETURN the reply body (null = no reply), RpcException
+// becomes an error reply, and synchronous RPC blocks on a
+// CompletableFuture with a timeout. Wire-compatible with every other
+// SDK in examples/; tests/test_java_wire_conformance.py holds this
+// file to the schema registry without a JVM.
+package maelstrom;
+
+import java.io.BufferedReader;
+import java.io.InputStreamReader;
+import java.util.ArrayList;
+import java.util.HashMap;
+import java.util.List;
+import java.util.Map;
+import java.util.concurrent.CompletableFuture;
+import java.util.concurrent.ConcurrentHashMap;
+import java.util.concurrent.ExecutorService;
+import java.util.concurrent.Executors;
+import java.util.concurrent.TimeUnit;
+import java.util.concurrent.TimeoutException;
+
+public final class Maelstrom {
+
+    /** Error catalog codes used by SDK helpers (core/errors.py). */
+    public static final int ERR_TIMEOUT = 0;
+    public static final int ERR_NOT_SUPPORTED = 10;
+    public static final int ERR_TEMPORARILY_UNAVAILABLE = 11;
+    public static final int ERR_CRASH = 13;
+    public static final int ERR_KEY_DOES_NOT_EXIST = 20;
+    public static final int ERR_PRECONDITION_FAILED = 22;
+    public static final int ERR_TXN_CONFLICT = 30;
+
+    /** Typed RPC error: thrown by handlers to send an error reply. */
+    public static final class RpcException extends Exception {
+        public final int code;
+        public RpcException(int code, String text) {
+            super(text);
+            this.code = code;
+        }
+    }
+
+    /** A handler processes one request body and returns the reply
+     *  body (null for no reply). */
+    public interface Handler {
+        Map<String, Object> handle(Map<String, Object> msg,
+                                   Map<String, Object> body)
+            throws Exception;
+    }
+
+    public static final class Node {
+        private final Object writeLock = new Object();
+        private final Map<String, Handler> handlers = new HashMap<>();
+        private final Map<Long, CompletableFuture<Map<String, Object>>>
+            pending = new ConcurrentHashMap<>();
+        private final ExecutorService pool =
+            Executors.newCachedThreadPool();
+        private volatile String nodeId = "";
+        private volatile List<String> nodeIds = new ArrayList<>();
+        private long nextMsgId = 0;
+        private Runnable onInit = null;
+
+        public String id() { return nodeId; }
+        public List<String> peers() { return nodeIds; }
+
+        public void handle(String type, Handler h) {
+            if (handlers.putIfAbsent(type, h) != null)
+                throw new IllegalStateException("duplicate handler " + type);
+        }
+
+        public void onInit(Runnable r) { onInit = r; }
+
+        private void writeEnvelope(String dest, Map<String, Object> body) {
+            Map<String, Object> env = new HashMap<>();
+            env.put("src", nodeId);
+            env.put("dest", dest);
+            env.put("body", body);
+            synchronized (writeLock) {
+                System.out.println(Json.write(env));
+                System.out.flush();
+            }
+        }
+
+        public void send(String dest, Map<String, Object> body) {
+            writeEnvelope(dest, body);
+        }
+
+        @SuppressWarnings("unchecked")
+        public void reply(Map<String, Object> req,
+                          Map<String, Object> body) {
+            Map<String, Object> reqBody =
+                (Map<String, Object>) req.get("body");
+            Object msgId = reqBody.get("msg_id");
+            if (msgId != null) body.put("in_reply_to", msgId);
+            writeEnvelope((String) req.get("src"), body);
+        }
+
+        /** Blocking RPC with timeout; error replies and timeouts
+         *  surface as RpcException. */
+        public Map<String, Object> rpc(String dest,
+                                       Map<String, Object> body,
+                                       long timeoutMillis)
+                throws RpcException {
+            long id;
+            CompletableFuture<Map<String, Object>> fut =
+                new CompletableFuture<>();
+            synchronized (writeLock) { id = ++nextMsgId; }
+            pending.put(id, fut);
+            body.put("msg_id", id);
+            writeEnvelope(dest, body);
+            try {
+                Map<String, Object> rep =
+                    fut.get(timeoutMillis, TimeUnit.MILLISECONDS);
+                if ("error".equals(rep.get("type")))
+                    throw new RpcException(
+                        ((Number) rep.getOrDefault("code", 13)).intValue(),
+                        String.valueOf(rep.get("text")));
+                return rep;
+            } catch (TimeoutException e) {
+                throw new RpcException(ERR_TIMEOUT, "RPC timeout");
+            } catch (InterruptedException | java.util.concurrent.ExecutionException e) {
+                throw new RpcException(ERR_CRASH, e.toString());
+            } finally {
+                pending.remove(id);
+            }
+        }
+
+        @SuppressWarnings("unchecked")
+        public void run() throws Exception {
+            BufferedReader in = new BufferedReader(
+                new InputStreamReader(System.in));
+            String line;
+            while ((line = in.readLine()) != null) {
+                if (line.isEmpty()) continue;
+                Map<String, Object> msg =
+                    (Map<String, Object>) Json.read(line);
+                Map<String, Object> body =
+                    (Map<String, Object>) msg.get("body");
+                Object irt = body.get("in_reply_to");
+                if (irt != null) {
+                    CompletableFuture<Map<String, Object>> fut =
+                        pending.get(((Number) irt).longValue());
+                    if (fut != null) fut.complete(body);
+                    continue;
+                }
+                String type = (String) body.get("type");
+                if ("init".equals(type)) {
+                    nodeId = (String) body.get("node_id");
+                    List<String> ids = new ArrayList<>();
+                    for (Object o : (List<Object>) body.get("node_ids"))
+                        ids.add((String) o);
+                    nodeIds = ids;
+                    Map<String, Object> ok = new HashMap<>();
+                    ok.put("type", "init_ok");
+                    reply(msg, ok);
+                    if (onInit != null) onInit.run();
+                    continue;
+                }
+                Handler h = handlers.get(type);
+                if (h == null) {
+                    reply(msg, errorBody(ERR_NOT_SUPPORTED,
+                                         "unknown type " + type));
+                    continue;
+                }
+                pool.submit(() -> dispatch(h, msg, body));
+            }
+            pool.shutdown();
+            pool.awaitTermination(5, TimeUnit.SECONDS);
+        }
+
+        private void dispatch(Handler h, Map<String, Object> msg,
+                              Map<String, Object> body) {
+            try {
+                Map<String, Object> rep = h.handle(msg, body);
+                if (rep != null) reply(msg, rep);
+            } catch (RpcException e) {
+                reply(msg, errorBody(e.code, e.getMessage()));
+            } catch (Exception e) {
+                reply(msg, errorBody(ERR_CRASH, e.toString()));
+            }
+        }
+
+        private static Map<String, Object> errorBody(int code,
+                                                     String text) {
+            Map<String, Object> b = new HashMap<>();
+            b.put("type", "error");
+            b.put("code", code);
+            b.put("text", text);
+            return b;
+        }
+    }
+
+    /** KV client for the harness services (lin-kv / seq-kv / lww-kv).
+     *  The role of demo/go/kv.go on this SDK's blocking surface. */
+    public static final class KV {
+        private final Node node;
+        private final String service;
+        public long timeoutMillis = 5000;
+
+        private KV(Node n, String s) { node = n; service = s; }
+        public static KV lin(Node n) { return new KV(n, "lin-kv"); }
+        public static KV seq(Node n) { return new KV(n, "seq-kv"); }
+        public static KV lww(Node n) { return new KV(n, "lww-kv"); }
+
+        public Object read(Object key) throws RpcException {
+            Map<String, Object> b = new HashMap<>();
+            b.put("type", "read");
+            b.put("key", key);
+            return node.rpc(service, b, timeoutMillis).get("value");
+        }
+
+        public long readLong(Object key, long dflt) throws RpcException {
+            try {
+                return ((Number) read(key)).longValue();
+            } catch (RpcException e) {
+                if (e.code == ERR_KEY_DOES_NOT_EXIST) return dflt;
+                throw e;
+            }
+        }
+
+        public void write(Object key, Object value) throws RpcException {
+            Map<String, Object> b = new HashMap<>();
+            b.put("type", "write");
+            b.put("key", key);
+            b.put("value", value);
+            node.rpc(service, b, timeoutMillis);
+        }
+
+        public void cas(Object key, Object from, Object to,
+                        boolean createIfNotExists) throws RpcException {
+            Map<String, Object> b = new HashMap<>();
+            b.put("type", "cas");
+            b.put("key", key);
+            b.put("from", from);
+            b.put("to", to);
+            b.put("create_if_not_exists", createIfNotExists);
+            node.rpc(service, b, timeoutMillis);
+        }
+    }
+
+    /** Minimal JSON codec: objects, arrays, strings, longs, doubles,
+     *  booleans, null — the wire subset every SDK here speaks. */
+    public static final class Json {
+        public static String write(Object v) {
+            StringBuilder sb = new StringBuilder();
+            writeTo(sb, v);
+            return sb.toString();
+        }
+
+        @SuppressWarnings("unchecked")
+        private static void writeTo(StringBuilder sb, Object v) {
+            if (v == null) { sb.append("null"); return; }
+            if (v instanceof String) { writeString(sb, (String) v); return; }
+            if (v instanceof Map) {
+                sb.append('{');
+                boolean first = true;
+                for (Map.Entry<String, Object> e :
+                         ((Map<String, Object>) v).entrySet()) {
+                    if (!first) sb.append(',');
+                    first = false;
+                    writeString(sb, e.getKey());
+                    sb.append(':');
+                    writeTo(sb, e.getValue());
+                }
+                sb.append('}');
+                return;
+            }
+            if (v instanceof List) {
+                sb.append('[');
+                boolean first = true;
+                for (Object o : (List<Object>) v) {
+                    if (!first) sb.append(',');
+                    first = false;
+                    writeTo(sb, o);
+                }
+                sb.append(']');
+                return;
+            }
+            sb.append(v);   // Number / Boolean
+        }
+
+        private static void writeString(StringBuilder sb, String s) {
+            sb.append('"');
+            for (int i = 0; i < s.length(); i++) {
+                char c = s.charAt(i);
+                switch (c) {
+                    case '"': sb.append("\\\""); break;
+                    case '\\': sb.append("\\\\"); break;
+                    case '\n': sb.append("\\n"); break;
+                    case '\r': sb.append("\\r"); break;
+                    case '\t': sb.append("\\t"); break;
+                    default:
+                        if (c < 0x20) sb.append(String.format("\\u%04x", (int) c));
+                        else sb.append(c);
+                }
+            }
+            sb.append('"');
+        }
+
+        public static Object read(String s) {
+            int[] pos = {0};
+            Object v = readValue(s, pos);
+            return v;
+        }
+
+        private static void ws(String s, int[] p) {
+            while (p[0] < s.length()
+                   && Character.isWhitespace(s.charAt(p[0]))) p[0]++;
+        }
+
+        private static Object readValue(String s, int[] p) {
+            ws(s, p);
+            char c = s.charAt(p[0]);
+            if (c == '{') return readObject(s, p);
+            if (c == '[') return readArray(s, p);
+            if (c == '"') return readString(s, p);
+            if (s.startsWith("true", p[0])) { p[0] += 4; return Boolean.TRUE; }
+            if (s.startsWith("false", p[0])) { p[0] += 5; return Boolean.FALSE; }
+            if (s.startsWith("null", p[0])) { p[0] += 4; return null; }
+            int start = p[0];
+            boolean dbl = false;
+            while (p[0] < s.length()
+                   && "+-0123456789.eE".indexOf(s.charAt(p[0])) >= 0) {
+                char d = s.charAt(p[0]);
+                if (d == '.' || d == 'e' || d == 'E') dbl = true;
+                p[0]++;
+            }
+            String num = s.substring(start, p[0]);
+            return dbl ? (Object) Double.parseDouble(num)
+                       : (Object) Long.parseLong(num);
+        }
+
+        private static Map<String, Object> readObject(String s, int[] p) {
+            Map<String, Object> m = new HashMap<>();
+            p[0]++;  // {
+            ws(s, p);
+            if (s.charAt(p[0]) == '}') { p[0]++; return m; }
+            while (true) {
+                ws(s, p);
+                String k = readString(s, p);
+                ws(s, p);
+                p[0]++;  // :
+                m.put(k, readValue(s, p));
+                ws(s, p);
+                char c = s.charAt(p[0]++);
+                if (c == '}') return m;
+                // else ',' — continue
+            }
+        }
+
+        private static List<Object> readArray(String s, int[] p) {
+            List<Object> l = new ArrayList<>();
+            p[0]++;  // [
+            ws(s, p);
+            if (s.charAt(p[0]) == ']') { p[0]++; return l; }
+            while (true) {
+                l.add(readValue(s, p));
+                ws(s, p);
+                char c = s.charAt(p[0]++);
+                if (c == ']') return l;
+            }
+        }
+
+        private static String readString(String s, int[] p) {
+            StringBuilder sb = new StringBuilder();
+            p[0]++;  // "
+            while (true) {
+                char c = s.charAt(p[0]++);
+                if (c == '"') return sb.toString();
+                if (c == '\\') {
+                    char e = s.charAt(p[0]++);
+                    switch (e) {
+                        case 'n': sb.append('\n'); break;
+                        case 'r': sb.append('\r'); break;
+                        case 't': sb.append('\t'); break;
+                        case 'b': sb.append('\b'); break;
+                        case 'f': sb.append('\f'); break;
+                        case 'u':
+                            sb.append((char) Integer.parseInt(
+                                s.substring(p[0], p[0] + 4), 16));
+                            p[0] += 4;
+                            break;
+                        default: sb.append(e);
+                    }
+                } else {
+                    sb.append(c);
+                }
+            }
+        }
+    }
+
+    private Maelstrom() {}
+}

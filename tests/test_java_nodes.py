@@ -1,0 +1,59 @@
+"""End-to-end runs of the Java example nodes through the process
+runtime. Skips cleanly when no JVM toolchain is present (this image
+ships none — the static wire conformance in
+test_java_wire_conformance.py still runs)."""
+
+import os
+import shutil
+import subprocess
+
+import pytest
+
+from maelstrom_tpu import run_test
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+J_DIR = os.path.join(REPO, "examples", "java")
+
+pytestmark = pytest.mark.skipif(
+    shutil.which("javac") is None or shutil.which("java") is None,
+    reason="no JVM toolchain in image")
+
+
+@pytest.fixture(scope="session")
+def java_classes(tmp_path_factory):
+    out = tmp_path_factory.mktemp("java-classes")
+    srcs = [os.path.join(J_DIR, f) for f in os.listdir(J_DIR)
+            if f.endswith(".java")]
+    subprocess.run(["javac", "-d", str(out)] + srcs, check=True,
+                   capture_output=True)
+    return out
+
+
+def _bin(classes, main):
+    return dict(bin="java",
+                bin_args=["-cp", str(classes), f"maelstrom.{main}"])
+
+
+def test_java_echo_e2e(java_classes, tmp_path):
+    res = run_test("echo", dict(
+        **_bin(java_classes, "EchoServer"), node_count=2,
+        time_limit=3.0, rate=20.0, concurrency=4,
+        store_root=str(tmp_path), seed=7))
+    assert res["valid?"] is True
+
+
+def test_java_broadcast_partition_e2e(java_classes, tmp_path):
+    res = run_test("broadcast", dict(
+        **_bin(java_classes, "BroadcastServer"), node_count=3,
+        time_limit=6.0, rate=20.0, concurrency=4,
+        nemesis=["partition"], nemesis_interval=2.0,
+        recovery_time=3.0, store_root=str(tmp_path), seed=7))
+    assert res["valid?"] is True
+
+
+def test_java_counter_seq_kv_e2e(java_classes, tmp_path):
+    res = run_test("g-counter", dict(
+        **_bin(java_classes, "CounterServer"), node_count=2,
+        time_limit=5.0, rate=10.0, concurrency=4,
+        store_root=str(tmp_path), seed=7))
+    assert res["valid?"] is True

@@ -1,0 +1,88 @@
+"""Runtime-independent wire conformance for the Java SDK + nodes.
+
+No JVM exists in this image, so — like the JS/Go/Ruby suites — the
+sources are validated STATICALLY against the wire protocol and the
+schema registry. The e2e suite (test_java_nodes.py) runs whenever a
+`javac`/`java` toolchain appears."""
+
+import os
+import re
+
+import pytest
+
+import maelstrom_tpu.workloads  # noqa: F401 — populate the registry
+from maelstrom_tpu.core.errors import ERRORS_BY_CODE
+from maelstrom_tpu.core.schema import REGISTRY
+
+J_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "examples", "java")
+
+SDK = open(os.path.join(J_DIR, "Maelstrom.java")).read()
+
+NODES = {
+    "EchoServer.java": ("echo", set()),
+    "BroadcastServer.java": ("broadcast", {"gossip"}),
+    "CounterServer.java": ("g-counter", set()),
+}
+
+
+def _literal_types(src):
+    """Every "type" -> "x" put into a reply/send body."""
+    return set(re.findall(
+        r'put\("type",\s*"([a-z_]+)"\)', src))
+
+
+def test_sdk_envelope_shape():
+    assert 'env.put("src", nodeId)' in SDK
+    assert 'env.put("dest", dest)' in SDK
+    assert 'env.put("body", body)' in SDK
+    assert '"in_reply_to"' in SDK and '"msg_id"' in SDK
+
+
+def test_sdk_init_handshake():
+    assert '"init_ok"' in SDK
+    assert '"node_id"' in SDK and '"node_ids"' in SDK
+
+
+def test_sdk_error_codes_in_catalog():
+    codes = {int(c) for c in re.findall(
+        r"ERR_[A-Z_]+ = (\d+);", SDK)}
+    assert codes, "no error constants found"
+    assert codes <= set(ERRORS_BY_CODE), codes - set(ERRORS_BY_CODE)
+
+
+def test_kv_client_speaks_service_schema():
+    for field in ('put("type", "read")', 'put("type", "write")',
+                  'put("type", "cas")', 'put("key", key)',
+                  'put("value", value)', 'put("from", from)',
+                  'put("to", to)', 'put("create_if_not_exists"'):
+        assert field in SDK, field
+    assert '"lin-kv"' in SDK and '"seq-kv"' in SDK and '"lww-kv"' in SDK
+
+
+def test_sdk_json_codec_roundtrip_shape():
+    # the embedded codec must at least cover the wire's value grammar
+    for token in ("readObject", "readArray", "readString",
+                  "Double.parseDouble", "Long.parseLong",
+                  '"null"', '"true"', '"false"'):
+        assert token in SDK, token
+
+
+@pytest.mark.parametrize("name", sorted(NODES))
+def test_node_reply_types_in_registry(name):
+    namespace, internal = NODES[name]
+    src = open(os.path.join(J_DIR, name)).read()
+    emitted = _literal_types(src)
+    rpcs = REGISTRY.get(namespace)
+    assert rpcs, f"no registry namespace {namespace}"
+    known = set()
+    for rpc in rpcs.values():
+        known.add(rpc.name)
+        known.add(rpc.response_type)
+    allowed = known | internal | {"error", "init_ok", "topology_ok",
+                                  "topology", "read", "write", "cas"}
+    unknown = emitted - allowed
+    assert not unknown, (name, unknown)
+    reply_types = {r.response_type for r in rpcs.values()}
+    assert emitted & reply_types, (name, "serves no workload reply",
+                                   emitted, reply_types)

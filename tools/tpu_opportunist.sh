@@ -111,62 +111,75 @@ run_xval() {
   local out="$1" ticks="$2" chunk="$3" deadline="$4" plat="${5:-}"
   echo "$(date +%s) xval: starting $out ticks=$ticks chunk=$chunk" \
     "(deadline ${deadline}s)" >> "$HEALTH_LOG"
+  # one command line for both platforms — only the backend-selection
+  # prefix differs (the CPU leg must unset the tunnel gate env or
+  # import jax can hang)
+  local -a pre=()
+  [ "$plat" = cpu ] && pre=(env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu)
   local rc
-  if [ "$plat" = cpu ]; then
-    # local CPU leg: tunnel gate env unset or import jax can hang
-    XVAL_INSTANCES=32768 XVAL_TICKS="$ticks" XVAL_CHUNK="$chunk" \
-      XVAL_SEED=7 timeout -k 15 "$deadline" \
-      env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
-      python tools/platform_xval.py run "$out" \
-      2>>/tmp/tpu_xval_err.log
-  else
-    XVAL_INSTANCES=32768 XVAL_TICKS="$ticks" XVAL_CHUNK="$chunk" \
-      XVAL_SEED=7 timeout -k 15 "$deadline" \
-      python tools/platform_xval.py run "$out" \
-      2>>/tmp/tpu_xval_err.log
-  fi
+  XVAL_INSTANCES=32768 XVAL_TICKS="$ticks" XVAL_CHUNK="$chunk" \
+    XVAL_SEED=7 timeout -k 15 "$deadline" \
+    ${pre[@]+"${pre[@]}"} \
+    python tools/platform_xval.py run "$out" \
+    2>>/tmp/tpu_xval_err.log
   rc=$?
+  # platform_xval writes OUT only at the very end, but a -k SIGKILL
+  # can still truncate mid-dump — never leave a failed run's file
   [ "$rc" -ne 0 ] && rm -f "$out"
   return "$rc"
 }
 
-# try_zoom: once the coarse compare has pinned a divergent 25-tick
-# chunk, recapture BOTH platforms at 1-tick digests up to that chunk's
-# end so the first divergent tick + carry leaf are on record. Runs on
-# EVERY healthy iteration until the fine compare lands (a tunnel drop
-# mid-zoom just retries next window); the CPU leg runs in the
-# background so scarce tunnel-healthy time is spent on the TPU side.
-try_zoom() {
+# The divergence-hunt zoom: once the coarse compare has pinned a
+# divergent 25-tick chunk, recapture BOTH platforms at 1-tick digests
+# up to that chunk's end. Each capture leg is singleton-guarded by its
+# output file, so a tunnel drop mid-zoom retries ONLY the missing leg.
+
+zoom_target() {   # prints the divergent-chunk end tick, if any
   grep -q "FIRST DIVERGENCE" artifacts/xval_compare_32k.txt \
-    2>/dev/null || return 0
+    2>/dev/null || return 1
+  grep -o 'tick <= [0-9]*' artifacts/xval_compare_32k.txt \
+    | grep -o '[0-9]*' | head -1
+}
+
+# ensure_cpu_fine: the CPU leg needs no TPU, so it launches (in the
+# background, once) on ANY loop iteration — the abundant tunnel-down
+# time funds it, never the scarce healthy windows.
+CPU_FINE_PID=""
+ensure_cpu_fine() {
+  [ -f artifacts/xval_cpu_32k_fine.json ] && return 0
+  [ -f artifacts/xval_compare_32k_fine.txt ] && return 0
+  [ -n "$CPU_FINE_PID" ] && kill -0 "$CPU_FINE_PID" 2>/dev/null \
+    && return 0
+  local T
+  T="$(zoom_target)" || return 0
+  [ -n "$T" ] || return 0
+  echo "$(date +%s) xval: CPU fine leg to tick $T (background)" \
+    >> "$HEALTH_LOG"
+  run_xval artifacts/xval_cpu_32k_fine.json "$T" 1 1800 cpu &
+  CPU_FINE_PID=$!
+}
+
+# try_zoom (healthy windows only): capture the TPU fine leg if it is
+# still missing, then compare as soon as both legs exist.
+try_zoom() {
   [ -f artifacts/xval_compare_32k_fine.txt ] && return 0
   local T
-  T="$(grep -o 'tick <= [0-9]*' artifacts/xval_compare_32k.txt \
-       | grep -o '[0-9]*' | head -1)"
+  T="$(zoom_target)" || return 0
   [ -n "$T" ] || return 0
-  echo "$(date +%s) xval: ZOOM to tick $T (1-tick digests)" \
-    >> "$HEALTH_LOG"
-  local cpu_pid=""
-  if [ ! -f artifacts/xval_cpu_32k_fine.json ]; then
-    run_xval artifacts/xval_cpu_32k_fine.json "$T" 1 1800 cpu &
-    cpu_pid=$!
+  if [ ! -f artifacts/xval_tpu_32k_fine.json ]; then
+    echo "$(date +%s) xval: ZOOM TPU leg to tick $T (1-tick digests)" \
+      >> "$HEALTH_LOG"
+    run_xval artifacts/xval_tpu_32k_fine.json "$T" 1 1500 || return 0
   fi
-  if run_xval artifacts/xval_tpu_32k_fine.json "$T" 1 1500; then
-    [ -n "$cpu_pid" ] && wait "$cpu_pid"
-    if [ -f artifacts/xval_cpu_32k_fine.json ]; then
-      python tools/platform_xval.py compare \
-        artifacts/xval_cpu_32k_fine.json \
-        artifacts/xval_tpu_32k_fine.json \
-        > artifacts/xval_compare_32k_fine.txt 2>&1
-      echo "$(date +%s) xval: fine compare rc=$? written" \
-        >> "$HEALTH_LOG"
-      commit_artifacts artifacts/xval_cpu_32k_fine.json \
-        artifacts/xval_tpu_32k_fine.json \
-        artifacts/xval_compare_32k_fine.txt "$HEALTH_LOG"
-    fi
-  else
-    [ -n "$cpu_pid" ] && wait "$cpu_pid"
-  fi
+  [ -f artifacts/xval_cpu_32k_fine.json ] || return 0
+  python tools/platform_xval.py compare \
+    artifacts/xval_cpu_32k_fine.json \
+    artifacts/xval_tpu_32k_fine.json \
+    > artifacts/xval_compare_32k_fine.txt 2>&1
+  echo "$(date +%s) xval: fine compare rc=$? written" >> "$HEALTH_LOG"
+  commit_artifacts artifacts/xval_cpu_32k_fine.json \
+    artifacts/xval_tpu_32k_fine.json \
+    artifacts/xval_compare_32k_fine.txt "$HEALTH_LOG"
 }
 
 last_state=""
@@ -221,6 +234,7 @@ while true; do
       fi
     fi
   fi
+  ensure_cpu_fine
   [ "$state" != "$last_state" ] && last_state="$state"
   sleep "$SLEEP_S"
 done
