@@ -62,7 +62,8 @@ public final class Maelstrom {
             Executors.newCachedThreadPool();
         private volatile String nodeId = "";
         private volatile List<String> nodeIds = new ArrayList<>();
-        private long nextMsgId = 0;
+        private final java.util.concurrent.atomic.AtomicLong nextMsgId =
+            new java.util.concurrent.atomic.AtomicLong();
         private Runnable onInit = null;
 
         public String id() { return nodeId; }
@@ -106,10 +107,9 @@ public final class Maelstrom {
                                        Map<String, Object> body,
                                        long timeoutMillis)
                 throws RpcException {
-            long id;
+            long id = nextMsgId.incrementAndGet();
             CompletableFuture<Map<String, Object>> fut =
                 new CompletableFuture<>();
-            synchronized (writeLock) { id = ++nextMsgId; }
             pending.put(id, fut);
             body.put("msg_id", id);
             writeEnvelope(dest, body);

@@ -64,18 +64,34 @@ def _check_range(actual, bound, label):
 @pytest.mark.parametrize("spec", SPECS, ids=lambda s: s["id"])
 def test_guide_embedded_config(spec):
     expect = spec["expect"]
-    if spec.get("runtime") == "tpu":
-        from maelstrom_tpu.models import get_model
-        from maelstrom_tpu.tpu.harness import run_tpu_test
-        model = get_model(spec["workload"],
-                          spec["opts"].get("node_count", 1), "grid")
-        res = run_tpu_test(model, dict(spec["opts"]))
+    if spec.get("runtime") in ("tpu", "native"):
+        # the vectorized runtimes share a results shape; only the
+        # harness call differs
+        if spec["runtime"] == "tpu":
+            from maelstrom_tpu.models import get_model
+            from maelstrom_tpu.tpu.harness import run_tpu_test
+            model = get_model(spec["workload"],
+                              spec["opts"].get("node_count", 1), "grid")
+            res = run_tpu_test(model, dict(spec["opts"]))
+        else:
+            from maelstrom_tpu.native import native_available
+            if not native_available():
+                pytest.skip("native engine unavailable "
+                            "(no C++ toolchain)")
+            from maelstrom_tpu.native.harness import run_native_test
+            res = run_native_test(dict(spec["opts"],
+                                       workload=spec["workload"]))
         if "delivered_min" in expect:
             assert res["net"]["delivered"] >= expect["delivered_min"], \
                 res["net"]
         if "violating" in expect:
             assert (res["invariants"]["violating-instances"]
                     == expect["violating"]), res["invariants"]
+        if "invalid_instances_min" in expect:
+            n_bad = sum(1 for i in res["instances"]
+                        if i.get("valid?") is False)
+            assert n_bad >= expect["invalid_instances_min"], \
+                res["instances"]
     else:
         from maelstrom_tpu.runner import run_test
         bin_cmd = example_bin(spec["node"])

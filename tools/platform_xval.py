@@ -112,8 +112,12 @@ def cmd_run(out_path: str) -> None:
                   zip(carry.stats._fields, carry.stats)},
         "checkpoints": checkpoints,
     }
-    with open(out_path, "w") as f:
+    # atomic publish: concurrent readers (the opportunist's zoom
+    # compare) must never observe a partially-written capture
+    tmp = out_path + ".tmp"
+    with open(tmp, "w") as f:
         json.dump(result, f)
+    os.replace(tmp, out_path)
     print(f"xval: wrote {out_path} (violations="
           f"{result['violations']}, stats={result['stats']})",
           file=sys.stderr, flush=True)

@@ -157,6 +157,9 @@ ensure_cpu_fine() {
     >> "$HEALTH_LOG"
   run_xval artifacts/xval_cpu_32k_fine.json "$T" 1 1800 cpu &
   CPU_FINE_PID=$!
+  # deprioritized: must not starve foreground TPU work if a healthy
+  # window opens while it runs
+  renice -n 10 -p "$CPU_FINE_PID" >/dev/null 2>&1 || true
 }
 
 # try_zoom (healthy windows only): capture the TPU fine leg if it is
@@ -233,8 +236,12 @@ while true; do
         commit_artifacts artifacts/scaling_tpu_partial.jsonl "$HEALTH_LOG"
       fi
     fi
+  else
+    # the CPU fine leg needs no tunnel — the abundant down-time funds
+    # it, never a healthy window (where it would compete with the
+    # foreground TPU captures for host CPU)
+    ensure_cpu_fine
   fi
-  ensure_cpu_fine
   [ "$state" != "$last_state" ] && last_state="$state"
   sleep "$SLEEP_S"
 done
