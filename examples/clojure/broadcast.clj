@@ -1,0 +1,50 @@
+#!/usr/bin/env bb
+;; Broadcast node (workload: broadcast): gossip-on-receive plus timed
+;; anti-entropy toward topology neighbors so partitions heal.
+(load-file (str (or (-> *file* java.io.File. .getParent) ".")
+                "/maelstrom.clj"))
+
+(def seen (atom #{}))
+(def neighbors (atom []))
+
+(defn gossip! [values except]
+  (when (seq values)
+    (doseq [peer @neighbors
+            :when (not= peer except)]
+      (maelstrom/send! peer {:type "gossip" :values (vec values)}))))
+
+(maelstrom/on "topology"
+  (fn [_msg body]
+    (reset! neighbors
+            (vec (get-in body [:topology (keyword @maelstrom/node-id)]
+                          [])))
+    {:type "topology_ok"}))
+
+(maelstrom/on "broadcast"
+  (fn [_msg body]
+    (let [v (:message body)
+          fresh? (not (contains? @seen v))]
+      (swap! seen conj v)
+      (when fresh? (gossip! [v] nil))
+      {:type "broadcast_ok"})))
+
+(maelstrom/on "gossip"
+  (fn [msg body]
+    (let [fresh (remove @seen (:values body))]
+      (swap! seen into fresh)
+      (gossip! fresh (:src msg)))
+    nil))
+
+(maelstrom/on "read"
+  (fn [_msg _body]
+    {:type "read_ok" :messages (vec @seen)}))
+
+(maelstrom/on-init
+  (fn []
+    (future
+      (loop []
+        (Thread/sleep 500)
+        (gossip! @seen nil)
+        (recur)))))
+
+(maelstrom/run!)

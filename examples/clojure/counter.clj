@@ -1,0 +1,39 @@
+#!/usr/bin/env bb
+;; Grow-only counter over seq-kv (workload: g-counter): CAS-increment
+;; a per-node key, sum every node's key on read — exercises the KV
+;; client against the harness's Sequential service.
+(load-file (str (or (-> *file* java.io.File. .getParent) ".")
+                "/maelstrom.clj"))
+
+(defn my-key [] (str "counter-" @maelstrom/node-id))
+
+(maelstrom/on "add"
+  (fn [_msg body]
+    (loop []
+      (let [cur (maelstrom/kv-read-default "seq-kv" (my-key) 0)
+            ok? (try
+                  (maelstrom/kv-cas "seq-kv" (my-key) cur
+                                    (+ cur (:delta body)) true)
+                  true
+                  (catch clojure.lang.ExceptionInfo e
+                    (if (= (:maelstrom/code (ex-data e))
+                           maelstrom/err-precondition-failed)
+                      false
+                      (throw e))))]
+        (if ok? {:type "add_ok"} (recur))))))
+
+(maelstrom/on "read"
+  (fn [_msg body]
+    ;; force recency: a write bumps this session's seq-kv watermark to
+    ;; the newest state before summing (the Sequential service may
+    ;; otherwise serve a stale snapshot — examples/python/
+    ;; counter_seq_kv.py documents the same fix)
+    (maelstrom/kv-write "seq-kv" (str "sync-" @maelstrom/node-id)
+                        (:msg_id body 0))
+    {:type "read_ok"
+     :value (reduce + 0
+                    (map #(maelstrom/kv-read-default
+                           "seq-kv" (str "counter-" %) 0)
+                         @maelstrom/node-ids))}))
+
+(maelstrom/run!)

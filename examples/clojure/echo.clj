@@ -1,0 +1,10 @@
+#!/usr/bin/env bb
+;; Echo node (workload: echo).
+(load-file (str (or (-> *file* java.io.File. .getParent) ".")
+                "/maelstrom.clj"))
+
+(maelstrom/on "echo"
+  (fn [_msg body]
+    {:type "echo_ok" :echo (:echo body)}))
+
+(maelstrom/run!)

@@ -1,0 +1,145 @@
+;; Clojure (babashka) node SDK for the maelstrom_tpu process runtime:
+;; JSON envelopes {src, dest, body} per line on stdin/stdout, init
+;; handshake, handler dispatch by body type, request/reply RPC via
+;; msg_id / in_reply_to.
+;;
+;; Counterpart of the reference's babashka library (demo/clojure/
+;; node.clj), re-designed rather than ported: one namespace holding an
+;; atom of node state, handlers as pure-ish fns RETURNING the reply
+;; body (nil = no reply), error maps thrown via ex-info, and blocking
+;; RPC on promises. Runs under babashka or JVM clojure (only
+;; cheshire/clojure.data.json-free: bb ships cheshire).
+;;
+;; No Clojure runtime ships in this image —
+;; tests/test_clojure_wire_conformance.py holds these sources to the
+;; schema registry statically; the e2e suite runs when `bb` appears.
+
+(ns maelstrom
+  (:require [cheshire.core :as json]))
+
+(def node-id (atom nil))
+(def node-ids (atom []))
+(def handlers (atom {}))
+(def init-hooks (atom []))
+(def pending (atom {}))          ; msg-id -> promise
+(def next-msg-id (atom 0))
+(def write-lock (Object.))
+
+;; error catalog codes used by SDK helpers (core/errors.py)
+(def err-timeout 0)
+(def err-not-supported 10)
+(def err-temporarily-unavailable 11)
+(def err-crash 13)
+(def err-key-does-not-exist 20)
+(def err-precondition-failed 22)
+(def err-txn-conflict 30)
+
+(defn rpc-error
+  "An ex-info a handler throws to send a typed error reply."
+  [code text]
+  (ex-info text {:maelstrom/code code}))
+
+(defn- write-envelope! [dest body]
+  (locking write-lock
+    (println (json/generate-string
+              {:src @node-id :dest dest :body body}))
+    (flush)))
+
+(defn send!
+  "Fire-and-forget a body to dest."
+  [dest body]
+  (write-envelope! dest body))
+
+(defn reply!
+  "Answer msg with body, stamping in_reply_to from its msg_id."
+  [msg body]
+  (write-envelope! (:src msg)
+                   (assoc body :in_reply_to (get-in msg [:body :msg_id]))))
+
+(defn rpc!
+  "Blocking RPC: returns the reply body, throws (rpc-error ...) on an
+  error reply or timeout."
+  ([dest body] (rpc! dest body 5000))
+  ([dest body timeout-ms]
+   (let [id (swap! next-msg-id inc)
+         p (promise)]
+     (swap! pending assoc id p)
+     (write-envelope! dest (assoc body :msg_id id))
+     (let [rep (deref p timeout-ms ::timeout)]
+       (swap! pending dissoc id)
+       (cond
+         (= rep ::timeout)
+         (throw (rpc-error err-timeout "RPC timeout"))
+         (= (:type rep) "error")
+         (throw (rpc-error (:code rep) (str (:text rep))))
+         :else rep)))))
+
+(defn on
+  "Register a handler: (on \"echo\" (fn [msg body] {:type \"echo_ok\"}))"
+  [type f]
+  (swap! handlers assoc type f))
+
+(defn on-init [f]
+  (swap! init-hooks conj f))
+
+;; --- KV client for the harness services (lin-kv / seq-kv / lww-kv) --
+
+(defn kv-read [service k]
+  (:value (rpc! service {:type "read" :key k})))
+
+(defn kv-read-default [service k default]
+  (try (kv-read service k)
+       (catch clojure.lang.ExceptionInfo e
+         (if (= (:maelstrom/code (ex-data e)) err-key-does-not-exist)
+           default
+           (throw e)))))
+
+(defn kv-write [service k v]
+  (rpc! service {:type "write" :key k :value v})
+  nil)
+
+(defn kv-cas
+  ([service k from to] (kv-cas service k from to false))
+  ([service k from to create?]
+   (rpc! service {:type "cas" :key k :from from :to to
+                  :create_if_not_exists create?})
+   nil))
+
+;; --- main loop ------------------------------------------------------
+
+(defn- dispatch [msg body]
+  (if-let [h (get @handlers (:type body))]
+    (try
+      (when-let [rep (h msg body)]
+        (reply! msg rep))
+      (catch clojure.lang.ExceptionInfo e
+        (reply! msg {:type "error"
+                     :code (or (:maelstrom/code (ex-data e)) err-crash)
+                     :text (ex-message e)}))
+      (catch Exception e
+        (reply! msg {:type "error" :code err-crash
+                     :text (str e)})))
+    (reply! msg {:type "error" :code err-not-supported
+                 :text (str "unknown type " (:type body))})))
+
+(defn run!
+  "Main loop: route replies to waiting RPCs, handle init, dispatch
+  requests on futures (handlers may themselves block in rpc!)."
+  []
+  (doseq [line (line-seq (java.io.BufferedReader. *in*))]
+    (when-not (empty? line)
+      (let [msg (json/parse-string line true)
+            body (:body msg)]
+        (cond
+          (:in_reply_to body)
+          (when-let [p (get @pending (:in_reply_to body))]
+            (deliver p body))
+
+          (= (:type body) "init")
+          (do (reset! node-id (:node_id body))
+              (reset! node-ids (vec (:node_ids body)))
+              (reply! msg {:type "init_ok"})
+              (doseq [f @init-hooks] (f)))
+
+          :else
+          (future (dispatch msg body)))))))

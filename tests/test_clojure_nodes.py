@@ -1,0 +1,44 @@
+"""End-to-end runs of the Clojure (babashka) example nodes through the
+process runtime. Skips cleanly when no `bb` interpreter is present
+(this image ships none — the static wire conformance in
+test_clojure_wire_conformance.py still runs)."""
+
+import os
+import shutil
+
+import pytest
+
+from maelstrom_tpu import run_test
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CLJ = os.path.join(REPO, "examples", "clojure")
+
+pytestmark = pytest.mark.skipif(
+    shutil.which("bb") is None, reason="no babashka in image")
+
+
+def _bin(name):
+    return dict(bin="bb", bin_args=[os.path.join(CLJ, name)])
+
+
+def test_clojure_echo_e2e(tmp_path):
+    res = run_test("echo", dict(
+        **_bin("echo.clj"), node_count=2, time_limit=3.0, rate=20.0,
+        concurrency=4, store_root=str(tmp_path), seed=7))
+    assert res["valid?"] is True
+
+
+def test_clojure_broadcast_partition_e2e(tmp_path):
+    res = run_test("broadcast", dict(
+        **_bin("broadcast.clj"), node_count=3, time_limit=6.0,
+        rate=20.0, concurrency=4, nemesis=["partition"],
+        nemesis_interval=2.0, recovery_time=3.0,
+        store_root=str(tmp_path), seed=7))
+    assert res["valid?"] is True
+
+
+def test_clojure_counter_seq_kv_e2e(tmp_path):
+    res = run_test("g-counter", dict(
+        **_bin("counter.clj"), node_count=2, time_limit=5.0,
+        rate=10.0, concurrency=4, store_root=str(tmp_path), seed=7))
+    assert res["valid?"] is True

@@ -1,0 +1,59 @@
+"""Runtime-independent wire conformance for the Clojure (babashka) SDK
++ nodes — the seventh SDK language (the reference's broadest demo set,
+demo/clojure/, 2k LoC). No babashka/JVM ships in this image, so the
+sources are validated statically like the JS/Go/Ruby/Java suites; the
+e2e suite (test_clojure_nodes.py) runs when a `bb` binary appears."""
+
+import os
+import re
+
+import pytest
+
+from wire_conformance_common import (assert_error_codes_in_catalog,
+                                     assert_node_reply_types)
+
+CLJ_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "examples", "clojure")
+
+SDK = open(os.path.join(CLJ_DIR, "maelstrom.clj")).read()
+
+NODES = {
+    "echo.clj": ("echo", set()),
+    "broadcast.clj": ("broadcast", {"gossip"}),
+    "counter.clj": ("g-counter", set()),
+}
+
+
+def _literal_types(src):
+    return set(re.findall(r':type\s+"([a-z_]+)"', src))
+
+
+def test_sdk_envelope_shape():
+    assert ":src @node-id :dest dest :body body" in SDK
+    assert ":in_reply_to" in SDK and ":msg_id" in SDK
+
+
+def test_sdk_init_handshake():
+    assert '"init_ok"' in SDK
+    assert ":node_id" in SDK and ":node_ids" in SDK
+
+
+def test_sdk_error_codes_in_catalog():
+    codes = {int(c) for c in re.findall(
+        r"\(def err-[a-z-]+ (\d+)\)", SDK)}
+    assert_error_codes_in_catalog(codes)
+
+
+def test_kv_client_speaks_service_schema():
+    for field in (':type "read" :key', ':type "write" :key',
+                  ':type "cas" :key', ":value v", ":from from",
+                  ":to to", ":create_if_not_exists"):
+        assert field in SDK, field
+
+
+@pytest.mark.parametrize("name", sorted(NODES))
+def test_node_reply_types_in_registry(name):
+    namespace, internal = NODES[name]
+    src = open(os.path.join(CLJ_DIR, name)).read()
+    emitted = _literal_types(src)
+    assert_node_reply_types(namespace, internal, emitted, name)

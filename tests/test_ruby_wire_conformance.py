@@ -12,9 +12,8 @@ import re
 
 import pytest
 
-import maelstrom_tpu.workloads  # noqa: F401 — populate the registry
-from maelstrom_tpu.core.errors import ERRORS_BY_CODE
-from maelstrom_tpu.core.schema import REGISTRY
+from wire_conformance_common import (assert_error_codes_in_catalog,
+                                     assert_node_reply_types)
 
 RB_DIR = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "examples", "ruby")
@@ -47,8 +46,7 @@ def test_sdk_init_handshake():
 def test_sdk_error_codes_in_catalog():
     codes = {int(c) for c in re.findall(
         r"^\s+[A-Z_]+ = (\d+)$", SDK, re.M)}
-    assert codes, "no error constants found"
-    assert codes <= set(ERRORS_BY_CODE), codes - set(ERRORS_BY_CODE)
+    assert_error_codes_in_catalog(codes)
 
 
 def test_kv_client_speaks_service_schema():
@@ -64,16 +62,4 @@ def test_node_reply_types_in_registry(name):
     namespace, internal = NODES[name]
     src = open(os.path.join(RB_DIR, name)).read()
     emitted = _literal_types(src)
-    rpcs = REGISTRY.get(namespace)
-    assert rpcs, f"no registry namespace {namespace}"
-    known = set()
-    for rpc in rpcs.values():
-        known.add(rpc.name)
-        known.add(rpc.response_type)
-    allowed = known | internal | {"error", "init_ok", "topology_ok",
-                                  "topology", "read", "write", "cas"}
-    unknown = emitted - allowed
-    assert not unknown, (name, unknown)
-    reply_types = {r.response_type for r in rpcs.values()}
-    assert emitted & reply_types, (name, "serves no workload reply",
-                                   emitted, reply_types)
+    assert_node_reply_types(namespace, internal, emitted, name)

@@ -1,0 +1,40 @@
+"""Shared core of the per-language static wire-conformance suites
+(Go / Ruby / Java / Clojure; the older JS suite predates this helper
+and additionally drives body-literal extraction differently).
+
+Each language file keeps only what is language-specific — the regexes
+that extract emitted "type" literals and error-code constants, and the
+node -> (registry namespace, internal RPC types) map — and delegates
+the registry/catalog logic here so the five suites cannot drift."""
+
+import maelstrom_tpu.workloads  # noqa: F401 — populate the registry
+from maelstrom_tpu.core.errors import ERRORS_BY_CODE
+from maelstrom_tpu.core.schema import REGISTRY
+
+# types every SDK may emit regardless of workload: protocol plumbing
+# plus the KV-service client verbs
+_ALWAYS_ALLOWED = {"error", "init_ok", "topology_ok", "topology",
+                   "read", "write", "cas"}
+
+
+def assert_error_codes_in_catalog(codes):
+    """Every error constant an SDK defines must be a catalog code."""
+    assert codes, "no error constants found"
+    assert codes <= set(ERRORS_BY_CODE), codes - set(ERRORS_BY_CODE)
+
+
+def assert_node_reply_types(namespace, internal, emitted, label):
+    """The "type" literals a node emits must be its workload's request/
+    reply vocabulary (plus node-internal RPCs and plumbing), and the
+    node must actually serve at least one workload reply."""
+    rpcs = REGISTRY.get(namespace)
+    assert rpcs, f"no registry namespace {namespace}"
+    known = set()
+    for rpc in rpcs.values():
+        known.add(rpc.name)
+        known.add(rpc.response_type)
+    unknown = emitted - (known | set(internal) | _ALWAYS_ALLOWED)
+    assert not unknown, (label, unknown)
+    reply_types = {r.response_type for r in rpcs.values()}
+    assert emitted & reply_types, (label, "serves no workload reply",
+                                   emitted, reply_types)
