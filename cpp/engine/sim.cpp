@@ -72,7 +72,9 @@ struct Cfg {
   // commit, leader replies read results at apply time — the
   // reference's txn_list_append.clj:74-143 semantics over Raft)
   int64_t workload;           // 0 = lin-kv, 1 = txn-list-append,
-                              // 2 = g-set (gossip CRDT, set-full)
+                              // 2 = g-set (gossip CRDT, set-full),
+                              // 3 = broadcast (topology flooding +
+                              //     anti-entropy, set-full)
   int64_t txn_max;            // micro-ops per txn (<= TXN_CAP)
   int64_t list_cap;           // per-key list capacity; an append txn
                               // that would overflow aborts WHOLE with
@@ -83,9 +85,14 @@ struct Cfg {
                                  // (uncommitted) — leader changes
                                  // truncate acked txns; Elle catches
                                  // lost appends / aborted reads
-  int64_t flag_gset_no_gossip;   // BUG: g-set nodes never gossip —
-                                 // adds stay on one node; set-full
-                                 // reports them lost
+  int64_t flag_gset_no_gossip;   // BUG: gossip-family nodes (g-set,
+                                 // broadcast) never gossip — values
+                                 // stay on one node; set-full reports
+                                 // them lost
+  int64_t topology;   // broadcast neighbor graph: 0 total, 1 line,
+                      // 2 grid, 3 tree2, 4 tree3, 5 tree4 (the
+                      // reference's --topology registry,
+                      // broadcast.clj:169-178, node-index form)
 };
 
 constexpr int TXN_CAP = 4;    // engine-wide micro-op slot bound
@@ -98,6 +105,8 @@ enum MType : int32_t {
   M_TXN = 20, M_TXN_OK = 21,
   M_GADD = 30, M_GADD_OK = 31, M_GREAD = 32, M_GREAD_OK = 33,
   M_GMERGE = 34,
+  M_BCAST = 40, M_BCAST_OK = 41, M_BREAD = 42, M_BREAD_OK = 43,
+  M_BGOSSIP = 44,
   M_ERROR = 127
 };
 
@@ -226,6 +235,54 @@ struct Sim {
   std::vector<SchedPhase> sched;   // scripted nemesis (same for every
                                    // instance, like the device runtime's
                                    // kind="scripted")
+  uint64_t nbr[30] = {0};          // broadcast topology adjacency
+                                   // (bitmask per node; n_nodes <= 30)
+
+  void init_topology() {
+    int32_t n = int32_t(cfg.n_nodes);
+    auto link = [&](int32_t a, int32_t b) {
+      if (a != b && a >= 0 && a < n && b >= 0 && b < n) {
+        nbr[a] |= 1ull << b;
+        nbr[b] |= 1ull << a;
+      }
+    };
+    switch (cfg.topology) {
+      case 1:   // line
+        for (int32_t i = 0; i + 1 < n; ++i) link(i, i + 1);
+        break;
+      case 2: {  // grid, row-major, width ~ sqrt(n)
+        int32_t w = 1;
+        while (w * w < n) ++w;
+        for (int32_t i = 0; i < n; ++i) {
+          if (i % w + 1 < w) link(i, i + 1);
+          link(i, i + w);
+        }
+        break;
+      }
+      case 3: case 4: case 5: {  // tree with branching k
+        int32_t k = int32_t(cfg.topology) - 1;
+        for (int32_t i = 1; i < n; ++i) link(i, (i - 1) / k);
+        break;
+      }
+      default:  // total
+        for (int32_t i = 0; i < n; ++i)
+          for (int32_t j = i + 1; j < n; ++j) link(i, j);
+    }
+  }
+
+  // flood values to every topology neighbor of `me` except `except`
+  void bcast_flood(Instance& in, int32_t t, int32_t me,
+                   const std::vector<int32_t>& values, int32_t except) {
+    if (values.empty() || cfg.flag_gset_no_gossip) return;
+    for (int32_t p = 0; p < cfg.n_nodes; ++p) {
+      if (p == except || !((nbr[me] >> p) & 1)) continue;
+      Msg g;
+      g.valid = 1; g.src = me; g.origin = me; g.dest = p;
+      g.type = M_BGOSSIP;
+      g.ext = values;
+      send(in, t, std::move(g));
+    }
+  }
 
   int32_t last_log_term(const Node& nd) const {
     return nd.log_len > 0 ? nd.log_term[nd.log_len - 1] : 0;
@@ -404,15 +461,36 @@ struct Sim {
     Node& nd = in.nodes[me];
     int32_t n = int32_t(cfg.n_nodes);
     switch (m.type) {
+      case M_BCAST: {
+        int32_t v = m.body[0];
+        if (nd.gseen.insert(v).second) {
+          nd.gset.push_back(v);
+          bcast_flood(in, t, me, {v}, -1);
+        }
+        node_reply(in, t, me, m, M_BCAST_OK, 0, 0, 0);
+        break;
+      }
+      case M_BGOSSIP: {
+        std::vector<int32_t> fresh;
+        for (int32_t v : m.ext)
+          if (nd.gseen.insert(v).second) {
+            nd.gset.push_back(v);
+            fresh.push_back(v);
+          }
+        bcast_flood(in, t, me, fresh, m.src);
+        break;
+      }
       case M_GADD: {
         gset_merge(nd, &m.body[0], 1);
         node_reply(in, t, me, m, M_GADD_OK, 0, 0, 0);
         break;
       }
-      case M_GREAD: {
+      case M_BREAD:
+      case M_GREAD: {   // one reply shape for both gossip families
         Msg r;
         r.valid = 1; r.src = me; r.origin = me; r.dest = m.src;
-        r.type = M_GREAD_OK; r.reply_to = m.msg_id;
+        r.type = m.type == M_BREAD ? M_BREAD_OK : M_GREAD_OK;
+        r.reply_to = m.msg_id;
         r.body[0] = int32_t(nd.gset.size());
         r.ext = nd.gset;
         send(in, t, std::move(r));
@@ -595,6 +673,25 @@ struct Sim {
       }
       return;
     }
+    if (cfg.workload == 3) {
+      // broadcast anti-entropy: flooding handles the fast path; a
+      // full-state resend to one rotating topology NEIGHBOR per
+      // heartbeat repairs what partitions/loss ate
+      if (!cfg.flag_gset_no_gossip && nbr[me] != 0 &&
+          t % cfg.heartbeat == int64_t(me) % cfg.heartbeat &&
+          !in.nodes[me].gset.empty()) {
+        int32_t deg = 0, peers[30];
+        for (int32_t p = 0; p < n; ++p)
+          if ((nbr[me] >> p) & 1) peers[deg++] = p;
+        int32_t p = peers[(t / cfg.heartbeat) % deg];
+        Msg g;
+        g.valid = 1; g.src = me; g.origin = me; g.dest = p;
+        g.type = M_BGOSSIP;
+        g.ext = nd.gset;
+        send(in, t, std::move(g));
+      }
+      return;
+    }
 
     // election timeout
     if (nd.role != 2 && t >= nd.election_deadline) {
@@ -752,7 +849,7 @@ struct Sim {
   }
 
   void check_invariants(Instance& in) const {
-    if (cfg.workload == 2) return;   // no Raft state to check
+    if (cfg.workload >= 2) return;   // no Raft state to check
     int32_t n = int32_t(cfg.n_nodes);
     bool bad = false;
     for (int32_t i = 0; i < n && !bad; ++i)
@@ -822,6 +919,7 @@ struct Sim {
   }
 
   void run(int64_t n_threads) {
+    if (cfg.workload == 3) init_topology();
     init_instances();
     int64_t I = cfg.n_instances;
     if (n_threads <= 1 || I < 2 * n_threads) {
@@ -913,7 +1011,7 @@ struct Sim {
         if (cfg.workload == 1)
           record_txn(*rec, t, c, etype, cl,
                      m.type == M_TXN_OK ? &m : nullptr);
-        else if (cfg.workload == 2 && m.type == M_GREAD_OK)
+        else if (m.type == M_GREAD_OK || m.type == M_BREAD_OK)
           record_gset_read(*rec, t, c, m);
         else
           rec->event(t, c, etype, cl.f, cl.k, v, cl.b);
@@ -927,7 +1025,7 @@ struct Sim {
         // (whole transactions are never idempotent; g-set adds are
         // indeterminate — set-full never counts info adds as lost)
         int32_t etype = ((cfg.workload == 0 && cl.f == F_READ) ||
-                         (cfg.workload == 2 && cl.f == F_GREAD))
+                         (cfg.workload >= 2 && cl.f == F_GREAD))
                             ? EV_FAIL : EV_INFO;
         if (rec) {
           if (cfg.workload == 1)
@@ -939,7 +1037,7 @@ struct Sim {
       }
       if (cl.status == 0 && in.rng.uniform() < cfg.rate) {
         bool final_phase = t >= cfg.final_start;
-        if (cfg.workload == 2) {
+        if (cfg.workload == 2 || cfg.workload == 3) {
           bool rd = final_phase || in.rng.uniform() < cfg.read_prob;
           cl.f = rd ? F_GREAD : F_GADD;
           cl.k = 0;
@@ -955,7 +1053,8 @@ struct Sim {
           q.src = int32_t(cfg.n_nodes) + c;
           q.origin = q.src;
           q.dest = in.rng.below(int32_t(cfg.n_nodes));
-          q.type = rd ? M_GREAD : M_GADD;
+          q.type = cfg.workload == 2 ? (rd ? M_GREAD : M_GADD)
+                                     : (rd ? M_BREAD : M_BCAST);
           q.msg_id = cl.msg_id;
           q.body[0] = cl.a;
           send(in, t, std::move(q));
@@ -1032,7 +1131,7 @@ extern "C" {
 // log_cap, elect_min, elect_jitter, n_keys, n_vals, flag_stale_read,
 // flag_eager_commit, flag_no_term_guard, max_events, n_threads,
 // instance_base, workload, txn_max, list_cap, read_prob_micro,
-// flag_txn_dirty_apply, flag_gset_no_gossip  (34 fields)
+// flag_txn_dirty_apply, flag_gset_no_gossip, topology  (35 fields)
 int64_t native_sim_run_sched(const int64_t* c, int64_t* stats_out,
                              int32_t* violations_out,
                              int32_t* events_out,
@@ -1079,7 +1178,9 @@ int64_t native_sim_run_sched(const int64_t* c, int64_t* stats_out,
   cfg.read_prob = double(c[31]) / 1e6;
   cfg.flag_txn_dirty_apply = c[32];
   cfg.flag_gset_no_gossip = c[33];
-  if (cfg.workload < 0 || cfg.workload > 2) return -1;
+  cfg.topology = c[34];
+  if (cfg.workload < 0 || cfg.workload > 3) return -1;
+  if (cfg.topology < 0 || cfg.topology > 5) return -1;
   if (cfg.nemesis_interval <= 0) cfg.nemesis_interval = 1;
   if (cfg.n_nodes > 30) return -1;   // votes bitmask width
   if (cfg.pool_slots > 64 || cfg.n_nodes + cfg.n_clients > 64)

@@ -182,11 +182,12 @@ def cmd_test(args) -> int:
         # the C++ scalar engine (cpp/engine): lin-kv and
         # txn-list-append Raft fleets on hosts without an accelerator —
         # same checkers, same artifacts
-        if args.workload not in ("lin-kv", "txn-list-append", "g-set"):
+        if args.workload not in ("lin-kv", "txn-list-append", "g-set",
+                                 "broadcast"):
             print("error: --runtime native implements the lin-kv, "
-                  "txn-list-append (Raft), and g-set workloads only; "
-                  "use --runtime tpu for the full model set",
-                  file=sys.stderr)
+                  "txn-list-append (Raft), g-set, and broadcast "
+                  "workloads only; use --runtime tpu for the full "
+                  "model set", file=sys.stderr)
             return 2
         if args.nemesis_kind == "scripted" \
                 and not args.nemesis_schedule_file:
@@ -218,6 +219,7 @@ def cmd_test(args) -> int:
         results = run_native_test(dict(
             workload=args.workload,
             consistency_models=args.consistency_models,
+            topology=args.topology,
             node_count=node_count, concurrency=concurrency,
             rate=args.rate, time_limit=args.time_limit,
             latency=args.latency, p_loss=args.p_loss,

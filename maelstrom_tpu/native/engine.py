@@ -122,10 +122,12 @@ def _decode_txn_history(ev: np.ndarray, ms_per_tick: float,
 
 
 def _decode_gset_history(ev: np.ndarray, ms_per_tick: float,
-                         final_start: int) -> List[dict]:
-    """g-set rows -> set-full's history: add ops carry their element;
-    read-ok rows are a header [.., n, ..] followed by ceil(n/7) rows of
-    7 raw values (record_gset_read's layout)."""
+                         final_start: int,
+                         add_name: str = "add") -> List[dict]:
+    """g-set/broadcast rows -> set-full's history: add ops carry their
+    element (f name "add" or "broadcast" per workload); read-ok rows
+    are a header [.., n, ..] followed by ceil(n/7) rows of 7 raw
+    values (record_gset_read's layout)."""
     hist: List[dict] = []
     i = 0
     while i < len(ev):
@@ -136,7 +138,7 @@ def _decode_gset_history(ev: np.ndarray, ms_per_tick: float,
             # to cap without writing — the remaining rows are zero
             # padding; the events-truncated flag reports it upstream
             break
-        fname = "add" if f == 1 else "read"
+        fname = add_name if f == 1 else "read"
         if fname == "read" and etype == EV_OK:
             n = int(ev[i][4])
             rows = (n + 6) // 7
@@ -145,8 +147,8 @@ def _decode_gset_history(ev: np.ndarray, ms_per_tick: float,
             i += 1 + rows
             value: Any = vals
         else:
-            value = int(ev[i][5]) if fname == "add" else None
-            if fname == "add" and value == NIL:
+            value = int(ev[i][5]) if fname == add_name else None
+            if fname == add_name and value == NIL:
                 value = None
             i += 1
         rec = {"process": client,
@@ -222,7 +224,7 @@ def run_native_sim(opts: Optional[Dict[str, Any]] = None
         # txn-list-append workload (cpp/engine txn mode — the native
         # twin of models/txn_raft.py)
         workload="lin-kv", txn_max=3, list_cap=16, read_prob=0.5,
-        txn_dirty_apply=False, gset_no_gossip=False,
+        txn_dirty_apply=False, gset_no_gossip=False, topology="grid",
         # instances are independent, so worker threads each own a
         # contiguous block end-to-end; per-instance trajectories are
         # identical at ANY thread count (RNG is a pure function of
@@ -231,6 +233,20 @@ def run_native_sim(opts: Optional[Dict[str, Any]] = None
         threads=0,   # 0 = all cores
     )
     o.update(opts or {})
+    if o["workload"] in ("g-set", "broadcast"):
+        # flooding/gossip volume dwarfs the Raft flagship's — the
+        # 16-slot headline pool overflows into wedged clients (request
+        # or reply eaten -> 1000-tick timeout); size like the device
+        # runtime's gossip defaults instead unless the caller chose
+        if "pool_slots" not in (opts or {}):
+            o["pool_slots"] = 48
+        if "inbox_k" not in (opts or {}):
+            o["inbox_k"] = 4
+        if "rpc_timeout" not in (opts or {}):
+            # gossip RTT is ~2 ticks; the Raft-sized 1s timeout wedges
+            # a client for half a short horizon when loss eats a reply,
+            # starving the final reads set-full judges by
+            o["rpc_timeout"] = 0.25
     mpt = o["ms_per_tick"]
     n_ticks = int(o["time_limit"] * 1000 / mpt)
     recovery_ticks = min(int(o["recovery_time"] * 1000 / mpt),
@@ -243,21 +259,30 @@ def run_native_sim(opts: Optional[Dict[str, Any]] = None
     rate = min(1.0, float(o["rate"]) / C / 1000.0 * mpt)
     max_events = max(64, 2 * C * n_ticks // 4)
 
-    _workloads = {"lin-kv": 0, "txn-list-append": 1, "g-set": 2}
+    _workloads = {"lin-kv": 0, "txn-list-append": 1, "g-set": 2,
+                  "broadcast": 3}
     if o["workload"] not in _workloads:
         raise ValueError(f"unknown native workload {o['workload']!r} "
                          f"(expected one of {sorted(_workloads)})")
     workload = _workloads[o["workload"]]
+    _topologies = {"total": 0, "line": 1, "grid": 2, "tree2": 3,
+                   "tree3": 4, "tree4": 5,
+                   "tree": 3}   # alias, matching workloads/topology.py
+    if workload != 3:
+        o["topology"] = "total"   # only broadcast consults it
+    elif o["topology"] not in _topologies:
+        raise ValueError(f"unknown native topology {o['topology']!r} "
+                         f"(expected one of {sorted(_topologies)})")
     txn_max, list_cap = int(o["txn_max"]), int(o["list_cap"])
     ev_w = 4 + 3 * txn_max + txn_max * list_cap if workload == 1 else 7
-    if workload == 2:
+    if workload >= 2:
         # g-set reads stream their whole set as 7-value rows, so the
         # event budget scales with ops^2/7 in the worst case; ops per
         # client are rate-bounded by the horizon
         max_events = max(256, 2 * C * n_ticks)
 
     threads = int(o["threads"]) or (os.cpu_count() or 1)
-    cfg = (ctypes.c_int64 * 34)(
+    cfg = (ctypes.c_int64 * 35)(
         int(o["seed"]), I, n_ticks, int(o["node_count"]), C, R,
         int(o["pool_slots"]), int(o["inbox_k"]),
         int(float(o["latency"]) / mpt * 1000),
@@ -277,7 +302,8 @@ def run_native_sim(opts: Optional[Dict[str, Any]] = None
         workload, txn_max, list_cap,
         int(float(o["read_prob"]) * 1e6),
         1 if o["txn_dirty_apply"] else 0,
-        1 if o["gset_no_gossip"] else 0)
+        1 if o["gset_no_gossip"] else 0,
+        _topologies[o["topology"]])
 
     stats = (ctypes.c_int64 * 5)()
     violations = np.zeros(I, dtype=np.int32)
@@ -323,10 +349,11 @@ def run_native_sim(opts: Optional[Dict[str, Any]] = None
             _decode_txn_history(events[i, :n_events[i]], mpt,
                                 final_start, txn_max, list_cap)
             for i in range(R)]
-    elif workload == 2:
+    elif workload in (2, 3):
+        add_name = "add" if workload == 2 else "broadcast"
         histories = [
             _decode_gset_history(events[i, :n_events[i]], mpt,
-                                 final_start)
+                                 final_start, add_name=add_name)
             for i in range(R)]
     else:
         histories = [

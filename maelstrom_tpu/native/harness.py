@@ -23,9 +23,13 @@ def _checker_for(workload: str, consistency_model: str = None):
     if workload == "g-set":
         from ..checkers.set_full import set_full_checker
         return set_full_checker
+    if workload == "broadcast":
+        from ..checkers.set_full import set_full_checker
+        return lambda h: set_full_checker(h, add_f="broadcast")
     if workload != "lin-kv":
         raise ValueError(f"unknown native workload {workload!r} "
-                         "(expected lin-kv, txn-list-append, or g-set)")
+                         "(expected lin-kv, txn-list-append, g-set, "
+                         "or broadcast)")
     from ..checkers.linearizable import linearizable_kv_checker
     return linearizable_kv_checker
 

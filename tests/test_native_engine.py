@@ -327,3 +327,41 @@ def test_native_gset_truncation_decodes_cleanly():
     ev[0] = [5, 0, 1, 1, 0, 42, 0]
     h = _decode_gset_history(ev, 1, 1 << 30)
     assert len(h) == 1 and h[0]["value"] == 42
+
+
+# --- broadcast workload (fourth native family: topology flooding +
+# anti-entropy, set-full with stable latency) ------------------------
+
+def _bcast_opts(**kw):
+    o = dict(workload="broadcast", n_instances=48, record_instances=4,
+             time_limit=2.0, nemesis=["partition"],
+             nemesis_interval=0.3, p_loss=0.05, recovery_time=0.4,
+             seed=7, read_prob=0.1, node_count=5, topology="grid",
+             threads=1)
+    o.update(kw)
+    return o
+
+
+@pytest.mark.parametrize("topo", ["grid", "line", "tree2", "total"])
+def test_native_broadcast_topologies_clean(topo):
+    res = run_native_test(_bcast_opts(topology=topo))
+    assert res["valid?"] is True, res["instances"][:2]
+    for inst in res["instances"]:
+        assert inst.get("lost-count", 0) == 0, (topo, inst)
+    assert sum(i.get("stable-count", 0)
+               for i in res["instances"]) > 100
+
+
+def test_native_broadcast_no_gossip_caught():
+    res = run_native_test(_bcast_opts(gset_no_gossip=True))
+    assert res["valid?"] is False
+    assert any(i.get("lost-count", 0) > 5 for i in res["instances"])
+
+
+def test_native_broadcast_instance_base_bit_exact():
+    from maelstrom_tpu.native import run_native_sim
+    res = run_native_sim(_bcast_opts())
+    solo = run_native_sim(_bcast_opts(n_instances=1,
+                                      record_instances=1,
+                                      instance_base=3))
+    assert solo["histories"][0] == res["histories"][3]
