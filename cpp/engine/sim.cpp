@@ -74,7 +74,11 @@ struct Cfg {
   int64_t workload;           // 0 = lin-kv, 1 = txn-list-append,
                               // 2 = g-set (gossip CRDT, set-full),
                               // 3 = broadcast (topology flooding +
-                              //     anti-entropy, set-full)
+                              //     anti-entropy, set-full),
+                              // 4 = unique-ids (node-striped counters),
+                              // 5 = pn-counter (per-node G-counter
+                              //     pair CRDT, interval checker),
+                              // 6 = g-counter (same, deltas >= 0)
   int64_t txn_max;            // micro-ops per txn (<= TXN_CAP)
   int64_t list_cap;           // per-key list capacity; an append txn
                               // that would overflow aborts WHOLE with
@@ -85,10 +89,12 @@ struct Cfg {
                                  // (uncommitted) — leader changes
                                  // truncate acked txns; Elle catches
                                  // lost appends / aborted reads
-  int64_t flag_gset_no_gossip;   // BUG: gossip-family nodes (g-set,
-                                 // broadcast) never gossip — values
-                                 // stay on one node; set-full reports
-                                 // them lost
+  int64_t flag_gset_no_gossip;   // family BUG flag: gossip-family
+                                 // nodes (g-set, broadcast,
+                                 // pn-counter) never gossip — values
+                                 // strand on one node (set-full lost /
+                                 // interval miss); unique-ids drops
+                                 // node striping (id collisions)
   int64_t topology;   // broadcast neighbor graph: 0 total, 1 line,
                       // 2 grid, 3 tree2, 4 tree3, 5 tree4 (the
                       // reference's --topology registry,
@@ -107,6 +113,9 @@ enum MType : int32_t {
   M_GMERGE = 34,
   M_BCAST = 40, M_BCAST_OK = 41, M_BREAD = 42, M_BREAD_OK = 43,
   M_BGOSSIP = 44,
+  M_UID = 50, M_UID_OK = 51,
+  M_PNADD = 60, M_PNADD_OK = 61, M_PNREAD = 62, M_PNREAD_OK = 63,
+  M_PNMERGE = 64,
   M_ERROR = 127
 };
 
@@ -162,6 +171,9 @@ struct Node {
   std::vector<std::vector<int32_t>> lists;   // txn workload state
   std::vector<int32_t> gset;                 // g-set workload state:
   std::unordered_set<int32_t> gseen;         // insertion order + member
+  int32_t uid_counter = 0;                   // unique-ids workload
+  std::vector<int64_t> pn_pos, pn_neg;       // pn-counter CRDT: one
+                                             // G-counter pair per node
   std::vector<int32_t> next_idx, match_idx;
 };
 
@@ -480,6 +492,40 @@ struct Sim {
         bcast_flood(in, t, me, fresh, m.src);
         break;
       }
+      case M_UID: {
+        // node-striped ids: counter * N + me is unique across the
+        // cluster with no coordination (the reference's flake-id demo
+        // shape, demo/clojure/flake_ids.clj's role). The family bug
+        // flag drops the striping — bare counters collide across
+        // nodes, which the uniqueness checker must catch.
+        int32_t id = cfg.flag_gset_no_gossip
+                         ? nd.uid_counter++
+                         : nd.uid_counter++ * n + me;
+        node_reply(in, t, me, m, M_UID_OK, id, 0, 0);
+        break;
+      }
+      case M_PNADD: {
+        int32_t delta = m.body[0];
+        if (delta >= 0) nd.pn_pos[me] += delta;
+        else nd.pn_neg[me] += -int64_t(delta);
+        node_reply(in, t, me, m, M_PNADD_OK, 0, 0, 0);
+        break;
+      }
+      case M_PNREAD: {
+        int64_t total = 0;
+        for (int32_t i = 0; i < n; ++i)
+          total += nd.pn_pos[i] - nd.pn_neg[i];
+        node_reply(in, t, me, m, M_PNREAD_OK, int32_t(total), 0, 0);
+        break;
+      }
+      case M_PNMERGE: {
+        // G-counter pair merge: elementwise max per origin node
+        for (int32_t i = 0; i < n; ++i) {
+          nd.pn_pos[i] = std::max(nd.pn_pos[i], int64_t(m.ext[i]));
+          nd.pn_neg[i] = std::max(nd.pn_neg[i], int64_t(m.ext[n + i]));
+        }
+        break;
+      }
       case M_GADD: {
         gset_merge(nd, &m.body[0], 1);
         node_reply(in, t, me, m, M_GADD_OK, 0, 0, 0);
@@ -669,6 +715,26 @@ struct Sim {
         g.dest = (me + hop) % n;
         g.type = M_GMERGE;
         g.ext = nd.gset;
+        send(in, t, std::move(g));
+      }
+      return;
+    }
+    if (cfg.workload == 4) return;   // unique-ids: no timers at all
+    if (cfg.workload >= 5) {
+      // pn/g-counter anti-entropy: ship both G-counter vectors to one
+      // rotating peer every heartbeat (merge = elementwise max)
+      if (n > 1 && !cfg.flag_gset_no_gossip &&
+          t % cfg.heartbeat == int64_t(me) % cfg.heartbeat) {
+        int32_t hop = 1 + int32_t((t / cfg.heartbeat) % (n - 1));
+        Msg g;
+        g.valid = 1; g.src = me; g.origin = me;
+        g.dest = (me + hop) % n;
+        g.type = M_PNMERGE;
+        g.ext.reserve(2 * n);
+        for (int32_t i = 0; i < n; ++i)
+          g.ext.push_back(int32_t(nd.pn_pos[i]));
+        for (int32_t i = 0; i < n; ++i)
+          g.ext.push_back(int32_t(nd.pn_neg[i]));
         send(in, t, std::move(g));
       }
       return;
@@ -890,6 +956,10 @@ struct Sim {
         nd.kv.assign(cfg.n_keys, NIL);
         if (cfg.workload == 1)
           nd.lists.assign(cfg.n_keys, {});
+        if (cfg.workload >= 5) {
+          nd.pn_pos.assign(cfg.n_nodes, 0);
+          nd.pn_neg.assign(cfg.n_nodes, 0);
+        }
         nd.next_idx.assign(cfg.n_nodes, 0);
         nd.match_idx.assign(cfg.n_nodes, 0);
       }
@@ -1005,7 +1075,10 @@ struct Sim {
         v = cl.a;
       } else {
         etype = EV_OK;
-        v = m.type == M_READ_OK ? m.body[1] : cl.a;
+        v = m.type == M_READ_OK ? m.body[1]
+            : (m.type == M_UID_OK || m.type == M_PNREAD_OK)
+                ? m.body[0]
+                : cl.a;
       }
       if (rec) {
         if (cfg.workload == 1)
@@ -1037,6 +1110,49 @@ struct Sim {
       }
       if (cl.status == 0 && in.rng.uniform() < cfg.rate) {
         bool final_phase = t >= cfg.final_start;
+        if (cfg.workload == 4) {
+          cl.f = 1;    // generate
+          cl.k = 0; cl.a = NIL;
+          cl.msg_id = cl.next_msg_id++;
+          cl.invoked = t;
+          cl.status = 1;
+          if (rec) rec->event(t, c, EV_INVOKE, 1, 0, NIL, 0);
+          Msg q;
+          q.valid = 1;
+          q.src = int32_t(cfg.n_nodes) + c;
+          q.origin = q.src;
+          q.dest = in.rng.below(int32_t(cfg.n_nodes));
+          q.type = M_UID;
+          q.msg_id = cl.msg_id;
+          send(in, t, std::move(q));
+          continue;
+        }
+        if (cfg.workload == 5 || cfg.workload == 6) {
+          bool rd = final_phase || in.rng.uniform() < cfg.read_prob;
+          cl.f = rd ? F_GREAD : F_GADD;
+          cl.k = 0;
+          // deltas in [-5, 5] (pn-counter, the reference generator's
+          // range, pn_counter.clj:133-136) or [0, 5] (g-counter:
+          // the same generator filtered non-negative)
+          cl.a = rd ? NIL
+                 : cfg.workload == 6
+                     ? int32_t(in.rng.below(6))
+                     : int32_t(in.rng.below(11)) - 5;
+          cl.msg_id = cl.next_msg_id++;
+          cl.invoked = t;
+          cl.status = 1;
+          if (rec) rec->event(t, c, EV_INVOKE, cl.f, 0, cl.a, 0);
+          Msg q;
+          q.valid = 1;
+          q.src = int32_t(cfg.n_nodes) + c;
+          q.origin = q.src;
+          q.dest = in.rng.below(int32_t(cfg.n_nodes));
+          q.type = rd ? M_PNREAD : M_PNADD;
+          q.msg_id = cl.msg_id;
+          q.body[0] = cl.a;
+          send(in, t, std::move(q));
+          continue;
+        }
         if (cfg.workload == 2 || cfg.workload == 3) {
           bool rd = final_phase || in.rng.uniform() < cfg.read_prob;
           cl.f = rd ? F_GREAD : F_GADD;
@@ -1179,7 +1295,7 @@ int64_t native_sim_run_sched(const int64_t* c, int64_t* stats_out,
   cfg.flag_txn_dirty_apply = c[32];
   cfg.flag_gset_no_gossip = c[33];
   cfg.topology = c[34];
-  if (cfg.workload < 0 || cfg.workload > 3) return -1;
+  if (cfg.workload < 0 || cfg.workload > 6) return -1;
   if (cfg.topology < 0 || cfg.topology > 5) return -1;
   if (cfg.nemesis_interval <= 0) cfg.nemesis_interval = 1;
   if (cfg.n_nodes > 30) return -1;   // votes bitmask width

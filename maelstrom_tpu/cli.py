@@ -182,12 +182,12 @@ def cmd_test(args) -> int:
         # the C++ scalar engine (cpp/engine): lin-kv and
         # txn-list-append Raft fleets on hosts without an accelerator —
         # same checkers, same artifacts
-        if args.workload not in ("lin-kv", "txn-list-append", "g-set",
-                                 "broadcast"):
-            print("error: --runtime native implements the lin-kv, "
-                  "txn-list-append (Raft), g-set, and broadcast "
-                  "workloads only; use --runtime tpu for the full "
-                  "model set", file=sys.stderr)
+        from .native.engine import NATIVE_WORKLOADS
+        if args.workload not in NATIVE_WORKLOADS:
+            print("error: --runtime native implements "
+                  f"{', '.join(sorted(NATIVE_WORKLOADS))} only; use "
+                  "--runtime tpu for the full model set",
+                  file=sys.stderr)
             return 2
         if args.nemesis_kind == "scripted" \
                 and not args.nemesis_schedule_file:

@@ -35,6 +35,12 @@ EV_INVOKE, EV_OK, EV_FAIL, EV_INFO = 1, 2, 3, 4
 F_NAMES = {1: "read", 2: "write", 3: "cas"}
 ETYPE_NAMES = {EV_OK: "ok", EV_FAIL: "fail", EV_INFO: "info"}
 
+# the single source of truth for which workloads the engine speaks
+# (name -> cfg.workload enum); cli.py and harness.py derive from it
+NATIVE_WORKLOADS = {"lin-kv": 0, "txn-list-append": 1, "g-set": 2,
+                    "broadcast": 3, "unique-ids": 4, "pn-counter": 5,
+                    "g-counter": 6}
+
 
 def _load():
     global _lib, _lib_tried
@@ -163,6 +169,34 @@ def _decode_gset_history(ev: np.ndarray, ms_per_tick: float,
     return hist
 
 
+def _decode_value_history(ev: np.ndarray, ms_per_tick: float,
+                          final_start: int, f_names) -> List[dict]:
+    """Single-value rows [n, 7] for the unique-ids / pn-counter /
+    g-counter families: invoke values are None for reads/generates and
+    the (possibly negative) delta for adds; completions carry the id /
+    total / echoed delta in the value lane."""
+    hist: List[dict] = []
+    for row in ev:
+        tick, client, etype, f, v = (int(row[0]), int(row[1]),
+                                     int(row[2]), int(row[3]),
+                                     int(row[5]))
+        fname = f_names[f]
+        if etype == EV_INVOKE:
+            value = v if fname == "add" else None
+        else:
+            value = v
+        rec = {"process": client,
+               "type": ("invoke" if etype == EV_INVOKE
+                        else ETYPE_NAMES[etype]),
+               "f": fname, "value": value}
+        if etype == EV_INVOKE and tick >= final_start:
+            rec["final"] = True
+        rec["time"] = int(tick * ms_per_tick * 1_000_000)
+        rec["index"] = len(hist)
+        hist.append(rec)
+    return hist
+
+
 def _decode_history(ev: np.ndarray, ms_per_tick: float,
                     final_start: int) -> List[dict]:
     """events [n, 7] (tick, client, etype, f, k, v, b) -> the checker's
@@ -233,7 +267,8 @@ def run_native_sim(opts: Optional[Dict[str, Any]] = None
         threads=0,   # 0 = all cores
     )
     o.update(opts or {})
-    if o["workload"] in ("g-set", "broadcast"):
+    if o["workload"] in ("g-set", "broadcast", "pn-counter",
+                         "g-counter"):
         # flooding/gossip volume dwarfs the Raft flagship's — the
         # 16-slot headline pool overflows into wedged clients (request
         # or reply eaten -> 1000-tick timeout); size like the device
@@ -242,11 +277,12 @@ def run_native_sim(opts: Optional[Dict[str, Any]] = None
             o["pool_slots"] = 48
         if "inbox_k" not in (opts or {}):
             o["inbox_k"] = 4
-        if "rpc_timeout" not in (opts or {}):
-            # gossip RTT is ~2 ticks; the Raft-sized 1s timeout wedges
-            # a client for half a short horizon when loss eats a reply,
-            # starving the final reads set-full judges by
-            o["rpc_timeout"] = 0.25
+    if o["workload"] != "lin-kv" and o["workload"] != "txn-list-append" \
+            and "rpc_timeout" not in (opts or {}):
+        # non-Raft ops complete in ~2 ticks; the Raft-sized 1s timeout
+        # wedges a client for half a short horizon when loss eats a
+        # reply, starving the final reads the checkers judge by
+        o["rpc_timeout"] = 0.25
     mpt = o["ms_per_tick"]
     n_ticks = int(o["time_limit"] * 1000 / mpt)
     recovery_ticks = min(int(o["recovery_time"] * 1000 / mpt),
@@ -259,12 +295,10 @@ def run_native_sim(opts: Optional[Dict[str, Any]] = None
     rate = min(1.0, float(o["rate"]) / C / 1000.0 * mpt)
     max_events = max(64, 2 * C * n_ticks // 4)
 
-    _workloads = {"lin-kv": 0, "txn-list-append": 1, "g-set": 2,
-                  "broadcast": 3}
-    if o["workload"] not in _workloads:
+    if o["workload"] not in NATIVE_WORKLOADS:
         raise ValueError(f"unknown native workload {o['workload']!r} "
-                         f"(expected one of {sorted(_workloads)})")
-    workload = _workloads[o["workload"]]
+                         f"(expected one of {sorted(NATIVE_WORKLOADS)})")
+    workload = NATIVE_WORKLOADS[o["workload"]]
     _topologies = {"total": 0, "line": 1, "grid": 2, "tree2": 3,
                    "tree3": 4, "tree4": 5,
                    "tree": 3}   # alias, matching workloads/topology.py
@@ -354,6 +388,13 @@ def run_native_sim(opts: Optional[Dict[str, Any]] = None
         histories = [
             _decode_gset_history(events[i, :n_events[i]], mpt,
                                  final_start, add_name=add_name)
+            for i in range(R)]
+    elif workload in (4, 5, 6):
+        f_names = ({1: "generate"} if workload == 4
+                   else {1: "add", 2: "read"})
+        histories = [
+            _decode_value_history(events[i, :n_events[i]], mpt,
+                                  final_start, f_names)
             for i in range(R)]
     else:
         histories = [

@@ -26,10 +26,17 @@ def _checker_for(workload: str, consistency_model: str = None):
     if workload == "broadcast":
         from ..checkers.set_full import set_full_checker
         return lambda h: set_full_checker(h, add_f="broadcast")
+    if workload == "unique-ids":
+        from ..checkers.unique_ids import unique_ids_checker
+        return unique_ids_checker
+    if workload in ("pn-counter", "g-counter"):
+        from ..checkers.pn_counter import pn_counter_checker
+        return pn_counter_checker
     if workload != "lin-kv":
+        from .engine import NATIVE_WORKLOADS
         raise ValueError(f"unknown native workload {workload!r} "
-                         "(expected lin-kv, txn-list-append, g-set, "
-                         "or broadcast)")
+                         f"(expected one of "
+                         f"{sorted(NATIVE_WORKLOADS)})")
     from ..checkers.linearizable import linearizable_kv_checker
     return linearizable_kv_checker
 

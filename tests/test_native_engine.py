@@ -365,3 +365,53 @@ def test_native_broadcast_instance_base_bit_exact():
                                       record_instances=1,
                                       instance_base=3))
     assert solo["histories"][0] == res["histories"][3]
+
+
+# --- unique-ids + pn/g-counter (families five through seven) --------
+
+def _small_opts(**kw):
+    o = dict(n_instances=48, record_instances=4, time_limit=2.0,
+             nemesis=["partition"], nemesis_interval=0.3, p_loss=0.05,
+             recovery_time=0.4, seed=7, read_prob=0.15, threads=1)
+    o.update(kw)
+    return o
+
+
+def test_native_unique_ids_clean_and_collision_caught():
+    res = run_native_test(_small_opts(workload="unique-ids"))
+    assert res["valid?"] is True
+    assert sum(i.get("acknowledged-count", 0)
+               for i in res["instances"]) > 200
+    # the family bug flag drops node striping: bare counters collide
+    bad = run_native_test(_small_opts(workload="unique-ids",
+                                      gset_no_gossip=True))
+    assert bad["valid?"] is False
+    assert any(i.get("duplicated-count", 0) > 0
+               for i in bad["instances"])
+
+
+@pytest.mark.parametrize("wl", ["pn-counter", "g-counter"])
+def test_native_counters_interval_clean(wl):
+    res = run_native_test(_small_opts(workload=wl))
+    assert res["valid?"] is True, res["instances"][:2]
+    if wl == "g-counter":
+        # non-negative deltas: sums never go below zero
+        for inst in res["instances"]:
+            for v in inst.get("final-reads") or []:
+                assert v >= 0, inst
+
+
+def test_native_pn_counter_no_gossip_caught():
+    res = run_native_test(_small_opts(workload="pn-counter",
+                                      gset_no_gossip=True))
+    assert res["valid?"] is False
+
+
+def test_native_unique_ids_instance_base_bit_exact():
+    from maelstrom_tpu.native import run_native_sim
+    res = run_native_sim(_small_opts(workload="unique-ids"))
+    solo = run_native_sim(_small_opts(workload="unique-ids",
+                                      n_instances=1,
+                                      record_instances=1,
+                                      instance_base=2))
+    assert solo["histories"][0] == res["histories"][2]
