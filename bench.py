@@ -382,10 +382,11 @@ def _native_bench() -> bool:
     from maelstrom_tpu.checkers.linearizable import \
         linearizable_kv_checker
 
-    # workload breadth at bench time: quick checked runs of the other
-    # two native families (txn-list-append/Elle, g-set/set-full) ride
-    # on the headline line, so the artifact shows the engine posting
-    # the number is not a one-workload machine
+    # workload breadth at bench time: quick checked runs of three
+    # more native families (txn-list-append/Elle, g-set/set-full,
+    # pn-counter/interval) ride on the headline line, so the artifact
+    # shows the engine posting the number covers all four checker
+    # kinds, not one workload
     # the one base config every native run below derives from — the
     # headline regimes and the family runs must never drift apart
     base_opts = dict(node_count=3, concurrency=6, inbox_k=1,
@@ -398,9 +399,13 @@ def _native_bench() -> bool:
     if os.environ.get("BENCH_FAMILIES") != "0":
         from maelstrom_tpu.checkers.elle import check_list_append
         from maelstrom_tpu.checkers.set_full import set_full_checker
+        from maelstrom_tpu.checkers.pn_counter import \
+            pn_counter_checker
         for wname, wopts, chk in (
                 ("txn-list-append", {}, check_list_append),
-                ("g-set", {"read_prob": 0.1}, set_full_checker)):
+                ("g-set", {"read_prob": 0.1}, set_full_checker),
+                ("pn-counter", {"read_prob": 0.15},
+                 pn_counter_checker)):
             fam_opts = dict(base_opts, n_instances=1024,
                             record_instances=2, time_limit=1.5,
                             workload=wname, **wopts)
