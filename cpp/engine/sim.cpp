@@ -78,7 +78,11 @@ struct Cfg {
                               // 4 = unique-ids (node-striped counters),
                               // 5 = pn-counter (per-node G-counter
                               //     pair CRDT, interval checker),
-                              // 6 = g-counter (same, deltas >= 0)
+                              // 6 = g-counter (same, deltas >= 0),
+                              // 7 = txn-rw-register (txns over the
+                              //     Raft log, register semantics,
+                              //     Elle rw-register checker),
+                              // 8 = echo (payload round-trip)
   int64_t txn_max;            // micro-ops per txn (<= TXN_CAP)
   int64_t list_cap;           // per-key list capacity; an append txn
                               // that would overflow aborts WHOLE with
@@ -114,6 +118,7 @@ enum MType : int32_t {
   M_BCAST = 40, M_BCAST_OK = 41, M_BREAD = 42, M_BREAD_OK = 43,
   M_BGOSSIP = 44,
   M_UID = 50, M_UID_OK = 51,
+  M_ECHO = 70, M_ECHO_OK = 71,
   M_PNADD = 60, M_PNADD_OK = 61, M_PNREAD = 62, M_PNREAD_OK = 63,
   M_PNMERGE = 64,
   M_ERROR = 127
@@ -381,6 +386,32 @@ struct Sim {
   // [len, (f, k, v|rlen)*], ext = concatenated read values.
   void apply_txn(Instance& in, int32_t t, int32_t me, Node& nd,
                  const Entry& e, bool reply) {
+    if (cfg.workload == 7) {
+      // rw-register semantics: writes install kv[k] = v, reads return
+      // the current value (NIL = unwritten); never aborts. Reads see
+      // the txn's own earlier writes (sequential apply).
+      Msg r;
+      r.body[0] = e.tlen;
+      for (int32_t j = 0; j < e.tlen; ++j) {
+        int32_t f = e.top[j][0];
+        int32_t k = std::min(std::max(e.top[j][1], 0),
+                             int32_t(cfg.n_keys) - 1);
+        r.body[1 + 3 * j] = f;
+        r.body[2 + 3 * j] = k;
+        if (f == F_TXN_R) {
+          r.body[3 + 3 * j] = nd.kv[k];
+        } else {
+          nd.kv[k] = e.top[j][2];
+          r.body[3 + 3 * j] = e.top[j][2];
+        }
+      }
+      if (reply && e.client >= 0) {
+        r.valid = 1; r.src = me; r.origin = me; r.dest = e.client;
+        r.type = M_TXN_OK; r.reply_to = e.cmsg;
+        send(in, t, std::move(r));
+      }
+      return;
+    }
     int32_t grow[64] = {0};
     bool abort = false;
     for (int32_t j = 0; j < e.tlen && !abort; ++j) {
@@ -425,12 +456,16 @@ struct Sim {
     }
   }
 
+  bool txn_mode() const {   // txn-list-append or txn-rw-register
+    return cfg.workload == 1 || cfg.workload == 7;
+  }
+
   // AppendEntries entry <-> wire lanes (L_ENTRY..): lin-kv entries use
   // 6 lanes (f,k,a,b,client,cmsg); txn entries use 1+3*TXN_CAP+2
   // (len, micro-ops, client, cmsg) — dispatch on cfg.workload
   Entry entry_from_wire(const Msg& m) const {
     Entry e;
-    if (cfg.workload == 1) {
+    if (txn_mode()) {
       e.tlen = m.body[L_ENTRY + 0];
       for (int32_t j = 0; j < TXN_CAP; ++j)
         for (int32_t x = 0; x < 3; ++x)
@@ -447,7 +482,7 @@ struct Sim {
   }
 
   void entry_to_wire(Msg& a, const Entry& e) const {
-    if (cfg.workload == 1) {
+    if (txn_mode()) {
       a.body[L_ENTRY + 0] = e.tlen;
       for (int32_t j = 0; j < TXN_CAP; ++j)
         for (int32_t x = 0; x < 3; ++x)
@@ -490,6 +525,10 @@ struct Sim {
             fresh.push_back(v);
           }
         bcast_flood(in, t, me, fresh, m.src);
+        break;
+      }
+      case M_ECHO: {
+        node_reply(in, t, me, m, M_ECHO_OK, m.body[0], 0, 0);
         break;
       }
       case M_UID: {
@@ -719,8 +758,9 @@ struct Sim {
       }
       return;
     }
-    if (cfg.workload == 4) return;   // unique-ids: no timers at all
-    if (cfg.workload >= 5) {
+    if (cfg.workload == 4 || cfg.workload == 8)
+      return;   // unique-ids / echo: no timers at all
+    if (cfg.workload == 5 || cfg.workload == 6) {
       // pn/g-counter anti-entropy: ship both G-counter vectors to one
       // rotating peer every heartbeat (merge = elementwise max)
       if (n > 1 && !cfg.flag_gset_no_gossip &&
@@ -875,7 +915,7 @@ struct Sim {
         p[4 + 3 * j] = f;
         p[5 + 3 * j] = ok->body[2 + 3 * j];
         p[6 + 3 * j] = ok->body[3 + 3 * j];
-        if (f == F_TXN_R) {
+        if (cfg.workload == 1 && f == F_TXN_R) {
           int32_t rlen = std::min(ok->body[3 + 3 * j],
                                   int32_t(cfg.list_cap));
           for (int32_t i = 0; i < rlen && off < ok->ext.size(); ++i)
@@ -915,7 +955,8 @@ struct Sim {
   }
 
   void check_invariants(Instance& in) const {
-    if (cfg.workload >= 2) return;   // no Raft state to check
+    // Raft invariants apply to the Raft-backed workloads only
+    if (cfg.workload >= 2 && cfg.workload != 7) return;
     int32_t n = int32_t(cfg.n_nodes);
     bool bad = false;
     for (int32_t i = 0; i < n && !bad; ++i)
@@ -956,7 +997,7 @@ struct Sim {
         nd.kv.assign(cfg.n_keys, NIL);
         if (cfg.workload == 1)
           nd.lists.assign(cfg.n_keys, {});
-        if (cfg.workload >= 5) {
+        if (cfg.workload == 5 || cfg.workload == 6) {
           nd.pn_pos.assign(cfg.n_nodes, 0);
           nd.pn_neg.assign(cfg.n_nodes, 0);
         }
@@ -1076,12 +1117,13 @@ struct Sim {
       } else {
         etype = EV_OK;
         v = m.type == M_READ_OK ? m.body[1]
-            : (m.type == M_UID_OK || m.type == M_PNREAD_OK)
+            : (m.type == M_UID_OK || m.type == M_PNREAD_OK ||
+               m.type == M_ECHO_OK)
                 ? m.body[0]
                 : cl.a;
       }
       if (rec) {
-        if (cfg.workload == 1)
+        if (txn_mode())
           record_txn(*rec, t, c, etype, cl,
                      m.type == M_TXN_OK ? &m : nullptr);
         else if (m.type == M_GREAD_OK || m.type == M_BREAD_OK)
@@ -1101,7 +1143,7 @@ struct Sim {
                          (cfg.workload >= 2 && cl.f == F_GREAD))
                             ? EV_FAIL : EV_INFO;
         if (rec) {
-          if (cfg.workload == 1)
+          if (txn_mode())
             record_txn(*rec, t, c, etype, cl, nullptr);
           else
             rec->event(t, c, etype, cl.f, cl.k, cl.a, cl.b);
@@ -1110,6 +1152,26 @@ struct Sim {
       }
       if (cl.status == 0 && in.rng.uniform() < cfg.rate) {
         bool final_phase = t >= cfg.final_start;
+        if (cfg.workload == 8) {
+          cl.f = 1;    // echo
+          cl.a = 1 + cl.next_msg_id * int32_t(cfg.n_clients) + c;
+          cl.k = cl.a;   // echoed-back payload rides the k lane so the
+                         // completion row carries sent AND received
+          cl.msg_id = cl.next_msg_id++;
+          cl.invoked = t;
+          cl.status = 1;
+          if (rec) rec->event(t, c, EV_INVOKE, 1, 0, cl.a, 0);
+          Msg q;
+          q.valid = 1;
+          q.src = int32_t(cfg.n_nodes) + c;
+          q.origin = q.src;
+          q.dest = in.rng.below(int32_t(cfg.n_nodes));
+          q.type = M_ECHO;
+          q.msg_id = cl.msg_id;
+          q.body[0] = cl.a;
+          send(in, t, std::move(q));
+          continue;
+        }
         if (cfg.workload == 4) {
           cl.f = 1;    // generate
           cl.k = 0; cl.a = NIL;
@@ -1176,7 +1238,7 @@ struct Sim {
           send(in, t, std::move(q));
           continue;
         }
-        if (cfg.workload == 1) {
+        if (txn_mode()) {
           cl.tlen = 1 + in.rng.below(int32_t(cfg.txn_max));
           for (int32_t j = 0; j < cl.tlen; ++j) {
             bool rd = final_phase || in.rng.uniform() < cfg.read_prob;
@@ -1295,7 +1357,7 @@ int64_t native_sim_run_sched(const int64_t* c, int64_t* stats_out,
   cfg.flag_txn_dirty_apply = c[32];
   cfg.flag_gset_no_gossip = c[33];
   cfg.topology = c[34];
-  if (cfg.workload < 0 || cfg.workload > 6) return -1;
+  if (cfg.workload < 0 || cfg.workload > 8) return -1;
   if (cfg.topology < 0 || cfg.topology > 5) return -1;
   if (cfg.nemesis_interval <= 0) cfg.nemesis_interval = 1;
   if (cfg.n_nodes > 30) return -1;   // votes bitmask width
@@ -1303,7 +1365,7 @@ int64_t native_sim_run_sched(const int64_t* c, int64_t* stats_out,
     return -1;                       // deliver scratch-array bounds
   if (n_phases > 0 && cfg.n_nodes > 8)
     return -1;                       // schedule bitmask width
-  if (cfg.workload == 1) {
+  if (cfg.workload == 1 || cfg.workload == 7) {
     if (cfg.txn_max < 1 || cfg.txn_max > TXN_CAP) return -1;
     if (cfg.list_cap < 1 || cfg.list_cap > 4096) return -1;
     if (cfg.n_keys > 64) return -1;  // apply_txn grow-array bound
@@ -1311,7 +1373,8 @@ int64_t native_sim_run_sched(const int64_t* c, int64_t* stats_out,
 
   // event row width is workload-dependent (see Recorder)
   int64_t ev_w = cfg.workload == 1
-      ? 4 + 3 * cfg.txn_max + cfg.txn_max * cfg.list_cap : 7;
+      ? 4 + 3 * cfg.txn_max + cfg.txn_max * cfg.list_cap
+      : cfg.workload == 7 ? 4 + 3 * cfg.txn_max : 7;
 
   Sim sim;
   sim.cfg = cfg;

@@ -205,9 +205,9 @@ def cmd_test(args) -> int:
                 args.nemesis = list(args.nemesis) + ["partition"]
         notes = [(args.availability, "--availability", None),
                  (args.latency_dist, "--latency-dist", "exponential")]
-        if args.workload != "txn-list-append":
-            # only txn-list-append is model-selectable (Elle); lin-kv
-            # is WGL-checked, g-set is set-full-checked
+        if args.workload not in ("txn-list-append", "txn-rw-register"):
+            # only the Elle-checked txn workloads are model-selectable;
+            # the rest use WGL / set-full / interval / uniqueness
             notes.append((args.consistency_models,
                           "--consistency-models", None))
         for val, name, default in notes:

@@ -39,7 +39,8 @@ ETYPE_NAMES = {EV_OK: "ok", EV_FAIL: "fail", EV_INFO: "info"}
 # (name -> cfg.workload enum); cli.py and harness.py derive from it
 NATIVE_WORKLOADS = {"lin-kv": 0, "txn-list-append": 1, "g-set": 2,
                     "broadcast": 3, "unique-ids": 4, "pn-counter": 5,
-                    "g-counter": 6}
+                    "g-counter": 6, "txn-rw-register": 7,
+                    "echo": 8}
 
 
 def _load():
@@ -119,6 +120,60 @@ def _decode_txn_history(ev: np.ndarray, ms_per_tick: float,
                "type": ("invoke" if etype == EV_INVOKE
                         else ETYPE_NAMES[etype]),
                "f": "txn", "value": ops}
+        if etype == EV_INVOKE and tick >= final_start:
+            rec["final"] = True
+        rec["time"] = int(tick * ms_per_tick * 1_000_000)
+        rec["index"] = len(hist)
+        hist.append(rec)
+    return hist
+
+
+def _decode_rw_history(ev: np.ndarray, ms_per_tick: float,
+                       final_start: int, txn_max: int) -> List[dict]:
+    """txn-rw-register rows [n, 4 + 3*txn_max] -> Elle's micro-op
+    history: value = [[f, k, v], ...] with f in {"w", "r"}; ok reads
+    carry the observed value (NIL -> None), invoke reads None."""
+    hist: List[dict] = []
+    for row in ev:
+        tick, client, etype, ln = (int(row[0]), int(row[1]),
+                                   int(row[2]), int(row[3]))
+        ops: List[Any] = []
+        for j in range(min(ln, txn_max)):
+            f, k, v = (int(row[4 + 3 * j]), int(row[5 + 3 * j]),
+                       int(row[6 + 3 * j]))
+            if f == 1:      # read
+                seen = (None if (etype != EV_OK or v == NIL) else v)
+                ops.append(["r", k, seen])
+            else:           # write
+                ops.append(["w", k, v])
+        rec = {"process": client,
+               "type": ("invoke" if etype == EV_INVOKE
+                        else ETYPE_NAMES[etype]),
+               "f": "txn", "value": ops}
+        if etype == EV_INVOKE and tick >= final_start:
+            rec["final"] = True
+        rec["time"] = int(tick * ms_per_tick * 1_000_000)
+        rec["index"] = len(hist)
+        hist.append(rec)
+    return hist
+
+
+def _decode_echo_history(ev: np.ndarray, ms_per_tick: float,
+                         final_start: int) -> List[dict]:
+    """echo rows -> the echo checker's shape (workloads/echo.py:32-38).
+    Invoke rows are [t, c, 1, 1, 0, payload, 0]; completion rows are
+    [t, c, etype, 1, sent, received, 0] — ok records carry the
+    response as value and the request under "echo"."""
+    hist: List[dict] = []
+    for row in ev:
+        tick, client, etype = int(row[0]), int(row[1]), int(row[2])
+        if etype == EV_INVOKE:
+            rec = {"process": client, "type": "invoke", "f": "echo",
+                   "value": int(row[5])}   # the sent payload
+        else:
+            rec = {"process": client, "type": ETYPE_NAMES[etype],
+                   "f": "echo", "value": int(row[5]),
+                   "echo": int(row[4])}
         if etype == EV_INVOKE and tick >= final_start:
             rec["final"] = True
         rec["time"] = int(tick * ms_per_tick * 1_000_000)
@@ -277,7 +332,8 @@ def run_native_sim(opts: Optional[Dict[str, Any]] = None
             o["pool_slots"] = 48
         if "inbox_k" not in (opts or {}):
             o["inbox_k"] = 4
-    if o["workload"] != "lin-kv" and o["workload"] != "txn-list-append" \
+    if o["workload"] not in ("lin-kv", "txn-list-append",
+                             "txn-rw-register") \
             and "rpc_timeout" not in (opts or {}):
         # non-Raft ops complete in ~2 ticks; the Raft-sized 1s timeout
         # wedges a client for half a short horizon when loss eats a
@@ -308,11 +364,14 @@ def run_native_sim(opts: Optional[Dict[str, Any]] = None
         raise ValueError(f"unknown native topology {o['topology']!r} "
                          f"(expected one of {sorted(_topologies)})")
     txn_max, list_cap = int(o["txn_max"]), int(o["list_cap"])
-    ev_w = 4 + 3 * txn_max + txn_max * list_cap if workload == 1 else 7
-    if workload >= 2:
-        # g-set reads stream their whole set as 7-value rows, so the
-        # event budget scales with ops^2/7 in the worst case; ops per
-        # client are rate-bounded by the horizon
+    ev_w = (4 + 3 * txn_max + txn_max * list_cap if workload == 1
+            else 4 + 3 * txn_max if workload == 7 else 7)
+    if workload in (2, 3):
+        # g-set/broadcast reads stream their whole set as 7-value
+        # rows, so the event budget scales with ops^2/7 in the worst
+        # case; ops per client are rate-bounded by the horizon. The
+        # other families emit one row per event and keep the base
+        # budget.
         max_events = max(256, 2 * C * n_ticks)
 
     threads = int(o["threads"]) or (os.cpu_count() or 1)
@@ -388,6 +447,16 @@ def run_native_sim(opts: Optional[Dict[str, Any]] = None
         histories = [
             _decode_gset_history(events[i, :n_events[i]], mpt,
                                  final_start, add_name=add_name)
+            for i in range(R)]
+    elif workload == 7:
+        histories = [
+            _decode_rw_history(events[i, :n_events[i]], mpt,
+                               final_start, txn_max)
+            for i in range(R)]
+    elif workload == 8:
+        histories = [
+            _decode_echo_history(events[i, :n_events[i]], mpt,
+                                 final_start)
             for i in range(R)]
     elif workload in (4, 5, 6):
         f_names = ({1: "generate"} if workload == 4

@@ -20,6 +20,13 @@ def _checker_for(workload: str, consistency_model: str = None):
         from ..checkers.elle import check_list_append
         model = consistency_model or "strict-serializable"
         return lambda h: check_list_append(h, consistency_model=model)
+    if workload == "txn-rw-register":
+        from ..checkers.elle import check_rw_register
+        model = consistency_model or "strict-serializable"
+        return lambda h: check_rw_register(h, consistency_model=model)
+    if workload == "echo":
+        from ..workloads.echo import echo_checker
+        return lambda h: echo_checker(h, {})
     if workload == "g-set":
         from ..checkers.set_full import set_full_checker
         return set_full_checker

@@ -415,3 +415,47 @@ def test_native_unique_ids_instance_base_bit_exact():
                                       record_instances=1,
                                       instance_base=2))
     assert solo["histories"][0] == res["histories"][2]
+
+
+# --- txn-rw-register + echo (families eight and nine) ---------------
+
+def test_native_rw_register_clean_elle_valid():
+    from maelstrom_tpu.checkers.elle import check_rw_register
+    res = run_native_sim(_txn_opts(workload="txn-rw-register"))
+    assert res["violating-instances"] == 0
+    n_txns = 0
+    for h in res["histories"]:
+        r = check_rw_register(h)
+        assert r["valid?"] is True, r
+        n_txns += r["txn-count"]
+    assert n_txns > 100
+
+
+def test_native_rw_register_dirty_apply_caught():
+    from maelstrom_tpu.checkers.elle import check_rw_register
+    res = run_native_sim(_txn_opts(workload="txn-rw-register",
+                                   txn_dirty_apply=True))
+    flagged = 0
+    anomalies = set()
+    for h in res["histories"]:
+        r = check_rw_register(h)
+        if r["valid?"] is False:
+            flagged += 1
+            anomalies |= set(r["anomalies"].keys())
+    assert flagged >= 2, "dirty-apply went undetected on registers"
+    assert anomalies & {"G0", "G1a", "G1c", "G-single", "G2-item",
+                        "unwritten-read"}, anomalies
+
+
+def test_native_echo_clean():
+    res = run_native_test(_small_opts(workload="echo"))
+    assert res["valid?"] is True
+    assert sum(i.get("ok-count", 0) for i in res["instances"]) > 200
+
+
+def test_native_rw_register_instance_base_bit_exact():
+    res = run_native_sim(_txn_opts(workload="txn-rw-register"))
+    solo = run_native_sim(_txn_opts(workload="txn-rw-register",
+                                    n_instances=1, record_instances=1,
+                                    instance_base=6))
+    assert solo["histories"][0] == res["histories"][6]
