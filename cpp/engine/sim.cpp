@@ -82,7 +82,10 @@ struct Cfg {
                               // 7 = txn-rw-register (txns over the
                               //     Raft log, register semantics,
                               //     Elle rw-register checker),
-                              // 8 = echo (payload round-trip)
+                              // 8 = echo (payload round-trip),
+                              // 9 = kafka (single-broker log on node
+                              //     0: send/poll/commit_offsets,
+                              //     kafka anomaly checker)
   int64_t txn_max;            // micro-ops per txn (<= TXN_CAP)
   int64_t list_cap;           // per-key list capacity; an append txn
                               // that would overflow aborts WHOLE with
@@ -98,7 +101,10 @@ struct Cfg {
                                  // pn-counter) never gossip — values
                                  // strand on one node (set-full lost /
                                  // interval miss); unique-ids drops
-                                 // node striping (id collisions)
+                                 // node striping (id collisions);
+                                 // kafka's broker skips the first
+                                 // pending message per key per poll
+                                 // (lost writes)
   int64_t topology;   // broadcast neighbor graph: 0 total, 1 line,
                       // 2 grid, 3 tree2, 4 tree3, 5 tree4 (the
                       // reference's --topology registry,
@@ -106,6 +112,8 @@ struct Cfg {
 };
 
 constexpr int TXN_CAP = 4;    // engine-wide micro-op slot bound
+constexpr int KPOLL_MAX = 3;  // kafka: max messages per key per poll
+constexpr int KPOS_MAX = 8;   // kafka: consumer-position key bound
 
 // ------------------------------------------------------------ message
 enum MType : int32_t {
@@ -119,6 +127,8 @@ enum MType : int32_t {
   M_BGOSSIP = 44,
   M_UID = 50, M_UID_OK = 51,
   M_ECHO = 70, M_ECHO_OK = 71,
+  M_KSEND = 80, M_KSEND_OK = 81, M_KPOLL = 82, M_KPOLL_OK = 83,
+  M_KCOMMIT = 84, M_KCOMMIT_OK = 85, M_KLIST = 86, M_KLIST_OK = 87,
   M_PNADD = 60, M_PNADD_OK = 61, M_PNREAD = 62, M_PNREAD_OK = 63,
   M_PNMERGE = 64,
   M_ERROR = 127
@@ -177,6 +187,7 @@ struct Node {
   std::vector<int32_t> gset;                 // g-set workload state:
   std::unordered_set<int32_t> gseen;         // insertion order + member
   int32_t uid_counter = 0;                   // unique-ids workload
+  std::vector<int32_t> kcommitted;           // kafka committed offsets
   std::vector<int64_t> pn_pos, pn_neg;       // pn-counter CRDT: one
                                              // G-counter pair per node
   std::vector<int32_t> next_idx, match_idx;
@@ -195,6 +206,7 @@ struct Client {
   int32_t msg_id = -1, next_msg_id = 0, invoked = 0;
   int32_t tlen = 0;             // txn workload: the outstanding txn
   int32_t tops[TXN_CAP][3] = {};
+  int32_t kpos[KPOS_MAX] = {0};  // kafka consumer positions per key
 };
 
 struct Stats {
@@ -527,6 +539,55 @@ struct Sim {
         bcast_flood(in, t, me, fresh, m.src);
         break;
       }
+      case M_KSEND: {
+        int32_t k = std::min(std::max(m.body[0], 0),
+                             int32_t(cfg.n_keys) - 1);
+        nd.lists[k].push_back(m.body[1]);
+        node_reply(in, t, me, m, M_KSEND_OK, k, m.body[1],
+                   int32_t(nd.lists[k].size()) - 1);
+        break;
+      }
+      case M_KPOLL: {
+        // request ext = consumer positions per key; reply ext = up to
+        // KPOLL_MAX (k, offset, value) triples per key from there.
+        // The family BUG flag skips the first pending message per key
+        // — consumers advance past values nobody ever observes, which
+        // the checker reports as lost writes.
+        Msg r;
+        r.valid = 1; r.src = me; r.origin = me; r.dest = m.src;
+        r.type = M_KPOLL_OK; r.reply_to = m.msg_id;
+        int32_t n_tr = 0;
+        for (int32_t k = 0; k < cfg.n_keys; ++k) {
+          int32_t pos = k < int32_t(m.ext.size()) ? m.ext[k] : 0;
+          int32_t len = int32_t(nd.lists[k].size());
+          if (cfg.flag_gset_no_gossip && len > pos) ++pos;
+          for (int32_t i = 0; i < KPOLL_MAX && pos < len; ++i, ++pos) {
+            r.ext.push_back(k);
+            r.ext.push_back(pos);
+            r.ext.push_back(nd.lists[k][pos]);
+            ++n_tr;
+          }
+        }
+        r.body[0] = n_tr;
+        send(in, t, std::move(r));
+        break;
+      }
+      case M_KCOMMIT: {
+        for (int32_t k = 0; k < cfg.n_keys; ++k) {
+          int32_t off = k < int32_t(m.ext.size()) ? m.ext[k] : -1;
+          nd.kcommitted[k] = std::max(nd.kcommitted[k], off);
+        }
+        node_reply(in, t, me, m, M_KCOMMIT_OK, 0, 0, 0);
+        break;
+      }
+      case M_KLIST: {
+        Msg r;
+        r.valid = 1; r.src = me; r.origin = me; r.dest = m.src;
+        r.type = M_KLIST_OK; r.reply_to = m.msg_id;
+        r.ext.assign(nd.kcommitted.begin(), nd.kcommitted.end());
+        send(in, t, std::move(r));
+        break;
+      }
       case M_ECHO: {
         node_reply(in, t, me, m, M_ECHO_OK, m.body[0], 0, 0);
         break;
@@ -758,8 +819,9 @@ struct Sim {
       }
       return;
     }
-    if (cfg.workload == 4 || cfg.workload == 8)
-      return;   // unique-ids / echo: no timers at all
+    if (cfg.workload == 4 || cfg.workload == 8 ||
+        cfg.workload == 9)
+      return;   // unique-ids / echo / kafka broker: no timers
     if (cfg.workload == 5 || cfg.workload == 6) {
       // pn/g-counter anti-entropy: ship both G-counter vectors to one
       // rotating peer every heartbeat (merge = elementwise max)
@@ -954,6 +1016,50 @@ struct Sim {
     }
   }
 
+  // kafka event rows (width 7). send: one row
+  // [t, c, etype, 1, k, v, offset|NIL]. poll ok: header
+  // [t, c, 2, 2, n_triples, 0, 0] + one (k, off, v) row per message.
+  // commit ok: header [t, c, 2, 3, n_keys, 0, 0] + one (k, off) row
+  // per key. Failed/indeterminate polls/commits are single rows.
+  void record_kafka(Recorder& rec, int32_t t, int32_t c, int32_t etype,
+                    const Client& cl, const Msg* ok) const {
+    if (cl.f == 1) {   // send
+      rec.event(t, c, etype, 1, cl.k, cl.a,
+                (ok && etype == EV_OK) ? ok->body[2] : NIL);
+      return;
+    }
+    if (etype != EV_OK || !ok) {
+      rec.event(t, c, etype, cl.f, 0, 0, 0);
+      return;
+    }
+    if (cl.f == 2) {   // poll ok: header + triples
+      int32_t n_tr = ok->body[0];
+      int64_t need = 1 + n_tr;
+      if (!rec.out || rec.n + need > rec.cap) { rec.n = rec.cap; return; }
+      rec.event(t, c, EV_OK, 2, n_tr, 0, 0);
+      for (int32_t i = 0; i < n_tr; ++i) {
+        int32_t* p = rec.row();
+        p[0] = ok->ext[3 * i];
+        p[1] = ok->ext[3 * i + 1];
+        p[2] = ok->ext[3 * i + 2];
+      }
+      return;
+    }
+    // commit ok: the offsets the client sent (positions are frozen
+    // while its one outstanding op is in flight). list ok: the
+    // server-reported committed offsets from the reply.
+    int64_t need = 1 + cfg.n_keys;
+    if (!rec.out || rec.n + need > rec.cap) { rec.n = rec.cap; return; }
+    rec.event(t, c, EV_OK, cl.f, int32_t(cfg.n_keys), 0, 0);
+    for (int32_t k = 0; k < cfg.n_keys; ++k) {
+      int32_t* p = rec.row();
+      p[0] = k;
+      p[1] = cl.f == 4 && k < int32_t(ok->ext.size())
+                 ? ok->ext[k]
+                 : cl.kpos[k] - 1;
+    }
+  }
+
   void check_invariants(Instance& in) const {
     // Raft invariants apply to the Raft-backed workloads only
     if (cfg.workload >= 2 && cfg.workload != 7) return;
@@ -995,8 +1101,10 @@ struct Sim {
         nd.log_term.assign(cfg.log_cap, 0);
         nd.log_body.assign(cfg.log_cap, Entry{});
         nd.kv.assign(cfg.n_keys, NIL);
-        if (cfg.workload == 1)
+        if (cfg.workload == 1 || cfg.workload == 9)
           nd.lists.assign(cfg.n_keys, {});
+        if (cfg.workload == 9)
+          nd.kcommitted.assign(cfg.n_keys, -1);
         if (cfg.workload == 5 || cfg.workload == 6) {
           nd.pn_pos.assign(cfg.n_nodes, 0);
           nd.pn_neg.assign(cfg.n_nodes, 0);
@@ -1122,10 +1230,22 @@ struct Sim {
                 ? m.body[0]
                 : cl.a;
       }
+      if (cfg.workload == 9 && m.type == M_KPOLL_OK) {
+        // consume: advance this client's positions past everything
+        // the poll returned (state change — recording or not)
+        for (size_t i = 0; i + 2 < m.ext.size(); i += 3) {
+          int32_t k = m.ext[i];
+          if (k >= 0 && k < KPOS_MAX)
+            cl.kpos[k] = std::max(cl.kpos[k], m.ext[i + 1] + 1);
+        }
+      }
       if (rec) {
         if (txn_mode())
           record_txn(*rec, t, c, etype, cl,
                      m.type == M_TXN_OK ? &m : nullptr);
+        else if (cfg.workload == 9)
+          record_kafka(*rec, t, c, etype, cl,
+                       etype == EV_OK ? &m : nullptr);
         else if (m.type == M_GREAD_OK || m.type == M_BREAD_OK)
           record_gset_read(*rec, t, c, m);
         else
@@ -1145,6 +1265,8 @@ struct Sim {
         if (rec) {
           if (txn_mode())
             record_txn(*rec, t, c, etype, cl, nullptr);
+          else if (cfg.workload == 9)
+            record_kafka(*rec, t, c, etype, cl, nullptr);
           else
             rec->event(t, c, etype, cl.f, cl.k, cl.a, cl.b);
         }
@@ -1152,6 +1274,42 @@ struct Sim {
       }
       if (cl.status == 0 && in.rng.uniform() < cfg.rate) {
         bool final_phase = t >= cfg.final_start;
+        if (cfg.workload == 9) {
+          double rr = in.rng.uniform();
+          cl.f = final_phase ? 2
+                 : rr < 0.45 ? 1 : rr < 0.8 ? 2 : rr < 0.93 ? 3 : 4;
+          cl.msg_id = cl.next_msg_id++;
+          cl.invoked = t;
+          cl.status = 1;
+          Msg q;
+          q.valid = 1;
+          q.src = int32_t(cfg.n_nodes) + c;
+          q.origin = q.src;
+          q.dest = 0;   // the broker
+          q.msg_id = cl.msg_id;
+          if (cl.f == 1) {
+            cl.k = in.rng.below(int32_t(cfg.n_keys));
+            cl.a = 1 + cl.next_msg_id * int32_t(cfg.n_clients) + c;
+            q.type = M_KSEND;
+            q.body[0] = cl.k; q.body[1] = cl.a;
+            if (rec) rec->event(t, c, EV_INVOKE, 1, cl.k, cl.a, NIL);
+          } else if (cl.f == 2) {
+            q.type = M_KPOLL;
+            for (int32_t k = 0; k < cfg.n_keys; ++k)
+              q.ext.push_back(cl.kpos[k]);
+            if (rec) rec->event(t, c, EV_INVOKE, 2, 0, 0, 0);
+          } else if (cl.f == 3) {
+            q.type = M_KCOMMIT;
+            for (int32_t k = 0; k < cfg.n_keys; ++k)
+              q.ext.push_back(cl.kpos[k] - 1);
+            if (rec) rec->event(t, c, EV_INVOKE, 3, 0, 0, 0);
+          } else {
+            q.type = M_KLIST;
+            if (rec) rec->event(t, c, EV_INVOKE, 4, 0, 0, 0);
+          }
+          send(in, t, std::move(q));
+          continue;
+        }
         if (cfg.workload == 8) {
           cl.f = 1;    // echo
           cl.a = 1 + cl.next_msg_id * int32_t(cfg.n_clients) + c;
@@ -1357,7 +1515,8 @@ int64_t native_sim_run_sched(const int64_t* c, int64_t* stats_out,
   cfg.flag_txn_dirty_apply = c[32];
   cfg.flag_gset_no_gossip = c[33];
   cfg.topology = c[34];
-  if (cfg.workload < 0 || cfg.workload > 8) return -1;
+  if (cfg.workload < 0 || cfg.workload > 9) return -1;
+  if (cfg.workload == 9 && cfg.n_keys > KPOS_MAX) return -1;
   if (cfg.topology < 0 || cfg.topology > 5) return -1;
   if (cfg.nemesis_interval <= 0) cfg.nemesis_interval = 1;
   if (cfg.n_nodes > 30) return -1;   // votes bitmask width

@@ -40,7 +40,7 @@ ETYPE_NAMES = {EV_OK: "ok", EV_FAIL: "fail", EV_INFO: "info"}
 NATIVE_WORKLOADS = {"lin-kv": 0, "txn-list-append": 1, "g-set": 2,
                     "broadcast": 3, "unique-ids": 4, "pn-counter": 5,
                     "g-counter": 6, "txn-rw-register": 7,
-                    "echo": 8}
+                    "echo": 8, "kafka": 9}
 
 
 def _load():
@@ -150,6 +150,59 @@ def _decode_rw_history(ev: np.ndarray, ms_per_tick: float,
                "type": ("invoke" if etype == EV_INVOKE
                         else ETYPE_NAMES[etype]),
                "f": "txn", "value": ops}
+        if etype == EV_INVOKE and tick >= final_start:
+            rec["final"] = True
+        rec["time"] = int(tick * ms_per_tick * 1_000_000)
+        rec["index"] = len(hist)
+        hist.append(rec)
+    return hist
+
+
+def _decode_kafka_history(ev: np.ndarray, ms_per_tick: float,
+                          final_start: int) -> List[dict]:
+    """kafka rows -> the kafka checker's shapes (checkers/kafka.py):
+    send [k, v] / [k, v, offset]; poll ok = {key: [[off, v], ...]}
+    reassembled from header + triple rows; commit_offsets ok =
+    {key: off} from header + pair rows."""
+    F = {1: "send", 2: "poll", 3: "commit_offsets",
+         4: "list_committed_offsets"}
+    hist: List[dict] = []
+    i = 0
+    while i < len(ev):
+        row = ev[i]
+        tick, client, etype, f = (int(row[0]), int(row[1]),
+                                  int(row[2]), int(row[3]))
+        if etype not in ETYPE_NAMES and etype != EV_INVOKE:
+            break   # recorder saturation padding
+        fname = F.get(f)
+        if fname is None:
+            break
+        value: Any
+        if fname == "send":
+            k, v, off = int(row[4]), int(row[5]), int(row[6])
+            value = [k, v, off] if (etype == EV_OK) else [k, v]
+            i += 1
+        elif etype == EV_OK and fname == "poll":
+            n = int(row[4])
+            msgs: Dict[int, list] = {}
+            for r2 in ev[i + 1:i + 1 + n]:
+                msgs.setdefault(int(r2[0]), []).append(
+                    [int(r2[1]), int(r2[2])])
+            value = msgs
+            i += 1 + n
+        elif etype == EV_OK and fname in ("commit_offsets",
+                                          "list_committed_offsets"):
+            n = int(row[4])
+            value = {int(r2[0]): int(r2[1])
+                     for r2 in ev[i + 1:i + 1 + n] if int(r2[1]) >= 0}
+            i += 1 + n
+        else:
+            value = None
+            i += 1
+        rec = {"process": client,
+               "type": ("invoke" if etype == EV_INVOKE
+                        else ETYPE_NAMES[etype]),
+               "f": fname, "value": value}
         if etype == EV_INVOKE and tick >= final_start:
             rec["final"] = True
         rec["time"] = int(tick * ms_per_tick * 1_000_000)
@@ -369,10 +422,13 @@ def run_native_sim(opts: Optional[Dict[str, Any]] = None
     if workload in (2, 3):
         # g-set/broadcast reads stream their whole set as 7-value
         # rows, so the event budget scales with ops^2/7 in the worst
-        # case; ops per client are rate-bounded by the horizon. The
-        # other families emit one row per event and keep the base
-        # budget.
+        # case; ops per client are rate-bounded by the horizon
         max_events = max(256, 2 * C * n_ticks)
+    elif workload == 9:
+        # kafka polls/commits emit header + up to
+        # n_keys*KPOLL_MAX / n_keys rows per op — amplify the
+        # one-row-per-event base budget accordingly
+        max_events = max(256, C * n_ticks * 4)
 
     threads = int(o["threads"]) or (os.cpu_count() or 1)
     cfg = (ctypes.c_int64 * 35)(
@@ -452,6 +508,11 @@ def run_native_sim(opts: Optional[Dict[str, Any]] = None
         histories = [
             _decode_rw_history(events[i, :n_events[i]], mpt,
                                final_start, txn_max)
+            for i in range(R)]
+    elif workload == 9:
+        histories = [
+            _decode_kafka_history(events[i, :n_events[i]], mpt,
+                                  final_start)
             for i in range(R)]
     elif workload == 8:
         histories = [

@@ -24,6 +24,9 @@ def _checker_for(workload: str, consistency_model: str = None):
         from ..checkers.elle import check_rw_register
         model = consistency_model or "strict-serializable"
         return lambda h: check_rw_register(h, consistency_model=model)
+    if workload == "kafka":
+        from ..checkers.kafka import kafka_checker
+        return kafka_checker
     if workload == "echo":
         from ..workloads.echo import echo_checker
         return lambda h: echo_checker(h, {})

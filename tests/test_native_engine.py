@@ -459,3 +459,41 @@ def test_native_rw_register_instance_base_bit_exact():
                                     n_instances=1, record_instances=1,
                                     instance_base=6))
     assert solo["histories"][0] == res["histories"][6]
+
+
+# --- kafka (family ten: the full workload table runs natively) ------
+
+def _kafka_opts(**kw):
+    o = dict(workload="kafka", n_instances=48, record_instances=4,
+             time_limit=2.0, node_count=1, nemesis=[], p_loss=0.05,
+             recovery_time=0.3, seed=7, threads=1)
+    o.update(kw)
+    return o
+
+
+def test_native_kafka_clean():
+    res = run_native_test(_kafka_opts())
+    assert res["valid?"] is True, res["instances"][:2]
+    assert sum(i.get("send-count", 0) for i in res["instances"]) > 200
+    assert sum(i.get("poll-count", 0) for i in res["instances"]) > 200
+
+
+def test_native_kafka_poll_skip_caught():
+    # the family bug flag makes the broker skip the first pending
+    # message per key on every poll — consumers advance past values
+    # nobody observes, which the checker reports as lost writes
+    res = run_native_test(_kafka_opts(gset_no_gossip=True))
+    assert res["valid?"] is False
+    anoms = set()
+    for i in res["instances"]:
+        anoms |= set((i.get("anomalies") or {}).keys())
+    assert "lost-write" in anoms, anoms
+
+
+def test_native_kafka_instance_base_bit_exact():
+    from maelstrom_tpu.native import run_native_sim
+    res = run_native_sim(_kafka_opts())
+    solo = run_native_sim(_kafka_opts(n_instances=1,
+                                      record_instances=1,
+                                      instance_base=1))
+    assert solo["histories"][0] == res["histories"][1]
