@@ -382,11 +382,11 @@ def _native_bench() -> bool:
     from maelstrom_tpu.checkers.linearizable import \
         linearizable_kv_checker
 
-    # workload breadth at bench time: quick checked runs of three
-    # more native families (txn-list-append/Elle, g-set/set-full,
-    # pn-counter/interval) ride on the headline line, so the artifact
-    # shows the engine posting the number covers all four checker
-    # kinds, not one workload
+    # workload breadth at bench time: quick checked runs of four more
+    # native families (txn-list-append/Elle, g-set/set-full,
+    # pn-counter/interval, kafka/log-anomalies) ride on the headline
+    # line, so the artifact shows the engine posting the number spans
+    # the checker families, not one workload
     # the one base config every native run below derives from — the
     # headline regimes and the family runs must never drift apart
     base_opts = dict(node_count=3, concurrency=6, inbox_k=1,
@@ -399,13 +399,17 @@ def _native_bench() -> bool:
     if os.environ.get("BENCH_FAMILIES") != "0":
         from maelstrom_tpu.checkers.elle import check_list_append
         from maelstrom_tpu.checkers.set_full import set_full_checker
+        from maelstrom_tpu.checkers.kafka import kafka_checker
         from maelstrom_tpu.checkers.pn_counter import \
             pn_counter_checker
         for wname, wopts, chk in (
                 ("txn-list-append", {}, check_list_append),
-                ("g-set", {"read_prob": 0.1}, set_full_checker),
-                ("pn-counter", {"read_prob": 0.15},
-                 pn_counter_checker)):
+                ("g-set", {"read_prob": 0.1, "rpc_timeout": 0.25},
+                 set_full_checker),
+                ("pn-counter", {"read_prob": 0.15, "rpc_timeout": 0.25},
+                 pn_counter_checker),
+                ("kafka", {"node_count": 1, "nemesis": [],
+                           "rpc_timeout": 0.25}, kafka_checker)):
             fam_opts = dict(base_opts, n_instances=1024,
                             record_instances=2, time_limit=1.5,
                             workload=wname, **wopts)

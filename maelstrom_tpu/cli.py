@@ -380,6 +380,16 @@ DEMOS = [
       "p_loss": 0.05, "recovery_time": 0.4, "rate": 200.0,
       "time_limit": 2.0, "threads": 1, "gset_no_gossip": True},
      False),
+    ("txn-rw-register", "(native engine)",
+     {"runtime": "native", "n_instances": 48, "record_instances": 4,
+      "nemesis": ["partition"], "nemesis_interval": 0.3,
+      "p_loss": 0.05, "recovery_time": 0.3, "rate": 200.0,
+      "time_limit": 2.5, "threads": 1}),
+    ("kafka", "(native engine, poll-skip mutant)",
+     {"runtime": "native", "n_instances": 48, "record_instances": 4,
+      "node_count": 1, "nemesis": [], "p_loss": 0.05,
+      "recovery_time": 0.3, "rate": 200.0, "time_limit": 2.0,
+      "threads": 1, "gset_no_gossip": True}, False),
 ]
 
 
