@@ -109,6 +109,13 @@ struct Cfg {
                       // 2 grid, 3 tree2, 4 tree3, 5 tree4 (the
                       // reference's --topology registry,
                       // broadcast.clj:169-178, node-index form)
+  int64_t kafka_crash_clients;   // kafka: clients randomly "crash" —
+                                 // drop their consumer positions and
+                                 // resume from the broker's committed
+                                 // offsets; the first poll after
+                                 // carries the reassigned flag the
+                                 // checker honors (kafka.clj
+                                 // :crash-clients semantics)
 };
 
 constexpr int TXN_CAP = 4;    // engine-wide micro-op slot bound
@@ -207,6 +214,8 @@ struct Client {
   int32_t tlen = 0;             // txn workload: the outstanding txn
   int32_t tops[TXN_CAP][3] = {};
   int32_t kpos[KPOS_MAX] = {0};  // kafka consumer positions per key
+  int32_t reassigned = 0;        // kafka: next poll resumes from
+                                 // committed offsets (post-crash)
 };
 
 struct Stats {
@@ -1023,6 +1032,10 @@ struct Sim {
   // per key. Failed/indeterminate polls/commits are single rows.
   void record_kafka(Recorder& rec, int32_t t, int32_t c, int32_t etype,
                     const Client& cl, const Msg* ok) const {
+    if (cl.f == 5) {   // crash: indeterminate by definition
+      rec.event(t, c, EV_INFO, 5, 0, 0, 0);
+      return;
+    }
     if (cl.f == 1) {   // send
       rec.event(t, c, etype, 1, cl.k, cl.a,
                 (ok && etype == EV_OK) ? ok->body[2] : NIL);
@@ -1230,7 +1243,16 @@ struct Sim {
                 ? m.body[0]
                 : cl.a;
       }
+      if (cfg.workload == 9 && m.type == M_KLIST_OK && cl.f == 5) {
+        // crash resume: positions jump to committed+1; the next poll
+        // is flagged reassigned so backwards jumps are legal
+        for (int32_t k = 0; k < int32_t(cfg.n_keys) && k < KPOS_MAX;
+             ++k)
+          cl.kpos[k] = (k < int32_t(m.ext.size()) ? m.ext[k] : -1) + 1;
+        cl.reassigned = 1;
+      }
       if (cfg.workload == 9 && m.type == M_KPOLL_OK) {
+        if (cl.f == 2) cl.reassigned = 0;   // the flag rides one poll
         // consume: advance this client's positions past everything
         // the poll returned (state change — recording or not)
         for (size_t i = 0; i + 2 < m.ext.size(); i += 3) {
@@ -1276,8 +1298,13 @@ struct Sim {
         bool final_phase = t >= cfg.final_start;
         if (cfg.workload == 9) {
           double rr = in.rng.uniform();
-          cl.f = final_phase ? 2
-                 : rr < 0.45 ? 1 : rr < 0.8 ? 2 : rr < 0.93 ? 3 : 4;
+          if (cfg.kafka_crash_clients && !final_phase &&
+              in.rng.uniform() < 0.01) {
+            cl.f = 5;   // crash: refetch committed offsets and resume
+          } else {
+            cl.f = final_phase ? 2
+                   : rr < 0.45 ? 1 : rr < 0.8 ? 2 : rr < 0.93 ? 3 : 4;
+          }
           cl.msg_id = cl.next_msg_id++;
           cl.invoked = t;
           cl.status = 1;
@@ -1297,15 +1324,19 @@ struct Sim {
             q.type = M_KPOLL;
             for (int32_t k = 0; k < cfg.n_keys; ++k)
               q.ext.push_back(cl.kpos[k]);
-            if (rec) rec->event(t, c, EV_INVOKE, 2, 0, 0, 0);
+            if (rec) rec->event(t, c, EV_INVOKE, 2, cl.reassigned,
+                                0, 0);
           } else if (cl.f == 3) {
             q.type = M_KCOMMIT;
             for (int32_t k = 0; k < cfg.n_keys; ++k)
               q.ext.push_back(cl.kpos[k] - 1);
             if (rec) rec->event(t, c, EV_INVOKE, 3, 0, 0, 0);
-          } else {
+          } else if (cl.f == 4) {
             q.type = M_KLIST;
             if (rec) rec->event(t, c, EV_INVOKE, 4, 0, 0, 0);
+          } else {
+            q.type = M_KLIST;   // crash: the refetch rides a list RPC
+            if (rec) rec->event(t, c, EV_INVOKE, 5, 0, 0, 0);
           }
           send(in, t, std::move(q));
           continue;
@@ -1467,7 +1498,8 @@ extern "C" {
 // log_cap, elect_min, elect_jitter, n_keys, n_vals, flag_stale_read,
 // flag_eager_commit, flag_no_term_guard, max_events, n_threads,
 // instance_base, workload, txn_max, list_cap, read_prob_micro,
-// flag_txn_dirty_apply, flag_gset_no_gossip, topology  (35 fields)
+// flag_txn_dirty_apply, flag_gset_no_gossip, topology,
+// kafka_crash_clients  (36 fields)
 int64_t native_sim_run_sched(const int64_t* c, int64_t* stats_out,
                              int32_t* violations_out,
                              int32_t* events_out,
@@ -1515,6 +1547,7 @@ int64_t native_sim_run_sched(const int64_t* c, int64_t* stats_out,
   cfg.flag_txn_dirty_apply = c[32];
   cfg.flag_gset_no_gossip = c[33];
   cfg.topology = c[34];
+  cfg.kafka_crash_clients = c[35];
   if (cfg.workload < 0 || cfg.workload > 9) return -1;
   if (cfg.workload == 9 && cfg.n_keys > KPOS_MAX) return -1;
   if (cfg.topology < 0 || cfg.topology > 5) return -1;

@@ -205,6 +205,10 @@ def cmd_test(args) -> int:
                 args.nemesis = list(args.nemesis) + ["partition"]
         notes = [(args.availability, "--availability", None),
                  (args.latency_dist, "--latency-dist", "exponential")]
+        if args.workload != "kafka":
+            # crash injection is a kafka-client feature everywhere
+            notes.append((args.crash_clients or None,
+                          "--crash-clients", None))
         if args.workload not in ("txn-list-append", "txn-rw-register"):
             # only the Elle-checked txn workloads are model-selectable;
             # the rest use WGL / set-full / interval / uniqueness
@@ -220,6 +224,7 @@ def cmd_test(args) -> int:
             workload=args.workload,
             consistency_models=args.consistency_models,
             topology=args.topology,
+            crash_clients=args.crash_clients,
             node_count=node_count, concurrency=concurrency,
             rate=args.rate, time_limit=args.time_limit,
             latency=args.latency, p_loss=args.p_loss,
@@ -237,7 +242,8 @@ def cmd_test(args) -> int:
         from .tpu.harness import run_tpu_test
         for flag, name in ((args.log_stderr, "--log-stderr"),
                            (args.log_net_send, "--log-net-send"),
-                           (args.log_net_recv, "--log-net-recv")):
+                           (args.log_net_recv, "--log-net-recv"),
+                           (args.crash_clients, "--crash-clients")):
             if flag:
                 print(f"note: {name} has no effect on the TPU runtime "
                       f"(no node processes / host wire log)",

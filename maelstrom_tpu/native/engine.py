@@ -165,7 +165,7 @@ def _decode_kafka_history(ev: np.ndarray, ms_per_tick: float,
     reassembled from header + triple rows; commit_offsets ok =
     {key: off} from header + pair rows."""
     F = {1: "send", 2: "poll", 3: "commit_offsets",
-         4: "list_committed_offsets"}
+         4: "list_committed_offsets", 5: "crash"}
     hist: List[dict] = []
     i = 0
     while i < len(ev):
@@ -178,9 +178,17 @@ def _decode_kafka_history(ev: np.ndarray, ms_per_tick: float,
         if fname is None:
             break
         value: Any
-        if fname == "send":
+        reassigned = False
+        if fname == "crash":
+            value = None
+            i += 1
+        elif fname == "send":
             k, v, off = int(row[4]), int(row[5]), int(row[6])
             value = [k, v, off] if (etype == EV_OK) else [k, v]
+            i += 1
+        elif fname == "poll" and etype == EV_INVOKE:
+            value = None
+            reassigned = bool(int(row[4]))
             i += 1
         elif etype == EV_OK and fname == "poll":
             n = int(row[4])
@@ -203,6 +211,8 @@ def _decode_kafka_history(ev: np.ndarray, ms_per_tick: float,
                "type": ("invoke" if etype == EV_INVOKE
                         else ETYPE_NAMES[etype]),
                "f": fname, "value": value}
+        if reassigned:
+            rec["reassigned"] = True
         if etype == EV_INVOKE and tick >= final_start:
             rec["final"] = True
         rec["time"] = int(tick * ms_per_tick * 1_000_000)
@@ -367,6 +377,7 @@ def run_native_sim(opts: Optional[Dict[str, Any]] = None
         # twin of models/txn_raft.py)
         workload="lin-kv", txn_max=3, list_cap=16, read_prob=0.5,
         txn_dirty_apply=False, gset_no_gossip=False, topology="grid",
+        crash_clients=False,
         # instances are independent, so worker threads each own a
         # contiguous block end-to-end; per-instance trajectories are
         # identical at ANY thread count (RNG is a pure function of
@@ -431,7 +442,7 @@ def run_native_sim(opts: Optional[Dict[str, Any]] = None
         max_events = max(256, C * n_ticks * 4)
 
     threads = int(o["threads"]) or (os.cpu_count() or 1)
-    cfg = (ctypes.c_int64 * 35)(
+    cfg = (ctypes.c_int64 * 36)(
         int(o["seed"]), I, n_ticks, int(o["node_count"]), C, R,
         int(o["pool_slots"]), int(o["inbox_k"]),
         int(float(o["latency"]) / mpt * 1000),
@@ -452,7 +463,8 @@ def run_native_sim(opts: Optional[Dict[str, Any]] = None
         int(float(o["read_prob"]) * 1e6),
         1 if o["txn_dirty_apply"] else 0,
         1 if o["gset_no_gossip"] else 0,
-        _topologies[o["topology"]])
+        _topologies[o["topology"]],
+        1 if o["crash_clients"] else 0)
 
     stats = (ctypes.c_int64 * 5)()
     violations = np.zeros(I, dtype=np.int32)

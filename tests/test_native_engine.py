@@ -497,3 +497,29 @@ def test_native_kafka_instance_base_bit_exact():
                                       record_instances=1,
                                       instance_base=1))
     assert solo["histories"][0] == res["histories"][1]
+
+
+def test_native_kafka_crash_clients_resume_from_committed():
+    # crashed clients refetch committed offsets and resume; the first
+    # poll after carries the reassigned flag the checker honors. The
+    # flag is load-bearing: stripped histories must show the backward
+    # jumps as external-nonmonotonic.
+    from maelstrom_tpu.native import run_native_sim
+    from maelstrom_tpu.checkers.kafka import kafka_checker
+    raw = run_native_sim(_kafka_opts(time_limit=3.0, n_instances=64,
+                                     record_instances=8,
+                                     crash_clients=True))
+    crashes = stripped_caught = 0
+    for h in raw["histories"]:
+        crashes += sum(1 for r in h if r["f"] == "crash"
+                       and r["type"] == "invoke")
+        assert kafka_checker(h)["valid?"] is True
+        bare = [{k: v for k, v in r.items() if k != "reassigned"}
+                for r in h]
+        r2 = kafka_checker(bare)
+        if r2["valid?"] is False and \
+                "external-nonmonotonic" in r2["anomalies"]:
+            stripped_caught += 1
+    assert crashes >= 3, "crash injection never fired"
+    assert stripped_caught >= 1, \
+        "no crash produced an actual backward jump"
