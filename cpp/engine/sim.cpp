@@ -109,6 +109,14 @@ struct Cfg {
                       // 2 grid, 3 tree2, 4 tree3, 5 tree4 (the
                       // reference's --topology registry,
                       // broadcast.clj:169-178, node-index form)
+  int64_t kafka_txn;             // kafka: clients issue multi-mop
+                                 // send/poll transactions (the
+                                 // reference's :txn? op shape); the
+                                 // broker aborts ~8% with error 30 —
+                                 // definite fails whose sends must
+                                 // never surface. flag_txn_dirty_apply
+                                 // leaves an aborted txn's sends
+                                 // durable (aborted-read, caught)
   int64_t kafka_crash_clients;   // kafka: clients randomly "crash" —
                                  // drop their consumer positions and
                                  // resume from the broker's committed
@@ -136,6 +144,7 @@ enum MType : int32_t {
   M_ECHO = 70, M_ECHO_OK = 71,
   M_KSEND = 80, M_KSEND_OK = 81, M_KPOLL = 82, M_KPOLL_OK = 83,
   M_KCOMMIT = 84, M_KCOMMIT_OK = 85, M_KLIST = 86, M_KLIST_OK = 87,
+  M_KTXN = 88, M_KTXN_OK = 89,
   M_PNADD = 60, M_PNADD_OK = 61, M_PNREAD = 62, M_PNREAD_OK = 63,
   M_PNMERGE = 64,
   M_ERROR = 127
@@ -565,19 +574,11 @@ struct Sim {
         Msg r;
         r.valid = 1; r.src = me; r.origin = me; r.dest = m.src;
         r.type = M_KPOLL_OK; r.reply_to = m.msg_id;
-        int32_t n_tr = 0;
-        for (int32_t k = 0; k < cfg.n_keys; ++k) {
-          int32_t pos = k < int32_t(m.ext.size()) ? m.ext[k] : 0;
-          int32_t len = int32_t(nd.lists[k].size());
-          if (cfg.flag_gset_no_gossip && len > pos) ++pos;
-          for (int32_t i = 0; i < KPOLL_MAX && pos < len; ++i, ++pos) {
-            r.ext.push_back(k);
-            r.ext.push_back(pos);
-            r.ext.push_back(nd.lists[k][pos]);
-            ++n_tr;
-          }
-        }
-        r.body[0] = n_tr;
+        std::vector<int32_t> pos(cfg.n_keys, 0);
+        for (int32_t k = 0;
+             k < cfg.n_keys && k < int32_t(m.ext.size()); ++k)
+          pos[k] = m.ext[k];
+        r.body[0] = kpoll_scan(nd, pos, r.ext);
         send(in, t, std::move(r));
         break;
       }
@@ -587,6 +588,59 @@ struct Sim {
           nd.kcommitted[k] = std::max(nd.kcommitted[k], off);
         }
         node_reply(in, t, me, m, M_KCOMMIT_OK, 0, 0, 0);
+        break;
+      }
+      case M_KTXN: {
+        // request ext = positions[n_keys] then (op, k, v) mop triples.
+        // Atomic on the sequential broker; ~8% abort with error 30.
+        // The dirty-apply family bug applies sends BEFORE the abort
+        // roll, so an aborted txn's sends stay durable.
+        int32_t nk = int32_t(cfg.n_keys);
+        std::vector<int32_t> pos(m.ext.begin(),
+                                 m.ext.begin() + nk);
+        bool abort = in.rng.uniform() < 0.08;
+        Msg r;
+        r.valid = 1; r.src = me; r.origin = me; r.dest = m.src;
+        r.reply_to = m.msg_id;
+        int32_t n_mops = 0;
+        bool dirty = cfg.flag_txn_dirty_apply != 0;
+        if (abort && !dirty) {
+          r.type = M_ERROR;
+          r.body[0] = 30;   // txn-conflict: definite
+          send(in, t, std::move(r));
+          break;
+        }
+        for (size_t i = nk; i + 3 <= m.ext.size(); i += 3) {
+          int32_t op = m.ext[i];
+          int32_t k = std::min(std::max(m.ext[i + 1], 0), nk - 1);
+          if (op == 1) {   // send
+            nd.lists[k].push_back(m.ext[i + 2]);
+            r.ext.push_back(1);
+            r.ext.push_back(1);
+            r.ext.push_back(k);
+            r.ext.push_back(int32_t(nd.lists[k].size()) - 1);
+            r.ext.push_back(m.ext[i + 2]);
+          } else {         // poll over all keys from pos
+            size_t hdr = r.ext.size();
+            r.ext.push_back(2);
+            r.ext.push_back(0);
+            r.ext[hdr + 1] = kpoll_scan(nd, pos, r.ext);
+          }
+          ++n_mops;
+        }
+        if (abort) {   // dirty mode: sends already durable, then abort
+          Msg err;
+          err.valid = 1; err.src = me; err.origin = me;
+          err.dest = m.src;
+          err.reply_to = m.msg_id;
+          err.type = M_ERROR;
+          err.body[0] = 30;
+          send(in, t, std::move(err));
+          break;
+        }
+        r.type = M_KTXN_OK;
+        r.body[0] = n_mops;
+        send(in, t, std::move(r));
         break;
       }
       case M_KLIST: {
@@ -1025,6 +1079,27 @@ struct Sim {
     }
   }
 
+  // one poll scan for both the plain M_KPOLL handler and txn poll
+  // mops: emit up to KPOLL_MAX (k, offset, value) triples per key
+  // from ``pos`` (advanced in place), honoring the skip-one mutant
+  int32_t kpoll_scan(const Node& nd, std::vector<int32_t>& pos,
+                     std::vector<int32_t>& out) const {
+    int32_t n_tr = 0;
+    for (int32_t k = 0; k < int32_t(cfg.n_keys); ++k) {
+      int32_t p = pos[k];
+      int32_t len = int32_t(nd.lists[k].size());
+      if (cfg.flag_gset_no_gossip && len > p) ++p;
+      for (int32_t i = 0; i < KPOLL_MAX && p < len; ++i, ++p) {
+        out.push_back(k);
+        out.push_back(p);
+        out.push_back(nd.lists[k][p]);
+        ++n_tr;
+      }
+      pos[k] = p;
+    }
+    return n_tr;
+  }
+
   // kafka event rows (width 7). send: one row
   // [t, c, etype, 1, k, v, offset|NIL]. poll ok: header
   // [t, c, 2, 2, n_triples, 0, 0] + one (k, off, v) row per message.
@@ -1070,6 +1145,69 @@ struct Sim {
       p[1] = cl.f == 4 && k < int32_t(ok->ext.size())
                  ? ok->ext[k]
                  : cl.kpos[k] - 1;
+    }
+  }
+
+  // kafka txn rows: header [t, c, etype, 6, n_mops, 0, 0] then one
+  // block per mop — send ok [1, k, v, offset]; poll ok [2, n_triples]
+  // + one (k, off, v) row per message; invoke/fail/info mop rows are
+  // [op, k, v] (send) / [2] (poll).
+  void record_kafka_txn(Recorder& rec, int32_t t, int32_t c,
+                        int32_t etype, const Client& cl,
+                        const Msg* ok) const {
+    if (etype != EV_OK || !ok) {
+      // invoke (reassigned bit on the header lets a crash-resumed
+      // txn's first poll mop legally jump backward) and fail/info
+      // echoes share one row shape
+      int64_t need = 1 + cl.tlen;
+      if (!rec.out || rec.n + need > rec.cap) {
+        rec.n = rec.cap;
+        return;
+      }
+      rec.event(t, c, etype, 6, cl.tlen,
+                etype == EV_INVOKE ? cl.reassigned : 0, 0);
+      for (int32_t j = 0; j < cl.tlen; ++j) {
+        int32_t* p = rec.row();
+        p[0] = cl.tops[j][0];
+        p[1] = cl.tops[j][1];
+        p[2] = cl.tops[j][2];
+      }
+      return;
+    }
+    // rows needed: 1 header + per mop (1 send row, or 1 + n_tr poll)
+    int64_t need = 1;
+    {
+      size_t i = 0;
+      while (i + 1 < ok->ext.size()) {
+        int32_t op = ok->ext[i], n = ok->ext[i + 1];
+        i += 2;
+        if (op == 1) { need += 1; i += 3; }
+        else { need += 1 + n; i += size_t(n) * 3; }
+      }
+    }
+    if (!rec.out || rec.n + need > rec.cap) { rec.n = rec.cap; return; }
+    rec.event(t, c, EV_OK, 6, ok->body[0], 0, 0);
+    size_t i = 0;
+    while (i + 1 < ok->ext.size()) {
+      int32_t op = ok->ext[i], n = ok->ext[i + 1];
+      i += 2;
+      int32_t* p = rec.row();
+      if (op == 1) {
+        p[0] = 1;
+        p[1] = ok->ext[i];       // k
+        p[2] = ok->ext[i + 2];   // v
+        p[3] = ok->ext[i + 1];   // offset
+        i += 3;
+      } else {
+        p[0] = 2;
+        p[1] = n;
+        for (int32_t j = 0; j < n; ++j, i += 3) {
+          int32_t* q2 = rec.row();
+          q2[0] = ok->ext[i];
+          q2[1] = ok->ext[i + 1];
+          q2[2] = ok->ext[i + 2];
+        }
+      }
     }
   }
 
@@ -1251,6 +1389,29 @@ struct Sim {
           cl.kpos[k] = (k < int32_t(m.ext.size()) ? m.ext[k] : -1) + 1;
         cl.reassigned = 1;
       }
+      if (cfg.workload == 9 && m.type == M_KTXN_OK) {
+        // advance positions past every poll-mop result; the
+        // reassigned flag rides until a txn that actually POLLED
+        // completes (the checker applies it to the first poll mop)
+        size_t i = 0;
+        bool saw_poll = false;
+        while (i + 1 < m.ext.size()) {
+          int32_t op = m.ext[i], n = m.ext[i + 1];
+          i += 2;
+          if (op == 1) {
+            i += 3;
+          } else {
+            saw_poll = true;
+            for (int32_t j = 0; j < n && i + 3 <= m.ext.size();
+                 ++j, i += 3) {
+              int32_t k = m.ext[i];
+              if (k >= 0 && k < KPOS_MAX)
+                cl.kpos[k] = std::max(cl.kpos[k], m.ext[i + 1] + 1);
+            }
+          }
+        }
+        if (saw_poll) cl.reassigned = 0;
+      }
       if (cfg.workload == 9 && m.type == M_KPOLL_OK) {
         if (cl.f == 2) cl.reassigned = 0;   // the flag rides one poll
         // consume: advance this client's positions past everything
@@ -1265,6 +1426,9 @@ struct Sim {
         if (txn_mode())
           record_txn(*rec, t, c, etype, cl,
                      m.type == M_TXN_OK ? &m : nullptr);
+        else if (cfg.workload == 9 && cl.f == 6)
+          record_kafka_txn(*rec, t, c, etype, cl,
+                           etype == EV_OK ? &m : nullptr);
         else if (cfg.workload == 9)
           record_kafka(*rec, t, c, etype, cl,
                        etype == EV_OK ? &m : nullptr);
@@ -1287,6 +1451,8 @@ struct Sim {
         if (rec) {
           if (txn_mode())
             record_txn(*rec, t, c, etype, cl, nullptr);
+          else if (cfg.workload == 9 && cl.f == 6)
+            record_kafka_txn(*rec, t, c, etype, cl, nullptr);
           else if (cfg.workload == 9)
             record_kafka(*rec, t, c, etype, cl, nullptr);
           else
@@ -1301,6 +1467,8 @@ struct Sim {
           if (cfg.kafka_crash_clients && !final_phase &&
               in.rng.uniform() < 0.01) {
             cl.f = 5;   // crash: refetch committed offsets and resume
+          } else if (cfg.kafka_txn) {
+            cl.f = 6;   // multi-mop transaction
           } else {
             cl.f = final_phase ? 2
                    : rr < 0.45 ? 1 : rr < 0.8 ? 2 : rr < 0.93 ? 3 : 4;
@@ -1314,7 +1482,31 @@ struct Sim {
           q.origin = q.src;
           q.dest = 0;   // the broker
           q.msg_id = cl.msg_id;
-          if (cl.f == 1) {
+          if (cl.f == 6) {
+            // 1-3 mops, ~60% sends with unique values; final phase
+            // all-polls so the lost/aborted analysis gets coverage
+            cl.tlen = 1 + in.rng.below(3);
+            for (int32_t j = 0; j < cl.tlen; ++j) {
+              bool send_mop = !final_phase && in.rng.uniform() < 0.6;
+              cl.tops[j][0] = send_mop ? 1 : 2;
+              cl.tops[j][1] = send_mop
+                  ? in.rng.below(int32_t(cfg.n_keys)) : 0;
+              cl.tops[j][2] = send_mop
+                  ? 1 + (cl.next_msg_id * int32_t(cfg.n_clients) + c)
+                        * 3 + j
+                  : 0;
+            }
+            q.type = M_KTXN;
+            for (int32_t k = 0; k < cfg.n_keys; ++k)
+              q.ext.push_back(cl.kpos[k]);
+            for (int32_t j = 0; j < cl.tlen; ++j) {
+              q.ext.push_back(cl.tops[j][0]);
+              q.ext.push_back(cl.tops[j][1]);
+              q.ext.push_back(cl.tops[j][2]);
+            }
+            if (rec) record_kafka_txn(*rec, t, c, EV_INVOKE, cl,
+                                      nullptr);
+          } else if (cl.f == 1) {
             cl.k = in.rng.below(int32_t(cfg.n_keys));
             cl.a = 1 + cl.next_msg_id * int32_t(cfg.n_clients) + c;
             q.type = M_KSEND;
@@ -1499,7 +1691,7 @@ extern "C" {
 // flag_eager_commit, flag_no_term_guard, max_events, n_threads,
 // instance_base, workload, txn_max, list_cap, read_prob_micro,
 // flag_txn_dirty_apply, flag_gset_no_gossip, topology,
-// kafka_crash_clients  (36 fields)
+// kafka_crash_clients, kafka_txn  (37 fields)
 int64_t native_sim_run_sched(const int64_t* c, int64_t* stats_out,
                              int32_t* violations_out,
                              int32_t* events_out,
@@ -1548,6 +1740,7 @@ int64_t native_sim_run_sched(const int64_t* c, int64_t* stats_out,
   cfg.flag_gset_no_gossip = c[33];
   cfg.topology = c[34];
   cfg.kafka_crash_clients = c[35];
+  cfg.kafka_txn = c[36];
   if (cfg.workload < 0 || cfg.workload > 9) return -1;
   if (cfg.workload == 9 && cfg.n_keys > KPOS_MAX) return -1;
   if (cfg.topology < 0 || cfg.topology > 5) return -1;

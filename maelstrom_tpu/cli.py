@@ -225,6 +225,7 @@ def cmd_test(args) -> int:
             consistency_models=args.consistency_models,
             topology=args.topology,
             crash_clients=args.crash_clients,
+            txn=args.txn,
             node_count=node_count, concurrency=concurrency,
             rate=args.rate, time_limit=args.time_limit,
             latency=args.latency, p_loss=args.p_loss,

@@ -165,7 +165,7 @@ def _decode_kafka_history(ev: np.ndarray, ms_per_tick: float,
     reassembled from header + triple rows; commit_offsets ok =
     {key: off} from header + pair rows."""
     F = {1: "send", 2: "poll", 3: "commit_offsets",
-         4: "list_committed_offsets", 5: "crash"}
+         4: "list_committed_offsets", 5: "crash", 6: "txn"}
     hist: List[dict] = []
     i = 0
     while i < len(ev):
@@ -182,6 +182,36 @@ def _decode_kafka_history(ev: np.ndarray, ms_per_tick: float,
         if fname == "crash":
             value = None
             i += 1
+        elif fname == "txn":
+            n_mops = int(row[4])
+            if etype == EV_INVOKE:
+                reassigned = bool(int(row[5]))
+            mops: List[Any] = []
+            j = i + 1
+            if etype == EV_OK:
+                for _ in range(n_mops):
+                    r2 = ev[j]
+                    if int(r2[0]) == 1:
+                        mops.append(["send", int(r2[1]),
+                                     [int(r2[3]), int(r2[2])]])
+                        j += 1
+                    else:
+                        n_tr = int(r2[1])
+                        msgs: Dict[int, list] = {}
+                        for r3 in ev[j + 1:j + 1 + n_tr]:
+                            msgs.setdefault(int(r3[0]), []).append(
+                                [int(r3[1]), int(r3[2])])
+                        mops.append(["poll", msgs])
+                        j += 1 + n_tr
+            else:
+                for r2 in ev[i + 1:i + 1 + n_mops]:
+                    if int(r2[0]) == 1:
+                        mops.append(["send", int(r2[1]), int(r2[2])])
+                    else:
+                        mops.append(["poll", None])
+                j = i + 1 + n_mops
+            value = mops
+            i = j
         elif fname == "send":
             k, v, off = int(row[4]), int(row[5]), int(row[6])
             value = [k, v, off] if (etype == EV_OK) else [k, v]
@@ -377,7 +407,7 @@ def run_native_sim(opts: Optional[Dict[str, Any]] = None
         # twin of models/txn_raft.py)
         workload="lin-kv", txn_max=3, list_cap=16, read_prob=0.5,
         txn_dirty_apply=False, gset_no_gossip=False, topology="grid",
-        crash_clients=False,
+        crash_clients=False, txn=False,
         # instances are independent, so worker threads each own a
         # contiguous block end-to-end; per-instance trajectories are
         # identical at ANY thread count (RNG is a pure function of
@@ -442,7 +472,7 @@ def run_native_sim(opts: Optional[Dict[str, Any]] = None
         max_events = max(256, C * n_ticks * 4)
 
     threads = int(o["threads"]) or (os.cpu_count() or 1)
-    cfg = (ctypes.c_int64 * 36)(
+    cfg = (ctypes.c_int64 * 37)(
         int(o["seed"]), I, n_ticks, int(o["node_count"]), C, R,
         int(o["pool_slots"]), int(o["inbox_k"]),
         int(float(o["latency"]) / mpt * 1000),
@@ -464,7 +494,8 @@ def run_native_sim(opts: Optional[Dict[str, Any]] = None
         1 if o["txn_dirty_apply"] else 0,
         1 if o["gset_no_gossip"] else 0,
         _topologies[o["topology"]],
-        1 if o["crash_clients"] else 0)
+        1 if o["crash_clients"] else 0,
+        1 if o["txn"] else 0)
 
     stats = (ctypes.c_int64 * 5)()
     violations = np.zeros(I, dtype=np.int32)

@@ -523,3 +523,54 @@ def test_native_kafka_crash_clients_resume_from_committed():
     assert crashes >= 3, "crash injection never fired"
     assert stripped_caught >= 1, \
         "no crash produced an actual backward jump"
+
+
+def test_native_kafka_txn_atomic_and_mutant_caught():
+    # multi-mop send/poll transactions: atomic on the broker (~8%
+    # aborts, error 30, definite); clean runs pass the checker with
+    # real txn load. The dirty-apply family flag leaves an aborted
+    # txn's sends durable — aborted-read, caught.
+    from maelstrom_tpu.native import run_native_sim
+    from maelstrom_tpu.checkers.kafka import kafka_checker
+    raw = run_native_sim(_kafka_opts(time_limit=3.0, n_instances=64,
+                                     record_instances=8, txn=True))
+    txns = aborts = 0
+    for h in raw["histories"]:
+        assert kafka_checker(h)["valid?"] is True
+        txns += sum(1 for r in h if r["f"] == "txn"
+                    and r["type"] == "ok")
+        aborts += sum(1 for r in h if r["f"] == "txn"
+                      and r["type"] == "fail")
+    assert txns > 100, "no committed transactions"
+    assert aborts > 3, "the abort path never fired"
+    bad = run_native_sim(_kafka_opts(time_limit=3.0, n_instances=64,
+                                     record_instances=8, txn=True,
+                                     txn_dirty_apply=True))
+    anoms = set()
+    for h in bad["histories"]:
+        r = kafka_checker(h)
+        if r["valid?"] is False:
+            anoms |= set(r["anomalies"].keys())
+    assert "aborted-read" in anoms, anoms
+
+
+def test_native_kafka_txn_with_crash_clients_clean():
+    # the combo the reassigned-flag plumbing exists for: crashed txn
+    # clients reset to committed offsets (usually 0 — txn clients
+    # never commit) and their next polling txn legally jumps backward;
+    # the flag must ride the txn invoke or the checker would flag a
+    # correct broker as external-nonmonotonic
+    from maelstrom_tpu.native import run_native_sim
+    from maelstrom_tpu.checkers.kafka import kafka_checker
+    crashes = 0
+    for seed in (7, 11, 19):
+        raw = run_native_sim(_kafka_opts(time_limit=3.0,
+                                         n_instances=64,
+                                         record_instances=8, txn=True,
+                                         crash_clients=True,
+                                         seed=seed))
+        for h in raw["histories"]:
+            assert kafka_checker(h)["valid?"] is True, seed
+            crashes += sum(1 for r in h if r["f"] == "crash"
+                           and r["type"] == "invoke")
+    assert crashes >= 5, "crash injection never fired under txn mode"
