@@ -4,8 +4,9 @@
 // Role: the same simulated-cluster semantics as the JAX device runtime
 // (maelstrom_tpu/tpu/{netsim,runtime}.py + models/raft.py) — virtual
 // clock, per-instance mailbox pool with latency/loss/partitions,
-// fleets of Raft clusters driven by rate-limited clients, per-tick
-// invariants, recorded histories for the full checkers — implemented
+// every workload family from Raft consensus to gossip CRDTs to the
+// kafka log, rate-limited clients, per-tick invariants, recorded
+// histories for the full checkers — implemented
 // as straight scalar loops, which on a CPU beat masked tensor ops by
 // an order of magnitude (no masked lanes, no materialized
 // intermediates). This is the "native runtime component" counterpart

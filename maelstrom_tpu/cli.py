@@ -179,9 +179,9 @@ def cmd_test(args) -> int:
             log_net_recv=args.log_net_recv, seed=args.seed,
             store_root=args.store))
     elif args.runtime == "native":
-        # the C++ scalar engine (cpp/engine): lin-kv and
-        # txn-list-append Raft fleets on hosts without an accelerator —
-        # same checkers, same artifacts
+        # the C++ scalar engine (cpp/engine): the full workload table
+        # on hosts without an accelerator — same checkers, same
+        # artifacts
         from .native.engine import NATIVE_WORKLOADS
         if args.workload not in NATIVE_WORKLOADS:
             print("error: --runtime native implements "

@@ -10,8 +10,9 @@ library is unavailable, so the native path is an accelerator, never a
 requirement (the pattern of checkers/native.py).
 
 Histories come back in the exact dict shape the workload checkers
-consume, so a native run is checkable by the same WGL linearizability
-checker as a device run.
+consume, so a native run is judged by the same checker catalogue as a
+device run (WGL, Elle list-append + rw-register, set-full, interval,
+uniqueness, kafka anomalies).
 """
 
 from __future__ import annotations
