@@ -491,7 +491,8 @@ def _native_bench() -> bool:
             "violating_instances": res["violating-instances"],
             "recorded_checker_verdicts": verdicts,
             "funnel": funnel,
-            **({"families": families}
+            **({"families": families,
+                "host_spin_s": _host_spin_s()}
                if families and cfg_name == "k1" else {}),
             "events_truncated": bool(res.get("events-truncated")),
             "complete": True,
@@ -499,6 +500,20 @@ def _native_bench() -> bool:
         log(TAG, f"phase[native-{cfg_name}]: {value:,.0f} msgs/s, "
                  f"verdicts={verdicts}, funnel={funnel}")
     return ran_any
+
+
+def _host_spin_s() -> float:
+    """A fixed pure-Python integer loop, timed — a crude host-speed
+    calibration published on the metric line so round-over-round
+    msgs/s comparisons can be read against host state (this round's
+    host measurably throttled late in a long run: identical engine
+    binaries and bit-identical trajectories ran ~2.4x slower than the
+    r4 driver capture; see artifacts/native_98k_instances_r05.json)."""
+    t0 = time.monotonic()
+    x = 0
+    for i in range(20_000_000):
+        x += i
+    return round(time.monotonic() - t0, 3)
 
 
 def _native_replay_histories(opts, ids):
