@@ -387,6 +387,11 @@ def _native_bench() -> bool:
     # pn-counter/interval, kafka/log-anomalies) ride on the headline
     # line, so the artifact shows the engine posting the number spans
     # the checker families, not one workload
+    # host-speed calibration brackets the whole native phase: on a
+    # burstable host the state can change mid-bench, so the line
+    # carries both endpoints
+    spin_before = _host_spin_s()
+
     # the one base config every native run below derives from — the
     # headline regimes and the family runs must never drift apart
     base_opts = dict(node_count=3, concurrency=6, inbox_k=1,
@@ -491,9 +496,11 @@ def _native_bench() -> bool:
             "violating_instances": res["violating-instances"],
             "recorded_checker_verdicts": verdicts,
             "funnel": funnel,
-            **({"families": families,
-                "host_spin_s": _host_spin_s()}
-               if families and cfg_name == "k1" else {}),
+            **({"families": families} if families
+               and cfg_name == "k1" else {}),
+            **({"host_spin_s": {"before": spin_before,
+                                "after": _host_spin_s()}}
+               if cfg_name == "k1" else {}),
             "events_truncated": bool(res.get("events-truncated")),
             "complete": True,
         }), flush=True)
