@@ -1,0 +1,19 @@
+"""Static-analysis subsystem: the ``maelstrom lint`` passes.
+
+Three cooperating passes keep the TPU runtime's contracts machine-
+enforced (doc/lint.md has the rule catalog and workflow):
+
+- :mod:`.trace_lint` — AST trace-hygiene lint over the traced surfaces
+  (models, tick loop, delivery kernel): TRC1xx rules.
+- :mod:`.contract_audit` — ``jax.eval_shape`` audit of every registered
+  model's shape/dtype/lane contracts: CON2xx rules.
+- :mod:`.schema_lint` — RPC registry vs wire encodings vs demo nodes:
+  SCH3xx rules.
+
+Findings are :class:`~.findings.Finding` records; the checked-in
+``baseline.json`` holds the justified exceptions.
+"""
+
+from .findings import (Baseline, Finding, LintReport, SEV_ERROR,  # noqa
+                       SEV_INFO, SEV_WARNING, render_text)
+from .runner import ALL_PASSES, run_lint  # noqa

@@ -1,0 +1,322 @@
+"""Abstract-eval contract audit: ``jax.eval_shape`` over every model.
+
+The scan tick loop only works if each model's traced methods are *shape
+fixed points*: the carry pytree that leaves a tick must be structurally
+identical (treedef + shapes + dtypes) to the one that entered, and the
+lane constants a model declares (``body_lanes``, ``op_lanes``,
+``ev_vals``, ``max_out``, ``tick_out``) must match what its traced
+functions actually produce — a mismatch either fails late inside
+``lax.scan`` with an opaque error, or (dtype drift) silently triggers a
+recompile per tick. This pass traces every registered model abstractly
+(no FLOPs, no device) across its declared workload configurations and
+audits the contract up front, with ``file:line``-free but symbol-precise
+findings.
+
+Rules (CON2xx):
+
+=======  ======================  ========  =================================
+rule     name                    severity  what it checks
+=======  ======================  ========  =================================
+CON200   trace-failure           error     a traced method raised during
+                                           abstract evaluation
+CON201   carry-fixed-point       error     scan carry treedef/shape/dtype
+                                           is a fixed point of the tick
+CON202   emit-shape-contract     error     ``handle``/``tick`` return
+                                           ``(max_out|tick_out, lanes)``
+                                           int32 rows and preserve the row
+                                           pytree
+CON203   client-lane-contract    error     ``sample_op``/``encode_request``
+                                           /``decode_reply(_wide)`` match
+                                           ``op_lanes``/``lanes``/
+                                           ``ev_vals``; event tensor width
+                                           is ``2 + ev_vals``
+CON204   int32-counter-overflow  error     runtime counters (NETID stamp,
+                                           client op ids, delivery-priority
+                                           horizon, declared flake-id
+                                           splits) stay inside int32 within
+                                           the tick horizon
+=======  ======================  ========  =================================
+
+The tick horizon used by CON204 is ``TICK_HORIZON = 1 << 20``: the
+delivery priority in ``tpu/netsim.py`` ranks messages by
+``((1 << 20) - deliver_tick) * S``, so any simulation past 2^20 ticks
+would silently stop delivering — ``make_sim_config`` enforces the same
+bound at config time.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, List, Optional, Tuple
+
+from .findings import Finding, SEV_ERROR, SEV_WARNING
+
+PASS_NAME = "contract"
+
+# The hard tick ceiling implied by netsim's delivery priority encoding.
+TICK_HORIZON = 1 << 20
+
+INT32_MAX = 2**31 - 1
+
+# (workload, node_count) pairs audited by the repo-wide run; buggy
+# variants are appended dynamically from the model registries.
+AUDIT_WORKLOADS: List[Tuple[str, int]] = [
+    ("echo", 1), ("echo", 2),
+    ("unique-ids", 3),
+    ("broadcast", 5),
+    ("g-set", 5),
+    ("pn-counter", 3),
+    ("g-counter", 3),
+    ("lin-kv", 5),
+    ("txn-list-append", 3),
+    ("txn-rw-register", 3),
+    ("kafka", 1),
+]
+
+
+def _buggy_workloads() -> List[Tuple[str, int]]:
+    from ..models.raft_buggy import BUGGY_MODELS
+    from ..models.txn_raft import TXN_BUGGY_MODELS
+    from ..models.kafka import KAFKA_BUGGY_MODELS
+    out = [(f"lin-kv-bug-{k}", 5) for k in BUGGY_MODELS]
+    for k in TXN_BUGGY_MODELS:
+        if k.startswith("rw-"):
+            out.append((f"txn-rw-register-bug-{k[3:]}", 3))
+        else:
+            out.append((f"txn-list-append-bug-{k}", 3))
+    out.extend((f"kafka-bug-{k}", 1) for k in KAFKA_BUGGY_MODELS)
+    return out
+
+
+def _model_path(model) -> str:
+    mod = type(model).__module__
+    return mod.replace(".", os.sep) + ".py"
+
+
+def _leaf_sig(tree) -> List[Tuple[str, Tuple[int, ...], str]]:
+    """(path, shape, dtype) per leaf, with key paths for messages."""
+    import jax
+    out = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        keystr = jax.tree_util.keystr(path)
+        out.append((keystr, tuple(leaf.shape), str(leaf.dtype)))
+    return out
+
+
+def _tree_mismatches(a, b) -> List[str]:
+    """Human-readable structural differences between two abstract trees."""
+    import jax
+    ta = jax.tree_util.tree_structure(a)
+    tb = jax.tree_util.tree_structure(b)
+    if ta != tb:
+        return [f"pytree structure changed: {ta} -> {tb}"]
+    msgs = []
+    for (ka, sa, da), (kb, sb, db) in zip(_leaf_sig(a), _leaf_sig(b)):
+        if sa != sb:
+            msgs.append(f"leaf {ka or '<root>'} shape {sa} -> {sb}")
+        if da != db:
+            msgs.append(f"leaf {ka or '<root>'} dtype {da} -> {db}")
+    return msgs
+
+
+def _audit_opts(node_count: int) -> dict:
+    return dict(node_count=node_count, concurrency=2, time_limit=0.25,
+                rate=50.0, latency=5.0, n_instances=4,
+                record_instances=2, journal_instances=0, layout="lead")
+
+
+def audit_model(model, node_count: int, label: Optional[str] = None,
+                opts: Optional[dict] = None) -> List[Finding]:
+    """Audit ONE model instance; testable entry point."""
+    import jax
+    import jax.numpy as jnp
+    from ..tpu.harness import make_sim_config
+    from ..tpu.runtime import init_carry, make_tick_fn
+
+    label = label or getattr(model, "name", type(model).__name__)
+    path = _model_path(model)
+    cls = type(model).__name__
+    findings: List[Finding] = []
+
+    def flag(rule, name, message, severity=SEV_ERROR, symbol=cls):
+        findings.append(Finding(
+            rule=rule, name=name, severity=severity, pass_name=PASS_NAME,
+            path=path, line=0, symbol=symbol,
+            message=f"[{label}] {message}"))
+
+    sim = make_sim_config(model, opts or _audit_opts(node_count))
+    cfg = sim.net
+    try:
+        params = model.make_params(cfg.n_nodes)
+    except Exception as e:
+        flag("CON200", "trace-failure",
+             f"make_params({cfg.n_nodes}) raised: {e!r}")
+        return findings
+
+    # --- CON202/CON203: per-method probes ---------------------------------
+    def probe():
+        key = jax.random.PRNGKey(0)
+        row = model.init_row(cfg.n_nodes, jnp.int32(0), key, params)
+        msg = jnp.zeros((cfg.lanes,), jnp.int32)
+        row_h, outs = model.handle(row, jnp.int32(0), msg, jnp.int32(0),
+                                   key, cfg, params)
+        row_t, touts = model.tick(row, jnp.int32(0), jnp.int32(0), key,
+                                  cfg, params)
+        state = jax.tree_util.tree_map(
+            lambda a: jnp.broadcast_to(a, (cfg.n_nodes,) + a.shape), row)
+        inv = model.invariants(state, cfg, params)
+        op = model.sample_op(key, jnp.int32(0), cfg, params)
+        fop = model.sample_final_op(key, jnp.int32(0), cfg, params)
+        req = model.encode_request(op, jnp.int32(0), jnp.int32(0), key,
+                                   cfg, params)
+        if model.ev_vals == 4:
+            et, val = model.decode_reply(op, msg, cfg, params)
+        else:
+            et, val = model.decode_reply_wide(op, msg, cfg, params)
+        return dict(row=row, row_h=row_h, row_t=row_t, outs=outs,
+                    touts=touts, inv=inv, op=op, fop=fop, req=req,
+                    et=et, val=val)
+
+    shapes = None
+    try:
+        shapes = jax.eval_shape(probe)
+    except Exception as e:
+        flag("CON200", "trace-failure",
+             f"abstract evaluation of the model's traced methods "
+             f"raised {type(e).__name__}: {e}")
+
+    if shapes is not None:
+        outs, touts = shapes["outs"], shapes["touts"]
+        if tuple(outs.shape) != (model.max_out, cfg.lanes) \
+                or str(outs.dtype) != "int32":
+            flag("CON202", "emit-shape-contract", symbol=f"{cls}.handle",
+                 message=f"handle() emits {tuple(outs.shape)} "
+                         f"{outs.dtype}, declared (max_out={model.max_out}"
+                         f", lanes={cfg.lanes}) int32")
+        if tuple(touts.shape) != (model.tick_out, cfg.lanes) \
+                or str(touts.dtype) != "int32":
+            flag("CON202", "emit-shape-contract", symbol=f"{cls}.tick",
+                 message=f"tick() emits {tuple(touts.shape)} "
+                         f"{touts.dtype}, declared (tick_out="
+                         f"{model.tick_out}, lanes={cfg.lanes}) int32")
+        for which, after in (("handle", shapes["row_h"]),
+                             ("tick", shapes["row_t"])):
+            for m in _tree_mismatches(shapes["row"], after):
+                flag("CON202", "emit-shape-contract",
+                     symbol=f"{cls}.{which}",
+                     message=f"row pytree is not a fixed point of "
+                             f"{which}(): {m}")
+        inv = shapes["inv"]
+        if tuple(inv.shape) != () or str(inv.dtype) not in ("bool",):
+            flag("CON202", "emit-shape-contract",
+                 symbol=f"{cls}.invariants",
+                 message=f"invariants() returns {tuple(inv.shape)} "
+                         f"{inv.dtype}, expected scalar bool")
+        for which in ("op", "fop"):
+            o = shapes[which]
+            if tuple(o.shape) != (model.op_lanes,) \
+                    or str(o.dtype) != "int32":
+                flag("CON203", "client-lane-contract",
+                     symbol=f"{cls}.sample_op",
+                     message=f"{'sample_final_op' if which == 'fop' else 'sample_op'}"
+                             f"() returns {tuple(o.shape)} {o.dtype}, "
+                             f"declared op_lanes={model.op_lanes} int32")
+        req = shapes["req"]
+        if tuple(req.shape) != (cfg.lanes,) or str(req.dtype) != "int32":
+            flag("CON203", "client-lane-contract",
+                 symbol=f"{cls}.encode_request",
+                 message=f"encode_request() returns {tuple(req.shape)} "
+                         f"{req.dtype}, expected wire row "
+                         f"({cfg.lanes},) int32")
+        want_val = (3,) if model.ev_vals == 4 else (model.ev_vals,)
+        val = shapes["val"]
+        decoder = ("decode_reply" if model.ev_vals == 4
+                   else "decode_reply_wide")
+        if tuple(val.shape) != want_val:
+            flag("CON203", "client-lane-contract",
+                 symbol=f"{cls}.{decoder}",
+                 message=f"{decoder}() value lanes are "
+                         f"{tuple(val.shape)}, declared ev_vals="
+                         f"{model.ev_vals} implies {want_val}")
+
+    # --- CON201: full-tick carry fixed point ------------------------------
+    try:
+        carry0 = jax.eval_shape(lambda: init_carry(model, sim, 0, params))
+        tick_fn = make_tick_fn(model, sim, params)
+        carry1, ys = jax.eval_shape(
+            tick_fn, carry0, jax.ShapeDtypeStruct((), jnp.int32))
+        for m in _tree_mismatches(carry0, carry1):
+            flag("CON201", "carry-fixed-point",
+                 message=f"scan carry is not a fixed point of the tick: "
+                         f"{m}")
+        ev = ys.events
+        want_ev = (sim.record_instances, sim.client.n_clients, 2,
+                   2 + model.ev_vals)
+        if tuple(ev.shape) != want_ev:
+            flag("CON203", "client-lane-contract",
+                 message=f"per-tick event tensor is {tuple(ev.shape)}, "
+                         f"declared ev_vals={model.ev_vals} implies "
+                         f"{want_ev}")
+    except Exception as e:
+        flag("CON200", "trace-failure",
+             f"abstract evaluation of the full tick raised "
+             f"{type(e).__name__}: {e}")
+
+    # --- CON204: int32 counter bounds at the tick horizon -----------------
+    N, C, K = cfg.n_nodes, cfg.n_clients, cfg.inbox_k
+    fanout = N * (K * model.max_out + model.tick_out) + C
+    netid_max = TICK_HORIZON * fanout
+    if netid_max > INT32_MAX:
+        flag("CON204", "int32-counter-overflow",
+             message=f"NETID stamp t * fanout ({fanout}/tick) reaches "
+                     f"{netid_max} at the {TICK_HORIZON}-tick horizon "
+                     f"> int32 max — journal send/recv pairing breaks")
+    uniq_max = TICK_HORIZON * C + C
+    if uniq_max > INT32_MAX:
+        flag("CON204", "int32-counter-overflow",
+             message=f"client op counter `uniq` (next_msg_id * {C} "
+                     f"clients) reaches {uniq_max} at the horizon "
+                     f"> int32 max — minted values collide")
+    if sim.n_ticks > TICK_HORIZON:
+        flag("CON204", "int32-counter-overflow",
+             message=f"n_ticks={sim.n_ticks} exceeds the delivery-"
+                     f"priority horizon {TICK_HORIZON} — messages past "
+                     f"it rank negative and are never delivered")
+    # models that partition an int32 id space declare the split
+    bits = getattr(model, "flake_counter_bits", None)
+    if bits is not None:
+        per_node_max = TICK_HORIZON * K * model.max_out
+        if per_node_max > (1 << bits):
+            flag("CON204", "int32-counter-overflow",
+                 message=f"flake counter field is {bits} bits but a "
+                         f"node can handle {per_node_max} requests "
+                         f"within the {TICK_HORIZON}-tick horizon — "
+                         f"ids from different nodes collide past "
+                         f"2^{bits} ops")
+        if N << bits > INT32_MAX:
+            flag("CON204", "int32-counter-overflow",
+                 message=f"node_idx << {bits} overflows int32 at "
+                         f"node_count={N}")
+    return findings
+
+
+def run_contract_audit(repo_root: str = ".",
+                       workloads: Optional[List[Tuple[str, int]]] = None
+                       ) -> List[Finding]:
+    from ..models import get_model
+
+    specs = list(workloads) if workloads is not None else (
+        AUDIT_WORKLOADS + _buggy_workloads())
+    findings: List[Finding] = []
+    for workload, n in specs:
+        try:
+            model = get_model(workload, n, "grid")
+        except Exception as e:
+            findings.append(Finding(
+                rule="CON200", name="trace-failure", severity=SEV_ERROR,
+                pass_name=PASS_NAME, path="maelstrom_tpu/models/"
+                "__init__.py", line=0, symbol="get_model",
+                message=f"get_model({workload!r}, {n}) raised: {e!r}"))
+            continue
+        findings.extend(audit_model(model, n, label=f"{workload}/n={n}"))
+    return findings

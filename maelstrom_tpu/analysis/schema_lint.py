@@ -1,0 +1,434 @@
+"""Schema/wire conformance lint: registry vs wire encodings vs demo nodes.
+
+The ``rpc()`` registry (core/schema.py) is the single source of truth
+for message vocabularies — it drives validation, docs, and the TPU
+runtime's fixed-width encodings. This pass cross-checks the three places
+a vocabulary can drift apart:
+
+- the registry itself,
+- the TPU models' int32 lane encodings (``tpu/wire.py`` rows + each
+  model's ``T_*`` constants / ``WIRE_TYPES`` map),
+- the bundled demo nodes under ``examples/python/`` (via the demo
+  matrix in ``cli.DEMOS``).
+
+Rules (SCH3xx):
+
+=======  =====================  ========  ==================================
+rule     name                   severity  what it flags
+=======  =====================  ========  ==================================
+SCH301   response-type-drift    error /   a node emits ``<rpc>_ok`` that
+                                warning   does not match the registry's
+                                          declared response type (error), or
+                                          an ``*_ok`` type whose stem is
+                                          neither registered nor handled in
+                                          the same node (warning)
+SCH302   missing-handler        error     a demo-matrix node lacks a
+                                          handler for one of its workload's
+                                          registered request RPCs
+SCH303   optional-field-access  error     a handler subscripts a request
+                                          field the schema declares
+                                          ``Opt`` — crashes on valid input
+SCH304   unknown-error-code     error     an error code used in code is not
+                                          in the core/errors registry; or
+                                          the TPU runtime's definite-code
+                                          table drifted from the registry
+SCH305   no-wire-lane           error     a registered request RPC of a
+                                          TPU-modeled workload has no int32
+                                          wire TYPE (``WIRE_TYPES`` /
+                                          ``T_<NAME>`` convention), or its
+                                          required scalar fields exceed the
+                                          model's body lanes
+=======  =====================  ========  ==================================
+"""
+
+from __future__ import annotations
+
+import ast
+import glob
+import os
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .findings import Finding, SEV_ERROR, SEV_WARNING
+
+PASS_NAME = "schema"
+
+ENVELOPE_TYPES = {"init", "init_ok", "error"}
+
+# registered request RPCs that are only exercised behind a CLI flag:
+# (namespace, rpc) -> the opts key that turns them on
+GATED_RPCS = {("kafka", "txn"): "txn"}
+
+# workload namespace -> (model workload name, node_count) for the wire
+# coverage rule; namespaces absent here have no TPU model
+TPU_MODELED = {
+    "echo": ("echo", 1),
+    "unique-ids": ("unique-ids", 3),
+    "broadcast": ("broadcast", 5),
+    "g-set": ("g-set", 5),
+    "pn-counter": ("pn-counter", 3),
+    "g-counter": ("g-counter", 3),
+    "lin-kv": ("lin-kv", 5),
+    "txn-list-append": ("txn-list-append", 3),
+    "txn-rw-register": ("txn-rw-register", 3),
+    "kafka": ("kafka", 1),
+}
+
+
+def _finding(rule, name, severity, path, line, symbol, message):
+    return Finding(rule=rule, name=name, severity=severity,
+                   pass_name=PASS_NAME, path=path, line=line,
+                   symbol=symbol, message=message)
+
+
+# --- node-file scanning -----------------------------------------------------
+
+def _string_calls(tree: ast.AST, func_name: str, attr: str
+                  ) -> List[Tuple[str, int]]:
+    """(literal, lineno) for calls shaped ``<func_name>.<attr>("lit")``."""
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == attr and \
+                isinstance(node.func.value, ast.Name) and \
+                node.args and isinstance(node.args[0], ast.Constant) and \
+                isinstance(node.args[0].value, str):
+            out.append((node.args[0].value, node.lineno))
+    return out
+
+
+def _emitted_types(tree: ast.AST) -> List[Tuple[str, int]]:
+    """String values of ``"type"`` keys in dict literals."""
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Dict):
+            for k, v in zip(node.keys, node.values):
+                if isinstance(k, ast.Constant) and k.value == "type" \
+                        and isinstance(v, ast.Constant) \
+                        and isinstance(v.value, str):
+                    out.append((v.value, v.lineno))
+    return out
+
+
+def _loop_registered(tree: ast.AST) -> Set[str]:
+    """Handler names registered via ``for t in ("a", "b"): node.on(t, f)``."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.For) or \
+                not isinstance(node.target, ast.Name) or \
+                not isinstance(node.iter, (ast.Tuple, ast.List)):
+            continue
+        names = [e.value for e in node.iter.elts
+                 if isinstance(e, ast.Constant) and isinstance(e.value, str)]
+        if len(names) != len(node.iter.elts):
+            continue
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call) and \
+                    isinstance(sub.func, ast.Attribute) and \
+                    sub.func.attr == "on" and sub.args and \
+                    isinstance(sub.args[0], ast.Name) and \
+                    sub.args[0].id == node.target.id:
+                out.update(names)
+    return out
+
+
+def _has_dynamic_on(tree: ast.AST) -> bool:
+    """True when some ``node.on(expr, ...)`` registration could not be
+    resolved to string literals — SCH302 cannot prove a handler missing
+    then."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "on" and node.args and \
+                not isinstance(node.args[0], ast.Constant):
+            if isinstance(node.args[0], ast.Name):
+                continue    # loop-variable form: _loop_registered saw it
+            return True
+    return False
+
+
+def _handlers(tree: ast.AST) -> Dict[str, ast.FunctionDef]:
+    """rpc name -> handler FunctionDef for ``@node.on("x")`` decorators."""
+    out = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for dec in node.decorator_list:
+            if isinstance(dec, ast.Call) and \
+                    isinstance(dec.func, ast.Attribute) and \
+                    dec.func.attr == "on" and dec.args and \
+                    isinstance(dec.args[0], ast.Constant) and \
+                    isinstance(dec.args[0].value, str):
+                out[dec.args[0].value] = node
+    return out
+
+
+def _registry():
+    """The populated RPC registry (importing workloads registers all)."""
+    import maelstrom_tpu.workloads  # noqa: F401  (side effect: rpc())
+    from ..core.schema import REGISTRY
+    return REGISTRY
+
+
+def _opt_request_keys(rpcdef) -> Set[str]:
+    from ..core.schema import Opt
+    return {k.key for k in rpcdef.request if isinstance(k, Opt)}
+
+
+def scan_node_source(rel_path: str, src: str, workload: Optional[str],
+                     required_rpcs: Iterable[str],
+                     registry=None) -> List[Finding]:
+    """SCH301/302/303 over one demo node file (testable core).
+
+    ``workload``: the node's workload namespace (None = not in the demo
+    matrix; only the global SCH301 shape checks run then).
+    """
+    registry = registry if registry is not None else _registry()
+    findings: List[Finding] = []
+    try:
+        tree = ast.parse(src, filename=rel_path)
+    except SyntaxError as e:
+        return [_finding("SCH300", "syntax-error", SEV_ERROR, rel_path,
+                         e.lineno or 0, "", f"cannot parse: {e.msg}")]
+
+    handlers = _handlers(tree)
+    handled = set(handlers) | {n for n, _ in
+                               _string_calls(tree, "node", "on")}
+    handled |= _loop_registered(tree)
+    dynamic_registration = _has_dynamic_on(tree)
+    emitted = _emitted_types(tree)
+    all_request_names = {n for rpcs in registry.values() for n in rpcs}
+    all_response_types = {d.response_type for rpcs in registry.values()
+                          for d in rpcs.values()} | ENVELOPE_TYPES
+    ns_rpcs = registry.get(workload, {}) if workload else {}
+
+    # SCH302: every required request RPC has a handler (skipped when the
+    # node registers handlers through names we cannot resolve)
+    for name in required_rpcs:
+        if not dynamic_registration and name not in handled:
+            findings.append(_finding(
+                "SCH302", "missing-handler", SEV_ERROR, rel_path, 0,
+                os.path.basename(rel_path),
+                f"no handler for the {workload!r} workload's "
+                f"registered RPC {name!r} (expected node.on({name!r}))"))
+
+    # SCH301: emitted *_ok types
+    for t, line in emitted:
+        if not t.endswith("_ok") or t in ENVELOPE_TYPES:
+            continue
+        stem = t[: -len("_ok")]
+        if stem in ns_rpcs:
+            declared = ns_rpcs[stem].response_type
+            if t != declared:
+                findings.append(_finding(
+                    "SCH301", "response-type-drift", SEV_ERROR, rel_path,
+                    line, os.path.basename(rel_path),
+                    f"replies to {stem!r} with type {t!r} but the "
+                    f"registry declares {declared!r}"))
+            continue
+        if t in all_response_types:
+            continue
+        if stem in handled or stem in {e for e, _ in emitted}:
+            continue    # internal node-to-node protocol message
+        findings.append(_finding(
+            "SCH301", "response-type-drift", SEV_WARNING, rel_path, line,
+            os.path.basename(rel_path),
+            f"emits reply type {t!r} whose request {stem!r} is neither "
+            f"registered ({sorted(all_request_names)[:8]}...) nor "
+            f"handled in this node"))
+
+    # SCH303: handlers subscripting Opt request fields
+    for rpc_name, fn in handlers.items():
+        d = ns_rpcs.get(rpc_name)
+        if d is None:
+            continue
+        opt_keys = _opt_request_keys(d)
+        if not opt_keys:
+            continue
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.Subscript) and \
+                    isinstance(sub.slice, ast.Constant) and \
+                    sub.slice.value in opt_keys:
+                findings.append(_finding(
+                    "SCH303", "optional-field-access", SEV_ERROR,
+                    rel_path, sub.lineno, f"{rpc_name} handler",
+                    f"subscripts request field "
+                    f"{sub.slice.value!r} which the schema declares "
+                    f"optional — use .get(); a valid request without it "
+                    f"crashes the handler"))
+    return findings
+
+
+# --- error codes ------------------------------------------------------------
+
+def check_definite_codes() -> List[Finding]:
+    """SCH304a: tpu/runtime.py's definite-code table == error registry."""
+    from ..core.errors import _ERRORS
+    from ..tpu.runtime import _DEFINITE_CODES
+    registry_definite = tuple(sorted(e.code for e in _ERRORS if e.definite))
+    runtime_definite = tuple(sorted(_DEFINITE_CODES))
+    if registry_definite != runtime_definite:
+        return [_finding(
+            "SCH304", "unknown-error-code", SEV_ERROR,
+            "maelstrom_tpu/tpu/runtime.py", 0, "_DEFINITE_CODES",
+            f"TPU runtime definite-error table {runtime_definite} != "
+            f"core.errors registry {registry_definite} — fail/info "
+            f"verdicts drift between runtimes")]
+    return []
+
+
+def check_error_codes(sources: Dict[str, str],
+                      valid_codes: Optional[Set[int]] = None
+                      ) -> List[Finding]:
+    """SCH304b: literal error codes must exist in the registry
+    (codes >= 1000 are the documented user range)."""
+    if valid_codes is None:
+        from ..core.errors import ERRORS_BY_CODE
+        valid_codes = set(ERRORS_BY_CODE)
+    findings = []
+    for rel_path, src in sources.items():
+        try:
+            tree = ast.parse(src, filename=rel_path)
+        except SyntaxError:
+            continue    # trace/schema passes report parse errors already
+        for node in ast.walk(tree):
+            code = None
+            if isinstance(node, ast.Call):
+                f = node.func
+                name = f.id if isinstance(f, ast.Name) else (
+                    f.attr if isinstance(f, ast.Attribute) else "")
+                if name == "RPCError" and node.args and \
+                        isinstance(node.args[0], ast.Constant) and \
+                        isinstance(node.args[0].value, int):
+                    code = node.args[0].value
+            elif isinstance(node, ast.Dict):
+                for k, v in zip(node.keys, node.values):
+                    if isinstance(k, ast.Constant) and k.value == "code" \
+                            and isinstance(v, ast.Constant) \
+                            and isinstance(v.value, int):
+                        code = v.value
+            if code is not None and code not in valid_codes \
+                    and code < 1000:
+                findings.append(_finding(
+                    "SCH304", "unknown-error-code", SEV_ERROR, rel_path,
+                    node.lineno, "",
+                    f"error code {code} is not in the core/errors.py "
+                    f"registry (user codes start at 1000) — checkers "
+                    f"will misclassify its definiteness"))
+    return findings
+
+
+# --- wire coverage ----------------------------------------------------------
+
+def _scalar_required_fields(rpcdef) -> List[str]:
+    from ..core import schema as S
+    out = []
+    for k, v in rpcdef.request.items():
+        if k is Ellipsis or isinstance(k, S.Opt):
+            continue
+        if isinstance(v, (list, dict, S.MapOf)):
+            continue    # structured payloads have bespoke encodings
+        out.append(k)
+    return out
+
+
+def check_wire_coverage(registry=None) -> List[Finding]:
+    """SCH305: every registered request RPC of a TPU-modeled workload
+    resolves to a wire TYPE constant, and its required scalar fields fit
+    the model's body lanes."""
+    import importlib
+    from ..models import get_model
+
+    registry = registry if registry is not None else _registry()
+    findings: List[Finding] = []
+    for ns, (workload, n) in sorted(TPU_MODELED.items()):
+        if ns not in registry:
+            findings.append(_finding(
+                "SCH305", "no-wire-lane", SEV_ERROR,
+                "maelstrom_tpu/core/schema.py", 0, ns,
+                f"workload {ns!r} has a TPU model but no registered "
+                f"RPCs — docs and validation are blind to it"))
+            continue
+        model = get_model(workload, n, "grid")
+        mod = importlib.import_module(type(model).__module__)
+        path = type(model).__module__.replace(".", os.sep) + ".py"
+        wire_types = getattr(model, "WIRE_TYPES", None)
+        for name, d in registry[ns].items():
+            if wire_types is not None and name in wire_types:
+                continue    # explicit map (None = declared lane-free)
+            const = name.upper().replace("-", "_")
+            if hasattr(mod, f"T_{const}") or hasattr(mod, f"TYPE_{const}"):
+                continue
+            findings.append(_finding(
+                "SCH305", "no-wire-lane", SEV_ERROR, path, 0,
+                type(model).__name__,
+                f"registered RPC {ns}/{name} has no wire TYPE "
+                f"(expected T_{const}/TYPE_{const} or a WIRE_TYPES "
+                f"entry) — the device runtime cannot carry it"))
+        for name, d in registry[ns].items():
+            fields = _scalar_required_fields(d)
+            if len(fields) > model.body_lanes:
+                findings.append(_finding(
+                    "SCH305", "no-wire-lane", SEV_ERROR, path, 0,
+                    type(model).__name__,
+                    f"RPC {ns}/{name} needs {len(fields)} scalar "
+                    f"request lanes {fields} but the model declares "
+                    f"body_lanes={model.body_lanes}"))
+    return findings
+
+
+# --- orchestration ----------------------------------------------------------
+
+def _demo_python_nodes() -> List[Tuple[str, str, dict]]:
+    """(workload, node_file, opts) for the python demo-matrix entries."""
+    from ..cli import DEMOS
+    out = []
+    for entry in DEMOS:
+        workload, node, extra = entry[0], entry[1], entry[2]
+        if extra.get("runtime") == "native":
+            continue
+        node_file = node.split()[0]
+        out.append((workload, node_file, extra))
+    return out
+
+
+def run_schema_lint(repo_root: str = ".") -> List[Finding]:
+    registry = _registry()
+    findings: List[Finding] = []
+
+    # demo nodes: one scan per unique (file, workload); required RPCs
+    # are the union over the matrix entries that run that pairing
+    required: Dict[Tuple[str, str], Set[str]] = {}
+    for workload, node_file, extra in _demo_python_nodes():
+        key = (node_file, workload)
+        rpcs = required.setdefault(key, set())
+        for name in registry.get(workload, {}):
+            gate = GATED_RPCS.get((workload, name))
+            if gate is not None and not extra.get(gate):
+                continue
+            rpcs.add(name)
+    for (node_file, workload), rpcs in sorted(required.items()):
+        rel = os.path.join("examples", "python", node_file)
+        ap = os.path.join(repo_root, rel)
+        if not os.path.exists(ap):
+            findings.append(_finding(
+                "SCH302", "missing-handler", SEV_ERROR, rel, 0, node_file,
+                f"demo matrix references {node_file!r} for "
+                f"{workload!r} but the file does not exist"))
+            continue
+        with open(ap) as f:
+            src = f.read()
+        findings.extend(scan_node_source(rel, src, workload,
+                                         sorted(rpcs), registry))
+
+    # error codes: demo nodes + the whole package
+    sources = {}
+    for pat in ("examples/python/*.py", "maelstrom_tpu/**/*.py"):
+        for p in glob.glob(os.path.join(repo_root, pat), recursive=True):
+            rel = os.path.relpath(p, repo_root)
+            with open(p) as f:
+                sources[rel] = f.read()
+    findings.extend(check_error_codes(sources))
+    findings.extend(check_definite_codes())
+    findings.extend(check_wire_coverage(registry))
+    return findings

@@ -1,0 +1,615 @@
+"""Trace-hygiene lint: an AST pass over the traced surfaces.
+
+The TPU runtime traces every :class:`~..tpu.runtime.Model` method and
+the tick-loop helpers exactly once and replays the jitted graph for the
+whole simulation. Python-level control flow on traced values, host
+synchronizations, hidden mutable state, and bare-Python randomness all
+either crash at trace time, silently freeze a "random" choice into the
+graph, or force per-tick recompilation — the 100x-slowdown /
+wrong-verdict bug class this pass exists to catch *before* a device run.
+
+Mechanics: a file-local taint analysis. A function is **traced** when
+
+- it is a known Model traced method (``handle``, ``tick``, ...), or
+- one of its parameters has a conventional traced name (``row``,
+  ``msg``, ``t``, ``key``, ``carry``, ``pool``, ... or ``*_ref`` for
+  Pallas kernels), or
+- it is (transitively) called from a traced function — by-name fixpoint
+  over ``self.x(...)`` / bare-name calls across all scanned files, so
+  helpers like ``RaftModel._apply_one`` inherit tracedness, or
+- it is defined *inside* a traced function (scan/vmap bodies).
+
+Inside a traced function, parameters are tainted (except a static-name
+allowlist: ``self``, ``cfg``, ``n_nodes``, config objects), and taint
+propagates through expressions. Host-side methods (``invoke_record``,
+``checker``, the harness) never match and are skipped.
+
+Rules (TRC1xx):
+
+=======  ====================  ========  =====================================
+rule     name                  severity  what it flags
+=======  ====================  ========  =====================================
+TRC101   traced-branch         error     python ``if`` on a traced value
+TRC102   traced-while          error     python ``while`` on a traced value
+TRC103   traced-assert         error     ``assert`` on a traced value
+TRC104   host-sync             error     ``.item()`` / ``int()`` / ``float()``
+                                         / ``bool()`` / ``np.asarray`` on a
+                                         traced value inside a traced fn
+TRC105   mutable-capture       error     mutating a list/dict/set captured
+                                         from an enclosing scope (or module /
+                                         ``self`` state) inside a traced fn
+TRC106   data-dependent-shape  warning   ``jnp.nonzero`` / ``unique`` /
+                                         ``argwhere`` / 1-arg ``where`` —
+                                         value-dependent shapes break jit
+                                         and differ across replicas
+TRC107   bare-python-rng       error     ``random.*`` / ``np.random.*`` in a
+                                         traced fn (a ``jax.random`` key is
+                                         the only replay-stable source)
+=======  ====================  ========  =====================================
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from .findings import Finding, SEV_ERROR, SEV_WARNING
+
+PASS_NAME = "trace"
+
+# Model methods that the runtime traces (tpu/runtime.py contract).
+KNOWN_TRACED_METHODS = {
+    "init_row", "handle", "tick", "invariants", "sample_op",
+    "sample_final_op", "encode_request", "decode_reply",
+    "decode_reply_wide",
+}
+
+# Conventional traced-argument names: presence of one marks a
+# module-level function as traced (tick-loop helpers, netsim ops).
+TRACED_PARAM_NAMES = {
+    "row", "msg", "msgs", "t", "key", "keys", "carry", "pool",
+    "node_state", "client_state", "inbox", "inbox_nodes",
+    "inbox_clients", "op", "uniq", "msg_id", "client_idx", "node_idx",
+    "partitions", "instance_key", "row_body",
+}
+
+# Parameters that are static (python-level) even inside traced functions.
+STATIC_PARAM_NAMES = {
+    "self", "cls", "cfg", "ccfg", "nem", "sim", "model", "params",
+    "n_nodes", "node_count", "seed", "interpret", "length", "checker",
+    "opts", "mesh", "axes", "gossip_prob", "body_lanes",
+}
+
+# Attribute reads on tainted values that yield static metadata.
+_STATIC_ATTRS = {"shape", "dtype", "ndim", "size"}
+
+# Calls that launder taint into static python values (and are themselves
+# host syncs when applied to a traced value).
+_HOST_SYNC_BUILTINS = {"int", "float", "bool", "complex"}
+_HOST_SYNC_ATTRS = {"item", "tolist", "block_until_ready"}
+_NP_SYNC_FUNCS = {"asarray", "array", "copyto"}
+
+_DATA_DEP_FUNCS = {"nonzero", "flatnonzero", "argwhere", "unique",
+                   "unique_values"}
+
+_MUTATING_METHODS = {"append", "extend", "insert", "remove", "pop",
+                     "clear", "add", "discard", "update", "setdefault",
+                     "popitem"}
+
+_RNG_MODULE_NAMES = {"random"}          # stdlib `import random`
+_NP_NAMES = {"np", "numpy"}
+
+# Builtins whose results are static regardless of argument taint (len of
+# a traced array is its static shape; range/enumerate over statics).
+_STATIC_RESULT_BUILTINS = {"len", "range", "enumerate", "zip", "isinstance",
+                           "hasattr", "getattr", "type", "round", "repr",
+                           "str", "print", "min", "max", "abs", "sorted"}
+# note: min/max/abs on *tracers* would be host syncs via __bool__ only
+# for min/max with multiple tracer args; kept static to avoid false
+# positives on `min(python, python)` — TRC101 still catches the branchy
+# patterns that matter.
+
+
+def _func_params(fn: ast.AST) -> List[str]:
+    a = fn.args
+    names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return names
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for nested attribute chains rooted at a Name, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class _FileIndex(ast.NodeVisitor):
+    """First pass over one file: function defs, their called names, and
+    which functions look traced by themselves."""
+
+    def __init__(self):
+        self.functions: Dict[str, List[Tuple[str, ast.AST]]] = {}
+        # name -> list of (qualname, node); name collisions keep all
+        self.calls_from: Dict[str, Set[str]] = {}   # qualname -> callee names
+        self.self_traced: Set[str] = set()          # qualnames
+        self._stack: List[str] = []
+        self._class_stack: List[str] = []
+
+    def _qual(self, name: str) -> str:
+        return ".".join(self._class_stack + [name])
+
+    def visit_ClassDef(self, node: ast.ClassDef):
+        self._class_stack.append(node.name)
+        self.generic_visit(node)
+        self._class_stack.pop()
+
+    def _visit_fn(self, node):
+        qual = self._qual(node.name)
+        self.functions.setdefault(node.name, []).append((qual, node))
+        params = _func_params(node)
+        in_class = bool(self._class_stack)
+        if in_class:
+            # methods: only the runtime's known traced entry points (and
+            # the call-graph fixpoint) — param names like `t`/`row` also
+            # appear on host-side decoders (journal, history decoding)
+            if node.name in KNOWN_TRACED_METHODS:
+                self.self_traced.add(qual)
+        elif any(p in TRACED_PARAM_NAMES or p.endswith("_ref")
+                 for p in params if p not in STATIC_PARAM_NAMES):
+            self.self_traced.add(qual)
+        callees: Set[str] = set()
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                f = sub.func
+                if isinstance(f, ast.Name):
+                    callees.add(f.id)
+                elif isinstance(f, ast.Attribute) and \
+                        isinstance(f.value, ast.Name) and \
+                        f.value.id in ("self", "cls"):
+                    callees.add(f.attr)
+        self.calls_from[qual] = callees
+        # nested defs are deliberately NOT indexed as separate functions:
+        # the checker walks them inline with the parent's taint env
+
+    visit_FunctionDef = _visit_fn
+    visit_AsyncFunctionDef = _visit_fn
+
+
+def _traced_qualnames(indexes: Dict[str, _FileIndex]) -> Set[str]:
+    """Global fixpoint: traced roots + anything they call (by name)."""
+    traced_names: Set[str] = set()      # bare function/method names
+    traced_quals: Set[str] = set()
+    for idx in indexes.values():
+        for qual in idx.self_traced:
+            traced_quals.add(qual)
+            traced_names.add(qual.rsplit(".", 1)[-1])
+    changed = True
+    while changed:
+        changed = False
+        for idx in indexes.values():
+            for name, defs in idx.functions.items():
+                for qual, _node in defs:
+                    is_traced = (qual in traced_quals
+                                 or name in traced_names)
+                    if not is_traced:
+                        continue
+                    if qual not in traced_quals:
+                        traced_quals.add(qual)
+                        changed = True
+                    for callee in idx.calls_from.get(qual, ()):
+                        if callee in traced_names:
+                            continue
+                        # only propagate to names actually defined
+                        # somewhere in the scanned set
+                        if any(callee in i.functions
+                               for i in indexes.values()):
+                            traced_names.add(callee)
+                            changed = True
+    return traced_quals
+
+
+class _TraceChecker(ast.NodeVisitor):
+    """Taint-tracking walk of ONE traced function (incl. nested defs)."""
+
+    def __init__(self, path: str, symbol: str, module_mutables: Set[str],
+                 findings: List[Finding]):
+        self.path = path
+        self.symbol = symbol
+        self.module_mutables = module_mutables
+        self.findings = findings
+        self.tainted: Set[str] = set()
+        self.local_names: Set[str] = set()
+        self._flagged: Set[Tuple[str, int]] = set()
+
+    # --- reporting --------------------------------------------------------
+
+    def _flag(self, rule: str, name: str, severity: str, node: ast.AST,
+              message: str):
+        k = (rule, getattr(node, "lineno", 0))
+        if k in self._flagged:
+            return
+        self._flagged.add(k)
+        self.findings.append(Finding(
+            rule=rule, name=name, severity=severity, pass_name=PASS_NAME,
+            path=self.path, line=getattr(node, "lineno", 0),
+            symbol=self.symbol, message=message))
+
+    # --- taint evaluation -------------------------------------------------
+
+    def _is_tainted(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        if isinstance(node, ast.Attribute):
+            if node.attr in _STATIC_ATTRS:
+                return False
+            base = node.value
+            # self.x / cfg.x / module.CONST are static configuration
+            if isinstance(base, ast.Name) and base.id not in self.tainted:
+                return False
+            return self._is_tainted(base)
+        if isinstance(node, ast.Subscript):
+            return (self._is_tainted(node.value)
+                    or self._is_tainted(node.slice))
+        if isinstance(node, ast.Call):
+            return self._call_taint(node)
+        if isinstance(node, ast.BinOp):
+            return self._is_tainted(node.left) or self._is_tainted(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self._is_tainted(node.operand)
+        if isinstance(node, ast.BoolOp):
+            return any(self._is_tainted(v) for v in node.values)
+        if isinstance(node, ast.Compare):
+            # `x is None` / `x is not None` is a static python-level
+            # structure check, legitimate on optional traced args
+            if all(isinstance(op, (ast.Is, ast.IsNot))
+                   for op in node.ops):
+                return False
+            return (self._is_tainted(node.left)
+                    or any(self._is_tainted(c) for c in node.comparators))
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return any(self._is_tainted(e) for e in node.elts)
+        if isinstance(node, ast.Dict):
+            return any(self._is_tainted(v) for v in node.values
+                       if v is not None)
+        if isinstance(node, ast.IfExp):
+            return (self._is_tainted(node.test)
+                    or self._is_tainted(node.body)
+                    or self._is_tainted(node.orelse))
+        if isinstance(node, ast.Starred):
+            return self._is_tainted(node.value)
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp,
+                             ast.DictComp)):
+            return any(self._is_tainted(g.iter) for g in node.generators)
+        if isinstance(node, ast.Lambda):
+            return False
+        if isinstance(node, ast.JoinedStr):
+            return False
+        if isinstance(node, ast.Slice):
+            return any(self._is_tainted(p) for p in
+                       (node.lower, node.upper, node.step) if p is not None)
+        return False
+
+    def _call_taint(self, node: ast.Call) -> bool:
+        f = node.func
+        dotted = _dotted(f) or ""
+        root = dotted.split(".", 1)[0]
+        args_tainted = (any(self._is_tainted(a) for a in node.args)
+                        or any(self._is_tainted(kw.value)
+                               for kw in node.keywords))
+        if isinstance(f, ast.Name) and f.id in _STATIC_RESULT_BUILTINS:
+            return False
+        if isinstance(f, ast.Name) and f.id in _HOST_SYNC_BUILTINS:
+            return False        # flagged separately; result is host-static
+        if root in ("jnp", "jax"):
+            return True         # jax ops produce traced values
+        if isinstance(f, ast.Attribute) and f.attr in _STATIC_ATTRS:
+            return False
+        if isinstance(f, ast.Attribute) and self._is_tainted(f.value):
+            return True         # method on a traced value (.at[].set, ...)
+        return args_tainted
+
+    # --- binding ----------------------------------------------------------
+
+    def _bind(self, target: ast.AST, tainted: bool):
+        if isinstance(target, ast.Name):
+            self.local_names.add(target.id)
+            if tainted:
+                self.tainted.add(target.id)
+            else:
+                self.tainted.discard(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._bind(e, tainted)
+        elif isinstance(target, ast.Starred):
+            self._bind(target.value, tainted)
+        # subscript/attribute targets: handled by mutation rule
+
+    # --- statements -------------------------------------------------------
+
+    def check_function(self, fn: ast.AST, extra_static: Set[str] = frozenset()):
+        for p in _func_params(fn):
+            self.local_names.add(p)
+            if p not in STATIC_PARAM_NAMES and p not in extra_static:
+                self.tainted.add(p)
+        for stmt in fn.body:
+            self.visit(stmt)
+
+    def visit_Assign(self, node: ast.Assign):
+        t = self._is_tainted(node.value)
+        for target in node.targets:
+            if isinstance(target, (ast.Subscript, ast.Attribute)):
+                self._check_mutation_target(target, node)
+            self._bind(target, t)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign):
+        if node.value is not None:
+            self._bind(node.target, self._is_tainted(node.value))
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign):
+        t = self._is_tainted(node.value) or self._is_tainted(node.target)
+        if isinstance(node.target, (ast.Subscript, ast.Attribute)):
+            self._check_mutation_target(node.target, node)
+        self._bind(node.target, t)
+        self.generic_visit(node)
+
+    def visit_If(self, node: ast.If):
+        if self._is_tainted(node.test):
+            self._flag("TRC101", "traced-branch", SEV_ERROR, node,
+                       "python `if` on a traced value — use jnp.where / "
+                       "lax.cond; a tracer has no stable __bool__")
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While):
+        if self._is_tainted(node.test):
+            self._flag("TRC102", "traced-while", SEV_ERROR, node,
+                       "python `while` on a traced value — use "
+                       "lax.while_loop / lax.fori_loop")
+        self.generic_visit(node)
+
+    def visit_Assert(self, node: ast.Assert):
+        if self._is_tainted(node.test):
+            self._flag("TRC103", "traced-assert", SEV_ERROR, node,
+                       "assert on a traced value — crashes at trace time; "
+                       "use checkify or an invariants() lane")
+        self.generic_visit(node)
+
+    def visit_For(self, node: ast.For):
+        # iterating a traced array unrolls (legal); the target is traced.
+        # The iterable expression itself still gets the call rules
+        # (host-sync/RNG inside `for x in np.asarray(row)`).
+        self.visit(node.iter)
+        self._bind(node.target, self._is_tainted(node.iter))
+        for stmt in node.body + node.orelse:
+            self.visit(stmt)
+
+    def visit_Call(self, node: ast.Call):
+        f = node.func
+        dotted = _dotted(f) or ""
+        root = dotted.split(".", 1)[0]
+        args_tainted = (any(self._is_tainted(a) for a in node.args)
+                        or any(self._is_tainted(kw.value)
+                               for kw in node.keywords))
+
+        # TRC104: host syncs
+        if isinstance(f, ast.Name) and f.id in _HOST_SYNC_BUILTINS \
+                and args_tainted:
+            self._flag("TRC104", "host-sync", SEV_ERROR, node,
+                       f"`{f.id}()` on a traced value forces a host sync "
+                       f"(ConcretizationTypeError under jit)")
+        if isinstance(f, ast.Attribute) and f.attr in _HOST_SYNC_ATTRS \
+                and self._is_tainted(f.value):
+            self._flag("TRC104", "host-sync", SEV_ERROR, node,
+                       f"`.{f.attr}()` on a traced value forces a device "
+                       f"round-trip inside a traced function")
+        if root in _NP_NAMES and len(dotted.split(".")) == 2 \
+                and dotted.split(".")[1] in _NP_SYNC_FUNCS and args_tainted:
+            self._flag("TRC104", "host-sync", SEV_ERROR, node,
+                       f"`{dotted}()` on a traced value materializes on "
+                       f"host — use jnp inside traced code")
+
+        # TRC106: data-dependent output shapes
+        if root in {"jnp", "jax"} | _NP_NAMES:
+            leaf = dotted.rsplit(".", 1)[-1]
+            if leaf in _DATA_DEP_FUNCS:
+                self._flag("TRC106", "data-dependent-shape", SEV_WARNING,
+                           node,
+                           f"`{dotted}` has a value-dependent output "
+                           f"shape — fails under jit/vmap and is not "
+                           f"replica-deterministic; use fixed-size masks")
+            if leaf == "where" and len(node.args) == 1 and not node.keywords:
+                self._flag("TRC106", "data-dependent-shape", SEV_WARNING,
+                           node,
+                           "1-arg `where` returns value-dependent shapes "
+                           "— use the 3-arg select form")
+
+        # TRC107: bare python RNG
+        if root in _RNG_MODULE_NAMES and "." in dotted:
+            self._flag("TRC107", "bare-python-rng", SEV_ERROR, node,
+                       f"`{dotted}()` (python RNG) inside a traced "
+                       f"function freezes one sample into the compiled "
+                       f"graph — thread a jax.random key instead")
+        if root in _NP_NAMES and ".random." in "." + dotted + ".":
+            self._flag("TRC107", "bare-python-rng", SEV_ERROR, node,
+                       f"`{dotted}()` (numpy RNG) inside a traced "
+                       f"function freezes one sample into the compiled "
+                       f"graph — thread a jax.random key instead")
+
+        # TRC105: mutating a captured container
+        if isinstance(f, ast.Attribute) and f.attr in _MUTATING_METHODS:
+            self._check_mutation_target(f.value, node, method=f.attr)
+
+        self.generic_visit(node)
+
+    def _check_mutation_target(self, target: ast.AST, node: ast.AST,
+                               method: Optional[str] = None):
+        """Flag in-place mutation of state captured from outside the
+        traced function (enclosing scope, module globals, or self)."""
+        root = target
+        while isinstance(root, (ast.Subscript, ast.Attribute)):
+            root = root.value
+        if not isinstance(root, ast.Name):
+            return
+        name = root.id
+        is_self_state = (isinstance(target, ast.Attribute)
+                         and name in ("self", "cls"))
+        captured = (name not in self.local_names
+                    and (name in self.module_mutables
+                         or name in self.tainted))
+        if is_self_state or captured:
+            what = f".{method}()" if method else "assignment"
+            self._flag("TRC105", "mutable-capture", SEV_ERROR, node,
+                       f"in-place {what} on `{name}` captured from an "
+                       f"enclosing scope — traced functions must be "
+                       f"pure; mutation runs once at trace time, not "
+                       f"per tick")
+
+    def _visit_nested_fn(self, node):
+        # nested defs (scan/vmap bodies) share the enclosing taint env;
+        # their params are traced unless conventionally static
+        self.local_names.add(node.name)
+        saved = (set(self.tainted), set(self.local_names))
+        for p in _func_params(node):
+            self.local_names.add(p)
+            if p not in STATIC_PARAM_NAMES:
+                self.tainted.add(p)
+        for stmt in node.body:
+            self.visit(stmt)
+        self.tainted, self.local_names = saved
+
+    visit_FunctionDef = _visit_nested_fn
+    visit_AsyncFunctionDef = _visit_nested_fn
+
+    def visit_Lambda(self, node: ast.Lambda):
+        saved = (set(self.tainted), set(self.local_names))
+        for p in _func_params(node):
+            self.local_names.add(p)
+            if p not in STATIC_PARAM_NAMES:
+                self.tainted.add(p)
+        self.visit(node.body)
+        self.tainted, self.local_names = saved
+
+    def visit_ClassDef(self, node: ast.ClassDef):
+        pass    # class defs inside traced fns: out of scope
+
+    def visit_Import(self, node):
+        for a in node.names:
+            self.local_names.add(a.asname or a.name.split(".")[0])
+
+    def visit_ImportFrom(self, node):
+        for a in node.names:
+            self.local_names.add(a.asname or a.name)
+
+
+def _module_mutables(tree: ast.Module) -> Set[str]:
+    """Module-level names bound to mutable literals (lists/dicts/sets)."""
+    out: Set[str] = set()
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign) and isinstance(
+                stmt.value, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                             ast.DictComp, ast.SetComp)):
+            for t in stmt.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+    return out
+
+
+def default_trace_targets(repo_root: str) -> List[str]:
+    """The traced surfaces named by the lint contract: every model, the
+    tick-loop machinery, and the delivery kernel."""
+    import glob
+    pats = ["maelstrom_tpu/models/*.py", "maelstrom_tpu/tpu/*.py",
+            "maelstrom_tpu/ops/delivery.py"]
+    out = []
+    for p in pats:
+        out.extend(sorted(glob.glob(os.path.join(repo_root, p))))
+    return [p for p in out if os.path.basename(p) != "__init__.py"
+            or "models" not in p]
+
+
+def lint_sources(sources: Dict[str, str]) -> List[Finding]:
+    """Lint a {repo-relative-path: source} mapping (testable core)."""
+    findings: List[Finding] = []
+    indexes: Dict[str, _FileIndex] = {}
+    trees: Dict[str, ast.Module] = {}
+    for path, src in sources.items():
+        try:
+            tree = ast.parse(src, filename=path)
+        except SyntaxError as e:
+            findings.append(Finding(
+                rule="TRC100", name="syntax-error", severity=SEV_ERROR,
+                pass_name=PASS_NAME, path=path, line=e.lineno or 0,
+                symbol="", message=f"cannot parse: {e.msg}"))
+            continue
+        trees[path] = tree
+        idx = _FileIndex()
+        idx.visit(tree)
+        indexes[path] = idx
+
+    traced_quals = _traced_qualnames(indexes)
+
+    for path, idx in indexes.items():
+        mutables = _module_mutables(trees[path])
+        for name, defs in idx.functions.items():
+            for qual, node in defs:
+                if qual in traced_quals:
+                    checker = _TraceChecker(path, qual, mutables,
+                                            findings)
+                    checker.check_function(node)
+                    continue
+                # host-side factories (make_tick_fn & co.) wrap traced
+                # bodies in nested defs: check any nested def whose own
+                # params look traced, with a fresh environment
+                for sub in ast.walk(node):
+                    if sub is node or not isinstance(
+                            sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        continue
+                    if any(p in TRACED_PARAM_NAMES or p.endswith("_ref")
+                           for p in _func_params(sub)
+                           if p not in STATIC_PARAM_NAMES):
+                        checker = _TraceChecker(
+                            path, f"{qual}.{sub.name}", mutables,
+                            findings)
+                        checker.check_function(sub)
+    # nested-def scanning can visit a doubly-nested body twice — dedupe
+    # on (rule, location)
+    seen, out = set(), []
+    for f in findings:
+        k = (f.rule, f.path, f.line)
+        if k not in seen:
+            seen.add(k)
+            out.append(f)
+    return out
+
+
+def run_trace_lint(repo_root: str,
+                   paths: Optional[List[str]] = None) -> List[Finding]:
+    targets = paths if paths else default_trace_targets(repo_root)
+    sources = {}
+    findings: List[Finding] = []
+    for p in targets:
+        ap = p if os.path.isabs(p) else os.path.join(repo_root, p)
+        rel = os.path.relpath(ap, repo_root)
+        try:
+            with open(ap) as f:
+                sources[rel] = f.read()
+        except OSError as e:
+            # surface unreadable targets as findings and keep scanning
+            # the rest — one bad path must not hide real hazards
+            findings.append(Finding(
+                rule="TRC100", name="unreadable-file",
+                severity=SEV_ERROR, pass_name=PASS_NAME, path=rel,
+                line=0, symbol="", message=str(e)))
+    findings.extend(lint_sources(sources))
+    return findings

@@ -10,6 +10,8 @@ option specs :136-229):
 - ``check``  — re-run checkers offline on a stored history
 - ``export`` — emit Jepsen-compatible EDN histories for adjudication by
   stock Elle/Knossos outside this image
+- ``lint``   — the static-analysis gate: trace-hygiene, abstract-eval
+  contract, and schema/wire conformance passes (doc/lint.md)
 """
 
 from __future__ import annotations
@@ -658,6 +660,29 @@ def cmd_export(args) -> int:
     return 0
 
 
+def cmd_lint(args) -> int:
+    """Run the analysis passes; --strict turns error findings into a
+    nonzero exit (the pre-merge gate, tools/lint_gate.sh)."""
+    from .analysis import render_text, run_lint
+    from .analysis.findings import DEFAULT_BASELINE
+
+    # None = runner default (all passes; trace-only when paths restrict)
+    passes = tuple(args.passes) if args.passes else None
+    baseline = None if args.no_baseline else (args.baseline
+                                              or DEFAULT_BASELINE)
+    report = run_lint(repo_root=args.root,
+                      passes=passes,
+                      paths=args.paths or None,
+                      baseline_path=baseline)
+    if args.json:
+        print(json.dumps(report.to_json(), indent=2))
+    else:
+        print(render_text(report))
+    if args.strict and report.errors():
+        return 1
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="maelstrom_tpu",
@@ -711,11 +736,35 @@ def main(argv=None) -> int:
                                "the default single EDN vector "
                                "(history.edn shape)")
 
+    p_lint = sub.add_parser(
+        "lint", help="static analysis: trace-hygiene, contract, and "
+                     "schema/wire conformance passes (doc/lint.md)")
+    p_lint.add_argument("paths", nargs="*",
+                        help="restrict the trace-hygiene pass to these "
+                             "files (other passes then run only when "
+                             "named explicitly with --pass)")
+    p_lint.add_argument("--strict", action="store_true",
+                        help="exit 1 on any unsuppressed error-severity "
+                             "finding")
+    p_lint.add_argument("--json", action="store_true",
+                        help="machine-readable findings on stdout")
+    p_lint.add_argument("--pass", dest="passes", action="append",
+                        choices=["trace", "contract", "schema"],
+                        help="run only the named pass(es); default all")
+    p_lint.add_argument("--baseline", default=None,
+                        help="baseline file (default "
+                             "maelstrom_tpu/analysis/baseline.json)")
+    p_lint.add_argument("--no-baseline", action="store_true",
+                        help="ignore the baseline: report and gate on "
+                             "every finding, including expected fixtures")
+    p_lint.add_argument("--root", default=REPO,
+                        help="repo root to lint (default: this checkout)")
+
     args = parser.parse_args(argv)
     try:
         return {"test": cmd_test, "demo": cmd_demo, "serve": cmd_serve,
                 "doc": cmd_doc, "check": cmd_check,
-                "export": cmd_export}[args.command](args)
+                "export": cmd_export, "lint": cmd_lint}[args.command](args)
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2

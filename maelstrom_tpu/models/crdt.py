@@ -90,6 +90,8 @@ class GossipSetModel(Model):
     idempotent_fs = (F_READ,)
     add_f_name = "add"
     read_value_key = "value"
+    # schema-conformance map (SCH305): registry RPC name -> wire TYPE
+    WIRE_TYPES = {"add": T_ADD, "read": T_READ}
 
     def __init__(self, topology: str = "grid"):
         self.topology = topology
@@ -214,6 +216,9 @@ class BroadcastModel(GossipSetModel):
     """Broadcast-workload face of the gossip set (messages == elements)."""
     name = "broadcast"
     add_f_name = "broadcast"
+    # `topology` is config-only on-device: the adjacency matrix arrives
+    # via make_params, never on the wire (None = declared lane-free)
+    WIRE_TYPES = {"broadcast": T_ADD, "read": T_READ, "topology": None}
 
 
 class PNCounterModel(Model):
@@ -226,6 +231,7 @@ class PNCounterModel(Model):
     gossip_prob = 0.5
     idempotent_fs = (F_READ,)
     allow_negative = True
+    WIRE_TYPES = {"add": T_ADD, "read": T_READ}
 
     def __init__(self, n_nodes_hint: int = 5, topology: str = "total"):
         # body must carry the full counter table: 2 lanes per node

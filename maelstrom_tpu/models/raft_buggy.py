@@ -33,6 +33,8 @@ compiles to its own specialized graph with the bug baked in.
 
 from __future__ import annotations
 
+import jax.numpy as jnp
+
 from .raft import RaftModel
 
 
@@ -83,3 +85,42 @@ BUGGY_MODELS = {
     "short-log-wins": RaftShortLogWins,
     "eager-commit": RaftEagerCommit,
 }
+
+
+# --- trace-hygiene lint fixtures -------------------------------------------
+#
+# The mutants above are PROTOCOL bugs: shape-correct JAX that encodes a
+# wrong algorithm — the checkers' prey. The class below is the OTHER bug
+# family this corpus must cover: trace-hygiene violations that
+# `maelstrom lint` (analysis/trace_lint.py) exists to catch before a
+# device run. It is deliberately broken — python control flow on traced
+# values, host syncs, hidden mutable state, bare-python RNG — and would
+# crash (or silently freeze randomness into the graph) if ever traced.
+# It is therefore NOT in BUGGY_MODELS and must never be registered;
+# tests/test_analysis_lint.py asserts the linter flags every hazard, and
+# analysis/baseline.json carries the findings as status="expected"
+# (visible, never silently baselined).
+
+_GOSSIP_LOG = []    # module state a traced fn must not touch
+
+
+class RaftTracedHazards(RaftModel):
+    """LINT FIXTURE (do not register): every TRC-rule hazard in one tick."""
+    name = "lin-kv-lint-fixture-traced-hazards"
+
+    def tick(self, row, node_idx, t, key, cfg, params):
+        import random
+        if row.term > 0:                       # TRC101 traced-branch
+            row = row._replace(
+                term=row.term + int(row.commit_idx))   # TRC104 host sync
+        while row.log_len > 0:                 # TRC102 traced-while
+            break
+        assert row.commit_idx >= 0             # TRC103 traced-assert
+        _GOSSIP_LOG.append(t)                  # TRC105 mutable-capture
+        jitter = random.randint(0, 3)          # TRC107 bare-python-rng
+        hot = jnp.nonzero(row.match_idx)[0]    # TRC106 data-dep shape
+        del jitter, hot
+        return super().tick(row, node_idx, t, key, cfg, params)
+
+
+LINT_FIXTURE_MODELS = {"traced-hazards": RaftTracedHazards}

@@ -23,6 +23,13 @@ class UniqueIdsModel(Model):
     max_out = 1
     tick_out = 0
     idempotent_fs = ()
+    # declared id-space split audited by `maelstrom lint` (CON204): ids
+    # are node_idx << flake_counter_bits | counter, so uniqueness holds
+    # only while a node's counter stays below 2^20 — see the baselined
+    # justification in analysis/baseline.json
+    flake_counter_bits = 20
+    # schema-conformance map (SCH305): registry RPC name -> wire TYPE
+    WIRE_TYPES = {"generate": TYPE_GEN}
 
     def init_row(self, n_nodes, node_idx, key, params):
         return jnp.int32(0)     # per-node counter

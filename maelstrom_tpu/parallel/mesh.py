@@ -26,6 +26,20 @@ from ..tpu.runtime import Carry, Model, NetStats, SimConfig, simulate
 
 AXIS = "instances"
 
+
+def _shard_map(f, mesh, in_specs, out_specs):
+    """``jax.shard_map`` across jax versions: the public API (>= 0.6,
+    ``check_vma``) when present, else the experimental one (0.4.x,
+    ``check_rep``). Replication checking is off either way — the scan
+    carry mixes unvaried zero-init leaves with seed-varied ones (see
+    the callers' notes)."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map as _sm
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=False)
+
 # per-shard RNG decorrelation stride; device i simulates with seed
 # ``seed + i * SEED_STRIDE``. Exposed (with shard_seeds) so equivalence
 # oracles can replay individual shards unsharded.
@@ -74,11 +88,10 @@ def _run_sharded(model: Model, sim: SimConfig, mesh: Mesh, seeds, params):
     # zero-initialized carry components are unvaried constants while the
     # seed-derived ones vary per shard; check_vma would reject the scan
     # carry mix, and everything here is embarrassingly parallel anyway
-    return jax.shard_map(
+    return _shard_map(
         shard_body, mesh=mesh,
         in_specs=(P(*axes), P()),
         out_specs=(P(), P(axes), P(None, axes)),
-        check_vma=False,
     )(seeds, params)
 
 
@@ -176,9 +189,9 @@ def run_sim_sharded_chunked(model: Model, sim: SimConfig, seed: int,
         def body(seed_shard, params_rep):
             return _carry_to_wire(init_carry(
                 model, sim, seed_shard.reshape(()), params_rep), sim)
-        return jax.shard_map(
+        return _shard_map(
             body, mesh=mesh, in_specs=(P(*axes), P()),
-            out_specs=wire_spec, check_vma=False)(seeds, params)
+            out_specs=wire_spec)(seeds, params)
 
     @partial(jax.jit, static_argnames=("length",), donate_argnums=0)
     def chunk_fn(wire, t0, params, length):
@@ -189,11 +202,10 @@ def run_sim_sharded_chunked(model: Model, sim: SimConfig, seed: int,
                 tick, carry,
                 t0_rep.reshape(()) + jnp.arange(length, dtype=jnp.int32))
             return _carry_to_wire(carry, sim), ys.events
-        return jax.shard_map(
+        return _shard_map(
             body, mesh=mesh,
             in_specs=(wire_spec, P(), P()),
-            out_specs=(wire_spec, P(None, axes)),
-            check_vma=False)(wire, t0, params)
+            out_specs=(wire_spec, P(None, axes)))(wire, t0, params)
 
     wire = init_fn(seeds, params)
     events_chunks = []

@@ -76,6 +76,15 @@ def make_sim_config(model: Model, opts: Dict[str, Any]) -> SimConfig:
     o = {**TPU_DEFAULTS, **opts}
     mpt = o["ms_per_tick"]
     n_ticks = int(o["time_limit"] * 1000 / mpt)
+    # netsim's delivery priority encodes the deadline as
+    # ((1 << 20) - deliver_tick) * S: past 2^20 ticks priorities go
+    # negative and messages silently stop being delivered. Refuse the
+    # config instead (raise ms_per_tick to coarsen the clock).
+    if n_ticks >= (1 << 20):
+        raise ValueError(
+            f"time_limit {o['time_limit']}s at {mpt} ms/tick needs "
+            f"{n_ticks} ticks, past the 2^20-tick delivery horizon "
+            f"(netsim age_rank encoding); raise --ms-per-tick")
     net = NetConfig(
         n_nodes=o["node_count"],
         n_clients=o["concurrency"],

@@ -1,0 +1,548 @@
+"""Tests for the `maelstrom lint` static-analysis subsystem.
+
+Coverage contract (ISSUE acceptance): each of the three passes has at
+least 3 distinct rules exercised with positive AND negative fixtures;
+the intentional-bug fixture in models/raft_buggy.py is asserted to be
+flagged (as status="expected" baseline entries, never silently
+accepted); and the repo-wide run is clean modulo the checked-in
+baseline.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from conftest import REPO
+
+from maelstrom_tpu.analysis.findings import (Baseline, BaselineEntry,
+                                             Finding, LintReport,
+                                             render_text)
+from maelstrom_tpu.analysis.trace_lint import lint_sources
+
+
+def _trace(src, path="fixture.py"):
+    return lint_sources({path: textwrap.dedent(src)})
+
+
+def _rules(findings):
+    return {f.rule for f in findings}
+
+
+# --- trace-hygiene rules (TRC1xx) ------------------------------------------
+
+class TestTraceRules:
+    def test_traced_branch_flagged(self):
+        fs = _trace("""
+            class M:
+                def handle(self, row, node_idx, msg, t, key, cfg, params):
+                    if row > 0:
+                        row = row + 1
+                    return row, None
+        """)
+        assert _rules(fs) == {"TRC101"}
+
+    def test_static_branch_not_flagged(self):
+        fs = _trace("""
+            class M:
+                vote_check = True
+                def handle(self, row, node_idx, msg, t, key, cfg, params):
+                    if self.vote_check:
+                        row = row + 1
+                    if cfg.n_nodes > 2:
+                        row = row - 1
+                    if params is None:
+                        row = row * 2
+                    return row, None
+        """)
+        assert fs == []
+
+    def test_traced_while_and_assert(self):
+        fs = _trace("""
+            class M:
+                def tick(self, row, node_idx, t, key, cfg, params):
+                    while row > 0:
+                        break
+                    assert t >= 0
+                    return row, None
+        """)
+        assert _rules(fs) == {"TRC102", "TRC103"}
+
+    def test_static_loop_not_flagged(self):
+        fs = _trace("""
+            class M:
+                apply_max = 2
+                def tick(self, row, node_idx, t, key, cfg, params):
+                    outs = []
+                    for _ in range(self.apply_max):
+                        outs.append(row)
+                    assert cfg.n_nodes > 0
+                    return row, outs
+        """)
+        assert fs == []
+
+    def test_host_sync_flagged(self):
+        fs = _trace("""
+            import numpy as np
+            class M:
+                def handle(self, row, node_idx, msg, t, key, cfg, params):
+                    a = int(msg)
+                    b = row.item()
+                    c = np.asarray(row)
+                    return row, (a, b, c)
+        """)
+        assert [f.rule for f in fs] == ["TRC104"] * 3
+
+    def test_host_sync_on_static_not_flagged(self):
+        fs = _trace("""
+            import numpy as np
+            class M:
+                def handle(self, row, node_idx, msg, t, key, cfg, params):
+                    n = int(cfg.latency_mean)
+                    tbl = np.asarray([1, 2, 3])
+                    return row, (n, tbl)
+        """)
+        assert fs == []
+
+    def test_mutable_capture_flagged(self):
+        fs = _trace("""
+            CACHE = []
+            class M:
+                def tick(self, row, node_idx, t, key, cfg, params):
+                    CACHE.append(t)
+                    self.seen = {}
+                    return row, None
+        """)
+        assert _rules(fs) == {"TRC105"}
+        assert len(fs) == 2
+
+    def test_local_mutation_not_flagged(self):
+        fs = _trace("""
+            class M:
+                def tick(self, row, node_idx, t, key, cfg, params):
+                    outs = []
+                    outs.append(row)
+                    return row, outs
+        """)
+        assert fs == []
+
+    def test_data_dependent_shape_warns(self):
+        fs = _trace("""
+            import jax.numpy as jnp
+            class M:
+                def invariants(self, node_state, cfg, params):
+                    bad = jnp.nonzero(node_state)
+                    alt = jnp.where(node_state > 0)
+                    return bad, alt
+        """)
+        assert [f.rule for f in fs] == ["TRC106"] * 2
+        assert all(f.severity == "warning" for f in fs)
+
+    def test_three_arg_where_not_flagged(self):
+        fs = _trace("""
+            import jax.numpy as jnp
+            class M:
+                def invariants(self, node_state, cfg, params):
+                    return jnp.where(node_state > 0, 1, 0)
+        """)
+        assert fs == []
+
+    def test_bare_python_rng_flagged(self):
+        fs = _trace("""
+            import random
+            import numpy as np
+            class M:
+                def sample_op(self, key, uniq, cfg, params):
+                    a = random.random()
+                    b = np.random.randint(3)
+                    return a, b
+        """)
+        assert [f.rule for f in fs] == ["TRC107"] * 2
+
+    def test_jax_random_not_flagged(self):
+        fs = _trace("""
+            import jax
+            class M:
+                def sample_op(self, key, uniq, cfg, params):
+                    return jax.random.randint(key, (), 0, 5)
+        """)
+        assert fs == []
+
+    def test_helper_reached_via_fixpoint(self):
+        """A `_`-helper called from handle() inherits tracedness; a
+        host-side decoder with the same shape does not."""
+        fs = _trace("""
+            class M:
+                def handle(self, row, node_idx, msg, t, key, cfg, params):
+                    return self._bump(row), None
+                def _bump(self, value):
+                    if value > 0:
+                        return value + 1
+                    return value
+                def complete_record(self, f, a, b, c, etype):
+                    if f == 1:
+                        return {"v": int(a)}
+                    return None
+        """)
+        assert [(f.rule, f.symbol) for f in fs] == [("TRC101", "M._bump")]
+
+    def test_for_iterable_expression_checked(self):
+        """Hazards inside the `for` iterator itself are not a blind spot."""
+        fs = _trace("""
+            import numpy as np
+            class M:
+                def tick(self, row, node_idx, t, key, cfg, params):
+                    for x in np.asarray(row):
+                        pass
+                    return row, None
+        """)
+        assert _rules(fs) == {"TRC104"}
+
+    def test_nested_scan_body_checked(self):
+        """Bodies nested in host-side factories (make_tick_fn style)."""
+        fs = _trace("""
+            def make_tick_fn(model, sim, params):
+                def tick_fn(carry, t):
+                    if t > 0:
+                        carry = carry
+                    return carry, None
+                return tick_fn
+        """)
+        assert _rules(fs) == {"TRC101"}
+
+
+# --- abstract-eval contract rules (CON2xx) ---------------------------------
+
+@pytest.fixture(scope="module")
+def echo_base():
+    from maelstrom_tpu.models.echo import EchoModel
+    return EchoModel
+
+
+def _audit(model, n=1):
+    from maelstrom_tpu.analysis.contract_audit import audit_model
+    return audit_model(model, n)
+
+
+class TestContractRules:
+    def test_clean_model_passes(self, echo_base):
+        assert _audit(echo_base()) == []
+
+    def test_emit_shape_contract_max_out(self, echo_base):
+        import jax.numpy as jnp
+
+        class TooManyOuts(echo_base):
+            def handle(self, row, node_idx, msg, t, key, cfg, params):
+                row, out = super().handle(row, node_idx, msg, t, key,
+                                          cfg, params)
+                return row, jnp.concatenate([out, out], axis=0)
+
+        fs = _audit(TooManyOuts())
+        assert "CON202" in _rules(fs)
+        assert any("max_out" in f.message for f in fs)
+
+    def test_carry_fixed_point_dtype_drift(self, echo_base):
+        import jax.numpy as jnp
+
+        class DtypeDrift(echo_base):
+            def tick(self, row, node_idx, t, key, cfg, params):
+                _, outs = super().tick(row, node_idx, t, key, cfg,
+                                       params)
+                return row.astype(jnp.float32), outs
+
+        fs = _audit(DtypeDrift())
+        rules = _rules(fs)
+        assert "CON202" in rules          # row not a fixed point of tick
+        assert "CON201" in rules          # ...so the scan carry drifts
+        assert any("int32 -> float32" in f.message for f in fs)
+
+    def test_client_lane_contract_op_lanes(self, echo_base):
+        import jax.numpy as jnp
+
+        class WrongOpLanes(echo_base):
+            def sample_op(self, key, uniq, cfg, params):
+                return jnp.zeros((7,), jnp.int32)   # declares op_lanes=4
+
+        fs = _audit(WrongOpLanes())
+        assert "CON203" in _rules(fs)
+        # the full tick also fails to trace (client_step broadcasts the
+        # op row against the declared width) — surfaced as a trace
+        # failure, not silence
+        assert "CON200" in _rules(fs)
+
+    def test_client_lane_contract_decode_width(self, echo_base):
+        import jax.numpy as jnp
+
+        class ShortDecode(echo_base):
+            def decode_reply(self, op, msg, cfg, params):
+                et, _ = super().decode_reply(op, msg, cfg, params)
+                return et, jnp.zeros((2,), jnp.int32)   # needs (3,)
+
+        fs = _audit(ShortDecode())
+        assert "CON203" in _rules(fs)
+        assert any("decode_reply" in f.symbol for f in fs)
+
+    def test_int32_overflow_flake_bits(self, echo_base):
+        class TinyFlake(echo_base):
+            flake_counter_bits = 10
+
+        fs = _audit(TinyFlake())
+        assert "CON204" in _rules(fs)
+        assert any("collide" in f.message for f in fs)
+
+    def test_trace_failure_surfaces(self, echo_base):
+        class Crashes(echo_base):
+            def handle(self, row, node_idx, msg, t, key, cfg, params):
+                raise RuntimeError("boom")
+
+        fs = _audit(Crashes())
+        assert "CON200" in _rules(fs)
+
+
+# --- schema/wire conformance rules (SCH3xx) --------------------------------
+
+class TestSchemaRules:
+    def _scan(self, src, workload="echo", required=("echo",)):
+        from maelstrom_tpu.analysis.schema_lint import scan_node_source
+        return scan_node_source("examples/python/fixture.py",
+                                textwrap.dedent(src), workload,
+                                list(required))
+
+    def test_missing_handler_flagged(self):
+        fs = self._scan("""
+            from node import Node
+            node = Node()
+        """)
+        assert _rules(fs) == {"SCH302"}
+
+    def test_handler_present_not_flagged(self):
+        fs = self._scan("""
+            from node import Node
+            node = Node()
+            @node.on("echo")
+            def echo(msg):
+                node.reply(msg, {"type": "echo_ok",
+                                 "echo": msg["body"]["echo"]})
+        """)
+        assert fs == []
+
+    def test_loop_registration_resolved(self):
+        fs = self._scan("""
+            from node import Node
+            node = Node()
+            def client_op(msg): pass
+            for t in ("read", "write", "cas"):
+                node.on(t, client_op)
+        """, workload="lin-kv", required=("read", "write", "cas"))
+        assert fs == []
+
+    def test_response_type_drift_flagged(self):
+        fs = self._scan("""
+            from node import Node
+            node = Node()
+            @node.on("echo")
+            def echo(msg):
+                node.reply(msg, {"type": "echo_okay_ok"})
+        """)
+        assert "SCH301" in _rules(fs)
+
+    def test_internal_protocol_ok_not_flagged(self):
+        fs = self._scan("""
+            from node import Node
+            node = Node()
+            @node.on("echo")
+            def echo(msg):
+                node.reply(msg, {"type": "echo_ok"})
+            @node.on("gossip")
+            def gossip(msg):
+                node.reply(msg, {"type": "gossip_ok"})
+        """)
+        assert fs == []
+
+    def test_optional_field_subscript_flagged(self):
+        fs = self._scan("""
+            from node import Node
+            node = Node()
+            @node.on("poll")
+            def poll(msg):
+                offs = msg["body"]["offsets"]
+                node.reply(msg, {"type": "poll_ok", "msgs": {}})
+        """, workload="kafka", required=("poll",))
+        assert "SCH303" in _rules(fs)
+
+    def test_optional_field_get_not_flagged(self):
+        fs = self._scan("""
+            from node import Node
+            node = Node()
+            @node.on("poll")
+            def poll(msg):
+                offs = msg["body"].get("offsets") or {}
+                node.reply(msg, {"type": "poll_ok", "msgs": {}})
+        """, workload="kafka", required=("poll",))
+        assert fs == []
+
+    def test_unknown_error_code_flagged(self):
+        from maelstrom_tpu.analysis.schema_lint import check_error_codes
+        fs = check_error_codes({"examples/python/x.py": textwrap.dedent("""
+            from node import RPCError
+            def f(node, msg):
+                node.reply_error(msg, RPCError(99, "nope"))
+                node.reply(msg, {"type": "error", "code": 1001})
+                node.reply_error(msg, RPCError(22, "fine"))
+        """)})
+        assert [f.rule for f in fs] == ["SCH304"]
+        assert "99" in fs[0].message
+
+    def test_definite_codes_conform(self):
+        from maelstrom_tpu.analysis.schema_lint import check_definite_codes
+        assert check_definite_codes() == []
+
+    def test_wire_coverage_clean_on_repo(self):
+        from maelstrom_tpu.analysis.schema_lint import check_wire_coverage
+        assert check_wire_coverage() == []
+
+    def test_wire_coverage_missing_type_flagged(self):
+        from maelstrom_tpu.analysis.schema_lint import check_wire_coverage
+        from maelstrom_tpu.core.schema import rpc, REGISTRY
+        rpc("unique-ids", "reserve_lint_probe",
+            "synthetic RPC with no wire lane (test only)",
+            request={}, response={})
+        try:
+            fs = check_wire_coverage()
+            assert "SCH305" in _rules(fs)
+            assert any("reserve_lint_probe" in f.message for f in fs)
+        finally:
+            del REGISTRY["unique-ids"]["reserve_lint_probe"]
+
+
+# --- baseline / findings plumbing ------------------------------------------
+
+class TestBaseline:
+    def _finding(self, rule="TRC101", path="a.py", symbol="M.tick"):
+        return Finding(rule=rule, name="traced-branch", severity="error",
+                       pass_name="trace", path=path, line=3,
+                       symbol=symbol, message="m")
+
+    def test_fingerprint_is_line_free(self):
+        a, b = self._finding(), self._finding()
+        b.line = 99
+        assert a.fingerprint == b.fingerprint
+
+    def test_match_and_stale(self):
+        f = self._finding()
+        bl = Baseline([BaselineEntry(f.fingerprint, "why", "accepted"),
+                       BaselineEntry("TRC999:gone.py:X", "old", "accepted")])
+        assert bl.match(f) is not None
+        stale = bl.stale_entries()
+        assert [e.fingerprint for e in stale] == ["TRC999:gone.py:X"]
+
+    def test_render_text_mentions_stale(self):
+        rep = LintReport(findings=[self._finding()],
+                         stale=[BaselineEntry("TRC9:x:y", "r")],
+                         files_scanned=1, passes_run=("trace",))
+        text = render_text(rep, color=False)
+        assert "STALE" in text and "TRC101" in text
+        assert "1 error(s)" in text
+
+
+# --- the raft_buggy intentional fixture ------------------------------------
+
+class TestBuggyFixture:
+    def test_linter_flags_the_fixture(self):
+        """models/raft_buggy.py must trip every TRC rule family."""
+        from maelstrom_tpu.analysis.trace_lint import run_trace_lint
+        fs = run_trace_lint(
+            REPO, paths=["maelstrom_tpu/models/raft_buggy.py"])
+        got = _rules(fs)
+        assert {"TRC101", "TRC102", "TRC103", "TRC104", "TRC105",
+                "TRC106", "TRC107"} <= got
+        assert all(f.symbol == "RaftTracedHazards.tick" for f in fs)
+
+    def test_fixture_findings_are_expected_not_silent(self):
+        """Every fixture finding is baselined as status='expected' — a
+        visible, test-asserted exception, not silent acceptance."""
+        from maelstrom_tpu.analysis.trace_lint import run_trace_lint
+        fs = run_trace_lint(
+            REPO, paths=["maelstrom_tpu/models/raft_buggy.py"])
+        bl = Baseline.load()
+        for f in fs:
+            entry = bl.match(f)
+            assert entry is not None, f.fingerprint
+            assert entry.status == "expected", f.fingerprint
+
+    def test_fixture_never_registered(self):
+        from maelstrom_tpu.models.raft_buggy import (BUGGY_MODELS,
+                                                     RaftTracedHazards)
+        assert RaftTracedHazards not in BUGGY_MODELS.values()
+
+
+# --- repo-wide smoke + CLI ---------------------------------------------------
+
+class TestRepoWide:
+    @pytest.mark.slow
+    def test_repo_lint_clean_modulo_baseline(self):
+        """The full three-pass run is clean given the checked-in
+        baseline, and the baseline has no stale entries."""
+        from maelstrom_tpu.analysis import run_lint
+        report = run_lint(repo_root=REPO)
+        assert report.errors() == [], [f.to_dict() for f in
+                                       report.errors()]
+        assert report.stale == [], [e.fingerprint for e in report.stale]
+
+    def test_trace_and_schema_passes_clean(self):
+        """The two sub-second passes are clean modulo baseline (the
+        fast-tier slice of the repo-wide gate)."""
+        from maelstrom_tpu.analysis import run_lint
+        report = run_lint(repo_root=REPO, passes=("trace", "schema"))
+        assert report.errors() == [], [f.to_dict() for f in
+                                       report.errors()]
+
+    def test_partial_run_reports_no_stale_entries(self):
+        """A --pass / paths-restricted run never sees the findings that
+        out-of-scope baseline entries suppress, so it must not advise
+        deleting them as stale."""
+        from maelstrom_tpu.analysis import run_lint
+        report = run_lint(repo_root=REPO, passes=("trace",))
+        assert report.stale == []
+        report = run_lint(repo_root=REPO,
+                          paths=["maelstrom_tpu/models/echo.py"])
+        assert report.stale == []
+
+    def test_explicit_pass_honored_with_paths(self):
+        """--pass schema with file paths runs schema, not trace."""
+        from maelstrom_tpu.analysis import run_lint
+        report = run_lint(repo_root=REPO, passes=("schema",),
+                          paths=["maelstrom_tpu/models/echo.py"])
+        assert report.passes_run == ("schema",)
+
+    def test_unreadable_path_does_not_mask_findings(self):
+        from maelstrom_tpu.analysis.trace_lint import run_trace_lint
+        fs = run_trace_lint(
+            REPO, paths=["maelstrom_tpu/models/raft_buggy.py",
+                         "does/not/exist.py"])
+        rules = _rules(fs)
+        assert "TRC100" in rules          # the unreadable target
+        assert "TRC101" in rules          # ...without hiding real ones
+
+    @pytest.mark.slow
+    def test_cli_strict_gate(self):
+        """`maelstrom lint --strict` exits 0 repo-wide (baseline on) and
+        nonzero on the fixture with the baseline disabled."""
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        ok = subprocess.run(
+            [sys.executable, "-m", "maelstrom_tpu", "lint", "--strict"],
+            cwd=REPO, env=env, capture_output=True, text=True)
+        assert ok.returncode == 0, ok.stdout + ok.stderr
+        bad = subprocess.run(
+            [sys.executable, "-m", "maelstrom_tpu", "lint", "--strict",
+             "--no-baseline", "--json",
+             "maelstrom_tpu/models/raft_buggy.py"],
+            cwd=REPO, env=env, capture_output=True, text=True)
+        assert bad.returncode == 1, bad.stdout + bad.stderr
+        payload = json.loads(bad.stdout)
+        assert payload["summary"]["errors"] >= 6
+        rules = {f["rule"] for f in payload["findings"]}
+        assert {"TRC101", "TRC104", "TRC107"} <= rules

@@ -86,8 +86,20 @@ def test_mutant_anomaly_history_roundtrips(tmp_path):
 def test_cli_export_roundtrip(tmp_path, capsys):
     """Default export is one EDN vector per file (the history.edn shape
     — ADVICE r3 #1: a stock read-string must see the whole history, not
-    just the first op)."""
-    src = os.path.join(REPO, "store", "txn-list-append", "latest")
+    just the first op). Self-provisions its store run (a quick TPU
+    txn-list-append sim) instead of assuming a pre-existing artifact —
+    the seed tree shipped without one and the test failed on fresh
+    checkouts."""
+    from maelstrom_tpu.models.txn_raft import TxnListAppendModel
+    from maelstrom_tpu.tpu.harness import run_tpu_test
+
+    store_root = str(tmp_path / "store")
+    run_tpu_test(TxnListAppendModel(n_nodes_hint=1),
+                 dict(node_count=1, concurrency=2, time_limit=1.0,
+                      rate=50.0, latency=2.0, n_instances=2,
+                      record_instances=2, seed=7,
+                      store_root=store_root))
+    src = os.path.join(store_root, "txn-list-append-tpu", "latest")
     out = str(tmp_path / "out")
     rc = cli_main(["export", src, "-o", out])
     assert rc == 0

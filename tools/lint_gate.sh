@@ -1,0 +1,26 @@
+#!/usr/bin/env bash
+# Pre-merge gate: the static-analysis passes + the tier-1 test sweep.
+#
+#   tools/lint_gate.sh            # lint --strict, then tier-1 pytest
+#   tools/lint_gate.sh --lint-only
+#
+# Exit nonzero on any unsuppressed error-severity lint finding or any
+# tier-1 test failure. Wire this as the pre-merge check; the baseline
+# workflow for justified exceptions is documented in doc/lint.md.
+
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+echo "== maelstrom lint --strict"
+python -m maelstrom_tpu lint --strict
+
+if [[ "${1:-}" == "--lint-only" ]]; then
+    exit 0
+fi
+
+echo
+echo "== tier-1 pytest (-m 'not slow')"
+exec python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider
