@@ -169,6 +169,28 @@ def child_main(canary: bool = False) -> None:
 
     model = RaftModel(n_nodes_hint=3, log_cap=64, heartbeat=8)
 
+    # flight-recorder telemetry rides the bench by default (the metric
+    # line then carries ticks-to-ack quantiles from the same counters
+    # the fleet-metrics artifact uses); BENCH_TELEMETRY=0 reverts to the
+    # bare no-telemetry carry for overhead A/B runs
+    bench_telemetry = os.environ.get("BENCH_TELEMETRY") != "0"
+
+    def _latency_ticks(c):
+        """Fleet ticks-to-ack quantiles off the live carry (same
+        estimator as telemetry/fleet.py's fleet-metrics.json)."""
+        if c.telemetry is None:
+            return None
+        import numpy as np
+        from maelstrom_tpu.telemetry.fleet import (bucket_upper_ticks,
+                                                   hist_quantile)
+        hist = np.asarray(c.telemetry.rpc_hist).sum(axis=0)
+        uppers = bucket_upper_ticks(hist.shape[0])
+        out = {}
+        for q in (0.5, 0.95, 0.99):
+            b = hist_quantile(hist, q)
+            out[f"p{int(q * 100)}"] = None if b is None else uppers[b]
+        return out
+
     for cfg_name, net_knobs, cfg_sim_seconds, cfg_instances in configs:
         cfg_n_instances = cfg_instances or n_instances
         if cfg_instances is not None and cfg_instances == n_instances:
@@ -180,6 +202,7 @@ def child_main(canary: bool = False) -> None:
                     rate=200.0, latency=5.0, rpc_timeout=1.0,
                     nemesis=["partition"], nemesis_interval=0.4,
                     p_loss=0.05, recovery_time=0.3, seed=7,
+                    telemetry=bench_telemetry,
                     **net_knobs)
         sim = make_sim_config(model, opts)
         params = model.make_params(sim.net.n_nodes)
@@ -239,6 +262,9 @@ def child_main(canary: bool = False) -> None:
                 "wall_s": round(wall, 3),
                 "bytes_per_instance": int(bytes_per_instance),
             }
+            lat = _latency_ticks(carry)
+            if lat is not None:
+                rec["latency_ticks"] = lat
             if provisional:
                 rec["provisional"] = True   # compile-inclusive window
             if complete:

@@ -71,7 +71,7 @@ TRACED_PARAM_NAMES = {
     "row", "msg", "msgs", "t", "key", "keys", "carry", "pool",
     "node_state", "client_state", "inbox", "inbox_nodes",
     "inbox_clients", "op", "uniq", "msg_id", "client_idx", "node_idx",
-    "partitions", "instance_key", "row_body",
+    "partitions", "instance_key", "row_body", "tel",
 }
 
 # Parameters that are static (python-level) even inside traced functions.
@@ -530,7 +530,8 @@ def default_trace_targets(repo_root: str) -> List[str]:
     tick-loop machinery, and the delivery kernel."""
     import glob
     pats = ["maelstrom_tpu/models/*.py", "maelstrom_tpu/tpu/*.py",
-            "maelstrom_tpu/ops/delivery.py"]
+            "maelstrom_tpu/ops/delivery.py",
+            "maelstrom_tpu/telemetry/recorder.py"]
     out = []
     for p in pats:
         out.extend(sorted(glob.glob(os.path.join(repo_root, p))))

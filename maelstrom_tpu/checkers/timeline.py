@@ -22,10 +22,17 @@ def render_timeline(history, path: str):
     for p in pairs(history):
         inv, comp = p["invoke"], p["complete"]
         proc = inv.get("process")
+        # histories assembled outside the runner (nemesis-only records,
+        # hand-written fixtures, external EDN imports) may lack time
+        # fields — skip untimed invokes instead of raising KeyError,
+        # and draw an untimed completion as instantaneous
+        if inv.get("time") is None:
+            continue
         if proc not in ops_by_proc:
             procs.append(proc)
         t0 = inv["time"] / 1e9
-        t1 = (comp["time"] / 1e9) if comp else t0 + 0.01
+        t1 = (comp["time"] / 1e9) if comp and comp.get("time") is not None \
+            else t0 + 0.01
         outcome = comp["type"] if comp else "info"
         ops_by_proc[proc].append((t0, t1, outcome, inv, comp))
         t_max = max(t_max, t1)

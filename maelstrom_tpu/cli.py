@@ -12,6 +12,8 @@ option specs :136-229):
   stock Elle/Knossos outside this image
 - ``lint``   — the static-analysis gate: trace-hygiene, abstract-eval
   contract, and schema/wire conformance passes (doc/lint.md)
+- ``fleet-stats`` — render a TPU run's device-telemetry report (text +
+  SVG dashboards from fleet-metrics.json; doc/observability.md)
 """
 
 from __future__ import annotations
@@ -121,6 +123,16 @@ def add_test_options(p: argparse.ArgumentParser):
                    help="TPU runtime: virtual-clock resolution "
                         "(fidelity vs throughput trade)")
     p.add_argument("--p-loss", type=float, default=0.0)
+    p.add_argument("--no-telemetry", action="store_true",
+                   help="TPU runtime: disable the device flight "
+                        "recorder (doc/observability.md); no "
+                        "fleet-metrics.json is written")
+    p.add_argument("--telemetry-stride", type=int, default=0,
+                   help="TPU runtime: ticks per fleet-series window "
+                        "(0 = auto, <= 256 windows)")
+    p.add_argument("--profile-dir", default=None,
+                   help="TPU runtime: capture a jax.profiler trace of "
+                        "the run into this directory")
 
 
 def _availability(v):
@@ -284,6 +296,9 @@ def cmd_test(args) -> int:
             n_instances=args.n_instances,
             record_instances=args.record_instances,
             journal_instances=args.journal_instances,
+            telemetry=not args.no_telemetry,
+            telemetry_stride=args.telemetry_stride,
+            profile_dir=args.profile_dir,
             store_root=args.store,
             seed=args.seed or 0)
         if args.recovery_time is not None:
@@ -660,6 +675,42 @@ def cmd_export(args) -> int:
     return 0
 
 
+def cmd_fleet_stats(args) -> int:
+    """Render the fleet telemetry report of a TPU-runtime store run:
+    text summary on stdout plus the rate/drop/latency SVG dashboards
+    (re-rendered offline from fleet-metrics.json, so a run dir copied
+    off the machine keeps its dashboards reproducible)."""
+    from .telemetry.fleet import (FLEET_METRICS_FILE, load_fleet_metrics,
+                                  render_report, write_fleet_svgs)
+
+    path = os.path.realpath(args.path)
+    try:
+        metrics = load_fleet_metrics(path)
+    except OSError as e:
+        print(f"error: no {FLEET_METRICS_FILE} at {args.path} ({e}); "
+              f"fleet metrics are written by TPU-runtime runs unless "
+              f"--no-telemetry was passed", file=sys.stderr)
+        return 2
+    except json.JSONDecodeError as e:
+        print(f"error: unparseable fleet metrics at {args.path}: {e}",
+              file=sys.stderr)
+        return 2
+    run_dir = path if os.path.isdir(path) else os.path.dirname(path)
+    phases = None
+    try:
+        with open(os.path.join(run_dir, "results.json")) as f:
+            phases = json.load(f).get("perf", {}).get("phases")
+    except (OSError, json.JSONDecodeError):
+        pass
+    print(render_report(metrics, phases=phases))
+    if not args.no_svg:
+        out_dir = args.out or run_dir
+        os.makedirs(out_dir, exist_ok=True)
+        for p in write_fleet_svgs(metrics, out_dir):
+            print(f"wrote {p}", file=sys.stderr)
+    return 0
+
+
 def cmd_lint(args) -> int:
     """Run the analysis passes; --strict turns error findings into a
     nonzero exit (the pre-merge gate, tools/lint_gate.sh)."""
@@ -736,6 +787,20 @@ def main(argv=None) -> int:
                                "the default single EDN vector "
                                "(history.edn shape)")
 
+    p_fleet = sub.add_parser(
+        "fleet-stats", help="render the fleet telemetry report of a "
+                            "TPU-runtime store run (doc/observability"
+                            ".md)")
+    p_fleet.add_argument("path",
+                         help="a store run dir (e.g. "
+                              "store/echo-tpu/latest) or a "
+                              "fleet-metrics.json file")
+    p_fleet.add_argument("-o", "--out", default=None,
+                         help="directory for the SVG dashboards "
+                              "(default: the run dir)")
+    p_fleet.add_argument("--no-svg", action="store_true",
+                         help="text report only")
+
     p_lint = sub.add_parser(
         "lint", help="static analysis: trace-hygiene, contract, and "
                      "schema/wire conformance passes (doc/lint.md)")
@@ -764,7 +829,8 @@ def main(argv=None) -> int:
     try:
         return {"test": cmd_test, "demo": cmd_demo, "serve": cmd_serve,
                 "doc": cmd_doc, "check": cmd_check,
-                "export": cmd_export, "lint": cmd_lint}[args.command](args)
+                "export": cmd_export, "lint": cmd_lint,
+                "fleet-stats": cmd_fleet_stats}[args.command](args)
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2

@@ -85,6 +85,12 @@ class Net:
         # receiver-side blocklists: dest -> set of blocked srcs (net.clj:234)
         self.partitions: Dict[str, Set[str]] = {}
         self._part_lock = threading.Lock()
+        # drop counters, the host-runtime mirror of netsim.NetStats'
+        # dropped_* lanes (the process network has no bounded pool, so
+        # there is no overflow class here)
+        self._drop_lock = threading.Lock()
+        self.dropped_partition = 0
+        self.dropped_loss = 0
 
     # --- topology ---------------------------------------------------------
 
@@ -143,6 +149,15 @@ class Net:
         with self._part_lock:
             return src in self.partitions.get(dest, ())
 
+    def drop_stats(self) -> Dict[str, int]:
+        """Drop counters keyed like the TPU runtime's net block
+        (tpu/harness.py results["net"]), so process-runtime journal
+        stats and device fleet metrics agree on vocabulary."""
+        with self._drop_lock:
+            return {"dropped-partition": self.dropped_partition,
+                    "dropped-loss": self.dropped_loss,
+                    "dropped-overflow": 0}
+
     # --- send / recv ------------------------------------------------------
 
     def new_id(self) -> int:
@@ -165,6 +180,8 @@ class Net:
             print(f":net :send {m.to_wire()}", flush=True)
         # lost?
         if self.p_loss > 0 and self.rng.random() < self.p_loss:
+            with self._drop_lock:
+                self.dropped_loss += 1
             return m
         # client links have zero latency (net.clj:178-187)
         if is_client(src) or is_client(dest):
@@ -199,6 +216,8 @@ class Net:
                     if d <= now_ns:
                         heapq.heappop(q.heap)
                         if self._blocked(m.src, node_id):
+                            with self._drop_lock:
+                                self.dropped_partition += 1
                             continue  # dropped by partition
                         self.journal.log_recv(m)
                         if self.log_recv:

@@ -78,11 +78,13 @@ def _run_sharded(model: Model, sim: SimConfig, mesh: Mesh, seeds, params):
     axes = mesh.axis_names
 
     def shard_body(seed_shard, params_rep):
-        carry, ys = simulate(model, sim, seed_shard.reshape(()),
-                             params_rep)
+        with jax.named_scope("simulate_shard"):
+            carry, ys = simulate(model, sim, seed_shard.reshape(()),
+                                 params_rep)
         stats = carry.stats
-        for ax in axes:
-            stats = jax.tree.map(lambda x: jax.lax.psum(x, ax), stats)
+        with jax.named_scope("psum_stats"):
+            for ax in axes:
+                stats = jax.tree.map(lambda x: jax.lax.psum(x, ax), stats)
         return stats, carry.violations, ys.events
 
     # zero-initialized carry components are unvaried constants while the
@@ -129,22 +131,35 @@ def _carry_to_wire(c: Carry, sim: SimConfig) -> Carry:
     the chunk's ticks."""
     from ..tpu.runtime import canonical_carry
     c = canonical_carry(c, sim)
+    tel = c.telemetry
+    if tel is not None:
+        # per-instance telemetry leaves already lead with the instance
+        # axis; only the fleet series buffer (shard-local, not
+        # instance-batched) needs a leading shard axis like stats/key
+        tel = tel._replace(series=tel.series.reshape(
+            (1,) + tel.series.shape))
     return Carry(
         pool=c.pool, node_state=c.node_state,
         client_state=c.client_state,
         stats=jax.tree.map(lambda x: x.reshape(1), c.stats),
         violations=c.violations,
-        key=c.key.reshape(1, *c.key.shape))
+        key=c.key.reshape(1, *c.key.shape),
+        telemetry=tel)
 
 
 def _carry_from_wire(w: Carry, sim: SimConfig) -> Carry:
     from ..tpu.runtime import carry_from_canonical
+    tel = w.telemetry
+    if tel is not None:
+        tel = tel._replace(series=tel.series.reshape(
+            tel.series.shape[1:]))
     c = Carry(
         pool=w.pool, node_state=w.node_state,
         client_state=w.client_state,
         stats=jax.tree.map(lambda x: x.reshape(()), w.stats),
         violations=w.violations,
-        key=w.key.reshape(*w.key.shape[1:]))
+        key=w.key.reshape(*w.key.shape[1:]),
+        telemetry=tel)
     return carry_from_canonical(c, sim)
 
 

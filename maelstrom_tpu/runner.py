@@ -248,7 +248,8 @@ class TestRunner:
         history = self.history.records()
         return check_history(
             history, self.opts, self.workload.get("checker"),
-            extra={"net": net_stats_checker(self.journal, history)})
+            extra={"net": net_stats_checker(self.journal, history,
+                                            drops=self.net.drop_stats())})
 
     def write_store(self, results: Dict[str, Any]):
         if not self.store_dir:

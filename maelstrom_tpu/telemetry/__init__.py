@@ -1,0 +1,15 @@
+"""Fleet telemetry: the device-resident flight recorder threaded through
+the tick-loop carry (:mod:`.recorder`) and the host-side aggregation that
+turns it into fleet metrics, dashboards, and the ``maelstrom fleet-stats``
+report (:mod:`.fleet`).
+
+The split matters: :mod:`.recorder` is traced (fixed shapes, int32 lanes,
+no host syncs — it must pass ``maelstrom lint --strict`` like any model),
+while :mod:`.fleet` is plain numpy/JSON and never runs under jit.
+"""
+
+from .recorder import (Telemetry, TelemetryConfig, init_telemetry,
+                       latency_bucket, record_tick)
+
+__all__ = ["Telemetry", "TelemetryConfig", "init_telemetry",
+           "latency_bucket", "record_tick"]

@@ -1,0 +1,153 @@
+"""The in-scan flight recorder: a fixed-shape telemetry pytree threaded
+through the tick carry.
+
+Per protocol instance it accumulates NetStats totals, inbox/pool
+high-water marks, a log-bucket histogram of client RPC latency in ticks,
+nemesis partition epochs, and the first invariant-trip tick; a small
+fleet-aggregate time series (one row per ``stride`` ticks) rides in a
+fixed ``[n_windows, SERIES_LANES]`` buffer so memory stays bounded no
+matter the horizon. Everything is int32, fixed-shape, and updated with
+pure ``jnp`` ops — this module is a traced surface and is linted like a
+model (``maelstrom lint --strict``; see doc/observability.md).
+
+Design notes:
+
+- The time series is accumulated *in the carry* (scatter-add of one
+  fleet-summed row into window ``t // stride``) rather than stacked as a
+  raw per-tick scan output: device memory is then ``n_windows`` rows
+  regardless of ``n_ticks``, and the scatter is a single small non-batched
+  row (the slow vmapped-scatter path netsim avoids never appears).
+- Latency buckets are exact integer log2 ranges: bucket ``k`` holds
+  latencies in ``[2^k - 1, 2^(k+1) - 2]`` ticks, so host-side numpy
+  recomputation from a decoded history can match the device histogram
+  bit-for-bit (tests/test_telemetry.py holds it to that).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax.numpy as jnp
+
+# Fleet-aggregate series lanes (one row per stride window).
+SERIES_NAMES = ("delivered", "sent", "dropped-partition", "dropped-loss",
+                "dropped-overflow", "invokes", "acks", "inflight")
+SERIES_LANES = len(SERIES_NAMES)
+
+
+class TelemetryConfig(NamedTuple):
+    """Static telemetry parameters (python-level, part of SimConfig)."""
+    enabled: bool = True
+    hist_buckets: int = 16   # log2 latency buckets (covers 2^16-2 ticks)
+    stride: int = 64         # ticks per series window
+    n_windows: int = 32      # ceil(n_ticks / stride), fixed at config time
+
+
+class Telemetry(NamedTuple):
+    """Per-instance flight-recorder state (all int32; [I] unless noted).
+
+    ``first_violation`` is -1 until an instance's on-device invariants
+    trip; ``partition_prev`` is the 0/1 partition-activity latch used to
+    count activation edges into ``nemesis_epochs``.
+    """
+    sent: jnp.ndarray
+    delivered: jnp.ndarray
+    delivered_servers: jnp.ndarray   # server->server deliveries only
+    dropped_partition: jnp.ndarray
+    dropped_loss: jnp.ndarray
+    dropped_overflow: jnp.ndarray
+    invokes: jnp.ndarray             # client invocations
+    acks: jnp.ndarray                # ok completions
+    inbox_hwm: jnp.ndarray           # max deliveries in one tick
+    pool_hwm: jnp.ndarray            # max in-flight pool occupancy
+    partition_ticks: jnp.ndarray     # ticks with any partition edge up
+    nemesis_epochs: jnp.ndarray      # partition activation edges
+    partition_prev: jnp.ndarray      # 0/1 latch for edge detection
+    first_violation: jnp.ndarray     # first invariant-trip tick, -1 none
+    rpc_hist: jnp.ndarray            # [I, hist_buckets] ok-latency ticks
+    series: jnp.ndarray              # [n_windows, SERIES_LANES] fleet sums
+
+
+def init_telemetry(n_instances, cfg: TelemetryConfig
+                   ) -> Optional[Telemetry]:
+    """Zero-initialized recorder state, or None when telemetry is off
+    (the carry then has no telemetry leaves at all — the disabled path
+    is bit- and cost-identical to the pre-telemetry runtime)."""
+    if not cfg.enabled:
+        return None
+    z = jnp.zeros((n_instances,), jnp.int32)
+    return Telemetry(
+        sent=z, delivered=z, delivered_servers=z,
+        dropped_partition=z, dropped_loss=z, dropped_overflow=z,
+        invokes=z, acks=z, inbox_hwm=z, pool_hwm=z,
+        partition_ticks=z, nemesis_epochs=z, partition_prev=z,
+        first_violation=jnp.full((n_instances,), -1, jnp.int32),
+        rpc_hist=jnp.zeros((n_instances, cfg.hist_buckets), jnp.int32),
+        series=jnp.zeros((cfg.n_windows, SERIES_LANES), jnp.int32),
+    )
+
+
+def latency_bucket(lat, cfg: TelemetryConfig) -> jnp.ndarray:
+    """Exact integer log2 bucket of a latency in ticks: the number of
+    thresholds ``2^k`` (k in [1, hist_buckets)) that ``lat + 1`` reaches.
+    Bucket k therefore holds ``[2^k - 1, 2^(k+1) - 2]`` ticks, with the
+    last bucket open-ended. Integer comparisons only — no float log2, so
+    the host oracle can reproduce it exactly."""
+    thresholds = 2 ** jnp.arange(1, cfg.hist_buckets, dtype=jnp.int32)
+    lat = jnp.maximum(lat, 0)
+    return jnp.sum((lat[..., None] + 1) >= thresholds,
+                   axis=-1).astype(jnp.int32)
+
+
+def record_tick(tel: Telemetry, t, cfg: TelemetryConfig, *,
+                n_sent, n_del, n_del_serv, n_dropp, n_lost, n_ovf,
+                pool_occ, part_active, violated, ok_mask, invoke_mask,
+                lat) -> Telemetry:
+    """Fold one tick's deltas into the recorder.
+
+    All array arguments are batch-LEADING whatever the carry layout (the
+    runtime hands both layouts' deltas over in canonical orientation, so
+    lead/minor trajectories stay bit-identical): per-instance int32
+    vectors ``n_*``/``pool_occ`` [I], bool ``part_active``/``violated``
+    [I], and per-client ``ok_mask``/``invoke_mask``/``lat`` [I, C]
+    (``lat`` = ticks since the completing op's invocation; only entries
+    under ``ok_mask`` are histogrammed — ticks-to-ack, not timeouts).
+    """
+    part_i = part_active.astype(jnp.int32)
+    viol = violated.astype(jnp.int32)
+    bucket = latency_bucket(lat, cfg)                      # [I, C]
+    onehot = (bucket[..., None]
+              == jnp.arange(cfg.hist_buckets, dtype=jnp.int32))
+    hist_delta = jnp.sum(onehot & ok_mask[..., None],
+                         axis=1).astype(jnp.int32)         # [I, B]
+    n_acks = jnp.sum(ok_mask, axis=1).astype(jnp.int32)
+    n_invokes = jnp.sum(invoke_mask, axis=1).astype(jnp.int32)
+
+    row = jnp.stack([
+        jnp.sum(n_del), jnp.sum(n_sent), jnp.sum(n_dropp),
+        jnp.sum(n_lost), jnp.sum(n_ovf), jnp.sum(n_invokes),
+        jnp.sum(n_acks), jnp.sum(pool_occ),
+    ]).astype(jnp.int32)
+    window = jnp.minimum(t // cfg.stride, cfg.n_windows - 1)
+
+    return Telemetry(
+        sent=tel.sent + n_sent,
+        delivered=tel.delivered + n_del,
+        delivered_servers=tel.delivered_servers + n_del_serv,
+        dropped_partition=tel.dropped_partition + n_dropp,
+        dropped_loss=tel.dropped_loss + n_lost,
+        dropped_overflow=tel.dropped_overflow + n_ovf,
+        invokes=tel.invokes + n_invokes,
+        acks=tel.acks + n_acks,
+        inbox_hwm=jnp.maximum(tel.inbox_hwm, n_del),
+        pool_hwm=jnp.maximum(tel.pool_hwm, pool_occ),
+        partition_ticks=tel.partition_ticks + part_i,
+        nemesis_epochs=tel.nemesis_epochs
+        + (part_i * (1 - tel.partition_prev)),
+        partition_prev=part_i,
+        first_violation=jnp.where(
+            (tel.first_violation < 0) & (viol > 0), t,
+            tel.first_violation),
+        rpc_hist=tel.rpc_hist + hist_delta,
+        series=tel.series.at[window].add(row),
+    )

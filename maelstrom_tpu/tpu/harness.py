@@ -24,6 +24,7 @@ import numpy as np
 from .netsim import LATENCY_DISTS, NetConfig
 from .runtime import (ClientConfig, EV_FAIL, EV_INFO, EV_INVOKE, EV_NONE,
                       EV_OK, Model, NemesisConfig, SimConfig, run_sim)
+from ..telemetry.recorder import TelemetryConfig
 
 MS_PER_TICK = 1  # default virtual clock resolution (override per run)
 
@@ -56,6 +57,13 @@ TPU_DEFAULTS = dict(
                               # ~8x) and batch-lead on CPU (~10% faster
                               # there); trajectories are bit-identical
                               # either way (runtime.SimConfig.layout)
+    telemetry=True,           # device flight recorder (doc/
+                              # observability.md); False removes the
+                              # telemetry leaves from the carry entirely
+    telemetry_stride=0,       # ticks per fleet-series window (0 = auto:
+                              # <= 256 windows whatever the horizon)
+    telemetry_hist_buckets=16,  # log2 ticks-to-ack histogram lanes
+    profile_dir=None,         # jax.profiler trace capture directory
     seed=0,
 )
 
@@ -120,13 +128,27 @@ def make_sim_config(model: Model, opts: Dict[str, Any]) -> SimConfig:
              for until, pairs in o.get("nemesis_schedule", ())),
             key=lambda p: p[0])),  # searchsorted needs monotonic untils
     )
+    stride = int(o.get("telemetry_stride") or 0)
+    if stride <= 0:
+        # auto: bound the fleet series to <= 256 windows however long
+        # the horizon is (memory = n_windows * SERIES_LANES int32s)
+        stride = max(1, -(-n_ticks // 256))
+    telemetry = TelemetryConfig(
+        enabled=bool(o.get("telemetry", True)),
+        # clamp to int32-safe bucket thresholds (recorder compares
+        # against 2^k for k < hist_buckets; 2^31 would wrap negative)
+        hist_buckets=min(max(int(o.get("telemetry_hist_buckets", 16)),
+                             1), 31),
+        stride=stride,
+        n_windows=max(1, -(-n_ticks // stride)))
     return SimConfig(net=net, client=client, nemesis=nemesis,
                      n_instances=o["n_instances"], n_ticks=n_ticks,
                      record_instances=min(o["record_instances"],
                                           o["n_instances"]),
                      journal_instances=min(o["journal_instances"],
                                            o["n_instances"]),
-                     layout=resolve_layout(o["layout"]))
+                     layout=resolve_layout(o["layout"]),
+                     telemetry=telemetry)
 
 
 def events_to_histories(model: Model, events: np.ndarray,
@@ -164,6 +186,58 @@ def events_to_histories(model: Model, events: np.ndarray,
     return histories
 
 
+def _phase_timed_run(model: Model, sim: SimConfig, seed: int, params,
+                     profile_dir: Optional[str] = None):
+    """Dispatch :func:`run_sim` with per-phase wall-clock timers
+    (trace/lower, compile, execute) via the jit AOT API, optionally
+    under a ``jax.profiler`` trace capture. Falls back to one opaque
+    ``total-s`` timing on jax versions without a working AOT path — the
+    run itself never depends on the instrumentation."""
+    import jax
+    import jax.numpy as jnp
+
+    phases: Dict[str, float] = {}
+    profiling = False
+    if profile_dir:
+        try:
+            jax.profiler.start_trace(profile_dir)
+            profiling = True
+        except Exception as e:  # profiler backend unavailable
+            phases["profile-error"] = repr(e)[:160]
+    seed_arr = jnp.int32(seed)
+    t0 = time.monotonic()
+    try:
+        dispatch = None
+        try:
+            lowered = run_sim.lower(model, sim, seed_arr, params)
+            phases["trace-s"] = round(time.monotonic() - t0, 4)
+            t1 = time.monotonic()
+            compiled = lowered.compile()
+            phases["compile-s"] = round(time.monotonic() - t1, 4)
+            dispatch = lambda: compiled(seed_arr, params)
+        except Exception as e:
+            # AOT setup only — an execution failure below must raise,
+            # not silently re-dispatch the whole simulation
+            phases = {k: v for k, v in phases.items()
+                      if k == "profile-error"}
+            phases["aot-error"] = repr(e)[:160]
+        t2 = time.monotonic()
+        if dispatch is None:
+            out = jax.block_until_ready(
+                run_sim(model, sim, seed_arr, params))
+            phases["total-s"] = round(time.monotonic() - t0, 4)
+        else:
+            out = jax.block_until_ready(dispatch())
+            phases["execute-s"] = round(time.monotonic() - t2, 4)
+    finally:
+        if profiling:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+    return out, phases
+
+
 def run_tpu_test(model: Model, opts: Optional[Dict[str, Any]] = None,
                  params=None) -> Dict[str, Any]:
     opts = {**TPU_DEFAULTS, **(opts or {})}
@@ -171,8 +245,18 @@ def run_tpu_test(model: Model, opts: Optional[Dict[str, Any]] = None,
     if params is None:
         params = model.make_params(sim.net.n_nodes)
     t0 = time.monotonic()
-    carry, ys = run_sim(model, sim, opts["seed"], params)
+    (carry, ys), phases = _phase_timed_run(model, sim, opts["seed"],
+                                           params,
+                                           opts.get("profile_dir"))
+    t_fetch = time.monotonic()
     events = np.asarray(ys.events)
+    fleet = None
+    if carry.telemetry is not None:
+        import jax
+        from ..telemetry.fleet import fleet_summary
+        tel_host = jax.tree.map(np.asarray, carry.telemetry)
+        fleet = fleet_summary(tel_host, sim, opts["ms_per_tick"])
+    phases["fetch-s"] = round(time.monotonic() - t_fetch, 4)
     wall = time.monotonic() - t0
 
     histories = events_to_histories(model, events,
@@ -235,8 +319,16 @@ def run_tpu_test(model: Model, opts: Optional[Dict[str, Any]] = None,
             "msgs-per-sec": total_msgs / wall if wall > 0 else 0.0,
             "instance-ticks-per-sec": (sim.n_instances * sim.n_ticks / wall
                                        if wall > 0 else 0.0),
+            "phases": phases,
         },
     }
+    if fleet is not None:
+        # the condensed fleet view rides in results.json; the full dict
+        # (series, histograms, per-instance spreads) is the store's
+        # fleet-metrics.json, rendered by `maelstrom fleet-stats`
+        results["telemetry"] = {k: v for k, v in fleet.items()
+                                if k not in ("series", "latency-hist",
+                                             "per-instance")}
     if availability is not None:
         results["availability"] = availability
         if availability["valid?"] is False:
@@ -258,22 +350,33 @@ def run_tpu_test(model: Model, opts: Optional[Dict[str, Any]] = None,
                              if k != "histories"}
     journal = None
     if sim.journal_instances > 0:
+        from ..checkers.net_stats import net_stats_checker
         from .journal import TpuJournal
         journal = TpuJournal(model, sim.net, np.asarray(ys.journal_sends),
                              np.asarray(ys.journal_recvs), instance=0,
                              ms_per_tick=opts["ms_per_tick"])
-        ops = sum(1 for r in (histories[0] if histories else [])
-                  if r["type"] == "invoke")
-        jstats = journal.stats()
+        # instance 0's own drop counters ride along when the flight
+        # recorder ran, so the journal block and fleet-metrics.json
+        # agree (checkers/net_stats.py vocabulary)
+        drops = None
+        if carry.telemetry is not None:
+            tel = carry.telemetry
+            drops = {
+                "dropped-partition": int(tel.dropped_partition[0]),
+                "dropped-loss": int(tel.dropped_loss[0]),
+                "dropped-overflow": int(tel.dropped_overflow[0]),
+            }
+        ns = net_stats_checker(journal, histories[0] if histories else [],
+                               drops=drops)
         results["net"]["journal"] = {
-            "stats": jstats,
-            "msgs-per-op": (jstats["servers"]["msg-count"] / ops
-                            if ops else None),
+            "stats": ns["stats"],
+            "msgs-per-op": ns["msgs-per-op"],
+            **({"drops": ns["drops"]} if drops is not None else {}),
             "instance": 0,
         }
     if opts.get("store_root"):
         _write_store(model.name, opts["store_root"], results, histories,
-                     journal, funnel=funnel)
+                     journal, funnel=funnel, fleet=fleet)
     return results
 
 
@@ -325,16 +428,22 @@ def replay_instances(model: Model, opts: Dict[str, Any],
 
 def _write_store(name: str, store_root: str, results: Dict[str, Any],
                  histories, journal=None, funnel=None,
-                 suffix: str = "-tpu") -> None:
+                 suffix: str = "-tpu", fleet=None) -> None:
     """Store artifacts for a TPU (or native-engine) run: results.json +
     one history per recorded instance (the store layout of
     doc/results.md, minus node logs — there are no node processes),
-    plus the Lamport diagram when a per-message journal was recorded."""
+    plus the Lamport diagram when a per-message journal was recorded and
+    the fleet-metrics.json + dashboard SVGs when telemetry ran."""
     import json
     from datetime import datetime
     ts = datetime.now().strftime("%Y%m%d-%H%M%S-%f")
     d = os.path.join(store_root, f"{name}{suffix}", ts)
     os.makedirs(d, exist_ok=True)
+    if fleet is not None:
+        from ..telemetry.fleet import (write_fleet_metrics,
+                                       write_fleet_svgs)
+        write_fleet_metrics(fleet, d)
+        write_fleet_svgs(fleet, d)
     if journal is not None:
         from ..net.viz import plot_lamport
         plot_lamport(journal, os.path.join(d, "messages.svg"))

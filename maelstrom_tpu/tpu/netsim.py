@@ -77,6 +77,13 @@ def empty_pool(cfg: NetConfig) -> jnp.ndarray:
     return jnp.zeros((cfg.pool_slots, cfg.lanes), dtype=jnp.int32)
 
 
+def pool_occupancy(pool: jnp.ndarray) -> jnp.ndarray:
+    """Occupied slot count of a batch-leading pool ([..., S, L] ->
+    [...]): the telemetry recorder's in-flight gauge and high-water-mark
+    source. The VALID lane is 0/1, so a sum over the slot axis is exact."""
+    return jnp.sum(pool[..., wire.VALID], axis=-1).astype(jnp.int32)
+
+
 def no_partitions(cfg: NetConfig) -> jnp.ndarray:
     """partitions[dest, src] True = dest refuses traffic from src."""
     return jnp.zeros((cfg.n_total, cfg.n_total), dtype=bool)

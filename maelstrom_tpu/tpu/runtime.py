@@ -34,6 +34,8 @@ import jax.numpy as jnp
 
 from . import netsim, wire
 from .netsim import NetConfig, NetStats
+from ..telemetry import recorder as flight
+from ..telemetry.recorder import TelemetryConfig
 
 # --- history events -------------------------------------------------------
 
@@ -468,6 +470,11 @@ class SimConfig(NamedTuple):
                                  # delivery kernel). Trajectories are
                                  # bit-identical either way; see
                                  # canonical_carry.
+    telemetry: TelemetryConfig = TelemetryConfig()
+                                 # flight-recorder knobs (telemetry/
+                                 # recorder.py); enabled=False removes
+                                 # the telemetry leaves from the carry
+                                 # entirely (zero-overhead path)
 
 
 class TickOutputs(NamedTuple):
@@ -487,6 +494,9 @@ class Carry(NamedTuple):
     violations: jnp.ndarray    # [I] int32: ticks each instance violated
                                # a model invariant (0 = clean)
     key: jnp.ndarray           # the CONSTANT master key (never advanced)
+    telemetry: Any = None      # flight recorder (telemetry/recorder.py);
+                               # batch-LEADING in BOTH layouts, None when
+                               # sim.telemetry.enabled is False
 
 
 # RNG purpose tags. Every random draw in the simulation derives from
@@ -545,6 +555,7 @@ def init_carry(model: Model, sim: SimConfig, seed: int, params,
         stats=NetStats.zeros(),
         violations=jnp.zeros((I,), jnp.int32),
         key=key,
+        telemetry=flight.init_telemetry(I, sim.telemetry),
     )
 
 
@@ -574,6 +585,35 @@ def carry_from_canonical(carry: Carry, sim: SimConfig) -> Carry:
         client_state=jax.tree.map(to_minor, carry.client_state))
 
 
+def _update_telemetry(tel, sim: SimConfig, t, events, invoked_prev,
+                      pool_lead, inbox, deltas, part_active, violated):
+    """Fold one tick into the flight recorder (no-op when disabled).
+
+    Every array argument is batch-LEADING regardless of ``sim.layout`` —
+    both tick paths hand over canonical-orientation deltas, so the
+    recorder's math (and therefore the layout bit-identity the runtime
+    guarantees) is shared, not duplicated. ``pool_lead`` is the
+    post-enqueue pool with the instance axis first; ``invoked_prev`` the
+    pre-tick per-client invocation ticks [I, C]."""
+    if tel is None:
+        return None
+    N = sim.net.n_nodes
+    n_sent, n_del, n_dropp, n_lost, n_ovf = deltas
+    serv = inbox[:, :N]
+    n_del_serv = jnp.sum(
+        (serv[..., wire.VALID] == 1) & (serv[..., wire.ORIGIN] < N),
+        axis=(1, 2)).astype(jnp.int32)
+    return flight.record_tick(
+        tel, t, sim.telemetry,
+        n_sent=n_sent, n_del=n_del, n_del_serv=n_del_serv,
+        n_dropp=n_dropp, n_lost=n_lost, n_ovf=n_ovf,
+        pool_occ=netsim.pool_occupancy(pool_lead),
+        part_active=part_active, violated=violated,
+        ok_mask=events[:, :, 0, EV_TYPE] == EV_OK,
+        invoke_mask=events[:, :, 1, EV_TYPE] == EV_INVOKE,
+        lat=t - invoked_prev)
+
+
 def make_tick_fn(model: Model, sim: SimConfig, params,
                  instance_ids=None) -> Callable:
     cfg = sim.net
@@ -601,45 +641,52 @@ def make_tick_fn(model: Model, sim: SimConfig, params,
         # phase index itself, so a grudge holds for its whole phase (the
         # reference draws one grudge per nemesis op, nemesis.clj) instead
         # of flapping every tick
-        ikeys = _instance_keys(key, _RNG_NEMESIS, instance_ids)
-        partitions = jax.vmap(
-            lambda ik: partition_matrix(nem, cfg, t, ik))(ikeys)
+        with jax.named_scope("nemesis"):
+            ikeys = _instance_keys(key, _RNG_NEMESIS, instance_ids)
+            partitions = jax.vmap(
+                lambda ik: partition_matrix(nem, cfg, t, ik))(ikeys)
 
         from ..ops.delivery import _interpret, deliver_pallas, \
             pallas_enabled
-        if pallas_enabled():
-            # hand-fused VMEM kernel for the delivery hot op (ops/)
-            pool, inbox, n_del_i, n_dropp_i = deliver_pallas(
-                carry.pool, partitions, t, cfg,
-                interpret=_interpret())
-            n_del, n_dropp = n_del_i, n_dropp_i
-        else:
-            pool, inbox, n_del, n_dropp = jax.vmap(
-                lambda p, pa: netsim.deliver(p, pa, t, cfg))(carry.pool,
-                                                             partitions)
+        with jax.named_scope("deliver"):
+            if pallas_enabled():
+                # hand-fused VMEM kernel for the delivery hot op (ops/)
+                pool, inbox, n_del_i, n_dropp_i = deliver_pallas(
+                    carry.pool, partitions, t, cfg,
+                    interpret=_interpret())
+                n_del, n_dropp = n_del_i, n_dropp_i
+            else:
+                pool, inbox, n_del, n_dropp = jax.vmap(
+                    lambda p, pa: netsim.deliver(p, pa, t, cfg))(
+                        carry.pool, partitions)
 
-        node_keys = _instance_keys(key, _RNG_NODE, instance_ids, t)
-        node_state, node_outs = jax.vmap(
-            lambda st, ib, k: node_phase(model, st, ib, t, k, cfg, params))(
-                carry.node_state, inbox[:, :N], node_keys)
+        with jax.named_scope("node_phase"):
+            node_keys = _instance_keys(key, _RNG_NODE, instance_ids, t)
+            node_state, node_outs = jax.vmap(
+                lambda st, ib, k: node_phase(model, st, ib, t, k, cfg,
+                                             params))(
+                    carry.node_state, inbox[:, :N], node_keys)
 
-        client_keys = _instance_keys(key, _RNG_CLIENT, instance_ids, t)
-        client_state, reqs, events = jax.vmap(
-            lambda cs, ib, k: client_step(model, cs, ib, t, k, cfg, ccfg,
-                                          params))(
-                carry.client_state, inbox[:, N:], client_keys)
+        invoked_prev = carry.client_state.invoked
+        with jax.named_scope("client_step"):
+            client_keys = _instance_keys(key, _RNG_CLIENT, instance_ids, t)
+            client_state, reqs, events = jax.vmap(
+                lambda cs, ib, k: client_step(model, cs, ib, t, k, cfg,
+                                              ccfg, params))(
+                    carry.client_state, inbox[:, N:], client_keys)
 
-        outs = jnp.concatenate(
-            [node_outs.reshape(I, -1, cfg.lanes), reqs], axis=1)
-        # stamp network-unique message ids (send-time allocation, the
-        # role of net.clj:196-201's ID counter): unique per instance
-        M = outs.shape[1]
-        outs = outs.at[:, :, wire.NETID].set(
-            t * M + jnp.arange(M, dtype=jnp.int32)[None, :])
-        enq_keys = _instance_keys(key, _RNG_ENQUEUE, instance_ids, t)
-        pool, n_sent, n_lost, n_ovf = jax.vmap(
-            lambda p, m, k: netsim.enqueue(p, m, t, k, cfg))(pool, outs,
-                                                             enq_keys)
+        with jax.named_scope("enqueue"):
+            outs = jnp.concatenate(
+                [node_outs.reshape(I, -1, cfg.lanes), reqs], axis=1)
+            # stamp network-unique message ids (send-time allocation, the
+            # role of net.clj:196-201's ID counter): unique per instance
+            M = outs.shape[1]
+            outs = outs.at[:, :, wire.NETID].set(
+                t * M + jnp.arange(M, dtype=jnp.int32)[None, :])
+            enq_keys = _instance_keys(key, _RNG_ENQUEUE, instance_ids, t)
+            pool, n_sent, n_lost, n_ovf = jax.vmap(
+                lambda p, m, k: netsim.enqueue(p, m, t, k, cfg))(
+                    pool, outs, enq_keys)
 
         stats = NetStats(
             sent=carry.stats.sent + jnp.sum(n_sent),
@@ -651,11 +698,16 @@ def make_tick_fn(model: Model, sim: SimConfig, params,
         )
         violated = jax.vmap(
             lambda st: model.invariants(st, cfg, params))(node_state)
+        with jax.named_scope("telemetry"):
+            tel = _update_telemetry(
+                carry.telemetry, sim, t, events, invoked_prev, pool,
+                inbox, (n_sent, n_del, n_dropp, n_lost, n_ovf),
+                jnp.any(partitions, axis=(1, 2)), violated)
         new_carry = Carry(pool=pool, node_state=node_state,
                           client_state=client_state, stats=stats,
                           violations=carry.violations
                           + violated.astype(jnp.int32),
-                          key=key)
+                          key=key, telemetry=tel)
         J = sim.journal_instances
         ys = TickOutputs(
             events=events[:sim.record_instances],
@@ -691,36 +743,42 @@ def _make_tick_fn_minor(model: Model, sim: SimConfig, params,
     def tick_one(pool, node_row, client_row, instance_id, master, t):
         """One instance's full tick. pool [S, L]; returns the new
         per-instance state plus this tick's outputs and stat deltas."""
-        nem_key = jax.random.fold_in(
-            jax.random.fold_in(master, _RNG_NEMESIS), instance_id)
-        partitions = partition_matrix(nem, cfg, t, nem_key)
-        pool, inbox, n_del, n_dropp = netsim.deliver(pool, partitions, t,
-                                                     cfg)
+        with jax.named_scope("nemesis"):
+            nem_key = jax.random.fold_in(
+                jax.random.fold_in(master, _RNG_NEMESIS), instance_id)
+            partitions = partition_matrix(nem, cfg, t, nem_key)
+        with jax.named_scope("deliver"):
+            pool, inbox, n_del, n_dropp = netsim.deliver(pool, partitions,
+                                                         t, cfg)
 
-        node_key = jax.random.fold_in(jax.random.fold_in(
-            jax.random.fold_in(master, _RNG_NODE), t), instance_id)
-        node_row, node_outs = node_phase(model, node_row, inbox[:N], t,
-                                         node_key, cfg, params)
+        with jax.named_scope("node_phase"):
+            node_key = jax.random.fold_in(jax.random.fold_in(
+                jax.random.fold_in(master, _RNG_NODE), t), instance_id)
+            node_row, node_outs = node_phase(model, node_row, inbox[:N], t,
+                                             node_key, cfg, params)
 
-        client_key = jax.random.fold_in(jax.random.fold_in(
-            jax.random.fold_in(master, _RNG_CLIENT), t), instance_id)
-        client_row, reqs, events = client_step(model, client_row,
-                                               inbox[N:], t, client_key,
-                                               cfg, ccfg, params)
+        with jax.named_scope("client_step"):
+            client_key = jax.random.fold_in(jax.random.fold_in(
+                jax.random.fold_in(master, _RNG_CLIENT), t), instance_id)
+            client_row, reqs, events = client_step(model, client_row,
+                                                   inbox[N:], t,
+                                                   client_key, cfg, ccfg,
+                                                   params)
 
-        outs = jnp.concatenate(
-            [node_outs.reshape(-1, cfg.lanes), reqs], axis=0)
-        M = outs.shape[0]
-        outs = outs.at[:, wire.NETID].set(
-            t * M + jnp.arange(M, dtype=jnp.int32))
-        enq_key = jax.random.fold_in(jax.random.fold_in(
-            jax.random.fold_in(master, _RNG_ENQUEUE), t), instance_id)
-        pool, n_sent, n_lost, n_ovf = netsim.enqueue(pool, outs, t,
-                                                     enq_key, cfg)
+        with jax.named_scope("enqueue"):
+            outs = jnp.concatenate(
+                [node_outs.reshape(-1, cfg.lanes), reqs], axis=0)
+            M = outs.shape[0]
+            outs = outs.at[:, wire.NETID].set(
+                t * M + jnp.arange(M, dtype=jnp.int32))
+            enq_key = jax.random.fold_in(jax.random.fold_in(
+                jax.random.fold_in(master, _RNG_ENQUEUE), t), instance_id)
+            pool, n_sent, n_lost, n_ovf = netsim.enqueue(pool, outs, t,
+                                                         enq_key, cfg)
         violated = model.invariants(node_row, cfg, params)
         return (pool, node_row, client_row,
                 (n_sent, n_del, n_dropp, n_lost, n_ovf),
-                violated, events, outs, inbox)
+                violated, jnp.any(partitions), events, outs, inbox)
 
     # state rides at axis -1; per-tick outputs (events/journal rows,
     # stat deltas, violations) come out batch-LEADING so the downstream
@@ -729,12 +787,14 @@ def _make_tick_fn_minor(model: Model, sim: SimConfig, params,
     batched = jax.vmap(
         tick_one,
         in_axes=(-1, -1, -1, 0, None, None),
-        out_axes=(-1, -1, -1, 0, 0, 0, 0, 0))
+        out_axes=(-1, -1, -1, 0, 0, 0, 0, 0, 0))
 
     def tick_fn(carry: Carry, t):
-        (pool, node_state, client_state, deltas, violated, events, outs,
-         inbox) = batched(carry.pool, carry.node_state,
-                          carry.client_state, instance_ids, carry.key, t)
+        invoked_prev = jnp.moveaxis(carry.client_state.invoked, -1, 0)
+        (pool, node_state, client_state, deltas, violated, part_active,
+         events, outs, inbox) = batched(carry.pool, carry.node_state,
+                                        carry.client_state, instance_ids,
+                                        carry.key, t)
         n_sent, n_del, n_dropp, n_lost, n_ovf = deltas
         stats = NetStats(
             sent=carry.stats.sent + jnp.sum(n_sent),
@@ -744,11 +804,16 @@ def _make_tick_fn_minor(model: Model, sim: SimConfig, params,
             dropped_loss=carry.stats.dropped_loss + jnp.sum(n_lost),
             dropped_overflow=carry.stats.dropped_overflow + jnp.sum(n_ovf),
         )
+        with jax.named_scope("telemetry"):
+            tel = _update_telemetry(
+                carry.telemetry, sim, t, events, invoked_prev,
+                jnp.moveaxis(pool, -1, 0), inbox, deltas, part_active,
+                violated)
         new_carry = Carry(pool=pool, node_state=node_state,
                           client_state=client_state, stats=stats,
                           violations=carry.violations
                           + violated.astype(jnp.int32),
-                          key=carry.key)
+                          key=carry.key, telemetry=tel)
         J = sim.journal_instances
         ys = TickOutputs(
             events=events[:sim.record_instances],

@@ -37,6 +37,9 @@ def pytest_configure(config):
                    "`pytest -m fast` is the <2-minute sweep — every "
                    "component keeps at least one fast representative "
                    "(meta-tests like time-to-anomaly are slow-only)")
+    config.addinivalue_line(
+        "markers", "telemetry: flight-recorder / fleet-stats "
+                   "observability tests (doc/observability.md)")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -116,18 +116,35 @@ def test_cli_export_roundtrip(tmp_path, capsys):
             assert json.loads(json.dumps(edn_map_to_op(m))) == op
 
 
-def test_cli_export_stdout_maps(capsys):
-    src = os.path.join(REPO, "store", "lin-kv", "latest")
-    rc = cli_main(["export", src, "-o", "-", "--maps"])
+@pytest.fixture(scope="module")
+def lin_kv_store(tmp_path_factory):
+    """Self-provisioned store/lin-kv-tpu run for the stdout export
+    tests (ROADMAP residual fragility from PR 1: these used to read the
+    untracked store/lin-kv/latest artifact and failed on any checkout
+    where it was never generated)."""
+    from maelstrom_tpu.models import get_model
+    from maelstrom_tpu.tpu.harness import run_tpu_test
+
+    root = str(tmp_path_factory.mktemp("edn-store"))
+    # ONE recorded instance: the stdout-vector export refuses multi-shard
+    # runs (concatenated vectors are not one readable EDN form)
+    run_tpu_test(get_model("lin-kv", 3, "grid"), dict(
+        node_count=3, concurrency=2, time_limit=0.6, rate=60.0,
+        latency=5.0, n_instances=2, record_instances=1, seed=11,
+        store_root=root))
+    return os.path.join(root, "lin-kv-tpu", "latest")
+
+
+def test_cli_export_stdout_maps(lin_kv_store, capsys):
+    rc = cli_main(["export", lin_kv_store, "-o", "-", "--maps"])
     assert rc == 0
     lines = [l for l in capsys.readouterr().out.splitlines()
              if l.strip()]
     assert lines and all(l.startswith("{:") for l in lines)
 
 
-def test_cli_export_stdout_vector(capsys):
-    src = os.path.join(REPO, "store", "lin-kv", "latest")
-    rc = cli_main(["export", src, "-o", "-"])
+def test_cli_export_stdout_vector(lin_kv_store, capsys):
+    rc = cli_main(["export", lin_kv_store, "-o", "-"])
     assert rc == 0
     out = capsys.readouterr().out
     whole = loads(out)

@@ -1,0 +1,274 @@
+"""Flight-recorder telemetry: device histograms vs numpy oracles, fleet
+aggregation, the fleet-stats CLI, and the lint-gate guarantee that the
+telemetry carry is itself TRC/CON-clean (the first consumer-scale test
+of the PR 1 contract audit)."""
+
+import glob
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from maelstrom_tpu.models.echo import EchoModel
+from maelstrom_tpu.telemetry.fleet import (bucket_upper_ticks,
+                                           fleet_summary, hist_quantile)
+from maelstrom_tpu.telemetry.recorder import (TelemetryConfig,
+                                              latency_bucket)
+from maelstrom_tpu.tpu.harness import make_sim_config, run_tpu_test
+from maelstrom_tpu.tpu.runtime import run_sim
+
+pytestmark = pytest.mark.telemetry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+ECHO_OPTS = dict(node_count=2, concurrency=2, n_instances=8,
+                 record_instances=8, time_limit=1.0, rate=100.0,
+                 latency=5.0, p_loss=0.2, rpc_timeout=0.2,
+                 nemesis=["partition"], nemesis_interval=0.2,
+                 recovery_time=0.2, seed=5)
+
+
+def np_bucket(lat, buckets):
+    """Independent numpy restatement of recorder.latency_bucket."""
+    lat = max(int(lat), 0)
+    b = 0
+    for k in range(1, buckets):
+        if lat + 1 >= 2 ** k:
+            b += 1
+    return b
+
+
+def test_latency_bucket_exact_vs_oracle():
+    cfg = TelemetryConfig(hist_buckets=8)
+    lats = jnp.asarray([0, 1, 2, 3, 4, 6, 7, 14, 15, 62, 126, 127,
+                        1000, 10 ** 6, -3], jnp.int32)
+    got = np.asarray(latency_bucket(lats, cfg))
+    want = [np_bucket(int(x), 8) for x in np.asarray(lats)]
+    assert got.tolist() == want
+    # bucket k's inclusive range is [2^k - 1, 2^(k+1) - 2]
+    uppers = bucket_upper_ticks(8)
+    for k in range(7):
+        assert np_bucket(uppers[k], 8) == k
+        assert np_bucket(uppers[k] + 1, 8) == k + 1
+
+
+def test_hist_quantile_vs_numpy_oracle():
+    rng = np.random.default_rng(0)
+    for _ in range(20):
+        counts = rng.integers(0, 9, size=12)
+        if counts.sum() == 0:
+            assert hist_quantile(counts, 0.5) is None
+            continue
+        expanded = np.repeat(np.arange(12), counts)
+        n = len(expanded)
+        for q in (0.5, 0.95, 0.99, 1.0):
+            i = min(n - 1, int(q * n))
+            assert hist_quantile(counts, q) == int(np.sort(expanded)[i])
+
+
+def test_telemetry_pytree_round_trips_scan_and_eval_shape():
+    """The telemetry carry is a shape fixed point of the tick — through
+    jax.eval_shape AND a real (tiny) lax.scan — and vanishes entirely
+    when disabled."""
+    from maelstrom_tpu.tpu.runtime import init_carry, make_tick_fn
+
+    model = EchoModel()
+    sim = make_sim_config(model, dict(
+        node_count=2, concurrency=2, n_instances=4, record_instances=2,
+        time_limit=0.05, rate=100.0, latency=2.0, layout="lead"))
+    params = model.make_params(sim.net.n_nodes)
+    c0 = init_carry(model, sim, 0, params)
+    assert c0.telemetry is not None
+    tick = make_tick_fn(model, sim, params)
+    c1, _ = jax.eval_shape(tick, c0, jax.ShapeDtypeStruct((), jnp.int32))
+    for (pa, a), (pb, b) in zip(
+            jax.tree_util.tree_flatten_with_path(c0.telemetry)[0],
+            jax.tree_util.tree_flatten_with_path(c1.telemetry)[0]):
+        assert a.shape == b.shape and a.dtype == b.dtype, pa
+    cN, _ = jax.lax.scan(tick, c0, jnp.arange(10, dtype=jnp.int32))
+    assert int(jnp.sum(cN.telemetry.sent)) >= 0
+
+    sim_off = make_sim_config(model, dict(
+        node_count=2, concurrency=2, n_instances=4, record_instances=2,
+        time_limit=0.05, rate=100.0, latency=2.0, layout="lead",
+        telemetry=False))
+    assert init_carry(model, sim_off, 0, params).telemetry is None
+
+
+@pytest.fixture(scope="module")
+def echo_run(tmp_path_factory):
+    """One echo fleet with EVERY instance recorded, so device telemetry
+    is checkable against the decoded journal, plus its store artifacts."""
+    store = str(tmp_path_factory.mktemp("telemetry-store"))
+    res = run_tpu_test(EchoModel(), dict(ECHO_OPTS, store_root=store))
+    run_dir = res["store-dir"]
+    histories = []
+    for p in sorted(glob.glob(os.path.join(run_dir, "history-*.jsonl"))):
+        histories.append([json.loads(l) for l in open(p) if l.strip()])
+    with open(os.path.join(run_dir, "fleet-metrics.json")) as f:
+        metrics = json.load(f)
+    return res, histories, metrics, run_dir
+
+
+def test_fleet_totals_match_device_counters(echo_run):
+    res, histories, metrics, _ = echo_run
+    t = metrics["totals"]
+    assert t["sent"] == res["net"]["sent"]
+    assert t["delivered"] == res["net"]["delivered"]
+    assert t["dropped-partition"] == res["net"]["dropped-partition"]
+    assert t["dropped-loss"] == res["net"]["dropped-loss"]
+    assert t["dropped-overflow"] == res["net"]["dropped-overflow"]
+    assert t["dropped-loss"] > 0          # the config exercises loss
+    assert metrics["nemesis"]["epochs-max"] >= 1
+
+
+def test_fleet_counts_and_quantiles_match_journal_oracle(echo_run):
+    """The acceptance bar: per-fleet invoke/ack counts and the
+    ticks-to-ack histogram + quantiles in fleet-metrics.json must match
+    a pure-numpy recomputation from the decoded histories (every
+    instance is recorded here, so the journal covers the fleet)."""
+    from maelstrom_tpu.gen.history import pairs
+
+    res, histories, metrics, _ = echo_run
+    mpt = metrics["ms-per-tick"]
+    buckets = len(metrics["latency-hist"]["fleet-counts"])
+    all_lats = []
+    n_invokes = n_acks = 0
+    oracle_hist = np.zeros(buckets, dtype=np.int64)
+    for h in histories:
+        for p in pairs(h):
+            inv, comp = p["invoke"], p["complete"]
+            n_invokes += 1
+            if comp is None or comp["type"] != "ok":
+                continue
+            n_acks += 1
+            lat = round((comp["time"] - inv["time"]) / (mpt * 1e6))
+            all_lats.append(lat)
+            oracle_hist[np_bucket(lat, buckets)] += 1
+    assert n_invokes == metrics["totals"]["invokes"] > 0
+    assert n_acks == metrics["totals"]["acks"] > 0
+    assert oracle_hist.tolist() == metrics["latency-hist"]["fleet-counts"]
+    uppers = bucket_upper_ticks(buckets)
+    srt = sorted(all_lats)
+    for q in (0.5, 0.95, 0.99, 1.0):
+        i = min(len(srt) - 1, int(q * len(srt)))
+        assert metrics["latency-ticks"][str(q)] \
+            == uppers[np_bucket(srt[i], buckets)], q
+
+
+def test_per_instance_histograms_match_each_history(echo_run):
+    """Stronger than the fleet check: instance i's device histogram is
+    exactly the bucketed ok-latencies of instance i's own history."""
+    from maelstrom_tpu.gen.history import pairs
+    from maelstrom_tpu.models.echo import EchoModel as _E
+
+    res, histories, metrics, _ = echo_run
+    sim = make_sim_config(_E(), ECHO_OPTS)
+    carry, _ys = run_sim(_E(), sim, ECHO_OPTS["seed"],
+                         _E().make_params(sim.net.n_nodes))
+    hist = np.asarray(carry.telemetry.rpc_hist)
+    buckets = hist.shape[1]
+    for i, h in enumerate(histories):
+        oracle = np.zeros(buckets, dtype=np.int64)
+        for p in pairs(h):
+            comp = p["complete"]
+            if comp is None or comp["type"] != "ok":
+                continue
+            lat = round((comp["time"] - p["invoke"]["time"]) / 1e6)
+            oracle[np_bucket(lat, buckets)] += 1
+        assert hist[i].tolist() == oracle.tolist(), f"instance {i}"
+
+
+def test_series_windows_sum_to_totals(echo_run):
+    res, histories, metrics, _ = echo_run
+    ser = metrics["series"]
+    windows = np.asarray(ser["windows"], dtype=np.int64)
+    lanes = {n: i for i, n in enumerate(ser["lanes"])}
+    for name in ("delivered", "sent", "invokes", "acks"):
+        assert int(windows[:, lanes[name]].sum()) \
+            == metrics["totals"][name], name
+
+
+def test_fleet_stats_cli_smoke(echo_run, capsys):
+    from maelstrom_tpu.cli import main as cli_main
+
+    _res, _h, metrics, run_dir = echo_run
+    rc = cli_main(["fleet-stats", run_dir])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "ticks-to-ack" in out and "dropped" in out
+    for name in ("fleet-rate.svg", "fleet-drops.svg",
+                 "fleet-latency.svg", "fleet-metrics.json"):
+        p = os.path.join(run_dir, name)
+        assert os.path.exists(p) and os.path.getsize(p) > 100, name
+    # a bogus path is a clean error, not a traceback
+    assert cli_main(["fleet-stats", os.path.join(run_dir, "nope")]) == 2
+
+
+def test_no_telemetry_run_has_no_artifacts(tmp_path):
+    res = run_tpu_test(EchoModel(), dict(
+        node_count=2, concurrency=2, n_instances=4, record_instances=2,
+        time_limit=0.3, rate=100.0, latency=5.0, seed=3,
+        telemetry=False, store_root=str(tmp_path)))
+    assert "telemetry" not in res
+    assert not os.path.exists(os.path.join(res["store-dir"],
+                                           "fleet-metrics.json"))
+
+
+def test_telemetry_carry_is_lint_clean():
+    """The lint-gate satellite: the flight recorder is a traced surface
+    and must be TRC-clean by the PR 1 rules, and the telemetry-bearing
+    tick carry must audit CON-clean (fixed point, lane contracts)."""
+    from maelstrom_tpu.analysis.contract_audit import audit_model
+    from maelstrom_tpu.analysis.trace_lint import run_trace_lint
+
+    findings = run_trace_lint(
+        REPO, ["maelstrom_tpu/telemetry/recorder.py"])
+    assert findings == [], [f.message for f in findings]
+    audit = audit_model(EchoModel(), 2)
+    assert audit == [], [f.message for f in audit]
+
+
+@pytest.mark.slow
+def test_telemetry_overhead_bounded():
+    """Steady-state tick-loop overhead of the flight recorder, measured
+    compile-free on a bench-like echo config. The acceptance bar is 10%;
+    the assert allows CI scheduling noise on top (the measured ratio is
+    printed and recorded in doc/observability.md)."""
+    import time
+
+    from maelstrom_tpu.tpu.runtime import init_carry, make_tick_fn
+
+    model = EchoModel()
+    opts = dict(node_count=2, concurrency=4, n_instances=256,
+                record_instances=1, time_limit=0.5, rate=200.0,
+                latency=5.0, seed=7)
+
+    def run_one(telemetry):
+        sim = make_sim_config(model, dict(opts, telemetry=telemetry))
+        params = model.make_params(sim.net.n_nodes)
+        tick = make_tick_fn(model, sim, params)
+
+        @jax.jit
+        def scan(c):
+            return jax.lax.scan(
+                tick, c, jnp.arange(sim.n_ticks, dtype=jnp.int32))[0]
+
+        carry = init_carry(model, sim, 7, params)
+        jax.block_until_ready(scan(carry))        # compile + warm
+        best = float("inf")
+        for _ in range(2):
+            t0 = time.monotonic()
+            jax.block_until_ready(scan(carry))
+            best = min(best, time.monotonic() - t0)
+        return best
+
+    base = run_one(False)
+    with_tel = run_one(True)
+    ratio = with_tel / base
+    print(f"telemetry overhead: {base:.3f}s -> {with_tel:.3f}s "
+          f"(x{ratio:.3f})")
+    assert ratio < 1.25, (base, with_tel)

@@ -21,6 +21,19 @@ if [[ "${1:-}" == "--lint-only" ]]; then
 fi
 
 echo
+echo "== fleet-stats smoke (tiny echo run -> telemetry report)"
+SMOKE_STORE="$(mktemp -d)"
+trap 'rm -rf "$SMOKE_STORE"' EXIT
+python -m maelstrom_tpu test --runtime tpu -w echo --node-count 2 \
+    --time-limit 0.5 --rate 100 --n-instances 8 --record-instances 2 \
+    --seed 3 --store "$SMOKE_STORE" >/dev/null
+python -m maelstrom_tpu fleet-stats "$SMOKE_STORE"/echo-tpu/latest --no-svg
+test -s "$SMOKE_STORE"/echo-tpu/latest/fleet-metrics.json
+# clean up before the exec below — bash runs no EXIT trap across exec
+rm -rf "$SMOKE_STORE"
+trap - EXIT
+
+echo
 echo "== tier-1 pytest (-m 'not slow')"
 exec python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider

@@ -95,8 +95,11 @@ def cmd_run(out_path: str) -> None:
         t += use
         # digest the CANONICAL (batch-leading) orientation: digests are
         # index-weighted, so this keeps captures comparable across both
-        # carry layouts (runtime.SimConfig.layout) and across rounds
-        d = digest_tree(canonical_carry(carry, sim))
+        # carry layouts (runtime.SimConfig.layout) and across rounds.
+        # The flight recorder is derived state — excluded so digests
+        # stay comparable with pre-telemetry captures in artifacts/
+        d = digest_tree(canonical_carry(carry, sim)
+                        ._replace(telemetry=None))
         checkpoints.append({"tick": t, "digest": d})
         print(f"xval: tick {t}/{n_ticks}", file=sys.stderr, flush=True)
 
