@@ -216,25 +216,85 @@ def child_main(canary: bool = False) -> None:
                  f"{bytes_per_instance} B/instance "
                  f"({carry_bytes / 1e6:.1f} MB carry total)")
 
-        tick_fn = make_tick_fn(model, sim, params)
-
         # init_carry may alias identical buffers across leaves (broadcast
         # zeros); donation requires each argument buffer to be distinct.
         carry = jax.tree.map(lambda x: x.copy(), carry)
 
-        @lru_cache(maxsize=None)
-        def chunk_fn(length: int, _tick_fn=tick_fn):
-            @partial(jax.jit, donate_argnums=0)
-            def run(c, t0):
-                c, _ = jax.lax.scan(
-                    _tick_fn, c, t0 + jnp.arange(length, dtype=jnp.int32))
-                return c
-            return run
+        # pipelined executor (tpu/pipeline.py) by default: donated
+        # chunked dispatches emitting compacted event buffers, with the
+        # previous chunk's stats/event fetch overlapping the next
+        # chunk's device compute. BENCH_PIPELINE=0 reverts to the
+        # monolithic-chunk path (no event stream, sync per chunk) for
+        # A/B. Trajectories are bit-identical either way.
+        bench_pipeline = os.environ.get("BENCH_PIPELINE") != "0"
+        bench_unroll = int(os.environ.get("BENCH_UNROLL", "1"))
+        pipe_bytes = {"fetched": 0, "overflowed": 0}
+        if bench_pipeline:
+            from maelstrom_tpu.tpu.pipeline import (
+                _make_chunk_fn, compact_payload_bytes,
+                fetch_compact_payload)
+            # cap=None: the compacted buffer is sized per (static)
+            # dispatch length — the bench adapts its chunk size to the
+            # dispatch budget at run time
+            pchunk = _make_chunk_fn(model, sim, params, None, None,
+                                    bench_unroll)
+
+            def chunk_fn(length: int):
+                def run(c, t0):
+                    c, svec, buf, _ = pchunk(c, t0, length)
+                    return c, svec, buf
+                return run
+
+            def fetch_payload(svec, buf):
+                """Fetch one chunk's detached stats + compacted events
+                (overlappable — touches no donated buffer). Returns
+                (sent, delivered, ovf)."""
+                rows, n, overflowed = fetch_compact_payload(buf)
+                pipe_bytes["fetched"] += compact_payload_bytes(rows)
+                pipe_bytes["cap"] = max(pipe_bytes.get("cap", 0),
+                                        rows.shape[0])
+                pipe_bytes["overflowed"] += int(overflowed)
+                s = np.asarray(svec)
+                return int(s[0]), int(s[1]), int(s[4])
+        else:
+            tick_fn = make_tick_fn(model, sim, params)
+
+            @lru_cache(maxsize=None)
+            def chunk_fn(length: int, _tick_fn=tick_fn):
+                @partial(jax.jit, donate_argnums=0)
+                def run(c, t0):
+                    c, _ = jax.lax.scan(
+                        _tick_fn, c,
+                        t0 + jnp.arange(length, dtype=jnp.int32))
+                    return c
+                return run
+
+        import numpy as np
+
+        def step_chunk(c, length: int, t0: int):
+            """One dispatch; returns (carry', payload-or-None)."""
+            if bench_pipeline:
+                c, svec, buf = chunk_fn(length)(c, jnp.int32(t0))
+                return c, (svec, buf)
+            return chunk_fn(length)(c, jnp.int32(t0)), None
+
+        def sync_stats(c, payload):
+            """(sent, delivered, ovf) — from the detached pipeline
+            payload when present, else by blocking on the carry."""
+            if payload is not None:
+                return fetch_payload(*payload)
+            return (int(c.stats.sent), int(c.stats.delivered),
+                    int(c.stats.dropped_overflow))
+
+        dense_chunk_bytes = (sim.record_instances
+                             * sim.client.n_clients * 2
+                             * (2 + model.ev_vals) * 4)
 
         def emit(delivered_timed: int, delivered: int, sent: int,
                  ovf: int, ticks_done: int, wall: float,
                  provisional: bool = False,
-                 complete: bool = False, funnel=None) -> None:
+                 complete: bool = False, funnel=None,
+                 with_latency: bool = True) -> None:
             # `value` = delivered_timed / wall_s (both fields present, so
             # the metric is recomputable); `delivered`/`sent`/
             # `dropped_overflow`/`sim_ticks` are cumulative run totals
@@ -262,7 +322,20 @@ def child_main(canary: bool = False) -> None:
                 "wall_s": round(wall, 3),
                 "bytes_per_instance": int(bytes_per_instance),
             }
-            lat = _latency_ticks(carry)
+            if bench_pipeline:
+                rec["pipeline"] = True
+                rec["event_capacity"] = pipe_bytes.get("cap", 0)
+                rec["event_bytes_fetched"] = pipe_bytes["fetched"]
+                rec["event_bytes_dense"] = ticks_done * dense_chunk_bytes
+                if pipe_bytes["fetched"]:
+                    rec["fetch_reduction_x"] = round(
+                        rec["event_bytes_dense"] / pipe_bytes["fetched"],
+                        1)
+                rec["overflowed_chunks"] = pipe_bytes["overflowed"]
+            # latency quantiles read the live carry's histogram — a
+            # device sync, so the overlapped timed loop defers it to
+            # the final (blocked-anyway) line
+            lat = _latency_ticks(carry) if with_latency else None
             if lat is not None:
                 rec["latency_ticks"] = lat
             if provisional:
@@ -284,19 +357,18 @@ def child_main(canary: bool = False) -> None:
         W = min(32, n_ticks)
         log(TAG, f"phase[{cfg_name}]: compile + warm-up ({W} ticks)")
         t0 = time.monotonic()
-        carry = chunk_fn(W)(carry, jnp.int32(0))
+        carry, payload = step_chunk(carry, W, 0)
         ticks = W
-        delivered = int(carry.stats.delivered)  # blocks until ready
+        sent, delivered, ovf = sync_stats(carry, payload)  # blocks
         warm_wall = time.monotonic() - t0
         log(TAG, f"phase[{cfg_name}]: warm-up chunk done in "
                  f"{warm_wall:.1f}s ({delivered} delivered incl. compile)")
-        emit(delivered, delivered, int(carry.stats.sent),
-             int(carry.stats.dropped_overflow), ticks, warm_wall,
+        emit(delivered, delivered, sent, ovf, ticks, warm_wall,
              provisional=True)
         if ticks + W <= n_ticks:
             t1 = time.monotonic()
-            carry = chunk_fn(W)(carry, jnp.int32(ticks))
-            delivered = int(carry.stats.delivered)
+            carry, payload = step_chunk(carry, W, ticks)
+            sent, delivered, ovf = sync_stats(carry, payload)
             per_tick = (time.monotonic() - t1) / W
             ticks += W
         else:
@@ -313,8 +385,8 @@ def child_main(canary: bool = False) -> None:
         if L > W and ticks + L <= n_ticks:
             t1 = time.monotonic()
             base = delivered
-            carry = chunk_fn(L)(carry, jnp.int32(ticks))
-            delivered = int(carry.stats.delivered)
+            carry, payload = step_chunk(carry, L, ticks)
+            sent, delivered, ovf = sync_stats(carry, payload)
             ticks += L
             wall = time.monotonic() - t1
             log(TAG, f"phase[{cfg_name}]: {L}-tick chunk compiled + run "
@@ -322,34 +394,60 @@ def child_main(canary: bool = False) -> None:
             # compile-inclusive, but on a short horizon this may be the
             # only post-warm-up measurement — emit it (the timed loop's
             # lines, if any, supersede it as the last line per config)
-            emit(delivered - base, delivered, int(carry.stats.sent),
-                 int(carry.stats.dropped_overflow), ticks, wall,
+            emit(delivered - base, delivered, sent, ovf, ticks, wall,
                  provisional=True, complete=(ticks + W > n_ticks))
 
         # Timed window: chunked dispatches, cumulative metric re-emitted
         # after every chunk (the parent keeps the last line per config,
-        # so a mid-run tunnel death still yields a real number). A tail
-        # shorter than W is dropped rather than compiled-for; sim_ticks
-        # reports the ticks actually run.
+        # so a mid-run tunnel death still yields a real number). On the
+        # pipelined path chunk k's stats/event fetch happens AFTER
+        # chunk k+1 is dispatched, so the host work overlaps device
+        # compute and the loop never blocks on the in-flight chunk. A
+        # tail shorter than W is dropped rather than compiled-for;
+        # sim_ticks reports the ticks actually run.
         delivered0 = delivered
         t_start = time.monotonic()
         wall = 0.0
+        pending = None   # (payload, cumulative-ticks-after-that-chunk)
+
+        def drain_and_emit(done_payload, done_ticks, final=False):
+            nonlocal sent, delivered, ovf, wall
+            sent, delivered, ovf = fetch_payload(*done_payload)
+            wall = time.monotonic() - t_start
+            value = (delivered - delivered0) / wall if wall > 0 else 0.0
+            log(TAG, f"phase[{cfg_name}]: tick {done_ticks}/{n_ticks} — "
+                     f"cumulative {value:,.0f} msgs/s over {wall:.2f}s")
+            emit(delivered - delivered0, delivered, sent, ovf,
+                 done_ticks, wall, complete=(done_ticks + W > n_ticks),
+                 with_latency=final)
+
         while ticks < n_ticks:
             rem = n_ticks - ticks
             use = L if rem >= L else (W if rem >= W else 0)
             if use == 0:
                 break
-            carry = chunk_fn(use)(carry, jnp.int32(ticks))
+            carry, payload = step_chunk(carry, use, ticks)
             ticks += use
-            delivered = int(carry.stats.delivered)
-            wall = time.monotonic() - t_start
-            value = (delivered - delivered0) / wall if wall > 0 else 0.0
-            log(TAG, f"phase[{cfg_name}]: tick {ticks}/{n_ticks} — "
-                     f"cumulative {value:,.0f} msgs/s over {wall:.2f}s")
-            emit(delivered - delivered0, delivered,
-                 int(carry.stats.sent),
-                 int(carry.stats.dropped_overflow), ticks, wall,
-                 complete=(ticks + W > n_ticks))
+            if payload is None:
+                # monolithic A/B path: sync on the carry per chunk
+                sent, delivered, ovf = sync_stats(carry, None)
+                wall = time.monotonic() - t_start
+                value = ((delivered - delivered0) / wall
+                         if wall > 0 else 0.0)
+                log(TAG, f"phase[{cfg_name}]: tick {ticks}/{n_ticks} — "
+                         f"cumulative {value:,.0f} msgs/s over "
+                         f"{wall:.2f}s")
+                emit(delivered - delivered0, delivered, sent, ovf,
+                     ticks, wall, complete=(ticks + W > n_ticks))
+            else:
+                # pipelined: consume the PREVIOUS chunk while this one
+                # runs on device — the fetch/emit overlaps compute
+                if pending is not None:
+                    drain_and_emit(*pending)
+                pending = (payload, ticks)
+        if pending is not None:
+            # drain the last in-flight chunk (blocks on the device)
+            drain_and_emit(*pending, final=True)
         # funnel at the headline config (VERDICT r4 next #5): replay
         # tripped + sampled instances bit-exactly, full-check each, and
         # re-emit the final line carrying the funnel block

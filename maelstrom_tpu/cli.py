@@ -43,6 +43,14 @@ def _positive_int(value: str) -> int:
     return n
 
 
+def _nonnegative_int(value: str) -> int:
+    n = int(value)
+    if n < 0:
+        raise argparse.ArgumentTypeError(
+            f"must be a non-negative int: {value}")
+    return n
+
+
 def parse_concurrency(value: str, node_count: int) -> int:
     """'10' -> 10, '4n' -> 4 * node_count (core.clj opt-spec parity)."""
     if value.endswith("n"):
@@ -130,6 +138,19 @@ def add_test_options(p: argparse.ArgumentParser):
     p.add_argument("--telemetry-stride", type=int, default=0,
                    help="TPU runtime: ticks per fleet-series window "
                         "(0 = auto, <= 256 windows)")
+    p.add_argument("--pipeline", choices=["auto", "on", "off"],
+                   default="auto",
+                   help="TPU runtime: chunked donated executor "
+                        "(tpu/pipeline.py) — auto pipelines any horizon "
+                        "spanning multiple chunks; results are "
+                        "bit-identical either way")
+    p.add_argument("--chunk-ticks", type=_positive_int, default=100,
+                   help="TPU runtime: ticks per pipelined device "
+                        "dispatch")
+    p.add_argument("--event-capacity", type=_nonnegative_int, default=0,
+                   help="TPU runtime: compacted event rows per chunk "
+                        "(0 = auto from the client rate; overflow is "
+                        "flagged in results.perf.phases.pipeline)")
     p.add_argument("--profile-dir", default=None,
                    help="TPU runtime: capture a jax.profiler trace of "
                         "the run into this directory")
@@ -298,6 +319,9 @@ def cmd_test(args) -> int:
             journal_instances=args.journal_instances,
             telemetry=not args.no_telemetry,
             telemetry_stride=args.telemetry_stride,
+            pipeline=args.pipeline,
+            chunk_ticks=args.chunk_ticks,
+            event_capacity=args.event_capacity,
             profile_dir=args.profile_dir,
             store_root=args.store,
             seed=args.seed or 0)

@@ -16,13 +16,14 @@ XLA inserting the collectives.
 from __future__ import annotations
 
 from functools import partial
-from typing import Optional, Tuple
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..tpu.runtime import Carry, Model, NetStats, SimConfig, simulate
+from ..telemetry.recorder import Telemetry
 
 AXIS = "instances"
 
@@ -69,13 +70,45 @@ def make_mesh(n_devices: Optional[int] = None) -> Mesh:
     return Mesh(np.array(devs), (AXIS,))
 
 
+def _empty_events(model: Model, sim: SimConfig, n_ticks=None):
+    """Zero-size dense event block for record_instances == 0 shards —
+    the tick fns emit no events ys at all then (TickOutputs.events is
+    None), but a uniform array must still cross the shard_map wire."""
+    ticks = sim.n_ticks if n_ticks is None else n_ticks
+    return jnp.zeros((ticks, 0, sim.client.n_clients, 2,
+                      2 + model.ev_vals), jnp.int32)
+
+
+def _tel_out_spec(tel: Telemetry, axes):
+    """Per-instance telemetry leaves concatenate across shards; the
+    fleet series buffer is shard-local and comes back psum'd."""
+    spec = jax.tree.map(lambda _: P(axes), tel)
+    return spec._replace(series=P())
+
+
+def merge_unsharded_telemetry(tels):
+    """Host-side equivalent of the shard_map telemetry merge: concat
+    the per-instance leaves across shards, sum the fleet series (the
+    oracle side of the sharded-telemetry equivalence tests)."""
+    import numpy as np
+    tels = list(tels)
+    merged = jax.tree.map(lambda *xs: np.concatenate(
+        [np.asarray(x) for x in xs], axis=0), *tels)
+    return merged._replace(series=sum(np.asarray(t.series)
+                                      for t in tels))
+
+
 @partial(jax.jit, static_argnames=("model", "sim", "mesh"))
 def _run_sharded(model: Model, sim: SimConfig, mesh: Mesh, seeds, params):
     """seeds: int32 shaped like ``mesh.devices``; ``sim`` describes the
     PER-DEVICE shard. Works for any mesh rank — stats psum over every
     mesh axis, sharded outputs split over all axes jointly (so a 1-D
-    ICI mesh and a 2-D DCN x ICI hybrid mesh share this code path)."""
+    ICI mesh and a 2-D DCN x ICI hybrid mesh share this code path).
+    Returns (stats, violations, events, telemetry) where telemetry is
+    the MERGED per-instance recorder (instance leaves concatenated over
+    shards, fleet series psum'd) or None when telemetry is off."""
     axes = mesh.axis_names
+    with_tel = sim.telemetry.enabled
 
     def shard_body(seed_shard, params_rep):
         with jax.named_scope("simulate_shard"):
@@ -85,41 +118,69 @@ def _run_sharded(model: Model, sim: SimConfig, mesh: Mesh, seeds, params):
         with jax.named_scope("psum_stats"):
             for ax in axes:
                 stats = jax.tree.map(lambda x: jax.lax.psum(x, ax), stats)
-        return stats, carry.violations, ys.events
+        events = (ys.events if ys.events is not None
+                  else _empty_events(model, sim))
+        if not with_tel:
+            return stats, carry.violations, events
+        tel = carry.telemetry
+        with jax.named_scope("psum_series"):
+            series = tel.series
+            for ax in axes:
+                series = jax.lax.psum(series, ax)
+        return stats, carry.violations, events, tel._replace(
+            series=series)
+
+    out_specs = (P(), P(axes), P(None, axes))
+    if with_tel:
+        from ..telemetry.recorder import init_telemetry
+        tel_template = jax.eval_shape(
+            lambda: init_telemetry(sim.n_instances, sim.telemetry))
+        out_specs = out_specs + (_tel_out_spec(tel_template, axes),)
 
     # zero-initialized carry components are unvaried constants while the
     # seed-derived ones vary per shard; check_vma would reject the scan
     # carry mix, and everything here is embarrassingly parallel anyway
-    return _shard_map(
+    out = _shard_map(
         shard_body, mesh=mesh,
         in_specs=(P(*axes), P()),
-        out_specs=(P(), P(axes), P(None, axes)),
+        out_specs=out_specs,
     )(seeds, params)
+    if not with_tel:
+        return out + (None,)
+    return out
 
 
 def run_sim_unsharded(model: Model, sim: SimConfig, seed: int,
-                      n_shards: int, params=None
-                      ) -> Tuple[NetStats, "jnp.ndarray", "jnp.ndarray"]:
+                      n_shards: int, params=None,
+                      return_telemetry: bool = False):
     """The equivalence oracle for :func:`run_sim_sharded`: replay every
     shard's ``simulate`` serially on one device with the identical
     per-shard seeds and accumulate the same (stats, violations, events)
-    triple. A sharded run must match this bit-for-bit — shard_map and
+    triple — plus, with ``return_telemetry``, the merged per-instance
+    recorder. A sharded run must match this bit-for-bit — shard_map and
     collective placement may change performance, never results."""
     import numpy as np
 
     if params is None:
         params = model.make_params(sim.net.n_nodes)
     run_one = jax.jit(lambda s: simulate(model, sim, s, params))
-    stats, viol, evs = None, [], []
+    stats, viol, evs, tels = None, [], [], []
     for s in shard_seeds(seed, n_shards):
         carry_u, ys_u = run_one(jnp.int32(s))
         st = jax.tree.map(int, carry_u.stats)
         stats = st if stats is None else jax.tree.map(
             lambda a, b: a + b, stats, st)
         viol.append(np.asarray(carry_u.violations))
-        evs.append(np.asarray(ys_u.events))
-    return (NetStats(*stats), np.concatenate(viol, axis=0),
-            np.concatenate(evs, axis=1))
+        evs.append(np.asarray(ys_u.events)
+                   if ys_u.events is not None
+                   else np.asarray(_empty_events(model, sim)))
+        if carry_u.telemetry is not None:
+            tels.append(carry_u.telemetry)
+    out = (NetStats(*stats), np.concatenate(viol, axis=0),
+           np.concatenate(evs, axis=1))
+    if return_telemetry:
+        out = out + (merge_unsharded_telemetry(tels) if tels else None,)
+    return out
 
 
 def _carry_to_wire(c: Carry, sim: SimConfig) -> Carry:
@@ -165,8 +226,9 @@ def _carry_from_wire(w: Carry, sim: SimConfig) -> Carry:
 
 def run_sim_sharded_chunked(model: Model, sim: SimConfig, seed: int,
                             params=None, mesh: Optional[Mesh] = None,
-                            chunk: int = 100
-                            ) -> Tuple[NetStats, jnp.ndarray, jnp.ndarray]:
+                            chunk: int = 100,
+                            return_telemetry: bool = False,
+                            perf: Optional[dict] = None):
     """:func:`run_sim_sharded` issued as a sequence of ``chunk``-tick
     device dispatches — the production dispatch pattern (single giant
     dispatches fault the TPU tunnel; see bench.py) — with the carry left
@@ -174,25 +236,27 @@ def run_sim_sharded_chunked(model: Model, sim: SimConfig, seed: int,
     single-scan path by construction (the tick function depends only on
     (carry, t)), which :func:`run_sim_unsharded` then verifies.
 
-    Returns the same (psum'd NetStats, violations, events) triple;
-    events are concatenated on host along the tick axis.
+    The dispatch loop is the shared chunk executor
+    (:func:`..tpu.pipeline.run_chunked`): chunk *k*'s events are
+    fetched while chunk *k + 1* runs on device, the wire carry is
+    donated between dispatches, and chunk plans prefer a divisor of the
+    horizon so every dispatch shares one compile. Pass a dict as
+    ``perf`` to receive the driver's dispatch/fetch overlap stats.
+
+    Returns the same (psum'd NetStats, violations, events) triple —
+    events concatenated on host along the tick axis — plus the merged
+    per-instance telemetry when ``return_telemetry`` is set.
     """
     import numpy as np
+
+    from ..tpu.pipeline import plan_chunks, run_chunked
+    from ..tpu.runtime import init_carry, make_tick_fn
 
     mesh = mesh or make_mesh()
     mesh, seeds, params = _prepare(model, sim, seed, mesh, params)
     axes = mesh.axis_names
 
-    from ..tpu.runtime import init_carry, make_tick_fn
-
-    # a trailing partial chunk would force a SECOND full compile of
-    # chunk_fn (scan length is static); prefer a nearby divisor of the
-    # horizon so every dispatch shares one compile
-    if sim.n_ticks % chunk:
-        for c in range(chunk, max(chunk // 2, 1), -1):
-            if sim.n_ticks % c == 0:
-                chunk = c
-                break
+    plans = plan_chunks(sim.n_ticks, chunk)
 
     dummy_w = jax.eval_shape(
         lambda p: _carry_to_wire(init_carry(model, sim, 0, p), sim),
@@ -216,42 +280,66 @@ def run_sim_sharded_chunked(model: Model, sim: SimConfig, seed: int,
             carry, ys = jax.lax.scan(
                 tick, carry,
                 t0_rep.reshape(()) + jnp.arange(length, dtype=jnp.int32))
-            return _carry_to_wire(carry, sim), ys.events
+            events = (ys.events if ys.events is not None
+                      else _empty_events(model, sim, length))
+            return _carry_to_wire(carry, sim), events
         return _shard_map(
             body, mesh=mesh,
             in_specs=(wire_spec, P(), P()),
             out_specs=(wire_spec, P(None, axes)))(wire, t0, params)
 
-    wire = init_fn(seeds, params)
     events_chunks = []
-    t = 0
-    while t < sim.n_ticks:
-        use = min(chunk, sim.n_ticks - t)
-        wire, events = chunk_fn(wire, jnp.int32(t), params, use)
+
+    def dispatch(w, t0, length):
+        return chunk_fn(w, jnp.int32(t0), params, length)
+
+    def consume(events, t0, length):
         events_chunks.append(np.asarray(events))
-        t += use
+
+    wire, chunk_stats = run_chunked(init_fn(seeds, params), plans,
+                                    dispatch, consume)
+    if perf is not None:
+        perf.update(chunk_stats)
 
     # final: per-shard stats summed on host (stats crossed the boundary
     # as [n_shards]-length arrays, one slot per shard)
     stats = NetStats(*(int(jnp.sum(x)) for x in wire.stats))
     violations = np.asarray(wire.violations)
-    return stats, violations, np.concatenate(events_chunks, axis=0)
+    out = (stats, violations, np.concatenate(events_chunks, axis=0))
+    if return_telemetry:
+        tel = wire.telemetry
+        if tel is not None:
+            # wire format: per-instance leaves already concatenated
+            # across shards; the series buffer crossed as one
+            # [n_shards, n_windows, lanes] block — fleet-merge it
+            tel = jax.tree.map(np.asarray, tel)
+            tel = tel._replace(series=tel.series.sum(axis=0))
+        out = out + (tel,)
+    return out
 
 
 def run_sim_sharded(model: Model, sim: SimConfig, seed: int, params=None,
-                    mesh: Optional[Mesh] = None
-                    ) -> Tuple[NetStats, jnp.ndarray, jnp.ndarray]:
+                    mesh: Optional[Mesh] = None,
+                    return_telemetry: bool = False):
     """Run one ``sim``-sized shard per device across the mesh (any
     rank; default the 1-D local-device mesh).
 
     Returns (fleet-wide NetStats summed over devices, per-instance
     on-device invariant-violation tick counts
     [n_instances * n_devices], events [T, R * n_devices, C, 2,
-    2 + model.ev_vals]).
+    2 + model.ev_vals]) — plus, when ``return_telemetry`` is set, the
+    merged per-instance flight recorder: instance-axis leaves
+    concatenated across shards ([n_instances * n_devices] like
+    ``violations``), fleet series psum'd over the mesh (None when
+    telemetry is disabled).
     """
     mesh = mesh or make_mesh()
     mesh, seeds, params = _prepare(model, sim, seed, mesh, params)
-    return _run_sharded(model, sim, mesh, seeds, params)
+    stats, violations, events, tel = _run_sharded(model, sim, mesh,
+                                                  seeds, params)
+    if return_telemetry:
+        return stats, violations, events, tel
+    return stats, violations, events
 
 
 def _prepare(model: Model, sim: SimConfig, seed: int, mesh: Mesh, params):

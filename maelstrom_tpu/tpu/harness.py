@@ -64,6 +64,15 @@ TPU_DEFAULTS = dict(
                               # <= 256 windows whatever the horizon)
     telemetry_hist_buckets=16,  # log2 ticks-to-ack histogram lanes
     profile_dir=None,         # jax.profiler trace capture directory
+    pipeline="auto",          # chunked donated executor (tpu/pipeline.py):
+                              # "auto" uses it whenever the horizon spans
+                              # multiple chunks; "on"/"off" force it. The
+                              # pipelined path is bit-identical to the
+                              # monolithic scan (tests/test_pipeline.py)
+    chunk_ticks=100,          # ticks per pipelined device dispatch
+    event_capacity=0,         # compacted event rows per chunk (0 = auto
+                              # from the client rate; overflow is flagged
+                              # in perf.phases.pipeline, never silent)
     seed=0,
 )
 
@@ -238,6 +247,58 @@ def _phase_timed_run(model: Model, sim: SimConfig, seed: int, params,
     return out, phases
 
 
+def resolve_pipeline(sim: SimConfig, opts: Dict[str, Any]) -> bool:
+    """Decide whether a run takes the chunked pipelined executor
+    (tpu/pipeline.py) or the monolithic single-dispatch scan. ``auto``
+    pipelines any horizon whose chunk plan spans multiple dispatches —
+    single-chunk runs keep the single compile."""
+    mode = opts.get("pipeline", "auto")
+    if mode in (True, "on"):
+        return True
+    if mode in (False, "off", None):
+        return False
+    from .pipeline import plan_chunks
+    return len(plan_chunks(sim.n_ticks,
+                           int(opts.get("chunk_ticks") or 100))) > 1
+
+
+def _pipelined_phase_run(model: Model, sim: SimConfig, seed: int, params,
+                         opts: Dict[str, Any],
+                         profile_dir: Optional[str] = None):
+    """The chunked executor under the same phase-timer/profiler contract
+    as :func:`_phase_timed_run`: returns ((carry, events, journal_sends,
+    journal_recvs), phases) with the per-chunk dispatch/fetch/decode
+    overlap stats under ``phases["pipeline"]``."""
+    import jax
+
+    from .pipeline import run_sim_pipelined
+
+    phases: Dict[str, Any] = {}
+    profiling = False
+    if profile_dir:
+        try:
+            jax.profiler.start_trace(profile_dir)
+            profiling = True
+        except Exception as e:
+            phases["profile-error"] = repr(e)[:160]
+    t0 = time.monotonic()
+    try:
+        res = run_sim_pipelined(
+            model, sim, seed, params,
+            chunk=int(opts.get("chunk_ticks") or 100),
+            event_cap=int(opts.get("event_capacity") or 0) or None)
+    finally:
+        if profiling:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+    phases["total-s"] = round(time.monotonic() - t0, 4)
+    phases["pipeline"] = res.perf
+    return (res.carry, res.events, res.journal_sends,
+            res.journal_recvs), phases
+
+
 def run_tpu_test(model: Model, opts: Optional[Dict[str, Any]] = None,
                  params=None) -> Dict[str, Any]:
     opts = {**TPU_DEFAULTS, **(opts or {})}
@@ -245,11 +306,29 @@ def run_tpu_test(model: Model, opts: Optional[Dict[str, Any]] = None,
     if params is None:
         params = model.make_params(sim.net.n_nodes)
     t0 = time.monotonic()
-    (carry, ys), phases = _phase_timed_run(model, sim, opts["seed"],
-                                           params,
-                                           opts.get("profile_dir"))
-    t_fetch = time.monotonic()
-    events = np.asarray(ys.events)
+    use_pipe = resolve_pipeline(sim, opts)
+    if use_pipe:
+        ((carry, events, journal_sends, journal_recvs),
+         phases) = _pipelined_phase_run(model, sim, opts["seed"], params,
+                                        opts, opts.get("profile_dir"))
+        # the pipelined executor accounted its own (overlapped) event
+        # fetch under phases["pipeline"]; fetch-s below covers only the
+        # telemetry pull + fleet reduction
+        t_fetch = time.monotonic()
+    else:
+        (carry, ys), phases = _phase_timed_run(model, sim, opts["seed"],
+                                               params,
+                                               opts.get("profile_dir"))
+        # fetch-s includes the dense event tensor's device-to-host
+        # transfer on the monolithic path (doc/observability.md)
+        t_fetch = time.monotonic()
+        events = (np.asarray(ys.events) if ys.events is not None
+                  else np.zeros((sim.n_ticks, 0, sim.client.n_clients,
+                                 2, 2 + model.ev_vals), np.int32))
+        journal_sends = (np.asarray(ys.journal_sends)
+                         if ys.journal_sends is not None else None)
+        journal_recvs = (np.asarray(ys.journal_recvs)
+                         if ys.journal_recvs is not None else None)
     fleet = None
     if carry.telemetry is not None:
         import jax
@@ -322,6 +401,12 @@ def run_tpu_test(model: Model, opts: Optional[Dict[str, Any]] = None,
             "phases": phases,
         },
     }
+    pipe_stats = phases.get("pipeline")
+    if pipe_stats and pipe_stats.get("overflowed-chunks"):
+        # a compacted event buffer overflowed: decoded histories are
+        # missing events, so a "valid" verdict must not read as full
+        # coverage (raise event_capacity / lower chunk_ticks to fix)
+        results["events-truncated"] = True
     if fleet is not None:
         # the condensed fleet view rides in results.json; the full dict
         # (series, histograms, per-instance spreads) is the store's
@@ -352,8 +437,8 @@ def run_tpu_test(model: Model, opts: Optional[Dict[str, Any]] = None,
     if sim.journal_instances > 0:
         from ..checkers.net_stats import net_stats_checker
         from .journal import TpuJournal
-        journal = TpuJournal(model, sim.net, np.asarray(ys.journal_sends),
-                             np.asarray(ys.journal_recvs), instance=0,
+        journal = TpuJournal(model, sim.net, journal_sends,
+                             journal_recvs, instance=0,
                              ms_per_tick=opts["ms_per_tick"])
         # instance 0's own drop counters ride along when the flight
         # recorder ran, so the journal block and fleet-metrics.json

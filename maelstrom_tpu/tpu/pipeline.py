@@ -1,0 +1,372 @@
+"""Chunked, donated, double-buffered executor for the device tick loop.
+
+The monolithic path (:func:`..tpu.runtime.run_sim`) issues the whole
+horizon as ONE device dispatch and fetches a dense per-tick event tensor
+``[T, R, C, 2, 2 + ev_vals]`` afterwards — the host checker pipeline
+then runs strictly *after* ``block_until_ready``, and the dense tensor
+is almost entirely empty rows (at the default 100 ops/s fewer than 1%
+of ticks carry an event). This module replaces that with the
+production dispatch pattern:
+
+- the scan is issued in ~``chunk_ticks``-tick chunks, jitted once per
+  chunk length with ``donate_argnums`` on the carry, so the carry
+  buffers are reused in place and never round-trip the host;
+- chunk *k + 1* is dispatched **before** chunk *k*'s outputs are
+  fetched — JAX dispatch is asynchronous, so the host's fetch + decode
+  + check work on chunk *k* overlaps the device compute of chunk
+  *k + 1* (the decoupling/pipelining move of Compartmentalized MultiPaxos,
+  arXiv:2012.15762, applied to the simulator's own dispatch loop);
+- instead of the dense event tensor, each chunk emits a fixed-capacity
+  **compacted** event buffer: one ``[cap, 3 + ev_vals]`` int32 block of
+  ``(tick, loc, etype, vals...)`` rows plus a row count, built on
+  device by a mask prefix-sum scatter (``loc`` packs the dense
+  ``(r, c, slot)`` coordinates). Device scan-ys memory and host fetch
+  bytes drop by the event sparsity (~10x at default record/rate
+  settings), which is what raises the max ticks x instances per chip.
+  Overflow (more events in a chunk than ``cap``) is *flagged*, never
+  silent: the row count keeps counting past the capacity.
+
+Trajectories are bit-identical to the monolithic scan by construction:
+the tick function depends only on ``(carry, t)``, every carry leaf is
+int32/uint32 (no float accumulators), and compaction only *reads* the
+tick's event output. ``tests/test_pipeline.py`` holds both carry
+layouts to that, plus compacted-vs-dense equality and donation safety.
+
+The chunk *driver* (:func:`run_chunked`) is shared with
+``parallel/mesh.py``'s sharded runner so single-device, mesh, and bench
+paths all use one donation-correct loop.
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import Any, Callable, Dict, List, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .runtime import (Carry, EV_NONE, Model, SimConfig, TickOutputs,
+                      default_instance_ids, init_carry, make_tick_fn)
+
+# --- chunk planning -------------------------------------------------------
+
+
+def plan_chunks(n_ticks: int, chunk: int) -> List[Tuple[int, int]]:
+    """Split ``n_ticks`` into ``(t0, length)`` dispatch plans.
+
+    A trailing partial chunk would force a SECOND full compile of the
+    chunk function (scan length is static), so when ``chunk`` does not
+    divide the horizon a nearby divisor (down to ``chunk // 2``) is
+    preferred; failing that, the tail chunk pays one extra compile.
+    """
+    chunk = max(1, min(chunk, n_ticks))
+    if n_ticks % chunk:
+        for c in range(chunk, max(chunk // 2, 1), -1):
+            if n_ticks % c == 0:
+                chunk = c
+                break
+    plans = []
+    t = 0
+    while t < n_ticks:
+        use = min(chunk, n_ticks - t)
+        plans.append((t, use))
+        t += use
+    return plans
+
+
+def run_chunked(state0: Any, plans: List[Tuple[int, int]],
+                dispatch: Callable[[Any, int, int], Tuple[Any, Any]],
+                consume: Optional[Callable[[Any, int, int], None]] = None,
+                ) -> Tuple[Any, Dict[str, float]]:
+    """The double-buffered chunk loop shared by every chunked runner.
+
+    ``dispatch(state, t0, length) -> (state, payload)`` issues one
+    (asynchronous) device chunk; ``consume(payload, t0, length)``
+    fetches/decodes chunk *k*'s payload and is called AFTER chunk
+    *k + 1* has been dispatched, so host-side consumption overlaps
+    device compute. Returns the final state and wall-clock stats:
+    ``first-dispatch-s`` (compile-inclusive), ``dispatch-s`` (steady
+    issue time), ``consume-s`` (host fetch + decode).
+    """
+    stats = {"chunks": len(plans), "first-dispatch-s": 0.0,
+             "dispatch-s": 0.0, "consume-s": 0.0}
+    st = state0
+    pending: Optional[Tuple[Any, int, int]] = None
+    for i, (t0, length) in enumerate(plans):
+        tick0 = time.monotonic()
+        st, payload = dispatch(st, t0, length)
+        dt = time.monotonic() - tick0
+        stats["first-dispatch-s" if i == 0 else "dispatch-s"] += dt
+        if pending is not None and consume is not None:
+            tick0 = time.monotonic()
+            consume(*pending)
+            stats["consume-s"] += time.monotonic() - tick0
+        pending = (payload, t0, length)
+    if pending is not None and consume is not None:
+        tick0 = time.monotonic()
+        consume(*pending)
+        stats["consume-s"] += time.monotonic() - tick0
+    return st, stats
+
+
+# --- device-side event compaction ----------------------------------------
+
+
+class CompactEvents(NamedTuple):
+    """One chunk's compacted event stream (device side).
+
+    ``rows[i] = (tick, loc, etype, vals[ev_vals])`` for the i-th
+    nonempty event of the chunk, ``loc = (r * C + c) * 2 + slot`` (the
+    flattened dense coordinates). ``count`` keeps counting past ``cap``
+    — ``count > rows.shape[0]`` IS the overflow flag; overflowing rows
+    are dropped by the scatter, never written out of bounds.
+    """
+    rows: Any       # [cap, 3 + ev_vals] int32
+    count: Any      # [] int32 — total events seen (may exceed cap)
+
+
+def compact_lanes(model: Model) -> int:
+    return 3 + model.ev_vals
+
+
+def event_capacity(sim: SimConfig, model: Model, chunk: int) -> int:
+    """Auto-size the per-chunk compacted buffer from the client rate.
+
+    Expected nonempty rows per chunk = 2 events (invoke + completion)
+    per fired op; ops fire per client per tick with probability
+    ``sim.client.rate``. 1.5x the expectation (floor 128, rounded up to
+    64) is >8 sigma of the binomial at default settings; overflow is
+    flagged, not silent, so a pathological config degrades loudly.
+    Clamped to the dense row count — compaction can never need more.
+    """
+    R = sim.record_instances
+    C = sim.client.n_clients
+    dense_rows = chunk * R * C * 2
+    expected = 2.0 * chunk * R * C * sim.client.rate
+    cap = max(128, int(-(-1.5 * expected // 64)) * 64)
+    return max(1, min(cap, dense_rows))
+
+
+def _compact_tick(buf: CompactEvents, t, events, V: int) -> CompactEvents:
+    """Fold one tick's dense events ``[R, C, 2, 2 + V]`` into the
+    compacted buffer: mask prefix-sum assigns each nonempty event its
+    output row; rows past capacity (and masked-out rows) scatter with
+    ``mode='drop'``. Traced; int32 throughout."""
+    cap = buf.rows.shape[0]
+    flat = events.reshape(-1, events.shape[-1])          # [E, 2 + V]
+    E = flat.shape[0]
+    mask = flat[:, 0] != EV_NONE
+    pos = buf.count + jnp.cumsum(mask.astype(jnp.int32)) - 1
+    idx = jnp.where(mask, pos, cap)                      # cap -> dropped
+    loc = jnp.arange(E, dtype=jnp.int32)
+    new_rows = jnp.concatenate(
+        [jnp.broadcast_to(t, (E,)).astype(jnp.int32)[:, None],
+         loc[:, None], flat[:, 0:1], flat[:, 1:1 + V]], axis=1)
+    rows = buf.rows.at[idx].set(new_rows, mode="drop")
+    return CompactEvents(rows=rows,
+                         count=buf.count
+                         + jnp.sum(mask).astype(jnp.int32))
+
+
+def fetch_compact_payload(buf: CompactEvents
+                          ) -> Tuple[np.ndarray, int, bool]:
+    """Host-fetch one chunk's compacted buffer: returns ``(rows, count,
+    overflowed)``. The single place that knows the overflow convention
+    (``count`` keeps counting past the capacity) — the harness executor
+    and bench.py both account through it."""
+    rows = np.asarray(buf.rows)
+    n = int(buf.count)
+    return rows, n, n > rows.shape[0]
+
+
+def compact_payload_bytes(rows: np.ndarray) -> int:
+    """Fetched bytes of one compacted chunk (rows + the count scalar +
+    the detached stats vector ride in the same transfer class)."""
+    return rows.nbytes + 8
+
+
+def expand_compact_events(model: Model, sim: SimConfig,
+                          chunks: List[Tuple[np.ndarray, int]],
+                          n_ticks: Optional[int] = None) -> np.ndarray:
+    """Host-side inverse of the compaction: rebuild the dense
+    ``[T, R, C, 2, 2 + ev_vals]`` tensor from per-chunk compacted rows
+    (``(rows, count)`` pairs in dispatch order). The msg-id lane is not
+    carried by the compact stream and comes back zero — the history
+    decoder never reads it (``events_to_histories`` drops ``ev[-1]``),
+    so decoded histories are identical to the dense path's."""
+    T = sim.n_ticks if n_ticks is None else n_ticks
+    R, C, V = sim.record_instances, sim.client.n_clients, model.ev_vals
+    dense = np.zeros((T, R, C, 2, 2 + V), dtype=np.int32)
+    for rows, count in chunks:
+        n = min(int(count), rows.shape[0])
+        used = np.asarray(rows[:n])
+        if n == 0:
+            continue
+        t = used[:, 0]
+        loc = used[:, 1]
+        r, rem = np.divmod(loc, C * 2)
+        c, slot = np.divmod(rem, 2)
+        dense[t, r, c, slot, 0] = used[:, 2]
+        dense[t, r, c, slot, 1:1 + V] = used[:, 3:3 + V]
+    return dense
+
+
+# --- the pipelined single-device executor ---------------------------------
+
+
+class PipelineResult(NamedTuple):
+    """Host-side outcome of :func:`run_sim_pipelined`."""
+    carry: Carry
+    events: np.ndarray           # dense [T, R, C, 2, 2 + ev_vals]
+    journal_sends: np.ndarray    # [T, J, M, L] (zero-size when J == 0)
+    journal_recvs: np.ndarray    # [T, J, NT, K, L]
+    perf: Dict[str, Any]         # chunk/overlap/fetch-byte stats
+
+
+@partial(jax.jit, static_argnames=("model", "sim"))
+def _init_pipelined(model: Model, sim: SimConfig, seed, params,
+                    instance_ids) -> Carry:
+    return init_carry(model, sim, seed, params, instance_ids)
+
+
+def _make_chunk_fn(model: Model, sim: SimConfig, params, instance_ids,
+                   cap: Optional[int], unroll: int):
+    """Build the jitted, carry-donating chunk dispatch. The traced body
+    wraps the runtime tick function: per tick the dense event block is
+    folded into the compacted buffer instead of being stacked into the
+    scan ys (events ys are skipped entirely when nothing is recorded).
+    ``cap=None`` sizes the buffer per (static) chunk length via
+    :func:`event_capacity` — right for callers whose dispatch length
+    adapts at run time (bench.py).
+    """
+    V = model.ev_vals
+    R = sim.record_instances
+    J = sim.journal_instances
+    tick = make_tick_fn(model, sim, params, instance_ids)
+
+    @partial(jax.jit, static_argnames=("length",), donate_argnums=(0,))
+    def chunk_fn(carry, t0, length):
+        use_cap = cap if cap else event_capacity(sim, model, length)
+        buf = CompactEvents(
+            rows=jnp.zeros((use_cap, 3 + V), jnp.int32),
+            count=jnp.int32(0)) if R > 0 else None
+
+        def body(c_and_buf, t):
+            c, b = c_and_buf
+            c, ys = tick(c, t)
+            if b is not None:
+                b = _compact_tick(b, t, ys.events, V)
+            outs = TickOutputs(events=None,
+                               journal_sends=ys.journal_sends,
+                               journal_recvs=ys.journal_recvs)
+            return (c, b), outs
+
+        (carry, buf), ys = jax.lax.scan(
+            body, (carry, buf),
+            t0 + jnp.arange(length, dtype=jnp.int32), unroll=unroll)
+        journal = (ys.journal_sends, ys.journal_recvs) if J > 0 else None
+        # detached NetStats snapshot ([5] int32, NetStats field order):
+        # progress reporting can read it without touching the carry the
+        # NEXT dispatch donates away (bench.py's overlapped metric loop)
+        stats_vec = jnp.stack(list(carry.stats))
+        return carry, stats_vec, buf, journal
+
+    return chunk_fn
+
+
+def run_sim_pipelined(model: Model, sim: SimConfig, seed: int,
+                      params=None, instance_ids=None,
+                      chunk: int = 100, event_cap: Optional[int] = None,
+                      unroll: int = 1) -> PipelineResult:
+    """Chunked, donated, double-buffered replacement for
+    :func:`..tpu.runtime.run_sim` + the dense event fetch.
+
+    Dispatches the horizon in ``chunk``-tick pieces with the carry
+    donated between dispatches; while chunk *k + 1* runs on device the
+    host fetches chunk *k*'s compacted events. Returns the final carry,
+    the reconstructed dense event tensor (bit-identical decode), the
+    journal streams, and per-chunk dispatch/fetch/decode overlap stats
+    including the fetched-vs-dense event byte counts.
+    """
+    if params is None:
+        params = model.make_params(sim.net.n_nodes)
+    if instance_ids is None:
+        instance_ids = default_instance_ids(sim)
+    R, C, V = sim.record_instances, sim.client.n_clients, model.ev_vals
+    plans = plan_chunks(sim.n_ticks, chunk)
+    cap = (event_capacity(sim, model, plans[0][1])
+           if not event_cap else int(event_cap))
+    chunk_fn = _make_chunk_fn(model, sim, params, instance_ids, cap,
+                              unroll)
+
+    t_init = time.monotonic()
+    # donation needs each leaf to own its buffer; init_carry broadcasts
+    # shared zero blocks across leaves, so copy before the first donate
+    st = _init_pipelined(model, sim, jnp.int32(seed), params,
+                         jnp.asarray(instance_ids, jnp.int32))
+    st = jax.tree.map(lambda x: x.copy(), st)
+    init_s = time.monotonic() - t_init
+
+    compact_chunks: List[Tuple[np.ndarray, int]] = []
+    journal_chunks: List[Tuple[np.ndarray, np.ndarray]] = []
+    fetched_bytes = [0]
+    fetch_s = [0.0]
+    overflowed = [0]
+
+    def dispatch(carry_st, t0, length):
+        c, _, buf, journal = chunk_fn(carry_st, jnp.int32(t0), length)
+        return c, (buf, journal)
+
+    def consume(payload, t0, length):
+        buf, journal = payload
+        t_f = time.monotonic()
+        if buf is not None:
+            # device fetch — overlaps the next chunk's compute
+            rows, n, ovf = fetch_compact_payload(buf)
+            fetched_bytes[0] += compact_payload_bytes(rows)
+            overflowed[0] += int(ovf)
+            compact_chunks.append((rows, n))
+        if journal is not None:
+            journal_chunks.append((np.asarray(journal[0]),
+                                   np.asarray(journal[1])))
+        fetch_s[0] += time.monotonic() - t_f
+
+    st, stats = run_chunked(st, plans, dispatch, consume)
+    carry = jax.block_until_ready(st)
+
+    t_dec = time.monotonic()
+    events = expand_compact_events(model, sim, compact_chunks)
+    decode_s = time.monotonic() - t_dec
+    if journal_chunks:
+        j_sends = np.concatenate([a for a, _ in journal_chunks], axis=0)
+        j_recvs = np.concatenate([b for _, b in journal_chunks], axis=0)
+    else:
+        cfg = sim.net
+        M = 0
+        j_sends = np.zeros((sim.n_ticks, 0, M, cfg.lanes), np.int32)
+        j_recvs = np.zeros((sim.n_ticks, 0, cfg.n_total, cfg.inbox_k,
+                            cfg.lanes), np.int32)
+
+    dense_bytes = sim.n_ticks * R * C * 2 * (2 + V) * 4
+    perf = {
+        "chunk-ticks": plans[0][1],
+        "event-capacity": cap,
+        "init-s": round(init_s, 4),
+        # fetch-s: device-to-host payload transfers, overlapped with
+        # the next chunk's compute; decode-s: the host-side dense
+        # reconstruction after the loop
+        "fetch-s": round(fetch_s[0], 4),
+        "decode-s": round(decode_s, 4),
+        "event-bytes-fetched": fetched_bytes[0],
+        "event-bytes-dense": dense_bytes,
+        "fetch-reduction-x": round(dense_bytes / fetched_bytes[0], 1)
+        if fetched_bytes[0] else None,
+        "overflowed-chunks": overflowed[0],
+        **{k: round(v, 4) if isinstance(v, float) else v
+           for k, v in stats.items() if k != "consume-s"},
+    }
+    return PipelineResult(carry=carry, events=events,
+                          journal_sends=j_sends, journal_recvs=j_recvs,
+                          perf=perf)

@@ -480,10 +480,16 @@ class SimConfig(NamedTuple):
 class TickOutputs(NamedTuple):
     """Per-tick scan outputs: history events for the recorded instances,
     plus (when journal_instances > 0) the raw sent rows and delivered
-    inboxes of the journaled instances."""
-    events: jnp.ndarray          # [R, C, 2, 2 + ev_vals]
-    journal_sends: jnp.ndarray   # [J, M, L] outgoing rows (pre-enqueue)
-    journal_recvs: jnp.ndarray   # [J, NT, K, L] delivered this tick
+    inboxes of the journaled instances.
+
+    Fields are ``None`` (an empty pytree — no device buffer, no scan-ys
+    stacking, no host fetch) when their instance count is zero: a
+    fleet-stats-only run (``record_instances == 0``) materializes no
+    event tensor at all, and the journal buffers only exist when
+    journaling was requested."""
+    events: Optional[jnp.ndarray]        # [R, C, 2, 2 + ev_vals]
+    journal_sends: Optional[jnp.ndarray]  # [J, M, L] rows (pre-enqueue)
+    journal_recvs: Optional[jnp.ndarray]  # [J, NT, K, L] delivered
 
 
 class Carry(NamedTuple):
@@ -709,10 +715,11 @@ def make_tick_fn(model: Model, sim: SimConfig, params,
                           + violated.astype(jnp.int32),
                           key=key, telemetry=tel)
         J = sim.journal_instances
+        R = sim.record_instances
         ys = TickOutputs(
-            events=events[:sim.record_instances],
-            journal_sends=outs[:J],
-            journal_recvs=inbox[:J],
+            events=events[:R] if R > 0 else None,
+            journal_sends=outs[:J] if J > 0 else None,
+            journal_recvs=inbox[:J] if J > 0 else None,
         )
         return new_carry, ys
 
@@ -815,10 +822,11 @@ def _make_tick_fn_minor(model: Model, sim: SimConfig, params,
                           + violated.astype(jnp.int32),
                           key=carry.key, telemetry=tel)
         J = sim.journal_instances
+        R = sim.record_instances
         ys = TickOutputs(
-            events=events[:sim.record_instances],
-            journal_sends=outs[:J],
-            journal_recvs=inbox[:J],
+            events=events[:R] if R > 0 else None,
+            journal_sends=outs[:J] if J > 0 else None,
+            journal_recvs=inbox[:J] if J > 0 else None,
         )
         return new_carry, ys
 

@@ -40,6 +40,9 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "telemetry: flight-recorder / fleet-stats "
                    "observability tests (doc/observability.md)")
+    config.addinivalue_line(
+        "markers", "pipeline: chunked donated executor / event "
+                   "compaction tests (tpu/pipeline.py)")
 
 
 def pytest_collection_modifyitems(config, items):

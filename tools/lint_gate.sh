@@ -21,9 +21,19 @@ if [[ "${1:-}" == "--lint-only" ]]; then
 fi
 
 echo
-echo "== fleet-stats smoke (tiny echo run -> telemetry report)"
+echo "== chunked pipeline smoke (donated executor, compacted events)"
 SMOKE_STORE="$(mktemp -d)"
 trap 'rm -rf "$SMOKE_STORE"' EXIT
+# write-then-grep (not a pipe): grep -q exiting early would EPIPE the
+# still-printing CLI and fail the gate under pipefail
+python -m maelstrom_tpu test --runtime tpu -w echo --node-count 2 \
+    --time-limit 0.5 --rate 100 --n-instances 8 --record-instances 2 \
+    --pipeline on --chunk-ticks 50 --seed 3 --store "$SMOKE_STORE" \
+    > "$SMOKE_STORE/pipeline-smoke.json"
+grep -q '"chunk-ticks": 50' "$SMOKE_STORE/pipeline-smoke.json"
+
+echo
+echo "== fleet-stats smoke (tiny echo run -> telemetry report)"
 python -m maelstrom_tpu test --runtime tpu -w echo --node-count 2 \
     --time-limit 0.5 --rate 100 --n-instances 8 --record-instances 2 \
     --seed 3 --store "$SMOKE_STORE" >/dev/null
