@@ -228,11 +228,31 @@ def child_main(canary: bool = False) -> None:
         # A/B. Trajectories are bit-identical either way.
         bench_pipeline = os.environ.get("BENCH_PIPELINE") != "0"
         bench_unroll = int(os.environ.get("BENCH_UNROLL", "1"))
+        # run heartbeat A/B (telemetry/stream.py): BENCH_HEARTBEAT=0
+        # drops the per-chunk violation-scan fetch + JSONL append so
+        # the metric line can price the streaming observability layer
+        # (acceptance: within noise of the bare pipelined path)
+        bench_heartbeat = (bench_pipeline
+                           and os.environ.get("BENCH_HEARTBEAT") != "0")
         pipe_bytes = {"fetched": 0, "overflowed": 0}
+        hb_state = {"writer": None, "chunk": 0}
+        if bench_heartbeat:
+            import tempfile
+            from maelstrom_tpu.telemetry.stream import HeartbeatWriter
+            hb_dir = tempfile.mkdtemp(prefix="bench-heartbeat-")
+            hb_state["writer"] = HeartbeatWriter(
+                hb_dir, meta={"workload": model.name,
+                              "instances": cfg_n_instances,
+                              "ticks": sim.n_ticks,
+                              "bench-config": cfg_name})
+            log(TAG, f"phase[{cfg_name}]: heartbeat -> "
+                     f"{hb_state['writer'].path}")
         if bench_pipeline:
             from maelstrom_tpu.tpu.pipeline import (
                 _make_chunk_fn, compact_payload_bytes,
                 fetch_compact_payload)
+            from maelstrom_tpu.telemetry.stream import (
+                scan_to_violation, stats_vec_to_net)
             # cap=None: the compacted buffer is sized per (static)
             # dispatch length — the bench adapts its chunk size to the
             # dispatch budget at run time
@@ -241,13 +261,14 @@ def child_main(canary: bool = False) -> None:
 
             def chunk_fn(length: int):
                 def run(c, t0):
-                    c, svec, buf, _ = pchunk(c, t0, length)
-                    return c, svec, buf
+                    c, svec, scan, buf, _ = pchunk(c, t0, length)
+                    return c, svec, scan, buf
                 return run
 
-            def fetch_payload(svec, buf):
+            def fetch_payload(svec, scan, buf, t0, length):
                 """Fetch one chunk's detached stats + compacted events
-                (overlappable — touches no donated buffer). Returns
+                (overlappable — touches no donated buffer), append the
+                heartbeat record when enabled. Returns
                 (sent, delivered, ovf)."""
                 rows, n, overflowed = fetch_compact_payload(buf)
                 pipe_bytes["fetched"] += compact_payload_bytes(rows)
@@ -255,6 +276,14 @@ def child_main(canary: bool = False) -> None:
                                         rows.shape[0])
                 pipe_bytes["overflowed"] += int(overflowed)
                 s = np.asarray(svec)
+                hb = hb_state["writer"]
+                if hb is not None:
+                    hb.record_chunk(
+                        chunk=hb_state["chunk"], t0=int(t0),
+                        ticks=int(length), net=stats_vec_to_net(s),
+                        violation=scan_to_violation(np.asarray(scan)),
+                        overflowed=bool(overflowed))
+                hb_state["chunk"] += 1
                 return int(s[0]), int(s[1]), int(s[4])
         else:
             tick_fn = make_tick_fn(model, sim, params)
@@ -274,8 +303,8 @@ def child_main(canary: bool = False) -> None:
         def step_chunk(c, length: int, t0: int):
             """One dispatch; returns (carry', payload-or-None)."""
             if bench_pipeline:
-                c, svec, buf = chunk_fn(length)(c, jnp.int32(t0))
-                return c, (svec, buf)
+                c, svec, scan, buf = chunk_fn(length)(c, jnp.int32(t0))
+                return c, (svec, scan, buf, t0, length)
             return chunk_fn(length)(c, jnp.int32(t0)), None
 
         def sync_stats(c, payload):
@@ -324,6 +353,9 @@ def child_main(canary: bool = False) -> None:
             }
             if bench_pipeline:
                 rec["pipeline"] = True
+                rec["heartbeat"] = bench_heartbeat
+                if bench_heartbeat:
+                    rec["heartbeat_records"] = hb_state["chunk"]
                 rec["event_capacity"] = pipe_bytes.get("cap", 0)
                 rec["event_bytes_fetched"] = pipe_bytes["fetched"]
                 rec["event_bytes_dense"] = ticks_done * dense_chunk_bytes
@@ -483,6 +515,8 @@ def child_main(canary: bool = False) -> None:
                  int(carry.stats.sent),
                  int(carry.stats.dropped_overflow), ticks, wall,
                  complete=True, funnel=funnel)
+        if hb_state["writer"] is not None:
+            hb_state["writer"].finish(ticks=ticks)
         log(TAG, f"phase[{cfg_name}]: done")
     log(TAG, "phase: done")
 

@@ -531,7 +531,9 @@ def default_trace_targets(repo_root: str) -> List[str]:
     import glob
     pats = ["maelstrom_tpu/models/*.py", "maelstrom_tpu/tpu/*.py",
             "maelstrom_tpu/ops/delivery.py",
-            "maelstrom_tpu/telemetry/recorder.py"]
+            "maelstrom_tpu/telemetry/recorder.py",
+            "maelstrom_tpu/telemetry/stream.py",
+            "maelstrom_tpu/checkers/triage.py"]
     out = []
     for p in pats:
         out.extend(sorted(glob.glob(os.path.join(repo_root, p))))

@@ -14,6 +14,10 @@ option specs :136-229):
   contract, and schema/wire conformance passes (doc/lint.md)
 - ``fleet-stats`` — render a TPU run's device-telemetry report (text +
   SVG dashboards from fleet-metrics.json; doc/observability.md)
+- ``watch``  — tail a live (or dead) run's streaming heartbeat.jsonl
+  into a terminal report (doc/observability.md live-runs section)
+- ``triage`` — replay a run's flagged instances bit-exactly and emit
+  per-instance forensics bundles (spacetime SVG + EDN journal + repro)
 """
 
 from __future__ import annotations
@@ -151,6 +155,18 @@ def add_test_options(p: argparse.ArgumentParser):
                    help="TPU runtime: compacted event rows per chunk "
                         "(0 = auto from the client rate; overflow is "
                         "flagged in results.perf.phases.pipeline)")
+    p.add_argument("--no-heartbeat", action="store_true",
+                   help="TPU runtime: do not stream heartbeat.jsonl "
+                        "into the store dir during the run "
+                        "(doc/observability.md live-runs section)")
+    p.add_argument("--fail-fast", action="store_true",
+                   help="TPU runtime: stop dispatching chunks once the "
+                        "device-side violation scan trips (at most one "
+                        "in-flight chunk runs past the detection); "
+                        "results gain a top-level \"fail-fast\" block "
+                        "and `maelstrom triage` picks up from there. "
+                        "Needs the chunked executor (a multi-chunk "
+                        "horizon or --pipeline on)")
     p.add_argument("--profile-dir", default=None,
                    help="TPU runtime: capture a jax.profiler trace of "
                         "the run into this directory")
@@ -305,6 +321,9 @@ def cmd_test(args) -> int:
             return 2
         tpu_opts = dict(
             nemesis_schedule=schedule,
+            topology=args.topology,
+            heartbeat=not args.no_heartbeat,
+            fail_fast=args.fail_fast,
             node_count=node_count, concurrency=concurrency,
             rate=args.rate, time_limit=args.time_limit,
             latency=args.latency, latency_dist=args.latency_dist,
@@ -735,6 +754,91 @@ def cmd_fleet_stats(args) -> int:
     return 0
 
 
+def cmd_watch(args) -> int:
+    """Tail a run's streaming heartbeat into a terminal report — the
+    live view of a fleet that used to be a black box until the final
+    fetch (doc/observability.md). One-shot by default; --follow keeps
+    tailing (new chunk records print as they land) until the run-end
+    record arrives or Ctrl-C."""
+    import time as _time
+
+    from .telemetry.stream import (heartbeat_path, read_heartbeat,
+                                   render_chunk_line,
+                                   render_watch_report)
+
+    path = heartbeat_path(os.path.realpath(args.path))
+    if not os.path.exists(path):
+        print(f"error: no heartbeat at {args.path} (heartbeat.jsonl is "
+              f"streamed by TPU-runtime runs with a --store dir unless "
+              f"--no-heartbeat was passed)", file=sys.stderr)
+        return 2
+    hb = read_heartbeat(path)
+
+    def age():
+        try:
+            return _time.time() - os.path.getmtime(path)
+        except OSError:
+            return None
+
+    if not args.follow:
+        print(render_watch_report(hb, path=args.path, mtime_age_s=age()))
+        return 0 if hb["end"] is not None else 3
+
+    # follow: print the header + chunks seen so far, then poll for new
+    # records (the reader re-parses the file — records are tiny)
+    h = hb.get("header") or {}
+    print(f"run: {h.get('workload', '?')} — {h.get('instances', '?')} "
+          f"instances x {h.get('ticks', '?')} ticks, chunk "
+          f"{h.get('chunk-ticks', '?')}  [{args.path}]")
+    printed = 0
+    try:
+        while True:
+            hb = read_heartbeat(path)
+            for rec in hb["chunks"][printed:]:
+                print(render_chunk_line(rec))
+            printed = len(hb["chunks"])
+            if hb["end"] is not None:
+                end = hb["end"]
+                print(f"status: {end.get('status', 'complete')} — "
+                      f"{end.get('ticks', '?')} ticks in "
+                      f"{end.get('wall-s', '?')}s"
+                      + (f", valid? {end['valid?']}"
+                         if "valid?" in end else ""))
+                v = end.get("first-violation")
+                if v:
+                    print(f"first violation: instance "
+                          f"{v.get('instance')} at tick "
+                          f"{v.get('tick')}")
+                return 0
+            _time.sleep(args.interval)
+    except KeyboardInterrupt:
+        print()
+        return 130
+
+
+def cmd_triage(args) -> int:
+    """Replay the flagged instances of a stored run and emit their
+    forensics bundles (checkers/triage.py). Works on complete runs
+    (flagged set from results.json) and on partial/fail-fast/killed
+    runs (flagged set from the heartbeat's device-side violation
+    scan)."""
+    from .checkers.triage import (TriageError, render_triage_report,
+                                  triage_run)
+
+    try:
+        summary = triage_run(
+            os.path.realpath(args.path),
+            ids=args.instance or None,
+            max_instances=args.max_instances,
+            out_root=args.out,
+            max_svg_events=args.max_svg_events)
+    except TriageError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    print(render_triage_report(summary))
+    return 0
+
+
 def cmd_lint(args) -> int:
     """Run the analysis passes; --strict turns error findings into a
     nonzero exit (the pre-merge gate, tools/lint_gate.sh)."""
@@ -825,6 +929,40 @@ def main(argv=None) -> int:
     p_fleet.add_argument("--no-svg", action="store_true",
                          help="text report only")
 
+    p_watch = sub.add_parser(
+        "watch", help="tail a run's streaming heartbeat.jsonl into a "
+                      "live terminal report (doc/observability.md)")
+    p_watch.add_argument("path",
+                         help="a store run dir (e.g. store/lin-kv-tpu/"
+                              "latest) or a heartbeat.jsonl file")
+    p_watch.add_argument("-f", "--follow", action="store_true",
+                         help="keep tailing until the run-end record "
+                              "(or Ctrl-C); default is one shot")
+    p_watch.add_argument("--interval", type=float, default=1.0,
+                         help="--follow poll interval in seconds")
+
+    p_triage = sub.add_parser(
+        "triage", help="replay a run's flagged instances and emit "
+                       "per-instance forensics bundles (spacetime SVG "
+                       "+ EDN journal + repro.json)")
+    p_triage.add_argument("path",
+                          help="a store run dir (complete, fail-fast-"
+                               "stopped, or killed mid-run)")
+    p_triage.add_argument("--instance", type=int, action="append",
+                          default=[],
+                          help="triage this instance id (repeatable; "
+                               "default: the run's flagged instances)")
+    p_triage.add_argument("--max-instances", type=_positive_int,
+                          default=8,
+                          help="cap on instances to replay (default 8)")
+    p_triage.add_argument("-o", "--out", default=None,
+                          help="output directory (default: "
+                               "<run-dir>/triage)")
+    p_triage.add_argument("--max-svg-events", type=_positive_int,
+                          default=1500,
+                          help="Lamport SVG event cap; beyond it the "
+                               "diagram is annotated '+N elided'")
+
     p_lint = sub.add_parser(
         "lint", help="static analysis: trace-hygiene, contract, and "
                      "schema/wire conformance passes (doc/lint.md)")
@@ -854,7 +992,8 @@ def main(argv=None) -> int:
         return {"test": cmd_test, "demo": cmd_demo, "serve": cmd_serve,
                 "doc": cmd_doc, "check": cmd_check,
                 "export": cmd_export, "lint": cmd_lint,
-                "fleet-stats": cmd_fleet_stats}[args.command](args)
+                "fleet-stats": cmd_fleet_stats, "watch": cmd_watch,
+                "triage": cmd_triage}[args.command](args)
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2

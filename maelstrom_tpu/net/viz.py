@@ -1,8 +1,11 @@
 """Lamport spacetime diagrams: renders ``messages.svg`` from the network
 journal — one vertical line per node, one arrow per delivered message,
 labelled with the message body (minus envelope fields); client messages
-blue, errors pink, server traffic black. Render is capped at 10,000 events
-with a truncation notice.
+blue, errors pink, server traffic black. Render is capped (default
+10,000 events, one SVG row each — callers with long horizons pass a
+tighter ``max_events``, e.g. ``maelstrom triage``) with an explicit
+"+N elided" annotation, so the output stays a viewable file rather than
+an unbounded SVG.
 
 Parity: reference src/maelstrom/net/viz.clj (cap :13-16, send/recv pairing
 :27-56, colors :113-120, plot-analemma! :281-325).
@@ -30,10 +33,13 @@ def _label(body: dict) -> str:
     return s[:48]
 
 
-def plot_lamport(journal, path: str):
+def plot_lamport(journal, path: str, max_events: int = MAX_EVENTS):
     events = list(journal.events())
-    truncated = len(events) > MAX_EVENTS
-    events = events[:MAX_EVENTS]
+    total = len(events)
+    cap = max(1, int(max_events))
+    n_elided = max(0, total - cap)
+    truncated = n_elided > 0
+    events = events[:cap]
 
     # pair sends with recvs by message id (viz.clj:27-56)
     sends: Dict[int, int] = {}   # msg id -> event row of send
@@ -106,8 +112,8 @@ def plot_lamport(journal, path: str):
 
     if truncated:
         parts.append(f'<text x="10" y="{height-10}" font-size="12" '
-                     f'fill="#aa0000">(truncated to first {MAX_EVENTS} '
-                     f'events)</text>')
+                     f'fill="#aa0000">(truncated to first {len(events)} '
+                     f'events, +{n_elided} elided)</text>')
     parts.append("</svg>")
     with open(path, "w") as f:
         f.write("\n".join(parts))

@@ -228,7 +228,8 @@ def run_sim_sharded_chunked(model: Model, sim: SimConfig, seed: int,
                             params=None, mesh: Optional[Mesh] = None,
                             chunk: int = 100,
                             return_telemetry: bool = False,
-                            perf: Optional[dict] = None):
+                            perf: Optional[dict] = None,
+                            heartbeat=None, fail_fast: bool = False):
     """:func:`run_sim_sharded` issued as a sequence of ``chunk``-tick
     device dispatches — the production dispatch pattern (single giant
     dispatches fault the TPU tunnel; see bench.py) — with the carry left
@@ -243,14 +244,28 @@ def run_sim_sharded_chunked(model: Model, sim: SimConfig, seed: int,
     horizon so every dispatch shares one compile. Pass a dict as
     ``perf`` to receive the driver's dispatch/fetch overlap stats.
 
+    ``heartbeat`` (a :class:`..telemetry.stream.HeartbeatWriter`) gets
+    one record per consumed chunk: each shard computes its own detached
+    NetStats snapshot + first-violation scan ON DEVICE (fresh [1, 5] /
+    [1, 3] blocks, so they survive the wire donation) and the host
+    merges the ``[n_shards, 3]`` scans — violating counts summed,
+    earliest tick argmin'd, local instance indices remapped to the
+    merged global ids the returned ``violations`` array uses.
+    ``fail_fast`` stops dispatching within one chunk of a consumed
+    chunk's scan showing a tripped invariant; the events then cover
+    only ``perf["ticks-dispatched"]`` ticks.
+
     Returns the same (psum'd NetStats, violations, events) triple —
     events concatenated on host along the tick axis — plus the merged
     per-instance telemetry when ``return_telemetry`` is set.
     """
     import numpy as np
 
-    from ..tpu.pipeline import plan_chunks, run_chunked
-    from ..tpu.runtime import init_carry, make_tick_fn
+    from ..tpu.pipeline import plan_chunks, run_chunked, violation_scan
+    from ..tpu.runtime import default_instance_ids, init_carry, \
+        make_tick_fn
+    from ..telemetry.stream import (combine_shard_scans,
+                                    scan_to_violation, stats_vec_to_net)
 
     mesh = mesh or make_mesh()
     mesh, seeds, params = _prepare(model, sim, seed, mesh, params)
@@ -282,22 +297,46 @@ def run_sim_sharded_chunked(model: Model, sim: SimConfig, seed: int,
                 t0_rep.reshape(()) + jnp.arange(length, dtype=jnp.int32))
             events = (ys.events if ys.events is not None
                       else _empty_events(model, sim, length))
-            return _carry_to_wire(carry, sim), events
+            # detached per-shard snapshots ([1, 5] stats / [1, 3] scan,
+            # shard-leading so they concatenate under P(axes)): the
+            # heartbeat reads them after the wire is donated away
+            svec = jnp.stack(list(carry.stats)).reshape(1, -1)
+            scan = violation_scan(
+                carry.violations, carry.telemetry,
+                default_instance_ids(sim)).reshape(1, -1)
+            return _carry_to_wire(carry, sim), events, svec, scan
         return _shard_map(
             body, mesh=mesh,
             in_specs=(wire_spec, P(), P()),
-            out_specs=(wire_spec, P(None, axes)))(wire, t0, params)
+            out_specs=(wire_spec, P(None, axes), P(axes),
+                       P(axes)))(wire, t0, params)
 
     events_chunks = []
+    chunk_idx = [0]
+    tripped = [False]
 
     def dispatch(w, t0, length):
-        return chunk_fn(w, jnp.int32(t0), params, length)
+        w, events, svec, scan = chunk_fn(w, jnp.int32(t0), params,
+                                         length)
+        return w, (events, svec, scan)
 
-    def consume(events, t0, length):
+    def consume(payload, t0, length):
+        events, svec, scan = payload
         events_chunks.append(np.asarray(events))
+        scan_np = combine_shard_scans(np.asarray(scan),
+                                      sim.n_instances)
+        if int(scan_np[0]) > 0:
+            tripped[0] = True
+        if heartbeat is not None:
+            heartbeat.record_chunk(
+                chunk=chunk_idx[0], t0=t0, ticks=length,
+                net=stats_vec_to_net(np.asarray(svec).sum(axis=0)),
+                violation=scan_to_violation(scan_np))
+        chunk_idx[0] += 1
 
+    should_stop = (lambda: tripped[0]) if fail_fast else None
     wire, chunk_stats = run_chunked(init_fn(seeds, params), plans,
-                                    dispatch, consume)
+                                    dispatch, consume, should_stop)
     if perf is not None:
         perf.update(chunk_stats)
 

@@ -49,6 +49,18 @@ def _rate(num: int, den: int) -> float:
     return (num / den) if den else 0.0
 
 
+def _imax(a) -> int:
+    """max of a possibly-empty int leaf (0 on empty — PR 3 made several
+    run buffers Optional/zero-size; reductions must degrade, not raise)."""
+    a = np.asarray(a)
+    return int(a.max()) if a.size else 0
+
+
+def _fmean(a) -> float:
+    a = np.asarray(a)
+    return float(a.mean()) if a.size else 0.0
+
+
 def fleet_summary(tel, sim, ms_per_tick: float = 1.0) -> Dict:
     """Reduce one run's Telemetry pytree into the fleet-metrics dict
     (the exact content of ``fleet-metrics.json``)."""
@@ -112,14 +124,13 @@ def fleet_summary(tel, sim, ms_per_tick: float = 1.0) -> Dict:
             "fleet-counts": [int(c) for c in fleet_hist],
         },
         "high-water": {
-            "inbox-deliveries-per-tick": int(get(tel.inbox_hwm).max()),
-            "pool-occupancy": int(get(tel.pool_hwm).max()),
+            "inbox-deliveries-per-tick": _imax(tel.inbox_hwm),
+            "pool-occupancy": _imax(tel.pool_hwm),
             "pool-slots": int(sim.net.pool_slots),
         },
         "nemesis": {
-            "epochs-max": int(get(tel.nemesis_epochs).max()),
-            "partition-ticks-mean": float(get(tel.partition_ticks)
-                                          .mean()),
+            "epochs-max": _imax(tel.nemesis_epochs),
+            "partition-ticks-mean": _fmean(tel.partition_ticks),
         },
         "invariants": {
             "tripped-instances": int(tripped.size),

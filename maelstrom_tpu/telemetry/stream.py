@@ -1,0 +1,302 @@
+"""Streaming run heartbeat: one JSONL record per dispatched chunk.
+
+The fleet recorder (``recorder.py``/``fleet.py``) and the chunked
+executor (``tpu/pipeline.py``) made runs *inspectable after the fact*;
+until now a 100k-instance sweep was still a black box between the
+first dispatch and the final fetch. This module is the live tap: the
+chunk drivers hand each chunk's detached device snapshots — the
+``NetStats`` vector, the first-violation scan (``(instance, tick)``
+argmin computed ON DEVICE, see ``pipeline.violation_scan``), and the
+compacted-event overflow flag — to a :class:`HeartbeatWriter`, which
+appends one self-contained JSON line per chunk to
+``store/<test>/<run>/heartbeat.jsonl`` and flushes immediately.
+
+Append + flush per record means a run killed at ANY point leaves a
+valid JSONL *prefix* (at worst one truncated final line, which
+:func:`read_heartbeat` skips): ``maelstrom watch`` and ``maelstrom
+triage`` operate on partial run dirs that never got a results.json —
+the durable incremental progress journaling move of Netherite
+(PAPERS.md) applied to the simulator's own dispatch loop.
+
+Record schema (all host-written; one JSON object per line):
+
+- ``{"type": "run-start", "schema": 1, ...meta}`` — first line; meta
+  carries the workload name, horizon, chunk plan, and the JSON repro
+  ``opts`` dict ``maelstrom triage`` replays from.
+- ``{"type": "chunk", "chunk": k, "t0": t, "ticks": n, "wall-s": w,
+  "device-s": d, "net": {...}, "first-violation": {...}|null,
+  "events-overflowed": bool}`` — one per dispatched chunk, written
+  when the chunk's payload is consumed (i.e. while chunk *k + 1* runs
+  on device). ``net`` is the CUMULATIVE fleet NetStats; the violation
+  block is ``{"instances": n, "tick": t, "instance": i}`` with
+  ``tick == -1`` when the run had no telemetry (violation known,
+  first-trip tick not recorded).
+- ``{"type": "run-end", "status": "complete"|"stopped", ...}`` — last
+  line on a clean exit; ABSENT on a crash (that absence is what
+  ``maelstrom watch`` reports as a dead/partial run).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+HEARTBEAT_FILE = "heartbeat.jsonl"
+HEARTBEAT_SCHEMA = 1
+
+# NetStats field order (netsim.NetStats) under the JSON names the
+# results.json "net" block already uses.
+NET_LANES = ("sent", "delivered", "dropped-partition", "dropped-loss",
+             "dropped-overflow")
+
+# violation_scan lanes (tpu/pipeline.py): [n_violating, first_tick,
+# first_instance]; tick/instance are -1 when nothing tripped, tick is
+# -1 (unknown) when telemetry was off.
+SCAN_LANES = ("violating", "first-tick", "first-instance")
+
+
+def stats_vec_to_net(vec) -> Dict[str, int]:
+    """Decode one detached NetStats snapshot ([5] int32, field order)."""
+    v = np.asarray(vec).reshape(-1)
+    return {name: int(v[i]) for i, name in enumerate(NET_LANES)}
+
+
+def scan_to_violation(vec) -> Optional[Dict[str, int]]:
+    """Decode a violation-scan vector; None when nothing tripped."""
+    v = np.asarray(vec).reshape(-1)
+    if int(v[0]) <= 0:
+        return None
+    return {"instances": int(v[0]), "tick": int(v[1]),
+            "instance": int(v[2])}
+
+
+def combine_shard_scans(scans, n_instances_per_shard: int) -> np.ndarray:
+    """Host-side merge of per-shard violation scans ([n_shards, 3]) into
+    one fleet scan [3]. Local instance indices become global merged ids
+    (``shard * n_instances_per_shard + local`` — the index convention of
+    the merged ``violations`` array the sharded runners return). The
+    reported instance is the one with the earliest first-violation tick
+    (ties and unknown ticks break toward the lowest global id)."""
+    scans = np.asarray(scans).reshape(-1, 3)
+    n = int(scans[:, 0].sum())
+    if n <= 0:
+        return np.array([0, -1, -1], np.int32)
+    best = None   # (tick-key, global-instance, tick)
+    for shard, (cnt, tick, inst) in enumerate(scans):
+        if int(cnt) <= 0:
+            continue
+        gid = shard * n_instances_per_shard + int(inst)
+        key = (int(tick) if int(tick) >= 0 else np.iinfo(np.int32).max,
+               gid)
+        if best is None or key < best[:2]:
+            best = key + (int(tick),)
+    return np.array([n, best[2], best[1]], np.int32)
+
+
+class HeartbeatWriter:
+    """Appends heartbeat records to ``<run_dir>/heartbeat.jsonl``.
+
+    Every record is written and flushed atomically-enough for a
+    line-oriented reader: a crash mid-run leaves a valid prefix plus at
+    most one torn final line. The writer tracks the first violation it
+    sees so ``finish`` can summarize without re-reading the file."""
+
+    def __init__(self, run_dir: Optional[str] = None, *,
+                 meta: Optional[Dict[str, Any]] = None,
+                 path: Optional[str] = None):
+        if path is None:
+            if run_dir is None:
+                raise ValueError("HeartbeatWriter needs run_dir or path")
+            path = os.path.join(run_dir, HEARTBEAT_FILE)
+        self.path = path
+        self._f = open(path, "w")
+        self._t0 = time.monotonic()
+        self.chunks = 0
+        self.ticks = 0
+        self.first_violation: Optional[Dict[str, int]] = None
+        self._write({"type": "run-start", "schema": HEARTBEAT_SCHEMA,
+                     **(meta or {})})
+
+    def _write(self, rec: Dict[str, Any]) -> None:
+        self._f.write(json.dumps(rec, default=repr) + "\n")
+        self._f.flush()
+
+    def record_chunk(self, *, chunk: int, t0: int, ticks: int,
+                     net: Optional[Dict[str, int]] = None,
+                     violation: Optional[Dict[str, int]] = None,
+                     overflowed: bool = False,
+                     device_s: Optional[float] = None,
+                     extra: Optional[Dict[str, Any]] = None) -> None:
+        rec: Dict[str, Any] = {
+            "type": "chunk", "chunk": int(chunk), "t0": int(t0),
+            "ticks": int(ticks),
+            "wall-s": round(time.monotonic() - self._t0, 4),
+        }
+        if device_s is not None:
+            rec["device-s"] = round(device_s, 4)
+        if net is not None:
+            rec["net"] = net
+        rec["first-violation"] = violation
+        rec["events-overflowed"] = bool(overflowed)
+        if extra:
+            rec.update(extra)
+        if violation is not None and self.first_violation is None:
+            self.first_violation = dict(violation, chunk=int(chunk))
+        self.chunks += 1
+        self.ticks = max(self.ticks, int(t0) + int(ticks))
+        self._write(rec)
+
+    def finish(self, status: str = "complete",
+               **fields: Any) -> None:
+        """Write the run-end record and close. Safe to call twice."""
+        if self._f.closed:
+            return
+        self._write({"type": "run-end", "status": status,
+                     "chunks": self.chunks, "ticks": self.ticks,
+                     "wall-s": round(time.monotonic() - self._t0, 4),
+                     "first-violation": self.first_violation,
+                     **fields})
+        self._f.close()
+
+    def close(self) -> None:
+        """Close WITHOUT a run-end record (crash path: the missing
+        run-end is the signal the run died)."""
+        if not self._f.closed:
+            self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is None:
+            self.finish()
+        else:
+            self.close()
+
+
+# --- reading / watching ----------------------------------------------------
+
+
+def heartbeat_path(path: str) -> str:
+    """Resolve a run dir (or direct file path) to its heartbeat file."""
+    if os.path.isdir(path):
+        return os.path.join(path, HEARTBEAT_FILE)
+    return path
+
+
+def read_heartbeat(path: str) -> Dict[str, Any]:
+    """Parse a heartbeat file (or run dir) into ``{header, chunks, end,
+    skipped}``. Tolerates a torn tail — a run killed mid-write leaves a
+    valid prefix and this reader uses it (the ``maelstrom check``
+    _load_history_records convention)."""
+    path = heartbeat_path(path)
+    header: Optional[Dict[str, Any]] = None
+    chunks: List[Dict[str, Any]] = []
+    end: Optional[Dict[str, Any]] = None
+    skipped = 0
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                skipped += 1
+                continue
+            t = rec.get("type")
+            if t == "run-start":
+                header = rec
+            elif t == "chunk":
+                chunks.append(rec)
+            elif t == "run-end":
+                end = rec
+    return {"header": header, "chunks": chunks, "end": end,
+            "skipped": skipped}
+
+
+def first_violation_of(hb: Dict[str, Any]) -> Optional[Dict[str, int]]:
+    """Earliest-seen violation block of a parsed heartbeat (run-end
+    summary when present, else the first chunk record carrying one)."""
+    if hb.get("end") and hb["end"].get("first-violation"):
+        return hb["end"]["first-violation"]
+    for rec in hb.get("chunks", ()):
+        if rec.get("first-violation"):
+            return rec["first-violation"]
+    return None
+
+
+def flagged_instances(hb: Dict[str, Any]) -> List[int]:
+    """Distinct violating instance ids the heartbeat named, in
+    first-seen order. The per-chunk scan reports only the argmin
+    instance, so on a partial run this is a (correct but possibly
+    incomplete) lower bound — results.json, when present, has the full
+    list."""
+    seen: List[int] = []
+    for rec in hb.get("chunks", ()):
+        v = rec.get("first-violation")
+        if v and v.get("instance", -1) >= 0 and v["instance"] not in seen:
+            seen.append(v["instance"])
+    return seen
+
+
+def render_chunk_line(rec: Dict[str, Any]) -> str:
+    net = rec.get("net") or {}
+    v = rec.get("first-violation")
+    parts = [f"chunk {rec.get('chunk', '?'):>3}",
+             f"t={rec.get('t0', '?')}..????"]
+    if isinstance(rec.get("t0"), int) and isinstance(rec.get("ticks"),
+                                                     int):
+        parts[1] = f"t={rec['t0']}..{rec['t0'] + rec['ticks'] - 1}"
+    if net:
+        parts.append(f"sent {net.get('sent', 0)} "
+                     f"delivered {net.get('delivered', 0)}")
+    parts.append("OVERFLOW" if rec.get("events-overflowed") else "")
+    parts.append(f"viol {v['instances']} (first: instance "
+                 f"{v['instance']} @ tick {v['tick']})" if v else "viol 0")
+    if isinstance(rec.get("wall-s"), (int, float)):
+        parts.append(f"{rec['wall-s']:.2f}s")
+    return "  ".join(p for p in parts if p)
+
+
+def render_watch_report(hb: Dict[str, Any], path: str = "",
+                        mtime_age_s: Optional[float] = None) -> str:
+    """The one-shot ``maelstrom watch`` report of a parsed heartbeat."""
+    lines: List[str] = []
+    h = hb.get("header") or {}
+    desc = h.get("workload", "?")
+    lines.append(
+        f"run: {desc} — {h.get('instances', '?')} instances x "
+        f"{h.get('ticks', '?')} ticks, chunk {h.get('chunk-ticks', '?')}"
+        + (f"  [{path}]" if path else ""))
+    for rec in hb.get("chunks", ()):
+        lines.append(render_chunk_line(rec))
+    v = first_violation_of(hb)
+    if v:
+        tick = v.get("tick", -1)
+        lines.append(
+            f"first violation: instance {v.get('instance')}"
+            + (f" at tick {tick}" if tick is not None and tick >= 0
+               else " (tick unknown: telemetry off)")
+            + f" — {v.get('instances', '?')} violating instance(s)")
+    end = hb.get("end")
+    if end:
+        lines.append(f"status: {end.get('status', 'complete')} — "
+                     f"{end.get('chunks', len(hb.get('chunks', [])))} "
+                     f"chunks, {end.get('ticks', '?')} ticks in "
+                     f"{end.get('wall-s', '?')}s"
+                     + (f", valid? {end['valid?']}"
+                        if "valid?" in end else ""))
+    else:
+        age = ("" if mtime_age_s is None
+               else f" (last write {mtime_age_s:.0f}s ago)")
+        lines.append(f"status: no run-end record — run still in "
+                     f"progress or died{age}")
+    if hb.get("skipped"):
+        lines.append(f"({hb['skipped']} unparseable line(s) skipped — "
+                     f"torn tail from an interrupted writer)")
+    return "\n".join(lines)

@@ -73,6 +73,14 @@ TPU_DEFAULTS = dict(
     event_capacity=0,         # compacted event rows per chunk (0 = auto
                               # from the client rate; overflow is flagged
                               # in perf.phases.pipeline, never silent)
+    heartbeat=True,           # stream one heartbeat.jsonl record per
+                              # chunk into the store dir (telemetry/
+                              # stream.py; needs store_root — purely
+                              # observational, bit-identical off/on)
+    fail_fast=False,          # stop dispatching chunks once the
+                              # device-side violation scan trips (at
+                              # most one in-flight chunk runs past the
+                              # detection; results gain "fail-fast")
     seed=0,
 )
 
@@ -264,11 +272,13 @@ def resolve_pipeline(sim: SimConfig, opts: Dict[str, Any]) -> bool:
 
 def _pipelined_phase_run(model: Model, sim: SimConfig, seed: int, params,
                          opts: Dict[str, Any],
-                         profile_dir: Optional[str] = None):
+                         profile_dir: Optional[str] = None,
+                         heartbeat=None):
     """The chunked executor under the same phase-timer/profiler contract
-    as :func:`_phase_timed_run`: returns ((carry, events, journal_sends,
-    journal_recvs), phases) with the per-chunk dispatch/fetch/decode
-    overlap stats under ``phases["pipeline"]``."""
+    as :func:`_phase_timed_run`: returns (PipelineResult, phases) with
+    the per-chunk dispatch/fetch/decode overlap stats under
+    ``phases["pipeline"]``. ``heartbeat``/``opts["fail_fast"]`` thread
+    through to :func:`..tpu.pipeline.run_sim_pipelined`."""
     import jax
 
     from .pipeline import run_sim_pipelined
@@ -286,7 +296,9 @@ def _pipelined_phase_run(model: Model, sim: SimConfig, seed: int, params,
         res = run_sim_pipelined(
             model, sim, seed, params,
             chunk=int(opts.get("chunk_ticks") or 100),
-            event_cap=int(opts.get("event_capacity") or 0) or None)
+            event_cap=int(opts.get("event_capacity") or 0) or None,
+            heartbeat=heartbeat,
+            fail_fast=bool(opts.get("fail_fast")))
     finally:
         if profiling:
             try:
@@ -295,8 +307,52 @@ def _pipelined_phase_run(model: Model, sim: SimConfig, seed: int, params,
                 pass
     phases["total-s"] = round(time.monotonic() - t0, 4)
     phases["pipeline"] = res.perf
-    return (res.carry, res.events, res.journal_sends,
-            res.journal_recvs), phases
+    return res, phases
+
+
+# opts that fully determine a run's trajectory (plus the model identity)
+# — the heartbeat's run-start record carries them so `maelstrom triage`
+# can rebuild the exact SimConfig and replay flagged instances
+# bit-exactly on a run dir that never produced a results.json.
+_REPRO_OPT_KEYS = (
+    "node_count", "concurrency", "rate", "time_limit", "latency",
+    "latency_dist", "p_loss", "nemesis", "nemesis_interval",
+    "nemesis_kind", "nemesis_schedule", "rpc_timeout", "recovery_time",
+    "n_instances", "record_instances", "journal_instances", "pool_slots",
+    "inbox_k", "ms_per_tick", "layout", "telemetry", "telemetry_stride",
+    "telemetry_hist_buckets", "chunk_ticks", "event_capacity", "seed",
+    "topology", "availability", "consistency_models", "key_count")
+
+
+def heartbeat_meta(model: Model, sim: SimConfig,
+                   opts: Dict[str, Any]) -> Dict[str, Any]:
+    """The run-start record's payload: enough to label a live report
+    (`maelstrom watch`) and to replay the run (`maelstrom triage`)."""
+    import json
+    repro = {}
+    for k in _REPRO_OPT_KEYS:
+        if k in opts:
+            try:
+                json.dumps(opts[k])
+            except (TypeError, ValueError):
+                continue
+            repro[k] = opts[k]
+    return {
+        "workload": model.name,
+        "instances": sim.n_instances,
+        "ticks": sim.n_ticks,
+        "record-instances": sim.record_instances,
+        "journal-instances": sim.journal_instances,
+        "chunk-ticks": int(opts.get("chunk_ticks") or 100),
+        "layout": sim.layout,
+        "seed": int(opts.get("seed") or 0),
+        "opts": repro,
+        # scalar model knobs (log_cap, heartbeat, n_keys, topology, ...)
+        # — get_model's defaults may differ from how THIS model was
+        # built, and the replay must rebuild the identical automaton
+        "model-config": {k: v for k, v in vars(model).items()
+                         if isinstance(v, (bool, int, float, str))},
+    }
 
 
 def run_tpu_test(model: Model, opts: Optional[Dict[str, Any]] = None,
@@ -305,30 +361,63 @@ def run_tpu_test(model: Model, opts: Optional[Dict[str, Any]] = None,
     sim = make_sim_config(model, opts)
     if params is None:
         params = model.make_params(sim.net.n_nodes)
-    t0 = time.monotonic()
+    # the store dir exists from the first tick on: the streaming
+    # heartbeat (telemetry/stream.py) writes into it DURING the run, so
+    # `maelstrom watch` / `triage` work on runs that die mid-horizon
+    run_dir = None
+    hb = None
+    if opts.get("store_root"):
+        run_dir = prepare_store_dir(model.name, opts["store_root"])
     use_pipe = resolve_pipeline(sim, opts)
-    if use_pipe:
-        ((carry, events, journal_sends, journal_recvs),
-         phases) = _pipelined_phase_run(model, sim, opts["seed"], params,
-                                        opts, opts.get("profile_dir"))
-        # the pipelined executor accounted its own (overlapped) event
-        # fetch under phases["pipeline"]; fetch-s below covers only the
-        # telemetry pull + fleet reduction
-        t_fetch = time.monotonic()
-    else:
-        (carry, ys), phases = _phase_timed_run(model, sim, opts["seed"],
-                                               params,
-                                               opts.get("profile_dir"))
-        # fetch-s includes the dense event tensor's device-to-host
-        # transfer on the monolithic path (doc/observability.md)
-        t_fetch = time.monotonic()
-        events = (np.asarray(ys.events) if ys.events is not None
-                  else np.zeros((sim.n_ticks, 0, sim.client.n_clients,
-                                 2, 2 + model.ev_vals), np.int32))
-        journal_sends = (np.asarray(ys.journal_sends)
-                         if ys.journal_sends is not None else None)
-        journal_recvs = (np.asarray(ys.journal_recvs)
-                         if ys.journal_recvs is not None else None)
+    if opts.get("fail_fast") and not use_pipe:
+        # fail-fast needs per-chunk dispatch to have anything to stop;
+        # a monolithic run would silently burn the whole horizon while
+        # the user believes the protection is active
+        import sys
+        print("note: --fail-fast has no effect on the monolithic "
+              "executor (single-dispatch horizon); use --pipeline on "
+              "or a multi-chunk --time-limit/--chunk-ticks",
+              file=sys.stderr)
+    if run_dir and opts.get("heartbeat", True):
+        from ..telemetry.stream import HeartbeatWriter
+        hb = HeartbeatWriter(
+            run_dir, meta=dict(heartbeat_meta(model, sim, opts),
+                               pipeline=bool(use_pipe)))
+    t0 = time.monotonic()
+    pipe_res = None
+    try:
+        if use_pipe:
+            pipe_res, phases = _pipelined_phase_run(
+                model, sim, opts["seed"], params, opts,
+                opts.get("profile_dir"), heartbeat=hb)
+            carry, events = pipe_res.carry, pipe_res.events
+            journal_sends = pipe_res.journal_sends
+            journal_recvs = pipe_res.journal_recvs
+            # the pipelined executor accounted its own (overlapped)
+            # event fetch under phases["pipeline"]; fetch-s below covers
+            # only the telemetry pull + fleet reduction
+            t_fetch = time.monotonic()
+        else:
+            (carry, ys), phases = _phase_timed_run(
+                model, sim, opts["seed"], params,
+                opts.get("profile_dir"))
+            # fetch-s includes the dense event tensor's device-to-host
+            # transfer on the monolithic path (doc/observability.md)
+            t_fetch = time.monotonic()
+            events = (np.asarray(ys.events) if ys.events is not None
+                      else np.zeros((sim.n_ticks, 0,
+                                     sim.client.n_clients,
+                                     2, 2 + model.ev_vals), np.int32))
+            journal_sends = (np.asarray(ys.journal_sends)
+                             if ys.journal_sends is not None else None)
+            journal_recvs = (np.asarray(ys.journal_recvs)
+                             if ys.journal_recvs is not None else None)
+    except BaseException:
+        if hb is not None:
+            # no run-end record: the heartbeat prefix IS the crash
+            # artifact (`maelstrom watch` reports the run as dead)
+            hb.close()
+        raise
     fleet = None
     if carry.telemetry is not None:
         import jax
@@ -392,21 +481,40 @@ def run_tpu_test(model: Model, opts: Optional[Dict[str, Any]] = None,
             "dropped-loss": int(stats.dropped_loss),
             "dropped-overflow": int(stats.dropped_overflow),
         },
-        "perf": {
-            "wall-s": wall,
-            "ticks": sim.n_ticks,
-            "msgs-per-sec": total_msgs / wall if wall > 0 else 0.0,
-            "instance-ticks-per-sec": (sim.n_instances * sim.n_ticks / wall
-                                       if wall > 0 else 0.0),
-            "phases": phases,
-        },
     }
     pipe_stats = phases.get("pipeline")
+    # on a fail-fast stop only the dispatched prefix ran — perf must
+    # report the ticks that actually executed, not the planned horizon
+    # (a 2x-inflated instance-ticks-per-sec otherwise)
+    ticks_run = (pipe_stats["ticks-dispatched"]
+                 if pipe_stats and pipe_stats.get("stopped-early")
+                 else sim.n_ticks)
+    results["perf"] = {
+        "wall-s": wall,
+        "ticks": ticks_run,
+        "msgs-per-sec": total_msgs / wall if wall > 0 else 0.0,
+        "instance-ticks-per-sec": (sim.n_instances * ticks_run / wall
+                                   if wall > 0 else 0.0),
+        "phases": phases,
+    }
     if pipe_stats and pipe_stats.get("overflowed-chunks"):
         # a compacted event buffer overflowed: decoded histories are
         # missing events, so a "valid" verdict must not read as full
         # coverage (raise event_capacity / lower chunk_ticks to fix)
         results["events-truncated"] = True
+    if pipe_stats and pipe_stats.get("stopped-early"):
+        # --fail-fast tripped: the run covers only the dispatched
+        # prefix; the device-side scan says where it went wrong
+        from ..telemetry.stream import scan_to_violation
+        results["fail-fast"] = {
+            "stopped": True,
+            "ticks-dispatched": pipe_stats["ticks-dispatched"],
+            "ticks-planned": sim.n_ticks,
+            "first-violation": (scan_to_violation(pipe_res.scan)
+                                if pipe_res is not None
+                                and pipe_res.scan is not None
+                                else None),
+        }
     if fleet is not None:
         # the condensed fleet view rides in results.json; the full dict
         # (series, histograms, per-instance spreads) is the store's
@@ -461,7 +569,15 @@ def run_tpu_test(model: Model, opts: Optional[Dict[str, Any]] = None,
         }
     if opts.get("store_root"):
         _write_store(model.name, opts["store_root"], results, histories,
-                     journal, funnel=funnel, fleet=fleet)
+                     journal, funnel=funnel, fleet=fleet,
+                     store_dir=run_dir)
+    if hb is not None:
+        hb.finish(
+            status=("stopped" if results.get("fail-fast") else
+                    "complete"),
+            **{"valid?": results["valid?"],
+               "violating-instances": n_violating,
+               **({"store-dir": run_dir} if run_dir else {})})
     return results
 
 
@@ -511,19 +627,39 @@ def replay_instances(model: Model, opts: Dict[str, Any],
     }
 
 
-def _write_store(name: str, store_root: str, results: Dict[str, Any],
-                 histories, journal=None, funnel=None,
-                 suffix: str = "-tpu", fleet=None) -> None:
-    """Store artifacts for a TPU (or native-engine) run: results.json +
-    one history per recorded instance (the store layout of
-    doc/results.md, minus node logs — there are no node processes),
-    plus the Lamport diagram when a per-message journal was recorded and
-    the fleet-metrics.json + dashboard SVGs when telemetry ran."""
-    import json
+def prepare_store_dir(name: str, store_root: str,
+                      suffix: str = "-tpu") -> str:
+    """Create a run's store directory (and point the ``latest`` symlink
+    at it) BEFORE the run starts, so live artifacts — the streaming
+    heartbeat.jsonl — have somewhere to go while the fleet is still on
+    device. ``_write_store`` fills the same directory at the end."""
     from datetime import datetime
     ts = datetime.now().strftime("%Y%m%d-%H%M%S-%f")
     d = os.path.join(store_root, f"{name}{suffix}", ts)
     os.makedirs(d, exist_ok=True)
+    latest = os.path.join(os.path.dirname(d), "latest")
+    try:
+        if os.path.islink(latest):
+            os.unlink(latest)
+        os.symlink(os.path.basename(d), latest)
+    except OSError:
+        pass
+    return d
+
+
+def _write_store(name: str, store_root: str, results: Dict[str, Any],
+                 histories, journal=None, funnel=None,
+                 suffix: str = "-tpu", fleet=None,
+                 store_dir: Optional[str] = None) -> None:
+    """Store artifacts for a TPU (or native-engine) run: results.json +
+    one history per recorded instance (the store layout of
+    doc/results.md, minus node logs — there are no node processes),
+    plus the Lamport diagram when a per-message journal was recorded and
+    the fleet-metrics.json + dashboard SVGs when telemetry ran.
+    ``store_dir`` reuses a directory :func:`prepare_store_dir` already
+    created (heartbeat runs stream into it mid-run)."""
+    import json
+    d = store_dir or prepare_store_dir(name, store_root, suffix)
     if fleet is not None:
         from ..telemetry.fleet import (write_fleet_metrics,
                                        write_fleet_svgs)
@@ -556,11 +692,4 @@ def _write_store(name: str, store_root: str, results: Dict[str, Any],
             with open(p, "w") as f:
                 for r in h:
                     f.write(json.dumps(r) + "\n")
-    latest = os.path.join(os.path.dirname(d), "latest")
-    try:
-        if os.path.islink(latest):
-            os.unlink(latest)
-        os.symlink(os.path.basename(d), latest)
-    except OSError:
-        pass
     results["store-dir"] = d

@@ -79,6 +79,7 @@ def plan_chunks(n_ticks: int, chunk: int) -> List[Tuple[int, int]]:
 def run_chunked(state0: Any, plans: List[Tuple[int, int]],
                 dispatch: Callable[[Any, int, int], Tuple[Any, Any]],
                 consume: Optional[Callable[[Any, int, int], None]] = None,
+                should_stop: Optional[Callable[[], bool]] = None,
                 ) -> Tuple[Any, Dict[str, float]]:
     """The double-buffered chunk loop shared by every chunked runner.
 
@@ -89,14 +90,28 @@ def run_chunked(state0: Any, plans: List[Tuple[int, int]],
     device compute. Returns the final state and wall-clock stats:
     ``first-dispatch-s`` (compile-inclusive), ``dispatch-s`` (steady
     issue time), ``consume-s`` (host fetch + decode).
+
+    ``should_stop`` is polled after each consume (i.e. after chunk *k*'s
+    payload has been inspected, with chunk *k + 1* already in flight):
+    returning True stops further dispatches — at most ONE chunk runs
+    past the one whose payload raised the stop (the ``--fail-fast``
+    contract). The already-dispatched chunk is still consumed, so its
+    heartbeat/events are not lost. Stats then carry
+    ``stopped-early: True`` and ``ticks-dispatched`` reports the ticks
+    actually issued.
     """
-    stats = {"chunks": len(plans), "first-dispatch-s": 0.0,
-             "dispatch-s": 0.0, "consume-s": 0.0}
+    stats: Dict[str, Any] = {"chunks": len(plans),
+                             "first-dispatch-s": 0.0,
+                             "dispatch-s": 0.0, "consume-s": 0.0}
     st = state0
     pending: Optional[Tuple[Any, int, int]] = None
+    ticks_dispatched = 0
+    stopped = False
     for i, (t0, length) in enumerate(plans):
         tick0 = time.monotonic()
         st, payload = dispatch(st, t0, length)
+        ticks_dispatched = t0 + length
+        stats["chunks"] = i + 1
         dt = time.monotonic() - tick0
         stats["first-dispatch-s" if i == 0 else "dispatch-s"] += dt
         if pending is not None and consume is not None:
@@ -104,11 +119,51 @@ def run_chunked(state0: Any, plans: List[Tuple[int, int]],
             consume(*pending)
             stats["consume-s"] += time.monotonic() - tick0
         pending = (payload, t0, length)
+        if should_stop is not None and should_stop():
+            stopped = True
+            break
     if pending is not None and consume is not None:
         tick0 = time.monotonic()
         consume(*pending)
         stats["consume-s"] += time.monotonic() - tick0
+    stats["ticks-dispatched"] = ticks_dispatched
+    if stopped:
+        stats["stopped-early"] = True
     return st, stats
+
+
+# --- device-side first-violation scan -------------------------------------
+
+
+def violation_scan(violations, telemetry, instance_ids) -> jnp.ndarray:
+    """Reduce the fleet's invariant state to a [3] int32 vector —
+    ``[n_violating, first_tick, first_instance]`` — entirely on device,
+    so the per-chunk heartbeat learns *where* a 100k-instance sweep went
+    wrong without fetching any per-instance buffer.
+
+    The cheap per-workload invariant lanes (``Model.invariants``: echo
+    has none, g-set/raft carry lost-add / stale-read / commit-agreement
+    witnesses) already accumulate into ``carry.violations`` every tick;
+    with the flight recorder on, ``telemetry.first_violation`` holds
+    each instance's first-trip tick and the scan argmins over it —
+    the reported instance is the EARLIEST tripper. Without telemetry the
+    tick lane is -1 (violation known, tick unknown) and the instance is
+    the lowest-id tripper. Traced; the result is a fresh (detached)
+    array, safe to fetch after the carry is donated away."""
+    tripped = violations > 0
+    n = jnp.sum(tripped).astype(jnp.int32)
+    ids = jnp.asarray(instance_ids, jnp.int32)
+    big = jnp.int32(np.iinfo(np.int32).max)
+    if telemetry is not None:
+        ft = telemetry.first_violation
+        key = jnp.where(ft >= 0, ft, big)
+        i = jnp.argmin(key)
+        tick = jnp.where(n > 0, ft[i], -1)
+    else:
+        i = jnp.argmin(jnp.where(tripped, ids, big))
+        tick = jnp.full((), -1, jnp.int32)
+    inst = jnp.where(n > 0, ids[i], -1)
+    return jnp.stack([n, tick.astype(jnp.int32), inst.astype(jnp.int32)])
 
 
 # --- device-side event compaction ----------------------------------------
@@ -189,16 +244,32 @@ def compact_payload_bytes(rows: np.ndarray) -> int:
 
 def expand_compact_events(model: Model, sim: SimConfig,
                           chunks: List[Tuple[np.ndarray, int]],
-                          n_ticks: Optional[int] = None) -> np.ndarray:
+                          n_ticks: Optional[int] = None,
+                          instances: Optional[List[int]] = None
+                          ) -> np.ndarray:
     """Host-side inverse of the compaction: rebuild the dense
     ``[T, R, C, 2, 2 + ev_vals]`` tensor from per-chunk compacted rows
     (``(rows, count)`` pairs in dispatch order). The msg-id lane is not
     carried by the compact stream and comes back zero — the history
     decoder never reads it (``events_to_histories`` drops ``ev[-1]``),
-    so decoded histories are identical to the dense path's."""
+    so decoded histories are identical to the dense path's.
+
+    ``instances`` selects a SUBSET of the recorded instances (by record
+    index, in the order given): only their rows are expanded, into a
+    ``[T, len(instances), C, 2, ...]`` tensor — ``maelstrom triage``
+    rebuilds one flagged instance's history without materializing the
+    fleet's full dense tensor."""
     T = sim.n_ticks if n_ticks is None else n_ticks
     R, C, V = sim.record_instances, sim.client.n_clients, model.ev_vals
-    dense = np.zeros((T, R, C, 2, 2 + V), dtype=np.int32)
+    if instances is not None:
+        remap = np.full((R,), -1, dtype=np.int64)
+        for pos, r_idx in enumerate(instances):
+            remap[int(r_idx)] = pos
+        R_out = len(instances)
+    else:
+        remap = None
+        R_out = R
+    dense = np.zeros((T, R_out, C, 2, 2 + V), dtype=np.int32)
     for rows, count in chunks:
         n = min(int(count), rows.shape[0])
         used = np.asarray(rows[:n])
@@ -208,6 +279,12 @@ def expand_compact_events(model: Model, sim: SimConfig,
         loc = used[:, 1]
         r, rem = np.divmod(loc, C * 2)
         c, slot = np.divmod(rem, 2)
+        if remap is not None:
+            r = remap[r]
+            keep = r >= 0
+            if not keep.all():
+                t, r, c, slot = t[keep], r[keep], c[keep], slot[keep]
+                used = used[keep]
         dense[t, r, c, slot, 0] = used[:, 2]
         dense[t, r, c, slot, 1:1 + V] = used[:, 3:3 + V]
     return dense
@@ -217,12 +294,22 @@ def expand_compact_events(model: Model, sim: SimConfig,
 
 
 class PipelineResult(NamedTuple):
-    """Host-side outcome of :func:`run_sim_pipelined`."""
+    """Host-side outcome of :func:`run_sim_pipelined`.
+
+    On a fail-fast stop the tick-axis arrays cover only the DISPATCHED
+    prefix (``perf["ticks-dispatched"]`` ticks); the carry is the state
+    after that prefix."""
     carry: Carry
     events: np.ndarray           # dense [T, R, C, 2, 2 + ev_vals]
     journal_sends: np.ndarray    # [T, J, M, L] (zero-size when J == 0)
     journal_recvs: np.ndarray    # [T, J, NT, K, L]
     perf: Dict[str, Any]         # chunk/overlap/fetch-byte stats
+    scan: Optional[np.ndarray] = None   # final violation scan [3]
+                                        # (stream.SCAN_LANES)
+    compact: Optional[List[Tuple[np.ndarray, int]]] = None
+                                 # per-chunk compacted (rows, count),
+                                 # kept only with keep_compact=True
+                                 # (triage's instance-subset expansion)
 
 
 @partial(jax.jit, static_argnames=("model", "sim"))
@@ -244,6 +331,8 @@ def _make_chunk_fn(model: Model, sim: SimConfig, params, instance_ids,
     V = model.ev_vals
     R = sim.record_instances
     J = sim.journal_instances
+    if instance_ids is None:
+        instance_ids = default_instance_ids(sim)
     tick = make_tick_fn(model, sim, params, instance_ids)
 
     @partial(jax.jit, static_argnames=("length",), donate_argnums=(0,))
@@ -267,11 +356,15 @@ def _make_chunk_fn(model: Model, sim: SimConfig, params, instance_ids,
             body, (carry, buf),
             t0 + jnp.arange(length, dtype=jnp.int32), unroll=unroll)
         journal = (ys.journal_sends, ys.journal_recvs) if J > 0 else None
-        # detached NetStats snapshot ([5] int32, NetStats field order):
-        # progress reporting can read it without touching the carry the
-        # NEXT dispatch donates away (bench.py's overlapped metric loop)
+        # detached NetStats snapshot ([5] int32, NetStats field order)
+        # and first-violation scan ([3] int32, stream.SCAN_LANES):
+        # progress reporting / the run heartbeat can read them without
+        # touching the carry the NEXT dispatch donates away (bench.py's
+        # overlapped metric loop, telemetry/stream.py)
         stats_vec = jnp.stack(list(carry.stats))
-        return carry, stats_vec, buf, journal
+        scan_vec = violation_scan(carry.violations, carry.telemetry,
+                                  jnp.asarray(instance_ids, jnp.int32))
+        return carry, stats_vec, scan_vec, buf, journal
 
     return chunk_fn
 
@@ -279,7 +372,9 @@ def _make_chunk_fn(model: Model, sim: SimConfig, params, instance_ids,
 def run_sim_pipelined(model: Model, sim: SimConfig, seed: int,
                       params=None, instance_ids=None,
                       chunk: int = 100, event_cap: Optional[int] = None,
-                      unroll: int = 1) -> PipelineResult:
+                      unroll: int = 1, heartbeat=None,
+                      fail_fast: bool = False,
+                      keep_compact: bool = False) -> PipelineResult:
     """Chunked, donated, double-buffered replacement for
     :func:`..tpu.runtime.run_sim` + the dense event fetch.
 
@@ -289,6 +384,17 @@ def run_sim_pipelined(model: Model, sim: SimConfig, seed: int,
     the reconstructed dense event tensor (bit-identical decode), the
     journal streams, and per-chunk dispatch/fetch/decode overlap stats
     including the fetched-vs-dense event byte counts.
+
+    ``heartbeat`` (a :class:`..telemetry.stream.HeartbeatWriter`)
+    receives one record per consumed chunk — cumulative NetStats, the
+    device-computed first-violation scan, and the overflow flag; purely
+    observational, trajectories are bit-identical with or without it.
+    ``fail_fast`` stops dispatching once a consumed chunk's scan shows
+    a tripped invariant (at most one further chunk is issued — it was
+    already in flight); the returned tick-axis arrays then cover only
+    ``perf["ticks-dispatched"]`` ticks and ``perf["stopped-early"]`` is
+    set. ``keep_compact`` retains the per-chunk compacted rows on the
+    result for instance-subset re-expansion (``maelstrom triage``).
     """
     if params is None:
         params = model.make_params(sim.net.n_nodes)
@@ -314,14 +420,19 @@ def run_sim_pipelined(model: Model, sim: SimConfig, seed: int,
     fetched_bytes = [0]
     fetch_s = [0.0]
     overflowed = [0]
+    chunk_idx = [0]
+    last_scan: List[Optional[np.ndarray]] = [None]
+    tripped = [False]
 
     def dispatch(carry_st, t0, length):
-        c, _, buf, journal = chunk_fn(carry_st, jnp.int32(t0), length)
-        return c, (buf, journal)
+        c, svec, scan, buf, journal = chunk_fn(carry_st, jnp.int32(t0),
+                                               length)
+        return c, (svec, scan, buf, journal)
 
     def consume(payload, t0, length):
-        buf, journal = payload
+        svec, scan, buf, journal = payload
         t_f = time.monotonic()
+        ovf = False
         if buf is not None:
             # device fetch — overlaps the next chunk's compute
             rows, n, ovf = fetch_compact_payload(buf)
@@ -331,13 +442,29 @@ def run_sim_pipelined(model: Model, sim: SimConfig, seed: int,
         if journal is not None:
             journal_chunks.append((np.asarray(journal[0]),
                                    np.asarray(journal[1])))
+        scan_np = np.asarray(scan)
+        last_scan[0] = scan_np
+        if int(scan_np[0]) > 0:
+            tripped[0] = True
+        if heartbeat is not None:
+            from ..telemetry.stream import (scan_to_violation,
+                                            stats_vec_to_net)
+            heartbeat.record_chunk(
+                chunk=chunk_idx[0], t0=t0, ticks=length,
+                net=stats_vec_to_net(svec),
+                violation=scan_to_violation(scan_np),
+                overflowed=bool(ovf))
+        chunk_idx[0] += 1
         fetch_s[0] += time.monotonic() - t_f
 
-    st, stats = run_chunked(st, plans, dispatch, consume)
+    should_stop = (lambda: tripped[0]) if fail_fast else None
+    st, stats = run_chunked(st, plans, dispatch, consume, should_stop)
     carry = jax.block_until_ready(st)
+    ticks_done = stats["ticks-dispatched"]
 
     t_dec = time.monotonic()
-    events = expand_compact_events(model, sim, compact_chunks)
+    events = expand_compact_events(model, sim, compact_chunks,
+                                   n_ticks=ticks_done)
     decode_s = time.monotonic() - t_dec
     if journal_chunks:
         j_sends = np.concatenate([a for a, _ in journal_chunks], axis=0)
@@ -345,11 +472,11 @@ def run_sim_pipelined(model: Model, sim: SimConfig, seed: int,
     else:
         cfg = sim.net
         M = 0
-        j_sends = np.zeros((sim.n_ticks, 0, M, cfg.lanes), np.int32)
-        j_recvs = np.zeros((sim.n_ticks, 0, cfg.n_total, cfg.inbox_k,
+        j_sends = np.zeros((ticks_done, 0, M, cfg.lanes), np.int32)
+        j_recvs = np.zeros((ticks_done, 0, cfg.n_total, cfg.inbox_k,
                             cfg.lanes), np.int32)
 
-    dense_bytes = sim.n_ticks * R * C * 2 * (2 + V) * 4
+    dense_bytes = ticks_done * R * C * 2 * (2 + V) * 4
     perf = {
         "chunk-ticks": plans[0][1],
         "event-capacity": cap,
@@ -369,4 +496,6 @@ def run_sim_pipelined(model: Model, sim: SimConfig, seed: int,
     }
     return PipelineResult(carry=carry, events=events,
                           journal_sends=j_sends, journal_recvs=j_recvs,
-                          perf=perf)
+                          perf=perf, scan=last_scan[0],
+                          compact=compact_chunks if keep_compact
+                          else None)

@@ -43,6 +43,10 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "pipeline: chunked donated executor / event "
                    "compaction tests (tpu/pipeline.py)")
+    config.addinivalue_line(
+        "markers", "triage: streaming heartbeat / watch / triage "
+                   "forensics tests (telemetry/stream.py, "
+                   "checkers/triage.py)")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -133,15 +133,18 @@ def test_use_after_donate_regression():
     carry0 = jax.tree.map(lambda x: x.copy(),
                           init_carry(model, sim, 7, params))
     pool0 = carry0.pool
-    carry1, svec, buf, _ = chunk_fn(carry0, jnp.int32(0), 40)
+    carry1, svec, scan, buf, _ = chunk_fn(carry0, jnp.int32(0), 40)
     if not pool0.is_deleted():
         pytest.skip("backend did not donate the carry buffer")
     # the donated input is gone — reuse must raise, not return garbage
     with pytest.raises(RuntimeError):
         np.asarray(pool0)
-    # the detached stats snapshot stays readable after the NEXT chunk
-    # donates carry1 away (the overlapped bench loop depends on this)
-    carry2, svec2, _, _ = chunk_fn(carry1, jnp.int32(40), 40)
+    # the detached stats + violation-scan snapshots stay readable after
+    # the NEXT chunk donates carry1 away (the overlapped bench loop and
+    # the run heartbeat both depend on this)
+    carry2, svec2, scan2, _, _ = chunk_fn(carry1, jnp.int32(40), 40)
+    assert np.asarray(scan).shape == (3,)
+    assert np.asarray(scan2).shape == (3,)
     assert carry1.pool.is_deleted()
     d1 = int(np.asarray(svec)[1])
     d2 = int(np.asarray(svec2)[1])

@@ -39,6 +39,28 @@ python -m maelstrom_tpu test --runtime tpu -w echo --node-count 2 \
     --seed 3 --store "$SMOKE_STORE" >/dev/null
 python -m maelstrom_tpu fleet-stats "$SMOKE_STORE"/echo-tpu/latest --no-svg
 test -s "$SMOKE_STORE"/echo-tpu/latest/fleet-metrics.json
+
+echo
+echo "== watch/triage smoke (planted buggy lin-kv -> spacetime SVG)"
+# a short double-vote horizon under partitions: the on-device two-
+# leaders invariant trips, --fail-fast stops dispatch, and the run
+# exits 1 (analysis invalid) — which is the EXPECTED outcome here
+rc=0
+python -m maelstrom_tpu test --runtime tpu -w lin-kv-bug-double-vote \
+    --node-count 3 --concurrency 6 --rate 200 --time-limit 0.3 \
+    --n-instances 16 --record-instances 4 --nemesis partition \
+    --nemesis-interval 0.04 --recovery-time 0 --p-loss 0.05 \
+    --pipeline on --chunk-ticks 50 --seed 7 --fail-fast \
+    --store "$SMOKE_STORE" > "$SMOKE_STORE/triage-smoke.json" || rc=$?
+[[ "$rc" == "1" ]] || { echo "expected exit 1 (mutant caught), got $rc"; exit 1; }
+grep -q '"fail-fast"' "$SMOKE_STORE/triage-smoke.json"
+BUGGY_RUN="$SMOKE_STORE"/lin-kv-bug-double-vote-tpu/latest
+test -s "$BUGGY_RUN"/heartbeat.jsonl
+python -m maelstrom_tpu watch "$BUGGY_RUN"
+python -m maelstrom_tpu triage "$BUGGY_RUN" --max-instances 1
+# the flagged instance got its spacetime diagram + repro bundle
+ls "$BUGGY_RUN"/triage/instance-*/messages.svg
+ls "$BUGGY_RUN"/triage/instance-*/repro.json
 # clean up before the exec below — bash runs no EXIT trap across exec
 rm -rf "$SMOKE_STORE"
 trap - EXIT
