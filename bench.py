@@ -211,6 +211,24 @@ def child_main(canary: bool = False) -> None:
         carry = init_carry(model, sim, 7, params)
         carry_bytes = sum(x.nbytes for x in jax.tree.leaves(carry))
         bytes_per_instance = carry_bytes // max(1, cfg_n_instances)
+
+        # static IR cost of this config's fused tick (analysis/
+        # cost_model.py — the same figures `maelstrom lint --cost`
+        # budgets): the metric line carries the cost trajectory next to
+        # wall-clock, so a fusion refactor shows up in BENCH_*.json as
+        # eqns/bytes down BEFORE a TPU window confirms the ms/tick win.
+        # Purely static (one abstract trace, no device); never allowed
+        # to kill the bench.
+        ir_eqns = ir_bytes_est = None
+        try:
+            from maelstrom_tpu.analysis.cost_model import tick_cost
+            _cost = tick_cost(model, sim, params)
+            ir_eqns, ir_bytes_est = _cost.eqns, _cost.hbm_bytes
+            log(TAG, f"phase[{cfg_name}]: static tick IR — "
+                     f"{ir_eqns} eqns, ~{ir_bytes_est / 1e6:.1f} MB "
+                     f"intermediates/tick")
+        except Exception as e:
+            log(TAG, f"phase[{cfg_name}]: tick_cost unavailable: {e!r}")
         log(TAG, f"phase[{cfg_name}]: sim built — {cfg_n_instances} x "
                  f"{sim.net.n_nodes} nodes, {sim.n_ticks} ticks, "
                  f"{bytes_per_instance} B/instance "
@@ -249,15 +267,15 @@ def child_main(canary: bool = False) -> None:
                      f"{hb_state['writer'].path}")
         if bench_pipeline:
             from maelstrom_tpu.tpu.pipeline import (
-                _make_chunk_fn, compact_payload_bytes,
-                fetch_compact_payload)
+                compact_payload_bytes, fetch_compact_payload,
+                make_chunk_fn)
             from maelstrom_tpu.telemetry.stream import (
                 scan_to_violation, stats_vec_to_net)
             # cap=None: the compacted buffer is sized per (static)
             # dispatch length — the bench adapts its chunk size to the
             # dispatch budget at run time
-            pchunk = _make_chunk_fn(model, sim, params, None, None,
-                                    bench_unroll)
+            pchunk = make_chunk_fn(model, sim, params, None, None,
+                                   bench_unroll)
 
             def chunk_fn(length: int):
                 def run(c, t0):
@@ -351,6 +369,9 @@ def child_main(canary: bool = False) -> None:
                 "wall_s": round(wall, 3),
                 "bytes_per_instance": int(bytes_per_instance),
             }
+            if ir_eqns is not None:
+                rec["ir_eqns"] = ir_eqns
+                rec["ir_bytes_est"] = ir_bytes_est
             if bench_pipeline:
                 rec["pipeline"] = True
                 rec["heartbeat"] = bench_heartbeat

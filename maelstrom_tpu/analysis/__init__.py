@@ -1,6 +1,6 @@
 """Static-analysis subsystem: the ``maelstrom lint`` passes.
 
-Three cooperating passes keep the TPU runtime's contracts machine-
+Five cooperating passes keep the TPU runtime's contracts machine-
 enforced (doc/lint.md has the rule catalog and workflow):
 
 - :mod:`.trace_lint` — AST trace-hygiene lint over the traced surfaces
@@ -9,11 +9,18 @@ enforced (doc/lint.md has the rule catalog and workflow):
   model's shape/dtype/lane contracts: CON2xx rules.
 - :mod:`.schema_lint` — RPC registry vs wire encodings vs demo nodes:
   SCH3xx rules.
+- :mod:`.ir_lint` — opt-in (``--ir``) audit of the LOWERED tick IR:
+  dtype-widening leaks, host round-trips, donation aliasing on the
+  compiled executors, fusion breakers, baked-in constants: JXP4xx.
+- :mod:`.cost_model` + the ``--cost`` gate — per-model static tick
+  cost (eqn count, est. HBM bytes, per-phase decomposition) budgeted
+  against the checked-in ``cost_baseline.json``: COST5xx rules.
 
 Findings are :class:`~.findings.Finding` records; the checked-in
-``baseline.json`` holds the justified exceptions.
+``baseline.json`` holds the justified exceptions and
+``cost_baseline.json`` the per-model cost budget.
 """
 
 from .findings import (Baseline, Finding, LintReport, SEV_ERROR,  # noqa
                        SEV_INFO, SEV_WARNING, render_text)
-from .runner import ALL_PASSES, run_lint  # noqa
+from .runner import ALL_PASSES, EXTRA_PASSES, run_lint  # noqa

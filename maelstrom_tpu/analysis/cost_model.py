@@ -1,0 +1,274 @@
+"""Static per-tick cost model over the lowered JAX IR.
+
+PR 1's AST lint and the abstract-eval contract audit stop at the Python
+surface: they can say a model's *shapes* are right, but not what the
+tick actually lowers to. The tick loop's honest throughput ceiling is
+launch overhead — ~1000 XLA thunks per tick on the flagship config
+(ROADMAP "pipelined executor" item) — so the quantity to budget is the
+**lowered graph itself**: how many equations one fused tick compiles
+to, how they split across the ``jax.named_scope`` phases the runtime
+already annotates (nemesis / deliver / node_phase / client_step /
+enqueue / telemetry), and how many intermediate HBM bytes they move.
+This module computes those numbers *statically* — ``jax.make_jaxpr``
+over the same tick closure the executor scans, no device, no FLOPs —
+so they are deterministic, diffable, and cheap enough to gate every PR.
+
+The numbers feed three consumers:
+
+- ``maelstrom lint --cost`` (``analysis/ir_lint.py``): every registered
+  model x both carry layouts is compared against the checked-in
+  ``analysis/cost_baseline.json``; a >10% eqn or byte regression fails
+  the gate pre-merge, and ``--update-baseline`` re-records after an
+  intentional change.
+- ``bench.py``: the metric line carries ``ir_eqns`` / ``ir_bytes_est``
+  so the static cost trajectory lands in BENCH_*.json next to
+  wall-clock.
+- ``tools/tick_profile.py``: measured ms/tick is printed next to the
+  static per-phase eqn counts, with the phase table defined HERE
+  (:data:`PHASES`) instead of re-derived by hand.
+
+Estimates, not measurements: ``hbm_bytes`` sums every equation's output
+aval bytes (scan bodies weighted by trip count) — an upper-bound proxy
+for HBM traffic that ignores fusion, which is exactly why it works as a
+*regression* signal (fusion-friendlier IR lowers it; a new
+fusion-breaking intermediate raises it).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+# The tick loop's jax.named_scope phase vocabulary (tpu/runtime.py,
+# both carry layouts). Equations outside any named scope (stat
+# accumulation, invariants, event assembly, scan plumbing) count under
+# OTHER_PHASE.
+PHASES = ("nemesis", "deliver", "node_phase", "client_step", "enqueue",
+          "telemetry")
+OTHER_PHASE = "other"
+
+DEFAULT_COST_BASELINE = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "cost_baseline.json")
+
+# cost-gate tolerance: a model's tick may drift this fraction above its
+# baseline eqn/byte figures before COST501 fails the gate
+DEFAULT_TOLERANCE = 0.10
+
+# both carry layouts are first-class citizens of the cost baseline —
+# the batch-minor tick lowers to a (slightly) different graph
+AUDIT_LAYOUTS = ("lead", "minor")
+
+
+@dataclass
+class CostReport:
+    """Static cost of ONE fused tick (one model, one layout)."""
+    eqns: int                        # recursive equation count
+    hbm_bytes: int                   # est. intermediate bytes per tick
+    phases: Dict[str, int] = field(default_factory=dict)
+    ops: Dict[str, int] = field(default_factory=dict)
+    const_bytes: int = 0             # total baked-in constant bytes
+    max_const_bytes: int = 0         # largest single baked-in constant
+    carry_bytes: int = 0             # carry pytree bytes (audit config)
+    max_broadcast_bytes: int = 0     # largest broadcast_in_dim output
+
+    def to_entry(self) -> Dict[str, Any]:
+        """The checked-in baseline representation (stable keys only —
+        the op histogram is too jax-version-volatile to pin)."""
+        return {"eqns": self.eqns,
+                "hbm-bytes-per-tick": self.hbm_bytes,
+                "phases": {k: self.phases[k]
+                           for k in sorted(self.phases)}}
+
+
+def _aval_bytes(v) -> int:
+    aval = getattr(v, "aval", None)
+    shape = getattr(aval, "shape", None)
+    dtype = getattr(aval, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n * dtype.itemsize
+
+
+def _sub_jaxprs(eqn) -> List[Tuple[Any, int]]:
+    """(inner jaxpr, byte-weight multiplier) pairs of one equation.
+    Scan bodies run ``length`` times per outer evaluation; every other
+    nesting (cond branches, while bodies, pjit calls) weighs 1 — while
+    trip counts are unknowable statically and cond branches are
+    alternatives, so 1 is the deterministic choice."""
+    mult = int(eqn.params.get("length", 1)) \
+        if eqn.primitive.name == "scan" else 1
+    out = []
+    for v in eqn.params.values():
+        for sub in (v if isinstance(v, (list, tuple)) else (v,)):
+            inner = getattr(sub, "jaxpr", None)
+            if inner is not None and hasattr(inner, "eqns"):
+                out.append((inner, mult))     # ClosedJaxpr
+            elif hasattr(sub, "eqns"):
+                out.append((sub, mult))       # raw Jaxpr
+    return out
+
+
+_TRANSFORM_RE = re.compile(r"^\w+\((.*)\)$")
+
+
+def _phase_of(eqn) -> str:
+    """Phase attribution from the equation's named_scope stack: the
+    first path component, unwrapped of transform markers — under the
+    batch-minor layout's instance vmap a scope renders as
+    ``vmap(deliver)``. Nested scopes inherit their root phase."""
+    stack = str(eqn.source_info.name_stack)
+    root = stack.split("/", 1)[0] if stack else ""
+    while True:
+        m = _TRANSFORM_RE.match(root)
+        if not m:
+            break
+        root = m.group(1)
+    return root if root in PHASES else OTHER_PHASE
+
+
+def cost_of_jaxpr(closed, carry=None) -> CostReport:
+    """Walk one ClosedJaxpr (a traced tick) into a :class:`CostReport`.
+    ``carry`` (a pytree of ShapeDtypeStructs) sizes the carry-relative
+    thresholds the hazard pass uses."""
+    import jax
+
+    phases: Dict[str, int] = {p: 0 for p in PHASES + (OTHER_PHASE,)}
+    ops: Dict[str, int] = {}
+    totals = {"eqns": 0, "bytes": 0, "max_bcast": 0}
+
+    def walk(jaxpr, phase: Optional[str], mult: int) -> None:
+        for eqn in jaxpr.eqns:
+            ph = phase if phase is not None else _phase_of(eqn)
+            name = eqn.primitive.name
+            totals["eqns"] += 1
+            phases[ph] += 1
+            ops[name] = ops.get(name, 0) + 1
+            out_bytes = sum(_aval_bytes(v) for v in eqn.outvars)
+            totals["bytes"] += out_bytes * mult
+            if name == "broadcast_in_dim":
+                totals["max_bcast"] = max(totals["max_bcast"], out_bytes)
+            for sub, sub_mult in _sub_jaxprs(eqn):
+                walk(sub, ph, mult * sub_mult)
+
+    walk(closed.jaxpr, None, 1)
+    const_sizes = []
+    for c in closed.consts:
+        try:
+            import numpy as np
+            const_sizes.append(int(np.asarray(c).nbytes))
+        except Exception:
+            pass
+    carry_bytes = 0
+    if carry is not None:
+        for leaf in jax.tree.leaves(carry):
+            n = 1
+            for d in getattr(leaf, "shape", ()):
+                n *= int(d)
+            carry_bytes += n * getattr(leaf, "dtype", None).itemsize \
+                if getattr(leaf, "dtype", None) is not None else 0
+    return CostReport(
+        eqns=totals["eqns"], hbm_bytes=totals["bytes"],
+        phases={k: v for k, v in phases.items() if v},
+        ops=ops, const_bytes=sum(const_sizes),
+        max_const_bytes=max(const_sizes, default=0),
+        carry_bytes=carry_bytes,
+        max_broadcast_bytes=totals["max_bcast"])
+
+
+# --- tracing the tick ------------------------------------------------------
+
+
+def audit_sim(model, node_count: int, layout: str = "lead"):
+    """The small fixed audit config every static analysis shares (the
+    contract audit's opts + an explicit carry layout) — cost numbers are
+    comparable only under one config."""
+    from .contract_audit import _audit_opts
+    from ..tpu.harness import make_sim_config
+    return make_sim_config(model, {**_audit_opts(node_count),
+                                   "layout": layout})
+
+
+def trace_tick(model, sim, params=None):
+    """``jax.make_jaxpr`` of the fused tick under ``sim`` — the same
+    closure the executors scan. Returns ``(closed_jaxpr, carry_shapes,
+    out_shapes)`` where ``carry_shapes`` is the input carry pytree of
+    ShapeDtypeStructs and ``out_shapes`` the traced ``(carry', ys)``."""
+    import jax
+    import jax.numpy as jnp
+    from ..tpu.runtime import init_carry, make_tick_fn
+
+    if params is None:
+        params = model.make_params(sim.net.n_nodes)
+    tick = make_tick_fn(model, sim, params)
+    carry = jax.eval_shape(lambda: init_carry(model, sim, 0, params))
+    closed, out_shapes = jax.make_jaxpr(tick, return_shape=True)(
+        carry, jnp.int32(0))
+    return closed, carry, out_shapes
+
+
+def tick_cost(model, sim, params=None) -> CostReport:
+    """One-call static cost of ``model``'s fused tick under ``sim`` —
+    the bench.py / tools entry point."""
+    closed, carry, _ = trace_tick(model, sim, params)
+    return cost_of_jaxpr(closed, carry)
+
+
+# --- the audited model universe -------------------------------------------
+
+
+def cost_specs() -> List[Tuple[str, int]]:
+    """Every registered model: the contract audit's workload table plus
+    the registered buggy variants (the same universe CON2xx audits) —
+    each is costed in BOTH carry layouts."""
+    from .contract_audit import AUDIT_WORKLOADS, _buggy_workloads
+    return list(AUDIT_WORKLOADS) + _buggy_workloads()
+
+
+def entry_key(workload: str, node_count: int, layout: str) -> str:
+    return f"{workload}/n={node_count}/{layout}"
+
+
+# --- baseline io -----------------------------------------------------------
+
+
+def load_cost_baseline(path: Optional[str] = None) -> Dict[str, Any]:
+    path = path or DEFAULT_COST_BASELINE
+    if not os.path.exists(path):
+        return {"version": 1, "tolerance": DEFAULT_TOLERANCE,
+                "entries": {}}
+    with open(path) as f:
+        data = json.load(f)
+    data.setdefault("tolerance", DEFAULT_TOLERANCE)
+    data.setdefault("entries", {})
+    return data
+
+
+def save_cost_baseline(entries: Dict[str, Dict[str, Any]],
+                       path: Optional[str] = None,
+                       tolerance: float = DEFAULT_TOLERANCE) -> str:
+    path = path or DEFAULT_COST_BASELINE
+    payload = {
+        "version": 1,
+        "_comment": (
+            "Per-model static tick-cost baseline for `maelstrom lint "
+            "--cost` (doc/lint.md). Keys: <workload>/n=<nodes>/"
+            "<layout>; eqns = recursive jaxpr equation count of one "
+            "fused tick, hbm-bytes-per-tick = summed intermediate "
+            "output bytes (scan bodies weighted by trip count), phases "
+            "= eqn count per jax.named_scope phase. Regenerate after "
+            "an INTENTIONAL cost change with `maelstrom lint --cost "
+            "--update-baseline`; a PR that regresses any entry by more "
+            "than `tolerance` fails the gate (COST501)."),
+        "tolerance": tolerance,
+        "entries": {k: entries[k] for k in sorted(entries)},
+    }
+    with open(path, "w") as f:
+        json.dump(payload, f, indent=2)
+        f.write("\n")
+    return path

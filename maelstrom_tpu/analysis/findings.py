@@ -78,6 +78,24 @@ class BaselineEntry:
     status: str = "accepted"     # accepted | expected
 
 
+# rule-id prefix -> owning pass; staleness checks are scoped to the
+# passes that actually RAN (a trace/contract/schema run must not call
+# the opt-in ir/cost entries stale just because it skipped those
+# passes)
+_RULE_PASS_PREFIXES = (("TRC", "trace"), ("CON", "contract"),
+                       ("SCH", "schema"), ("JXP", "ir"),
+                       ("COST", "cost"))
+
+
+def fingerprint_pass(fingerprint: str) -> Optional[str]:
+    """The pass a baseline fingerprint's rule family belongs to (None
+    for an unrecognized prefix — treated as always in scope)."""
+    for prefix, pass_name in _RULE_PASS_PREFIXES:
+        if fingerprint.startswith(prefix):
+            return pass_name
+    return None
+
+
 class Baseline:
     """Fingerprint -> entry map with hit tracking (for staleness)."""
 
@@ -104,9 +122,19 @@ class Baseline:
             self._hits[e.fingerprint] = self._hits.get(e.fingerprint, 0) + 1
         return e
 
-    def stale_entries(self) -> List[BaselineEntry]:
-        return [e for fp, e in sorted(self.entries.items())
-                if fp not in self._hits]
+    def stale_entries(self, passes=None) -> List[BaselineEntry]:
+        """Unmatched entries — restricted, when ``passes`` is given, to
+        entries whose rule family belongs to a pass that ran."""
+        out = []
+        for fp, e in sorted(self.entries.items()):
+            if fp in self._hits:
+                continue
+            owner = fingerprint_pass(fp)
+            if passes is not None and owner is not None \
+                    and owner not in passes:
+                continue
+            out.append(e)
+        return out
 
 
 # --- report -----------------------------------------------------------------

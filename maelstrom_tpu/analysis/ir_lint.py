@@ -1,0 +1,575 @@
+"""IR-level hazard lint + cost gate: ``maelstrom lint --ir --cost``.
+
+The AST lint (TRC1xx) and abstract-eval contract audit (CON2xx) police
+the *Python* surface of the traced tick. This pass polices what the
+tick actually **lowers to**: for every registered model and both carry
+layouts it traces the fused tick (``jax.make_jaxpr`` — abstract, no
+device) and audits the jaxpr; it also lowers and COMPILES the real
+production dispatch steps — ``tpu/pipeline.py::make_chunk_fn`` and
+``parallel/mesh.py::make_sharded_chunk_fn``, the exact callables the
+executors dispatch — to verify that carry donation actually aliased on
+the executable (not a re-lowered copy).
+
+Rules (JXP4xx — hazards; COST5xx — the cost budget):
+
+=======  =======================  ========  ===============================
+rule     name                     severity  what it flags
+=======  =======================  ========  ===============================
+JXP400   ir-trace-failure         error     the tick failed to lower at all
+JXP401   dtype-widening-leak      error     a non-int32/uint32 leaf in the
+                                            scan carry (float/64-bit
+                                            promotion leaks: bit-identity,
+                                            x-platform replay, and donated
+                                            compaction all assume integer
+                                            state), or any 64-bit aval
+                                            anywhere in the tick IR
+JXP402   host-round-trip          error     pure_callback / io_callback /
+                                            debug_callback inside the
+                                            traced tick — a device->host
+                                            round-trip per tick
+JXP403   donation-not-aliased     error     a compiled executor declares
+                                            ``donate_argnums`` on the carry
+                                            but the executable did not
+                                            alias every carry leaf
+                                            (silently-dropped donation =
+                                            2x HBM + a hidden copy)
+JXP404   fusion-breaker           warning   ``while`` in the tick body, or
+                                            a ``broadcast_in_dim``
+                                            intermediate larger than k x
+                                            the carry — the patterns that
+                                            break fusion and spill HBM
+JXP405   baked-in-constant        warning   a constant >= 64 KiB embedded
+                                            in the tick jaxpr (executable
+                                            bloat + retrace trigger)
+COST500  cost-baseline-updated    info      ``--update-baseline`` rewrote
+                                            the baseline
+COST501  cost-regression          error     eqns or est. HBM bytes/tick
+                                            regressed > tolerance (10%)
+                                            vs ``cost_baseline.json``
+COST502  cost-baseline-missing    error     a registered model x layout
+                                            has no baseline entry
+COST503  cost-baseline-stale      warning   a baseline entry matches no
+                                            registered model
+COST504  cost-improvement         info      a model got > tolerance
+                                            CHEAPER — refresh the baseline
+                                            to bank the win
+=======  =======================  ========  ===============================
+
+The IR-hazard fixtures (``models/ir_hazards.py``) are audited alongside
+the registered models; their findings are carried as status="expected"
+in ``analysis/baseline.json`` and asserted by
+``tests/test_analysis_ir.py`` — the planted-bug convention of
+``RaftTracedHazards``.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import warnings
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from . import cost_model
+from .cost_model import CostReport
+from .findings import Finding, SEV_ERROR, SEV_INFO, SEV_WARNING
+
+PASS_IR = "ir"
+PASS_COST = "cost"
+
+# the runtime's bit-identity envelope: every carry leaf must be one of
+# these (the master PRNG key is uint32; everything else is int32)
+ALLOWED_CARRY_DTYPES = ("int32", "uint32")
+X64_DTYPES = ("int64", "uint64", "float64")
+
+# host-round-trip primitives (JXP402)
+CALLBACK_PRIMITIVES = ("pure_callback", "io_callback", "debug_callback",
+                       "callback", "outside_call", "host_callback_call")
+
+# JXP404 thresholds: a broadcast intermediate larger than BOTH of these
+# is flagged (the floor keeps tiny audit-config carries from making
+# every legitimate [I, N, N] broadcast look oversized)
+BROADCAST_CARRY_MULT = 8
+BROADCAST_FLOOR_BYTES = 1 << 20          # 1 MiB
+
+# JXP405 threshold
+CONST_WARN_BYTES = 64 << 10              # 64 KiB
+
+# donation-audit subjects: compiling is ~5 s per executable, so the
+# repo-wide gate verifies the (model-independent) executor wiring on
+# the cheapest model rather than re-compiling the world
+DONATION_WORKLOAD = ("echo", 2)
+
+
+def _model_path(model) -> str:
+    return type(model).__module__.replace(".", os.sep) + ".py"
+
+
+def _finding(rule, name, severity, path, symbol, message,
+             pass_name=PASS_IR) -> Finding:
+    return Finding(rule=rule, name=name, severity=severity,
+                   pass_name=pass_name, path=path, line=0,
+                   symbol=symbol, message=message)
+
+
+# --- per-model hazard audit ------------------------------------------------
+
+
+def audit_model_ir(model, node_count: int, layout: str = "lead",
+                   label: Optional[str] = None,
+                   ) -> Tuple[List[Finding], Optional[CostReport]]:
+    """Trace one model's fused tick in one layout and audit the IR.
+    Returns (findings, cost report) — the report is reused by the cost
+    pass so each (model, layout) is traced exactly once per run."""
+    import jax
+
+    label = label or getattr(model, "name", type(model).__name__)
+    label = f"{label}/{layout}"
+    path = _model_path(model)
+    cls = type(model).__name__
+    findings: List[Finding] = []
+
+    def flag(rule, name, message, severity=SEV_ERROR, symbol=cls):
+        findings.append(_finding(rule, name, severity, path, symbol,
+                                 f"[{label}] {message}"))
+
+    try:
+        sim = cost_model.audit_sim(model, node_count, layout)
+        closed, carry, out_shapes = cost_model.trace_tick(model, sim)
+    except Exception as e:
+        flag("JXP400", "ir-trace-failure",
+             f"lowering the fused tick raised {type(e).__name__}: {e}")
+        return findings, None
+    report = cost_model.cost_of_jaxpr(closed, carry)
+
+    # JXP401a: carry leaves outside the integer envelope. The traced
+    # output carry (out_shapes[0]) is authoritative — it is what the
+    # scan actually threads.
+    carry_out = out_shapes[0]
+    for kp, leaf in jax.tree_util.tree_flatten_with_path(carry_out)[0]:
+        dt = str(leaf.dtype)
+        if dt not in ALLOWED_CARRY_DTYPES:
+            flag("JXP401", "dtype-widening-leak",
+                 f"carry leaf {jax.tree_util.keystr(kp) or '<root>'} is "
+                 f"{dt} — the scan carry must stay int32/uint32 "
+                 f"(bit-identity, cross-platform replay, and donated "
+                 f"compaction all assume integer state)")
+    # JXP401b: 64-bit avals anywhere in the tick IR (an enable_x64 /
+    # numpy-scalar promotion leak — silent 2x HBM and a dtype cliff on
+    # TPU, which emulates int64 pairwise)
+    wide = {dt: n for dt, n in _dtype_census(closed).items()
+            if dt in X64_DTYPES}
+    if wide:
+        flag("JXP401", "dtype-widening-leak",
+             f"64-bit intermediates in the tick IR: "
+             f"{', '.join(f'{n}x {dt}' for dt, n in sorted(wide.items()))}"
+             f" — an x64/numpy-promotion leak")
+
+    # JXP402: host callbacks in traced code
+    cbs = {p: n for p, n in report.ops.items()
+           if p in CALLBACK_PRIMITIVES}
+    if cbs:
+        flag("JXP402", "host-round-trip",
+             f"host callback primitive(s) in the tick: "
+             f"{', '.join(f'{p} x{n}' for p, n in sorted(cbs.items()))}"
+             f" — one device->host round-trip per tick serializes the "
+             f"scan and faults the TPU tunnel at fleet scale")
+
+    # JXP404: fusion breakers
+    n_while = report.ops.get("while", 0)
+    if n_while:
+        flag("JXP404", "fusion-breaker", severity=SEV_WARNING,
+             message=f"{n_while} while_loop(s) in the tick body — XLA "
+                     f"can neither unroll nor fuse across an unbounded "
+                     f"trip count (scatter x"
+                     f"{report.ops.get('scatter', 0)}, sort x"
+                     f"{report.ops.get('sort', 0)} ride the same tick)")
+    bcast_limit = max(BROADCAST_CARRY_MULT * max(report.carry_bytes, 1),
+                      BROADCAST_FLOOR_BYTES)
+    if report.max_broadcast_bytes > bcast_limit:
+        flag("JXP404", "fusion-breaker", severity=SEV_WARNING,
+             message=f"a broadcast_in_dim intermediate is "
+                     f"{report.max_broadcast_bytes} B — "
+                     f"{report.max_broadcast_bytes // max(report.carry_bytes, 1)}"
+                     f"x the {report.carry_bytes} B carry (HBM spill "
+                     f"between producer and consumers)")
+
+    # JXP405: baked-in constants
+    if report.max_const_bytes >= CONST_WARN_BYTES:
+        flag("JXP405", "baked-in-constant", severity=SEV_WARNING,
+             message=f"largest baked-in constant is "
+                     f"{report.max_const_bytes} B "
+                     f"({report.const_bytes} B total) — embedded in "
+                     f"every executable and a retrace trigger; pass it "
+                     f"as params instead")
+    return findings, report
+
+
+def _dtype_census(closed) -> Dict[str, int]:
+    census: Dict[str, int] = {}
+
+    def walk(jaxpr):
+        for eqn in jaxpr.eqns:
+            for v in eqn.outvars:
+                dt = getattr(getattr(v, "aval", None), "dtype", None)
+                if dt is not None:
+                    census[str(dt)] = census.get(str(dt), 0) + 1
+            for sub, _ in cost_model._sub_jaxprs(eqn):
+                walk(sub)
+
+    walk(closed.jaxpr)
+    return census
+
+
+# --- JXP403: donation aliasing on the COMPILED executors -------------------
+
+
+def aliased_params_of(compiled_text: str) -> set:
+    """Parse the HLO module header's ``input_output_alias`` config into
+    the set of aliased parameter indices. The config nests braces —
+    ``{ {0}: (0, {}, may-alias), ... }`` — so the block is delimited by
+    brace counting, not regex."""
+    marker = "input_output_alias={"
+    start = compiled_text.find(marker)
+    if start < 0:
+        return set()
+    depth, i = 1, start + len(marker)
+    while i < len(compiled_text) and depth > 0:
+        if compiled_text[i] == "{":
+            depth += 1
+        elif compiled_text[i] == "}":
+            depth -= 1
+        i += 1
+    block = compiled_text[start + len(marker):i - 1]
+    return {int(p) for p in re.findall(r"\(\s*(\d+)\s*,", block)}
+
+
+def audit_donation(jit_fn, args: Sequence[Any], n_donated: int, *,
+                   path: str, symbol: str, label: str,
+                   static_kwargs: Optional[Dict[str, Any]] = None,
+                   ) -> List[Finding]:
+    """Lower + compile ``jit_fn`` (which declares ``donate_argnums`` on
+    its first argument, a pytree of ``n_donated`` leaves) and verify
+    the executable aliased EVERY donated leaf. XLA silently drops
+    un-aliasable donations (shape/dtype mismatch between the donated
+    input and any output) — the failure mode is invisible until HBM
+    fills at 2x the expected footprint."""
+    findings: List[Finding] = []
+
+    def flag(message):
+        findings.append(_finding(
+            "JXP403", "donation-not-aliased", SEV_ERROR, path, symbol,
+            f"[{label}] {message}"))
+
+    try:
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            compiled = jit_fn.lower(*args,
+                                    **(static_kwargs or {})).compile()
+        donation_warnings = [str(w.message) for w in caught
+                             if "donated" in str(w.message).lower()]
+    except Exception as e:
+        flag(f"lower/compile of the donating executor raised "
+             f"{type(e).__name__}: {e}")
+        return findings
+    aliased = aliased_params_of(compiled.as_text())
+    missing = sorted(set(range(n_donated)) - aliased)
+    if missing:
+        flag(f"{len(missing)} of {n_donated} donated carry leaves were "
+             f"NOT aliased by the compiled executable (flat param "
+             f"indices {missing[:8]}{'...' if len(missing) > 8 else ''})"
+             f" — the donation was silently dropped; every undonated "
+             f"leaf doubles its HBM footprint per dispatch")
+    for w in donation_warnings:
+        flag(f"XLA declined donated buffers at compile time: "
+             f"{w.splitlines()[0][:160]}")
+    return findings
+
+
+def audit_step_ir(fn, args: Sequence[Any], *, path: str, symbol: str,
+                  label: str,
+                  static_kwargs: Optional[Dict[str, Any]] = None,
+                  ) -> List[Finding]:
+    """Hazard-audit a whole EXECUTOR STEP (the chunked pipeline dispatch
+    / sharded mesh body) at the jaxpr level: 64-bit leaks and host
+    callbacks anywhere in the step, including the compaction/scan
+    plumbing the per-model tick audit never sees."""
+    import jax
+
+    findings: List[Finding] = []
+
+    def flag(rule, name, message):
+        findings.append(_finding(rule, name, SEV_ERROR, path, symbol,
+                                 f"[{label}] {message}"))
+
+    try:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")   # tracing through a
+            # donating jit: donation cannot apply under make_jaxpr
+            closed = jax.make_jaxpr(
+                lambda *a: fn(*a, **(static_kwargs or {})))(*args)
+    except Exception as e:
+        flag("JXP400", "ir-trace-failure",
+             f"lowering the executor step raised "
+             f"{type(e).__name__}: {e}")
+        return findings
+    wide = {dt: n for dt, n in _dtype_census(closed).items()
+            if dt in X64_DTYPES}
+    if wide:
+        flag("JXP401", "dtype-widening-leak",
+             f"64-bit intermediates in the executor step: "
+             f"{', '.join(f'{n}x {dt}' for dt, n in sorted(wide.items()))}")
+    report = cost_model.cost_of_jaxpr(closed)
+    cbs = {p: n for p, n in report.ops.items()
+           if p in CALLBACK_PRIMITIVES}
+    if cbs:
+        flag("JXP402", "host-round-trip",
+             f"host callback primitive(s) in the executor step: "
+             f"{', '.join(f'{p} x{n}' for p, n in sorted(cbs.items()))}")
+    return findings
+
+
+def _donation_args(model, sim):
+    """ShapeDtypeStruct stand-ins for one chunk dispatch's arguments."""
+    import jax
+    import jax.numpy as jnp
+    from ..tpu.runtime import init_carry
+
+    params = model.make_params(sim.net.n_nodes)
+    carry = jax.eval_shape(lambda: init_carry(model, sim, 0, params))
+    sds = jax.tree.map(lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype),
+                       carry)
+    return params, sds, jax.ShapeDtypeStruct((), jnp.int32)
+
+
+def audit_pipeline_donation(layouts=("lead", "minor"),
+                            chunk_len: int = 4,
+                            step_hazards: bool = True) -> List[Finding]:
+    """JXP403 over the single-device pipelined executor: compile the
+    ACTUAL ``make_chunk_fn`` product (the callable run_sim_pipelined
+    dispatches) and verify carry aliasing, in both carry layouts —
+    plus (``step_hazards``) the jaxpr-level hazard audit of the whole
+    dispatch step, compaction and scan plumbing included."""
+    import jax
+    from ..models import get_model
+    from ..tpu import pipeline
+    from ..tpu.runtime import default_instance_ids
+
+    wl, n = DONATION_WORKLOAD
+    findings: List[Finding] = []
+    for layout in layouts:
+        model = get_model(wl, n)
+        sim = cost_model.audit_sim(model, n, layout)
+        params, carry_sds, t_sds = _donation_args(model, sim)
+        chunk_fn = pipeline.make_chunk_fn(
+            model, sim, params, default_instance_ids(sim), 64, 1)
+        kw = dict(path="maelstrom_tpu/tpu/pipeline.py",
+                  symbol="make_chunk_fn", label=f"{wl}/n={n}/{layout}",
+                  static_kwargs={"length": chunk_len})
+        if step_hazards:
+            findings.extend(audit_step_ir(chunk_fn,
+                                          (carry_sds, t_sds), **kw))
+        findings.extend(audit_donation(
+            chunk_fn, (carry_sds, t_sds),
+            len(jax.tree.leaves(carry_sds)), **kw))
+    return findings
+
+
+def audit_mesh_donation(chunk_len: int = 4,
+                        step_hazards: bool = True) -> List[Finding]:
+    """JXP403 over the sharded executor: compile the ACTUAL
+    ``make_sharded_chunk_fn`` product on a 1-device mesh and verify the
+    wire carry aliased through the shard_map boundary — plus
+    (``step_hazards``) the jaxpr-level hazard audit of the sharded
+    body."""
+    import jax
+    import jax.numpy as jnp
+    from ..models import get_model
+    from ..parallel import mesh as mesh_mod
+    from ..tpu.runtime import init_carry
+
+    wl, n = DONATION_WORKLOAD
+    model = get_model(wl, n)
+    sim = cost_model.audit_sim(model, n, "lead")
+    params = model.make_params(sim.net.n_nodes)
+    if params is None:
+        params = jnp.zeros((), jnp.int32)    # the _prepare convention
+    mesh = mesh_mod.make_mesh(1)
+    chunk_fn, _ = mesh_mod.make_sharded_chunk_fn(model, sim, mesh,
+                                                 params)
+    wire = jax.eval_shape(
+        lambda p: mesh_mod._carry_to_wire(
+            init_carry(model, sim, 0, p), sim), params)
+    wire_sds = jax.tree.map(
+        lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype), wire)
+    t_sds = jax.ShapeDtypeStruct((), jnp.int32)
+    p_sds = jax.tree.map(
+        lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype), params)
+    kw = dict(path="maelstrom_tpu/parallel/mesh.py",
+              symbol="make_sharded_chunk_fn", label=f"{wl}/n={n}/sharded",
+              static_kwargs={"length": chunk_len})
+    findings: List[Finding] = []
+    if step_hazards:
+        findings.extend(audit_step_ir(chunk_fn,
+                                      (wire_sds, t_sds, p_sds), **kw))
+    findings.extend(audit_donation(
+        chunk_fn, (wire_sds, t_sds, p_sds),
+        len(jax.tree.leaves(wire_sds)), **kw))
+    return findings
+
+
+# --- the cost gate ---------------------------------------------------------
+
+
+def compare_costs(live: Dict[str, CostReport],
+                  baseline: Dict[str, Any],
+                  paths: Dict[str, Tuple[str, str]],
+                  full_universe: bool = True) -> List[Finding]:
+    """Diff live cost reports against the checked-in baseline.
+    ``paths`` maps entry key -> (repo path, class symbol) for finding
+    locations; ``full_universe`` gates stale-entry reporting (a
+    restricted audit never sees every key)."""
+    tol = float(baseline.get("tolerance", cost_model.DEFAULT_TOLERANCE))
+    entries = baseline.get("entries", {})
+    findings: List[Finding] = []
+    for key in sorted(live):
+        rep = live[key]
+        path, symbol = paths[key]
+        base = entries.get(key)
+        if base is None:
+            findings.append(_finding(
+                "COST502", "cost-baseline-missing", SEV_ERROR, path,
+                symbol,
+                f"[{key}] no cost-baseline entry — record one with "
+                f"`maelstrom lint --cost --update-baseline`",
+                pass_name=PASS_COST))
+            continue
+        regressions = []
+        for field_name, got, want in (
+                ("eqns", rep.eqns, base.get("eqns")),
+                ("hbm-bytes-per-tick", rep.hbm_bytes,
+                 base.get("hbm-bytes-per-tick"))):
+            if want is None or want <= 0:
+                continue
+            if got > want * (1 + tol):
+                regressions.append((field_name, got, want))
+        if regressions:
+            worst = _worst_phase_delta(rep.phases, base.get("phases", {}))
+            detail = "; ".join(
+                f"{f}: {got} vs baseline {want} "
+                f"(+{(got / want - 1) * 100:.0f}%)"
+                for f, got, want in regressions)
+            findings.append(_finding(
+                "COST501", "cost-regression", SEV_ERROR, path, symbol,
+                f"[{key}] tick cost regressed past the {tol:.0%} "
+                f"budget: {detail}{worst} — make the change cheaper, "
+                f"or re-baseline with --update-baseline and justify it "
+                f"in the PR", pass_name=PASS_COST))
+        elif (rep.eqns < base.get("eqns", 0) * (1 - tol)
+              and rep.hbm_bytes <= base.get("hbm-bytes-per-tick",
+                                            rep.hbm_bytes)):
+            findings.append(_finding(
+                "COST504", "cost-improvement", SEV_INFO, path, symbol,
+                f"[{key}] tick got cheaper: eqns {rep.eqns} vs baseline "
+                f"{base['eqns']} — run --update-baseline to bank the "
+                f"win", pass_name=PASS_COST))
+    if full_universe:
+        for key in sorted(set(entries) - set(live)):
+            findings.append(_finding(
+                "COST503", "cost-baseline-stale", SEV_WARNING,
+                "maelstrom_tpu/analysis/cost_baseline.json", "",
+                f"[{key}] baseline entry matches no registered "
+                f"model x layout — remove or re-record it",
+                pass_name=PASS_COST))
+    return findings
+
+
+def _worst_phase_delta(live_phases: Dict[str, int],
+                       base_phases: Dict[str, int]) -> str:
+    worst, delta = None, 0
+    for ph in set(live_phases) | set(base_phases):
+        d = live_phases.get(ph, 0) - base_phases.get(ph, 0)
+        if d > delta:
+            worst, delta = ph, d
+    return f" (worst phase: {worst} +{delta} eqns)" if worst else ""
+
+
+# --- orchestration ---------------------------------------------------------
+
+
+def run_ir_lint(repo_root: str = ".", hazards: bool = True,
+                cost: bool = False,
+                cost_baseline_path: Optional[str] = None,
+                update_baseline: bool = False,
+                workloads: Optional[List[Tuple[str, int]]] = None,
+                layouts: Sequence[str] = cost_model.AUDIT_LAYOUTS,
+                include_fixtures: bool = True,
+                donation: bool = True) -> List[Finding]:
+    """Run the IR hazard pass and/or the cost gate.
+
+    ``workloads=None`` audits the full registered universe (plus the IR
+    fixtures and the compiled-donation audit); a restricted list skips
+    fixtures/donation/stale reporting — pointing the analyzer at a
+    model means "audit this model", not "re-audit the world".
+    """
+    from ..models import get_model
+
+    full = workloads is None
+    specs = cost_model.cost_specs() if full else list(workloads)
+    findings: List[Finding] = []
+    live: Dict[str, CostReport] = {}
+    paths: Dict[str, Tuple[str, str]] = {}
+
+    for wl, n in specs:
+        try:
+            model = get_model(wl, n, "grid")
+        except Exception as e:
+            findings.append(_finding(
+                "JXP400", "ir-trace-failure", SEV_ERROR,
+                "maelstrom_tpu/models/__init__.py", "get_model",
+                f"get_model({wl!r}, {n}) raised: {e!r}"))
+            continue
+        for layout in layouts:
+            fs, report = audit_model_ir(model, n, layout,
+                                        label=f"{wl}/n={n}")
+            if hazards:
+                findings.extend(fs)
+            else:
+                # a tick that no longer lowers is fatal for the cost
+                # pass too: without this a cost-only run would drop the
+                # broken model from `live` and misreport it as a mere
+                # stale-entry warning (or, with --update-baseline,
+                # silently delete its budget)
+                findings.extend(f for f in fs if f.rule == "JXP400")
+            if report is not None:
+                key = cost_model.entry_key(wl, n, layout)
+                live[key] = report
+                paths[key] = (_model_path(model), type(model).__name__)
+
+    if hazards and full and include_fixtures:
+        from ..models.ir_hazards import IR_FIXTURE_MODELS
+        for kind, cls in sorted(IR_FIXTURE_MODELS.items()):
+            fs, _ = audit_model_ir(cls(), 2, "lead",
+                                   label=f"fixture-{kind}")
+            findings.extend(fs)
+
+    if hazards and full and donation:
+        findings.extend(audit_pipeline_donation())
+        findings.extend(audit_mesh_donation())
+
+    if cost:
+        if update_baseline:
+            path = cost_model.save_cost_baseline(
+                {k: r.to_entry() for k, r in live.items()},
+                cost_baseline_path)
+            findings.append(_finding(
+                "COST500", "cost-baseline-updated", SEV_INFO,
+                os.path.relpath(path, os.path.abspath(repo_root))
+                if os.path.isabs(path) else path, "",
+                f"recorded {len(live)} cost-baseline entr"
+                f"{'y' if len(live) == 1 else 'ies'}",
+                pass_name=PASS_COST))
+        else:
+            baseline = cost_model.load_cost_baseline(cost_baseline_path)
+            findings.extend(compare_costs(live, baseline, paths,
+                                          full_universe=full))
+    return findings

@@ -17,19 +17,30 @@ from .findings import (Baseline, DEFAULT_BASELINE, Finding, LintReport,
 
 ALL_PASSES = ("trace", "contract", "schema")
 
+# opt-in passes: the IR hazard audit and the cost gate trace (and, for
+# JXP403, compile) every registered model — tens of seconds, so they
+# run only when named (`--ir` / `--cost` / `--pass ir`), never as part
+# of the default sweep
+EXTRA_PASSES = ("ir", "cost")
+
 
 def run_lint(repo_root: str = ".",
              passes: Optional[Sequence[str]] = None,
              paths: Optional[List[str]] = None,
              baseline_path: Optional[str] = DEFAULT_BASELINE,
+             cost_baseline_path: Optional[str] = None,
+             update_cost_baseline: bool = False,
              ) -> LintReport:
     """Run the requested passes and fold in the baseline.
 
-    ``passes=None`` means "everything" — unless ``paths`` restricts the
+    ``passes=None`` means "every default pass" (trace/contract/schema;
+    the IR + cost passes are opt-in) — unless ``paths`` restricts the
     run to explicit files, in which case only the trace pass runs by
     default (pointing the linter at a file means "lint this file", not
     "re-audit the world"). Passes named explicitly always run.
     ``baseline_path=None`` disables baseline suppression entirely.
+    ``cost_baseline_path`` / ``update_cost_baseline`` parameterize the
+    cost pass (analysis/cost_baseline.json by default).
     """
     repo_root = os.path.abspath(repo_root)
     findings: List[Finding] = []
@@ -52,6 +63,14 @@ def run_lint(repo_root: str = ".",
     if "schema" in effective:
         from .schema_lint import run_schema_lint
         findings.extend(run_schema_lint(repo_root))
+    if "ir" in effective or "cost" in effective:
+        from .ir_lint import run_ir_lint
+        findings.extend(run_ir_lint(
+            repo_root,
+            hazards="ir" in effective,
+            cost="cost" in effective,
+            cost_baseline_path=cost_baseline_path,
+            update_baseline=update_cost_baseline))
 
     baseline = (Baseline.load(baseline_path) if baseline_path
                 else Baseline())
@@ -65,10 +84,13 @@ def run_lint(repo_root: str = ".",
     # staleness is only meaningful for a full-scope run: a partial
     # invocation (--pass / explicit paths) never sees the findings that
     # out-of-scope baseline entries suppress, and reporting those as
-    # stale would tell the user to delete live entries
-    full_scope = set(effective) == set(ALL_PASSES) and paths is None
+    # stale would tell the user to delete live entries. Staleness is
+    # also PASS-scoped (findings.fingerprint_pass): a default run must
+    # not report the ir/cost entries as stale just because those
+    # opt-in passes did not run.
+    full_scope = set(ALL_PASSES) <= set(effective) and paths is None
     return LintReport(findings=live, suppressed=suppressed,
-                      stale=baseline.stale_entries() if full_scope
-                      else [],
+                      stale=baseline.stale_entries(set(effective))
+                      if full_scope else [],
                       files_scanned=files_scanned,
                       passes_run=effective)

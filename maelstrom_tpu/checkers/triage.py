@@ -6,9 +6,10 @@ message history. ``maelstrom triage <run-dir>`` closes that loop:
 
 1. **Select** the flagged instances — results.json's
    ``invariants.violating-instance-ids`` when the run completed, else
-   the streaming heartbeat's device-computed first-violation scan
-   (telemetry/stream.py), so a run killed mid-horizon (or stopped by
-   ``--fail-fast``) is still triageable.
+   ALL instances the streaming heartbeat's device-computed top-K
+   violation scans named (telemetry/stream.py ``flagged_instances`` —
+   up to ``--scan-top-k`` per chunk), so a run killed mid-horizon (or
+   stopped by ``--fail-fast``) is still triageable.
 2. **Replay** exactly those instances bit-exactly (the instance-stable
    RNG of tpu/runtime.py: a trajectory depends only on
    ``(seed, instance_id)``) with full event recording AND per-message

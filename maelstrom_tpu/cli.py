@@ -11,7 +11,9 @@ option specs :136-229):
 - ``export`` — emit Jepsen-compatible EDN histories for adjudication by
   stock Elle/Knossos outside this image
 - ``lint``   — the static-analysis gate: trace-hygiene, abstract-eval
-  contract, and schema/wire conformance passes (doc/lint.md)
+  contract, and schema/wire conformance passes, plus the opt-in
+  IR-hazard audit and per-model cost budget (``--ir`` / ``--cost``;
+  doc/lint.md)
 - ``fleet-stats`` — render a TPU run's device-telemetry report (text +
   SVG dashboards from fleet-metrics.json; doc/observability.md)
 - ``watch``  — tail a live (or dead) run's streaming heartbeat.jsonl
@@ -167,6 +169,12 @@ def add_test_options(p: argparse.ArgumentParser):
                         "and `maelstrom triage` picks up from there. "
                         "Needs the chunked executor (a multi-chunk "
                         "horizon or --pipeline on)")
+    p.add_argument("--scan-top-k", type=_positive_int, default=8,
+                   help="TPU runtime: violation-scan lanes per chunk — "
+                        "the heartbeat names the top-K earliest "
+                        "tripping instances per chunk instead of just "
+                        "the argmin, and `maelstrom triage` replays "
+                        "all of them (default 8)")
     p.add_argument("--profile-dir", default=None,
                    help="TPU runtime: capture a jax.profiler trace of "
                         "the run into this directory")
@@ -324,6 +332,7 @@ def cmd_test(args) -> int:
             topology=args.topology,
             heartbeat=not args.no_heartbeat,
             fail_fast=args.fail_fast,
+            scan_top_k=args.scan_top_k,
             node_count=node_count, concurrency=concurrency,
             rate=args.rate, time_limit=args.time_limit,
             latency=args.latency, latency_dist=args.latency_dist,
@@ -845,14 +854,23 @@ def cmd_lint(args) -> int:
     from .analysis import render_text, run_lint
     from .analysis.findings import DEFAULT_BASELINE
 
-    # None = runner default (all passes; trace-only when paths restrict)
-    passes = tuple(args.passes) if args.passes else None
+    # None = runner default (all default passes; trace-only when paths
+    # restrict). --ir / --cost are additive shorthands for the opt-in
+    # IR-hazard and cost-budget passes (--update-baseline implies
+    # --cost: re-recording IS a cost-pass run).
+    passes = list(args.passes) if args.passes else []
+    if args.ir:
+        passes.append("ir")
+    if args.cost or args.update_baseline:
+        passes.append("cost")
     baseline = None if args.no_baseline else (args.baseline
                                               or DEFAULT_BASELINE)
     report = run_lint(repo_root=args.root,
-                      passes=passes,
+                      passes=tuple(dict.fromkeys(passes)) or None,
                       paths=args.paths or None,
-                      baseline_path=baseline)
+                      baseline_path=baseline,
+                      cost_baseline_path=args.cost_baseline,
+                      update_cost_baseline=args.update_baseline)
     if args.json:
         print(json.dumps(report.to_json(), indent=2))
     else:
@@ -965,7 +983,9 @@ def main(argv=None) -> int:
 
     p_lint = sub.add_parser(
         "lint", help="static analysis: trace-hygiene, contract, and "
-                     "schema/wire conformance passes (doc/lint.md)")
+                     "schema/wire conformance passes, plus the opt-in "
+                     "IR hazard audit (--ir) and per-model cost budget "
+                     "(--cost) (doc/lint.md)")
     p_lint.add_argument("paths", nargs="*",
                         help="restrict the trace-hygiene pass to these "
                              "files (other passes then run only when "
@@ -976,8 +996,34 @@ def main(argv=None) -> int:
     p_lint.add_argument("--json", action="store_true",
                         help="machine-readable findings on stdout")
     p_lint.add_argument("--pass", dest="passes", action="append",
-                        choices=["trace", "contract", "schema"],
-                        help="run only the named pass(es); default all")
+                        choices=["trace", "contract", "schema", "ir",
+                                 "cost"],
+                        help="run only the named pass(es); default "
+                             "trace+contract+schema (ir/cost are "
+                             "opt-in — they trace/compile every "
+                             "registered model)")
+    p_lint.add_argument("--ir", action="store_true",
+                        help="run the IR hazard pass (JXP4xx): audit "
+                             "the lowered tick jaxpr of every "
+                             "registered model x both carry layouts "
+                             "and verify donation aliasing on the "
+                             "compiled pipeline/mesh executors "
+                             "(doc/lint.md)")
+    p_lint.add_argument("--cost", action="store_true",
+                        help="run the cost-budget gate (COST5xx): "
+                             "static tick cost of every registered "
+                             "model x both layouts vs "
+                             "analysis/cost_baseline.json; >10% "
+                             "regression is an error")
+    p_lint.add_argument("--update-baseline", action="store_true",
+                        help="re-record analysis/cost_baseline.json "
+                             "from the current tree (implies --cost); "
+                             "commit the result with the PR that "
+                             "justifies the new cost")
+    p_lint.add_argument("--cost-baseline", default=None,
+                        help="cost-baseline file (default "
+                             "maelstrom_tpu/analysis/cost_baseline"
+                             ".json)")
     p_lint.add_argument("--baseline", default=None,
                         help="baseline file (default "
                              "maelstrom_tpu/analysis/baseline.json)")

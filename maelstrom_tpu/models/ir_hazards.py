@@ -1,0 +1,122 @@
+"""Deliberately IR-hazardous models — the JXP-rule lint fixtures.
+
+``models/raft_buggy.py`` holds the two older fixture families: protocol
+bugs the checkers must catch, and Python-surface trace hazards the AST
+lint (TRC1xx) must catch. This module is the third: models whose
+*Python* is clean — they trace, they hold the eval_shape contracts, the
+AST lint has nothing to say — but whose **lowered IR** carries exactly
+the hazards the IR analyzer (``analysis/ir_lint.py``, JXP4xx) exists to
+flag before they cost a device run:
+
+- :class:`IrFloatLeak` — a float32 leaf rides the scan carry. The tick
+  is still a perfect shape/dtype fixed point (CON201 is satisfied!),
+  but the carry has left the int32/uint32 bit-identity envelope the
+  runtime guarantees — cross-platform replay and donation-safe
+  compaction both assume integer state. JXP401.
+- :class:`IrHostCallback` — a host callback inside the traced tick: a
+  device->host->device round-trip per tick that serializes the scan
+  and faults the TPU tunnel at fleet scale. JXP402.
+- :class:`IrFusionBreaker` — a traced ``while_loop`` plus an oversized
+  ``broadcast_in_dim`` intermediate (many times the carry) in the tick
+  body: the fusion-breaker patterns that blow up thunk count and HBM
+  spill. JXP404.
+- :class:`IrBakedConst` — a large module-level numpy array hoisted into
+  the jaxpr as a baked-in constant: executable bloat, and a retrace
+  trigger whenever the "constant" changes. JXP405.
+
+Like ``RaftTracedHazards``, these are NOT in any workload registry and
+must never be: ``tests/test_analysis_ir.py`` asserts each one trips its
+rule, and ``analysis/baseline.json`` carries the findings as
+status="expected" (visible, never silently baselined).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .echo import EchoModel
+
+
+class _FloatRow(NamedTuple):
+    seen: jnp.ndarray    # int32 — the honest part of the row
+    drift: jnp.ndarray   # float32 — the planted carry leak
+
+
+class IrFloatLeak(EchoModel):
+    """IR FIXTURE (do not register): a float32 leaf in the scan carry.
+
+    Shape/dtype fixed point holds (float32 in, float32 out), so the
+    contract audit passes — only the IR pass sees that the carry left
+    the integer envelope."""
+    name = "echo-ir-float-leak"
+
+    def init_row(self, n_nodes, node_idx, key, params):
+        return _FloatRow(seen=jnp.zeros((), jnp.int32),
+                         drift=jnp.zeros((), jnp.float32))
+
+    def handle(self, row, node_idx, msg, t, key, cfg, params):
+        _, out = super().handle(row.seen, node_idx, msg, t, key, cfg,
+                                params)
+        # a weak-typed python float promotes the accumulator — the
+        # classic silent widening the rule exists for
+        drift = row.drift * 0.999 + 1.0
+        return _FloatRow(seen=row.seen + 1, drift=drift), out
+
+
+class IrHostCallback(EchoModel):
+    """IR FIXTURE (do not register): a host callback in the traced
+    tick — one device->host round-trip per tick per node."""
+    name = "echo-ir-host-callback"
+
+    def tick(self, row, node_idx, t, key, cfg, params):
+        jitter = jax.pure_callback(
+            lambda tt: np.int32(0),
+            jax.ShapeDtypeStruct((), jnp.int32), t,
+            vmap_method="expand_dims")
+        return row + jitter * 0, jnp.zeros((self.tick_out, cfg.lanes),
+                                           dtype=jnp.int32)
+
+
+class IrFusionBreaker(EchoModel):
+    """IR FIXTURE (do not register): fusion-breaking tick body — a
+    traced while_loop (unbounded trip count: XLA can neither unroll nor
+    fuse across it) and a broadcast intermediate many times the carry
+    size (HBM spill between the producer and every consumer)."""
+    name = "echo-ir-fusion-breaker"
+
+    def tick(self, row, node_idx, t, key, cfg, params):
+        big = jnp.broadcast_to(t, (512, 1024))   # 2 MiB of int32
+        row = row + jnp.sum(big) * 0
+        row = jax.lax.while_loop(lambda r: r < 0, lambda r: r + 1, row)
+        return row, jnp.zeros((self.tick_out, cfg.lanes),
+                              dtype=jnp.int32)
+
+
+# 128 KiB of int32 that lowers as a jaxpr constant, not an input
+_BAKED_TABLE = np.arange(32768, dtype=np.int32)
+
+
+class IrBakedConst(EchoModel):
+    """IR FIXTURE (do not register): a large baked-in constant — the
+    whole table is embedded in every compiled executable, and editing
+    it silently retraces."""
+    name = "echo-ir-baked-const"
+
+    def tick(self, row, node_idx, t, key, cfg, params):
+        bias = jnp.sum(jnp.asarray(_BAKED_TABLE)) * 0
+        return row + bias, jnp.zeros((self.tick_out, cfg.lanes),
+                                     dtype=jnp.int32)
+
+
+# audited by analysis/ir_lint.py alongside the registered models;
+# intentionally NOT reachable from models.get_model
+IR_FIXTURE_MODELS = {
+    "float-leak": IrFloatLeak,
+    "host-callback": IrHostCallback,
+    "fusion-breaker": IrFusionBreaker,
+    "baked-const": IrBakedConst,
+}

@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..tpu.pipeline import DEFAULT_SCAN_TOP_K
 from ..tpu.runtime import Carry, Model, NetStats, SimConfig, simulate
 from ..telemetry.recorder import Telemetry
 
@@ -224,12 +225,62 @@ def _carry_from_wire(w: Carry, sim: SimConfig) -> Carry:
     return carry_from_canonical(c, sim)
 
 
+def make_sharded_chunk_fn(model: Model, sim: SimConfig, mesh: Mesh,
+                          params, scan_k: int = DEFAULT_SCAN_TOP_K):
+    """Build the sharded production dispatch step: the jitted,
+    wire-donating ``chunk_fn(wire, t0, params, length)`` plus the
+    ``wire_spec`` its carry crosses the shard_map boundary under.
+    ``sim`` describes the PER-DEVICE shard; ``scan_k`` is the per-shard
+    violation scan's top-K width.
+
+    Public because it IS the executable the sharded runner dispatches:
+    the IR/cost analyzer (``analysis/ir_lint.py``) lowers and compiles
+    this exact callable to verify donation aliasing (JXP403) and audit
+    the sharded body's IR — not a re-lowered copy."""
+    from ..tpu.pipeline import violation_scan
+    from ..tpu.runtime import default_instance_ids, init_carry, \
+        make_tick_fn
+
+    axes = mesh.axis_names
+    dummy_w = jax.eval_shape(
+        lambda p: _carry_to_wire(init_carry(model, sim, 0, p), sim),
+        params)
+    wire_spec = jax.tree.map(lambda _: P(axes), dummy_w)
+
+    @partial(jax.jit, static_argnames=("length",), donate_argnums=0)
+    def chunk_fn(wire, t0, params, length):
+        def body(w, t0_rep, params_rep):
+            carry = _carry_from_wire(w, sim)
+            tick = make_tick_fn(model, sim, params_rep)
+            carry, ys = jax.lax.scan(
+                tick, carry,
+                t0_rep.reshape(()) + jnp.arange(length, dtype=jnp.int32))
+            events = (ys.events if ys.events is not None
+                      else _empty_events(model, sim, length))
+            # detached per-shard snapshots ([1, 5] stats / [1, K, 3]
+            # scan, shard-leading so they concatenate under P(axes)):
+            # the heartbeat reads them after the wire is donated away
+            svec = jnp.stack(list(carry.stats)).reshape(1, -1)
+            scan = violation_scan(
+                carry.violations, carry.telemetry,
+                default_instance_ids(sim), k=scan_k)[None]
+            return _carry_to_wire(carry, sim), events, svec, scan
+        return _shard_map(
+            body, mesh=mesh,
+            in_specs=(wire_spec, P(), P()),
+            out_specs=(wire_spec, P(None, axes), P(axes),
+                       P(axes)))(wire, t0, params)
+
+    return chunk_fn, wire_spec
+
+
 def run_sim_sharded_chunked(model: Model, sim: SimConfig, seed: int,
                             params=None, mesh: Optional[Mesh] = None,
                             chunk: int = 100,
                             return_telemetry: bool = False,
                             perf: Optional[dict] = None,
-                            heartbeat=None, fail_fast: bool = False):
+                            heartbeat=None, fail_fast: bool = False,
+                            scan_k: Optional[int] = None):
     """:func:`run_sim_sharded` issued as a sequence of ``chunk``-tick
     device dispatches — the production dispatch pattern (single giant
     dispatches fault the TPU tunnel; see bench.py) — with the carry left
@@ -246,14 +297,16 @@ def run_sim_sharded_chunked(model: Model, sim: SimConfig, seed: int,
 
     ``heartbeat`` (a :class:`..telemetry.stream.HeartbeatWriter`) gets
     one record per consumed chunk: each shard computes its own detached
-    NetStats snapshot + first-violation scan ON DEVICE (fresh [1, 5] /
-    [1, 3] blocks, so they survive the wire donation) and the host
-    merges the ``[n_shards, 3]`` scans — violating counts summed,
-    earliest tick argmin'd, local instance indices remapped to the
-    merged global ids the returned ``violations`` array uses.
-    ``fail_fast`` stops dispatching within one chunk of a consumed
-    chunk's scan showing a tripped invariant; the events then cover
-    only ``perf["ticks-dispatched"]`` ticks.
+    NetStats snapshot + top-K first-violation scan ON DEVICE (fresh
+    [1, 5] / [1, K, 3] blocks, so they survive the wire donation) and
+    the host merges the ``[n_shards, K, 3]`` scans — violating counts
+    summed, rows re-ranked by earliest tick, local instance indices
+    remapped to the merged global ids the returned ``violations`` array
+    uses (``stream.combine_shard_scans``). ``scan_k`` defaults to
+    :data:`..tpu.pipeline.DEFAULT_SCAN_TOP_K`. ``fail_fast`` stops
+    dispatching within one chunk of a consumed chunk's scan showing a
+    tripped invariant; the events then cover only
+    ``perf["ticks-dispatched"]`` ticks.
 
     Returns the same (psum'd NetStats, violations, events) triple —
     events concatenated on host along the tick axis — plus the merged
@@ -261,22 +314,22 @@ def run_sim_sharded_chunked(model: Model, sim: SimConfig, seed: int,
     """
     import numpy as np
 
-    from ..tpu.pipeline import plan_chunks, run_chunked, violation_scan
-    from ..tpu.runtime import default_instance_ids, init_carry, \
-        make_tick_fn
+    from ..tpu.pipeline import plan_chunks, run_chunked
+    from ..tpu.runtime import init_carry
     from ..telemetry.stream import (combine_shard_scans,
-                                    scan_to_violation, stats_vec_to_net)
+                                    scan_to_violation,
+                                    scan_to_violations, stats_vec_to_net)
 
     mesh = mesh or make_mesh()
     mesh, seeds, params = _prepare(model, sim, seed, mesh, params)
     axes = mesh.axis_names
+    if scan_k is None:
+        scan_k = DEFAULT_SCAN_TOP_K
 
     plans = plan_chunks(sim.n_ticks, chunk)
 
-    dummy_w = jax.eval_shape(
-        lambda p: _carry_to_wire(init_carry(model, sim, 0, p), sim),
-        params)
-    wire_spec = jax.tree.map(lambda _: P(axes), dummy_w)
+    chunk_fn, wire_spec = make_sharded_chunk_fn(model, sim, mesh,
+                                                params, scan_k=scan_k)
 
     @jax.jit
     def init_fn(seeds, params):
@@ -286,30 +339,6 @@ def run_sim_sharded_chunked(model: Model, sim: SimConfig, seed: int,
         return _shard_map(
             body, mesh=mesh, in_specs=(P(*axes), P()),
             out_specs=wire_spec)(seeds, params)
-
-    @partial(jax.jit, static_argnames=("length",), donate_argnums=0)
-    def chunk_fn(wire, t0, params, length):
-        def body(w, t0_rep, params_rep):
-            carry = _carry_from_wire(w, sim)
-            tick = make_tick_fn(model, sim, params_rep)
-            carry, ys = jax.lax.scan(
-                tick, carry,
-                t0_rep.reshape(()) + jnp.arange(length, dtype=jnp.int32))
-            events = (ys.events if ys.events is not None
-                      else _empty_events(model, sim, length))
-            # detached per-shard snapshots ([1, 5] stats / [1, 3] scan,
-            # shard-leading so they concatenate under P(axes)): the
-            # heartbeat reads them after the wire is donated away
-            svec = jnp.stack(list(carry.stats)).reshape(1, -1)
-            scan = violation_scan(
-                carry.violations, carry.telemetry,
-                default_instance_ids(sim)).reshape(1, -1)
-            return _carry_to_wire(carry, sim), events, svec, scan
-        return _shard_map(
-            body, mesh=mesh,
-            in_specs=(wire_spec, P(), P()),
-            out_specs=(wire_spec, P(None, axes), P(axes),
-                       P(axes)))(wire, t0, params)
 
     events_chunks = []
     chunk_idx = [0]
@@ -325,13 +354,14 @@ def run_sim_sharded_chunked(model: Model, sim: SimConfig, seed: int,
         events_chunks.append(np.asarray(events))
         scan_np = combine_shard_scans(np.asarray(scan),
                                       sim.n_instances)
-        if int(scan_np[0]) > 0:
+        if int(scan_np[0, 0]) > 0:
             tripped[0] = True
         if heartbeat is not None:
             heartbeat.record_chunk(
                 chunk=chunk_idx[0], t0=t0, ticks=length,
                 net=stats_vec_to_net(np.asarray(svec).sum(axis=0)),
-                violation=scan_to_violation(scan_np))
+                violation=scan_to_violation(scan_np),
+                violations=scan_to_violations(scan_np))
         chunk_idx[0] += 1
 
     should_stop = (lambda: tripped[0]) if fail_fast else None

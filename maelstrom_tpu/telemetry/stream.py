@@ -5,8 +5,9 @@ executor (``tpu/pipeline.py``) made runs *inspectable after the fact*;
 until now a 100k-instance sweep was still a black box between the
 first dispatch and the final fetch. This module is the live tap: the
 chunk drivers hand each chunk's detached device snapshots — the
-``NetStats`` vector, the first-violation scan (``(instance, tick)``
-argmin computed ON DEVICE, see ``pipeline.violation_scan``), and the
+``NetStats`` vector, the first-violation scan (top-K earliest
+``(instance, tick)`` rows computed ON DEVICE, see
+``pipeline.violation_scan``), and the
 compacted-event overflow flag — to a :class:`HeartbeatWriter`, which
 appends one self-contained JSON line per chunk to
 ``store/<test>/<run>/heartbeat.jsonl`` and flushes immediately.
@@ -25,12 +26,14 @@ Record schema (all host-written; one JSON object per line):
   ``opts`` dict ``maelstrom triage`` replays from.
 - ``{"type": "chunk", "chunk": k, "t0": t, "ticks": n, "wall-s": w,
   "device-s": d, "net": {...}, "first-violation": {...}|null,
-  "events-overflowed": bool}`` — one per dispatched chunk, written
-  when the chunk's payload is consumed (i.e. while chunk *k + 1* runs
-  on device). ``net`` is the CUMULATIVE fleet NetStats; the violation
-  block is ``{"instances": n, "tick": t, "instance": i}`` with
-  ``tick == -1`` when the run had no telemetry (violation known,
-  first-trip tick not recorded).
+  "violations": [{...}, ...], "events-overflowed": bool}`` — one per
+  dispatched chunk, written when the chunk's payload is consumed (i.e.
+  while chunk *k + 1* runs on device). ``net`` is the CUMULATIVE fleet
+  NetStats; the ``first-violation`` block is ``{"instances": n,
+  "tick": t, "instance": i}`` with ``tick == -1`` when the run had no
+  telemetry (violation known, first-trip tick not recorded), and
+  ``violations`` lists ALL top-K earliest trippers the device scan
+  named (``--scan-top-k`` rows; present only when something tripped).
 - ``{"type": "run-end", "status": "complete"|"stopped", ...}`` — last
   line on a clean exit; ABSENT on a crash (that absence is what
   ``maelstrom watch`` reports as a dead/partial run).
@@ -53,9 +56,12 @@ HEARTBEAT_SCHEMA = 1
 NET_LANES = ("sent", "delivered", "dropped-partition", "dropped-loss",
              "dropped-overflow")
 
-# violation_scan lanes (tpu/pipeline.py): [n_violating, first_tick,
-# first_instance]; tick/instance are -1 when nothing tripped, tick is
-# -1 (unknown) when telemetry was off.
+# violation_scan row lanes (tpu/pipeline.py): the scan is an int32
+# ``[K, 3]`` block, row i = [n_violating, tick_i, instance_i] for the
+# i-th earliest tripper; every row repeats the fleet-wide count in lane
+# 0, rows past the tripper count pad with instance = -1, and tick is
+# -1 (unknown) when telemetry was off. A flat [3] vector (the pre-top-K
+# wire format) decodes as K=1.
 SCAN_LANES = ("violating", "first-tick", "first-instance")
 
 
@@ -65,36 +71,69 @@ def stats_vec_to_net(vec) -> Dict[str, int]:
     return {name: int(v[i]) for i, name in enumerate(NET_LANES)}
 
 
+def _scan_rows(vec) -> np.ndarray:
+    """Normalize a violation scan ([3] legacy or [K, 3]) to [K, 3]."""
+    return np.asarray(vec).reshape(-1, 3)
+
+
 def scan_to_violation(vec) -> Optional[Dict[str, int]]:
-    """Decode a violation-scan vector; None when nothing tripped."""
-    v = np.asarray(vec).reshape(-1)
+    """Decode a violation scan's FIRST row (the earliest tripper — the
+    PR-4 argmin); None when nothing tripped. Accepts [3] or [K, 3]."""
+    v = _scan_rows(vec)[0]
     if int(v[0]) <= 0:
         return None
     return {"instances": int(v[0]), "tick": int(v[1]),
             "instance": int(v[2])}
 
 
-def combine_shard_scans(scans, n_instances_per_shard: int) -> np.ndarray:
-    """Host-side merge of per-shard violation scans ([n_shards, 3]) into
-    one fleet scan [3]. Local instance indices become global merged ids
+def scan_to_violations(vec) -> List[Dict[str, int]]:
+    """Decode ALL valid rows of a top-K violation scan into
+    ``[{"instance": i, "tick": t}, ...]`` (earliest first; empty when
+    nothing tripped). Padding rows (instance == -1) are dropped."""
+    rows = _scan_rows(vec)
+    if int(rows[0, 0]) <= 0:
+        return []
+    return [{"instance": int(inst), "tick": int(tick)}
+            for _, tick, inst in rows if int(inst) >= 0]
+
+
+def combine_shard_scans(scans, n_instances_per_shard: int,
+                        k: Optional[int] = None) -> np.ndarray:
+    """Host-side merge of per-shard top-K violation scans
+    ([n_shards, K, 3]; a legacy [n_shards, 3] input reads as K=1) into
+    one fleet scan [k, 3] (default ``k`` = the per-shard K). Local
+    instance indices become global merged ids
     (``shard * n_instances_per_shard + local`` — the index convention of
-    the merged ``violations`` array the sharded runners return). The
-    reported instance is the one with the earliest first-violation tick
-    (ties and unknown ticks break toward the lowest global id)."""
-    scans = np.asarray(scans).reshape(-1, 3)
-    n = int(scans[:, 0].sum())
+    the merged ``violations`` array the sharded runners return). Rows
+    are ordered by earliest first-violation tick (ties and unknown
+    ticks break toward the lowest global id); lane 0 of every row is
+    the fleet-wide violating count summed over shards."""
+    scans = np.asarray(scans)
+    if scans.ndim == 2:
+        scans = scans[:, None, :]
+    n_shards, K, _ = scans.shape
+    k_out = max(1, int(k) if k else K)
+    n = int(scans[:, 0, 0].sum())
+    out = np.full((k_out, 3), -1, np.int32)
+    out[:, 0] = n
     if n <= 0:
-        return np.array([0, -1, -1], np.int32)
-    best = None   # (tick-key, global-instance, tick)
-    for shard, (cnt, tick, inst) in enumerate(scans):
-        if int(cnt) <= 0:
+        return out
+    big = np.iinfo(np.int32).max
+    rows = []   # (tick-key, global-instance, tick)
+    for shard in range(n_shards):
+        if int(scans[shard, 0, 0]) <= 0:
             continue
-        gid = shard * n_instances_per_shard + int(inst)
-        key = (int(tick) if int(tick) >= 0 else np.iinfo(np.int32).max,
-               gid)
-        if best is None or key < best[:2]:
-            best = key + (int(tick),)
-    return np.array([n, best[2], best[1]], np.int32)
+        for _, tick, inst in scans[shard]:
+            if int(inst) < 0:
+                continue
+            gid = shard * n_instances_per_shard + int(inst)
+            rows.append((int(tick) if int(tick) >= 0 else big, gid,
+                         int(tick)))
+    rows.sort()
+    for j, (_, gid, tick) in enumerate(rows[:k_out]):
+        out[j, 1] = tick
+        out[j, 2] = gid
+    return out
 
 
 class HeartbeatWriter:
@@ -128,6 +167,7 @@ class HeartbeatWriter:
     def record_chunk(self, *, chunk: int, t0: int, ticks: int,
                      net: Optional[Dict[str, int]] = None,
                      violation: Optional[Dict[str, int]] = None,
+                     violations: Optional[List[Dict[str, int]]] = None,
                      overflowed: bool = False,
                      device_s: Optional[float] = None,
                      extra: Optional[Dict[str, Any]] = None) -> None:
@@ -141,6 +181,9 @@ class HeartbeatWriter:
         if net is not None:
             rec["net"] = net
         rec["first-violation"] = violation
+        if violation is not None and violations:
+            # the top-K lanes; row 0 repeats first-violation
+            rec["violations"] = violations
         rec["events-overflowed"] = bool(overflowed)
         if extra:
             rec.update(extra)
@@ -232,15 +275,21 @@ def first_violation_of(hb: Dict[str, Any]) -> Optional[Dict[str, int]]:
 
 def flagged_instances(hb: Dict[str, Any]) -> List[int]:
     """Distinct violating instance ids the heartbeat named, in
-    first-seen order. The per-chunk scan reports only the argmin
-    instance, so on a partial run this is a (correct but possibly
-    incomplete) lower bound — results.json, when present, has the full
-    list."""
+    first-seen order — ALL top-K lanes of each chunk's scan (falling
+    back to the lone ``first-violation`` row on pre-top-K heartbeats).
+    The scan names at most K instances per chunk, so on a partial run
+    this is a (correct but possibly incomplete) lower bound —
+    results.json, when present, has the full list."""
     seen: List[int] = []
     for rec in hb.get("chunks", ()):
-        v = rec.get("first-violation")
-        if v and v.get("instance", -1) >= 0 and v["instance"] not in seen:
-            seen.append(v["instance"])
+        lanes = rec.get("violations")
+        if not lanes:
+            v = rec.get("first-violation")
+            lanes = [v] if v else []
+        for v in lanes:
+            if v and v.get("instance", -1) >= 0 \
+                    and v["instance"] not in seen:
+                seen.append(v["instance"])
     return seen
 
 
@@ -256,8 +305,11 @@ def render_chunk_line(rec: Dict[str, Any]) -> str:
         parts.append(f"sent {net.get('sent', 0)} "
                      f"delivered {net.get('delivered', 0)}")
     parts.append("OVERFLOW" if rec.get("events-overflowed") else "")
+    n_lanes = len(rec.get("violations") or ())
+    more = f", +{n_lanes - 1} more named" if v and n_lanes > 1 else ""
     parts.append(f"viol {v['instances']} (first: instance "
-                 f"{v['instance']} @ tick {v['tick']})" if v else "viol 0")
+                 f"{v['instance']} @ tick {v['tick']}{more})"
+                 if v else "viol 0")
     if isinstance(rec.get("wall-s"), (int, float)):
         parts.append(f"{rec['wall-s']:.2f}s")
     return "  ".join(p for p in parts if p)

@@ -81,6 +81,10 @@ TPU_DEFAULTS = dict(
                               # device-side violation scan trips (at
                               # most one in-flight chunk runs past the
                               # detection; results gain "fail-fast")
+    scan_top_k=8,             # violation-scan lanes per chunk: the
+                              # heartbeat names the top-K earliest
+                              # tripping instances, not just the argmin
+                              # (tpu/pipeline.violation_scan)
     seed=0,
 )
 
@@ -298,7 +302,8 @@ def _pipelined_phase_run(model: Model, sim: SimConfig, seed: int, params,
             chunk=int(opts.get("chunk_ticks") or 100),
             event_cap=int(opts.get("event_capacity") or 0) or None,
             heartbeat=heartbeat,
-            fail_fast=bool(opts.get("fail_fast")))
+            fail_fast=bool(opts.get("fail_fast")),
+            scan_k=int(opts.get("scan_top_k") or 1))
     finally:
         if profiling:
             try:
@@ -505,15 +510,18 @@ def run_tpu_test(model: Model, opts: Optional[Dict[str, Any]] = None,
     if pipe_stats and pipe_stats.get("stopped-early"):
         # --fail-fast tripped: the run covers only the dispatched
         # prefix; the device-side scan says where it went wrong
-        from ..telemetry.stream import scan_to_violation
+        from ..telemetry.stream import (scan_to_violation,
+                                        scan_to_violations)
+        have_scan = pipe_res is not None and pipe_res.scan is not None
         results["fail-fast"] = {
             "stopped": True,
             "ticks-dispatched": pipe_stats["ticks-dispatched"],
             "ticks-planned": sim.n_ticks,
             "first-violation": (scan_to_violation(pipe_res.scan)
-                                if pipe_res is not None
-                                and pipe_res.scan is not None
-                                else None),
+                                if have_scan else None),
+            # all top-K lanes the device scan named (--scan-top-k)
+            "violations": (scan_to_violations(pipe_res.scan)
+                           if have_scan else []),
         }
     if fleet is not None:
         # the condensed fleet view rides in results.json; the full dict

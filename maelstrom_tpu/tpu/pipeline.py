@@ -134,36 +134,50 @@ def run_chunked(state0: Any, plans: List[Tuple[int, int]],
 
 # --- device-side first-violation scan -------------------------------------
 
+# per-chunk top-K violation lanes reported by the heartbeat scan; the
+# CLI's --scan-top-k overrides it (K=1 degenerates to the PR-4 argmin)
+DEFAULT_SCAN_TOP_K = 8
 
-def violation_scan(violations, telemetry, instance_ids) -> jnp.ndarray:
-    """Reduce the fleet's invariant state to a [3] int32 vector —
-    ``[n_violating, first_tick, first_instance]`` — entirely on device,
-    so the per-chunk heartbeat learns *where* a 100k-instance sweep went
-    wrong without fetching any per-instance buffer.
+
+def violation_scan(violations, telemetry, instance_ids,
+                   k: int = 1) -> jnp.ndarray:
+    """Reduce the fleet's invariant state to a ``[k, 3]`` int32 block —
+    row *i* = ``[n_violating, tick_i, instance_i]`` for the *i*-th
+    earliest violating instance — entirely on device, so the per-chunk
+    heartbeat learns *where* a 100k-instance sweep went wrong without
+    fetching any per-instance buffer.
 
     The cheap per-workload invariant lanes (``Model.invariants``: echo
     has none, g-set/raft carry lost-add / stale-read / commit-agreement
     witnesses) already accumulate into ``carry.violations`` every tick;
     with the flight recorder on, ``telemetry.first_violation`` holds
-    each instance's first-trip tick and the scan argmins over it —
-    the reported instance is the EARLIEST tripper. Without telemetry the
-    tick lane is -1 (violation known, tick unknown) and the instance is
-    the lowest-id tripper. Traced; the result is a fresh (detached)
-    array, safe to fetch after the carry is donated away."""
+    each instance's first-trip tick and the scan sorts on it — row 0 is
+    the EARLIEST tripper (ties break toward the lowest instance id, the
+    stable-sort order), exactly the PR-4 argmin. Without telemetry the
+    tick lane is -1 (violation known, tick unknown) and rows are the
+    lowest-id trippers. Every row carries the fleet-wide count in lane
+    0; rows past the number of trippers pad with ``instance = -1``.
+    Traced; the result is a fresh (detached) array, safe to fetch after
+    the carry is donated away."""
     tripped = violations > 0
     n = jnp.sum(tripped).astype(jnp.int32)
     ids = jnp.asarray(instance_ids, jnp.int32)
     big = jnp.int32(np.iinfo(np.int32).max)
+    k = max(1, min(int(k), int(ids.shape[0])))
     if telemetry is not None:
         ft = telemetry.first_violation
         key = jnp.where(ft >= 0, ft, big)
-        i = jnp.argmin(key)
-        tick = jnp.where(n > 0, ft[i], -1)
     else:
-        i = jnp.argmin(jnp.where(tripped, ids, big))
-        tick = jnp.full((), -1, jnp.int32)
-    inst = jnp.where(n > 0, ids[i], -1)
-    return jnp.stack([n, tick.astype(jnp.int32), inst.astype(jnp.int32)])
+        ft = None
+        key = jnp.where(tripped, ids, big)
+    order = jnp.argsort(key, stable=True)[:k]
+    valid = jnp.arange(k, dtype=jnp.int32) < n
+    ticks = (jnp.where(valid, ft[order], -1) if ft is not None
+             else jnp.full((k,), -1, jnp.int32))
+    insts = jnp.where(valid, ids[order], -1)
+    return jnp.stack([jnp.full((k,), n, jnp.int32),
+                      ticks.astype(jnp.int32),
+                      insts.astype(jnp.int32)], axis=1)
 
 
 # --- device-side event compaction ----------------------------------------
@@ -304,8 +318,8 @@ class PipelineResult(NamedTuple):
     journal_sends: np.ndarray    # [T, J, M, L] (zero-size when J == 0)
     journal_recvs: np.ndarray    # [T, J, NT, K, L]
     perf: Dict[str, Any]         # chunk/overlap/fetch-byte stats
-    scan: Optional[np.ndarray] = None   # final violation scan [3]
-                                        # (stream.SCAN_LANES)
+    scan: Optional[np.ndarray] = None   # final violation scan [K, 3]
+                                        # (stream.SCAN_LANES per row)
     compact: Optional[List[Tuple[np.ndarray, int]]] = None
                                  # per-chunk compacted (rows, count),
                                  # kept only with keep_compact=True
@@ -318,15 +332,22 @@ def _init_pipelined(model: Model, sim: SimConfig, seed, params,
     return init_carry(model, sim, seed, params, instance_ids)
 
 
-def _make_chunk_fn(model: Model, sim: SimConfig, params, instance_ids,
-                   cap: Optional[int], unroll: int):
+def make_chunk_fn(model: Model, sim: SimConfig, params, instance_ids,
+                  cap: Optional[int], unroll: int,
+                  scan_k: int = DEFAULT_SCAN_TOP_K):
     """Build the jitted, carry-donating chunk dispatch. The traced body
     wraps the runtime tick function: per tick the dense event block is
     folded into the compacted buffer instead of being stacked into the
     scan ys (events ys are skipped entirely when nothing is recorded).
     ``cap=None`` sizes the buffer per (static) chunk length via
     :func:`event_capacity` — right for callers whose dispatch length
-    adapts at run time (bench.py).
+    adapts at run time (bench.py). ``scan_k`` is the violation scan's
+    top-K width.
+
+    Public because it IS the production dispatch step: the IR/cost
+    analyzer (``analysis/ir_lint.py``) lowers and compiles this exact
+    callable to verify donation aliasing (JXP403) on the executable the
+    fleet actually runs — not a re-lowered copy.
     """
     V = model.ev_vals
     R = sim.record_instances
@@ -357,16 +378,22 @@ def _make_chunk_fn(model: Model, sim: SimConfig, params, instance_ids,
             t0 + jnp.arange(length, dtype=jnp.int32), unroll=unroll)
         journal = (ys.journal_sends, ys.journal_recvs) if J > 0 else None
         # detached NetStats snapshot ([5] int32, NetStats field order)
-        # and first-violation scan ([3] int32, stream.SCAN_LANES):
-        # progress reporting / the run heartbeat can read them without
-        # touching the carry the NEXT dispatch donates away (bench.py's
-        # overlapped metric loop, telemetry/stream.py)
+        # and top-K violation scan ([scan_k, 3] int32, stream.SCAN_LANES
+        # per row): progress reporting / the run heartbeat can read them
+        # without touching the carry the NEXT dispatch donates away
+        # (bench.py's overlapped metric loop, telemetry/stream.py)
         stats_vec = jnp.stack(list(carry.stats))
         scan_vec = violation_scan(carry.violations, carry.telemetry,
-                                  jnp.asarray(instance_ids, jnp.int32))
+                                  jnp.asarray(instance_ids, jnp.int32),
+                                  k=scan_k)
         return carry, stats_vec, scan_vec, buf, journal
 
     return chunk_fn
+
+
+# pre-rename alias (bench.py and older callers imported the underscore
+# name before the IR analyzer made the builder part of the public API)
+_make_chunk_fn = make_chunk_fn
 
 
 def run_sim_pipelined(model: Model, sim: SimConfig, seed: int,
@@ -374,7 +401,8 @@ def run_sim_pipelined(model: Model, sim: SimConfig, seed: int,
                       chunk: int = 100, event_cap: Optional[int] = None,
                       unroll: int = 1, heartbeat=None,
                       fail_fast: bool = False,
-                      keep_compact: bool = False) -> PipelineResult:
+                      keep_compact: bool = False,
+                      scan_k: int = DEFAULT_SCAN_TOP_K) -> PipelineResult:
     """Chunked, donated, double-buffered replacement for
     :func:`..tpu.runtime.run_sim` + the dense event fetch.
 
@@ -395,6 +423,9 @@ def run_sim_pipelined(model: Model, sim: SimConfig, seed: int,
     ``perf["ticks-dispatched"]`` ticks and ``perf["stopped-early"]`` is
     set. ``keep_compact`` retains the per-chunk compacted rows on the
     result for instance-subset re-expansion (``maelstrom triage``).
+    ``scan_k`` widens the per-chunk violation scan to the top-K earliest
+    trippers (heartbeat ``violations`` lanes; K=1 is the argmin-only
+    scan).
     """
     if params is None:
         params = model.make_params(sim.net.n_nodes)
@@ -404,8 +435,8 @@ def run_sim_pipelined(model: Model, sim: SimConfig, seed: int,
     plans = plan_chunks(sim.n_ticks, chunk)
     cap = (event_capacity(sim, model, plans[0][1])
            if not event_cap else int(event_cap))
-    chunk_fn = _make_chunk_fn(model, sim, params, instance_ids, cap,
-                              unroll)
+    chunk_fn = make_chunk_fn(model, sim, params, instance_ids, cap,
+                             unroll, scan_k=scan_k)
 
     t_init = time.monotonic()
     # donation needs each leaf to own its buffer; init_carry broadcasts
@@ -442,17 +473,19 @@ def run_sim_pipelined(model: Model, sim: SimConfig, seed: int,
         if journal is not None:
             journal_chunks.append((np.asarray(journal[0]),
                                    np.asarray(journal[1])))
-        scan_np = np.asarray(scan)
+        scan_np = np.asarray(scan).reshape(-1, 3)
         last_scan[0] = scan_np
-        if int(scan_np[0]) > 0:
+        if int(scan_np[0, 0]) > 0:
             tripped[0] = True
         if heartbeat is not None:
             from ..telemetry.stream import (scan_to_violation,
+                                            scan_to_violations,
                                             stats_vec_to_net)
             heartbeat.record_chunk(
                 chunk=chunk_idx[0], t0=t0, ticks=length,
                 net=stats_vec_to_net(svec),
                 violation=scan_to_violation(scan_np),
+                violations=scan_to_violations(scan_np),
                 overflowed=bool(ovf))
         chunk_idx[0] += 1
         fetch_s[0] += time.monotonic() - t_f

@@ -47,6 +47,9 @@ def pytest_configure(config):
         "markers", "triage: streaming heartbeat / watch / triage "
                    "forensics tests (telemetry/stream.py, "
                    "checkers/triage.py)")
+    config.addinivalue_line(
+        "markers", "ir: IR-level lint / cost-model tests "
+                   "(analysis/ir_lint.py, analysis/cost_model.py)")
 
 
 def pytest_collection_modifyitems(config, items):

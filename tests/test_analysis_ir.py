@@ -1,0 +1,317 @@
+"""IR-level lint + cost-model tests (analysis/ir_lint.py, cost_model.py).
+
+Pins the PR's acceptance bars: each planted IR-hazard fixture trips its
+JXP rule, a donate-without-aliasing regression trips JXP403 while the
+REAL compiled pipeline/mesh executors verify clean, cost-baseline
+drift detection fires COST501/502/503 on synthetic drift, the baseline
+covers every registered model x both carry layouts, and the repo-wide
+``maelstrom lint --ir --cost --strict`` gate is green modulo the
+expected-fixture baseline entries.
+"""
+
+import json
+import os
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from maelstrom_tpu.analysis import cost_model, run_lint
+from maelstrom_tpu.analysis.findings import Baseline
+from maelstrom_tpu.analysis.ir_lint import (aliased_params_of,
+                                            audit_donation,
+                                            audit_model_ir,
+                                            audit_pipeline_donation,
+                                            compare_costs, run_ir_lint)
+from maelstrom_tpu.models.ir_hazards import (IR_FIXTURE_MODELS,
+                                             IrBakedConst, IrFloatLeak,
+                                             IrFusionBreaker,
+                                             IrHostCallback)
+
+pytestmark = pytest.mark.ir
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _rules(findings):
+    return {f.rule for f in findings}
+
+
+@pytest.fixture(scope="module")
+def repo_report():
+    """ONE repo-wide ir+cost run (the expensive part: every registered
+    model x both layouts traced, the real executors compiled), shared
+    by every gate-level assertion below."""
+    return run_lint(repo_root=REPO, passes=("ir", "cost"))
+
+
+# --- the planted fixtures trip their rules ---------------------------------
+
+
+class TestFixturesTrip:
+    def test_float_leak_trips_jxp401(self):
+        fs, report = audit_model_ir(IrFloatLeak(), 2, "lead")
+        assert _rules(fs) == {"JXP401"}
+        (f,) = fs
+        assert f.severity == "error"
+        assert "drift" in f.message and "float32" in f.message
+        assert report is not None and report.eqns > 0
+
+    def test_host_callback_trips_jxp402(self):
+        fs, _ = audit_model_ir(IrHostCallback(), 2, "lead")
+        assert _rules(fs) == {"JXP402"}
+        assert "pure_callback" in fs[0].message
+
+    def test_fusion_breaker_trips_jxp404(self):
+        fs, report = audit_model_ir(IrFusionBreaker(), 2, "lead")
+        assert _rules(fs) == {"JXP404"}
+        msgs = " ".join(f.message for f in fs)
+        # both planted breakers: the while_loop AND the oversized
+        # broadcast intermediate
+        assert "while_loop" in msgs and "broadcast_in_dim" in msgs
+        assert all(f.severity == "warning" for f in fs)
+        assert report.max_broadcast_bytes >= 2 << 20
+
+    def test_baked_const_trips_jxp405(self):
+        fs, report = audit_model_ir(IrBakedConst(), 2, "lead")
+        assert _rules(fs) == {"JXP405"}
+        assert report.max_const_bytes >= 128 << 10
+
+    def test_registered_models_do_not_trip(self):
+        """The fixtures' rules must not fire on the honest models —
+        the audit's false-positive guard (echo + the raft flagship)."""
+        from maelstrom_tpu.models import get_model
+        for wl, n in (("echo", 2), ("lin-kv", 5)):
+            for layout in ("lead", "minor"):
+                fs, report = audit_model_ir(get_model(wl, n), n, layout)
+                assert fs == [], [f.to_dict() for f in fs]
+                assert report.eqns > 0
+                # phase decomposition covers the named scopes
+                assert set(cost_model.PHASES) <= set(report.phases)
+
+    def test_fixtures_never_registered(self):
+        from maelstrom_tpu.models import get_model
+        for kind in IR_FIXTURE_MODELS:
+            with pytest.raises(ValueError):
+                get_model(f"echo-ir-{kind}", 2)
+
+    def test_fixture_findings_are_expected_not_silent(self):
+        """Every fixture finding is baselined as status='expected' — a
+        visible, test-asserted exception, not silent acceptance."""
+        bl = Baseline.load()
+        for kind, cls in IR_FIXTURE_MODELS.items():
+            fs, _ = audit_model_ir(cls(), 2, "lead",
+                                   label=f"fixture-{kind}")
+            assert fs, kind
+            for f in fs:
+                entry = bl.match(f)
+                assert entry is not None, f.fingerprint
+                assert entry.status == "expected", f.fingerprint
+
+
+# --- JXP403: donation aliasing ---------------------------------------------
+
+
+class TestDonationAliasing:
+    def test_planted_regression_trips_jxp403(self):
+        """A donate_argnums function whose donated input cannot alias
+        (shape/dtype drift between input and outputs) must be flagged —
+        XLA drops the donation silently, the audit must not."""
+        @partial(jax.jit, donate_argnums=(0,))
+        def broken(carry, t):
+            a, b = carry
+            # neither output matches a donated input buffer
+            return (a.astype(jnp.float32).astype(jnp.int32).reshape(2, 8),
+                    b[:4]), jnp.sum(a) + t
+
+        args = ((jax.ShapeDtypeStruct((4, 4), jnp.int32),
+                 jax.ShapeDtypeStruct((8,), jnp.int32)),
+                jax.ShapeDtypeStruct((), jnp.int32))
+        fs = audit_donation(broken, args, 2, path="tests/planted.py",
+                            symbol="broken", label="planted")
+        assert _rules(fs) == {"JXP403"}
+        assert any("NOT aliased" in f.message for f in fs)
+
+    def test_clean_donation_passes(self):
+        @partial(jax.jit, donate_argnums=(0,))
+        def clean(carry, t):
+            a, b = carry
+            return (a + t, b * 2), jnp.sum(a)
+
+        args = ((jax.ShapeDtypeStruct((4, 4), jnp.int32),
+                 jax.ShapeDtypeStruct((8,), jnp.int32)),
+                jax.ShapeDtypeStruct((), jnp.int32))
+        assert audit_donation(clean, args, 2, path="t.py", symbol="c",
+                              label="clean") == []
+
+    def test_alias_parser_handles_nested_braces(self):
+        txt = ("HloModule jit_f, is_scheduled=true, input_output_alias="
+               "{ {0}: (0, {}, may-alias), {1}: (3, {}, may-alias) }, "
+               "entry_computation_layout={(s32[4]{0})->s32[4]{0}}")
+        assert aliased_params_of(txt) == {0, 3}
+        assert aliased_params_of("HloModule jit_g") == set()
+
+    def test_real_pipeline_executable_aliases_every_carry_leaf(self):
+        """JXP403 on the ACTUAL make_chunk_fn product: the executable
+        run_sim_pipelined dispatches must alias the full donated
+        carry. (The repo-wide fixture covers both layouts + the mesh
+        executor; this pins the single-device path directly.)"""
+        assert audit_pipeline_donation(layouts=("lead",)) == []
+
+
+# --- the cost gate ---------------------------------------------------------
+
+
+def _fake_report(eqns=1000, hbm=500000):
+    return cost_model.CostReport(eqns=eqns, hbm_bytes=hbm,
+                                 phases={"node_phase": eqns // 2})
+
+
+class TestCostGate:
+    PATHS = {"echo/n=2/lead": ("maelstrom_tpu/models/echo.py",
+                               "EchoModel")}
+
+    def test_regression_trips_cost501(self):
+        live = {"echo/n=2/lead": _fake_report(eqns=1200)}
+        base = {"tolerance": 0.10,
+                "entries": {"echo/n=2/lead": {"eqns": 1000,
+                                              "hbm-bytes-per-tick":
+                                                  500000}}}
+        fs = compare_costs(live, base, self.PATHS)
+        assert _rules(fs) == {"COST501"}
+        assert fs[0].severity == "error"
+        assert "+20%" in fs[0].message
+
+    def test_within_tolerance_is_clean(self):
+        live = {"echo/n=2/lead": _fake_report(eqns=1050)}
+        base = {"tolerance": 0.10,
+                "entries": {"echo/n=2/lead": {"eqns": 1000,
+                                              "hbm-bytes-per-tick":
+                                                  500000}}}
+        assert compare_costs(live, base, self.PATHS) == []
+
+    def test_missing_entry_trips_cost502(self):
+        fs = compare_costs({"echo/n=2/lead": _fake_report()},
+                           {"tolerance": 0.10, "entries": {}},
+                           self.PATHS)
+        assert _rules(fs) == {"COST502"}
+
+    def test_stale_entry_trips_cost503_only_on_full_universe(self):
+        base = {"tolerance": 0.10,
+                "entries": {"gone/n=9/lead": {"eqns": 5,
+                                              "hbm-bytes-per-tick": 5}}}
+        fs = compare_costs({}, base, {}, full_universe=True)
+        assert _rules(fs) == {"COST503"}
+        assert fs[0].severity == "warning"
+        assert compare_costs({}, base, {}, full_universe=False) == []
+
+    def test_improvement_trips_cost504_info(self):
+        live = {"echo/n=2/lead": _fake_report(eqns=700, hbm=400000)}
+        base = {"tolerance": 0.10,
+                "entries": {"echo/n=2/lead": {"eqns": 1000,
+                                              "hbm-bytes-per-tick":
+                                                  500000}}}
+        fs = compare_costs(live, base, self.PATHS)
+        assert _rules(fs) == {"COST504"}
+        assert fs[0].severity == "info"
+
+    def test_checked_in_baseline_covers_every_model_both_layouts(self):
+        data = json.load(open(cost_model.DEFAULT_COST_BASELINE))
+        want = {cost_model.entry_key(wl, n, layout)
+                for wl, n in cost_model.cost_specs()
+                for layout in cost_model.AUDIT_LAYOUTS}
+        assert set(data["entries"]) == want
+        for key, e in data["entries"].items():
+            assert e["eqns"] > 0 and e["hbm-bytes-per-tick"] > 0, key
+            assert e["phases"], key
+
+    def test_update_baseline_roundtrip(self, tmp_path):
+        """--update-baseline writes a baseline the very next cost run
+        is clean against (drift detection pinned end-to-end on a real
+        trace)."""
+        path = str(tmp_path / "cost_baseline.json")
+        fs = run_ir_lint(hazards=False, cost=True,
+                         workloads=[("echo", 2)], layouts=("lead",),
+                         cost_baseline_path=path, update_baseline=True)
+        assert _rules(fs) == {"COST500"}
+        assert os.path.exists(path)
+        fs = run_ir_lint(hazards=False, cost=True,
+                         workloads=[("echo", 2)], layouts=("lead",),
+                         cost_baseline_path=path)
+        assert fs == [], [f.to_dict() for f in fs]
+        # ...and a synthetic 2x bloat against that same baseline fails
+        data = json.load(open(path))
+        key = "echo/n=2/lead"
+        data["entries"][key]["eqns"] //= 2
+        json.dump(data, open(path, "w"))
+        fs = run_ir_lint(hazards=False, cost=True,
+                         workloads=[("echo", 2)], layouts=("lead",),
+                         cost_baseline_path=path)
+        assert _rules(fs) == {"COST501"}
+
+
+# --- the cost model itself -------------------------------------------------
+
+
+class TestCostModel:
+    def test_tick_cost_is_deterministic_and_layout_aware(self):
+        from maelstrom_tpu.models import get_model
+        model = get_model("echo", 2)
+        sim_l = cost_model.audit_sim(model, 2, "lead")
+        sim_m = cost_model.audit_sim(model, 2, "minor")
+        a = cost_model.tick_cost(model, sim_l)
+        b = cost_model.tick_cost(model, sim_l)
+        assert (a.eqns, a.hbm_bytes, a.phases) == \
+            (b.eqns, b.hbm_bytes, b.phases)
+        c = cost_model.tick_cost(model, sim_m)
+        # the two layouts lower to (slightly) different graphs — both
+        # are budgeted separately
+        assert c.eqns != a.eqns
+        assert sum(a.phases.values()) == a.eqns
+
+    def test_scan_body_bytes_are_trip_weighted(self):
+        """A scan body's intermediates are charged per trip — the HBM
+        estimate must scale with the trip count."""
+        def f(x):
+            return jax.lax.scan(lambda c, _: (c * 2 + 1, None), x,
+                                None, length=10)[0]
+
+        def g(x):
+            return jax.lax.scan(lambda c, _: (c * 2 + 1, None), x,
+                                None, length=100)[0]
+
+        x = jax.ShapeDtypeStruct((128,), jnp.int32)
+        cf = cost_model.cost_of_jaxpr(jax.make_jaxpr(f)(x))
+        cg = cost_model.cost_of_jaxpr(jax.make_jaxpr(g)(x))
+        assert cf.eqns == cg.eqns          # static graph size is equal
+        assert cg.hbm_bytes > cf.hbm_bytes * 5
+
+
+# --- repo-wide gate --------------------------------------------------------
+
+
+class TestRepoWideGate:
+    def test_ir_cost_gate_green_modulo_expected_fixtures(self,
+                                                         repo_report):
+        """The acceptance bar: `maelstrom lint --ir --cost --strict`
+        repo-wide finds no unsuppressed errors, every fixture finding
+        is suppressed as expected, and no stale entries surface."""
+        assert repo_report.errors() == [], [
+            f.to_dict() for f in repo_report.errors()]
+        suppressed_rules = {f.rule for f, _ in repo_report.suppressed}
+        assert {"JXP401", "JXP402", "JXP404",
+                "JXP405"} <= suppressed_rules
+        assert all(e.status == "expected"
+                   for f, e in repo_report.suppressed
+                   if f.rule.startswith("JXP"))
+        assert repo_report.passes_run == ("ir", "cost")
+
+    def test_gate_saw_the_compiled_executors(self, repo_report):
+        """JXP403 verdicts come from the compiled pipeline/mesh
+        executables; a clean gate means the audit ran and aliased —
+        the rule must not appear as a finding OR a suppression."""
+        all_rules = (_rules(repo_report.findings)
+                     | {f.rule for f, _ in repo_report.suppressed})
+        assert "JXP403" not in all_rules
+        assert "JXP400" not in all_rules      # every model lowered

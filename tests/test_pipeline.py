@@ -143,8 +143,10 @@ def test_use_after_donate_regression():
     # the NEXT chunk donates carry1 away (the overlapped bench loop and
     # the run heartbeat both depend on this)
     carry2, svec2, scan2, _, _ = chunk_fn(carry1, jnp.int32(40), 40)
-    assert np.asarray(scan).shape == (3,)
-    assert np.asarray(scan2).shape == (3,)
+    # top-K violation lanes ([K, 3], default K=8; row 0 = the argmin)
+    from maelstrom_tpu.tpu.pipeline import DEFAULT_SCAN_TOP_K
+    assert np.asarray(scan).shape == (DEFAULT_SCAN_TOP_K, 3)
+    assert np.asarray(scan2).shape == (DEFAULT_SCAN_TOP_K, 3)
     assert carry1.pool.is_deleted()
     d1 = int(np.asarray(svec)[1])
     d2 = int(np.asarray(svec2)[1])

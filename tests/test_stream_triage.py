@@ -206,18 +206,73 @@ def test_fail_fast_sharded(tmp_path):
 
 def test_combine_shard_scans_globalizes_instances():
     I = 8   # instances per shard
+    # legacy [n_shards, 3] single-lane wire format reads as K=1
     scans = np.array([[0, -1, -1],       # clean shard
                       [2, 90, 3],        # shard 1: first trip t=90 @ 3
                       [1, 82, 5],        # shard 2: earliest, local 5
                       [0, -1, -1]], np.int32)
     out = combine_shard_scans(scans, I)
-    assert out.tolist() == [3, 82, 2 * I + 5]
+    assert out.shape == (1, 3)
+    assert out[0].tolist() == [3, 82, 2 * I + 5]
     # telemetry-off runs report tick -1: lowest global id wins
     out = combine_shard_scans(np.array([[0, -1, -1], [1, -1, 6],
                                         [2, -1, 1]], np.int32), I)
-    assert out.tolist() == [3, -1, 1 * I + 6]
+    assert out[0].tolist() == [3, -1, 1 * I + 6]
     out = combine_shard_scans(np.zeros((3, 3), np.int32), I)
-    assert out.tolist() == [0, -1, -1]
+    assert out[0].tolist() == [0, -1, -1]
+
+
+def test_combine_shard_scans_top_k_merge():
+    """[n_shards, K, 3] scans merge into one globally-ranked top-K
+    block: rows ordered by earliest tick across shards, padding rows
+    dropped, count lane = fleet-wide sum."""
+    I = 8
+    pad = [2, -1, -1]
+    scans = np.array([
+        [[0, -1, -1], [0, -1, -1]],          # clean shard
+        [[2, 90, 3], [2, 95, 0]],            # shard 1: two trippers
+        [[1, 82, 5], [1, -1, -1]],           # shard 2: earliest, 1 lane
+    ], np.int32)
+    scans[2, 1] = pad                        # padding row semantics
+    out = combine_shard_scans(scans, I)
+    assert out.shape == (2, 3)
+    assert out[0].tolist() == [3, 82, 2 * I + 5]
+    assert out[1].tolist() == [3, 90, 1 * I + 3]
+    # k widens/narrows the merged block independently of the shard K
+    out4 = combine_shard_scans(scans, I, k=4)
+    assert out4.shape == (4, 3)
+    assert out4[2].tolist() == [3, 95, 1 * I + 0]
+    assert out4[3].tolist() == [3, -1, -1]   # padding past the trippers
+
+
+def test_violation_scan_top_k_device():
+    """violation_scan(k) names the K earliest trippers in tick order
+    (row 0 == the PR-4 argmin), padding unused rows with instance -1."""
+    import jax.numpy as jnp
+    from maelstrom_tpu.telemetry.recorder import (TelemetryConfig,
+                                                  init_telemetry)
+    from maelstrom_tpu.tpu.pipeline import violation_scan
+    I = 6
+    violations = jnp.asarray([0, 2, 1, 0, 3, 1], jnp.int32)
+    tel = init_telemetry(I, TelemetryConfig(enabled=True, n_windows=1))
+    tel = tel._replace(first_violation=jnp.asarray(
+        [-1, 40, 95, -1, 12, 95], jnp.int32))
+    ids = jnp.arange(I, dtype=jnp.int32)
+    out = np.asarray(violation_scan(violations, tel, ids, k=3))
+    assert out.shape == (3, 3)
+    assert out[0].tolist() == [4, 12, 4]
+    assert out[1].tolist() == [4, 40, 1]
+    assert out[2].tolist() == [4, 95, 2]    # tick tie -> lowest id
+    # k past the tripper count pads with instance -1
+    out = np.asarray(violation_scan(violations, tel, ids, k=6))
+    assert out[4].tolist() == [4, -1, -1]
+    # telemetry-off: lowest-id trippers, tick unknown
+    out = np.asarray(violation_scan(violations, None, ids, k=2))
+    assert out[0].tolist() == [4, -1, 1]
+    assert out[1].tolist() == [4, -1, 2]
+    # k=1 degenerates to the original argmin vector (as a [1, 3] block)
+    out = np.asarray(violation_scan(violations, tel, ids))
+    assert out.tolist() == [[4, 12, 4]]
 
 
 # --- fail-fast -------------------------------------------------------------
@@ -318,11 +373,14 @@ def test_triage_partial_run_without_results(failfast_run, tmp_path):
     report = render_watch_report(hb, path=partial)
     assert "no run-end record" in report
     assert "instance 13" in report
-    # triage falls back to the heartbeat's scan-named instances
-    assert flagged_instances(hb) == [13]
+    # triage falls back to the heartbeat's scan-named instances — the
+    # top-K lanes name BOTH trippers of this run (13 first: the
+    # earliest-tick row leads each chunk's scan), where the PR-4
+    # argmin-only scan saw just 13
+    assert flagged_instances(hb) == [13, 6]
     summary = triage_run(partial)
-    assert [e["instance"] for e in summary["triaged"]] == [13]
-    assert summary["replayed-violating"] == 1
+    assert [e["instance"] for e in summary["triaged"]] == [13, 6]
+    assert summary["replayed-violating"] == 2
     d = summary["triaged"][0]["dir"]
     for name in ("messages.svg", "journal.edn", "repro.json",
                  "history.jsonl"):

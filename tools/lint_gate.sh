@@ -16,14 +16,45 @@ export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 echo "== maelstrom lint --strict"
 python -m maelstrom_tpu lint --strict
 
+SMOKE_STORE="$(mktemp -d)"
+trap 'rm -rf "$SMOKE_STORE"' EXIT
+
+echo
+echo "== maelstrom lint --ir --cost --strict (IR hazards + cost budget)"
+python -m maelstrom_tpu lint --ir --cost --strict
+
+echo
+echo "== cost-regression canary (tampered baseline must fail the gate)"
+# Simulate a PR that bloats a model's tick: shrink one checked-in
+# baseline entry by 50% (equivalent to the live cost growing 2x) and
+# require the cost gate to exit 1 with COST501. This exercises the
+# detection path end-to-end without editing source.
+python - "$SMOKE_STORE/cost_tampered.json" <<'PY'
+import json, sys
+base = json.load(open("maelstrom_tpu/analysis/cost_baseline.json"))
+key = sorted(base["entries"])[0]
+e = base["entries"][key]
+e["eqns"] = max(1, e["eqns"] // 2)
+e["hbm-bytes-per-tick"] = max(1, e["hbm-bytes-per-tick"] // 2)
+json.dump(base, open(sys.argv[1], "w"))
+print(f"tampered entry: {key}")
+PY
+rc=0
+python -m maelstrom_tpu lint --cost --strict \
+    --cost-baseline "$SMOKE_STORE/cost_tampered.json" \
+    > "$SMOKE_STORE/cost-canary.out" || rc=$?
+[[ "$rc" == "1" ]] || { echo "expected exit 1 (cost regression caught), got $rc"; exit 1; }
+grep -q 'COST501' "$SMOKE_STORE/cost-canary.out"
+echo "canary caught: $(grep -c COST501 "$SMOKE_STORE/cost-canary.out") COST501 finding(s)"
+
 if [[ "${1:-}" == "--lint-only" ]]; then
+    rm -rf "$SMOKE_STORE"
+    trap - EXIT
     exit 0
 fi
 
 echo
 echo "== chunked pipeline smoke (donated executor, compacted events)"
-SMOKE_STORE="$(mktemp -d)"
-trap 'rm -rf "$SMOKE_STORE"' EXIT
 # write-then-grep (not a pipe): grep -q exiting early would EPIPE the
 # still-printing CLI and fail the gate under pipefail
 python -m maelstrom_tpu test --runtime tpu -w echo --node-count 2 \
