@@ -229,6 +229,28 @@ def child_main(canary: bool = False) -> None:
                      f"intermediates/tick")
         except Exception as e:
             log(TAG, f"phase[{cfg_name}]: tick_cost unavailable: {e!r}")
+
+        # post-compile launch-overhead metric: op count of the OPTIMIZED
+        # single-tick executable (entry + surviving while bodies) — what
+        # the "~1000 XLA thunks/tick" ceiling is stated in. Costs one
+        # extra tick compile, so BENCH_IR_THUNKS=0 skips it; backend-
+        # and XLA-version-volatile, so surfaced but never baselined
+        # (doc/results.md explains reading it next to ir_eqns).
+        ir_thunks = ir_while_loops = None
+        if os.environ.get("BENCH_IR_THUNKS") != "0":
+            try:
+                from maelstrom_tpu.analysis.cost_model import (
+                    compiled_tick_stats)
+                _t0 = time.time()
+                _st = compiled_tick_stats(model, sim, params)
+                ir_thunks = _st["ir_thunks"]
+                ir_while_loops = _st["while_loops"]
+                log(TAG, f"phase[{cfg_name}]: compiled tick — "
+                         f"{ir_thunks} thunks, {ir_while_loops} while "
+                         f"loops ({time.time() - _t0:.1f}s compile)")
+            except Exception as e:
+                log(TAG, f"phase[{cfg_name}]: compiled_tick_stats "
+                         f"unavailable: {e!r}")
         log(TAG, f"phase[{cfg_name}]: sim built — {cfg_n_instances} x "
                  f"{sim.net.n_nodes} nodes, {sim.n_ticks} ticks, "
                  f"{bytes_per_instance} B/instance "
@@ -372,6 +394,9 @@ def child_main(canary: bool = False) -> None:
             if ir_eqns is not None:
                 rec["ir_eqns"] = ir_eqns
                 rec["ir_bytes_est"] = ir_bytes_est
+            if ir_thunks is not None:
+                rec["ir_thunks"] = ir_thunks
+                rec["ir_while_loops"] = ir_while_loops
             if bench_pipeline:
                 rec["pipeline"] = True
                 rec["heartbeat"] = bench_heartbeat

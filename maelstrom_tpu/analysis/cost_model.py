@@ -73,12 +73,22 @@ class CostReport:
     max_const_bytes: int = 0         # largest single baked-in constant
     carry_bytes: int = 0             # carry pytree bytes (audit config)
     max_broadcast_bytes: int = 0     # largest broadcast_in_dim output
+    loops: int = 0                   # fusion-breaking loops in the tick
+                                     # body: while_loops plus scans
+                                     # whose bodies are NOT fully
+                                     # unrolled at lowering (each one
+                                     # survives as an XLA while — the
+                                     # boundary fusion cannot cross)
 
     def to_entry(self) -> Dict[str, Any]:
         """The checked-in baseline representation (stable keys only —
-        the op histogram is too jax-version-volatile to pin)."""
+        the op histogram is too jax-version-volatile to pin).
+        ``fusion-breakers`` doubles as the model's JXP404 loop budget
+        (analysis/ir_lint.py): the refactored raft-family ticks pin 0,
+        legacy-scan models keep their recorded count."""
         return {"eqns": self.eqns,
                 "hbm-bytes-per-tick": self.hbm_bytes,
+                "fusion-breakers": self.loops,
                 "phases": {k: self.phases[k]
                            for k in sorted(self.phases)}}
 
@@ -140,7 +150,7 @@ def cost_of_jaxpr(closed, carry=None) -> CostReport:
 
     phases: Dict[str, int] = {p: 0 for p in PHASES + (OTHER_PHASE,)}
     ops: Dict[str, int] = {}
-    totals = {"eqns": 0, "bytes": 0, "max_bcast": 0}
+    totals = {"eqns": 0, "bytes": 0, "max_bcast": 0, "loops": 0}
 
     def walk(jaxpr, phase: Optional[str], mult: int) -> None:
         for eqn in jaxpr.eqns:
@@ -153,6 +163,17 @@ def cost_of_jaxpr(closed, carry=None) -> CostReport:
             totals["bytes"] += out_bytes * mult
             if name == "broadcast_in_dim":
                 totals["max_bcast"] = max(totals["max_bcast"], out_bytes)
+            if name == "while":
+                totals["loops"] += 1
+            elif name == "scan":
+                # a scan survives lowering as an XLA while UNLESS its
+                # body is fully unrolled (lax.scan(..., unroll=True) /
+                # unroll >= length) — only the loop form breaks fusion
+                length = int(eqn.params.get("length", 0))
+                unroll = eqn.params.get("unroll", 1)
+                unroll = length if unroll is True else int(unroll)
+                if unroll < length:
+                    totals["loops"] += 1
             for sub, sub_mult in _sub_jaxprs(eqn):
                 walk(sub, ph, mult * sub_mult)
 
@@ -178,7 +199,8 @@ def cost_of_jaxpr(closed, carry=None) -> CostReport:
         ops=ops, const_bytes=sum(const_sizes),
         max_const_bytes=max(const_sizes, default=0),
         carry_bytes=carry_bytes,
-        max_broadcast_bytes=totals["max_bcast"])
+        max_broadcast_bytes=totals["max_bcast"],
+        loops=totals["loops"])
 
 
 # --- tracing the tick ------------------------------------------------------
@@ -217,6 +239,86 @@ def tick_cost(model, sim, params=None) -> CostReport:
     the bench.py / tools entry point."""
     closed, carry, _ = trace_tick(model, sim, params)
     return cost_of_jaxpr(closed, carry)
+
+
+# --- post-compile cost: the thunk count -------------------------------------
+#
+# ``eqns`` measures the tick BEFORE XLA fusion — a deterministic,
+# baseline-able regression signal. What the accelerator actually
+# launches is the OPTIMIZED executable: one thunk per instruction in
+# the entry computation (fusions collapse whole eqn neighborhoods into
+# one), re-launched per iteration inside any surviving while loop. The
+# functions below compile the same tick closure and count that —
+# ``ir_thunks`` is the direct launch-overhead metric the ROADMAP's
+# "~1000 XLA thunks/tick" ceiling is stated in. It is XLA-version- and
+# backend-volatile, so it is SURFACED (bench metric lines,
+# tools/tick_profile.py) but never baselined.
+
+
+_HLO_INSTR_RE = re.compile(r"^\s+(?:ROOT\s+)?[%\w][\w.\-]*\s*=\s")
+_HLO_REGION_RE = re.compile(r"(?:body|condition)=%?([\w.\-]+)")
+
+
+def hlo_exec_stats(compiled_text: str) -> Dict[str, int]:
+    """Parse optimized-HLO text into the launch-overhead stats:
+
+    - ``ir_thunks``: instructions in the ENTRY computation plus the
+      while body/condition computations — the ops the runtime actually
+      launches (fusion-internal instructions execute inside their
+      fusion's single thunk and are excluded). While bodies are found
+      by resolving each while op's ``body=``/``condition=`` attributes
+      (their computation NAMES are XLA-version noise — ``region_NN``
+      here, ``while_body`` elsewhere). While-resident instructions
+      RE-launch every trip, so at equal counts a while-free executable
+      is strictly cheaper — read ``ir_thunks`` next to ``while_loops``.
+    - ``hlo_instructions``: whole-module instruction count.
+    - ``while_loops``: surviving while ops (each is a fusion boundary
+      and a per-trip relaunch of its body).
+    """
+    # pass 1: instruction count per computation + the loop computations
+    counts: Dict[str, int] = {}
+    entry_name = ""
+    loop_regions: set = set()
+    whiles = 0
+    section = ""
+    for line in compiled_text.splitlines():
+        if line.endswith("{") and not line.startswith("  "):
+            toks = line.split()
+            name_tok = (toks[1] if toks and toks[0] == "ENTRY"
+                        else toks[0] if toks else "")
+            section = name_tok.lstrip("%").split("(")[0]
+            if line.startswith("ENTRY "):
+                entry_name = section
+            counts.setdefault(section, 0)
+            continue
+        if _HLO_INSTR_RE.match(line):
+            counts[section] = counts.get(section, 0) + 1
+            if " while(" in line:
+                whiles += 1
+                loop_regions.update(_HLO_REGION_RE.findall(line))
+    total = sum(counts.values())
+    in_body = sum(c for name, c in counts.items()
+                  if name in loop_regions)
+    return {"ir_thunks": counts.get(entry_name, 0) + in_body,
+            "hlo_instructions": total, "while_loops": whiles}
+
+
+def compiled_tick_stats(model, sim, params=None) -> Dict[str, int]:
+    """Lower + COMPILE one fused tick (abstract inputs, current JAX
+    backend) and return :func:`hlo_exec_stats` of the executable."""
+    import jax
+    import jax.numpy as jnp
+    from ..tpu.runtime import init_carry, make_tick_fn
+
+    if params is None:
+        params = model.make_params(sim.net.n_nodes)
+    tick = make_tick_fn(model, sim, params)
+    carry = jax.eval_shape(lambda: init_carry(model, sim, 0, params))
+    sds = jax.tree.map(
+        lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype), carry)
+    compiled = jax.jit(tick).lower(
+        sds, jax.ShapeDtypeStruct((), jnp.int32)).compile()
+    return hlo_exec_stats(compiled.as_text())
 
 
 # --- the audited model universe -------------------------------------------

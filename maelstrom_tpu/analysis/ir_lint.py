@@ -33,8 +33,16 @@ JXP403   donation-not-aliased     error     a compiled executor declares
                                             alias every carry leaf
                                             (silently-dropped donation =
                                             2x HBM + a hidden copy)
-JXP404   fusion-breaker           warning   ``while`` in the tick body, or
-                                            a ``broadcast_in_dim``
+JXP404   fusion-breaker           error/    more fusion-breaking loops
+                                  warning   (whiles + non-unrolled scans)
+                                            in the tick body than the
+                                            model's per-entry
+                                            ``fusion-breakers`` budget in
+                                            ``cost_baseline.json`` (error;
+                                            the fused raft family pins 0 —
+                                            budget-less entries warn on
+                                            explicit while_loops), or a
+                                            ``broadcast_in_dim``
                                             intermediate larger than k x
                                             the carry — the patterns that
                                             break fusion and spill HBM
@@ -116,10 +124,21 @@ def _finding(rule, name, severity, path, symbol, message,
 
 def audit_model_ir(model, node_count: int, layout: str = "lead",
                    label: Optional[str] = None,
+                   loop_budget: Optional[int] = None,
                    ) -> Tuple[List[Finding], Optional[CostReport]]:
     """Trace one model's fused tick in one layout and audit the IR.
     Returns (findings, cost report) — the report is reused by the cost
-    pass so each (model, layout) is traced exactly once per run."""
+    pass so each (model, layout) is traced exactly once per run.
+
+    ``loop_budget`` is the model's JXP404 budget: the number of
+    fusion-breaking loops (while_loops + non-unrolled scans) its tick
+    is ALLOWED to carry, read from the cost baseline's per-entry
+    ``fusion-breakers`` field by :func:`run_ir_lint`. Exceeding it is
+    an ERROR — the fused raft-family models pin 0, so a re-introduced
+    per-slot scan fails the gate, while kafka's recorded loops stay
+    legal without a global exemption. ``None`` (no baseline entry yet)
+    falls back to the PR-5 global behavior: explicit while_loops warn,
+    legacy scans pass un-counted."""
     import jax
 
     label = label or getattr(model, "name", type(model).__name__)
@@ -174,9 +193,25 @@ def audit_model_ir(model, node_count: int, layout: str = "lead",
              f" — one device->host round-trip per tick serializes the "
              f"scan and faults the TPU tunnel at fleet scale")
 
-    # JXP404: fusion breakers
-    n_while = report.ops.get("while", 0)
-    if n_while:
+    # JXP404: fusion breakers — while_loops plus scans that survive
+    # lowering as XLA whiles (non-unrolled bodies); each is a fusion
+    # boundary and a per-trip relaunch
+    n_loops = report.loops
+    if loop_budget is not None and n_loops > loop_budget:
+        flag("JXP404", "fusion-breaker",
+             message=f"{n_loops} fusion-breaking loop(s) in the tick "
+                     f"body vs this model's budget of {loop_budget} "
+                     f"(cost_baseline.json 'fusion-breakers') — a "
+                     f"while/non-unrolled scan survives as an XLA "
+                     f"while the backend can neither unroll nor fuse "
+                     f"across; restore the fused formulation or "
+                     f"re-baseline with --update-baseline and justify "
+                     f"the loop")
+    elif loop_budget is None and report.ops.get("while", 0):
+        # budget-less entry (not yet re-baselined, or a fixture): the
+        # PR-5 global behavior — explicit while_loops warn, legacy
+        # scans are implicitly tolerated
+        n_while = report.ops["while"]
         flag("JXP404", "fusion-breaker", severity=SEV_WARNING,
              message=f"{n_while} while_loop(s) in the tick body — XLA "
                      f"can neither unroll nor fuse across an unbounded "
@@ -519,6 +554,14 @@ def run_ir_lint(repo_root: str = ".", hazards: bool = True,
     live: Dict[str, CostReport] = {}
     paths: Dict[str, Tuple[str, str]] = {}
 
+    # per-model JXP404 loop budgets from the cost baseline (entries
+    # recorded before the field existed give None -> the global
+    # any-loop-warns fallback)
+    budgets: Dict[str, Optional[int]] = {
+        k: e.get("fusion-breakers")
+        for k, e in cost_model.load_cost_baseline(
+            cost_baseline_path).get("entries", {}).items()}
+
     for wl, n in specs:
         try:
             model = get_model(wl, n, "grid")
@@ -529,8 +572,10 @@ def run_ir_lint(repo_root: str = ".", hazards: bool = True,
                 f"get_model({wl!r}, {n}) raised: {e!r}"))
             continue
         for layout in layouts:
-            fs, report = audit_model_ir(model, n, layout,
-                                        label=f"{wl}/n={n}")
+            fs, report = audit_model_ir(
+                model, n, layout, label=f"{wl}/n={n}",
+                loop_budget=budgets.get(
+                    cost_model.entry_key(wl, n, layout)))
             if hazards:
                 findings.extend(fs)
             else:

@@ -31,28 +31,14 @@ import jax.numpy as jnp
 
 from ..tpu import wire
 from ..tpu.runtime import EV_INFO, EV_OK, Model, TYPE_ERROR
-
-# message types
-T_READ = 1
-T_WRITE = 2
-T_CAS = 3
-T_READ_OK = 4
-T_WRITE_OK = 5
-T_CAS_OK = 6
-T_REQ_VOTE = 10
-T_VOTE_REPLY = 11
-T_APPEND = 12
-T_APPEND_REPLY = 13
-
-F_READ = 1
-F_WRITE = 2
-F_CAS = 3
-
-NIL = -1     # missing KV value
-
-# base log entry body lanes: (f, key, a, b, client, client_msg_id);
-# subclasses widen via the ``entry_lanes`` class attribute
-ENTRY_LANES = 6
+from . import raft_core
+# the protocol constants live with the shared fusion kernel; re-exported
+# here so this module stays the raft vocabulary's import point (the
+# wire-schema lint resolves T_* against the model's module)
+from .raft_core import (ENTRY_LANES, F_CAS, F_READ, F_WRITE, NIL,  # noqa: F401
+                        T_APPEND, T_APPEND_REPLY, T_CAS, T_CAS_OK,
+                        T_READ, T_READ_OK, T_REQ_VOTE, T_VOTE_REPLY,
+                        T_WRITE, T_WRITE_OK, iclip, sel)
 
 
 class RaftRow(NamedTuple):
@@ -165,15 +151,22 @@ class RaftModel(Model):
         return jnp.full((self.n_keys,), NIL, jnp.int32)
 
     def _is_client_request(self, mtype):
-        return (mtype == T_READ) | (mtype == T_WRITE) | (mtype == T_CAS)
+        # T_READ..T_CAS are contiguous (1..3): one range test instead
+        # of three equality ors — same values on every int32
+        return (mtype >= T_READ) & (mtype <= T_CAS)
 
     def _encode_entry(self, msg, src):
-        """Client request message -> log entry row [entry_lanes]."""
-        mtype = msg[wire.TYPE]
-        f = jnp.where(mtype == T_READ, F_READ,
-                      jnp.where(mtype == T_WRITE, F_WRITE, F_CAS))
-        return jnp.stack([f, msg[wire.BODY], msg[wire.BODY + 1],
-                          msg[wire.BODY + 2], src, msg[wire.MSGID]])
+        """Client request message -> log entry row [entry_lanes]
+        (lane-contiguous: f, the three op lanes, src, msg id). The f
+        code IS the wire type for client requests (T_READ..T_CAS ==
+        F_READ..F_CAS == 1..3); for any other message type the encoded
+        row is garbage either way — both the legacy and the fused node
+        step only ever commit it to the log under cli_accept, which
+        implies a client request."""
+        return jnp.concatenate(
+            [msg[wire.TYPE:wire.TYPE + 1],
+             msg[wire.BODY:wire.BODY + 3], src[None],
+             msg[wire.MSGID:wire.MSGID + 1]])
 
     # --- helpers ----------------------------------------------------------
 
@@ -571,6 +564,61 @@ class RaftModel(Model):
             return out
 
         return jax.vmap(per_peer)(peers)
+
+    # --- fused node step (models/raft_core.py) ---------------------------
+    #
+    # The runtime drives raft-family models through the
+    # compartmentalized kernel: batched inbox decode, a minimal
+    # unrolled sequential core, batched reply assembly, and a
+    # deduplicated apply loop. handle()/tick()/_apply_one() above stay
+    # as the bit-identity reference oracle (tests/test_node_fusion.py)
+    # and for host-side single-message debugging.
+
+    fused_node = True
+
+    def node_rng(self, mkeys):
+        return raft_core.node_rng(self, mkeys)
+
+    def inbox_step(self, row, node_idx, msg, rng, t, cfg, params):
+        return raft_core.inbox_step(self, row, node_idx, msg, rng, t,
+                                    cfg)
+
+    def fused_tick(self, row, node_idx, t, rng, cfg, params):
+        return raft_core.fused_tick(self, row, node_idx, t, rng, cfg)
+
+    def apply_entry(self, row, do, entry, cfg):
+        """Apply ONE committed log entry to the KV state machine and
+        build the leader's client reply row — the per-model hook under
+        :func:`raft_core.fused_tick`'s shared apply loop. Mirrors
+        :meth:`_apply_one` value-for-value (the last_applied advance
+        lives in the shared loop; SRC/ORIGIN are stamped there too)."""
+        f, k = entry[0], entry[1]
+        a, b = entry[2], entry[3]
+        client, cmsg = entry[4], entry[5]
+        z0 = f * 0
+        k = iclip(k, z0, z0 + (self.n_keys - 1))  # echoed in the reply
+        cur = raft_core.tget(row.kv, k)
+        cas_ok = cur == a
+        new_val = sel(f == F_WRITE, a, sel((f == F_CAS) & cas_ok, b,
+                                           cur))
+        row = row._replace(
+            kv=sel(do, row.kv.at[k].set(new_val, mode="drop"),
+                   row.kv))
+
+        # leader replies to the waiting client; read replies carry
+        # (key, value), cas errors the code
+        reply_type = sel(f == F_READ, T_READ_OK,
+                         sel(f == F_WRITE, T_WRITE_OK,
+                             sel(cas_ok, T_CAS_OK, TYPE_ERROR)))
+        err_code = sel(cur == NIL, 20, 22)
+        z01 = z0[None]
+        out = jnp.concatenate([
+            (do & (row.role == 2)).astype(jnp.int32)[None], z01,
+            client[None], z01, reply_type[None], z01, cmsg[None], z01,
+            z01, sel(reply_type == TYPE_ERROR, err_code, k)[None],
+            cur[None],
+            jnp.zeros((cfg.lanes - wire.BODY - 2,), jnp.int32)])
+        return row, out
 
     # --- on-device invariants --------------------------------------------
 

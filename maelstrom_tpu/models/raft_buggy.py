@@ -107,6 +107,11 @@ _GOSSIP_LOG = []    # module state a traced fn must not touch
 class RaftTracedHazards(RaftModel):
     """LINT FIXTURE (do not register): every TRC-rule hazard in one tick."""
     name = "lin-kv-lint-fixture-traced-hazards"
+    # this fixture overrides the LEGACY tick hook, so it must opt out
+    # of the fused driver (which would route around an overridden
+    # handle()/tick() — the rule for any raft subclass that overrides
+    # the legacy hooks instead of the raft_core compartments)
+    fused_node = False
 
     def tick(self, row, node_idx, t, key, cfg, params):
         import random

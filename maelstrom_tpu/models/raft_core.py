@@ -1,0 +1,517 @@
+"""Fusion-first shared kernel for the raft-family node step.
+
+The original :class:`~.raft.RaftModel.handle` was one monolithic traced
+function, re-run per inbox slot under ``lax.scan``, with two unrolled
+copies of the apply machinery and a per-peer loop riding the tick hook.
+The static cost gate (``analysis/cost_baseline.json``, PR 5) measured
+the consequence: the node phase alone was ~1083 equations for lin-kv
+and ~1499 for txn-list-append — the single largest contributor to the
+~1000-thunk launch-overhead ceiling on the CPU bench line.
+
+This module restructures that step into the compartments of
+"Scaling Replicated State Machines with Compartmentalization"
+(PAPERS.md) — independently batchable stages around a minimal
+sequential core — expressed as mappable JAX functions (the DrJAX
+idiom), shared by every raft-family model (lin-kv, txn-rw-register,
+txn-list-append, and the planted-bug variants):
+
+- :func:`inbox_step` — the **minimal sequential core**: only the
+  order-dependent state chain (term/role/vote adoption, the single
+  log write, commit and replication bookkeeping) runs per slot.
+  Scanned with ``unroll=True``, so the lowered HLO has NO while loop —
+  the slots become straight-line code XLA fuses across. The scan
+  carries the raw message row per slot; field decode happens inline
+  (one equation per field, counted once for all K slots), because a
+  wide pre-decoded xs pytree costs a batching transpose per leaf under
+  the instance vmap.
+- :func:`assemble_replies` — **batched reply assembly**: the K out
+  rows are built in one scatter/gather pass over the per-slot decision
+  lanes the core emits (column writes on a zero canvas + one masked
+  select between the forward echo and the protocol-reply table),
+  instead of lane-by-lane ``.at[].set`` chains inside the loop.
+- :func:`fused_tick` — the per-tick hook with the replicated-log
+  **apply compartment** deduplicated: one table-driven apply body
+  (``Model.apply_entry``, the per-model state-machine hook) run as an
+  unrolled scan of ``apply_max`` trips, where the legacy models traced
+  ``apply_max`` full copies.
+- :func:`peer_sends` — peer RPC emission as column-wise table writes
+  over all peers at once.
+- :func:`node_rng` — every random draw of the node's tick in one
+  batched threefry site (the legacy path paid three expansions).
+
+Equation economics (why this halves the gated eqn count): scalar
+``jnp.where`` lowers to 2-3 equations (broadcast + convert + select)
+where :func:`sel` is one ``lax.select_n``; ``jnp.clip`` is 5 where
+:func:`iclip` is 2; ``jnp.stack`` of k columns is k+1 equations plus a
+batching transpose each, where k column writes on a shared zero canvas
+are ~2k; and each unrolled Python copy of a loop body re-traces every
+equation, where a ``lax.scan(..., unroll=True)`` body is counted once
+and STILL lowers without a while loop. Correctness is pinned by
+``tests/test_node_fusion.py``: trajectories are bit-identical to the
+pre-refactor handler in both carry layouts (frozen golden digests plus
+a live legacy-path oracle) — every formula below mirrors the legacy
+dataflow value-for-value, including the junk lanes of invalid slots.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..tpu import wire
+from ..tpu.runtime import TYPE_ERROR
+
+# message types (the raft protocol + lin-kv client vocabulary; the txn
+# models add their own T_TXN/T_TXN_OK in txn_raft.py)
+T_READ = 1
+T_WRITE = 2
+T_CAS = 3
+T_READ_OK = 4
+T_WRITE_OK = 5
+T_CAS_OK = 6
+T_REQ_VOTE = 10
+T_VOTE_REPLY = 11
+T_APPEND = 12
+T_APPEND_REPLY = 13
+
+F_READ = 1
+F_WRITE = 2
+F_CAS = 3
+
+NIL = -1     # missing KV value
+
+# base log entry body lanes: (f, key, a, b, client, client_msg_id);
+# subclasses widen via the ``entry_lanes`` class attribute
+ENTRY_LANES = 6
+
+
+# --- equation-frugal primitives --------------------------------------------
+
+
+def sel(pred, on_true, on_false):
+    """``jnp.where`` at ``lax.select_n`` prices: ONE equation on
+    same-shaped int32 operands (the sequential core is almost entirely
+    int32 scalars) instead of where()'s broadcast + convert + select
+    chain. Python ints coerce to int32 constants; values are identical
+    to ``jnp.where`` — bit-identity depends on it."""
+    return lax.select_n(pred, jnp.asarray(on_false, jnp.int32),
+                        jnp.asarray(on_true, jnp.int32))
+
+
+def iclip(x, lo, hi):
+    """``jnp.clip`` for int32 index clamping at ONE equation
+    (``lax.clamp`` is a single primitive; same values). ``lo``/``hi``
+    are usually pooled batched constants (see :func:`inbox_step`)."""
+    return lax.clamp(jnp.asarray(lo, jnp.int32), x,
+                     jnp.asarray(hi, jnp.int32))
+
+
+def tget(a, i):
+    """``a[clip(i, 0, len-1)]`` — scalar or whole leading-axis row.
+    ``jnp.take(mode="clip")`` is the cheapest batched formulation of a
+    clipped dynamic read under the runtime's two vmap levels (one
+    gather; ~3 equations vs ~7 for clamp+index or a dynamic slice).
+    The clip IS the legacy explicit clamp, so values are identical for
+    every int32 index. Writes use the dual idiom inline:
+    ``a.at[i].set(v, mode="drop")`` (~5 equations) — exact wherever
+    the legacy write either clamped a provably in-range index or
+    wrote an unchanged value at the clamp boundary (a no-op, which is
+    what drop does)."""
+    return jnp.take(a, i, axis=0, mode="clip")
+
+
+# --- batched RNG compartment -----------------------------------------------
+
+
+def node_rng(model, mkeys):
+    """Every random draw of one node's whole tick in ONE batched
+    threefry expansion. ``mkeys`` is the runtime's [K+1] per-slot key
+    stack (slot i = the legacy per-message ``fold_in(nkey, i)``; slot
+    K = the legacy tick key). Draw-for-draw identical to the legacy
+    paths: slot jitters are ``randint(fold_in(nkey, i))`` and the tick
+    jitter is ``randint(split(tkey)[1])`` — the same keys, the same
+    bounds, one vmapped call site instead of three scattered ones.
+    Returns ``(slot_jitter [K], tick_jitter)``."""
+    K = mkeys.shape[0] - 1
+    k_jit = jax.random.split(mkeys[K])[1]
+    jkeys = jnp.concatenate([mkeys[:K], k_jit[None]], axis=0)
+    jit_all = jax.vmap(
+        lambda k: jax.random.randint(k, (), 0, model.elect_jitter))(jkeys)
+    return jit_all[:K], jit_all[K]
+
+
+# --- the minimal sequential core -------------------------------------------
+
+
+def _popcount(votes, n_nodes: int, z1):
+    """``popcount(votes) + 1`` — the vote count incl. self. For the
+    usual small clusters a 2^n-entry lookup table (one gather) beats
+    the n-lane shift/mask/reduce. Valid because ``votes`` only ever
+    accumulates bits ``1 << src`` of granted vote replies, and vote
+    replies are emitted exclusively by server nodes (src < n) — so
+    ``votes < 2^n`` is an invariant and the table is total. Falls back
+    to the shift/reduce form for wide clusters."""
+    if n_nodes <= 8:
+        table = jnp.asarray(
+            [bin(v).count("1") for v in range(1 << n_nodes)],
+            dtype=jnp.int32)
+        return tget(table, votes) + z1
+    return jnp.sum((votes[None] >> jnp.arange(n_nodes)) & z1) + z1
+
+
+def inbox_step(model, row, node_idx, msg, jitter, t, cfg):
+    """One slot of the sequential core: the order-dependent state
+    chain (term/vote/role adoption, the single log write, commit and
+    replication bookkeeping) plus the slot's reply row, which comes
+    out as scan ys — under ``unroll=True`` the scan is straight-line
+    HLO, so the K reply rows materialize as one fused batch exactly
+    like a hand-vectorized assembly, without paying a second set of
+    per-lane equation sites. Field-for-field mirror of the legacy
+    ``RaftModel.handle`` dataflow — self-gating on invalid (all-zero)
+    slots exactly as before, since type 0 raises no flag.
+
+    The ``z0``/``z1``/``zm1`` locals are the pooled-constant idiom:
+    under the runtime's vmaps every *literal* operand costs a
+    broadcast equation per use, so the handful of constants this step
+    leans on (0, 1, -1, log_cap-1) are materialized ONCE as batched
+    values (``mtype * 0`` is exactly 0) and reused."""
+    n = cfg.n_nodes
+    cap = model.log_cap
+
+    # inline slot decode: the raft protocol overloads body lanes per
+    # type (b0 = sender term on every protocol message; b1 = candidate
+    # last-log-index / AE prev index / grant-or-success flag; b2 =
+    # candidate last-log-term / AE prev term / reply match index), so
+    # six lane reads cover every RPC
+    mtype = msg[wire.TYPE]
+    src = msg[wire.SRC]
+    msgid = msg[wire.MSGID]
+    b0 = msg[wire.BODY]
+    b1 = msg[wire.BODY + 1]
+    b2 = msg[wire.BODY + 2]
+    z0 = mtype * 0           # pooled batched constants (see docstring)
+    z1 = z0 + 1
+    zm1 = z0 - 1
+    zcap = z0 + cap
+    zcap1 = z0 + (cap - 1)
+    nid = node_idx + z0      # node id / tick, batched once and reused
+    tb = t + z0
+    is_vote = mtype == T_REQ_VOTE
+    is_vrep = mtype == T_VOTE_REPLY
+    is_ae = mtype == T_APPEND
+    is_arep = mtype == T_APPEND_REPLY
+    is_cli = model._is_client_request(mtype)
+    is_proto = is_vote | is_vrep | is_ae | is_arep
+    b1_is_1 = b1 == z1     # vote granted / append success share lane 1
+
+    # --- term adoption / step-down
+    higher = is_proto & (b0 > row.term)
+    term = sel(higher, b0, row.term)
+    role = sel(higher, z0, row.role)
+    voted_for = sel(higher, zm1, row.voted_for)
+    votes = sel(higher, z0, row.votes)
+
+    prev_idx = b1
+    ae_widx = iclip(prev_idx, z0, zcap1)
+
+    # --- RequestVote
+    c_lli, c_llt = b1, b2
+    my_llt = sel(row.log_len > z0, tget(row.log_term, row.log_len - z1),
+                 z0)
+    if model.vote_check_log_index:
+        log_ok = (c_llt > my_llt) | ((c_llt == my_llt)
+                                     & (c_lli >= row.log_len))
+    else:
+        # BUG variant: recency compares terms only
+        log_ok = c_llt >= my_llt
+    cur_term = b0 == term    # shared by grant/count_it/ae/arep gating
+    grant = is_vote & cur_term
+    if model.vote_check_voted_for:
+        grant = grant & ((voted_for == zm1) | (voted_for == src))
+    if model.vote_check_log:
+        grant = grant & log_ok
+    voted_for = sel(grant, src, voted_for)
+
+    # --- VoteReply
+    count_it = (role == z1) & cur_term & (is_vrep & b1_is_1)
+    votes = sel(count_it, votes | (z1 << src), votes)
+    n_votes = _popcount(votes, n, z1)
+    win = count_it & (n_votes > n // 2)
+    role = sel(win, 2, role)
+
+    # --- AppendEntries
+    prev_term = b2
+    l_commit = msg[wire.BODY + 3]
+    n_entries = msg[wire.BODY + 4]
+    e_term = msg[wire.BODY + 5]
+    ae_current = is_ae & cur_term
+    role = sel(ae_current & (role == z1), z0, role)
+    leader_hint = sel(ae_current, src, row.leader_hint)
+    prev_ok = (prev_idx == z0) | (
+        (prev_idx <= row.log_len)
+        & (tget(row.log_term, prev_idx - z1) == prev_term))
+    fits = prev_idx < zcap
+    accept = ae_current & prev_ok & ((n_entries == z0) | fits)
+    ae_write = accept & (n_entries == z1)
+    same = (row.log_len > prev_idx) & (tget(row.log_term, prev_idx)
+                                        == e_term)
+    # a same-entry re-append implies log_len > prev_idx, so the legacy
+    # max(log_len, prev_idx+1) is just log_len — only a CONFLICTING
+    # write truncates to prev_idx+1
+    conflict = ae_write & ~same
+    ae_len = sel(conflict, prev_idx + z1, row.log_len)
+    match_ack = sel(accept, prev_idx + n_entries, z0)
+
+    # --- client request (append to own log as leader, else proxy)
+    is_leader = role == 2
+    cli_accept = is_cli & is_leader & (row.log_len < zcap)
+    if model.serve_reads_locally:
+        # BUG variant: reads bypass the log entirely
+        is_stale = is_cli & (mtype == T_READ)
+        cli_accept = cli_accept & ~is_stale
+    forward = (is_cli & ~cli_accept & (row.leader_hint >= z0)
+               & (row.leader_hint != nid)
+               & (msg[wire.BODY + model.proxy_hops_lane] < 3))
+    if model.serve_reads_locally:
+        forward = forward & ~is_stale
+
+    # --- the single log write (AE entry or client append; exclusive;
+    # a client append has log_len < cap, so its slot needs no clamp —
+    # non-writing slots get the out-of-range drop sentinel)
+    slot = sel(ae_write, ae_widx, sel(cli_accept, row.log_len, zcap))
+    w_term = sel(ae_write, e_term, term)
+    e_body = msg[wire.BODY + 6:wire.BODY + 6 + model.entry_lanes]
+    w_body = sel(ae_write, e_body, model._encode_entry(msg, src))
+    log_term = row.log_term.at[slot].set(w_term, mode="drop")
+    log_body = row.log_body.at[slot].set(w_body, mode="drop")
+    log_len = sel(cli_accept, row.log_len + z1, ae_len)
+
+    # Leader-Completeness witness (see RaftRow.truncated_committed)
+    truncated_committed = row.truncated_committed | (
+        conflict & (ae_widx < row.commit_idx)).astype(jnp.int32)
+
+    # --- commit advance (Raft §5.3: min(leaderCommit, last new
+    # entry)). Unconditional: match_ack is 0 on non-accepted slots, so
+    # min(l_commit, 0) <= 0 <= commit_idx and the max is a no-op there
+    commit_idx = jnp.maximum(row.commit_idx,
+                             jnp.minimum(l_commit, match_ack))
+
+    # --- AppendEntriesReply bookkeeping (leader side)
+    r_success = b1_is_1
+    r_match = b2
+    mine = is_arep & is_leader & cur_term
+    nxt = tget(row.next_idx, src)
+    nxt = sel(mine,
+              sel(r_success, jnp.maximum(nxt, r_match),
+                  jnp.maximum(nxt - z1, z0)),
+              nxt)
+    # non-arep slots leave nxt unchanged, so the legacy boundary-
+    # clamped write of an out-of-range (client) src was a no-op —
+    # drop-mode is that no-op
+    next_idx = row.next_idx.at[src].set(nxt, mode="drop")
+    # on winning an election: reset replication state
+    next_idx = sel(win, jnp.broadcast_to(row.log_len, (n,)), next_idx)
+    mtch_old = tget(row.match_idx, src)
+    mtch = sel(mine & r_success, jnp.maximum(mtch_old, r_match),
+               mtch_old)
+    match_idx = row.match_idx.at[src].set(mtch, mode="drop")
+    match_idx = sel(win, jnp.broadcast_to(z0, (n,)), match_idx)
+    # own-slot seeding: win and cli_accept are mutually exclusive
+    # (vote-reply vs client-request slots), so the legacy pair of
+    # guarded writes is one write with a selected value
+    match_idx = match_idx.at[node_idx].set(
+        sel(cli_accept, row.log_len + z1,
+            sel(win, row.log_len, tget(match_idx, node_idx))),
+        mode="drop")
+    last_hb = sel(win, tb - model.heartbeat, row.last_hb)
+
+    # --- election timer: reset on vote grant or current-term AE (the
+    # jitter was drawn in the batched RNG compartment, same key)
+    election_deadline = sel(grant | ae_current,
+                            t + model.elect_min + jitter,
+                            row.election_deadline)
+
+    row = row._replace(
+        term=term, voted_for=voted_for, role=role, votes=votes,
+        commit_idx=commit_idx, log_term=log_term, log_body=log_body,
+        log_len=log_len, next_idx=next_idx, match_idx=match_idx,
+        election_deadline=election_deadline, last_hb=last_hb,
+        leader_hint=leader_hint,
+        truncated_committed=truncated_committed)
+
+    # --- the slot's reply row (lane-for-lane the legacy assembly,
+    # including the junk lanes of invalid slots — TYPE 127, body code
+    # 11 — which the journal records verbatim). SRC/ORIGIN are
+    # pre-stamped (node id on ordinary replies, the client src on
+    # proxied forwards) — the fused contract, so the runtime skips its
+    # masked re-stamp pass.
+    bl = model.body_lanes
+    is_req = is_vote | is_ae
+    valid = is_req | (is_cli & ~cli_accept)
+    dest = sel(forward, leader_hint, src)
+    # the protocol encodes every reply type as request type + 1
+    type_ = sel(is_req, mtype + z1, sel(forward, mtype, TYPE_ERROR))
+    reply_to = sel(forward, zm1, msgid)
+    msgid_out = sel(forward, msgid, zm1)
+    src_out = sel(forward, src, nid)
+    # body lanes: a forward echoes the full request body (hops lane
+    # bumped); protocol replies use lanes 0..2; rejections carry
+    # error code 11 in lane 0
+    fwd_body = msg[wire.BODY:wire.BODY + bl] \
+        .at[model.proxy_hops_lane].add(z1)
+    # lane 1: grant implies is_vote and accept implies is_ae (disjoint
+    # types), lane 2: match_ack is already accept-gated — no selects
+    proto_body = jnp.concatenate(
+        [sel(is_req, term, 11)[None],
+         (grant | accept).astype(jnp.int32)[None], match_ack[None],
+         jnp.zeros((bl - 3,), jnp.int32)])
+    body = sel(forward, fwd_body, proto_body)
+    if model.serve_reads_locally:
+        # BUG variant: the local read answered straight from the KV
+        stale = is_stale
+        kk = iclip(b0, z0, z0 + (model.n_keys - 1))
+        valid = valid | stale
+        dest = sel(stale, src, dest)
+        type_ = sel(stale, T_READ_OK, type_)
+        reply_to = sel(stale, msgid, reply_to)
+        msgid_out = sel(stale, zm1, msgid_out)
+        src_out = sel(stale, nid, src_out)
+        stale_body = jnp.zeros((bl,), jnp.int32) \
+            .at[0].set(kk).at[1].set(tget(row.kv, kk))
+        body = sel(stale, stale_body, body)
+    z01 = z0[None]
+    hdr = jnp.concatenate([
+        valid.astype(jnp.int32)[None], src_out[None], dest[None], z01,
+        type_[None], msgid_out[None], reply_to[None], nid[None], z01])
+    return row, jnp.concatenate([hdr, body])
+
+
+# --- the apply compartment -------------------------------------------------
+
+
+def apply_frontier(model, row):
+    """(do, entry) for the next entry to apply; the dirty-apply
+    mutant's frontier is the raw log end instead of the commit index."""
+    frontier = (row.log_len if model.apply_uncommitted
+                else row.commit_idx)
+    do = row.last_applied < frontier
+    return do, tget(row.log_body, row.last_applied)
+
+
+def fused_tick(model, row, node_idx, t, jitter, cfg):
+    """The per-tick hook, compartmentalized: election timer, leader
+    commit advance, ONE table-driven apply body (``apply_max`` trips
+    of an unrolled scan over ``Model.apply_entry`` — the legacy models
+    traced ``apply_max`` full copies), and the peer-send table (one
+    unrolled per-peer body). Value-for-value mirror of the legacy
+    ``RaftModel.tick``; replies and peer rows come out SRC/ORIGIN
+    pre-stamped (the fused contract)."""
+    n = cfg.n_nodes
+    # pooled batched constants (see inbox_step) — derived from a ROW
+    # field so they are batched over instances too (node_idx is not)
+    z0 = row.term * 0
+    z1 = z0 + 1
+    nid = node_idx + z0
+    tb = t + z0
+
+    # 1) election timeout -> candidacy
+    timeout = (row.role != 2) & (tb >= row.election_deadline)
+    row = row._replace(
+        term=sel(timeout, row.term + z1, row.term),
+        role=sel(timeout, z1, row.role),
+        voted_for=sel(timeout, nid, row.voted_for),
+        votes=sel(timeout, z0, row.votes),
+        # make the first vote solicitation fire immediately
+        last_hb=sel(timeout, tb - model.heartbeat, row.last_hb),
+        # suspected-dead leader: stop proxying to it
+        leader_hint=sel(timeout, z0 - 1, row.leader_hint),
+        election_deadline=sel(timeout, tb + model.elect_min + jitter,
+                              row.election_deadline),
+    )
+
+    # 2) leader: advance commit to the median match index (current
+    # term only), then apply
+    is_leader = row.role == 2
+    match = row.match_idx.at[node_idx].set(row.log_len, mode="drop")
+    if model.commit_quorum:
+        majority_match = jnp.sort(match)[(n - 1) // 2]  # >= on majority
+    else:
+        # BUG variant: commit at the MAX match index (no majority)
+        majority_match = jnp.max(match)
+    if model.commit_term_guard:
+        current_term_ok = tget(row.log_term,
+                               majority_match - z1) == row.term
+    else:
+        # BUG variant (Raft §5.4.2): commit on replication count alone
+        current_term_ok = jnp.bool_(True)
+    new_commit = sel(
+        is_leader & (majority_match > row.commit_idx) & current_term_ok,
+        majority_match, row.commit_idx)
+    row = row._replace(commit_idx=new_commit, match_idx=match)
+
+    # 3) apply up to apply_max committed entries; leader replies.
+    # unroll=True: the jaxpr carries the body ONCE, the HLO still
+    # lowers to straight-line (while-free) code.
+    def apply_step(r, _):
+        do, entry = apply_frontier(model, r)
+        r, out = model.apply_entry(r, do, entry, cfg)
+        return r._replace(last_applied=sel(do, r.last_applied + z1,
+                                           r.last_applied)), out
+
+    row, replies = lax.scan(apply_step, row, None,
+                            length=model.apply_max, unroll=True)
+    # pre-stamp the client replies (apply_entry leaves SRC/ORIGIN 0)
+    replies = replies.at[:, wire.SRC].set(nid) \
+        .at[:, wire.ORIGIN].set(nid)
+
+    # 4) peer sends: candidates solicit votes (re-solicit on the same
+    # cadence to survive loss), leaders replicate. The cadence test is
+    # the same expression for both roles — computed once.
+    due = tb - row.last_hb >= model.heartbeat
+    solicit = (row.role == 1) & due
+    hb_due = (row.role == 2) & due
+    row = row._replace(last_hb=sel(hb_due | solicit, tb, row.last_hb))
+    peers = peer_sends(model, row, nid, t, solicit, hb_due, cfg, z0)
+    return row, jnp.concatenate([replies, peers], axis=0)
+
+
+def peer_sends(model, row, node_idx, t, solicit, hb_due, cfg, z0):
+    """One message per peer slot (N-1 rows): RequestVote when a
+    soliciting candidate, AppendEntries on the leader's heartbeat
+    cadence. One unrolled per-peer body (shared node-level lanes —
+    send flags, term, own last log term — hoisted out of it)."""
+    n = cfg.n_nodes
+    z1 = z0 + 1
+    valid = (solicit | hb_due).astype(jnp.int32)
+    type_ = sel(solicit, T_REQ_VOTE, T_APPEND)
+    my_llt = sel(row.log_len > z0,
+                 tget(row.log_term, row.log_len - z1), z0)
+    # peers = all nodes except self, packed into n-1 slots
+    slots = jnp.arange(n - 1, dtype=jnp.int32)
+    peers = jnp.where(slots >= node_idx, slots + z1, slots)
+
+    def per_peer(carry, peer):
+        prev_idx = tget(row.next_idx, peer)
+        has_entry = (row.log_len > prev_idx).astype(jnp.int32)
+        b4 = sel(solicit, z0, has_entry)
+        entry = tget(row.log_body, prev_idx) * b4  # b4 masks vote sends
+        z01 = z0[None]
+        nid1 = node_idx[None]
+        pieces = [
+            valid[None], nid1, peer[None], z01, type_[None], z01, z01,
+            nid1, z01, row.term[None],
+            sel(solicit, row.log_len, prev_idx)[None],
+            sel(solicit, my_llt,
+                sel(prev_idx > z0, tget(row.log_term, prev_idx - z1),
+                    z0))[None],
+            sel(solicit, z0, row.commit_idx)[None],
+            b4[None],
+            sel(solicit, z0, tget(row.log_term, prev_idx))[None],
+            entry]
+        if model.body_lanes > 6 + model.entry_lanes:
+            pieces.append(jnp.zeros((model.body_lanes - 6
+                                     - model.entry_lanes,), jnp.int32))
+        return carry, jnp.concatenate(pieces)
+
+    return lax.scan(per_peer, z0, peers, unroll=True)[1]

@@ -35,10 +35,13 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from ..tpu import wire
 from ..tpu.runtime import EV_INFO, EV_OK, TYPE_ERROR
 from .raft import RaftModel, RaftRow
+from . import raft_core
+from .raft_core import iclip, sel
 
 # micro-op f codes
 MF_R = 1
@@ -87,10 +90,9 @@ class _TxnRaftBase(RaftModel):
         return mtype == T_TXN
 
     def _encode_entry(self, msg, src):
-        body = jax.lax.dynamic_slice(msg, (wire.BODY,),
-                                     (1 + 3 * self.txn_max,))
         return jnp.concatenate(
-            [body, jnp.stack([src, msg[wire.MSGID]])])
+            [msg[wire.BODY:wire.BODY + 1 + 3 * self.txn_max],
+             src[None], msg[wire.MSGID:wire.MSGID + 1]])
 
     # --- client side ------------------------------------------------------
 
@@ -211,12 +213,69 @@ class TxnListAppendModel(_TxnRaftBase):
         out = out.at[0, wire.TYPE].set(
             jnp.where(ok, T_TXN_OK, TYPE_ERROR))
         out = out.at[0, wire.REPLYTO].set(cmsg)
-        body = jnp.where(
-            ok, reply,
-            jnp.zeros_like(reply).at[0].set(30))  # 30 = txn-conflict
+        body = sel(ok, reply,
+                   jnp.zeros_like(reply).at[0].set(30))  # txn-conflict
         out = jax.lax.dynamic_update_slice(out, body[None],
                                            (0, wire.BODY))
         return row, out
+
+    def apply_entry(self, row: RaftRow, do, entry, cfg):
+        """Fused-path apply hook: the txn_max micro-op chain as ONE
+        unrolled-scan body instead of txn_max traced copies — mirrors
+        :meth:`_apply_one` value-for-value (reads snapshot the per-key
+        list as of that micro-op, an overflowing append aborts the
+        whole txn with error 30)."""
+        T = self.txn_max
+        Lc = self.list_cap
+        ln, client, cmsg = entry[0], entry[-2], entry[-1]
+        reply = jnp.zeros((self.ev_vals,), jnp.int32).at[0].set(ln)
+        reply = lax.dynamic_update_slice(reply, entry[1:1 + 3 * T],
+                                         (1,))
+        rbase = 1 + 3 * T
+        fkv = entry[1:1 + 3 * T].reshape(T, 3)
+
+        def micro(carry, x):
+            kv, reply, overflow = carry
+            i, mop = x
+            f, k, v = mop[0], mop[1], mop[2]
+            z0i = i * 0
+            active = i < ln
+            is_rd = active & (f == MF_R)
+            is_app = active & (f == MF_APPEND)
+            # one clamped row read (dget == the legacy k clip) shared
+            # by the read snapshot and the append path
+            rk = raft_core.tget(kv, k)
+            # read: snapshot k's list (sees earlier appends in this txn)
+            reply = lax.dynamic_update_slice(
+                reply, jnp.where(is_rd, rk[1:], 0),
+                (rbase + i * Lc,))
+            # append: push v
+            lk = rk[0]
+            fits = lk < Lc
+            overflow = overflow | (is_app & ~fits)
+            new_rk = lax.dynamic_update_index_in_dim(
+                rk, v, 1 + iclip(lk, z0i, z0i + (Lc - 1)), axis=0)
+            new_rk = new_rk.at[0].add(1)
+            kv = sel(is_app & fits,
+                     kv.at[k].set(new_rk, mode="drop"), kv)
+            return (kv, reply, overflow), None
+
+        (kv, reply, overflow), _ = lax.scan(
+            micro, (row.kv, reply, jnp.bool_(False)),
+            (jnp.arange(T, dtype=jnp.int32), fkv), unroll=True)
+        ok = ~overflow
+        row = row._replace(kv=sel(do & ok, kv, row.kv))
+
+        z0 = ln * 0
+        z01 = z0[None]
+        body = sel(ok, reply,
+                   jnp.zeros_like(reply).at[0].set(30))  # txn-conflict
+        pad = cfg.lanes - wire.BODY - self.ev_vals
+        return row, jnp.concatenate(
+            [(do & (row.role == 2)).astype(jnp.int32)[None], z01,
+             client[None], z01, sel(ok, T_TXN_OK, TYPE_ERROR)[None],
+             z01, cmsg[None], z01, z01, body]
+            + ([jnp.zeros((pad,), jnp.int32)] if pad else []))
 
     def complete_record(self, *vals_etype):
         vals, etype = vals_etype[:-1], vals_etype[-1]
@@ -294,6 +353,48 @@ class TxnRwRegisterModel(_TxnRaftBase):
         out = jax.lax.dynamic_update_slice(out, reply[None],
                                            (0, wire.BODY))
         return row, out
+
+    def apply_entry(self, row: RaftRow, do, entry, cfg):
+        """Fused-path apply hook: register micro-ops as one
+        unrolled-scan body — mirrors :meth:`_apply_one`
+        value-for-value (reads fold into the echoed v lane)."""
+        T = self.txn_max
+        ln, client, cmsg = entry[0], entry[-2], entry[-1]
+        reply = jnp.zeros((self.ev_vals,), jnp.int32).at[0].set(ln)
+        reply = lax.dynamic_update_slice(reply, entry[1:1 + 3 * T],
+                                         (1,))
+        fkv = entry[1:1 + 3 * T].reshape(T, 3)
+
+        def micro(carry, x):
+            kv, reply = carry
+            i, mop = x
+            f, k, v = mop[0], mop[1], mop[2]
+            active = i < ln
+            is_rd = active & (f == MF_R)
+            is_wr = active & (f == MF_W)
+            # read result replaces the echoed v lane (dget/dset clamp
+            # exactly like the legacy k clip)
+            vlane = 3 + 3 * i
+            reply = reply.at[vlane].set(
+                jnp.where(is_rd, raft_core.tget(kv, k),
+                          raft_core.tget(reply, vlane)),
+                mode="drop")
+            kv = sel(is_wr, kv.at[k].set(v, mode="drop"), kv)
+            return (kv, reply), None
+
+        (kv, reply), _ = lax.scan(
+            micro, (row.kv, reply),
+            (jnp.arange(T, dtype=jnp.int32), fkv), unroll=True)
+        row = row._replace(kv=sel(do, kv, row.kv))
+
+        z0 = ln * 0
+        z01 = z0[None]
+        pad = cfg.lanes - wire.BODY - self.ev_vals
+        return row, jnp.concatenate(
+            [(do & (row.role == 2)).astype(jnp.int32)[None], z01,
+             client[None], z01, (z0 + T_TXN_OK)[None], z01, cmsg[None],
+             z01, z01, reply]
+            + ([jnp.zeros((pad,), jnp.int32)] if pad else []))
 
     def complete_record(self, *vals_etype):
         vals, etype = vals_etype[:-1], vals_etype[-1]

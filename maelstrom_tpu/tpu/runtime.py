@@ -83,6 +83,12 @@ class Model:
     ev_vals: int = 4          # value lanes per history event; models with
                               # wide payloads (transactions) raise this and
                               # implement decode_reply_wide
+    fused_node = False        # True: the runtime drives the node step
+                              # through the compartmentalized protocol
+                              # (decode_inbox/node_rng/inbox_step/
+                              # assemble_replies/fused_tick) instead of
+                              # scanning handle()+tick() — see the
+                              # fused-protocol section below
 
     # models are stateless singletons: hash by type so fresh instances hit
     # the jit cache instead of forcing a recompile per Model()
@@ -116,6 +122,42 @@ class Model:
              ) -> Tuple[Any, jnp.ndarray]:
         """Per-tick hook (timers, gossip). Default: no-op."""
         return row, jnp.zeros((self.tick_out, cfg.lanes), dtype=jnp.int32)
+
+    # --- fused node-step protocol (opt-in via ``fused_node = True``) ------
+    #
+    # A fused model splits the node step into independently batchable
+    # compartments so the hot per-slot loop carries only the
+    # order-dependent state chain: the runtime draws the tick's
+    # randomness in one batched site (node_rng), scans the minimal
+    # sequential core over the slots (inbox_step, unrolled so the HLO
+    # is while-free), assembles all K replies in one scatter/gather
+    # pass (assemble_replies), then runs the per-tick hook
+    # (fused_tick). CONTRACT: trajectories must be bit-identical to
+    # the handle()/tick() formulation — handle/tick stay as the
+    # reference oracle (tests/test_node_fusion.py) — max_out must be 1
+    # (one reply row per inbox slot), and every emitted row must come
+    # out SRC/ORIGIN pre-stamped (the legacy path's masked re-stamp
+    # applied after the fact; fused models bake the same values in).
+
+    def node_rng(self, mkeys) -> Tuple[Any, Any]:
+        """All random draws for one node's tick from the [K+1] slot
+        key stack (slot i = fold_in(node key, i); slot K = the tick
+        key). Returns (per-slot draws [K, ...], tick draws)."""
+        raise NotImplementedError
+
+    def inbox_step(self, row, node_idx, msg, rng, t, cfg: NetConfig,
+                   params) -> Tuple[Any, jnp.ndarray]:
+        """One slot of the sequential core: (row', reply row [L]) from
+        one message row. Must self-gate on invalid slots like
+        handle(); the reply rows come out as scan ys, which the
+        unrolled scan materializes as one fused [K, L] batch."""
+        raise NotImplementedError
+
+    def fused_tick(self, row, node_idx, t, rng, cfg: NetConfig, params
+                   ) -> Tuple[Any, jnp.ndarray]:
+        """Per-tick hook for the fused path: like tick(), but takes
+        the pre-drawn randomness from node_rng instead of a key."""
+        raise NotImplementedError
 
     def invariants(self, node_state, cfg: NetConfig, params) -> jnp.ndarray:
         """Cheap whole-cluster safety invariants, evaluated on-device every
@@ -409,26 +451,19 @@ def node_phase(model: Model, node_state, inbox_nodes, t, key,
 
     node_state: pytree with leading node axis [N, ...].
     inbox_nodes: [N, K, L]. Returns (state', outs [N*(K*max_out+tick_out), L]).
+
+    Two drivers share this entry: the legacy per-slot scan over
+    ``handle()``, and — for ``model.fused_node`` models — the
+    compartmentalized step (batched decode -> unrolled minimal
+    sequential core -> batched reply assembly -> fused tick hook; see
+    the Model fused-protocol docs). Both produce bit-identical
+    trajectories; the fused driver exists because its jaxpr is ~2x
+    smaller and its HLO is while-free (models/raft_core.py).
     """
     N = cfg.n_nodes
     L = cfg.lanes
 
-    def per_node(row, inbox_row, nkey, node_idx):
-        def step(r, x):
-            msg, i = x
-            # distinct key per handled message — a shared key would
-            # correlate every random draw a model makes within a tick
-            mkey = jax.random.fold_in(nkey, i)
-            # models self-gate on invalid (all-zero) messages — see the
-            # Model.handle contract
-            return model.handle(r, node_idx, msg, t, mkey, cfg, params)
-
-        k_idx = jnp.arange(inbox_row.shape[0], dtype=jnp.int32)
-        row, outs_k = jax.lax.scan(step, row, (inbox_row, k_idx))
-        tkey = jax.random.fold_in(nkey, inbox_row.shape[0])
-        row, outs_t = model.tick(row, node_idx, t, tkey, cfg, params)
-        outs = jnp.concatenate(
-            [outs_k.reshape(-1, L), outs_t.reshape(-1, L)], axis=0)
+    def stamp(outs, node_idx):
         # stamp src = this node on rows whose SRC lane is still the zero
         # default. Contract: models either leave SRC unset (ordinary
         # sends/replies) or copy a CLIENT src (>= n_nodes) when proxying a
@@ -440,8 +475,47 @@ def node_phase(model: Model, node_state, inbox_nodes, t, key,
                       outs[:, wire.SRC]))
         # ORIGIN is always the emitting node — the physical link the
         # message leaves on — regardless of any proxied logical src
-        outs = outs.at[:, wire.ORIGIN].set(node_idx)
-        return row, outs
+        return outs.at[:, wire.ORIGIN].set(node_idx)
+
+    if model.fused_node:
+        assert model.max_out == 1, "fused node step assumes max_out == 1"
+
+        def per_node(row, inbox_row, nkey, node_idx):
+            K = inbox_row.shape[0]
+            # [K+1] slot keys in one batched fold: slot i is the legacy
+            # per-message fold_in(nkey, i), slot K the legacy tick key —
+            # the model batches ALL its draws from these in one site
+            mkeys = jax.vmap(lambda i: jax.random.fold_in(nkey, i))(
+                jnp.arange(K + 1, dtype=jnp.int32))
+            slot_rng, tick_rng = model.node_rng(mkeys)
+            row, outs_k = jax.lax.scan(
+                lambda r, x: model.inbox_step(r, node_idx, x[0], x[1],
+                                              t, cfg, params),
+                row, (inbox_row, slot_rng), unroll=True)
+            row, outs_t = model.fused_tick(row, node_idx, t, tick_rng,
+                                           cfg, params)
+            # fused models pre-stamp SRC/ORIGIN on every emitted row
+            # (see the fused-protocol contract) — no re-stamp pass
+            return row, jnp.concatenate([outs_k, outs_t], axis=0)
+    else:
+        def per_node(row, inbox_row, nkey, node_idx):
+            def step(r, x):
+                msg, i = x
+                # distinct key per handled message — a shared key would
+                # correlate every random draw a model makes within a tick
+                mkey = jax.random.fold_in(nkey, i)
+                # models self-gate on invalid (all-zero) messages — see
+                # the Model.handle contract
+                return model.handle(r, node_idx, msg, t, mkey, cfg,
+                                    params)
+
+            k_idx = jnp.arange(inbox_row.shape[0], dtype=jnp.int32)
+            row, outs_k = jax.lax.scan(step, row, (inbox_row, k_idx))
+            tkey = jax.random.fold_in(nkey, inbox_row.shape[0])
+            row, outs_t = model.tick(row, node_idx, t, tkey, cfg, params)
+            outs = jnp.concatenate(
+                [outs_k.reshape(-1, L), outs_t.reshape(-1, L)], axis=0)
+            return row, stamp(outs, node_idx)
 
     keys = jax.random.split(key, N)
     idx = jnp.arange(N, dtype=jnp.int32)

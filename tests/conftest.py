@@ -50,6 +50,9 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "ir: IR-level lint / cost-model tests "
                    "(analysis/ir_lint.py, analysis/cost_model.py)")
+    config.addinivalue_line(
+        "markers", "fusion: compartmentalized node-step bit-identity "
+                   "/ cost tests (models/raft_core.py)")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -78,6 +78,38 @@ class TestFixturesTrip:
         assert _rules(fs) == {"JXP405"}
         assert report.max_const_bytes >= 128 << 10
 
+    def test_loop_budget_exceeded_is_an_error(self):
+        """Per-model JXP404 budgets: a legacy-scan tick audited under
+        a zero loop budget is an ERROR naming the budget — the gate a
+        re-introduced per-slot scan would hit on the fused raft family
+        — while the same tick under a budget covering its loops stays
+        clean."""
+        from maelstrom_tpu.models.raft import RaftModel
+
+        legacy = type("RaftLegacyForBudget", (RaftModel,),
+                      {"fused_node": False})(n_nodes_hint=3)
+        fs, report = audit_model_ir(legacy, 3, "lead", loop_budget=0)
+        budget_fs = [f for f in fs if "budget" in f.message]
+        assert budget_fs and all(f.rule == "JXP404"
+                                 and f.severity == "error"
+                                 for f in budget_fs)
+        assert report.loops > 0
+
+        fs_ok, _ = audit_model_ir(legacy, 3, "lead",
+                                  loop_budget=report.loops)
+        assert not [f for f in fs_ok if "budget" in f.message]
+
+    def test_fused_raft_family_has_zero_loops(self):
+        """The fused models hold the budget they pin: zero
+        fusion-breaking loops in the whole tick, both layouts."""
+        from maelstrom_tpu.models.raft import RaftModel
+
+        for layout in ("lead", "minor"):
+            fs, report = audit_model_ir(RaftModel(n_nodes_hint=3), 3,
+                                        layout, loop_budget=0)
+            assert "JXP404" not in _rules(fs)
+            assert report.loops == 0
+
     def test_registered_models_do_not_trip(self):
         """The fixtures' rules must not fire on the honest models —
         the audit's false-positive guard (echo + the raft flagship)."""
@@ -286,6 +318,69 @@ class TestCostModel:
         cg = cost_model.cost_of_jaxpr(jax.make_jaxpr(g)(x))
         assert cf.eqns == cg.eqns          # static graph size is equal
         assert cg.hbm_bytes > cf.hbm_bytes * 5
+
+    def test_loops_count_only_surviving_whiles(self):
+        """The ``loops`` (fusion-breakers) metric: a plain scan and a
+        while_loop each count once; a fully unrolled scan lowers
+        while-free and counts zero."""
+        def scanned(x):
+            return jax.lax.scan(lambda c, _: (c + 1, None), x, None,
+                                length=8)[0]
+
+        def unrolled(x):
+            return jax.lax.scan(lambda c, _: (c + 1, None), x, None,
+                                length=8, unroll=True)[0]
+
+        def whiled(x):
+            return jax.lax.while_loop(lambda c: c[0] < 8,
+                                      lambda c: (c[0] + 1, c[1] * 2), x)
+
+        x = jax.ShapeDtypeStruct((), jnp.int32)
+        assert cost_model.cost_of_jaxpr(
+            jax.make_jaxpr(scanned)(x)).loops == 1
+        assert cost_model.cost_of_jaxpr(
+            jax.make_jaxpr(unrolled)(x)).loops == 0
+        assert cost_model.cost_of_jaxpr(
+            jax.make_jaxpr(whiled)((x, x))).loops == 1
+
+    def test_hlo_exec_stats_parses_entry_and_while_bodies(self):
+        """ir_thunks = entry instructions + while body/condition
+        instructions, with while regions resolved from the while op's
+        attributes (names are XLA-version noise), fusion-internal
+        instructions excluded."""
+        hlo = "\n".join([
+            "HloModule m",
+            "",
+            "%fused_computation.1 (p: s32[4]) -> s32[4] {",
+            "  %p = s32[4]{0} parameter(0)",
+            "  ROOT %a = s32[4]{0} add(%p, %p)",
+            "}",
+            "",
+            "%region_7.12 (c: (s32[], s32[4])) -> (s32[], s32[4]) {",
+            "  %c = (s32[], s32[4]{0}) parameter(0)",
+            "  %i = s32[] get-tuple-element(%c), index=0",
+            "  ROOT %t = (s32[], s32[4]{0}) tuple(%i, %i)",
+            "}",
+            "",
+            "%region_8.13 (c: (s32[], s32[4])) -> pred[] {",
+            "  %c = (s32[], s32[4]{0}) parameter(0)",
+            "  ROOT %lt = pred[] compare(%c, %c), direction=LT",
+            "}",
+            "",
+            "ENTRY %main.20 (a: s32[4]) -> s32[4] {",
+            "  %a = s32[4]{0} parameter(0)",
+            "  %f = s32[4]{0} fusion(%a), kind=kLoop, "
+            "calls=%fused_computation.1",
+            "  %w = (s32[], s32[4]{0}) while((s32[], s32[4]{0}) %f), "
+            "condition=%region_8.13, body=%region_7.12",
+            "  ROOT %r = s32[4]{0} get-tuple-element(%w), index=1",
+            "}",
+        ])
+        st = cost_model.hlo_exec_stats(hlo)
+        # entry: 4 instrs; while body: 3; while cond: 2; the fusion's
+        # 2 internal instrs excluded from thunks, included in the total
+        assert st == {"ir_thunks": 9, "hlo_instructions": 11,
+                      "while_loops": 1}
 
 
 # --- repo-wide gate --------------------------------------------------------

@@ -1,0 +1,244 @@
+"""Fusion-first node runtime: bit-identity + cost acceptance gates.
+
+The compartmentalized node step (``models/raft_core.py``, driven by
+``runtime.node_phase`` for ``fused_node`` models) promises two things:
+
+1. **Bit-identity** — trajectories are EXACTLY the pre-refactor
+   runtime's, in both carry layouts. The proof is two-sided: frozen
+   golden digests recorded from the pre-refactor code
+   (``tests/data/node_fusion_golden.json`` — these can never be
+   regenerated from this tree, so they pin history), and a LIVE oracle
+   (the legacy ``handle()``/``tick()`` driver still in the runtime,
+   selected by flipping ``fused_node`` off on a throwaway subclass).
+2. **Cost** — the node phase of every raft-family model drops >= 2x in
+   jaxpr equation count vs the PR-5 baseline, with ZERO fusion-breaking
+   loops (the unrolled scans must keep lowering while-free), enforced
+   forever by the per-model ``fusion-breakers`` budgets in
+   ``analysis/cost_baseline.json``.
+
+The planted-bug corpus rides the same kernel (the bug knobs are static
+branches in raft_core), so the golden set includes every buggy variant:
+dirty-apply / double-vote / stale-read must keep planting EXACTLY the
+same bugs — their digests are pinned too, and the double-vote mutant
+must still trip the on-device invariant (the full Elle-checker trips
+stay pinned by tests/test_tpu_txn.py and the triage fixtures).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from maelstrom_tpu.models import get_model
+from maelstrom_tpu.tpu.harness import make_sim_config
+from maelstrom_tpu.tpu.runtime import canonical_carry, run_sim
+
+pytestmark = pytest.mark.fusion
+
+GOLDEN_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "data", "node_fusion_golden.json")
+with open(GOLDEN_PATH) as f:
+    GOLDEN = json.load(f)
+
+# the exact recording config of the frozen digests (pre-refactor code,
+# tests/data/node_fusion_golden.json) — every knob matters: a changed
+# horizon or rate is a different trajectory, not a failed identity
+GOLDEN_OPTS = dict(node_count=3, concurrency=4, n_instances=2,
+                   record_instances=2, time_limit=1.2, rate=300.0,
+                   latency=4.0, rpc_timeout=0.5, nemesis=["partition"],
+                   nemesis_interval=0.25, p_loss=0.05,
+                   recovery_time=0.3, pool_slots=32, seed=0,
+                   telemetry=False)
+GOLDEN_SEED = 11
+
+RAFT_FAMILY = [
+    "lin-kv",
+    "lin-kv-bug-double-vote", "lin-kv-bug-stale-read",
+    "lin-kv-bug-no-term-guard", "lin-kv-bug-short-log-wins",
+    "lin-kv-bug-eager-commit",
+    "txn-list-append", "txn-rw-register",
+    "txn-list-append-bug-dirty-apply", "txn-rw-register-bug-dirty-apply",
+]
+
+# the PR-5 node-phase eqn figures this PR halves (the acceptance bar's
+# "before" column — frozen history, doc/results.md scoreboard)
+PR5_NODE_EQNS = {"lin-kv": 1083, "txn-rw-register": 1175,
+                 "txn-list-append": 1499}
+AUDIT_N = {"lin-kv": 5, "txn-rw-register": 3, "txn-list-append": 3}
+
+
+def _legacy_of(model):
+    """The same model instance driven through the legacy
+    handle()/tick() node step: a throwaway subclass (fresh type => its
+    own jit cache slot) with the fused protocol switched off."""
+    cls = type(model)
+    leg = type(cls.__name__ + "LegacyOracle", (cls,),
+               {"fused_node": False})
+    m = leg.__new__(leg)
+    m.__dict__.update(model.__dict__)
+    return m
+
+
+def _traj_digest(model, layout):
+    """sha256 over the canonicalized end-of-run carry + the dense event
+    tensor — the exact recipe of the frozen recording script (canonical
+    orientation makes the digest layout-independent by construction)."""
+    sim = make_sim_config(model, {**GOLDEN_OPTS, "layout": layout})
+    carry, ys = run_sim(model, sim, GOLDEN_SEED,
+                        model.make_params(sim.net.n_nodes))
+    canon = canonical_carry(carry, sim)
+    h = hashlib.sha256()
+    for leaf in jax.tree.leaves((canon.pool, canon.node_state,
+                                 canon.client_state, canon.violations,
+                                 canon.stats)):
+        h.update(np.asarray(leaf).tobytes())
+    h.update(np.asarray(ys.events).tobytes())
+    return h.hexdigest()
+
+
+# --- frozen pre-refactor oracle -------------------------------------------
+
+
+# tier-1 pins the three headline models (lin-kv in BOTH layouts; the
+# txn models split one layout each — the golden file itself pins
+# lead==minor) plus one bug variant per bug family; the full 10x2
+# sweep (identical assertion, the remaining variants) is the slow
+# re-measure, budgeted out of the 870s tier-1 window
+TIER1_GOLDEN = [("lin-kv", "lead"), ("lin-kv", "minor"),
+                ("txn-rw-register", "lead"),
+                ("txn-list-append", "minor"),
+                ("lin-kv-bug-double-vote", "lead"),
+                ("txn-list-append-bug-dirty-apply", "lead")]
+SLOW_GOLDEN = [(wl, layout) for wl in RAFT_FAMILY
+               for layout in ("lead", "minor")
+               if (wl, layout) not in TIER1_GOLDEN]
+
+
+@pytest.mark.parametrize("workload,layout", TIER1_GOLDEN)
+def test_golden_digest(workload, layout):
+    """The fused runtime reproduces the pre-refactor trajectory
+    bit-for-bit (frozen digest, recorded before the refactor)."""
+    model = get_model(workload, GOLDEN_OPTS["node_count"])
+    assert _traj_digest(model, layout) == GOLDEN[f"{workload}/{layout}"]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("workload,layout", SLOW_GOLDEN)
+def test_golden_digest_full_sweep(workload, layout):
+    model = get_model(workload, GOLDEN_OPTS["node_count"])
+    assert _traj_digest(model, layout) == GOLDEN[f"{workload}/{layout}"]
+
+
+def test_golden_set_is_complete_and_layout_independent():
+    """Every raft-family model x both layouts is pinned, and each
+    lead/minor pair recorded the SAME digest (canonical_carry is a pure
+    transpose — a layout-dependent digest would mean the recording
+    itself caught a layout bug)."""
+    assert set(GOLDEN) == {f"{wl}/{layout}" for wl in RAFT_FAMILY
+                           for layout in ("lead", "minor")}
+    for wl in RAFT_FAMILY:
+        assert GOLDEN[f"{wl}/lead"] == GOLDEN[f"{wl}/minor"], wl
+
+
+def test_golden_pins_the_planted_bugs():
+    """The recorded trajectories PROVE the bug corpus stayed planted:
+    a mutant whose bug manifests inside the recording horizon digests
+    differently from its correct base model."""
+    for wl in ("lin-kv-bug-double-vote", "lin-kv-bug-stale-read",
+               "lin-kv-bug-eager-commit"):
+        assert GOLDEN[f"{wl}/lead"] != GOLDEN["lin-kv/lead"], wl
+    assert (GOLDEN["txn-list-append-bug-dirty-apply/lead"]
+            != GOLDEN["txn-list-append/lead"])
+    assert (GOLDEN["txn-rw-register-bug-dirty-apply/lead"]
+            != GOLDEN["txn-rw-register/lead"])
+
+
+# --- live legacy-path oracle ----------------------------------------------
+
+
+def _assert_fused_equals_legacy(workload, layout, opts, seed=7):
+    model = get_model(workload, opts["node_count"])
+    assert type(model).fused_node, "raft family must default to fused"
+    sim = make_sim_config(model, {**opts, "layout": layout})
+    params = model.make_params(sim.net.n_nodes)
+    fused = run_sim(model, sim, seed, params)
+    legacy = run_sim(_legacy_of(model), sim, seed, params)
+    for a, b in zip(jax.tree.leaves(fused), jax.tree.leaves(legacy)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_fused_equals_legacy_live():
+    """Fused vs legacy driver on the SAME current tree: full (carry,
+    ys) equality, every leaf — the oracle that keeps working after the
+    golden config's trajectory drifts for an intentional reason."""
+    _assert_fused_equals_legacy("lin-kv", "lead", GOLDEN_OPTS)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("workload,layout", [
+    ("txn-list-append", "minor"), ("txn-rw-register", "lead"),
+    ("lin-kv-bug-stale-read", "minor"),
+    ("txn-rw-register-bug-dirty-apply", "lead")])
+def test_fused_equals_legacy_live_sweep(workload, layout):
+    _assert_fused_equals_legacy(workload, layout, GOLDEN_OPTS)
+
+
+# --- the planted bugs still fire ------------------------------------------
+
+
+def test_double_vote_still_trips_on_device_invariant():
+    """The fused double-vote mutant still elects two leaders in one
+    term under partitions — the on-device invariant lane must light up
+    (the config is test_stream_triage's forensics fixture)."""
+    opts = dict(node_count=3, concurrency=6, n_instances=16,
+                record_instances=4, inbox_k=1, pool_slots=16,
+                time_limit=0.3, rate=200.0, latency=5.0,
+                rpc_timeout=1.0, nemesis=["partition"],
+                nemesis_interval=0.04, p_loss=0.05, recovery_time=0.0)
+    model = get_model("lin-kv-bug-double-vote", 3)
+    sim = make_sim_config(model, opts)
+    carry, _ = run_sim(model, sim, 7, model.make_params(3))
+    assert int(np.asarray(carry.violations).sum()) > 0
+
+    # the correct model stays clean under the identical schedule
+    ok_model = get_model("lin-kv", 3)
+    ok_carry, _ = run_sim(ok_model, sim, 7, ok_model.make_params(3))
+    assert int(np.asarray(ok_carry.violations).sum()) == 0
+
+
+# --- the cost acceptance bar ----------------------------------------------
+
+
+def test_node_phase_eqns_halved_vs_pr5():
+    """ISSUE-6 acceptance: node-phase eqn count >= 2x down vs the PR-5
+    baseline for the three headline models, in BOTH layouts, with zero
+    fusion-breaking loops in the whole tick."""
+    from maelstrom_tpu.analysis.cost_model import audit_sim, tick_cost
+    for wl, before in PR5_NODE_EQNS.items():
+        n = AUDIT_N[wl]
+        model = get_model(wl, n)
+        for layout in ("lead", "minor"):
+            cost = tick_cost(model, audit_sim(model, n, layout))
+            now = cost.phases["node_phase"]
+            assert now * 2 <= before, (wl, layout, now, before)
+            assert cost.loops == 0, (wl, layout)
+
+
+def test_raft_family_budgets_pinned_at_zero():
+    """The re-recorded cost baseline carries a zero fusion-breaker
+    budget for every raft-family entry — the JXP404 per-model gate that
+    makes a re-introduced per-slot scan a pre-merge ERROR."""
+    from maelstrom_tpu.analysis.cost_model import load_cost_baseline
+    entries = load_cost_baseline()["entries"]
+    raft_keys = [k for k in entries
+                 if k.split("/")[0] in RAFT_FAMILY]
+    assert len(raft_keys) == 20          # 10 models x 2 layouts
+    for k in raft_keys:
+        assert entries[k]["fusion-breakers"] == 0, k
+        assert entries[k]["phases"]["node_phase"] * 2 <= max(
+            PR5_NODE_EQNS.values())

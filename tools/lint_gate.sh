@@ -24,11 +24,15 @@ echo "== maelstrom lint --ir --cost --strict (IR hazards + cost budget)"
 python -m maelstrom_tpu lint --ir --cost --strict
 
 echo
-echo "== cost-regression canary (tampered baseline must fail the gate)"
-# Simulate a PR that bloats a model's tick: shrink one checked-in
-# baseline entry by 50% (equivalent to the live cost growing 2x) and
-# require the cost gate to exit 1 with COST501. This exercises the
-# detection path end-to-end without editing source.
+echo "== cost/budget-regression canary (tampered baseline must fail)"
+# Simulate a PR that (a) bloats a model's tick — shrink one checked-in
+# baseline entry by 50% (equivalent to the live cost growing 2x) — and
+# (b) re-introduces a fusion-breaking loop — drop kafka's recorded
+# JXP404 loop budget to 0, so its (legal, recorded) loop now exceeds
+# budget exactly like a per-slot scan sneaking back into the fused
+# raft family would. One tampered-baseline run must exit 1 with BOTH
+# COST501 and the JXP404 budget error. This exercises the detection
+# paths end-to-end without editing source.
 python - "$SMOKE_STORE/cost_tampered.json" <<'PY'
 import json, sys
 base = json.load(open("maelstrom_tpu/analysis/cost_baseline.json"))
@@ -36,16 +40,35 @@ key = sorted(base["entries"])[0]
 e = base["entries"][key]
 e["eqns"] = max(1, e["eqns"] // 2)
 e["hbm-bytes-per-tick"] = max(1, e["hbm-bytes-per-tick"] // 2)
+budget_keys = [k for k in base["entries"]
+               if base["entries"][k].get("fusion-breakers", 0) > 0]
+assert budget_keys, "no loop-carrying entry to tamper"
+for k in budget_keys[:2]:
+    base["entries"][k]["fusion-breakers"] = 0
 json.dump(base, open(sys.argv[1], "w"))
-print(f"tampered entry: {key}")
+print(f"tampered entries: {key} (cost), {budget_keys[:2]} (budget)")
 PY
 rc=0
-python -m maelstrom_tpu lint --cost --strict \
+python -m maelstrom_tpu lint --ir --cost --strict \
     --cost-baseline "$SMOKE_STORE/cost_tampered.json" \
     > "$SMOKE_STORE/cost-canary.out" || rc=$?
-[[ "$rc" == "1" ]] || { echo "expected exit 1 (cost regression caught), got $rc"; exit 1; }
+[[ "$rc" == "1" ]] || { echo "expected exit 1 (regressions caught), got $rc"; exit 1; }
 grep -q 'COST501' "$SMOKE_STORE/cost-canary.out"
-echo "canary caught: $(grep -c COST501 "$SMOKE_STORE/cost-canary.out") COST501 finding(s)"
+grep -Eq 'ERROR JXP404.*budget' "$SMOKE_STORE/cost-canary.out"
+echo "canary caught: $(grep -c COST501 "$SMOKE_STORE/cost-canary.out") COST501 + $(grep -Ec 'ERROR JXP404' "$SMOKE_STORE/cost-canary.out") JXP404-budget finding(s)"
+
+echo
+echo "== raft-family fusion budgets hold (fused ticks pin 0 loops)"
+python - <<'PY'
+import json
+base = json.load(open("maelstrom_tpu/analysis/cost_baseline.json"))
+raft = [k for k in base["entries"]
+        if k.split("/")[0].startswith(("lin-kv", "txn-"))]
+assert len(raft) == 20, f"expected 20 raft-family entries, got {len(raft)}"
+bad = [k for k in raft if base["entries"][k]["fusion-breakers"] != 0]
+assert not bad, f"raft-family entries with nonzero loop budget: {bad}"
+print(f"{len(raft)} raft-family entries, all fusion-breakers=0")
+PY
 
 if [[ "${1:-}" == "--lint-only" ]]; then
     rm -rf "$SMOKE_STORE"

@@ -84,6 +84,29 @@ def main() -> None:
         # abstract trace, shared with `maelstrom lint --cost`
         cost = cost_model.tick_cost(model, sim, params)
 
+        # post-compile launch-overhead stats for the FIRST size only
+        # (one extra tick compile; PROF_THUNKS=0 skips): ir_thunks is
+        # the op count of the optimized executable — eqns measure the
+        # tick pre-fusion, thunks what the backend actually launches
+        if I == sizes[0] and os.environ.get("PROF_THUNKS") != "0":
+            try:
+                st = cost_model.compiled_tick_stats(model, sim, params)
+                row = {"instances": I, "phase": "compiled_tick",
+                       "ir_thunks": st["ir_thunks"],
+                       "while_loops": st["while_loops"],
+                       "hlo_instructions": st["hlo_instructions"],
+                       "static_eqns": cost.eqns}
+                rows.append(row)
+                print(json.dumps(row), flush=True)
+                print(f"# compiled tick: {st['ir_thunks']} thunks "
+                      f"({st['while_loops']} while loops, "
+                      f"{st['hlo_instructions']} HLO instrs) vs "
+                      f"{cost.eqns} pre-fusion eqns",
+                      file=sys.stderr, flush=True)
+            except Exception as e:
+                print(f"# compiled_tick_stats unavailable: {e!r}",
+                      file=sys.stderr, flush=True)
+
         def static_eqns(phase_name: str):
             if phase_name in phase_map:
                 return cost.phases.get(phase_map[phase_name], 0)
@@ -230,6 +253,8 @@ def main() -> None:
     by_phase = {}
     eqns_of = {}
     for r in rows:
+        if "ms_per_tick" not in r:
+            continue   # compiled_tick stats row — not a timing
         by_phase.setdefault(r["phase"], {})[r["instances"]] = \
             r["ms_per_tick"]
         if "static_eqns" in r:
