@@ -220,9 +220,12 @@ def child_main(canary: bool = False) -> None:
         # Purely static (one abstract trace, no device); never allowed
         # to kill the bench.
         ir_eqns = ir_bytes_est = None
+        _traced = _cost = None
         try:
-            from maelstrom_tpu.analysis.cost_model import tick_cost
-            _cost = tick_cost(model, sim, params)
+            from maelstrom_tpu.analysis.cost_model import (
+                cost_of_jaxpr, trace_tick)
+            _traced = trace_tick(model, sim, params)
+            _cost = cost_of_jaxpr(_traced[0], _traced[1])
             ir_eqns, ir_bytes_est = _cost.eqns, _cost.hbm_bytes
             log(TAG, f"phase[{cfg_name}]: static tick IR — "
                      f"{ir_eqns} eqns, ~{ir_bytes_est / 1e6:.1f} MB "
@@ -250,6 +253,30 @@ def child_main(canary: bool = False) -> None:
                          f"loops ({time.time() - _t0:.1f}s compile)")
             except Exception as e:
                 log(TAG, f"phase[{cfg_name}]: compiled_tick_stats "
+                         f"unavailable: {e!r}")
+
+        # lane occupancy of the same tick graph (analysis/
+        # lane_liveness.py — the figures `maelstrom lint --lanes`
+        # gates): how many of the Msg's lanes this config actually
+        # reads, and the dead-lane byte slice of ir_bytes_est — the
+        # ROADMAP item 2 specialization headroom, tracked per round
+        # next to wall-clock. Static like ir_eqns; BENCH_LANES=0 skips.
+        lanes_live = lanes_dead = lanes_dead_bytes = None
+        if os.environ.get("BENCH_LANES") != "0":
+            try:
+                from maelstrom_tpu.analysis.cost_model import (
+                    tick_lane_stats)
+                _ls = tick_lane_stats(model, sim, traced=_traced,
+                                      cost=_cost)
+                lanes_live = _ls["lanes_live"]
+                lanes_dead = _ls["lanes_dead"]
+                lanes_dead_bytes = _ls["lanes_dead_bytes"]
+                log(TAG, f"phase[{cfg_name}]: lane liveness — "
+                         f"{lanes_live} live / {lanes_dead} dead lanes, "
+                         f"~{lanes_dead_bytes / 1e3:.0f} kB/tick dead "
+                         f"traffic")
+            except Exception as e:
+                log(TAG, f"phase[{cfg_name}]: tick_lane_stats "
                          f"unavailable: {e!r}")
         log(TAG, f"phase[{cfg_name}]: sim built — {cfg_n_instances} x "
                  f"{sim.net.n_nodes} nodes, {sim.n_ticks} ticks, "
@@ -397,6 +424,10 @@ def child_main(canary: bool = False) -> None:
             if ir_thunks is not None:
                 rec["ir_thunks"] = ir_thunks
                 rec["ir_while_loops"] = ir_while_loops
+            if lanes_live is not None:
+                rec["lanes_live"] = lanes_live
+                rec["lanes_dead"] = lanes_dead
+                rec["lanes_dead_bytes"] = lanes_dead_bytes
             if bench_pipeline:
                 rec["pipeline"] = True
                 rec["heartbeat"] = bench_heartbeat

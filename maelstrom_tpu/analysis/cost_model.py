@@ -216,21 +216,36 @@ def audit_sim(model, node_count: int, layout: str = "lead"):
                                    "layout": layout})
 
 
-def trace_tick(model, sim, params=None):
+def trace_tick(model, sim, params=None, cache=None):
     """``jax.make_jaxpr`` of the fused tick under ``sim`` — the same
     closure the executors scan. Returns ``(closed_jaxpr, carry_shapes,
     out_shapes)`` where ``carry_shapes`` is the input carry pytree of
-    ShapeDtypeStructs and ``out_shapes`` the traced ``(carry', ys)``."""
+    ShapeDtypeStructs and ``out_shapes`` the traced ``(carry', ys)``.
+    ``cache`` (a mutable mapping, keyed by :func:`entry_key`) lets the
+    combined ``lint --ir --cost --lanes`` gate trace each model x
+    layout once instead of once per pass. The key does NOT capture the
+    sim config, so pass a cache only with :func:`audit_sim`-built sims
+    (the lint passes' shared convention); only default-``params``
+    traces are cached (custom params change the graph)."""
     import jax
     import jax.numpy as jnp
     from ..tpu.runtime import init_carry, make_tick_fn
 
+    key = None
+    if cache is not None and params is None:
+        key = entry_key(getattr(model, "name", type(model).__name__),
+                        sim.net.n_nodes, sim.layout)
+        hit = cache.get(key)
+        if hit is not None:
+            return hit
     if params is None:
         params = model.make_params(sim.net.n_nodes)
     tick = make_tick_fn(model, sim, params)
     carry = jax.eval_shape(lambda: init_carry(model, sim, 0, params))
     closed, out_shapes = jax.make_jaxpr(tick, return_shape=True)(
         carry, jnp.int32(0))
+    if key is not None:
+        cache[key] = (closed, carry, out_shapes)
     return closed, carry, out_shapes
 
 
@@ -239,6 +254,22 @@ def tick_cost(model, sim, params=None) -> CostReport:
     the bench.py / tools entry point."""
     closed, carry, _ = trace_tick(model, sim, params)
     return cost_of_jaxpr(closed, carry)
+
+
+def tick_lane_stats(model, sim, traced=None,
+                    cost: Optional[CostReport] = None) -> Dict[str, int]:
+    """Lane-liveness stats of ``model``'s fused tick under ``sim`` —
+    ``lanes_live`` / ``lanes_dead`` / ``lanes_dead_bytes``, the figures
+    bench.py and tools/tick_profile.py print next to ``ir_bytes_est``
+    (``dead_bytes`` is the slice of the byte estimate that moves lanes
+    nothing ever reads — ROADMAP item 2's measured headroom). Thin
+    delegation so cost consumers need only this module; the analysis
+    itself lives in :mod:`.lane_liveness`. ``traced`` (a
+    :func:`trace_tick` triple) and ``cost`` (its :func:`cost_of_jaxpr`
+    report) skip the duplicate trace when the caller already computed
+    them for the same model x sim."""
+    from .lane_liveness import lane_stats
+    return lane_stats(model, sim, traced=traced, cost=cost)
 
 
 # --- post-compile cost: the thunk count -------------------------------------
@@ -339,6 +370,25 @@ def entry_key(workload: str, node_count: int, layout: str) -> str:
 # --- baseline io -----------------------------------------------------------
 
 
+def toolchain_note(recorded: Optional[str], what: str,
+                   re_record_flag: str = "--update-baseline",
+                   ) -> Optional[str]:
+    """The self-explaining staleness downgrade (ROADMAP accepted-debt
+    item): recorded baselines/manifests are jax-version-dependent, so
+    when the recording version differs from the running one, drift is
+    expected toolchain movement — the gate downgrades to a warning that
+    says exactly how to re-record instead of failing as if code
+    regressed. Returns ``None`` when versions match (or nothing was
+    recorded), else the note to append to drift findings."""
+    import jax
+    if recorded is None or recorded == jax.__version__:
+        return None
+    return (f"recorded under jax {recorded}, this run is jax "
+            f"{jax.__version__} — toolchain drift, not necessarily a "
+            f"code regression; re-record {what} with {re_record_flag} "
+            f"and commit the result")
+
+
 def load_cost_baseline(path: Optional[str] = None) -> Dict[str, Any]:
     path = path or DEFAULT_COST_BASELINE
     if not os.path.exists(path):
@@ -354,6 +404,7 @@ def load_cost_baseline(path: Optional[str] = None) -> Dict[str, Any]:
 def save_cost_baseline(entries: Dict[str, Dict[str, Any]],
                        path: Optional[str] = None,
                        tolerance: float = DEFAULT_TOLERANCE) -> str:
+    import jax
     path = path or DEFAULT_COST_BASELINE
     payload = {
         "version": 1,
@@ -366,7 +417,10 @@ def save_cost_baseline(entries: Dict[str, Dict[str, Any]],
             "= eqn count per jax.named_scope phase. Regenerate after "
             "an INTENTIONAL cost change with `maelstrom lint --cost "
             "--update-baseline`; a PR that regresses any entry by more "
-            "than `tolerance` fails the gate (COST501)."),
+            "than `tolerance` fails the gate (COST501). jax-version "
+            "records the tracing toolchain: under a different jax the "
+            "gate downgrades drift to a re-record warning."),
+        "jax-version": jax.__version__,
         "tolerance": tolerance,
         "entries": {k: entries[k] for k in sorted(entries)},
     }

@@ -84,7 +84,7 @@ class BaselineEntry:
 # passes)
 _RULE_PASS_PREFIXES = (("TRC", "trace"), ("CON", "contract"),
                        ("SCH", "schema"), ("JXP", "ir"),
-                       ("COST", "cost"))
+                       ("COST", "cost"), ("LNE", "lanes"))
 
 
 def fingerprint_pass(fingerprint: str) -> Optional[str]:

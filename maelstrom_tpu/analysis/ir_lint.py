@@ -125,6 +125,7 @@ def _finding(rule, name, severity, path, symbol, message,
 def audit_model_ir(model, node_count: int, layout: str = "lead",
                    label: Optional[str] = None,
                    loop_budget: Optional[int] = None,
+                   trace_cache=None,
                    ) -> Tuple[List[Finding], Optional[CostReport]]:
     """Trace one model's fused tick in one layout and audit the IR.
     Returns (findings, cost report) — the report is reused by the cost
@@ -153,12 +154,19 @@ def audit_model_ir(model, node_count: int, layout: str = "lead",
 
     try:
         sim = cost_model.audit_sim(model, node_count, layout)
-        closed, carry, out_shapes = cost_model.trace_tick(model, sim)
+        closed, carry, out_shapes = cost_model.trace_tick(
+            model, sim, cache=trace_cache)
     except Exception as e:
         flag("JXP400", "ir-trace-failure",
              f"lowering the fused tick raised {type(e).__name__}: {e}")
         return findings, None
     report = cost_model.cost_of_jaxpr(closed, carry)
+    if trace_cache is not None:
+        # leave the report next to the shared trace so the lanes pass
+        # skips the duplicate byte walk in the combined gate
+        trace_cache[cost_model.entry_key(
+            getattr(model, "name", type(model).__name__),
+            node_count, layout) + "::cost"] = report
 
     # JXP401a: carry leaves outside the integer envelope. The traced
     # output carry (out_shapes[0]) is authoritative — it is what the
@@ -465,6 +473,11 @@ def compare_costs(live: Dict[str, CostReport],
     restricted audit never sees every key)."""
     tol = float(baseline.get("tolerance", cost_model.DEFAULT_TOLERANCE))
     entries = baseline.get("entries", {})
+    # the recorded toolchain: under a different jax version the lowered
+    # graphs legitimately differ, so drift downgrades from a hard
+    # COST501 failure to a self-explaining re-record warning
+    note = cost_model.toolchain_note(baseline.get("jax-version"),
+                                     "the cost baseline")
     findings: List[Finding] = []
     for key in sorted(live):
         rep = live[key]
@@ -494,11 +507,13 @@ def compare_costs(live: Dict[str, CostReport],
                 f"(+{(got / want - 1) * 100:.0f}%)"
                 for f, got, want in regressions)
             findings.append(_finding(
-                "COST501", "cost-regression", SEV_ERROR, path, symbol,
+                "COST501", "cost-regression",
+                SEV_WARNING if note else SEV_ERROR, path, symbol,
                 f"[{key}] tick cost regressed past the {tol:.0%} "
                 f"budget: {detail}{worst} — make the change cheaper, "
                 f"or re-baseline with --update-baseline and justify it "
-                f"in the PR", pass_name=PASS_COST))
+                f"in the PR" + (f" ({note})" if note else ""),
+                pass_name=PASS_COST))
         elif (rep.eqns < base.get("eqns", 0) * (1 - tol)
               and rep.hbm_bytes <= base.get("hbm-bytes-per-tick",
                                             rep.hbm_bytes)):
@@ -513,7 +528,8 @@ def compare_costs(live: Dict[str, CostReport],
                 "COST503", "cost-baseline-stale", SEV_WARNING,
                 "maelstrom_tpu/analysis/cost_baseline.json", "",
                 f"[{key}] baseline entry matches no registered "
-                f"model x layout — remove or re-record it",
+                f"model x layout — remove or re-record it"
+                + (f" ({note})" if note else ""),
                 pass_name=PASS_COST))
     return findings
 
@@ -538,7 +554,8 @@ def run_ir_lint(repo_root: str = ".", hazards: bool = True,
                 workloads: Optional[List[Tuple[str, int]]] = None,
                 layouts: Sequence[str] = cost_model.AUDIT_LAYOUTS,
                 include_fixtures: bool = True,
-                donation: bool = True) -> List[Finding]:
+                donation: bool = True,
+                trace_cache=None) -> List[Finding]:
     """Run the IR hazard pass and/or the cost gate.
 
     ``workloads=None`` audits the full registered universe (plus the IR
@@ -575,7 +592,8 @@ def run_ir_lint(repo_root: str = ".", hazards: bool = True,
             fs, report = audit_model_ir(
                 model, n, layout, label=f"{wl}/n={n}",
                 loop_budget=budgets.get(
-                    cost_model.entry_key(wl, n, layout)))
+                    cost_model.entry_key(wl, n, layout)),
+                trace_cache=trace_cache)
             if hazards:
                 findings.extend(fs)
             else:

@@ -1,0 +1,1610 @@
+"""Lane-liveness dataflow analyzer: ``maelstrom lint --lanes``.
+
+ROADMAP item 2 wants per-family lane-width specialization of the
+one-size-fits-all ``Msg``/carry (the r5 DRAM-bound regression: the Msg
+grew ~1.6x to carry all ten workload families and native throughput
+halved). Doing that refactor aggressively needs *static evidence* of
+which lanes each family actually touches. The existing passes audit
+hazards (TRC/CON/SCH/JXP) and cost (COST) — this one audits
+**liveness**: a backward dataflow slice over the traced tick jaxpr,
+from the tick's observable outputs (history events, telemetry, stats,
+violations, and the carry fixed point) back through
+slice/gather/scatter/``select_n``/index-update equations, resolving
+lane indices through the ``tpu/wire.py`` header constants and each
+model's dispatch-table constants baked into the IR.
+
+Per model x carry layout it computes:
+
+- the **live message-lane set** — which of the 9 header +
+  ``body_lanes`` body lanes are ever read on any reachable path;
+- the **live carry-leaf map** — per-leaf live/dead/carried
+  classification with byte attribution;
+- **dead stores** — body lanes written by the node/client/enqueue
+  phases but never read before being overwritten or dropped.
+
+The per-model result is serialized into the checked-in
+``analysis/lane_manifest.json`` (``--update-manifest`` re-records,
+drift fails the gate — the ``cost_baseline.json`` workflow), which
+doubles as the machine-readable input for the specialization PR: each
+entry carries ``live_body_lanes``, ``dead_bytes_per_tick_est``, and a
+projected narrow ``ir_bytes_est``.
+
+Rules (LNE6xx):
+
+=======  =======================  ========  ===============================
+rule     name                     severity  what it flags
+=======  =======================  ========  ===============================
+LNE600   lane-manifest-updated    info      ``--update-manifest`` rewrote
+                                            the manifest
+LNE601   dead-body-lane           warning   a declared body lane is never
+                                            read on any reachable path —
+                                            pure HBM/DRAM headroom for the
+                                            narrow-layout refactor
+LNE602   dead-carry-leaf          warning   a carry leaf feeds no
+                                            observable output (not even
+                                            through the carry fixed point)
+LNE603   dead-store               warning   a body lane is written but
+                                            never read before being
+                                            overwritten or dropped
+LNE604   lane-overread            error     a resolved lane index reaches
+                                            outside the model's declared
+                                            lane universe (silently clamps
+                                            under jit — reads the wrong
+                                            lane)
+LNE605   lane-unresolvable        warning   a lane index could not be
+                                            resolved statically — the
+                                            analysis fell back to
+                                            conservative all-live for the
+                                            model
+LNE606   lane-manifest-drift      error     the live lane set differs from
+                                            the checked-in manifest entry
+                                            (warning + a re-record hint
+                                            when the manifest was recorded
+                                            under a different jax version)
+LNE607   lane-manifest-missing    error     a registered model x layout
+                                            has no manifest entry
+LNE608   lane-manifest-stale      warning   a manifest entry matches no
+                                            registered model
+LNE609   lane-analysis-failure    error     ``get_model`` or the lane
+                                            analysis itself raised — the
+                                            model could not be audited at
+                                            all (distinct from LNE605's
+                                            in-model widening)
+=======  =======================  ========  ===============================
+
+Safety direction: the live set OVERAPPROXIMATES — every transfer rule
+either models an equation exactly or demands all lanes of its inputs,
+and any unresolvable lane index widens the whole model to all-live
+(LNE605). A lane the manifest calls dead is therefore *provably*
+unread under the audit config, which is what makes the manifest a
+safety proof the narrow-layout refactor can lean on
+(``tests/test_analysis_lanes.py`` pins the end-to-end version:
+narrowing a fixture model's ``body_lanes`` to its recorded live set
+leaves trajectories bit-identical in both carry layouts).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import (AbstractSet, Any, Dict, List, Optional, Sequence,
+                    Set, Tuple)
+
+import numpy as np
+
+from . import cost_model
+from .findings import Finding, SEV_ERROR, SEV_INFO, SEV_WARNING
+
+PASS_NAME = "lanes"
+
+DEFAULT_LANE_MANIFEST = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "lane_manifest.json")
+
+# demand lattice: NONE (absent) < mask (frozenset of lane ids) < FULL
+FULL = "full"
+CONFLICT = "conflict"
+
+# constant folding stays cheap: arrays above this size are never
+# materialized (lane-index operands are tiny — a few elements)
+_CONST_FOLD_MAX_ELEMS = 8192
+
+# elementwise primitives: same-shape operands and output share lane
+# coordinates exactly (jaxprs carry explicit broadcasts, so same-rank
+# operands of these really are aligned)
+_ELEMENTWISE = frozenset({
+    "add", "sub", "mul", "div", "rem", "max", "min", "and", "or", "xor",
+    "not", "neg", "sign", "abs", "eq", "ne", "lt", "le", "gt", "ge",
+    "select_n", "convert_element_type", "shift_left",
+    "shift_right_logical", "shift_right_arithmetic", "clamp",
+    "integer_pow", "pow", "exp", "log", "floor", "ceil", "round",
+    "square", "sqrt", "rsqrt", "logistic", "tanh", "erf", "is_finite",
+    "stop_gradient", "copy", "nextafter", "population_count", "clz",
+})
+
+_REDUCES = frozenset({"reduce_sum", "reduce_max", "reduce_min",
+                      "reduce_or", "reduce_and", "reduce_prod"})
+
+
+def _join(a, b):
+    """Demand-lattice join."""
+    if a is None:
+        return b
+    if b is None:
+        return a
+    if a == FULL or b == FULL:
+        return FULL
+    return a | b
+
+
+def _aval(v):
+    return getattr(v, "aval", None)
+
+
+def _shape(v) -> Tuple[int, ...]:
+    aval = _aval(v)
+    return tuple(getattr(aval, "shape", ()))
+
+
+def _is_var(v) -> bool:
+    # Literals have a .val; DropVars are Vars whose demand is meaningless
+    return not hasattr(v, "val")
+
+
+def _sub_closed(eqn):
+    """(name, ClosedJaxpr-or-Jaxpr) pairs nested in one equation."""
+    out = []
+    for k, v in eqn.params.items():
+        for sub in (v if isinstance(v, (list, tuple)) else (v,)):
+            if hasattr(sub, "eqns") or hasattr(getattr(sub, "jaxpr", None),
+                                               "eqns"):
+                out.append((k, sub))
+    return out
+
+
+def _inner_jaxpr(sub):
+    return sub.jaxpr if hasattr(sub, "jaxpr") else sub
+
+
+@dataclass
+class LaneReport:
+    """Liveness result for ONE model x layout."""
+    label: str
+    lanes: int                       # full lane universe (9 + body)
+    body_lanes: int
+    live_lanes: Set[int] = field(default_factory=set)
+    reads: Dict[int, Set[str]] = field(default_factory=dict)
+    writes: Dict[int, Set[str]] = field(default_factory=dict)
+    dead_stores: List[Tuple[int, str]] = field(default_factory=list)
+    overreads: List[Tuple[int, str]] = field(default_factory=list)
+    carry_leaves: Dict[str, Dict[str, Any]] = field(default_factory=dict)
+    conservative: bool = False       # LNE605 fallback fired
+    notes: List[str] = field(default_factory=list)
+    ir_bytes_est: int = 0
+    dead_bytes_est: int = 0
+
+    @property
+    def live_body_lanes(self) -> List[int]:
+        from ..tpu import wire
+        return sorted(l - wire.BODY for l in self.live_lanes
+                      if l >= wire.BODY)
+
+    @property
+    def dead_body_lanes(self) -> List[int]:
+        return sorted(set(range(self.body_lanes))
+                      - set(self.live_body_lanes))
+
+    @property
+    def live_header_lanes(self) -> List[int]:
+        from ..tpu import wire
+        return sorted(l for l in self.live_lanes if l < wire.BODY)
+
+    @property
+    def dead_carry_leaves(self) -> List[str]:
+        return sorted(p for p, e in self.carry_leaves.items()
+                      if e["status"] == "dead")
+
+    def to_entry(self) -> Dict[str, Any]:
+        """The checked-in manifest representation. Key names follow the
+        specialization contract (ROADMAP item 2): ``live_body_lanes``
+        is the narrow-layout target, ``dead_bytes_per_tick_est`` the
+        measured headroom, ``projected_narrow_ir_bytes_est`` the cost
+        model's estimate of the tick after the refactor."""
+        return {
+            "lanes": self.lanes,
+            "body_lanes": self.body_lanes,
+            "live_header_lanes": self.live_header_lanes,
+            "live_body_lanes": self.live_body_lanes,
+            "dead_body_lanes": self.dead_body_lanes,
+            "dead_carry_leaves": self.dead_carry_leaves,
+            "dead_stores": sorted({f"{lane}:{phase}"
+                                   for lane, phase in self.dead_stores}),
+            "resolution": ("conservative" if self.conservative
+                           else "exact"),
+            "ir_bytes_est": self.ir_bytes_est,
+            "dead_bytes_per_tick_est": self.dead_bytes_est,
+            "projected_narrow_ir_bytes_est":
+                self.ir_bytes_est - self.dead_bytes_est,
+        }
+
+
+class _Analyzer:
+    """One backward lane-liveness pass over one traced tick jaxpr.
+
+    Three cooperating fixpoints, all on finite lattices:
+
+    1. constant folding (forward, once): small integer arrays derivable
+       from literals/constvars — the lane-index operands of
+       gather/scatter/dynamic-slice equations;
+    2. lane-axis tagging (bidirectional, to fixpoint): which axis of
+       which intermediate is message-lane-shaped, seeded from the carry
+       pool leaf and propagated through structural equations both ways
+       (messages are *built* lanes-last from zeros and only meet the
+       pool at the enqueue select — forward-only tagging misses them);
+    3. demand propagation (backward, to fixpoint): per-var demand is
+       NONE, a set of live lanes, or FULL; the tick-level carry
+       feedback (out-leaf demand joins into in-leaf demand) closes the
+       "live = needed by any future tick's observables" loop.
+    """
+
+    def __init__(self, closed, n_lanes: int,
+                 lane_invars: Dict[int, int],
+                 phase_of=None):
+        self.closed = closed
+        self.L = n_lanes
+        self.tags: Dict[Any, Any] = {}           # Var -> axis | CONFLICT
+        self.demand: Dict[Any, Any] = {}         # Var -> None/mask/FULL
+        self.consts: Dict[Any, np.ndarray] = {}  # Var -> concrete value
+        # scan-body xs vars whose outer array is known: the set of
+        # values a per-trip slice can take (resolves BODY+i loops)
+        self.possible: Dict[Any, Set[int]] = {}
+        self.reads: Dict[int, Set[str]] = {}
+        self.writes: Dict[int, Set[str]] = {}
+        self.dead_stores: List[Tuple[int, str]] = []
+        self.overreads: List[Tuple[int, str]] = []
+        self.notes: List[str] = []
+        self.conservative = False
+        self._changed = False
+        self._record = False
+        self._phase_ctx: Optional[str] = None
+        self._phase_of = phase_of or cost_model._phase_of
+        for idx, axis in lane_invars.items():
+            self._set_tag(closed.jaxpr.invars[idx], axis)
+        for cv, cval in zip(closed.jaxpr.constvars, closed.consts):
+            self._remember_const(cv, cval)
+
+    # --- constant folding --------------------------------------------------
+
+    def _remember_const(self, var, val):
+        try:
+            arr = np.asarray(val)
+        except Exception:
+            return
+        if arr.size <= _CONST_FOLD_MAX_ELEMS and \
+                arr.dtype.kind in "iub":
+            self.consts[var] = arr
+
+    def _cval(self, v):
+        """Concrete value of an operand, if known."""
+        if hasattr(v, "val"):
+            try:
+                return np.asarray(v.val)
+            except Exception:
+                return None
+        return self.consts.get(v)
+
+    def fold_consts(self):
+        self._fold(self.closed.jaxpr)
+
+    def _fold(self, jaxpr):
+        for eqn in jaxpr.eqns:
+            name = eqn.primitive.name
+            vals = [self._cval(v) for v in eqn.invars]
+            out = None
+            try:
+                if name == "iota":
+                    shape = eqn.params["shape"]
+                    if int(np.prod(shape)) <= _CONST_FOLD_MAX_ELEMS:
+                        dim = eqn.params["dimension"]
+                        out = np.broadcast_to(
+                            np.arange(shape[dim]).reshape(
+                                [-1 if i == dim else 1
+                                 for i in range(len(shape))]),
+                            shape).astype(np.int64)
+                elif any(v is None for v in vals):
+                    out = None
+                elif name == "broadcast_in_dim":
+                    shape = eqn.params["shape"]
+                    if int(np.prod(shape)) <= _CONST_FOLD_MAX_ELEMS:
+                        bdims = eqn.params["broadcast_dimensions"]
+                        src = vals[0].reshape(
+                            [vals[0].shape[bdims.index(i)]
+                             if i in bdims else 1
+                             for i in range(len(shape))])
+                        out = np.broadcast_to(src, shape)
+                elif name == "concatenate":
+                    out = np.concatenate(vals,
+                                         axis=eqn.params["dimension"])
+                elif name == "convert_element_type":
+                    out = vals[0]
+                elif name == "add":
+                    out = vals[0] + vals[1]
+                elif name == "sub":
+                    out = vals[0] - vals[1]
+                elif name == "mul":
+                    out = vals[0] * vals[1]
+                elif name == "max":
+                    out = np.maximum(vals[0], vals[1])
+                elif name == "min":
+                    out = np.minimum(vals[0], vals[1])
+                elif name in ("eq", "ne", "lt", "le", "gt", "ge"):
+                    import operator
+                    out = {"eq": operator.eq, "ne": operator.ne,
+                           "lt": operator.lt, "le": operator.le,
+                           "gt": operator.gt,
+                           "ge": operator.ge}[name](vals[0], vals[1])
+                elif name == "select_n":
+                    # the clamp jnp indexing wraps around traced
+                    # indices: fold it so the lane value stays visible
+                    out = np.choose(vals[0].astype(np.int64),
+                                    vals[1:], mode="clip")
+                elif name == "rem":
+                    out = np.where(vals[1] == 0, 0,
+                                   np.fmod(vals[0], np.where(
+                                       vals[1] == 0, 1, vals[1])))
+                elif name == "reshape":
+                    out = vals[0].reshape(eqn.params["new_sizes"])
+                elif name == "squeeze":
+                    out = np.squeeze(
+                        vals[0], axis=tuple(eqn.params["dimensions"]))
+                elif name == "transpose":
+                    out = np.transpose(vals[0],
+                                       eqn.params["permutation"])
+                elif name == "slice":
+                    idx = tuple(
+                        slice(s, l, st) for s, l, st in zip(
+                            eqn.params["start_indices"],
+                            eqn.params["limit_indices"],
+                            eqn.params["strides"]
+                            or (1,) * len(eqn.params["start_indices"])))
+                    out = vals[0][idx]
+            except Exception:
+                out = None
+            if out is not None and len(eqn.outvars) == 1 \
+                    and _is_var(eqn.outvars[0]):
+                arr = np.asarray(out)
+                if arr.size <= _CONST_FOLD_MAX_ELEMS and \
+                        arr.dtype.kind in "iub":
+                    self.consts[eqn.outvars[0]] = arr
+            # recurse: pjit bodies see the operand consts; scan bodies
+            # see const operands plus per-trip value SETS for known xs
+            for _, sub in _sub_closed(eqn):
+                inner = _inner_jaxpr(sub)
+                if name == "pjit" and \
+                        len(inner.invars) == len(eqn.invars):
+                    for bv, val in zip(inner.invars, vals):
+                        if val is not None:
+                            self._remember_const(bv, val)
+                elif name == "scan":
+                    nc = eqn.params["num_consts"]
+                    ncar = eqn.params["num_carry"]
+                    for bv, val in zip(inner.invars[:nc], vals[:nc]):
+                        if val is not None:
+                            self._remember_const(bv, val)
+                    for k, bv in enumerate(inner.invars[nc + ncar:]):
+                        val = vals[nc + ncar + k]
+                        if val is not None and val.ndim >= 1:
+                            self.possible[bv] = \
+                                {int(x) for x in np.unique(val)}
+                for cv, cval in zip(getattr(inner, "constvars", ()),
+                                    getattr(sub, "consts", ())):
+                    self._remember_const(cv, cval)
+                self._fold(inner)
+                # propagate foldable pjit RESULTS back out — jnp's
+                # index clamping hides inside pjit(_where) bodies
+                if name == "pjit" and \
+                        len(inner.outvars) == len(eqn.outvars):
+                    for bo, oo in zip(inner.outvars, eqn.outvars):
+                        val = self._cval(bo)
+                        if val is not None and _is_var(oo):
+                            self.consts[oo] = val
+
+    def _resolve_lane_values(self, v) -> Optional[Set[int]]:
+        """The set of values a lane-index operand can take, or None."""
+        val = self._cval(v)
+        if val is not None:
+            return {int(x) for x in np.unique(val)}
+        if v in self.possible:
+            return set(self.possible[v])
+        return None
+
+    # --- lane-axis tagging -------------------------------------------------
+
+    def _set_tag(self, var, axis):
+        if not _is_var(var) or axis is None:
+            return
+        cur = self.tags.get(var)
+        if cur is None:
+            self.tags[var] = axis
+            self._changed = True
+        elif cur != axis:
+            if cur != CONFLICT:
+                self.tags[var] = CONFLICT
+                self._changed = True
+
+    def _tag(self, var):
+        t = self.tags.get(var) if _is_var(var) else None
+        return t if t != CONFLICT else None
+
+    def infer_tags(self, max_iters: int = 30):
+        for _ in range(max_iters):
+            self._changed = False
+            self._tag_walk(self.closed.jaxpr)
+            if not self._changed:
+                return
+        # a half-propagated tagging can narrow demand along a wrongly
+        # tagged axis, so non-convergence must widen like run_demand's
+        self.note("lane-axis tagging did not converge "
+                  f"in {max_iters} sweeps — results widened")
+        self.conservative = True
+
+    def _unify(self, a, b):
+        """Two vars share lane coordinates on the same axis."""
+        ta, tb = self._tag(a), self._tag(b)
+        if ta is not None:
+            self._set_tag(b, ta)
+        if tb is not None:
+            self._set_tag(a, tb)
+
+    def _unify_axis_map(self, src, dst, axis_map):
+        """src axis a ↔ dst axis axis_map[a] (dict, both directions)."""
+        ts = self._tag(src)
+        if ts is not None and ts in axis_map:
+            self._set_tag(dst, axis_map[ts])
+        td = self._tag(dst)
+        if td is not None:
+            inv = {v: k for k, v in axis_map.items()}
+            if td in inv:
+                self._set_tag(src, inv[td])
+
+    def _reshape_axis_map(self, in_shape, out_shape) -> Dict[int, int]:
+        """Axes preserved by a reshape: same dim size AND same trailing
+        element count (the unique axis-identity a reshape can keep)."""
+        def trailing(shape):
+            out, p = [], 1
+            for d in reversed(shape):
+                out.append(p)
+                p *= d
+            return list(reversed(out))
+        t_in, t_out = trailing(in_shape), trailing(out_shape)
+        amap = {}
+        for a, (da, ta) in enumerate(zip(in_shape, t_in)):
+            for b, (db, tb) in enumerate(zip(out_shape, t_out)):
+                if da == db and ta == tb:
+                    amap[a] = b
+                    break
+        return amap
+
+    def _tag_eqn(self, eqn):
+        name = eqn.primitive.name
+        invars, outvars = eqn.invars, eqn.outvars
+        if name in _ELEMENTWISE:
+            shp = _shape(outvars[0])
+            for v in invars:
+                if _shape(v) == shp:
+                    self._unify(v, outvars[0])
+        elif name == "broadcast_in_dim":
+            bdims = tuple(eqn.params["broadcast_dimensions"])
+            in_shape, out_shape = _shape(invars[0]), _shape(outvars[0])
+            amap = {a: b for a, b in enumerate(bdims)
+                    if in_shape[a] == out_shape[b]}
+            self._unify_axis_map(invars[0], outvars[0], amap)
+        elif name == "transpose":
+            perm = tuple(eqn.params["permutation"])
+            amap = {p: i for i, p in enumerate(perm)}
+            self._unify_axis_map(invars[0], outvars[0], amap)
+        elif name == "squeeze":
+            dims = set(int(d) for d in eqn.params["dimensions"])
+            amap, b = {}, 0
+            for a in range(len(_shape(invars[0]))):
+                if a not in dims:
+                    amap[a] = b
+                    b += 1
+            self._unify_axis_map(invars[0], outvars[0], amap)
+        elif name == "reshape":
+            if eqn.params.get("dimensions") is None:
+                amap = self._reshape_axis_map(_shape(invars[0]),
+                                              _shape(outvars[0]))
+                self._unify_axis_map(invars[0], outvars[0], amap)
+        elif name == "slice":
+            in_shape, out_shape = _shape(invars[0]), _shape(outvars[0])
+            amap = {a: a for a in range(len(in_shape))
+                    if in_shape[a] == out_shape[a]}
+            self._unify_axis_map(invars[0], outvars[0], amap)
+        elif name == "concatenate":
+            dim = int(eqn.params["dimension"])
+            for v in invars:
+                amap = {a: a for a in range(len(_shape(v)))
+                        if a != dim}
+                self._unify_axis_map(v, outvars[0], amap)
+        elif name in _REDUCES or name in ("argmax", "argmin"):
+            axes = set(int(a) for a in eqn.params.get("axes", ()))
+            amap, b = {}, 0
+            for a in range(len(_shape(invars[0]))):
+                if a not in axes:
+                    amap[a] = b
+                    b += 1
+            self._unify_axis_map(invars[0], outvars[0], amap)
+        elif name == "sort":
+            dim = int(eqn.params.get("dimension", -1))
+            for v, o in zip(invars, outvars):
+                amap = {a: a for a in range(len(_shape(v)))
+                        if a != dim}
+                self._unify_axis_map(v, o, amap)
+        elif name == "gather":
+            self._tag_gather(eqn)
+        elif name in ("scatter", "scatter-add", "scatter-mul",
+                      "scatter-min", "scatter-max"):
+            self._tag_scatter(eqn)
+        elif name == "dynamic_slice":
+            in_shape, out_shape = _shape(invars[0]), _shape(outvars[0])
+            amap = {a: a for a in range(len(in_shape))
+                    if in_shape[a] == out_shape[a]}
+            self._unify_axis_map(invars[0], outvars[0], amap)
+        elif name == "dynamic_update_slice":
+            self._unify(invars[0], outvars[0])
+            in_shape, up_shape = _shape(invars[0]), _shape(invars[1])
+            amap = {a: a for a in range(len(in_shape))
+                    if in_shape[a] == up_shape[a]}
+            self._unify_axis_map(invars[0], invars[1], amap)
+        elif name == "pjit":
+            for _, sub in _sub_closed(eqn):
+                inner = _inner_jaxpr(sub)
+                if len(inner.invars) == len(invars) and \
+                        len(inner.outvars) == len(outvars):
+                    for a, b in zip(invars, inner.invars):
+                        self._unify(a, b)
+                    for a, b in zip(outvars, inner.outvars):
+                        self._unify(a, b)
+                self._tag_walk(inner)
+        elif name == "scan":
+            self._tag_scan(eqn)
+        elif name == "cond":
+            for _, sub in _sub_closed(eqn):
+                inner = _inner_jaxpr(sub)
+                if len(inner.invars) == len(invars) - 1 and \
+                        len(inner.outvars) == len(outvars):
+                    for a, b in zip(invars[1:], inner.invars):
+                        self._unify(a, b)
+                    for a, b in zip(outvars, inner.outvars):
+                        self._unify(a, b)
+                self._tag_walk(inner)
+        else:
+            for _, sub in _sub_closed(eqn):
+                self._tag_walk(_inner_jaxpr(sub))
+
+    def _gather_offset_map(self, dnums, operand_rank) -> Dict[int, int]:
+        """operand axis -> output axis for window (offset) dims."""
+        collapsed = set(int(d) for d in dnums.collapsed_slice_dims)
+        batching = set(int(d) for d in
+                       getattr(dnums, "operand_batching_dims", ()))
+        offset_dims = tuple(int(d) for d in dnums.offset_dims)
+        amap, k = {}, 0
+        for a in range(operand_rank):
+            if a in collapsed or a in batching:
+                continue
+            if k < len(offset_dims):
+                amap[a] = offset_dims[k]
+            k += 1
+        return amap
+
+    def _tag_gather(self, eqn):
+        dnums = eqn.params["dimension_numbers"]
+        operand, out = eqn.invars[0], eqn.outvars[0]
+        slice_sizes = tuple(int(s) for s in eqn.params["slice_sizes"])
+        in_shape = _shape(operand)
+        amap = {a: b for a, b in self._gather_offset_map(
+            dnums, len(in_shape)).items()
+            if slice_sizes[a] == in_shape[a]}
+        self._unify_axis_map(operand, out, amap)
+
+    def _scatter_window_map(self, dnums, operand_rank) -> Dict[int, int]:
+        """operand axis -> updates axis for window dims."""
+        inserted = set(int(d) for d in dnums.inserted_window_dims)
+        batching = set(int(d) for d in
+                       getattr(dnums, "operand_batching_dims", ()))
+        window = tuple(int(d) for d in dnums.update_window_dims)
+        amap, k = {}, 0
+        for a in range(operand_rank):
+            if a in inserted or a in batching:
+                continue
+            if k < len(window):
+                amap[a] = window[k]
+            k += 1
+        return amap
+
+    def _tag_scatter(self, eqn):
+        operand, out = eqn.invars[0], eqn.outvars[0]
+        self._unify(operand, out)
+        dnums = eqn.params["dimension_numbers"]
+        in_shape, up_shape = _shape(operand), _shape(eqn.invars[2])
+        amap = {a: b for a, b in self._scatter_window_map(
+            dnums, len(in_shape)).items()
+            if b < len(up_shape) and up_shape[b] == in_shape[a]}
+        self._unify_axis_map(operand, eqn.invars[2], amap)
+
+    def _tag_scan(self, eqn):
+        for _, sub in _sub_closed(eqn):
+            inner = _inner_jaxpr(sub)
+            nc = eqn.params["num_consts"]
+            ncar = eqn.params["num_carry"]
+            invars, outvars = eqn.invars, eqn.outvars
+            # consts + carry align 1:1; xs/ys drop the leading scan axis
+            for a, b in zip(invars[:nc + ncar], inner.invars[:nc + ncar]):
+                self._unify(a, b)
+            for a, b in zip(outvars[:ncar], inner.outvars[:ncar]):
+                self._unify(a, b)
+            # carry in <-> carry out of the body share coordinates
+            for a, b in zip(inner.invars[nc:nc + ncar],
+                            inner.outvars[:ncar]):
+                self._unify(a, b)
+            for a, b in zip(invars[nc + ncar:], inner.invars[nc + ncar:]):
+                shp = _shape(a)
+                amap = {ax: ax - 1 for ax in range(1, len(shp))}
+                self._unify_axis_map(a, b, amap)
+            for a, b in zip(outvars[ncar:], inner.outvars[ncar:]):
+                shp = _shape(a)
+                amap = {ax: ax - 1 for ax in range(1, len(shp))}
+                self._unify_axis_map(a, b, amap)
+            self._tag_walk(inner)
+
+    def _tag_walk(self, jaxpr):
+        for eqn in jaxpr.eqns:
+            self._tag_eqn(eqn)
+
+    # --- backward demand ---------------------------------------------------
+
+    def note(self, msg: str):
+        if msg not in self.notes:
+            self.notes.append(msg)
+
+    def _get_demand(self, var):
+        if not _is_var(var):
+            return None
+        return self.demand.get(var)
+
+    def _add_demand(self, var, d):
+        if d is None or not _is_var(var) or \
+                type(var).__name__ == "DropVar":
+            return
+        cur = self.demand.get(var)
+        new = _join(cur, d)
+        if new != cur:
+            self.demand[var] = new
+            self._changed = True
+
+    def _record_read(self, lanes, eqn):
+        if not self._record:
+            return
+        phase = self._phase_ctx or self._phase_of(eqn)
+        for lane in lanes:
+            if lane >= self.L or lane < 0:
+                self.overreads.append((lane, phase))
+                lane = max(0, min(lane, self.L - 1))
+            self.reads.setdefault(lane, set()).add(phase)
+
+    def _record_write(self, lanes, eqn, dead):
+        if not self._record:
+            return
+        phase = self._phase_ctx or self._phase_of(eqn)
+        for lane in lanes:
+            if lane >= self.L or lane < 0:
+                self.overreads.append((lane, phase))
+                continue
+            self.writes.setdefault(lane, set()).add(phase)
+            if dead:
+                self.dead_stores.append((lane, phase))
+
+    def _demand_default(self, eqn, any_out):
+        d = FULL if any_out else None
+        for v in eqn.invars:
+            self._add_demand(v, d)
+
+    def _fallback_full(self, eqn, why: str):
+        """LNE605: an unresolvable lane access — all lanes conservatively
+        live, noted once per site kind."""
+        self.conservative = True
+        self.note(why)
+        for v in eqn.invars:
+            self._add_demand(v, FULL)
+
+    def run_demand(self, out_demands: List[Any],
+                   carry_pairs: Sequence[Tuple[int, int]],
+                   max_iters: int = 60):
+        """Backward fixpoint. ``out_demands`` aligns with
+        ``jaxpr.outvars``; ``carry_pairs`` are (outvar_idx, invar_idx)
+        feedback edges (demand on a carry input leaf joins into the
+        matching output leaf — the next tick needs it)."""
+        jaxpr = self.closed.jaxpr
+        for v, d in zip(jaxpr.outvars, out_demands):
+            self._add_demand(v, d)
+        for _ in range(max_iters):
+            self._changed = False
+            for out_i, in_i in carry_pairs:
+                self._add_demand(jaxpr.outvars[out_i],
+                                 self._get_demand(jaxpr.invars[in_i]))
+            self._demand_walk(jaxpr)
+            if not self._changed:
+                break
+        else:
+            self.note(f"demand propagation did not converge in "
+                      f"{max_iters} sweeps — results widened")
+            self.conservative = True
+        # one recording sweep at the fixpoint
+        self._record = True
+        self._demand_walk(jaxpr)
+        self._record = False
+
+    def _demand_walk(self, jaxpr):
+        outer = self._phase_ctx
+        for eqn in reversed(jaxpr.eqns):
+            self._phase_ctx = outer if outer is not None \
+                else self._phase_of(eqn)
+            self._demand_eqn(eqn)
+        self._phase_ctx = outer
+
+    def _demand_eqn(self, eqn):
+        name = eqn.primitive.name
+        invars, outvars = eqn.invars, eqn.outvars
+        outs = [self._get_demand(v) for v in outvars]
+        any_out = any(d is not None for d in outs)
+        if not any_out and name not in ("pjit", "scan", "cond", "while"):
+            return
+        d0 = outs[0] if outs else None
+
+        if name in _ELEMENTWISE:
+            shp = _shape(outvars[0])
+            for v in invars:
+                self._add_demand(v, d0 if _shape(v) == shp
+                                 else (FULL if d0 is not None else None))
+        elif name in ("broadcast_in_dim", "transpose", "squeeze",
+                      "reshape", "sort", "rev"):
+            # lane coordinates survive exactly when the tagger connected
+            # in and out; a masked demand otherwise widens
+            if name == "sort":
+                for v, o in zip(invars, outvars):
+                    dd = self._get_demand(o)
+                    if dd is None:
+                        continue
+                    if isinstance(dd, frozenset) and (
+                            self._tag(v) is None or self._tag(o) is None):
+                        dd = FULL
+                    self._add_demand(v, dd)
+            else:
+                dd = d0
+                if isinstance(dd, frozenset) and (
+                        self._tag(invars[0]) is None
+                        or self._tag(outvars[0]) is None):
+                    dd = FULL
+                self._add_demand(invars[0], dd)
+        elif name == "concatenate":
+            dim = int(eqn.params["dimension"])
+            for v in invars:
+                dd = d0
+                if isinstance(dd, frozenset):
+                    tv = self._tag(v)
+                    if tv is None or tv == dim:
+                        dd = FULL
+                self._add_demand(v, dd)
+        elif name in _REDUCES or name in ("argmax", "argmin"):
+            axes = set(int(a) for a in eqn.params.get("axes", ()))
+            t_in = self._tag(invars[0])
+            dd = d0 if (isinstance(d0, frozenset)
+                        and t_in is not None
+                        and t_in not in axes) else \
+                (FULL if any_out else None)
+            self._add_demand(invars[0], dd)
+        elif name == "slice":
+            self._demand_slice(eqn, d0)
+        elif name == "dynamic_slice":
+            self._demand_dynamic_slice(eqn, d0)
+        elif name == "gather":
+            self._demand_gather(eqn, d0)
+        elif name in ("scatter", "scatter-add", "scatter-mul",
+                      "scatter-min", "scatter-max"):
+            self._demand_scatter(eqn, d0, rmw=name != "scatter")
+        elif name == "dynamic_update_slice":
+            self._demand_dus(eqn, d0)
+        elif name == "pjit":
+            subs = _sub_closed(eqn)
+            ok = False
+            for _, sub in subs:
+                inner = _inner_jaxpr(sub)
+                if len(inner.invars) == len(invars) and \
+                        len(inner.outvars) == len(outvars):
+                    for bo, d in zip(inner.outvars, outs):
+                        self._add_demand(bo, d)
+                    self._demand_walk(inner)
+                    for v, bv in zip(invars, inner.invars):
+                        self._add_demand(v, self._get_demand(bv))
+                    ok = True
+            if not ok and any_out:
+                self._demand_default(eqn, any_out)
+        elif name == "scan":
+            self._demand_scan(eqn, outs)
+        elif name == "cond":
+            branches = [_inner_jaxpr(s) for _, s in _sub_closed(eqn)]
+            fit = [b for b in branches
+                   if len(b.invars) == len(invars) - 1
+                   and len(b.outvars) == len(outvars)]
+            if fit and len(fit) == len(branches):
+                self._add_demand(invars[0],
+                                 FULL if any_out else None)
+                for b in branches:
+                    for bo, d in zip(b.outvars, outs):
+                        self._add_demand(bo, d)
+                    self._demand_walk(b)
+                    for v, bv in zip(invars[1:], b.invars):
+                        self._add_demand(v, self._get_demand(bv))
+            else:
+                for b in branches:
+                    for bo in b.outvars:
+                        self._add_demand(bo, FULL if any_out else None)
+                    self._demand_walk(b)
+                self._demand_default(eqn, any_out)
+        elif name == "while":
+            # no whiles in honest ticks (JXP404 polices them); any lane
+            # array crossing one is conservatively all-live
+            for _, sub in _sub_closed(eqn):
+                inner = _inner_jaxpr(sub)
+                for bo in inner.outvars:
+                    self._add_demand(bo, FULL if any_out else None)
+                self._demand_walk(inner)
+            if any(self._tag(v) is not None for v in invars) and any_out:
+                self._fallback_full(
+                    eqn, "a lane-tagged array crosses a while_loop — "
+                         "conservative all-live")
+            else:
+                self._demand_default(eqn, any_out)
+        else:
+            if any(self._tag(v) is not None for v in invars) and \
+                    any_out and name not in (
+                        "random_wrap", "random_unwrap", "random_bits",
+                        "random_fold_in", "random_split",
+                        "bitcast_convert_type", "top_k"):
+                # an unmodeled primitive consuming a lane array: every
+                # lane must be assumed read
+                self._fallback_full(
+                    eqn, f"unmodeled primitive '{name}' consumes a "
+                         f"lane-tagged array — conservative all-live")
+                if self._record:
+                    self._record_read(range(self.L), eqn)
+                return
+            self._demand_default(eqn, any_out)
+
+    # -- lane-precise transfer functions --
+
+    def _demand_slice(self, eqn, d0):
+        operand = eqn.invars[0]
+        t = self._tag(operand)
+        in_shape, out_shape = _shape(operand), _shape(eqn.outvars[0])
+        if t is None or d0 is None:
+            self._add_demand(operand,
+                             FULL if d0 is not None else None)
+            return
+        start = eqn.params["start_indices"][t]
+        limit = eqn.params["limit_indices"][t]
+        stride = (eqn.params["strides"] or
+                  (1,) * len(in_shape))[t]
+        if (start, limit, stride) == (0, in_shape[t], 1):
+            self._add_demand(operand, d0)     # lane axis untouched
+            return
+        window = frozenset(range(start, limit, stride))
+        if isinstance(d0, frozenset) and self._tag(eqn.outvars[0]) == t:
+            # narrowed but still tagged: demand maps straight through
+            self._add_demand(operand, d0 & window or frozenset())
+            lanes = d0 & window
+        else:
+            self._add_demand(operand, window)
+            lanes = window
+        self._record_read(sorted(lanes), eqn)
+
+    def _demand_dynamic_slice(self, eqn, d0):
+        operand = eqn.invars[0]
+        t = self._tag(operand)
+        in_shape = _shape(operand)
+        out_shape = _shape(eqn.outvars[0])
+        if t is None or d0 is None:
+            self._demand_default(eqn, d0 is not None)
+            return
+        size = out_shape[t]
+        for v in eqn.invars[1:]:
+            self._add_demand(v, FULL)
+        if size == in_shape[t]:
+            self._add_demand(operand, d0)
+            return
+        idx = self._resolve_lane_values(eqn.invars[1 + t])
+        if idx is None:
+            self._fallback_full(
+                eqn, "dynamic_slice along the lane axis with an "
+                     "unresolvable start index — conservative all-live")
+            self._record_read(range(self.L), eqn)
+            return
+        lanes = set()
+        for i in idx:
+            i = max(0, min(int(i), in_shape[t] - size))  # XLA clamps
+            lanes.update(range(i, i + size))
+        # a start whose (unclamped) window leaves the lane universe is
+        # an overread: surface the extreme lane it aimed at
+        over = sorted(v if v < 0 else v + size - 1 for v in idx
+                      if not 0 <= v <= in_shape[t] - size)
+        self._add_demand(operand, frozenset(lanes))
+        self._record_read(over + sorted(lanes), eqn)
+
+    def _demand_gather(self, eqn, d0):
+        operand, indices = eqn.invars[0], eqn.invars[1]
+        t = self._tag(operand)
+        if t is None or d0 is None:
+            self._demand_default(eqn, d0 is not None)
+            return
+        dnums = eqn.params["dimension_numbers"]
+        slice_sizes = tuple(int(s) for s in eqn.params["slice_sizes"])
+        in_shape = _shape(operand)
+        self._add_demand(indices, FULL)
+        if slice_sizes[t] == in_shape[t]:
+            # lane axis rides the window whole: demand passes through
+            dd = d0
+            if isinstance(dd, frozenset) and \
+                    self._tag(eqn.outvars[0]) is None:
+                dd = FULL
+            self._add_demand(operand, dd)
+            return
+        start_map = tuple(int(d) for d in dnums.start_index_map)
+        if t in start_map:
+            # lane-indexed gather (a vmapped dynamic_slice along the
+            # lane axis lowers here too): resolve the lane column,
+            # widen by the window size
+            col = start_map.index(t)
+            vals = self._resolve_lane_values(indices)
+            if vals is None:
+                self._fallback_full(
+                    eqn, "gather along the lane axis with an "
+                         "unresolvable index — conservative all-live")
+                self._record_read(range(self.L), eqn)
+                return
+            col_exact = len(start_map) == 1
+            if not col_exact:
+                # the index array interleaves columns for several
+                # axes; per-column resolution needs the raw array
+                arr = self._cval(indices)
+                if arr is not None and arr.ndim >= 1 and \
+                        arr.shape[-1] == len(start_map):
+                    vals = {int(x) for x in
+                            np.unique(arr[..., col])}
+                    col_exact = True
+                # else: the unioned value set stays — overapproximate,
+                # fine for liveness but too coarse for the
+                # error-severity overread check (other columns' values
+                # are not lane starts)
+            w = slice_sizes[t]
+            lanes_raw: Set[int] = set()
+            for v in vals:
+                # XLA clamps the start so the window stays in bounds
+                v = max(0, min(int(v), in_shape[t] - w))
+                lanes_raw.update(range(v, v + w))
+            # a start whose (unclamped) window leaves the lane universe
+            # is an overread: surface the extreme lane it aimed at
+            over = sorted(v if v < 0 else v + w - 1 for v in vals
+                          if not 0 <= v <= in_shape[t] - w) \
+                if col_exact else []
+            self._record_read(over + sorted(lanes_raw), eqn)
+            self._add_demand(operand, frozenset(lanes_raw))
+            return
+        self._fallback_full(
+            eqn, "gather takes a partial lane window — "
+                 "conservative all-live")
+        self._record_read(range(self.L), eqn)
+
+    def _resolve_scatter_columns(self, eqn, dnums
+                                 ) -> Optional[Dict[int, Set[int]]]:
+        """operand axis -> set of written indices, for scattered dims."""
+        indices = eqn.invars[1]
+        arr = self._cval(indices)
+        if arr is None:
+            return None
+        sdims = tuple(int(d) for d in dnums.scatter_dims_to_operand_dims)
+        if arr.ndim == 0:
+            arr = arr.reshape(1, 1)
+        if arr.shape[-1] != len(sdims):
+            if len(sdims) == 1:
+                arr = arr.reshape(-1, 1)
+            else:
+                return None
+        flat = arr.reshape(-1, len(sdims))
+        return {axis: {int(x) for x in np.unique(flat[:, k])}
+                for k, axis in enumerate(sdims)}
+
+    def _demand_scatter(self, eqn, d0, rmw: bool):
+        operand, indices, updates = eqn.invars[:3]
+        t = self._tag(operand)
+        if t is None or d0 is None:
+            self._demand_default(eqn, d0 is not None)
+            return
+        self._add_demand(indices, FULL)
+        dnums = eqn.params["dimension_numbers"]
+        in_shape, up_shape = _shape(operand), _shape(updates)
+        window_map = self._scatter_window_map(dnums, len(in_shape))
+        inserted = set(int(d) for d in dnums.inserted_window_dims)
+        if t in window_map:
+            # lane axis rides the update window
+            if up_shape[window_map[t]] == in_shape[t]:
+                dd = d0
+                if isinstance(dd, frozenset) and \
+                        self._tag(updates) is None:
+                    dd = FULL
+                self._add_demand(updates, dd)
+                self._add_demand(operand, d0)
+                return
+            # partial window (a slice-set like ``.at[0, BODY:BODY+2]``):
+            # the window's lane start rides the scatter indices when
+            # the lane axis is a scattered dim, else it pins to 0
+            cols = self._resolve_scatter_columns(eqn, dnums)
+            sdims = tuple(int(d)
+                          for d in dnums.scatter_dims_to_operand_dims)
+            if t not in sdims:
+                cols = dict(cols or {})
+                cols[t] = {0}
+            w = up_shape[window_map[t]]
+            if cols is not None and t in cols:
+                window: Set[int] = set()
+                for v in cols[t]:
+                    v = max(0, min(int(v), in_shape[t] - w))
+                    window.update(range(v, v + w))
+                in_range = frozenset(window)
+                full_cover = len(cols[t]) == 1 and all(
+                    up_shape[b] == in_shape[a]
+                    for a, b in window_map.items() if a != t) and all(
+                    cols.get(a) == set(range(in_shape[a]))
+                    for a in inserted)
+                demanded = (d0 if d0 == FULL
+                            else frozenset(d0) & in_range)
+                dead = demanded is not FULL and not demanded
+                self._record_write(sorted(window), eqn,
+                                   dead=dead and not rmw)
+                self._add_demand(updates, None if dead else FULL)
+                if not rmw and full_cover and isinstance(d0, frozenset):
+                    self._add_demand(operand, d0 - in_range)
+                else:
+                    self._add_demand(operand, d0)
+                return
+            self._fallback_full(
+                eqn, "scatter writes a partial lane window with an "
+                     "unresolvable start — conservative all-live")
+            return
+        if t not in inserted:
+            # lane axis is an operand batching dim — nothing narrows
+            self._add_demand(updates,
+                             FULL if d0 is not None else None)
+            self._add_demand(operand, d0)
+            return
+        cols = self._resolve_scatter_columns(eqn, dnums)
+        if cols is None or t not in cols:
+            self.note("scatter along the lane axis with unresolvable "
+                      "indices — no dead-store credit taken")
+            self._add_demand(updates, FULL)
+            self._add_demand(operand, d0)
+            return
+        written = {w for w in cols[t]}
+        in_range = frozenset(w for w in written
+                             if 0 <= w < in_shape[t])
+        # full coverage on every other axis = the write kills the lane:
+        # window axes must span the operand, other scattered axes must
+        # enumerate their full range
+        full_cover = all(
+            up_shape[b] == in_shape[a]
+            for a, b in window_map.items() if a != t) and all(
+            a == t or cols.get(a) == set(range(in_shape[a]))
+            for a in inserted)
+        demanded = (d0 if d0 == FULL
+                    else frozenset(d0) & in_range)
+        dead = demanded is not FULL and not demanded
+        self._record_write(sorted(written), eqn, dead=dead and not rmw)
+        self._add_demand(updates, None if dead else FULL)
+        if not rmw and full_cover and isinstance(d0, frozenset):
+            self._add_demand(operand, d0 - in_range)
+        else:
+            self._add_demand(operand, d0)
+
+    def _demand_dus(self, eqn, d0):
+        operand, update = eqn.invars[0], eqn.invars[1]
+        t = self._tag(operand)
+        if t is None or d0 is None:
+            self._demand_default(eqn, d0 is not None)
+            return
+        in_shape, up_shape = _shape(operand), _shape(update)
+        for v in eqn.invars[2:]:
+            self._add_demand(v, FULL)
+        if up_shape[t] == in_shape[t]:
+            dd = d0
+            if isinstance(dd, frozenset) and self._tag(update) is None:
+                dd = FULL
+            self._add_demand(update, dd)
+            self._add_demand(operand, d0)
+            return
+        idx = self._resolve_lane_values(eqn.invars[2 + t])
+        if idx is None:
+            self.note("dynamic_update_slice along the lane axis with "
+                      "an unresolvable start — no dead-store credit "
+                      "taken")
+            self._add_demand(update, FULL)
+            self._add_demand(operand, d0)
+            return
+        window = set()
+        for i in idx:
+            i = max(0, min(int(i), in_shape[t] - up_shape[t]))
+            window.update(range(i, i + up_shape[t]))
+        window = frozenset(window)
+        full_cover = all(up_shape[a] == in_shape[a]
+                         for a in range(len(in_shape)) if a != t)
+        demanded = d0 if d0 == FULL else frozenset(d0) & window
+        dead = demanded is not FULL and not demanded
+        self._record_write(sorted(window), eqn, dead=dead)
+        self._add_demand(update, None if dead else FULL)
+        if full_cover and isinstance(d0, frozenset) and len(idx) == 1:
+            self._add_demand(operand, d0 - window)
+        else:
+            self._add_demand(operand, d0)
+
+    def _demand_scan(self, eqn, outs):
+        nc = eqn.params["num_consts"]
+        ncar = eqn.params["num_carry"]
+        invars, outvars = eqn.invars, eqn.outvars
+        for _, sub in _sub_closed(eqn):
+            inner = _inner_jaxpr(sub)
+            # seed body outs: final-carry + ys demand (lane masks pass:
+            # the tagger aligned coordinates across the scan axis)
+            for k in range(ncar):
+                self._add_demand(inner.outvars[k], outs[k])
+            for k in range(len(outvars) - ncar):
+                d = outs[ncar + k]
+                bo = inner.outvars[ncar + k]
+                if isinstance(d, frozenset) and (
+                        self._tag(outvars[ncar + k]) is None
+                        or self._tag(bo) is None):
+                    d = FULL
+                self._add_demand(bo, d)
+            # inner fixpoint: carry-in demand feeds carry-out
+            for _ in range(40):
+                before = self._snapshot(inner)
+                for k in range(ncar):
+                    self._add_demand(inner.outvars[k],
+                                     self._get_demand(
+                                         inner.invars[nc + k]))
+                self._demand_walk(inner)
+                if self._snapshot(inner) == before:
+                    break
+            # eqn inputs from body inputs
+            for k in range(nc):
+                self._add_demand(invars[k],
+                                 self._get_demand(inner.invars[k]))
+            for k in range(ncar):
+                self._add_demand(invars[nc + k],
+                                 self._get_demand(
+                                     inner.invars[nc + k]))
+            for k in range(len(invars) - nc - ncar):
+                d = self._get_demand(inner.invars[nc + ncar + k])
+                xv = invars[nc + ncar + k]
+                if isinstance(d, frozenset) and (
+                        self._tag(xv) is None or
+                        self._tag(inner.invars[nc + ncar + k]) is None):
+                    d = FULL
+                self._add_demand(xv, d)
+
+    def _snapshot(self, jaxpr):
+        return tuple(self.demand.get(v)
+                     for v in list(jaxpr.invars) + list(jaxpr.outvars))
+
+
+# --- per-model analysis ----------------------------------------------------
+
+
+def _carry_paths(carry) -> List[str]:
+    import jax
+    return [jax.tree_util.keystr(kp) for kp, _ in
+            jax.tree_util.tree_flatten_with_path(carry)[0]]
+
+
+def _pool_lane_axis(layout: str, pool_shape: Tuple[int, ...],
+                    n_lanes: int) -> int:
+    axis = 1 if layout == "minor" else len(pool_shape) - 1
+    if pool_shape[axis] != n_lanes:
+        raise ValueError(
+            f"pool leaf {pool_shape} does not carry {n_lanes} lanes at "
+            f"axis {axis} (layout={layout!r})")
+    return axis
+
+
+# carry fields that are observables in their own right: fetched and
+# reported by the harness after every run, so their demand is
+# unconditional (everything else earns its liveness through the carry
+# fixed point)
+_OBSERVED_CARRY_FIELDS = ("stats", "violations", "telemetry", "key")
+
+
+def analyze_model(model, node_count: int, layout: str = "lead",
+                  label: Optional[str] = None, sim=None,
+                  traced=None, cost=None,
+                  trace_cache=None) -> LaneReport:
+    """Run the lane-liveness slice for one model x layout. ``sim``
+    overrides the shared audit config (bench.py passes its own, so the
+    metric line prices the configuration it measures). ``traced`` (a
+    ``cost_model.trace_tick`` triple) and ``cost`` (its
+    ``cost_of_jaxpr`` report) let callers that already traced the SAME
+    model x sim skip the duplicate abstract trace / cost walk."""
+    import jax
+
+    if sim is not None:
+        # a caller-supplied sim changes the tick graph, but the shared
+        # cache is keyed by (name, n, layout) from audit sims only —
+        # never mix the two
+        layout = sim.layout
+        trace_cache = None
+    label = label or f"{getattr(model, 'name', type(model).__name__)}" \
+                     f"/{layout}"
+    if sim is None:
+        sim = cost_model.audit_sim(model, node_count, layout)
+    closed, carry, out_shapes = traced or cost_model.trace_tick(
+        model, sim, cache=trace_cache)
+    n_lanes = sim.net.lanes
+    carry_leaves = jax.tree_util.tree_leaves(carry)
+    paths = _carry_paths(carry)
+    n_carry = len(carry_leaves)
+
+    pool_idx = paths.index(".pool")
+    lane_axis = _pool_lane_axis(layout, carry_leaves[pool_idx].shape,
+                                n_lanes)
+    ana = _Analyzer(closed, n_lanes, {pool_idx: lane_axis})
+    ana.fold_consts()
+    ana.infer_tags()
+
+    # observable seeding: ys (history events / journal rows) are FULL;
+    # observed carry fields are FULL; the rest starts dead and earns
+    # demand through the feedback edges
+    n_out = len(closed.jaxpr.outvars)
+    out_demands: List[Any] = [None] * n_out
+    for i, p in enumerate(paths):
+        field_name = p.split(".")[1].split("[")[0] if "." in p else p
+        if field_name in _OBSERVED_CARRY_FIELDS:
+            out_demands[i] = FULL
+    for i in range(n_carry, n_out):
+        out_demands[i] = FULL
+    carry_pairs = [(i, i) for i in range(n_carry)]
+    ana.run_demand(out_demands, carry_pairs)
+
+    # live lanes = the carry pool's demand at the fixpoint, plus every
+    # recorded read site (reads of rows that never reach the pool)
+    pool_demand = ana.demand.get(closed.jaxpr.invars[pool_idx])
+    live: Set[int] = set(ana.reads)
+    if pool_demand == FULL:
+        ana.conservative = True
+        ana.note("the message pool's demand widened to all lanes")
+        live = set(range(n_lanes))
+    elif isinstance(pool_demand, frozenset):
+        live |= set(pool_demand)
+    if ana.conservative:
+        live = set(range(n_lanes))
+
+    report = LaneReport(label=label, lanes=n_lanes,
+                        body_lanes=model.body_lanes,
+                        live_lanes=live,
+                        reads={k: set(v) for k, v in ana.reads.items()},
+                        writes={k: set(v) for k, v in ana.writes.items()},
+                        dead_stores=sorted(set(ana.dead_stores)),
+                        overreads=sorted(set(ana.overreads)),
+                        conservative=ana.conservative,
+                        notes=list(ana.notes))
+
+    # per-leaf classification + byte attribution
+    for i, (p, leaf) in enumerate(zip(paths, carry_leaves)):
+        nbytes = int(np.prod(leaf.shape or (1,))) * leaf.dtype.itemsize
+        d = ana.demand.get(closed.jaxpr.invars[i])
+        outvar = closed.jaxpr.outvars[i]
+        written = outvar is not closed.jaxpr.invars[i]
+        status = "dead" if d is None else \
+            ("live" if written else "carried")
+        report.carry_leaves[p] = {"status": status, "bytes": nbytes}
+
+    # dead-byte attribution: every lane-tagged intermediate pays for
+    # its dead lanes, scan bodies trip-weighted — the exact accounting
+    # ir_bytes_est uses, so the two subtract meaningfully
+    dead_lanes = set(range(n_lanes)) - live
+    if cost is None and trace_cache is not None:
+        # the ir/cost pass ran first in the combined gate and left its
+        # report next to the shared trace
+        cost = trace_cache.get(cost_model.entry_key(
+            getattr(model, "name", type(model).__name__),
+            sim.net.n_nodes, sim.layout) + "::cost")
+    cost = cost or cost_model.cost_of_jaxpr(closed, carry)
+    report.ir_bytes_est = cost.hbm_bytes
+    dead_frac = len(dead_lanes) / n_lanes
+    dead_bytes = 0.0
+    if dead_frac:
+        def walk(jaxpr, mult):
+            nonlocal dead_bytes
+            for eqn in jaxpr.eqns:
+                for v in eqn.outvars:
+                    if ana._tag(v) is not None and \
+                            _shape(v)[ana._tag(v)] == n_lanes:
+                        dead_bytes += cost_model._aval_bytes(v) \
+                            * dead_frac * mult
+                for sub, sub_mult in cost_model._sub_jaxprs(eqn):
+                    walk(sub, mult * sub_mult)
+        walk(closed.jaxpr, 1)
+    # dead carry leaves are pure headroom too
+    dead_bytes += sum(e["bytes"] for e in report.carry_leaves.values()
+                      if e["status"] == "dead")
+    report.dead_bytes_est = int(dead_bytes)
+    return report
+
+
+# --- findings --------------------------------------------------------------
+
+
+def _model_path(model) -> str:
+    return type(model).__module__.replace(".", os.sep) + ".py"
+
+
+def _finding(rule, name, severity, path, symbol, message) -> Finding:
+    return Finding(rule=rule, name=name, severity=severity,
+                   pass_name=PASS_NAME, path=path, line=0,
+                   symbol=symbol, message=message)
+
+
+def findings_of_report(model, report: LaneReport) -> List[Finding]:
+    """LNE601-LNE605 from one model's liveness result."""
+    path = _model_path(model)
+    cls = type(model).__name__
+    out: List[Finding] = []
+
+    def flag(rule, name, message, severity):
+        out.append(_finding(rule, name, severity, path, cls,
+                            f"[{report.label}] {message}"))
+
+    for lane, phase in sorted(set(report.overreads)):
+        flag("LNE604", "lane-overread",
+             f"a resolved lane index reaches lane {lane}, outside the "
+             f"declared universe of {report.lanes} lanes "
+             f"(9 header + body_lanes={report.body_lanes}) — under jit "
+             f"the access silently clamps to lane {report.lanes - 1} "
+             f"and reads/writes the wrong lane ({phase} phase)",
+             SEV_ERROR)
+    if report.conservative:
+        flag("LNE605", "lane-unresolvable",
+             "a lane index could not be resolved statically — the "
+             "model is conservatively ALL-LIVE (no dead-lane credit); "
+             + "; ".join(report.notes[:3]), SEV_WARNING)
+        return out
+    if report.dead_body_lanes:
+        flag("LNE601", "dead-body-lane",
+             f"body lane(s) {report.dead_body_lanes} of "
+             f"{report.body_lanes} are never read on any reachable "
+             f"path — ~{report.dead_bytes_est} B/tick of dead lane "
+             f"traffic; narrowing body_lanes to the live set "
+             f"{report.live_body_lanes} is trajectory-preserving "
+             f"(ROADMAP item 2 headroom)", SEV_WARNING)
+    for leaf in report.dead_carry_leaves:
+        flag("LNE602", "dead-carry-leaf",
+             f"carry leaf {leaf} "
+             f"({report.carry_leaves[leaf]['bytes']} B) feeds no "
+             f"observable output — not even through the carry fixed "
+             f"point; it is pure HBM ballast", SEV_WARNING)
+    dead_stores = sorted({(lane, phase)
+                          for lane, phase in report.dead_stores})
+    from ..tpu import wire
+    body_dead = [(lane, phase) for lane, phase in dead_stores
+                 if lane >= wire.BODY]
+    if body_dead:
+        detail = ", ".join(f"lane {lane} ({phase})"
+                           for lane, phase in body_dead[:6])
+        flag("LNE603", "dead-store",
+             f"body lane store(s) never read before being overwritten "
+             f"or dropped: {detail} — wasted writes the narrow layout "
+             f"would delete", SEV_WARNING)
+    return out
+
+
+# --- manifest io + drift gate ----------------------------------------------
+
+
+def load_lane_manifest(path: Optional[str] = None) -> Dict[str, Any]:
+    path = path or DEFAULT_LANE_MANIFEST
+    if not os.path.exists(path):
+        return {"version": 1, "entries": {}}
+    with open(path) as f:
+        data = json.load(f)
+    data.setdefault("entries", {})
+    return data
+
+
+def save_lane_manifest(entries: Dict[str, Dict[str, Any]],
+                       path: Optional[str] = None) -> str:
+    import jax
+    path = path or DEFAULT_LANE_MANIFEST
+    payload = {
+        "version": 1,
+        "_comment": (
+            "Per-model live-lane manifest for `maelstrom lint --lanes` "
+            "(doc/lint.md). Keys: <workload>/n=<nodes>/<layout>; "
+            "live_body_lanes = body lanes provably read on some "
+            "reachable path of the tick under the audit config (the "
+            "safe narrow-layout target), dead_bytes_per_tick_est = "
+            "estimated HBM bytes/tick moved for dead lanes + dead "
+            "carry leaves, projected_narrow_ir_bytes_est = ir_bytes_est "
+            "minus that headroom. Regenerate after an INTENTIONAL "
+            "lane-vocabulary change with `maelstrom lint --lanes "
+            "--update-manifest`; live-set drift fails the gate "
+            "(LNE606)."),
+        "jax-version": jax.__version__,
+        "entries": {k: entries[k] for k in sorted(entries)},
+    }
+    with open(path, "w") as f:
+        json.dump(payload, f, indent=2)
+        f.write("\n")
+    return path
+
+
+def compare_manifest(live: Dict[str, LaneReport],
+                     manifest: Dict[str, Any],
+                     paths: Dict[str, Tuple[str, str]],
+                     full_universe: bool = True,
+                     errored: AbstractSet[str] = frozenset(),
+                     ) -> List[Finding]:
+    """Diff live lane reports against the checked-in manifest. The gate
+    compares the LANE SETS (the safety-relevant fact); byte estimates
+    are informational and re-recorded with --update-manifest.
+    ``errored`` keys failed to analyze this run (they already carry an
+    LNE609 error) — their manifest entries are NOT stale, so they are
+    exempt from LNE608's remove-or-re-record advice."""
+    entries = manifest.get("entries", {})
+    note = cost_model.toolchain_note(manifest.get("jax-version"),
+                                     "lane manifest",
+                                     "--update-manifest")
+    findings: List[Finding] = []
+    for key in sorted(live):
+        rep = live[key]
+        path, symbol = paths[key]
+        base = entries.get(key)
+        if base is None:
+            findings.append(_finding(
+                "LNE607", "lane-manifest-missing", SEV_ERROR, path,
+                symbol,
+                f"[{key}] no lane-manifest entry — record one with "
+                f"`maelstrom lint --lanes --update-manifest`"))
+            continue
+        drifts = []
+        for field_name, got in (
+                ("live_body_lanes", rep.live_body_lanes),
+                ("live_header_lanes", rep.live_header_lanes),
+                ("resolution", "conservative" if rep.conservative
+                 else "exact")):
+            want = base.get(field_name)
+            if want is not None and want != got:
+                drifts.append(f"{field_name}: live {got} vs manifest "
+                              f"{want}")
+        if drifts:
+            findings.append(_finding(
+                "LNE606", "lane-manifest-drift",
+                SEV_WARNING if note else SEV_ERROR, path, symbol,
+                f"[{key}] live lane set drifted from the checked-in "
+                f"manifest: {'; '.join(drifts)} — a lane went "
+                f"live/dead; if intentional, re-record with "
+                f"--update-manifest and justify it in the PR"
+                + (f" ({note})" if note else "")))
+    if full_universe:
+        for key in sorted(set(entries) - set(live) - set(errored)):
+            findings.append(_finding(
+                "LNE608", "lane-manifest-stale", SEV_WARNING,
+                "maelstrom_tpu/analysis/lane_manifest.json", "",
+                f"[{key}] manifest entry matches no registered "
+                f"model x layout — remove or re-record it"))
+    return findings
+
+
+# --- orchestration ---------------------------------------------------------
+
+
+def run_lane_lint(repo_root: str = ".",
+                  manifest_path: Optional[str] = None,
+                  update_manifest: bool = False,
+                  workloads: Optional[List[Tuple[str, int]]] = None,
+                  layouts: Sequence[str] = cost_model.AUDIT_LAYOUTS,
+                  include_fixtures: bool = True,
+                  trace_cache=None) -> List[Finding]:
+    """The lanes pass: analyze every registered model x layout (or a
+    restricted list), emit LNE6xx findings, and gate against (or
+    re-record) the manifest."""
+    from ..models import get_model
+
+    full = workloads is None
+    specs = cost_model.cost_specs() if full else list(workloads)
+    findings: List[Finding] = []
+    live: Dict[str, LaneReport] = {}
+    paths: Dict[str, Tuple[str, str]] = {}
+    errored: Set[str] = set()
+
+    for wl, n in specs:
+        try:
+            model = get_model(wl, n, "grid")
+        except Exception as e:
+            findings.append(_finding(
+                "LNE609", "lane-analysis-failure", SEV_ERROR,
+                "maelstrom_tpu/models/__init__.py", "get_model",
+                f"get_model({wl!r}, {n}) raised: {e!r}"))
+            errored.update(cost_model.entry_key(wl, n, lay)
+                           for lay in layouts)
+            continue
+        for layout in layouts:
+            key = cost_model.entry_key(wl, n, layout)
+            try:
+                rep = analyze_model(model, n, layout,
+                                    label=f"{wl}/n={n}/{layout}",
+                                    trace_cache=trace_cache)
+            except Exception as e:
+                findings.append(_finding(
+                    "LNE609", "lane-analysis-failure", SEV_ERROR,
+                    _model_path(model), type(model).__name__,
+                    f"[{key}] lane analysis raised "
+                    f"{type(e).__name__}: {e}"))
+                errored.add(key)
+                continue
+            findings.extend(findings_of_report(model, rep))
+            live[key] = rep
+            paths[key] = (_model_path(model), type(model).__name__)
+
+    if full and include_fixtures:
+        from ..models.ir_hazards import LANE_FIXTURE_MODELS
+        for kind, cls in sorted(LANE_FIXTURE_MODELS.items()):
+            model = cls()
+            try:
+                rep = analyze_model(model, 2, "lead",
+                                    label=f"fixture-{kind}")
+            except Exception as e:
+                findings.append(_finding(
+                    "LNE609", "lane-analysis-failure", SEV_ERROR,
+                    _model_path(model), type(model).__name__,
+                    f"[fixture-{kind}] lane analysis raised "
+                    f"{type(e).__name__}: {e}"))
+                continue
+            findings.extend(findings_of_report(model, rep))
+
+    if update_manifest:
+        path = save_lane_manifest(
+            {k: r.to_entry() for k, r in live.items()}, manifest_path)
+        findings.append(_finding(
+            "LNE600", "lane-manifest-updated", SEV_INFO,
+            os.path.relpath(path, os.path.abspath(repo_root))
+            if os.path.isabs(path) else path, "",
+            f"recorded {len(live)} lane-manifest entr"
+            f"{'y' if len(live) == 1 else 'ies'}"))
+    else:
+        manifest = load_lane_manifest(manifest_path)
+        findings.extend(compare_manifest(live, manifest, paths,
+                                         full_universe=full,
+                                         errored=errored))
+    return findings
+
+
+# --- bench/profiler surface ------------------------------------------------
+
+
+def lane_stats(model, sim, traced=None, cost=None) -> Dict[str, int]:
+    """One-call liveness stats for bench.py / tools/tick_profile.py
+    metric lines: live lane count, dead lane count, and the dead-byte
+    estimate next to ``ir_bytes_est`` (same sim = same tick graph;
+    pass the tools' already-computed ``trace_tick`` triple / cost
+    report to skip re-tracing it)."""
+    rep = analyze_model(model, sim.net.n_nodes, sim.layout, sim=sim,
+                        traced=traced, cost=cost)
+    return {"lanes_live": len(rep.live_lanes),
+            "lanes_dead": rep.lanes - len(rep.live_lanes),
+            "lanes_dead_bytes": rep.dead_bytes_est}

@@ -17,11 +17,12 @@ from .findings import (Baseline, DEFAULT_BASELINE, Finding, LintReport,
 
 ALL_PASSES = ("trace", "contract", "schema")
 
-# opt-in passes: the IR hazard audit and the cost gate trace (and, for
-# JXP403, compile) every registered model — tens of seconds, so they
-# run only when named (`--ir` / `--cost` / `--pass ir`), never as part
-# of the default sweep
-EXTRA_PASSES = ("ir", "cost")
+# opt-in passes: the IR hazard audit, the cost gate, and the
+# lane-liveness slice trace (and, for JXP403, compile) every registered
+# model — tens of seconds, so they run only when named (`--ir` /
+# `--cost` / `--lanes` / `--pass ir`), never as part of the default
+# sweep
+EXTRA_PASSES = ("ir", "cost", "lanes")
 
 
 def run_lint(repo_root: str = ".",
@@ -30,6 +31,8 @@ def run_lint(repo_root: str = ".",
              baseline_path: Optional[str] = DEFAULT_BASELINE,
              cost_baseline_path: Optional[str] = None,
              update_cost_baseline: bool = False,
+             lane_manifest_path: Optional[str] = None,
+             update_lane_manifest: bool = False,
              ) -> LintReport:
     """Run the requested passes and fold in the baseline.
 
@@ -40,7 +43,9 @@ def run_lint(repo_root: str = ".",
     "re-audit the world"). Passes named explicitly always run.
     ``baseline_path=None`` disables baseline suppression entirely.
     ``cost_baseline_path`` / ``update_cost_baseline`` parameterize the
-    cost pass (analysis/cost_baseline.json by default).
+    cost pass (analysis/cost_baseline.json by default);
+    ``lane_manifest_path`` / ``update_lane_manifest`` the lanes pass
+    (analysis/lane_manifest.json).
     """
     repo_root = os.path.abspath(repo_root)
     findings: List[Finding] = []
@@ -63,6 +68,10 @@ def run_lint(repo_root: str = ".",
     if "schema" in effective:
         from .schema_lint import run_schema_lint
         findings.extend(run_schema_lint(repo_root))
+    # the ir/cost and lanes passes each trace every registered model x
+    # layout; a shared cache makes the combined gate pay that jaxpr
+    # sweep once
+    trace_cache: dict = {}
     if "ir" in effective or "cost" in effective:
         from .ir_lint import run_ir_lint
         findings.extend(run_ir_lint(
@@ -70,7 +79,15 @@ def run_lint(repo_root: str = ".",
             hazards="ir" in effective,
             cost="cost" in effective,
             cost_baseline_path=cost_baseline_path,
-            update_baseline=update_cost_baseline))
+            update_baseline=update_cost_baseline,
+            trace_cache=trace_cache))
+    if "lanes" in effective:
+        from .lane_liveness import run_lane_lint
+        findings.extend(run_lane_lint(
+            repo_root,
+            manifest_path=lane_manifest_path,
+            update_manifest=update_lane_manifest,
+            trace_cache=trace_cache))
 
     baseline = (Baseline.load(baseline_path) if baseline_path
                 else Baseline())

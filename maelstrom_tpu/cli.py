@@ -863,6 +863,8 @@ def cmd_lint(args) -> int:
         passes.append("ir")
     if args.cost or args.update_baseline:
         passes.append("cost")
+    if args.lanes or args.update_manifest:
+        passes.append("lanes")
     baseline = None if args.no_baseline else (args.baseline
                                               or DEFAULT_BASELINE)
     report = run_lint(repo_root=args.root,
@@ -870,7 +872,9 @@ def cmd_lint(args) -> int:
                       paths=args.paths or None,
                       baseline_path=baseline,
                       cost_baseline_path=args.cost_baseline,
-                      update_cost_baseline=args.update_baseline)
+                      update_cost_baseline=args.update_baseline,
+                      lane_manifest_path=args.lane_manifest,
+                      update_lane_manifest=args.update_manifest)
     if args.json:
         print(json.dumps(report.to_json(), indent=2))
     else:
@@ -997,7 +1001,7 @@ def main(argv=None) -> int:
                         help="machine-readable findings on stdout")
     p_lint.add_argument("--pass", dest="passes", action="append",
                         choices=["trace", "contract", "schema", "ir",
-                                 "cost"],
+                                 "cost", "lanes"],
                         help="run only the named pass(es); default "
                              "trace+contract+schema (ir/cost are "
                              "opt-in — they trace/compile every "
@@ -1023,6 +1027,23 @@ def main(argv=None) -> int:
     p_lint.add_argument("--cost-baseline", default=None,
                         help="cost-baseline file (default "
                              "maelstrom_tpu/analysis/cost_baseline"
+                             ".json)")
+    p_lint.add_argument("--lanes", action="store_true",
+                        help="run the lane-liveness pass (LNE6xx): "
+                             "backward dataflow slice of every "
+                             "registered model x both carry layouts — "
+                             "live message-lane sets, dead carry "
+                             "leaves, dead stores — gated against "
+                             "analysis/lane_manifest.json "
+                             "(doc/lint.md)")
+    p_lint.add_argument("--update-manifest", action="store_true",
+                        help="re-record analysis/lane_manifest.json "
+                             "from the current tree (implies --lanes); "
+                             "commit the result with the PR that "
+                             "changes the lane vocabulary")
+    p_lint.add_argument("--lane-manifest", default=None,
+                        help="lane-manifest file (default "
+                             "maelstrom_tpu/analysis/lane_manifest"
                              ".json)")
     p_lint.add_argument("--baseline", default=None,
                         help="baseline file (default "

@@ -38,6 +38,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..tpu import wire
 from .echo import EchoModel
 
 
@@ -119,4 +120,84 @@ IR_FIXTURE_MODELS = {
     "host-callback": IrHostCallback,
     "fusion-breaker": IrFusionBreaker,
     "baked-const": IrBakedConst,
+}
+
+
+# --- lane-liveness fixtures (analysis/lane_liveness.py, LNE6xx) ------------
+#
+# The fourth fixture family: models whose IR is hazard-free by every
+# JXP/COST measure but whose LANE USAGE is wasteful or wrong — exactly
+# what the backward dataflow slice exists to prove statically. Same
+# convention as above: never registered, findings carried as
+# status="expected" in analysis/baseline.json, each rule pinned by
+# tests/test_analysis_lanes.py.
+
+
+class _DeadRow(NamedTuple):
+    seen: jnp.ndarray     # int32 — written every tick, observed never
+    ballast: jnp.ndarray  # int32[4] — carried verbatim, read nowhere
+
+
+class IrDeadLane(EchoModel):
+    """LANE FIXTURE (do not register): declares ``body_lanes = 4`` but
+    the protocol only ever touches body lane 0 — lanes 1-3 are pure
+    HBM/DRAM headroom (LNE601), and the carry gains two leaves that
+    feed no observable output, not even through the carry fixed point
+    (LNE602). The manifest entry for this model is the narrow-layout
+    safety proof's test subject: shrinking ``body_lanes`` to the
+    recorded live set must leave trajectories bit-identical."""
+    name = "echo-ir-dead-lane"
+    body_lanes = 4
+
+    def init_row(self, n_nodes, node_idx, key, params):
+        return _DeadRow(seen=jnp.zeros((), jnp.int32),
+                        ballast=jnp.zeros((4,), jnp.int32))
+
+    def handle(self, row, node_idx, msg, t, key, cfg, params):
+        _, out = super().handle(row.seen, node_idx, msg, t, key, cfg,
+                                params)
+        return _DeadRow(seen=row.seen + 1, ballast=row.ballast), out
+
+
+class IrDeadStore(EchoModel):
+    """LANE FIXTURE (do not register): the echo reply also stamps the
+    request's msg_id into body lane 1 — but no reader (server or
+    client decode) ever looks at that lane, so every write is a dead
+    store (LNE603) and the lane itself is dead (LNE601). The narrow
+    layout would delete the write entirely."""
+    name = "echo-ir-dead-store"
+
+    def handle(self, row, node_idx, msg, t, key, cfg, params):
+        row, out = super().handle(row, node_idx, msg, t, key, cfg,
+                                  params)
+        out = out.at[0, wire.BODY + 1].set(msg[wire.MSGID])
+        return row, out
+
+
+class IrLaneOverread(EchoModel):
+    """LANE FIXTURE (do not register): reads one lane past the end of
+    the message row. The index is traced, so nothing errors at trace
+    time — under jit the gather silently clamps to the last real lane
+    and the model reads the WRONG data (LNE604, error severity). The
+    static slice resolves the index constant and flags the out-of-
+    universe access the runtime would hide."""
+    name = "echo-ir-lane-overread"
+
+    def handle(self, row, node_idx, msg, t, key, cfg, params):
+        row, out = super().handle(row, node_idx, msg, t, key, cfg,
+                                  params)
+        # one past the last lane: a traced index defeats the python-
+        # level bounds check and jit clamps instead of raising
+        ghost = jax.lax.dynamic_index_in_dim(
+            msg, jnp.int32(cfg.lanes), axis=-1, keepdims=False)
+        out = out.at[0, wire.BODY].add(ghost * 0)
+        return row, out
+
+
+# audited by analysis/lane_liveness.py alongside the registered models;
+# intentionally NOT reachable from models.get_model
+LANE_FIXTURE_MODELS = {
+    "dead-lane": IrDeadLane,
+    "dead-store": IrDeadStore,
+    "lane-overread": IrLaneOverread,
 }

@@ -59,6 +59,12 @@ def make_msg(src, dest, type_, msg_id=-1, reply_to=-1, body=(),
     """Build one message row (traced-friendly). ``origin`` defaults to
     ``src``; the runtime's node phase re-stamps it with the emitting
     node's index anyway."""
+    if len(body) > body_lanes:
+        raise ValueError(
+            f"make_msg: body has {len(body)} values but the wire "
+            f"format carries body_lanes={body_lanes} — the .at[BODY+i] "
+            f"writes past the row end would silently clip/alias under "
+            f"jit; widen the model's body_lanes or shrink the body")
     m = jnp.zeros((lanes(body_lanes),), dtype=jnp.int32)
     m = m.at[VALID].set(1)
     m = m.at[SRC].set(src)

@@ -53,6 +53,9 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "fusion: compartmentalized node-step bit-identity "
                    "/ cost tests (models/raft_core.py)")
+    config.addinivalue_line(
+        "markers", "lanes: lane-liveness dataflow / manifest tests "
+                   "(analysis/lane_liveness.py)")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,416 @@
+"""Lane-liveness dataflow tests (analysis/lane_liveness.py).
+
+Pins the PR's acceptance bars: each planted lane fixture trips its
+LNE6xx rule, the conservative fallback (LNE605) fires on genuinely
+unresolvable lane indices, manifest drift/missing/stale detection works
+(including the jax-version staleness downgrade), Baseline.stale_entries
+scopes LNE entries to the lanes pass, and — the safety proof the
+specialization PR leans on — narrowing a fixture model's ``body_lanes``
+to its recorded live set leaves tick trajectories bit-identical in both
+carry layouts.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from maelstrom_tpu.analysis import cost_model, run_lint
+from maelstrom_tpu.analysis.findings import (Baseline, BaselineEntry,
+                                             fingerprint_pass)
+from maelstrom_tpu.analysis.lane_liveness import (DEFAULT_LANE_MANIFEST,
+                                                  LaneReport,
+                                                  analyze_model,
+                                                  compare_manifest,
+                                                  findings_of_report,
+                                                  load_lane_manifest,
+                                                  run_lane_lint,
+                                                  save_lane_manifest)
+from maelstrom_tpu.models.ir_hazards import (LANE_FIXTURE_MODELS,
+                                             IrDeadLane, IrDeadStore,
+                                             IrLaneOverread)
+from maelstrom_tpu.tpu import wire
+
+pytestmark = pytest.mark.lanes
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _rules(findings):
+    return {f.rule for f in findings}
+
+
+# --- the planted fixtures trip their rules ---------------------------------
+
+
+class TestFixturesTrip:
+    def test_dead_lane_trips_lne601_and_602(self):
+        rep = analyze_model(IrDeadLane(), 2, "lead")
+        fs = findings_of_report(IrDeadLane(), rep)
+        assert {"LNE601", "LNE602"} <= _rules(fs)
+        assert not rep.conservative
+        # the declared-but-unread lanes are exactly the recorded
+        # headroom the fixture plants
+        assert rep.live_body_lanes == [0]
+        assert rep.dead_body_lanes == [1, 2, 3]
+        assert rep.dead_bytes_est > 0
+        # both planted carry leaves classify dead
+        dead = set(rep.dead_carry_leaves)
+        assert any("seen" in p for p in dead)
+        assert any("ballast" in p for p in dead)
+
+    def test_dead_store_trips_lne603(self):
+        rep = analyze_model(IrDeadStore(), 2, "lead")
+        fs = findings_of_report(IrDeadStore(), rep)
+        assert "LNE603" in _rules(fs)
+        # the stamped-but-never-read lane is body lane 1
+        assert wire.BODY + 1 in {lane for lane, _ in rep.dead_stores}
+        assert 1 in rep.dead_body_lanes
+
+    def test_lane_overread_trips_lne604_as_error(self):
+        rep = analyze_model(IrLaneOverread(), 2, "lead")
+        fs = findings_of_report(IrLaneOverread(), rep)
+        overreads = [f for f in fs if f.rule == "LNE604"]
+        assert overreads and all(f.severity == "error"
+                                 for f in overreads)
+        # the fixture aims one past the row end
+        assert rep.lanes in {lane for lane, _ in rep.overreads}
+
+    def test_fixtures_trip_in_both_layouts(self):
+        for layout in ("lead", "minor"):
+            for kind, cls in sorted(LANE_FIXTURE_MODELS.items()):
+                rep = analyze_model(cls(), 2, layout)
+                fs = findings_of_report(cls(), rep)
+                assert fs, (kind, layout)
+
+    def test_unresolvable_index_falls_back_conservative(self):
+        """LNE605: a lane index computed from message DATA cannot be
+        resolved statically — the model must widen to all-live (no
+        dead-lane credit), not silently under-approximate."""
+        from maelstrom_tpu.models.echo import EchoModel
+
+        class DataIndexed(EchoModel):
+            name = "echo-data-indexed"
+
+            def handle(self, row, node_idx, msg, t, key, cfg, params):
+                row, out = super().handle(row, node_idx, msg, t, key,
+                                          cfg, params)
+                # index depends on traced payload: unresolvable
+                lane = msg[wire.BODY] % cfg.lanes
+                ghost = jax.lax.dynamic_index_in_dim(
+                    msg, lane, axis=-1, keepdims=False)
+                out = out.at[0, wire.BODY].add(ghost * 0)
+                return row, out
+
+        rep = analyze_model(DataIndexed(), 2, "lead")
+        assert rep.conservative
+        assert rep.live_lanes == set(range(rep.lanes))
+        fs = findings_of_report(DataIndexed(), rep)
+        assert _rules(fs) == {"LNE605"}
+        assert not rep.dead_body_lanes   # no credit taken
+
+    def test_honest_echo_is_exact(self):
+        """False-positive guard: the registered echo model resolves
+        exactly (no LNE604/605) and its one payload lane is live."""
+        from maelstrom_tpu.models import get_model
+        for layout in ("lead", "minor"):
+            rep = analyze_model(get_model("echo", 2), 2, layout)
+            assert not rep.conservative, rep.notes
+            assert not rep.overreads
+            assert 0 in rep.live_body_lanes
+
+
+# --- manifest io + drift gate ----------------------------------------------
+
+
+def _fake_report(**kw):
+    defaults = dict(label="echo/n=2/lead", lanes=11, body_lanes=2,
+                    live_lanes=set(range(9)) | {wire.BODY})
+    defaults.update(kw)
+    return LaneReport(**defaults)
+
+
+class TestManifestGate:
+    def test_roundtrip_and_entry_contract(self, tmp_path):
+        rep = _fake_report()
+        path = str(tmp_path / "m.json")
+        save_lane_manifest({"echo/n=2/lead": rep.to_entry()}, path)
+        man = load_lane_manifest(path)
+        e = man["entries"]["echo/n=2/lead"]
+        # the specialization contract: the three keys ROADMAP item 2's
+        # refactor consumes
+        assert e["live_body_lanes"] == [0]
+        assert "dead_bytes_per_tick_est" in e
+        assert e["projected_narrow_ir_bytes_est"] == \
+            e["ir_bytes_est"] - e["dead_bytes_per_tick_est"]
+        assert man["jax-version"] == jax.__version__
+
+    def test_drift_is_an_error_same_toolchain(self):
+        rep = _fake_report()
+        entry = rep.to_entry()
+        entry["live_body_lanes"] = [0, 1]   # manifest claims lane 1 live
+        manifest = {"jax-version": jax.__version__,
+                    "entries": {"echo/n=2/lead": entry}}
+        fs = compare_manifest({"echo/n=2/lead": rep}, manifest,
+                              {"echo/n=2/lead": ("p.py", "Echo")})
+        (f,) = [f for f in fs if f.rule == "LNE606"]
+        assert f.severity == "error"
+        assert "live_body_lanes" in f.message
+
+    def test_drift_downgrades_under_toolchain_skew(self):
+        """The self-explaining staleness downgrade: recorded under a
+        different jax, drift is a re-record warning, not a failure."""
+        rep = _fake_report()
+        entry = rep.to_entry()
+        entry["live_body_lanes"] = [0, 1]
+        manifest = {"jax-version": "0.0.0",
+                    "entries": {"echo/n=2/lead": entry}}
+        fs = compare_manifest({"echo/n=2/lead": rep}, manifest,
+                              {"echo/n=2/lead": ("p.py", "Echo")})
+        (f,) = [f for f in fs if f.rule == "LNE606"]
+        assert f.severity == "warning"
+        assert "--update-manifest" in f.message
+        assert "0.0.0" in f.message
+
+    def test_missing_and_stale_entries(self):
+        rep = _fake_report()
+        manifest = {"jax-version": jax.__version__,
+                    "entries": {"ghost/n=9/lead": rep.to_entry()}}
+        fs = compare_manifest({"echo/n=2/lead": rep}, manifest,
+                              {"echo/n=2/lead": ("p.py", "Echo")})
+        assert _rules(fs) == {"LNE607", "LNE608"}
+        missing = [f for f in fs if f.rule == "LNE607"]
+        assert missing[0].severity == "error"
+
+    def test_errored_keys_are_not_stale(self):
+        """A model whose analysis crashed already carries LNE609; its
+        manifest entries must NOT also be called stale (LNE608 would
+        advise deleting perfectly valid entries)."""
+        rep = _fake_report()
+        manifest = {"jax-version": jax.__version__,
+                    "entries": {"ghost/n=9/lead": rep.to_entry()}}
+        fs = compare_manifest({}, manifest, {},
+                              errored={"ghost/n=9/lead"})
+        assert "LNE608" not in _rules(fs)
+
+    def test_analysis_failure_trips_lne609(self):
+        """get_model crashing is a total audit failure (error-severity
+        LNE609), distinct from LNE605's documented warning-severity
+        conservative widening."""
+        fs = run_lane_lint(workloads=[("no-such-workload", 3)])
+        hits = [f for f in fs if f.rule == "LNE609"]
+        assert hits and all(f.severity == "error" for f in hits)
+        assert not [f for f in fs if f.rule == "LNE605"]
+
+    def test_cost_toolchain_note_matches_contract(self):
+        assert cost_model.toolchain_note(jax.__version__, "x") is None
+        assert cost_model.toolchain_note(None, "x") is None
+        note = cost_model.toolchain_note("0.0.0", "the cost baseline")
+        assert "--update-baseline" in note and "0.0.0" in note
+
+    def test_checked_in_manifest_covers_registry_with_headroom(self):
+        """Acceptance bar: the committed manifest has one entry per
+        registered model x layout, and at least one family records
+        nonzero dead bytes — the measured ROADMAP item 2 headroom."""
+        man = load_lane_manifest(DEFAULT_LANE_MANIFEST)
+        want = {cost_model.entry_key(wl, n, layout)
+                for wl, n in cost_model.cost_specs()
+                for layout in cost_model.AUDIT_LAYOUTS}
+        assert set(man["entries"]) == want
+        assert any(e["dead_bytes_per_tick_est"] > 0
+                   for e in man["entries"].values())
+        assert man.get("jax-version")
+
+    def test_restricted_run_gates_against_checked_in_manifest(self):
+        """One model x both layouts against the committed manifest:
+        clean, and with a tampered copy the same run raises LNE606."""
+        fs = run_lane_lint(REPO, workloads=[("echo", 2)])
+        assert not [f for f in fs if f.severity == "error"], \
+            [f.to_dict() for f in fs if f.severity == "error"]
+
+    def test_restricted_run_flags_tampered_manifest(self, tmp_path):
+        man = load_lane_manifest(DEFAULT_LANE_MANIFEST)
+        key = cost_model.entry_key("echo", 2, "lead")
+        man["entries"][key]["live_body_lanes"] = []
+        tampered = tmp_path / "tampered.json"
+        tampered.write_text(json.dumps(man))
+        fs = run_lane_lint(REPO, manifest_path=str(tampered),
+                           workloads=[("echo", 2)])
+        drifts = [f for f in fs if f.rule == "LNE606"]
+        assert drifts and drifts[0].severity == "error"
+
+    def test_update_manifest_records_and_regates_clean(self, tmp_path):
+        """record → immediately re-gate: the freshly recorded manifest
+        must be drift-free (the --update-manifest workflow)."""
+        path = str(tmp_path / "m.json")
+        fs = run_lane_lint(REPO, manifest_path=path,
+                           update_manifest=True,
+                           workloads=[("echo", 2)])
+        assert "LNE600" in _rules(fs)
+        fs2 = run_lane_lint(REPO, manifest_path=path,
+                            workloads=[("echo", 2)])
+        assert not [f for f in fs2
+                    if f.rule in ("LNE606", "LNE607", "LNE608")]
+
+
+# --- baseline pass-scoping -------------------------------------------------
+
+
+class TestBaselineScoping:
+    def test_lne_fingerprints_map_to_lanes_pass(self):
+        assert fingerprint_pass("LNE601:maelstrom_tpu/models/"
+                                "ir_hazards.py:IrDeadLane") == "lanes"
+        assert fingerprint_pass("COST501:x:y") == "cost"
+        assert fingerprint_pass("TRC101:x:y") == "trace"
+
+    def test_stale_entries_scoped_to_ran_passes(self):
+        """An unmatched LNE entry is stale ONLY when the lanes pass
+        ran — a default trace/contract/schema sweep must not call the
+        lane baseline stale (the third opt-in pass joins the PR 5
+        prefix map)."""
+        b = Baseline(entries=[
+            BaselineEntry(fingerprint="LNE601:p.py:Ghost",
+                          status="expected", reason="t"),
+            BaselineEntry(fingerprint="TRC101:p.py:Ghost",
+                          status="expected", reason="t"),
+        ])
+        stale_default = b.stale_entries(
+            passes=("trace", "contract", "schema"))
+        assert [e.fingerprint for e in stale_default] == \
+            ["TRC101:p.py:Ghost"]
+        stale_lanes = b.stale_entries(passes=("lanes",))
+        assert [e.fingerprint for e in stale_lanes] == \
+            ["LNE601:p.py:Ghost"]
+        assert len(b.stale_entries(passes=None)) == 2
+
+    def test_repo_baseline_has_no_orphan_lane_entries(self):
+        """Every LNE entry in the checked-in baseline names a fixture
+        class (or accepted model) that still exists."""
+        b = Baseline.load(os.path.join(
+            REPO, "maelstrom_tpu", "analysis", "baseline.json"))
+        import importlib
+        for fp in b.entries:
+            if not fp.startswith("LNE"):
+                continue
+            _, path, symbol = fp.split(":")
+            mod = importlib.import_module(
+                path[:-3].replace(os.sep, ".").replace("/", "."))
+            assert hasattr(mod, symbol), fp
+
+
+# --- the narrow-layout safety proof ----------------------------------------
+
+
+def _run_echo_fixture(model, layout, opts=None):
+    from maelstrom_tpu.tpu.harness import make_sim_config
+    from maelstrom_tpu.tpu.runtime import canonical_carry, run_sim
+    base = dict(node_count=2, concurrency=4, n_instances=16,
+                record_instances=4, inbox_k=1, pool_slots=12,
+                time_limit=0.1, rate=200.0, latency=5.0,
+                rpc_timeout=1.0, nemesis=[], seed=11, layout=layout)
+    base.update(opts or {})
+    sim = make_sim_config(model, base)
+    params = model.make_params(sim.net.n_nodes)
+    carry, ys = run_sim(model, sim, base["seed"], params)
+    return canonical_carry(carry, sim), ys, sim
+
+
+class TestNarrowLayoutRoundTrip:
+    @pytest.mark.parametrize("layout", ["lead", "minor"])
+    def test_narrowing_to_live_set_is_trajectory_preserving(self,
+                                                            layout):
+        """The end-to-end safety proof: record the fixture's live set,
+        rebuild it with ``body_lanes`` narrowed to exactly that set,
+        and the tick trajectories are bit-identical — same decoded
+        events, same stats/violations, same live pool lanes. This is
+        the check the ROADMAP item 2 specialization PR re-runs per
+        family before shrinking the real Msg."""
+        wide = IrDeadLane()
+        rep = analyze_model(wide, 2, layout)
+        assert not rep.conservative
+        live = rep.live_body_lanes
+        assert live == [0]          # the manifest's recorded live set
+        narrow_width = max(live) + 1
+
+        narrow_cls = type("IrDeadLaneNarrow", (IrDeadLane,),
+                          {"body_lanes": narrow_width})
+        wide_c, wide_ys, wide_sim = _run_echo_fixture(wide, layout)
+        nar_c, nar_ys, nar_sim = _run_echo_fixture(narrow_cls(), layout)
+
+        # decoded observables: bit-identical
+        np.testing.assert_array_equal(np.asarray(wide_ys.events),
+                                      np.asarray(nar_ys.events))
+        # fleet stats + violations: bit-identical, leaf by leaf
+        for leaf_name in ("stats", "violations"):
+            wl = jax.tree_util.tree_leaves(getattr(wide_c, leaf_name))
+            nl = jax.tree_util.tree_leaves(getattr(nar_c, leaf_name))
+            assert len(wl) == len(nl)
+            for a, b in zip(wl, nl):
+                np.testing.assert_array_equal(np.asarray(a),
+                                              np.asarray(b),
+                                              err_msg=leaf_name)
+        # the surviving lanes of the pool carry the same bits: header
+        # lanes + the live body lanes (dead lanes are the only thing
+        # the narrow layout dropped)
+        keep = list(range(wire.BODY)) + [wire.BODY + l for l in live]
+        np.testing.assert_array_equal(
+            np.asarray(wide_c.pool)[..., keep],
+            np.asarray(nar_c.pool)[..., keep])
+        # the run exercised real traffic
+        assert int(np.asarray(wide_c.stats.delivered)) > 10
+
+    def test_dead_bytes_shrink_when_narrowed(self):
+        """The projection is honest: the narrow rebuild's ir_bytes_est
+        lands at or below the wide model's projected figure."""
+        wide_rep = analyze_model(IrDeadLane(), 2, "lead")
+        narrow_cls = type("IrDeadLaneNarrow", (IrDeadLane,),
+                          {"body_lanes": 1})
+        narrow_rep = analyze_model(narrow_cls(), 2, "lead")
+        assert narrow_rep.ir_bytes_est < wide_rep.ir_bytes_est
+        assert narrow_rep.dead_bytes_est < wide_rep.dead_bytes_est
+
+
+# --- wire-format guard (the make_msg satellite) ----------------------------
+
+
+class TestMakeMsgGuard:
+    def test_body_overflow_raises_at_trace_time(self):
+        with pytest.raises(ValueError, match="body_lanes"):
+            wire.make_msg(src=0, dest=1, type_=1, body=(1, 2, 3),
+                          body_lanes=2)
+
+    def test_body_overflow_raises_under_jit(self):
+        def build():
+            return wire.make_msg(src=0, dest=1, type_=1,
+                                 body=(1, 2, 3, 4), body_lanes=3)
+        with pytest.raises(ValueError, match="body_lanes"):
+            jax.jit(build)()
+
+    def test_full_body_still_fits(self):
+        m = wire.make_msg(src=0, dest=1, type_=1, body=(7, 8),
+                          body_lanes=2)
+        assert m.shape == (wire.lanes(2),)
+        assert int(m[wire.BODY]) == 7 and int(m[wire.BODY + 1]) == 8
+
+
+# --- repo-wide gate (exhaustive sweep: slow) -------------------------------
+
+
+@pytest.mark.slow
+class TestRepoGate:
+    def test_repo_wide_lanes_gate_is_green(self):
+        """Every registered model x both layouts + the fixtures, gated
+        against the committed manifest and baseline: zero unsuppressed
+        findings, and every expected fixture entry HIT (none stale)."""
+        report = run_lint(repo_root=REPO, passes=("lanes",))
+        assert report.findings == [], \
+            [f.to_dict() for f in report.findings]
+        stale = [e.fingerprint for e in report.stale
+                 if e.fingerprint.startswith("LNE")]
+        assert stale == []
+        hit = {e.fingerprint for _, e in report.suppressed}
+        assert any(fp.startswith("LNE604") for fp in hit)
+        assert any(fp.startswith("LNE603") for fp in hit)

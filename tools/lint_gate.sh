@@ -20,8 +20,8 @@ SMOKE_STORE="$(mktemp -d)"
 trap 'rm -rf "$SMOKE_STORE"' EXIT
 
 echo
-echo "== maelstrom lint --ir --cost --strict (IR hazards + cost budget)"
-python -m maelstrom_tpu lint --ir --cost --strict
+echo "== maelstrom lint --ir --cost --lanes --strict (IR hazards + cost budget + lane liveness)"
+python -m maelstrom_tpu lint --ir --cost --lanes --strict
 
 echo
 echo "== cost/budget-regression canary (tampered baseline must fail)"
@@ -56,6 +56,32 @@ python -m maelstrom_tpu lint --ir --cost --strict \
 grep -q 'COST501' "$SMOKE_STORE/cost-canary.out"
 grep -Eq 'ERROR JXP404.*budget' "$SMOKE_STORE/cost-canary.out"
 echo "canary caught: $(grep -c COST501 "$SMOKE_STORE/cost-canary.out") COST501 + $(grep -Ec 'ERROR JXP404' "$SMOKE_STORE/cost-canary.out") JXP404-budget finding(s)"
+
+echo
+echo "== lane-manifest canary (tampered live set must fail)"
+# Simulate the failure mode the manifest gate exists to catch: a
+# manifest that calls a LIVE lane dead (the narrow-layout refactor
+# would then delete a lane the protocol reads). Drop the last recorded
+# live body lane from one entry; the live-vs-manifest diff must exit 1
+# with LNE606. jax-version is copied through, so this also proves the
+# same-toolchain path is a hard error, not the re-record warning.
+python - "$SMOKE_STORE/lanes_tampered.json" <<'PY'
+import json, sys
+man = json.load(open("maelstrom_tpu/analysis/lane_manifest.json"))
+key = next(k for k in sorted(man["entries"])
+           if man["entries"][k]["live_body_lanes"])
+e = man["entries"][key]
+e["live_body_lanes"] = e["live_body_lanes"][:-1]
+json.dump(man, open(sys.argv[1], "w"))
+print(f"tampered entry: {key} (marked a live body lane dead)")
+PY
+rc=0
+python -m maelstrom_tpu lint --lanes --strict \
+    --lane-manifest "$SMOKE_STORE/lanes_tampered.json" \
+    > "$SMOKE_STORE/lanes-canary.out" || rc=$?
+[[ "$rc" == "1" ]] || { echo "expected exit 1 (lane drift caught), got $rc"; exit 1; }
+grep -Eq 'ERROR LNE606' "$SMOKE_STORE/lanes-canary.out"
+echo "canary caught: $(grep -Ec 'ERROR LNE606' "$SMOKE_STORE/lanes-canary.out") LNE606 drift finding(s)"
 
 echo
 echo "== raft-family fusion budgets hold (fused ticks pin 0 loops)"

@@ -81,8 +81,10 @@ def main() -> None:
         tick_fn = make_tick_fn(model, sim, params)
 
         # static decomposition of THIS config's fused tick — one
-        # abstract trace, shared with `maelstrom lint --cost`
-        cost = cost_model.tick_cost(model, sim, params)
+        # abstract trace, shared with `maelstrom lint --cost` and
+        # reused by the lane-liveness block below
+        traced = cost_model.trace_tick(model, sim, params)
+        cost = cost_model.cost_of_jaxpr(traced[0], traced[1])
 
         # post-compile launch-overhead stats for the FIRST size only
         # (one extra tick compile; PROF_THUNKS=0 skips): ir_thunks is
@@ -105,6 +107,31 @@ def main() -> None:
                       file=sys.stderr, flush=True)
             except Exception as e:
                 print(f"# compiled_tick_stats unavailable: {e!r}",
+                      file=sys.stderr, flush=True)
+
+        # lane occupancy of the same tick (PROF_LANES=0 skips): live
+        # vs dead Msg lanes and the dead-byte slice of the HBM
+        # estimate — the `maelstrom lint --lanes` figures, printed
+        # next to static eqns so "which phase is heavy" and "which
+        # lanes pay for it" read off one profile
+        if I == sizes[0] and os.environ.get("PROF_LANES") != "0":
+            try:
+                ls = cost_model.tick_lane_stats(model, sim,
+                                                traced=traced,
+                                                cost=cost)
+                row = {"instances": I, "phase": "lane_liveness",
+                       "lanes_live": ls["lanes_live"],
+                       "lanes_dead": ls["lanes_dead"],
+                       "lanes_dead_bytes": ls["lanes_dead_bytes"]}
+                rows.append(row)
+                print(json.dumps(row), flush=True)
+                print(f"# lane liveness: {ls['lanes_live']} live / "
+                      f"{ls['lanes_dead']} dead lanes, "
+                      f"~{ls['lanes_dead_bytes'] / 1e3:.0f} kB/tick "
+                      f"dead traffic (lane_manifest.json)",
+                      file=sys.stderr, flush=True)
+            except Exception as e:
+                print(f"# tick_lane_stats unavailable: {e!r}",
                       file=sys.stderr, flush=True)
 
         def static_eqns(phase_name: str):
