@@ -57,6 +57,16 @@ BASELINE_MSGS_PER_SEC = 60_000.0
 TAG = "bench"
 
 
+def _argv_value(flag: str, default: str) -> str:
+    """``--flag VALUE`` from argv; the default when absent or dangling
+    (bench takes no argparse — env knobs + these two positionals)."""
+    if flag in sys.argv:
+        i = sys.argv.index(flag)
+        if i + 1 < len(sys.argv):
+            return sys.argv[i + 1]
+    return default
+
+
 # --------------------------------------------------------------------------
 # child: the actual measurement (runs under a parent-enforced deadline)
 # --------------------------------------------------------------------------
@@ -74,6 +84,16 @@ def child_main(canary: bool = False) -> None:
     devs = jax.devices()
     platform = devs[0].platform
     log(TAG, f"phase: devices ok — {len(devs)} x {platform}")
+
+    # persistent XLA compile cache (utils/compile_cache.py): a healthy
+    # TPU window spends its seconds measuring, not recompiling the same
+    # chunk fns as the last probe. --compile-cache DIR overrides the
+    # .jax_cache default; MAELSTROM_COMPILE_CACHE=0 disables.
+    from maelstrom_tpu.utils.compile_cache import enable_compile_cache
+    cache_dir = enable_compile_cache(
+        _argv_value("--compile-cache", ".jax_cache"))
+    log(TAG, f"phase: compile cache "
+             f"{cache_dir if cache_dir else 'disabled'}")
 
     from maelstrom_tpu.models.raft import RaftModel
     from maelstrom_tpu.tpu.harness import make_sim_config
@@ -870,6 +890,11 @@ def parent_main() -> int:
     cpu_deadline = float(os.environ.get("BENCH_CPU_S", 150))
     t_start = time.monotonic()
     here = os.path.abspath(__file__)
+    # children pick the compile-cache dir up from the env (utils/
+    # compile_cache.py: env beats the child's own default flag)
+    if "--compile-cache" in sys.argv:
+        os.environ["MAELSTROM_COMPILE_CACHE"] = _argv_value(
+            "--compile-cache", ".jax_cache")
     accel_env = dict(os.environ)
     cpu_env = cpu_child_env(1)
 

@@ -533,7 +533,8 @@ def default_trace_targets(repo_root: str) -> List[str]:
             "maelstrom_tpu/ops/delivery.py",
             "maelstrom_tpu/telemetry/recorder.py",
             "maelstrom_tpu/telemetry/stream.py",
-            "maelstrom_tpu/checkers/triage.py"]
+            "maelstrom_tpu/checkers/triage.py",
+            "maelstrom_tpu/campaign/*.py"]
     out = []
     for p in pats:
         out.extend(sorted(glob.glob(os.path.join(repo_root, p))))

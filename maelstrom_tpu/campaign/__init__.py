@@ -1,0 +1,32 @@
+"""Durable campaign control plane: checkpointed carry, resumable run
+queue, and a multi-run trend store.
+
+Everything below this package treats one ``run_tpu_test`` invocation as
+a mortal process; this package makes sweeps survive it (the Netherite
+durable-partition move, PAPERS.md):
+
+- ``checkpoint.py`` — every K chunks the chunked executors hand their
+  donated carry (off a detached snapshot) plus the host-side event
+  accumulators to an atomic write-temp-then-rename checkpoint under
+  ``store/<test>/<run>/checkpoint/``; ``resume`` continues dispatch so
+  the concatenated segments are bit-identical to an uninterrupted run.
+- ``spec.py`` — a JSON (or TOML, py3.11+) campaign file declares a
+  sweep matrix (workload x config x seed x horizon) expanded into work
+  items.
+- ``queue.py`` — the file-lock-claimed item state machine
+  (``pending -> running -> done/failed/preempted``): a killed worker's
+  item is re-claimable and resumed from its last checkpoint.
+- ``runner.py`` — ``maelstrom campaign run`` drains the queue through
+  the pipelined executor (fail-fast and triage still fire per run);
+  ``resume_run`` rebuilds a killed run from its heartbeat + checkpoint.
+- ``report.py`` — ``status`` merges per-item heartbeats into one live
+  table; ``report`` aggregates completed runs into
+  ``summary.json`` trend rows rendered by the ``serve`` store browser.
+
+See doc/guide/09-campaigns.md for the walkthrough.
+"""
+
+from .checkpoint import (CheckpointError, checkpoint_path,  # noqa: F401
+                         load_checkpoint, save_checkpoint)
+from .queue import submit_campaign  # noqa: F401
+from .runner import resume_run, run_campaign  # noqa: F401

@@ -1,0 +1,187 @@
+"""Atomic carry checkpoints: kill a chunked run, resume it bit-exactly.
+
+The chunked executors (``tpu/pipeline.py``, ``parallel/mesh.py``) donate
+the carry between dispatches, so mid-run state used to live only on
+device — a killed process lost the sweep. This module persists, every K
+chunks, everything a continuation needs:
+
+- the **carry pytree** fetched off a detached snapshot (the same PR-4
+  pattern the heartbeat's stats vector uses: fetch completes before the
+  next dispatch donates the buffers away). The master RNG key is a carry
+  leaf (``Carry.key``, never advanced — every draw folds in
+  ``(purpose, tick, instance)``), so carrying the pytree IS carrying the
+  RNG state;
+- the **host-side accumulators** — per-chunk compacted event rows (and
+  journal blocks / sharded dense event chunks) consumed so far, so the
+  resumed run's decoded histories cover the FULL horizon, not just the
+  tail segment;
+- ``ticks-dispatched`` and the chunk cursor, so the resumed dispatch
+  plan is the exact suffix of the original plan.
+
+Durability contract: one ``state.npz`` written as
+``state.npz.tmp-<pid>`` then ``os.replace``d into place — a kill at ANY
+point leaves either the previous checkpoint or the new one, never a
+torn file (tests/test_campaign.py pins this). Bit-exactness contract:
+the tick function depends only on ``(carry, t)``, so resuming from the
+restored carry at tick T produces the identical trajectory an
+uninterrupted run had from tick T — in both carry layouts and through
+the sharded driver (the wire carry checkpoints the same way).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+CHECKPOINT_DIR = "checkpoint"
+CHECKPOINT_FILE = "state.npz"
+CHECKPOINT_SCHEMA = 1
+
+# executor kinds a checkpoint can belong to; resume refuses a mismatch
+# (a sharded wire carry is NOT a single-device carry)
+KIND_PIPELINED = "pipelined"
+KIND_SHARDED = "sharded"
+
+
+class CheckpointError(ValueError):
+    """A checkpoint that cannot be saved/loaded/restored."""
+
+
+def checkpoint_path(run_dir: str) -> str:
+    return os.path.join(run_dir, CHECKPOINT_DIR, CHECKPOINT_FILE)
+
+
+def _leaves(tree) -> List[np.ndarray]:
+    import jax
+    return [np.asarray(x) for x in jax.tree.leaves(tree)]
+
+
+def save_checkpoint(run_dir: str, *, kind: str, state: Any, ticks: int,
+                    chunks: int,
+                    compact: Tuple[Tuple[np.ndarray, int], ...] = (),
+                    journal: Tuple[Tuple[np.ndarray, np.ndarray],
+                                   ...] = (),
+                    events: Tuple[np.ndarray, ...] = (),
+                    meta: Optional[Dict[str, Any]] = None) -> str:
+    """Write one atomic checkpoint under ``<run_dir>/checkpoint/``.
+
+    ``state`` is the carry pytree, device- or host-side — leaves are
+    fetched with ``np.asarray`` (this is the blocking detached-snapshot
+    fetch; the caller invokes it between dispatches, before the
+    donation of the next chunk, host-side — never under trace).
+    Returns the checkpoint path."""
+    path = checkpoint_path(run_dir)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    arrays: Dict[str, np.ndarray] = {}
+    leaves = _leaves(state)
+    for i, leaf in enumerate(leaves):
+        arrays[f"carry/{i:03d}"] = leaf
+    for i, (rows, count) in enumerate(compact):
+        arrays[f"compact_rows/{i:04d}"] = np.asarray(rows)
+    arrays["compact_counts"] = np.asarray(
+        [int(c) for _, c in compact], np.int64)
+    for i, (sends, recvs) in enumerate(journal):
+        arrays[f"journal_send/{i:04d}"] = np.asarray(sends)
+        arrays[f"journal_recv/{i:04d}"] = np.asarray(recvs)
+    for i, ev in enumerate(events):
+        arrays[f"events/{i:04d}"] = np.asarray(ev)
+    header = {
+        "schema": CHECKPOINT_SCHEMA, "kind": kind,
+        "ticks": int(ticks), "chunks": int(chunks),
+        "n-carry-leaves": len(leaves), "n-compact": len(compact),
+        "n-journal": len(journal), "n-events": len(events),
+        "meta": meta or {},
+    }
+    arrays["__meta__"] = np.frombuffer(
+        json.dumps(header).encode(), dtype=np.uint8)
+    tmp = f"{path}.tmp-{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            np.savez(f, **arrays)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)   # the atomicity pivot: old XOR new
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    return path
+
+
+def load_checkpoint(run_dir: str) -> Optional[Dict[str, Any]]:
+    """Load a run dir's checkpoint; ``None`` when none was written.
+    Stale ``*.tmp-*`` siblings (a writer killed mid-write) are ignored —
+    the rename pivot means ``state.npz`` is always a complete file."""
+    path = checkpoint_path(run_dir)
+    if not os.path.exists(path):
+        return None
+    try:
+        with np.load(path) as z:
+            header = json.loads(bytes(z["__meta__"]).decode())
+            if header.get("schema") != CHECKPOINT_SCHEMA:
+                raise CheckpointError(
+                    f"{path}: unsupported checkpoint schema "
+                    f"{header.get('schema')!r}")
+            carry = [z[f"carry/{i:03d}"]
+                     for i in range(header["n-carry-leaves"])]
+            counts = z["compact_counts"]
+            compact = [(z[f"compact_rows/{i:04d}"], int(counts[i]))
+                       for i in range(header["n-compact"])]
+            journal = [(z[f"journal_send/{i:04d}"],
+                        z[f"journal_recv/{i:04d}"])
+                       for i in range(header["n-journal"])]
+            events = [z[f"events/{i:04d}"]
+                      for i in range(header["n-events"])]
+    except CheckpointError:
+        raise
+    except Exception as e:
+        raise CheckpointError(f"unreadable checkpoint {path}: {e!r}")
+    return {"kind": header["kind"], "ticks": header["ticks"],
+            "chunks": header["chunks"], "carry": carry,
+            "compact": compact, "journal": journal, "events": events,
+            "meta": header.get("meta", {}), "path": path}
+
+
+def restore_carry(template: Any, leaves: List[np.ndarray]) -> Any:
+    """Rebuild a device carry from checkpointed leaves using a freshly
+    initialized ``template`` pytree (same model/sim/config) for the
+    treedef. Shape/dtype mismatches mean the run is being resumed under
+    a different config — refused, not silently reinterpreted."""
+    import jax
+    import jax.numpy as jnp
+    t_leaves, treedef = jax.tree.flatten(template)
+    if len(t_leaves) != len(leaves):
+        raise CheckpointError(
+            f"checkpoint has {len(leaves)} carry leaves but the "
+            f"rebuilt config produces {len(t_leaves)} — the resume "
+            f"config does not match the checkpointed run")
+    out = []
+    for i, (t, v) in enumerate(zip(t_leaves, leaves)):
+        if tuple(t.shape) != tuple(v.shape) or t.dtype != v.dtype:
+            raise CheckpointError(
+                f"carry leaf {i}: checkpoint {v.shape}/{v.dtype} vs "
+                f"rebuilt {t.shape}/{t.dtype} — the resume config does "
+                f"not match the checkpointed run")
+        # donation needs each leaf to own its buffer (same reason
+        # run_sim_pipelined copies the init carry)
+        out.append(jnp.asarray(v).copy())
+    return jax.tree.unflatten(treedef, out)
+
+
+def make_checkpoint_cb(run_dir: str, *, kind: str,
+                       meta: Optional[Dict[str, Any]] = None):
+    """The executor-facing sink: a ``cb(state, ticks, host)`` closure
+    for ``run_sim_pipelined``/``run_sim_sharded_chunked``'s
+    ``checkpoint_cb`` — ``host`` is the executor's accumulator dict
+    (``compact``/``journal``/``events``/``chunks``)."""
+    def cb(state, ticks, host: Dict[str, Any]) -> None:
+        save_checkpoint(
+            run_dir, kind=kind, state=state, ticks=ticks,
+            chunks=int(host.get("chunks", 0)),
+            compact=tuple(host.get("compact", ())),
+            journal=tuple(host.get("journal", ())),
+            events=tuple(host.get("events", ())),
+            meta=meta)
+    return cb

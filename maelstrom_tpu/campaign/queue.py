@@ -1,0 +1,289 @@
+"""The resumable run queue: file-lock-claimed work items on shared disk.
+
+No daemon, no database — a campaign is a directory (the same
+philosophy as the store itself), so any number of workers on any hosts
+sharing the filesystem can drain one campaign:
+
+.. code-block:: text
+
+    store/campaigns/<name>-<ts>/
+      campaign.json            # the submitted spec + expansion record
+      items/item-0007.json     # one work item: opts + status + history
+      items/item-0007.lock     # O_EXCL claim (pid/host/time), while running
+      summary.json             # written by `campaign report`
+
+State machine per item (all transitions via write-temp-then-rename, so
+readers never see a torn item file)::
+
+    pending ──claim──> running ──finish──> done | failed
+       ^                  │
+       └── preempted <────┘  (worker died: stale lock detected)
+
+A claim is an ``O_CREAT | O_EXCL`` lock-file create — the one
+filesystem primitive that is atomic everywhere — so two workers can
+never run the same item. A worker killed mid-item leaves status
+``running`` with a lock whose pid is dead; any later claimer (or
+``campaign resume``) detects the stale lock, steals it atomically via
+rename, marks the item ``preempted``, and the item becomes claimable
+again — resumed from its run dir's last checkpoint rather than from
+tick zero (campaign/checkpoint.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import time
+from typing import Any, Dict, List, NamedTuple, Optional
+
+CAMPAIGN_FILE = "campaign.json"
+ITEMS_DIR = "items"
+CAMPAIGNS_SUBDIR = "campaigns"   # under the store root, so `serve`
+                                 # browses campaigns next to runs
+
+# item states
+PENDING = "pending"
+RUNNING = "running"
+DONE = "done"
+FAILED = "failed"
+PREEMPTED = "preempted"
+CLAIMABLE = (PENDING, PREEMPTED)
+
+
+class QueueError(ValueError):
+    """A campaign dir that cannot be used as a queue."""
+
+
+class Claim(NamedTuple):
+    """One successfully claimed item: update it via
+    :func:`finish_item` (which releases the lock)."""
+    item: Dict[str, Any]
+    path: str      # the item's JSON file
+    lock: str      # the held lock file
+
+
+def write_json_atomic(path: str, obj: Any) -> None:
+    tmp = f"{path}.tmp-{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(obj, f, indent=2, default=repr)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def submit_campaign(spec: Dict[str, Any], store_root: str) -> str:
+    """Expand ``spec`` and create the campaign dir + item files under
+    ``<store_root>/campaigns/``. Returns the campaign dir."""
+    from datetime import datetime
+
+    from .spec import expand_items
+    items = expand_items(spec)
+    ts = datetime.now().strftime("%Y%m%d-%H%M%S")
+    name = str(spec.get("name") or "campaign")
+    cdir = os.path.join(store_root, CAMPAIGNS_SUBDIR, f"{name}-{ts}")
+    for attempt in range(2, 100):
+        try:
+            os.makedirs(os.path.join(cdir, ITEMS_DIR), exist_ok=False)
+            break
+        except FileExistsError:
+            cdir = os.path.join(store_root, CAMPAIGNS_SUBDIR,
+                                f"{name}-{ts}-{attempt}")
+    write_json_atomic(os.path.join(cdir, CAMPAIGN_FILE),
+                      {"name": name, "spec": spec,
+                       "n-items": len(items),
+                       "submitted": time.time()})
+    for i, opts in enumerate(items):
+        write_json_atomic(
+            item_path(cdir, i),
+            {"id": i, "workload": opts["workload"], "opts": opts,
+             "status": PENDING, "attempts": 0, "run-dir": None,
+             "updated": time.time()})
+    return cdir
+
+
+def item_path(cdir: str, item_id: int) -> str:
+    return os.path.join(cdir, ITEMS_DIR, f"item-{item_id:04d}.json")
+
+
+def load_campaign(cdir: str) -> Dict[str, Any]:
+    p = os.path.join(cdir, CAMPAIGN_FILE)
+    if not os.path.exists(p):
+        raise QueueError(f"not a campaign dir (no {CAMPAIGN_FILE}): "
+                         f"{cdir}")
+    with open(p) as f:
+        return json.load(f)
+
+
+def list_items(cdir: str) -> List[Dict[str, Any]]:
+    """All items in id order (unreadable/torn files surface as status
+    ``"unreadable"`` rather than vanishing from the table)."""
+    d = os.path.join(cdir, ITEMS_DIR)
+    if not os.path.isdir(d):
+        raise QueueError(f"not a campaign dir (no {ITEMS_DIR}/): {cdir}")
+    out = []
+    for name in sorted(os.listdir(d)):
+        if not (name.startswith("item-") and name.endswith(".json")):
+            continue
+        p = os.path.join(d, name)
+        try:
+            with open(p) as f:
+                item = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            item = {"id": name, "status": "unreadable"}
+        item["_path"] = p
+        out.append(item)
+    return out
+
+
+def _worker_id() -> str:
+    return f"{socket.gethostname()}:{os.getpid()}"
+
+
+def _lock_stale(lock_path: str) -> bool:
+    """A lock is stale when its recorded pid is dead on THIS host.
+    Cross-host locks are never called stale automatically (no way to
+    probe liveness over shared disk) — ``requeue_stale`` with
+    ``force=True`` handles a lost remote worker."""
+    try:
+        with open(lock_path) as f:
+            info = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return False   # mid-write by a live claimer: not ours to steal
+    if info.get("host") != socket.gethostname():
+        return False
+    try:
+        os.kill(int(info.get("pid", -1)), 0)
+        return False
+    except (OSError, ValueError):
+        return True
+
+
+def _try_lock(lock_path: str) -> Optional[int]:
+    """Atomically create the claim lock; None when already held."""
+    try:
+        return os.open(lock_path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+    except FileExistsError:
+        return None
+
+
+def _steal_stale_lock(lock_path: str) -> bool:
+    """Atomically retire a stale lock: rename it aside (only ONE
+    stealer wins the rename), then the caller re-runs the normal
+    O_EXCL claim."""
+    retired = f"{lock_path}.stale-{os.getpid()}-{time.monotonic_ns()}"
+    try:
+        os.rename(lock_path, retired)
+    except OSError:
+        return False
+    try:
+        os.unlink(retired)
+    except OSError:
+        pass
+    return True
+
+
+def claim_next(cdir: str,
+               worker: Optional[str] = None) -> Optional[Claim]:
+    """Claim the lowest-id claimable item, or ``None`` when the queue
+    is drained. A ``running`` item whose lock is stale (its worker
+    died) is first flipped to ``preempted`` — its next claimer resumes
+    it from its checkpoint."""
+    worker = worker or _worker_id()
+    for item in list_items(cdir):
+        path = item.get("_path")
+        status = item.get("status")
+        if not path or status in (DONE, FAILED, "unreadable"):
+            continue
+        lock = path[:-len(".json")] + ".lock"
+        if status == RUNNING:
+            # a running item with a dead owner is preempted work
+            if not (os.path.exists(lock) and _lock_stale(lock)):
+                continue
+            if not _steal_stale_lock(lock):
+                continue   # another worker stole it first
+        fd = _try_lock(lock)
+        if fd is None:
+            if _lock_stale(lock) and _steal_stale_lock(lock):
+                fd = _try_lock(lock)
+            if fd is None:
+                continue
+        try:
+            os.write(fd, json.dumps(
+                {"pid": os.getpid(), "host": socket.gethostname(),
+                 "worker": worker, "claimed": time.time()}).encode())
+        finally:
+            os.close(fd)
+        # re-read under the lock: the item may have finished between
+        # the listing and the claim
+        try:
+            with open(path) as f:
+                item = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            os.unlink(lock)
+            continue
+        if item.get("status") not in CLAIMABLE + (RUNNING,):
+            os.unlink(lock)
+            continue
+        if item.get("status") == RUNNING:
+            item["status"] = PREEMPTED   # recorded for the history
+        prev_status = item["status"]
+        item.update(status=RUNNING, attempts=item.get("attempts", 0) + 1,
+                    **{"claimed-by": worker, "updated": time.time(),
+                       "resumed-from-checkpoint": False,
+                       "previous-status": prev_status})
+        item.pop("_path", None)
+        write_json_atomic(path, item)
+        return Claim(item=item, path=path, lock=lock)
+    return None
+
+
+def finish_item(claim: Claim, status: str,
+                **fields: Any) -> Dict[str, Any]:
+    """Transition a claimed item to ``done``/``failed`` (or back to
+    ``preempted`` on a handled interruption) and release the lock."""
+    item = dict(claim.item)
+    item.update(status=status, updated=time.time(), **fields)
+    write_json_atomic(claim.path, item)
+    try:
+        os.unlink(claim.lock)
+    except OSError:
+        pass
+    return item
+
+
+def requeue_stale(cdir: str, force: bool = False) -> List[int]:
+    """Flip dead-worker ``running`` items to ``preempted`` (claimable
+    again). ``force`` additionally reclaims lock-LESS and CROSS-HOST
+    running items — the operator's lever when a remote worker is known
+    lost. A live same-host lock is never stolen, force or not: its
+    worker is demonstrably still running the item."""
+    flipped = []
+    for item in list_items(cdir):
+        if item.get("status") != RUNNING:
+            continue
+        path = item["_path"]
+        lock = path[:-len(".json")] + ".lock"
+        if os.path.exists(lock):
+            stale = _lock_stale(lock)
+            if not stale and force:
+                # cross-host locks can't be liveness-probed; only
+                # --force may call them lost. Same-host live pids stay.
+                try:
+                    with open(lock) as f:
+                        stale = (json.load(f).get("host")
+                                 != socket.gethostname())
+                except (OSError, json.JSONDecodeError):
+                    stale = False
+        else:
+            stale = force   # running without a lock: crashed claimer
+        if not stale:
+            continue
+        if os.path.exists(lock) and not _steal_stale_lock(lock):
+            continue
+        item = dict(item)
+        item.pop("_path", None)
+        item.update(status=PREEMPTED, updated=time.time())
+        write_json_atomic(path, item)
+        flipped.append(item.get("id"))
+    return flipped

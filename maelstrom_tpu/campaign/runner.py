@@ -1,0 +1,172 @@
+"""Drain the queue; resume the dead. The campaign worker loop.
+
+``run_campaign`` is what ``maelstrom campaign run`` executes: claim the
+next item, run it through the pipelined executor via ``run_tpu_test``
+(fail-fast, heartbeat, funnel, and per-run triage all behave exactly as
+on a hand-run test), record the verdict on the item, repeat until the
+queue drains. Items default to periodic carry checkpoints
+(``checkpoint_every``), so a worker killed mid-item — the preempted-TPU
+-window case — leaves a claimable ``preempted`` item whose next claimer
+continues from the checkpoint instead of tick zero.
+
+``resume_run`` is the single-run face of the same machinery: given any
+killed run dir (campaign-managed or hand-run), rebuild the model and
+opts from the heartbeat's run-start record — the replay contract
+``maelstrom triage`` already relies on — restore the checkpoint, and
+finish the run bit-identically to an uninterrupted execution.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+import traceback
+from typing import Any, Dict, List, Optional
+
+from . import queue as q
+from .checkpoint import CheckpointError, load_checkpoint
+
+# campaign items checkpoint by default — durability is the point of
+# queueing a run (a hand-run test keeps checkpointing opt-in)
+DEFAULT_CHECKPOINT_EVERY = 4
+
+
+def build_model(workload: str, opts: Dict[str, Any],
+                model_config: Optional[Dict[str, Any]] = None):
+    """Registry lookup + the scalar-knob restore `maelstrom triage`
+    uses — campaign items and heartbeat resumes rebuild the identical
+    automaton the original run simulated."""
+    from ..checkers.triage import resolve_model
+    model = resolve_model({"workload": workload, "opts": opts,
+                           "model-config": model_config or {}})
+    # fresh runs (no recorded model-config yet) honor the key_count
+    # opt the way the CLI does; a recorded n_keys wins on resume
+    if opts.get("key_count") and hasattr(model, "n_keys") \
+            and "n_keys" not in (model_config or {}):
+        model.n_keys = opts["key_count"]
+    return model
+
+
+def resume_run(run_dir: str,
+               opts_override: Optional[Dict[str, Any]] = None
+               ) -> Dict[str, Any]:
+    """Resume one killed checkpointed run in place; returns the final
+    results dict (also written to the run dir's results.json),
+    bit-identical to the run executed uninterrupted."""
+    from ..checkers.triage import TriageError, load_run_info
+    from ..tpu.harness import run_tpu_test
+
+    run_dir = os.path.realpath(run_dir)
+    if load_checkpoint(run_dir) is None:
+        raise CheckpointError(
+            f"{run_dir} has no checkpoint/ to resume from — "
+            f"checkpointing is enabled with --checkpoint-every K "
+            f"(campaign items default to K={DEFAULT_CHECKPOINT_EVERY})")
+    try:
+        info = load_run_info(run_dir)
+    except TriageError as e:
+        raise CheckpointError(str(e))
+    opts = dict(info["opts"])
+    opts["seed"] = info["seed"]
+    opts.update(opts_override or {})
+    model = build_model(info["workload"], opts, info["model-config"])
+    return run_tpu_test(model, opts, resume_from=run_dir)
+
+
+def _run_item(claim: q.Claim, store_root: str,
+              overrides: Dict[str, Any],
+              triage_invalid: bool = False) -> Dict[str, Any]:
+    """Execute (or resume) one claimed item; returns the finished item
+    record."""
+    item = claim.item
+    opts = dict(item["opts"])
+    opts.setdefault("checkpoint_every", DEFAULT_CHECKPOINT_EVERY)
+    opts.setdefault("store_root", store_root)
+    opts.update(overrides)
+    workload = item["workload"]
+    prev_dir = item.get("run-dir")
+    t0 = time.monotonic()
+    try:
+        if prev_dir and load_checkpoint(prev_dir) is not None:
+            # a previous attempt died mid-run: continue its segments
+            results = resume_run(prev_dir, opts_override=overrides)
+            results.setdefault("store-dir", prev_dir)
+            resumed = True
+        else:
+            from ..tpu.harness import prepare_store_dir, run_tpu_test
+            model = build_model(workload, opts)
+            # record the run dir on the item BEFORE the run: a worker
+            # SIGKILLed mid-horizon leaves the item pointing at the
+            # dir whose checkpoint the next claimer resumes from
+            run_dir = prepare_store_dir(model.name, store_root,
+                                        tag=f"item{item['id']}")
+            item = dict(item, **{"run-dir": run_dir})
+            q.write_json_atomic(claim.path, item)
+            claim = claim._replace(item=item)
+            opts["store_dir"] = run_dir
+            results = run_tpu_test(model, opts)
+            resumed = False
+    except Exception as e:
+        return q.finish_item(
+            claim, q.FAILED, error=repr(e)[:500],
+            traceback=traceback.format_exc()[-2000:],
+            **{"wall-s": round(time.monotonic() - t0, 2)})
+    run_dir = results.get("store-dir")
+    if triage_invalid and results.get("valid?") is False and run_dir:
+        try:
+            from ..checkers.triage import triage_run
+            triage_run(run_dir)
+        except Exception:
+            pass   # forensics are best-effort; the verdict stands
+    return q.finish_item(
+        claim, q.DONE,
+        **{"run-dir": run_dir,
+           "valid?": results.get("valid?"),
+           "violating-instances": results.get("invariants", {})
+           .get("violating-instances"),
+           "msgs-per-sec": round(results.get("perf", {})
+                                 .get("msgs-per-sec", 0.0), 1),
+           "resumed-from-checkpoint": resumed,
+           "wall-s": round(time.monotonic() - t0, 2)})
+
+
+def run_campaign(cdir: str, store_root: Optional[str] = None,
+                 max_items: Optional[int] = None,
+                 overrides: Optional[Dict[str, Any]] = None,
+                 triage_invalid: bool = False,
+                 log=print) -> Dict[str, Any]:
+    """Drain the campaign queue from this process. Returns
+    ``{ran, done, failed, invalid, items}``; a queue another worker is
+    simultaneously draining shares fairly (claims are per-item locks).
+    """
+    cdir = os.path.realpath(cdir)
+    q.load_campaign(cdir)   # validates the dir
+    if store_root is None:
+        # store/campaigns/<name>/ -> store/ (items land next to
+        # hand-run tests, browsable by `maelstrom serve`)
+        store_root = os.path.dirname(os.path.dirname(cdir))
+    ran: List[Dict[str, Any]] = []
+    while max_items is None or len(ran) < max_items:
+        claim = q.claim_next(cdir)
+        if claim is None:
+            break
+        item = claim.item
+        log(f"== item {item['id']}: {item['workload']} "
+            f"(attempt {item['attempts']}"
+            + (", resuming" if item.get("run-dir") else "") + ")")
+        done = _run_item(claim, store_root, dict(overrides or {}),
+                         triage_invalid=triage_invalid)
+        verdict = done.get("valid?")
+        log(f"   -> {done['status']}"
+            + (f", valid? {verdict}" if done["status"] == q.DONE else
+               f": {done.get('error')}"))
+        ran.append(done)
+    return {
+        "ran": len(ran),
+        "done": sum(1 for r in ran if r["status"] == q.DONE),
+        "failed": sum(1 for r in ran if r["status"] == q.FAILED),
+        "invalid": sum(1 for r in ran
+                       if r["status"] == q.DONE
+                       and r.get("valid?") is not True),
+        "items": ran,
+    }

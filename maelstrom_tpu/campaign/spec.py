@@ -1,0 +1,96 @@
+"""Campaign specs: a declarative sweep matrix expanded into work items.
+
+A campaign file (JSON everywhere; TOML on Python 3.11+ where the
+stdlib ``tomllib`` exists — the container pins no third-party parser)
+declares what the Pulsar enterprise-benchmarking methodology calls a
+campaign matrix: every combination of workload x config x seed x
+horizon, each combination one queue item. Shape:
+
+.. code-block:: json
+
+    {
+      "name": "nightly-raft",
+      "defaults": {"time_limit": 1.0, "n_instances": 64,
+                   "checkpoint_every": 4},
+      "matrix": {"workload": ["lin-kv", "txn-rw-register"],
+                 "seed": [0, 1, 2],
+                 "nemesis": [[], ["partition"]]},
+      "items": [{"workload": "echo", "seed": 9, "time_limit": 0.5}]
+    }
+
+``matrix`` keys holding lists are swept (cartesian product, sorted key
+order); scalar keys are constants. ``defaults`` underlie every item;
+explicit ``items`` entries append verbatim (over defaults). Any
+``run_tpu_test`` opt is a valid key — ``workload`` (required) plus
+``node_count``/``topology``/``key_count`` select the model.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+from typing import Any, Dict, List
+
+# opt keys that select/construct the model rather than the SimConfig
+MODEL_KEYS = ("workload", "node_count", "topology", "key_count")
+
+
+class SpecError(ValueError):
+    """A campaign spec that cannot be parsed or expanded."""
+
+
+def load_spec(path: str) -> Dict[str, Any]:
+    """Parse a campaign file (.json, or .toml on py3.11+)."""
+    if path.endswith(".toml"):
+        try:
+            import tomllib
+        except ImportError:
+            raise SpecError(
+                "TOML campaign specs need Python 3.11+ (stdlib "
+                "tomllib); re-write the spec as JSON")
+        with open(path, "rb") as f:
+            spec = tomllib.load(f)
+    else:
+        with open(path) as f:
+            try:
+                spec = json.load(f)
+            except json.JSONDecodeError as e:
+                raise SpecError(f"{path}: not valid JSON ({e})")
+    if not isinstance(spec, dict):
+        raise SpecError(f"{path}: top level must be a table/object")
+    spec.setdefault(
+        "name", os.path.splitext(os.path.basename(path))[0])
+    return spec
+
+
+def expand_items(spec: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Expand a spec into the ordered work-item opt dicts.
+
+    Every item is a flat ``run_tpu_test``-style opts dict including
+    ``workload``; item ids are assigned by position (matrix rows in
+    sorted-key cartesian order, then explicit ``items``)."""
+    defaults = dict(spec.get("defaults") or {})
+    matrix = dict(spec.get("matrix") or {})
+    explicit = list(spec.get("items") or [])
+    out: List[Dict[str, Any]] = []
+    if matrix:
+        swept = {k: v for k, v in matrix.items() if isinstance(v, list)}
+        consts = {k: v for k, v in matrix.items()
+                  if not isinstance(v, list)}
+        keys = sorted(swept)
+        for combo in itertools.product(*(swept[k] for k in keys)):
+            item = {**defaults, **consts, **dict(zip(keys, combo))}
+            out.append(item)
+    for item in explicit:
+        if not isinstance(item, dict):
+            raise SpecError(f"items entry is not a table: {item!r}")
+        out.append({**defaults, **item})
+    if not out:
+        raise SpecError(
+            f"campaign {spec.get('name')!r} expands to zero items "
+            f"(empty matrix and no explicit items)")
+    for i, item in enumerate(out):
+        if not item.get("workload"):
+            raise SpecError(f"item {i} names no workload: {item!r}")
+    return out

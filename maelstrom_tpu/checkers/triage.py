@@ -118,11 +118,13 @@ def load_run_info(run_dir: str) -> Dict[str, Any]:
     }
 
 
-def _resolve_model(info: Dict[str, Any]):
+def resolve_model(info: Dict[str, Any]):
     """Rebuild the run's model: registry lookup by workload name, then
     restore the recorded scalar knobs — the original may have been
     constructed with non-default kwargs (log_cap, heartbeat, n_keys...)
-    and the bit-exact replay needs the identical automaton."""
+    and the bit-exact replay needs the identical automaton. Shared with
+    the campaign runner (``campaign/runner.py``), whose resumed runs
+    rest on the same model-identity contract."""
     from ..models import get_model
     opts = info["opts"]
     model = get_model(info["workload"], int(opts.get("node_count", 1)),
@@ -131,6 +133,9 @@ def _resolve_model(info: Dict[str, Any]):
         if hasattr(model, k):
             setattr(model, k, v)
     return model
+
+
+_resolve_model = resolve_model   # pre-rename internal alias
 
 
 def _journal_edn_lines(journal):

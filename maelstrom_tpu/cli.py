@@ -20,6 +20,11 @@ option specs :136-229):
   into a terminal report (doc/observability.md live-runs section)
 - ``triage`` — replay a run's flagged instances bit-exactly and emit
   per-instance forensics bundles (spacetime SVG + EDN journal + repro)
+- ``campaign`` — the durable control plane: ``submit`` a sweep matrix
+  as a resumable work queue, ``run`` drains it with periodic carry
+  checkpoints, ``status``/``watch --campaign`` follow it live,
+  ``resume`` continues killed work bit-exactly, ``report`` writes the
+  multi-run trend summary (doc/guide/09-campaigns.md)
 """
 
 from __future__ import annotations
@@ -175,6 +180,19 @@ def add_test_options(p: argparse.ArgumentParser):
                         "tripping instances per chunk instead of just "
                         "the argmin, and `maelstrom triage` replays "
                         "all of them (default 8)")
+    p.add_argument("--checkpoint-every", type=_nonnegative_int,
+                   default=0,
+                   help="TPU runtime: durable carry checkpoint every K "
+                        "chunks (0 = off). A checkpointed run killed "
+                        "at any point resumes BIT-EXACTLY via "
+                        "`maelstrom campaign resume <run-dir>` "
+                        "(doc/guide/09-campaigns.md)")
+    p.add_argument("--compile-cache", default=".jax_cache",
+                   help="persistent XLA compile cache dir (default "
+                        ".jax_cache; MAELSTROM_COMPILE_CACHE=0 or "
+                        "--compile-cache 0 disables) — resumed/queued "
+                        "runs skip recompiles; perf.phases records "
+                        "hit/miss counts")
     p.add_argument("--profile-dir", default=None,
                    help="TPU runtime: capture a jax.profiler trace of "
                         "the run into this directory")
@@ -333,6 +351,8 @@ def cmd_test(args) -> int:
             heartbeat=not args.no_heartbeat,
             fail_fast=args.fail_fast,
             scan_top_k=args.scan_top_k,
+            checkpoint_every=args.checkpoint_every,
+            compile_cache=args.compile_cache,
             node_count=node_count, concurrency=concurrency,
             rate=args.rate, time_limit=args.time_limit,
             latency=args.latency, latency_dist=args.latency_dist,
@@ -763,18 +783,54 @@ def cmd_fleet_stats(args) -> int:
     return 0
 
 
+def _watch_campaign(args) -> int:
+    """``watch --campaign``: tail EVERY item of a campaign dir — the
+    merged live table re-rendered each poll until the queue settles
+    (all items done/failed and no heartbeat still moving)."""
+    import time as _time
+
+    from .campaign.queue import DONE, FAILED, QueueError
+    from .campaign.report import campaign_status, render_status
+
+    try:
+        status = campaign_status(args.path)
+    except QueueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if not args.follow:
+        print(render_status(status))
+        settled = all(r["status"] in (DONE, FAILED)
+                      for r in status["items"])
+        return 0 if settled else 3
+    try:
+        while True:
+            status = campaign_status(args.path)
+            print(render_status(status), flush=True)
+            if all(r["status"] in (DONE, FAILED)
+                   for r in status["items"]):
+                return 0
+            _time.sleep(args.interval)
+            print()
+    except KeyboardInterrupt:
+        print()
+        return 130
+
+
 def cmd_watch(args) -> int:
     """Tail a run's streaming heartbeat into a terminal report — the
     live view of a fleet that used to be a black box until the final
     fetch (doc/observability.md). One-shot by default; --follow keeps
     tailing (new chunk records print as they land) until the run-end
-    record arrives or Ctrl-C."""
+    record arrives or Ctrl-C. ``--campaign`` tails a whole campaign
+    dir's items instead of one run."""
     import time as _time
 
     from .telemetry.stream import (heartbeat_path, read_heartbeat,
                                    render_chunk_line,
                                    render_watch_report)
 
+    if args.campaign:
+        return _watch_campaign(args)
     path = heartbeat_path(os.path.realpath(args.path))
     if not os.path.exists(path):
         print(f"error: no heartbeat at {args.path} (heartbeat.jsonl is "
@@ -804,7 +860,9 @@ def cmd_watch(args) -> int:
         while True:
             hb = read_heartbeat(path)
             for rec in hb["chunks"][printed:]:
-                print(render_chunk_line(rec))
+                # flush per line: a piped follow (CI smoke, tee) must
+                # see records as they land, not at block-buffer size
+                print(render_chunk_line(rec), flush=True)
             printed = len(hb["chunks"])
             if hb["end"] is not None:
                 end = hb["end"]
@@ -846,6 +904,86 @@ def cmd_triage(args) -> int:
         return 2
     print(render_triage_report(summary))
     return 0
+
+
+def cmd_campaign(args) -> int:
+    """The durable campaign control plane (doc/guide/09-campaigns.md):
+    submit a sweep matrix as a work queue, drain it from any number of
+    workers, watch it live, resume killed work from checkpoints, and
+    aggregate the trend summary the serve browser renders."""
+    from .campaign.checkpoint import CheckpointError
+    from .campaign.queue import (QueueError, load_campaign,
+                                 requeue_stale, submit_campaign)
+    from .campaign.report import (campaign_report, campaign_status,
+                                  render_report, render_status)
+    from .campaign.runner import resume_run, run_campaign
+    from .campaign.spec import SpecError, load_spec
+
+    try:
+        if args.action == "submit":
+            spec = load_spec(args.path)
+            cdir = submit_campaign(spec, args.store)
+            meta = load_campaign(cdir)
+            print(f"submitted campaign {meta['name']!r}: "
+                  f"{meta['n-items']} item(s)")
+            print(cdir)
+            return 0
+        if args.action == "run":
+            from .utils.compile_cache import enable_compile_cache
+            enable_compile_cache(args.compile_cache or ".jax_cache")
+            requeued = requeue_stale(args.path)
+            if requeued:
+                print(f"requeued {len(requeued)} preempted item(s): "
+                      f"{requeued}")
+            # only EXPLICIT flags override per-item spec opts (both
+            # flags default to None so 'not given' is distinguishable)
+            overrides = {}
+            if args.checkpoint_every is not None:
+                overrides["checkpoint_every"] = args.checkpoint_every
+            if args.compile_cache is not None:
+                overrides["compile_cache"] = args.compile_cache
+            summary = run_campaign(
+                args.path, max_items=args.max_items,
+                overrides=overrides, triage_invalid=args.triage)
+            print(f"\nran {summary['ran']} item(s): "
+                  f"{summary['done']} done "
+                  f"({summary['invalid']} invalid), "
+                  f"{summary['failed']} failed")
+            return 1 if (summary["failed"] or summary["invalid"]) else 0
+        if args.action == "status":
+            print(render_status(campaign_status(args.path)))
+            return 0
+        if args.action == "resume":
+            if os.path.exists(os.path.join(args.path, "campaign.json")):
+                # campaign dir: requeue dead work, then drain it
+                requeued = requeue_stale(args.path, force=args.force)
+                print(f"requeued {len(requeued)} preempted item(s)"
+                      + (f": {requeued}" if requeued else ""))
+                summary = run_campaign(args.path,
+                                       max_items=args.max_items,
+                                       triage_invalid=args.triage)
+                print(f"\nran {summary['ran']} item(s): "
+                      f"{summary['done']} done "
+                      f"({summary['invalid']} invalid), "
+                      f"{summary['failed']} failed")
+                return 1 if (summary["failed"] or summary["invalid"]) \
+                    else 0
+            # single run dir: finish it in place
+            results = resume_run(os.path.realpath(args.path))
+            print(json.dumps(results, indent=2, default=repr))
+            verdict = results.get("valid?")
+            return 0 if verdict is True else (
+                2 if verdict == "unknown" else 1)
+        if args.action == "report":
+            summary = campaign_report(
+                args.path, static_cost=not args.no_static_cost)
+            print(render_report(summary))
+            print(f"\nwrote {os.path.join(args.path, 'summary.json')}")
+            return 0
+    except (SpecError, QueueError, CheckpointError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    raise AssertionError(f"unhandled campaign action {args.action!r}")
 
 
 def cmd_lint(args) -> int:
@@ -962,6 +1100,11 @@ def main(argv=None) -> int:
                               "(or Ctrl-C); default is one shot")
     p_watch.add_argument("--interval", type=float, default=1.0,
                          help="--follow poll interval in seconds")
+    p_watch.add_argument("--campaign", action="store_true",
+                         help="PATH is a campaign dir: tail ALL items' "
+                              "heartbeats as one merged live table "
+                              "(terminates when every item is "
+                              "done/failed)")
 
     p_triage = sub.add_parser(
         "triage", help="replay a run's flagged instances and emit "
@@ -984,6 +1127,63 @@ def main(argv=None) -> int:
                           default=1500,
                           help="Lamport SVG event cap; beyond it the "
                                "diagram is annotated '+N elided'")
+
+    p_camp = sub.add_parser(
+        "campaign", help="durable sweep campaigns: submit a work-queue "
+                         "matrix, drain/resume it across process "
+                         "deaths, aggregate trend reports "
+                         "(doc/guide/09-campaigns.md)")
+    camp_sub = p_camp.add_subparsers(dest="action", required=True)
+    c_submit = camp_sub.add_parser(
+        "submit", help="expand a campaign spec (JSON; TOML on py3.11+) "
+                       "into a queued campaign dir")
+    c_submit.add_argument("path", help="campaign spec file")
+    c_submit.add_argument("--store", default="store")
+    c_run = camp_sub.add_parser(
+        "run", help="drain the queue: claim items, run them through "
+                    "the pipelined executor with periodic carry "
+                    "checkpoints; exit 1 if any item failed or was "
+                    "invalid")
+    c_run.add_argument("path", help="campaign dir (from submit)")
+    c_run.add_argument("--max-items", type=_positive_int, default=None)
+    c_run.add_argument("--checkpoint-every", type=_nonnegative_int,
+                       default=None,
+                       help="chunks between carry checkpoints "
+                            "(default 4; 0 disables)")
+    c_run.add_argument("--compile-cache", default=None,
+                       help="persistent XLA compile cache dir "
+                            "(default .jax_cache; an explicit flag "
+                            "also overrides per-item spec settings; "
+                            "MAELSTROM_COMPILE_CACHE=0 disables)")
+    c_run.add_argument("--triage", action="store_true",
+                       help="auto-run `maelstrom triage` on each "
+                            "invalid item's run dir")
+    c_status = camp_sub.add_parser(
+        "status", help="merge every item's heartbeat into one live "
+                       "table")
+    c_status.add_argument("path", help="campaign dir")
+    c_resume = camp_sub.add_parser(
+        "resume", help="campaign dir: requeue dead workers' items and "
+                       "drain (each resumes from its checkpoint); run "
+                       "dir: finish that one run in place, bit-"
+                       "identical to an uninterrupted execution")
+    c_resume.add_argument("path", help="campaign dir or run dir")
+    c_resume.add_argument("--max-items", type=_positive_int,
+                          default=None)
+    c_resume.add_argument("--force", action="store_true",
+                          help="also requeue running items with no/"
+                               "foreign locks (lost remote worker)")
+    c_resume.add_argument("--triage", action="store_true",
+                          help="auto-triage invalid items")
+    c_report = camp_sub.add_parser(
+        "report", help="aggregate completed items into "
+                       "<campaign>/summary.json trend rows (rendered "
+                       "by `maelstrom serve`)")
+    c_report.add_argument("path", help="campaign dir")
+    c_report.add_argument("--no-static-cost", action="store_true",
+                          help="skip the per-config ir_bytes_est "
+                               "column (one abstract trace per "
+                               "distinct model config)")
 
     p_lint = sub.add_parser(
         "lint", help="static analysis: trace-hygiene, contract, and "
@@ -1060,7 +1260,8 @@ def main(argv=None) -> int:
                 "doc": cmd_doc, "check": cmd_check,
                 "export": cmd_export, "lint": cmd_lint,
                 "fleet-stats": cmd_fleet_stats, "watch": cmd_watch,
-                "triage": cmd_triage}[args.command](args)
+                "triage": cmd_triage,
+                "campaign": cmd_campaign}[args.command](args)
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2

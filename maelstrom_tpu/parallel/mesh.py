@@ -225,6 +225,34 @@ def _carry_from_wire(w: Carry, sim: SimConfig) -> Carry:
     return carry_from_canonical(c, sim)
 
 
+def wire_template(model: Model, sim: SimConfig, mesh: Mesh, params=None):
+    """Abstract template (shapes/dtypes/treedef) of the GLOBAL wire
+    carry ``run_sim_sharded_chunked`` threads between dispatches: the
+    per-shard wire with every leading axis scaled by the shard count
+    (each leaf crosses the shard_map boundary under ``P(axes)``).
+    ``campaign/checkpoint.restore_carry`` validates a sharded
+    checkpoint against it on resume — a different mesh size fails the
+    shape check instead of silently mis-sharding."""
+    if params is None:
+        params = model.make_params(sim.net.n_nodes)
+    if params is None:
+        params = jnp.zeros((), jnp.int32)
+    n = int(mesh.devices.size)
+    shard = jax.eval_shape(
+        lambda p: _carry_to_wire(init_carry_abstract(model, sim, p),
+                                 sim), params)
+    return jax.tree.map(
+        lambda s: jax.ShapeDtypeStruct((s.shape[0] * n,) + s.shape[1:],
+                                       s.dtype), shard)
+
+
+def init_carry_abstract(model: Model, sim: SimConfig, params):
+    """One shard's init carry under eval_shape (seed value irrelevant —
+    only shapes/dtypes are consumed)."""
+    from ..tpu.runtime import init_carry
+    return init_carry(model, sim, 0, params)
+
+
 def make_sharded_chunk_fn(model: Model, sim: SimConfig, mesh: Mesh,
                           params, scan_k: int = DEFAULT_SCAN_TOP_K):
     """Build the sharded production dispatch step: the jitted,
@@ -280,7 +308,10 @@ def run_sim_sharded_chunked(model: Model, sim: SimConfig, seed: int,
                             return_telemetry: bool = False,
                             perf: Optional[dict] = None,
                             heartbeat=None, fail_fast: bool = False,
-                            scan_k: Optional[int] = None):
+                            scan_k: Optional[int] = None,
+                            checkpoint_cb=None,
+                            checkpoint_every: int = 0,
+                            resume=None):
     """:func:`run_sim_sharded` issued as a sequence of ``chunk``-tick
     device dispatches — the production dispatch pattern (single giant
     dispatches fault the TPU tunnel; see bench.py) — with the carry left
@@ -311,10 +342,19 @@ def run_sim_sharded_chunked(model: Model, sim: SimConfig, seed: int,
     Returns the same (psum'd NetStats, violations, events) triple —
     events concatenated on host along the tick axis — plus the merged
     per-instance telemetry when ``return_telemetry`` is set.
+
+    ``checkpoint_cb(wire, ticks, host)``/``checkpoint_every``/``resume``
+    are the campaign durability hooks (campaign/checkpoint.py), exactly
+    as on :func:`..tpu.pipeline.run_sim_pipelined` — the checkpointed
+    state is the WIRE carry (kind ``"sharded"``), and ``host`` carries
+    the dense per-chunk event blocks under ``"events"``. A resumed
+    sharded run needs the same mesh shape (the wire leaves' leading
+    axis bakes in the shard count; :func:`restore_carry` refuses a
+    mismatch).
     """
     import numpy as np
 
-    from ..tpu.pipeline import plan_chunks, run_chunked
+    from ..tpu.pipeline import resume_plans, run_chunked
     from ..tpu.runtime import init_carry
     from ..telemetry.stream import (combine_shard_scans,
                                     scan_to_violation,
@@ -326,7 +366,7 @@ def run_sim_sharded_chunked(model: Model, sim: SimConfig, seed: int,
     if scan_k is None:
         scan_k = DEFAULT_SCAN_TOP_K
 
-    plans = plan_chunks(sim.n_ticks, chunk)
+    plans = resume_plans(sim.n_ticks, chunk, resume)
 
     chunk_fn, wire_spec = make_sharded_chunk_fn(model, sim, mesh,
                                                 params, scan_k=scan_k)
@@ -340,8 +380,9 @@ def run_sim_sharded_chunked(model: Model, sim: SimConfig, seed: int,
             body, mesh=mesh, in_specs=(P(*axes), P()),
             out_specs=wire_spec)(seeds, params)
 
-    events_chunks = []
-    chunk_idx = [0]
+    events_chunks = ([np.asarray(e) for e in resume.events]
+                     if resume else [])
+    chunk_idx = [resume.chunks if resume else 0]
     tripped = [False]
 
     def dispatch(w, t0, length):
@@ -365,8 +406,24 @@ def run_sim_sharded_chunked(model: Model, sim: SimConfig, seed: int,
         chunk_idx[0] += 1
 
     should_stop = (lambda: tripped[0]) if fail_fast else None
-    wire, chunk_stats = run_chunked(init_fn(seeds, params), plans,
-                                    dispatch, consume, should_stop)
+    checkpoint = None
+    if checkpoint_cb is not None and checkpoint_every > 0:
+        def checkpoint(wire_st, ticks, _chunks):
+            checkpoint_cb(wire_st, ticks,
+                          {"events": list(events_chunks),
+                           "chunks": chunk_idx[0]})
+    if resume is not None:
+        wire0 = resume.carry
+    else:
+        wire0 = init_fn(seeds, params)
+    if plans:
+        wire, chunk_stats = run_chunked(
+            wire0, plans, dispatch, consume, should_stop,
+            checkpoint=checkpoint, checkpoint_every=checkpoint_every)
+    else:
+        wire = wire0
+        chunk_stats = {"chunks": 0, "ticks-dispatched":
+                       resume.ticks if resume else 0}
     if perf is not None:
         perf.update(chunk_stats)
 

@@ -35,7 +35,9 @@ img { max-width: 100%; border: 1px solid #ddd; }
 
 
 def _verdict(run_dir: str) -> Optional[object]:
-    for name in ("results.json",):
+    # campaign dirs carry their verdict in the trend summary
+    # (campaign/report.py); plain runs in results.json
+    for name in ("results.json", "summary.json"):
         p = os.path.join(run_dir, name)
         if os.path.exists(p):
             try:
@@ -88,11 +90,70 @@ def _index(store: str) -> bytes:
     return _page("maelstrom-tpu results", body)
 
 
+def _campaign_tables(d: str) -> str:
+    """The trend-store view of a campaign dir: per-item rows + the
+    per-workload trend aggregation from summary.json (written by
+    ``maelstrom campaign report``)."""
+    try:
+        with open(os.path.join(d, "summary.json")) as f:
+            s = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return ("<p>(no summary.json yet — run "
+                "<code>maelstrom campaign report</code>)</p>")
+    parts = ["<h2>Items</h2><table><tr><th>item</th><th>workload</th>"
+             "<th>seed</th><th>status</th><th>valid?</th><th>viol</th>"
+             "<th>msgs/s</th><th>ir bytes/tick</th><th>resumed</th>"
+             "<th>run</th></tr>"]
+    for r in s.get("items", ()):
+        v = r.get("valid?")
+        run_dir = r.get("run-dir") or ""
+        # run dirs live in the same store the server roots at; link
+        # relatively when they do
+        store_root = os.path.realpath(os.path.dirname(
+            os.path.dirname(d)))
+        rel = (os.path.relpath(os.path.realpath(run_dir), store_root)
+               if run_dir else "")
+        link = (f"<a href='/{html.escape(rel)}/'>"
+                f"{html.escape(os.path.basename(run_dir))}</a>"
+                if run_dir and not rel.startswith("..") else "")
+        parts.append(
+            f"<tr class='{_cls(v)}'><td>{r.get('id')}</td>"
+            f"<td>{html.escape(str(r.get('workload')))}</td>"
+            f"<td>{r.get('seed')}</td><td>{r.get('status')}</td>"
+            f"<td>{v}</td><td>{r.get('violating-instances') or 0}</td>"
+            f"<td>{r.get('msgs-per-sec') or '-'}</td>"
+            f"<td>{r.get('ir-bytes-est') or '-'}</td>"
+            f"<td>{'yes' if r.get('resumed') else '-'}</td>"
+            f"<td>{link}</td></tr>")
+    parts.append("</table><h2>Trends (per workload)</h2><table>"
+                 "<tr><th>workload</th><th>runs</th><th>done</th>"
+                 "<th>valid</th><th>invalid</th><th>failed</th>"
+                 "<th>viol</th><th>msgs/s mean</th><th>msgs/s max</th>"
+                 "<th>ir bytes/tick</th></tr>")
+    for wl in sorted(s.get("trends", {})):
+        t = s["trends"][wl]
+        cls = ("valid" if t["invalid"] == 0 and t["failed"] == 0
+               and t["done"] == t["runs"] else
+               "invalid" if t["invalid"] or t["failed"] else "")
+        parts.append(
+            f"<tr class='{cls}'><td>{html.escape(wl)}</td>"
+            f"<td>{t['runs']}</td><td>{t['done']}</td>"
+            f"<td>{t['valid']}</td><td>{t['invalid']}</td>"
+            f"<td>{t['failed']}</td><td>{t['violating-instances']}</td>"
+            f"<td>{t['msgs-per-sec-mean']}</td>"
+            f"<td>{t['msgs-per-sec-max']}</td>"
+            f"<td>{t.get('ir-bytes-est') or '-'}</td></tr>")
+    parts.append("</table>")
+    return "".join(parts)
+
+
 def _run_page(store: str, wl: str, run: str) -> bytes:
     d = os.path.join(store, wl, run)
     v = _verdict(d)
     parts = [f"<p>verdict: <span class='badge {_cls(v)}'>{v}</span> "
              f"&middot; <a href='/'>&larr; all runs</a></p>"]
+    if os.path.exists(os.path.join(d, "campaign.json")):
+        parts.append(_campaign_tables(d))
     files = sorted(os.listdir(d))
     svgs = [f for f in files if f.endswith(".svg")]
     others = [f for f in files if not f.endswith(".svg")]

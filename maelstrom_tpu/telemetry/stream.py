@@ -37,6 +37,11 @@ Record schema (all host-written; one JSON object per line):
 - ``{"type": "run-end", "status": "complete"|"stopped", ...}`` — last
   line on a clean exit; ABSENT on a crash (that absence is what
   ``maelstrom watch`` reports as a dead/partial run).
+- ``{"type": "resume", "from-ticks": t, ...}`` — a seam: ``maelstrom
+  campaign resume`` restored the run from its checkpoint
+  (campaign/checkpoint.py) and is APPENDING to the killed run's valid
+  prefix; chunk records continue at the absolute chunk cursor and the
+  eventual run-end covers the whole concatenated run.
 """
 
 from __future__ import annotations
@@ -146,19 +151,29 @@ class HeartbeatWriter:
 
     def __init__(self, run_dir: Optional[str] = None, *,
                  meta: Optional[Dict[str, Any]] = None,
-                 path: Optional[str] = None):
+                 path: Optional[str] = None,
+                 resume_from: Optional[int] = None):
         if path is None:
             if run_dir is None:
                 raise ValueError("HeartbeatWriter needs run_dir or path")
             path = os.path.join(run_dir, HEARTBEAT_FILE)
         self.path = path
-        self._f = open(path, "w")
+        # a resumed run APPENDS to the killed run's valid prefix: the
+        # original run-start header (with its repro opts) stays the
+        # authoritative first line, a "resume" record marks the seam,
+        # and chunk records continue at the absolute chunk cursor
+        self._f = open(path, "a" if resume_from is not None else "w")
         self._t0 = time.monotonic()
         self.chunks = 0
         self.ticks = 0
         self.first_violation: Optional[Dict[str, int]] = None
-        self._write({"type": "run-start", "schema": HEARTBEAT_SCHEMA,
-                     **(meta or {})})
+        if resume_from is not None:
+            self._write({"type": "resume", "schema": HEARTBEAT_SCHEMA,
+                         "from-ticks": int(resume_from),
+                         **(meta or {})})
+        else:
+            self._write({"type": "run-start",
+                         "schema": HEARTBEAT_SCHEMA, **(meta or {})})
 
     def _write(self, rec: Dict[str, Any]) -> None:
         self._f.write(json.dumps(rec, default=repr) + "\n")
@@ -189,7 +204,9 @@ class HeartbeatWriter:
             rec.update(extra)
         if violation is not None and self.first_violation is None:
             self.first_violation = dict(violation, chunk=int(chunk))
-        self.chunks += 1
+        # chunk indices are absolute (a resumed run continues the
+        # cursor), so the run-end summary counts the whole run
+        self.chunks = max(self.chunks + 1, int(chunk) + 1)
         self.ticks = max(self.ticks, int(t0) + int(ticks))
         self._write(rec)
 
@@ -239,6 +256,7 @@ def read_heartbeat(path: str) -> Dict[str, Any]:
     path = heartbeat_path(path)
     header: Optional[Dict[str, Any]] = None
     chunks: List[Dict[str, Any]] = []
+    resumes: List[Dict[str, Any]] = []
     end: Optional[Dict[str, Any]] = None
     skipped = 0
     with open(path) as f:
@@ -256,10 +274,16 @@ def read_heartbeat(path: str) -> Dict[str, Any]:
                 header = rec
             elif t == "chunk":
                 chunks.append(rec)
+            elif t == "resume":
+                # a seam: the process died and campaign resume picked
+                # the run back up from its checkpoint — chunk records
+                # continue; any premature end record is superseded
+                resumes.append(rec)
+                end = None
             elif t == "run-end":
                 end = rec
     return {"header": header, "chunks": chunks, "end": end,
-            "skipped": skipped}
+            "resumes": resumes, "skipped": skipped}
 
 
 def first_violation_of(hb: Dict[str, Any]) -> Optional[Dict[str, int]]:
@@ -348,6 +372,9 @@ def render_watch_report(hb: Dict[str, Any], path: str = "",
                else f" (last write {mtime_age_s:.0f}s ago)")
         lines.append(f"status: no run-end record — run still in "
                      f"progress or died{age}")
+    if hb.get("resumes"):
+        lines.append(f"({len(hb['resumes'])} resume seam(s) — the run "
+                     f"was continued from a checkpoint)")
     if hb.get("skipped"):
         lines.append(f"({hb['skipped']} unparseable line(s) skipped — "
                      f"torn tail from an interrupted writer)")

@@ -23,7 +23,8 @@ import numpy as np
 
 from .netsim import LATENCY_DISTS, NetConfig
 from .runtime import (ClientConfig, EV_FAIL, EV_INFO, EV_INVOKE, EV_NONE,
-                      EV_OK, Model, NemesisConfig, SimConfig, run_sim)
+                      EV_OK, Model, NemesisConfig, SimConfig,
+                      default_instance_ids, run_sim)
 from ..telemetry.recorder import TelemetryConfig
 
 MS_PER_TICK = 1  # default virtual clock resolution (override per run)
@@ -85,6 +86,19 @@ TPU_DEFAULTS = dict(
                               # heartbeat names the top-K earliest
                               # tripping instances, not just the argmin
                               # (tpu/pipeline.violation_scan)
+    checkpoint_every=0,       # chunks between durable carry checkpoints
+                              # (campaign/checkpoint.py; 0 = off). A
+                              # checkpointed run killed at ANY point
+                              # resumes bit-exactly via `maelstrom
+                              # campaign resume <run-dir>`
+    run_tag=None,             # store-dir suffix (<ts>-<tag>) so
+                              # concurrent runs sharing a test name get
+                              # collision-free dirs (campaign items
+                              # pass item<k>)
+    compile_cache=".jax_cache",  # persistent XLA compile cache dir
+                              # (resumed/queued runs skip recompiles;
+                              # MAELSTROM_COMPILE_CACHE=0 disables,
+                              # perf.phases gains hit/miss counts)
     seed=0,
 )
 
@@ -277,12 +291,14 @@ def resolve_pipeline(sim: SimConfig, opts: Dict[str, Any]) -> bool:
 def _pipelined_phase_run(model: Model, sim: SimConfig, seed: int, params,
                          opts: Dict[str, Any],
                          profile_dir: Optional[str] = None,
-                         heartbeat=None):
+                         heartbeat=None, checkpoint_cb=None,
+                         resume=None):
     """The chunked executor under the same phase-timer/profiler contract
     as :func:`_phase_timed_run`: returns (PipelineResult, phases) with
     the per-chunk dispatch/fetch/decode overlap stats under
-    ``phases["pipeline"]``. ``heartbeat``/``opts["fail_fast"]`` thread
-    through to :func:`..tpu.pipeline.run_sim_pipelined`."""
+    ``phases["pipeline"]``. ``heartbeat``/``opts["fail_fast"]``/
+    ``checkpoint_cb``/``resume`` thread through to
+    :func:`..tpu.pipeline.run_sim_pipelined`."""
     import jax
 
     from .pipeline import run_sim_pipelined
@@ -303,7 +319,10 @@ def _pipelined_phase_run(model: Model, sim: SimConfig, seed: int, params,
             event_cap=int(opts.get("event_capacity") or 0) or None,
             heartbeat=heartbeat,
             fail_fast=bool(opts.get("fail_fast")),
-            scan_k=int(opts.get("scan_top_k") or 1))
+            scan_k=int(opts.get("scan_top_k") or 1),
+            checkpoint_cb=checkpoint_cb,
+            checkpoint_every=int(opts.get("checkpoint_every") or 0),
+            resume=resume)
     finally:
         if profiling:
             try:
@@ -326,7 +345,11 @@ _REPRO_OPT_KEYS = (
     "n_instances", "record_instances", "journal_instances", "pool_slots",
     "inbox_k", "ms_per_tick", "layout", "telemetry", "telemetry_stride",
     "telemetry_hist_buckets", "chunk_ticks", "event_capacity", "seed",
-    "topology", "availability", "consistency_models", "key_count")
+    "topology", "availability", "consistency_models", "key_count",
+    # behavioral knobs `campaign resume` replays from the header so a
+    # resumed run re-runs under the SAME policy it started with
+    "pipeline", "fail_fast", "scan_top_k", "funnel", "funnel_max",
+    "checkpoint_every")
 
 
 def heartbeat_meta(model: Model, sim: SimConfig,
@@ -361,8 +384,22 @@ def heartbeat_meta(model: Model, sim: SimConfig,
 
 
 def run_tpu_test(model: Model, opts: Optional[Dict[str, Any]] = None,
-                 params=None) -> Dict[str, Any]:
+                 params=None,
+                 resume_from: Optional[str] = None) -> Dict[str, Any]:
+    """Configure, run, decode, check — one device test.
+
+    ``resume_from`` continues a checkpointed run IN PLACE: pass the
+    killed run's store dir (with ``opts`` rebuilt from its heartbeat
+    header — ``campaign.runner.resume_run`` does both), and the run
+    restores the carry + host accumulators from
+    ``<run_dir>/checkpoint/``, appends to the heartbeat, and overwrites
+    the run dir's artifacts with results bit-identical to an
+    uninterrupted run."""
+    from ..utils.compile_cache import (CacheStats, enable_compile_cache,
+                                       phase_record)
     opts = {**TPU_DEFAULTS, **(opts or {})}
+    cache_dir = enable_compile_cache(opts.get("compile_cache"))
+    cache_stats = CacheStats() if cache_dir else None
     sim = make_sim_config(model, opts)
     if params is None:
         params = model.make_params(sim.net.n_nodes)
@@ -371,8 +408,46 @@ def run_tpu_test(model: Model, opts: Optional[Dict[str, Any]] = None,
     # `maelstrom watch` / `triage` work on runs that die mid-horizon
     run_dir = None
     hb = None
-    if opts.get("store_root"):
-        run_dir = prepare_store_dir(model.name, opts["store_root"])
+    resume = None
+    if resume_from is not None:
+        from ..campaign.checkpoint import (CheckpointError,
+                                           load_checkpoint,
+                                           restore_carry)
+        from .pipeline import ResumeState, _init_pipelined
+        import jax
+        import jax.numpy as jnp
+        run_dir = os.path.realpath(resume_from)
+        ck = load_checkpoint(run_dir)
+        if ck is None:
+            raise CheckpointError(
+                f"{run_dir} has no checkpoint to resume from "
+                f"(checkpointing is opt-in: --checkpoint-every K)")
+        if ck["kind"] != "pipelined":
+            raise CheckpointError(
+                f"{run_dir} holds a {ck['kind']!r} checkpoint; "
+                f"run_tpu_test resumes single-device runs only")
+        # abstract template: restore_carry only needs treedef +
+        # shapes/dtypes — eval_shape avoids materializing (and then
+        # discarding) a full init carry on device at resume time
+        template = jax.eval_shape(
+            lambda: _init_pipelined(
+                model, sim, jnp.int32(opts["seed"]), params,
+                jnp.asarray(default_instance_ids(sim))))
+        resume = ResumeState(
+            carry=restore_carry(template, ck["carry"]),
+            ticks=int(ck["ticks"]), chunks=int(ck["chunks"]),
+            compact=tuple(ck["compact"]),
+            journal=tuple(ck["journal"]))
+        opts = {**opts, "pipeline": "on"}   # checkpoints are chunked
+    elif opts.get("store_dir"):
+        # caller pre-created (and recorded) the run dir — the campaign
+        # runner does, so a killed worker's item still knows where its
+        # checkpoint lives and the next claimer can resume it
+        run_dir = opts["store_dir"]
+        os.makedirs(run_dir, exist_ok=True)
+    elif opts.get("store_root"):
+        run_dir = prepare_store_dir(model.name, opts["store_root"],
+                                    tag=opts.get("run_tag"))
     use_pipe = resolve_pipeline(sim, opts)
     if opts.get("fail_fast") and not use_pipe:
         # fail-fast needs per-chunk dispatch to have anything to stop;
@@ -385,16 +460,44 @@ def run_tpu_test(model: Model, opts: Optional[Dict[str, Any]] = None,
               file=sys.stderr)
     if run_dir and opts.get("heartbeat", True):
         from ..telemetry.stream import HeartbeatWriter
-        hb = HeartbeatWriter(
-            run_dir, meta=dict(heartbeat_meta(model, sim, opts),
-                               pipeline=bool(use_pipe)))
+        if resume is not None:
+            hb = HeartbeatWriter(
+                run_dir, resume_from=resume.ticks,
+                meta={"workload": model.name,
+                      "chunks-done": resume.chunks})
+        else:
+            hb = HeartbeatWriter(
+                run_dir, meta=dict(heartbeat_meta(model, sim, opts),
+                                   pipeline=bool(use_pipe)))
+    checkpoint_cb = None
+    if int(opts.get("checkpoint_every") or 0) > 0:
+        if run_dir and use_pipe:
+            from ..campaign.checkpoint import make_checkpoint_cb
+            checkpoint_cb = make_checkpoint_cb(
+                run_dir, kind="pipelined",
+                meta={"workload": model.name,
+                      "seed": int(opts["seed"]),
+                      "layout": sim.layout,
+                      "chunk-ticks": int(opts.get("chunk_ticks")
+                                         or 100)})
+        else:
+            # durability the user asked for would silently not exist —
+            # say so (the --fail-fast note above sets the precedent)
+            import sys
+            why = ("no store dir to hold checkpoint/" if not run_dir
+                   else "the monolithic executor has no chunk "
+                        "boundaries to checkpoint at")
+            print(f"note: --checkpoint-every has no effect here "
+                  f"({why}); the run will NOT be resumable",
+                  file=sys.stderr)
     t0 = time.monotonic()
     pipe_res = None
     try:
         if use_pipe:
             pipe_res, phases = _pipelined_phase_run(
                 model, sim, opts["seed"], params, opts,
-                opts.get("profile_dir"), heartbeat=hb)
+                opts.get("profile_dir"), heartbeat=hb,
+                checkpoint_cb=checkpoint_cb, resume=resume)
             carry, events = pipe_res.carry, pipe_res.events
             journal_sends = pipe_res.journal_sends
             journal_recvs = pipe_res.journal_recvs
@@ -494,6 +597,9 @@ def run_tpu_test(model: Model, opts: Optional[Dict[str, Any]] = None,
     ticks_run = (pipe_stats["ticks-dispatched"]
                  if pipe_stats and pipe_stats.get("stopped-early")
                  else sim.n_ticks)
+    cache_rec = phase_record(opts.get("compile_cache"), cache_stats)
+    if cache_rec is not None:
+        phases["compile-cache"] = cache_rec
     results["perf"] = {
         "wall-s": wall,
         "ticks": ticks_run,
@@ -575,9 +681,9 @@ def run_tpu_test(model: Model, opts: Optional[Dict[str, Any]] = None,
             **({"drops": ns["drops"]} if drops is not None else {}),
             "instance": 0,
         }
-    if opts.get("store_root"):
-        _write_store(model.name, opts["store_root"], results, histories,
-                     journal, funnel=funnel, fleet=fleet,
+    if run_dir is not None:
+        _write_store(model.name, opts.get("store_root") or "", results,
+                     histories, journal, funnel=funnel, fleet=fleet,
                      store_dir=run_dir)
     if hb is not None:
         hb.finish(
@@ -636,22 +742,40 @@ def replay_instances(model: Model, opts: Dict[str, Any],
 
 
 def prepare_store_dir(name: str, store_root: str,
-                      suffix: str = "-tpu") -> str:
+                      suffix: str = "-tpu",
+                      tag: Optional[str] = None) -> str:
     """Create a run's store directory (and point the ``latest`` symlink
     at it) BEFORE the run starts, so live artifacts — the streaming
     heartbeat.jsonl — have somewhere to go while the fleet is still on
-    device. ``_write_store`` fills the same directory at the end."""
+    device. ``_write_store`` fills the same directory at the end.
+
+    Concurrency-safe: two runs sharing a test name get DISTINCT dirs
+    (``exist_ok=False`` + a collision counter — campaign items also
+    pass ``tag`` for human-readable ``<ts>-item<k>`` names) and the
+    ``latest`` symlink is repointed atomically (symlink-temp-then-
+    rename), so a reader never sees it missing or dangling mid-swap."""
     from datetime import datetime
     ts = datetime.now().strftime("%Y%m%d-%H%M%S-%f")
-    d = os.path.join(store_root, f"{name}{suffix}", ts)
-    os.makedirs(d, exist_ok=True)
-    latest = os.path.join(os.path.dirname(d), "latest")
+    base = f"{ts}-{tag}" if tag else ts
+    parent = os.path.join(store_root, f"{name}{suffix}")
+    d = os.path.join(parent, base)
+    for attempt in range(2, 100):
+        try:
+            os.makedirs(d, exist_ok=False)
+            break
+        except FileExistsError:
+            d = os.path.join(parent, f"{base}-{attempt}")
+    latest = os.path.join(parent, "latest")
     try:
-        if os.path.islink(latest):
-            os.unlink(latest)
-        os.symlink(os.path.basename(d), latest)
+        tmp = os.path.join(parent,
+                           f".latest-tmp-{os.getpid()}-{id(d)}")
+        os.symlink(os.path.basename(d), tmp)
+        os.replace(tmp, latest)   # atomic repoint — never unlink-first
     except OSError:
-        pass
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
     return d
 
 

@@ -80,6 +80,9 @@ def run_chunked(state0: Any, plans: List[Tuple[int, int]],
                 dispatch: Callable[[Any, int, int], Tuple[Any, Any]],
                 consume: Optional[Callable[[Any, int, int], None]] = None,
                 should_stop: Optional[Callable[[], bool]] = None,
+                checkpoint: Optional[Callable[[Any, int, int],
+                                              None]] = None,
+                checkpoint_every: int = 0,
                 ) -> Tuple[Any, Dict[str, float]]:
     """The double-buffered chunk loop shared by every chunked runner.
 
@@ -99,14 +102,24 @@ def run_chunked(state0: Any, plans: List[Tuple[int, int]],
     heartbeat/events are not lost. Stats then carry
     ``stopped-early: True`` and ``ticks-dispatched`` reports the ticks
     actually issued.
+
+    ``checkpoint(state, ticks_dispatched, chunks_done)`` is called every
+    ``checkpoint_every`` chunks (campaign/checkpoint.py's durable-resume
+    sink). At a checkpoint the in-flight chunk is consumed FIRST — the
+    host-side accumulators must cover exactly ``ticks_dispatched`` ticks
+    for the snapshot to be a consistent cut — so a checkpoint chunk
+    forgoes its fetch/compute overlap; amortized over K chunks. Stats
+    carry ``checkpoints`` and ``checkpoint-s``.
     """
     stats: Dict[str, Any] = {"chunks": len(plans),
                              "first-dispatch-s": 0.0,
                              "dispatch-s": 0.0, "consume-s": 0.0}
     st = state0
     pending: Optional[Tuple[Any, int, int]] = None
-    ticks_dispatched = 0
+    ticks_dispatched = plans[0][0] if plans else 0
     stopped = False
+    n_ckpt = 0
+    ckpt_s = 0.0
     for i, (t0, length) in enumerate(plans):
         tick0 = time.monotonic()
         st, payload = dispatch(st, t0, length)
@@ -119,6 +132,20 @@ def run_chunked(state0: Any, plans: List[Tuple[int, int]],
             consume(*pending)
             stats["consume-s"] += time.monotonic() - tick0
         pending = (payload, t0, length)
+        if checkpoint is not None and checkpoint_every > 0 \
+                and (i + 1) % checkpoint_every == 0 \
+                and i + 1 < len(plans):
+            # consistent cut: drain the in-flight payload so the host
+            # accumulators match the carry's tick frontier, then save
+            if consume is not None:
+                tick0 = time.monotonic()
+                consume(*pending)
+                stats["consume-s"] += time.monotonic() - tick0
+            pending = None
+            tick0 = time.monotonic()
+            checkpoint(st, ticks_dispatched, i + 1)
+            ckpt_s += time.monotonic() - tick0
+            n_ckpt += 1
         if should_stop is not None and should_stop():
             stopped = True
             break
@@ -127,9 +154,55 @@ def run_chunked(state0: Any, plans: List[Tuple[int, int]],
         consume(*pending)
         stats["consume-s"] += time.monotonic() - tick0
     stats["ticks-dispatched"] = ticks_dispatched
+    if n_ckpt:
+        stats["checkpoints"] = n_ckpt
+        stats["checkpoint-s"] = ckpt_s
     if stopped:
         stats["stopped-early"] = True
     return st, stats
+
+
+class ResumeState(NamedTuple):
+    """A restored mid-run cut to continue dispatch from (built by
+    ``campaign/checkpoint.py`` from an on-disk checkpoint).
+
+    ``carry`` is the restored device pytree (single-device ``Carry``
+    here; the sharded driver passes its wire carry), ``ticks`` the tick
+    frontier it represents, ``chunks`` the absolute consumed-chunk
+    cursor, and ``compact``/``journal``/``events`` the host-side
+    accumulators covering ticks ``[0, ticks)`` — so the resumed run's
+    decoded outputs span the FULL horizon, bit-identical to an
+    uninterrupted run."""
+    carry: Any
+    ticks: int
+    chunks: int = 0
+    compact: Tuple[Tuple[np.ndarray, int], ...] = ()
+    journal: Tuple[Tuple[np.ndarray, np.ndarray], ...] = ()
+    events: Tuple[np.ndarray, ...] = ()
+
+
+def resume_plans(n_ticks: int, chunk: int,
+                 resume: Optional["ResumeState"]
+                 ) -> List[Tuple[int, int]]:
+    """The dispatch plan of a (possibly resumed) run: the full-horizon
+    chunk plan, minus the prefix a resume already covers. Checkpoints
+    are taken at chunk boundaries of the SAME plan, so the remainder is
+    an exact suffix; a frontier off every boundary means the chunk plan
+    changed between run and resume — refused (the concatenated segments
+    could not be chunk-aligned, and chunk length is a compiled
+    constant)."""
+    plans = plan_chunks(n_ticks, chunk)
+    if resume is None:
+        return plans
+    rest = [p for p in plans if p[0] >= resume.ticks]
+    if resume.ticks >= n_ticks:
+        return []
+    if not rest or rest[0][0] != resume.ticks:
+        raise ValueError(
+            f"checkpoint tick frontier {resume.ticks} is not a chunk "
+            f"boundary of plan_chunks({n_ticks}, {chunk}) — resume "
+            f"with the original --chunk-ticks")
+    return rest
 
 
 # --- device-side first-violation scan -------------------------------------
@@ -402,7 +475,10 @@ def run_sim_pipelined(model: Model, sim: SimConfig, seed: int,
                       unroll: int = 1, heartbeat=None,
                       fail_fast: bool = False,
                       keep_compact: bool = False,
-                      scan_k: int = DEFAULT_SCAN_TOP_K) -> PipelineResult:
+                      scan_k: int = DEFAULT_SCAN_TOP_K,
+                      checkpoint_cb=None, checkpoint_every: int = 0,
+                      resume: Optional[ResumeState] = None
+                      ) -> PipelineResult:
     """Chunked, donated, double-buffered replacement for
     :func:`..tpu.runtime.run_sim` + the dense event fetch.
 
@@ -426,32 +502,54 @@ def run_sim_pipelined(model: Model, sim: SimConfig, seed: int,
     ``scan_k`` widens the per-chunk violation scan to the top-K earliest
     trippers (heartbeat ``violations`` lanes; K=1 is the argmin-only
     scan).
+
+    ``checkpoint_cb(carry, ticks, host)`` receives, every
+    ``checkpoint_every`` chunks, the carry at a consistent cut plus the
+    host accumulators (``{"compact", "journal", "chunks"}``) — the
+    campaign checkpoint sink (campaign/checkpoint.py). ``resume``
+    continues a checkpointed run: dispatch starts at its tick frontier
+    (the exact plan suffix, :func:`resume_plans`) and the returned
+    events/journal cover the FULL horizon, bit-identical to an
+    uninterrupted run.
     """
     if params is None:
         params = model.make_params(sim.net.n_nodes)
     if instance_ids is None:
         instance_ids = default_instance_ids(sim)
     R, C, V = sim.record_instances, sim.client.n_clients, model.ev_vals
-    plans = plan_chunks(sim.n_ticks, chunk)
-    cap = (event_capacity(sim, model, plans[0][1])
+    full_plans = plan_chunks(sim.n_ticks, chunk)
+    plans = resume_plans(sim.n_ticks, chunk, resume)
+    cap = (event_capacity(sim, model, full_plans[0][1])
            if not event_cap else int(event_cap))
     chunk_fn = make_chunk_fn(model, sim, params, instance_ids, cap,
                              unroll, scan_k=scan_k)
 
     t_init = time.monotonic()
-    # donation needs each leaf to own its buffer; init_carry broadcasts
-    # shared zero blocks across leaves, so copy before the first donate
-    st = _init_pipelined(model, sim, jnp.int32(seed), params,
-                         jnp.asarray(instance_ids, jnp.int32))
-    st = jax.tree.map(lambda x: x.copy(), st)
+    if resume is not None:
+        # the restored cut — campaign/checkpoint.restore_carry already
+        # copied each leaf into its own donation-safe buffer
+        st = resume.carry
+    else:
+        # donation needs each leaf to own its buffer; init_carry
+        # broadcasts shared zero blocks across leaves, so copy before
+        # the first donate
+        st = _init_pipelined(model, sim, jnp.int32(seed), params,
+                             jnp.asarray(instance_ids, jnp.int32))
+        st = jax.tree.map(lambda x: x.copy(), st)
     init_s = time.monotonic() - t_init
 
-    compact_chunks: List[Tuple[np.ndarray, int]] = []
-    journal_chunks: List[Tuple[np.ndarray, np.ndarray]] = []
+    compact_chunks: List[Tuple[np.ndarray, int]] = (
+        [(np.asarray(r), int(n)) for r, n in resume.compact]
+        if resume else [])
+    journal_chunks: List[Tuple[np.ndarray, np.ndarray]] = (
+        [(np.asarray(a), np.asarray(b)) for a, b in resume.journal]
+        if resume else [])
     fetched_bytes = [0]
     fetch_s = [0.0]
-    overflowed = [0]
-    chunk_idx = [0]
+    # prior segments' overflow flags persist (count > cap is the flag)
+    overflowed = [sum(1 for r, n in compact_chunks
+                      if n > r.shape[0])]
+    chunk_idx = [resume.chunks if resume else 0]
     last_scan: List[Optional[np.ndarray]] = [None]
     tripped = [False]
 
@@ -491,7 +589,22 @@ def run_sim_pipelined(model: Model, sim: SimConfig, seed: int,
         fetch_s[0] += time.monotonic() - t_f
 
     should_stop = (lambda: tripped[0]) if fail_fast else None
-    st, stats = run_chunked(st, plans, dispatch, consume, should_stop)
+    checkpoint = None
+    if checkpoint_cb is not None and checkpoint_every > 0:
+        def checkpoint(carry_st, ticks, _chunks):
+            checkpoint_cb(carry_st, ticks,
+                          {"compact": list(compact_chunks),
+                           "journal": list(journal_chunks),
+                           "chunks": chunk_idx[0]})
+    if plans:
+        st, stats = run_chunked(st, plans, dispatch, consume,
+                                should_stop, checkpoint=checkpoint,
+                                checkpoint_every=checkpoint_every)
+    else:
+        # resume of an already-complete horizon: nothing to dispatch
+        stats = {"chunks": 0, "first-dispatch-s": 0.0, "dispatch-s": 0.0,
+                 "consume-s": 0.0,
+                 "ticks-dispatched": resume.ticks if resume else 0}
     carry = jax.block_until_ready(st)
     ticks_done = stats["ticks-dispatched"]
 
@@ -511,7 +624,7 @@ def run_sim_pipelined(model: Model, sim: SimConfig, seed: int,
 
     dense_bytes = ticks_done * R * C * 2 * (2 + V) * 4
     perf = {
-        "chunk-ticks": plans[0][1],
+        "chunk-ticks": full_plans[0][1],
         "event-capacity": cap,
         "init-s": round(init_s, 4),
         # fetch-s: device-to-host payload transfers, overlapped with
@@ -524,6 +637,7 @@ def run_sim_pipelined(model: Model, sim: SimConfig, seed: int,
         "fetch-reduction-x": round(dense_bytes / fetched_bytes[0], 1)
         if fetched_bytes[0] else None,
         "overflowed-chunks": overflowed[0],
+        **({"resumed-from-ticks": resume.ticks} if resume else {}),
         **{k: round(v, 4) if isinstance(v, float) else v
            for k, v in stats.items() if k != "consume-s"},
     }

@@ -1,0 +1,106 @@
+"""Persistent XLA compile cache for production entrypoints.
+
+The tier-1 test suite has used a persistent compilation cache since
+PR 1 (tests/conftest.py); this wires the same lever into the paths
+users actually run — ``run_tpu_test``, ``bench.py``, and ``maelstrom
+campaign run`` — so a resumed or queued run re-dispatches in seconds
+instead of recompiling its chunk functions (the ROADMAP item-3
+"seconds-to-first-tick" down-payment).
+
+``MAELSTROM_COMPILE_CACHE`` overrides everything: ``0`` disables, any
+other value is the cache directory; otherwise the caller's
+``--compile-cache`` flag (default ``.jax_cache``) wins. Hit/miss counts
+come from jax's own monitoring events
+(``/jax/compilation_cache/cache_hits|cache_misses``) via a process-wide
+listener, and land in ``results.perf.phases["compile-cache"]``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, Optional
+
+ENV_VAR = "MAELSTROM_COMPILE_CACHE"
+DEFAULT_DIR = ".jax_cache"
+
+_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+_MISS_EVENT = "/jax/compilation_cache/cache_misses"
+
+_counts = {"hits": 0, "misses": 0}
+_lock = threading.Lock()
+_listener_installed = False
+
+
+def _listener(event: str, **kw: Any) -> None:
+    if event == _HIT_EVENT:
+        with _lock:
+            _counts["hits"] += 1
+    elif event == _MISS_EVENT:
+        with _lock:
+            _counts["misses"] += 1
+
+
+def resolve_cache_dir(flag: Optional[str]) -> Optional[str]:
+    """The effective cache dir: env override first, then the flag.
+    ``None`` means disabled."""
+    env = os.environ.get(ENV_VAR)
+    if env is not None:
+        if env.strip() in ("0", ""):
+            return None
+        return env
+    if flag in (None, "", "0"):
+        return None
+    return flag
+
+
+def enable_compile_cache(flag: Optional[str] = DEFAULT_DIR
+                         ) -> Optional[str]:
+    """Point jax's persistent compilation cache at the resolved dir and
+    install the hit/miss listener. Returns the absolute cache dir, or
+    ``None`` when disabled. Idempotent — safe to call per run."""
+    global _listener_installed
+    cache_dir = resolve_cache_dir(flag)
+    if cache_dir is None:
+        return None
+    import jax
+    cache_dir = os.path.abspath(cache_dir)
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          1.0)
+    except Exception:
+        return None   # ancient jax without the cache knobs: degrade
+    if not _listener_installed:
+        try:
+            from jax._src import monitoring
+            monitoring.register_event_listener(_listener)
+            _listener_installed = True
+        except Exception:
+            pass   # counters stay 0; the cache itself still works
+    return cache_dir
+
+
+class CacheStats:
+    """Bracket one run: ``snap = CacheStats(); ...; snap.delta()``."""
+
+    def __init__(self) -> None:
+        with _lock:
+            self._h0, self._m0 = _counts["hits"], _counts["misses"]
+
+    def delta(self) -> Dict[str, int]:
+        with _lock:
+            return {"hits": _counts["hits"] - self._h0,
+                    "misses": _counts["misses"] - self._m0}
+
+
+def phase_record(flag: Optional[str], stats: Optional[CacheStats]
+                 ) -> Optional[Dict[str, Any]]:
+    """The ``perf.phases["compile-cache"]`` block of one run."""
+    cache_dir = resolve_cache_dir(flag)
+    if cache_dir is None:
+        return None
+    rec: Dict[str, Any] = {"dir": os.path.abspath(cache_dir)}
+    if stats is not None:
+        rec.update(stats.delta())
+    return rec

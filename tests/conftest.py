@@ -56,6 +56,10 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "lanes: lane-liveness dataflow / manifest tests "
                    "(analysis/lane_liveness.py)")
+    config.addinivalue_line(
+        "markers", "campaign: durable control-plane tests — "
+                   "checkpoint/resume, run queue, trend store "
+                   "(maelstrom_tpu/campaign/)")
 
 
 def pytest_collection_modifyitems(config, items):

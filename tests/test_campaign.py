@@ -1,0 +1,644 @@
+"""Durable campaign control-plane tests (maelstrom_tpu/campaign/).
+
+Pins the PR's acceptance bars:
+
+- **checkpoint durability** — the write-temp-then-rename pivot means a
+  writer killed at ANY point leaves the previous checkpoint or the new
+  one, never a torn file;
+- **bit-exact resume** — a chunked run killed mid-horizon resumes from
+  its last checkpoint and produces decoded histories, fleet metrics,
+  and checker verdicts identical to the same run executed
+  uninterrupted, in BOTH carry layouts and through the sharded driver;
+  double-resume is idempotent;
+- **queue semantics** — file-lock claims are exclusive, a dead worker's
+  item is detected stale and re-claimed, and the item then resumes from
+  its recorded run dir's checkpoint;
+- **triage over segments** — `maelstrom triage` on a resumed run
+  replays the FULL dispatched horizon across the kill seam;
+- the `latest` symlink survives concurrent runs (atomic repoint) and
+  campaign items get collision-free run dirs.
+"""
+
+import copy
+import glob
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+import maelstrom_tpu.campaign.checkpoint as ckpt
+from maelstrom_tpu.campaign import queue as cqueue
+from maelstrom_tpu.campaign.checkpoint import (CheckpointError,
+                                               checkpoint_path,
+                                               load_checkpoint,
+                                               restore_carry,
+                                               save_checkpoint)
+from maelstrom_tpu.campaign.runner import resume_run, run_campaign
+from maelstrom_tpu.campaign.spec import SpecError, expand_items
+from maelstrom_tpu.models.echo import EchoModel
+from maelstrom_tpu.telemetry.stream import read_heartbeat
+from maelstrom_tpu.tpu.harness import (make_sim_config,
+                                       prepare_store_dir, run_tpu_test)
+from maelstrom_tpu.tpu.pipeline import (ResumeState, _init_pipelined,
+                                        resume_plans, run_sim_pipelined)
+
+pytestmark = pytest.mark.campaign
+
+# the shared tiny echo config: 300 ticks / chunk 50 = 6 chunks, small
+# enough that a handful of runs stays inside the tier-1 budget
+ECHO_OPTS = dict(node_count=2, concurrency=2, n_instances=8,
+                 record_instances=2, time_limit=0.3, rate=100.0,
+                 latency=5.0, seed=3, funnel=False, pipeline="on",
+                 chunk_ticks=50)
+
+# the planted violating model of test_stream_triage — resumed-run
+# triage must name its instances across the kill seam
+BUGGY_OPTS = dict(node_count=3, concurrency=6, n_instances=16,
+                  record_instances=4, inbox_k=1, pool_slots=16,
+                  time_limit=0.3, rate=200.0, latency=5.0,
+                  rpc_timeout=1.0, nemesis=["partition"],
+                  nemesis_interval=0.04, p_loss=0.05, recovery_time=0.0,
+                  seed=7, funnel=False, pipeline="on", chunk_ticks=50)
+
+
+class Killed(BaseException):
+    """Simulated SIGKILL: raised from the checkpoint sink so the run
+    dies immediately after a checkpoint lands (BaseException so no
+    well-meaning except-Exception path can swallow the 'kill')."""
+
+
+def _kill_after(n_saves):
+    """Patch campaign.checkpoint.save_checkpoint to die after the n-th
+    save; returns the restore thunk."""
+    orig = ckpt.save_checkpoint
+    calls = [0]
+
+    def dying(*a, **k):
+        path = orig(*a, **k)
+        calls[0] += 1
+        if calls[0] >= n_saves:
+            raise Killed
+        return path
+
+    ckpt.save_checkpoint = dying
+    return lambda: setattr(ckpt, "save_checkpoint", orig)
+
+
+def _strip(results):
+    """Everything that must be bit-identical across kill/resume: the
+    full results dict minus wall-clock perf and the store path."""
+    r = copy.deepcopy(results)
+    r.pop("perf", None)
+    r.pop("store-dir", None)
+    return json.loads(json.dumps(r, default=repr))
+
+
+# --- checkpoint durability -------------------------------------------------
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    model = EchoModel()
+    sim = make_sim_config(model, ECHO_OPTS)
+    params = model.make_params(sim.net.n_nodes)
+    res = run_sim_pipelined(model, sim, 3, params, chunk=50,
+                            keep_compact=True)
+    d = str(tmp_path)
+    save_checkpoint(d, kind="pipelined", state=res.carry, ticks=300,
+                    chunks=6, compact=tuple(res.compact),
+                    meta={"workload": "echo"})
+    ck = load_checkpoint(d)
+    assert ck["kind"] == "pipelined"
+    assert ck["ticks"] == 300 and ck["chunks"] == 6
+    assert len(ck["compact"]) == len(res.compact)
+    for (a, na), (b, nb) in zip(ck["compact"], res.compact):
+        assert na == nb and np.array_equal(a, np.asarray(b))
+    assert ck["meta"]["workload"] == "echo"
+    for a, b in zip(ck["carry"], jax.tree.leaves(res.carry)):
+        assert np.array_equal(a, np.asarray(b))
+
+
+def test_checkpoint_kill_mid_write_leaves_old_or_none(tmp_path,
+                                                      monkeypatch):
+    """Atomicity: a writer that dies mid-write (before the rename
+    pivot) leaves the PREVIOUS checkpoint fully intact — and a first
+    write that dies leaves no checkpoint, not a torn one."""
+    model = EchoModel()
+    sim = make_sim_config(model, ECHO_OPTS)
+    carry = _init_pipelined(model, sim, 3,
+                            model.make_params(sim.net.n_nodes),
+                            np.arange(8, dtype=np.int32))
+    d = str(tmp_path)
+
+    def torn_savez(f, **arrays):
+        f.write(b"\x00" * 37)   # partial garbage, then the "kill"
+        raise Killed
+
+    # first-ever write dies: no checkpoint must exist
+    monkeypatch.setattr(np, "savez", torn_savez)
+    with pytest.raises(Killed):
+        save_checkpoint(d, kind="pipelined", state=carry, ticks=50,
+                        chunks=1)
+    monkeypatch.undo()
+    assert load_checkpoint(d) is None
+    assert not glob.glob(checkpoint_path(d) + ".tmp-*")
+
+    # a good checkpoint, then a dying overwrite: the old one survives
+    save_checkpoint(d, kind="pipelined", state=carry, ticks=50,
+                    chunks=1)
+    monkeypatch.setattr(np, "savez", torn_savez)
+    with pytest.raises(Killed):
+        save_checkpoint(d, kind="pipelined", state=carry, ticks=100,
+                        chunks=2)
+    monkeypatch.undo()
+    ck = load_checkpoint(d)
+    assert ck is not None and ck["ticks"] == 50
+
+
+def test_restore_refuses_config_mismatch(tmp_path):
+    model = EchoModel()
+    sim = make_sim_config(model, ECHO_OPTS)
+    params = model.make_params(sim.net.n_nodes)
+    carry = _init_pipelined(model, sim, 3, params,
+                            np.arange(8, dtype=np.int32))
+    d = str(tmp_path)
+    save_checkpoint(d, kind="pipelined", state=carry, ticks=50,
+                    chunks=1)
+    ck = load_checkpoint(d)
+    other = make_sim_config(model, {**ECHO_OPTS, "n_instances": 16})
+    template = _init_pipelined(model, other, 3, params,
+                               np.arange(16, dtype=np.int32))
+    with pytest.raises(CheckpointError):
+        restore_carry(template, ck["carry"])
+
+
+def test_resume_plans_boundary_check():
+    assert resume_plans(300, 50, None) == [(0, 50), (50, 50), (100, 50),
+                                           (150, 50), (200, 50),
+                                           (250, 50)]
+    rs = ResumeState(carry=None, ticks=100)
+    assert resume_plans(300, 50, rs) == [(100, 50), (150, 50),
+                                         (200, 50), (250, 50)]
+    with pytest.raises(ValueError):
+        resume_plans(300, 50, ResumeState(carry=None, ticks=70))
+    assert resume_plans(300, 50, ResumeState(carry=None,
+                                             ticks=300)) == []
+
+
+# --- bit-exact resume ------------------------------------------------------
+
+
+@pytest.mark.parametrize("layout", ["lead", "minor"])
+def test_resume_bit_identical(tmp_path, layout):
+    """Kill after a mid-run checkpoint, resume, and the concatenated
+    segments equal the uninterrupted run — carry, decoded events,
+    telemetry leaves — in BOTH carry layouts."""
+    model = EchoModel()
+    sim = make_sim_config(model, {**ECHO_OPTS, "layout": layout})
+    params = model.make_params(sim.net.n_nodes)
+    base = run_sim_pipelined(model, sim, 3, params, chunk=50)
+
+    d = str(tmp_path)
+
+    def cb(state, ticks, host):
+        save_checkpoint(d, kind="pipelined", state=state, ticks=ticks,
+                        chunks=host["chunks"],
+                        compact=tuple(host["compact"]),
+                        journal=tuple(host["journal"]))
+        raise Killed
+
+    with pytest.raises(Killed):
+        run_sim_pipelined(model, sim, 3, params, chunk=50,
+                          checkpoint_cb=cb, checkpoint_every=2)
+    ck = load_checkpoint(d)
+    assert 0 < ck["ticks"] < sim.n_ticks
+    template = _init_pipelined(model, sim, 3, params,
+                               np.arange(8, dtype=np.int32))
+    resume = ResumeState(carry=restore_carry(template, ck["carry"]),
+                         ticks=ck["ticks"], chunks=ck["chunks"],
+                         compact=tuple(ck["compact"]),
+                         journal=tuple(ck["journal"]))
+    res = run_sim_pipelined(model, sim, 3, params, chunk=50,
+                            resume=resume)
+    assert res.perf["resumed-from-ticks"] == ck["ticks"]
+    assert res.perf["ticks-dispatched"] == sim.n_ticks
+    assert np.array_equal(base.events, res.events)
+    for a, b in zip(jax.tree.leaves(base.carry),
+                    jax.tree.leaves(res.carry)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.fixture(scope="module")
+def oracle_and_resumed(tmp_path_factory):
+    """One uninterrupted oracle run + one killed-then-resumed run of
+    the identical config, shared by the e2e equality tests below."""
+    oracle_store = str(tmp_path_factory.mktemp("oracle-store"))
+    killed_store = str(tmp_path_factory.mktemp("killed-store"))
+    opts = dict(ECHO_OPTS, checkpoint_every=2)
+    oracle = run_tpu_test(EchoModel(),
+                          dict(opts, store_root=oracle_store))
+    restore = _kill_after(1)
+    try:
+        with pytest.raises(Killed):
+            run_tpu_test(EchoModel(),
+                         dict(opts, store_root=killed_store))
+    finally:
+        restore()
+    (run_dir,) = glob.glob(os.path.join(killed_store, "echo-tpu", "2*"))
+    # the kill left checkpoint + heartbeat prefix, but no results
+    assert not os.path.exists(os.path.join(run_dir, "results.json"))
+    assert load_checkpoint(run_dir) is not None
+    resumed = resume_run(run_dir)
+    return oracle, resumed, run_dir
+
+
+def test_resume_run_matches_uninterrupted_oracle(oracle_and_resumed):
+    oracle, resumed, _ = oracle_and_resumed
+    assert _strip(oracle) == _strip(resumed)
+    assert resumed["valid?"] is True
+
+
+def test_resumed_store_artifacts_match(oracle_and_resumed):
+    """Decoded histories and fleet metrics on disk are byte-identical
+    to the uninterrupted run's."""
+    oracle, resumed, run_dir = oracle_and_resumed
+    odir = oracle["store-dir"]
+    for name in ("history-0.jsonl", "history-1.jsonl"):
+        with open(os.path.join(odir, name)) as a, \
+                open(os.path.join(run_dir, name)) as b:
+            assert a.read() == b.read()
+    with open(os.path.join(odir, "fleet-metrics.json")) as a, \
+            open(os.path.join(run_dir, "fleet-metrics.json")) as b:
+        assert json.load(a) == json.load(b)
+
+
+def test_resumed_heartbeat_has_seam_and_end(oracle_and_resumed):
+    _, _, run_dir = oracle_and_resumed
+    hb = read_heartbeat(run_dir)
+    assert len(hb["resumes"]) == 1
+    assert hb["resumes"][0]["from-ticks"] > 0
+    assert hb["end"] is not None
+    assert hb["end"]["status"] == "complete"
+    assert hb["end"]["ticks"] == 300
+
+
+def test_double_resume_idempotent(oracle_and_resumed):
+    """Resuming an already-finished run re-runs its tail segment from
+    the (still present) checkpoint and lands on the same results."""
+    oracle, _, run_dir = oracle_and_resumed
+    again = resume_run(run_dir)
+    assert _strip(again) == _strip(oracle)
+    hb = read_heartbeat(run_dir)
+    assert len(hb["resumes"]) == 2
+    assert hb["end"] is not None
+
+
+def test_resume_without_checkpoint_refused(tmp_path):
+    with pytest.raises(CheckpointError):
+        resume_run(str(tmp_path))
+
+
+@pytest.mark.slow
+def test_resume_sharded_bit_identical(tmp_path):
+    """The sharded driver checkpoints its wire carry and resumes
+    bit-identically (same mesh shape enforced by the restore check)."""
+    from maelstrom_tpu.parallel.mesh import (make_mesh,
+                                             run_sim_sharded_chunked,
+                                             wire_template)
+    model = EchoModel()
+    opts = dict(ECHO_OPTS, n_instances=4, time_limit=0.12)
+    sim = make_sim_config(model, opts)
+    mesh = make_mesh(2)
+    base = run_sim_sharded_chunked(model, sim, seed=3, mesh=mesh,
+                                   chunk=40)
+    d = str(tmp_path)
+
+    def cb(state, ticks, host):
+        save_checkpoint(d, kind="sharded", state=state, ticks=ticks,
+                        chunks=host["chunks"],
+                        events=tuple(host["events"]))
+        raise Killed
+
+    with pytest.raises(Killed):
+        run_sim_sharded_chunked(model, sim, seed=3, mesh=mesh,
+                                chunk=40, checkpoint_cb=cb,
+                                checkpoint_every=1)
+    ck = load_checkpoint(d)
+    assert ck["kind"] == "sharded" and 0 < ck["ticks"] < sim.n_ticks
+    tmpl = wire_template(model, sim, mesh)
+    resume = ResumeState(carry=restore_carry(tmpl, ck["carry"]),
+                         ticks=ck["ticks"], chunks=ck["chunks"],
+                         events=tuple(ck["events"]))
+    res = run_sim_sharded_chunked(model, sim, seed=3, mesh=mesh,
+                                  chunk=40, resume=resume)
+    assert base[0] == res[0]
+    assert np.array_equal(base[1], res[1])
+    assert np.array_equal(base[2], res[2])
+    # a wrong-size mesh is refused, never silently mis-sharded
+    with pytest.raises(CheckpointError):
+        restore_carry(wire_template(model, sim, make_mesh(4)),
+                      ck["carry"])
+
+
+def test_triage_on_resumed_run_covers_full_horizon(tmp_path):
+    """`maelstrom triage` on a killed-then-resumed run of the planted
+    double-vote mutant: the flagged instances replay over the FULL
+    dispatched horizon across both segments and re-trip."""
+    from maelstrom_tpu.checkers.triage import triage_run
+    from maelstrom_tpu.models.raft_buggy import RaftDoubleVote
+
+    def buggy():
+        return RaftDoubleVote(n_nodes_hint=3, log_cap=64, heartbeat=8)
+
+    store = str(tmp_path / "store")
+    opts = dict(BUGGY_OPTS, checkpoint_every=2, store_root=store)
+    oracle = run_tpu_test(buggy(), opts)
+    assert oracle["valid?"] is False
+    restore = _kill_after(1)
+    try:
+        with pytest.raises(Killed):
+            run_tpu_test(buggy(), dict(opts, store_root=str(
+                tmp_path / "killed")))
+    finally:
+        restore()
+    (run_dir,) = glob.glob(str(tmp_path / "killed" /
+                               "lin-kv-bug-double-vote-tpu" / "2*"))
+    resumed = resume_run(run_dir)
+    assert _strip(resumed) == _strip(oracle)
+    summary = triage_run(run_dir, max_instances=2)
+    assert summary["flagged"] == oracle["invariants"][
+        "violating-instance-ids"]
+    assert summary["ticks"] == 300   # full horizon, not the tail
+    assert summary["replayed-violating"] == len(summary["triaged"])
+
+
+# --- compile cache ---------------------------------------------------------
+
+
+def test_compile_cache_recorded_in_phases(tmp_path, monkeypatch):
+    cache = str(tmp_path / "cache")
+    try:
+        res = run_tpu_test(EchoModel(),
+                           dict(ECHO_OPTS, compile_cache=cache))
+        rec = res["perf"]["phases"]["compile-cache"]
+        assert rec["dir"] == os.path.abspath(cache)
+        assert rec["hits"] >= 0 and rec["misses"] >= 0
+        # disabled via env: no record, no cache writes
+        monkeypatch.setenv("MAELSTROM_COMPILE_CACHE", "0")
+        res2 = run_tpu_test(EchoModel(), dict(ECHO_OPTS))
+        assert "compile-cache" not in res2["perf"]["phases"]
+    finally:
+        # restore the suite-wide cache dir (tests/conftest.py)
+        jax.config.update("jax_compilation_cache_dir", os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            ".jax_cache"))
+
+
+# --- campaign spec + queue -------------------------------------------------
+
+
+def test_spec_matrix_expansion():
+    items = expand_items({
+        "name": "m",
+        "defaults": {"time_limit": 1.0},
+        "matrix": {"workload": ["echo", "g-set"], "seed": [0, 1],
+                   "rate": 50.0},
+        "items": [{"workload": "echo", "seed": 9}],
+    })
+    assert len(items) == 5
+    assert all(i["time_limit"] == 1.0 for i in items)
+    assert all(i.get("rate", 50.0) == 50.0 for i in items[:4])
+    combos = {(i["workload"], i["seed"]) for i in items[:4]}
+    assert combos == {("echo", 0), ("echo", 1), ("g-set", 0),
+                      ("g-set", 1)}
+    assert items[4] == {"time_limit": 1.0, "workload": "echo",
+                        "seed": 9}
+    with pytest.raises(SpecError):
+        expand_items({"name": "empty"})
+    with pytest.raises(SpecError):
+        expand_items({"matrix": {"seed": [1]}})   # no workload
+
+
+def _tiny_campaign(store, n=2):
+    return cqueue.submit_campaign(
+        {"name": "t", "items": [dict(ECHO_OPTS, workload="echo",
+                                     seed=s) for s in range(n)]},
+        store)
+
+
+def test_queue_claim_exclusive_and_ordered(tmp_path):
+    cdir = _tiny_campaign(str(tmp_path), n=3)
+    c0 = cqueue.claim_next(cdir, worker="w0")
+    assert c0.item["id"] == 0 and c0.item["status"] == "running"
+    c1 = cqueue.claim_next(cdir, worker="w1")
+    assert c1.item["id"] == 1   # the running item 0 is skipped
+    cqueue.finish_item(c0, cqueue.DONE, **{"valid?": True})
+    cqueue.finish_item(c1, cqueue.FAILED, error="boom")
+    c2 = cqueue.claim_next(cdir)
+    assert c2.item["id"] == 2
+    cqueue.finish_item(c2, cqueue.DONE, **{"valid?": True})
+    assert cqueue.claim_next(cdir) is None
+    statuses = [i["status"] for i in cqueue.list_items(cdir)]
+    assert statuses == ["done", "failed", "done"]
+
+
+def test_queue_stale_lock_reclaim(tmp_path):
+    """A worker that died holding an item: its lock pid is dead, the
+    item flips to preempted and the next claimer takes it over."""
+    import socket
+    cdir = _tiny_campaign(str(tmp_path), n=1)
+    claim = cqueue.claim_next(cdir, worker="doomed")
+    # forge the lock as a dead process on this host (pid 2**22+1 is
+    # beyond default pid_max)
+    with open(claim.lock, "w") as f:
+        json.dump({"pid": (1 << 22) + 1,
+                   "host": socket.gethostname()}, f)
+    again = cqueue.claim_next(cdir, worker="rescuer")
+    assert again is not None and again.item["id"] == 0
+    assert again.item["previous-status"] == "preempted"
+    assert again.item["attempts"] == 2
+    cqueue.finish_item(again, cqueue.DONE, **{"valid?": True})
+    # a live lock is NEVER stolen
+    cdir2 = _tiny_campaign(str(tmp_path / "c2"), n=1)
+    live = cqueue.claim_next(cdir2, worker="alive")
+    assert cqueue.claim_next(cdir2, worker="thief") is None
+    cqueue.finish_item(live, cqueue.DONE)
+
+
+def test_requeue_stale_flips_dead_running_items(tmp_path):
+    import socket
+    cdir = _tiny_campaign(str(tmp_path), n=2)
+    claim = cqueue.claim_next(cdir)
+    with open(claim.lock, "w") as f:
+        json.dump({"pid": (1 << 22) + 1,
+                   "host": socket.gethostname()}, f)
+    assert cqueue.requeue_stale(cdir) == [0]
+    assert cqueue.list_items(cdir)[0]["status"] == "preempted"
+
+
+def test_requeue_force_never_steals_live_same_host_lock(tmp_path):
+    """--force is for lock-less / cross-host items; a live same-host
+    lock means the worker is demonstrably running — never stolen."""
+    cdir = _tiny_campaign(str(tmp_path / "live"), n=1)
+    live = cqueue.claim_next(cdir)
+    assert cqueue.requeue_stale(cdir, force=True) == []
+    assert cqueue.list_items(cdir)[0]["status"] == "running"
+    cqueue.finish_item(live, cqueue.DONE)
+    # a lock-LESS running item is reclaimed only under force
+    cdir2 = _tiny_campaign(str(tmp_path / "lockless"), n=1)
+    c = cqueue.claim_next(cdir2)
+    os.unlink(c.lock)
+    assert cqueue.requeue_stale(cdir2) == []
+    assert cqueue.requeue_stale(cdir2, force=True) == [0]
+    # a cross-host lock (liveness unprobeable) also needs force
+    cdir3 = _tiny_campaign(str(tmp_path / "remote"), n=1)
+    c3 = cqueue.claim_next(cdir3)
+    with open(c3.lock, "w") as f:
+        json.dump({"pid": os.getpid(), "host": "some-other-host"}, f)
+    assert cqueue.requeue_stale(cdir3) == []
+    assert cqueue.requeue_stale(cdir3, force=True) == [0]
+
+
+def test_campaign_end_to_end_with_planted_bug(tmp_path):
+    """A 2-item campaign — clean echo + the planted double-vote mutant
+    — drains to done with the mutant flagged invalid, and the trend
+    report aggregates both."""
+    from maelstrom_tpu.campaign.report import (campaign_report,
+                                               campaign_status)
+    store = str(tmp_path)
+    cdir = cqueue.submit_campaign(
+        {"name": "e2e", "items": [
+            dict(ECHO_OPTS, workload="echo"),
+            dict(BUGGY_OPTS, workload="lin-kv-bug-double-vote"),
+        ]}, store)
+    summary = run_campaign(cdir, log=lambda *a, **k: None)
+    assert summary["ran"] == 2 and summary["done"] == 2
+    assert summary["failed"] == 0 and summary["invalid"] == 1
+    status = campaign_status(cdir)
+    assert status["counts"] == {"done": 2}
+    rep = campaign_report(cdir, static_cost=False)
+    assert rep["valid?"] is False
+    by_wl = rep["trends"]
+    assert by_wl["echo"]["valid"] == 1
+    assert by_wl["lin-kv-bug-double-vote"]["invalid"] == 1
+    assert os.path.exists(os.path.join(cdir, "summary.json"))
+    # items landed in the store with collision-free tagged dirs
+    runs = glob.glob(os.path.join(store, "*-tpu", "*item*"))
+    assert len(runs) == 2
+    # serve renders the campaign page with the trend table
+    from maelstrom_tpu.serve import _run_page
+    page = _run_page(store, cqueue.CAMPAIGNS_SUBDIR,
+                     os.path.basename(cdir)).decode()
+    assert "Trends (per workload)" in page
+    assert "lin-kv-bug-double-vote" in page
+
+
+# --- store-dir bugfix ------------------------------------------------------
+
+
+def test_prepare_store_dir_concurrent_collision_free(tmp_path):
+    """Two runs sharing a test name: distinct dirs, and `latest` always
+    resolves to an existing run dir mid-churn (atomic repoint)."""
+    store = str(tmp_path)
+    dirs, errors = [], []
+
+    def spin(k):
+        try:
+            for _ in range(8):
+                dirs.append(prepare_store_dir("echo", store))
+        except Exception as e:   # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=spin, args=(k,))
+               for k in range(4)]
+    stop = [False]
+    seen_bad = []
+
+    def reader():
+        latest = os.path.join(store, "echo-tpu", "latest")
+        while not stop[0]:
+            if os.path.lexists(latest) and not os.path.exists(latest):
+                seen_bad.append("dangling")   # pragma: no cover
+    watcher = threading.Thread(target=reader)
+    watcher.start()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stop[0] = True
+    watcher.join()
+    assert not errors
+    assert len(dirs) == len(set(dirs)) == 32
+    assert not seen_bad
+    latest = os.path.join(store, "echo-tpu", "latest")
+    assert os.path.isdir(os.path.realpath(latest))
+    # campaign items: human-readable tagged names
+    d = prepare_store_dir("echo", store, tag="item7")
+    assert d.endswith("-item7")
+
+
+# --- watch -----------------------------------------------------------------
+
+
+def _spawn_watch(args, cwd):
+    env = dict(os.environ,
+               PYTHONPATH=os.path.dirname(os.path.dirname(
+                   os.path.abspath(__file__))))
+    return subprocess.Popen(
+        [sys.executable, "-m", "maelstrom_tpu", "watch"] + args,
+        cwd=cwd, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True)
+
+
+def test_watch_follow_terminates_on_run_end(tmp_path):
+    """--follow exits 0 by itself once the run-end record lands (the
+    regression this satellite pins), and reports the resume seam."""
+    run = tmp_path / "run"
+    run.mkdir()
+    hb = open(run / "heartbeat.jsonl", "w")
+
+    def rec(obj):
+        hb.write(json.dumps(obj) + "\n")
+        hb.flush()
+
+    rec({"type": "run-start", "schema": 1, "workload": "echo",
+         "instances": 4, "ticks": 200, "chunk-ticks": 100})
+    proc = _spawn_watch(["run", "--follow", "--interval", "0.1"],
+                        str(tmp_path))
+    time.sleep(0.4)
+    rec({"type": "chunk", "chunk": 0, "t0": 0, "ticks": 100,
+         "wall-s": 0.1, "net": {"sent": 5, "delivered": 5},
+         "first-violation": None, "events-overflowed": False})
+    rec({"type": "resume", "schema": 1, "from-ticks": 100})
+    rec({"type": "chunk", "chunk": 1, "t0": 100, "ticks": 100,
+         "wall-s": 0.2, "net": {"sent": 9, "delivered": 9},
+         "first-violation": None, "events-overflowed": False})
+    rec({"type": "run-end", "status": "complete", "chunks": 2,
+         "ticks": 200, "wall-s": 0.5, "first-violation": None,
+         "valid?": True})
+    out, _ = proc.communicate(timeout=30)
+    assert proc.returncode == 0, out
+    assert "status: complete" in out
+    assert "chunk   1" in out
+
+
+def test_watch_campaign_mode(tmp_path):
+    cdir = _tiny_campaign(str(tmp_path), n=2)
+    c0 = cqueue.claim_next(cdir)
+    cqueue.finish_item(c0, cqueue.DONE, **{"valid?": True})
+    proc = _spawn_watch([os.path.relpath(cdir, str(tmp_path)),
+                         "--campaign"], str(tmp_path))
+    out, _ = proc.communicate(timeout=30)
+    assert proc.returncode == 3, out   # not settled: item 1 pending
+    assert "done 1" in out and "pending 1" in out
+    c1 = cqueue.claim_next(cdir)
+    cqueue.finish_item(c1, cqueue.FAILED, error="x")
+    proc = _spawn_watch([os.path.relpath(cdir, str(tmp_path)),
+                         "--campaign"], str(tmp_path))
+    out, _ = proc.communicate(timeout=30)
+    assert proc.returncode == 0, out   # settled

@@ -141,6 +141,73 @@ python -m maelstrom_tpu triage "$BUGGY_RUN" --max-instances 1
 # the flagged instance got its spacetime diagram + repro bundle
 ls "$BUGGY_RUN"/triage/instance-*/messages.svg
 ls "$BUGGY_RUN"/triage/instance-*/repro.json
+echo
+echo "== campaign smoke (submit -> SIGKILL mid-run -> resume -> oracle)"
+# a 2-item campaign: a clean echo sweep (long enough that the SIGKILL
+# lands mid-horizon) and the planted double-vote mutant. The worker is
+# SIGKILLed at its first checkpoint; `campaign resume` must requeue the
+# preempted item, resume it BIT-EXACTLY from the checkpoint, drain the
+# mutant, and exit 1 (the planted bug is invalid — that exit code IS
+# the assertion that per-item verdicts still gate).
+cat > "$SMOKE_STORE/camp.json" <<'JSON'
+{"name": "gate",
+ "items": [
+   {"workload": "echo", "node_count": 2, "concurrency": 2,
+    "n_instances": 8, "record_instances": 2, "time_limit": 0.6,
+    "rate": 100.0, "latency": 5.0, "seed": 3, "funnel": false,
+    "pipeline": "on", "chunk_ticks": 25, "checkpoint_every": 1},
+   {"workload": "lin-kv-bug-double-vote", "node_count": 3,
+    "concurrency": 6, "n_instances": 16, "record_instances": 4,
+    "inbox_k": 1, "pool_slots": 16, "time_limit": 0.3, "rate": 200.0,
+    "latency": 5.0, "rpc_timeout": 1.0, "nemesis": ["partition"],
+    "nemesis_interval": 0.04, "p_loss": 0.05, "recovery_time": 0.0,
+    "seed": 7, "funnel": false, "pipeline": "on", "chunk_ticks": 50}
+ ]}
+JSON
+python -m maelstrom_tpu campaign submit "$SMOKE_STORE/camp.json" \
+    --store "$SMOKE_STORE"
+CDIR=$(ls -d "$SMOKE_STORE"/campaigns/gate-*)
+python -u -m maelstrom_tpu campaign run "$CDIR" \
+    > "$SMOKE_STORE/campaign-run.log" 2>&1 &
+WORKER=$!
+for _ in $(seq 1 600); do
+    ls "$SMOKE_STORE"/echo-tpu/*/checkpoint/state.npz >/dev/null 2>&1 \
+        && break
+    sleep 0.1
+done
+kill -9 "$WORKER" 2>/dev/null || true
+wait "$WORKER" 2>/dev/null || true
+rc=0
+python -u -m maelstrom_tpu campaign resume "$CDIR" || rc=$?
+[[ "$rc" == "1" ]] || { echo "expected exit 1 (planted-bug item invalid), got $rc"; exit 1; }
+python -m maelstrom_tpu campaign report "$CDIR" --no-static-cost
+python - "$CDIR" "$SMOKE_STORE/camp.json" <<'PY'
+# the resumed echo item's verdict + traffic must match the SAME config
+# executed uninterrupted (the bit-exact resume contract, end to end)
+import json, sys
+cdir, spec_path = sys.argv[1], sys.argv[2]
+items = [json.load(open(f"{cdir}/items/item-{i:04d}.json"))
+         for i in (0, 1)]
+assert items[0]["status"] == "done" and items[0]["valid?"] is True, items[0]
+assert items[0]["resumed-from-checkpoint"] is True, \
+    "echo item was not resumed from its checkpoint"
+assert items[1]["status"] == "done" and items[1]["valid?"] is False, items[1]
+res = json.load(open(items[0]["run-dir"] + "/results.json"))
+from maelstrom_tpu.campaign.runner import build_model
+from maelstrom_tpu.tpu.harness import run_tpu_test
+opts = dict(json.load(open(spec_path))["items"][0])
+oracle = run_tpu_test(build_model(opts.pop("workload"), opts), opts)
+assert oracle["valid?"] is True
+assert res["net"] == {k: int(v) for k, v in oracle["net"].items()}, \
+    (res["net"], oracle["net"])
+assert res["invariants"] == json.loads(json.dumps(oracle["invariants"])), \
+    "resumed invariants differ from the uninterrupted oracle"
+summary = json.load(open(f"{cdir}/summary.json"))
+assert summary["valid?"] is False  # the mutant drags the campaign down
+print("campaign smoke: resumed verdicts match the uninterrupted "
+      "oracle; planted bug caught")
+PY
+
 # clean up before the exec below — bash runs no EXIT trap across exec
 rm -rf "$SMOKE_STORE"
 trap - EXIT
