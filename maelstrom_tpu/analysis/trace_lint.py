@@ -79,6 +79,10 @@ STATIC_PARAM_NAMES = {
     "self", "cls", "cfg", "ccfg", "nem", "sim", "model", "params",
     "n_nodes", "node_count", "seed", "interpret", "length", "checker",
     "opts", "mesh", "axes", "gossip_prob", "body_lanes",
+    # fault-plan engine (maelstrom_tpu/faults/): the compiled
+    # FaultConfig and its snapshot stride are trace-time constants,
+    # exactly like `nem`/`cfg`
+    "fx", "every",
 }
 
 # Attribute reads on tainted values that yield static metadata.
@@ -534,7 +538,8 @@ def default_trace_targets(repo_root: str) -> List[str]:
             "maelstrom_tpu/telemetry/recorder.py",
             "maelstrom_tpu/telemetry/stream.py",
             "maelstrom_tpu/checkers/triage.py",
-            "maelstrom_tpu/campaign/*.py"]
+            "maelstrom_tpu/campaign/*.py",
+            "maelstrom_tpu/faults/*.py"]
     out = []
     for p in pats:
         out.extend(sorted(glob.glob(os.path.join(repo_root, p))))

@@ -27,6 +27,20 @@ never run the same item. A worker killed mid-item leaves status
 rename, marks the item ``preempted``, and the item becomes claimable
 again — resumed from its run dir's last checkpoint rather than from
 tick zero (campaign/checkpoint.py).
+
+Locks are **leases**: every claim records ``lease-expires`` (now +
+``DEFAULT_LEASE_TTL`` seconds) and the worker renews it while the item
+runs (``runner.LeaseKeeper``, every TTL/3). Staleness is two-tiered:
+on the holder's own host the pid probe is authoritative (dead = stale
+immediately, alive = never stale, lapsed lease or not), and a
+cross-host lock is stale once its lease EXPIRES — a lost remote
+worker's items requeue by themselves on the next ``claim_next`` /
+``requeue_stale`` pass, no ``requeue_stale --force`` needed. ``force``
+stays the operator's lever for a remote worker known lost before its
+TTL runs out. Renewal forfeits rather than races: a renewer that finds
+its lock stolen or its lease already expired stops without writing, so
+only expired/dead locks — which have no live renewer — are ever
+stolen, and the claim's ``O_EXCL`` create remains the single arbiter.
 """
 
 from __future__ import annotations
@@ -140,23 +154,94 @@ def _worker_id() -> str:
     return f"{socket.gethostname()}:{os.getpid()}"
 
 
+# lease duration written on every claim/renewal. Workers renew at
+# TTL/3 (runner.LeaseKeeper), so a healthy worker's lease is always
+# comfortably fresh and an expired lease means its holder is gone —
+# on any host.
+DEFAULT_LEASE_TTL = 300.0
+
+
+def _lease_body(worker: str, ttl: float = DEFAULT_LEASE_TTL) -> dict:
+    now = time.time()
+    return {"pid": os.getpid(), "host": socket.gethostname(),
+            "worker": worker, "claimed": now,
+            "lease-expires": now + ttl}
+
+
+def lease_is_ours(lock_path: str, worker: Optional[str] = None) -> bool:
+    """Does ``lock_path`` still hold OUR live lease? False when the
+    lock is gone, held by another worker (stolen and re-claimed), or
+    our lease already expired (lost — a stealer may be mid-claim).
+    The renewal path's terminal test, shared with
+    ``runner.LeaseKeeper`` so a transient read error is
+    distinguishable from a genuinely lost lease."""
+    worker = worker or _worker_id()
+    try:
+        with open(lock_path) as f:
+            info = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return False
+    if info.get("worker") != worker:
+        return False
+    expires = info.get("lease-expires")
+    return expires is None or time.time() <= float(expires)
+
+
+def renew_lease(lock_path: str, worker: Optional[str] = None,
+                ttl: float = DEFAULT_LEASE_TTL) -> bool:
+    """Refresh a held lock's lease (write-temp-then-rename, so readers
+    never see a torn lock). Returns False — and writes nothing — when
+    the lease is no longer ours (:func:`lease_is_ours`: gone, stolen,
+    or lapsed; a stealer may be mid-claim and our replace would
+    clobber their O_EXCL lock — the renewer forfeits instead) or the
+    write itself failed. With the forfeit checks, a steal can only
+    happen to an expired or dead-pid lock, neither of which has a
+    live renewer, so renewal and stealing never race on a healthy
+    clock."""
+    worker = worker or _worker_id()
+    if not lease_is_ours(lock_path, worker):
+        return False
+    tmp = f"{lock_path}.renew-{os.getpid()}"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(_lease_body(worker, ttl), f)
+        if not os.path.exists(lock_path):
+            os.unlink(tmp)
+            return False
+        os.replace(tmp, lock_path)
+        return True
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+
+
 def _lock_stale(lock_path: str) -> bool:
-    """A lock is stale when its recorded pid is dead on THIS host.
-    Cross-host locks are never called stale automatically (no way to
-    probe liveness over shared disk) — ``requeue_stale`` with
-    ``force=True`` handles a lost remote worker."""
+    """A lock is stale when its holder is provably or presumably gone:
+
+    - same host: the pid probe is authoritative — a LIVE local pid is
+      never stale (even with a lapsed lease: a stopped/swapping worker
+      that missed renewals is still running the item), a dead one is
+      stale immediately.
+    - cross host (unprobeable): stale iff the lease EXPIRED, so a lost
+      remote worker's items requeue without ``requeue_stale --force``.
+      Pre-lease locks (no ``lease-expires``) keep the old rule: never
+      auto-stale."""
     try:
         with open(lock_path) as f:
             info = json.load(f)
     except (OSError, json.JSONDecodeError):
         return False   # mid-write by a live claimer: not ours to steal
-    if info.get("host") != socket.gethostname():
-        return False
-    try:
-        os.kill(int(info.get("pid", -1)), 0)
-        return False
-    except (OSError, ValueError):
-        return True
+    if info.get("host") == socket.gethostname():
+        try:
+            os.kill(int(info.get("pid", -1)), 0)
+            return False
+        except (OSError, ValueError):
+            return True
+    expires = info.get("lease-expires")
+    return expires is not None and time.time() > float(expires)
 
 
 def _try_lock(lock_path: str) -> Optional[int]:
@@ -209,9 +294,7 @@ def claim_next(cdir: str,
             if fd is None:
                 continue
         try:
-            os.write(fd, json.dumps(
-                {"pid": os.getpid(), "host": socket.gethostname(),
-                 "worker": worker, "claimed": time.time()}).encode())
+            os.write(fd, json.dumps(_lease_body(worker)).encode())
         finally:
             os.close(fd)
         # re-read under the lock: the item may have finished between
@@ -254,10 +337,13 @@ def finish_item(claim: Claim, status: str,
 
 def requeue_stale(cdir: str, force: bool = False) -> List[int]:
     """Flip dead-worker ``running`` items to ``preempted`` (claimable
-    again). ``force`` additionally reclaims lock-LESS and CROSS-HOST
-    running items — the operator's lever when a remote worker is known
-    lost. A live same-host lock is never stolen, force or not: its
-    worker is demonstrably still running the item."""
+    again). With lease-carrying locks this is automatic for ANY host:
+    an expired lease is stale wherever its worker ran. ``force`` is
+    the operator's lever for a remote worker KNOWN lost before its
+    lease runs out: it additionally reclaims lock-LESS items and
+    cross-host locks regardless of lease freshness. A live same-host
+    lock is never stolen, force or not — its worker is demonstrably
+    still running the item."""
     flipped = []
     for item in list_items(cdir):
         if item.get("status") != RUNNING:
@@ -267,8 +353,10 @@ def requeue_stale(cdir: str, force: bool = False) -> List[int]:
         if os.path.exists(lock):
             stale = _lock_stale(lock)
             if not stale and force:
-                # cross-host locks can't be liveness-probed; only
-                # --force may call them lost. Same-host live pids stay.
+                # cross-host locks can't be liveness-probed; --force is
+                # the operator asserting the remote worker is lost, so
+                # it overrides even an unexpired lease. Same-host live
+                # pids always stay.
                 try:
                     with open(lock) as f:
                         stale = (json.load(f).get("host")

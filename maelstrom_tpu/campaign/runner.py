@@ -31,6 +31,44 @@ from .checkpoint import CheckpointError, load_checkpoint
 DEFAULT_CHECKPOINT_EVERY = 4
 
 
+class LeaseKeeper:
+    """Background renewal of a claimed item's lock lease while the item
+    runs (``queue.renew_lease`` every TTL/3). A worker that dies stops
+    renewing, the lease expires, and any host's next claim/requeue pass
+    flips the item preempted — the cross-host liveness signal pid
+    probing can't provide. Daemon thread: a SIGKILL kills it with the
+    worker, which is exactly the point."""
+
+    def __init__(self, lock_path: str,
+                 ttl: float = q.DEFAULT_LEASE_TTL):
+        import threading
+        self._lock = lock_path
+        self._ttl = ttl
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="lease-keeper", daemon=True)
+
+    def _run(self):
+        while not self._stop.wait(self._ttl / 3.0):
+            if q.renew_lease(self._lock, ttl=self._ttl):
+                continue
+            # renewal failed: stop ONLY when the lease is genuinely
+            # lost (finished, stolen, or lapsed). A transient write
+            # error (NFS blip, ENOSPC) while the lease is still ours
+            # must keep retrying — giving up would let the lease
+            # expire under a live worker and invite a double claim.
+            if not q.lease_is_ours(self._lock):
+                return
+
+    def __enter__(self):
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._stop.set()
+        self._thread.join(timeout=2.0)
+
+
 def build_model(workload: str, opts: Dict[str, Any],
                 model_config: Optional[Dict[str, Any]] = None):
     """Registry lookup + the scalar-knob restore `maelstrom triage`
@@ -154,8 +192,9 @@ def run_campaign(cdir: str, store_root: Optional[str] = None,
         log(f"== item {item['id']}: {item['workload']} "
             f"(attempt {item['attempts']}"
             + (", resuming" if item.get("run-dir") else "") + ")")
-        done = _run_item(claim, store_root, dict(overrides or {}),
-                         triage_invalid=triage_invalid)
+        with LeaseKeeper(claim.lock):
+            done = _run_item(claim, store_root, dict(overrides or {}),
+                             triage_invalid=triage_invalid)
         verdict = done.get("valid?")
         log(f"   -> {done['status']}"
             + (f", valid? {verdict}" if done["status"] == q.DONE else

@@ -22,7 +22,11 @@ horizon, each combination one queue item. Shape:
 order); scalar keys are constants. ``defaults`` underlie every item;
 explicit ``items`` entries append verbatim (over defaults). Any
 ``run_tpu_test`` opt is a valid key — ``workload`` (required) plus
-``node_count``/``topology``/``key_count`` select the model.
+``node_count``/``topology``/``key_count``/``crash_clients``/
+``txn_dirty_apply`` select the model, and ``fault_plan`` (an inline
+plan dict, doc/guide/10-faults.md) or fault ``nemesis`` kinds put a
+whole fault campaign — crash-restart, link degradation, clock skew —
+in the queue like any other sweep axis.
 """
 
 from __future__ import annotations

@@ -47,6 +47,32 @@ def _hashable(v):
         return repr(v)
 
 
+def mark_reassigned_after_crashes(history):
+    """Crash-clients mode (device/TPU runtime): tag each process's
+    first ok poll AFTER a crash completion as ``reassigned``.
+
+    The native engine writes the flag onto its own records at reopen
+    time; device clients are stateless rows, so the flag is derived
+    host-side from the history order instead — sound because a client
+    runs one op at a time, so any poll completed after its crash
+    completed was necessarily served from the broker's already-reset
+    cursor. Returns a new history (records are copied before
+    mutation)."""
+    crashed = set()
+    out = []
+    for r in history:
+        f = r.get("f")
+        proc = r.get("process")
+        if f == "crash" and r.get("type") not in (None, "invoke"):
+            crashed.add(proc)
+        elif (f == "poll" and r.get("type") == "ok"
+              and proc in crashed):
+            r = dict(r, reassigned=True)
+            crashed.discard(proc)
+        out.append(r)
+    return out
+
+
 def kafka_checker(history) -> dict:
     from ..gen.history import pairs
     anomalies: Dict[str, List[Any]] = defaultdict(list)

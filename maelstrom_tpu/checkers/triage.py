@@ -128,7 +128,7 @@ def resolve_model(info: Dict[str, Any]):
     from ..models import get_model
     opts = info["opts"]
     model = get_model(info["workload"], int(opts.get("node_count", 1)),
-                      opts.get("topology") or "grid")
+                      opts.get("topology") or "grid", opts=opts)
     for k, v in info.get("model-config", {}).items():
         if hasattr(model, k):
             setattr(model, k, v)

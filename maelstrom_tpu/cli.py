@@ -87,8 +87,29 @@ def add_test_options(p: argparse.ArgumentParser):
     p.add_argument("--latency-dist", default="exponential",
                    choices=["constant", "uniform", "exponential"])
     p.add_argument("--nemesis", action="append", default=[],
-                   choices=["partition"])
+                   choices=["partition", "crash-restart", "link-degrade",
+                            "clock-skew"],
+                   help="fault kinds, composable (repeat the flag). "
+                        "'partition' runs everywhere; the fault-plan "
+                        "kinds (crash-restart, link-degrade, "
+                        "clock-skew) are device-resident TPU-runtime "
+                        "lanes generated on the nemesis interval grid "
+                        "(maelstrom_tpu/faults/, doc/guide/10-faults"
+                        ".md)")
     p.add_argument("--nemesis-interval", type=float, default=10.0)
+    p.add_argument("--fault-plan", default=None,
+                   help="TPU runtime: JSON fault-plan file (phases of "
+                        "crash-restart / link-degradation / clock-skew "
+                        "lanes; doc/guide/10-faults.md). Mutually "
+                        "exclusive with the generated fault --nemesis "
+                        "kinds; composes with --nemesis partition")
+    p.add_argument("--fault-snapshot-every", type=_positive_int,
+                   default=None,
+                   help="TPU runtime: ticks between crash-recovery "
+                        "snapshot-slab captures (default: the plan's "
+                        "own snapshot_every, else 1 = write-through "
+                        "durability; larger strides model async "
+                        "persistence)")
     p.add_argument("--nemesis-kind", default="random-halves",
                    choices=["random-halves", "isolated-node",
                             "majorities-ring", "scripted"],
@@ -141,6 +162,11 @@ def add_test_options(p: argparse.ArgumentParser):
     p.add_argument("--ms-per-tick", type=_positive_int, default=1,
                    help="TPU runtime: virtual-clock resolution "
                         "(fidelity vs throughput trade)")
+    p.add_argument("--rpc-timeout", type=float, default=None,
+                   help="TPU runtime: client RPC timeout in simulated "
+                        "seconds (default 1.0). Fault campaigns want "
+                        "it short so clients cycle instead of hanging "
+                        "on crashed/unreachable nodes")
     p.add_argument("--p-loss", type=float, default=0.0)
     p.add_argument("--no-telemetry", action="store_true",
                    help="TPU runtime: disable the device flight "
@@ -227,6 +253,35 @@ def _parse_schedule_file(path: str, node_count: int):
 def cmd_test(args) -> int:
     node_count = args.node_count
     concurrency = parse_concurrency(args.concurrency, node_count)
+    from .faults import FAULT_KINDS
+    fault_kinds = [k for k in args.nemesis if k in FAULT_KINDS]
+    if args.runtime != "tpu" and (fault_kinds or args.fault_plan):
+        print("error: the fault-plan engine (--fault-plan and the "
+              f"{'/'.join(FAULT_KINDS)} nemesis kinds) is "
+              "device-resident — --runtime tpu only; the host runtimes "
+              "speak --nemesis partition (doc/guide/10-faults.md)",
+              file=sys.stderr)
+        return 2
+    fault_plan = None
+    if args.fault_plan:
+        if fault_kinds:
+            print("error: --fault-plan and the generated fault "
+                  "--nemesis kinds are mutually exclusive — put the "
+                  "faults in the plan file", file=sys.stderr)
+            return 2
+        from .faults import SpecError, validate_fault_plan
+        try:
+            with open(args.fault_plan) as f:
+                fault_plan = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"error: --fault-plan {args.fault_plan}: {e}",
+                  file=sys.stderr)
+            return 2
+        try:
+            validate_fault_plan(fault_plan, node_count)
+        except SpecError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
     if args.runtime == "process":
         if not args.bin:
             print("error: --bin is required for the process runtime",
@@ -320,13 +375,25 @@ def cmd_test(args) -> int:
         from .tpu.harness import run_tpu_test
         for flag, name in ((args.log_stderr, "--log-stderr"),
                            (args.log_net_send, "--log-net-send"),
-                           (args.log_net_recv, "--log-net-recv"),
-                           (args.crash_clients, "--crash-clients")):
+                           (args.log_net_recv, "--log-net-recv")):
             if flag:
                 print(f"note: {name} has no effect on the TPU runtime "
                       f"(no node processes / host wire log)",
                       file=sys.stderr)
-        model = get_model(args.workload, node_count, args.topology)
+        if args.crash_clients and not args.workload.startswith("kafka"):
+            # crash injection is a kafka-client feature everywhere
+            print("note: --crash-clients has no effect on the TPU "
+                  f"{args.workload} runtime (kafka-only)",
+                  file=sys.stderr)
+        if args.txn:
+            # device-side multi-mop kafka transactions are the one
+            # native-vocabulary piece still host-only (deferred —
+            # PARITY.md); saying so beats silently running single-mop
+            print("note: --txn has no effect on the TPU runtime yet "
+                  "(kafka transactions are process/native-runtime "
+                  "features; use --runtime native)", file=sys.stderr)
+        model = get_model(args.workload, node_count, args.topology,
+                          opts={"crash_clients": args.crash_clients})
         if args.key_count and hasattr(model, "n_keys"):
             model.n_keys = args.key_count
         schedule = ()
@@ -347,6 +414,9 @@ def cmd_test(args) -> int:
             return 2
         tpu_opts = dict(
             nemesis_schedule=schedule,
+            fault_plan=fault_plan,
+            fault_snapshot_every=args.fault_snapshot_every,
+            crash_clients=args.crash_clients,
             topology=args.topology,
             heartbeat=not args.no_heartbeat,
             fail_fast=args.fail_fast,
@@ -375,6 +445,8 @@ def cmd_test(args) -> int:
             seed=args.seed or 0)
         if args.recovery_time is not None:
             tpu_opts["recovery_time"] = args.recovery_time
+        if args.rpc_timeout is not None:
+            tpu_opts["rpc_timeout"] = args.rpc_timeout
         results = run_tpu_test(model, tpu_opts)
     print(json.dumps(results, indent=2, default=repr))
     print()

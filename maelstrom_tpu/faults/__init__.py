@@ -1,0 +1,42 @@
+"""Device-resident fault-plan engine: the composable nemesis vocabulary.
+
+The reference nemesis composes partition grudges on an interval
+(``nemesis.clj``; ``maelstrom_tpu/nemesis.py`` is its host-side port,
+kept as the reference-parity oracle). The TPU runtime's partition
+nemesis (``tpu/runtime.py::partition_matrix``) went device-resident but
+spoke partitions ONLY. This package closes ROADMAP item 4's second
+half: faults beyond partitions, each a lane of one fixed-shape **fault
+plan** the tick scan indexes by ``t``:
+
+- **crash-restart** — a crash mask holds victim nodes in reset: the
+  carry row is wiped back to the restart state (recovered from a
+  per-node device-held snapshot slab — Netherite's crash-restart-with-
+  recovery idiom — or cold-booted when the model keeps no durable
+  state), delivery to the victim is blocked (its in-flight inbox is
+  dropped by the existing recv-side partition drop), and its own sends
+  are suppressed for the duration of the phase.
+- **link degradation** — the single ``[n, n]`` partition plane
+  generalizes to per-directed-edge quality: block (asymmetric
+  partitions), extra latency (slow links), and elevated loss, applied
+  at enqueue/delivery time per ``(dest, origin)`` edge.
+- **clock/timeout skew** — a per-node tick-rate multiplier drives each
+  node's LOCAL clock (``local_t = t * rate / 64``); election and
+  heartbeat timers run on local time, so Raft's timeout assumptions are
+  actually stressed while the network keeps global time.
+
+The plan is compiled from a declarative :class:`FaultSpec`-shaped dict
+(``doc/guide/10-faults.md``) into a hashable :class:`FaultConfig` that
+rides ``SimConfig`` as static trace-time configuration; per-tick state
+is selected by ``searchsorted`` over the phase boundaries, exactly like
+the scripted partition nemesis. An all-healthy plan is bit-identical to
+a fault-free run in both carry layouts (``tests/test_faults.py``), and
+every lane is proven by a planted-bug model whose anomaly the existing
+checker/triage pipeline catches (``models/raft_buggy.py``:
+``RaftForgetsSnapshot``, ``RaftFixedTimeout``).
+"""
+
+from .engine import (FaultConfig, FaultPlanes, NO_PLANES,  # noqa: F401
+                     phase_summary, tick_planes, update_snapshots,
+                     wipe_crashed)
+from .spec import (FAULT_KINDS, SpecError, compile_fault_plan,  # noqa: F401
+                   generate_fault_plan, validate_fault_plan)

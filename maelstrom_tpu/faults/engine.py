@@ -1,0 +1,300 @@
+"""The device side of the fault-plan engine.
+
+:class:`FaultConfig` is the compiled, hashable form of a fault plan: a
+phase timeline (``untils``) plus, per phase, the crash victims, the
+degraded directed edges, and the per-node clock rates — all plain
+nested tuples so ``SimConfig`` stays a static jit argument. At trace
+time the phases are baked into constant planes (one row per phase plus
+a trailing all-healthy row) and the tick selects its row with a single
+``searchsorted`` over ``t`` — the same constant-folding move the
+scripted partition nemesis uses (``runtime.partition_matrix``).
+
+Lane semantics (shared by BOTH carry layouts — the helpers here take
+ONE instance's unbatched state, and the runtime vmaps them exactly like
+every other tick phase, so lead/minor trajectories stay bit-identical):
+
+- ``crash`` — victims are held in reset for the whole phase: every
+  crashed tick the node row is rebuilt via ``Model.restart_row`` (from
+  the snapshot slab — its durable storage — or cold from the init
+  path), delivery TO the victim is blocked via the partition plane (the
+  recv-side drop IS the lost inbox), and the victim's emitted rows are
+  invalidated before enqueue. Messages already in flight FROM the
+  victim still deliver — they are on the wire, not in the dead process.
+  The snapshot slab captures ``Model.snapshot_row`` of every healthy
+  node each ``snapshot_every`` ticks (1 = write-through durability: the
+  slab always holds the kill-point state; larger strides model
+  asynchronous persistence, where losing the tail is a legitimate
+  finding, not a checker bug).
+- ``links`` — per-directed-edge ``(dest, origin)`` quality: ``block``
+  folds into the delivery partition plane (asymmetric partitions),
+  ``delay`` adds ticks to the sampled latency at enqueue time, and
+  ``loss_pm`` (per-mille) is an extra independent loss roll. Neutral
+  values (0) are value-identical to the healthy path.
+- ``skew`` — per-node clock rate in 64ths (64 = 1.0x): the node phase
+  runs each node's timers on ``local_t = (t * rate) // 64``. Rate 64 is
+  exactly ``t`` (no rounding), so a neutral skew lane is bit-identical.
+
+Everything here is traced (fixed shapes, jnp only, static branches on
+the config) and linted with the models (``maelstrom lint --strict``).
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+NEUTRAL_RATE = 64          # skew rates are 64ths; 64 == 1.0x (exact)
+
+
+class FaultConfig(NamedTuple):
+    """Static, hashable fault plan (rides ``SimConfig.faults``).
+
+    ``untils`` are the strictly-increasing phase end ticks; phase ``p``
+    covers ``[untils[p-1], untils[p])`` (phase 0 starts at tick 0) and
+    every tick at/after ``untils[-1]`` or ``stop_tick`` — the final
+    heal window — is healthy. The per-phase lane tuples are aligned
+    with ``untils``:
+
+    - ``crash[p]``   — tuple of crashed server-node ids
+    - ``links[p]``   — tuples ``(dst, src, block, delay, loss_pm)``
+    - ``skew[p]``    — tuples ``(node, rate64)``
+    """
+    enabled: bool = False
+    stop_tick: int = 1 << 30
+    snapshot_every: int = 1
+    untils: Tuple[int, ...] = ()
+    crash: Tuple[Tuple[int, ...], ...] = ()
+    links: Tuple[Tuple[Tuple[int, int, int, int, int], ...], ...] = ()
+    skew: Tuple[Tuple[Tuple[int, int], ...], ...] = ()
+
+    # lane presence is a STATIC property: a lane is "present" when any
+    # phase lists entries for it (even value-neutral ones), and only
+    # present lanes add anything to the traced graph — a default
+    # FaultConfig() compiles the exact pre-fault tick.
+    @property
+    def has_crash(self) -> bool:
+        return self.enabled and any(len(p) for p in self.crash)
+
+    @property
+    def has_links(self) -> bool:
+        return self.enabled and any(len(p) for p in self.links)
+
+    @property
+    def has_skew(self) -> bool:
+        return self.enabled and any(len(p) for p in self.skew)
+
+    @property
+    def active(self) -> bool:
+        return self.has_crash or self.has_links or self.has_skew
+
+
+class FaultPlanes(NamedTuple):
+    """One tick's selected fault state (``None`` = lane not present,
+    statically — the runtime's fault branches key on these)."""
+    crash: Optional[Any] = None      # [N] bool — nodes held in reset
+    block: Optional[Any] = None      # [NT, NT] bool — recv-side drops
+    delay: Optional[Any] = None      # [NT, NT] int32 — extra latency
+    loss_pm: Optional[Any] = None    # [NT, NT] int32 — per-mille loss
+    t_nodes: Optional[Any] = None    # [N] int32 — per-node local clock
+
+
+NO_PLANES = FaultPlanes()
+
+
+@lru_cache(maxsize=64)
+def _planes_np(fx: FaultConfig, n_nodes: int, n_clients: int):
+    """Bake the phase timeline into dense per-phase numpy planes
+    (row ``P`` = the trailing all-healthy phase). Cached: FaultConfig
+    is hashable and the planes are pure functions of it."""
+    NT = n_nodes + n_clients
+    P = len(fx.untils)
+    crash = np.zeros((P + 1, n_nodes), dtype=bool)
+    block = np.zeros((P + 1, NT, NT), dtype=bool)
+    delay = np.zeros((P + 1, NT, NT), dtype=np.int32)
+    loss = np.zeros((P + 1, NT, NT), dtype=np.int32)
+    skew = np.full((P + 1, n_nodes), NEUTRAL_RATE, dtype=np.int32)
+    for p in range(P):
+        if p < len(fx.crash):
+            for v in fx.crash[p]:
+                crash[p, v] = True
+                # a dead process hears nobody — servers AND clients;
+                # its own in-flight sends still deliver (origin edges
+                # are NOT blocked)
+                block[p, v, :] = True
+        if p < len(fx.links):
+            for dst, src, blk, d, pm in fx.links[p]:
+                # duplicate entries for one directed edge MERGE (the
+                # spec promises "one edge may combine delay and loss",
+                # and plans often list them as separate entries) —
+                # last-writer-wins would silently zero earlier fields
+                if blk:
+                    block[p, dst, src] = True
+                delay[p, dst, src] = max(delay[p, dst, src], d)
+                loss[p, dst, src] = max(loss[p, dst, src], pm)
+        if p < len(fx.skew):
+            for node, rate in fx.skew[p]:
+                skew[p, node] = rate
+    untils = np.asarray(fx.untils, dtype=np.int32)
+    return untils, crash, block, delay, loss, skew
+
+
+def tick_planes(fx: FaultConfig, cfg, t) -> FaultPlanes:
+    """Select tick ``t``'s planes (traced; constants baked per phase).
+    ``cfg`` is the NetConfig (static). Ticks at/after ``stop_tick``
+    read the all-healthy row — the final heal window."""
+    if not fx.active:
+        return NO_PLANES
+    import jax.numpy as jnp
+
+    untils, crash, block, delay, loss, skew = _planes_np(
+        fx, cfg.n_nodes, cfg.n_clients)
+    P = len(fx.untils)
+    phase = jnp.searchsorted(jnp.asarray(untils), t, side="right")
+    phase = jnp.clip(jnp.where(t < fx.stop_tick, phase, P), 0, P)
+    out = {}
+    if fx.has_crash:
+        out["crash"] = jnp.asarray(crash)[phase]
+    if fx.has_crash or _any_block(fx):
+        out["block"] = jnp.asarray(block)[phase]
+    if fx.has_links:
+        out["delay"] = jnp.asarray(delay)[phase]
+        out["loss_pm"] = jnp.asarray(loss)[phase]
+    if fx.has_skew:
+        out["t_nodes"] = (t * jnp.asarray(skew)[phase]) // NEUTRAL_RATE
+    return FaultPlanes(**out)
+
+
+def _any_block(fx: FaultConfig) -> bool:
+    return any(e[2] for p in fx.links for e in p) or fx.has_crash
+
+
+def wipe_crashed(model, node_state, snapshots, crash_mask, t_nodes,
+                 wipe_key, cfg, params):
+    """Hold crashed nodes in reset: rebuild each victim's row via
+    ``Model.restart_row`` (per-node restart RNG folded off
+    ``wipe_key``) and select it in under the crash mask. One instance's
+    unbatched state (``node_state`` leaves ``[N, ...]``); the runtime
+    vmaps this over instances in both layouts."""
+    import jax
+    import jax.numpy as jnp
+
+    N = cfg.n_nodes
+    idx = jnp.arange(N, dtype=jnp.int32)
+    nkeys = jax.vmap(lambda i: jax.random.fold_in(wipe_key, i))(idx)
+    fresh = jax.vmap(
+        lambda nk, ni, snap, tn: model.restart_row(N, ni, nk, params,
+                                                   snap, tn))(
+        nkeys, idx, snapshots, t_nodes)
+
+    def pick(a, b):
+        m = crash_mask.reshape((N,) + (1,) * (a.ndim - 1))
+        return jnp.where(m, b, a)
+
+    return jax.tree.map(pick, node_state, fresh)
+
+
+def update_snapshots(model, node_state, snapshots, crash_mask, t,
+                     every: int):
+    """Fold the tick's end-of-tick durable state into the snapshot slab
+    (one instance, leaves ``[N, ...]``). Crashed (held-in-reset) nodes
+    never overwrite their slab row — the slab keeps the kill-point
+    state for the restart. ``every == 1`` is write-through durability;
+    larger strides snapshot on tick ``t`` with ``(t + 1) % every == 0``
+    (asynchronous persistence — the tail since the last snapshot is
+    genuinely lost on a crash)."""
+    import jax
+    import jax.numpy as jnp
+
+    fresh = model.snapshot_row(node_state)
+
+    def mix(s, v):
+        m = crash_mask.reshape((crash_mask.shape[0],)
+                               + (1,) * (v.ndim - 1))
+        out = jnp.where(m, s, v)
+        if every > 1:
+            out = jnp.where((t + 1) % every == 0, out, s)
+        return out
+
+    return jax.tree.map(mix, snapshots, fresh)
+
+
+# --- host-side reporting ---------------------------------------------------
+
+
+def phase_at(fx: FaultConfig, tick: int) -> int:
+    """Host-side phase index at ``tick`` (``len(untils)`` = healthy;
+    the heartbeat's fault-epoch lane — the plan is deterministic, so
+    the host needs no device traffic to know it)."""
+    if not fx.active or tick >= fx.stop_tick:
+        return len(fx.untils)
+    return int(np.searchsorted(np.asarray(fx.untils, dtype=np.int64),
+                               tick, side="right"))
+
+
+def phase_summary(fx: FaultConfig, tick: int) -> Dict[str, Any]:
+    """The heartbeat's per-chunk fault-epoch record: which phase the
+    chunk ended in and which lanes it had active."""
+    p = phase_at(fx, tick)
+    out: Dict[str, Any] = {"phase": p, "phases": len(fx.untils)}
+    if p >= len(fx.untils):
+        out["healthy"] = True
+        return out
+    if p < len(fx.crash) and fx.crash[p]:
+        out["crashed"] = sorted(fx.crash[p])
+    if p < len(fx.links) and fx.links[p]:
+        out["degraded-edges"] = len(fx.links[p])
+    if p < len(fx.skew) and fx.skew[p]:
+        out["skewed-nodes"] = len(fx.skew[p])
+    return out
+
+
+def span_summary(fx: FaultConfig, t0: int, ticks: int) -> Dict[str, Any]:
+    """Fault-epoch record for a tick RANGE (a dispatched chunk): the
+    union of lanes active anywhere in ``[t0, t0 + ticks)``, plus the
+    phase the span ended in. Chunks are coarser than phases, so a
+    point sample at the chunk end would miss short fault windows."""
+    end = t0 + max(1, int(ticks)) - 1
+    out: Dict[str, Any] = {"phase": phase_at(fx, end),
+                           "phases": len(fx.untils)}
+    crashed: set = set()
+    edges = 0
+    skewed = 0
+    healthy = True
+    for p in range(len(fx.untils)):
+        lo = fx.untils[p - 1] if p else 0
+        hi = min(fx.untils[p], fx.stop_tick)
+        if lo >= t0 + ticks or hi <= t0:
+            continue
+        if p < len(fx.crash) and fx.crash[p]:
+            crashed.update(fx.crash[p])
+            healthy = False
+        if p < len(fx.links) and fx.links[p]:
+            edges = max(edges, len(fx.links[p]))
+            healthy = False
+        if p < len(fx.skew) and fx.skew[p]:
+            skewed = max(skewed, len(fx.skew[p]))
+            healthy = False
+    if healthy:
+        out["healthy"] = True
+        return out
+    if crashed:
+        out["crashed"] = sorted(crashed)
+    if edges:
+        out["degraded-edges"] = edges
+    if skewed:
+        out["skewed-nodes"] = skewed
+    return out
+
+
+def plan_summary(fx: FaultConfig) -> Dict[str, Any]:
+    """The run-start heartbeat record's fault block: enough to label a
+    live report without re-shipping the whole plan (the repro opts
+    carry the full spec)."""
+    lanes = [name for name, on in (("crash-restart", fx.has_crash),
+                                   ("link-degradation", fx.has_links),
+                                   ("clock-skew", fx.has_skew)) if on]
+    return {"phases": len(fx.untils), "lanes": lanes,
+            "snapshot-every": fx.snapshot_every,
+            "stop-tick": int(fx.stop_tick)}

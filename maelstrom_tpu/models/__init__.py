@@ -2,12 +2,26 @@
 
 The device-side counterpart of workloads/__init__.py's registry; the CLI's
 ``--runtime tpu`` resolves through here.
+
+``opts`` carries the native-engine vocabulary-parity flags so the TPU
+runtime speaks them the same way ``run_native_test`` does:
+
+- ``crash_clients`` — kafka: clients randomly crash and resume from the
+  committed offsets (``models/kafka.py``; the native engine's
+  ``kafka_crash_clients`` twin).
+- ``txn_dirty_apply`` — txn workloads: select the dirty-apply mutant by
+  FLAG instead of by mutant workload name (the native engine's
+  ``flag_txn_dirty_apply``); the returned model carries the mutant's
+  own name, so stored runs/replays resolve it unambiguously.
 """
 
 from __future__ import annotations
 
+from typing import Any, Dict, Optional
 
-def get_model(workload: str, node_count: int, topology: str = "grid"):
+
+def get_model(workload: str, node_count: int, topology: str = "grid",
+              opts: Optional[Dict[str, Any]] = None):
     from .crdt import (BroadcastModel, GCounterModel, GossipSetModel,
                        PNCounterModel)
     from .echo import EchoModel
@@ -17,6 +31,13 @@ def get_model(workload: str, node_count: int, topology: str = "grid"):
     from .txn_raft import (TXN_BUGGY_MODELS, TxnListAppendModel,
                            TxnRwRegisterModel)
     from .unique_ids import UniqueIdsModel
+
+    opts = opts or {}
+    if opts.get("txn_dirty_apply") and workload in ("txn-list-append",
+                                                    "txn-rw-register"):
+        # flag-selected mutant (native-engine parity): same automaton
+        # as the -bug-dirty-apply workload name
+        workload = f"{workload}-bug-dirty-apply"
 
     if workload == "echo":
         return EchoModel()
@@ -48,11 +69,12 @@ def get_model(workload: str, node_count: int, topology: str = "grid"):
             if kind in TXN_BUGGY_MODELS:
                 return TXN_BUGGY_MODELS[kind](n_nodes_hint=node_count)
     if workload == "kafka":
-        return KafkaModel()
+        return KafkaModel(crash_clients=bool(opts.get("crash_clients")))
     if workload.startswith("kafka-bug-"):
         kind = workload[len("kafka-bug-"):]
         if kind in KAFKA_BUGGY_MODELS:
-            return KAFKA_BUGGY_MODELS[kind]()
+            return KAFKA_BUGGY_MODELS[kind](
+                crash_clients=bool(opts.get("crash_clients")))
     raise ValueError(
         f"no TPU model for workload {workload!r}; available: echo, "
         f"broadcast, g-set, g-counter, pn-counter, lin-kv, kafka, "

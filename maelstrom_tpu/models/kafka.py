@@ -33,6 +33,10 @@ F_SEND = 1
 F_POLL = 2
 F_COMMIT = 3
 F_LIST = 4
+F_CRASH = 5       # --crash-clients: the client "crashes" — its broker-
+                  # side consumer cursor resets to the committed
+                  # offsets (jepsen.tests.kafka :crash-clients; the
+                  # native engine's kafka_crash_clients twin)
 
 T_SEND = 30
 T_SEND_OK = 31
@@ -42,6 +46,8 @@ T_COMMIT = 34
 T_COMMIT_OK = 35
 T_LIST = 36
 T_LIST_OK = 37
+T_CRASH = 38
+T_CRASH_OK = 39
 
 
 class KafkaRow(NamedTuple):
@@ -68,16 +74,24 @@ class KafkaModel(Model):
     commit_monotonic = True   # False: commits blindly overwrite
 
     def __init__(self, n_keys: int = 4, log_cap: int = 64,
-                 poll_max: int = 3):
+                 poll_max: int = 3, crash_clients: bool = False,
+                 crash_rate: float = 0.05):
         self.n_keys = n_keys
         self.log_cap = log_cap
         self.poll_max = poll_max
+        # --crash-clients (native-engine vocabulary parity): clients
+        # randomly issue crash ops; the broker resets their consumer
+        # cursor to the committed offsets, so the next poll legally
+        # jumps backwards (the checker wrapper marks it reassigned)
+        self.crash_clients = bool(crash_clients)
+        self.crash_rate = float(crash_rate)
         self.body_lanes = max(n_keys * poll_max * 2, n_keys, 3)
         self.ev_vals = 1 + self.body_lanes
         self.op_lanes = 4
 
     def _config(self):
-        return (self.n_keys, self.log_cap, self.poll_max)
+        return (self.n_keys, self.log_cap, self.poll_max,
+                self.crash_clients, self.crash_rate)
 
     def __hash__(self):
         return hash((type(self), self._config()))
@@ -117,6 +131,15 @@ class KafkaModel(Model):
         is_commit = mtype == T_COMMIT
         is_list = mtype == T_LIST
         is_any = is_send | is_poll | is_commit | is_list
+        if self.crash_clients:
+            # client crash: the broker discards the consumer's cursor
+            # and re-seats it at the committed offsets (next unread
+            # after the commit; committed is -1 when none)
+            is_crash = mtype == T_CRASH
+            is_any = is_any | is_crash
+            positions = jnp.where(
+                is_crash, positions.at[ci].set(row.committed + 1),
+                positions)
 
         k = jnp.clip(msg[wire.BODY], 0, self.n_keys - 1)
         v = msg[wire.BODY + 1]
@@ -175,11 +198,13 @@ class KafkaModel(Model):
         out = jnp.zeros((1, cfg.lanes), dtype=jnp.int32)
         out = out.at[0, wire.VALID].set(jnp.where(is_any, 1, 0))
         out = out.at[0, wire.DEST].set(src)
+        tail = (jnp.where(is_list, T_LIST_OK, T_CRASH_OK)
+                if self.crash_clients else T_LIST_OK)
         out = out.at[0, wire.TYPE].set(
             jnp.where(is_send & fits, T_SEND_OK,
             jnp.where(is_send, TYPE_ERROR,
             jnp.where(is_poll, T_POLL_OK,
-            jnp.where(is_commit, T_COMMIT_OK, T_LIST_OK)))))
+            jnp.where(is_commit, T_COMMIT_OK, tail)))))
         out = out.at[0, wire.REPLYTO].set(msg[wire.MSGID])
         body = jnp.zeros((self.body_lanes,), jnp.int32)
         # send_ok: offset; full log: error 11 (definite, retryable)
@@ -215,16 +240,24 @@ class KafkaModel(Model):
         f = jnp.where(r < 0.45, F_SEND,
                       jnp.where(r < 0.85, F_POLL,
                                 jnp.where(r < 0.95, F_COMMIT, F_LIST)))
+        if self.crash_clients:
+            # crash injection on its own folded key, so enabling the
+            # mode never perturbs the base op-mix draws
+            kc = jax.random.fold_in(key, 3)
+            f = jnp.where(jax.random.uniform(kc) < self.crash_rate,
+                          F_CRASH, f)
         v = 1 + uniq  # unique message value per instance
         return jnp.stack([f, k, jnp.where(f == F_SEND, v, 0),
                           jnp.int32(0)])
 
     def encode_request(self, op, msg_id, client_idx, key, cfg, params):
         del key
+        tail = (jnp.where(op[0] == F_LIST, T_LIST, T_CRASH)
+                if self.crash_clients else T_LIST)
         mtype = jnp.where(op[0] == F_SEND, T_SEND,
                           jnp.where(op[0] == F_POLL, T_POLL,
                                     jnp.where(op[0] == F_COMMIT, T_COMMIT,
-                                              T_LIST)))
+                                              tail)))
         return wire.make_msg(src=0, dest=0, type_=mtype, msg_id=msg_id,
                              body=(op[1], op[2]),
                              body_lanes=self.body_lanes)
@@ -256,6 +289,10 @@ class KafkaModel(Model):
             return {"f": "poll", "value": None}
         if f == F_COMMIT:
             return {"f": "commit_offsets", "value": {}}
+        if f == F_CRASH:
+            # crash ops never complete ok by design (the checker's
+            # crash-clients vocabulary; checkers/perf.py exempts them)
+            return {"f": "crash", "value": None}
         return {"f": "list_committed_offsets",
                 "value": list(range(self.n_keys))}
 
@@ -286,8 +323,16 @@ class KafkaModel(Model):
         return {"f": name, "value": offsets}
 
     def checker(self):
-        from ..checkers.kafka import kafka_checker
-        return lambda history, opts: kafka_checker(history)
+        from ..checkers.kafka import (kafka_checker,
+                                      mark_reassigned_after_crashes)
+        if not self.crash_clients:
+            return lambda history, opts: kafka_checker(history)
+        # crash-clients mode: a reopened consumer resumes from the
+        # committed offsets, so its first poll after a crash may
+        # legally jump backwards — tag it reassigned, exactly the flag
+        # the native engine rides on its own records
+        return lambda history, opts: kafka_checker(
+            mark_reassigned_after_crashes(history))
 
 
 class KafkaOffsetReuse(KafkaModel):

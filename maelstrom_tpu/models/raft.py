@@ -620,6 +620,44 @@ class RaftModel(Model):
             jnp.zeros((cfg.lanes - wire.BODY - 2,), jnp.int32)])
         return row, out
 
+    # --- crash-restart recovery (maelstrom_tpu/faults/ crash lane) -------
+    #
+    # Real Raft persists term/votedFor and the log synchronously and
+    # rebuilds the state machine by replaying the log on restart; the
+    # applied KV + cursors are therefore equivalent-to-durable. The
+    # snapshot slab holds exactly that durable subset, and restart
+    # rebuilds the row as follower with every volatile field (role,
+    # votes, replication cursors, leader hint, timers) reset — so
+    # correct Raft stays SAFE under crash-restart with write-through
+    # snapshots (snapshot_every=1), which tests/test_faults.py pins.
+    # The RaftForgetsSnapshot mutant flips ``recovers_snapshot`` off:
+    # an amnesiac reboot that re-votes in old terms and forgets
+    # committed entries — the crash lane's planted bug.
+
+    DURABLE_LANES = ("term", "voted_for", "log_term", "log_body",
+                     "log_len", "kv", "commit_idx", "last_applied",
+                     "truncated_committed")
+
+    recovers_snapshot = True   # False: restart ignores durable storage
+                               # (the forget-snapshot planted bug)
+
+    def snapshot_row(self, row: RaftRow):
+        """The durable subset (pure field selection, so it applies to
+        batched rows in either carry layout)."""
+        return {k: getattr(row, k) for k in self.DURABLE_LANES}
+
+    def restart_row(self, n_nodes, node_idx, key, params, snap, t):
+        fresh = self.init_row(n_nodes, node_idx, key, params)
+        # init_row's timers are relative to tick 0 — re-base on the
+        # restart tick (node-local clock under the skew lane)
+        fresh = fresh._replace(
+            election_deadline=(fresh.election_deadline
+                               + t).astype(jnp.int32),
+            last_hb=jnp.asarray(t, jnp.int32))
+        if not self.recovers_snapshot:
+            return fresh     # BUG: cold boot — total state loss
+        return fresh._replace(**{k: snap[k] for k in self.DURABLE_LANES})
+
     # --- on-device invariants --------------------------------------------
 
     def invariants(self, node_state: RaftRow, cfg, params):

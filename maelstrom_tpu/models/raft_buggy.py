@@ -29,6 +29,14 @@ compiles to its own specialized graph with the bug baked in.
 - :class:`RaftEagerCommit` — the leader commits at the MAX match index
   (no majority quorum): acknowledged writes it alone holds are lost on
   failover.
+- :class:`RaftForgetsSnapshot` — crash-restart recovery ignores the
+  durable snapshot slab (fault engine crash lane, maelstrom_tpu/
+  faults/): the node reboots amnesiac, re-votes in old terms and loses
+  committed entries.
+- :class:`RaftFixedTimeout` — election timeouts are deterministic (no
+  jitter): nodes time out in lockstep and livelock with no leader —
+  the clock-skew lane's liveness anomaly, flagged by the availability
+  checker.
 """
 
 from __future__ import annotations
@@ -67,6 +75,39 @@ class RaftShortLogWins(RaftModel):
     vote_check_log_index = False
 
 
+class RaftForgetsSnapshot(RaftModel):
+    """Crash-restart durability broken (the fault engine's crash-lane
+    planted bug): restart ignores the snapshot slab and cold-boots with
+    term 0, no vote, an empty log, and a blank KV — as if the node kept
+    no durable storage at all. Under a crash-restart fault plan the
+    amnesiac node re-grants votes it already cast (two leaders per
+    term: the on-device election-safety invariant trips) and, when a
+    crashed majority reboots together, elects a leader over an empty
+    log — committed entries vanish and both the committed-prefix
+    agreement invariant and WGL's lost-write detection fire. The
+    correct model under the SAME plan recovers from its snapshots and
+    stays valid (tests/test_faults.py anomaly matrix)."""
+    name = "lin-kv-bug-forget-snapshot"
+    recovers_snapshot = False
+
+
+class RaftFixedTimeout(RaftModel):
+    """Randomized election timeouts removed (the clock-skew lane's
+    planted bug): every node draws a zero jitter, so election deadlines
+    are deterministic and collide — all nodes time out in lockstep,
+    vote for themselves, reject each other, and repeat forever. No
+    leader is ever elected, no client op ever completes ok, and the
+    availability checker flags the livelock, while correct Raft (whose
+    randomized timeouts are exactly the mechanism this mutant deletes)
+    elects fine under the SAME skewed-clock plan. Raft's liveness
+    argument (§5.4's randomized-timeout lemma) made executable."""
+    name = "lin-kv-bug-fixed-timeout"
+
+    def __init__(self, n_nodes_hint: int = 5, **kw):
+        kw["elect_jitter"] = 1   # randint(0, 1) == 0 always
+        super().__init__(n_nodes_hint=n_nodes_hint, **kw)
+
+
 class RaftEagerCommit(RaftModel):
     """Commit quorum broken: the leader advances commit_idx to the MAX
     match index instead of the majority median — entries are committed
@@ -84,6 +125,8 @@ BUGGY_MODELS = {
     "no-term-guard": RaftNoTermGuard,
     "short-log-wins": RaftShortLogWins,
     "eager-commit": RaftEagerCommit,
+    "forget-snapshot": RaftForgetsSnapshot,
+    "fixed-timeout": RaftFixedTimeout,
 }
 
 

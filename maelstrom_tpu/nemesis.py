@@ -1,4 +1,16 @@
-"""Fault injection: network partition nemesis.
+"""Fault injection: the HOST-SIDE partition nemesis (process runtime).
+
+This thread-based nemesis is the direct port of the reference's
+``nemesis.clj`` and is kept as the **reference-parity oracle**: it
+speaks exactly what the reference speaks (partition grudges on an
+interval, receiver-side drops, a final heal) so the process runtime's
+fault behavior stays comparable line-for-line with upstream Maelstrom.
+Partitions are NOT the only fault in this repo — the device runtimes
+have the fault-plan engine (``maelstrom_tpu/faults/``,
+``doc/guide/10-faults.md``): composable crash-restart with snapshot
+recovery, asymmetric/slow/lossy links, and per-node clock skew, each
+proven by a planted-bug anomaly. New fault vocabulary lands there; this
+module intentionally stays partitions-only, matching the reference.
 
 The nemesis runs on its own thread alongside the client workers: every
 ``interval`` seconds it alternately starts a partition (computing a *grudge*

@@ -203,6 +203,9 @@ def _carry_to_wire(c: Carry, sim: SimConfig) -> Carry:
     return Carry(
         pool=c.pool, node_state=c.node_state,
         client_state=c.client_state,
+        # the fault engine's snapshot slab is instance-batched like
+        # node_state (canonical_carry already led its batch axis)
+        snapshots=c.snapshots,
         stats=jax.tree.map(lambda x: x.reshape(1), c.stats),
         violations=c.violations,
         key=c.key.reshape(1, *c.key.shape),
@@ -218,6 +221,7 @@ def _carry_from_wire(w: Carry, sim: SimConfig) -> Carry:
     c = Carry(
         pool=w.pool, node_state=w.node_state,
         client_state=w.client_state,
+        snapshots=w.snapshots,
         stats=jax.tree.map(lambda x: x.reshape(()), w.stats),
         violations=w.violations,
         key=w.key.reshape(*w.key.shape[1:]),

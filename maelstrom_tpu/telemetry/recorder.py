@@ -6,7 +6,13 @@ high-water marks, a log-bucket histogram of client RPC latency in ticks,
 nemesis partition epochs, and the first invariant-trip tick; a small
 fleet-aggregate time series (one row per ``stride`` ticks) rides in a
 fixed ``[n_windows, SERIES_LANES]`` buffer so memory stays bounded no
-matter the horizon. Everything is int32, fixed-shape, and updated with
+matter the horizon. Fault-plan runs (``maelstrom_tpu/faults/``) need no
+extra lanes here: the plan's edge blocks (crashed receivers, asymmetric
+link blocks) fold into the delivery partition plane BEFORE it reaches
+``part_active``, so ``partition_ticks``/``nemesis_epochs`` count
+fault-blocked ticks too, and the per-chunk fault EPOCH is host-derived
+from the deterministic plan by the heartbeat (``telemetry/stream.py``
+record schema) at zero carry cost. Everything is int32, fixed-shape, and updated with
 pure ``jnp`` ops — this module is a traced surface and is linted like a
 model (``maelstrom lint --strict``; see doc/observability.md).
 

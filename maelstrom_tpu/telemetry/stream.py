@@ -26,7 +26,8 @@ Record schema (all host-written; one JSON object per line):
   ``opts`` dict ``maelstrom triage`` replays from.
 - ``{"type": "chunk", "chunk": k, "t0": t, "ticks": n, "wall-s": w,
   "device-s": d, "net": {...}, "first-violation": {...}|null,
-  "violations": [{...}, ...], "events-overflowed": bool}`` — one per
+  "violations": [{...}, ...], "events-overflowed": bool,
+  "fault": {...}}`` — one per
   dispatched chunk, written when the chunk's payload is consumed (i.e.
   while chunk *k + 1* runs on device). ``net`` is the CUMULATIVE fleet
   NetStats; the ``first-violation`` block is ``{"instances": n,
@@ -34,6 +35,12 @@ Record schema (all host-written; one JSON object per line):
   telemetry (violation known, first-trip tick not recorded), and
   ``violations`` lists ALL top-K earliest trippers the device scan
   named (``--scan-top-k`` rows; present only when something tripped).
+  ``fault`` (fault-plan runs only) is the chunk's fault epoch —
+  ``{"phase": p, "phases": P, "crashed": [...], "degraded-edges": n,
+  "skewed-nodes": n}`` or ``{"healthy": true}`` — computed host-side
+  from the deterministic plan (``faults.engine.span_summary``), zero
+  device traffic; the run-start header carries the plan's lane list
+  under ``faults``.
 - ``{"type": "run-end", "status": "complete"|"stopped", ...}`` — last
   line on a clean exit; ABSENT on a crash (that absence is what
   ``maelstrom watch`` reports as a dead/partial run).
@@ -328,6 +335,17 @@ def render_chunk_line(rec: Dict[str, Any]) -> str:
     if net:
         parts.append(f"sent {net.get('sent', 0)} "
                      f"delivered {net.get('delivered', 0)}")
+    fault = rec.get("fault")
+    if fault and not fault.get("healthy"):
+        bits = []
+        if fault.get("crashed"):
+            bits.append("crash " + ",".join(
+                str(n) for n in fault["crashed"]))
+        if fault.get("degraded-edges"):
+            bits.append(f"links {fault['degraded-edges']}")
+        if fault.get("skewed-nodes"):
+            bits.append(f"skew {fault['skewed-nodes']}")
+        parts.append("fault[" + " ".join(bits) + "]")
     parts.append("OVERFLOW" if rec.get("events-overflowed") else "")
     n_lanes = len(rec.get("violations") or ())
     more = f", +{n_lanes - 1} more named" if v and n_lanes > 1 else ""

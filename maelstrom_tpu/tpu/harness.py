@@ -95,6 +95,19 @@ TPU_DEFAULTS = dict(
                               # concurrent runs sharing a test name get
                               # collision-free dirs (campaign items
                               # pass item<k>)
+    fault_plan=None,          # declarative fault-plan dict (maelstrom_
+                              # tpu/faults/spec.py; CLI --fault-plan):
+                              # crash-restart, link degradation, clock
+                              # skew phases, compiled into the tick.
+                              # Mutually exclusive with the generated
+                              # fault --nemesis kinds
+    fault_snapshot_every=None,  # ticks between snapshot-slab captures
+                              # for crash recovery (None defers to the
+                              # plan's own snapshot_every, default 1 =
+                              # write-through durability; larger
+                              # strides model async persistence, where
+                              # losing the tail on a crash is a
+                              # legitimate finding)
     compile_cache=".jax_cache",  # persistent XLA compile cache dir
                               # (resumed/queued runs skip recompiles;
                               # MAELSTROM_COMPILE_CACHE=0 disables,
@@ -163,6 +176,36 @@ def make_sim_config(model: Model, opts: Dict[str, Any]) -> SimConfig:
              for until, pairs in o.get("nemesis_schedule", ())),
             key=lambda p: p[0])),  # searchsorted needs monotonic untils
     )
+    # the fault-plan engine (maelstrom_tpu/faults/): an explicit plan
+    # dict, or the composable fault --nemesis kinds generated on the
+    # partition nemesis's interval grid; both heal at stop_tick
+    from ..faults import (FAULT_KINDS, compile_fault_plan,
+                          generate_fault_plan)
+    fault_kinds = [k for k in (o["nemesis"] or []) if k in FAULT_KINDS]
+    plan = o.get("fault_plan")
+    if plan and fault_kinds:
+        raise ValueError(
+            f"--fault-plan and the generated fault nemesis kinds "
+            f"({', '.join(fault_kinds)}) are mutually exclusive — put "
+            f"the faults in the plan file")
+    if not plan and fault_kinds:
+        plan = generate_fault_plan(
+            fault_kinds, o["node_count"], n_ticks,
+            max(1, int(o["nemesis_interval"] * 1000 / mpt)), stop_tick)
+    snap_every = o.get("fault_snapshot_every")
+    faults = compile_fault_plan(
+        plan, o["node_count"], stop_tick,
+        snapshot_every=None if snap_every is None else int(snap_every))
+    if fault_kinds and not faults.active:
+        # the user explicitly asked for these fault kinds; silently
+        # running fault-free (e.g. crash-restart/link-degrade on a
+        # single-node cluster, which they cannot target) would report
+        # a "valid" verdict that tested nothing
+        raise ValueError(
+            f"--nemesis {'/'.join(fault_kinds)} generated no fault "
+            f"lanes for node_count={o['node_count']} (crash-restart "
+            f"and link-degrade need >= 2 server nodes; use clock-skew "
+            f"or an explicit --fault-plan for single-node workloads)")
     stride = int(o.get("telemetry_stride") or 0)
     if stride <= 0:
         # auto: bound the fleet series to <= 256 windows however long
@@ -177,6 +220,7 @@ def make_sim_config(model: Model, opts: Dict[str, Any]) -> SimConfig:
         stride=stride,
         n_windows=max(1, -(-n_ticks // stride)))
     return SimConfig(net=net, client=client, nemesis=nemesis,
+                     faults=faults,
                      n_instances=o["n_instances"], n_ticks=n_ticks,
                      record_instances=min(o["record_instances"],
                                           o["n_instances"]),
@@ -349,7 +393,13 @@ _REPRO_OPT_KEYS = (
     # behavioral knobs `campaign resume` replays from the header so a
     # resumed run re-runs under the SAME policy it started with
     "pipeline", "fail_fast", "scan_top_k", "funnel", "funnel_max",
-    "checkpoint_every")
+    "checkpoint_every",
+    # fault-plan engine (maelstrom_tpu/faults/): the plan is part of
+    # the trajectory, so triage/resume must rebuild it
+    "fault_plan", "fault_snapshot_every",
+    # model-selection flags (native-engine vocabulary parity): the
+    # replay must rebuild the same mutant/crash-mode automaton
+    "crash_clients", "txn_dirty_apply")
 
 
 def heartbeat_meta(model: Model, sim: SimConfig,
@@ -365,7 +415,7 @@ def heartbeat_meta(model: Model, sim: SimConfig,
             except (TypeError, ValueError):
                 continue
             repro[k] = opts[k]
-    return {
+    meta = {
         "workload": model.name,
         "instances": sim.n_instances,
         "ticks": sim.n_ticks,
@@ -381,6 +431,13 @@ def heartbeat_meta(model: Model, sim: SimConfig,
         "model-config": {k: v for k, v in vars(model).items()
                          if isinstance(v, (bool, int, float, str))},
     }
+    if sim.faults.active:
+        # label the live report (`maelstrom watch`); the repro opts
+        # above carry the full plan (or the deterministic generator
+        # inputs) for the bit-exact replay
+        from ..faults.engine import plan_summary
+        meta["faults"] = plan_summary(sim.faults)
+    return meta
 
 
 def run_tpu_test(model: Model, opts: Optional[Dict[str, Any]] = None,

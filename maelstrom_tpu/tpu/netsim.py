@@ -13,7 +13,11 @@ slots. Each virtual-clock tick:
   sampled latency deadline (constant / uniform / exponential, in ticks),
   probabilistic loss, and zero latency on client links (net.clj:178-187).
   Pool overflow drops messages and counts them (an explicit, journaled
-  form of packet loss — SURVEY §7 hard parts).
+  form of packet loss — SURVEY §7 hard parts). The fault engine's
+  link-degradation lane (``maelstrom_tpu/faults/``) generalizes the
+  boolean partition plane to per-directed-edge quality: blocks fold
+  into the delivery partition matrix, while extra latency and elevated
+  loss ride ``enqueue``'s ``edge_delay`` / ``edge_loss_pm`` planes.
 
 Everything is pure, fixed-shape, and vmappable over the instance axis;
 `vmap(deliver)` / `vmap(enqueue)` are the hot ops of the whole TPU runtime.
@@ -160,10 +164,19 @@ def _sample_latency(key, n, cfg: NetConfig) -> jnp.ndarray:
 
 @partial(jax.jit, static_argnames=("cfg",))
 def enqueue(pool: jnp.ndarray, msgs: jnp.ndarray, t: jnp.ndarray,
-            key: jnp.ndarray, cfg: NetConfig
+            key: jnp.ndarray, cfg: NetConfig,
+            edge_delay=None, edge_loss_pm=None
             ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Insert outgoing messages (``[M, lanes]``, invalid rows ignored) into
-    the pool. Returns ``(pool', n_sent, n_lost, n_overflow)``."""
+    the pool. Returns ``(pool', n_sent, n_lost, n_overflow)``.
+
+    ``edge_delay`` / ``edge_loss_pm`` are the fault engine's link-
+    degradation planes (``[NT, NT]`` int32 per ``(dest, origin)`` edge:
+    extra latency ticks, per-mille loss probability —
+    ``maelstrom_tpu/faults/``). ``None`` — every fault-free run — keeps
+    the pre-fault graph; zero-valued planes are value-identical to it,
+    and the edge-loss roll uses its own folded key so enabling the lane
+    never perturbs the base latency/loss draws."""
     M = msgs.shape[0]
     msg_valid = msgs[:, wire.VALID] == 1
 
@@ -173,6 +186,10 @@ def enqueue(pool: jnp.ndarray, msgs: jnp.ndarray, t: jnp.ndarray,
                       (msgs[:, wire.DEST] >= cfg.n_nodes))
     lat = _sample_latency(k_lat, M, cfg)
     lat = jnp.where(is_client_edge, 0, lat)
+    if edge_delay is not None:
+        # slow links: per-directed-edge extra ticks (keyed on the
+        # physical sender, like partitions and the base latency)
+        lat = lat + edge_delay[msgs[:, wire.DEST], msgs[:, wire.ORIGIN]]
     # deliverable no earlier than the next tick
     msgs = msgs.at[:, wire.DTICK].set(t + 1 + lat)
 
@@ -181,6 +198,12 @@ def enqueue(pool: jnp.ndarray, msgs: jnp.ndarray, t: jnp.ndarray,
         lost = (jax.random.uniform(k_loss, (M,)) < cfg.p_loss) & msg_valid
     else:
         lost = jnp.zeros((M,), dtype=bool)
+    if edge_loss_pm is not None:
+        # elevated per-edge loss: an independent roll on its own key
+        pm = edge_loss_pm[msgs[:, wire.DEST], msgs[:, wire.ORIGIN]]
+        u = jax.random.uniform(jax.random.fold_in(key, 2), (M,))
+        lost = lost | ((u * 1000.0 < pm.astype(jnp.float32))
+                       & msg_valid)
     live = msg_valid & ~lost
 
     # free-slot assignment: argsort puts empty slots first (stable)

@@ -579,12 +579,18 @@ def run_sim_pipelined(model: Model, sim: SimConfig, seed: int,
             from ..telemetry.stream import (scan_to_violation,
                                             scan_to_violations,
                                             stats_vec_to_net)
+            extra = None
+            if sim.faults.active:
+                # the plan is deterministic and host-known: the chunk's
+                # fault epoch costs no device traffic
+                from ..faults.engine import span_summary
+                extra = {"fault": span_summary(sim.faults, t0, length)}
             heartbeat.record_chunk(
                 chunk=chunk_idx[0], t0=t0, ticks=length,
                 net=stats_vec_to_net(svec),
                 violation=scan_to_violation(scan_np),
                 violations=scan_to_violations(scan_np),
-                overflowed=bool(ovf))
+                overflowed=bool(ovf), extra=extra)
         chunk_idx[0] += 1
         fetch_s[0] += time.monotonic() - t_f
 

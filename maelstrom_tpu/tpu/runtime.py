@@ -6,11 +6,16 @@ own message pool, partition matrix, and RNG stream. The runtime stacks
 lockstep:
 
     tick t:
+      faults    : fault-plan phase select; crash-restart wipe from the
+                  snapshot slab (maelstrom_tpu/faults/)
       nemesis   : recompute per-instance partition matrices from schedule
+                  (fault-plan edge blocks fold in)
       deliver   : vmap(netsim.deliver)   -> per-node inboxes
       node step : vmap over instances, vmap over nodes, scan over inbox
+                  (per-node local clocks under the clock-skew lane)
       client step: decode replies -> history events; sample/encode new ops
-      enqueue   : vmap(netsim.enqueue)   -> pool with latency/loss applied
+      enqueue   : vmap(netsim.enqueue)   -> pool with latency/loss (and
+                  fault-plan edge delay/loss) applied
 
 The whole loop is a single ``lax.scan`` over ticks, jitted once; the only
 host traffic is the initial state upload and the final history/stat
@@ -34,6 +39,8 @@ import jax.numpy as jnp
 
 from . import netsim, wire
 from .netsim import NetConfig, NetStats
+from ..faults import engine as faults_engine
+from ..faults.engine import FaultConfig, NO_PLANES
 from ..telemetry import recorder as flight
 from ..telemetry.recorder import TelemetryConfig
 
@@ -158,6 +165,35 @@ class Model:
         """Per-tick hook for the fused path: like tick(), but takes
         the pre-drawn randomness from node_rng instead of a key."""
         raise NotImplementedError
+
+    # --- crash-restart fault lane (maelstrom_tpu/faults/) -----------------
+    #
+    # When a fault plan carries a crash lane, the runtime holds each
+    # victim in reset: every crashed tick the node's row is rebuilt via
+    # restart_row() and selected in under the crash mask, and the
+    # snapshot slab captures snapshot_row() of every healthy node on
+    # the plan's snapshot stride (1 = write-through durability). The
+    # default semantics are COLD restart — total state loss, the right
+    # behavior for models without durable storage; models with a
+    # durability story (Raft's persisted term/vote/log) override both
+    # hooks (models/raft.py).
+
+    def snapshot_row(self, row) -> Any:
+        """The durable subset of a node row persisted into the fault
+        engine's snapshot slab. Must be pure leaf selection /
+        restructuring (no math): it is applied to BATCHED rows in both
+        carry layouts. Default: the whole row."""
+        return row
+
+    def restart_row(self, n_nodes: int, node_idx, key, params, snap,
+                    t) -> Any:
+        """Rebuild a node row as of a restart at (node-local) tick
+        ``t``, given its last snapshot-slab row ``snap``. Default: the
+        init path — a cold boot that forgets everything (``snap``
+        ignored). Models with durable state restore it here; absolute
+        timers must be re-based on ``t``."""
+        del snap, t
+        return self.init_row(n_nodes, node_idx, key, params)
 
     def invariants(self, node_state, cfg: NetConfig, params) -> jnp.ndarray:
         """Cheap whole-cluster safety invariants, evaluated on-device every
@@ -446,7 +482,7 @@ def partition_matrix(nem: NemesisConfig, cfg: NetConfig, t, instance_key
 # --- node phase -----------------------------------------------------------
 
 def node_phase(model: Model, node_state, inbox_nodes, t, key,
-               cfg: NetConfig, params):
+               cfg: NetConfig, params, t_nodes=None):
     """All nodes of one instance handle their inboxes then run tick hooks.
 
     node_state: pytree with leading node axis [N, ...].
@@ -459,6 +495,12 @@ def node_phase(model: Model, node_state, inbox_nodes, t, key,
     the Model fused-protocol docs). Both produce bit-identical
     trajectories; the fused driver exists because its jaxpr is ~2x
     smaller and its HLO is while-free (models/raft_core.py).
+
+    ``t_nodes`` ([N] int32, fault engine's clock-skew lane) substitutes
+    each node's LOCAL clock for ``t`` in its timer logic (election
+    deadlines, heartbeat cadence); ``None`` — the default and every
+    fault-free run — hands every node the global ``t`` through the
+    identical closure the pre-fault runtime used.
     """
     N = cfg.n_nodes
     L = cfg.lanes
@@ -480,7 +522,7 @@ def node_phase(model: Model, node_state, inbox_nodes, t, key,
     if model.fused_node:
         assert model.max_out == 1, "fused node step assumes max_out == 1"
 
-        def per_node(row, inbox_row, nkey, node_idx):
+        def per_node(row, inbox_row, nkey, node_idx, tn):
             K = inbox_row.shape[0]
             # [K+1] slot keys in one batched fold: slot i is the legacy
             # per-message fold_in(nkey, i), slot K the legacy tick key —
@@ -490,15 +532,15 @@ def node_phase(model: Model, node_state, inbox_nodes, t, key,
             slot_rng, tick_rng = model.node_rng(mkeys)
             row, outs_k = jax.lax.scan(
                 lambda r, x: model.inbox_step(r, node_idx, x[0], x[1],
-                                              t, cfg, params),
+                                              tn, cfg, params),
                 row, (inbox_row, slot_rng), unroll=True)
-            row, outs_t = model.fused_tick(row, node_idx, t, tick_rng,
+            row, outs_t = model.fused_tick(row, node_idx, tn, tick_rng,
                                            cfg, params)
             # fused models pre-stamp SRC/ORIGIN on every emitted row
             # (see the fused-protocol contract) — no re-stamp pass
             return row, jnp.concatenate([outs_k, outs_t], axis=0)
     else:
-        def per_node(row, inbox_row, nkey, node_idx):
+        def per_node(row, inbox_row, nkey, node_idx, tn):
             def step(r, x):
                 msg, i = x
                 # distinct key per handled message — a shared key would
@@ -506,20 +548,28 @@ def node_phase(model: Model, node_state, inbox_nodes, t, key,
                 mkey = jax.random.fold_in(nkey, i)
                 # models self-gate on invalid (all-zero) messages — see
                 # the Model.handle contract
-                return model.handle(r, node_idx, msg, t, mkey, cfg,
+                return model.handle(r, node_idx, msg, tn, mkey, cfg,
                                     params)
 
             k_idx = jnp.arange(inbox_row.shape[0], dtype=jnp.int32)
             row, outs_k = jax.lax.scan(step, row, (inbox_row, k_idx))
             tkey = jax.random.fold_in(nkey, inbox_row.shape[0])
-            row, outs_t = model.tick(row, node_idx, t, tkey, cfg, params)
+            row, outs_t = model.tick(row, node_idx, tn, tkey, cfg, params)
             outs = jnp.concatenate(
                 [outs_k.reshape(-1, L), outs_t.reshape(-1, L)], axis=0)
             return row, stamp(outs, node_idx)
 
     keys = jax.random.split(key, N)
     idx = jnp.arange(N, dtype=jnp.int32)
-    return jax.vmap(per_node)(node_state, inbox_nodes, keys, idx)
+    if t_nodes is None:
+        # the pre-fault path: every node's clock IS the global t,
+        # closed over exactly as before (no per-node clock vector in
+        # the graph — bit- and cost-identical to the pre-fault tick)
+        return jax.vmap(
+            lambda row, ib, k, i: per_node(row, ib, k, i, t))(
+            node_state, inbox_nodes, keys, idx)
+    return jax.vmap(per_node)(node_state, inbox_nodes, keys, idx,
+                              t_nodes)
 
 
 # --- the scan loop --------------------------------------------------------
@@ -549,6 +599,13 @@ class SimConfig(NamedTuple):
                                  # recorder.py); enabled=False removes
                                  # the telemetry leaves from the carry
                                  # entirely (zero-overhead path)
+    faults: FaultConfig = FaultConfig()
+                                 # compiled fault plan (maelstrom_tpu/
+                                 # faults/): crash-restart, link
+                                 # degradation, clock skew. The default
+                                 # (disabled) config traces EXACTLY the
+                                 # pre-fault tick graph
+                                 # (doc/guide/10-faults.md)
 
 
 class TickOutputs(NamedTuple):
@@ -577,6 +634,12 @@ class Carry(NamedTuple):
     telemetry: Any = None      # flight recorder (telemetry/recorder.py);
                                # batch-LEADING in BOTH layouts, None when
                                # sim.telemetry.enabled is False
+    snapshots: Any = None      # fault-engine snapshot slab: the durable
+                               # subset of node_state (Model.snapshot_row
+                               # per node, same layout orientation as
+                               # node_state), read by crash-restart
+                               # recovery (maelstrom_tpu/faults/). None
+                               # unless the fault plan has a crash lane
 
 
 # RNG purpose tags. Every random draw in the simulation derives from
@@ -592,6 +655,7 @@ _RNG_NEMESIS = 1
 _RNG_NODE = 2
 _RNG_CLIENT = 3
 _RNG_ENQUEUE = 4
+_RNG_RESTART = 5    # crash-restart re-init jitter (faults/ crash lane)
 
 
 def _instance_keys(master, purpose: int, instance_ids, t=None):
@@ -624,9 +688,15 @@ def init_carry(model: Model, sim: SimConfig, seed: int, params,
         _instance_keys(key, _RNG_INIT, instance_ids))
     pool_shape = ((cfg.pool_slots, cfg.lanes, I) if minor
                   else (I, cfg.pool_slots, cfg.lanes))
+    # the fault engine's snapshot slab seeds from the init state
+    # (snapshot_row is pure leaf selection, so it applies to the
+    # batched node_state in either layout orientation)
+    snapshots = (model.snapshot_row(node_state)
+                 if sim.faults.has_crash else None)
     return Carry(
         pool=jnp.zeros(pool_shape, jnp.int32),
         node_state=node_state,
+        snapshots=snapshots,
         client_state=jax.tree.map(
             (lambda a: jnp.broadcast_to(a[..., None], a.shape + (I,)))
             if minor else
@@ -651,7 +721,8 @@ def canonical_carry(carry: Carry, sim: SimConfig) -> Carry:
     return carry._replace(
         pool=to_lead(carry.pool),
         node_state=jax.tree.map(to_lead, carry.node_state),
-        client_state=jax.tree.map(to_lead, carry.client_state))
+        client_state=jax.tree.map(to_lead, carry.client_state),
+        snapshots=jax.tree.map(to_lead, carry.snapshots))
 
 
 def carry_from_canonical(carry: Carry, sim: SimConfig) -> Carry:
@@ -662,7 +733,8 @@ def carry_from_canonical(carry: Carry, sim: SimConfig) -> Carry:
     return carry._replace(
         pool=to_minor(carry.pool),
         node_state=jax.tree.map(to_minor, carry.node_state),
-        client_state=jax.tree.map(to_minor, carry.client_state))
+        client_state=jax.tree.map(to_minor, carry.client_state),
+        snapshots=jax.tree.map(to_minor, carry.snapshots))
 
 
 def _update_telemetry(tel, sim: SimConfig, t, events, invoked_prev,
@@ -717,6 +789,27 @@ def make_tick_fn(model: Model, sim: SimConfig, params,
     def tick_fn(carry: Carry, t):
         key = carry.key
 
+        # fault plan: select tick t's planes (static no-op when the
+        # plan has no lanes — NO_PLANES keeps every branch below on
+        # the pre-fault path)
+        fx = sim.faults
+        with jax.named_scope("faults"):
+            planes = (faults_engine.tick_planes(fx, cfg, t)
+                      if fx.active else NO_PLANES)
+            node_state_in = carry.node_state
+            snapshots = carry.snapshots
+            if planes.crash is not None:
+                # crash-restart: victims held in reset — rebuilt from
+                # their snapshot-slab row (or cold) every crashed tick
+                tvec = (planes.t_nodes if planes.t_nodes is not None
+                        else jnp.broadcast_to(t, (N,)).astype(jnp.int32))
+                wipe_keys = _instance_keys(key, _RNG_RESTART,
+                                           instance_ids, t)
+                node_state_in = jax.vmap(
+                    lambda st, sn, k: faults_engine.wipe_crashed(
+                        model, st, sn, planes.crash, tvec, k, cfg,
+                        params))(node_state_in, snapshots, wipe_keys)
+
         # nemesis keys are t-INdependent: partition_matrix folds in the
         # phase index itself, so a grudge holds for its whole phase (the
         # reference draws one grudge per nemesis op, nemesis.clj) instead
@@ -725,6 +818,10 @@ def make_tick_fn(model: Model, sim: SimConfig, params,
             ikeys = _instance_keys(key, _RNG_NEMESIS, instance_ids)
             partitions = jax.vmap(
                 lambda ik: partition_matrix(nem, cfg, t, ik))(ikeys)
+            if planes.block is not None:
+                # fault-plan edge blocks (asymmetric links + crashed
+                # receivers) fold into the delivery partition plane
+                partitions = partitions | planes.block[None]
 
         from ..ops.delivery import _interpret, deliver_pallas, \
             pallas_enabled
@@ -744,8 +841,9 @@ def make_tick_fn(model: Model, sim: SimConfig, params,
             node_keys = _instance_keys(key, _RNG_NODE, instance_ids, t)
             node_state, node_outs = jax.vmap(
                 lambda st, ib, k: node_phase(model, st, ib, t, k, cfg,
-                                             params))(
-                    carry.node_state, inbox[:, :N], node_keys)
+                                             params,
+                                             t_nodes=planes.t_nodes))(
+                    node_state_in, inbox[:, :N], node_keys)
 
         invoked_prev = carry.client_state.invoked
         with jax.named_scope("client_step"):
@@ -756,6 +854,11 @@ def make_tick_fn(model: Model, sim: SimConfig, params,
                     carry.client_state, inbox[:, N:], client_keys)
 
         with jax.named_scope("enqueue"):
+            if planes.crash is not None:
+                # a dead process sends nothing: invalidate the victims'
+                # emitted rows before they reach the wire
+                node_outs = node_outs.at[..., wire.VALID].mul(
+                    (~planes.crash).astype(jnp.int32)[None, :, None])
             outs = jnp.concatenate(
                 [node_outs.reshape(I, -1, cfg.lanes), reqs], axis=1)
             # stamp network-unique message ids (send-time allocation, the
@@ -765,8 +868,17 @@ def make_tick_fn(model: Model, sim: SimConfig, params,
                 t * M + jnp.arange(M, dtype=jnp.int32)[None, :])
             enq_keys = _instance_keys(key, _RNG_ENQUEUE, instance_ids, t)
             pool, n_sent, n_lost, n_ovf = jax.vmap(
-                lambda p, m, k: netsim.enqueue(p, m, t, k, cfg))(
+                lambda p, m, k: netsim.enqueue(
+                    p, m, t, k, cfg, edge_delay=planes.delay,
+                    edge_loss_pm=planes.loss_pm))(
                     pool, outs, enq_keys)
+
+        if snapshots is not None:
+            with jax.named_scope("faults"):
+                snapshots = jax.vmap(
+                    lambda st, sn: faults_engine.update_snapshots(
+                        model, st, sn, planes.crash, t,
+                        fx.snapshot_every))(node_state, snapshots)
 
         stats = NetStats(
             sent=carry.stats.sent + jnp.sum(n_sent),
@@ -787,7 +899,7 @@ def make_tick_fn(model: Model, sim: SimConfig, params,
                           client_state=client_state, stats=stats,
                           violations=carry.violations
                           + violated.astype(jnp.int32),
-                          key=key, telemetry=tel)
+                          key=key, telemetry=tel, snapshots=snapshots)
         J = sim.journal_instances
         R = sim.record_instances
         ys = TickOutputs(
@@ -821,13 +933,32 @@ def _make_tick_fn_minor(model: Model, sim: SimConfig, params,
     nem = sim.nemesis
     N = cfg.n_nodes
 
-    def tick_one(pool, node_row, client_row, instance_id, master, t):
+    fx = sim.faults
+
+    def tick_one(pool, node_row, client_row, snap_row, instance_id,
+                 master, t):
         """One instance's full tick. pool [S, L]; returns the new
         per-instance state plus this tick's outputs and stat deltas."""
+        with jax.named_scope("faults"):
+            # fault planes depend only on t (shared plan), so under the
+            # instance vmap they stay unbatched — computed once
+            planes = (faults_engine.tick_planes(fx, cfg, t)
+                      if fx.active else NO_PLANES)
+            if planes.crash is not None:
+                tvec = (planes.t_nodes if planes.t_nodes is not None
+                        else jnp.broadcast_to(t, (N,)).astype(jnp.int32))
+                wipe_key = jax.random.fold_in(jax.random.fold_in(
+                    jax.random.fold_in(master, _RNG_RESTART), t),
+                    instance_id)
+                node_row = faults_engine.wipe_crashed(
+                    model, node_row, snap_row, planes.crash, tvec,
+                    wipe_key, cfg, params)
         with jax.named_scope("nemesis"):
             nem_key = jax.random.fold_in(
                 jax.random.fold_in(master, _RNG_NEMESIS), instance_id)
             partitions = partition_matrix(nem, cfg, t, nem_key)
+            if planes.block is not None:
+                partitions = partitions | planes.block
         with jax.named_scope("deliver"):
             pool, inbox, n_del, n_dropp = netsim.deliver(pool, partitions,
                                                          t, cfg)
@@ -836,7 +967,8 @@ def _make_tick_fn_minor(model: Model, sim: SimConfig, params,
             node_key = jax.random.fold_in(jax.random.fold_in(
                 jax.random.fold_in(master, _RNG_NODE), t), instance_id)
             node_row, node_outs = node_phase(model, node_row, inbox[:N], t,
-                                             node_key, cfg, params)
+                                             node_key, cfg, params,
+                                             t_nodes=planes.t_nodes)
 
         with jax.named_scope("client_step"):
             client_key = jax.random.fold_in(jax.random.fold_in(
@@ -847,6 +979,9 @@ def _make_tick_fn_minor(model: Model, sim: SimConfig, params,
                                                    params)
 
         with jax.named_scope("enqueue"):
+            if planes.crash is not None:
+                node_outs = node_outs.at[..., wire.VALID].mul(
+                    (~planes.crash).astype(jnp.int32)[:, None])
             outs = jnp.concatenate(
                 [node_outs.reshape(-1, cfg.lanes), reqs], axis=0)
             M = outs.shape[0]
@@ -854,10 +989,16 @@ def _make_tick_fn_minor(model: Model, sim: SimConfig, params,
                 t * M + jnp.arange(M, dtype=jnp.int32))
             enq_key = jax.random.fold_in(jax.random.fold_in(
                 jax.random.fold_in(master, _RNG_ENQUEUE), t), instance_id)
-            pool, n_sent, n_lost, n_ovf = netsim.enqueue(pool, outs, t,
-                                                         enq_key, cfg)
+            pool, n_sent, n_lost, n_ovf = netsim.enqueue(
+                pool, outs, t, enq_key, cfg, edge_delay=planes.delay,
+                edge_loss_pm=planes.loss_pm)
+        if snap_row is not None:
+            with jax.named_scope("faults"):
+                snap_row = faults_engine.update_snapshots(
+                    model, node_row, snap_row, planes.crash, t,
+                    fx.snapshot_every)
         violated = model.invariants(node_row, cfg, params)
-        return (pool, node_row, client_row,
+        return (pool, node_row, client_row, snap_row,
                 (n_sent, n_del, n_dropp, n_lost, n_ovf),
                 violated, jnp.any(partitions), events, outs, inbox)
 
@@ -867,15 +1008,15 @@ def _make_tick_fn_minor(model: Model, sim: SimConfig, params,
     # identical to the lead path's
     batched = jax.vmap(
         tick_one,
-        in_axes=(-1, -1, -1, 0, None, None),
-        out_axes=(-1, -1, -1, 0, 0, 0, 0, 0, 0))
+        in_axes=(-1, -1, -1, -1, 0, None, None),
+        out_axes=(-1, -1, -1, -1, 0, 0, 0, 0, 0, 0))
 
     def tick_fn(carry: Carry, t):
         invoked_prev = jnp.moveaxis(carry.client_state.invoked, -1, 0)
-        (pool, node_state, client_state, deltas, violated, part_active,
-         events, outs, inbox) = batched(carry.pool, carry.node_state,
-                                        carry.client_state, instance_ids,
-                                        carry.key, t)
+        (pool, node_state, client_state, snapshots, deltas, violated,
+         part_active, events, outs, inbox) = batched(
+             carry.pool, carry.node_state, carry.client_state,
+             carry.snapshots, instance_ids, carry.key, t)
         n_sent, n_del, n_dropp, n_lost, n_ovf = deltas
         stats = NetStats(
             sent=carry.stats.sent + jnp.sum(n_sent),
@@ -894,7 +1035,8 @@ def _make_tick_fn_minor(model: Model, sim: SimConfig, params,
                           client_state=client_state, stats=stats,
                           violations=carry.violations
                           + violated.astype(jnp.int32),
-                          key=carry.key, telemetry=tel)
+                          key=carry.key, telemetry=tel,
+                          snapshots=snapshots)
         J = sim.journal_instances
         R = sim.record_instances
         ys = TickOutputs(

@@ -60,6 +60,10 @@ def pytest_configure(config):
         "markers", "campaign: durable control-plane tests — "
                    "checkpoint/resume, run queue, trend store "
                    "(maelstrom_tpu/campaign/)")
+    config.addinivalue_line(
+        "markers", "faults: device-resident fault-plan engine tests — "
+                   "crash-restart, link degradation, clock skew, "
+                   "planted-bug anomaly matrix (maelstrom_tpu/faults/)")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -504,6 +504,143 @@ def test_requeue_force_never_steals_live_same_host_lock(tmp_path):
     assert cqueue.requeue_stale(cdir3, force=True) == [0]
 
 
+def test_lease_expiry_requeues_lost_remote_worker(tmp_path):
+    """Lease-file TTLs (PR-8 residual): a cross-host lock whose lease
+    expired is stale WITHOUT --force — the lost-remote-worker case
+    that used to need `requeue_stale --force`."""
+    cdir = _tiny_campaign(str(tmp_path), n=1)
+    claim = cqueue.claim_next(cdir, worker="remote:1")
+    # a fresh claim writes a lease
+    with open(claim.lock) as f:
+        lock = json.load(f)
+    assert lock["lease-expires"] > time.time()
+    # forge it as a remote worker whose lease ran out
+    with open(claim.lock, "w") as f:
+        json.dump({"pid": os.getpid(), "host": "some-other-host",
+                   "worker": "remote:1", "claimed": time.time() - 900,
+                   "lease-expires": time.time() - 300}, f)
+    assert cqueue.requeue_stale(cdir) == [0]
+    again = cqueue.claim_next(cdir, worker="rescuer")
+    assert again is not None and again.item["previous-status"] \
+        == "preempted"
+    cqueue.finish_item(again, cqueue.DONE, **{"valid?": True})
+
+
+def test_fresh_remote_lease_not_auto_stolen_force_overrides(tmp_path):
+    """An UNexpired remote lease presumes its worker alive — never
+    auto-stolen; --force is the operator asserting the remote worker
+    is lost and overrides the TTL. A lapsed-lease SAME-HOST lock with
+    a live pid stays held either way (the pid probe is authoritative
+    locally)."""
+    cdir = _tiny_campaign(str(tmp_path), n=1)
+    claim = cqueue.claim_next(cdir, worker="remote:1")
+    with open(claim.lock, "w") as f:
+        json.dump({"pid": os.getpid(), "host": "some-other-host",
+                   "worker": "remote:1", "claimed": time.time(),
+                   "lease-expires": time.time() + 600}, f)
+    assert cqueue.requeue_stale(cdir) == []
+    assert cqueue.claim_next(cdir, worker="thief") is None
+    assert cqueue.requeue_stale(cdir, force=True) == [0]
+
+    # same-host live pid with a LAPSED lease: still running (stopped/
+    # swapping workers miss renewals) — never stolen, force or not
+    import socket
+    cdir2 = _tiny_campaign(str(tmp_path / "local"), n=1)
+    c2 = cqueue.claim_next(cdir2, worker="slow:1")
+    with open(c2.lock, "w") as f:
+        json.dump({"pid": os.getpid(), "host": socket.gethostname(),
+                   "worker": "slow:1", "claimed": time.time() - 900,
+                   "lease-expires": time.time() - 300}, f)
+    assert cqueue.requeue_stale(cdir2) == []
+    assert cqueue.requeue_stale(cdir2, force=True) == []
+    assert cqueue.claim_next(cdir2, worker="thief") is None
+
+
+def test_expired_lease_claimed_directly(tmp_path):
+    """claim_next itself steals an expired lease (no separate requeue
+    pass needed): the dead remote worker's item re-runs."""
+    cdir = _tiny_campaign(str(tmp_path), n=1)
+    claim = cqueue.claim_next(cdir, worker="remote:1")
+    with open(claim.lock, "w") as f:
+        json.dump({"pid": os.getpid(), "host": "some-other-host",
+                   "worker": "remote:1", "claimed": time.time() - 900,
+                   "lease-expires": time.time() - 1}, f)
+    again = cqueue.claim_next(cdir, worker="rescuer")
+    assert again is not None
+    assert again.item["claimed-by"] == "rescuer"
+    cqueue.finish_item(again, cqueue.DONE)
+
+
+def test_renew_lease_extends_and_stops_after_finish(tmp_path):
+    cdir = _tiny_campaign(str(tmp_path), n=1)
+    claim = cqueue.claim_next(cdir, worker="w")
+    with open(claim.lock) as f:
+        before = json.load(f)["lease-expires"]
+    time.sleep(0.05)
+    assert cqueue.renew_lease(claim.lock, worker="w")
+    with open(claim.lock) as f:
+        after = json.load(f)["lease-expires"]
+    assert after > before
+    cqueue.finish_item(claim, cqueue.DONE)
+    # lock gone: renewal reports False (the LeaseKeeper's stop signal)
+    assert cqueue.renew_lease(claim.lock, worker="w") is False
+
+
+def test_renew_lease_forfeits_when_stolen_or_lapsed(tmp_path):
+    """A renewer that finds its lock held by someone else — or its own
+    lease already expired — must NOT write: the steal/claim path owns
+    the lock now, and a clobbering renewal would double-claim."""
+    cdir = _tiny_campaign(str(tmp_path), n=1)
+    claim = cqueue.claim_next(cdir, worker="w1")
+    # stolen and re-claimed by another worker
+    with open(claim.lock, "w") as f:
+        json.dump(cqueue._lease_body("w2"), f)
+    assert cqueue.renew_lease(claim.lock, worker="w1") is False
+    with open(claim.lock) as f:
+        assert json.load(f)["worker"] == "w2"   # untouched
+    # own lease lapsed: forfeited, not refreshed
+    with open(claim.lock, "w") as f:
+        json.dump(dict(cqueue._lease_body("w1"),
+                       **{"lease-expires": time.time() - 5}), f)
+    assert cqueue.renew_lease(claim.lock, worker="w1") is False
+    cqueue.finish_item(claim, cqueue.DONE)
+
+
+def test_lease_is_ours_distinguishes_terminal_from_transient(tmp_path):
+    """The LeaseKeeper's stop test: a failed renewal only terminates
+    the keeper when the lease is genuinely lost — ours-and-fresh means
+    the failure was transient and renewal must keep retrying."""
+    cdir = _tiny_campaign(str(tmp_path), n=1)
+    claim = cqueue.claim_next(cdir, worker="w1")
+    assert cqueue.lease_is_ours(claim.lock, worker="w1")
+    assert not cqueue.lease_is_ours(claim.lock, worker="w2")
+    with open(claim.lock, "w") as f:
+        json.dump(dict(cqueue._lease_body("w1"),
+                       **{"lease-expires": time.time() - 5}), f)
+    assert not cqueue.lease_is_ours(claim.lock, worker="w1")
+    cqueue.finish_item(claim, cqueue.DONE)
+    assert not cqueue.lease_is_ours(claim.lock, worker="w1")
+
+
+def test_lease_keeper_renews_while_item_runs(tmp_path):
+    from maelstrom_tpu.campaign.runner import LeaseKeeper
+    cdir = _tiny_campaign(str(tmp_path), n=1)
+    # default worker id: the keeper renews as _worker_id() and the
+    # ownership check must match (the campaign runner's arrangement)
+    claim = cqueue.claim_next(cdir)
+    with open(claim.lock) as f:
+        before = json.load(f)["claimed"]
+    with LeaseKeeper(claim.lock, ttl=0.3):
+        time.sleep(0.5)
+    # the keeper re-stamped the lease at ttl/3 cadence: the write time
+    # advanced and the expiry still covers now + a fresh ttl window
+    with open(claim.lock) as f:
+        lock = json.load(f)
+    assert lock["claimed"] > before
+    assert lock["lease-expires"] > time.time()
+    cqueue.finish_item(claim, cqueue.DONE)
+
+
 def test_campaign_end_to_end_with_planted_bug(tmp_path):
     """A 2-item campaign — clean echo + the planted double-vote mutant
     — drains to done with the mutant flagged invalid, and the trend

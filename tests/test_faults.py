@@ -1,0 +1,536 @@
+"""Device-resident fault-plan engine: spec/compile units, the
+all-healthy bit-identity guarantee, and the planted-bug anomaly matrix.
+
+The engine's contract (doc/guide/10-faults.md) has three legs, each
+pinned here:
+
+1. **Bit-identity** — a fault plan whose lanes are present but
+   value-neutral (zero delay/loss, rate-1.0 skew, crash phases beyond
+   the horizon) produces trajectories BIT-IDENTICAL to a fault-free
+   run, in BOTH carry layouts; and an active plan produces identical
+   trajectories across layouts (the engine rides the same vmapped
+   per-instance code both ways). Combined with the frozen pre-refactor
+   goldens (tests/test_node_fusion.py), this proves fault-free runs
+   are bit-identical to pre-fault-engine history.
+2. **Anomaly matrix** — for each fault lane, a planted-bug model trips
+   its checker while the CORRECT model stays valid under the SAME
+   plan: crash-restart vs RaftForgetsSnapshot (amnesiac recovery →
+   committed-prefix/election-safety invariants + WGL), clock skew vs
+   RaftFixedTimeout (lockstep livelock → availability), link
+   degradation vs RaftStaleRead (lagging replicas served locally →
+   WGL).
+3. **Observatory integration** — the funnel replays violating
+   instances bit-exactly under a fault plan (instance-stable RNG holds
+   with the new restart lane), and fault epochs ride the heartbeat.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from maelstrom_tpu.faults import (FAULT_KINDS, FaultConfig, SpecError,
+                                  compile_fault_plan,
+                                  generate_fault_plan,
+                                  validate_fault_plan)
+from maelstrom_tpu.faults.engine import phase_at, phase_summary
+from maelstrom_tpu.models import get_model
+from maelstrom_tpu.tpu.harness import (make_sim_config, replay_instances,
+                                       run_tpu_test)
+from maelstrom_tpu.tpu.runtime import canonical_carry, run_sim
+
+pytestmark = pytest.mark.faults
+
+
+# --- shared fixtures -------------------------------------------------------
+
+# crash-lane matrix plan: commit writes on a healthy cluster, crash a
+# MAJORITY {0, 1}, then isolate the full-log survivor so the restarted
+# pair must form a quorum from whatever their recovery preserved.
+# Correct Raft recovers its durable term/vote/log from the snapshot
+# slab and elects safely; the forget-snapshot mutant reboots amnesiac
+# and commits fresh entries over slots the survivor holds committed —
+# the on-device committed-prefix invariant trips.
+_ISOLATE_2 = [{"dst": 2, "src": 0, "block": True},
+              {"dst": 2, "src": 1, "block": True},
+              {"dst": 0, "src": 2, "block": True},
+              {"dst": 1, "src": 2, "block": True}]
+CRASH_PLAN = {"phases": [{"until": 220},
+                         {"until": 280, "crash": [0, 1]},
+                         {"until": 520, "links": _ISOLATE_2},
+                         {"until": 700}]}
+# inbox_k=2 / pool_slots=24 throughout: an ~8x smaller unrolled inbox
+# graph per compile (the suite's dominant cost), anomaly rates verified
+# across seeds at exactly these shapes
+CRASH_OPTS = dict(node_count=3, concurrency=4, n_instances=32,
+                  record_instances=4, time_limit=0.7, rate=300.0,
+                  latency=5.0, rpc_timeout=0.08, recovery_time=0.1,
+                  fault_plan=CRASH_PLAN, heartbeat=False, seed=7,
+                  funnel_max=6, inbox_k=2, pool_slots=24)
+
+# skew-lane matrix plan: a uniformly 2x-fast cluster — elections fire
+# twice as often relative to network latency. Jittered timeouts break
+# the symmetry; the fixed-timeout mutant's deadlines collide in
+# lockstep forever (no leader, zero acks).
+SKEW_PLAN = {"phases": [{"until": 10_000,
+                         "skew": {"0": 2.0, "1": 2.0, "2": 2.0}}]}
+SKEW_OPTS = dict(node_count=3, concurrency=4, n_instances=8,
+                 record_instances=4, time_limit=0.6, rate=300.0,
+                 latency=5.0, latency_dist="constant", rpc_timeout=0.08,
+                 recovery_time=0.1, availability=0.15, funnel=False,
+                 heartbeat=False, fault_plan=SKEW_PLAN, seed=7,
+                 inbox_k=2, pool_slots=24)
+
+# link-lane matrix plan: every server-server edge slow AND lossy —
+# replication lags hard, so locally-served reads are stale.
+_DEGRADE_ALL = [{"dst": d, "src": s, "delay": 45, "loss": 0.35}
+                for d in range(3) for s in range(3) if d != s]
+LINK_PLAN = {"phases": [{"until": 120},
+                        {"until": 800, "links": _DEGRADE_ALL}]}
+LINK_OPTS = dict(node_count=3, concurrency=8, n_instances=16,
+                 record_instances=8, time_limit=0.8, rate=500.0,
+                 latency=5.0, rpc_timeout=0.08, recovery_time=0.1,
+                 fault_plan=LINK_PLAN, funnel=False, heartbeat=False,
+                 seed=7, inbox_k=2, pool_slots=24)
+
+
+def _run_carry(workload, opts, layout="lead"):
+    model = get_model(workload, opts["node_count"])
+    sim = make_sim_config(model, {**opts, "layout": layout})
+    return model, sim, run_sim(model, sim, opts["seed"],
+                               model.make_params(opts["node_count"]))
+
+
+# --- spec / compile units --------------------------------------------------
+
+
+class TestSpec:
+    def test_compile_roundtrip(self):
+        fx = compile_fault_plan(CRASH_PLAN, 3, stop_tick=600)
+        assert fx.enabled and fx.has_crash and fx.has_links
+        assert not fx.has_skew
+        assert fx.untils == (220, 280, 520, 700)
+        assert fx.crash[1] == (0, 1)
+        assert len(fx.links[2]) == 4
+        # phases index correctly, and stop_tick heals
+        assert phase_at(fx, 0) == 0
+        assert phase_at(fx, 250) == 1
+        assert phase_at(fx, 280) == 2
+        assert phase_at(fx, 599) == 3
+        assert phase_at(fx, 600) == 4      # healed row
+        s = phase_summary(fx, 250)
+        assert s["crashed"] == [0, 1]
+
+    def test_none_plan_is_disabled(self):
+        fx = compile_fault_plan(None, 3, stop_tick=600)
+        assert fx == FaultConfig()
+        assert not fx.active
+
+    def test_loss_stored_per_mille_and_skew_in_64ths(self):
+        fx = compile_fault_plan(
+            {"phases": [{"until": 10,
+                         "links": [{"dst": 0, "src": 1, "loss": 0.25}],
+                         "skew": {"2": 1.5}}]}, 3, stop_tick=600)
+        assert fx.links[0][0][4] == 250
+        assert fx.skew[0] == ((2, 96),)
+
+    @pytest.mark.parametrize("plan,msg", [
+        ({}, "phases"),
+        ({"phases": [{"until": 0}]}, "until"),
+        ({"phases": [{"until": 10}, {"until": 5}]}, "until"),
+        ({"phases": [{"until": 10, "crash": [7]}]}, "out of range"),
+        ({"phases": [{"until": 10,
+                      "links": [{"dst": 0, "src": 1, "loss": 2.0}]}]},
+         "loss"),
+        ({"phases": [{"until": 10, "skew": {"0": 100.0}}]}, "rate"),
+        ({"snapshot_every": 0, "phases": [{"until": 10}]},
+         "snapshot_every"),
+    ])
+    def test_validation_rejects(self, plan, msg):
+        with pytest.raises(SpecError, match=msg):
+            validate_fault_plan(plan, 3)
+
+    def test_dash_keys_tolerated(self):
+        fx = compile_fault_plan(
+            {"snapshot-every": 2,
+             "phases": [{"until": 10, "crash": [0]}]}, 3, stop_tick=600)
+        assert fx.snapshot_every == 2 and fx.crash[0] == (0,)
+
+    def test_generators_compose(self):
+        plan = generate_fault_plan(list(FAULT_KINDS), 3, 600, 50, 500)
+        fx = compile_fault_plan(plan, 3, stop_tick=500)
+        assert fx.has_crash and fx.has_links and fx.has_skew
+        # crash victims are always a minority (correct models must
+        # survive the generated plan)
+        for victims in fx.crash:
+            assert len(victims) <= 1
+        # skew alone produces a single whole-run phase
+        solo = compile_fault_plan(
+            generate_fault_plan(["clock-skew"], 3, 600, 50, 500),
+            3, stop_tick=500)
+        assert solo.has_skew and not solo.has_crash
+        assert len(solo.untils) == 1
+
+    def test_duplicate_edge_entries_merge(self):
+        """Two entries for one directed edge combine (the documented
+        'one edge may combine delay and loss') instead of the second
+        zeroing the first's fields."""
+        from maelstrom_tpu.faults.engine import _planes_np
+        fx = compile_fault_plan(
+            {"phases": [{"until": 50, "links": [
+                {"dst": 0, "src": 1, "delay": 20},
+                {"dst": 0, "src": 1, "loss": 0.25},
+                {"dst": 0, "src": 1, "block": True}]}]},
+            3, stop_tick=600)
+        _, _, block, delay, loss, _ = _planes_np(fx, 3, 2)
+        assert delay[0, 0, 1] == 20
+        assert loss[0, 0, 1] == 250
+        assert block[0, 0, 1]
+
+    def test_single_node_fault_kinds_rejected(self):
+        """crash-restart/link-degrade cannot target a 1-node cluster:
+        asking for them must be a hard error, not a silently fault-free
+        'valid' run; clock-skew (which can) still works."""
+        kafka = get_model("kafka", 1)
+        with pytest.raises(ValueError, match="no fault lanes"):
+            make_sim_config(kafka, dict(node_count=1,
+                                        nemesis=["crash-restart"]))
+        sim = make_sim_config(kafka, dict(node_count=1,
+                                          nemesis=["clock-skew"]))
+        assert sim.faults.has_skew
+
+    def test_generator_clamps_oversized_interval(self):
+        """A nemesis interval longer than the horizon must still yield
+        an ACTIVE plan (at least one fault phase) — asking for faults
+        and silently running fault-free would be a lie. This is the
+        default 10s interval vs a 2-3s run."""
+        for kinds in (["crash-restart"], ["crash-restart",
+                                          "clock-skew"]):
+            plan = generate_fault_plan(kinds, 3, n_ticks=2500,
+                                       interval=10_000, stop_tick=2400)
+            fx = compile_fault_plan(plan, 3, stop_tick=2400)
+            assert fx.active, (kinds, plan)
+            assert fx.has_crash
+            if "clock-skew" in kinds:
+                assert fx.has_skew
+
+
+# --- bit-identity ----------------------------------------------------------
+
+# lanes PRESENT but value-neutral: zero delay/loss edges, rate-1.0 skew
+# on every node, and a crash phase parked beyond stop_tick — the full
+# engine machinery (snapshot slab, wipe select, edge planes, local
+# clocks) is in the graph, with values identical to the healthy path
+_NEUTRAL_PLAN = {"phases": [
+    {"until": 250,
+     "links": [{"dst": 0, "src": 1, "delay": 0, "loss": 0.0}],
+     "skew": {str(i): 1.0 for i in range(3)}},
+    {"until": 100_000, "crash": [0]}]}
+
+_IDENTITY_OPTS = dict(node_count=3, concurrency=2, n_instances=4,
+                      record_instances=2, time_limit=0.3, rate=200.0,
+                      latency=5.0, p_loss=0.05, nemesis=["partition"],
+                      nemesis_interval=0.05, seed=0, inbox_k=2,
+                      pool_slots=24)
+
+
+class TestBitIdentity:
+    @pytest.mark.parametrize("layout", ["lead", "minor"])
+    def test_all_healthy_plan_bit_identical(self, layout):
+        """A value-neutral plan (every lane exercised) reproduces the
+        fault-free trajectory bit-for-bit — composed with the partition
+        nemesis, which must keep working unchanged."""
+        model = get_model("lin-kv", 3)
+        sim = make_sim_config(model, {**_IDENTITY_OPTS,
+                                      "layout": layout})
+        fx = compile_fault_plan(_NEUTRAL_PLAN, 3,
+                                stop_tick=sim.nemesis.stop_tick)
+        params = model.make_params(3)
+        base_c, base_y = run_sim(model, sim, 0, params)
+        neut_c, neut_y = run_sim(model, sim._replace(faults=fx), 0,
+                                 params)
+        assert neut_c.snapshots is not None   # the machinery really ran
+        for a, b in zip(
+                jax.tree.leaves((base_c.pool, base_c.node_state,
+                                 base_c.client_state, base_c.stats,
+                                 base_c.violations)),
+                jax.tree.leaves((neut_c.pool, neut_c.node_state,
+                                 neut_c.client_state, neut_c.stats,
+                                 neut_c.violations))):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        np.testing.assert_array_equal(np.asarray(base_y.events),
+                                      np.asarray(neut_y.events))
+
+    def test_active_plan_layout_independent(self):
+        """An ACTIVE plan (crash + links + skew all firing) produces
+        bit-identical trajectories in both carry layouts."""
+        opts = dict(_IDENTITY_OPTS, fault_plan=None, nemesis=[])
+        plan = {"phases": [{"until": 80},
+                           {"until": 140, "crash": [0, 1]},
+                           {"until": 220,
+                            "links": [{"dst": 0, "src": 2, "delay": 10},
+                                      {"dst": 2, "src": 0, "loss": 0.3},
+                                      {"dst": 1, "src": 2,
+                                       "block": True}]},
+                           {"until": 280, "skew": {"0": 2.0,
+                                                   "1": 0.5}}]}
+        out = {}
+        for layout in ("lead", "minor"):
+            model = get_model("lin-kv", 3)
+            sim = make_sim_config(model, {**opts, "layout": layout})
+            fx = compile_fault_plan(plan, 3,
+                                    stop_tick=sim.nemesis.stop_tick)
+            sim = sim._replace(faults=fx)
+            c, y = run_sim(model, sim, 0, model.make_params(3))
+            canon = canonical_carry(c, sim)
+            out[layout] = (jax.tree.leaves(
+                (canon.pool, canon.node_state, canon.client_state,
+                 canon.stats, canon.violations, canon.snapshots)),
+                np.asarray(y.events))
+        for a, b in zip(out["lead"][0], out["minor"][0]):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        np.testing.assert_array_equal(out["lead"][1], out["minor"][1])
+
+
+# --- the anomaly matrix ----------------------------------------------------
+
+
+class TestCrashRestartLane:
+    def test_forget_snapshot_caught_correct_model_survives(self):
+        """The crash lane's planted bug: amnesiac recovery commits over
+        the survivor's committed prefix — the on-device invariant
+        trips across most of the fleet and the funnel's bit-exact
+        replay confirms every tripper; correct Raft under the SAME
+        plan recovers from its snapshots and stays fully valid."""
+        bug = run_tpu_test(get_model("lin-kv-bug-forget-snapshot", 3),
+                           dict(CRASH_OPTS))
+        assert bug["valid?"] is False
+        tripped = bug["invariants"]["violating-instances"]
+        assert tripped >= 8, bug["invariants"]
+        # the funnel replayed the flagged subset into violation again
+        # — instance-stable RNG holds across the restart lane (the
+        # bit-exact-replay contract under an active fault plan)
+        funnel = bug["funnel"]
+        assert funnel["replayed-violating"] == len(funnel["ids"]) > 0
+
+        ok = run_tpu_test(get_model("lin-kv", 3), dict(CRASH_OPTS))
+        assert ok["valid?"] is True
+        assert ok["invariants"]["violating-instances"] == 0
+
+    @pytest.mark.slow
+    def test_crash_actually_perturbs_the_trajectory(self):
+        """Guard against a silently inert lane: the crash plan must
+        change the correct model's trajectory vs a fault-free run."""
+        _, _, (c_fault, _) = _run_carry("lin-kv", CRASH_OPTS)
+        _, _, (c_plain, _) = _run_carry(
+            "lin-kv", {**CRASH_OPTS, "fault_plan": None})
+        assert not np.array_equal(
+            np.asarray(c_fault.node_state.commit_idx),
+            np.asarray(c_plain.node_state.commit_idx))
+
+
+class TestClockSkewLane:
+    def test_fixed_timeout_livelocks_correct_model_elects(self):
+        """The skew lane's planted bug: deterministic election
+        deadlines collide in lockstep — no leader, zero acks, the
+        availability checker flags the livelock. Correct Raft's
+        randomized timeouts elect fine under the SAME 2x-fast plan."""
+        bug = run_tpu_test(get_model("lin-kv-bug-fixed-timeout", 3),
+                           dict(SKEW_OPTS))
+        assert bug["valid?"] is False
+        assert bug["availability"]["valid?"] is False
+        assert bug["availability"]["ok-count"] == 0
+
+        ok = run_tpu_test(get_model("lin-kv", 3), dict(SKEW_OPTS))
+        assert ok["valid?"] is True
+        assert ok["availability"]["ok-count"] > 0
+
+
+class TestLinkDegradationLane:
+    def test_stale_read_caught_correct_model_survives(self):
+        """The link lane vs the stale-read mutant: slow lossy
+        replication makes locally-served reads stale (WGL catches the
+        linearizability violation); correct Raft reads through the log
+        and stays valid under the SAME degraded edges."""
+        bug = run_tpu_test(get_model("lin-kv-bug-stale-read", 3),
+                           dict(LINK_OPTS))
+        assert bug["valid?"] is False
+        assert bug["valid-instances"] < bug["checked-instances"]
+
+        ok = run_tpu_test(get_model("lin-kv", 3), dict(LINK_OPTS))
+        assert ok["valid?"] is True
+        assert ok["valid-instances"] == ok["checked-instances"]
+
+
+@pytest.mark.slow
+class TestAnomalyMatrixSweep:
+    """The full matrix across extra seeds — the cheap representatives
+    above keep one pinned seed per lane inside the tier-1 budget."""
+
+    @pytest.mark.parametrize("seed", [11, 13])
+    def test_crash_lane(self, seed):
+        bug = run_tpu_test(get_model("lin-kv-bug-forget-snapshot", 3),
+                           dict(CRASH_OPTS, seed=seed))
+        ok = run_tpu_test(get_model("lin-kv", 3),
+                          dict(CRASH_OPTS, seed=seed))
+        assert bug["valid?"] is False and ok["valid?"] is True
+
+    @pytest.mark.parametrize("seed", [11, 13])
+    def test_link_lane(self, seed):
+        bug = run_tpu_test(get_model("lin-kv-bug-stale-read", 3),
+                           dict(LINK_OPTS, seed=seed))
+        ok = run_tpu_test(get_model("lin-kv", 3),
+                          dict(LINK_OPTS, seed=seed))
+        assert bug["valid?"] is False and ok["valid?"] is True
+
+    @pytest.mark.parametrize("seed", [11, 13])
+    def test_skew_lane(self, seed):
+        bug = run_tpu_test(get_model("lin-kv-bug-fixed-timeout", 3),
+                           dict(SKEW_OPTS, seed=seed))
+        ok = run_tpu_test(get_model("lin-kv", 3),
+                          dict(SKEW_OPTS, seed=seed))
+        assert bug["valid?"] is False and ok["valid?"] is True
+
+    def test_generated_minority_crash_plan_is_survivable(self):
+        """The CLI's generated crash-restart plan (one rotating victim
+        at a time) must be survivable by correct Raft — the safety bar
+        for the composable --nemesis vocabulary."""
+        opts = dict(node_count=3, concurrency=4, n_instances=16,
+                    record_instances=4, time_limit=0.8, rate=200.0,
+                    latency=5.0, rpc_timeout=0.08, recovery_time=0.15,
+                    nemesis=["crash-restart"], nemesis_interval=0.08,
+                    heartbeat=False, seed=7)
+        res = run_tpu_test(get_model("lin-kv", 3), opts)
+        assert res["valid?"] is True
+        assert res["invariants"]["violating-instances"] == 0
+
+
+# --- observatory integration ----------------------------------------------
+
+
+class TestObservatory:
+    def test_fault_epochs_ride_the_heartbeat(self, tmp_path):
+        """Chunked fault runs stream their fault epoch per chunk, and
+        the run-start header labels the plan's lanes (model-agnostic —
+        a cheap echo fleet exercises the whole path)."""
+        plan = {"phases": [{"until": 100},
+                           {"until": 140, "crash": [1]},
+                           {"until": 220,
+                            "links": [{"dst": 0, "src": 1,
+                                       "delay": 5}]}]}
+        opts = dict(node_count=2, concurrency=2, n_instances=8,
+                    record_instances=2, time_limit=0.3, rate=100.0,
+                    latency=5.0, recovery_time=0.05, seed=3,
+                    fault_plan=plan, funnel=False,
+                    store_root=str(tmp_path), pipeline="on",
+                    chunk_ticks=50)
+        run_tpu_test(get_model("echo", 2), opts)
+        from maelstrom_tpu.telemetry.stream import read_heartbeat
+        run_dir = os.path.realpath(
+            os.path.join(str(tmp_path), "echo-tpu", "latest"))
+        hb = read_heartbeat(run_dir)
+        assert hb["header"]["faults"]["lanes"] == [
+            "crash-restart", "link-degradation"]
+        faults = [rec.get("fault") for rec in hb["chunks"]]
+        assert all(f is not None for f in faults)
+        # the crash phase [100, 140) lands inside the 100..150 chunk
+        crashed = [f for f in faults if f.get("crashed")]
+        assert crashed and crashed[0]["crashed"] == [1]
+        assert faults[-1].get("healthy") is True
+
+    @pytest.mark.slow
+    def test_replay_is_bit_exact_under_fault_plan(self):
+        """replay_instances on specific ids reproduces the violating
+        trajectories (the triage/funnel contract) with fault lanes
+        active — the standalone form of the funnel self-check the fast
+        crash-lane test already pins."""
+        model = get_model("lin-kv-bug-forget-snapshot", 3)
+        _, _, (carry, _) = _run_carry("lin-kv-bug-forget-snapshot",
+                                      CRASH_OPTS)
+        viol = np.nonzero(np.asarray(carry.violations))[0]
+        ids = [int(i) for i in viol[:3]]
+        assert ids
+        rep = replay_instances(model, dict(CRASH_OPTS), ids)
+        assert rep["replayed-violating"] == len(ids)
+
+
+# --- kafka crash-clients (TPU/native vocabulary parity) --------------------
+
+
+KAFKA_OPTS = dict(node_count=1, concurrency=4, n_instances=8,
+                  record_instances=4, time_limit=1.0, rate=300.0,
+                  latency=5.0, seed=3, funnel=False, heartbeat=False)
+
+
+@pytest.fixture(scope="module")
+def kafka_crash_histories():
+    """One shared replay of the crash-clients fleet — every kafka
+    parity assertion reads these histories instead of re-simulating."""
+    model = get_model("kafka", 1, opts={"crash_clients": True})
+    rep = replay_instances(model, dict(KAFKA_OPTS), list(range(8)))
+    return rep["histories"]
+
+
+class TestKafkaCrashClients:
+    def test_crash_clients_valid_end_to_end(self):
+        model = get_model("kafka", 1, opts={"crash_clients": True})
+        assert model.crash_clients
+        res = run_tpu_test(model, dict(KAFKA_OPTS))
+        assert res["valid?"] is True
+
+    def test_crashes_fired(self, kafka_crash_histories):
+        crashes = sum(1 for h in kafka_crash_histories.values()
+                      for r in h if r.get("f") == "crash"
+                      and r["type"] == "invoke")
+        assert crashes >= 3, "crash injection never fired"
+
+    def test_reassigned_marking_is_load_bearing(self,
+                                                kafka_crash_histories):
+        """Run the raw checker WITHOUT the reassigned tagging and it
+        must see the backward jumps (external nonmonotonic) the tag
+        legalizes — proving the committed-offset resume actually
+        rewinds consumers; with the tagging, every history is clean."""
+        from maelstrom_tpu.checkers.kafka import (
+            kafka_checker, mark_reassigned_after_crashes)
+        union_hit = False
+        for h in kafka_crash_histories.values():
+            naked = kafka_checker(h)
+            marked = kafka_checker(mark_reassigned_after_crashes(h))
+            assert marked["valid?"] is True, marked["anomaly-types"]
+            if "external-nonmonotonic" in naked["anomaly-types"]:
+                union_hit = True
+        assert union_hit, ("no consumer ever rewound — the crash "
+                           "lane is inert")
+
+    def test_default_kafka_never_crashes(self):
+        model = get_model("kafka", 1)
+        assert not model.crash_clients
+        rep = replay_instances(model, dict(KAFKA_OPTS), [0, 1])
+        assert not any(r.get("f") == "crash"
+                       for h in rep["histories"].values() for r in h)
+
+
+class TestModelSelectionParity:
+    def test_dirty_apply_flag_selects_mutant(self):
+        for wl in ("txn-list-append", "txn-rw-register"):
+            m = get_model(wl, 3, opts={"txn_dirty_apply": True})
+            assert m.name == f"{wl}-bug-dirty-apply"
+            assert get_model(wl, 3).name == wl
+
+    def test_resolve_model_honors_parity_flags(self):
+        from maelstrom_tpu.checkers.triage import resolve_model
+        m = resolve_model({"workload": "kafka",
+                           "opts": {"node_count": 1,
+                                    "crash_clients": True},
+                           "model-config": {}})
+        assert m.crash_clients
+
+    def test_new_mutants_registered(self):
+        assert get_model("lin-kv-bug-forget-snapshot", 3).name \
+            == "lin-kv-bug-forget-snapshot"
+        m = get_model("lin-kv-bug-fixed-timeout", 3)
+        assert m.elect_jitter == 1

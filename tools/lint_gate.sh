@@ -90,7 +90,9 @@ import json
 base = json.load(open("maelstrom_tpu/analysis/cost_baseline.json"))
 raft = [k for k in base["entries"]
         if k.split("/")[0].startswith(("lin-kv", "txn-"))]
-assert len(raft) == 20, f"expected 20 raft-family entries, got {len(raft)}"
+# 12 raft-family models (incl. the fault-engine mutants
+# forget-snapshot + fixed-timeout) x lead/minor
+assert len(raft) == 24, f"expected 24 raft-family entries, got {len(raft)}"
 bad = [k for k in raft if base["entries"][k]["fusion-breakers"] != 0]
 assert not bad, f"raft-family entries with nonzero loop budget: {bad}"
 print(f"{len(raft)} raft-family entries, all fusion-breakers=0")
@@ -141,6 +143,52 @@ python -m maelstrom_tpu triage "$BUGGY_RUN" --max-instances 1
 # the flagged instance got its spacetime diagram + repro bundle
 ls "$BUGGY_RUN"/triage/instance-*/messages.svg
 ls "$BUGGY_RUN"/triage/instance-*/repro.json
+echo
+echo "== fault-plan smoke (crash-restart plan -> planted amnesia bug -> triage)"
+# the crash lane's anomaly proof end-to-end: commit writes, crash a
+# MAJORITY, isolate the full-log survivor — the forget-snapshot mutant
+# reboots amnesiac and commits over the survivor's committed prefix,
+# the on-device invariant trips, --fail-fast stops dispatch, the run
+# exits 1, and triage replays a crashed instance into a forensics
+# bundle. (The correct model under this exact plan recovers from its
+# snapshot slab and stays valid — tests/test_faults.py pins that side.)
+cat > "$SMOKE_STORE/crash_plan.json" <<'JSON'
+{"phases": [{"until": 220},
+            {"until": 280, "crash": [0, 1]},
+            {"until": 520, "links": [
+               {"dst": 2, "src": 0, "block": true},
+               {"dst": 2, "src": 1, "block": true},
+               {"dst": 0, "src": 2, "block": true},
+               {"dst": 1, "src": 2, "block": true}]},
+            {"until": 700}]}
+JSON
+rc=0
+python -m maelstrom_tpu test --runtime tpu -w lin-kv-bug-forget-snapshot \
+    --node-count 3 --concurrency 4 --rate 300 --time-limit 0.7 \
+    --n-instances 32 --record-instances 4 --rpc-timeout 0.08 \
+    --recovery-time 0.1 --fault-plan "$SMOKE_STORE/crash_plan.json" \
+    --pipeline on --chunk-ticks 100 --seed 7 --fail-fast \
+    --store "$SMOKE_STORE" > "$SMOKE_STORE/fault-smoke.json" || rc=$?
+[[ "$rc" == "1" ]] || { echo "expected exit 1 (amnesiac recovery caught), got $rc"; exit 1; }
+grep -q '"fail-fast"' "$SMOKE_STORE/fault-smoke.json"
+python - "$SMOKE_STORE/fault-smoke.json" <<'PY'
+import json, sys
+# the CLI prints the results JSON followed by the verdict banner —
+# raw_decode stops at the end of the JSON object
+res = json.JSONDecoder().raw_decode(open(sys.argv[1]).read())[0]
+n = res["invariants"]["violating-instances"]
+assert n > 0, "no instance tripped the committed-prefix violation"
+print(f"fault smoke: {n} instance(s) tripped; fail-fast stopped at "
+      f"{res['fail-fast']['ticks-dispatched']}/{res['fail-fast']['ticks-planned']} ticks")
+PY
+FAULT_RUN="$SMOKE_STORE"/lin-kv-bug-forget-snapshot-tpu/latest
+test -s "$FAULT_RUN"/heartbeat.jsonl
+grep -q '"fault"' "$FAULT_RUN"/heartbeat.jsonl   # fault epochs streamed
+python -m maelstrom_tpu triage "$FAULT_RUN" --max-instances 1
+# the crashed instance's forensics bundle (stale state replayed bit-exactly)
+ls "$FAULT_RUN"/triage/instance-*/messages.svg
+ls "$FAULT_RUN"/triage/instance-*/repro.json
+
 echo
 echo "== campaign smoke (submit -> SIGKILL mid-run -> resume -> oracle)"
 # a 2-item campaign: a clean echo sweep (long enough that the SIGKILL
