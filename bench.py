@@ -194,6 +194,11 @@ def child_main(canary: bool = False) -> None:
     # the fleet-metrics artifact uses); BENCH_TELEMETRY=0 reverts to the
     # bare no-telemetry carry for overhead A/B runs
     bench_telemetry = os.environ.get("BENCH_TELEMETRY") != "0"
+    # BENCH_WIDE=1 forces the pre-specialization worst-case row width
+    # (the 9-header format with the NETID lane always carried) so
+    # wide-vs-narrow throughput is one env var apart — the native
+    # engine's knob of the same name re-instantiates at W_TXN
+    bench_wide = os.environ.get("BENCH_WIDE") == "1"
 
     def _latency_ticks(c):
         """Fleet ticks-to-ack quantiles off the live carry (same
@@ -223,6 +228,7 @@ def child_main(canary: bool = False) -> None:
                     nemesis=["partition"], nemesis_interval=0.4,
                     p_loss=0.05, recovery_time=0.3, seed=7,
                     telemetry=bench_telemetry,
+                    **({"netid": True} if bench_wide else {}),
                     **net_knobs)
         sim = make_sim_config(model, opts)
         params = model.make_params(sim.net.n_nodes)
@@ -437,6 +443,11 @@ def child_main(canary: bool = False) -> None:
                 "dropped_overflow": ovf,
                 "wall_s": round(wall, 3),
                 "bytes_per_instance": int(bytes_per_instance),
+                # the resolved per-model wire format (8 header + body
+                # [+ NETID]); BENCH_WIDE=1 pins the old worst-case row
+                "msg_lanes": sim.net.lanes,
+                "bytes_per_msg_row": 4 * sim.net.lanes,
+                "wide": bench_wide,
             }
             if ir_eqns is not None:
                 rec["ir_eqns"] = ir_eqns
@@ -648,12 +659,15 @@ def _native_bench() -> bool:
     spin_before = _host_spin_s()
 
     # the one base config every native run below derives from — the
-    # headline regimes and the family runs must never drift apart
+    # headline regimes and the family runs must never drift apart.
+    # BENCH_WIDE=1 re-instantiates the engine at the pre-specialization
+    # worst-case Msg/Entry width (wide-vs-narrow A/B, one env var)
+    bench_wide = os.environ.get("BENCH_WIDE") == "1"
     base_opts = dict(node_count=3, concurrency=6, inbox_k=1,
                      pool_slots=16, rate=200.0, latency=5.0,
                      rpc_timeout=1.0, nemesis=["partition"],
                      nemesis_interval=0.4, p_loss=0.05,
-                     recovery_time=0.3, seed=7)
+                     recovery_time=0.3, seed=7, wide=bench_wide)
 
     families = {}
     if os.environ.get("BENCH_FAMILIES") != "0":
@@ -695,6 +709,10 @@ def _native_bench() -> bool:
                 "sim_ticks": p["ticks"],
                 "violating_instances": fres["violating-instances"],
                 "recorded_checker_verdicts": fverd,
+                # per-family width class: the bytes-per-row reduction
+                # the specialization buys THIS family
+                "msg_lanes": p.get("msg-lanes"),
+                "bytes_per_msg_row": p.get("bytes-per-msg-row"),
             }
             log(TAG, f"phase[native-family-{wname}]: "
                      f"{p['msgs-per-sec']:,.0f} msgs/s, "
@@ -748,6 +766,10 @@ def _native_bench() -> bool:
             "dropped_overflow": res["stats"]["dropped-overflow"],
             "wall_s": round(p["wall-s"], 3),
             "threads": p.get("threads", 1),
+            # per-family templated Msg row of THIS instantiation
+            "msg_lanes": p.get("msg-lanes"),
+            "bytes_per_msg_row": p.get("bytes-per-msg-row"),
+            "wide": bench_wide,
             "violating_instances": res["violating-instances"],
             "recorded_checker_verdicts": verdicts,
             "funnel": funnel,

@@ -125,6 +125,12 @@ struct Cfg {
                                  // carries the reassigned flag the
                                  // checker honors (kafka.clj
                                  // :crash-clients semantics)
+  int64_t force_wide;            // A/B knob: instantiate the engine at
+                                 // the worst-case W_TXN width whatever
+                                 // the workload (the pre-specialization
+                                 // Msg/Entry layout; trajectories are
+                                 // identical — extra lanes are always
+                                 // zero). bench.py's BENCH_WIDE=1.
 };
 
 constexpr int TXN_CAP = 4;    // engine-wide micro-op slot bound
@@ -154,19 +160,39 @@ enum MType : int32_t {
 // body lanes: protocol lanes 0..5; AppendEntries carries its full
 // entry in lanes 6.. (lin-kv: f, k, a, b, client, cmsg; txn: len,
 // (f,k,v)*TXN_CAP, client, cmsg); client requests keep their
-// forward-hop counter in lane L_HOPS
-constexpr int BODY_LANES = 6 + 1 + 3 * TXN_CAP + 2;   // 21
+// forward-hop counter in lane L_HOPS.
+//
+// Per-family WIDTH CLASSES (ROADMAP item 2): the Msg/Entry structs are
+// templated on the body width and instantiated once per class, so the
+// hot delivery/inbox loops of a gossip fleet stream 6-lane rows while
+// only the txn families pay the 21-lane worst case. The Python twin of
+// this table lives in maelstrom_tpu/native/wire.py; `maelstrom lint
+// --lanes` cross-checks both against the model registry (LNE610), so
+// these constants and the JAX side's body_lanes can never silently
+// diverge. cfg.force_wide re-instantiates every family at W_TXN — the
+// one-env-var wide-vs-narrow A/B (BENCH_WIDE=1).
+constexpr int W_GOSSIP = 6;                         // body[0..5] only
+constexpr int W_LINKV = 6 + 6 + 1;                  // 13: + entry + hops
+constexpr int W_TXN = 6 + 1 + 3 * TXN_CAP + 2;      // 21: + txn entry
+constexpr int BODY_LANES_MAX = W_TXN;
 constexpr int L_ENTRY = 6;
 constexpr int L_HOPS = 12;              // lin-kv request hop counter
 constexpr int L_THOPS = 1 + 3 * TXN_CAP;  // txn request hop counter (13)
 
-struct Msg {
+constexpr int body_lanes_for(int64_t workload) {
+  return (workload == 1 || workload == 7) ? W_TXN
+         : workload == 0                  ? W_LINKV
+                                          : W_GOSSIP;
+}
+
+template <int BL>
+struct MsgT {
   int32_t valid = 0;
   int32_t src = 0, origin = 0, dest = 0;
   int32_t type = 0;
   int32_t msg_id = -1, reply_to = -1;
   int32_t dtick = 0;
-  int32_t body[BODY_LANES] = {0};
+  int32_t body[BL] = {0};
   // variable payload for txn read results (M_TXN_OK): the in-process
   // "wire" models message COUNT and latency, not byte layout, so a
   // reply may carry its read lists out of band (empty => no heap
@@ -175,30 +201,35 @@ struct Msg {
 };
 
 // --------------------------------------------------------------- raft
-struct Entry {
+template <int BL>
+struct EntryT {
+  // txn micro-op slots exist only in the txn width class; the narrow
+  // families carry one dummy slot so the struct stays POD-regular
+  static constexpr int TOPS = BL >= W_TXN ? TXN_CAP : 1;
   int32_t f = 0, k = 0, a = 0, b = 0, client = -1, cmsg = -1;
   // txn workload: tlen > 0 marks a transaction entry of tlen micro-ops
   int32_t tlen = 0;
-  int32_t top[TXN_CAP][3] = {};   // (f, k, v) per micro-op
-  bool operator==(const Entry& o) const {
+  int32_t top[TOPS][3] = {};   // (f, k, v) per micro-op
+  bool operator==(const EntryT& o) const {
     if (!(f == o.f && k == o.k && a == o.a && b == o.b &&
           client == o.client && cmsg == o.cmsg && tlen == o.tlen))
       return false;
-    for (int j = 0; j < TXN_CAP; ++j)
+    for (int j = 0; j < TOPS; ++j)
       for (int x = 0; x < 3; ++x)
         if (top[j][x] != o.top[j][x]) return false;
     return true;
   }
 };
 
-struct Node {
+template <int BL>
+struct NodeT {
   int32_t term = 0, voted_for = -1, role = 0, votes = 0;
   int32_t commit_idx = 0, last_applied = 0, log_len = 0;
   int32_t leader_hint = -1;
   int32_t election_deadline = 0, last_hb = 0;
   int32_t truncated_committed = 0;
   std::vector<int32_t> log_term;
-  std::vector<Entry> log_body;
+  std::vector<EntryT<BL>> log_body;
   std::vector<int32_t> kv;
   std::vector<std::vector<int32_t>> lists;   // txn workload state
   std::vector<int32_t> gset;                 // g-set workload state:
@@ -233,16 +264,17 @@ struct Stats {
           dropped_loss = 0, dropped_overflow = 0;
 };
 
-struct Instance {
+template <int BL>
+struct InstanceT {
   Rng rng;
-  std::vector<Msg> pool;
-  std::vector<Node> nodes;
+  std::vector<MsgT<BL>> pool;
+  std::vector<NodeT<BL>> nodes;
   std::vector<Client> clients;
   std::vector<int8_t> side;     // nemesis halves assignment per node
   int64_t cur_phase = -1;
   int32_t violations = 0;
   Stats stats;                  // per-instance: threads never share
-  explicit Instance(uint64_t s) : rng(s) {}
+  explicit InstanceT(uint64_t s) : rng(s) {}
 };
 
 struct Recorder {
@@ -275,7 +307,19 @@ struct SchedPhase {
   uint64_t blocked;    // bit dst*N+src set = dst refuses src (N<=8)
 };
 
-struct Sim {
+// The whole engine is templated on the family's body width class: one
+// instantiation per class (W_GOSSIP / W_LINKV / W_TXN), chosen by
+// workload at dispatch — the narrow families' pool scans stream a
+// ~45% smaller Msg row and lin-kv's Raft log drops the txn micro-op
+// slab from every Entry.
+template <int BL>
+struct SimT {
+  using Msg = MsgT<BL>;
+  using Entry = EntryT<BL>;
+  using Node = NodeT<BL>;
+  using Instance = InstanceT<BL>;
+  static constexpr int BODY_LANES = BL;
+
   Cfg cfg;
   std::vector<Instance> insts;
   Stats stats;
@@ -496,14 +540,22 @@ struct Sim {
   // (len, micro-ops, client, cmsg) — dispatch on cfg.workload
   Entry entry_from_wire(const Msg& m) const {
     Entry e;
-    if (txn_mode()) {
-      e.tlen = m.body[L_ENTRY + 0];
-      for (int32_t j = 0; j < TXN_CAP; ++j)
-        for (int32_t x = 0; x < 3; ++x)
-          e.top[j][x] = m.body[L_ENTRY + 1 + 3 * j + x];
-      e.client = m.body[L_ENTRY + 1 + 3 * TXN_CAP];
-      e.cmsg = m.body[L_ENTRY + 2 + 3 * TXN_CAP];
-    } else {
+    // compile-time constant lane indices past the family's width class
+    // must not be instantiated: the gossip class (BL=6) never runs
+    // Raft, the lin-kv class (BL=13) never runs txn entries — the
+    // dispatcher guarantees both, if constexpr makes it type-safe
+    if constexpr (BL >= W_TXN) {
+      if (txn_mode()) {
+        e.tlen = m.body[L_ENTRY + 0];
+        for (int32_t j = 0; j < TXN_CAP; ++j)
+          for (int32_t x = 0; x < 3; ++x)
+            e.top[j][x] = m.body[L_ENTRY + 1 + 3 * j + x];
+        e.client = m.body[L_ENTRY + 1 + 3 * TXN_CAP];
+        e.cmsg = m.body[L_ENTRY + 2 + 3 * TXN_CAP];
+        return e;
+      }
+    }
+    if constexpr (BL >= W_LINKV) {
       e.f = m.body[L_ENTRY + 0]; e.k = m.body[L_ENTRY + 1];
       e.a = m.body[L_ENTRY + 2]; e.b = m.body[L_ENTRY + 3];
       e.client = m.body[L_ENTRY + 4];
@@ -513,14 +565,18 @@ struct Sim {
   }
 
   void entry_to_wire(Msg& a, const Entry& e) const {
-    if (txn_mode()) {
-      a.body[L_ENTRY + 0] = e.tlen;
-      for (int32_t j = 0; j < TXN_CAP; ++j)
-        for (int32_t x = 0; x < 3; ++x)
-          a.body[L_ENTRY + 1 + 3 * j + x] = e.top[j][x];
-      a.body[L_ENTRY + 1 + 3 * TXN_CAP] = e.client;
-      a.body[L_ENTRY + 2 + 3 * TXN_CAP] = e.cmsg;
-    } else {
+    if constexpr (BL >= W_TXN) {
+      if (txn_mode()) {
+        a.body[L_ENTRY + 0] = e.tlen;
+        for (int32_t j = 0; j < TXN_CAP; ++j)
+          for (int32_t x = 0; x < 3; ++x)
+            a.body[L_ENTRY + 1 + 3 * j + x] = e.top[j][x];
+        a.body[L_ENTRY + 1 + 3 * TXN_CAP] = e.client;
+        a.body[L_ENTRY + 2 + 3 * TXN_CAP] = e.cmsg;
+        return;
+      }
+    }
+    if constexpr (BL >= W_LINKV) {
       a.body[L_ENTRY + 0] = e.f; a.body[L_ENTRY + 1] = e.k;
       a.body[L_ENTRY + 2] = e.a; a.body[L_ENTRY + 3] = e.b;
       a.body[L_ENTRY + 4] = e.client;
@@ -711,32 +767,34 @@ struct Sim {
         break;
       }
       case M_TXN: {
-        bool leader = nd.role == 2;
-        if (leader && nd.log_len < cfg.log_cap) {
-          Entry e;
-          e.tlen = std::min(m.body[0], int32_t(TXN_CAP));
-          for (int32_t j = 0; j < e.tlen; ++j)
-            for (int32_t x = 0; x < 3; ++x)
-              e.top[j][x] = m.body[1 + 3 * j + x];
-          e.client = m.src; e.cmsg = m.msg_id;
-          nd.log_term[nd.log_len] = nd.term;
-          nd.log_body[nd.log_len] = e;
-          nd.log_len += 1;
-          nd.match_idx[me] = nd.log_len;
-          if (cfg.flag_txn_dirty_apply) {
-            // BUG: apply + reply NOW, before any replication — an
-            // acked txn a new leader then truncates is simply gone
-            apply_txn(in, t, me, nd, e, true);
-            nd.last_applied = std::max(nd.last_applied, nd.log_len);
+        if constexpr (BL >= W_TXN) {   // txn width class only
+          bool leader = nd.role == 2;
+          if (leader && nd.log_len < cfg.log_cap) {
+            Entry e;
+            e.tlen = std::min(m.body[0], int32_t(TXN_CAP));
+            for (int32_t j = 0; j < e.tlen; ++j)
+              for (int32_t x = 0; x < 3; ++x)
+                e.top[j][x] = m.body[1 + 3 * j + x];
+            e.client = m.src; e.cmsg = m.msg_id;
+            nd.log_term[nd.log_len] = nd.term;
+            nd.log_body[nd.log_len] = e;
+            nd.log_len += 1;
+            nd.match_idx[me] = nd.log_len;
+            if (cfg.flag_txn_dirty_apply) {
+              // BUG: apply + reply NOW, before any replication — an
+              // acked txn a new leader then truncates is simply gone
+              apply_txn(in, t, me, nd, e, true);
+              nd.last_applied = std::max(nd.last_applied, nd.log_len);
+            }
+          } else if (!leader && nd.leader_hint >= 0 &&
+                     nd.leader_hint != me && m.body[L_THOPS] < 3) {
+            Msg f = m;                 // forward toward the leader
+            f.origin = me; f.dest = nd.leader_hint;
+            f.body[L_THOPS] += 1;
+            send(in, t, std::move(f));
+          } else {
+            node_reply(in, t, me, m, M_ERROR, 11, 0, 0);
           }
-        } else if (!leader && nd.leader_hint >= 0 &&
-                   nd.leader_hint != me && m.body[L_THOPS] < 3) {
-          Msg f = m;                 // forward toward the leader
-          f.origin = me; f.dest = nd.leader_hint;
-          f.body[L_THOPS] += 1;
-          send(in, t, std::move(f));
-        } else {
-          node_reply(in, t, me, m, M_ERROR, 11, 0, 0);
         }
         break;
       }
@@ -827,34 +885,36 @@ struct Sim {
         break;
       }
       case M_READ:
-        if (cfg.flag_stale_read) {   // BUG: serve reads from local state
-          int32_t k = std::min(std::max(m.body[0], 0),
-                               int32_t(cfg.n_keys) - 1);
-          node_reply(in, t, me, m, M_READ_OK, k, nd.kv[k], 0);
-          break;
-        }
-        [[fallthrough]];
       case M_WRITE:
       case M_CAS: {
-        bool leader = nd.role == 2;
-        if (leader && nd.log_len < cfg.log_cap) {
-          Entry e;
-          e.f = m.type == M_READ ? F_READ
-                : m.type == M_WRITE ? F_WRITE : F_CAS;
-          e.k = m.body[0]; e.a = m.body[1]; e.b = m.body[2];
-          e.client = m.src; e.cmsg = m.msg_id;
-          nd.log_term[nd.log_len] = nd.term;
-          nd.log_body[nd.log_len] = e;
-          nd.log_len += 1;
-          nd.match_idx[me] = nd.log_len;
-        } else if (!leader && nd.leader_hint >= 0 &&
-                   nd.leader_hint != me && m.body[L_HOPS] < 3) {
-          Msg f = m;                 // forward toward the leader
-          f.origin = me; f.dest = nd.leader_hint;
-          f.body[L_HOPS] += 1;
-          send(in, t, std::move(f));
-        } else {
-          node_reply(in, t, me, m, M_ERROR, 11, 0, 0);
+        if constexpr (BL >= W_LINKV) {   // lin-kv width class only
+          if (m.type == M_READ && cfg.flag_stale_read) {
+            // BUG: serve reads from local state
+            int32_t k = std::min(std::max(m.body[0], 0),
+                                 int32_t(cfg.n_keys) - 1);
+            node_reply(in, t, me, m, M_READ_OK, k, nd.kv[k], 0);
+            break;
+          }
+          bool leader = nd.role == 2;
+          if (leader && nd.log_len < cfg.log_cap) {
+            Entry e;
+            e.f = m.type == M_READ ? F_READ
+                  : m.type == M_WRITE ? F_WRITE : F_CAS;
+            e.k = m.body[0]; e.a = m.body[1]; e.b = m.body[2];
+            e.client = m.src; e.cmsg = m.msg_id;
+            nd.log_term[nd.log_len] = nd.term;
+            nd.log_body[nd.log_len] = e;
+            nd.log_len += 1;
+            nd.match_idx[me] = nd.log_len;
+          } else if (!leader && nd.leader_hint >= 0 &&
+                     nd.leader_hint != me && m.body[L_HOPS] < 3) {
+            Msg f = m;                 // forward toward the leader
+            f.origin = me; f.dest = nd.leader_hint;
+            f.body[L_HOPS] += 1;
+            send(in, t, std::move(f));
+          } else {
+            node_reply(in, t, me, m, M_ERROR, 11, 0, 0);
+          }
         }
         break;
       }
@@ -1681,6 +1741,39 @@ struct Sim {
   }
 };
 
+// run one width-class instantiation end-to-end: schedule, recorders,
+// simulate, copy out. The body is width-independent; only the Msg/
+// Entry/Node row layouts differ per instantiation.
+template <int BL>
+int64_t run_engine(const Cfg& cfg, int64_t n_threads, int64_t ev_w,
+                   int64_t* stats_out, int32_t* violations_out,
+                   int32_t* events_out, int64_t* n_events_out,
+                   const int64_t* sched_flat, int64_t n_phases) {
+  SimT<BL> sim;
+  sim.cfg = cfg;
+  for (int64_t i = 0; i < n_phases; ++i)
+    sim.sched.push_back(SchedPhase{int32_t(sched_flat[i * 2]),
+                                   uint64_t(sched_flat[i * 2 + 1])});
+  sim.recs.resize(cfg.record);
+  for (int64_t i = 0; i < cfg.record; ++i) {
+    sim.recs[i].out = events_out + i * cfg.max_events * ev_w;
+    sim.recs[i].cap = cfg.max_events;
+    sim.recs[i].width = int32_t(ev_w);
+  }
+  sim.run(n_threads);
+
+  stats_out[0] = sim.stats.sent;
+  stats_out[1] = sim.stats.delivered;
+  stats_out[2] = sim.stats.dropped_partition;
+  stats_out[3] = sim.stats.dropped_loss;
+  stats_out[4] = sim.stats.dropped_overflow;
+  for (int64_t i = 0; i < cfg.n_instances; ++i)
+    violations_out[i] = sim.insts[i].violations;
+  for (int64_t i = 0; i < cfg.record; ++i)
+    n_events_out[i] = sim.recs[i].n;
+  return 0;
+}
+
 }  // namespace
 
 extern "C" {
@@ -1692,7 +1785,7 @@ extern "C" {
 // flag_eager_commit, flag_no_term_guard, max_events, n_threads,
 // instance_base, workload, txn_max, list_cap, read_prob_micro,
 // flag_txn_dirty_apply, flag_gset_no_gossip, topology,
-// kafka_crash_clients, kafka_txn  (37 fields)
+// kafka_crash_clients, kafka_txn, force_wide  (38 fields)
 int64_t native_sim_run_sched(const int64_t* c, int64_t* stats_out,
                              int32_t* violations_out,
                              int32_t* events_out,
@@ -1705,6 +1798,23 @@ int64_t native_sim_run(const int64_t* c, int64_t* stats_out,
                        int64_t* n_events_out) {
   return native_sim_run_sched(c, stats_out, violations_out, events_out,
                               n_events_out, nullptr, 0);
+}
+
+// width-class introspection for bench metric lines and the LNE610
+// source/binary conformance check: body lanes and the compiled
+// bytes-per-Msg-row of one workload's instantiation
+int64_t native_msg_lanes(int64_t workload, int64_t wide) {
+  if (workload < 0 || workload > 9) return -1;
+  return wide ? BODY_LANES_MAX : body_lanes_for(workload);
+}
+
+int64_t native_msg_row_bytes(int64_t workload, int64_t wide) {
+  if (workload < 0 || workload > 9) return -1;
+  switch (wide ? BODY_LANES_MAX : body_lanes_for(workload)) {
+    case W_GOSSIP: return int64_t(sizeof(MsgT<W_GOSSIP>));
+    case W_LINKV: return int64_t(sizeof(MsgT<W_LINKV>));
+    default: return int64_t(sizeof(MsgT<W_TXN>));
+  }
 }
 
 // sched_flat: n_phases x 2 int64s — (until_tick, blocked_bitmask) with
@@ -1742,6 +1852,7 @@ int64_t native_sim_run_sched(const int64_t* c, int64_t* stats_out,
   cfg.topology = c[34];
   cfg.kafka_crash_clients = c[35];
   cfg.kafka_txn = c[36];
+  cfg.force_wide = c[37];
   if (cfg.workload < 0 || cfg.workload > 9) return -1;
   if (cfg.workload == 9 && cfg.n_keys > KPOS_MAX) return -1;
   if (cfg.topology < 0 || cfg.topology > 5) return -1;
@@ -1762,29 +1873,24 @@ int64_t native_sim_run_sched(const int64_t* c, int64_t* stats_out,
       ? 4 + 3 * cfg.txn_max + cfg.txn_max * cfg.list_cap
       : cfg.workload == 7 ? 4 + 3 * cfg.txn_max : 7;
 
-  Sim sim;
-  sim.cfg = cfg;
-  for (int64_t i = 0; i < n_phases; ++i)
-    sim.sched.push_back(SchedPhase{int32_t(sched_flat[i * 2]),
-                                   uint64_t(sched_flat[i * 2 + 1])});
-  sim.recs.resize(cfg.record);
-  for (int64_t i = 0; i < cfg.record; ++i) {
-    sim.recs[i].out = events_out + i * cfg.max_events * ev_w;
-    sim.recs[i].cap = cfg.max_events;
-    sim.recs[i].width = int32_t(ev_w);
+  // per-family width-class dispatch: the whole engine instantiates at
+  // the workload's body width (force_wide pins the pre-specialization
+  // worst case for the one-knob A/B)
+  switch (cfg.force_wide ? BODY_LANES_MAX
+                         : body_lanes_for(cfg.workload)) {
+    case W_GOSSIP:
+      return run_engine<W_GOSSIP>(cfg, n_threads, ev_w, stats_out,
+                                  violations_out, events_out,
+                                  n_events_out, sched_flat, n_phases);
+    case W_LINKV:
+      return run_engine<W_LINKV>(cfg, n_threads, ev_w, stats_out,
+                                 violations_out, events_out,
+                                 n_events_out, sched_flat, n_phases);
+    default:
+      return run_engine<W_TXN>(cfg, n_threads, ev_w, stats_out,
+                               violations_out, events_out,
+                               n_events_out, sched_flat, n_phases);
   }
-  sim.run(n_threads);
-
-  stats_out[0] = sim.stats.sent;
-  stats_out[1] = sim.stats.delivered;
-  stats_out[2] = sim.stats.dropped_partition;
-  stats_out[3] = sim.stats.dropped_loss;
-  stats_out[4] = sim.stats.dropped_overflow;
-  for (int64_t i = 0; i < cfg.n_instances; ++i)
-    violations_out[i] = sim.insts[i].violations;
-  for (int64_t i = 0; i < cfg.record; ++i)
-    n_events_out[i] = sim.recs[i].n;
-  return 0;
 }
 
 }  // extern "C"

@@ -15,8 +15,9 @@ model's dispatch-table constants baked into the IR.
 
 Per model x carry layout it computes:
 
-- the **live message-lane set** — which of the 9 header +
-  ``body_lanes`` body lanes are ever read on any reachable path;
+- the **live message-lane set** — which of the 8 header +
+  ``body_lanes`` (+ optional trailing NETID) lanes are ever read on
+  any reachable path;
 - the **live carry-leaf map** — per-leaf live/dead/carried
   classification with byte attribution;
 - **dead stores** — body lanes written by the node/client/enqueue
@@ -70,6 +71,16 @@ LNE609   lane-analysis-failure    error     ``get_model`` or the lane
                                             model could not be audited at
                                             all (distinct from LNE605's
                                             in-model widening)
+LNE610   native-width-divergence  error     the native engine's templated
+                                            per-family ``BODY_LANES``/
+                                            ``L_*`` constants, the Python
+                                            width table (``native/
+                                            wire.py``), the model
+                                            registry's lane math, or the
+                                            built ``libsim.so`` disagree —
+                                            the C++ templates and JAX
+                                            ``body_lanes`` must never
+                                            silently diverge
 =======  =======================  ========  ===============================
 
 Safety direction: the live set OVERAPPROXIMATES — every transfer rule
@@ -170,7 +181,9 @@ def _inner_jaxpr(sub):
 class LaneReport:
     """Liveness result for ONE model x layout."""
     label: str
-    lanes: int                       # full lane universe (9 + body)
+    lanes: int                       # full lane universe of the audit
+                                     # config's wire format (8 header
+                                     # + body + optional NETID)
     body_lanes: int
     live_lanes: Set[int] = field(default_factory=set)
     reads: Dict[int, Set[str]] = field(default_factory=dict)
@@ -1374,7 +1387,7 @@ def findings_of_report(model, report: LaneReport) -> List[Finding]:
         flag("LNE604", "lane-overread",
              f"a resolved lane index reaches lane {lane}, outside the "
              f"declared universe of {report.lanes} lanes "
-             f"(9 header + body_lanes={report.body_lanes}) — under jit "
+             f"(8 header + body_lanes={report.body_lanes}) — under jit "
              f"the access silently clamps to lane {report.lanes - 1} "
              f"and reads/writes the wrong lane ({phase} phase)",
              SEV_ERROR)
@@ -1510,6 +1523,59 @@ def compare_manifest(live: Dict[str, LaneReport],
     return findings
 
 
+# --- LNE610: native width-class conformance --------------------------------
+
+
+_NATIVE_WIRE_PATH = "maelstrom_tpu/native/wire.py"
+
+
+def native_width_findings(cpp_src: Optional[str] = None,
+                          table: Optional[Dict[str, int]] = None,
+                          include_fixture: bool = True) -> List[Finding]:
+    """LNE610: cross-check the native engine's templated per-family
+    width constants (parsed from ``cpp/engine/sim.cpp``), the Python
+    width table (``native/wire.py``), the registry's per-family lane
+    math, and — when built — the compiled ``libsim.so``. The fixture
+    table (:data:`..native.wire.FIXTURE_DIVERGENT_WIDTHS`) is audited
+    alongside on full runs so the rule provably fires (expected-status
+    baseline entry, the ir_hazards idiom)."""
+    from ..native import wire as nwire
+
+    findings: List[Finding] = []
+    try:
+        registry = nwire.registry_width_facts()
+    except Exception as e:
+        registry = None
+        findings.append(_finding(
+            "LNE610", "native-width-divergence", SEV_ERROR,
+            _NATIVE_WIRE_PATH, "registry_width_facts",
+            f"registry width facts unavailable: {e!r}"))
+    compiled = None
+    try:
+        from ..native.engine import native_available, native_msg_lanes
+        if native_available():
+            compiled = {wl: native_msg_lanes(wl)
+                        for wl in nwire.NATIVE_BODY_LANES}
+    except Exception:
+        compiled = None   # no toolchain — source/table checks still run
+    for symbol, message in nwire.check_native_widths(
+            cpp_src=cpp_src, table=table,
+            registry_entry_lanes=registry, compiled_lanes=compiled):
+        findings.append(_finding(
+            "LNE610", "native-width-divergence", SEV_ERROR,
+            "cpp/engine/sim.cpp", symbol, message))
+    if include_fixture and table is None:
+        fixture_table = dict(nwire.NATIVE_BODY_LANES,
+                             **nwire.FIXTURE_DIVERGENT_WIDTHS)
+        for symbol, message in nwire.check_native_widths(
+                cpp_src=cpp_src, table=fixture_table):
+            findings.append(_finding(
+                "LNE610", "native-width-divergence", SEV_ERROR,
+                _NATIVE_WIRE_PATH, "FIXTURE_DIVERGENT_WIDTHS",
+                f"[fixture] {message}"))
+    return findings
+
+
 # --- orchestration ---------------------------------------------------------
 
 
@@ -1576,6 +1642,9 @@ def run_lane_lint(repo_root: str = ".",
                     f"{type(e).__name__}: {e}"))
                 continue
             findings.extend(findings_of_report(model, rep))
+
+    if full:
+        findings.extend(native_width_findings())
 
     if update_manifest:
         path = save_lane_manifest(

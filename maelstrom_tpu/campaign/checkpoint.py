@@ -157,13 +157,37 @@ def restore_carry(template: Any, leaves: List[np.ndarray]) -> Any:
             f"checkpoint has {len(leaves)} carry leaves but the "
             f"rebuilt config produces {len(t_leaves)} — the resume "
             f"config does not match the checkpointed run")
+    mismatches = [
+        i for i, (t, v) in enumerate(zip(t_leaves, leaves))
+        if tuple(t.shape) != tuple(v.shape) or t.dtype != v.dtype]
+    if mismatches:
+        i = mismatches[0]
+        t, v = t_leaves[i], leaves[i]
+        ts, vs = tuple(t.shape), tuple(v.shape)
+        hint = ""
+        # a wire-format width change (the optional trailing NETID
+        # lane) mismatches EXACTLY the pool leaf — Carry's first field
+        # — on one axis, by one lane, with every other leaf intact.
+        # Anything broader (instance count, pool slots, node count)
+        # mismatches other leaves/axes too and keeps the generic
+        # message, so the hint never misdirects unrelated config drift
+        # to the netid knob.
+        if (mismatches == [0] and len(ts) == len(vs) and
+                t.dtype == v.dtype and
+                sum(a != b for a, b in zip(ts, vs)) == 1 and
+                abs(sum(ts) - sum(vs)) == 1):
+            hint = (" — a message-row LANE-WIDTH change: the "
+                    "checkpoint was taken under a different wire "
+                    "format (narrow vs netid/journaling); resume "
+                    "with the run's recorded wire format "
+                    "(heartbeat run-start `wire-format`, the "
+                    "netid/journal_instances opts)")
+        raise CheckpointError(
+            f"carry leaf {i}: checkpoint {vs}/{v.dtype} vs "
+            f"rebuilt {ts}/{t.dtype} — the resume config does "
+            f"not match the checkpointed run" + hint)
     out = []
-    for i, (t, v) in enumerate(zip(t_leaves, leaves)):
-        if tuple(t.shape) != tuple(v.shape) or t.dtype != v.dtype:
-            raise CheckpointError(
-                f"carry leaf {i}: checkpoint {v.shape}/{v.dtype} vs "
-                f"rebuilt {t.shape}/{t.dtype} — the resume config does "
-                f"not match the checkpointed run")
+    for v in leaves:
         # donation needs each leaf to own its buffer (same reason
         # run_sim_pipelined copies the init carry)
         out.append(jnp.asarray(v).copy())

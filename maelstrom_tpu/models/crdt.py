@@ -167,7 +167,7 @@ class GossipSetModel(Model):
             src=0, dest=dest,
             type_=jnp.where(is_add, T_ADD, T_READ),
             msg_id=msg_id, body=(jnp.where(is_add, op[1], 0),),
-            body_lanes=self.body_lanes)
+            body_lanes=self.body_lanes, netid=cfg.netid)
 
     def decode_reply(self, op, msg, cfg, params):
         mtype = msg[wire.TYPE]
@@ -311,7 +311,7 @@ class PNCounterModel(Model):
             src=0, dest=dest,
             type_=jnp.where(is_add, T_ADD, T_READ),
             msg_id=msg_id, body=(jnp.where(is_add, op[1], 0),),
-            body_lanes=self.body_lanes)
+            body_lanes=self.body_lanes, netid=cfg.netid)
 
     def decode_reply(self, op, msg, cfg, params):
         mtype = msg[wire.TYPE]

@@ -51,7 +51,8 @@ class EchoModel(Model):
         dest = jax.random.randint(key, (), 0, cfg.n_nodes, dtype=jnp.int32)
         return wire.make_msg(src=0, dest=dest, type_=TYPE_ECHO,
                              msg_id=msg_id, body=(op[1],),
-                             body_lanes=self.body_lanes)
+                             body_lanes=self.body_lanes,
+                             netid=cfg.netid)
 
     def decode_reply(self, op, msg, cfg, params):
         ok = msg[wire.TYPE] == TYPE_ECHO_OK

@@ -260,7 +260,8 @@ class KafkaModel(Model):
                                               tail)))
         return wire.make_msg(src=0, dest=0, type_=mtype, msg_id=msg_id,
                              body=(op[1], op[2]),
-                             body_lanes=self.body_lanes)
+                             body_lanes=self.body_lanes,
+                             netid=cfg.netid)
 
     def decode_reply_wide(self, op, msg, cfg, params):
         mtype = msg[wire.TYPE]

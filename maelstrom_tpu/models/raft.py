@@ -193,7 +193,8 @@ class RaftModel(Model):
     def _reply(self, cfg, dest, type_, reply_to, body_vals):
         return wire.make_msg(src=0, dest=dest, type_=type_,
                              reply_to=reply_to, body=body_vals,
-                             body_lanes=self.body_lanes)[None]
+                             body_lanes=self.body_lanes,
+                             netid=cfg.netid)[None]
 
     # --- message handlers -------------------------------------------------
 
@@ -615,7 +616,7 @@ class RaftModel(Model):
         out = jnp.concatenate([
             (do & (row.role == 2)).astype(jnp.int32)[None], z01,
             client[None], z01, reply_type[None], z01, cmsg[None], z01,
-            z01, sel(reply_type == TYPE_ERROR, err_code, k)[None],
+            sel(reply_type == TYPE_ERROR, err_code, k)[None],
             cur[None],
             jnp.zeros((cfg.lanes - wire.BODY - 2,), jnp.int32)])
         return row, out
@@ -716,7 +717,8 @@ class RaftModel(Model):
                           jnp.where(op[0] == F_WRITE, T_WRITE, T_CAS))
         return wire.make_msg(src=0, dest=dest, type_=mtype, msg_id=msg_id,
                              body=(op[1], op[2], op[3]),
-                             body_lanes=self.body_lanes)
+                             body_lanes=self.body_lanes,
+                             netid=cfg.netid)
 
     def decode_reply(self, op, msg, cfg, params):
         mtype = msg[wire.TYPE]

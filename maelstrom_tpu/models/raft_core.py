@@ -383,8 +383,10 @@ def inbox_step(model, row, node_idx, msg, jitter, t, cfg):
     z01 = z0[None]
     hdr = jnp.concatenate([
         valid.astype(jnp.int32)[None], src_out[None], dest[None], z01,
-        type_[None], msgid_out[None], reply_to[None], nid[None], z01])
-    return row, jnp.concatenate([hdr, body])
+        type_[None], msgid_out[None], reply_to[None], nid[None]])
+    pad = cfg.lanes - wire.HDR_LANES - bl   # netid formats: trailing 0
+    return row, jnp.concatenate(
+        [hdr, body] + ([jnp.zeros((pad,), jnp.int32)] if pad else []))
 
 
 # --- the apply compartment -------------------------------------------------
@@ -500,7 +502,7 @@ def peer_sends(model, row, node_idx, t, solicit, hb_due, cfg, z0):
         nid1 = node_idx[None]
         pieces = [
             valid[None], nid1, peer[None], z01, type_[None], z01, z01,
-            nid1, z01, row.term[None],
+            nid1, row.term[None],
             sel(solicit, row.log_len, prev_idx)[None],
             sel(solicit, my_llt,
                 sel(prev_idx > z0, tget(row.log_term, prev_idx - z1),
@@ -509,9 +511,9 @@ def peer_sends(model, row, node_idx, t, solicit, hb_due, cfg, z0):
             b4[None],
             sel(solicit, z0, tget(row.log_term, prev_idx))[None],
             entry]
-        if model.body_lanes > 6 + model.entry_lanes:
-            pieces.append(jnp.zeros((model.body_lanes - 6
-                                     - model.entry_lanes,), jnp.int32))
+        pad = cfg.lanes - wire.HDR_LANES - 6 - model.entry_lanes
+        if pad:   # wider body lanes + the netid formats' trailing lane
+            pieces.append(jnp.zeros((pad,), jnp.int32))
         return carry, jnp.concatenate(pieces)
 
     return lax.scan(per_peer, z0, peers, unroll=True)[1]

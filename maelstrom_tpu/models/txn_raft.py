@@ -129,7 +129,7 @@ class _TxnRaftBase(RaftModel):
     def encode_request(self, op, msg_id, client_idx, key, cfg, params):
         dest = jax.random.randint(key, (), 0, cfg.n_nodes, dtype=jnp.int32)
         m = wire.make_msg(src=0, dest=dest, type_=T_TXN, msg_id=msg_id,
-                          body_lanes=self.body_lanes)
+                          body_lanes=self.body_lanes, netid=cfg.netid)
         return jax.lax.dynamic_update_slice(m, op, (wire.BODY,))
 
     def decode_reply_wide(self, op, msg, cfg, params):
@@ -274,7 +274,7 @@ class TxnListAppendModel(_TxnRaftBase):
         return row, jnp.concatenate(
             [(do & (row.role == 2)).astype(jnp.int32)[None], z01,
              client[None], z01, sel(ok, T_TXN_OK, TYPE_ERROR)[None],
-             z01, cmsg[None], z01, z01, body]
+             z01, cmsg[None], z01, body]
             + ([jnp.zeros((pad,), jnp.int32)] if pad else []))
 
     def complete_record(self, *vals_etype):
@@ -393,7 +393,7 @@ class TxnRwRegisterModel(_TxnRaftBase):
         return row, jnp.concatenate(
             [(do & (row.role == 2)).astype(jnp.int32)[None], z01,
              client[None], z01, (z0 + T_TXN_OK)[None], z01, cmsg[None],
-             z01, z01, reply]
+             z01, reply]
             + ([jnp.zeros((pad,), jnp.int32)] if pad else []))
 
     def complete_record(self, *vals_etype):

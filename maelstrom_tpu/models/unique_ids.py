@@ -51,7 +51,8 @@ class UniqueIdsModel(Model):
     def encode_request(self, op, msg_id, client_idx, key, cfg, params):
         dest = jax.random.randint(key, (), 0, cfg.n_nodes, dtype=jnp.int32)
         return wire.make_msg(src=0, dest=dest, type_=TYPE_GEN,
-                             msg_id=msg_id, body_lanes=self.body_lanes)
+                             msg_id=msg_id, body_lanes=self.body_lanes,
+                             netid=cfg.netid)
 
     def decode_reply(self, op, msg, cfg, params):
         ok = msg[wire.TYPE] == TYPE_GEN_OK

@@ -79,6 +79,13 @@ def _load():
             ctypes.POINTER(ctypes.c_int64),   # sched[n_phases*2]
             ctypes.c_int64,                   # n_phases
         ]
+        # width-class introspection (per-family templated Msg rows):
+        # bench metric lines + the LNE610 source/binary cross-check
+        lib.native_msg_lanes.restype = ctypes.c_int64
+        lib.native_msg_lanes.argtypes = [ctypes.c_int64, ctypes.c_int64]
+        lib.native_msg_row_bytes.restype = ctypes.c_int64
+        lib.native_msg_row_bytes.argtypes = [ctypes.c_int64,
+                                             ctypes.c_int64]
         _lib = lib
     except (OSError, AttributeError):
         # AttributeError = a prebuilt library missing current symbols
@@ -89,6 +96,27 @@ def _load():
 
 def native_available() -> bool:
     return _load() is not None
+
+
+def native_msg_lanes(workload: str, wide: bool = False) -> Optional[int]:
+    """Compiled body-lane width class of ``workload``'s Msg row (None
+    when the native library is unavailable)."""
+    lib = _load()
+    if lib is None:
+        return None
+    return int(lib.native_msg_lanes(NATIVE_WORKLOADS[workload],
+                                    1 if wide else 0))
+
+
+def native_msg_row_bytes(workload: str, wide: bool = False
+                         ) -> Optional[int]:
+    """Compiled ``sizeof`` of one Msg row for ``workload``'s width
+    class (None when the native library is unavailable)."""
+    lib = _load()
+    if lib is None:
+        return None
+    return int(lib.native_msg_row_bytes(NATIVE_WORKLOADS[workload],
+                                        1 if wide else 0))
 
 
 def _decode_txn_history(ev: np.ndarray, ms_per_tick: float,
@@ -409,6 +437,10 @@ def run_native_sim(opts: Optional[Dict[str, Any]] = None
         workload="lin-kv", txn_max=3, list_cap=16, read_prob=0.5,
         txn_dirty_apply=False, gset_no_gossip=False, topology="grid",
         crash_clients=False, txn=False,
+        # wide=True forces the pre-specialization worst-case Msg/Entry
+        # width (W_TXN) whatever the workload — the narrow-vs-wide A/B
+        # knob (bench.py BENCH_WIDE=1); trajectories are identical
+        wide=False,
         # instances are independent, so worker threads each own a
         # contiguous block end-to-end; per-instance trajectories are
         # identical at ANY thread count (RNG is a pure function of
@@ -473,7 +505,7 @@ def run_native_sim(opts: Optional[Dict[str, Any]] = None
         max_events = max(256, C * n_ticks * 4)
 
     threads = int(o["threads"]) or (os.cpu_count() or 1)
-    cfg = (ctypes.c_int64 * 37)(
+    cfg = (ctypes.c_int64 * 38)(
         int(o["seed"]), I, n_ticks, int(o["node_count"]), C, R,
         int(o["pool_slots"]), int(o["inbox_k"]),
         int(float(o["latency"]) / mpt * 1000),
@@ -496,7 +528,8 @@ def run_native_sim(opts: Optional[Dict[str, Any]] = None
         1 if o["gset_no_gossip"] else 0,
         _topologies[o["topology"]],
         1 if o["crash_clients"] else 0,
-        1 if o["txn"] else 0)
+        1 if o["txn"] else 0,
+        1 if o["wide"] else 0)
 
     stats = (ctypes.c_int64 * 5)()
     violations = np.zeros(I, dtype=np.int32)
@@ -595,6 +628,12 @@ def run_native_sim(opts: Optional[Dict[str, Any]] = None
             "instances": I,
             "threads": threads,
             "msgs-per-sec": int(stats[1]) / wall if wall > 0 else 0.0,
+            # per-family width-class facts of THIS run's instantiation
+            "msg-lanes": int(lib.native_msg_lanes(
+                workload, 1 if o["wide"] else 0)),
+            "bytes-per-msg-row": int(lib.native_msg_row_bytes(
+                workload, 1 if o["wide"] else 0)),
+            "wide": bool(o["wide"]),
         },
     }
 

@@ -52,6 +52,15 @@ TPU_DEFAULTS = dict(
     journal_instances=0,      # instances with full per-message journals
                               # (Lamport SVG + msgs-per-op; costs device
                               # output bandwidth, so opt-in)
+    netid=None,               # wire format's trailing NETID journal-
+                              # pairing lane: None (auto) carries it
+                              # exactly when journal_instances > 0 —
+                              # the narrow default format drops the
+                              # lane the lane manifest proves dead.
+                              # True forces the old 9-header-width row
+                              # (the BENCH_WIDE / wide-vs-narrow A/B
+                              # knob); trajectories are bit-identical
+                              # either way (tests/test_analysis_lanes)
     layout="auto",            # carry batch-axis position: "auto" picks
                               # batch-minor on accelerators (TPU tiling
                               # pads the lead layout's tiny trailing dims
@@ -141,6 +150,17 @@ def make_sim_config(model: Model, opts: Dict[str, Any]) -> SimConfig:
             f"time_limit {o['time_limit']}s at {mpt} ms/tick needs "
             f"{n_ticks} ticks, past the 2^20-tick delivery horizon "
             f"(netsim age_rank encoding); raise --ms-per-tick")
+    journal_instances = min(o["journal_instances"], o["n_instances"])
+    # per-model wire format: the NETID journal-pairing lane rides only
+    # when this run records journals (or the caller forces the wide
+    # format for an A/B); the journal decoder needs the lane, so
+    # journaling with netid=False is refused rather than mis-decoded
+    netid = o.get("netid")
+    netid = journal_instances > 0 if netid is None else bool(netid)
+    if journal_instances > 0 and not netid:
+        raise ValueError(
+            "journal_instances > 0 needs the wire format's NETID "
+            "pairing lane; drop netid=False or disable journaling")
     net = NetConfig(
         n_nodes=o["node_count"],
         n_clients=o["concurrency"],
@@ -150,6 +170,7 @@ def make_sim_config(model: Model, opts: Dict[str, Any]) -> SimConfig:
         latency_mean=float(o["latency"]) / mpt,
         latency_dist=LATENCY_DISTS[o["latency_dist"]],
         p_loss=float(o["p_loss"]),
+        netid=netid,
     )
     # final window layout (the reference's heal -> quiesce -> final reads,
     # core.clj:74-80): partitions stop at stop_tick, clients keep running
@@ -224,8 +245,7 @@ def make_sim_config(model: Model, opts: Dict[str, Any]) -> SimConfig:
                      n_instances=o["n_instances"], n_ticks=n_ticks,
                      record_instances=min(o["record_instances"],
                                           o["n_instances"]),
-                     journal_instances=min(o["journal_instances"],
-                                           o["n_instances"]),
+                     journal_instances=journal_instances,
                      layout=resolve_layout(o["layout"]),
                      telemetry=telemetry)
 
@@ -386,7 +406,8 @@ _REPRO_OPT_KEYS = (
     "node_count", "concurrency", "rate", "time_limit", "latency",
     "latency_dist", "p_loss", "nemesis", "nemesis_interval",
     "nemesis_kind", "nemesis_schedule", "rpc_timeout", "recovery_time",
-    "n_instances", "record_instances", "journal_instances", "pool_slots",
+    "n_instances", "record_instances", "journal_instances", "netid",
+    "pool_slots",
     "inbox_k", "ms_per_tick", "layout", "telemetry", "telemetry_stride",
     "telemetry_hist_buckets", "chunk_ticks", "event_capacity", "seed",
     "topology", "availability", "consistency_models", "key_count",
@@ -421,6 +442,10 @@ def heartbeat_meta(model: Model, sim: SimConfig,
         "ticks": sim.n_ticks,
         "record-instances": sim.record_instances,
         "journal-instances": sim.journal_instances,
+        # the RESOLVED wire format (header + body widths + netid lane):
+        # triage / campaign resume rebuild narrowed runs bit-exactly
+        # from it instead of inheriting whatever the default layout is
+        "wire-format": sim.net.wire_format,
         "chunk-ticks": int(opts.get("chunk_ticks") or 100),
         "layout": sim.layout,
         "seed": int(opts.get("seed") or 0),

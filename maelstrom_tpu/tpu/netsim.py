@@ -27,7 +27,6 @@ lowers to vectorized sort networks.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import NamedTuple, Tuple
 
 import jax
@@ -53,6 +52,11 @@ class NetConfig(NamedTuple):
     latency_mean: float     # mean latency in ticks
     latency_dist: int       # LATENCY_* enum
     p_loss: float
+    netid: bool = False     # wire format carries the trailing NETID
+                            # journal-pairing lane (on only when a run
+                            # records per-message journals — the narrow
+                            # default drops the lane the manifest
+                            # proves dead; see tpu/wire.py)
 
     @property
     def n_total(self) -> int:
@@ -60,7 +64,16 @@ class NetConfig(NamedTuple):
 
     @property
     def lanes(self) -> int:
-        return wire.lanes(self.body_lanes)
+        return wire.lanes(self.body_lanes, self.netid)
+
+    @property
+    def netid_lane(self) -> int:
+        """Index of the trailing NETID lane (netid formats only)."""
+        return wire.netid_lane(self.lanes)
+
+    @property
+    def wire_format(self) -> dict:
+        return wire.format_desc(self.body_lanes, self.netid)
 
 
 class NetStats(NamedTuple):
@@ -93,7 +106,6 @@ def no_partitions(cfg: NetConfig) -> jnp.ndarray:
     return jnp.zeros((cfg.n_total, cfg.n_total), dtype=bool)
 
 
-@partial(jax.jit, static_argnames=("cfg",))
 def deliver(pool: jnp.ndarray, partitions: jnp.ndarray, t: jnp.ndarray,
             cfg: NetConfig) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray,
                                      jnp.ndarray]:
@@ -101,6 +113,14 @@ def deliver(pool: jnp.ndarray, partitions: jnp.ndarray, t: jnp.ndarray,
 
     Returns ``(pool', inbox, n_delivered, n_dropped_partition)`` where
     ``inbox`` is ``[n_total, K, lanes]`` (invalid rows zeroed).
+
+    Not jitted here: the only production callers are the (jitted) tick
+    functions, where an inner jit boundary is pure trace overhead — and
+    it double-counts every full-width output in the static byte gate.
+    The full-width row is touched exactly twice: one fill-gather builds
+    the inbox (out-of-range sentinel rows fill with zeros, replacing
+    the masked-select + zero-broadcast cascade) and one fill-gather
+    rebuilds the pool with delivered/dropped slots cleared.
     """
     S = cfg.pool_slots
     valid = pool[:, wire.VALID] == 1
@@ -133,18 +153,24 @@ def deliver(pool: jnp.ndarray, partitions: jnp.ndarray, t: jnp.ndarray,
     else:
         topv, topi = jax.lax.top_k(prio, cfg.inbox_k)    # [NT, K]
     take = topv > 0
-    inbox = jnp.where(take[:, :, None], pool[topi], 0)
+    # one fill-gather streams each taken row into the inbox: non-taken
+    # slots aim past the pool (index S) and fill with the zero row —
+    # value-identical to where(take, pool[topi], 0) without
+    # materializing the mask + zero tensor at full row width
+    srows = jnp.where(take, topi, S)
+    inbox = pool.at[srows].get(mode="fill", fill_value=0)
 
     # clear delivered + dropped slots from the pool (scatter-free: slot s
     # is taken iff some (node, k) selected it — see enqueue's note on
-    # vmapped scatters)
+    # vmapped scatters). Cleared slots re-gather the zero fill row.
     flat_i = topi.reshape(-1)
     flat_take = take.reshape(-1)
     taken_slots = jnp.any(
         (flat_i[None, :] == slot_order[:, None]) & flat_take[None, :],
         axis=1)
     cleared = taken_slots | drop_mask
-    pool = jnp.where(cleared[:, None], 0, pool)
+    keep_rows = jnp.where(cleared, S, slot_order)
+    pool = pool.at[keep_rows].get(mode="fill", fill_value=0)
     return pool, inbox, jnp.sum(take).astype(jnp.int32), \
         jnp.sum(drop_mask).astype(jnp.int32)
 
@@ -162,7 +188,6 @@ def _sample_latency(key, n, cfg: NetConfig) -> jnp.ndarray:
     return lat.astype(jnp.int32)
 
 
-@partial(jax.jit, static_argnames=("cfg",))
 def enqueue(pool: jnp.ndarray, msgs: jnp.ndarray, t: jnp.ndarray,
             key: jnp.ndarray, cfg: NetConfig,
             edge_delay=None, edge_loss_pm=None
@@ -176,8 +201,17 @@ def enqueue(pool: jnp.ndarray, msgs: jnp.ndarray, t: jnp.ndarray,
     ``maelstrom_tpu/faults/``). ``None`` — every fault-free run — keeps
     the pre-fault graph; zero-valued planes are value-identical to it,
     and the edge-loss roll uses its own folded key so enabling the lane
-    never perturbs the base latency/loss draws."""
+    never perturbs the base latency/loss draws.
+
+    Not jitted here (the tick functions are the jit boundary — see
+    :func:`deliver`). Placement streams the full-width row exactly
+    once: all routing math runs on header columns, the two compaction
+    permutations compose into one slot -> original-message index map,
+    and a single gather + deadline-column stitch builds each placed
+    row — the old path re-materialized every outgoing row twice (the
+    deadline scatter and the compaction gather) before placement."""
     M = msgs.shape[0]
+    S = cfg.pool_slots
     msg_valid = msgs[:, wire.VALID] == 1
 
     k_lat, k_loss = jax.random.split(key)
@@ -190,8 +224,9 @@ def enqueue(pool: jnp.ndarray, msgs: jnp.ndarray, t: jnp.ndarray,
         # slow links: per-directed-edge extra ticks (keyed on the
         # physical sender, like partitions and the base latency)
         lat = lat + edge_delay[msgs[:, wire.DEST], msgs[:, wire.ORIGIN]]
-    # deliverable no earlier than the next tick
-    msgs = msgs.at[:, wire.DTICK].set(t + 1 + lat)
+    # deliverable no earlier than the next tick — kept as a column and
+    # stitched into the placed rows below (never scattered into all M)
+    dtick = t + 1 + lat
 
     # loss
     if cfg.p_loss > 0:
@@ -212,7 +247,6 @@ def enqueue(pool: jnp.ndarray, msgs: jnp.ndarray, t: jnp.ndarray,
     free_count = jnp.sum(~pool_valid)
     # compact live messages to the front so slot j gets the j-th live msg
     live_order = jnp.argsort(~live)                  # live msgs first
-    msgs_c = msgs[live_order]
     live_c = live[live_order]
     n_live = jnp.sum(live)
 
@@ -220,18 +254,24 @@ def enqueue(pool: jnp.ndarray, msgs: jnp.ndarray, t: jnp.ndarray,
     can_place = live_c & (j < free_count)
     # rows that don't place target an out-of-bounds slot id and so can
     # never collide with a placed row's slot
-    target = jnp.where(can_place, order[jnp.minimum(j, cfg.pool_slots - 1)],
-                       cfg.pool_slots)
+    target = jnp.where(can_place, order[jnp.minimum(j, S - 1)], S)
     # placement as the INVERSE mapping — each slot gathers the one
     # message that targets it (at most one: `order` is a permutation and
     # can_place is a j-prefix). Gather + select instead of a batched
     # scatter: vmapped scatters lower to serialized updates on TPU and
     # dominated the whole tick at large instance counts (8.8x cost from
     # 4k->16k instances, vs ~linear for every other phase).
-    hit = target[None, :] == jnp.arange(cfg.pool_slots)[:, None]  # [S, M]
+    hit = target[None, :] == jnp.arange(S)[:, None]   # [S, M]
     has = jnp.any(hit, axis=1)
-    src = jnp.argmax(hit, axis=1)
-    pool = jnp.where(has[:, None], msgs_c[src], pool)
+    src = jnp.argmax(hit, axis=1)          # slot -> compacted msg index
+    msg_src = live_order[src]              # slot -> ORIGINAL msg index
+    placed = msgs[msg_src]                 # the one full-width gather
+    # single-lane deadline stitch (a lane-precise column write, which
+    # keeps the liveness analyzer's per-lane demand masks exact across
+    # the placement — a lane-axis concatenate here would widen them)
+    placed = placed.at[:, wire.DTICK].set(dtick[msg_src])
+    pool = jax.lax.select(
+        jnp.broadcast_to(has[:, None], pool.shape), placed, pool)
     n_placed = jnp.sum(can_place)
     overflow = n_live - n_placed
     # sent counts every valid message, including ones the network then

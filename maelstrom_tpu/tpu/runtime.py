@@ -738,14 +738,16 @@ def carry_from_canonical(carry: Carry, sim: SimConfig) -> Carry:
 
 
 def _update_telemetry(tel, sim: SimConfig, t, events, invoked_prev,
-                      pool_lead, inbox, deltas, part_active, violated):
+                      pool_occ, inbox, deltas, part_active, violated):
     """Fold one tick into the flight recorder (no-op when disabled).
 
     Every array argument is batch-LEADING regardless of ``sim.layout`` —
     both tick paths hand over canonical-orientation deltas, so the
     recorder's math (and therefore the layout bit-identity the runtime
-    guarantees) is shared, not duplicated. ``pool_lead`` is the
-    post-enqueue pool with the instance axis first; ``invoked_prev`` the
+    guarantees) is shared, not duplicated. ``pool_occ`` is the [I]
+    post-enqueue occupied-slot count (each layout sums its own VALID
+    lane — int32 sums commute exactly, so the figure is layout-
+    identical without transposing the full pool); ``invoked_prev`` the
     pre-tick per-client invocation ticks [I, C]."""
     if tel is None:
         return None
@@ -759,7 +761,7 @@ def _update_telemetry(tel, sim: SimConfig, t, events, invoked_prev,
         tel, t, sim.telemetry,
         n_sent=n_sent, n_del=n_del, n_del_serv=n_del_serv,
         n_dropp=n_dropp, n_lost=n_lost, n_ovf=n_ovf,
-        pool_occ=netsim.pool_occupancy(pool_lead),
+        pool_occ=pool_occ,
         part_active=part_active, violated=violated,
         ok_mask=events[:, :, 0, EV_TYPE] == EV_OK,
         invoke_mask=events[:, :, 1, EV_TYPE] == EV_INVOKE,
@@ -862,10 +864,13 @@ def make_tick_fn(model: Model, sim: SimConfig, params,
             outs = jnp.concatenate(
                 [node_outs.reshape(I, -1, cfg.lanes), reqs], axis=1)
             # stamp network-unique message ids (send-time allocation, the
-            # role of net.clj:196-201's ID counter): unique per instance
+            # role of net.clj:196-201's ID counter): unique per instance.
+            # Only journaling formats carry the lane — the narrow default
+            # skips the full-row restamp entirely (tpu/wire.py)
             M = outs.shape[1]
-            outs = outs.at[:, :, wire.NETID].set(
-                t * M + jnp.arange(M, dtype=jnp.int32)[None, :])
+            if cfg.netid:
+                outs = outs.at[:, :, cfg.netid_lane].set(
+                    t * M + jnp.arange(M, dtype=jnp.int32)[None, :])
             enq_keys = _instance_keys(key, _RNG_ENQUEUE, instance_ids, t)
             pool, n_sent, n_lost, n_ovf = jax.vmap(
                 lambda p, m, k: netsim.enqueue(
@@ -892,7 +897,8 @@ def make_tick_fn(model: Model, sim: SimConfig, params,
             lambda st: model.invariants(st, cfg, params))(node_state)
         with jax.named_scope("telemetry"):
             tel = _update_telemetry(
-                carry.telemetry, sim, t, events, invoked_prev, pool,
+                carry.telemetry, sim, t, events, invoked_prev,
+                netsim.pool_occupancy(pool),
                 inbox, (n_sent, n_del, n_dropp, n_lost, n_ovf),
                 jnp.any(partitions, axis=(1, 2)), violated)
         new_carry = Carry(pool=pool, node_state=node_state,
@@ -985,8 +991,9 @@ def _make_tick_fn_minor(model: Model, sim: SimConfig, params,
             outs = jnp.concatenate(
                 [node_outs.reshape(-1, cfg.lanes), reqs], axis=0)
             M = outs.shape[0]
-            outs = outs.at[:, wire.NETID].set(
-                t * M + jnp.arange(M, dtype=jnp.int32))
+            if cfg.netid:
+                outs = outs.at[:, cfg.netid_lane].set(
+                    t * M + jnp.arange(M, dtype=jnp.int32))
             enq_key = jax.random.fold_in(jax.random.fold_in(
                 jax.random.fold_in(master, _RNG_ENQUEUE), t), instance_id)
             pool, n_sent, n_lost, n_ovf = netsim.enqueue(
@@ -1027,10 +1034,14 @@ def _make_tick_fn_minor(model: Model, sim: SimConfig, params,
             dropped_overflow=carry.stats.dropped_overflow + jnp.sum(n_ovf),
         )
         with jax.named_scope("telemetry"):
+            # occupancy from the minor pool [S, L, I] directly — the
+            # old full-pool moveaxis materialized an [I, S, L] copy
+            # every tick just to sum one lane
             tel = _update_telemetry(
                 carry.telemetry, sim, t, events, invoked_prev,
-                jnp.moveaxis(pool, -1, 0), inbox, deltas, part_active,
-                violated)
+                jnp.sum(pool[:, wire.VALID, :], axis=0
+                        ).astype(jnp.int32),
+                inbox, deltas, part_active, violated)
         new_carry = Carry(pool=pool, node_state=node_state,
                           client_state=client_state, stats=stats,
                           violations=carry.violations

@@ -1,7 +1,9 @@
 """Fixed-width message encoding for the device-resident network.
 
-The process runtime ships JSON; the TPU runtime ships int32 lanes. Every
-message is one row of ``MSG_LANES + body_lanes`` int32s:
+The process runtime ships JSON; the TPU runtime ships int32 lanes. The
+row layout is a per-model **wire format**: a fixed 8-lane header, the
+model's declared ``body_lanes`` payload lanes, and — only when a run
+records per-message journals — one trailing NETID lane:
 
 ====  ===========================================================
 lane  meaning
@@ -18,11 +20,21 @@ lane  meaning
       Latency sampling and partition drops key on origin, reply routing
       on src)
 8+    body lanes (workload-specific payload encoding)
+last  NETID (only when ``netid`` is on): network-unique message id,
+      stamped by the runtime at send time (tick * fanout + row) — the
+      journal's send/recv pairing key (role of net.clj's message-ID
+      allocator, net.clj:196-201). The lane-liveness manifest proved
+      it dead in every registered model when journaling is off
+      (``analysis/lane_manifest.json``), so the narrow default format
+      simply does not carry it.
 ====  ===========================================================
 
 Workload vocabularies (the ``defrpc`` schemas of SURVEY §2.2) map onto the
 body lanes per workload; capped body width is a stated design constraint of
 the TPU runtime (SURVEY §7 hard parts: fixed shapes vs dynamic protocols).
+Rows are sized by :func:`lanes`; every consumer reads the resolved format
+from ``NetConfig`` (``body_lanes`` + ``netid``), never from a global
+worst-case width — the per-family specialization of ROADMAP item 2.
 """
 
 from __future__ import annotations
@@ -37,35 +49,51 @@ TYPE = 4
 MSGID = 5
 REPLYTO = 6
 ORIGIN = 7
-NETID = 8         # network-unique message id, stamped by the runtime at
-                  # send time (tick * fanout + row) — the journal's
-                  # send/recv pairing key (role of net.clj's message-ID
-                  # allocator, net.clj:196-201)
-BODY = 9          # first body lane
+BODY = 8          # first body lane
 
-HDR_LANES = 9
+HDR_LANES = 8
 
 
-def lanes(body_lanes: int) -> int:
-    return HDR_LANES + body_lanes
+def lanes(body_lanes: int, netid: bool = False) -> int:
+    """Row width of the wire format: 8 header + body (+ NETID)."""
+    return HDR_LANES + body_lanes + (1 if netid else 0)
 
 
-def empty_msgs(n: int, body_lanes: int) -> jnp.ndarray:
-    return jnp.zeros((n, lanes(body_lanes)), dtype=jnp.int32)
+def netid_lane(n_lanes: int) -> int:
+    """Index of the trailing NETID lane in an ``netid=True`` row."""
+    return n_lanes - 1
+
+
+def format_desc(body_lanes: int, netid: bool = False) -> dict:
+    """JSON-able description of a resolved wire format — recorded into
+    heartbeat run-start records and bench metric lines so narrowed runs
+    rebuild (and report) the exact row layout they ran under."""
+    return {"header_lanes": HDR_LANES, "body_lanes": int(body_lanes),
+            "netid": bool(netid),
+            "lanes": lanes(body_lanes, netid),
+            "bytes_per_msg_row": 4 * lanes(body_lanes, netid)}
+
+
+def empty_msgs(n: int, body_lanes: int, netid: bool = False
+               ) -> jnp.ndarray:
+    return jnp.zeros((n, lanes(body_lanes, netid)), dtype=jnp.int32)
 
 
 def make_msg(src, dest, type_, msg_id=-1, reply_to=-1, body=(),
-             body_lanes: int = 6, origin=None):
+             body_lanes: int = 6, origin=None, netid: bool = False):
     """Build one message row (traced-friendly). ``origin`` defaults to
     ``src``; the runtime's node phase re-stamps it with the emitting
-    node's index anyway."""
+    node's index anyway. ``netid`` widens the row by the trailing
+    journal-pairing lane (left zero here — the runtime stamps it at
+    send time); models pass ``cfg.netid`` so their rows match the
+    run's resolved format."""
     if len(body) > body_lanes:
         raise ValueError(
             f"make_msg: body has {len(body)} values but the wire "
             f"format carries body_lanes={body_lanes} — the .at[BODY+i] "
             f"writes past the row end would silently clip/alias under "
             f"jit; widen the model's body_lanes or shrink the body")
-    m = jnp.zeros((lanes(body_lanes),), dtype=jnp.int32)
+    m = jnp.zeros((lanes(body_lanes, netid),), dtype=jnp.int32)
     m = m.at[VALID].set(1)
     m = m.at[SRC].set(src)
     m = m.at[DEST].set(dest)

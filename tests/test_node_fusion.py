@@ -87,13 +87,27 @@ def _legacy_of(model):
 def _traj_digest(model, layout):
     """sha256 over the canonicalized end-of-run carry + the dense event
     tensor — the exact recipe of the frozen recording script (canonical
-    orientation makes the digest layout-independent by construction)."""
-    sim = make_sim_config(model, {**GOLDEN_OPTS, "layout": layout})
+    orientation makes the digest layout-independent by construction).
+
+    The digests were recorded under the pre-specialization wire format
+    (9-lane header with NETID at lane 8, always stamped). The run
+    therefore forces ``netid=True`` — value-identical to the recording
+    config, today's opt-in spelling of the always-on lane — and maps the
+    pool back to the legacy lane ORDER (NETID moved from the trailing
+    lane to lane 8) before hashing; every other leaf is untouched by
+    the format change."""
+    sim = make_sim_config(model, {**GOLDEN_OPTS, "layout": layout,
+                                  "netid": True})
     carry, ys = run_sim(model, sim, GOLDEN_SEED,
                         model.make_params(sim.net.n_nodes))
     canon = canonical_carry(carry, sim)
+    legacy_pool = np.concatenate(
+        [np.asarray(canon.pool[..., :8]),      # VALID..ORIGIN
+         np.asarray(canon.pool[..., -1:]),     # NETID (legacy lane 8)
+         np.asarray(canon.pool[..., 8:-1])],   # body lanes
+        axis=-1)
     h = hashlib.sha256()
-    for leaf in jax.tree.leaves((canon.pool, canon.node_state,
+    for leaf in jax.tree.leaves((legacy_pool, canon.node_state,
                                  canon.client_state, canon.violations,
                                  canon.stats)):
         h.update(np.asarray(leaf).tobytes())

@@ -58,13 +58,17 @@ grep -Eq 'ERROR JXP404.*budget' "$SMOKE_STORE/cost-canary.out"
 echo "canary caught: $(grep -c COST501 "$SMOKE_STORE/cost-canary.out") COST501 + $(grep -Ec 'ERROR JXP404' "$SMOKE_STORE/cost-canary.out") JXP404-budget finding(s)"
 
 echo
-echo "== lane-manifest canary (tampered live set must fail)"
-# Simulate the failure mode the manifest gate exists to catch: a
-# manifest that calls a LIVE lane dead (the narrow-layout refactor
-# would then delete a lane the protocol reads). Drop the last recorded
-# live body lane from one entry; the live-vs-manifest diff must exit 1
-# with LNE606. jax-version is copied through, so this also proves the
-# same-toolchain path is a hard error, not the re-record warning.
+echo "== lane/width canary (tampered manifest + native width table must fail)"
+# Simulate the two failure modes the specialization gates exist to
+# catch: (a) a manifest that calls a LIVE lane dead (the narrow-layout
+# refactor would then delete a lane the protocol reads) and (b) a
+# native width-class constant drifting away from the Python table /
+# registry (the C++ templates would silently stream a different row
+# than the JAX twin). One combined --ir --cost --lanes run against the
+# tampered manifest and a tampered sim.cpp must exit 1 with BOTH
+# LNE606 and LNE610. jax-version is copied through, so this also
+# proves the same-toolchain path is a hard error, not the re-record
+# warning.
 python - "$SMOKE_STORE/lanes_tampered.json" <<'PY'
 import json, sys
 man = json.load(open("maelstrom_tpu/analysis/lane_manifest.json"))
@@ -75,13 +79,29 @@ e["live_body_lanes"] = e["live_body_lanes"][:-1]
 json.dump(man, open(sys.argv[1], "w"))
 print(f"tampered entry: {key} (marked a live body lane dead)")
 PY
+cp -p cpp/engine/sim.cpp "$SMOKE_STORE/sim.cpp.orig"
+# an interrupt mid-canary must not strand the tampered source: restore
+# sim.cpp BEFORE the smoke store (and its pristine backup) is deleted
+trap 'cp -p "$SMOKE_STORE/sim.cpp.orig" cpp/engine/sim.cpp \
+      2>/dev/null || true; rm -rf "$SMOKE_STORE"' EXIT
+sed -i 's/constexpr int W_GOSSIP = 6;/constexpr int W_GOSSIP = 7;/' \
+    cpp/engine/sim.cpp
+grep -q 'W_GOSSIP = 7' cpp/engine/sim.cpp   # the tamper really landed
+# MAELSTROM_TPU_NO_NATIVE: the native loader auto-rebuilds a stale .so
+# from source — running it against the tampered source would bake the
+# tamper into libsim.so (LNE610's compiled check would then rightly
+# fail every later run). The source-vs-table checks fire either way.
 rc=0
-python -m maelstrom_tpu lint --lanes --strict \
+MAELSTROM_TPU_NO_NATIVE=1 \
+python -m maelstrom_tpu lint --ir --cost --lanes --strict \
     --lane-manifest "$SMOKE_STORE/lanes_tampered.json" \
     > "$SMOKE_STORE/lanes-canary.out" || rc=$?
-[[ "$rc" == "1" ]] || { echo "expected exit 1 (lane drift caught), got $rc"; exit 1; }
+cp -p "$SMOKE_STORE/sim.cpp.orig" cpp/engine/sim.cpp
+trap 'rm -rf "$SMOKE_STORE"' EXIT   # source restored — plain cleanup
+[[ "$rc" == "1" ]] || { echo "expected exit 1 (lane/width drift caught), got $rc"; exit 1; }
 grep -Eq 'ERROR LNE606' "$SMOKE_STORE/lanes-canary.out"
-echo "canary caught: $(grep -Ec 'ERROR LNE606' "$SMOKE_STORE/lanes-canary.out") LNE606 drift finding(s)"
+grep -Eq 'ERROR LNE610' "$SMOKE_STORE/lanes-canary.out"
+echo "canary caught: $(grep -Ec 'ERROR LNE606' "$SMOKE_STORE/lanes-canary.out") LNE606 + $(grep -Ec 'ERROR LNE610' "$SMOKE_STORE/lanes-canary.out") LNE610 finding(s)"
 
 echo
 echo "== raft-family fusion budgets hold (fused ticks pin 0 loops)"
@@ -113,6 +133,33 @@ python -m maelstrom_tpu test --runtime tpu -w echo --node-count 2 \
     --pipeline on --chunk-ticks 50 --seed 3 --store "$SMOKE_STORE" \
     > "$SMOKE_STORE/pipeline-smoke.json"
 grep -q '"chunk-ticks": 50' "$SMOKE_STORE/pipeline-smoke.json"
+
+echo
+echo "== native narrow-vs-wide smoke (equal checker verdicts)"
+# the width-templated engine must run the identical trajectory at its
+# per-family width and at the forced worst-case width (BENCH_WIDE's
+# knob) — same stats, same histories, same checker verdicts
+python - <<'PY'
+import sys
+from maelstrom_tpu.native.engine import native_available, run_native_sim
+if not native_available():
+    print("native engine unavailable — smoke skipped")
+    sys.exit(0)
+from maelstrom_tpu.checkers.linearizable import linearizable_kv_checker
+o = dict(workload="lin-kv", n_instances=256, time_limit=1.0,
+         record_instances=4, threads=1, seed=7)
+a = run_native_sim(o)
+b = run_native_sim({**o, "wide": True})
+assert a["stats"] == b["stats"], (a["stats"], b["stats"])
+assert a["histories"] == b["histories"], "histories diverged"
+va = [linearizable_kv_checker(h)["valid?"] for h in a["histories"]]
+vb = [linearizable_kv_checker(h)["valid?"] for h in b["histories"]]
+assert va == vb, (va, vb)
+na, nb = (a["perf"]["bytes-per-msg-row"], b["perf"]["bytes-per-msg-row"])
+assert na < nb, (na, nb)
+print(f"narrow {na} B/row == wide {nb} B/row trajectories; "
+      f"verdicts equal: {va}")
+PY
 
 echo
 echo "== fleet-stats smoke (tiny echo run -> telemetry report)"
