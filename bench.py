@@ -304,6 +304,26 @@ def child_main(canary: bool = False) -> None:
             except Exception as e:
                 log(TAG, f"phase[{cfg_name}]: tick_lane_stats "
                          f"unavailable: {e!r}")
+
+        # proven overflow headroom of the same tick graph (analysis/
+        # absint.py — the proof `maelstrom lint --ranges` gates):
+        # minimum counter headroom in bits to int32 max at the
+        # production horizon, 0 = unproven. Static like ir_eqns;
+        # BENCH_RANGES=0 skips (the interval fixed point costs a few
+        # seconds on the biggest ticks).
+        ovf_margin_bits = None
+        if os.environ.get("BENCH_RANGES") != "0":
+            try:
+                from maelstrom_tpu.analysis.cost_model import (
+                    tick_range_stats)
+                _rs = tick_range_stats(model, sim, traced=_traced)
+                ovf_margin_bits = _rs["ovf_margin_bits"]
+                log(TAG, f"phase[{cfg_name}]: value ranges — "
+                         f"{ovf_margin_bits} bit(s) of proven counter "
+                         f"headroom at the production horizon")
+            except Exception as e:
+                log(TAG, f"phase[{cfg_name}]: tick_range_stats "
+                         f"unavailable: {e!r}")
         log(TAG, f"phase[{cfg_name}]: sim built — {cfg_n_instances} x "
                  f"{sim.net.n_nodes} nodes, {sim.n_ticks} ticks, "
                  f"{bytes_per_instance} B/instance "
@@ -459,6 +479,8 @@ def child_main(canary: bool = False) -> None:
                 rec["lanes_live"] = lanes_live
                 rec["lanes_dead"] = lanes_dead
                 rec["lanes_dead_bytes"] = lanes_dead_bytes
+            if ovf_margin_bits is not None:
+                rec["ovf_margin_bits"] = ovf_margin_bits
             if bench_pipeline:
                 rec["pipeline"] = True
                 rec["heartbeat"] = bench_heartbeat

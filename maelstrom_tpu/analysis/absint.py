@@ -1,0 +1,2290 @@
+"""Jaxpr abstract interpreter: ``maelstrom lint --ranges``.
+
+The analysis stack can say what the tick *reads* (lane liveness, pass
+6) and what it *costs* (IR/cost gate, passes 4-5), but not what its
+values *can be*: CON204's counter-overflow check is a per-leaf
+heuristic over hand-picked counters, the 2^20-tick horizon cap in
+``make_sim_config`` was hand-derived, and nothing proved the composed
+scatters in the models' apply loops never race on duplicate indices —
+the classic silent-nondeterminism hazard on accelerator scatter units.
+This pass is the missing third pillar: a forward **interval abstract
+interpretation** of the traced fused tick (the same
+``cost_model.trace_tick`` jaxpr the other passes share through the
+trace cache), with per-leaf / per-*lane* int32 ranges — the message
+pool's lane axis is resolved through the lane-liveness tagger, so the
+DTICK deadline lane, the small TYPE enum lane, and the model's payload
+lanes each carry their own range instead of one conflated join.
+
+Per model x carry layout the analyzer:
+
+- seeds every carry leaf from an abstract walk of the ``init_carry``
+  jaxpr (no device, no concrete state);
+- iterates the tick transfer to a fixed point, classifying each leaf
+  element as *stable* or as a *counter* with a measured per-tick
+  growth bound ``g``;
+- widens counters **affinely in the horizon** ``T`` (``hi(T) = hi_fp +
+  g * T``) instead of to infinity, then re-applies the tick transfer
+  at the horizon state to verify the growth bound still holds there
+  (leaves that fail — super-linear recurrences — widen to dtype-full
+  and void their proof, ABS704);
+- walks the tick once more at the horizon state recording every int32
+  arithmetic site whose infinite-precision result escapes int32
+  (ABS701), every gather/scatter whose resolved index range is
+  provably outside its operand axis under a clamping mode (ABS703),
+  and every non-commutative scatter whose index rows can alias
+  (ABS702);
+- binary-searches the largest power-of-two horizon with a clean walk —
+  the entry's **proven** ``max_safe_horizon_log2`` — and, on failure,
+  the minimal overflowing ``T``.
+
+Rules (ABS7xx):
+
+=======  ========================  ========  ==============================
+rule     name                      severity  what it flags
+=======  ========================  ========  ==============================
+ABS700   range-manifest-updated    info      ``--update-ranges`` rewrote
+                                             the manifest
+ABS701   int32-overflow            error     an int32 value (an arithmetic
+                                             site in the tick, or a carry
+                                             counter extrapolated to the
+                                             horizon) provably escapes
+                                             int32 within the configured
+                                             horizon — with the offending
+                                             leaf/eqn and the minimal T
+                                             that overflows
+ABS702   scatter-write-race        error     a non-commutative scatter
+                                             (overwrite mode) whose index
+                                             rows can alias within one
+                                             tick — XLA applies duplicate
+                                             updates in unspecified order,
+                                             so the result is silently
+                                             nondeterministic
+ABS703   oob-index                 error     a gather/scatter/dynamic-
+                                             slice index range provably
+                                             outside the operand axis
+                                             under a clamping mode — jit
+                                             clamps instead of raising,
+                                             so the access silently reads/
+                                             writes the wrong element
+ABS704   range-unresolvable        warning   a carry leaf's growth could
+                                             not be bounded (super-linear
+                                             recurrence, unmodeled
+                                             primitive, while_loop) — the
+                                             leaf widened to dtype-full
+                                             and the overflow verdict for
+                                             it is vacuous (mirror of
+                                             LNE605's widening)
+ABS705   range-manifest-drift      error     the proven ranges differ from
+                                             the checked-in manifest entry
+                                             (warning + a re-record hint
+                                             when the manifest was
+                                             recorded under a different
+                                             jax version —
+                                             ``cost_model.toolchain_note``)
+ABS706   range-manifest-missing    error     a registered model x layout
+                                             has no manifest entry
+ABS707   range-manifest-stale      warning   a manifest entry matches no
+                                             registered model
+ABS708   range-analysis-failure    error     ``get_model`` or the range
+                                             analysis itself raised
+=======  ========================  ========  ==============================
+
+Soundness caveats (documented in doc/lint.md pass 7): interval
+transfer functions over-approximate values, so a *clean* verdict is a
+proof only up to the affine-widening assumption — the per-tick growth
+``g`` measured at the abstract fixed point is assumed maximal, which
+holds for the additive bounded-increment counters this runtime uses
+(interval addition's growth is state-independent) and is re-checked by
+one transfer application at the horizon state; leaves that fail the
+re-check widen and are reported unproven rather than proven-safe.
+Threefry/RNG primitives are opaque (full uint32 range, never an
+overflow — wraparound there is intended), and uint32 arithmetic is
+exempt from ABS701 (defined wraparound).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+from dataclasses import dataclass, field
+from typing import (Any, Dict, List, Optional, Sequence, Set, Tuple)
+
+import numpy as np
+
+from . import cost_model
+from .findings import Finding, SEV_ERROR, SEV_INFO, SEV_WARNING
+
+PASS_NAME = "ranges"
+
+DEFAULT_RANGE_MANIFEST = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "range_manifest.json")
+
+INT32_MIN = -(1 << 31)
+INT32_MAX = (1 << 31) - 1
+
+# the largest power-of-two horizon the default analysis probes: one
+# clean walk at 2^PROBE_LOG2 proves every smaller horizon (bounds are
+# monotone in T). Past ~2^24 the netsim age-rank encoding and the
+# cumulative fleet counters genuinely overflow, so probing higher only
+# buys binary-search work on entries that can never pass.
+PROBE_LOG2 = 24
+
+# the production horizon make_sim_config enforces (netsim delivery-
+# priority encoding) — headroom bits are quoted at this horizon
+PRODUCTION_LOG2 = 20
+
+# commutative scatter combiners: duplicate indices are deterministic
+# for integer arithmetic, so only overwrite-mode scatters can race
+_COMMUTATIVE_SCATTERS = frozenset(
+    {"scatter-add", "scatter-mul", "scatter-min", "scatter-max",
+     "scatter-and", "scatter-or", "scatter-xor"})
+
+# RNG / bit-plumbing primitives whose outputs are deliberately the full
+# dtype range: opaque, never an overflow (threefry wraparound is the
+# point), never a widening note
+_OPAQUE_PRIMS = frozenset(
+    {"threefry2x32", "random_bits", "random_seed", "random_wrap",
+     "random_unwrap", "random_fold_in", "random_split", "random_clone",
+     "random_gamma", "bitcast_convert_type"})
+
+Itv = Tuple[float, float]       # (lo, hi); python ints for int dtypes
+
+
+def _itv_join(a: Optional[Itv], b: Optional[Itv]) -> Optional[Itv]:
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return (min(a[0], b[0]), max(a[1], b[1]))
+
+
+def _dtype_itv(dtype) -> Optional[Itv]:
+    """The full range of a dtype — the TOP element for tracked kinds,
+    None (untracked) for floats and exotics."""
+    kind = getattr(dtype, "kind", None)
+    if kind == "b":
+        return (0, 1)
+    if kind in ("i", "u"):
+        info = np.iinfo(dtype)
+        return (int(info.min), int(info.max))
+    return None
+
+
+def _aval(v):
+    return getattr(v, "aval", None)
+
+
+def _shape(v) -> Tuple[int, ...]:
+    return tuple(getattr(_aval(v), "shape", ()))
+
+
+def _dtype(v):
+    return getattr(_aval(v), "dtype", None)
+
+
+def _is_var(v) -> bool:
+    return not hasattr(v, "val")
+
+
+@dataclass
+class Val:
+    """Abstract value of one array: a whole-array interval, plus an
+    optional per-lane interval vector when the array is tagged with the
+    wire-format lane axis (lane coordinate -> interval)."""
+    itv: Optional[Itv]
+    lanes: Optional[Tuple[Optional[Itv], ...]] = None
+
+    def whole(self) -> Optional[Itv]:
+        if self.lanes is not None:
+            out: Optional[Itv] = None
+            for li in self.lanes:
+                out = _itv_join(out, li)
+            return out if out is not None else self.itv
+        return self.itv
+
+
+def _val_join(a: Val, b: Val) -> Val:
+    if a.lanes is not None and b.lanes is not None \
+            and len(a.lanes) == len(b.lanes):
+        return Val(None, tuple(_itv_join(x, y)
+                               for x, y in zip(a.lanes, b.lanes)))
+    return Val(_itv_join(a.whole(), b.whole()))
+
+
+def _val_eq(a: Val, b: Val) -> bool:
+    return a.whole() == b.whole() and a.lanes == b.lanes
+
+
+def _const_val(arr, lane_axis: Optional[int], n_lanes: int) -> Val:
+    """Exact Val of a concrete array (a jaxpr const or literal)."""
+    try:
+        a = np.asarray(arr)
+    except Exception:
+        return Val(None)
+    if a.dtype.kind == "f":
+        # float constants bound the latency-sampling chain (-mean *
+        # log(u)); non-finite values stay untracked
+        if a.size == 0 or not np.isfinite(a).all():
+            return Val(None) if a.size else Val((0, 0))
+        return Val((float(a.min()), float(a.max())))
+    if a.dtype.kind not in "iub":
+        return Val(None)
+    if a.size == 0:
+        return Val((0, 0))
+    if lane_axis is not None and a.ndim > lane_axis \
+            and a.shape[lane_axis] == n_lanes:
+        moved = np.moveaxis(a, lane_axis, 0).reshape(n_lanes, -1)
+        return Val(None, tuple((int(r.min()), int(r.max()))
+                               for r in moved))
+    return Val((int(a.min()), int(a.max())))
+
+
+def _sub_closed(eqn):
+    out = []
+    for k, v in eqn.params.items():
+        for sub in (v if isinstance(v, (list, tuple)) else (v,)):
+            if hasattr(sub, "eqns") or hasattr(getattr(sub, "jaxpr", None),
+                                               "eqns"):
+                out.append((k, sub))
+    return out
+
+
+def _inner_jaxpr(sub):
+    return sub.jaxpr if hasattr(sub, "jaxpr") else sub
+
+
+# --- the interpreter -------------------------------------------------------
+
+
+class _Interp:
+    """One forward interval walk over a traced tick jaxpr.
+
+    ``tagger`` is the lane-liveness ``_Analyzer`` (already folded and
+    tagged) — it supplies the lane-axis map (which axis of which var is
+    message-lane-shaped) and the constant folds used to resolve
+    gather/scatter index columns exactly. ``None`` disables per-lane
+    tracking (the init-carry walk needs none)."""
+
+    def __init__(self, tagger, n_lanes: int, phase_of=None):
+        self.tagger = tagger
+        self.L = n_lanes
+        self.notes: List[str] = []
+        self.record = False
+        self.overflow_sites: List[Dict[str, Any]] = []
+        self.oob_sites: List[Dict[str, Any]] = []
+        self.race_sites: List[Dict[str, Any]] = []
+        self.scatter_census: Dict[str, int] = {}
+        self._phase_ctx: Optional[str] = None
+        self._phase_of = phase_of or cost_model._phase_of
+
+    # -- plumbing --
+
+    def note(self, msg: str):
+        if msg not in self.notes:
+            self.notes.append(msg)
+
+    def _lane_axis(self, v) -> Optional[int]:
+        if self.tagger is None or not _is_var(v):
+            return None
+        t = self.tagger._tag(v)
+        if t is None:
+            return None
+        shp = _shape(v)
+        if t < len(shp) and shp[t] == self.L:
+            return t
+        return None
+
+    def _cval(self, v):
+        if hasattr(v, "val"):
+            try:
+                return np.asarray(v.val)
+            except Exception:
+                return None
+        if self.tagger is not None:
+            return self.tagger.consts.get(v)
+        return None
+
+    def _get(self, env, v) -> Val:
+        if hasattr(v, "val"):
+            return _const_val(v.val, None, self.L)
+        got = env.get(v)
+        if got is not None:
+            return got
+        cv = self._cval(v)
+        if cv is not None:
+            return _const_val(cv, self._lane_axis(v), self.L)
+        return Val(_dtype_itv(_dtype(v)))
+
+    def _top(self, v) -> Val:
+        return Val(_dtype_itv(_dtype(v)))
+
+    def _mk(self, out_var, itv: Optional[Itv],
+            lanes: Optional[Tuple] = None) -> Val:
+        """Clamp a computed Val to the output's dtype range and attach
+        lanes only when the output is lane-tagged."""
+        top = _dtype_itv(_dtype(out_var))
+        if top is None:
+            # float outputs: no dtype clamp, but keep the bounds (the
+            # latency-sampling chain rides through here)
+            if lanes is not None:
+                joined: Optional[Itv] = None
+                for li in lanes:
+                    joined = _itv_join(joined, li)
+                itv = _itv_join(itv, joined)
+            return Val(itv)
+
+        def cl(i):
+            if i is None:
+                return top
+            return (max(top[0], min(i[0], top[1])),
+                    max(top[0], min(i[1], top[1])))
+        if lanes is not None and self._lane_axis(out_var) is not None:
+            return Val(None, tuple(cl(i) for i in lanes))
+        if lanes is not None:
+            joined: Optional[Itv] = None
+            for li in lanes:
+                joined = _itv_join(joined, li)
+            itv = _itv_join(itv, joined)
+        return Val(cl(itv))
+
+    def _phase(self, eqn) -> str:
+        return self._phase_ctx if self._phase_ctx is not None \
+            else self._phase_of(eqn)
+
+    def _check_ovf(self, eqn, lo, hi, in_itvs) -> Itv:
+        """Record an ABS701 site when an int32 arithmetic result
+        escapes int32 — only when every operand was itself strictly
+        inside int32 (an already-saturated operand means the overflow
+        was created, and reported, upstream), and at least one operand
+        is runtime state (an all-constant wrap — e.g. the dead top
+        square in a pow-by-squaring lowering — is a lowering artifact,
+        not a horizon-reachable overflow)."""
+        dt = _dtype(eqn.outvars[0])
+        if getattr(dt, "kind", None) == "i" and np.dtype(dt).itemsize == 4 \
+                and (lo < INT32_MIN or hi > INT32_MAX):
+            clean_ins = all(
+                i is not None and i[0] > INT32_MIN and i[1] < INT32_MAX
+                for i in in_itvs)
+            runtime_in = any(self._cval(v) is None
+                             for v in eqn.invars)
+            if self.record and clean_ins and runtime_in:
+                self.overflow_sites.append({
+                    "kind": "eqn", "prim": eqn.primitive.name,
+                    "phase": self._phase(eqn),
+                    "lo": int(lo), "hi": int(hi)})
+        return (lo, hi)
+
+    # -- the walk --
+
+    def call(self, jaxpr, invals: Sequence[Val],
+             consts: Sequence[Any] = ()) -> List[Val]:
+        env: Dict[Any, Val] = {}
+        for cv, cval in zip(getattr(jaxpr, "constvars", ()), consts):
+            env[cv] = _const_val(cval, self._lane_axis(cv), self.L)
+        for v, val in zip(jaxpr.invars, invals):
+            env[v] = val
+        self._walk(jaxpr, env)
+        return [self._get(env, v) for v in jaxpr.outvars]
+
+    def _walk(self, jaxpr, env):
+        outer = self._phase_ctx
+        for eqn in jaxpr.eqns:
+            self._phase_ctx = outer if outer is not None \
+                else self._phase_of(eqn)
+            try:
+                outs = self._eval_eqn(eqn, env)
+            except Exception as e:  # a transfer bug must degrade, not die
+                self.note(f"transfer for '{eqn.primitive.name}' raised "
+                          f"{type(e).__name__} — widened to dtype-full")
+                outs = [self._top(o) for o in eqn.outvars]
+            for o, val in zip(eqn.outvars, outs):
+                if _is_var(o) and type(o).__name__ != "DropVar":
+                    env[o] = val
+        self._phase_ctx = outer
+
+    # -- per-primitive transfer --
+
+    def _eval_eqn(self, eqn, env) -> List[Val]:
+        name = eqn.primitive.name
+        ins = [self._get(env, v) for v in eqn.invars]
+
+        if name in ("add", "sub", "mul", "max", "min", "div", "rem"):
+            return [self._binop(eqn, name, ins)]
+        if name == "select_n":
+            return [self._select_n(eqn, ins)]
+        if name == "clamp":
+            return [self._clamp(eqn, ins)]
+        if name in ("neg", "abs", "sign", "not", "integer_pow",
+                    "exp", "log", "sqrt", "rsqrt", "logistic", "tanh",
+                    "erf", "floor", "ceil", "round", "square",
+                    "is_finite", "population_count", "clz",
+                    "stop_gradient", "copy", "real", "imag"):
+            return [self._unop(eqn, name, ins[0])]
+        if name in ("eq", "ne", "lt", "le", "gt", "ge"):
+            return [self._mk(eqn.outvars[0], (0, 1))]
+        if name in ("and", "or", "xor"):
+            return [self._bitwise(eqn, name, ins)]
+        if name in ("shift_left", "shift_right_logical",
+                    "shift_right_arithmetic"):
+            return [self._shift(eqn, name, ins)]
+        if name == "convert_element_type":
+            return [self._convert(eqn, ins[0])]
+        if name in _OPAQUE_PRIMS:
+            return [self._bitcast(eqn, ins[0]) if
+                    name == "bitcast_convert_type" else self._top(o)
+                    for o in eqn.outvars]
+        if name in ("broadcast_in_dim", "reshape", "squeeze",
+                    "transpose", "rev", "expand_dims"):
+            return [self._shapeop(eqn, ins[0])]
+        if name == "iota":
+            return [self._iota(eqn)]
+        if name == "concatenate":
+            return [self._concat(eqn, ins)]
+        if name == "slice":
+            return [self._slice(eqn, ins[0])]
+        if name == "pad":
+            return [self._mk(eqn.outvars[0],
+                             _itv_join(ins[0].whole(), ins[1].whole()),
+                             ins[0].lanes)]
+        if name in ("reduce_sum", "reduce_max", "reduce_min",
+                    "reduce_or", "reduce_and", "reduce_prod"):
+            return [self._reduce(eqn, name, ins[0])]
+        if name in ("argmax", "argmin"):
+            axes = tuple(int(a) for a in eqn.params.get("axes", ()))
+            n = 1
+            for a in axes:
+                n *= _shape(eqn.invars[0])[a]
+            return [self._mk(eqn.outvars[0], (0, max(0, n - 1)))]
+        if name in ("cumsum", "cumlogsumexp", "cummax", "cummin",
+                    "cumprod"):
+            return [self._cumop(eqn, name, ins[0])]
+        if name == "sort":
+            return [Val(v.whole(), v.lanes if
+                        self._lane_axis(o) is not None else None)
+                    for v, o in zip(ins, eqn.outvars)]
+        if name == "top_k":
+            k_axis = _shape(eqn.invars[0])[-1]
+            return [Val(ins[0].whole()),
+                    self._mk(eqn.outvars[1], (0, max(0, k_axis - 1)))]
+        if name == "gather":
+            return [self._gather(eqn, ins, env)]
+        if name.startswith("scatter"):
+            return [self._scatter(eqn, name, ins, env)]
+        if name == "dynamic_slice":
+            return [self._dynamic_slice(eqn, ins)]
+        if name == "dynamic_update_slice":
+            return [self._dus(eqn, ins)]
+        if name == "pjit" or name in ("closed_call", "core_call",
+                                      "custom_jvp_call",
+                                      "custom_vjp_call", "remat",
+                                      "checkpoint"):
+            return self._call_like(eqn, ins)
+        if name == "scan":
+            return self._scan(eqn, ins)
+        if name == "cond":
+            return self._cond(eqn, ins)
+        if name == "while":
+            return self._while(eqn, ins)
+        if name in ("nextafter", "pow", "atan2", "uniform"):
+            return [Val(None)]
+        # unmodeled: dtype-full, noted once per primitive (the ABS704
+        # mirror of LNE605's conservative widening)
+        if any(getattr(_dtype(o), "kind", None) in ("i", "u")
+               for o in eqn.outvars):
+            self.note(f"unmodeled primitive '{name}' — outputs widened "
+                      f"to dtype-full")
+        return [self._top(o) for o in eqn.outvars]
+
+    # elementwise helpers ---------------------------------------------------
+
+    def _aligned_lanes(self, eqn, ins) -> Optional[List[Tuple]]:
+        """Per-lane operand vectors when the op can run lane-wise: every
+        operand is either lane-tagged (same coordinates) or a whole
+        value broadcast across lanes."""
+        if self._lane_axis(eqn.outvars[0]) is None:
+            return None
+        if not any(v.lanes is not None for v in ins):
+            return None
+        cols = []
+        for v in ins:
+            if v.lanes is not None:
+                cols.append(v.lanes)
+            else:
+                cols.append((v.whole(),) * self.L)
+        return [tuple(c[i] for c in cols) for i in range(self.L)]
+
+    def _binop_itv(self, name, a: Optional[Itv], b: Optional[Itv],
+                   eqn, record=True) -> Optional[Itv]:
+        if a is None or b is None:
+            return None
+        (al, ah), (bl, bh) = a, b
+        if name == "add":
+            lo, hi = al + bl, ah + bh
+        elif name == "sub":
+            lo, hi = al - bh, ah - bl
+        elif name == "mul":
+            cs = (al * bl, al * bh, ah * bl, ah * bh)
+            lo, hi = min(cs), max(cs)
+        elif name == "max":
+            lo, hi = max(al, bl), max(ah, bh)
+        elif name == "min":
+            lo, hi = min(al, bl), min(ah, bh)
+        elif name == "rem":
+            # sign follows the dividend; |r| < |divisor| and <= |dividend|
+            m = max(abs(bl), abs(bh))
+            m = max(0, m - 1) if isinstance(m, int) else m
+            m = min(m, max(abs(al), abs(ah)))
+            lo = 0 if al >= 0 else -m
+            hi = 0 if ah <= 0 else m
+        elif name == "div":
+            dt = _dtype(eqn.outvars[0])
+            if getattr(dt, "kind", None) in ("i", "u"):
+                m = max(abs(al), abs(ah))
+                lo = 0 if al >= 0 and bl >= 0 else -m
+                hi = m
+            else:
+                return None
+        else:
+            return None
+        if record:
+            lo, hi = self._check_ovf(eqn, lo, hi, [a, b])
+        return (lo, hi)
+
+    def _binop(self, eqn, name, ins) -> Val:
+        lanes_in = self._aligned_lanes(eqn, ins)
+        if lanes_in is not None:
+            lanes = tuple(self._binop_itv(name, a, b, eqn)
+                          for a, b in lanes_in)
+            return self._mk(eqn.outvars[0], None, lanes)
+        return self._mk(eqn.outvars[0],
+                        self._binop_itv(name, ins[0].whole(),
+                                        ins[1].whole(), eqn))
+
+    def _select_n(self, eqn, ins) -> Val:
+        cases = ins[1:]
+        lanes_in = self._aligned_lanes(eqn, [ins[0]] + list(cases))
+        if lanes_in is not None:
+            lanes = []
+            for row in lanes_in:
+                out: Optional[Itv] = None
+                for c in row[1:]:
+                    out = _itv_join(out, c)
+                lanes.append(out)
+            return self._mk(eqn.outvars[0], None, tuple(lanes))
+        out: Optional[Itv] = None
+        for c in cases:
+            w = c.whole()
+            if w is None:
+                return Val(_dtype_itv(_dtype(eqn.outvars[0])))
+            out = _itv_join(out, w)
+        return self._mk(eqn.outvars[0], out)
+
+    def _clamp(self, eqn, ins) -> Val:
+        lo_v, x, hi_v = ins
+
+        def one(lo_i, x_i, hi_i):
+            if x_i is None or lo_i is None or hi_i is None:
+                if lo_i is not None and hi_i is not None:
+                    return (lo_i[0], hi_i[1])
+                return None
+            return (min(max(x_i[0], lo_i[0]), hi_i[0]),
+                    min(max(x_i[1], lo_i[1]), hi_i[1]))
+        lanes_in = self._aligned_lanes(eqn, ins)
+        if lanes_in is not None:
+            return self._mk(eqn.outvars[0], None,
+                            tuple(one(a, b, c) for a, b, c in lanes_in))
+        return self._mk(eqn.outvars[0],
+                        one(lo_v.whole(), x.whole(), hi_v.whole()))
+
+    def _unop(self, eqn, name, v: Val) -> Val:
+        def one(i: Optional[Itv]) -> Optional[Itv]:
+            if i is None:
+                if name in ("sign",):
+                    return (-1, 1)
+                if name in ("logistic", "is_finite"):
+                    return (0, 1)
+                if name == "tanh":
+                    return (-1, 1)
+                if name == "erf":
+                    return (-1, 1)
+                if name in ("population_count", "clz"):
+                    return (0, 64)
+                return None
+            lo, hi = i
+            try:
+                if name == "neg":
+                    out = (-hi, -lo)
+                elif name == "abs":
+                    out = (0 if lo <= 0 <= hi else min(abs(lo), abs(hi)),
+                           max(abs(lo), abs(hi)))
+                elif name == "sign":
+                    out = (-1 if lo < 0 else (0 if lo == 0 else 1),
+                           1 if hi > 0 else (0 if hi == 0 else -1))
+                    out = (min(out), max(out))
+                elif name == "not":
+                    dt = _dtype(eqn.outvars[0])
+                    out = (0, 1) if getattr(dt, "kind", "") == "b" \
+                        else (-hi - 1, -lo - 1)
+                elif name == "integer_pow":
+                    p = int(eqn.params["y"])
+                    cs = [lo ** p, hi ** p] + ([0] if lo <= 0 <= hi
+                                               else [])
+                    out = (min(cs), max(cs))
+                    out = self._check_ovf(eqn, out[0], out[1], [i])
+                elif name == "exp":
+                    out = (math.exp(min(lo, 700)), math.exp(min(hi, 700)))
+                elif name == "log":
+                    if lo <= 0:
+                        return None
+                    out = (math.log(lo), math.log(hi))
+                elif name in ("sqrt",):
+                    if lo < 0:
+                        return None
+                    out = (math.sqrt(lo), math.sqrt(hi))
+                elif name == "rsqrt":
+                    if lo <= 0:
+                        return None
+                    out = (1.0 / math.sqrt(hi), 1.0 / math.sqrt(lo))
+                elif name in ("logistic", "is_finite"):
+                    out = (0, 1)
+                elif name in ("tanh", "erf"):
+                    out = (-1, 1)
+                elif name == "floor":
+                    out = (math.floor(lo), math.floor(hi))
+                elif name == "ceil":
+                    out = (math.ceil(lo), math.ceil(hi))
+                elif name == "round":
+                    out = (round(lo), round(hi))
+                elif name == "square":
+                    cs = [lo * lo, hi * hi] + ([0] if lo <= 0 <= hi
+                                               else [])
+                    out = (min(cs), max(cs))
+                    out = self._check_ovf(eqn, out[0], out[1], [i])
+                elif name in ("population_count", "clz"):
+                    out = (0, 64)
+                elif name in ("stop_gradient", "copy", "real", "imag"):
+                    out = i
+                else:
+                    return None
+            except (OverflowError, ValueError):
+                return None
+            return out
+        if v.lanes is not None and self._lane_axis(eqn.outvars[0]) \
+                is not None:
+            return self._mk(eqn.outvars[0], None,
+                            tuple(one(i) for i in v.lanes))
+        return self._mk(eqn.outvars[0], one(v.whole()))
+
+    def _bitwise(self, eqn, name, ins) -> Val:
+        def one(a: Optional[Itv], b: Optional[Itv]) -> Optional[Itv]:
+            dt = _dtype(eqn.outvars[0])
+            if getattr(dt, "kind", "") == "b":
+                return (0, 1)
+            if a is None or b is None:
+                return None
+            if name == "and" and (a[0] >= 0 or b[0] >= 0):
+                # masking with a nonneg operand bounds the result by it
+                # whatever the other side's sign (x & 1 stays [0, 1])
+                hi = min(x[1] for x in (a, b) if x[0] >= 0)
+                return (0, hi)
+            if a[0] < 0 or b[0] < 0:
+                # sign bits involved: bitwise results stay within the
+                # magnitude envelope of the operands (two's complement)
+                m = max(abs(a[0]), abs(a[1]), abs(b[0]), abs(b[1]), 1)
+                bits = int(m).bit_length()
+                return (-(1 << bits), (1 << bits) - 1)
+            if name == "and":
+                return (0, min(a[1], b[1]))
+            # or/xor: bounded by the next power of two covering both;
+            # or additionally dominates both operands (nonneg), which
+            # keeps jax.random.uniform's mantissa|0x3f800000 pattern
+            # recognizable for the bitcast-to-[1,2) transfer
+            bits = max(int(a[1]).bit_length(), int(b[1]).bit_length())
+            lo = max(a[0], b[0]) if name == "or" else 0
+            return (lo, (1 << bits) - 1)
+        lanes_in = self._aligned_lanes(eqn, ins)
+        if lanes_in is not None:
+            return self._mk(eqn.outvars[0], None,
+                            tuple(one(a, b) for a, b in lanes_in))
+        return self._mk(eqn.outvars[0],
+                        one(ins[0].whole(), ins[1].whole()))
+
+    def _shift(self, eqn, name, ins) -> Val:
+        a, s = ins[0].whole(), ins[1].whole()
+        if a is None or s is None:
+            return self._top(eqn.outvars[0])
+        # out-of-range shift amounts are undefined in XLA; clamping the
+        # abstract amount to the defined window keeps e.g. the raft
+        # vote bitmask (1 << src with a joined-lane src) bounded
+        sl, sh = max(0, int(s[0])), int(min(max(s[1], 0), 63))
+        if name == "shift_left":
+            if a[0] < 0:
+                return self._top(eqn.outvars[0])
+            # shift_left is bit plumbing, not arithmetic: `1 << bit`
+            # deliberately reaches the sign bit in the bitset idiom
+            # (crdt.py _set_bit), so a shift past the dtype is the
+            # defined wrap, never an ABS701 — the result just widens
+            lo, hi = int(a[0]) << sl, int(a[1]) << sh
+            top = _dtype_itv(_dtype(eqn.outvars[0]))
+            if top is not None and hi > top[1]:
+                return Val(top)
+            return self._mk(eqn.outvars[0], (lo, hi))
+        if a[0] < 0:
+            if name == "shift_right_arithmetic":
+                return self._mk(eqn.outvars[0],
+                                (int(a[0]) >> sl, int(a[1]) >> sl))
+            return self._top(eqn.outvars[0])   # logical shift of negative
+        return self._mk(eqn.outvars[0],
+                        (int(a[0]) >> sh, int(a[1]) >> sl))
+
+    def _convert(self, eqn, v: Val) -> Val:
+        src = _dtype(eqn.invars[0])
+        dst = _dtype(eqn.outvars[0])
+        dst_top = _dtype_itv(dst)
+
+        def one(i: Optional[Itv]) -> Optional[Itv]:
+            if i is None:
+                return dst_top
+            if dst_top is None:        # float destination: values pass
+                return i
+            lo, hi = i
+            if getattr(src, "kind", "") == "f":
+                if not (math.isfinite(lo) and math.isfinite(hi)):
+                    return dst_top
+                lo, hi = math.floor(lo), math.floor(hi)
+            if getattr(dst, "kind", "") == "b":
+                return (0, 1)
+            if lo < dst_top[0] or hi > dst_top[1]:
+                return dst_top       # wrapping conversion: full range
+            return (int(lo), int(hi)) if getattr(dst, "kind", "") in \
+                ("i", "u") else (lo, hi)
+        if v.lanes is not None and self._lane_axis(eqn.outvars[0]) \
+                is not None:
+            return Val(None, tuple(one(i) for i in v.lanes))
+        return Val(one(v.whole()))
+
+    def _bitcast(self, eqn, v: Val) -> Val:
+        """The one bitcast pattern worth modeling: mantissa bits OR'd
+        with 0x3f800000 viewed as float32 — jax.random.uniform's
+        [1, 2) construction. Everything else is opaque."""
+        src, dst = _dtype(eqn.invars[0]), _dtype(eqn.outvars[0])
+        w = v.whole()
+        if getattr(dst, "kind", "") == "f" and \
+                getattr(src, "kind", "") == "u" and w is not None \
+                and 0x3F800000 <= w[0] and w[1] <= 0x3FFFFFFF:
+            return Val((1.0, 2.0))
+        return self._top(eqn.outvars[0])
+
+    # structure -------------------------------------------------------------
+
+    def _shapeop(self, eqn, v: Val) -> Val:
+        if self._lane_axis(eqn.outvars[0]) is not None \
+                and v.lanes is not None:
+            return Val(None, v.lanes)
+        if self._lane_axis(eqn.outvars[0]) is not None and \
+                v.itv is not None:
+            return Val(None, (v.itv,) * self.L)
+        return Val(v.whole())
+
+    def _iota(self, eqn) -> Val:
+        shape = eqn.params["shape"]
+        dim = int(eqn.params["dimension"])
+        n = int(shape[dim])
+        out = eqn.outvars[0]
+        if self._lane_axis(out) == dim:
+            return Val(None, tuple((i, i) for i in range(self.L)))
+        return self._mk(out, (0, max(0, n - 1)))
+
+    def _concat(self, eqn, ins) -> Val:
+        out = eqn.outvars[0]
+        axis = int(eqn.params["dimension"])
+        la = self._lane_axis(out)
+        if la == axis:
+            # a row built lane-wise from pieces: splice per-lane vals
+            lanes: List[Optional[Itv]] = []
+            for v, piece in zip(ins, eqn.invars):
+                size = _shape(piece)[axis]
+                if v.lanes is not None and len(v.lanes) == size:
+                    lanes.extend(v.lanes)
+                else:
+                    lanes.extend([v.whole()] * size)
+            if len(lanes) == self.L:
+                return Val(None, tuple(lanes))
+        if la is not None:
+            pieces = [v.lanes if v.lanes is not None
+                      else (v.whole(),) * self.L for v in ins]
+            return Val(None, tuple(
+                _itv_join_many([p[i] for p in pieces])
+                for i in range(self.L)))
+        w: Optional[Itv] = None
+        for v in ins:
+            iv = v.whole()
+            if iv is None:
+                return Val(None)
+            w = _itv_join(w, iv)
+        return self._mk(out, w)
+
+    def _slice(self, eqn, v: Val) -> Val:
+        la_in = self._lane_axis(eqn.invars[0])
+        out = eqn.outvars[0]
+        if la_in is not None and v.lanes is not None:
+            start = eqn.params["start_indices"][la_in]
+            limit = eqn.params["limit_indices"][la_in]
+            stride = (eqn.params["strides"] or
+                      (1,) * len(_shape(eqn.invars[0])))[la_in]
+            sel = v.lanes[start:limit:stride]
+            if len(sel) == self.L and self._lane_axis(out) is not None:
+                return Val(None, tuple(sel))
+            return Val(_itv_join_many(list(sel)))
+        return Val(v.whole(), v.lanes if
+                   self._lane_axis(out) is not None else None)
+
+    def _reduce(self, eqn, name, v: Val) -> Val:
+        axes = tuple(int(a) for a in eqn.params.get("axes", ()))
+        in_shape = _shape(eqn.invars[0])
+        n = 1
+        for a in axes:
+            n *= int(in_shape[a])
+        la = self._lane_axis(eqn.invars[0])
+        if name == "reduce_sum":
+            if la is not None and la in axes and v.lanes is not None:
+                # summing the lane axis: per-lane bounds add exactly
+                rest = n // self.L if self.L else n
+                lo = sum((i[0] if i else INT32_MIN) for i in v.lanes)
+                hi = sum((i[1] if i else INT32_MAX) for i in v.lanes)
+                lo, hi = lo * max(1, rest), hi * max(1, rest)
+            else:
+                w = v.whole()
+                if w is None:
+                    return self._top(eqn.outvars[0])
+                lo, hi = n * w[0] if w[0] < 0 else w[0] * n, n * w[1] \
+                    if w[1] > 0 else w[1] * n
+                lo, hi = min(lo, w[0] * n), max(hi, w[1] * n)
+            lo, hi = self._check_ovf(eqn, lo, hi, [v.whole()])
+            return self._mk(eqn.outvars[0], (lo, hi))
+        if name == "reduce_prod":
+            return self._top(eqn.outvars[0])
+        # max/min/or/and keep the value envelope
+        w = v.whole()
+        if name in ("reduce_or", "reduce_and"):
+            dt = _dtype(eqn.outvars[0])
+            if getattr(dt, "kind", "") == "b":
+                return self._mk(eqn.outvars[0], (0, 1))
+        return self._mk(eqn.outvars[0], w)
+
+    def _cumop(self, eqn, name, v: Val) -> Val:
+        w = v.whole()
+        if w is None:
+            return self._top(eqn.outvars[0])
+        if name == "cumsum":
+            axis = int(eqn.params["axis"])
+            n = int(_shape(eqn.invars[0])[axis])
+            lo = min(w[0], w[0] * n)
+            hi = max(w[1], w[1] * n)
+            lo, hi = self._check_ovf(eqn, lo, hi, [w])
+            return self._mk(eqn.outvars[0], (lo, hi))
+        if name in ("cummax", "cummin"):
+            return self._mk(eqn.outvars[0], w)
+        return self._top(eqn.outvars[0])
+
+    # gather / scatter / dynamic slicing ------------------------------------
+
+    def _mode_name(self, eqn) -> str:
+        return str(eqn.params.get("mode", "")).lower()
+
+    def _record_oob(self, eqn, axis_size, lo, hi, what):
+        if self.record:
+            self.oob_sites.append({
+                "prim": eqn.primitive.name, "phase": self._phase(eqn),
+                "what": what, "axis_size": int(axis_size),
+                "lo": int(lo), "hi": int(hi)})
+
+    def _gather(self, eqn, ins, env) -> Val:
+        operand, idx = ins[0], ins[1]
+        dnums = eqn.params["dimension_numbers"]
+        slice_sizes = tuple(int(s) for s in eqn.params["slice_sizes"])
+        in_shape = _shape(eqn.invars[0])
+        mode = self._mode_name(eqn)
+        fill = "fill" in mode or "drop" in mode
+        # ABS703: a clamping-mode gather whose index range is provably
+        # outside the operand axis — resolve columns exactly when the
+        # index array folded, else use the whole-index interval
+        start_map = tuple(int(d) for d in dnums.start_index_map)
+        arr = self._cval(eqn.invars[1])
+        iw = idx.whole()
+        if not fill:
+            for col, d in enumerate(start_map):
+                limit = in_shape[d] - slice_sizes[d]
+                if arr is not None and arr.ndim >= 1 \
+                        and arr.shape[-1] == len(start_map):
+                    c = arr.reshape(-1, len(start_map))[:, col]
+                    clo, chi = int(c.min()), int(c.max())
+                elif iw is not None:
+                    clo, chi = int(iw[0]), int(iw[1])
+                else:
+                    continue
+                if clo > limit or chi < 0:
+                    self._record_oob(eqn, in_shape[d], clo, chi,
+                                     f"gather axis {d}")
+        la = self._lane_axis(eqn.invars[0])
+        out_la = self._lane_axis(eqn.outvars[0])
+        if la is not None and operand.lanes is not None:
+            if slice_sizes[la] == in_shape[la] and la not in \
+                    set(int(d) for d in
+                        getattr(dnums, "collapsed_slice_dims", ())):
+                lanes = operand.lanes
+                if fill:
+                    lanes = tuple(_itv_join(i, (0, 0)) for i in lanes)
+                if out_la is not None:
+                    return Val(None, lanes)
+                return Val(_itv_join_many(list(lanes)))
+            if la in start_map:
+                # lane-indexed gather: join the reachable lanes
+                col = start_map.index(la)
+                vals = None
+                if arr is not None and arr.ndim >= 1 and \
+                        arr.shape[-1] == len(start_map):
+                    c = arr.reshape(-1, len(start_map))[:, col]
+                    vals = range(max(0, int(c.min())),
+                                 min(self.L, int(c.max()) + 1))
+                elif iw is not None:
+                    vals = range(max(0, int(iw[0])),
+                                 min(self.L, int(iw[1]) + 1))
+                w = slice_sizes[la]
+                if vals is not None:
+                    out: Optional[Itv] = None
+                    for vstart in vals:
+                        for lane in range(vstart,
+                                          min(self.L, vstart + w)):
+                            out = _itv_join(out, operand.lanes[lane])
+                    if fill:
+                        out = _itv_join(out, (0, 0))
+                    return self._mk(eqn.outvars[0], out)
+        w = operand.whole()
+        if fill:
+            w = _itv_join(w, (0, 0))
+        return self._mk(eqn.outvars[0], w, (
+            operand.lanes if out_la is not None else None))
+
+    def _scatter_rows(self, eqn) -> int:
+        """Index rows per batch slice: >1 means several updates can
+        target the same operand element within one scatter."""
+        dn = eqn.params["dimension_numbers"]
+        idx_shape = _shape(eqn.invars[1])
+        bdims = set(int(d) for d in
+                    getattr(dn, "scatter_indices_batching_dims", ()))
+        rows = 1
+        for a, d in enumerate(idx_shape[:-1] if idx_shape else ()):
+            if a not in bdims:
+                rows *= int(d)
+        return rows
+
+    def _scatter_race(self, eqn, idx_val: Val):
+        """ABS702: can this overwrite-mode scatter's index rows alias?
+        Proof obligations, cheapest first: a single row per batch is
+        trivially race-free; folded constant indices are checked for
+        duplicates exactly; otherwise the pigeonhole on the resolved
+        index-space cardinality applies; an unresolvable multi-row
+        overwrite scatter is reported — "can alias" is exactly the
+        hazard."""
+        rows = self._scatter_rows(eqn)
+        if rows <= 1:
+            return
+        dn = eqn.params["dimension_numbers"]
+        sdims = tuple(int(d) for d in dn.scatter_dims_to_operand_dims)
+        arr = self._cval(eqn.invars[1])
+        if arr is not None and arr.ndim >= 1 and sdims and \
+                arr.shape[-1] == len(sdims):
+            bdims = tuple(int(d) for d in
+                          getattr(dn, "scatter_indices_batching_dims",
+                                  ()))
+            moved = np.moveaxis(arr, bdims,
+                                tuple(range(len(bdims)))) \
+                if bdims else arr[None]
+            flat = moved.reshape(np.prod(moved.shape[:len(bdims)] or
+                                         (1,), dtype=int) if bdims
+                                 else 1, -1, len(sdims))
+            for batch in flat:
+                uniq = {tuple(int(x) for x in row) for row in batch}
+                if len(uniq) < len(batch):
+                    if self.record:
+                        self.race_sites.append({
+                            "prim": eqn.primitive.name,
+                            "phase": self._phase(eqn),
+                            "why": "constant index rows contain "
+                                   "duplicates", "rows": rows})
+                    return
+            return                       # constants proven distinct
+        # pigeonhole on the abstract index space
+        iw = idx_val.whole()
+        in_shape = _shape(eqn.invars[0])
+        if iw is not None:
+            card = 1
+            for d in sdims:
+                lo = max(int(iw[0]), 0)
+                hi = min(int(iw[1]), in_shape[d] - 1)
+                card *= max(0, hi - lo + 1)
+            if card < rows:
+                if self.record:
+                    self.race_sites.append({
+                        "prim": eqn.primitive.name,
+                        "phase": self._phase(eqn),
+                        "why": f"pigeonhole: {rows} update rows over "
+                               f"{card} reachable index tuples",
+                        "rows": rows})
+                return
+        if self.record:
+            self.race_sites.append({
+                "prim": eqn.primitive.name, "phase": self._phase(eqn),
+                "why": "index rows unresolvable — aliasing cannot be "
+                       "ruled out", "rows": rows})
+
+    def _scatter(self, eqn, name, ins, env) -> Val:
+        operand, idx, updates = ins[0], ins[1], ins[2]
+        dn = eqn.params["dimension_numbers"]
+        in_shape = _shape(eqn.invars[0])
+        mode = self._mode_name(eqn)
+        if self.record:
+            ph = self._phase(eqn)
+            self.scatter_census[ph] = self.scatter_census.get(ph, 0) + 1
+        if name == "scatter" and name not in _COMMUTATIVE_SCATTERS:
+            self._scatter_race(eqn, idx)
+        # ABS703 on clamping-mode scatters (drop-mode discards OOB)
+        if "clip" in mode:
+            iw = idx.whole()
+            sdims = tuple(int(d) for d in dn.scatter_dims_to_operand_dims)
+            arr = self._cval(eqn.invars[1])
+            for col, d in enumerate(sdims):
+                if arr is not None and arr.ndim >= 1 and \
+                        arr.shape[-1] == len(sdims):
+                    c = arr.reshape(-1, len(sdims))[:, col]
+                    clo, chi = int(c.min()), int(c.max())
+                elif iw is not None:
+                    clo, chi = int(iw[0]), int(iw[1])
+                else:
+                    continue
+                if clo > in_shape[d] - 1 or chi < 0:
+                    self._record_oob(eqn, in_shape[d], clo, chi,
+                                     f"scatter axis {d}")
+        # value transfer
+        if name == "scatter-add":
+            rows = self._scatter_rows(eqn)
+            ow, uw = operand.whole(), updates.whole()
+            if ow is None or uw is None:
+                return self._top(eqn.outvars[0])
+            lo = ow[0] + rows * min(0, uw[0])
+            hi = ow[1] + rows * max(0, uw[1])
+            lo, hi = self._check_ovf(eqn, lo, hi, [ow, uw])
+            return self._mk(eqn.outvars[0], (lo, hi), operand.lanes)
+        la = self._lane_axis(eqn.invars[0])
+        if la is not None and operand.lanes is not None:
+            window_map = _scatter_window_map(dn, len(in_shape))
+            sdims = tuple(int(d) for d in dn.scatter_dims_to_operand_dims)
+            written: Optional[Set[int]] = None
+            if la in window_map:
+                up_shape = _shape(eqn.invars[2])
+                w = up_shape[window_map[la]] \
+                    if window_map[la] < len(up_shape) else self.L
+                if w == in_shape[la]:
+                    written = set(range(self.L))
+                elif la in sdims:
+                    # a partial lane window POSITIONED by an index
+                    # column (jnp's .at[slice] / dynamic_update_slice
+                    # lowerings): resolve the start(s) and write
+                    # exactly the covered lanes — the gossip body
+                    # write lands on its declared lanes instead of
+                    # smearing the whole row
+                    arr = self._cval(eqn.invars[1])
+                    if arr is not None and arr.ndim >= 1 and sdims \
+                            and arr.shape[-1] == len(sdims):
+                        c = arr.reshape(-1, len(sdims))[
+                            :, sdims.index(la)]
+                        written = set()
+                        for v in np.unique(c):
+                            start = max(0, min(int(v), self.L - w))
+                            written.update(range(start, start + w))
+                else:
+                    written = set(range(min(self.L, w)))
+            elif la in sdims:
+                arr = self._cval(eqn.invars[1])
+                if arr is not None and arr.ndim >= 1 and sdims and \
+                        arr.shape[-1] == len(sdims):
+                    c = arr.reshape(-1, len(sdims))[:, sdims.index(la)]
+                    written = {int(x) for x in np.unique(c)
+                               if 0 <= int(x) < self.L}
+            if written is None:
+                written = set(range(self.L))
+            uw = updates.whole()
+            ul = updates.lanes
+            lanes = []
+            for i, cur in enumerate(operand.lanes):
+                if i in written:
+                    upd = ul[i] if ul is not None and \
+                        len(ul) == self.L else uw
+                    lanes.append(_itv_join(cur, upd))
+                else:
+                    lanes.append(cur)
+            if self._lane_axis(eqn.outvars[0]) is not None:
+                return Val(None, tuple(lanes))
+            return Val(_itv_join_many(lanes))
+        return self._mk(eqn.outvars[0],
+                        _itv_join(operand.whole(), updates.whole()),
+                        operand.lanes)
+
+    def _dynamic_slice(self, eqn, ins) -> Val:
+        operand = ins[0]
+        in_shape = _shape(eqn.invars[0])
+        out_shape = _shape(eqn.outvars[0])
+        # ABS703: dynamic_slice always clamps its start
+        for a, sv in enumerate(eqn.invars[1:]):
+            limit = in_shape[a] - out_shape[a]
+            w = self._get({}, sv).whole() if not _is_var(sv) else \
+                ins[1 + a].whole()
+            if w is not None and (w[0] > limit or w[1] < 0) \
+                    and in_shape[a] != out_shape[a]:
+                self._record_oob(eqn, in_shape[a], int(w[0]), int(w[1]),
+                                 f"dynamic_slice axis {a}")
+        la = self._lane_axis(eqn.invars[0])
+        if la is not None and operand.lanes is not None:
+            if out_shape[la] == in_shape[la]:
+                if self._lane_axis(eqn.outvars[0]) is not None:
+                    return Val(None, operand.lanes)
+                return Val(_itv_join_many(list(operand.lanes)))
+            sw = ins[1 + la].whole()
+            if sw is not None:
+                lo = max(0, int(sw[0]))
+                hi = min(self.L - out_shape[la], int(sw[1]))
+                sel = [operand.lanes[i]
+                       for s in range(lo, hi + 1)
+                       for i in range(s, s + out_shape[la])]
+                if sel:
+                    return self._mk(eqn.outvars[0],
+                                    _itv_join_many(sel))
+            return self._mk(eqn.outvars[0],
+                            _itv_join_many(list(operand.lanes)))
+        return self._mk(eqn.outvars[0], operand.whole(),
+                        operand.lanes)
+
+    def _dus(self, eqn, ins) -> Val:
+        operand, update = ins[0], ins[1]
+        in_shape = _shape(eqn.invars[0])
+        up_shape = _shape(eqn.invars[1])
+        for a, sv in enumerate(eqn.invars[2:]):
+            limit = in_shape[a] - up_shape[a]
+            w = ins[2 + a].whole()
+            if w is not None and (w[0] > limit or w[1] < 0) \
+                    and in_shape[a] != up_shape[a]:
+                self._record_oob(eqn, in_shape[a], int(w[0]), int(w[1]),
+                                 f"dynamic_update_slice axis {a}")
+        la = self._lane_axis(eqn.invars[0])
+        if la is not None and operand.lanes is not None:
+            written: Set[int] = set(range(self.L))
+            if up_shape[la] != in_shape[la]:
+                sw = ins[2 + la].whole()
+                if sw is not None:
+                    lo = max(0, min(int(sw[0]), self.L - up_shape[la]))
+                    hi = max(0, min(int(sw[1]), self.L - up_shape[la]))
+                    written = {i for s in range(lo, hi + 1)
+                               for i in range(s, s + up_shape[la])}
+            ul = update.lanes
+            uw = update.whole()
+            lanes = []
+            for i, cur in enumerate(operand.lanes):
+                if i in written:
+                    # weak update: join (must-overwrite would need a
+                    # single resolved start; join is always sound)
+                    upd = ul[i] if ul is not None and \
+                        len(ul) == self.L and \
+                        up_shape[la] == in_shape[la] else uw
+                    lanes.append(_itv_join(cur, upd))
+                else:
+                    lanes.append(cur)
+            if self._lane_axis(eqn.outvars[0]) is not None:
+                return Val(None, tuple(lanes))
+            return Val(_itv_join_many(lanes))
+        return self._mk(eqn.outvars[0],
+                        _itv_join(operand.whole(), update.whole()),
+                        operand.lanes)
+
+    # control flow ----------------------------------------------------------
+
+    def _call_like(self, eqn, ins) -> List[Val]:
+        subs = _sub_closed(eqn)
+        for _, sub in subs:
+            inner = _inner_jaxpr(sub)
+            if len(inner.invars) == len(eqn.invars) and \
+                    len(inner.outvars) == len(eqn.outvars):
+                return self.call(inner, ins,
+                                 getattr(sub, "consts", ()))
+        if any(getattr(_dtype(o), "kind", None) in ("i", "u")
+               for o in eqn.outvars):
+            self.note(f"call-like primitive "
+                      f"'{eqn.primitive.name}' with mismatched inner "
+                      f"arity — outputs widened")
+        return [self._top(o) for o in eqn.outvars]
+
+    def _cond(self, eqn, ins) -> List[Val]:
+        branches = [(s, _inner_jaxpr(s)) for _, s in _sub_closed(eqn)]
+        fit = [(s, b) for s, b in branches
+               if len(b.invars) == len(eqn.invars) - 1
+               and len(b.outvars) == len(eqn.outvars)]
+        if not fit or len(fit) != len(branches):
+            return [self._top(o) for o in eqn.outvars]
+        outs: Optional[List[Val]] = None
+        for s, b in fit:
+            bouts = self.call(b, ins[1:], getattr(s, "consts", ()))
+            outs = bouts if outs is None else \
+                [_val_join(a, x) for a, x in zip(outs, bouts)]
+        return outs or [self._top(o) for o in eqn.outvars]
+
+    def _while(self, eqn, ins) -> List[Val]:
+        # no whiles in honest ticks (JXP404 polices them); outputs
+        # widen to dtype-full and the model's proof degrades (ABS704)
+        if any(getattr(_dtype(o), "kind", None) in ("i", "u")
+               for o in eqn.outvars):
+            self.note("a while_loop crosses the tick — its outputs "
+                      "widened to dtype-full")
+        return [self._top(o) for o in eqn.outvars]
+
+    def _scan(self, eqn, ins) -> List[Val]:
+        nc = int(eqn.params["num_consts"])
+        ncar = int(eqn.params["num_carry"])
+        length = int(eqn.params.get("length", 1))
+        subs = _sub_closed(eqn)
+        if not subs:
+            return [self._top(o) for o in eqn.outvars]
+        sub = subs[0][1]
+        inner = _inner_jaxpr(sub)
+        consts_v = ins[:nc]
+        carry_v = list(ins[nc:nc + ncar])
+        xs_v = []
+        for k, xv in enumerate(ins[nc + ncar:]):
+            bv = inner.invars[nc + ncar + k]
+            keep_lanes = xv.lanes is not None and \
+                self._lane_axis(bv) is not None
+            xs_v.append(Val(xv.whole(),
+                            xv.lanes if keep_lanes else None))
+
+        def apply(cvals: List[Val], rec: bool) -> List[Val]:
+            saved = self.record
+            self.record = rec and saved
+            try:
+                return self.call(inner, list(consts_v) + cvals + xs_v,
+                                 getattr(sub, "consts", ()))
+            finally:
+                self.record = saved
+
+        final_carry, unstable = self._loop_fixpoint(
+            apply, carry_v, length,
+            [inner.invars[nc + k] for k in range(ncar)])
+        outs = apply(final_carry, True)
+        out_carry = [_val_join(c, o)
+                     for c, o in zip(final_carry, outs[:ncar])]
+        ys = outs[ncar:]
+        # ys lanes survive only when the stacked outer var is tagged
+        result = []
+        for k, c in enumerate(out_carry):
+            result.append(c)
+        for k, y in enumerate(ys):
+            ov = eqn.outvars[ncar + k]
+            keep = y.lanes is not None and \
+                self._lane_axis(ov) is not None
+            result.append(Val(y.whole(), y.lanes if keep else None))
+        return result
+
+    # the shared loop widener ------------------------------------------------
+
+    def _loop_fixpoint(self, apply, seed_vals: List[Val], length: int,
+                       carry_vars=None, pad: bool = False,
+                       iters: int = 5) -> Tuple[List[Val], List[int]]:
+        """Iterate ``apply`` (one abstract loop body) joining into the
+        carry; on non-convergence, extrapolate each element's per-trip
+        growth affinely by ``length`` and re-verify the growth bound at
+        the widened state. Returns (final carry, indices of leaves
+        whose growth could not be bounded)."""
+        hist = [list(seed_vals)]
+        cur = list(seed_vals)
+        stable = False
+        # enough join iterations for COUPLED rates to reach steady
+        # state (raft's term adopts pool lanes that adopt terms: the
+        # common rate only emerges once the feedback cycle saturates —
+        # measuring on the transient under-estimates it and the
+        # verification below would churn)
+        for _ in range(iters):
+            outs = apply(cur, False)
+            new = [_val_join(c, o) for c, o in zip(cur, outs)]
+            if all(_val_eq(a, b) for a, b in zip(new, cur)):
+                stable = True
+                break
+            hist.append(new)
+            cur = new
+        if stable:
+            self._fp_base, self._fp_rates = cur, [None] * len(cur)
+            return cur, []
+        g_prev = [_growth(a, b) for a, b in zip(hist[-3], hist[-2])]
+        g_fin = [_growth(a, b) for a, b in zip(hist[-2], hist[-1])]
+        if len(hist) >= 4:
+            g_old = [_growth(a, b) for a, b in zip(hist[-4], hist[-3])]
+            g_prev = [_growth_max(a, b) for a, b in zip(g_old, g_prev)]
+        # extrapolate with the larger of the last two growth rates,
+        # then VERIFY: one more application at the widened state must
+        # not grow faster than the assumed rate. Coupled counters
+        # (raft's term adopts the pool's term lane, which carries
+        # client values growing at the op-mint rate) measure different
+        # transient rates, so the repair loop raises a failing leaf's
+        # rate to the growth actually observed at the horizon state and
+        # re-extrapolates — rates converge to the coupled system's
+        # common rate in a few rounds. A genuinely super-linear
+        # recurrence keeps outrunning every assumed rate (its observed
+        # step scales with the horizon) and widens to dtype-full.
+        g_cur = [_growth_max(a, b) for a, b in zip(g_prev, g_fin)]
+        unstable: List[int] = []
+        threshold_mode: Set[int] = set()
+        widened = []
+        for i, (v, gp, gf) in enumerate(zip(cur, g_prev, g_fin)):
+            if _growth_accel(gp, gf):
+                # the leaf's base-iteration growth is still
+                # accelerating (the pn gossip max-merge tripling toward
+                # its clamp): an affine extrapolation of the transient
+                # rate would be sound but hopelessly loose (it blows
+                # the N-way sum past int32 at modest horizons). Climb
+                # thresholds from the base instead — SELECTIVELY, only
+                # the accelerating lanes; steady lanes (the pool's
+                # DTICK deadline) keep their affine extrapolation. The
+                # climb finds the chain's clamp fixpoint, and the exit
+                # hands back the true post-clamp drift as the rate.
+                threshold_mode.add(i)
+                widened.append(_mixed_init(v, gp, gf, g_cur[i],
+                                           length))
+            else:
+                widened.append(_extrapolate(v, g_cur[i], length))
+        for rnd in range(24):
+            outs = apply(widened, False)
+            ok = True
+            for i, (w, o) in enumerate(zip(widened, outs)):
+                if i in unstable:
+                    continue
+                if _growth_within(w, o, g_cur[i]):
+                    continue
+                ok = False
+                if os.environ.get("ABSINT_DEBUG"):
+                    nm = (carry_vars[i] if carry_vars and
+                          i < len(carry_vars) else i)
+                    print(f"[absint] rnd{rnd} fail #{i} ({nm}) "
+                          f"w={w.whole()} o={o.whole()} "
+                          f"thr={i in threshold_mode} "
+                          f"g={_rate_size(g_cur[i])}")
+                if _step_saturated(o):
+                    if rnd < 2 and i not in threshold_mode:
+                        # the affine extrapolation overshot the rail —
+                        # the transient rate was garbage (a geometric
+                        # chain heading for a clamp). Restart the leaf
+                        # as a threshold climb from its iteration base;
+                        # if it saturates AGAIN the overflow is real.
+                        threshold_mode.add(i)
+                        widened[i] = _threshold_widen(cur[i])
+                    else:
+                        # the observed step hit the int32 rail past
+                        # the redirect window: at THIS horizon the
+                        # leaf overflows — mark it past the rail so
+                        # the caller's leaf-overflow check reports it
+                        # (a smaller probe decides whether the growth
+                        # was linear-but-large or super-linear)
+                        widened[i] = _overflowed_like(w)
+                    continue
+                step = _step_size(w, o)
+                if i in threshold_mode:
+                    if step <= 256:
+                        # the geometric phase ended (the chain's clamp
+                        # was reached — pn's counter_abs_max): the
+                        # small steady residual IS the asymptotic
+                        # rate. REPLACE the meaningless transient rate
+                        # and extrapolate affinely from here.
+                        threshold_mode.discard(i)
+                        g_cur[i] = _growth(w, o)
+                        widened[i] = _extrapolate(_val_join(w, o),
+                                                  g_cur[i], length)
+                    else:
+                        # classic widening-to-thresholds: jump to the
+                        # next power of two past the observed output —
+                        # only on the lanes that actually failed
+                        widened[i] = _threshold_sel(w, o, g_cur[i])
+                elif rnd < 3:
+                    # settling constant offsets (a window buffer
+                    # filling, a lane one tick behind its source):
+                    # plain join absorbs them without touching the rate
+                    widened[i] = _val_join(w, o)
+                else:
+                    # steady residual: the coupled system's common
+                    # rate is higher than this leaf's measured one —
+                    # adopt the OBSERVED step as the rate (replace,
+                    # not max: a stale transient rate must not keep
+                    # inflating the bound once the chain settles) and
+                    # re-extrapolate from the joined state
+                    g_cur[i] = _growth_max(_growth(w, o),
+                                           _growth(w, o))
+                    widened[i] = _extrapolate(_val_join(w, o),
+                                              g_cur[i], length)
+            if ok:
+                break
+        else:
+            outs = apply(widened, False)
+            for i, (w, o, g) in enumerate(zip(widened, outs, g_cur)):
+                if i in unstable:
+                    continue
+                if not _growth_within(w, o, g):
+                    widened[i] = Val(None)
+                    unstable.append(i)
+        # stash the pre-extrapolation base and the verified rates so
+        # the caller can re-extrapolate the SAME proof to a smaller
+        # horizon without re-iterating (rates are monotone in t)
+        self._fp_base, self._fp_rates = cur, g_cur
+        # pay the verification slack into the claimed bounds — at the
+        # TOP (tick) level only: inner-scan carries are re-verified by
+        # the tick-level loop anyway, and padding them once per outer
+        # iteration would compound the slack geometrically
+        if pad:
+            for i in range(len(widened)):
+                if i not in unstable:
+                    widened[i] = _pad(widened[i], g_cur[i])
+        return widened, sorted(unstable)
+
+
+def _itv_join_many(itvs: List[Optional[Itv]]) -> Optional[Itv]:
+    out: Optional[Itv] = None
+    for i in itvs:
+        if i is None:
+            return None
+        out = _itv_join(out, i)
+    return out
+
+
+def _growth(a: Val, b: Val):
+    """Per-element (hi-growth, lo-growth) from one loop iteration —
+    per-lane vectors when both sides carry lanes."""
+    def one(x: Optional[Itv], y: Optional[Itv]):
+        if x is None or y is None:
+            return None
+        return (max(0, y[1] - x[1]), max(0, x[0] - y[0]))
+    if a.lanes is not None and b.lanes is not None \
+            and len(a.lanes) == len(b.lanes):
+        return [one(x, y) for x, y in zip(a.lanes, b.lanes)]
+    return one(a.whole(), b.whole())
+
+
+def _growth_max(gp, gl):
+    """Elementwise max of two growth measurements."""
+    def one(p, l):
+        if p is None or l is None:
+            return None
+        return (max(p[0], l[0]), max(p[1], l[1]))
+    if isinstance(gl, list) or isinstance(gp, list):
+        n = len(gl) if isinstance(gl, list) else len(gp)
+        gp = gp if isinstance(gp, list) else [gp] * n
+        gl = gl if isinstance(gl, list) else [gl] * n
+        return [one(p, l) for p, l in zip(gp, gl)]
+    return one(gp, gl)
+
+
+def _extrapolate(v: Val, g, n: int) -> Val:
+    def one(i: Optional[Itv], gi) -> Optional[Itv]:
+        if i is None:
+            return None
+        if gi is None or gi == (0, 0):
+            return i
+        return (i[0] - gi[1] * n, i[1] + gi[0] * n)
+    if v.lanes is not None and isinstance(g, list) \
+            and len(g) == len(v.lanes):
+        return Val(None, tuple(one(i, gi)
+                               for i, gi in zip(v.lanes, g)))
+    return Val(one(v.whole(), g if not isinstance(g, list) else None))
+
+
+def _growth_accel(gp, gl) -> bool:
+    """True when the later growth measurement materially exceeds the
+    earlier one — the leaf is still accelerating across the base
+    iterations and its transient rate must not be extrapolated."""
+    def one(p, l):
+        if l is None:
+            return False
+        if p is None:
+            return max(l) > 256
+        return l[0] > 1.5 * p[0] + 256 or l[1] > 1.5 * p[1] + 256
+    if isinstance(gl, list) or isinstance(gp, list):
+        n = len(gl) if isinstance(gl, list) else len(gp)
+        gp = gp if isinstance(gp, list) else [gp] * n
+        gl = gl if isinstance(gl, list) else [gl] * n
+        return any(one(p, l) for p, l in zip(gp, gl))
+    return one(gp, gl)
+
+
+def _rate_size(g) -> float:
+    if isinstance(g, list):
+        return max((max(gi) for gi in g if gi is not None), default=0)
+    return max(g) if g is not None else 0
+
+
+def _step_size(w: Val, o: Val) -> float:
+    """Scalar magnitude of one verification residual (max over
+    lanes/sides) — the accelerating-vs-steady discriminator."""
+    g = _growth(w, o)
+    if isinstance(g, list):
+        return max((max(gi) for gi in g if gi is not None), default=0)
+    return max(g) if g is not None else 0
+
+
+def _threshold_sel(w: Val, o: Val, g) -> Val:
+    """Per-lane selective threshold widening: lanes still within the
+    slack allowance keep their joined value; failing lanes jump to the
+    next power-of-two threshold past the observed output."""
+    def one(wi: Optional[Itv], oi: Optional[Itv], gi) -> Optional[Itv]:
+        if wi is None or oi is None:
+            return _itv_join(wi, oi)
+        sh, sl = _slack(gi)
+        if oi[1] <= wi[1] + sh and oi[0] >= wi[0] - sl:
+            return _itv_join(wi, oi)
+        return _threshold_itv(_itv_join(wi, oi))
+    if w.lanes is not None and o.lanes is not None \
+            and len(w.lanes) == len(o.lanes):
+        gl = g if isinstance(g, list) else [g] * len(w.lanes)
+        return Val(None, tuple(one(wi, oi, gi) for wi, oi, gi in
+                               zip(w.lanes, o.lanes, gl)))
+    return _threshold_widen(_val_join(w, o))
+
+
+def _mixed_init(v: Val, gp, gf, g, length: int) -> Val:
+    """Initial widening for an accelerating leaf: per lane, jump the
+    accelerating lanes to a threshold and extrapolate the steady
+    ones."""
+    if v.lanes is None or not isinstance(gf, list):
+        return _threshold_widen(v)
+    gpl = gp if isinstance(gp, list) else [gp] * len(v.lanes)
+    gcl = g if isinstance(g, list) else [g] * len(v.lanes)
+    lanes = []
+    for vi, gpi, gfi, gci in zip(v.lanes, gpl, gf, gcl):
+        if _growth_accel(gpi, gfi):
+            lanes.append(_threshold_itv(vi))
+        else:
+            ex = _extrapolate(Val(vi), gci, length)
+            lanes.append(ex.whole())
+    return Val(None, tuple(lanes))
+
+
+def _threshold_itv(i: Optional[Itv]) -> Optional[Itv]:
+    if i is None:
+        return None
+    hi = int(max(i[1], 1))
+    lo = int(min(i[0], 0))
+    return (-(1 << abs(lo).bit_length()) if lo < 0 else lo,
+            1 << hi.bit_length())
+
+
+def _threshold_widen(v: Val) -> Val:
+    """Jump a bound outward to the next power-of-two threshold (one
+    doubling past the observed value) so geometric chains reach their
+    stabilizing clamp in logarithmically many repair rounds."""
+    if v.lanes is not None:
+        return Val(None, tuple(_threshold_itv(i) for i in v.lanes))
+    return Val(_threshold_itv(v.whole()))
+
+
+def _overflowed_like(w: Val) -> Val:
+    """A bound one past the int32 rails — the explicit 'this leaf
+    overflows at this horizon' marker the leaf-overflow check reads
+    (and _growth_within trivially accepts, ending the repair churn)."""
+    over = (INT32_MIN - 1, INT32_MAX + 1)
+    if w.lanes is not None:
+        return Val(None, (over,) * len(w.lanes))
+    return Val(over)
+
+
+def _step_saturated(o: Val) -> bool:
+    """True when an observed verification step already hit the int32
+    rail — the leaf is outrunning every finite rate (super-linear);
+    inflating the rate further would only turn 'unprovable' into a
+    bogus concrete overflow claim."""
+    def one(i: Optional[Itv]) -> bool:
+        return i is not None and (i[1] >= INT32_MAX or i[0] <= INT32_MIN)
+    if o.lanes is not None:
+        return any(one(i) for i in o.lanes)
+    return one(o.whole())
+
+
+# verification slack: a multi-leaf feedback cycle (term -> pool lane ->
+# term) settles its cross-leaf offsets a constant at a time, so the
+# re-application check allows a bounded number of growth steps plus an
+# absolute floor — and _pad() charges the same allowance back into the
+# final bounds, so the claimed invariant is exactly what was verified.
+# Against million-tick extrapolations the allowance is noise; a super-
+# linear recurrence still blows past it (its excess scales with T).
+_SLACK_MUL = 32
+_SLACK_ABS = 16
+
+
+def _slack(gi) -> Tuple[int, int]:
+    gh, glo = gi if gi is not None else (0, 0)
+    return (max(_SLACK_MUL * gh, _SLACK_ABS),
+            max(_SLACK_MUL * glo, _SLACK_ABS))
+
+
+def _growth_within(w: Val, o: Val, g) -> bool:
+    """out must stay within the slack allowance of the widened state."""
+    def one(wi: Optional[Itv], oi: Optional[Itv], gi) -> bool:
+        if wi is None:
+            return True
+        if oi is None:
+            return False
+        sh, sl = _slack(gi)
+        return oi[1] <= wi[1] + sh and oi[0] >= wi[0] - sl
+    if w.lanes is not None and o.lanes is not None \
+            and isinstance(g, list) and len(g) == len(w.lanes):
+        return all(one(wi, oi, gi)
+                   for wi, oi, gi in zip(w.lanes, o.lanes, g))
+    return one(w.whole(), o.whole(),
+               g if not isinstance(g, list) else None)
+
+
+def _pad(v: Val, g) -> Val:
+    """Charge the verification slack into a bound (see _SLACK_MUL).
+    Saturating at the int32 rails: a leaf sitting AT the rail is TOP
+    (imprecision), not an overflow — only bounds that already crossed
+    (the _overflowed_like marker) stay past it."""
+    def one(i: Optional[Itv], gi) -> Optional[Itv]:
+        if i is None:
+            return None
+        sh, sl = _slack(gi)
+        lo, hi = i[0] - sl, i[1] + sh
+        if i[1] <= INT32_MAX:
+            hi = min(hi, INT32_MAX)
+        if i[0] >= INT32_MIN:
+            lo = max(lo, INT32_MIN)
+        return (lo, hi)
+    if v.lanes is not None and isinstance(g, list) \
+            and len(g) == len(v.lanes):
+        return Val(None, tuple(one(i, gi)
+                               for i, gi in zip(v.lanes, g)))
+    return Val(one(v.whole(), g if not isinstance(g, list) else None))
+
+
+def _scatter_window_map(dnums, operand_rank) -> Dict[int, int]:
+    inserted = set(int(d) for d in dnums.inserted_window_dims)
+    batching = set(int(d) for d in
+                   getattr(dnums, "operand_batching_dims", ()))
+    window = tuple(int(d) for d in dnums.update_window_dims)
+    amap, k = {}, 0
+    for a in range(operand_rank):
+        if a in inserted or a in batching:
+            continue
+        if k < len(window):
+            amap[a] = window[k]
+        k += 1
+    return amap
+
+
+# --- per-model analysis ----------------------------------------------------
+
+
+@dataclass
+class RangeReport:
+    """Value-range result for ONE model x layout."""
+    label: str
+    probe_log2: int                     # largest horizon probed
+    horizon_log2: int = PRODUCTION_LOG2  # horizon ABS701 gates on (the
+                                        # probe itself when explicitly
+                                        # overridden — the lint_gate
+                                        # canary's synthetic budget)
+    proven: bool = True                 # no unbounded leaves / notes
+    max_safe_horizon_log2: int = 0      # largest 2^k with a clean walk
+    min_overflow_t: Optional[int] = None
+    overflow_sites: List[Dict[str, Any]] = field(default_factory=list)
+    oob_sites: List[Dict[str, Any]] = field(default_factory=list)
+    race_sites: List[Dict[str, Any]] = field(default_factory=list)
+    scatter_census: Dict[str, int] = field(default_factory=dict)
+    unproven_leaves: List[str] = field(default_factory=list)
+    counters: Dict[str, int] = field(default_factory=dict)
+    # leaf path -> headroom bits at the production horizon
+    flake: Optional[Dict[str, int]] = None
+    notes: List[str] = field(default_factory=list)
+
+    @property
+    def ovf_margin_bits(self) -> int:
+        """Minimum proven counter headroom — bits to int32 max at
+        ``min(2^20, 2^max_safe)``, the horizon the entry is actually
+        proven (and production-capped) to. 0 = unproven. The bench.py
+        metric."""
+        if not self.proven:
+            return 0
+        return min(self.counters.values(), default=31)
+
+    @property
+    def race_status(self) -> str:
+        if self.race_sites:
+            return "racing"
+        return "race-free" if self.proven else "unproven"
+
+    def to_entry(self) -> Dict[str, Any]:
+        """The checked-in manifest representation: the safety-relevant
+        facts (proven horizon, per-counter headroom, scatter-race
+        verdict) the drift gate pins."""
+        entry = {
+            "proven": self.proven,
+            "max_safe_horizon_log2": self.max_safe_horizon_log2,
+            "min_overflow_t": self.min_overflow_t,
+            "scatter_race": self.race_status,
+            "netsim_scatters": sum(
+                n for ph, n in self.scatter_census.items()
+                if ph in ("deliver", "enqueue")),
+            "ovf_margin_bits": self.ovf_margin_bits,
+            "counters": {k: self.counters[k]
+                         for k in sorted(self.counters)},
+        }
+        if self.flake is not None:
+            entry["flake"] = self.flake
+        if self.unproven_leaves:
+            entry["unproven_leaves"] = sorted(self.unproven_leaves)
+        return entry
+
+
+def _carry_paths(carry) -> List[str]:
+    import jax
+    return [jax.tree_util.keystr(kp) for kp, _ in
+            jax.tree_util.tree_flatten_with_path(carry)[0]]
+
+
+def _abstract_init_vals(model, sim, n_lanes: int,
+                        pool_idx: int) -> List[Val]:
+    """Seed intervals for every carry leaf from an abstract walk of the
+    ``init_carry`` jaxpr — no concrete state is ever materialized, so
+    this prices the same at 4 instances or 100k."""
+    import jax
+    from ..tpu.runtime import init_carry
+
+    params = model.make_params(sim.net.n_nodes)
+    closed = jax.make_jaxpr(
+        lambda: init_carry(model, sim, 0, params))()
+    interp = _Interp(tagger=None, n_lanes=n_lanes,
+                     phase_of=lambda eqn: "init")
+    outs = interp.call(closed.jaxpr, [], closed.consts)
+    # the pool leaf is all-zero at init in every configuration — give
+    # it an exact per-lane seed so lane precision starts tight
+    vals = []
+    for i, v in enumerate(outs):
+        if i == pool_idx:
+            vals.append(Val(None, ((0, 0),) * n_lanes))
+        else:
+            vals.append(Val(v.whole()))
+    return vals
+
+
+def analyze_model(model, node_count: int, layout: str = "lead",
+                  label: Optional[str] = None, sim=None,
+                  traced=None, trace_cache=None,
+                  probe_log2: Optional[int] = None) -> RangeReport:
+    """Run the interval analysis for one model x layout. ``sim``
+    overrides the shared audit config (bench.py passes its own);
+    ``traced`` (a ``cost_model.trace_tick`` triple) and ``trace_cache``
+    follow the lanes-pass conventions so the combined gate traces each
+    model x layout once. ``probe_log2`` raises/lowers the largest
+    horizon probed (the lint_gate canary probes 2^31 to plant a
+    synthetic overflow budget every cumulative counter trips)."""
+    from .lane_liveness import _Analyzer, _pool_lane_axis
+
+    if sim is not None:
+        layout = sim.layout
+        trace_cache = None
+    label = label or f"{getattr(model, 'name', type(model).__name__)}" \
+                     f"/{layout}"
+    if sim is None:
+        sim = cost_model.audit_sim(model, node_count, layout)
+    closed, carry, out_shapes = traced or cost_model.trace_tick(
+        model, sim, cache=trace_cache)
+    n_lanes = sim.net.lanes
+    probe = PROBE_LOG2 if probe_log2 is None else int(probe_log2)
+
+    import jax
+    paths = _carry_paths(carry)
+    n_carry = len(paths)
+    pool_idx = paths.index(".pool")
+    lane_axis = _pool_lane_axis(layout,
+                                jax.tree_util.tree_leaves(carry)
+                                [pool_idx].shape, n_lanes)
+    tagger = _Analyzer(closed, n_lanes, {pool_idx: lane_axis})
+    tagger.fold_consts()
+    tagger.infer_tags()
+
+    interp = _Interp(tagger, n_lanes)
+    init_vals = _abstract_init_vals(model, sim, n_lanes, pool_idx)
+    # lane-tag the pool seed only if the traced invar really is tagged
+    if interp._lane_axis(closed.jaxpr.invars[pool_idx]) is None:
+        init_vals[pool_idx] = Val((0, 0))
+
+    def fixpoint(T: int):
+        t_val = Val((0, T - 1))
+
+        def apply(carry_vals, rec: bool) -> List[Val]:
+            interp.record = rec
+            outs = interp.call(closed.jaxpr,
+                               list(carry_vals) + [t_val],
+                               closed.consts)
+            interp.record = False
+            return outs[:n_carry]
+        final, unstable = interp._loop_fixpoint(
+            apply, list(init_vals), T, pad=True, iters=8)
+        return final, unstable, t_val, interp._fp_base, \
+            interp._fp_rates
+
+    def probe_walk(final, t_val) -> Tuple[List[Dict], List[Dict],
+                                          List[Dict]]:
+        interp.overflow_sites, interp.oob_sites, interp.race_sites = \
+            [], [], []
+        interp.scatter_census = {}
+        interp.record = True
+        interp.call(closed.jaxpr, list(final) + [t_val], closed.consts)
+        interp.record = False
+        return (list(interp.overflow_sites), list(interp.oob_sites),
+                list(interp.race_sites))
+
+    # one fixed point at the probe horizon; growth rates measured there
+    # over-approximate every smaller horizon (monotone transfer), so
+    # smaller probes reuse the same extrapolation base
+    final, unstable, t_top, base, rates = fixpoint(1 << probe)
+
+    # leaf-level overflow: an extrapolated carry counter escaping int32
+    leaf_over: List[Tuple[str, Itv]] = []
+    for i, v in enumerate(final):
+        if i in unstable:
+            continue
+        w = v.whole()
+        leaf_dt = getattr(jax.tree_util.tree_leaves(carry)[i],
+                          "dtype", None)
+        if w is not None and str(leaf_dt) == "int32" and \
+                (w[0] < INT32_MIN or w[1] > INT32_MAX):
+            leaf_over.append((paths[i], w))
+
+    over, oob, races = probe_walk(final, t_top)
+    census = dict(interp.scatter_census)
+    # oob/races are horizon-independent verdicts (reported as ABS703/
+    # ABS702 on their own); only OVERFLOW drives the horizon search
+    clean = not (over or leaf_over)
+
+    report = RangeReport(
+        label=label, probe_log2=probe,
+        # an explicitly-requested probe IS the configured horizon the
+        # overflow verdict gates on; the default probe gates on the
+        # production cap (real models prove past it with headroom)
+        horizon_log2=(PRODUCTION_LOG2 if probe_log2 is None
+                      else probe))
+    report.scatter_census = census
+    report.race_sites = races
+    report.oob_sites = oob
+    report.notes = list(interp.notes)
+    report.unproven_leaves = [paths[i] for i in unstable]
+    report.proven = not unstable and not interp.notes
+
+    if clean:
+        report.max_safe_horizon_log2 = probe
+    else:
+        # binary-search the largest clean power-of-two horizon; bounds
+        # are monotone in T so one fixpoint per candidate suffices
+        lo_k, hi_k = -1, probe
+        while hi_k - lo_k > 1:
+            mid = (lo_k + hi_k) // 2
+            f_mid, uns_mid, t_mid, _, _ = fixpoint(1 << mid)
+            o_mid, _, _ = probe_walk(f_mid, t_mid)
+            l_mid = _leaf_overflow(f_mid, uns_mid, carry)
+            if o_mid or l_mid:
+                hi_k = mid
+            else:
+                lo_k = mid
+        report.max_safe_horizon_log2 = max(0, lo_k)
+        report.min_overflow_t = _min_overflow_t(
+            fixpoint, probe_walk, carry,
+            1 << max(0, lo_k), 1 << hi_k)
+        report.overflow_sites = over + [
+            {"kind": "leaf", "leaf": p, "lo": int(w[0]), "hi": int(w[1])}
+            for p, w in leaf_over]
+
+    # per-counter headroom at the production horizon — re-extrapolate
+    # the probe fixpoint's verified base/rates to the smaller horizon
+    # (rates are monotone in t, so this is the same proof, cheaper)
+    t_prod = 1 << min(PRODUCTION_LOG2, report.max_safe_horizon_log2)
+    uns_prod = unstable
+    f_prod = [v if g is None else _pad(_extrapolate(v, g, t_prod), g)
+              for v, g in zip(base, rates)]
+    leaves = jax.tree_util.tree_leaves(carry)
+    for i, (v0, vT) in enumerate(zip(init_vals, f_prod)):
+        if i in uns_prod:
+            continue
+        w0, wT = v0.whole(), vT.whole()
+        if w0 is None or wT is None or \
+                str(getattr(leaves[i], "dtype", "")) != "int32":
+            continue
+        if wT[1] >= INT32_MAX or wT[0] <= INT32_MIN:
+            # rails-saturated = TOP by design (the g-set seen bitmask
+            # deliberately uses the sign bit): no headroom CLAIM — a
+            # counter that genuinely reached the rails would have made
+            # the probe walk dirty instead
+            continue
+        if wT[1] > w0[1] or wT[0] < w0[0]:     # a counter: it moved
+            m = max(abs(int(wT[0])), abs(int(wT[1])), 1)
+            report.counters[paths[i]] = max(0, 31 - m.bit_length())
+    # the declared flake-id split, proven not hand-waved (the ROADMAP
+    # accepted-debt item): the node-state counter's proven ceiling vs
+    # the field width CON204 audits
+    bits = getattr(model, "flake_counter_bits", None)
+    if bits is not None:
+        peak = 0
+        for i, p in enumerate(paths):
+            if p.startswith(".node_state") and i not in uns_prod:
+                w = f_prod[i].whole()
+                if w is not None:
+                    peak = max(peak, int(w[1]))
+        report.flake = {
+            "bits": int(bits),
+            "proven_counter_max": int(peak),
+            "fits": bool(peak < (1 << bits)),
+        }
+        if not report.flake["fits"]:
+            report.overflow_sites.append({
+                "kind": "flake", "leaf": ".node_state",
+                "hi": int(peak), "bits": int(bits)})
+    return report
+
+
+def _leaf_overflow(final, unstable, carry_shapes) -> bool:
+    import jax
+    leaves = jax.tree_util.tree_leaves(carry_shapes)
+    for i, v in enumerate(final):
+        if i in unstable:
+            continue
+        w = v.whole()
+        if w is not None and str(getattr(leaves[i], "dtype", "")) == \
+                "int32" and (w[0] < INT32_MIN or w[1] > INT32_MAX):
+            return True
+    return False
+
+
+def _min_overflow_t(fixpoint, probe_walk, carry_shapes, lo_t: int,
+                    hi_t: int) -> int:
+    """Binary-search the minimal horizon T (not necessarily a power of
+    two) whose walk overflows — ABS701 names it."""
+    while hi_t - lo_t > 1:
+        mid = (lo_t + hi_t) // 2
+        f, uns, t_v, _, _ = fixpoint(mid)
+        o, _, _ = probe_walk(f, t_v)
+        if o or _leaf_overflow(f, uns, carry_shapes):
+            hi_t = mid
+        else:
+            lo_t = mid
+    return hi_t
+
+
+# --- findings --------------------------------------------------------------
+
+
+def _model_path(model) -> str:
+    return type(model).__module__.replace(".", os.sep) + ".py"
+
+
+def _finding(rule, name, severity, path, symbol, message) -> Finding:
+    return Finding(rule=rule, name=name, severity=severity,
+                   pass_name=PASS_NAME, path=path, line=0,
+                   symbol=symbol, message=message)
+
+
+def findings_of_report(model, report: RangeReport) -> List[Finding]:
+    """ABS701-ABS704 from one model's range result."""
+    path = _model_path(model)
+    cls = type(model).__name__
+    out: List[Finding] = []
+
+    def flag(rule, name, message, severity=SEV_ERROR):
+        out.append(_finding(rule, name, severity, path, cls,
+                            f"[{report.label}] {message}"))
+
+    horizon = min(report.horizon_log2, report.probe_log2)
+    if report.max_safe_horizon_log2 < horizon:
+        for site in report.overflow_sites[:4]:
+            if site.get("kind") == "leaf":
+                where = f"carry leaf {site['leaf']} reaches " \
+                        f"[{site['lo']}, {site['hi']}]"
+            elif site.get("kind") == "flake":
+                where = f"flake counter {site['leaf']} provably " \
+                        f"reaches {site['hi']} > 2^{site['bits']} " \
+                        f"(the declared id-space split) — ids collide"
+            else:
+                where = f"'{site['prim']}' in the {site['phase']} " \
+                        f"phase reaches [{site['lo']}, {site['hi']}]"
+            flag("ABS701", "int32-overflow",
+                 f"int32 overflow reachable within the 2^{horizon}-tick "
+                 f"horizon: {where}; minimal overflowing T = "
+                 f"{report.min_overflow_t} (proven safe only to "
+                 f"2^{report.max_safe_horizon_log2})")
+    elif report.flake is not None and not report.flake["fits"]:
+        flag("ABS701", "int32-overflow",
+             f"flake counter provably reaches "
+             f"{report.flake['proven_counter_max']} within the "
+             f"2^{horizon}-tick horizon but the declared split is "
+             f"{report.flake['bits']} bits — ids from different nodes "
+             f"collide; widen flake_counter_bits (and prove the fix "
+             f"with --update-ranges)")
+    for site in report.race_sites[:4]:
+        flag("ABS702", "scatter-write-race",
+             f"non-commutative scatter in the {site['phase']} phase: "
+             f"{site['rows']} update rows, {site['why']} — XLA applies "
+             f"duplicate overwrite updates in unspecified order, so "
+             f"the tick is silently nondeterministic; make the update "
+             f"commutative (scatter-add/min/max), sequentialize the "
+             f"writes, or prove the indices distinct")
+    for site in report.oob_sites[:4]:
+        flag("ABS703", "oob-index",
+             f"provably out-of-bounds {site['what']} in the "
+             f"{site['phase']} phase: index range [{site['lo']}, "
+             f"{site['hi']}] vs axis size {site['axis_size']} — under "
+             f"jit the access silently clamps (LNE604's column-exact "
+             f"check, upgraded to full range reasoning)")
+    if not report.proven:
+        why = report.unproven_leaves[:3] or report.notes[:2]
+        flag("ABS704", "range-unresolvable",
+             f"value ranges could not be fully bounded — "
+             f"{'; '.join(str(w) for w in why)}; the overflow verdict "
+             f"for the widened leaves is vacuous (conservative "
+             f"widening, the LNE605 mirror)", SEV_WARNING)
+    return out
+
+
+# --- manifest io + drift gate ----------------------------------------------
+
+
+def load_range_manifest(path: Optional[str] = None) -> Dict[str, Any]:
+    path = path or DEFAULT_RANGE_MANIFEST
+    if not os.path.exists(path):
+        return {"version": 1, "entries": {}}
+    with open(path) as f:
+        data = json.load(f)
+    data.setdefault("entries", {})
+    return data
+
+
+def save_range_manifest(entries: Dict[str, Dict[str, Any]],
+                        path: Optional[str] = None) -> str:
+    import jax
+    path = path or DEFAULT_RANGE_MANIFEST
+    payload = {
+        "version": 1,
+        "_comment": (
+            "Per-model proven value-range manifest for `maelstrom lint "
+            "--ranges` (doc/lint.md pass 7). Keys: <workload>/"
+            "n=<nodes>/<layout>; max_safe_horizon_log2 = largest "
+            "power-of-two tick horizon with a PROVEN overflow-free "
+            "abstract walk (make_sim_config refuses horizons above it), "
+            "counters = per-carry-leaf headroom bits to int32 max at "
+            "the production horizon, scatter_race = the ABS702 "
+            "determinism verdict (race-free = every non-commutative "
+            "scatter's index rows proven distinct; netsim_scatters "
+            "counts scatter sites in the deliver/enqueue phases — 0 "
+            "certifies the composed-gather path scatter-free). "
+            "Regenerate after an INTENTIONAL range change with "
+            "`maelstrom lint --ranges --update-ranges`; drift fails "
+            "the gate (ABS705). jax-version records the tracing "
+            "toolchain: under a different jax the gate downgrades "
+            "drift to a re-record warning."),
+        "jax-version": jax.__version__,
+        "production_horizon_log2": PRODUCTION_LOG2,
+        "entries": {k: entries[k] for k in sorted(entries)},
+    }
+    with open(path, "w") as f:
+        json.dump(payload, f, indent=2)
+        f.write("\n")
+    _MANIFEST_CACHE.clear()
+    return path
+
+
+def compare_manifest(live: Dict[str, RangeReport],
+                     manifest: Dict[str, Any],
+                     paths: Dict[str, Tuple[str, str]],
+                     full_universe: bool = True,
+                     errored=frozenset()) -> List[Finding]:
+    """Diff live range reports against the checked-in manifest. The
+    gate pins the safety-relevant facts: the proven horizon, the
+    scatter-race verdict, and per-counter headroom bits."""
+    entries = manifest.get("entries", {})
+    note = cost_model.toolchain_note(manifest.get("jax-version"),
+                                     "the range manifest",
+                                     "--update-ranges")
+    findings: List[Finding] = []
+    for key in sorted(live):
+        rep = live[key]
+        path, symbol = paths[key]
+        base = entries.get(key)
+        if base is None:
+            findings.append(_finding(
+                "ABS706", "range-manifest-missing", SEV_ERROR, path,
+                symbol,
+                f"[{key}] no range-manifest entry — record one with "
+                f"`maelstrom lint --ranges --update-ranges`"))
+            continue
+        drifts = []
+        for field_name, got in (
+                ("proven", rep.proven),
+                ("max_safe_horizon_log2", rep.max_safe_horizon_log2),
+                ("scatter_race", rep.race_status),
+                ("netsim_scatters", sum(
+                    n for ph, n in rep.scatter_census.items()
+                    if ph in ("deliver", "enqueue"))),
+                ("ovf_margin_bits", rep.ovf_margin_bits),
+                ("counters", {k: rep.counters[k]
+                              for k in sorted(rep.counters)})):
+            want = base.get(field_name)
+            if want is not None and want != got:
+                drifts.append(f"{field_name}: live {got!r} vs manifest "
+                              f"{want!r}")
+        if drifts:
+            findings.append(_finding(
+                "ABS705", "range-manifest-drift",
+                SEV_WARNING if note else SEV_ERROR, path, symbol,
+                f"[{key}] proven ranges drifted from the checked-in "
+                f"manifest: {'; '.join(drifts)} — a counter's proven "
+                f"bound moved; if intentional, re-record with "
+                f"--update-ranges and justify it in the PR"
+                + (f" ({note})" if note else "")))
+    if full_universe:
+        for key in sorted(set(entries) - set(live) - set(errored)):
+            findings.append(_finding(
+                "ABS707", "range-manifest-stale", SEV_WARNING,
+                "maelstrom_tpu/analysis/range_manifest.json", "",
+                f"[{key}] manifest entry matches no registered "
+                f"model x layout — remove or re-record it"))
+    return findings
+
+
+# --- the make_sim_config cross-check ---------------------------------------
+
+_MANIFEST_CACHE: Dict[str, Dict[str, Any]] = {}
+
+
+def proven_horizon_log2(model_name: str,
+                        manifest_path: Optional[str] = None
+                        ) -> Optional[int]:
+    """The model's proven overflow-free horizon (log2) from the
+    checked-in manifest — the minimum across its recorded layouts, or
+    None when the model has no proven entry. ``make_sim_config``
+    cross-checks its horizon refusal against this instead of the one
+    global 2^20 constant (unproven entries never cap: the global
+    netsim bound still applies)."""
+    path = manifest_path or DEFAULT_RANGE_MANIFEST
+    cached = _MANIFEST_CACHE.get(path)
+    if cached is None:
+        cached = load_range_manifest(path)
+        _MANIFEST_CACHE[path] = cached
+    best: Optional[int] = None
+    for key, entry in cached.get("entries", {}).items():
+        if key.split("/", 1)[0] != model_name:
+            continue
+        if not entry.get("proven"):
+            continue
+        k = entry.get("max_safe_horizon_log2")
+        if k is None:
+            continue
+        best = int(k) if best is None else min(best, int(k))
+    return best
+
+
+# --- orchestration ---------------------------------------------------------
+
+
+def run_range_lint(repo_root: str = ".",
+                   manifest_path: Optional[str] = None,
+                   update_manifest: bool = False,
+                   workloads: Optional[List[Tuple[str, int]]] = None,
+                   layouts: Sequence[str] = cost_model.AUDIT_LAYOUTS,
+                   include_fixtures: bool = True,
+                   trace_cache=None,
+                   probe_log2: Optional[int] = None) -> List[Finding]:
+    """The ranges pass: interval-analyze every registered model x
+    layout (or a restricted list), emit ABS7xx findings, and gate
+    against (or re-record) the manifest."""
+    from ..models import get_model
+
+    full = workloads is None
+    specs = cost_model.cost_specs() if full else list(workloads)
+    findings: List[Finding] = []
+    live: Dict[str, RangeReport] = {}
+    paths: Dict[str, Tuple[str, str]] = {}
+    errored: Set[str] = set()
+
+    for wl, n in specs:
+        try:
+            model = get_model(wl, n, "grid")
+        except Exception as e:
+            findings.append(_finding(
+                "ABS708", "range-analysis-failure", SEV_ERROR,
+                "maelstrom_tpu/models/__init__.py", "get_model",
+                f"get_model({wl!r}, {n}) raised: {e!r}"))
+            errored.update(cost_model.entry_key(wl, n, lay)
+                           for lay in layouts)
+            continue
+        for layout in layouts:
+            key = cost_model.entry_key(wl, n, layout)
+            try:
+                rep = analyze_model(model, n, layout,
+                                    label=f"{wl}/n={n}/{layout}",
+                                    trace_cache=trace_cache,
+                                    probe_log2=probe_log2)
+            except Exception as e:
+                findings.append(_finding(
+                    "ABS708", "range-analysis-failure", SEV_ERROR,
+                    _model_path(model), type(model).__name__,
+                    f"[{key}] range analysis raised "
+                    f"{type(e).__name__}: {e}"))
+                errored.add(key)
+                continue
+            findings.extend(findings_of_report(model, rep))
+            live[key] = rep
+            paths[key] = (_model_path(model), type(model).__name__)
+
+    if full and include_fixtures:
+        from ..models.ir_hazards import RANGE_FIXTURE_MODELS
+        for kind, cls in sorted(RANGE_FIXTURE_MODELS.items()):
+            model = cls()
+            for layout in layouts:
+                try:
+                    rep = analyze_model(model, 2, layout,
+                                        label=f"fixture-{kind}/{layout}")
+                except Exception as e:
+                    findings.append(_finding(
+                        "ABS708", "range-analysis-failure", SEV_ERROR,
+                        _model_path(model), type(model).__name__,
+                        f"[fixture-{kind}/{layout}] range analysis "
+                        f"raised {type(e).__name__}: {e}"))
+                    continue
+                findings.extend(findings_of_report(model, rep))
+
+    if update_manifest:
+        path = save_range_manifest(
+            {k: r.to_entry() for k, r in live.items()}, manifest_path)
+        findings.append(_finding(
+            "ABS700", "range-manifest-updated", SEV_INFO,
+            os.path.relpath(path, os.path.abspath(repo_root))
+            if os.path.isabs(path) else path, "",
+            f"recorded {len(live)} range-manifest entr"
+            f"{'y' if len(live) == 1 else 'ies'}"))
+    else:
+        manifest = load_range_manifest(manifest_path)
+        findings.extend(compare_manifest(live, manifest, paths,
+                                         full_universe=full,
+                                         errored=errored))
+    return findings
+
+
+# --- bench/profiler surface ------------------------------------------------
+
+
+def tick_range_stats(model, sim, traced=None) -> Dict[str, int]:
+    """One-call range stats for bench.py metric lines: the minimum
+    proven counter headroom (bits to int32 max at the production
+    horizon) of this exact configuration's tick. 0 = unproven."""
+    rep = analyze_model(model, sim.net.n_nodes, sim.layout, sim=sim,
+                        traced=traced)
+    return {"ovf_margin_bits": rep.ovf_margin_bits}

@@ -212,8 +212,12 @@ def audit_sim(model, node_count: int, layout: str = "lead"):
     comparable only under one config."""
     from .contract_audit import _audit_opts
     from ..tpu.harness import make_sim_config
+    # range_horizon_check=False: the audit config is what the range
+    # pass itself analyzes — a stale proven bound must not be able to
+    # block its own re-proof
     return make_sim_config(model, {**_audit_opts(node_count),
-                                   "layout": layout})
+                                   "layout": layout,
+                                   "range_horizon_check": False})
 
 
 def trace_tick(model, sim, params=None, cache=None):
@@ -254,6 +258,18 @@ def tick_cost(model, sim, params=None) -> CostReport:
     the bench.py / tools entry point."""
     closed, carry, _ = trace_tick(model, sim, params)
     return cost_of_jaxpr(closed, carry)
+
+
+def tick_range_stats(model, sim, traced=None) -> Dict[str, int]:
+    """Value-range stats of ``model``'s fused tick under ``sim`` —
+    ``ovf_margin_bits`` (minimum proven counter headroom to int32 max
+    at the production horizon; 0 = unproven), the figure bench.py
+    prints next to the static-cost fields. Thin delegation so cost
+    consumers need only this module; the analysis itself lives in
+    :mod:`.absint`. ``traced`` (a :func:`trace_tick` triple) skips the
+    duplicate abstract trace."""
+    from .absint import tick_range_stats as _stats
+    return _stats(model, sim, traced=traced)
 
 
 def tick_lane_stats(model, sim, traced=None,
@@ -378,8 +394,12 @@ def toolchain_note(recorded: Optional[str], what: str,
     when the recording version differs from the running one, drift is
     expected toolchain movement — the gate downgrades to a warning that
     says exactly how to re-record instead of failing as if code
-    regressed. Returns ``None`` when versions match (or nothing was
-    recorded), else the note to append to drift findings."""
+    regressed. Consumers: COST501/COST503 (cost baseline), LNE606
+    (lane manifest, ``--update-manifest``), and ABS705 (range
+    manifest, ``--update-ranges`` — a toolchain move self-explains
+    "re-record with --update-ranges" instead of hard-failing). Returns
+    ``None`` when versions match (or nothing was recorded), else the
+    note to append to drift findings."""
     import jax
     if recorded is None or recorded == jax.__version__:
         return None

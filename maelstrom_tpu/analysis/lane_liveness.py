@@ -365,6 +365,33 @@ class _Analyzer:
                     out = np.where(vals[1] == 0, 0,
                                    np.fmod(vals[0], np.where(
                                        vals[1] == 0, 1, vals[1])))
+                elif name in ("and", "or", "xor"):
+                    import operator
+                    out = {"and": operator.and_, "or": operator.or_,
+                           "xor": operator.xor}[name](vals[0], vals[1])
+                elif name == "shift_left":
+                    out = np.left_shift(vals[0], vals[1])
+                elif name == "shift_right_logical":
+                    # logical shift: shift the unsigned reinterpretation
+                    u = vals[0].astype(np.uint64 if
+                                       vals[0].dtype.itemsize == 8
+                                       else np.uint32)
+                    out = np.right_shift(u, vals[1].astype(u.dtype)
+                                         ).astype(vals[0].dtype)
+                elif name == "shift_right_arithmetic":
+                    out = np.right_shift(vals[0], vals[1])
+                elif name == "not":
+                    out = np.invert(vals[0])
+                elif name == "pow":
+                    with np.errstate(over="ignore"):
+                        out = np.power(vals[0], vals[1])
+                elif name == "integer_pow":
+                    with np.errstate(over="ignore"):
+                        out = np.power(vals[0], eqn.params["y"])
+                elif name == "neg":
+                    out = -vals[0]
+                elif name == "clamp":
+                    out = np.clip(vals[1], vals[0], vals[2])
                 elif name == "reshape":
                     out = vals[0].reshape(eqn.params["new_sizes"])
                 elif name == "squeeze":

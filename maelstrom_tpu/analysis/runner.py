@@ -17,12 +17,12 @@ from .findings import (Baseline, DEFAULT_BASELINE, Finding, LintReport,
 
 ALL_PASSES = ("trace", "contract", "schema")
 
-# opt-in passes: the IR hazard audit, the cost gate, and the
-# lane-liveness slice trace (and, for JXP403, compile) every registered
-# model — tens of seconds, so they run only when named (`--ir` /
-# `--cost` / `--lanes` / `--pass ir`), never as part of the default
-# sweep
-EXTRA_PASSES = ("ir", "cost", "lanes")
+# opt-in passes: the IR hazard audit, the cost gate, the lane-liveness
+# slice, and the value-range abstract interpreter trace (and, for
+# JXP403, compile) every registered model — tens of seconds to minutes,
+# so they run only when named (`--ir` / `--cost` / `--lanes` /
+# `--ranges` / `--pass ir`), never as part of the default sweep
+EXTRA_PASSES = ("ir", "cost", "lanes", "ranges")
 
 
 def run_lint(repo_root: str = ".",
@@ -33,6 +33,9 @@ def run_lint(repo_root: str = ".",
              update_cost_baseline: bool = False,
              lane_manifest_path: Optional[str] = None,
              update_lane_manifest: bool = False,
+             range_manifest_path: Optional[str] = None,
+             update_range_manifest: bool = False,
+             ranges_horizon_log2: Optional[int] = None,
              ) -> LintReport:
     """Run the requested passes and fold in the baseline.
 
@@ -45,7 +48,10 @@ def run_lint(repo_root: str = ".",
     ``cost_baseline_path`` / ``update_cost_baseline`` parameterize the
     cost pass (analysis/cost_baseline.json by default);
     ``lane_manifest_path`` / ``update_lane_manifest`` the lanes pass
-    (analysis/lane_manifest.json).
+    (analysis/lane_manifest.json); ``range_manifest_path`` /
+    ``update_range_manifest`` / ``ranges_horizon_log2`` the ranges
+    pass (analysis/range_manifest.json; the horizon override is the
+    lint_gate canary's synthetic overflow budget).
     """
     repo_root = os.path.abspath(repo_root)
     findings: List[Finding] = []
@@ -88,6 +94,14 @@ def run_lint(repo_root: str = ".",
             manifest_path=lane_manifest_path,
             update_manifest=update_lane_manifest,
             trace_cache=trace_cache))
+    if "ranges" in effective:
+        from .absint import run_range_lint
+        findings.extend(run_range_lint(
+            repo_root,
+            manifest_path=range_manifest_path,
+            update_manifest=update_range_manifest,
+            trace_cache=trace_cache,
+            probe_log2=ranges_horizon_log2))
 
     baseline = (Baseline.load(baseline_path) if baseline_path
                 else Baseline())

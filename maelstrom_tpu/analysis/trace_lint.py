@@ -539,7 +539,10 @@ def default_trace_targets(repo_root: str) -> List[str]:
             "maelstrom_tpu/telemetry/stream.py",
             "maelstrom_tpu/checkers/triage.py",
             "maelstrom_tpu/campaign/*.py",
-            "maelstrom_tpu/faults/*.py"]
+            "maelstrom_tpu/faults/*.py",
+            # host-side analysis code, but its verdicts gate traced
+            # code — keep the analyzer itself lint-clean
+            "maelstrom_tpu/analysis/absint.py"]
     out = []
     for p in pats:
         out.extend(sorted(glob.glob(os.path.join(repo_root, p))))

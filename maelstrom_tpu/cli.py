@@ -1075,6 +1075,8 @@ def cmd_lint(args) -> int:
         passes.append("cost")
     if args.lanes or args.update_manifest:
         passes.append("lanes")
+    if args.ranges or args.update_ranges:
+        passes.append("ranges")
     baseline = None if args.no_baseline else (args.baseline
                                               or DEFAULT_BASELINE)
     report = run_lint(repo_root=args.root,
@@ -1084,7 +1086,10 @@ def cmd_lint(args) -> int:
                       cost_baseline_path=args.cost_baseline,
                       update_cost_baseline=args.update_baseline,
                       lane_manifest_path=args.lane_manifest,
-                      update_lane_manifest=args.update_manifest)
+                      update_lane_manifest=args.update_manifest,
+                      range_manifest_path=args.range_manifest,
+                      update_range_manifest=args.update_ranges,
+                      ranges_horizon_log2=args.ranges_horizon_log2)
     if args.json:
         print(json.dumps(report.to_json(), indent=2))
     else:
@@ -1273,7 +1278,7 @@ def main(argv=None) -> int:
                         help="machine-readable findings on stdout")
     p_lint.add_argument("--pass", dest="passes", action="append",
                         choices=["trace", "contract", "schema", "ir",
-                                 "cost", "lanes"],
+                                 "cost", "lanes", "ranges"],
                         help="run only the named pass(es); default "
                              "trace+contract+schema (ir/cost are "
                              "opt-in — they trace/compile every "
@@ -1317,6 +1322,29 @@ def main(argv=None) -> int:
                         help="lane-manifest file (default "
                              "maelstrom_tpu/analysis/lane_manifest"
                              ".json)")
+    p_lint.add_argument("--ranges", action="store_true",
+                        help="run the value-range pass (ABS7xx): "
+                             "interval abstract interpretation of "
+                             "every registered model x both carry "
+                             "layouts — int32 overflow proofs to the "
+                             "tick horizon, scatter write-write race "
+                             "detection, provable OOB indices — gated "
+                             "against analysis/range_manifest.json "
+                             "(doc/lint.md)")
+    p_lint.add_argument("--update-ranges", action="store_true",
+                        help="re-record analysis/range_manifest.json "
+                             "from the current tree (implies "
+                             "--ranges); commit the result with the PR "
+                             "that changes the proven ranges")
+    p_lint.add_argument("--range-manifest", default=None,
+                        help="range-manifest file (default "
+                             "maelstrom_tpu/analysis/range_manifest"
+                             ".json)")
+    p_lint.add_argument("--ranges-horizon-log2", type=int, default=None,
+                        help="override the largest probed horizon "
+                             "(log2; default 24) — the lint_gate "
+                             "canary probes 31 so every cumulative "
+                             "counter trips ABS701")
     p_lint.add_argument("--baseline", default=None,
                         help="baseline file (default "
                              "maelstrom_tpu/analysis/baseline.json)")

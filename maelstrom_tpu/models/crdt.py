@@ -231,6 +231,18 @@ class PNCounterModel(Model):
     gossip_prob = 0.5
     idempotent_fs = (F_READ,)
     allow_negative = True
+    # trust-boundary clamps (value-identical on every honest trace,
+    # and what lets the range analyzer prove the counter lanes bounded
+    # instead of widening them through the gossip max-merge feedback —
+    # the add/read/gossip vocabularies share body lanes, so the
+    # abstract lane range joins them):
+    # - add deltas are drawn in [-add_abs_max, add_abs_max]
+    #   (sample_op), so clamping the decoded delta changes nothing;
+    # - a read value is the N-way slab sum; |true value| <= add_abs_max
+    #   x total adds < 2^27 for any horizon/concurrency this runtime
+    #   permits, and capping it leaves the sum 4+ bits inside int32.
+    add_abs_max = 5
+    counter_abs_max = 1 << 27
     WIRE_TYPES = {"add": T_ADD, "read": T_READ}
 
     def __init__(self, n_nodes_hint: int = 5, topology: str = "total"):
@@ -260,8 +272,11 @@ class PNCounterModel(Model):
         mtype = msg[wire.TYPE]
         out = jnp.zeros((1, cfg.lanes), dtype=jnp.int32)
 
-        # add: bump own (plus, minus)
-        delta = msg[wire.BODY]
+        # add: bump own (plus, minus) — delta clamped to the declared
+        # op range (see the trust-boundary note on the class)
+        delta = jnp.clip(msg[wire.BODY],
+                         -self.add_abs_max if self.allow_negative else 0,
+                         self.add_abs_max)
         plus = jnp.maximum(delta, 0)
         minus = jnp.maximum(-delta, 0)
         added = row.at[node_idx].set(row[node_idx]
@@ -275,7 +290,8 @@ class PNCounterModel(Model):
                         jnp.where(mtype == T_GOSSIP, merged, row))
 
         is_req = (mtype == T_ADD) | (mtype == T_READ)
-        value = jnp.sum(row[:, 0]) - jnp.sum(row[:, 1])
+        value = jnp.clip(jnp.sum(row[:, 0]) - jnp.sum(row[:, 1]),
+                         -self.counter_abs_max, self.counter_abs_max)
         out = out.at[0, wire.VALID].set(jnp.where(is_req, 1, 0))
         out = out.at[0, wire.DEST].set(msg[wire.SRC])
         out = out.at[0, wire.TYPE].set(
@@ -294,8 +310,9 @@ class PNCounterModel(Model):
     def sample_op(self, key, uniq, cfg, params):
         k1, k2 = jax.random.split(key)
         is_add = jax.random.uniform(k1) < 0.5
-        lo = -5 if self.allow_negative else 0
-        delta = jax.random.randint(k2, (), lo, 6, dtype=jnp.int32)
+        lo = -self.add_abs_max if self.allow_negative else 0
+        delta = jax.random.randint(k2, (), lo, self.add_abs_max + 1,
+                                   dtype=jnp.int32)
         return jnp.where(
             is_add,
             jnp.array([F_ADD, 0, 0, 0], jnp.int32).at[1].set(delta),

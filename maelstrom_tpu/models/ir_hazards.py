@@ -201,3 +201,79 @@ LANE_FIXTURE_MODELS = {
     "dead-store": IrDeadStore,
     "lane-overread": IrLaneOverread,
 }
+
+
+# --- value-range fixtures (analysis/absint.py, ABS7xx) ---------------------
+#
+# The fifth fixture family: models that are clean by every TRC/CON/JXP/
+# COST/LNE measure but whose VALUE RANGES are hazardous — exactly what
+# the interval abstract interpreter exists to prove. Same convention:
+# never registered, findings carried as status="expected" in
+# analysis/baseline.json, each rule pinned by
+# tests/test_analysis_ranges.py in BOTH carry layouts.
+
+
+class IrCounterOverflow(EchoModel):
+    """RANGE FIXTURE (do not register): an unclamped per-tick counter
+    increment of 2048 — the leaf provably crosses int32 max at exactly
+    T = 2^31 / 2^11 = 2^20 ticks, i.e. just past the production
+    horizon's last tick (ABS701: the proof names the leaf and the
+    minimal overflowing T; the hand-style CON204 audit cannot see it
+    because the counter is not one of its known vocabulary)."""
+    name = "echo-ir-counter-overflow"
+
+    def tick(self, row, node_idx, t, key, cfg, params):
+        # 2^11 per tick: reaches 2^31 on tick 2^20 exactly
+        return row + 2048, jnp.zeros((self.tick_out, cfg.lanes),
+                                     dtype=jnp.int32)
+
+
+class IrScatterRace(EchoModel):
+    """RANGE FIXTURE (do not register): two of the three overwrite-
+    scatter update rows target the SAME index — a non-commutative
+    write-write race within one tick. XLA's scatter applies duplicate
+    overwrite updates in unspecified order, so which value wins is
+    backend- and schedule-dependent: the classic silent-nondeterminism
+    hazard on accelerator scatter units (ABS702). The constant index
+    rows make the aliasing *provable*, not merely unprovable-disjoint."""
+    name = "echo-ir-scatter-race"
+
+    def init_row(self, n_nodes, node_idx, key, params):
+        return jnp.zeros((4,), jnp.int32)
+
+    def handle(self, row, node_idx, msg, t, key, cfg, params):
+        seen, out = super().handle(row[0], node_idx, msg, t, key, cfg,
+                                   params)
+        # rows 0 and 1 both write slot 1 with different payloads
+        vals = jnp.stack([msg[wire.MSGID], msg[wire.MSGID] + 1, seen])
+        row = row.at[jnp.array([1, 1, 2])].set(vals)
+        return row.at[0].set(seen), out
+
+
+class IrOobGather(EchoModel):
+    """RANGE FIXTURE (do not register): a gather whose index range is
+    provably past the end of its table — ``8 + (t % 4)`` over an
+    8-entry pool, so every reachable index is out of bounds. The index
+    is traced, so nothing raises: under jit the gather silently clamps
+    to the last row and the model reads the WRONG data (ABS703 —
+    LNE604's column-exact check upgraded to full range reasoning; the
+    interval domain resolves ``t % 4`` to [0, 3] through the rem
+    transfer and proves ``[8, 11]`` never intersects ``[0, 7]``)."""
+    name = "echo-ir-oob-gather"
+
+    def tick(self, row, node_idx, t, key, cfg, params):
+        table = jnp.arange(8, dtype=jnp.int32)
+        ghost = jax.lax.dynamic_index_in_dim(
+            table, 8 + jax.lax.rem(t, jnp.int32(4)), axis=0,
+            keepdims=False)
+        return row + ghost * 0, jnp.zeros((self.tick_out, cfg.lanes),
+                                          dtype=jnp.int32)
+
+
+# audited by analysis/absint.py alongside the registered models;
+# intentionally NOT reachable from models.get_model
+RANGE_FIXTURE_MODELS = {
+    "counter-overflow": IrCounterOverflow,
+    "scatter-race": IrScatterRace,
+    "oob-gather": IrOobGather,
+}

@@ -235,7 +235,12 @@ def inbox_step(model, row, node_idx, msg, jitter, t, cfg):
 
     # --- VoteReply
     count_it = (role == z1) & cur_term & (is_vrep & b1_is_1)
-    votes = sel(count_it, votes | (z1 << src), votes)
+    # trust-boundary clamp (see the ae_len note below): a counted
+    # vote-reply's src is a server node in [0, n), so the clamp is a
+    # no-op on honest traffic — it keeps the bitmask shift provably
+    # in-range for the range analyzer (lax.clamp: one equation)
+    votes = sel(count_it,
+                votes | (z1 << iclip(src, z0, z0 + (n - 1))), votes)
     n_votes = _popcount(votes, n, z1)
     win = count_it & (n_votes > n // 2)
     role = sel(win, 2, role)
@@ -260,8 +265,18 @@ def inbox_step(model, row, node_idx, msg, jitter, t, cfg):
     # max(log_len, prev_idx+1) is just log_len — only a CONFLICTING
     # write truncates to prev_idx+1
     conflict = ae_write & ~same
-    ae_len = sel(conflict, prev_idx + z1, row.log_len)
-    match_ack = sel(accept, prev_idx + n_entries, z0)
+    # wire fields are untrusted input: cap the composed indices at
+    # the decode boundary so a corrupt/hostile prev_idx or entry count
+    # cannot push a match/commit index past the log. Value-identical on
+    # every honest trace (accept implies prev_idx <= log_len <= cap and
+    # fits when an entry rides along), and it is what lets the range
+    # analyzer (analysis/absint.py) prove the replication indices
+    # bounded instead of widening them through the pool feedback (the
+    # clamp is two-sided: the junk-slot arithmetic of unselected
+    # branches otherwise doubles the LOWER bound per tick through the
+    # prev_idx + n_entries lane sum).
+    ae_len = sel(conflict, ae_widx + z1, row.log_len)
+    match_ack = sel(accept, iclip(prev_idx + n_entries, z0, zcap), z0)
 
     # --- client request (append to own log as leader, else proxy)
     is_leader = role == 2
@@ -299,7 +314,11 @@ def inbox_step(model, row, node_idx, msg, jitter, t, cfg):
 
     # --- AppendEntriesReply bookkeeping (leader side)
     r_success = b1_is_1
-    r_match = b2
+    # same trust-boundary clamp: an honest reply's match index is the
+    # follower's log_len <= cap (see ae_len/match_ack note above) —
+    # without it the b2 lane's joined range feeds next_idx/match_idx
+    # and the own-slot seeding amplifies it through the peer-AE lanes
+    r_match = iclip(b2, z0, zcap)
     mine = is_arep & is_leader & cur_term
     nxt = tget(row.next_idx, src)
     nxt = sel(mine,

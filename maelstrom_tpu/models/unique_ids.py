@@ -1,7 +1,17 @@
-"""Vectorized unique-ids model: flake-style ids ``node_idx * 2^20 +
-counter`` — coordination-free uniqueness (the TPU face of the unique-ids
-workload; reference src/maelstrom/workload/unique_ids.clj and
-demo/clojure/flake_ids.clj)."""
+"""Vectorized unique-ids model: flake-style ids ``node_idx <<
+flake_counter_bits | counter`` — coordination-free uniqueness (the TPU
+face of the unique-ids workload; reference
+src/maelstrom/workload/unique_ids.clj and demo/clojure/flake_ids.clj).
+
+The id-space split is PROVEN, not hand-waved: the range analyzer
+(``maelstrom lint --ranges``, analysis/absint.py) bounds the per-node
+counter's reachable ceiling at the enforced 2^20-tick horizon (a node
+can handle up to ``inbox_k`` generates per tick, so the static bound
+is ``inbox_k * 2^20`` — the old 20-bit split was provably thinner than
+its hand analysis claimed and was widened here), and the checked-in
+``analysis/range_manifest.json`` records the proof. CON204 audits the
+declared split arithmetic; ABS701 re-proves it against the traced
+dataflow every gate run."""
 
 from __future__ import annotations
 
@@ -23,11 +33,16 @@ class UniqueIdsModel(Model):
     max_out = 1
     tick_out = 0
     idempotent_fs = ()
-    # declared id-space split audited by `maelstrom lint` (CON204): ids
-    # are node_idx << flake_counter_bits | counter, so uniqueness holds
-    # only while a node's counter stays below 2^20 — see the baselined
-    # justification in analysis/baseline.json
-    flake_counter_bits = 20
+    # declared id-space split audited by `maelstrom lint` (CON204 and,
+    # dataflow-proven, ABS701): ids are node_idx << flake_counter_bits
+    # | counter. 25 bits holds the range analyzer's proven counter
+    # ceiling (inbox_k * 2^20 < 2^24 under the audit config) with a
+    # full doubling of margin; node ids keep 6 bits (<= 63 nodes,
+    # int32-checked by CON204). The former 20-bit split was an
+    # accepted-debt waiver whose margin the analyzer proved thinner
+    # than the hand analysis claimed — widened and re-proven in
+    # analysis/range_manifest.json.
+    flake_counter_bits = 25
     # schema-conformance map (SCH305): registry RPC name -> wire TYPE
     WIRE_TYPES = {"generate": TYPE_GEN}
 
@@ -42,7 +57,8 @@ class UniqueIdsModel(Model):
         out = out.at[0, wire.DEST].set(msg[wire.SRC])
         out = out.at[0, wire.TYPE].set(TYPE_GEN_OK)
         out = out.at[0, wire.REPLYTO].set(msg[wire.MSGID])
-        out = out.at[0, wire.BODY].set(node_idx * (1 << 20) + row)
+        out = out.at[0, wire.BODY].set(
+            node_idx * (1 << self.flake_counter_bits) + row)
         return row, out
 
     def sample_op(self, key, uniq, cfg, params):

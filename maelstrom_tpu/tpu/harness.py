@@ -150,6 +150,25 @@ def make_sim_config(model: Model, opts: Dict[str, Any]) -> SimConfig:
             f"time_limit {o['time_limit']}s at {mpt} ms/tick needs "
             f"{n_ticks} ticks, past the 2^20-tick delivery horizon "
             f"(netsim age_rank encoding); raise --ms-per-tick")
+    # cross-check against the PROVEN per-model overflow-free bound from
+    # the range manifest (analysis/absint.py) instead of trusting the
+    # one global constant: a model whose counters provably overflow
+    # earlier is refused BY NAME at config time, not corrupted at tick
+    # 2^k. Models without a proven entry fall back to the global cap.
+    # The analysis's own audit configs opt out (range_horizon_check) —
+    # re-proving a bound must never be blocked by the stale bound it
+    # is about to replace.
+    from ..analysis.absint import proven_horizon_log2
+    cap_log2 = (proven_horizon_log2(getattr(model, "name", None))
+                if o.get("range_horizon_check", True) else None)
+    if cap_log2 is not None and n_ticks >= (1 << cap_log2):
+        raise ValueError(
+            f"time_limit {o['time_limit']}s at {mpt} ms/tick needs "
+            f"{n_ticks} ticks, past model {model.name!r}'s PROVEN "
+            f"overflow-free horizon 2^{cap_log2} "
+            f"(analysis/range_manifest.json); shorten the run, raise "
+            f"--ms-per-tick, or re-prove a wider bound with `maelstrom "
+            f"lint --ranges --update-ranges`")
     journal_instances = min(o["journal_instances"], o["n_instances"])
     # per-model wire format: the NETID journal-pairing lane rides only
     # when this run records journals (or the caller forces the wide

@@ -1039,7 +1039,7 @@ def _make_tick_fn_minor(model: Model, sim: SimConfig, params,
             # every tick just to sum one lane
             tel = _update_telemetry(
                 carry.telemetry, sim, t, events, invoked_prev,
-                jnp.sum(pool[:, wire.VALID, :], axis=0
+                jnp.sum(pool[:, wire.VALID, :] & 1, axis=0
                         ).astype(jnp.int32),
                 inbox, deltas, part_active, violated)
         new_carry = Carry(pool=pool, node_state=node_state,

@@ -57,6 +57,9 @@ def pytest_configure(config):
         "markers", "lanes: lane-liveness dataflow / manifest tests "
                    "(analysis/lane_liveness.py)")
     config.addinivalue_line(
+        "markers", "ranges: value-range abstract-interpreter / "
+                   "range-manifest tests (analysis/absint.py)")
+    config.addinivalue_line(
         "markers", "campaign: durable control-plane tests — "
                    "checkpoint/resume, run queue, trend store "
                    "(maelstrom_tpu/campaign/)")

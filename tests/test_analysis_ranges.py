@@ -1,0 +1,421 @@
+"""Value-range abstract-interpreter tests (analysis/absint.py).
+
+Pins the PR's acceptance bars: each planted range fixture trips its
+ABS7xx rule in BOTH carry layouts, real models prove overflow-free to
+(at least) the production horizon with the netsim scatter path
+certified race-free, the manifest round-trips / gates drift / is
+re-recordable, the scan widener terminates (and refuses to "prove" a
+super-linear recurrence), the combined gate reuses the shared
+trace_cache (no duplicate traces), and ``make_sim_config`` refuses a
+horizon above a model's proven bound BY NAME.
+"""
+
+import json
+import os
+
+import jax.numpy as jnp
+import pytest
+
+from maelstrom_tpu.analysis import absint, cost_model, run_lint
+from maelstrom_tpu.analysis.absint import (DEFAULT_RANGE_MANIFEST,
+                                           PRODUCTION_LOG2, RangeReport,
+                                           analyze_model,
+                                           compare_manifest,
+                                           findings_of_report,
+                                           load_range_manifest,
+                                           proven_horizon_log2,
+                                           run_range_lint,
+                                           save_range_manifest,
+                                           tick_range_stats)
+from maelstrom_tpu.analysis.findings import fingerprint_pass
+from maelstrom_tpu.models import get_model
+from maelstrom_tpu.models.echo import EchoModel
+from maelstrom_tpu.models.ir_hazards import (RANGE_FIXTURE_MODELS,
+                                             IrCounterOverflow,
+                                             IrOobGather, IrScatterRace)
+
+pytestmark = pytest.mark.ranges
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _rules(findings):
+    return {f.rule for f in findings}
+
+
+# --- the planted fixtures trip their rules ---------------------------------
+
+
+class TestFixturesTrip:
+    @pytest.mark.parametrize("layout", ["lead", "minor"])
+    def test_counter_overflow_trips_abs701(self, layout):
+        rep = analyze_model(IrCounterOverflow(), 2, layout)
+        fs = findings_of_report(IrCounterOverflow(), rep)
+        assert "ABS701" in _rules(fs)
+        # 2048/tick crosses int32 max just past the production horizon:
+        # proven safe only below 2^20, minimal overflowing T named
+        assert rep.max_safe_horizon_log2 == PRODUCTION_LOG2 - 1
+        assert rep.min_overflow_t is not None
+        assert 0 < (1 << 20) - rep.min_overflow_t <= 64
+        msg = next(f for f in fs if f.rule == "ABS701").message
+        assert str(rep.min_overflow_t) in msg
+        assert "leaf" in msg or "node_state" in msg
+
+    @pytest.mark.parametrize("layout", ["lead", "minor"])
+    def test_scatter_race_trips_abs702(self, layout):
+        rep = analyze_model(IrScatterRace(), 2, layout)
+        fs = findings_of_report(IrScatterRace(), rep)
+        assert "ABS702" in _rules(fs)
+        assert rep.race_status == "racing"
+        assert any("duplicates" in s["why"] for s in rep.race_sites)
+        # the race is the ONLY defect: the counter side still proves
+        assert rep.max_safe_horizon_log2 >= PRODUCTION_LOG2
+
+    @pytest.mark.parametrize("layout", ["lead", "minor"])
+    def test_oob_gather_trips_abs703(self, layout):
+        rep = analyze_model(IrOobGather(), 2, layout)
+        fs = findings_of_report(IrOobGather(), rep)
+        assert "ABS703" in _rules(fs)
+        site = rep.oob_sites[0]
+        # the interval domain resolves 8 + (t % 4) to a range starting
+        # at 8 — provably past the whole 8-entry table (the hi may
+        # over-approximate under the vmap plumbing's joins)
+        assert site["lo"] == 8 and site["hi"] >= 11
+        assert site["axis_size"] == 8
+        assert "ABS701" not in _rules(fs)   # orthogonal verdicts
+
+    def test_fixture_rules_are_disjoint(self):
+        """Each fixture trips exactly its own rule family."""
+        for kind, cls in RANGE_FIXTURE_MODELS.items():
+            rep = analyze_model(cls(), 2, "lead", label=kind)
+            rules = _rules(findings_of_report(cls(), rep))
+            want = {"counter-overflow": "ABS701",
+                    "scatter-race": "ABS702",
+                    "oob-gather": "ABS703"}[kind]
+            assert want in rules, (kind, rules)
+
+
+# --- widening semantics ----------------------------------------------------
+
+
+class _DoublingCounter(EchoModel):
+    """Inline (never-registered) super-linear recurrence: the affine
+    widener must refuse to 'prove' it and widen instead (ABS704)."""
+    name = "echo-test-doubling"
+
+    def tick(self, row, node_idx, t, key, cfg, params):
+        return row * 2 + 1, jnp.zeros((self.tick_out, cfg.lanes),
+                                      dtype=jnp.int32)
+
+
+class TestWidening:
+    def test_widening_terminates_on_scan_fixed_point(self):
+        """The tick-level fixed point terminates on a real model whose
+        tick carries inner scans (the non-fused kafka path has
+        recorded fusion-breaker loops) and yields a proof."""
+        rep = analyze_model(get_model("kafka", 1, "grid"), 1, "lead")
+        assert rep.proven
+        assert rep.max_safe_horizon_log2 >= PRODUCTION_LOG2
+
+    def test_super_linear_growth_is_not_proven(self):
+        """A doubling counter must come out unproven (ABS704) or as an
+        overflow at a tiny horizon — never as a clean proof."""
+        m = _DoublingCounter()
+        rep = analyze_model(m, 2, "lead")
+        fs = findings_of_report(m, rep)
+        assert (not rep.proven) or \
+            rep.max_safe_horizon_log2 < PRODUCTION_LOG2
+        assert {"ABS701", "ABS704"} & _rules(fs)
+
+    def test_real_models_prove_clean_with_headroom(self):
+        """The acceptance bar, on the tier-1 budget slice: echo and
+        lin-kv (the raft family representative) prove overflow-free at
+        the production horizon in both layouts, race-free, with
+        nonzero counter headroom; the netsim deliver/enqueue composed-
+        gather path carries zero scatter sites."""
+        for wl, n in (("echo", 2), ("lin-kv", 5)):
+            model = get_model(wl, n, "grid")
+            for layout in ("lead", "minor"):
+                rep = analyze_model(model, n, layout)
+                assert rep.proven, (wl, layout, rep.notes,
+                                    rep.unproven_leaves)
+                assert rep.max_safe_horizon_log2 >= PRODUCTION_LOG2, \
+                    (wl, layout, rep.overflow_sites)
+                assert rep.race_status == "race-free"
+                assert rep.ovf_margin_bits >= 1
+                # the netsim certification: the composed-gather deliver
+                # path carries NO scatter, and enqueue's only scatter
+                # is the single-row deadline-column stitch — proven
+                # race-free with everything else above
+                assert rep.scatter_census.get("deliver", 0) == 0
+                assert rep.scatter_census.get("enqueue", 0) <= 1
+                fs = findings_of_report(model, rep)
+                assert not [f for f in fs if f.severity == "error"], \
+                    [f.message for f in fs]
+
+    def test_flake_split_is_proven(self):
+        """The retired ROADMAP waiver: unique-ids' id-space split is a
+        PROVEN bound now — the counter's reachable ceiling fits the
+        declared field with margin (the old 20-bit split did NOT; the
+        analyzer found the margin thinner than the hand analysis
+        claimed, and the split was widened)."""
+        m = get_model("unique-ids", 3, "grid")
+        rep = analyze_model(m, 3, "lead")
+        assert rep.flake is not None
+        assert rep.flake["fits"] is True
+        assert rep.flake["bits"] == m.flake_counter_bits
+        # the proof would have REJECTED the old hand-waved split
+        assert rep.flake["proven_counter_max"] > (1 << 20)
+        assert rep.flake["proven_counter_max"] < (1 << rep.flake["bits"])
+
+
+# --- manifest gate ---------------------------------------------------------
+
+
+def _report(label="echo/n=2/lead", **kw):
+    rep = RangeReport(label=label, probe_log2=24, proven=True,
+                      max_safe_horizon_log2=21)
+    rep.counters = {".stats.sent": 4}
+    for k, v in kw.items():
+        setattr(rep, k, v)
+    return rep
+
+
+class TestManifestGate:
+    def test_roundtrip_and_entry_contract(self, tmp_path):
+        path = str(tmp_path / "ranges.json")
+        rep = _report()
+        save_range_manifest({"echo/n=2/lead": rep.to_entry()}, path)
+        man = load_range_manifest(path)
+        e = man["entries"]["echo/n=2/lead"]
+        assert e["proven"] is True
+        assert e["max_safe_horizon_log2"] == 21
+        assert e["scatter_race"] == "race-free"
+        assert e["netsim_scatters"] == 0
+        assert e["counters"] == {".stats.sent": 4}
+        import jax
+        assert man["jax-version"] == jax.__version__
+        fs = compare_manifest({"echo/n=2/lead": rep}, man,
+                              {"echo/n=2/lead": ("p.py", "E")})
+        assert fs == []
+
+    def test_drift_is_an_error_same_toolchain(self):
+        import jax
+        rep = _report()
+        man = {"jax-version": jax.__version__,
+               "entries": {"echo/n=2/lead": {
+                   **rep.to_entry(), "max_safe_horizon_log2": 24}}}
+        fs = compare_manifest({"echo/n=2/lead": rep}, man,
+                              {"echo/n=2/lead": ("p.py", "E")})
+        assert [f.rule for f in fs] == ["ABS705"]
+        assert fs[0].severity == "error"
+
+    def test_drift_downgrades_under_toolchain_skew(self):
+        rep = _report()
+        man = {"jax-version": "0.0.0-not-this-one",
+               "entries": {"echo/n=2/lead": {
+                   **rep.to_entry(), "ovf_margin_bits": 30}}}
+        fs = compare_manifest({"echo/n=2/lead": rep}, man,
+                              {"echo/n=2/lead": ("p.py", "E")})
+        assert [f.rule for f in fs] == ["ABS705"]
+        assert fs[0].severity == "warning"
+        assert "--update-ranges" in fs[0].message
+
+    def test_missing_and_stale_entries(self):
+        import jax
+        rep = _report()
+        man = {"jax-version": jax.__version__,
+               "entries": {"gone/n=9/lead": _report().to_entry()}}
+        fs = compare_manifest({"echo/n=2/lead": rep}, man,
+                              {"echo/n=2/lead": ("p.py", "E")})
+        assert {f.rule for f in fs} == {"ABS706", "ABS707"}
+
+    def test_errored_keys_are_not_stale(self):
+        import jax
+        man = {"jax-version": jax.__version__,
+               "entries": {"broken/n=2/lead": _report().to_entry()}}
+        fs = compare_manifest({}, man, {}, errored={"broken/n=2/lead"})
+        assert fs == []
+
+    def test_update_records_and_regates_clean(self, tmp_path):
+        path = str(tmp_path / "ranges.json")
+        fs = run_range_lint(workloads=[("echo", 2)],
+                            manifest_path=path, update_manifest=True)
+        assert "ABS700" in _rules(fs)
+        assert not [f for f in fs if f.severity == "error"]
+        fs2 = run_range_lint(workloads=[("echo", 2)],
+                             manifest_path=path)
+        assert not [f for f in fs2 if f.severity == "error"], \
+            [f.message for f in fs2]
+
+    def test_tampered_manifest_trips_abs705(self, tmp_path):
+        path = str(tmp_path / "ranges.json")
+        run_range_lint(workloads=[("echo", 2)], manifest_path=path,
+                       update_manifest=True)
+        man = json.load(open(path))
+        key = sorted(man["entries"])[0]
+        man["entries"][key]["ovf_margin_bits"] += 7
+        json.dump(man, open(path, "w"))
+        fs = run_range_lint(workloads=[("echo", 2)],
+                            manifest_path=path)
+        errs = [f for f in fs if f.rule == "ABS705"]
+        assert errs and errs[0].severity == "error"
+
+    def test_checked_in_manifest_covers_registry(self):
+        """Every registered model x layout has a PROVEN entry at (or
+        above) the production horizon in the checked-in manifest —
+        the acceptance criterion, read off the committed artifact."""
+        man = load_range_manifest(DEFAULT_RANGE_MANIFEST)
+        keys = {cost_model.entry_key(wl, n, lay)
+                for wl, n in cost_model.cost_specs()
+                for lay in ("lead", "minor")}
+        missing = keys - set(man["entries"])
+        assert not missing, sorted(missing)
+        for k in sorted(keys):
+            e = man["entries"][k]
+            assert e["proven"] is True, k
+            assert e["max_safe_horizon_log2"] >= PRODUCTION_LOG2, \
+                (k, e["max_safe_horizon_log2"])
+            assert e["scatter_race"] == "race-free", k
+            # ABS702's netsim certification: the composed-gather
+            # deliver path carries no scatter; enqueue's single-row
+            # deadline stitch is the only netsim scatter site
+            assert e["netsim_scatters"] <= 1, k
+            assert e["ovf_margin_bits"] >= 1, k
+
+    def test_synthetic_horizon_trips_abs701(self):
+        """The lint_gate canary's synthetic overflow budget: probing
+        at 2^31 makes every cumulative fleet counter trip ABS701."""
+        fs = run_range_lint(workloads=[("echo", 2)],
+                            layouts=("lead",), probe_log2=31)
+        assert any(f.rule == "ABS701" and f.severity == "error"
+                   for f in fs)
+
+
+# --- baseline scoping + pass plumbing --------------------------------------
+
+
+class TestPassPlumbing:
+    def test_abs_fingerprints_map_to_ranges_pass(self):
+        assert fingerprint_pass("ABS701:x:y") == "ranges"
+
+    def test_trace_cache_is_shared(self):
+        """The combined --ir --cost --lanes --ranges gate must trace
+        each model x layout ONCE: a restricted multi-pass run through
+        the shared cache ends with exactly one trace per entry and the
+        ranges pass sees cache hits, not fresh traces."""
+        from maelstrom_tpu.analysis.ir_lint import run_ir_lint
+        from maelstrom_tpu.analysis.lane_liveness import run_lane_lint
+        cache: dict = {}
+        calls = []
+        orig = cost_model.trace_tick
+
+        def counting(model, sim, params=None, cache=None):
+            key = cost_model.entry_key(
+                getattr(model, "name", "?"), sim.net.n_nodes,
+                sim.layout)
+            hit = cache is not None and key in cache
+            calls.append((key, hit))
+            return orig(model, sim, params, cache)
+
+        cost_model.trace_tick = counting
+        try:
+            run_ir_lint(workloads=[("echo", 2)], trace_cache=cache,
+                        donation=False, include_fixtures=False)
+            run_lane_lint(workloads=[("echo", 2)], trace_cache=cache,
+                          include_fixtures=False)
+            run_range_lint(workloads=[("echo", 2)], trace_cache=cache,
+                           include_fixtures=False)
+        finally:
+            cost_model.trace_tick = orig
+        per_key: dict = {}
+        for key, hit in calls:
+            per_key.setdefault(key, []).append(hit)
+        for key, hits in per_key.items():
+            assert hits[0] is False and all(hits[1:]), (key, hits)
+        # the ranges pass (3rd) saw only cache hits
+        assert all(hit for key, hit in calls[-2:]), calls
+
+    def test_bench_stats_surface(self):
+        sim = cost_model.audit_sim(get_model("echo", 2, "grid"), 2,
+                                   "lead")
+        st = cost_model.tick_range_stats(get_model("echo", 2, "grid"),
+                                         sim)
+        assert st["ovf_margin_bits"] >= 1
+
+
+# --- make_sim_config cross-check -------------------------------------------
+
+
+class TestHorizonRefusal:
+    def test_refuses_above_proven_bound_by_name(self, tmp_path,
+                                                monkeypatch):
+        """A model whose manifest proves a bound BELOW the global 2^20
+        cap is refused above it, and the refusal names the model and
+        the re-prove command."""
+        from maelstrom_tpu.tpu.harness import make_sim_config
+        path = str(tmp_path / "ranges.json")
+        rep = _report(label="echo/n=2/lead")
+        rep.max_safe_horizon_log2 = 12
+        save_range_manifest({"echo/n=2/lead": rep.to_entry()}, path)
+        monkeypatch.setattr(absint, "DEFAULT_RANGE_MANIFEST", path)
+        absint._MANIFEST_CACHE.clear()
+        model = get_model("echo", 2, "grid")
+        with pytest.raises(ValueError) as ei:
+            make_sim_config(model, dict(node_count=2,
+                                        time_limit=5.0,
+                                        ms_per_tick=1.0))
+        msg = str(ei.value)
+        assert "'echo'" in msg and "2^12" in msg
+        assert "--update-ranges" in msg
+        # below the proven bound the same config family is accepted
+        sim = make_sim_config(model, dict(node_count=2,
+                                          time_limit=3.0,
+                                          ms_per_tick=1.0))
+        assert sim.n_ticks == 3000
+        absint._MANIFEST_CACHE.clear()
+
+    def test_unproven_entry_does_not_cap(self, tmp_path, monkeypatch):
+        from maelstrom_tpu.tpu.harness import make_sim_config
+        path = str(tmp_path / "ranges.json")
+        rep = _report(label="echo/n=2/lead")
+        rep.max_safe_horizon_log2 = 3
+        rep.proven = False
+        save_range_manifest({"echo/n=2/lead": rep.to_entry()}, path)
+        monkeypatch.setattr(absint, "DEFAULT_RANGE_MANIFEST", path)
+        absint._MANIFEST_CACHE.clear()
+        sim = make_sim_config(get_model("echo", 2, "grid"),
+                              dict(node_count=2, time_limit=5.0,
+                                   ms_per_tick=1.0))
+        assert sim.n_ticks == 5000     # only the global cap applies
+        absint._MANIFEST_CACHE.clear()
+
+    def test_proven_horizon_reads_min_across_layouts(self, tmp_path):
+        path = str(tmp_path / "ranges.json")
+        a = _report(label="echo/n=2/lead")
+        b = _report(label="echo/n=2/minor")
+        b.max_safe_horizon_log2 = 20
+        save_range_manifest({"echo/n=2/lead": a.to_entry(),
+                             "echo/n=2/minor": b.to_entry()}, path)
+        assert proven_horizon_log2("echo", path) == 20
+        assert proven_horizon_log2("not-a-model", path) is None
+
+
+# --- the repo-wide gate ----------------------------------------------------
+
+
+@pytest.mark.slow
+class TestRepoGate:
+    def test_repo_wide_ranges_gate_is_green(self):
+        """The full `--ranges` sweep (every registered model x both
+        layouts + the range fixtures) is clean modulo the expected-
+        status fixture entries in analysis/baseline.json."""
+        report = run_lint(repo_root=REPO, passes=("ranges",),
+                          baseline_path=os.path.join(
+                              REPO, "maelstrom_tpu", "analysis",
+                              "baseline.json"))
+        assert report.errors() == [], [f.to_dict()
+                                       for f in report.errors()]
+        expected = {f.rule for f, e in report.suppressed
+                    if e.status == "expected"}
+        assert {"ABS701", "ABS702", "ABS703"} <= expected

@@ -20,8 +20,8 @@ SMOKE_STORE="$(mktemp -d)"
 trap 'rm -rf "$SMOKE_STORE"' EXIT
 
 echo
-echo "== maelstrom lint --ir --cost --lanes --strict (IR hazards + cost budget + lane liveness)"
-python -m maelstrom_tpu lint --ir --cost --lanes --strict
+echo "== maelstrom lint --ir --cost --lanes --ranges --strict (IR hazards + cost budget + lane liveness + value ranges)"
+python -m maelstrom_tpu lint --ir --cost --lanes --ranges --strict
 
 echo
 echo "== cost/budget-regression canary (tampered baseline must fail)"
@@ -102,6 +102,39 @@ trap 'rm -rf "$SMOKE_STORE"' EXIT   # source restored — plain cleanup
 grep -Eq 'ERROR LNE606' "$SMOKE_STORE/lanes-canary.out"
 grep -Eq 'ERROR LNE610' "$SMOKE_STORE/lanes-canary.out"
 echo "canary caught: $(grep -Ec 'ERROR LNE606' "$SMOKE_STORE/lanes-canary.out") LNE606 + $(grep -Ec 'ERROR LNE610' "$SMOKE_STORE/lanes-canary.out") LNE610 finding(s)"
+
+echo
+echo "== range canary (tampered manifest must fail; synthetic horizon must overflow)"
+# Simulate (a) a PR that silently weakens a proven bound — claim one
+# checked-in entry has 7 more headroom bits than the live proof finds —
+# and (b) a synthetic overflow budget: probe one model at a 2^31-tick
+# horizon, where every cumulative fleet counter provably crosses int32.
+# The combined gate must exit 1 with ABS705 for (a); (b) must surface
+# ABS701 with the offending leaf and the minimal overflowing T.
+python - "$SMOKE_STORE/ranges_tampered.json" <<'PY2'
+import json, sys
+man = json.load(open("maelstrom_tpu/analysis/range_manifest.json"))
+key = sorted(man["entries"])[0]
+man["entries"][key]["ovf_margin_bits"] += 7
+json.dump(man, open(sys.argv[1], "w"))
+print(f"tampered entry: {key} (inflated the recorded headroom)")
+PY2
+rc=0
+python -m maelstrom_tpu lint --ranges --strict \
+    --range-manifest "$SMOKE_STORE/ranges_tampered.json" \
+    > "$SMOKE_STORE/ranges-canary.out" || rc=$?
+[[ "$rc" == "1" ]] || { echo "expected exit 1 (range drift caught), got $rc"; exit 1; }
+grep -Eq 'ERROR ABS705' "$SMOKE_STORE/ranges-canary.out"
+echo "canary caught: $(grep -Ec 'ERROR ABS705' "$SMOKE_STORE/ranges-canary.out") ABS705 finding(s)"
+python - <<'PY2'
+from maelstrom_tpu.analysis.absint import run_range_lint
+fs = run_range_lint(workloads=[("echo", 2)], layouts=("lead",),
+                    probe_log2=31)
+hits = [f for f in fs if f.rule == "ABS701" and f.severity == "error"]
+assert hits, "synthetic 2^31 horizon tripped no ABS701"
+print(f"synthetic horizon: {len(hits)} ABS701 finding(s), e.g. "
+      f"{hits[0].message[:110]}")
+PY2
 
 echo
 echo "== raft-family fusion budgets hold (fused ticks pin 0 loops)"
