@@ -199,6 +199,22 @@ def child_main(canary: bool = False) -> None:
     # wide-vs-narrow throughput is one env var apart — the native
     # engine's knob of the same name re-instantiates at W_TXN
     bench_wide = os.environ.get("BENCH_WIDE") == "1"
+    # fault-fuzz A/B (maelstrom_tpu/faults/fuzz.py): the bench rides an
+    # ALL-HEALTHY distribution by default — every lane configured at
+    # rate 0, so the per-instance schedule draw + per-tick plane select
+    # are fully in the graph while the trajectory stays bit-identical
+    # to the bare run. BENCH_FUZZ=0 drops it, so the metric-line delta
+    # prices the schedule-RNG lane (acceptance: within the
+    # telemetry-style noise bar; tests/test_fault_fuzz.py re-measures)
+    bench_fuzz = os.environ.get("BENCH_FUZZ") != "0"
+    # links + skew only: a crash lane would also ride the snapshot
+    # slab, whose cost PR 9 prices separately — this A/B isolates the
+    # schedule draw + per-tick per-instance plane select
+    BENCH_FUZZ_DIST = {
+        "windows": [2, 4], "gap": [40, 200], "duration": [20, 100],
+        "links": {"rate": 0.0, "edges": [1, 2]},
+        "skew": {"rate": 0.0, "victims": [1, 1]},
+    }
 
     def _latency_ticks(c):
         """Fleet ticks-to-ack quantiles off the live carry (same
@@ -229,6 +245,8 @@ def child_main(canary: bool = False) -> None:
                     p_loss=0.05, recovery_time=0.3, seed=7,
                     telemetry=bench_telemetry,
                     **({"netid": True} if bench_wide else {}),
+                    **({"fault_fuzz": BENCH_FUZZ_DIST}
+                       if bench_fuzz else {}),
                     **net_knobs)
         sim = make_sim_config(model, opts)
         params = model.make_params(sim.net.n_nodes)
@@ -468,6 +486,11 @@ def child_main(canary: bool = False) -> None:
                 "msg_lanes": sim.net.lanes,
                 "bytes_per_msg_row": 4 * sim.net.lanes,
                 "wide": bench_wide,
+                # schedule-RNG lane A/B (BENCH_FUZZ=0 drops it): the
+                # all-healthy distribution keeps trajectories identical
+                "fault_fuzz": bench_fuzz,
+                **({"fuzz_phases": 2 * sim.faults.fuzz.windows_max}
+                   if bench_fuzz and sim.faults.has_fuzz else {}),
             }
             if ir_eqns is not None:
                 rec["ir_eqns"] = ir_eqns

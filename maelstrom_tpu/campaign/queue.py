@@ -108,11 +108,20 @@ def submit_campaign(spec: Dict[str, Any], store_root: str) -> str:
                        "n-items": len(items),
                        "submitted": time.time()})
     for i, opts in enumerate(items):
+        # scheduling-policy keys (`retries`/`backoff-s`, dash or
+        # underscore) are queue metadata, not run opts: lift them off
+        # the opts dict onto the item record so a FAILED (not invalid)
+        # item re-queues up to N times with exponential backoff
+        opts = dict(opts)
+        retries = opts.pop("retries", 0)
+        backoff = opts.pop("backoff_s", opts.pop("backoff-s", 30.0))
         write_json_atomic(
             item_path(cdir, i),
             {"id": i, "workload": opts["workload"], "opts": opts,
-             "status": PENDING, "attempts": 0, "run-dir": None,
-             "updated": time.time()})
+             "status": PENDING, "attempts": 0, "failures": 0,
+             "retries": int(retries or 0),
+             "backoff-s": float(backoff or 0.0),
+             "run-dir": None, "updated": time.time()})
     return cdir
 
 
@@ -268,18 +277,37 @@ def _steal_stale_lock(lock_path: str) -> bool:
     return True
 
 
+def next_retry_eta(cdir: str) -> Optional[float]:
+    """Earliest ``not-before`` among claimable items still inside a
+    retry backoff window (None when no item is waiting on one) — the
+    worker loop's cue to wait instead of declaring the queue drained."""
+    eta: Optional[float] = None
+    now = time.time()
+    for item in list_items(cdir):
+        nb = item.get("not-before")
+        if item.get("status") in CLAIMABLE and nb is not None \
+                and float(nb) > now:
+            eta = float(nb) if eta is None else min(eta, float(nb))
+    return eta
+
+
 def claim_next(cdir: str,
                worker: Optional[str] = None) -> Optional[Claim]:
     """Claim the lowest-id claimable item, or ``None`` when the queue
     is drained. A ``running`` item whose lock is stale (its worker
     died) is first flipped to ``preempted`` — its next claimer resumes
-    it from its checkpoint."""
+    it from its checkpoint. Items inside a retry backoff window
+    (``not-before`` in the future) are skipped until it elapses."""
     worker = worker or _worker_id()
     for item in list_items(cdir):
         path = item.get("_path")
         status = item.get("status")
         if not path or status in (DONE, FAILED, "unreadable"):
             continue
+        nb = item.get("not-before")
+        if status in CLAIMABLE and nb is not None \
+                and float(nb) > time.time():
+            continue     # retry backoff still running
         lock = path[:-len(".json")] + ".lock"
         if status == RUNNING:
             # a running item with a dead owner is preempted work

@@ -77,6 +77,9 @@ def campaign_status(cdir: str) -> Dict[str, Any]:
             "workload": item.get("workload"),
             "status": status,
             "attempts": item.get("attempts", 0),
+            "failures": item.get("failures", 0),
+            "retries": item.get("retries", 0),
+            "not-before": item.get("not-before"),
             "seed": (item.get("opts") or {}).get("seed"),
             "valid?": item.get("valid?"),
             "run-dir": item.get("run-dir"),
@@ -106,10 +109,18 @@ def render_status(status: Dict[str, Any]) -> str:
             progress += f"  resumes {live['resumes']}"
         verdict = ("" if r.get("valid?") is None
                    else f"  valid? {r['valid?']}")
+        retrying = ""
+        if r.get("failures"):
+            retrying = (f"  failures {r['failures']}/"
+                        f"{r.get('retries', 0)}")
+            nb = r.get("not-before")
+            if nb is not None and float(nb) > time.time():
+                retrying += (f" (retry in "
+                             f"{float(nb) - time.time():.0f}s)")
         lines.append(
             f"  item {r['id']:>3}  {r['workload']:<18} "
             f"{r['status']:<9} attempts {r['attempts']}"
-            f"{verdict}{progress}")
+            f"{retrying}{verdict}{progress}")
     return "\n".join(lines)
 
 
@@ -155,6 +166,7 @@ def campaign_report(cdir: str, static_cost: bool = True,
             "seed": opts.get("seed"),
             "status": item.get("status"),
             "attempts": item.get("attempts", 0),
+            "failures": item.get("failures", 0),
             "valid?": item.get("valid?"),
             "violating-instances": item.get("violating-instances"),
             "msgs-per-sec": item.get("msgs-per-sec"),

@@ -145,10 +145,26 @@ def _run_item(claim: q.Claim, store_root: str,
             results = run_tpu_test(model, opts)
             resumed = False
     except Exception as e:
-        return q.finish_item(
-            claim, q.FAILED, error=repr(e)[:500],
-            traceback=traceback.format_exc()[-2000:],
-            **{"wall-s": round(time.monotonic() - t0, 2)})
+        # retries-with-backoff (spec `retries`/`backoff-s` keys): a
+        # FAILED item — crash, OOM, lost device; NOT an invalid verdict
+        # — re-queues up to N times, each wait doubling, with the
+        # backoff history recorded on the item JSON
+        failures = int(item.get("failures", 0)) + 1
+        retries = int(item.get("retries", 0) or 0)
+        fields = {"error": repr(e)[:500],
+                  "traceback": traceback.format_exc()[-2000:],
+                  "failures": failures,
+                  "wall-s": round(time.monotonic() - t0, 2)}
+        if failures <= retries:
+            backoff = float(item.get("backoff-s", 30.0) or 0.0) \
+                * (2 ** (failures - 1))
+            history = list(item.get("backoff-history") or [])
+            history.append(round(backoff, 2))
+            return q.finish_item(
+                claim, q.PENDING,
+                **{**fields, "not-before": time.time() + backoff,
+                   "backoff-history": history})
+        return q.finish_item(claim, q.FAILED, **fields)
     run_dir = results.get("store-dir")
     if triage_invalid and results.get("valid?") is False and run_dir:
         try:
@@ -156,9 +172,15 @@ def _run_item(claim: q.Claim, store_root: str,
             triage_run(run_dir)
         except Exception:
             pass   # forensics are best-effort; the verdict stands
+    # a retried item that now succeeded must not keep the failed
+    # attempt's residue — a done item showing an error string (or a
+    # stale backoff window) would mislead campaign status/report
+    cleared = {k: None for k in ("error", "traceback", "not-before")
+               if item.get(k) is not None}
     return q.finish_item(
         claim, q.DONE,
-        **{"run-dir": run_dir,
+        **{**cleared,
+           "run-dir": run_dir,
            "valid?": results.get("valid?"),
            "violating-instances": results.get("invariants", {})
            .get("violating-instances"),
@@ -187,10 +209,21 @@ def run_campaign(cdir: str, store_root: Optional[str] = None,
     while max_items is None or len(ran) < max_items:
         claim = q.claim_next(cdir)
         if claim is None:
-            break
+            # nothing claimable NOW — but an item sitting in a retry
+            # backoff window is still this worker's job: wait it out
+            # instead of declaring the queue drained
+            eta = q.next_retry_eta(cdir)
+            if eta is None:
+                break
+            wait = max(0.0, eta - time.time())
+            log(f"   (queue idle: next retry in {wait:.1f}s)")
+            time.sleep(min(wait + 0.05, 5.0))
+            continue
         item = claim.item
         log(f"== item {item['id']}: {item['workload']} "
             f"(attempt {item['attempts']}"
+            + (f", {item['failures']} failure(s) so far"
+               if item.get("failures") else "")
             + (", resuming" if item.get("run-dir") else "") + ")")
         with LeaseKeeper(claim.lock):
             done = _run_item(claim, store_root, dict(overrides or {}),
@@ -198,12 +231,16 @@ def run_campaign(cdir: str, store_root: Optional[str] = None,
         verdict = done.get("valid?")
         log(f"   -> {done['status']}"
             + (f", valid? {verdict}" if done["status"] == q.DONE else
-               f": {done.get('error')}"))
+               f": {done.get('error')}")
+            + (f" (retrying in {done['backoff-history'][-1]}s, "
+               f"failure {done['failures']}/{done.get('retries')})"
+               if done["status"] == q.PENDING else ""))
         ran.append(done)
     return {
         "ran": len(ran),
         "done": sum(1 for r in ran if r["status"] == q.DONE),
         "failed": sum(1 for r in ran if r["status"] == q.FAILED),
+        "retried": sum(1 for r in ran if r["status"] == q.PENDING),
         "invalid": sum(1 for r in ran
                        if r["status"] == q.DONE
                        and r.get("valid?") is not True),

@@ -24,9 +24,19 @@ explicit ``items`` entries append verbatim (over defaults). Any
 ``run_tpu_test`` opt is a valid key — ``workload`` (required) plus
 ``node_count``/``topology``/``key_count``/``crash_clients``/
 ``txn_dirty_apply`` select the model, and ``fault_plan`` (an inline
-plan dict, doc/guide/10-faults.md) or fault ``nemesis`` kinds put a
+plan dict, doc/guide/10-faults.md), ``fault_fuzz`` (an inline fault
+DISTRIBUTION — per-instance randomized schedules,
+``maelstrom_tpu/faults/fuzz.py``) or fault ``nemesis`` kinds put a
 whole fault campaign — crash-restart, link degradation, clock skew —
 in the queue like any other sweep axis.
+
+Two keys are queue scheduling policy rather than run opts:
+``retries`` (int, default 0) and ``backoff_s``/``backoff-s`` (float,
+default 30) — a FAILED (crashed, not invalid) item re-queues up to
+``retries`` times with exponential backoff recorded on the item JSON
+(``failures``/``not-before``/``backoff-history``), and ``campaign
+status``/``report`` show the attempt counts. ``submit`` lifts them off
+the opts dict onto the item record (campaign/queue.py).
 """
 
 from __future__ import annotations

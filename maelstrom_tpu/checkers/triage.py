@@ -198,6 +198,12 @@ def triage_run(run_dir: str, ids: Optional[List[int]] = None,
                 "journal_instances": K}
     ms_per_tick = float(sub_opts.get("ms_per_tick", 1) or 1)
     sim = make_sim_config(model, sub_opts)
+    # fault-fuzz runs: each flagged instance's RANDOMIZED schedule is a
+    # pure function of (seed, instance id) — reconstruct it into the
+    # bundle as a deterministic plan (`maelstrom shrink` minimizes it).
+    # sim.faults IS the original run's compiled config: sub_opts only
+    # changes instance/record counts, which the fault compile ignores.
+    fuzz_fx = sim.faults if info["opts"].get("fault_fuzz") else None
     if info["ticks"] and info["ticks"] < sim.n_ticks:
         # a fail-fast/killed run dispatched only a prefix; replay
         # exactly those ticks (trajectories are prefix-stable)
@@ -271,6 +277,17 @@ def triage_run(run_dir: str, ids: Optional[List[int]] = None,
             "command": (f"python -m maelstrom_tpu triage "
                         f"{info['run-dir']} --instance {gid}"),
         }
+        if fuzz_fx is not None:
+            from ..faults.fuzz import reconstruct_plan
+            plan = reconstruct_plan(fuzz_fx, sim.net.n_nodes,
+                                    info["seed"], gid)
+            with open(os.path.join(inst_dir, "schedule.json"),
+                      "w") as f:
+                json.dump(plan, f, indent=2)
+            repro["fault-schedule"] = plan
+            repro["shrink-command"] = (
+                f"python -m maelstrom_tpu shrink {info['run-dir']} "
+                f"--instance {gid}")
         with open(os.path.join(inst_dir, "repro.json"), "w") as f:
             json.dump(repro, f, indent=2, default=repr)
         summary["triaged"].append(entry)

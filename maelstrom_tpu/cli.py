@@ -20,6 +20,9 @@ option specs :136-229):
   into a terminal report (doc/observability.md live-runs section)
 - ``triage`` — replay a run's flagged instances bit-exactly and emit
   per-instance forensics bundles (spacetime SVG + EDN journal + repro)
+- ``shrink`` — minimize a fault-fuzz run's failing randomized
+  schedules into small still-failing deterministic plans
+  (faults/shrink.py; doc/guide/10-faults.md)
 - ``campaign`` — the durable control plane: ``submit`` a sweep matrix
   as a resumable work queue, ``run`` drains it with periodic carry
   checkpoints, ``status``/``watch --campaign`` follow it live,
@@ -103,6 +106,18 @@ def add_test_options(p: argparse.ArgumentParser):
                         "lanes; doc/guide/10-faults.md). Mutually "
                         "exclusive with the generated fault --nemesis "
                         "kinds; composes with --nemesis partition")
+    p.add_argument("--fault-fuzz", default=None,
+                   help="TPU runtime: JSON fault DISTRIBUTION file — "
+                        "per-instance RANDOMIZED crash/link/skew "
+                        "schedules drawn on device from the schedule-"
+                        "RNG lane, a different scenario per instance "
+                        "(maelstrom_tpu/faults/fuzz.py; doc/guide/"
+                        "10-faults.md). Flagged instances replay "
+                        "bit-exactly from the seed and `maelstrom "
+                        "shrink` minimizes them. Mutually exclusive "
+                        "with --fault-plan and the generated fault "
+                        "--nemesis kinds; composes with --nemesis "
+                        "partition")
     p.add_argument("--fault-snapshot-every", type=_positive_int,
                    default=None,
                    help="TPU runtime: ticks between crash-recovery "
@@ -255,8 +270,10 @@ def cmd_test(args) -> int:
     concurrency = parse_concurrency(args.concurrency, node_count)
     from .faults import FAULT_KINDS
     fault_kinds = [k for k in args.nemesis if k in FAULT_KINDS]
-    if args.runtime != "tpu" and (fault_kinds or args.fault_plan):
-        print("error: the fault-plan engine (--fault-plan and the "
+    if args.runtime != "tpu" and (fault_kinds or args.fault_plan
+                                  or args.fault_fuzz):
+        print("error: the fault-plan engine (--fault-plan, "
+              "--fault-fuzz and the "
               f"{'/'.join(FAULT_KINDS)} nemesis kinds) is "
               "device-resident — --runtime tpu only; the host runtimes "
               "speak --nemesis partition (doc/guide/10-faults.md)",
@@ -279,6 +296,27 @@ def cmd_test(args) -> int:
             return 2
         try:
             validate_fault_plan(fault_plan, node_count)
+        except SpecError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+    fault_fuzz = None
+    if args.fault_fuzz:
+        if args.fault_plan or fault_kinds:
+            print("error: --fault-fuzz (per-instance randomized "
+                  "schedules) is mutually exclusive with --fault-plan "
+                  "and the generated fault --nemesis kinds",
+                  file=sys.stderr)
+            return 2
+        from .faults import SpecError, validate_fault_fuzz
+        try:
+            with open(args.fault_fuzz) as f:
+                fault_fuzz = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"error: --fault-fuzz {args.fault_fuzz}: {e}",
+                  file=sys.stderr)
+            return 2
+        try:
+            validate_fault_fuzz(fault_fuzz, node_count)
         except SpecError as e:
             print(f"error: {e}", file=sys.stderr)
             return 2
@@ -415,6 +453,7 @@ def cmd_test(args) -> int:
         tpu_opts = dict(
             nemesis_schedule=schedule,
             fault_plan=fault_plan,
+            fault_fuzz=fault_fuzz,
             fault_snapshot_every=args.fault_snapshot_every,
             crash_clients=args.crash_clients,
             topology=args.topology,
@@ -978,6 +1017,32 @@ def cmd_triage(args) -> int:
     return 0
 
 
+def cmd_shrink(args) -> int:
+    """Minimize a fuzz run's failing schedules (faults/shrink.py):
+    reconstruct each flagged instance's randomized schedule from the
+    seed, replay it bit-exactly as a deterministic plan through the
+    pipelined executor, delta-debug it to a minimal still-failing
+    nemesis, and write triage/instance-<id>/shrunk-plan.json."""
+    from .faults.shrink import (ShrinkError, render_shrink_report,
+                                shrink_run)
+
+    try:
+        summary = shrink_run(
+            os.path.realpath(args.path),
+            ids=args.instance or None,
+            max_instances=args.max_instances,
+            max_attempts=args.max_attempts)
+    except ShrinkError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    print(render_shrink_report(summary))
+    if summary.get("errors"):
+        return 1
+    if not summary.get("shrunk") and not summary.get("note"):
+        return 1
+    return 0
+
+
 def cmd_campaign(args) -> int:
     """The durable campaign control plane (doc/guide/09-campaigns.md):
     submit a sweep matrix as a work queue, drain it from any number of
@@ -1205,6 +1270,28 @@ def main(argv=None) -> int:
                           help="Lamport SVG event cap; beyond it the "
                                "diagram is annotated '+N elided'")
 
+    p_shrink = sub.add_parser(
+        "shrink", help="minimize a fault-fuzz run's failing schedules: "
+                       "rebuild each flagged instance's randomized "
+                       "schedule from the seed, delta-debug it to a "
+                       "minimal still-failing deterministic plan "
+                       "(triage/instance-<id>/shrunk-plan.json)")
+    p_shrink.add_argument("path",
+                          help="a store run dir of a --fault-fuzz run "
+                               "with flagged instances")
+    p_shrink.add_argument("--instance", type=int, action="append",
+                          default=[],
+                          help="shrink this instance id (repeatable; "
+                               "default: the run's flagged instances)")
+    p_shrink.add_argument("--max-instances", type=_positive_int,
+                          default=4,
+                          help="cap on instances to shrink (default 4)")
+    p_shrink.add_argument("--max-attempts", type=_positive_int,
+                          default=24,
+                          help="replay budget per instance — each "
+                               "candidate reduction recompiles the "
+                               "single-instance tick (default 24)")
+
     p_camp = sub.add_parser(
         "campaign", help="durable sweep campaigns: submit a work-queue "
                          "matrix, drain/resume it across process "
@@ -1360,7 +1447,7 @@ def main(argv=None) -> int:
                 "doc": cmd_doc, "check": cmd_check,
                 "export": cmd_export, "lint": cmd_lint,
                 "fleet-stats": cmd_fleet_stats, "watch": cmd_watch,
-                "triage": cmd_triage,
+                "triage": cmd_triage, "shrink": cmd_shrink,
                 "campaign": cmd_campaign}[args.command](args)
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)

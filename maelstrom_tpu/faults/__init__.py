@@ -33,6 +33,14 @@ a fault-free run in both carry layouts (``tests/test_faults.py``), and
 every lane is proven by a planted-bug model whose anomaly the existing
 checker/triage pipeline catches (``models/raft_buggy.py``:
 ``RaftForgetsSnapshot``, ``RaftFixedTimeout``).
+
+Beyond the one deterministic fleet-shared plan, the **fuzzer**
+(:mod:`.fuzz`) samples a fault DISTRIBUTION into a DIFFERENT
+randomized schedule per instance, drawn on device from the dedicated
+schedule-RNG lane and riding the carry — and :mod:`.shrink`
+delta-debugs any failing drawn schedule back into a minimal
+deterministic plan (``maelstrom shrink``), keeping the plan dialect
+the single repro currency.
 """
 
 from .engine import (FaultConfig, FaultPlanes, NO_PLANES,  # noqa: F401
@@ -40,3 +48,5 @@ from .engine import (FaultConfig, FaultPlanes, NO_PLANES,  # noqa: F401
                      wipe_crashed)
 from .spec import (FAULT_KINDS, SpecError, compile_fault_plan,  # noqa: F401
                    generate_fault_plan, validate_fault_plan)
+from .fuzz import (FuzzConfig, compile_fault_fuzz,  # noqa: F401
+                   validate_fault_fuzz)

@@ -60,6 +60,12 @@ class FaultConfig(NamedTuple):
     - ``crash[p]``   — tuple of crashed server-node ids
     - ``links[p]``   — tuples ``(dst, src, block, delay, loss_pm)``
     - ``skew[p]``    — tuples ``(node, rate64)``
+
+    ``fuzz`` (a :class:`~.fuzz.FuzzConfig`, or ``None``) switches the
+    config from ONE deterministic fleet-shared plan to per-instance
+    RANDOMIZED schedules drawn on device (``faults/fuzz.py``): the
+    phase tuples stay empty then, and lane presence comes from the
+    distribution instead. Mutually exclusive with a phase timeline.
     """
     enabled: bool = False
     stop_tick: int = 1 << 30
@@ -68,21 +74,34 @@ class FaultConfig(NamedTuple):
     crash: Tuple[Tuple[int, ...], ...] = ()
     links: Tuple[Tuple[Tuple[int, int, int, int, int], ...], ...] = ()
     skew: Tuple[Tuple[Tuple[int, int], ...], ...] = ()
+    fuzz: Optional[Any] = None    # FuzzConfig (hashable NamedTuple)
 
     # lane presence is a STATIC property: a lane is "present" when any
-    # phase lists entries for it (even value-neutral ones), and only
-    # present lanes add anything to the traced graph — a default
-    # FaultConfig() compiles the exact pre-fault tick.
+    # phase lists entries for it (even value-neutral ones) — or, under
+    # a fuzz distribution, when the lane is configured at all (even at
+    # rate 0: the all-healthy probe keeps the machinery in the graph).
+    # Only present lanes add anything to the traced tick — a default
+    # FaultConfig() compiles the exact pre-fault graph.
+    @property
+    def has_fuzz(self) -> bool:
+        return self.fuzz is not None and self.fuzz.enabled
+
     @property
     def has_crash(self) -> bool:
+        if self.has_fuzz:
+            return self.fuzz.has_crash
         return self.enabled and any(len(p) for p in self.crash)
 
     @property
     def has_links(self) -> bool:
+        if self.has_fuzz:
+            return self.fuzz.has_links
         return self.enabled and any(len(p) for p in self.links)
 
     @property
     def has_skew(self) -> bool:
+        if self.has_fuzz:
+            return self.fuzz.has_skew
         return self.enabled and any(len(p) for p in self.skew)
 
     @property
@@ -143,7 +162,12 @@ def _planes_np(fx: FaultConfig, n_nodes: int, n_clients: int):
 def tick_planes(fx: FaultConfig, cfg, t) -> FaultPlanes:
     """Select tick ``t``'s planes (traced; constants baked per phase).
     ``cfg`` is the NetConfig (static). Ticks at/after ``stop_tick``
-    read the all-healthy row — the final heal window."""
+    read the all-healthy row — the final heal window. Fuzz configs
+    have no shared timeline — the runtime routes them through
+    ``fuzz.schedule_planes`` per instance instead."""
+    if fx.has_fuzz:
+        raise ValueError("tick_planes on a fuzz config: per-instance "
+                         "planes come from fuzz.schedule_planes")
     if not fx.active:
         return NO_PLANES
     import jax.numpy as jnp
@@ -295,6 +319,12 @@ def plan_summary(fx: FaultConfig) -> Dict[str, Any]:
     lanes = [name for name, on in (("crash-restart", fx.has_crash),
                                    ("link-degradation", fx.has_links),
                                    ("clock-skew", fx.has_skew)) if on]
-    return {"phases": len(fx.untils), "lanes": lanes,
-            "snapshot-every": fx.snapshot_every,
-            "stop-tick": int(fx.stop_tick)}
+    out: Dict[str, Any] = {"phases": len(fx.untils), "lanes": lanes,
+                           "snapshot-every": fx.snapshot_every,
+                           "stop-tick": int(fx.stop_tick)}
+    if fx.has_fuzz:
+        # per-instance randomized schedules: no shared phase timeline;
+        # the distribution block + fleet coverage counters label the run
+        from .fuzz import fuzz_summary
+        out["fuzz"] = fuzz_summary(fx)
+    return out

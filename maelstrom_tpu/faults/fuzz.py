@@ -1,0 +1,610 @@
+"""Randomized per-instance fault schedules: the device-resident fuzzer.
+
+PR 9's fault-plan engine runs ONE deterministic, fleet-shared schedule
+per sweep — every instance sees the same crashes at the same ticks, so
+a 100k-instance fleet explores exactly one point of the fault space per
+run. This module turns the same silicon into a fault-space search
+engine: a declarative **fault distribution** (CLI ``--fault-fuzz
+file.json``, campaign ``fault_fuzz`` key) compiles to a static
+:class:`FuzzConfig`, and at ``init_carry`` time each instance draws its
+OWN schedule on device from the dedicated schedule-RNG purpose
+(``runtime._RNG_FAULTS`` = :data:`RNG_PURPOSE`, instance-stable like
+``_RNG_RESTART``) — so 100k instances each run a DIFFERENT randomized
+crash/link/skew schedule inside one ``lax.scan``, in both carry
+layouts and through the sharded driver.
+
+Distribution format (ranges are inclusive ``[lo, hi]``; scalars read as
+``lo == hi``):
+
+.. code-block:: json
+
+    {"windows": [1, 3],
+     "gap": [50, 200],
+     "duration": [30, 120],
+     "crash": {"rate": 0.8, "victims": [1, 2]},
+     "links": {"rate": 0.5, "edges": [1, 4], "block": 0.3,
+               "delay": [0, 40], "loss": [0.0, 0.4]},
+     "skew":  {"rate": 0.3, "victims": [1, 2], "range": [0.5, 2.0]},
+     "snapshot_every": 1}
+
+- ``windows`` — fault-window count per schedule; each window is a
+  healthy ``gap`` followed by a ``duration``-tick fault phase (the
+  heal/fault alternation of the deterministic generator, with every
+  width drawn per instance).
+- per-lane blocks — ``rate`` is the per-window activation probability;
+  ``victims``/``edges`` the victim-count range (distinct nodes via an
+  on-device permutation; directed non-self edges for links); ``delay``/
+  ``loss``/``block``/``range`` the per-victim quality draws.
+
+The drawn :class:`FaultSchedule` is a small int32/bool pytree that
+RIDES THE CARRY (``Carry.fault_sched``) so checkpoint/resume and triage
+replay stay bit-exact, and each tick selects its planes with the same
+``searchsorted(t)`` move the deterministic engine uses
+(:func:`schedule_planes`). Every draw is integer-only
+(``randint``/permutation — no float thresholds), so a schedule is a
+bit-stable pure function of ``(seed, instance_id)`` across backends:
+:func:`reconstruct_schedule` re-draws any instance's schedule host-side
+and :func:`schedule_to_plan` lowers it to a deterministic ``--fault-
+plan`` dict whose compiled planes are value-identical — the foundation
+of ``maelstrom shrink`` (``faults/shrink.py``).
+
+All-healthy draws (every rate roll failing, or a ``rate: 0``
+distribution) produce value-neutral planes — zero delay/loss, rate-64
+clocks, no crashes — which PR 9 proved bit-identical to the fault-free
+tick, so fuzzed fleets pay only the schedule-select overhead on clean
+instances (``BENCH_FUZZ=0`` A/B in bench.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from .engine import NEUTRAL_RATE, FaultConfig, FaultPlanes
+from .spec import (MAX_DELAY_TICKS, MAX_RATE, MIN_RATE, SpecError,
+                   _get)
+
+# the schedule-RNG purpose tag (tpu/runtime.py aliases this as
+# _RNG_FAULTS): schedule keys fold (master, RNG_PURPOSE, instance_id) —
+# instance-stable, tick-independent, so a schedule is reconstructable
+# from the seed alone
+RNG_PURPOSE = 6
+
+MAX_WINDOWS = 16          # schedule-size ceiling: 2*W untils must stay
+                          # far inside int32 tick arithmetic
+
+
+class LaneFuzz(NamedTuple):
+    """One lane's slice of the distribution (all-int, hashable).
+
+    ``victims_max == 0`` means the lane is NOT CONFIGURED (statically
+    absent from the traced graph, like an empty plan lane). A
+    configured lane with ``rate_pm == 0`` is present-but-neutral: the
+    machinery traces, every draw is healthy — the all-healthy
+    bit-identity probe."""
+    rate_pm: int = 0          # per-window activation probability (per-mille)
+    victims_min: int = 0      # victim count range (nodes, or directed
+    victims_max: int = 0      # edges for the links lane)
+    block_pm: int = 0         # links: P(edge blocked), per-mille
+    delay_min: int = 0        # links: extra latency ticks
+    delay_max: int = 0
+    loss_pm_min: int = 0      # links: per-mille loss
+    loss_pm_max: int = 0
+    rate64_min: int = NEUTRAL_RATE   # skew: clock rate in 64ths
+    rate64_max: int = NEUTRAL_RATE
+
+
+class FuzzConfig(NamedTuple):
+    """Compiled fault distribution (rides ``FaultConfig.fuzz``; plain
+    ints so ``SimConfig`` stays a static, hashable jit argument)."""
+    enabled: bool = False
+    windows_min: int = 0
+    windows_max: int = 0
+    gap_min: int = 0
+    gap_max: int = 0
+    dur_min: int = 0
+    dur_max: int = 0
+    crash: LaneFuzz = LaneFuzz()
+    links: LaneFuzz = LaneFuzz()
+    skew: LaneFuzz = LaneFuzz()
+
+    @property
+    def has_crash(self) -> bool:
+        return self.enabled and self.crash.victims_max > 0
+
+    @property
+    def has_links(self) -> bool:
+        return self.enabled and self.links.victims_max > 0
+
+    @property
+    def has_skew(self) -> bool:
+        return self.enabled and self.skew.victims_max > 0
+
+
+class FaultSchedule(NamedTuple):
+    """One instance's drawn schedule (int32/bool leaves, unbatched; the
+    runtime batches it over instances in the carry's own layout).
+
+    ``untils`` is the interleaved heal/fault timeline cumsum: phase
+    ``2w`` is window ``w``'s healthy gap, phase ``2w + 1`` the fault
+    window itself, so ``searchsorted(untils, t)`` lands in a window iff
+    the phase index is odd. Unconfigured lanes carry zero-size (links)
+    or all-neutral (crash/skew) planes that the static presence flags
+    keep out of the traced tick."""
+    untils: Any          # [2W] int32 — cumulative phase boundaries
+    crash: Any           # [W, N] bool — per-window crash masks
+    edge_dst: Any        # [W, E] int32 — directed-edge victims
+    edge_src: Any        # [W, E] int32
+    edge_block: Any      # [W, E] int32 0/1
+    edge_delay: Any      # [W, E] int32 extra ticks
+    edge_loss_pm: Any    # [W, E] int32 per-mille
+    skew: Any            # [W, N] int32 rate64 (NEUTRAL_RATE = healthy)
+
+
+def _err(msg: str) -> SpecError:
+    return SpecError(f"fault fuzz: {msg}")
+
+
+def _range(v, what: str, lo_bound, hi_bound, cast=int) -> Tuple:
+    """Parse an inclusive ``[lo, hi]`` range (scalar = degenerate)."""
+    if isinstance(v, (list, tuple)):
+        if len(v) != 2:
+            raise _err(f"{what} range must be [lo, hi], got {v!r}")
+        lo, hi = cast(v[0]), cast(v[1])
+    else:
+        try:
+            lo = hi = cast(v)
+        except (TypeError, ValueError):
+            raise _err(f"{what} {v!r} is not a number or [lo, hi]")
+    if lo > hi:
+        raise _err(f"{what} range [{lo}, {hi}] has lo > hi")
+    if lo < lo_bound or hi > hi_bound:
+        raise _err(f"{what} range [{lo}, {hi}] out of "
+                   f"[{lo_bound}, {hi_bound}]")
+    return lo, hi
+
+
+def _rate_pm(v, what: str) -> int:
+    p = float(v or 0.0)
+    if not 0.0 <= p <= 1.0:
+        raise _err(f"{what} rate {p} out of [0, 1]")
+    return int(round(p * 1000))
+
+
+def validate_fault_fuzz(dist: Dict[str, Any], n_nodes: int) -> None:
+    """Raise :class:`SpecError` on a malformed distribution (compile
+    calls this first; the CLI calls it directly for friendly errors)."""
+    if not isinstance(dist, dict):
+        raise _err(f"top level must be a dict, got "
+                   f"{type(dist).__name__}")
+    _range(_get(dist, "windows", 1), "windows", 1, MAX_WINDOWS)
+    _range(_get(dist, "gap", [0, 0]), "gap", 0, MAX_DELAY_TICKS)
+    _range(_get(dist, "duration", [1, 1]), "duration", 1,
+           MAX_DELAY_TICKS)
+    every = _get(dist, "snapshot_every", 1)
+    if every is not None and int(every) < 1:
+        raise _err(f"snapshot_every must be >= 1, got {every}")
+    lanes = 0
+    crash = _get(dist, "crash")
+    if crash is not None:
+        _rate_pm(_get(crash, "rate", 0.0), "crash")
+        _range(_get(crash, "victims", 1), "crash victims", 1, n_nodes)
+        lanes += 1
+    links = _get(dist, "links")
+    if links is not None:
+        if n_nodes < 2:
+            raise _err("links lane needs >= 2 server nodes")
+        _rate_pm(_get(links, "rate", 0.0), "links")
+        _range(_get(links, "edges", 1), "links edges", 1,
+               n_nodes * (n_nodes - 1))
+        _rate_pm(_get(links, "block", 0.0), "links block")
+        _range(_get(links, "delay", [0, 0]), "links delay", 0,
+               MAX_DELAY_TICKS)
+        _range(_get(links, "loss", [0.0, 0.0]), "links loss", 0.0, 1.0,
+               cast=float)
+        lanes += 1
+    skew = _get(dist, "skew")
+    if skew is not None:
+        _rate_pm(_get(skew, "rate", 0.0), "skew")
+        _range(_get(skew, "victims", 1), "skew victims", 1, n_nodes)
+        _range(_get(skew, "range", [1.0, 1.0]), "skew range", MIN_RATE,
+               MAX_RATE, cast=float)
+        lanes += 1
+    if lanes == 0:
+        raise _err("needs at least one lane block "
+                   "(crash / links / skew)")
+
+
+def compile_fault_fuzz(dist: Optional[Dict[str, Any]], n_nodes: int,
+                       stop_tick: int,
+                       snapshot_every: Optional[int] = None
+                       ) -> FaultConfig:
+    """Lower a distribution dict to the static :class:`FaultConfig`
+    carrying a :class:`FuzzConfig` (``dist=None`` compiles the disabled
+    config, exactly like ``compile_fault_plan(None, ...)``)."""
+    if not dist:
+        return FaultConfig()
+    validate_fault_fuzz(dist, n_nodes)
+    w_lo, w_hi = _range(_get(dist, "windows", 1), "windows", 1,
+                        MAX_WINDOWS)
+    g_lo, g_hi = _range(_get(dist, "gap", [0, 0]), "gap", 0,
+                        MAX_DELAY_TICKS)
+    d_lo, d_hi = _range(_get(dist, "duration", [1, 1]), "duration", 1,
+                        MAX_DELAY_TICKS)
+    crash = links = skew = LaneFuzz()
+    c = _get(dist, "crash")
+    if c is not None:
+        v_lo, v_hi = _range(_get(c, "victims", 1), "crash victims", 1,
+                            n_nodes)
+        crash = LaneFuzz(rate_pm=_rate_pm(_get(c, "rate", 0.0), "crash"),
+                         victims_min=v_lo, victims_max=v_hi)
+    e = _get(dist, "links")
+    if e is not None:
+        e_lo, e_hi = _range(_get(e, "edges", 1), "links edges", 1,
+                            n_nodes * (n_nodes - 1))
+        dl_lo, dl_hi = _range(_get(e, "delay", [0, 0]), "links delay",
+                              0, MAX_DELAY_TICKS)
+        lp_lo, lp_hi = _range(_get(e, "loss", [0.0, 0.0]), "links loss",
+                              0.0, 1.0, cast=float)
+        links = LaneFuzz(
+            rate_pm=_rate_pm(_get(e, "rate", 0.0), "links"),
+            victims_min=e_lo, victims_max=e_hi,
+            block_pm=_rate_pm(_get(e, "block", 0.0), "links block"),
+            delay_min=dl_lo, delay_max=dl_hi,
+            loss_pm_min=int(round(lp_lo * 1000)),
+            loss_pm_max=int(round(lp_hi * 1000)))
+    s = _get(dist, "skew")
+    if s is not None:
+        v_lo, v_hi = _range(_get(s, "victims", 1), "skew victims", 1,
+                            n_nodes)
+        r_lo, r_hi = _range(_get(s, "range", [1.0, 1.0]), "skew range",
+                            MIN_RATE, MAX_RATE, cast=float)
+        skew = LaneFuzz(
+            rate_pm=_rate_pm(_get(s, "rate", 0.0), "skew"),
+            victims_min=v_lo, victims_max=v_hi,
+            rate64_min=max(1, int(round(r_lo * NEUTRAL_RATE))),
+            rate64_max=max(1, int(round(r_hi * NEUTRAL_RATE))))
+    plan_every = _get(dist, "snapshot_every", 1)
+    every = int(snapshot_every if snapshot_every is not None
+                else (1 if plan_every is None else plan_every))
+    fz = FuzzConfig(enabled=True, windows_min=w_lo, windows_max=w_hi,
+                    gap_min=g_lo, gap_max=g_hi, dur_min=d_lo,
+                    dur_max=d_hi, crash=crash, links=links, skew=skew)
+    return FaultConfig(enabled=True, stop_tick=int(stop_tick),
+                       snapshot_every=every, fuzz=fz)
+
+
+# --- the on-device schedule draw -------------------------------------------
+
+
+def _fold_seq(key, n: int):
+    """``[n]`` subkeys via the runtime's batched fold_in idiom."""
+    import jax
+    import jax.numpy as jnp
+    return jax.vmap(lambda i: jax.random.fold_in(key, i))(
+        jnp.arange(n, dtype=jnp.int32))
+
+
+def draw_schedule(key, fx: FaultConfig, n_nodes: int) -> FaultSchedule:
+    """Draw ONE instance's schedule (traced; integer draws only, so the
+    result is a backend-stable pure function of ``key``). Each
+    component folds its own subkey, so adding a lane to the
+    distribution never perturbs another lane's draws."""
+    import jax
+    import jax.numpy as jnp
+
+    fz = fx.fuzz
+    N = n_nodes
+    W = fz.windows_max
+    E = fz.links.victims_max
+    k_win, k_crash, k_links, k_skew = (jax.random.fold_in(key, i)
+                                       for i in (1, 2, 3, 4))
+
+    n_w = jax.random.randint(jax.random.fold_in(k_win, 0), (),
+                             fz.windows_min, fz.windows_max + 1)
+    gaps = jax.random.randint(jax.random.fold_in(k_win, 1), (W,),
+                              fz.gap_min, fz.gap_max + 1)
+    durs = jax.random.randint(jax.random.fold_in(k_win, 2), (W,),
+                              fz.dur_min, fz.dur_max + 1)
+    untils = jnp.cumsum(
+        jnp.stack([gaps, durs], axis=1).reshape(-1)).astype(jnp.int32)
+    w_live = jnp.arange(W) < n_w      # windows past the drawn count
+    #                                   exist but carry no faults
+
+    def roll(k, pm: int):
+        # integer bernoulli: no float threshold, bit-stable everywhere
+        return jax.random.randint(k, (), 0, 1000) < pm
+
+    if fz.has_crash:
+        def one_crash(kw):
+            act = roll(jax.random.fold_in(kw, 0), fz.crash.rate_pm)
+            nv = jax.random.randint(jax.random.fold_in(kw, 1), (),
+                                    fz.crash.victims_min,
+                                    fz.crash.victims_max + 1)
+            perm = jax.random.permutation(jax.random.fold_in(kw, 2), N)
+            mask = jnp.zeros((N,), bool).at[perm].set(
+                jnp.arange(N) < nv)
+            return mask & act
+        crash = jax.vmap(one_crash)(_fold_seq(k_crash, W)) \
+            & w_live[:, None]
+    else:
+        crash = jnp.zeros((W, N), bool)
+
+    if fz.has_links:
+        lf = fz.links
+
+        def one_links(kw):
+            act = roll(jax.random.fold_in(kw, 0), lf.rate_pm)
+            ne = jax.random.randint(jax.random.fold_in(kw, 1), (),
+                                    lf.victims_min, lf.victims_max + 1)
+            live_e = (jnp.arange(E) < ne) & act
+            dst = jax.random.randint(jax.random.fold_in(kw, 2), (E,),
+                                     0, N)
+            srcr = jax.random.randint(jax.random.fold_in(kw, 3), (E,),
+                                      0, N - 1)
+            src = srcr + (srcr >= dst)      # directed, never self
+            blk = jax.random.randint(jax.random.fold_in(kw, 4), (E,),
+                                     0, 1000) < lf.block_pm
+            dly = jax.random.randint(jax.random.fold_in(kw, 5), (E,),
+                                     lf.delay_min, lf.delay_max + 1)
+            pm = jax.random.randint(jax.random.fold_in(kw, 6), (E,),
+                                    lf.loss_pm_min, lf.loss_pm_max + 1)
+            z = live_e.astype(jnp.int32)
+            return (dst.astype(jnp.int32), src.astype(jnp.int32),
+                    blk.astype(jnp.int32) * z, dly * z, pm * z)
+        e_dst, e_src, e_blk, e_dly, e_pm = jax.vmap(one_links)(
+            _fold_seq(k_links, W))
+        zw = w_live[:, None].astype(jnp.int32)
+        e_blk, e_dly, e_pm = e_blk * zw, e_dly * zw, e_pm * zw
+    else:
+        z = jnp.zeros((W, 0), jnp.int32)
+        e_dst = e_src = e_blk = e_dly = e_pm = z
+
+    if fz.has_skew:
+        sf = fz.skew
+
+        def one_skew(kw):
+            act = roll(jax.random.fold_in(kw, 0), sf.rate_pm)
+            nv = jax.random.randint(jax.random.fold_in(kw, 1), (),
+                                    sf.victims_min, sf.victims_max + 1)
+            perm = jax.random.permutation(jax.random.fold_in(kw, 2), N)
+            victim = jnp.zeros((N,), bool).at[perm].set(
+                jnp.arange(N) < nv)
+            rate = jax.random.randint(jax.random.fold_in(kw, 3), (N,),
+                                      sf.rate64_min, sf.rate64_max + 1)
+            return jnp.where(victim & act, rate, NEUTRAL_RATE
+                             ).astype(jnp.int32)
+        skew = jax.vmap(one_skew)(_fold_seq(k_skew, W))
+        skew = jnp.where(w_live[:, None], skew, NEUTRAL_RATE)
+    else:
+        skew = jnp.full((W, N), NEUTRAL_RATE, jnp.int32)
+
+    return FaultSchedule(untils=untils, crash=crash, edge_dst=e_dst,
+                         edge_src=e_src, edge_block=e_blk,
+                         edge_delay=e_dly, edge_loss_pm=e_pm, skew=skew)
+
+
+def schedule_planes(sched: FaultSchedule, fx: FaultConfig, cfg,
+                    t) -> FaultPlanes:
+    """Select tick ``t``'s planes from ONE instance's drawn schedule
+    (traced; the runtime vmaps this over instances in both layouts —
+    the per-instance analog of ``engine.tick_planes``). Plane merge
+    semantics match ``engine._planes_np`` exactly — crashed receivers
+    block whole rows, duplicate edges max-merge — so a schedule
+    replayed as a deterministic plan selects value-identical planes."""
+    import jax.numpy as jnp
+
+    fz = fx.fuzz
+    N = cfg.n_nodes
+    NT = cfg.n_total
+    W = fz.windows_max
+    phase = jnp.searchsorted(sched.untils, t, side="right")
+    in_window = (phase % 2 == 1) & (phase < 2 * W) & (t < fx.stop_tick)
+    w = jnp.clip(phase // 2, 0, W - 1)
+    out = {}
+    if fz.has_crash:
+        out["crash"] = sched.crash[w] & in_window
+    link_blocks = fz.has_links and fz.links.block_pm > 0
+    if fz.has_crash or link_blocks:
+        block = jnp.zeros((NT, NT), jnp.int32)
+        if link_blocks:
+            blk = sched.edge_block[w] * in_window.astype(jnp.int32)
+            block = block.at[sched.edge_dst[w], sched.edge_src[w]].max(
+                blk)
+        block = block == 1
+        if fz.has_crash:
+            # a dead process hears nobody — servers AND clients
+            crash_nt = jnp.zeros((NT,), bool).at[:N].set(out["crash"])
+            block = block | crash_nt[:, None]
+        out["block"] = block
+    if fz.has_links:
+        act = in_window.astype(jnp.int32)
+        dst, src = sched.edge_dst[w], sched.edge_src[w]
+        out["delay"] = jnp.zeros((NT, NT), jnp.int32).at[dst, src].max(
+            sched.edge_delay[w] * act)
+        out["loss_pm"] = jnp.zeros((NT, NT), jnp.int32).at[
+            dst, src].max(sched.edge_loss_pm[w] * act)
+    if fz.has_skew:
+        rate = jnp.where(in_window, sched.skew[w], NEUTRAL_RATE)
+        out["t_nodes"] = (t * rate) // NEUTRAL_RATE
+    return FaultPlanes(**out)
+
+
+# --- host-side reconstruction (the seed -> schedule -> plan path) ----------
+
+
+def reconstruct_schedule(fx: FaultConfig, n_nodes: int, seed: int,
+                         instance_id: int) -> FaultSchedule:
+    """Re-draw one instance's schedule host-side: the identical key
+    chain ``init_carry`` uses — ``fold_in(fold_in(PRNGKey(seed),
+    RNG_PURPOSE), instance_id)`` — through the identical traced draw,
+    fetched to numpy. Integer draws make this bit-stable across
+    backends, so a fuzz hit on a TPU fleet reconstructs exactly on a
+    CPU triage box."""
+    import jax
+
+    key = jax.random.fold_in(
+        jax.random.fold_in(jax.random.PRNGKey(int(seed)), RNG_PURPOSE),
+        int(instance_id))
+    sched = jax.jit(draw_schedule, static_argnums=(1, 2))(key, fx,
+                                                          n_nodes)
+    return FaultSchedule(*[np.asarray(x) for x in sched])
+
+
+def schedule_to_plan(sched: FaultSchedule, fx: FaultConfig
+                     ) -> Dict[str, Any]:
+    """Lower a drawn schedule to a deterministic ``--fault-plan`` dict
+    whose compiled planes are value-identical at every tick: windows
+    with no drawn content merge into the healthy timeline (searchsorted
+    skips them on device too), windows entirely past the final-heal
+    ``stop_tick`` are dropped (healed on both paths), and all
+    quantities roundtrip exactly (integer ticks, per-mille loss,
+    64th-quantized skew)."""
+    fz = fx.fuzz
+    W = fz.windows_max
+    untils = np.asarray(sched.untils).reshape(-1)
+    phases: List[Dict[str, Any]] = []
+    prev = 0
+    for w in range(W):
+        gap_end = int(untils[2 * w])
+        win_end = int(untils[2 * w + 1])
+        if gap_end >= int(fx.stop_tick) or win_end <= gap_end:
+            continue
+        ph: Dict[str, Any] = {}
+        victims = np.nonzero(np.asarray(sched.crash[w]))[0]
+        if victims.size:
+            ph["crash"] = [int(v) for v in victims]
+        edges = []
+        for e in range(np.asarray(sched.edge_dst).shape[1]):
+            blk = int(sched.edge_block[w][e])
+            dly = int(sched.edge_delay[w][e])
+            pm = int(sched.edge_loss_pm[w][e])
+            if not (blk or dly or pm):
+                continue      # value-neutral edge: a no-op on device
+            edges.append({"dst": int(sched.edge_dst[w][e]),
+                          "src": int(sched.edge_src[w][e]),
+                          "block": bool(blk), "delay": dly,
+                          "loss": pm / 1000.0})
+        if edges:
+            ph["links"] = edges
+        skew = {str(n): int(r) / NEUTRAL_RATE
+                for n, r in enumerate(np.asarray(sched.skew[w]))
+                if int(r) != NEUTRAL_RATE}
+        if skew:
+            ph["skew"] = skew
+        if not ph:
+            continue          # contentless window: pure healthy time
+        if gap_end > prev:
+            phases.append({"until": gap_end})
+        phases.append({"until": win_end, **ph})
+        prev = win_end
+    if not phases:
+        return {}             # an all-healthy draw IS the empty plan
+    return {"snapshot_every": int(fx.snapshot_every), "phases": phases}
+
+
+def reconstruct_plan(fx: FaultConfig, n_nodes: int, seed: int,
+                     instance_id: int) -> Dict[str, Any]:
+    """seed + instance id -> the instance's concrete schedule as a
+    deterministic plan dict (``{}`` when the draw was all-healthy)."""
+    return schedule_to_plan(
+        reconstruct_schedule(fx, n_nodes, seed, instance_id), fx)
+
+
+def plan_weight(plan: Dict[str, Any]) -> Tuple[int, int]:
+    """(fault phases, total victims) of a plan dict — the shrinker's
+    minimality metric and the acceptance bar's 'strictly fewer'."""
+    if not plan:
+        return 0, 0
+    n_phases = 0
+    victims = 0
+    for ph in plan.get("phases", ()):
+        c = len(ph.get("crash") or [])
+        e = len(ph.get("links") or [])
+        s = len(ph.get("skew") or {})
+        if c or e or s:
+            n_phases += 1
+            victims += c + e + s
+    return n_phases, victims
+
+
+# --- fleet summaries (heartbeat fault-fuzz lane) ---------------------------
+
+
+def fleet_windows(fx: FaultConfig, n_nodes: int, seed: int,
+                  instance_ids) -> Dict[str, np.ndarray]:
+    """Host-side view of the whole fleet's drawn windows: ``starts``/
+    ``ends`` ``[I, W]`` (ends clipped to the final-heal ``stop_tick``)
+    plus per-lane activity masks. One vmapped re-draw per run — the
+    schedules are a pure function of the seed, so the heartbeat's
+    fault-fuzz lane costs no mid-run device traffic."""
+    import jax
+
+    key = jax.random.fold_in(jax.random.PRNGKey(int(seed)), RNG_PURPOSE)
+    ids = np.asarray(instance_ids, np.int32)
+
+    def draw_one(i):
+        return draw_schedule(jax.random.fold_in(key, i), fx, n_nodes)
+
+    sched = jax.jit(jax.vmap(draw_one))(ids)
+    untils = np.asarray(sched.untils)
+    starts = untils[:, 0::2]
+    ends = np.minimum(untils[:, 1::2], int(fx.stop_tick))
+    crash = np.asarray(sched.crash).any(axis=-1)
+    links = ((np.asarray(sched.edge_block)
+              + np.asarray(sched.edge_delay)
+              + np.asarray(sched.edge_loss_pm)) > 0).any(axis=-1) \
+        if np.asarray(sched.edge_dst).shape[-1] else \
+        np.zeros(starts.shape, bool)
+    skew = (np.asarray(sched.skew) != NEUTRAL_RATE).any(axis=-1)
+    live = ends > starts
+    return {"starts": starts, "ends": ends, "crash": crash & live,
+            "links": links & live, "skew": skew & live}
+
+
+def span_counters(win: Dict[str, np.ndarray], t0: int,
+                  ticks: int) -> Dict[str, int]:
+    """The heartbeat's per-chunk fault-fuzz record: how many instances
+    have a fault window overlapping ``[t0, t0 + ticks)``, per lane —
+    the per-instance analog of ``engine.span_summary``."""
+    t1 = int(t0) + max(1, int(ticks))
+    ov = (win["starts"] < t1) & (win["ends"] > int(t0))
+    out = {"schedules-active": int(
+        (ov & (win["crash"] | win["links"] | win["skew"]))
+        .any(axis=1).sum())}
+    for lane in ("crash", "links", "skew"):
+        out[lane] = int((ov & win[lane]).any(axis=1).sum())
+    return out
+
+
+def fleet_coverage(win: Dict[str, np.ndarray]) -> Dict[str, int]:
+    """Schedule-space coverage counters for the run-start heartbeat
+    record: distinct schedules drawn and total fault windows per lane
+    (the 'how much of the space did this sweep visit' label)."""
+    sig = np.concatenate(
+        [win["starts"], win["ends"],
+         win["crash"].astype(np.int32), win["links"].astype(np.int32),
+         win["skew"].astype(np.int32)], axis=1)
+    return {
+        "instances": int(sig.shape[0]),
+        "distinct-schedules": int(np.unique(sig, axis=0).shape[0]),
+        "crash-windows": int(win["crash"].sum()),
+        "link-windows": int(win["links"].sum()),
+        "skew-windows": int(win["skew"].sum()),
+    }
+
+
+def fuzz_summary(fx: FaultConfig) -> Dict[str, Any]:
+    """The run-start record's distribution block (static; coverage
+    counters ride separately via :func:`fleet_coverage`)."""
+    fz = fx.fuzz
+    lanes = [name for name, on in (("crash-restart", fz.has_crash),
+                                   ("link-degradation", fz.has_links),
+                                   ("clock-skew", fz.has_skew)) if on]
+    return {"lanes": lanes,
+            "windows": [fz.windows_min, fz.windows_max],
+            "gap": [fz.gap_min, fz.gap_max],
+            "duration": [fz.dur_min, fz.dur_max],
+            "snapshot-every": int(fx.snapshot_every),
+            "stop-tick": int(fx.stop_tick)}

@@ -1,0 +1,308 @@
+"""Automatic failing-schedule shrinking: fuzz hit -> minimal nemesis.
+
+A randomized fuzz sweep (``faults/fuzz.py``) turns one run into 100k
+distinct fault scenarios — and a hit into a needle nobody wants to
+read: the flagged instance's schedule may crash five nodes across three
+windows when ONE crash in ONE window was the trigger. ``maelstrom
+shrink <run-dir>`` closes that loop per flagged instance:
+
+1. **Reconstruct** the instance's concrete schedule from its seed
+   (``fuzz.reconstruct_plan`` — schedules are bit-stable pure functions
+   of ``(seed, instance_id)``) as a deterministic ``--fault-plan``
+   dict.
+2. **Verify** the reconstruction: replay the single instance through
+   the pipelined executor (``tpu/pipeline.run_sim_pipelined`` with
+   ``instance_ids=[id]`` — the instance-stable RNG makes node/client/
+   restart draws identical to the fleet run) under that plan and
+   require the on-device invariants to trip again. A non-failing
+   reconstruction is reported loudly — it would mean the seed -> plan
+   path is not bit-exact.
+3. **Delta-debug** the plan to a local minimum: greedy passes that drop
+   whole fault phases, drop individual victims (crash nodes, link
+   edges, skewed nodes), and halve phase durations — keeping any
+   reduction whose replay STILL fails — repeated to fixpoint under an
+   attempt budget.
+4. **Write** ``triage/instance-<id>/shrunk-plan.json`` (a pure plan
+   file, replayable via ``--fault-plan``) plus ``shrink.json`` with the
+   original/shrunk weights and the verification record.
+
+Each candidate replay recompiles the tick (fault planes are baked
+constants), so the replay config should be small — the shrink run
+reuses the original run's opts with ``n_instances=1`` and recording
+off; wall-clock is bounded by ``max_attempts``.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from . import fuzz as _fuzz
+
+SHRINK_FILE = "shrink.json"
+SHRUNK_PLAN_FILE = "shrunk-plan.json"
+
+
+class ShrinkError(ValueError):
+    """A run/instance that cannot be shrunk (not a fuzz run, or the
+    reconstruction does not reproduce the failure)."""
+
+
+def _phase_content(ph: Dict[str, Any]) -> int:
+    return (len(ph.get("crash") or []) + len(ph.get("links") or [])
+            + len(ph.get("skew") or {}))
+
+
+def _normalize(plan: Dict[str, Any]) -> Dict[str, Any]:
+    """Merge adjacent healthy phases and drop a healthy tail — pure
+    cosmetics for the written artifact (searchsorted semantics are
+    unchanged by either)."""
+    phases = [dict(p) for p in plan.get("phases", ())]
+    out: List[Dict[str, Any]] = []
+    for ph in phases:
+        if out and _phase_content(out[-1]) == 0 \
+                and _phase_content(ph) == 0:
+            out[-1]["until"] = ph["until"]
+        else:
+            out.append(ph)
+    while out and _phase_content(out[-1]) == 0:
+        out.pop()
+    if not out:
+        return {}
+    return {**{k: v for k, v in plan.items() if k != "phases"},
+            "phases": out}
+
+
+def _candidates(plan: Dict[str, Any]):
+    """Yield reduced candidate plans, most aggressive first: whole
+    fault phases dropped, then single victims, then halved durations.
+    Each candidate is an independent copy of ``plan``."""
+    phases = plan.get("phases", ())
+    fault_idx = [i for i, ph in enumerate(phases)
+                 if _phase_content(ph) > 0]
+    for i in fault_idx:
+        cand = copy.deepcopy(plan)
+        cand["phases"][i] = {"until": phases[i]["until"]}
+        yield f"drop-phase-{i}", cand
+    for i in fault_idx:
+        ph = phases[i]
+        for v in ph.get("crash") or []:
+            cand = copy.deepcopy(plan)
+            cand["phases"][i]["crash"] = [
+                x for x in ph["crash"] if x != v]
+            if not cand["phases"][i]["crash"]:
+                del cand["phases"][i]["crash"]
+            yield f"phase-{i}-drop-crash-{v}", cand
+        for j in range(len(ph.get("links") or [])):
+            cand = copy.deepcopy(plan)
+            del cand["phases"][i]["links"][j]
+            if not cand["phases"][i]["links"]:
+                del cand["phases"][i]["links"]
+            yield f"phase-{i}-drop-edge-{j}", cand
+        for node in list((ph.get("skew") or {})):
+            cand = copy.deepcopy(plan)
+            del cand["phases"][i]["skew"][node]
+            if not cand["phases"][i]["skew"]:
+                del cand["phases"][i]["skew"]
+            yield f"phase-{i}-drop-skew-{node}", cand
+    for i in fault_idx:
+        prev = int(phases[i - 1]["until"]) if i else 0
+        width = int(phases[i]["until"]) - prev
+        if width >= 2:
+            cand = copy.deepcopy(plan)
+            cand["phases"][i]["until"] = prev + width // 2
+            yield f"phase-{i}-halve-duration", cand
+
+
+def make_replayer(model, opts: Dict[str, Any], instance_id: int,
+                  params=None):
+    """Build ``replay(plan) -> bool`` (True = the single-instance
+    deterministic replay trips the on-device invariants). The replay
+    runs through the pipelined executor with the ORIGINAL run's opts —
+    same seed, same instance id, recording/journal/telemetry stripped
+    to the minimum the invariant lanes need."""
+    from ..tpu.harness import make_sim_config
+    from ..tpu.pipeline import run_sim_pipelined
+
+    base = {**opts, "fault_fuzz": None, "n_instances": 1,
+            "record_instances": 0, "journal_instances": 0,
+            "funnel": False, "heartbeat": False, "fail_fast": False,
+            "checkpoint_every": 0}
+    seed = int(base.get("seed") or 0)
+    chunk = int(base.get("chunk_ticks") or 100)
+    ids = np.asarray([int(instance_id)], np.int32)
+
+    def replay(plan: Optional[Dict[str, Any]]) -> bool:
+        sim = make_sim_config(model, {**base,
+                                      "fault_plan": plan or None})
+        p = params if params is not None \
+            else model.make_params(sim.net.n_nodes)
+        res = run_sim_pipelined(model, sim, seed, p,
+                                instance_ids=ids, chunk=chunk)
+        return int(np.asarray(res.carry.violations)[0]) > 0
+
+    return replay
+
+
+def shrink_plan(plan: Dict[str, Any], replay,
+                max_attempts: int = 24) -> Dict[str, Any]:
+    """Greedy delta-debugging to a local minimum: try each candidate
+    reduction, keep any that still fails, restart the pass on the
+    reduced plan; stop at fixpoint or when ``max_attempts`` replays
+    are spent. Returns ``{plan, attempts, kept}``."""
+    current = _normalize(plan)
+    attempts = 0
+    kept: List[str] = []
+    progress = True
+    while progress and attempts < max_attempts:
+        progress = False
+        for label, cand in _candidates(current):
+            if attempts >= max_attempts:
+                break
+            cand = _normalize(cand)
+            attempts += 1
+            if replay(cand if cand else None):
+                current = cand
+                kept.append(label)
+                progress = True
+                break       # restart candidate enumeration on the
+                #             reduced plan (greedy-first-improvement)
+    return {"plan": current, "attempts": attempts, "kept": kept}
+
+
+def shrink_instance(model, opts: Dict[str, Any], instance_id: int,
+                    params=None,
+                    max_attempts: int = 24) -> Dict[str, Any]:
+    """The full loop for one flagged instance: reconstruct -> verify ->
+    delta-debug -> verify the minimum. Raises :class:`ShrinkError`
+    when the run is not a fuzz run or the reconstructed plan does not
+    reproduce the failure."""
+    from ..tpu.harness import make_sim_config
+
+    if not opts.get("fault_fuzz"):
+        raise ShrinkError(
+            "not a fault-fuzz run (no fault_fuzz in the repro opts) — "
+            "deterministic-plan hits are already minimal-by-"
+            "construction inputs for hand-editing")
+    sim = make_sim_config(model, dict(opts))
+    seed = int(opts.get("seed") or 0)
+    plan0 = _fuzz.reconstruct_plan(sim.faults, sim.net.n_nodes, seed,
+                                   instance_id)
+    replay = make_replayer(model, opts, instance_id, params=params)
+    if not plan0:
+        raise ShrinkError(
+            f"instance {instance_id}: reconstructed schedule is "
+            f"all-healthy — a flagged instance with no faults means "
+            f"the failure is fault-independent (triage it instead)")
+    if not replay(plan0):
+        raise ShrinkError(
+            f"instance {instance_id}: the reconstructed deterministic "
+            f"plan does NOT reproduce the violation — the seed -> "
+            f"schedule replay was not bit-exact (this is a bug, "
+            f"report it)")
+    p0, v0 = _fuzz.plan_weight(plan0)
+    res = shrink_plan(plan0, replay, max_attempts=max_attempts)
+    shrunk = res["plan"]
+    # the reduced plan gets one final CONFIRMING replay (an unreduced
+    # plan is plan0, whose replay above already failed) — keeping the
+    # gate's `verified` assertion load-bearing rather than a constant
+    verified = (True if not res["kept"]
+                else replay(shrunk if shrunk else None))
+    p1, v1 = _fuzz.plan_weight(shrunk)
+    return {
+        "instance": int(instance_id),
+        "seed": seed,
+        "original-plan": plan0,
+        "original-phases": p0, "original-victims": v0,
+        "shrunk-plan": shrunk,
+        "shrunk-phases": p1, "shrunk-victims": v1,
+        "attempts": res["attempts"],
+        "kept": res["kept"],
+        "verified": verified,
+        "reduced": (p1, v1) < (p0, v0),
+    }
+
+
+def shrink_run(run_dir: str, ids: Optional[List[int]] = None,
+               max_instances: int = 4,
+               max_attempts: int = 24) -> Dict[str, Any]:
+    """``maelstrom shrink <run-dir>``: shrink each flagged instance's
+    schedule and write its minimal plan under
+    ``<run-dir>/triage/instance-<id>/``. Returns the summary (also
+    written to ``triage/shrink-summary.json``)."""
+    from ..checkers.triage import (TRIAGE_DIR, TriageError,
+                                   load_run_info, resolve_model)
+
+    try:
+        info = load_run_info(run_dir)
+    except TriageError as e:
+        raise ShrinkError(str(e))
+    opts = dict(info["opts"])
+    opts["seed"] = info["seed"]
+    if not opts.get("fault_fuzz"):
+        raise ShrinkError(
+            f"{info['run-dir']} is not a fault-fuzz run (its heartbeat "
+            f"repro opts carry no fault_fuzz distribution); shrink "
+            f"operates on randomized-schedule hits")
+    targets = [int(i) for i in (ids if ids else info["flagged"])]
+    dropped = max(0, len(targets) - int(max_instances))
+    targets = targets[:int(max_instances)]
+    out_dir = os.path.join(info["run-dir"], TRIAGE_DIR)
+    summary: Dict[str, Any] = {
+        "run-dir": info["run-dir"], "workload": info["workload"],
+        "flagged": info["flagged"], "shrunk": [], "errors": [],
+        "dropped": dropped, "out-dir": out_dir,
+    }
+    if not targets:
+        summary["note"] = ("no flagged instances (run is clean or the "
+                           "heartbeat saw no violation scan hits)")
+        return summary
+    model = resolve_model(info)
+    params = model.make_params(int(opts.get("node_count", 1)))
+    for gid in targets:
+        inst_dir = os.path.join(out_dir, f"instance-{gid}")
+        os.makedirs(inst_dir, exist_ok=True)
+        try:
+            rec = shrink_instance(model, opts, gid, params=params,
+                                  max_attempts=max_attempts)
+        except ShrinkError as e:
+            summary["errors"].append({"instance": gid,
+                                      "error": str(e)})
+            continue
+        with open(os.path.join(inst_dir, SHRUNK_PLAN_FILE), "w") as f:
+            json.dump(rec["shrunk-plan"], f, indent=2)
+        rec["shrunk-plan-file"] = os.path.join(inst_dir,
+                                               SHRUNK_PLAN_FILE)
+        with open(os.path.join(inst_dir, SHRINK_FILE), "w") as f:
+            json.dump(rec, f, indent=2)
+        summary["shrunk"].append(rec)
+    os.makedirs(out_dir, exist_ok=True)
+    with open(os.path.join(out_dir, "shrink-summary.json"), "w") as f:
+        json.dump(summary, f, indent=2, default=repr)
+    return summary
+
+
+def render_shrink_report(summary: Dict[str, Any]) -> str:
+    lines = [f"shrink: {summary['workload']} run at "
+             f"{summary['run-dir']}"]
+    if summary.get("note"):
+        lines.append(summary["note"])
+    for rec in summary.get("shrunk", ()):
+        lines.append(
+            f"  instance {rec['instance']}: "
+            f"{rec['original-phases']} phase(s)/"
+            f"{rec['original-victims']} victim(s) -> "
+            f"{rec['shrunk-phases']}/{rec['shrunk-victims']} in "
+            f"{rec['attempts']} replay(s); verified "
+            f"{rec['verified']} -> {rec.get('shrunk-plan-file', '?')}")
+    for err in summary.get("errors", ()):
+        lines.append(f"  instance {err['instance']}: ERROR "
+                     f"{err['error']}")
+    if summary.get("dropped"):
+        lines.append(f"  (+{summary['dropped']} flagged instance(s) "
+                     f"beyond --max-instances)")
+    return "\n".join(lines)

@@ -35,6 +35,15 @@ composable ``--nemesis`` kinds (``crash-restart``, ``link-degrade``,
 lanes compose with each other AND with the existing partition nemesis
 in one run. ``compile_fault_plan`` lowers a plan dict to the static
 :class:`~.engine.FaultConfig` the runtime traces against.
+
+A plan is ONE deterministic, fleet-shared schedule. Its randomized
+sibling is the fault DISTRIBUTION (``--fault-fuzz``,
+``spec`` → :mod:`~.fuzz`): the same three lanes, but with rates and
+ranges that each instance samples into its OWN schedule on device —
+and ``maelstrom shrink`` lowers any failing drawn schedule back INTO
+this module's plan dialect (``fuzz.schedule_to_plan`` emits plans that
+``validate_fault_plan``/``compile_fault_plan`` accept verbatim), so
+the deterministic plan remains the single replay/repro currency.
 """
 
 from __future__ import annotations

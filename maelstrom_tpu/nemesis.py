@@ -9,8 +9,15 @@ Partitions are NOT the only fault in this repo — the device runtimes
 have the fault-plan engine (``maelstrom_tpu/faults/``,
 ``doc/guide/10-faults.md``): composable crash-restart with snapshot
 recovery, asymmetric/slow/lossy links, and per-node clock skew, each
-proven by a planted-bug anomaly. New fault vocabulary lands there; this
-module intentionally stays partitions-only, matching the reference.
+proven by a planted-bug anomaly — plus per-instance RANDOMIZED fault
+schedules (``--fault-fuzz``, ``faults/fuzz.py``), which are
+TPU-runtime-only by construction: the schedule-RNG lane draws one
+schedule per vectorized instance on device, and a host runtime has
+exactly one "instance" (the real cluster) and no schedule-RNG lane to
+draw from — the CLI rejects ``--fault-fuzz`` on host runtimes with a
+pointer here, the same rejection pattern PR 9 set for the fault kinds
+(PARITY.md). New fault vocabulary lands there; this module
+intentionally stays partitions-only, matching the reference.
 
 The nemesis runs on its own thread alongside the client workers: every
 ``interval`` seconds it alternately starts a partition (computing a *grudge*

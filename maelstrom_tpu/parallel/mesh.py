@@ -203,9 +203,11 @@ def _carry_to_wire(c: Carry, sim: SimConfig) -> Carry:
     return Carry(
         pool=c.pool, node_state=c.node_state,
         client_state=c.client_state,
-        # the fault engine's snapshot slab is instance-batched like
-        # node_state (canonical_carry already led its batch axis)
+        # the fault engine's snapshot slab and the fuzzer's randomized
+        # schedule lanes are instance-batched like node_state
+        # (canonical_carry already led their batch axes)
         snapshots=c.snapshots,
+        fault_sched=c.fault_sched,
         stats=jax.tree.map(lambda x: x.reshape(1), c.stats),
         violations=c.violations,
         key=c.key.reshape(1, *c.key.shape),
@@ -222,6 +224,7 @@ def _carry_from_wire(w: Carry, sim: SimConfig) -> Carry:
         pool=w.pool, node_state=w.node_state,
         client_state=w.client_state,
         snapshots=w.snapshots,
+        fault_sched=w.fault_sched,
         stats=jax.tree.map(lambda x: x.reshape(()), w.stats),
         violations=w.violations,
         key=w.key.reshape(*w.key.shape[1:]),
@@ -389,6 +392,20 @@ def run_sim_sharded_chunked(model: Model, sim: SimConfig, seed: int,
     chunk_idx = [resume.chunks if resume else 0]
     tripped = [False]
 
+    # fuzz runs: the heartbeat's fault-fuzz lane (schedules-active per
+    # chunk) comes from one host-side re-draw of every shard's windows
+    # — schedules are pure functions of the shard seeds, zero mid-run
+    # device traffic (faults/fuzz.py)
+    fuzz_windows = None
+    if heartbeat is not None and sim.faults.has_fuzz:
+        from ..faults import fuzz as faults_fuzz
+        wins = [faults_fuzz.fleet_windows(
+                    sim.faults, sim.net.n_nodes, s,
+                    np.arange(sim.n_instances, dtype=np.int32))
+                for s in shard_seeds(seed, mesh.devices.size)]
+        fuzz_windows = {k: np.concatenate([w[k] for w in wins], axis=0)
+                        for k in wins[0]}
+
     def dispatch(w, t0, length):
         w, events, svec, scan = chunk_fn(w, jnp.int32(t0), params,
                                          length)
@@ -402,11 +419,17 @@ def run_sim_sharded_chunked(model: Model, sim: SimConfig, seed: int,
         if int(scan_np[0, 0]) > 0:
             tripped[0] = True
         if heartbeat is not None:
+            extra = None
+            if fuzz_windows is not None:
+                from ..faults import fuzz as faults_fuzz
+                extra = {"fault-fuzz": faults_fuzz.span_counters(
+                    fuzz_windows, t0, length)}
             heartbeat.record_chunk(
                 chunk=chunk_idx[0], t0=t0, ticks=length,
                 net=stats_vec_to_net(np.asarray(svec).sum(axis=0)),
                 violation=scan_to_violation(scan_np),
-                violations=scan_to_violations(scan_np))
+                violations=scan_to_violations(scan_np),
+                extra=extra)
         chunk_idx[0] += 1
 
     should_stop = (lambda: tripped[0]) if fail_fast else None

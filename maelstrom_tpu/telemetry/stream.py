@@ -40,7 +40,14 @@ Record schema (all host-written; one JSON object per line):
   "skewed-nodes": n}`` or ``{"healthy": true}`` — computed host-side
   from the deterministic plan (``faults.engine.span_summary``), zero
   device traffic; the run-start header carries the plan's lane list
-  under ``faults``.
+  under ``faults``. Fault-FUZZ runs (per-instance randomized
+  schedules, ``faults/fuzz.py``) carry ``fault-fuzz`` instead —
+  ``{"schedules-active": n, "crash": c, "links": l, "skew": s}``,
+  the count of instances whose drawn fault windows overlap the chunk
+  per lane, computed host-side by re-drawing the seed-deterministic
+  schedules (``fuzz.span_counters``); their run-start header adds
+  schedule-space coverage counters under ``fault-fuzz``
+  (``fuzz.fleet_coverage``: distinct schedules + windows per lane).
 - ``{"type": "run-end", "status": "complete"|"stopped", ...}`` — last
   line on a clean exit; ABSENT on a crash (that absence is what
   ``maelstrom watch`` reports as a dead/partial run).
@@ -346,6 +353,15 @@ def render_chunk_line(rec: Dict[str, Any]) -> str:
         if fault.get("skewed-nodes"):
             bits.append(f"skew {fault['skewed-nodes']}")
         parts.append("fault[" + " ".join(bits) + "]")
+    fz = rec.get("fault-fuzz")
+    if fz:
+        # randomized schedules: instances with a fault window in this
+        # chunk, per lane
+        bits = [f"{fz.get('schedules-active', 0)} active"]
+        for lane in ("crash", "links", "skew"):
+            if fz.get(lane):
+                bits.append(f"{lane} {fz[lane]}")
+        parts.append("fuzz[" + " ".join(bits) + "]")
     parts.append("OVERFLOW" if rec.get("events-overflowed") else "")
     n_lanes = len(rec.get("violations") or ())
     more = f", +{n_lanes - 1} more named" if v and n_lanes > 1 else ""

@@ -110,6 +110,15 @@ TPU_DEFAULTS = dict(
                               # skew phases, compiled into the tick.
                               # Mutually exclusive with the generated
                               # fault --nemesis kinds
+    fault_fuzz=None,          # fault DISTRIBUTION dict (maelstrom_tpu/
+                              # faults/fuzz.py; CLI --fault-fuzz):
+                              # per-instance RANDOMIZED crash/link/skew
+                              # schedules drawn on device from the
+                              # schedule-RNG lane — every instance runs
+                              # a different scenario; `maelstrom
+                              # shrink` minimizes the failing ones.
+                              # Mutually exclusive with fault_plan and
+                              # the fault --nemesis kinds
     fault_snapshot_every=None,  # ticks between snapshot-slab captures
                               # for crash recovery (None defers to the
                               # plan's own snapshot_every, default 1 =
@@ -219,23 +228,34 @@ def make_sim_config(model: Model, opts: Dict[str, Any]) -> SimConfig:
     # the fault-plan engine (maelstrom_tpu/faults/): an explicit plan
     # dict, or the composable fault --nemesis kinds generated on the
     # partition nemesis's interval grid; both heal at stop_tick
-    from ..faults import (FAULT_KINDS, compile_fault_plan,
-                          generate_fault_plan)
+    from ..faults import (FAULT_KINDS, compile_fault_fuzz,
+                          compile_fault_plan, generate_fault_plan)
     fault_kinds = [k for k in (o["nemesis"] or []) if k in FAULT_KINDS]
     plan = o.get("fault_plan")
+    fuzz_dist = o.get("fault_fuzz")
     if plan and fault_kinds:
         raise ValueError(
             f"--fault-plan and the generated fault nemesis kinds "
             f"({', '.join(fault_kinds)}) are mutually exclusive — put "
             f"the faults in the plan file")
+    if fuzz_dist and (plan or fault_kinds):
+        raise ValueError(
+            "--fault-fuzz (per-instance randomized schedules) is "
+            "mutually exclusive with --fault-plan and the generated "
+            "fault nemesis kinds — one run speaks one schedule source")
     if not plan and fault_kinds:
         plan = generate_fault_plan(
             fault_kinds, o["node_count"], n_ticks,
             max(1, int(o["nemesis_interval"] * 1000 / mpt)), stop_tick)
     snap_every = o.get("fault_snapshot_every")
-    faults = compile_fault_plan(
-        plan, o["node_count"], stop_tick,
-        snapshot_every=None if snap_every is None else int(snap_every))
+    snap_every = None if snap_every is None else int(snap_every)
+    if fuzz_dist:
+        faults = compile_fault_fuzz(fuzz_dist, o["node_count"],
+                                    stop_tick,
+                                    snapshot_every=snap_every)
+    else:
+        faults = compile_fault_plan(plan, o["node_count"], stop_tick,
+                                    snapshot_every=snap_every)
     if fault_kinds and not faults.active:
         # the user explicitly asked for these fault kinds; silently
         # running fault-free (e.g. crash-restart/link-degrade on a
@@ -434,9 +454,11 @@ _REPRO_OPT_KEYS = (
     # resumed run re-runs under the SAME policy it started with
     "pipeline", "fail_fast", "scan_top_k", "funnel", "funnel_max",
     "checkpoint_every",
-    # fault-plan engine (maelstrom_tpu/faults/): the plan is part of
-    # the trajectory, so triage/resume must rebuild it
-    "fault_plan", "fault_snapshot_every",
+    # fault-plan engine (maelstrom_tpu/faults/): the plan — or the
+    # fuzz distribution whose per-instance schedules derive from the
+    # seed — is part of the trajectory, so triage/resume/shrink must
+    # rebuild it
+    "fault_plan", "fault_fuzz", "fault_snapshot_every",
     # model-selection flags (native-engine vocabulary parity): the
     # replay must rebuild the same mutant/crash-mode automaton
     "crash_clients", "txn_dirty_apply")
@@ -478,9 +500,19 @@ def heartbeat_meta(model: Model, sim: SimConfig,
     if sim.faults.active:
         # label the live report (`maelstrom watch`); the repro opts
         # above carry the full plan (or the deterministic generator
-        # inputs) for the bit-exact replay
+        # inputs / the fuzz distribution) for the bit-exact replay
         from ..faults.engine import plan_summary
         meta["faults"] = plan_summary(sim.faults)
+    if sim.faults.has_fuzz:
+        # schedule-space coverage counters: one host-side re-draw of
+        # the fleet's windows (pure function of the seed) labels how
+        # much of the fault space this sweep visits
+        from ..faults import fuzz as faults_fuzz
+        meta["fault-fuzz"] = faults_fuzz.fleet_coverage(
+            faults_fuzz.fleet_windows(
+                sim.faults, sim.net.n_nodes,
+                int(opts.get("seed") or 0),
+                np.arange(sim.n_instances, dtype=np.int32)))
     return meta
 
 

@@ -18,6 +18,13 @@ slots. Each virtual-clock tick:
   boolean partition plane to per-directed-edge quality: blocks fold
   into the delivery partition matrix, while extra latency and elevated
   loss ride ``enqueue``'s ``edge_delay`` / ``edge_loss_pm`` planes.
+  Both planes are per-call arguments precisely so the fault FUZZER
+  (``faults/fuzz.py``) can vmap a DIFFERENT plane per instance —
+  deterministic plans close over one shared plane, randomized
+  schedules batch them, and the enqueue math is identical either way
+  (zero-valued planes stay value-identical to the healthy path, and
+  the edge-loss roll keeps its own folded key so enabling the lane
+  never perturbs the base latency/loss draws).
 
 Everything is pure, fixed-shape, and vmappable over the instance axis;
 `vmap(deliver)` / `vmap(enqueue)` are the hot ops of the whole TPU runtime.

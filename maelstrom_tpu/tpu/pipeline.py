@@ -552,6 +552,15 @@ def run_sim_pipelined(model: Model, sim: SimConfig, seed: int,
     chunk_idx = [resume.chunks if resume else 0]
     last_scan: List[Optional[np.ndarray]] = [None]
     tripped = [False]
+    # fuzz runs: one host-side re-draw of the fleet's fault windows
+    # feeds the heartbeat's per-chunk fault-fuzz lane — schedules are
+    # pure functions of (seed, instance id), zero mid-run device
+    # traffic (faults/fuzz.py)
+    fuzz_windows = None
+    if heartbeat is not None and sim.faults.has_fuzz:
+        from ..faults import fuzz as faults_fuzz
+        fuzz_windows = faults_fuzz.fleet_windows(
+            sim.faults, sim.net.n_nodes, seed, instance_ids)
 
     def dispatch(carry_st, t0, length):
         c, svec, scan, buf, journal = chunk_fn(carry_st, jnp.int32(t0),
@@ -580,7 +589,13 @@ def run_sim_pipelined(model: Model, sim: SimConfig, seed: int,
                                             scan_to_violations,
                                             stats_vec_to_net)
             extra = None
-            if sim.faults.active:
+            if fuzz_windows is not None:
+                # randomized schedules: how many instances' drawn fault
+                # windows overlap this chunk, per lane
+                from ..faults import fuzz as faults_fuzz
+                extra = {"fault-fuzz": faults_fuzz.span_counters(
+                    fuzz_windows, t0, length)}
+            elif sim.faults.active:
                 # the plan is deterministic and host-known: the chunk's
                 # fault epoch costs no device traffic
                 from ..faults.engine import span_summary

@@ -40,6 +40,7 @@ import jax.numpy as jnp
 from . import netsim, wire
 from .netsim import NetConfig, NetStats
 from ..faults import engine as faults_engine
+from ..faults import fuzz as faults_fuzz
 from ..faults.engine import FaultConfig, NO_PLANES
 from ..telemetry import recorder as flight
 from ..telemetry.recorder import TelemetryConfig
@@ -640,6 +641,13 @@ class Carry(NamedTuple):
                                # node_state), read by crash-restart
                                # recovery (maelstrom_tpu/faults/). None
                                # unless the fault plan has a crash lane
+    fault_sched: Any = None    # per-instance randomized fault schedules
+                               # (faults/fuzz.py FaultSchedule, batched
+                               # like node_state): drawn ONCE at init
+                               # from the _RNG_FAULTS purpose, constant
+                               # across ticks — riding the carry keeps
+                               # checkpoint/resume and triage replay
+                               # bit-exact. None unless the run fuzzes
 
 
 # RNG purpose tags. Every random draw in the simulation derives from
@@ -656,6 +664,12 @@ _RNG_NODE = 2
 _RNG_CLIENT = 3
 _RNG_ENQUEUE = 4
 _RNG_RESTART = 5    # crash-restart re-init jitter (faults/ crash lane)
+_RNG_FAULTS = faults_fuzz.RNG_PURPOSE   # = 6: the schedule-RNG lane —
+                    # per-instance randomized fault schedules
+                    # (faults/fuzz.py). Instance-stable (no tick fold),
+                    # so an instance's schedule — like its trajectory —
+                    # is a pure function of (seed, instance id) and
+                    # `maelstrom shrink` rebuilds it from the seed
 
 
 def _instance_keys(master, purpose: int, instance_ids, t=None):
@@ -693,10 +707,21 @@ def init_carry(model: Model, sim: SimConfig, seed: int, params,
     # batched node_state in either layout orientation)
     snapshots = (model.snapshot_row(node_state)
                  if sim.faults.has_crash else None)
+    # fuzz runs draw each instance's randomized fault schedule here,
+    # once, from the dedicated schedule-RNG purpose — instance-stable,
+    # so any subset replays (triage/funnel/shrink) redraw identically
+    fault_sched = None
+    if sim.faults.has_fuzz:
+        fkeys = _instance_keys(key, _RNG_FAULTS, instance_ids)
+        fault_sched = jax.vmap(
+            lambda fk: faults_fuzz.draw_schedule(fk, sim.faults,
+                                                 cfg.n_nodes),
+            out_axes=-1 if minor else 0)(fkeys)
     return Carry(
         pool=jnp.zeros(pool_shape, jnp.int32),
         node_state=node_state,
         snapshots=snapshots,
+        fault_sched=fault_sched,
         client_state=jax.tree.map(
             (lambda a: jnp.broadcast_to(a[..., None], a.shape + (I,)))
             if minor else
@@ -722,7 +747,8 @@ def canonical_carry(carry: Carry, sim: SimConfig) -> Carry:
         pool=to_lead(carry.pool),
         node_state=jax.tree.map(to_lead, carry.node_state),
         client_state=jax.tree.map(to_lead, carry.client_state),
-        snapshots=jax.tree.map(to_lead, carry.snapshots))
+        snapshots=jax.tree.map(to_lead, carry.snapshots),
+        fault_sched=jax.tree.map(to_lead, carry.fault_sched))
 
 
 def carry_from_canonical(carry: Carry, sim: SimConfig) -> Carry:
@@ -734,7 +760,8 @@ def carry_from_canonical(carry: Carry, sim: SimConfig) -> Carry:
         pool=to_minor(carry.pool),
         node_state=jax.tree.map(to_minor, carry.node_state),
         client_state=jax.tree.map(to_minor, carry.client_state),
-        snapshots=jax.tree.map(to_minor, carry.snapshots))
+        snapshots=jax.tree.map(to_minor, carry.snapshots),
+        fault_sched=jax.tree.map(to_minor, carry.fault_sched))
 
 
 def _update_telemetry(tel, sim: SimConfig, t, events, invoked_prev,
@@ -793,24 +820,51 @@ def make_tick_fn(model: Model, sim: SimConfig, params,
 
         # fault plan: select tick t's planes (static no-op when the
         # plan has no lanes — NO_PLANES keeps every branch below on
-        # the pre-fault path)
+        # the pre-fault path). Fuzz runs select PER-INSTANCE planes
+        # from the carried randomized schedules instead — every plane
+        # below then carries a leading instance axis.
         fx = sim.faults
+        fuzz_on = fx.has_fuzz
         with jax.named_scope("faults"):
-            planes = (faults_engine.tick_planes(fx, cfg, t)
-                      if fx.active else NO_PLANES)
+            if fuzz_on:
+                planes = jax.vmap(
+                    lambda s: faults_fuzz.schedule_planes(
+                        s, fx, cfg, t))(carry.fault_sched)
+            else:
+                planes = (faults_engine.tick_planes(fx, cfg, t)
+                          if fx.active else NO_PLANES)
             node_state_in = carry.node_state
             snapshots = carry.snapshots
             if planes.crash is not None:
                 # crash-restart: victims held in reset — rebuilt from
                 # their snapshot-slab row (or cold) every crashed tick
-                tvec = (planes.t_nodes if planes.t_nodes is not None
-                        else jnp.broadcast_to(t, (N,)).astype(jnp.int32))
                 wipe_keys = _instance_keys(key, _RNG_RESTART,
                                            instance_ids, t)
-                node_state_in = jax.vmap(
-                    lambda st, sn, k: faults_engine.wipe_crashed(
-                        model, st, sn, planes.crash, tvec, k, cfg,
-                        params))(node_state_in, snapshots, wipe_keys)
+                if fuzz_on and planes.t_nodes is not None:
+                    node_state_in = jax.vmap(
+                        lambda st, sn, k, cm, tv:
+                        faults_engine.wipe_crashed(
+                            model, st, sn, cm, tv, k, cfg, params))(
+                        node_state_in, snapshots, wipe_keys,
+                        planes.crash, planes.t_nodes)
+                elif fuzz_on:
+                    tvec = jnp.broadcast_to(t, (N,)).astype(jnp.int32)
+                    node_state_in = jax.vmap(
+                        lambda st, sn, k, cm:
+                        faults_engine.wipe_crashed(
+                            model, st, sn, cm, tvec, k, cfg, params))(
+                        node_state_in, snapshots, wipe_keys,
+                        planes.crash)
+                else:
+                    tvec = (planes.t_nodes
+                            if planes.t_nodes is not None
+                            else jnp.broadcast_to(t, (N,))
+                            .astype(jnp.int32))
+                    node_state_in = jax.vmap(
+                        lambda st, sn, k: faults_engine.wipe_crashed(
+                            model, st, sn, planes.crash, tvec, k, cfg,
+                            params))(node_state_in, snapshots,
+                                     wipe_keys)
 
         # nemesis keys are t-INdependent: partition_matrix folds in the
         # phase index itself, so a grudge holds for its whole phase (the
@@ -823,7 +877,8 @@ def make_tick_fn(model: Model, sim: SimConfig, params,
             if planes.block is not None:
                 # fault-plan edge blocks (asymmetric links + crashed
                 # receivers) fold into the delivery partition plane
-                partitions = partitions | planes.block[None]
+                partitions = partitions | (planes.block if fuzz_on
+                                           else planes.block[None])
 
         from ..ops.delivery import _interpret, deliver_pallas, \
             pallas_enabled
@@ -841,10 +896,18 @@ def make_tick_fn(model: Model, sim: SimConfig, params,
 
         with jax.named_scope("node_phase"):
             node_keys = _instance_keys(key, _RNG_NODE, instance_ids, t)
-            node_state, node_outs = jax.vmap(
-                lambda st, ib, k: node_phase(model, st, ib, t, k, cfg,
-                                             params,
-                                             t_nodes=planes.t_nodes))(
+            if fuzz_on and planes.t_nodes is not None:
+                # per-instance local clocks under the fuzzed skew lane
+                node_state, node_outs = jax.vmap(
+                    lambda st, ib, k, tn: node_phase(
+                        model, st, ib, t, k, cfg, params, t_nodes=tn))(
+                    node_state_in, inbox[:, :N], node_keys,
+                    planes.t_nodes)
+            else:
+                node_state, node_outs = jax.vmap(
+                    lambda st, ib, k: node_phase(
+                        model, st, ib, t, k, cfg, params,
+                        t_nodes=planes.t_nodes))(
                     node_state_in, inbox[:, :N], node_keys)
 
         invoked_prev = carry.client_state.invoked
@@ -859,8 +922,10 @@ def make_tick_fn(model: Model, sim: SimConfig, params,
             if planes.crash is not None:
                 # a dead process sends nothing: invalidate the victims'
                 # emitted rows before they reach the wire
+                cmask = (~planes.crash).astype(jnp.int32)
                 node_outs = node_outs.at[..., wire.VALID].mul(
-                    (~planes.crash).astype(jnp.int32)[None, :, None])
+                    cmask[:, :, None] if fuzz_on
+                    else cmask[None, :, None])
             outs = jnp.concatenate(
                 [node_outs.reshape(I, -1, cfg.lanes), reqs], axis=1)
             # stamp network-unique message ids (send-time allocation, the
@@ -872,18 +937,33 @@ def make_tick_fn(model: Model, sim: SimConfig, params,
                 outs = outs.at[:, :, cfg.netid_lane].set(
                     t * M + jnp.arange(M, dtype=jnp.int32)[None, :])
             enq_keys = _instance_keys(key, _RNG_ENQUEUE, instance_ids, t)
-            pool, n_sent, n_lost, n_ovf = jax.vmap(
-                lambda p, m, k: netsim.enqueue(
-                    p, m, t, k, cfg, edge_delay=planes.delay,
-                    edge_loss_pm=planes.loss_pm))(
-                    pool, outs, enq_keys)
+            if fuzz_on and planes.delay is not None:
+                # per-instance degraded-edge planes
+                pool, n_sent, n_lost, n_ovf = jax.vmap(
+                    lambda p, m, k, d, l: netsim.enqueue(
+                        p, m, t, k, cfg, edge_delay=d,
+                        edge_loss_pm=l))(
+                    pool, outs, enq_keys, planes.delay, planes.loss_pm)
+            else:
+                pool, n_sent, n_lost, n_ovf = jax.vmap(
+                    lambda p, m, k: netsim.enqueue(
+                        p, m, t, k, cfg, edge_delay=planes.delay,
+                        edge_loss_pm=planes.loss_pm))(
+                        pool, outs, enq_keys)
 
         if snapshots is not None:
             with jax.named_scope("faults"):
-                snapshots = jax.vmap(
-                    lambda st, sn: faults_engine.update_snapshots(
-                        model, st, sn, planes.crash, t,
-                        fx.snapshot_every))(node_state, snapshots)
+                if fuzz_on:
+                    snapshots = jax.vmap(
+                        lambda st, sn, cm:
+                        faults_engine.update_snapshots(
+                            model, st, sn, cm, t, fx.snapshot_every))(
+                        node_state, snapshots, planes.crash)
+                else:
+                    snapshots = jax.vmap(
+                        lambda st, sn: faults_engine.update_snapshots(
+                            model, st, sn, planes.crash, t,
+                            fx.snapshot_every))(node_state, snapshots)
 
         stats = NetStats(
             sent=carry.stats.sent + jnp.sum(n_sent),
@@ -905,7 +985,8 @@ def make_tick_fn(model: Model, sim: SimConfig, params,
                           client_state=client_state, stats=stats,
                           violations=carry.violations
                           + violated.astype(jnp.int32),
-                          key=key, telemetry=tel, snapshots=snapshots)
+                          key=key, telemetry=tel, snapshots=snapshots,
+                          fault_sched=carry.fault_sched)
         J = sim.journal_instances
         R = sim.record_instances
         ys = TickOutputs(
@@ -940,16 +1021,23 @@ def _make_tick_fn_minor(model: Model, sim: SimConfig, params,
     N = cfg.n_nodes
 
     fx = sim.faults
+    fuzz_on = fx.has_fuzz
 
-    def tick_one(pool, node_row, client_row, snap_row, instance_id,
-                 master, t):
+    def tick_one(pool, node_row, client_row, snap_row, sched_row,
+                 instance_id, master, t):
         """One instance's full tick. pool [S, L]; returns the new
         per-instance state plus this tick's outputs and stat deltas."""
         with jax.named_scope("faults"):
-            # fault planes depend only on t (shared plan), so under the
-            # instance vmap they stay unbatched — computed once
-            planes = (faults_engine.tick_planes(fx, cfg, t)
-                      if fx.active else NO_PLANES)
+            # deterministic-plan planes depend only on t (shared plan),
+            # so under the instance vmap they stay unbatched — computed
+            # once; fuzz planes select from THIS instance's carried
+            # randomized schedule, so they batch with the state
+            if fuzz_on:
+                planes = faults_fuzz.schedule_planes(sched_row, fx,
+                                                     cfg, t)
+            else:
+                planes = (faults_engine.tick_planes(fx, cfg, t)
+                          if fx.active else NO_PLANES)
             if planes.crash is not None:
                 tvec = (planes.t_nodes if planes.t_nodes is not None
                         else jnp.broadcast_to(t, (N,)).astype(jnp.int32))
@@ -1015,7 +1103,7 @@ def _make_tick_fn_minor(model: Model, sim: SimConfig, params,
     # identical to the lead path's
     batched = jax.vmap(
         tick_one,
-        in_axes=(-1, -1, -1, -1, 0, None, None),
+        in_axes=(-1, -1, -1, -1, -1, 0, None, None),
         out_axes=(-1, -1, -1, -1, 0, 0, 0, 0, 0, 0))
 
     def tick_fn(carry: Carry, t):
@@ -1023,7 +1111,8 @@ def _make_tick_fn_minor(model: Model, sim: SimConfig, params,
         (pool, node_state, client_state, snapshots, deltas, violated,
          part_active, events, outs, inbox) = batched(
              carry.pool, carry.node_state, carry.client_state,
-             carry.snapshots, instance_ids, carry.key, t)
+             carry.snapshots, carry.fault_sched, instance_ids,
+             carry.key, t)
         n_sent, n_del, n_dropp, n_lost, n_ovf = deltas
         stats = NetStats(
             sent=carry.stats.sent + jnp.sum(n_sent),
@@ -1047,7 +1136,8 @@ def _make_tick_fn_minor(model: Model, sim: SimConfig, params,
                           violations=carry.violations
                           + violated.astype(jnp.int32),
                           key=carry.key, telemetry=tel,
-                          snapshots=snapshots)
+                          snapshots=snapshots,
+                          fault_sched=carry.fault_sched)
         J = sim.journal_instances
         R = sim.record_instances
         ys = TickOutputs(

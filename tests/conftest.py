@@ -67,6 +67,11 @@ def pytest_configure(config):
         "markers", "faults: device-resident fault-plan engine tests — "
                    "crash-restart, link degradation, clock skew, "
                    "planted-bug anomaly matrix (maelstrom_tpu/faults/)")
+    config.addinivalue_line(
+        "markers", "fuzz: randomized per-instance fault-schedule "
+                   "fuzzer tests — schedule-RNG lane, seed-stable "
+                   "reconstruction, shrinking "
+                   "(maelstrom_tpu/faults/fuzz.py, shrink.py)")
 
 
 def pytest_collection_modifyitems(config, items):

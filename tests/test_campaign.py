@@ -779,3 +779,71 @@ def test_watch_campaign_mode(tmp_path):
                          "--campaign"], str(tmp_path))
     out, _ = proc.communicate(timeout=30)
     assert proc.returncode == 0, out   # settled
+
+
+# --- retries-with-backoff (spec `retries`/`backoff-s` keys) ----------------
+
+
+def test_failed_item_requeues_with_backoff(tmp_path):
+    """A FAILED (crashed, not invalid) item with `retries` re-queues
+    with exponential backoff recorded on the item JSON, is skipped
+    while its window runs, and lands FAILED only after the budget is
+    spent — with `failures`/`backoff-history` on the record."""
+    cdir = cqueue.submit_campaign(
+        {"name": "retry",
+         "items": [{"workload": "no-such-workload", "retries": 2,
+                    "backoff_s": 0.2}]}, str(tmp_path))
+    item = json.load(open(cqueue.item_path(cdir, 0)))
+    # policy keys lifted off opts onto the item record
+    assert item["retries"] == 2 and item["backoff-s"] == 0.2
+    assert "retries" not in item["opts"]
+
+    summary = run_campaign(cdir, store_root=str(tmp_path),
+                           log=lambda *a: None)
+    # 3 attempts ran (1 + 2 retries): two re-queues, one terminal fail
+    assert summary["ran"] == 3
+    assert summary["retried"] == 2 and summary["failed"] == 1
+    item = json.load(open(cqueue.item_path(cdir, 0)))
+    assert item["status"] == cqueue.FAILED
+    assert item["failures"] == 3 and item["attempts"] == 3
+    # exponential: each recorded wait doubles the previous
+    hist = item["backoff-history"]
+    assert hist == [0.2, 0.4]
+    assert "no-such-workload" in (item.get("error") or "") \
+        or item.get("error")
+
+
+def test_backoff_window_blocks_claims(tmp_path):
+    cdir = _tiny_campaign(str(tmp_path), n=1)
+    claim = cqueue.claim_next(cdir)
+    # simulate the runner's retry re-queue: pending, but not before
+    # a future instant
+    cqueue.finish_item(claim, cqueue.PENDING, failures=1,
+                       **{"not-before": time.time() + 30.0})
+    assert cqueue.claim_next(cdir) is None   # window still running
+    eta = cqueue.next_retry_eta(cdir)
+    assert eta is not None and eta > time.time()
+    # an elapsed window is claimable again
+    item = json.load(open(cqueue.item_path(cdir, 0)))
+    item["not-before"] = time.time() - 1.0
+    cqueue.write_json_atomic(cqueue.item_path(cdir, 0), item)
+    assert cqueue.next_retry_eta(cdir) is None
+    claim = cqueue.claim_next(cdir)
+    assert claim is not None and claim.item["id"] == 0
+
+
+def test_status_and_report_show_attempt_counts(tmp_path):
+    from maelstrom_tpu.campaign.report import (campaign_report,
+                                               campaign_status,
+                                               render_status)
+    cdir = cqueue.submit_campaign(
+        {"name": "retry2",
+         "items": [{"workload": "no-such-workload", "retries": 1,
+                    "backoff_s": 0.05}]}, str(tmp_path))
+    run_campaign(cdir, store_root=str(tmp_path), log=lambda *a: None)
+    status = campaign_status(cdir)
+    row = status["items"][0]
+    assert row["attempts"] == 2 and row["failures"] == 2
+    assert "failures 2/1" in render_status(status)
+    report = campaign_report(cdir, static_cost=False, write=False)
+    assert report["items"][0]["failures"] == 2

@@ -1,0 +1,605 @@
+"""Randomized per-instance fault fuzzer: the schedule-RNG lane.
+
+Four contracts, each pinned here (doc/guide/10-faults.md "Randomized
+schedules & shrinking"):
+
+1. **Distribution spec** — validate/compile units for the ``--fault-
+   fuzz`` JSON (per-lane rates, victim/duration/phase-count ranges),
+   and the mutual exclusions with ``--fault-plan`` / fault nemesis
+   kinds.
+2. **Per-instance randomization + bit-identity** — a fuzzed sweep
+   draws ≥2 DISTINCT schedules per lane across instances (the whole
+   point: one instance = one scenario); an all-healthy distribution
+   (lanes configured, rate 0 — full machinery in the graph) is
+   bit-identical to a fault-free run in BOTH carry layouts and through
+   the sharded driver; an active distribution is layout-independent.
+3. **Seed-stable reconstruction** — any instance's schedule rebuilds
+   host-side from ``(seed, instance_id)`` alone, lowers to a
+   deterministic ``--fault-plan`` dict, and the single-instance replay
+   under that plan is BIT-EXACT against the instance's slice of the
+   fuzzed fleet (the foundation of ``maelstrom shrink``).
+4. **Shrinking** — on a planted ``RaftForgetsSnapshot`` fuzz hit, the
+   delta-debugger converges to a plan with strictly fewer
+   phases/victims whose replay still trips the committed-prefix
+   invariant; checkpoint/resume under an active fuzz stays
+   bit-identical (the schedule lanes ride the carry).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from maelstrom_tpu.faults import (SpecError, compile_fault_fuzz,
+                                  validate_fault_fuzz)
+from maelstrom_tpu.faults import fuzz as fz
+from maelstrom_tpu.models import get_model
+from maelstrom_tpu.tpu.harness import make_sim_config, run_tpu_test
+from maelstrom_tpu.tpu.pipeline import run_sim_pipelined
+from maelstrom_tpu.tpu.runtime import canonical_carry, run_sim
+
+pytestmark = pytest.mark.fuzz
+
+
+# --- shared fixtures -------------------------------------------------------
+
+# an ACTIVE distribution exercising all three lanes (kept identical to
+# the doc walkthrough so the guide's config is tested)
+ACTIVE_DIST = {"windows": [2, 2], "gap": [40, 120],
+               "duration": [30, 80],
+               "crash": {"rate": 0.8, "victims": [1, 2]},
+               "links": {"rate": 0.6, "edges": [1, 3], "block": 0.5,
+                         "delay": [0, 20], "loss": [0.0, 0.3]},
+               "skew": {"rate": 0.5, "victims": [1, 2],
+                        "range": [0.5, 2.0]}}
+
+# lanes CONFIGURED but rate 0: the schedule machinery is fully in the
+# traced graph (planes computed per instance every tick) while every
+# draw is healthy — the bit-identity probe
+HEALTHY_DIST = {"windows": [1, 2], "gap": [20, 60],
+                "duration": [20, 50],
+                "crash": {"rate": 0.0, "victims": [1, 2]},
+                "links": {"rate": 0.0, "edges": [1, 2]},
+                "skew": {"rate": 0.0, "victims": [1, 1]}}
+
+# the shrinker's quarry: first gap long enough for Raft to commit
+# entries, then majority crashes — the forget-snapshot mutant reboots
+# amnesiac pairs that elect each other and commit over the survivor's
+# committed prefix; links/skew ride along as shrinkable decoys
+HIT_DIST = {"windows": [2, 2], "gap": [150, 260],
+            "duration": [50, 90],
+            "crash": {"rate": 1.0, "victims": [2, 2]},
+            "links": {"rate": 0.6, "edges": [1, 3], "block": 0.5,
+                      "delay": [0, 20], "loss": [0.0, 0.2]},
+            "skew": {"rate": 0.4, "victims": [1, 1],
+                     "range": [0.75, 1.5]}}
+HIT_OPTS = dict(node_count=3, concurrency=4, n_instances=16,
+                record_instances=2, time_limit=0.8, rate=300.0,
+                latency=5.0, rpc_timeout=0.08, recovery_time=0.1,
+                seed=7, inbox_k=2, pool_slots=24, fault_fuzz=HIT_DIST,
+                funnel=False, heartbeat=False)
+
+SMALL_OPTS = dict(node_count=3, concurrency=2, n_instances=8,
+                  record_instances=2, time_limit=0.4, rate=200.0,
+                  latency=5.0, rpc_timeout=0.08, recovery_time=0.1,
+                  seed=7, inbox_k=2, pool_slots=24)
+
+
+# --- spec / compile units --------------------------------------------------
+
+
+class TestSpec:
+    def test_compile_roundtrip(self):
+        fx = compile_fault_fuzz(ACTIVE_DIST, 3, stop_tick=600)
+        assert fx.enabled and fx.has_fuzz and fx.active
+        assert fx.has_crash and fx.has_links and fx.has_skew
+        f = fx.fuzz
+        assert (f.windows_min, f.windows_max) == (2, 2)
+        assert f.crash.rate_pm == 800
+        assert f.links.loss_pm_max == 300
+        assert f.skew.rate64_min == 32 and f.skew.rate64_max == 128
+        assert fx.untils == ()   # no shared timeline: fuzz is per-inst
+
+    def test_healthy_rates_keep_lanes_present(self):
+        """rate 0 keeps a configured lane STATICALLY present (the
+        all-healthy machinery probe) — presence is configuration, not
+        drawn content."""
+        fx = compile_fault_fuzz(HEALTHY_DIST, 3, stop_tick=600)
+        assert fx.has_crash and fx.has_links and fx.has_skew
+
+    def test_none_is_disabled(self):
+        fx = compile_fault_fuzz(None, 3, stop_tick=600)
+        assert not fx.active and not fx.has_fuzz
+
+    @pytest.mark.parametrize("dist,msg", [
+        ({}, "at least one lane"),
+        ({"windows": [3, 1], "crash": {"victims": 1}}, "lo > hi"),
+        ({"crash": {"rate": 2.0, "victims": 1}}, "rate"),
+        ({"crash": {"victims": [1, 7]}}, "victims"),
+        ({"links": {"edges": [1, 2]}, "windows": 99}, "windows"),
+        ({"skew": {"victims": 1, "range": [0.01, 1.0]}}, "range"),
+        ({"snapshot_every": 0, "crash": {"victims": 1}},
+         "snapshot_every"),
+    ])
+    def test_validation_rejects(self, dist, msg):
+        with pytest.raises(SpecError, match=msg):
+            validate_fault_fuzz(dist, 3)
+
+    def test_links_need_two_nodes(self):
+        with pytest.raises(SpecError, match="2 server nodes"):
+            validate_fault_fuzz({"links": {"edges": 1}}, 1)
+
+    def test_dash_keys_tolerated(self):
+        fx = compile_fault_fuzz(
+            {"snapshot-every": 2, "crash": {"victims": [1, 2]}},
+            3, stop_tick=600)
+        assert fx.snapshot_every == 2 and fx.has_crash
+
+    def test_mutually_exclusive_with_plan_and_kinds(self):
+        model = get_model("echo", 3)
+        plan = {"phases": [{"until": 10, "crash": [0]}]}
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            make_sim_config(model, dict(SMALL_OPTS,
+                                        fault_fuzz=HEALTHY_DIST,
+                                        fault_plan=plan))
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            make_sim_config(model, dict(SMALL_OPTS,
+                                        fault_fuzz=HEALTHY_DIST,
+                                        nemesis=["crash-restart"]))
+        # composes with the partition nemesis
+        sim = make_sim_config(model, dict(SMALL_OPTS,
+                                          fault_fuzz=HEALTHY_DIST,
+                                          nemesis=["partition"]))
+        assert sim.faults.has_fuzz and sim.nemesis.enabled
+
+
+# --- schedule draws --------------------------------------------------------
+
+
+class TestScheduleDraw:
+    def _draws(self, n=16):
+        fx = compile_fault_fuzz(ACTIVE_DIST, 3, stop_tick=500)
+        return fx, [fz.reconstruct_schedule(fx, 3, 7, i)
+                    for i in range(n)]
+
+    def test_schedules_differ_per_lane(self):
+        """The acceptance bar: a fuzzed sweep holds >= 2 DISTINCT
+        per-instance schedules PER LANE — the fleet explores many
+        fault-space points per run, not one."""
+        _, scheds = self._draws()
+        untils = {tuple(np.asarray(s.untils).tolist()) for s in scheds}
+        crash = {np.asarray(s.crash).astype(np.int8).tobytes()
+                 for s in scheds}
+        links = {np.concatenate(
+            [np.asarray(s.edge_dst), np.asarray(s.edge_src),
+             np.asarray(s.edge_block), np.asarray(s.edge_delay),
+             np.asarray(s.edge_loss_pm)], axis=None).tobytes()
+            for s in scheds}
+        skew = {np.asarray(s.skew).tobytes() for s in scheds}
+        assert len(untils) >= 2
+        assert len(crash) >= 2
+        assert len(links) >= 2
+        assert len(skew) >= 2
+
+    def test_draw_shapes_and_bounds(self):
+        fx, scheds = self._draws()
+        f = fx.fuzz
+        for s in scheds:
+            u = np.asarray(s.untils)
+            assert u.shape == (2 * f.windows_max,)
+            assert (np.diff(u) >= 0).all()
+            crash = np.asarray(s.crash)
+            assert ((crash.sum(axis=1) == 0)
+                    | ((crash.sum(axis=1) >= f.crash.victims_min)
+                       & (crash.sum(axis=1)
+                          <= f.crash.victims_max))).all()
+            dst, src = np.asarray(s.edge_dst), np.asarray(s.edge_src)
+            assert (dst != src).all()        # never a self edge
+            assert (dst >= 0).all() and (dst < 3).all()
+            assert (src >= 0).all() and (src < 3).all()
+            assert (np.asarray(s.edge_delay) <= f.links.delay_max).all()
+            skew = np.asarray(s.skew)
+            neutral = skew == 64
+            assert (neutral | ((skew >= f.skew.rate64_min)
+                               & (skew <= f.skew.rate64_max))).all()
+
+    def test_draw_is_seed_stable(self):
+        fx = compile_fault_fuzz(ACTIVE_DIST, 3, stop_tick=500)
+        a = fz.reconstruct_schedule(fx, 3, 7, 5)
+        b = fz.reconstruct_schedule(fx, 3, 7, 5)
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+    def test_fleet_windows_and_coverage(self):
+        fx = compile_fault_fuzz(ACTIVE_DIST, 3, stop_tick=500)
+        win = fz.fleet_windows(fx, 3, 7, np.arange(32))
+        cov = fz.fleet_coverage(win)
+        assert cov["instances"] == 32
+        assert cov["distinct-schedules"] >= 2
+        assert cov["crash-windows"] > 0
+        counters = fz.span_counters(win, 0, 500)
+        assert counters["schedules-active"] > 0
+        # a span past every window is quiet
+        assert fz.span_counters(win, 10_000, 100) == {
+            "schedules-active": 0, "crash": 0, "links": 0, "skew": 0}
+
+
+# --- bit-identity ----------------------------------------------------------
+
+
+class TestBitIdentity:
+    @pytest.mark.parametrize("layout", ["lead", "minor"])
+    def test_all_healthy_fuzz_bit_identical(self, layout):
+        """An all-healthy distribution (every lane configured, rate 0)
+        reproduces the fault-free trajectory bit-for-bit in both
+        layouts — the fuzz analog of the PR 9 neutral-plan probe."""
+        model = get_model("lin-kv", 3)
+        params = model.make_params(3)
+        base = make_sim_config(model, {**SMALL_OPTS, "layout": layout})
+        fzd = make_sim_config(model, {**SMALL_OPTS, "layout": layout,
+                                      "fault_fuzz": HEALTHY_DIST})
+        assert fzd.faults.has_fuzz
+        c0, y0 = run_sim(model, base, 7, params)
+        c1, y1 = run_sim(model, fzd, 7, params)
+        assert c1.fault_sched is not None    # machinery really ran
+        assert c1.snapshots is not None
+        for a, b in zip(
+                jax.tree.leaves((c0.pool, c0.node_state,
+                                 c0.client_state, c0.stats,
+                                 c0.violations)),
+                jax.tree.leaves((c1.pool, c1.node_state,
+                                 c1.client_state, c1.stats,
+                                 c1.violations))):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        np.testing.assert_array_equal(np.asarray(y0.events),
+                                      np.asarray(y1.events))
+
+    def test_active_fuzz_layout_independent(self):
+        """An ACTIVE distribution produces bit-identical trajectories
+        in both carry layouts (per-instance planes ride the same
+        vmapped code either way)."""
+        model = get_model("lin-kv", 3)
+        params = model.make_params(3)
+        out = {}
+        for layout in ("lead", "minor"):
+            sim = make_sim_config(model, {**SMALL_OPTS,
+                                          "layout": layout,
+                                          "fault_fuzz": ACTIVE_DIST})
+            c, y = run_sim(model, sim, 7, params)
+            canon = canonical_carry(c, sim)
+            out[layout] = (jax.tree.leaves(
+                (canon.pool, canon.node_state, canon.client_state,
+                 canon.stats, canon.violations, canon.snapshots,
+                 canon.fault_sched)), np.asarray(y.events))
+        for a, b in zip(out["lead"][0], out["minor"][0]):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        np.testing.assert_array_equal(out["lead"][1], out["minor"][1])
+
+    def test_all_healthy_fuzz_sharded_bit_identical(self):
+        """Through the sharded driver: an all-healthy fuzzed fleet's
+        (stats, violations, events) equal the fault-free sharded run
+        bit-for-bit; the schedule lanes cross the shard_map wire."""
+        from maelstrom_tpu.parallel.mesh import (make_mesh,
+                                                 run_sim_sharded)
+        model = get_model("echo", 2)
+        opts = dict(node_count=2, concurrency=2, n_instances=4,
+                    record_instances=2, time_limit=0.2, rate=200.0,
+                    latency=5.0, seed=3, inbox_k=2, pool_slots=16)
+        params = model.make_params(2)
+        mesh = make_mesh(2)
+        base = make_sim_config(model, dict(opts))
+        fzd = make_sim_config(model, {**opts,
+                                      "fault_fuzz": HEALTHY_DIST})
+        s0, v0, e0 = run_sim_sharded(model, base, 3, params, mesh=mesh)
+        s1, v1, e1 = run_sim_sharded(model, fzd, 3, params, mesh=mesh)
+        assert jax.tree.map(int, s0) == jax.tree.map(int, s1)
+        np.testing.assert_array_equal(np.asarray(v0), np.asarray(v1))
+        np.testing.assert_array_equal(np.asarray(e0), np.asarray(e1))
+
+    def test_active_fuzz_sharded_chunked_matches_oracle(self):
+        """An ACTIVE fuzzed fleet through the chunked sharded driver
+        equals the serial unsharded oracle — randomized schedules do
+        not break the shard-equivalence contract."""
+        from maelstrom_tpu.parallel.mesh import (make_mesh,
+                                                 run_sim_sharded_chunked,
+                                                 run_sim_unsharded)
+        model = get_model("echo", 2)
+        opts = dict(node_count=2, concurrency=2, n_instances=4,
+                    record_instances=2, time_limit=0.2, rate=200.0,
+                    latency=5.0, seed=3, inbox_k=2, pool_slots=16,
+                    fault_fuzz=dict(ACTIVE_DIST,
+                                    links=None, skew=None))
+        sim = make_sim_config(model, opts)
+        params = model.make_params(2)
+        mesh = make_mesh(2)
+        s_sh, v_sh, e_sh = run_sim_sharded_chunked(
+            model, sim, 3, params, mesh=mesh, chunk=50)
+        s_un, v_un, e_un = run_sim_unsharded(model, sim, 3, 2, params)
+        assert jax.tree.map(int, s_sh) == jax.tree.map(int, s_un)
+        np.testing.assert_array_equal(np.asarray(v_sh), v_un)
+        np.testing.assert_array_equal(np.asarray(e_sh), e_un)
+
+
+# --- seed-stable reconstruction --------------------------------------------
+
+
+class TestReconstruction:
+    def test_fuzz_instance_equals_plan_replay_bit_exact(self):
+        """Instance ``i`` of a fuzzed sweep and the single-instance
+        deterministic replay of its reconstructed plan are the SAME
+        trajectory, bit for bit — the contract `maelstrom shrink`'s
+        delta-debugging rests on."""
+        model = get_model("lin-kv", 3)
+        params = model.make_params(3)
+        sim = make_sim_config(model, {**SMALL_OPTS, "layout": "lead",
+                                      "fault_fuzz": ACTIVE_DIST})
+        c, _ = run_sim(model, sim, 7, params)
+        cc = canonical_carry(c, sim)
+        gid = 3
+        plan = fz.reconstruct_plan(sim.faults, 3, 7, gid)
+        assert plan and plan["phases"]   # instance 3 drew real faults
+        sub = make_sim_config(model, {**SMALL_OPTS, "layout": "lead",
+                                      "fault_plan": plan,
+                                      "n_instances": 1,
+                                      "record_instances": 1})
+        c1, _ = run_sim(model, sub, 7, params,
+                        jnp.asarray([gid], jnp.int32))
+        cc1 = canonical_carry(c1, sub)
+        a = jax.tree.map(lambda x: np.asarray(x)[gid],
+                         (cc.pool, cc.node_state, cc.client_state))
+        b = jax.tree.map(lambda x: np.asarray(x)[0],
+                         (cc1.pool, cc1.node_state, cc1.client_state))
+        for x, z in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+            np.testing.assert_array_equal(x, z)
+        assert int(np.asarray(c.violations)[gid]) \
+            == int(np.asarray(c1.violations)[0])
+
+    def test_all_healthy_draw_reconstructs_to_empty_plan(self):
+        fx = compile_fault_fuzz(HEALTHY_DIST, 3, stop_tick=500)
+        for i in range(4):
+            assert fz.reconstruct_plan(fx, 3, 7, i) == {}
+
+    def test_plan_validates_and_compiles(self):
+        """Reconstructed plans are legal ``--fault-plan`` inputs: they
+        pass the PR 9 validator and compile to matching lanes."""
+        from maelstrom_tpu.faults import (compile_fault_plan,
+                                          validate_fault_plan)
+        fx = compile_fault_fuzz(ACTIVE_DIST, 3, stop_tick=500)
+        seen_lane = False
+        for i in range(6):
+            plan = fz.reconstruct_plan(fx, 3, 7, i)
+            if not plan:
+                continue
+            validate_fault_plan(plan, 3)
+            det = compile_fault_plan(plan, 3, stop_tick=500)
+            assert det.active
+            seen_lane = True
+        assert seen_lane
+
+
+# --- the shrinker ----------------------------------------------------------
+
+
+class TestShrinker:
+    def test_shrinker_converges_on_forget_snapshot_hit(self):
+        """The acceptance bar end-to-end: the fuzzed sweep flags the
+        amnesia mutant, and the shrinker reduces the flagged
+        instance's drawn schedule to a plan with STRICTLY fewer
+        phases/victims whose deterministic replay still trips the
+        committed-prefix invariant (every kept reduction re-verified
+        by replay, the final plan by construction)."""
+        from maelstrom_tpu.faults.shrink import shrink_instance
+        model = get_model("lin-kv-bug-forget-snapshot", 3)
+        params = model.make_params(3)
+        sim = make_sim_config(model, dict(HIT_OPTS))
+        res = run_sim_pipelined(model, sim, HIT_OPTS["seed"], params,
+                                chunk=100)
+        viol = np.nonzero(np.asarray(res.carry.violations))[0]
+        assert viol.size > 0, "fuzz sweep produced no amnesia hit"
+        gid = int(viol[0])
+        rec = shrink_instance(model, dict(HIT_OPTS), gid,
+                              params=params, max_attempts=6)
+        assert rec["verified"]
+        assert rec["reduced"], rec
+        assert (rec["shrunk-phases"], rec["shrunk-victims"]) \
+            < (rec["original-phases"], rec["original-victims"])
+        # the artifact is a legal plan file
+        from maelstrom_tpu.faults import validate_fault_plan
+        validate_fault_plan(rec["shrunk-plan"], 3)
+
+    def test_shrink_rejects_non_fuzz_runs(self):
+        from maelstrom_tpu.faults.shrink import (ShrinkError,
+                                                 shrink_instance)
+        model = get_model("lin-kv", 3)
+        with pytest.raises(ShrinkError, match="not a fault-fuzz run"):
+            shrink_instance(model, dict(SMALL_OPTS), 0)
+
+    @pytest.mark.slow
+    def test_shrink_run_writes_bundles(self, tmp_path):
+        """The run-dir face: a stored fuzz run of the mutant shrinks
+        into triage/instance-<id>/shrunk-plan.json + shrink.json, and
+        the summary reports the reduction."""
+        from maelstrom_tpu.faults.shrink import shrink_run
+        model = get_model("lin-kv-bug-forget-snapshot", 3)
+        opts = dict(HIT_OPTS, store_root=str(tmp_path),
+                    heartbeat=True, pipeline="on", chunk_ticks=100)
+        res = run_tpu_test(model, opts)
+        assert res["valid?"] is False
+        run_dir = os.path.realpath(os.path.join(
+            str(tmp_path), "lin-kv-bug-forget-snapshot-tpu", "latest"))
+        summary = shrink_run(run_dir, max_instances=1, max_attempts=6)
+        assert summary["shrunk"], summary
+        rec = summary["shrunk"][0]
+        assert rec["verified"] and rec["reduced"]
+        plan_path = os.path.join(run_dir, "triage",
+                                 f"instance-{rec['instance']}",
+                                 "shrunk-plan.json")
+        with open(plan_path) as f:
+            plan = json.load(f)
+        assert plan["phases"]
+
+
+# --- checkpoint/resume + observability -------------------------------------
+
+
+class TestDurabilityAndObservability:
+    @pytest.mark.parametrize("layout", ["lead", "minor"])
+    def test_checkpoint_resume_under_fuzz_bit_identical(self, tmp_path,
+                                                        layout):
+        """Kill after a mid-run checkpoint under an ACTIVE fuzz,
+        resume, and the result equals the uninterrupted run — the
+        schedule lanes ride the carry through save/restore."""
+        from maelstrom_tpu.campaign.checkpoint import (load_checkpoint,
+                                                       restore_carry,
+                                                       save_checkpoint)
+        from maelstrom_tpu.tpu.pipeline import (ResumeState,
+                                                _init_pipelined)
+        model = get_model("echo", 2)
+        opts = dict(node_count=2, concurrency=2, n_instances=8,
+                    record_instances=2, time_limit=0.3, rate=200.0,
+                    latency=5.0, seed=3, inbox_k=2, pool_slots=16,
+                    layout=layout,
+                    fault_fuzz=dict(ACTIVE_DIST, links=None,
+                                    skew=None))
+        sim = make_sim_config(model, opts)
+        assert sim.faults.has_fuzz
+        params = model.make_params(2)
+        base = run_sim_pipelined(model, sim, 3, params, chunk=50)
+
+        d = str(tmp_path)
+
+        class Killed(Exception):
+            pass
+
+        def cb(state, ticks, host):
+            save_checkpoint(d, kind="pipelined", state=state,
+                            ticks=ticks, chunks=host["chunks"],
+                            compact=tuple(host["compact"]),
+                            journal=tuple(host["journal"]))
+            raise Killed
+
+        with pytest.raises(Killed):
+            run_sim_pipelined(model, sim, 3, params, chunk=50,
+                              checkpoint_cb=cb, checkpoint_every=2)
+        ck = load_checkpoint(d)
+        assert 0 < ck["ticks"] < sim.n_ticks
+        template = _init_pipelined(model, sim, 3, params,
+                                   np.arange(8, dtype=np.int32))
+        resume = ResumeState(
+            carry=restore_carry(template, ck["carry"]),
+            ticks=ck["ticks"], chunks=ck["chunks"],
+            compact=tuple(ck["compact"]),
+            journal=tuple(ck["journal"]))
+        res = run_sim_pipelined(model, sim, 3, params, chunk=50,
+                                resume=resume)
+        np.testing.assert_array_equal(base.events, res.events)
+        for a, b in zip(jax.tree.leaves(base.carry),
+                        jax.tree.leaves(res.carry)):
+            np.testing.assert_array_equal(np.asarray(a),
+                                          np.asarray(b))
+
+    def test_fuzz_lane_rides_the_heartbeat(self, tmp_path):
+        """Chunked fuzz runs stream schedules-active counters per
+        chunk, the run-start header carries the distribution + fleet
+        coverage, `watch` renders the lane, and triage bundles gain
+        the instance's reconstructed schedule."""
+        from maelstrom_tpu.telemetry.stream import (read_heartbeat,
+                                                    render_chunk_line)
+        model = get_model("echo", 2)
+        opts = dict(node_count=2, concurrency=2, n_instances=8,
+                    record_instances=2, time_limit=0.3, rate=100.0,
+                    latency=5.0, recovery_time=0.05, seed=3,
+                    fault_fuzz=dict(ACTIVE_DIST, gap=[20, 80],
+                                    links=None, skew=None),
+                    funnel=False, store_root=str(tmp_path),
+                    pipeline="on", chunk_ticks=50)
+        run_tpu_test(model, opts)
+        run_dir = os.path.realpath(
+            os.path.join(str(tmp_path), "echo-tpu", "latest"))
+        hb = read_heartbeat(run_dir)
+        header = hb["header"]
+        assert header["faults"]["fuzz"]["lanes"] == ["crash-restart"]
+        cov = header["fault-fuzz"]
+        assert cov["instances"] == 8
+        assert cov["distinct-schedules"] >= 2
+        lanes = [rec.get("fault-fuzz") for rec in hb["chunks"]]
+        assert all(x is not None for x in lanes)
+        assert any(x["schedules-active"] > 0 for x in lanes)
+        rendered = [render_chunk_line(rec) for rec in hb["chunks"]]
+        assert any("fuzz[" in line for line in rendered)
+
+    @pytest.mark.slow
+    def test_triage_bundle_carries_schedule(self, tmp_path):
+        from maelstrom_tpu.checkers.triage import triage_run
+        model = get_model("lin-kv-bug-forget-snapshot", 3)
+        opts = dict(HIT_OPTS, store_root=str(tmp_path),
+                    heartbeat=True, pipeline="on", chunk_ticks=100)
+        res = run_tpu_test(model, opts)
+        assert res["valid?"] is False
+        run_dir = os.path.realpath(os.path.join(
+            str(tmp_path), "lin-kv-bug-forget-snapshot-tpu", "latest"))
+        summary = triage_run(run_dir, max_instances=1)
+        inst_dir = summary["triaged"][0]["dir"]
+        with open(os.path.join(inst_dir, "schedule.json")) as f:
+            plan = json.load(f)
+        assert plan["phases"]
+        with open(os.path.join(inst_dir, "repro.json")) as f:
+            repro = json.load(f)
+        assert "shrink-command" in repro
+
+    def test_host_runtimes_reject_fault_fuzz(self, capsys):
+        """The PR 9 rejection pattern extends to --fault-fuzz: host
+        runtimes have one real cluster and no schedule-RNG lane
+        (nemesis.py parity note, PARITY.md)."""
+        from maelstrom_tpu.cli import main
+        rc = main(["test", "-w", "echo", "--runtime", "process",
+                   "--fault-fuzz", "nonexistent.json"])
+        assert rc == 2
+        err = capsys.readouterr().err
+        assert "--runtime tpu only" in err
+
+
+# --- overhead --------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_fuzz_overhead_within_noise():
+    """The bench A/B bar (BENCH_FUZZ=0): an all-healthy distribution's
+    schedule draw + per-tick plane select stay within the telemetry-
+    style noise allowance of the bare pipelined path, with identical
+    trajectories."""
+    import time
+
+    model = get_model("echo", 2)
+    opts = dict(node_count=2, concurrency=4, n_instances=256,
+                record_instances=1, time_limit=0.5, rate=200.0,
+                latency=5.0, seed=7, funnel=False)
+    params = model.make_params(2)
+
+    def run_one(with_fuzz):
+        sim = make_sim_config(
+            model, dict(opts, **({"fault_fuzz": HEALTHY_DIST}
+                                 if with_fuzz else {})))
+        best = float("inf")
+        delivered = None
+        for i in range(3):
+            t0 = time.monotonic()
+            res = run_sim_pipelined(model, sim, 7, params, chunk=100)
+            dt = time.monotonic() - t0
+            if i > 0:   # skip the compile-inclusive first pass
+                best = min(best, dt)
+            delivered = int(res.carry.stats.delivered)
+        return best, delivered
+
+    base_s, base_d = run_one(False)
+    fuzz_s, fuzz_d = run_one(True)
+    assert base_d == fuzz_d   # identical trajectories
+    ratio = fuzz_s / base_s
+    print(f"fuzz overhead: {base_s:.3f}s -> {fuzz_s:.3f}s "
+          f"(x{ratio:.3f})")
+    assert ratio < 1.25, (base_s, fuzz_s)

@@ -270,6 +270,51 @@ ls "$FAULT_RUN"/triage/instance-*/messages.svg
 ls "$FAULT_RUN"/triage/instance-*/repro.json
 
 echo
+echo "== fault-fuzz smoke (randomized schedules -> amnesia hit -> shrink)"
+# the fuzzer's loop end-to-end: a small fuzzed sweep over the planted
+# snapshot-amnesia mutant — every instance draws its OWN randomized
+# crash/link/skew schedule on device — must flag instances and exit 1;
+# `maelstrom shrink` must then reconstruct a flagged instance's
+# schedule from the seed, delta-debug it, and emit a shrunk-plan.json
+# with strictly fewer phases/victims whose deterministic replay still
+# trips the committed-prefix invariant (every kept reduction is
+# verified by replay; shrink exits nonzero otherwise)
+cat > "$SMOKE_STORE/fuzz_dist.json" <<'JSON'
+{"windows": [2, 2], "gap": [150, 260], "duration": [50, 90],
+ "crash": {"rate": 1.0, "victims": [2, 2]},
+ "links": {"rate": 0.6, "edges": [1, 3], "block": 0.5,
+           "delay": [0, 20], "loss": [0.0, 0.2]},
+ "skew": {"rate": 0.4, "victims": [1, 1], "range": [0.75, 1.5]}}
+JSON
+rc=0
+python -m maelstrom_tpu test --runtime tpu -w lin-kv-bug-forget-snapshot \
+    --node-count 3 --concurrency 4 --rate 300 --time-limit 0.8 \
+    --n-instances 16 --record-instances 2 --rpc-timeout 0.08 \
+    --recovery-time 0.1 --fault-fuzz "$SMOKE_STORE/fuzz_dist.json" \
+    --pipeline on --chunk-ticks 100 --seed 7 \
+    --store "$SMOKE_STORE" > "$SMOKE_STORE/fuzz-smoke.json" || rc=$?
+[[ "$rc" == "1" ]] || { echo "expected exit 1 (fuzzed amnesia caught), got $rc"; exit 1; }
+FUZZ_RUN="$SMOKE_STORE"/lin-kv-bug-forget-snapshot-tpu/latest
+grep -q '"fault-fuzz"' "$FUZZ_RUN"/heartbeat.jsonl  # fuzz lane streamed
+python -m maelstrom_tpu shrink "$FUZZ_RUN" --max-instances 1 \
+    --max-attempts 6
+ls "$FUZZ_RUN"/triage/instance-*/shrunk-plan.json
+python - "$FUZZ_RUN" <<'PY'
+import glob, json, sys
+rec = json.load(open(glob.glob(sys.argv[1]
+                               + "/triage/instance-*/shrink.json")[0]))
+assert rec["verified"], rec
+assert (rec["shrunk-phases"], rec["shrunk-victims"]) \
+    < (rec["original-phases"], rec["original-victims"]), rec
+plan = json.load(open(rec["shrunk-plan-file"]))
+assert plan["phases"], plan
+print(f"fuzz smoke: instance {rec['instance']} shrank "
+      f"{rec['original-phases']}p/{rec['original-victims']}v -> "
+      f"{rec['shrunk-phases']}p/{rec['shrunk-victims']}v in "
+      f"{rec['attempts']} replays (still failing)")
+PY
+
+echo
 echo "== campaign smoke (submit -> SIGKILL mid-run -> resume -> oracle)"
 # a 2-item campaign: a clean echo sweep (long enough that the SIGKILL
 # lands mid-horizon) and the planted double-vote mutant. The worker is
