@@ -238,7 +238,13 @@ def child_main(canary: bool = False) -> None:
             continue   # BENCH_INSTANCES >= 16384: k1 already covers it
         opts = dict(node_count=3, concurrency=6,
                     n_instances=cfg_n_instances,
-                    record_instances=1,
+                    # BENCH_RECORD_INSTANCES raises the recorded-
+                    # instance count to bench the host verdict stage
+                    # at fleet scale (more instances = more per-tick
+                    # event-fold work on device — an explicit knob,
+                    # never the default headline config)
+                    record_instances=int(os.environ.get(
+                        "BENCH_RECORD_INSTANCES", "1")),
                     time_limit=cfg_sim_seconds,
                     rate=200.0, latency=5.0, rpc_timeout=1.0,
                     nemesis=["partition"], nemesis_interval=0.4,
@@ -367,6 +373,15 @@ def child_main(canary: bool = False) -> None:
                            and os.environ.get("BENCH_HEARTBEAT") != "0")
         pipe_bytes = {"fetched": 0, "overflowed": 0}
         hb_state = {"writer": None, "chunk": 0}
+        # host verdict stage (checkers/pool.py): the pipelined path
+        # keeps each chunk's compacted rows so the recorded instances
+        # can be decoded + checked after the timed window, with
+        # BENCH_CHECK_WORKERS as the farm-size knob (0 = serial A/B).
+        # BENCH_CHECK=0 skips the stage AND the row retention (a long
+        # fleet-scale bench must not accumulate rows it will discard)
+        bench_check = os.environ.get("BENCH_CHECK") != "0"
+        compact_acc = []
+        check_stats = {}
         if bench_heartbeat:
             import tempfile
             from maelstrom_tpu.telemetry.stream import HeartbeatWriter
@@ -402,6 +417,12 @@ def child_main(canary: bool = False) -> None:
                 heartbeat record when enabled. Returns
                 (sent, delivered, ovf)."""
                 rows, n, overflowed = fetch_compact_payload(buf)
+                if bench_check:
+                    # retain only the occupied prefix (copy detaches
+                    # it from the cap-sized buffer) — retention scales
+                    # with actual events, not event-capacity x chunks
+                    compact_acc.append((rows[:min(n, rows.shape[0])]
+                                        .copy(), n))
                 pipe_bytes["fetched"] += compact_payload_bytes(rows)
                 pipe_bytes["cap"] = max(pipe_bytes.get("cap", 0),
                                         rows.shape[0])
@@ -517,6 +538,8 @@ def child_main(canary: bool = False) -> None:
                         rec["event_bytes_dense"] / pipe_bytes["fetched"],
                         1)
                 rec["overflowed_chunks"] = pipe_bytes["overflowed"]
+            if check_stats:
+                rec.update(check_stats)
             # latency quantiles read the live carry's histogram — a
             # device sync, so the overlapped timed loop defers it to
             # the final (blocked-anyway) line
@@ -633,6 +656,38 @@ def child_main(canary: bool = False) -> None:
         if pending is not None:
             # drain the last in-flight chunk (blocks on the device)
             drain_and_emit(*pending, final=True)
+        # host verdict stage: vectorized decode of the compacted
+        # stream + the workload checker over the recorded instances,
+        # pooled per BENCH_CHECK_WORKERS (unset = auto, 0 = serial
+        # A/B) — the metric line prices the host side of a checked
+        # run next to the device msgs/s (BENCH_CHECK=0 skips)
+        if bench_pipeline and bench_check and compact_acc:
+            from maelstrom_tpu.checkers.pool import (
+                VerdictPipeline, resolve_check_workers)
+            cw = resolve_check_workers(
+                os.environ.get("BENCH_CHECK_WORKERS"),
+                sim.record_instances)
+            vp = VerdictPipeline(model, sim.client.n_clients,
+                                 sim.record_instances,
+                                 sim.client.final_start, 1, opts, cw)
+            for vrows, vn in compact_acc:
+                vp.feed_chunk(vrows, vn, 0, 0)
+            verdicts, _vh, vrec = vp.finish()
+            check_stats.update(
+                check_workers=vrec["workers"],
+                check_mode=vrec["mode"],
+                decode_s=vrec["decode-s"],
+                check_s=vrec["check-s"],
+                verdicts_per_s=vrec["verdicts-per-s"],
+                check_valid=sum(1 for v in verdicts
+                                if v.get("valid?") in (True, "unknown")))
+            log(TAG, f"phase[{cfg_name}]: verdict stage "
+                     f"{vrec['mode']} x{vrec['workers']} — decode "
+                     f"{vrec['decode-s']}s, check {vrec['check-s']}s "
+                     f"({sim.record_instances} instance(s))")
+            emit(delivered - delivered0, delivered, sent, ovf, ticks,
+                 wall, complete=(ticks + W > n_ticks),
+                 with_latency=False)
         # funnel at the headline config (VERDICT r4 next #5): replay
         # tripped + sampled instances bit-exactly, full-check each, and
         # re-emit the final line carrying the funnel block

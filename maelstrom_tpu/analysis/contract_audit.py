@@ -37,6 +37,12 @@ CON204   int32-counter-overflow  error     runtime counters (NETID stamp,
                                            the tick horizon
 =======  ======================  ========  =================================
 
+``fused_node`` models (the raft family) have no legacy
+``handle``/``tick`` pair — CON202 probes their compartmentalized
+protocol instead (``node_rng`` -> ``inbox_step`` -> ``fused_tick``,
+exactly what ``runtime.node_phase`` drives), with ``inbox_step``'s
+single reply row checked against the ``(max_out=1, lanes)`` contract.
+
 The tick horizon used by CON204 is ``TICK_HORIZON = 1 << 20``: the
 delivery priority in ``tpu/netsim.py`` ranks messages by
 ``((1 << 20) - deliver_tick) * S``, so any simulation past 2^20 ticks
@@ -154,14 +160,35 @@ def audit_model(model, node_count: int, label: Optional[str] = None,
         return findings
 
     # --- CON202/CON203: per-method probes ---------------------------------
+    # fused_node models speak the compartmentalized protocol ONLY (the
+    # legacy handle()/tick() formulation was deleted after PR 6's soak
+    # window): probe node_rng -> inbox_step -> fused_tick, the exact
+    # methods runtime.node_phase drives, with inbox_step's single reply
+    # row widened to the (max_out, lanes) contract shape
+    fused = bool(getattr(model, "fused_node", False))
+
     def probe():
         key = jax.random.PRNGKey(0)
         row = model.init_row(cfg.n_nodes, jnp.int32(0), key, params)
         msg = jnp.zeros((cfg.lanes,), jnp.int32)
-        row_h, outs = model.handle(row, jnp.int32(0), msg, jnp.int32(0),
-                                   key, cfg, params)
-        row_t, touts = model.tick(row, jnp.int32(0), jnp.int32(0), key,
-                                  cfg, params)
+        if fused:
+            mkeys = jax.vmap(
+                lambda i: jax.random.fold_in(key, i))(
+                jnp.arange(cfg.inbox_k + 1, dtype=jnp.int32))
+            slot_rng, tick_rng = model.node_rng(mkeys)
+            rng0 = jax.tree_util.tree_map(lambda a: a[0], slot_rng)
+            row_h, outs = model.inbox_step(row, jnp.int32(0), msg,
+                                           rng0, jnp.int32(0), cfg,
+                                           params)
+            outs = outs[None]     # one reply row per slot (max_out==1)
+            row_t, touts = model.fused_tick(row, jnp.int32(0),
+                                            jnp.int32(0), tick_rng,
+                                            cfg, params)
+        else:
+            row_h, outs = model.handle(row, jnp.int32(0), msg,
+                                       jnp.int32(0), key, cfg, params)
+            row_t, touts = model.tick(row, jnp.int32(0), jnp.int32(0),
+                                      key, cfg, params)
         state = jax.tree_util.tree_map(
             lambda a: jnp.broadcast_to(a, (cfg.n_nodes,) + a.shape), row)
         inv = model.invariants(state, cfg, params)
@@ -185,22 +212,26 @@ def audit_model(model, node_count: int, label: Optional[str] = None,
              f"abstract evaluation of the model's traced methods "
              f"raised {type(e).__name__}: {e}")
 
+    handle_name = "inbox_step" if fused else "handle"
+    tick_name = "fused_tick" if fused else "tick"
     if shapes is not None:
         outs, touts = shapes["outs"], shapes["touts"]
         if tuple(outs.shape) != (model.max_out, cfg.lanes) \
                 or str(outs.dtype) != "int32":
-            flag("CON202", "emit-shape-contract", symbol=f"{cls}.handle",
-                 message=f"handle() emits {tuple(outs.shape)} "
+            flag("CON202", "emit-shape-contract",
+                 symbol=f"{cls}.{handle_name}",
+                 message=f"{handle_name}() emits {tuple(outs.shape)} "
                          f"{outs.dtype}, declared (max_out={model.max_out}"
                          f", lanes={cfg.lanes}) int32")
         if tuple(touts.shape) != (model.tick_out, cfg.lanes) \
                 or str(touts.dtype) != "int32":
-            flag("CON202", "emit-shape-contract", symbol=f"{cls}.tick",
-                 message=f"tick() emits {tuple(touts.shape)} "
+            flag("CON202", "emit-shape-contract",
+                 symbol=f"{cls}.{tick_name}",
+                 message=f"{tick_name}() emits {tuple(touts.shape)} "
                          f"{touts.dtype}, declared (tick_out="
                          f"{model.tick_out}, lanes={cfg.lanes}) int32")
-        for which, after in (("handle", shapes["row_h"]),
-                             ("tick", shapes["row_t"])):
+        for which, after in ((handle_name, shapes["row_h"]),
+                             (tick_name, shapes["row_t"])):
             for m in _tree_mismatches(shapes["row"], after):
                 flag("CON202", "emit-shape-contract",
                      symbol=f"{cls}.{which}",

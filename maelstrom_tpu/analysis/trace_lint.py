@@ -538,6 +538,7 @@ def default_trace_targets(repo_root: str) -> List[str]:
             "maelstrom_tpu/telemetry/recorder.py",
             "maelstrom_tpu/telemetry/stream.py",
             "maelstrom_tpu/checkers/triage.py",
+            "maelstrom_tpu/checkers/pool.py",
             "maelstrom_tpu/campaign/*.py",
             "maelstrom_tpu/faults/*.py",
             # host-side analysis code, but its verdicts gate traced

@@ -15,7 +15,33 @@ def compose_valid(verdicts) -> object:
     return out
 
 
-def check_history(history, opts, checker, extra=None):
+def checker_failure(exc, checker=None, instance=None,
+                    tb_limit: int = 1200) -> dict:
+    """A checker blow-up as a structured failing verdict: instance id,
+    checker name, and a truncated traceback ride in the result dict —
+    an exception is a *reason the analysis is invalid*, never a bare
+    string (and never a crash of the surrounding run). ``compose_valid``
+    counts it as a definite False.
+
+    The formatted traceback DROPS its first frame — the harness/pool
+    call site invoking the checker — so a pooled verdict and the serial
+    oracle's verdict for the same blow-up are byte-identical (the
+    call-site frame is the one thing that legitimately differs between
+    a farm worker and the in-process loop)."""
+    import traceback
+    out = {"valid?": False, "error": repr(exc)}
+    if checker is not None:
+        out["checker"] = checker
+    if instance is not None:
+        out["instance"] = int(instance)
+    tb = exc.__traceback__
+    tb = tb.tb_next if tb is not None and tb.tb_next is not None else tb
+    text = "".join(traceback.format_exception(type(exc), exc, tb))
+    out["traceback"] = text[-tb_limit:]
+    return out
+
+
+def check_history(history, opts, checker, extra=None, name=None):
     """Compose the standard checkers over one recorded history.
 
     Shared by the live runner and the offline ``check`` command so the
@@ -23,7 +49,9 @@ def check_history(history, opts, checker, extra=None):
     same composition). ``extra`` merges additional pre-computed results
     (e.g. the live runner's journal-based net stats) into the composed
     map before the verdict is taken. A workload checker that raises
-    becomes a failing result with the error attached, not a crash."""
+    becomes a failing result with the error attached, not a crash;
+    ``name`` labels the blow-up verdict's ``checker`` field (falls back
+    to the generic "workload")."""
     import traceback
 
     from .availability import availability_checker
@@ -42,7 +70,8 @@ def check_history(history, opts, checker, extra=None):
             results["workload"] = checker(history, opts)
         except Exception as e:
             traceback.print_exc()
-            results["workload"] = {"valid?": False, "error": repr(e)}
+            results["workload"] = checker_failure(
+                e, checker=name or "workload")
     results["valid?"] = compose_valid(
         r.get("valid?", True)
         for r in results.values() if isinstance(r, dict))

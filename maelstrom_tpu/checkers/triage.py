@@ -235,7 +235,13 @@ def triage_run(run_dir: str, ids: Optional[List[int]] = None,
         try:
             verdict = checker(history, sub_opts)
         except Exception as e:
-            verdict = {"valid?": False, "error": repr(e)}
+            # structured blow-up verdict (instance id + checker name +
+            # truncated traceback) — same contract as the harness's
+            # verdict pipeline (checkers/pool.py)
+            from . import checker_failure
+            from .pool import checker_name
+            verdict = checker_failure(e, checker=checker_name(model),
+                                      instance=gid)
         journal = TpuJournal(model, sim.net, res.journal_sends,
                              res.journal_recvs, instance=k,
                              ms_per_tick=ms_per_tick)

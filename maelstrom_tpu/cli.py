@@ -228,6 +228,16 @@ def add_test_options(p: argparse.ArgumentParser):
                         "at any point resumes BIT-EXACTLY via "
                         "`maelstrom campaign resume <run-dir>` "
                         "(doc/guide/09-campaigns.md)")
+    p.add_argument("--check-workers", type=_nonnegative_int,
+                   default=None,
+                   help="TPU runtime: checker-farm worker processes "
+                        "for the host verdict pipeline (checkers/"
+                        "pool.py) — per-instance histories decode and "
+                        "check in parallel, streaming per chunk. 0 "
+                        "forces the serial path; default auto uses a "
+                        "pool only for >= 16 recorded instances on a "
+                        "multi-core host. Verdicts are identical at "
+                        "every setting")
     p.add_argument("--compile-cache", default=".jax_cache",
                    help="persistent XLA compile cache dir (default "
                         ".jax_cache; MAELSTROM_COMPILE_CACHE=0 or "
@@ -462,6 +472,7 @@ def cmd_test(args) -> int:
             scan_top_k=args.scan_top_k,
             checkpoint_every=args.checkpoint_every,
             compile_cache=args.compile_cache,
+            check_workers=args.check_workers,
             node_count=node_count, concurrency=concurrency,
             rate=args.rate, time_limit=args.time_limit,
             latency=args.latency, latency_dist=args.latency_dist,
@@ -769,7 +780,8 @@ def cmd_check(args) -> int:
     histories = [_load_history_records(p) for p in paths]
 
     if len(histories) == 1 and not tpu_store:
-        results = check_history(histories[0], opts, checker)
+        results = check_history(histories[0], opts, checker,
+                                name=f"{workload_name}-checker")
     else:
         # multi-instance (TPU) run: the workload checker runs per
         # instance; stats/availability are fleet-wide over the union —
@@ -783,7 +795,10 @@ def cmd_check(args) -> int:
             try:
                 per_history.append(checker(h, opts))
             except Exception as e:
-                per_history.append({"valid?": False, "error": repr(e)})
+                from .checkers import checker_failure
+                per_history.append(checker_failure(
+                    e, checker=f"{workload_name}-checker",
+                    instance=len(per_history)))
         union = [r for h in histories for r in h]
         # fleet stats are informational here (the live TPU harness does
         # not gate on them: a recorded instance that never completed an

@@ -82,6 +82,7 @@ class GossipSetModel(Model):
     """
 
     name = "g-set"
+    checker_name = "set-full"
     n_values = 64              # element domain (2 x int32 bitmask words)
     body_lanes = 2
     max_out = 1
@@ -226,6 +227,7 @@ class PNCounterModel(Model):
     pointwise max; read returns sum(plus) - sum(minus)."""
 
     name = "pn-counter"
+    checker_name = "pn-counter"
     max_out = 1
     tick_out = 1
     gossip_prob = 0.5

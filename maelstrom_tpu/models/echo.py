@@ -23,6 +23,7 @@ F_ECHO = 1
 
 class EchoModel(Model):
     name = "echo"
+    checker_name = "echo"
     body_lanes = 2
     max_out = 1
     tick_out = 0

@@ -59,6 +59,7 @@ class KafkaRow(NamedTuple):
 
 class KafkaModel(Model):
     name = "kafka"
+    checker_name = "kafka"
     max_out = 1
     idempotent_fs = (F_POLL, F_LIST)
     # schema-conformance map (SCH305): registry RPC name -> wire TYPE.

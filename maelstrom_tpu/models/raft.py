@@ -68,6 +68,7 @@ class RaftRow(NamedTuple):
 
 class RaftModel(Model):
     name = "lin-kv"
+    checker_name = "linearizable-kv"
     body_lanes = 12           # AppendEntries header (6) + entry_lanes
     entry_lanes = ENTRY_LANES  # log entry width; replicated-state-machine
                                # subclasses (txn models) widen this
@@ -160,420 +161,23 @@ class RaftModel(Model):
         (lane-contiguous: f, the three op lanes, src, msg id). The f
         code IS the wire type for client requests (T_READ..T_CAS ==
         F_READ..F_CAS == 1..3); for any other message type the encoded
-        row is garbage either way — both the legacy and the fused node
-        step only ever commit it to the log under cli_accept, which
-        implies a client request."""
+        row is garbage either way — the fused node step only ever
+        commits it to the log under cli_accept, which implies a client
+        request."""
         return jnp.concatenate(
             [msg[wire.TYPE:wire.TYPE + 1],
              msg[wire.BODY:wire.BODY + 3], src[None],
              msg[wire.MSGID:wire.MSGID + 1]])
 
-    # --- helpers ----------------------------------------------------------
-
-    def _last_log_term(self, row: RaftRow):
-        return jnp.where(row.log_len > 0,
-                         row.log_term[jnp.maximum(row.log_len - 1, 0)], 0)
-
-    def _step_down(self, row: RaftRow, new_term, t):
-        """Adopt a higher term as follower."""
-        higher = new_term > row.term
-        return row._replace(
-            term=jnp.where(higher, new_term, row.term),
-            role=jnp.where(higher, 0, row.role),
-            voted_for=jnp.where(higher, -1, row.voted_for),
-            votes=jnp.where(higher, 0, row.votes),
-        )
-
-    def _reset_election(self, row: RaftRow, t, key):
-        jitter = jax.random.randint(key, (), 0, self.elect_jitter)
-        return row._replace(
-            election_deadline=(t + self.elect_min + jitter).astype(
-                jnp.int32))
-
-    def _reply(self, cfg, dest, type_, reply_to, body_vals):
-        return wire.make_msg(src=0, dest=dest, type_=type_,
-                             reply_to=reply_to, body=body_vals,
-                             body_lanes=self.body_lanes,
-                             netid=cfg.netid)[None]
-
-    # --- message handlers -------------------------------------------------
-
-    def handle(self, row: RaftRow, node_idx, msg, t, key, cfg, params):
-        """Fused single-pass handler: every RaftRow field is computed once
-        across all message types, and the log is touched by exactly ONE
-        drop-mode scatter — no full-log selects. (The per-type pick()
-        formulation cost ~5 full-state wheres per inbox slot and dominated
-        the tick; this shape is ~2x faster end-to-end.) Self-gating: an
-        invalid message has type 0, which matches no branch, so state is
-        unchanged and the out row stays invalid."""
-        mtype = msg[wire.TYPE]
-        src = msg[wire.SRC]
-        body0 = msg[wire.BODY]
-        n = cfg.n_nodes
-
-        is_vote = mtype == T_REQ_VOTE
-        is_vrep = mtype == T_VOTE_REPLY
-        is_ae = mtype == T_APPEND
-        is_arep = mtype == T_APPEND_REPLY
-        is_cli = self._is_client_request(mtype)
-        is_proto = is_vote | is_vrep | is_ae | is_arep
-
-        # --- term adoption / step-down (every protocol message carries
-        # the sender term in body lane 0)
-        higher = is_proto & (body0 > row.term)
-        term = jnp.where(higher, body0, row.term)
-        role = jnp.where(higher, 0, row.role)
-        voted_for = jnp.where(higher, -1, row.voted_for)
-        votes = jnp.where(higher, 0, row.votes)
-
-        # --- RequestVote
-        c_lli = msg[wire.BODY + 1]
-        c_llt = msg[wire.BODY + 2]
-        my_llt = self._last_log_term(row)
-        if self.vote_check_log_index:
-            log_ok = (c_llt > my_llt) | ((c_llt == my_llt)
-                                         & (c_lli >= row.log_len))
-        else:
-            # BUG variant: recency compares terms only — a shorter-log
-            # candidate at the same term can win and truncate entries
-            log_ok = c_llt >= my_llt
-        grant = is_vote & (body0 == term)
-        if self.vote_check_voted_for:
-            grant = grant & ((voted_for == -1) | (voted_for == src))
-        if self.vote_check_log:
-            grant = grant & log_ok
-        voted_for = jnp.where(grant, src, voted_for)
-
-        # --- VoteReply
-        granted = is_vrep & (msg[wire.BODY + 1] == 1)
-        count_it = (role == 1) & (body0 == term) & granted
-        votes = jnp.where(count_it,
-                          votes | (1 << src).astype(jnp.int32), votes)
-        n_votes = jnp.sum((votes[None] >> jnp.arange(n)) & 1) + 1  # + self
-        win = count_it & (n_votes > n // 2)
-        role = jnp.where(win, 2, role)
-
-        # --- AppendEntries
-        prev_idx = msg[wire.BODY + 1]
-        prev_term = msg[wire.BODY + 2]
-        l_commit = msg[wire.BODY + 3]
-        n_entries = msg[wire.BODY + 4]
-        e_term = msg[wire.BODY + 5]
-        e_body = msg[wire.BODY + 6:wire.BODY + 6 + self.entry_lanes]
-        ae_current = is_ae & (body0 == term)
-        # current-term AE: candidate steps down, sender is the leader hint
-        role = jnp.where(ae_current & (role == 1), 0, role)
-        leader_hint = jnp.where(ae_current, src, row.leader_hint)
-        prev_ok = (prev_idx == 0) | (
-            (prev_idx <= row.log_len)
-            & (row.log_term[jnp.clip(prev_idx - 1, 0, self.log_cap - 1)]
-               == prev_term))
-        fits = prev_idx < self.log_cap
-        accept = ae_current & prev_ok & ((n_entries == 0) | fits)
-        ae_write = accept & (n_entries == 1)
-        ae_widx = jnp.clip(prev_idx, 0, self.log_cap - 1)
-        same = (row.log_len > prev_idx) & (row.log_term[ae_widx] == e_term)
-        ae_len = jnp.where(
-            ae_write,
-            jnp.where(same, jnp.maximum(row.log_len, prev_idx + 1),
-                      prev_idx + 1),
-            row.log_len)
-        match_ack = jnp.where(accept, prev_idx + n_entries, 0)
-
-        # --- client request (append to own log as leader, else proxy)
-        is_leader = role == 2
-        cli_accept = is_cli & is_leader & (row.log_len < self.log_cap)
-        stale_read = jnp.bool_(False)
-        if self.serve_reads_locally:
-            # BUG variant: reads bypass the log entirely
-            stale_read = is_cli & (mtype == T_READ)
-            cli_accept = cli_accept & ~stale_read
-        cli_entry = self._encode_entry(msg, src)
-        hops = msg[wire.BODY + self.proxy_hops_lane]
-        forward = (is_cli & ~cli_accept & ~stale_read
-                   & (row.leader_hint >= 0)
-                   & (row.leader_hint != node_idx) & (hops < 3))
-
-        # --- the single log write (AE entry or client append; exclusive)
-        write = ae_write | cli_accept
-        widx = jnp.where(ae_write, ae_widx, row.log_len)
-        slot = jnp.where(write, jnp.clip(widx, 0, self.log_cap - 1),
-                         self.log_cap)
-        w_term = jnp.where(ae_write, e_term, term)
-        w_body = jnp.where(ae_write, e_body, cli_entry)
-        log_term = row.log_term.at[slot].set(w_term, mode="drop")
-        log_body = row.log_body.at[slot].set(w_body, mode="drop")
-        log_len = jnp.where(cli_accept, row.log_len + 1, ae_len)
-
-        # Leader-Completeness witness: a conflicting AppendEntries write
-        # below this node's own commit index overwrites a committed
-        # entry. Correct Raft can never do this; the no-term-guard
-        # mutant does, on the Figure-8 schedule.
-        truncated_committed = row.truncated_committed | (
-            ae_write & ~same & (ae_widx < row.commit_idx)).astype(jnp.int32)
-
-        # --- commit advance (Raft §5.3: min(leaderCommit, last new entry))
-        commit_idx = jnp.where(
-            accept,
-            jnp.maximum(row.commit_idx,
-                        jnp.minimum(l_commit, match_ack)),
-            row.commit_idx)
-
-        # --- AppendEntriesReply bookkeeping (leader side)
-        r_success = msg[wire.BODY + 1] == 1
-        r_match = msg[wire.BODY + 2]
-        mine = is_arep & is_leader & (body0 == term)
-        src_c = jnp.clip(src, 0, n - 1)
-        nxt = row.next_idx[src_c]
-        nxt = jnp.where(mine & r_success, jnp.maximum(nxt, r_match),
-                        jnp.where(mine & ~r_success,
-                                  jnp.maximum(nxt - 1, 0), nxt))
-        next_idx = row.next_idx.at[src_c].set(nxt)
-        # on winning an election: reset replication state
-        next_idx = jnp.where(win, row.log_len, next_idx)
-        mtch = jnp.where(mine & r_success,
-                         jnp.maximum(row.match_idx[src_c], r_match),
-                         row.match_idx[src_c])
-        match_idx = row.match_idx.at[src_c].set(mtch)
-        match_idx = jnp.where(win, jnp.zeros_like(match_idx), match_idx)
-        match_idx = match_idx.at[node_idx].set(
-            jnp.where(win, row.log_len, match_idx[node_idx]))
-        match_idx = jnp.where(
-            cli_accept,
-            match_idx.at[node_idx].set(row.log_len + 1), match_idx)
-        last_hb = jnp.where(win, t - self.heartbeat, row.last_hb)
-
-        # --- election timer: reset on vote grant or current-term AE
-        jitter = jax.random.randint(key, (), 0, self.elect_jitter)
-        election_deadline = jnp.where(
-            grant | ae_current,
-            (t + self.elect_min + jitter).astype(jnp.int32),
-            row.election_deadline)
-
-        row = RaftRow(term=term, voted_for=voted_for, role=role,
-                      votes=votes, commit_idx=commit_idx,
-                      last_applied=row.last_applied, log_term=log_term,
-                      log_body=log_body, log_len=log_len, kv=row.kv,
-                      next_idx=next_idx, match_idx=match_idx,
-                      election_deadline=election_deadline,
-                      last_hb=last_hb, leader_hint=leader_hint,
-                      truncated_committed=truncated_committed)
-
-        # --- the single out row
-        out = jnp.zeros((1, cfg.lanes), dtype=jnp.int32)
-        reply_needed = is_vote | is_ae | (is_cli & ~cli_accept)
-        out = out.at[0, wire.VALID].set(
-            jnp.where(reply_needed, 1, 0))
-        out = out.at[0, wire.DEST].set(
-            jnp.where(forward, row.leader_hint, src))
-        out = out.at[0, wire.TYPE].set(
-            jnp.where(is_vote, T_VOTE_REPLY,
-                      jnp.where(is_ae, T_APPEND_REPLY,
-                                jnp.where(forward, mtype, TYPE_ERROR))))
-        out = out.at[0, wire.REPLYTO].set(
-            jnp.where(forward, -1, msg[wire.MSGID]))
-        # body lanes: a forward echoes the full request body (hops lane
-        # bumped); protocol replies use lanes 0..2; rejections carry
-        # error code 11 in lane 0
-        fwd_body = jax.lax.dynamic_slice(
-            msg, (wire.BODY,), (self.body_lanes,)
-        ).at[self.proxy_hops_lane].add(1)
-        proto_body = jnp.zeros((self.body_lanes,), jnp.int32)
-        proto_body = proto_body.at[0].set(
-            jnp.where(is_vote | is_ae, term, 11))
-        proto_body = proto_body.at[1].set(
-            jnp.where(is_vote, grant.astype(jnp.int32),
-                      jnp.where(is_ae, accept.astype(jnp.int32), 0)))
-        proto_body = proto_body.at[2].set(
-            jnp.where(is_ae, match_ack, 0))
-        out = jax.lax.dynamic_update_slice(
-            out, jnp.where(forward, fwd_body, proto_body)[None],
-            (0, wire.BODY))
-        # a forwarded request keeps the client's msg_id and logical src
-        out = out.at[0, wire.MSGID].set(
-            jnp.where(forward, msg[wire.MSGID], -1))
-        out = out.at[0, wire.SRC].set(jnp.where(forward, src, 0))
-        if self.serve_reads_locally:
-            kk = jnp.clip(msg[wire.BODY], 0, self.n_keys - 1)
-            out = out.at[0, wire.VALID].set(
-                jnp.where(stale_read, 1, out[0, wire.VALID]))
-            out = out.at[0, wire.DEST].set(
-                jnp.where(stale_read, src, out[0, wire.DEST]))
-            out = out.at[0, wire.TYPE].set(
-                jnp.where(stale_read, T_READ_OK, out[0, wire.TYPE]))
-            out = out.at[0, wire.REPLYTO].set(
-                jnp.where(stale_read, msg[wire.MSGID],
-                          out[0, wire.REPLYTO]))
-            out = out.at[0, wire.MSGID].set(
-                jnp.where(stale_read, -1, out[0, wire.MSGID]))
-            out = out.at[0, wire.SRC].set(
-                jnp.where(stale_read, 0, out[0, wire.SRC]))
-            out = out.at[0, wire.BODY].set(
-                jnp.where(stale_read, kk, out[0, wire.BODY]))
-            out = out.at[0, wire.BODY + 1].set(
-                jnp.where(stale_read, row.kv[kk], out[0, wire.BODY + 1]))
-            out = out.at[0, wire.BODY + 3].set(
-                jnp.where(stale_read, 0, out[0, wire.BODY + 3]))
-        return row, out
-
-    # --- per-tick behavior ------------------------------------------------
-
-    def tick(self, row: RaftRow, node_idx, t, key, cfg, params):
-        n = cfg.n_nodes
-        k_elect, k_jit = jax.random.split(key)
-
-        # 1) election timeout -> candidacy
-        timeout = (row.role != 2) & (t >= row.election_deadline)
-        row = row._replace(
-            term=jnp.where(timeout, row.term + 1, row.term),
-            role=jnp.where(timeout, 1, row.role),
-            voted_for=jnp.where(timeout, node_idx, row.voted_for),
-            votes=jnp.where(timeout, 0, row.votes),
-            # make the first vote solicitation fire immediately
-            last_hb=jnp.where(timeout, t - self.heartbeat, row.last_hb),
-            # suspected-dead leader: stop proxying to it
-            leader_hint=jnp.where(timeout, -1, row.leader_hint),
-        )
-        # _reset_election only moves the deadline — select just that
-        # field rather than a full-pytree where (which would lean on
-        # XLA's select(p, x, x) simplification to avoid copying logs)
-        row = row._replace(election_deadline=jnp.where(
-            timeout,
-            self._reset_election(row, t, k_jit).election_deadline,
-            row.election_deadline))
-
-        # 2) leader: advance commit to the median match index (current
-        # term only), then apply
-        is_leader = row.role == 2
-        match = row.match_idx.at[node_idx].set(row.log_len)
-        if self.commit_quorum:
-            sorted_match = jnp.sort(match)               # ascending
-            majority_match = sorted_match[(n - 1) // 2]  # >= on majority
-        else:
-            # BUG variant: commit at the MAX match index — i.e. as soon
-            # as ANY single node (incl. the leader itself) holds the
-            # entry, no majority required; failover loses those entries
-            majority_match = jnp.max(match)
-        if self.commit_term_guard:
-            guard_idx = jnp.clip(majority_match - 1, 0, self.log_cap - 1)
-            current_term_ok = row.log_term[guard_idx] == row.term
-        else:
-            # BUG variant (Raft §5.4.2): commit on replication count alone
-            current_term_ok = jnp.bool_(True)
-        new_commit = jnp.where(
-            is_leader & (majority_match > row.commit_idx)
-            & current_term_ok,
-            majority_match, row.commit_idx)
-        row = row._replace(commit_idx=new_commit, match_idx=match)
-
-        # 3) apply up to apply_max committed entries; leader replies
-        outs = []
-        for _ in range(self.apply_max):
-            row, reply = self._apply_one(row, cfg)
-            outs.append(reply)
-
-        # 4) peer sends: candidates solicit votes (re-solicit on the same
-        # cadence to survive loss), leaders replicate
-        is_leader = row.role == 2
-        solicit = (row.role == 1) & (t - row.last_hb >= self.heartbeat)
-        hb_due = is_leader & (t - row.last_hb >= self.heartbeat)
-        row = row._replace(
-            last_hb=jnp.where(hb_due | solicit, t, row.last_hb))
-        peer_msgs = self._peer_sends(row, node_idx, t, solicit, hb_due, cfg)
-        outs.append(peer_msgs)
-        return row, jnp.concatenate(outs, axis=0)
-
-    def _apply_frontier(self, row: RaftRow):
-        """(do, aidx, entry) for the next entry to apply; the dirty-apply
-        mutant's frontier is the raw log end instead of the commit index."""
-        frontier = (row.log_len if self.apply_uncommitted
-                    else row.commit_idx)
-        do = row.last_applied < frontier
-        aidx = jnp.clip(row.last_applied, 0, self.log_cap - 1)
-        return do, aidx, row.log_body[aidx]
-
-    def _apply_one(self, row: RaftRow, cfg):
-        do, aidx, entry = self._apply_frontier(row)
-        f, k, a, b, client, cmsg = (entry[0], entry[1], entry[2], entry[3],
-                                    entry[4], entry[5])
-        k = jnp.clip(k, 0, self.n_keys - 1)
-        cur = row.kv[k]
-        cas_ok = cur == a
-        new_val = jnp.where(f == F_WRITE, a,
-                            jnp.where((f == F_CAS) & cas_ok, b, cur))
-        kv = jnp.where(do, row.kv.at[k].set(new_val), row.kv)
-        row = row._replace(
-            kv=kv, last_applied=jnp.where(do, row.last_applied + 1,
-                                          row.last_applied))
-
-        # leader replies to the waiting client
-        reply_type = jnp.where(
-            f == F_READ, T_READ_OK,
-            jnp.where(f == F_WRITE, T_WRITE_OK,
-                      jnp.where(cas_ok, T_CAS_OK, TYPE_ERROR)))
-        err_code = jnp.where(cur == NIL, 20, 22)
-        out = jnp.zeros((1, cfg.lanes), dtype=jnp.int32)
-        out = out.at[0, wire.VALID].set(
-            jnp.where(do & (row.role == 2), 1, 0))
-        out = out.at[0, wire.DEST].set(client)
-        out = out.at[0, wire.TYPE].set(reply_type)
-        out = out.at[0, wire.REPLYTO].set(cmsg)
-        # read replies carry (key, value); cas errors carry the code
-        out = out.at[0, wire.BODY].set(
-            jnp.where(reply_type == TYPE_ERROR, err_code, k))
-        out = out.at[0, wire.BODY + 1].set(cur)
-        return row, out
-
-    def _peer_sends(self, row: RaftRow, node_idx, t, solicit, hb_due, cfg):
-        """One message per peer slot (N-1 rows): RequestVote when a
-        soliciting candidate, AppendEntries on the leader's heartbeat
-        cadence."""
-        n = cfg.n_nodes
-        # peers = all nodes except self, packed into n-1 slots
-        slots = jnp.arange(n - 1, dtype=jnp.int32)
-        peers = jnp.where(slots >= node_idx, slots + 1, slots)
-
-        def per_peer(peer):
-            vote_body = [row.term, row.log_len, self._last_log_term(row)]
-            prev_idx = row.next_idx[peer]
-            has_entry = row.log_len > prev_idx
-            eidx = jnp.clip(prev_idx, 0, self.log_cap - 1)
-            pidx = jnp.clip(prev_idx - 1, 0, self.log_cap - 1)
-            append_body = [row.term, prev_idx,
-                           jnp.where(prev_idx > 0, row.log_term[pidx], 0),
-                           row.commit_idx,
-                           has_entry.astype(jnp.int32),
-                           row.log_term[eidx]]
-            out = jnp.zeros((cfg.lanes,), dtype=jnp.int32)
-            send_vote = solicit
-            send_append = hb_due
-            out = out.at[wire.VALID].set(
-                jnp.where(send_vote | send_append, 1, 0))
-            out = out.at[wire.DEST].set(peer)
-            out = out.at[wire.TYPE].set(
-                jnp.where(send_vote, T_REQ_VOTE, T_APPEND))
-            for i, v in enumerate(vote_body):
-                out = out.at[wire.BODY + i].set(
-                    jnp.where(send_vote, v, append_body[i]))
-            for i in range(len(vote_body), len(append_body)):
-                out = out.at[wire.BODY + i].set(
-                    jnp.where(send_vote, 0, append_body[i]))
-            entry = row.log_body[eidx] * has_entry.astype(jnp.int32)
-            out = jax.lax.dynamic_update_slice(
-                out, jnp.where(send_vote, 0, entry),
-                (wire.BODY + 6,))
-            return out
-
-        return jax.vmap(per_peer)(peers)
-
     # --- fused node step (models/raft_core.py) ---------------------------
     #
-    # The runtime drives raft-family models through the
-    # compartmentalized kernel: batched inbox decode, a minimal
-    # unrolled sequential core, batched reply assembly, and a
-    # deduplicated apply loop. handle()/tick()/_apply_one() above stay
-    # as the bit-identity reference oracle (tests/test_node_fusion.py)
-    # and for host-side single-message debugging.
+    # The raft family speaks ONLY the compartmentalized fused protocol:
+    # the legacy ``handle()``/``tick()`` formulation (the pre-fusion
+    # runtime, PR 6's reference oracle) was deleted after its soak
+    # window — bit-identity is pinned by the FROZEN pre-refactor golden
+    # digests in tests/data/node_fusion_golden.json, which were
+    # recorded from the legacy code and can never be regenerated from
+    # this tree (tests/test_node_fusion.py).
 
     fused_node = True
 
@@ -590,9 +194,10 @@ class RaftModel(Model):
     def apply_entry(self, row, do, entry, cfg):
         """Apply ONE committed log entry to the KV state machine and
         build the leader's client reply row — the per-model hook under
-        :func:`raft_core.fused_tick`'s shared apply loop. Mirrors
-        :meth:`_apply_one` value-for-value (the last_applied advance
-        lives in the shared loop; SRC/ORIGIN are stamped there too)."""
+        :func:`raft_core.fused_tick`'s shared apply loop. Value-for-
+        value the pre-fusion legacy apply, pinned by the frozen goldens
+        (the last_applied advance lives in the shared loop; SRC/ORIGIN
+        are stamped there too)."""
         f, k = entry[0], entry[1]
         a, b = entry[2], entry[3]
         client, cmsg = entry[4], entry[5]

@@ -150,10 +150,13 @@ _GOSSIP_LOG = []    # module state a traced fn must not touch
 class RaftTracedHazards(RaftModel):
     """LINT FIXTURE (do not register): every TRC-rule hazard in one tick."""
     name = "lin-kv-lint-fixture-traced-hazards"
-    # this fixture overrides the LEGACY tick hook, so it must opt out
-    # of the fused driver (which would route around an overridden
-    # handle()/tick() — the rule for any raft subclass that overrides
-    # the legacy hooks instead of the raft_core compartments)
+    # STATICALLY-LINTED-ONLY: this fixture exists for the AST trace
+    # lint to read, never to execute — the raft family's legacy
+    # handle()/tick() runtime path was deleted (models/raft.py), so
+    # driving this class would hit Model.handle's NotImplementedError
+    # before any hazard fired. fused_node=False keeps the linter's
+    # traced-surface taint on the overridden tick below; super().tick
+    # resolves to the abstract base's no-op default.
     fused_node = False
 
     def tick(self, row, node_idx, t, key, cfg, params):

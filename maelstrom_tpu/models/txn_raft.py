@@ -159,6 +159,7 @@ class TxnListAppendModel(_TxnRaftBase):
     """txn-list-append: reads return the full per-key append list."""
 
     name = "txn-list-append"
+    checker_name = "elle-list-append"
     write_f_name = "append"
     write_f = MF_APPEND
 
@@ -170,61 +171,12 @@ class TxnListAppendModel(_TxnRaftBase):
         # [n_keys, 1 + list_cap]: lane 0 = length, 1.. = appended values
         return jnp.zeros((self.n_keys, 1 + self.list_cap), jnp.int32)
 
-    def _apply_one(self, row: RaftRow, cfg):
-        do, aidx, entry = self._apply_frontier(row)
-        ln, client, cmsg = entry[0], entry[-2], entry[-1]
-
-        kv = row.kv
-        reply = jnp.zeros((self.ev_vals,), jnp.int32)
-        reply = reply.at[0].set(ln)
-        reply = jax.lax.dynamic_update_slice(
-            reply, entry[1:1 + 3 * self.txn_max], (1,))
-        rbase = 1 + 3 * self.txn_max
-        overflow = jnp.bool_(False)
-        for i in range(self.txn_max):
-            active = i < ln
-            f = entry[1 + 3 * i]
-            k = jnp.clip(entry[2 + 3 * i], 0, self.n_keys - 1)
-            v = entry[3 + 3 * i]
-            is_rd = active & (f == MF_R)
-            is_app = active & (f == MF_APPEND)
-            # read: snapshot k's list (sees earlier appends in this txn)
-            reply = jax.lax.dynamic_update_slice(
-                reply, jnp.where(is_rd, kv[k, 1:], 0),
-                (rbase + i * self.list_cap,))
-            # append: push v
-            lk = kv[k, 0]
-            fits = lk < self.list_cap
-            overflow = overflow | (is_app & ~fits)
-            new_kv = kv.at[k, 1 + jnp.clip(lk, 0, self.list_cap - 1)
-                           ].set(v).at[k, 0].add(1)
-            kv = jnp.where(is_app & fits, new_kv, kv)
-
-        ok = ~overflow
-        row = row._replace(
-            kv=jnp.where(do & ok, kv, row.kv),
-            last_applied=jnp.where(do, row.last_applied + 1,
-                                   row.last_applied))
-
-        out = jnp.zeros((1, cfg.lanes), dtype=jnp.int32)
-        out = out.at[0, wire.VALID].set(
-            jnp.where(do & (row.role == 2), 1, 0))
-        out = out.at[0, wire.DEST].set(client)
-        out = out.at[0, wire.TYPE].set(
-            jnp.where(ok, T_TXN_OK, TYPE_ERROR))
-        out = out.at[0, wire.REPLYTO].set(cmsg)
-        body = sel(ok, reply,
-                   jnp.zeros_like(reply).at[0].set(30))  # txn-conflict
-        out = jax.lax.dynamic_update_slice(out, body[None],
-                                           (0, wire.BODY))
-        return row, out
-
     def apply_entry(self, row: RaftRow, do, entry, cfg):
         """Fused-path apply hook: the txn_max micro-op chain as ONE
-        unrolled-scan body instead of txn_max traced copies — mirrors
-        :meth:`_apply_one` value-for-value (reads snapshot the per-key
-        list as of that micro-op, an overflowing append aborts the
-        whole txn with error 30)."""
+        unrolled-scan body instead of txn_max traced copies — value-
+        for-value the pre-fusion legacy apply (pinned by the frozen
+        goldens; reads snapshot the per-key list as of that micro-op,
+        an overflowing append aborts the whole txn with error 30)."""
         T = self.txn_max
         Lc = self.list_cap
         ln, client, cmsg = entry[0], entry[-2], entry[-1]
@@ -309,6 +261,7 @@ class TxnRwRegisterModel(_TxnRaftBase):
     value into the echoed ``v`` lane."""
 
     name = "txn-rw-register"
+    checker_name = "elle-rw-register"
     write_f_name = "w"
     write_f = MF_W
 
@@ -318,46 +271,10 @@ class TxnRwRegisterModel(_TxnRaftBase):
     def _init_kv(self):
         return jnp.zeros((self.n_keys,), jnp.int32)   # 0 = unwritten
 
-    def _apply_one(self, row: RaftRow, cfg):
-        do, aidx, entry = self._apply_frontier(row)
-        ln, client, cmsg = entry[0], entry[-2], entry[-1]
-
-        kv = row.kv
-        reply = jnp.zeros((self.ev_vals,), jnp.int32)
-        reply = reply.at[0].set(ln)
-        reply = jax.lax.dynamic_update_slice(
-            reply, entry[1:1 + 3 * self.txn_max], (1,))
-        for i in range(self.txn_max):
-            active = i < ln
-            f = entry[1 + 3 * i]
-            k = jnp.clip(entry[2 + 3 * i], 0, self.n_keys - 1)
-            v = entry[3 + 3 * i]
-            is_rd = active & (f == MF_R)
-            is_wr = active & (f == MF_W)
-            # read result replaces the echoed v lane
-            reply = reply.at[3 + 3 * i].set(
-                jnp.where(is_rd, kv[k], reply[3 + 3 * i]))
-            kv = jnp.where(is_wr, kv.at[k].set(v), kv)
-
-        row = row._replace(
-            kv=jnp.where(do, kv, row.kv),
-            last_applied=jnp.where(do, row.last_applied + 1,
-                                   row.last_applied))
-
-        out = jnp.zeros((1, cfg.lanes), dtype=jnp.int32)
-        out = out.at[0, wire.VALID].set(
-            jnp.where(do & (row.role == 2), 1, 0))
-        out = out.at[0, wire.DEST].set(client)
-        out = out.at[0, wire.TYPE].set(T_TXN_OK)
-        out = out.at[0, wire.REPLYTO].set(cmsg)
-        out = jax.lax.dynamic_update_slice(out, reply[None],
-                                           (0, wire.BODY))
-        return row, out
-
     def apply_entry(self, row: RaftRow, do, entry, cfg):
         """Fused-path apply hook: register micro-ops as one
-        unrolled-scan body — mirrors :meth:`_apply_one`
-        value-for-value (reads fold into the echoed v lane)."""
+        unrolled-scan body — value-for-value the pre-fusion legacy
+        apply (reads fold into the echoed v lane)."""
         T = self.txn_max
         ln, client, cmsg = entry[0], entry[-2], entry[-1]
         reply = jnp.zeros((self.ev_vals,), jnp.int32).at[0].set(ln)

@@ -29,6 +29,7 @@ F_GENERATE = 1
 
 class UniqueIdsModel(Model):
     name = "unique-ids"
+    checker_name = "unique-ids"
     body_lanes = 1
     max_out = 1
     tick_out = 0

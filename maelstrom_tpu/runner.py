@@ -249,7 +249,8 @@ class TestRunner:
         return check_history(
             history, self.opts, self.workload.get("checker"),
             extra={"net": net_stats_checker(self.journal, history,
-                                            drops=self.net.drop_stats())})
+                                            drops=self.net.drop_stats())},
+            name=f"{self.workload_name}-checker")
 
     def write_store(self, results: Dict[str, Any]):
         if not self.store_dir:

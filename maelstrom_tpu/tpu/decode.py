@@ -1,0 +1,336 @@
+"""Vectorized host-side event decode: device buffers -> op histories.
+
+The original decoder (``harness.events_to_histories``) walked every
+nonzero event of the dense ``[T, R, C, 2, 2 + ev_vals]`` tensor in a
+Python loop — one ``events[t, r, c, slot]`` scalar gather, one
+``int()``-per-lane conversion, and one dict build per event — and the
+pipelined executor first *reconstructed* that dense tensor from the
+compacted chunk buffers just to scan it again. At fleet scale the
+decode stage was the serial wall between the device finishing and the
+checkers starting.
+
+This module replaces both moves with NumPy column operations:
+
+- :func:`decode_dense` / :func:`decode_compact` make ONE vectorized
+  pass over the event buffers (no dense reconstruction on the compact
+  path) and emit per-instance **column slabs** — ``(tick, process,
+  etype, vals[ev_vals])`` arrays in exactly the serial decoder's
+  history order (tick, then process, then completion-before-invoke
+  slot order);
+- the Jepsen-style dict records are materialized **lazily, only at the
+  checker boundary** (:func:`materialize_records` /
+  :class:`LazyHistories`), from ``ndarray.tolist()`` columns instead of
+  per-element numpy scalar indexing — and byte-identical to the serial
+  decoder's output by construction (``tests/test_check_pool.py`` pins
+  ``json.dumps`` equality against :func:`reference_histories`);
+- chunks can be decoded **incrementally** (:class:`StreamDecoder`)
+  as the pipelined executor fetches them, so decode overlaps device
+  compute and the per-instance slabs can stream straight into the
+  parallel checker farm (``checkers/pool.py``).
+
+:func:`reference_histories` preserves the original per-event loop as
+the bit-identity oracle (and the "before" side of the decode-speedup
+scoreboard in doc/results.md).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .runtime import (EV_FAIL, EV_INFO, EV_INVOKE, EV_NONE, EV_OK, Model)
+
+ETYPE_NAMES = {EV_OK: "ok", EV_FAIL: "fail", EV_INFO: "info"}
+
+
+class EventSlab(NamedTuple):
+    """One instance's decoded events as columns, in history order.
+
+    ``vals`` is ``[n, ev_vals]`` (the msg-id lane is never carried —
+    the serial decoder drops it too). A slab is cheap to pickle, which
+    is what lets the checker pool ship per-instance work to worker
+    processes without materializing dict records in the parent."""
+    ticks: np.ndarray      # [n] int32
+    procs: np.ndarray      # [n] int32 (client index == history process)
+    etypes: np.ndarray     # [n] int32 (EV_* codes)
+    vals: np.ndarray       # [n, ev_vals] int32
+
+    @property
+    def n_events(self) -> int:
+        return int(self.ticks.shape[0])
+
+
+def empty_slab(ev_vals: int) -> EventSlab:
+    return EventSlab(ticks=np.zeros((0,), np.int32),
+                     procs=np.zeros((0,), np.int32),
+                     etypes=np.zeros((0,), np.int32),
+                     vals=np.zeros((0, ev_vals), np.int32))
+
+
+def concat_slabs(slabs: Sequence[EventSlab], ev_vals: int) -> EventSlab:
+    """Concatenate chunk-order slabs of one instance. Chunks cover
+    disjoint, increasing tick spans, so concatenation preserves the
+    global history order."""
+    if not slabs:
+        return empty_slab(ev_vals)
+    if len(slabs) == 1:
+        return slabs[0]
+    return EventSlab(
+        ticks=np.concatenate([s.ticks for s in slabs]),
+        procs=np.concatenate([s.procs for s in slabs]),
+        etypes=np.concatenate([s.etypes for s in slabs]),
+        vals=np.concatenate([s.vals for s in slabs], axis=0))
+
+
+def _split_by_instance(order: np.ndarray, insts: np.ndarray,
+                       ticks: np.ndarray, procs: np.ndarray,
+                       etypes: np.ndarray, vals: np.ndarray,
+                       n_instances: int) -> Dict[int, EventSlab]:
+    """Apply the history sort ``order`` and split the columns into one
+    slab per instance present. ``order`` must sort primarily by
+    ``insts`` so each instance's rows are contiguous."""
+    insts = insts[order]
+    ticks, procs = ticks[order], procs[order]
+    etypes, vals = etypes[order], vals[order]
+    out: Dict[int, EventSlab] = {}
+    if insts.shape[0] == 0:
+        return out
+    # contiguous [start, stop) runs per instance index
+    bounds = np.searchsorted(insts, np.arange(n_instances + 1))
+    for inst in range(n_instances):
+        lo, hi = int(bounds[inst]), int(bounds[inst + 1])
+        if lo == hi:
+            continue
+        out[inst] = EventSlab(ticks=ticks[lo:hi], procs=procs[lo:hi],
+                              etypes=etypes[lo:hi], vals=vals[lo:hi])
+    return out
+
+
+def decode_dense(model: Model, events: np.ndarray
+                 ) -> Dict[int, EventSlab]:
+    """One vectorized pass over the dense ``[T, R, C, 2, 2 + ev_vals]``
+    tensor: nonzero scan, column gather, history sort, per-instance
+    split. Instances with no events are simply absent from the map."""
+    events = np.asarray(events)
+    T, R, C, _, _ = events.shape
+    V = model.ev_vals
+    nz = np.argwhere(events[..., 0] != EV_NONE)
+    if nz.shape[0] == 0:
+        return {}
+    t, r, c, slot = nz[:, 0], nz[:, 1], nz[:, 2], nz[:, 3]
+    rows = events[t, r, c, slot]
+    etype = rows[:, 0]
+    vals = rows[:, 1:1 + V]
+    order = np.lexsort((slot, c, t, r))
+    return _split_by_instance(order, r, t.astype(np.int32),
+                              c.astype(np.int32),
+                              etype.astype(np.int32),
+                              vals.astype(np.int32, copy=False), R)
+
+
+def decode_compact(model: Model, n_clients: int, n_instances: int,
+                   chunks: Sequence[Tuple[np.ndarray, int]]
+                   ) -> Dict[int, EventSlab]:
+    """Decode per-chunk compacted ``(rows, count)`` buffers (the
+    pipelined executor's fetch payloads, ``tpu/pipeline.py``) straight
+    into per-instance slabs — the dense tensor is never rebuilt.
+    Overflowed chunks contribute their retained ``cap`` rows, exactly
+    like ``expand_compact_events`` (overflow stays a flagged,
+    non-silent condition at the executor level)."""
+    used = []
+    for rows, count in chunks:
+        n = min(int(count), rows.shape[0])
+        if n:
+            used.append(np.asarray(rows[:n]))
+    if not used:
+        return {}
+    allrows = used[0] if len(used) == 1 else np.concatenate(used, axis=0)
+    return decode_compact_rows(model, n_clients, n_instances, allrows)
+
+
+def decode_compact_rows(model: Model, n_clients: int, n_instances: int,
+                        rows: np.ndarray) -> Dict[int, EventSlab]:
+    """Column-decode already-trimmed compact rows
+    ``[(tick, loc, etype, vals...)]`` (``loc = (r * C + c) * 2 +
+    slot``)."""
+    V = model.ev_vals
+    t = rows[:, 0]
+    loc = rows[:, 1]
+    etype = rows[:, 2]
+    vals = rows[:, 3:3 + V]
+    r, rem = np.divmod(loc, n_clients * 2)
+    c, slot = np.divmod(rem, 2)
+    order = np.lexsort((slot, c, t, r))
+    return _split_by_instance(order, r, t.astype(np.int32),
+                              c.astype(np.int32),
+                              etype.astype(np.int32),
+                              vals.astype(np.int32, copy=False),
+                              n_instances)
+
+
+def materialize_records(model: Model, slab: EventSlab, final_start: int,
+                        ms_per_tick: float,
+                        index_base: int = 0) -> List[dict]:
+    """Build the Jepsen-style dict records for one slab — the lazy
+    checker-boundary step, shared verbatim by the in-process path and
+    the checker-pool workers so both produce byte-identical histories.
+    ``index_base`` continues a streaming instance's running ``index``
+    counter across chunk slabs."""
+    ticks = slab.ticks.tolist()
+    procs = slab.procs.tolist()
+    etypes = slab.etypes.tolist()
+    vals = slab.vals.tolist()
+    invoke_record = model.invoke_record
+    complete_record = model.complete_record
+    recs: List[dict] = []
+    append = recs.append
+    idx = index_base
+    for tick, proc, etype, v in zip(ticks, procs, etypes, vals):
+        time_ns = int(tick * ms_per_tick * 1_000_000)
+        if etype == EV_INVOKE:
+            rec = invoke_record(*v)
+            rec.update({"process": proc, "type": "invoke",
+                        "time": time_ns})
+            if tick >= final_start:
+                rec["final"] = True
+        else:
+            rec = complete_record(*v, etype)
+            rec.update({"process": proc, "type": ETYPE_NAMES[etype],
+                        "time": time_ns})
+        rec["index"] = idx
+        idx += 1
+        append(rec)
+    return recs
+
+
+class LazyHistories(Sequence):
+    """A sequence of per-instance histories that materializes each
+    instance's dict records on first access (and caches them). Shapes
+    exactly like the serial decoder's ``List[List[dict]]`` for every
+    consumer that iterates/indexes — store writers, plots, the
+    availability checker — while fleets whose verdicts came back from
+    the checker pool never pay for records nobody reads."""
+
+    def __init__(self, model: Model, slabs: Dict[int, EventSlab],
+                 n_instances: int, final_start: int,
+                 ms_per_tick: float):
+        self._model = model
+        self._slabs = slabs
+        self._n = n_instances
+        self._final_start = final_start
+        self._ms_per_tick = ms_per_tick
+        self._cache: Dict[int, List[dict]] = {}
+
+    def __len__(self) -> int:
+        return self._n
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return [self[j] for j in range(*i.indices(self._n))]
+        if i < 0:
+            i += self._n
+        if not 0 <= i < self._n:
+            raise IndexError(i)
+        if i not in self._cache:
+            slab = self._slabs.get(i)
+            self._cache[i] = ([] if slab is None else
+                              materialize_records(
+                                  self._model, slab, self._final_start,
+                                  self._ms_per_tick))
+        return self._cache[i]
+
+    def slab(self, i: int) -> Optional[EventSlab]:
+        return self._slabs.get(i)
+
+    def materialize(self) -> List[List[dict]]:
+        return [self[i] for i in range(self._n)]
+
+
+class StreamDecoder:
+    """Incremental column decode for the pipelined executor: feed each
+    chunk's compacted payload as it is fetched (overlapping device
+    compute), then :meth:`finish` into a :class:`LazyHistories`. The
+    per-chunk per-instance slabs are also handed to ``on_slabs`` — the
+    checker pool's streaming feed."""
+
+    def __init__(self, model: Model, n_clients: int, n_instances: int,
+                 final_start: int, ms_per_tick: float, on_slabs=None):
+        self._model = model
+        self._C = n_clients
+        self._R = n_instances
+        self._final_start = final_start
+        self._ms_per_tick = ms_per_tick
+        self._on_slabs = on_slabs
+        self._per_instance: Dict[int, List[EventSlab]] = {}
+        self.decode_s = 0.0
+
+    def feed(self, rows: np.ndarray, count: int, *_span) -> None:
+        import time
+        t0 = time.monotonic()
+        n = min(int(count), rows.shape[0])
+        slabs = (decode_compact_rows(self._model, self._C, self._R,
+                                     np.asarray(rows[:n]))
+                 if n else {})
+        for inst, slab in slabs.items():
+            self._per_instance.setdefault(inst, []).append(slab)
+        self.decode_s += time.monotonic() - t0
+        if self._on_slabs is not None and slabs:
+            self._on_slabs(slabs)
+
+    def feed_dense(self, events: np.ndarray) -> None:
+        """Monolithic-path entry: one dense tensor instead of chunks."""
+        import time
+        t0 = time.monotonic()
+        slabs = decode_dense(self._model, events)
+        for inst, slab in slabs.items():
+            self._per_instance.setdefault(inst, []).append(slab)
+        self.decode_s += time.monotonic() - t0
+        if self._on_slabs is not None and slabs:
+            self._on_slabs(slabs)
+
+    def finish(self) -> LazyHistories:
+        import time
+        t0 = time.monotonic()
+        V = self._model.ev_vals
+        merged = {inst: concat_slabs(parts, V)
+                  for inst, parts in self._per_instance.items()}
+        self.decode_s += time.monotonic() - t0
+        return LazyHistories(self._model, merged, self._R,
+                             self._final_start, self._ms_per_tick)
+
+
+# --- the serial reference oracle ------------------------------------------
+
+
+def reference_histories(model: Model, events: np.ndarray,
+                        final_start: int = 1 << 30,
+                        ms_per_tick: float = 1
+                        ) -> List[List[dict]]:
+    """The original per-event Python decoder, kept verbatim as the
+    bit-identity oracle for the vectorized path (and the "before" side
+    of the decode scoreboard in doc/results.md). Do not optimize."""
+    T, R, C, _, _ = events.shape
+    histories: List[List[dict]] = [[] for _ in range(R)]
+    etypes = events[..., 0]
+    nz = np.argwhere(etypes != EV_NONE)
+    nz = nz[np.lexsort((nz[:, 3], nz[:, 2], nz[:, 1], nz[:, 0]))]
+    for t, r, c, slot in nz:
+        ev = events[t, r, c, slot]
+        etype = int(ev[0])
+        vals = [int(x) for x in ev[1:-1]]
+        time_ns = int(int(t) * ms_per_tick * 1_000_000)
+        if etype == EV_INVOKE:
+            rec = model.invoke_record(*vals)
+            rec.update({"process": int(c), "type": "invoke",
+                        "time": time_ns})
+            if t >= final_start:
+                rec["final"] = True
+        else:
+            rec = model.complete_record(*vals, etype)
+            rec.update({"process": int(c), "type": ETYPE_NAMES[etype],
+                        "time": time_ns})
+        h = histories[r]
+        rec["index"] = len(h)
+        h.append(rec)
+    return histories

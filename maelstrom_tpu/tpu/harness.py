@@ -21,15 +21,15 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from .decode import ETYPE_NAMES  # noqa: F401 — canonical copy lives
+                                 # with the decoder; re-exported for
+                                 # older importers of this module
 from .netsim import LATENCY_DISTS, NetConfig
-from .runtime import (ClientConfig, EV_FAIL, EV_INFO, EV_INVOKE, EV_NONE,
-                      EV_OK, Model, NemesisConfig, SimConfig,
+from .runtime import (ClientConfig, Model, NemesisConfig, SimConfig,
                       default_instance_ids, run_sim)
 from ..telemetry.recorder import TelemetryConfig
 
 MS_PER_TICK = 1  # default virtual clock resolution (override per run)
-
-ETYPE_NAMES = {EV_OK: "ok", EV_FAIL: "fail", EV_INFO: "info"}
 
 
 TPU_DEFAULTS = dict(
@@ -130,6 +130,17 @@ TPU_DEFAULTS = dict(
                               # (resumed/queued runs skip recompiles;
                               # MAELSTROM_COMPILE_CACHE=0 disables,
                               # perf.phases gains hit/miss counts)
+    check_workers=None,       # host verdict pipeline (checkers/
+                              # pool.py): checker-farm worker processes
+                              # running the per-instance workload
+                              # checkers in parallel, fed streaming
+                              # per-chunk slabs. 0 = serial (the
+                              # oracle path); None = auto (pool only
+                              # when >= 16 recorded instances on a
+                              # multi-core host). Verdicts and stored
+                              # histories are byte-identical at every
+                              # setting, incl. auto-fallback when the
+                              # pool dies (tests/test_check_pool.py)
     seed=0,
 )
 
@@ -295,33 +306,19 @@ def events_to_histories(model: Model, events: np.ndarray,
                         ) -> List[List[dict]]:
     """Decode the [T, R, C, 2, 2 + model.ev_vals] device event tensor into one
     Jepsen-style history per recorded instance. Invocations at/after
-    ``final_start`` are tagged ``final`` (post-heal final reads)."""
-    T, R, C, _, _ = events.shape
-    histories: List[List[dict]] = [[] for _ in range(R)]
-    # vectorized scan for nonzero events to avoid python-looping over T*R*C
-    etypes = events[..., 0]
-    nz = np.argwhere(etypes != EV_NONE)
-    # ensure order: by tick, then slot 0 (completions) before slot 1
-    nz = nz[np.lexsort((nz[:, 3], nz[:, 2], nz[:, 1], nz[:, 0]))]
-    for t, r, c, slot in nz:
-        ev = events[t, r, c, slot]
-        etype = int(ev[0])
-        vals = [int(x) for x in ev[1:-1]]   # model.ev_vals value lanes
-        time_ns = int(int(t) * ms_per_tick * 1_000_000)
-        if etype == EV_INVOKE:
-            rec = model.invoke_record(*vals)
-            rec.update({"process": int(c), "type": "invoke",
-                        "time": time_ns})
-            if t >= final_start:
-                rec["final"] = True
-        else:
-            rec = model.complete_record(*vals, etype)
-            rec.update({"process": int(c), "type": ETYPE_NAMES[etype],
-                        "time": time_ns})
-        h = histories[r]
-        rec["index"] = len(h)
-        h.append(rec)
-    return histories
+    ``final_start`` are tagged ``final`` (post-heal final reads).
+
+    Vectorized: one NumPy column pass over the nonzero events
+    (``tpu/decode.py``), byte-identical to the original per-event loop
+    (kept as ``decode.reference_histories``, the pinned oracle). The
+    pipelined executor's compact buffers never even build this dense
+    tensor — ``run_tpu_test`` streams them through
+    :class:`..tpu.decode.StreamDecoder` directly."""
+    from .decode import LazyHistories, decode_dense
+    events = np.asarray(events)
+    slabs = decode_dense(model, events)
+    return LazyHistories(model, slabs, events.shape[1], final_start,
+                         ms_per_tick).materialize()
 
 
 def _phase_timed_run(model: Model, sim: SimConfig, seed: int, params,
@@ -395,7 +392,7 @@ def _pipelined_phase_run(model: Model, sim: SimConfig, seed: int, params,
                          opts: Dict[str, Any],
                          profile_dir: Optional[str] = None,
                          heartbeat=None, checkpoint_cb=None,
-                         resume=None):
+                         resume=None, event_sink=None):
     """The chunked executor under the same phase-timer/profiler contract
     as :func:`_phase_timed_run`: returns (PipelineResult, phases) with
     the per-chunk dispatch/fetch/decode overlap stats under
@@ -425,7 +422,11 @@ def _pipelined_phase_run(model: Model, sim: SimConfig, seed: int, params,
             scan_k=int(opts.get("scan_top_k") or 1),
             checkpoint_cb=checkpoint_cb,
             checkpoint_every=int(opts.get("checkpoint_every") or 0),
-            resume=resume)
+            resume=resume,
+            # the streaming verdict pipeline consumes the compact
+            # chunks directly — never reconstruct the dense tensor
+            event_sink=event_sink,
+            dense_events=event_sink is None)
     finally:
         if profiling:
             try:
@@ -453,7 +454,7 @@ _REPRO_OPT_KEYS = (
     # behavioral knobs `campaign resume` replays from the header so a
     # resumed run re-runs under the SAME policy it started with
     "pipeline", "fail_fast", "scan_top_k", "funnel", "funnel_max",
-    "checkpoint_every",
+    "checkpoint_every", "check_workers",
     # fault-plan engine (maelstrom_tpu/faults/): the plan — or the
     # fuzz distribution whose per-instance schedules derive from the
     # seed — is part of the trajectory, so triage/resume/shrink must
@@ -623,15 +624,37 @@ def run_tpu_test(model: Model, opts: Optional[Dict[str, Any]] = None,
             print(f"note: --checkpoint-every has no effect here "
                   f"({why}); the run will NOT be resumable",
                   file=sys.stderr)
+    # --- the host verdict pipeline (checkers/pool.py): a persistent
+    # checker farm spawned BEFORE dispatch (worker startup overlaps the
+    # device compile), fed per-chunk event slabs from the pipelined
+    # executor's consume side so decode + dict materialization + the
+    # per-workload checkers run WHILE later chunks compute on device.
+    # check_workers=0 is the serial oracle; any pool failure falls back
+    # to it with identical verdicts.
+    from ..checkers.pool import VerdictPipeline, resolve_check_workers
+    check_workers = resolve_check_workers(opts.get("check_workers"),
+                                          sim.record_instances)
+    verdict = VerdictPipeline(model, sim.client.n_clients,
+                              sim.record_instances,
+                              sim.client.final_start,
+                              opts["ms_per_tick"], opts, check_workers)
+    if resume is not None:
+        # the resumed segments' chunks are host-side already — replay
+        # them through the stream decoder ahead of the live suffix so
+        # histories cover the full horizon in chunk order
+        for _rows, _n in resume.compact:
+            verdict.feed_chunk(np.asarray(_rows), int(_n), 0, 0)
     t0 = time.monotonic()
     pipe_res = None
+    dense_np = None
     try:
         if use_pipe:
             pipe_res, phases = _pipelined_phase_run(
                 model, sim, opts["seed"], params, opts,
                 opts.get("profile_dir"), heartbeat=hb,
-                checkpoint_cb=checkpoint_cb, resume=resume)
-            carry, events = pipe_res.carry, pipe_res.events
+                checkpoint_cb=checkpoint_cb, resume=resume,
+                event_sink=verdict.feed_chunk)
+            carry = pipe_res.carry
             journal_sends = pipe_res.journal_sends
             journal_recvs = pipe_res.journal_recvs
             # the pipelined executor accounted its own (overlapped)
@@ -645,15 +668,16 @@ def run_tpu_test(model: Model, opts: Optional[Dict[str, Any]] = None,
             # fetch-s includes the dense event tensor's device-to-host
             # transfer on the monolithic path (doc/observability.md)
             t_fetch = time.monotonic()
-            events = (np.asarray(ys.events) if ys.events is not None
-                      else np.zeros((sim.n_ticks, 0,
-                                     sim.client.n_clients,
-                                     2, 2 + model.ev_vals), np.int32))
+            dense_np = (np.asarray(ys.events) if ys.events is not None
+                        else np.zeros((sim.n_ticks, 0,
+                                       sim.client.n_clients,
+                                       2, 2 + model.ev_vals), np.int32))
             journal_sends = (np.asarray(ys.journal_sends)
                              if ys.journal_sends is not None else None)
             journal_recvs = (np.asarray(ys.journal_recvs)
                              if ys.journal_recvs is not None else None)
     except BaseException:
+        verdict.close()
         if hb is not None:
             # no run-end record: the heartbeat prefix IS the crash
             # artifact (`maelstrom watch` reports the run as dead)
@@ -668,21 +692,22 @@ def run_tpu_test(model: Model, opts: Optional[Dict[str, Any]] = None,
     phases["fetch-s"] = round(time.monotonic() - t_fetch, 4)
     wall = time.monotonic() - t0
 
-    histories = events_to_histories(model, events,
-                                    final_start=sim.client.final_start,
-                                    ms_per_tick=opts["ms_per_tick"])
-    checker = model.checker()
-    per_instance = []
+    if dense_np is not None:
+        # monolithic path: the dense tensor decodes AFTER the fetch-s
+        # stamp, so fetch-s keeps meaning device-to-host transfer and
+        # the column decode is accounted once, under check.decode-s
+        verdict.feed_dense(dense_np)
+    # decode finalize + per-instance verdicts: pooled (instance-ordered
+    # assembly) or serial — byte-identical either way; histories stay
+    # lazy column slabs until something (store writer, availability,
+    # journal stats) actually reads the dict records
+    per_instance, histories, check_rec = verdict.finish()
+    phases["check"] = check_rec
     availability = None
     if opts.get("availability") is not None:
         from ..checkers.availability import availability_checker
         availability = availability_checker(
             [r for h in histories for r in h], opts["availability"])
-    for h in histories:
-        try:
-            per_instance.append(checker(h, opts))
-        except Exception as e:  # checker blow-up is a result, not a crash
-            per_instance.append({"valid?": False, "error": repr(e)})
     from ..checkers import compose_valid
     n_valid = sum(1 for r in per_instance
                   if r.get("valid?") in (True, "unknown"))
@@ -695,6 +720,11 @@ def run_tpu_test(model: Model, opts: Optional[Dict[str, Any]] = None,
     overall = compose_valid(r.get("valid?", True) for r in per_instance)
     if n_violating > 0:
         overall = False
+    # a checker that RAISED is a definite invalid-with-reason: the
+    # structured blow-up dict (instance id + checker name + truncated
+    # traceback, checkers.checker_failure) already carries valid?=False
+    # through compose_valid; the count makes it visible at the top
+    checker_errors = sum(1 for r in per_instance if "traceback" in r)
     violating_ids = np.nonzero(violations)[0]
     results = {
         "valid?": overall,
@@ -706,6 +736,7 @@ def run_tpu_test(model: Model, opts: Optional[Dict[str, Any]] = None,
         "instance-count": sim.n_instances,
         "checked-instances": len(per_instance),
         "valid-instances": n_valid,
+        **({"checker-errors": checker_errors} if checker_errors else {}),
         # every recorded instance's verdict, tagged with its index — an
         # invalid instance at ANY index keeps its full detail in the
         # artifact; valid verdicts beyond the first 32 collapse to a
@@ -783,8 +814,7 @@ def run_tpu_test(model: Model, opts: Optional[Dict[str, Any]] = None,
     if opts.get("funnel", True) and len(violating_ids) > 0:
         funnel_max = int(opts.get("funnel_max", 32))
         target_ids = [int(i) for i in violating_ids[:funnel_max]]
-        funnel = replay_instances(model, opts, target_ids, params=params,
-                                  checker=checker)
+        funnel = replay_instances(model, opts, target_ids, params=params)
         funnel["total-violating"] = n_violating
         results["funnel"] = {k: v for k, v in funnel.items()
                              if k != "histories"}
@@ -824,13 +854,17 @@ def run_tpu_test(model: Model, opts: Optional[Dict[str, Any]] = None,
                     "complete"),
             **{"valid?": results["valid?"],
                "violating-instances": n_violating,
+               # the verdict-stage summary (perf.phases.check) rides
+               # the run-end record so `maelstrom watch` prices the
+               # host side of a finished run too
+               "check": check_rec,
                **({"store-dir": run_dir} if run_dir else {})})
     return results
 
 
 def replay_instances(model: Model, opts: Dict[str, Any],
-                     instance_ids: List[int], params=None,
-                     checker=None) -> Dict[str, Any]:
+                     instance_ids: List[int],
+                     params=None) -> Dict[str, Any]:
     """Re-simulate exactly ``instance_ids`` (same seed/config) with full
     history recording, run the workload checker on each, and return
     ``{ids, verdicts, histories, replayed-violating}``. Bit-exactness
@@ -846,22 +880,27 @@ def replay_instances(model: Model, opts: Dict[str, Any],
     sim = make_sim_config(model, sub_opts)
     if params is None:
         params = model.make_params(sim.net.n_nodes)
-    if checker is None:
-        checker = model.checker()
     carry, ys = run_sim(model, sim, opts["seed"], params,
                         jnp.asarray(instance_ids, dtype=jnp.int32))
-    histories = events_to_histories(model, np.asarray(ys.events),
-                                    final_start=sim.client.final_start,
-                                    ms_per_tick=opts["ms_per_tick"])
-    verdicts = []
-    for iid, h in zip(instance_ids, histories):
-        try:
-            v = checker(h, opts)
-        except Exception as e:
-            v = {"valid?": False, "error": repr(e)}
+    from .decode import LazyHistories, decode_dense
+    histories = LazyHistories(model, decode_dense(model,
+                                                  np.asarray(ys.events)),
+                              K, sim.client.final_start,
+                              opts["ms_per_tick"])
+    # the shared verdict helper (checkers/pool.py): lazy slabs hand
+    # through so a big funnel batch can take the checker farm too —
+    # small ones resolve to the serial path; either way the blow-up
+    # reporting contract (checker_failure dicts) is the one the main
+    # verdict stage speaks
+    from ..checkers.pool import check_instances, resolve_check_workers
+    verdicts = check_instances(
+        model, histories, opts,
+        workers=resolve_check_workers(opts.get("check_workers"), K),
+        final_start=sim.client.final_start,
+        ms_per_tick=opts["ms_per_tick"])
+    for iid, h, v in zip(instance_ids, histories, verdicts):
         v["instance"] = int(iid)
         v["ops"] = sum(1 for r in h if r["type"] == "invoke")
-        verdicts.append(v)
     replay_viol = np.asarray(carry.violations)
     return {
         "ids": [int(i) for i in instance_ids],

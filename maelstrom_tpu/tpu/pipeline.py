@@ -387,7 +387,11 @@ class PipelineResult(NamedTuple):
     prefix (``perf["ticks-dispatched"]`` ticks); the carry is the state
     after that prefix."""
     carry: Carry
-    events: np.ndarray           # dense [T, R, C, 2, 2 + ev_vals]
+    events: Optional[np.ndarray]  # dense [T, R, C, 2, 2 + ev_vals]
+                                  # (None with dense_events=False —
+                                  # the compact stream was consumed
+                                  # directly, e.g. by the vectorized
+                                  # decoder in tpu/decode.py)
     journal_sends: np.ndarray    # [T, J, M, L] (zero-size when J == 0)
     journal_recvs: np.ndarray    # [T, J, NT, K, L]
     perf: Dict[str, Any]         # chunk/overlap/fetch-byte stats
@@ -477,7 +481,8 @@ def run_sim_pipelined(model: Model, sim: SimConfig, seed: int,
                       keep_compact: bool = False,
                       scan_k: int = DEFAULT_SCAN_TOP_K,
                       checkpoint_cb=None, checkpoint_every: int = 0,
-                      resume: Optional[ResumeState] = None
+                      resume: Optional[ResumeState] = None,
+                      event_sink=None, dense_events: bool = True
                       ) -> PipelineResult:
     """Chunked, donated, double-buffered replacement for
     :func:`..tpu.runtime.run_sim` + the dense event fetch.
@@ -511,6 +516,15 @@ def run_sim_pipelined(model: Model, sim: SimConfig, seed: int,
     (the exact plan suffix, :func:`resume_plans`) and the returned
     events/journal cover the FULL horizon, bit-identical to an
     uninterrupted run.
+
+    ``event_sink(rows, count, t0, length)`` receives each consumed
+    chunk's fetched compact payload (the streaming host verdict
+    pipeline — ``checkers/pool.py`` — decodes and checks chunk *k*
+    while chunk *k + 1* computes; purely observational here, the
+    executor keeps its own accumulators). ``dense_events=False`` skips
+    the end-of-run dense-tensor reconstruction (``result.events`` is
+    then None) for callers that consume the compact stream directly —
+    the vectorized decoder never needs the dense form.
     """
     if params is None:
         params = model.make_params(sim.net.n_nodes)
@@ -577,6 +591,8 @@ def run_sim_pipelined(model: Model, sim: SimConfig, seed: int,
             fetched_bytes[0] += compact_payload_bytes(rows)
             overflowed[0] += int(ovf)
             compact_chunks.append((rows, n))
+            if event_sink is not None:
+                event_sink(rows, n, t0, length)
         if journal is not None:
             journal_chunks.append((np.asarray(journal[0]),
                                    np.asarray(journal[1])))
@@ -630,8 +646,9 @@ def run_sim_pipelined(model: Model, sim: SimConfig, seed: int,
     ticks_done = stats["ticks-dispatched"]
 
     t_dec = time.monotonic()
-    events = expand_compact_events(model, sim, compact_chunks,
-                                   n_ticks=ticks_done)
+    events = (expand_compact_events(model, sim, compact_chunks,
+                                    n_ticks=ticks_done)
+              if dense_events else None)
     decode_s = time.monotonic() - t_dec
     if journal_chunks:
         j_sends = np.concatenate([a for a, _ in journal_chunks], axis=0)

@@ -72,6 +72,11 @@ def pytest_configure(config):
                    "fuzzer tests — schedule-RNG lane, seed-stable "
                    "reconstruction, shrinking "
                    "(maelstrom_tpu/faults/fuzz.py, shrink.py)")
+    config.addinivalue_line(
+        "markers", "pool: parallel host verdict pipeline tests — "
+                   "vectorized decode identity, checker-farm "
+                   "pool-vs-serial identity, kill-fallback "
+                   "(tpu/decode.py, checkers/pool.py)")
 
 
 def pytest_collection_modifyitems(config, items):

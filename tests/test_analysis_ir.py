@@ -79,23 +79,25 @@ class TestFixturesTrip:
         assert report.max_const_bytes >= 128 << 10
 
     def test_loop_budget_exceeded_is_an_error(self):
-        """Per-model JXP404 budgets: a legacy-scan tick audited under
-        a zero loop budget is an ERROR naming the budget — the gate a
-        re-introduced per-slot scan would hit on the fused raft family
-        — while the same tick under a budget covering its loops stays
-        clean."""
-        from maelstrom_tpu.models.raft import RaftModel
+        """Per-model JXP404 budgets: a per-slot-scan tick audited
+        under a zero loop budget is an ERROR naming the budget — the
+        gate a re-introduced sequential scan would hit on the fused
+        raft family (whose legacy scan formulation is deleted;
+        models/raft.py) — while the same tick under a budget covering
+        its loops stays clean. Echo still runs the legacy per-slot
+        driver, so its tick legally carries exactly that loop."""
+        from maelstrom_tpu.models.echo import EchoModel
 
-        legacy = type("RaftLegacyForBudget", (RaftModel,),
-                      {"fused_node": False})(n_nodes_hint=3)
-        fs, report = audit_model_ir(legacy, 3, "lead", loop_budget=0)
+        looped = EchoModel()
+        assert not getattr(looped, "fused_node", False)
+        fs, report = audit_model_ir(looped, 2, "lead", loop_budget=0)
         budget_fs = [f for f in fs if "budget" in f.message]
         assert budget_fs and all(f.rule == "JXP404"
                                  and f.severity == "error"
                                  for f in budget_fs)
         assert report.loops > 0
 
-        fs_ok, _ = audit_model_ir(legacy, 3, "lead",
+        fs_ok, _ = audit_model_ir(looped, 2, "lead",
                                   loop_budget=report.loops)
         assert not [f for f in fs_ok if "budget" in f.message]
 

@@ -1,0 +1,435 @@
+"""The parallel host verdict pipeline (tpu/decode.py + checkers/pool.py).
+
+Three contracts, all byte-level:
+
+1. **Vectorized decode identity** — the NumPy column decoder produces
+   dict histories ``json.dumps``-identical to the original per-event
+   loop (kept as ``decode.reference_histories``, the pinned oracle),
+   on the dense tensor AND straight from the compacted chunk buffers.
+2. **Pool-vs-serial verdict identity** — every registered workload, in
+   both carry layouts, checked through the worker farm at 1/2/4
+   workers, yields exactly the serial path's verdicts and stored
+   histories (tier-1 runs a representative slice; the full matrix is
+   the slow sweep).
+3. **Resilience** — killing every pool worker mid-run still yields the
+   serial verdicts (auto-fallback), and a checker that raises becomes
+   a structured invalid-with-reason verdict (instance id, checker
+   name, truncated traceback), never a crash.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from maelstrom_tpu.models import get_model
+from maelstrom_tpu.tpu import decode
+from maelstrom_tpu.tpu.harness import (events_to_histories,
+                                       make_sim_config, run_tpu_test)
+from maelstrom_tpu.tpu.runtime import run_sim
+
+pytestmark = pytest.mark.pool
+
+# one short, dense config every workload decodes real traffic from
+DECODE_OPTS = dict(node_count=3, concurrency=4, n_instances=8,
+                   record_instances=8, time_limit=0.5, rate=300.0,
+                   latency=4.0, rpc_timeout=0.25,
+                   nemesis=["partition"], nemesis_interval=0.1,
+                   p_loss=0.05, recovery_time=0.1, pool_slots=32,
+                   seed=11, telemetry=False, ms_per_tick=1)
+
+ALL_WORKLOADS = ["echo", "unique-ids", "broadcast", "g-set",
+                 "pn-counter", "g-counter", "lin-kv", "kafka",
+                 "txn-list-append", "txn-rw-register"]
+
+# tier-1 covers every distinct checker family once, alternating carry
+# layouts; the full workload x layout x worker-count matrix is slow
+TIER1_MATRIX = [("echo", "lead"), ("unique-ids", "minor"),
+                ("g-set", "lead"), ("pn-counter", "minor"),
+                ("lin-kv", "minor"), ("kafka", "lead"),
+                ("txn-list-append", "lead"),
+                ("txn-rw-register", "minor")]
+SLOW_MATRIX = [(wl, layout) for wl in ALL_WORKLOADS
+               for layout in ("lead", "minor")
+               if (wl, layout) not in TIER1_MATRIX]
+
+
+def _workload_opts(workload):
+    opts = dict(DECODE_OPTS)
+    if workload == "kafka":
+        opts.update(node_count=1, nemesis=[], nemesis_interval=0.5)
+    return opts
+
+
+def _run_events(workload, layout):
+    opts = {**_workload_opts(workload), "layout": layout}
+    model = get_model(workload, opts["node_count"])
+    sim = make_sim_config(model, opts)
+    carry, ys = run_sim(model, sim, opts["seed"],
+                        model.make_params(sim.net.n_nodes))
+    return model, sim, opts, np.asarray(ys.events)
+
+
+def _dump(histories):
+    return [json.dumps(h) for h in histories]
+
+
+# --- 1. vectorized decode identity ----------------------------------------
+
+
+@pytest.mark.parametrize("workload,layout",
+                         [("echo", "lead"), ("unique-ids", "lead"),
+                          ("lin-kv", "minor"),
+                          ("txn-list-append", "lead"),
+                          ("kafka", "minor")])
+def test_vectorized_decode_matches_reference(workload, layout):
+    """events_to_histories (the column decoder) == the original
+    per-event loop, json-byte-for-byte, wide ev_vals included."""
+    model, sim, opts, events = _run_events(workload, layout)
+    ref = decode.reference_histories(
+        model, events, final_start=sim.client.final_start,
+        ms_per_tick=opts["ms_per_tick"])
+    vec = events_to_histories(model, events,
+                              final_start=sim.client.final_start,
+                              ms_per_tick=opts["ms_per_tick"])
+    assert sum(len(h) for h in ref) > 20, "fixture decoded no traffic"
+    assert _dump(vec) == _dump(ref)
+
+
+def test_compact_decode_matches_dense():
+    """Slabs decoded straight from the compacted chunk stream equal
+    the dense-tensor decode — the pipelined path never rebuilds the
+    dense tensor, so this IS its history correctness proof."""
+    from maelstrom_tpu.tpu.pipeline import run_sim_pipelined
+    model, sim, opts, events = _run_events("lin-kv", "lead")
+    res = run_sim_pipelined(model, sim, opts["seed"],
+                            model.make_params(sim.net.n_nodes),
+                            chunk=50, keep_compact=True,
+                            dense_events=False)
+    assert res.events is None
+    slabs = decode.decode_compact(model, sim.client.n_clients,
+                                  sim.record_instances, res.compact)
+    lazy = decode.LazyHistories(model, slabs, sim.record_instances,
+                                sim.client.final_start,
+                                opts["ms_per_tick"])
+    ref = decode.reference_histories(
+        model, events, final_start=sim.client.final_start,
+        ms_per_tick=opts["ms_per_tick"])
+    assert _dump(lazy.materialize()) == _dump(ref)
+
+
+def test_stream_decoder_chunked_equals_one_shot():
+    """Feeding the StreamDecoder chunk-by-chunk (the run_chunked
+    consume-side hookup) equals decoding all chunks at once — index
+    counters and record order survive the incremental path."""
+    from maelstrom_tpu.tpu.pipeline import run_sim_pipelined
+    model, sim, opts, events = _run_events("echo", "lead")
+    res = run_sim_pipelined(model, sim, opts["seed"],
+                            model.make_params(sim.net.n_nodes),
+                            chunk=50, keep_compact=True)
+    sd = decode.StreamDecoder(model, sim.client.n_clients,
+                              sim.record_instances,
+                              sim.client.final_start,
+                              opts["ms_per_tick"])
+    for rows, count in res.compact:
+        sd.feed(rows, count)
+    ref = decode.reference_histories(
+        model, events, final_start=sim.client.final_start,
+        ms_per_tick=opts["ms_per_tick"])
+    assert _dump(sd.finish().materialize()) == _dump(ref)
+
+
+def test_final_tag_and_ms_per_tick():
+    """final-read tagging and the virtual-clock time stamps survive
+    vectorization (the two non-trivial per-record branches)."""
+    model, sim, opts, events = _run_events("g-set", "lead")
+    ref = decode.reference_histories(model, events,
+                                     final_start=sim.client.final_start,
+                                     ms_per_tick=2.5)
+    vec = events_to_histories(model, events,
+                              final_start=sim.client.final_start,
+                              ms_per_tick=2.5)
+    assert _dump(vec) == _dump(ref)
+    assert any(r.get("final") for h in ref for r in h), \
+        "fixture produced no final-phase ops"
+
+
+# --- 2. pool-vs-serial verdict identity -----------------------------------
+
+
+def _identity_case(workload, layout, workers_list=(2,)):
+    opts = {**_workload_opts(workload), "layout": layout,
+            "store_root": None, "funnel": False}
+    model = get_model(workload, opts["node_count"])
+    serial = run_tpu_test(model, dict(opts, check_workers=0))
+    for workers in workers_list:
+        pooled = run_tpu_test(get_model(workload, opts["node_count"]),
+                              dict(opts, check_workers=workers))
+        assert pooled["instances"] == serial["instances"], \
+            (workload, layout, workers)
+        assert pooled["valid?"] == serial["valid?"]
+        assert pooled["net"] == serial["net"]
+        rec = pooled["perf"]["phases"]["check"]
+        assert rec["mode"] in ("pooled", "pooled-fallback-serial")
+    return serial
+
+
+@pytest.mark.parametrize("workload,layout", TIER1_MATRIX)
+def test_pool_verdicts_identical_tier1(workload, layout):
+    _identity_case(workload, layout, workers_list=(2,))
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("workload,layout", SLOW_MATRIX)
+def test_pool_verdicts_identical_full(workload, layout):
+    _identity_case(workload, layout, workers_list=(1, 2, 4))
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("workload,layout", TIER1_MATRIX)
+def test_pool_verdicts_identical_tier1_all_workers(workload, layout):
+    _identity_case(workload, layout, workers_list=(1, 4))
+
+
+def test_pooled_stored_histories_byte_identical(tmp_path):
+    """Store artifacts (history-i.jsonl) from a pooled run equal the
+    serial run's, byte for byte."""
+    opts = {**_workload_opts("lin-kv"), "funnel": False}
+    s_root, p_root = str(tmp_path / "s"), str(tmp_path / "p")
+    run_tpu_test(get_model("lin-kv", 3),
+                 dict(opts, check_workers=0, store_root=s_root))
+    run_tpu_test(get_model("lin-kv", 3),
+                 dict(opts, check_workers=2, store_root=p_root))
+    for i in range(opts["record_instances"]):
+        a = open(os.path.join(s_root, "lin-kv-tpu", "latest",
+                              f"history-{i}.jsonl")).read()
+        b = open(os.path.join(p_root, "lin-kv-tpu", "latest",
+                              f"history-{i}.jsonl")).read()
+        assert a == b, f"history-{i} diverged"
+        assert a.strip(), f"history-{i} is empty"
+
+
+def test_incremental_unique_ids_matches_batch():
+    """The streaming unique-ids twin produces the batch checker's
+    exact dict (first-seen order, repr tie-breaks) fed in chunks."""
+    from maelstrom_tpu.checkers.pool import _IncrementalUniqueIds
+    from maelstrom_tpu.checkers.unique_ids import unique_ids_checker
+    history = []
+    for i, val in enumerate([7, 3, 7, 12, 3, 3, 99]):
+        history.append({"f": "generate", "value": None,
+                        "type": "invoke", "index": 2 * i})
+        history.append({"f": "generate", "value": val, "type": "ok",
+                        "index": 2 * i + 1})
+    history.append({"f": "generate", "value": None, "type": "invoke",
+                    "index": len(history)})   # unacknowledged tail
+    inc = _IncrementalUniqueIds(None, {})
+    for lo in range(0, len(history), 3):      # ragged chunking
+        inc.feed(history[lo:lo + 3])
+    assert inc.result() == unique_ids_checker(history)
+    assert inc.result()["valid?"] is False
+
+
+# --- 3. resilience ---------------------------------------------------------
+
+
+def test_pool_killed_mid_run_falls_back_to_serial(monkeypatch):
+    """SIGKILL every checker worker right after the pool spawns: the
+    run must complete with the serial path's exact verdicts and say so
+    (mode=pooled-fallback-serial)."""
+    from maelstrom_tpu.checkers import pool as pool_mod
+
+    opts = {**_workload_opts("lin-kv"), "funnel": False}
+    serial = run_tpu_test(get_model("lin-kv", 3),
+                          dict(opts, check_workers=0))
+
+    real_feed = pool_mod.CheckerPool.feed
+    state = {"killed": False}
+
+    def kill_then_feed(self, slabs):
+        if not state["killed"]:
+            self.kill()          # every worker dies mid-run
+            state["killed"] = True
+        return real_feed(self, slabs)
+
+    monkeypatch.setattr(pool_mod.CheckerPool, "feed", kill_then_feed)
+    pooled = run_tpu_test(get_model("lin-kv", 3),
+                          dict(opts, check_workers=2))
+    assert state["killed"], "pool was never exercised"
+    rec = pooled["perf"]["phases"]["check"]
+    assert rec["mode"] == "pooled-fallback-serial", rec
+    assert pooled["instances"] == serial["instances"]
+    assert pooled["valid?"] == serial["valid?"]
+
+
+def test_checker_blowup_is_structured_invalid():
+    """Satellite pin: a checker exception becomes invalid-with-reason —
+    instance id, checker name, truncated traceback — and the composed
+    verdict counts it as a definite False (results.checker-errors)."""
+
+    from maelstrom_tpu.models.echo import EchoModel
+
+    class BlowupEcho(EchoModel):
+        checker_name = "blowup-echo"
+
+        def checker(self):
+            def chk(history, opts):
+                raise RuntimeError("checker exploded on purpose")
+            return chk
+
+    res = run_tpu_test(BlowupEcho(), dict(
+        node_count=2, concurrency=2, n_instances=8, record_instances=2,
+        time_limit=0.5, rate=100.0, latency=5.0, seed=3,
+        check_workers=0, funnel=False))
+    assert res["valid?"] is False
+    assert res["checker-errors"] == 2
+    inst = res["instances"][0]
+    assert inst["valid?"] is False
+    assert inst["checker"] == "blowup-echo"
+    assert inst["instance"] == 0
+    assert "RuntimeError" in inst["traceback"]
+    assert "checker exploded on purpose" in inst["error"]
+
+
+def test_worker_side_blowup_is_structured_too():
+    """The worker main loop wraps checker exceptions with the same
+    checker_failure dict (exercised via the worker internals — pooled
+    e2e blow-ups need a registry model, which test models are not)."""
+    from maelstrom_tpu.checkers import checker_failure
+    try:
+        raise ValueError("boom")
+    except ValueError as e:
+        v = checker_failure(e, checker="elle-list-append", instance=5)
+    assert v["valid?"] is False
+    assert v["checker"] == "elle-list-append"
+    assert v["instance"] == 5
+    assert v["traceback"].endswith("ValueError: boom\n")
+    from maelstrom_tpu.checkers import compose_valid
+    assert compose_valid([v["valid?"], True]) is False
+
+
+def test_checker_failure_identical_across_call_sites():
+    """The byte-identity contract extends to BLOW-UP verdicts: the
+    formatted traceback drops its first frame (the harness/pool call
+    site), so the same checker exception produces the same dict
+    whether a farm worker or the serial loop caught it."""
+    from maelstrom_tpu.checkers import checker_failure
+
+    def exploding_checker(history, opts):
+        raise RuntimeError("same explosion")
+
+    def worker_like_call_site():
+        try:
+            exploding_checker([], {})
+        except Exception as e:
+            return checker_failure(e, checker="c", instance=3)
+
+    def serial_like_call_site():
+        try:
+            exploding_checker([], {})
+        except Exception as e:
+            return checker_failure(e, checker="c", instance=3)
+
+    assert worker_like_call_site() == serial_like_call_site()
+
+
+def test_resolve_check_workers_auto():
+    from maelstrom_tpu.checkers.pool import resolve_check_workers
+    assert resolve_check_workers(0, 512) == 0
+    assert resolve_check_workers(3, 512) == 3
+    auto = resolve_check_workers(None, 512)
+    if (os.cpu_count() or 1) >= 2:
+        assert 1 <= auto <= 4
+    else:
+        assert auto == 0
+    # tiny fleets never pay pool spawn
+    assert resolve_check_workers(None, 4) == 0
+
+
+# --- decode speedup (the >=5x acceptance, measured) ------------------------
+
+
+@pytest.mark.slow
+def test_vectorized_decode_speedup():
+    """Acceptance: the event -> per-instance-op-array decode (the
+    column pass that feeds the checker farm) beats the per-event
+    reference loop >= 5x on a bench-shaped tensor (measured ~9x on the
+    1-vCPU dev box, doc/results.md scoreboard). The remaining cost —
+    dict materialization — moved to the checker boundary, where the
+    pool spreads it across workers; the lazily-materialized dicts stay
+    byte-identical."""
+    import time
+
+    model, sim, opts, events = _run_events("lin-kv", "lead")
+    # tile the recorded instances to bench scale (identical per-copy
+    # content; the decoder treats copies as distinct instances)
+    reps = 16
+    events = np.tile(events, (1, reps, 1, 1, 1))
+    t0 = time.monotonic()
+    ref = decode.reference_histories(
+        model, events, final_start=sim.client.final_start)
+    ref_s = time.monotonic() - t0
+    t0 = time.monotonic()
+    slabs = decode.decode_dense(model, events)
+    col_s = time.monotonic() - t0
+    lazy = decode.LazyHistories(model, slabs, events.shape[1],
+                                sim.client.final_start, 1)
+    assert _dump(lazy.materialize()) == _dump(ref)
+    assert col_s * 5 <= ref_s, (col_s, ref_s)
+
+
+@pytest.mark.slow
+def test_pool_check_speedup_at_4_workers():
+    """Acceptance: 512-instance lin-kv verdict wall-clock >= 2.5x
+    faster through 4 checker workers than serial. Needs real cores —
+    skipped below 4 (the 1-vCPU dev box runs the identity half of the
+    contract; this half is the multi-core window's to hold)."""
+    import time
+
+    if (os.cpu_count() or 1) < 4:
+        pytest.skip("needs >= 4 cores to demonstrate pool scaling")
+    from maelstrom_tpu.checkers.pool import (CheckerPool, pool_spec,
+                                             checker_name)
+    from maelstrom_tpu.checkers import checker_failure
+
+    opts = {**_workload_opts("lin-kv"), "time_limit": 2.0,
+            "record_instances": 8, "n_instances": 8}
+    model = get_model("lin-kv", 3)
+    sim = make_sim_config(model, opts)
+    carry, ys = run_sim(model, sim, opts["seed"],
+                        model.make_params(3))
+    base = decode.decode_dense(model, np.asarray(ys.events))
+    # tile the 8 recorded instances to a 512-instance verdict load
+    slabs = {i: base[i % 8] for i in range(512) if (i % 8) in base}
+    spec = pool_spec(model, opts, sim.client.final_start, 1)
+
+    def pooled(workers):
+        farm = CheckerPool(spec, workers)
+        try:
+            t0 = time.monotonic()
+            farm.feed(slabs)
+            out = farm.finalize(list(range(512)))
+            dt = time.monotonic() - t0
+            assert out is not None, "pool broke"
+            return out, dt
+        finally:
+            farm.close()
+    # warm the forkserver so worker startup is not billed to the run
+    pooled(1)
+    checker = model.checker()
+    lazy = decode.LazyHistories(model, slabs, 512,
+                                sim.client.final_start, 1)
+    t0 = time.monotonic()
+    serial = {}
+    for inst in range(512):
+        try:
+            serial[inst] = checker(lazy[inst], opts)
+        except Exception as e:
+            serial[inst] = checker_failure(e, checker_name(model),
+                                           inst)
+    serial_s = time.monotonic() - t0
+    got, pooled_s = pooled(4)
+    assert got == serial
+    assert pooled_s * 2.5 <= serial_s, (pooled_s, serial_s)

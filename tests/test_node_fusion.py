@@ -4,12 +4,13 @@ The compartmentalized node step (``models/raft_core.py``, driven by
 ``runtime.node_phase`` for ``fused_node`` models) promises two things:
 
 1. **Bit-identity** — trajectories are EXACTLY the pre-refactor
-   runtime's, in both carry layouts. The proof is two-sided: frozen
-   golden digests recorded from the pre-refactor code
+   runtime's, in both carry layouts, pinned by frozen golden digests
+   recorded from the pre-refactor code
    (``tests/data/node_fusion_golden.json`` — these can never be
-   regenerated from this tree, so they pin history), and a LIVE oracle
-   (the legacy ``handle()``/``tick()`` driver still in the runtime,
-   selected by flipping ``fused_node`` off on a throwaway subclass).
+   regenerated from this tree, so they pin history). The legacy
+   ``handle()``/``tick()`` formulation itself (PR 6's live oracle) was
+   DELETED after its soak window — the goldens are the remaining, and
+   sufficient, identity anchor.
 2. **Cost** — the node phase of every raft-family model drops >= 2x in
    jaxpr equation count vs the PR-5 baseline, with ZERO fusion-breaking
    loops (the unrolled scans must keep lowering while-free), enforced
@@ -70,18 +71,6 @@ RAFT_FAMILY = [
 PR5_NODE_EQNS = {"lin-kv": 1083, "txn-rw-register": 1175,
                  "txn-list-append": 1499}
 AUDIT_N = {"lin-kv": 5, "txn-rw-register": 3, "txn-list-append": 3}
-
-
-def _legacy_of(model):
-    """The same model instance driven through the legacy
-    handle()/tick() node step: a throwaway subclass (fresh type => its
-    own jit cache slot) with the fused protocol switched off."""
-    cls = type(model)
-    leg = type(cls.__name__ + "LegacyOracle", (cls,),
-               {"fused_node": False})
-    m = leg.__new__(leg)
-    m.__dict__.update(model.__dict__)
-    return m
 
 
 def _traj_digest(model, layout):
@@ -172,34 +161,25 @@ def test_golden_pins_the_planted_bugs():
             != GOLDEN["txn-rw-register/lead"])
 
 
-# --- live legacy-path oracle ----------------------------------------------
+# --- the legacy path is gone ----------------------------------------------
 
 
-def _assert_fused_equals_legacy(workload, layout, opts, seed=7):
-    model = get_model(workload, opts["node_count"])
-    assert type(model).fused_node, "raft family must default to fused"
-    sim = make_sim_config(model, {**opts, "layout": layout})
-    params = model.make_params(sim.net.n_nodes)
-    fused = run_sim(model, sim, seed, params)
-    legacy = run_sim(_legacy_of(model), sim, seed, params)
-    for a, b in zip(jax.tree.leaves(fused), jax.tree.leaves(legacy)):
-        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
-
-
-def test_fused_equals_legacy_live():
-    """Fused vs legacy driver on the SAME current tree: full (carry,
-    ys) equality, every leaf — the oracle that keeps working after the
-    golden config's trajectory drifts for an intentional reason."""
-    _assert_fused_equals_legacy("lin-kv", "lead", GOLDEN_OPTS)
-
-
-@pytest.mark.slow
-@pytest.mark.parametrize("workload,layout", [
-    ("txn-list-append", "minor"), ("txn-rw-register", "lead"),
-    ("lin-kv-bug-stale-read", "minor"),
-    ("txn-rw-register-bug-dirty-apply", "lead")])
-def test_fused_equals_legacy_live_sweep(workload, layout):
-    _assert_fused_equals_legacy(workload, layout, GOLDEN_OPTS)
+def test_raft_family_has_no_legacy_node_path():
+    """ROADMAP item 1 residual: the legacy ``handle()``/``tick()``
+    formulation (and its helpers) was deleted from the raft family
+    after the soak window — the fused protocol is the only node step.
+    A reintroduced override would silently fork the semantics away
+    from what the frozen goldens pin, so its absence is asserted."""
+    from maelstrom_tpu.tpu.runtime import Model
+    for wl in RAFT_FAMILY:
+        model = get_model(wl, GOLDEN_OPTS["node_count"])
+        assert type(model).fused_node, wl
+        # handle/tick resolve to the abstract Model defaults only
+        assert type(model).handle is Model.handle, wl
+        assert type(model).tick is Model.tick, wl
+        for helper in ("_apply_one", "_peer_sends", "_apply_frontier",
+                       "_step_down", "_reset_election"):
+            assert not hasattr(model, helper), (wl, helper)
 
 
 # --- the planted bugs still fire ------------------------------------------

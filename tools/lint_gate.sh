@@ -195,6 +195,39 @@ print(f"narrow {na} B/row == wide {nb} B/row trajectories; "
 PY
 
 echo
+echo "== pooled-check smoke (checker farm == serial verdicts on the planted mutant)"
+# the planted double-vote mutant run twice — once through a 2-worker
+# checker farm, once serial — must exit 1 BOTH times with the same
+# flagged instances and per-instance verdicts (the pool can change
+# wall-clock, never a verdict), and the pooled run must actually have
+# used the pool (perf.phases.check.mode)
+for CW in 2 0; do
+    rc=0
+    python -m maelstrom_tpu test --runtime tpu -w lin-kv-bug-double-vote \
+        --node-count 3 --concurrency 6 --rate 200 --time-limit 0.3 \
+        --n-instances 16 --record-instances 4 --nemesis partition \
+        --nemesis-interval 0.04 --recovery-time 0 --p-loss 0.05 \
+        --pipeline on --chunk-ticks 50 --seed 7 --check-workers "$CW" \
+        > "$SMOKE_STORE/pool-smoke-cw$CW.json" || rc=$?
+    [[ "$rc" == "1" ]] || { echo "expected exit 1 (mutant caught at check-workers=$CW), got $rc"; exit 1; }
+done
+python - "$SMOKE_STORE" <<'PY'
+import json, sys
+dec = json.JSONDecoder()
+pooled = dec.raw_decode(open(sys.argv[1] + "/pool-smoke-cw2.json").read())[0]
+serial = dec.raw_decode(open(sys.argv[1] + "/pool-smoke-cw0.json").read())[0]
+assert pooled["perf"]["phases"]["check"]["mode"] == "pooled", \
+    pooled["perf"]["phases"]["check"]
+assert serial["perf"]["phases"]["check"]["mode"] == "serial"
+assert pooled["instances"] == serial["instances"], "verdicts diverged"
+assert pooled["invariants"] == serial["invariants"], "flagged set diverged"
+n = pooled["invariants"]["violating-instances"]
+assert n > 0, "planted bug not flagged"
+print(f"pooled-check smoke: {n} flagged instance(s), pooled == serial "
+      f"verdicts across {pooled['checked-instances']} checked")
+PY
+
+echo
 echo "== fleet-stats smoke (tiny echo run -> telemetry report)"
 python -m maelstrom_tpu test --runtime tpu -w echo --node-count 2 \
     --time-limit 0.5 --rate 100 --n-instances 8 --record-instances 2 \
