@@ -20,8 +20,10 @@ option specs :136-229):
   into a terminal report (doc/observability.md live-runs section)
 - ``triage`` — replay a run's flagged instances bit-exactly and emit
   per-instance forensics bundles (spacetime SVG + EDN journal + repro)
-- ``shrink`` — minimize a fault-fuzz run's failing randomized
-  schedules into small still-failing deterministic plans
+- ``shrink`` — minimize a fault run's failing scenario into a small
+  still-failing deterministic plan: fuzz runs reconstruct each flagged
+  instance's randomized schedule from the seed, --fault-plan runs
+  delta-debug the (usually over-specified) plan itself
   (faults/shrink.py; doc/guide/10-faults.md)
 - ``campaign`` — the durable control plane: ``submit`` a sweep matrix
   as a resumable work queue, ``run`` drains it with periodic carry
@@ -91,21 +93,24 @@ def add_test_options(p: argparse.ArgumentParser):
                    choices=["constant", "uniform", "exponential"])
     p.add_argument("--nemesis", action="append", default=[],
                    choices=["partition", "crash-restart", "link-degrade",
-                            "clock-skew"],
+                            "clock-skew", "membership"],
                    help="fault kinds, composable (repeat the flag). "
                         "'partition' runs everywhere; the fault-plan "
                         "kinds (crash-restart, link-degrade, "
-                        "clock-skew) are device-resident TPU-runtime "
-                        "lanes generated on the nemesis interval grid "
-                        "(maelstrom_tpu/faults/, doc/guide/10-faults"
-                        ".md)")
+                        "clock-skew, membership — the last drives "
+                        "mid-run node remove/rejoin through Raft "
+                        "joint consensus) are device-resident "
+                        "TPU-runtime lanes generated on the nemesis "
+                        "interval grid (maelstrom_tpu/faults/, "
+                        "doc/guide/10-faults.md)")
     p.add_argument("--nemesis-interval", type=float, default=10.0)
     p.add_argument("--fault-plan", default=None,
                    help="TPU runtime: JSON fault-plan file (phases of "
                         "crash-restart / link-degradation / clock-skew "
-                        "lanes; doc/guide/10-faults.md). Mutually "
-                        "exclusive with the generated fault --nemesis "
-                        "kinds; composes with --nemesis partition")
+                        "/ membership lanes; doc/guide/10-faults.md). "
+                        "Mutually exclusive with the generated fault "
+                        "--nemesis kinds; composes with --nemesis "
+                        "partition")
     p.add_argument("--fault-fuzz", default=None,
                    help="TPU runtime: JSON fault DISTRIBUTION file — "
                         "per-instance RANDOMIZED crash/link/skew "
@@ -1033,11 +1038,13 @@ def cmd_triage(args) -> int:
 
 
 def cmd_shrink(args) -> int:
-    """Minimize a fuzz run's failing schedules (faults/shrink.py):
-    reconstruct each flagged instance's randomized schedule from the
-    seed, replay it bit-exactly as a deterministic plan through the
-    pipelined executor, delta-debug it to a minimal still-failing
-    nemesis, and write triage/instance-<id>/shrunk-plan.json."""
+    """Minimize a fault run's failing scenario (faults/shrink.py):
+    for a fuzz run, reconstruct each flagged instance's randomized
+    schedule from the seed; for a --fault-plan run, start from the
+    plan itself. Replay bit-exactly through the pipelined executor,
+    delta-debug (ddmin complement-halving + greedy passes) to a
+    minimal still-failing nemesis, and write
+    triage/instance-<id>/shrunk-plan.json."""
     from .faults.shrink import (ShrinkError, render_shrink_report,
                                 shrink_run)
 
@@ -1286,14 +1293,17 @@ def main(argv=None) -> int:
                                "diagram is annotated '+N elided'")
 
     p_shrink = sub.add_parser(
-        "shrink", help="minimize a fault-fuzz run's failing schedules: "
+        "shrink", help="minimize a fault run's failing scenario: "
                        "rebuild each flagged instance's randomized "
-                       "schedule from the seed, delta-debug it to a "
-                       "minimal still-failing deterministic plan "
+                       "schedule from the seed (fuzz runs) or start "
+                       "from the deterministic plan itself "
+                       "(--fault-plan runs), then delta-debug to a "
+                       "minimal still-failing plan "
                        "(triage/instance-<id>/shrunk-plan.json)")
     p_shrink.add_argument("path",
-                          help="a store run dir of a --fault-fuzz run "
-                               "with flagged instances")
+                          help="a store run dir of a --fault-fuzz or "
+                               "--fault-plan run with flagged "
+                               "instances")
     p_shrink.add_argument("--instance", type=int, action="append",
                           default=[],
                           help="shrink this instance id (repeatable; "

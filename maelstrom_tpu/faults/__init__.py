@@ -23,6 +23,16 @@ plan** the tick scan indexes by ``t``:
   node's LOCAL clock (``local_t = t * rate / 64``); election and
   heartbeat timers run on local time, so Raft's timeout assumptions are
   actually stressed while the network keeps global time.
+- **membership** — per-phase node add/remove events (inheriting
+  ``members``/``add``/``remove`` dialect): non-members are parked like
+  crash victims, joins re-boot through ``Model.join_row`` from the
+  snapshot slab, clients re-target the member set, and the target
+  bitmask threads into the node step so Raft runs the change through
+  JOINT CONSENSUS (``models/raft_core.py``: C_old,new / C_new log
+  entries, dual-quorum election and commit) — where real consensus
+  implementations historically break, and where the two newest
+  planted bugs live (``RaftSingleQuorumReconfig``,
+  ``RaftVotesBeforeCatchup``).
 
 The plan is compiled from a declarative :class:`FaultSpec`-shaped dict
 (``doc/guide/10-faults.md``) into a hashable :class:`FaultConfig` that
@@ -44,9 +54,11 @@ the single repro currency.
 """
 
 from .engine import (FaultConfig, FaultPlanes, NO_PLANES,  # noqa: F401
-                     phase_summary, tick_planes, update_snapshots,
-                     wipe_crashed)
+                     member_bits, phase_summary, retarget_clients,
+                     tick_planes, update_snapshots, wipe_crashed,
+                     wipe_parked)
 from .spec import (FAULT_KINDS, SpecError, compile_fault_plan,  # noqa: F401
-                   generate_fault_plan, validate_fault_plan)
+                   generate_fault_plan, membership_walk,
+                   validate_fault_plan)
 from .fuzz import (FuzzConfig, compile_fault_fuzz,  # noqa: F401
                    validate_fault_fuzz)

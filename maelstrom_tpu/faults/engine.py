@@ -33,6 +33,23 @@ every other tick phase, so lead/minor trajectories stay bit-identical):
 - ``skew`` — per-node clock rate in 64ths (64 = 1.0x): the node phase
   runs each node's timers on ``local_t = (t * rate) // 64``. Rate 64 is
   exactly ``t`` (no rounding), so a neutral skew lane is bit-identical.
+- ``membership`` — a per-phase member bitmask (``spec.membership_walk``
+  resolves the add/remove event dialect to absolute per-phase sets).
+  NON-members are parked exactly like crashed nodes: delivery to them
+  is blocked via the partition plane, their sends are invalidated
+  pre-enqueue, and their row is held at ``Model.join_row`` of their
+  snapshot-slab state (terms/timers frozen at the leave point — a
+  parked replica is a powered-off machine, not a ranting candidate).
+  The tick that turns a node's membership ON is a JOIN: the last park
+  wipe already rebuilt the row through ``join_row`` with the CURRENT
+  target bitmask, so the node comes back re-provisioned (slab log +
+  cluster config + re-based timers — the Netherite rejoin idiom) and,
+  for catchup-gated models, mute until it holds the committed prefix.
+  The member bitmask also threads INTO the node step (``m_bits``), so
+  Raft drives the actual config change through joint consensus
+  (``models/raft_core.py``: C_old,new / C_new log entries,
+  dual-quorum election and commit) rather than by administrative fiat
+  — the plane is the operator's TARGET, the log is the truth.
 
 Everything here is traced (fixed shapes, jnp only, static branches on
 the config) and linted with the models (``maelstrom lint --strict``).
@@ -60,6 +77,9 @@ class FaultConfig(NamedTuple):
     - ``crash[p]``   — tuple of crashed server-node ids
     - ``links[p]``   — tuples ``(dst, src, block, delay, loss_pm)``
     - ``skew[p]``    — tuples ``(node, rate64)``
+    - ``members``    — ``None`` (lane absent) or one ABSOLUTE sorted
+      member tuple per phase (``spec.membership_walk`` applied the
+      add/remove inheritance); the trailing heal row is everyone
 
     ``fuzz`` (a :class:`~.fuzz.FuzzConfig`, or ``None``) switches the
     config from ONE deterministic fleet-shared plan to per-instance
@@ -74,6 +94,11 @@ class FaultConfig(NamedTuple):
     crash: Tuple[Tuple[int, ...], ...] = ()
     links: Tuple[Tuple[Tuple[int, int, int, int, int], ...], ...] = ()
     skew: Tuple[Tuple[Tuple[int, int], ...], ...] = ()
+    members: Optional[Tuple[Tuple[int, ...], ...]] = None
+    n_nodes: int = 0              # cluster size the plan compiled for
+    #                               (the membership lane's universe —
+    #                               host summaries need it; 0 on the
+    #                               disabled config)
     fuzz: Optional[Any] = None    # FuzzConfig (hashable NamedTuple)
 
     # lane presence is a STATIC property: a lane is "present" when any
@@ -105,8 +130,15 @@ class FaultConfig(NamedTuple):
         return self.enabled and any(len(p) for p in self.skew)
 
     @property
+    def has_members(self) -> bool:
+        if self.has_fuzz:
+            return self.fuzz.has_membership
+        return self.enabled and self.members is not None
+
+    @property
     def active(self) -> bool:
-        return self.has_crash or self.has_links or self.has_skew
+        return (self.has_crash or self.has_links or self.has_skew
+                or self.has_members)
 
 
 class FaultPlanes(NamedTuple):
@@ -117,6 +149,11 @@ class FaultPlanes(NamedTuple):
     delay: Optional[Any] = None      # [NT, NT] int32 — extra latency
     loss_pm: Optional[Any] = None    # [NT, NT] int32 — per-mille loss
     t_nodes: Optional[Any] = None    # [N] int32 — per-node local clock
+    member: Optional[Any] = None     # [N] bool — this tick's members
+    member_prev: Optional[Any] = None  # [N] bool — last tick's members
+    #                                  (member & ~member_prev = a JOIN
+    #                                  edge; ~(member & member_prev) =
+    #                                  the park-wipe mask)
 
 
 NO_PLANES = FaultPlanes()
@@ -134,6 +171,7 @@ def _planes_np(fx: FaultConfig, n_nodes: int, n_clients: int):
     delay = np.zeros((P + 1, NT, NT), dtype=np.int32)
     loss = np.zeros((P + 1, NT, NT), dtype=np.int32)
     skew = np.full((P + 1, n_nodes), NEUTRAL_RATE, dtype=np.int32)
+    member = np.ones((P + 1, n_nodes), dtype=bool)  # heal row: all in
     for p in range(P):
         if p < len(fx.crash):
             for v in fx.crash[p]:
@@ -142,6 +180,15 @@ def _planes_np(fx: FaultConfig, n_nodes: int, n_clients: int):
                 # its own in-flight sends still deliver (origin edges
                 # are NOT blocked)
                 block[p, v, :] = True
+        if fx.members is not None and p < len(fx.members):
+            member[p, :] = False
+            for v in fx.members[p]:
+                member[p, v] = True
+            # a parked non-member hears nobody, exactly like a crash
+            # victim (its in-flight sends still deliver)
+            for v in range(n_nodes):
+                if not member[p, v]:
+                    block[p, v, :] = True
         if p < len(fx.links):
             for dst, src, blk, d, pm in fx.links[p]:
                 # duplicate entries for one directed edge MERGE (the
@@ -156,7 +203,7 @@ def _planes_np(fx: FaultConfig, n_nodes: int, n_clients: int):
             for node, rate in fx.skew[p]:
                 skew[p, node] = rate
     untils = np.asarray(fx.untils, dtype=np.int32)
-    return untils, crash, block, delay, loss, skew
+    return untils, crash, block, delay, loss, skew, member
 
 
 def tick_planes(fx: FaultConfig, cfg, t) -> FaultPlanes:
@@ -172,11 +219,15 @@ def tick_planes(fx: FaultConfig, cfg, t) -> FaultPlanes:
         return NO_PLANES
     import jax.numpy as jnp
 
-    untils, crash, block, delay, loss, skew = _planes_np(
+    untils, crash, block, delay, loss, skew, member = _planes_np(
         fx, cfg.n_nodes, cfg.n_clients)
     P = len(fx.untils)
-    phase = jnp.searchsorted(jnp.asarray(untils), t, side="right")
-    phase = jnp.clip(jnp.where(t < fx.stop_tick, phase, P), 0, P)
+
+    def phase_of(tt):
+        ph = jnp.searchsorted(jnp.asarray(untils), tt, side="right")
+        return jnp.clip(jnp.where(tt < fx.stop_tick, ph, P), 0, P)
+
+    phase = phase_of(t)
     out = {}
     if fx.has_crash:
         out["crash"] = jnp.asarray(crash)[phase]
@@ -187,11 +238,19 @@ def tick_planes(fx: FaultConfig, cfg, t) -> FaultPlanes:
         out["loss_pm"] = jnp.asarray(loss)[phase]
     if fx.has_skew:
         out["t_nodes"] = (t * jnp.asarray(skew)[phase]) // NEUTRAL_RATE
+    if fx.has_members:
+        mem = jnp.asarray(member)
+        out["member"] = mem[phase]
+        # last tick's membership row: tick 0 reads its own phase (no
+        # join edge at the start — phase 0's members are the INITIAL
+        # cluster, provisioned at init, not a mid-run join)
+        out["member_prev"] = mem[phase_of(t - 1)]
     return FaultPlanes(**out)
 
 
 def _any_block(fx: FaultConfig) -> bool:
-    return any(e[2] for p in fx.links for e in p) or fx.has_crash
+    return any(e[2] for p in fx.links for e in p) or fx.has_crash \
+        or fx.has_members
 
 
 def wipe_crashed(model, node_state, snapshots, crash_mask, t_nodes,
@@ -217,6 +276,68 @@ def wipe_crashed(model, node_state, snapshots, crash_mask, t_nodes,
         return jnp.where(m, b, a)
 
     return jax.tree.map(pick, node_state, fresh)
+
+
+def member_bits(member):
+    """Fold the ``[N]`` member plane into the int32 bitmask the node
+    step consumes (bit ``i`` = node ``i`` is an administrative member —
+    the reconfiguration TARGET Raft's joint consensus drives toward).
+    ``N <= 30`` is enforced at spec time (``spec.MAX_MEMBER_NODES``)."""
+    import jax.numpy as jnp
+
+    n = member.shape[0]
+    return jnp.sum(jnp.where(member,
+                             jnp.int32(1) << jnp.arange(n,
+                                                        dtype=jnp.int32),
+                             0)).astype(jnp.int32)
+
+
+def wipe_parked(model, node_state, snapshots, park_mask, m_bits,
+                t_nodes, wipe_key, cfg, params):
+    """Hold non-(stable-)members parked: rebuild each parked row via
+    ``Model.join_row`` (snapshot-slab recovery + the CURRENT target
+    bitmask as the re-provisioned cluster config) and select it in
+    under the park mask. The mask covers ``~(member & member_prev)`` —
+    every non-member tick AND the join-edge tick itself, so a joining
+    node's final rebuild sees the bitmask that includes it. One
+    instance's unbatched state; the runtime vmaps this in both
+    layouts, mirroring :func:`wipe_crashed`."""
+    import jax
+    import jax.numpy as jnp
+
+    N = cfg.n_nodes
+    idx = jnp.arange(N, dtype=jnp.int32)
+    nkeys = jax.vmap(lambda i: jax.random.fold_in(wipe_key, i))(idx)
+    fresh = jax.vmap(
+        lambda nk, ni, snap, tn: model.join_row(N, ni, nk, params,
+                                                snap, tn, m_bits))(
+        nkeys, idx, snapshots, t_nodes)
+
+    def pick(a, b):
+        m = park_mask.reshape((N,) + (1,) * (a.ndim - 1))
+        return jnp.where(m, b, a)
+
+    return jax.tree.map(pick, node_state, fresh)
+
+
+def retarget_clients(reqs, member):
+    """Remap client request destinations onto the CURRENT member list
+    (clients only talk to nodes that exist — the reference's client
+    node-list refresh on reconfiguration). ``reqs`` is one instance's
+    ``[C, L]`` request block with server dests in ``[0, N)``; the remap
+    is ``members_sorted[dest % n_members]``, which is the identity when
+    everyone is a member (``argsort`` of an all-False key is stable ->
+    ``[0..N)``, and ``dest % N == dest``), keeping all-healthy lanes
+    bit-identical."""
+    import jax.numpy as jnp
+
+    from ..tpu import wire
+
+    order = jnp.argsort(~member).astype(jnp.int32)  # members first,
+    #                                                 ascending ids
+    n_m = jnp.maximum(jnp.sum(member).astype(jnp.int32), 1)
+    dest = reqs[:, wire.DEST]
+    return reqs.at[:, wire.DEST].set(order[dest % n_m])
 
 
 def update_snapshots(model, node_state, snapshots, crash_mask, t,
@@ -257,6 +378,35 @@ def phase_at(fx: FaultConfig, tick: int) -> int:
                                tick, side="right"))
 
 
+def _members_at(fx: FaultConfig, p: int) -> Optional[set]:
+    """Phase ``p``'s absolute member set (the trailing heal row — and
+    any phase past the lane's tuples — is everyone), or ``None`` when
+    the lane is absent."""
+    if fx.members is None:
+        return None
+    if 0 <= p < len(fx.members):
+        return set(fx.members[p])
+    return set(range(fx.n_nodes))
+
+
+def _membership_epoch(fx: FaultConfig, p: int) -> Optional[Dict[str, Any]]:
+    """The phase's membership record: the current member set, who is
+    OUT relative to the full cluster, and the join events at the phase
+    start (what ``watch`` renders as ``membership +1/-2``)."""
+    cur = _members_at(fx, p)
+    if cur is None:
+        return None
+    prev = _members_at(fx, p - 1) if p > 0 else cur
+    out: Dict[str, Any] = {"members": sorted(cur)}
+    joined = sorted(cur - prev)
+    removed = sorted(set(range(fx.n_nodes)) - cur)
+    if joined:
+        out["joined"] = joined
+    if removed:
+        out["removed"] = removed
+    return out
+
+
 def phase_summary(fx: FaultConfig, tick: int) -> Dict[str, Any]:
     """The heartbeat's per-chunk fault-epoch record: which phase the
     chunk ended in and which lanes it had active."""
@@ -271,6 +421,9 @@ def phase_summary(fx: FaultConfig, tick: int) -> Dict[str, Any]:
         out["degraded-edges"] = len(fx.links[p])
     if p < len(fx.skew) and fx.skew[p]:
         out["skewed-nodes"] = len(fx.skew[p])
+    mem = _membership_epoch(fx, p)
+    if mem is not None:
+        out["membership"] = mem
     return out
 
 
@@ -285,6 +438,9 @@ def span_summary(fx: FaultConfig, t0: int, ticks: int) -> Dict[str, Any]:
     crashed: set = set()
     edges = 0
     skewed = 0
+    joined: set = set()
+    removed: set = set()
+    members_end: Optional[set] = None
     healthy = True
     for p in range(len(fx.untils)):
         lo = fx.untils[p - 1] if p else 0
@@ -300,6 +456,15 @@ def span_summary(fx: FaultConfig, t0: int, ticks: int) -> Dict[str, Any]:
         if p < len(fx.skew) and fx.skew[p]:
             skewed = max(skewed, len(fx.skew[p]))
             healthy = False
+        mem = _membership_epoch(fx, p)
+        if mem is not None:
+            joined.update(mem.get("joined", ()))
+            removed.update(mem.get("removed", ()))
+            members_end = set(mem["members"])
+    if members_end is not None and (joined or removed
+                                    or len(members_end) < fx.n_nodes):
+        # join/remove events in the span, or nodes parked through it
+        healthy = False
     if healthy:
         out["healthy"] = True
         return out
@@ -309,6 +474,12 @@ def span_summary(fx: FaultConfig, t0: int, ticks: int) -> Dict[str, Any]:
         out["degraded-edges"] = edges
     if skewed:
         out["skewed-nodes"] = skewed
+    if members_end is not None:
+        out["membership"] = {"members": sorted(members_end),
+                             **({"joined": sorted(joined)}
+                                if joined else {}),
+                             **({"removed": sorted(removed)}
+                                if removed else {})}
     return out
 
 
@@ -318,7 +489,8 @@ def plan_summary(fx: FaultConfig) -> Dict[str, Any]:
     carry the full spec)."""
     lanes = [name for name, on in (("crash-restart", fx.has_crash),
                                    ("link-degradation", fx.has_links),
-                                   ("clock-skew", fx.has_skew)) if on]
+                                   ("clock-skew", fx.has_skew),
+                                   ("membership", fx.has_members)) if on]
     out: Dict[str, Any] = {"phases": len(fx.untils), "lanes": lanes,
                            "snapshot-every": fx.snapshot_every,
                            "stop-tick": int(fx.stop_tick)}

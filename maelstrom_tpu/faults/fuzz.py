@@ -25,6 +25,7 @@ Distribution format (ranges are inclusive ``[lo, hi]``; scalars read as
      "links": {"rate": 0.5, "edges": [1, 4], "block": 0.3,
                "delay": [0, 40], "loss": [0.0, 0.4]},
      "skew":  {"rate": 0.3, "victims": [1, 2], "range": [0.5, 2.0]},
+     "membership": {"rate": 0.4, "victims": [1, 2]},
      "snapshot_every": 1}
 
 - ``windows`` — fault-window count per schedule; each window is a
@@ -35,6 +36,13 @@ Distribution format (ranges are inclusive ``[lo, hi]``; scalars read as
   ``victims``/``edges`` the victim-count range (distinct nodes via an
   on-device permutation; directed non-self edges for links); ``delay``/
   ``loss``/``block``/``range`` the per-victim quality draws.
+- ``membership`` — per window, the drawn victims are REMOVED from the
+  cluster (parked like crash victims, clients re-targeted, and the
+  shrunk member bitmask handed to the node step as the
+  reconfiguration target — Raft drives the change through joint
+  consensus) and re-added when the window ends (a join: slab
+  recovery, catch-up gating). ``victims`` is capped at ``n_nodes - 1``
+  so no draw can ever empty the cluster.
 
 The drawn :class:`FaultSchedule` is a small int32/bool pytree that
 RIDES THE CARRY (``Carry.fault_sched``) so checkpoint/resume and triage
@@ -63,7 +71,7 @@ import numpy as np
 
 from .engine import NEUTRAL_RATE, FaultConfig, FaultPlanes
 from .spec import (MAX_DELAY_TICKS, MAX_RATE, MIN_RATE, SpecError,
-                   _get)
+                   _get, membership_heal_phases)
 
 # the schedule-RNG purpose tag (tpu/runtime.py aliases this as
 # _RNG_FAULTS): schedule keys fold (master, RNG_PURPOSE, instance_id) —
@@ -108,6 +116,7 @@ class FuzzConfig(NamedTuple):
     crash: LaneFuzz = LaneFuzz()
     links: LaneFuzz = LaneFuzz()
     skew: LaneFuzz = LaneFuzz()
+    membership: LaneFuzz = LaneFuzz()
 
     @property
     def has_crash(self) -> bool:
@@ -120,6 +129,10 @@ class FuzzConfig(NamedTuple):
     @property
     def has_skew(self) -> bool:
         return self.enabled and self.skew.victims_max > 0
+
+    @property
+    def has_membership(self) -> bool:
+        return self.enabled and self.membership.victims_max > 0
 
 
 class FaultSchedule(NamedTuple):
@@ -140,6 +153,9 @@ class FaultSchedule(NamedTuple):
     edge_delay: Any      # [W, E] int32 extra ticks
     edge_loss_pm: Any    # [W, E] int32 per-mille
     skew: Any            # [W, N] int32 rate64 (NEUTRAL_RATE = healthy)
+    mem_out: Any         # [W, N] bool — nodes REMOVED during window w
+    #                      (re-added at the window end; all-False when
+    #                      the membership lane is unconfigured)
 
 
 def _err(msg: str) -> SpecError:
@@ -211,9 +227,25 @@ def validate_fault_fuzz(dist: Dict[str, Any], n_nodes: int) -> None:
         _range(_get(skew, "range", [1.0, 1.0]), "skew range", MIN_RATE,
                MAX_RATE, cast=float)
         lanes += 1
+    mem = _get(dist, "membership")
+    if mem is not None:
+        if n_nodes < 2:
+            raise _err("membership lane needs >= 2 server nodes "
+                       "(removing the only node would empty the "
+                       "cluster)")
+        from .spec import MAX_MEMBER_NODES
+        if n_nodes > MAX_MEMBER_NODES:
+            raise _err(f"membership lane supports at most "
+                       f"{MAX_MEMBER_NODES} server nodes (int32 "
+                       f"member bitmask), got n_nodes={n_nodes}")
+        _rate_pm(_get(mem, "rate", 0.0), "membership")
+        # victims cap n_nodes - 1: no draw may ever EMPTY the cluster
+        _range(_get(mem, "victims", 1), "membership victims", 1,
+               n_nodes - 1)
+        lanes += 1
     if lanes == 0:
         raise _err("needs at least one lane block "
-                   "(crash / links / skew)")
+                   "(crash / links / skew / membership)")
 
 
 def compile_fault_fuzz(dist: Optional[Dict[str, Any]], n_nodes: int,
@@ -232,7 +264,7 @@ def compile_fault_fuzz(dist: Optional[Dict[str, Any]], n_nodes: int,
                         MAX_DELAY_TICKS)
     d_lo, d_hi = _range(_get(dist, "duration", [1, 1]), "duration", 1,
                         MAX_DELAY_TICKS)
-    crash = links = skew = LaneFuzz()
+    crash = links = skew = membership = LaneFuzz()
     c = _get(dist, "crash")
     if c is not None:
         v_lo, v_hi = _range(_get(c, "victims", 1), "crash victims", 1,
@@ -265,14 +297,23 @@ def compile_fault_fuzz(dist: Optional[Dict[str, Any]], n_nodes: int,
             victims_min=v_lo, victims_max=v_hi,
             rate64_min=max(1, int(round(r_lo * NEUTRAL_RATE))),
             rate64_max=max(1, int(round(r_hi * NEUTRAL_RATE))))
+    m = _get(dist, "membership")
+    if m is not None:
+        v_lo, v_hi = _range(_get(m, "victims", 1),
+                            "membership victims", 1, n_nodes - 1)
+        membership = LaneFuzz(
+            rate_pm=_rate_pm(_get(m, "rate", 0.0), "membership"),
+            victims_min=v_lo, victims_max=v_hi)
     plan_every = _get(dist, "snapshot_every", 1)
     every = int(snapshot_every if snapshot_every is not None
                 else (1 if plan_every is None else plan_every))
     fz = FuzzConfig(enabled=True, windows_min=w_lo, windows_max=w_hi,
                     gap_min=g_lo, gap_max=g_hi, dur_min=d_lo,
-                    dur_max=d_hi, crash=crash, links=links, skew=skew)
+                    dur_max=d_hi, crash=crash, links=links, skew=skew,
+                    membership=membership)
     return FaultConfig(enabled=True, stop_tick=int(stop_tick),
-                       snapshot_every=every, fuzz=fz)
+                       snapshot_every=every, fuzz=fz,
+                       n_nodes=int(n_nodes))
 
 
 # --- the on-device schedule draw -------------------------------------------
@@ -298,8 +339,8 @@ def draw_schedule(key, fx: FaultConfig, n_nodes: int) -> FaultSchedule:
     N = n_nodes
     W = fz.windows_max
     E = fz.links.victims_max
-    k_win, k_crash, k_links, k_skew = (jax.random.fold_in(key, i)
-                                       for i in (1, 2, 3, 4))
+    k_win, k_crash, k_links, k_skew, k_mem = (
+        jax.random.fold_in(key, i) for i in (1, 2, 3, 4, 5))
 
     n_w = jax.random.randint(jax.random.fold_in(k_win, 0), (),
                              fz.windows_min, fz.windows_max + 1)
@@ -380,9 +421,26 @@ def draw_schedule(key, fx: FaultConfig, n_nodes: int) -> FaultSchedule:
     else:
         skew = jnp.full((W, N), NEUTRAL_RATE, jnp.int32)
 
+    if fz.has_membership:
+        mf = fz.membership
+
+        def one_mem(kw):
+            act = roll(jax.random.fold_in(kw, 0), mf.rate_pm)
+            nv = jax.random.randint(jax.random.fold_in(kw, 1), (),
+                                    mf.victims_min, mf.victims_max + 1)
+            perm = jax.random.permutation(jax.random.fold_in(kw, 2), N)
+            mask = jnp.zeros((N,), bool).at[perm].set(
+                jnp.arange(N) < nv)
+            return mask & act
+        mem_out = jax.vmap(one_mem)(_fold_seq(k_mem, W)) \
+            & w_live[:, None]
+    else:
+        mem_out = jnp.zeros((W, N), bool)
+
     return FaultSchedule(untils=untils, crash=crash, edge_dst=e_dst,
                          edge_src=e_src, edge_block=e_blk,
-                         edge_delay=e_dly, edge_loss_pm=e_pm, skew=skew)
+                         edge_delay=e_dly, edge_loss_pm=e_pm,
+                         skew=skew, mem_out=mem_out)
 
 
 def schedule_planes(sched: FaultSchedule, fx: FaultConfig, cfg,
@@ -399,14 +457,25 @@ def schedule_planes(sched: FaultSchedule, fx: FaultConfig, cfg,
     N = cfg.n_nodes
     NT = cfg.n_total
     W = fz.windows_max
-    phase = jnp.searchsorted(sched.untils, t, side="right")
-    in_window = (phase % 2 == 1) & (phase < 2 * W) & (t < fx.stop_tick)
-    w = jnp.clip(phase // 2, 0, W - 1)
+
+    def window_at(tt):
+        ph = jnp.searchsorted(sched.untils, tt, side="right")
+        in_win = (ph % 2 == 1) & (ph < 2 * W) & (tt < fx.stop_tick)
+        return jnp.clip(ph // 2, 0, W - 1), in_win
+
+    w, in_window = window_at(t)
     out = {}
     if fz.has_crash:
         out["crash"] = sched.crash[w] & in_window
+    if fz.has_membership:
+        out["member"] = ~(sched.mem_out[w] & in_window)
+        # last tick's membership (join-edge / park-mask source); tick 0
+        # reads its own window timeline at -1 — the leading gap — so a
+        # zero-gap first window parks its victims from the very start
+        w_p, in_win_p = window_at(t - 1)
+        out["member_prev"] = ~(sched.mem_out[w_p] & in_win_p)
     link_blocks = fz.has_links and fz.links.block_pm > 0
-    if fz.has_crash or link_blocks:
+    if fz.has_crash or link_blocks or fz.has_membership:
         block = jnp.zeros((NT, NT), jnp.int32)
         if link_blocks:
             blk = sched.edge_block[w] * in_window.astype(jnp.int32)
@@ -417,6 +486,10 @@ def schedule_planes(sched: FaultSchedule, fx: FaultConfig, cfg,
             # a dead process hears nobody — servers AND clients
             crash_nt = jnp.zeros((NT,), bool).at[:N].set(out["crash"])
             block = block | crash_nt[:, None]
+        if fz.has_membership:
+            # a parked non-member hears nobody, exactly like a crash
+            out_nt = jnp.zeros((NT,), bool).at[:N].set(~out["member"])
+            block = block | out_nt[:, None]
         out["block"] = block
     if fz.has_links:
         act = in_window.astype(jnp.int32)
@@ -466,6 +539,10 @@ def schedule_to_plan(sched: FaultSchedule, fx: FaultConfig
     untils = np.asarray(sched.untils).reshape(-1)
     phases: List[Dict[str, Any]] = []
     prev = 0
+    pending_add: List[int] = []   # membership restores owed to the
+    #                               next emitted phase (the window
+    #                               ended; an unmatched trailing add is
+    #                               covered by the final-heal row)
     for w in range(W):
         gap_end = int(untils[2 * w])
         win_end = int(untils[2 * w + 1])
@@ -475,6 +552,9 @@ def schedule_to_plan(sched: FaultSchedule, fx: FaultConfig
         victims = np.nonzero(np.asarray(sched.crash[w]))[0]
         if victims.size:
             ph["crash"] = [int(v) for v in victims]
+        removed = np.nonzero(np.asarray(sched.mem_out[w]))[0]
+        if removed.size:
+            ph["remove"] = [int(v) for v in removed]
         edges = []
         for e in range(np.asarray(sched.edge_dst).shape[1]):
             blk = int(sched.edge_block[w][e])
@@ -493,12 +573,29 @@ def schedule_to_plan(sched: FaultSchedule, fx: FaultConfig
                 if int(r) != NEUTRAL_RATE}
         if skew:
             ph["skew"] = skew
+        # settle any owed membership rejoin FIRST: the previous
+        # removal window's victims rejoin at its end tick (== the
+        # start of whatever phase comes next), keeping the compiled
+        # planes value-identical to the drawn schedule's timeline
+        if pending_add:
+            if gap_end > prev:
+                phases.append({"until": gap_end,
+                               "add": pending_add})
+            else:
+                # zero-width gap: the rejoin rides the next window
+                # phase itself (membership_walk applies add, then
+                # remove)
+                ph["add"] = pending_add
+            pending_add = []
         if not ph:
             continue          # contentless window: pure healthy time
-        if gap_end > prev:
+        if gap_end > prev and (not phases
+                               or int(phases[-1]["until"]) < gap_end):
             phases.append({"until": gap_end})
         phases.append({"until": win_end, **ph})
         prev = win_end
+        if removed.size:
+            pending_add = [int(v) for v in removed]
     if not phases:
         return {}             # an all-healthy draw IS the empty plan
     return {"snapshot_every": int(fx.snapshot_every), "phases": phases}
@@ -512,20 +609,30 @@ def reconstruct_plan(fx: FaultConfig, n_nodes: int, seed: int,
         reconstruct_schedule(fx, n_nodes, seed, instance_id), fx)
 
 
-def plan_weight(plan: Dict[str, Any]) -> Tuple[int, int]:
+def plan_weight(plan: Dict[str, Any],
+                n_nodes: Optional[int] = None) -> Tuple[int, int]:
     """(fault phases, total victims) of a plan dict — the shrinker's
-    minimality metric and the acceptance bar's 'strictly fewer'."""
+    minimality metric and the acceptance bar's 'strictly fewer'.
+    Membership REMOVALS count as victims (an explicit absolute
+    ``members`` set counts once — but only when it actually removes a
+    node; a restore/no-op set is a HEAL, see
+    ``spec.membership_heal_phases``); rejoin ``add`` events are
+    healing, not faults, and weigh nothing."""
     if not plan:
         return 0, 0
+    heals = membership_heal_phases(plan, n_nodes)
     n_phases = 0
     victims = 0
-    for ph in plan.get("phases", ()):
+    for i, ph in enumerate(plan.get("phases", ())):
         c = len(ph.get("crash") or [])
         e = len(ph.get("links") or [])
         s = len(ph.get("skew") or {})
-        if c or e or s:
+        m = len(ph.get("remove") or []) \
+            + (1 if ph.get("members") is not None
+               and i not in heals else 0)
+        if c or e or s or m:
             n_phases += 1
-            victims += c + e + s
+            victims += c + e + s + m
     return n_phases, victims
 
 
@@ -558,9 +665,11 @@ def fleet_windows(fx: FaultConfig, n_nodes: int, seed: int,
         if np.asarray(sched.edge_dst).shape[-1] else \
         np.zeros(starts.shape, bool)
     skew = (np.asarray(sched.skew) != NEUTRAL_RATE).any(axis=-1)
+    membership = np.asarray(sched.mem_out).any(axis=-1)
     live = ends > starts
     return {"starts": starts, "ends": ends, "crash": crash & live,
-            "links": links & live, "skew": skew & live}
+            "links": links & live, "skew": skew & live,
+            "membership": membership & live}
 
 
 def span_counters(win: Dict[str, np.ndarray], t0: int,
@@ -571,9 +680,10 @@ def span_counters(win: Dict[str, np.ndarray], t0: int,
     t1 = int(t0) + max(1, int(ticks))
     ov = (win["starts"] < t1) & (win["ends"] > int(t0))
     out = {"schedules-active": int(
-        (ov & (win["crash"] | win["links"] | win["skew"]))
+        (ov & (win["crash"] | win["links"] | win["skew"]
+               | win["membership"]))
         .any(axis=1).sum())}
-    for lane in ("crash", "links", "skew"):
+    for lane in ("crash", "links", "skew", "membership"):
         out[lane] = int((ov & win[lane]).any(axis=1).sum())
     return out
 
@@ -585,13 +695,15 @@ def fleet_coverage(win: Dict[str, np.ndarray]) -> Dict[str, int]:
     sig = np.concatenate(
         [win["starts"], win["ends"],
          win["crash"].astype(np.int32), win["links"].astype(np.int32),
-         win["skew"].astype(np.int32)], axis=1)
+         win["skew"].astype(np.int32),
+         win["membership"].astype(np.int32)], axis=1)
     return {
         "instances": int(sig.shape[0]),
         "distinct-schedules": int(np.unique(sig, axis=0).shape[0]),
         "crash-windows": int(win["crash"].sum()),
         "link-windows": int(win["links"].sum()),
         "skew-windows": int(win["skew"].sum()),
+        "membership-windows": int(win["membership"].sum()),
     }
 
 
@@ -601,7 +713,9 @@ def fuzz_summary(fx: FaultConfig) -> Dict[str, Any]:
     fz = fx.fuzz
     lanes = [name for name, on in (("crash-restart", fz.has_crash),
                                    ("link-degradation", fz.has_links),
-                                   ("clock-skew", fz.has_skew)) if on]
+                                   ("clock-skew", fz.has_skew),
+                                   ("membership", fz.has_membership))
+             if on]
     return {"lanes": lanes,
             "windows": [fz.windows_min, fz.windows_max],
             "gap": [fz.gap_min, fz.gap_max],

@@ -9,7 +9,10 @@ shrink <run-dir>`` closes that loop per flagged instance:
 1. **Reconstruct** the instance's concrete schedule from its seed
    (``fuzz.reconstruct_plan`` — schedules are bit-stable pure functions
    of ``(seed, instance_id)``) as a deterministic ``--fault-plan``
-   dict.
+   dict. A deterministic ``--fault-plan`` run needs no reconstruction:
+   its plan IS the starting point, and the same minimizer applies
+   (hand-built scenarios — the membership reconfiguration plans
+   especially — are usually over-specified).
 2. **Verify** the reconstruction: replay the single instance through
    the pipelined executor (``tpu/pipeline.run_sim_pipelined`` with
    ``instance_ids=[id]`` — the instance-stable RNG makes node/client/
@@ -17,9 +20,14 @@ shrink <run-dir>`` closes that loop per flagged instance:
    require the on-device invariants to trip again. A non-failing
    reconstruction is reported loudly — it would mean the seed -> plan
    path is not bit-exact.
-3. **Delta-debug** the plan to a local minimum: greedy passes that drop
-   whole fault phases, drop individual victims (crash nodes, link
-   edges, skewed nodes), and halve phase durations — keeping any
+3. **Delta-debug** the plan to a local minimum: first ddmin-style
+   COMPLEMENT-HALVING rounds over the fault phases (drop half — then
+   quarters, eighths, ... — of the fault-carrying phases in ONE
+   replay; a kept drop removes many phases for one verification,
+   which is where multi-phase schedules beat the old greedy-only
+   pass), then the greedy passes that drop whole fault phases, drop
+   individual victims (crash nodes, link edges, skewed nodes,
+   membership removals), and halve phase durations — keeping any
    reduction whose replay STILL fails — repeated to fixpoint under an
    attempt budget.
 4. **Write** ``triage/instance-<id>/shrunk-plan.json`` (a pure plan
@@ -42,6 +50,7 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from . import fuzz as _fuzz
+from .spec import membership_heal_phases
 
 SHRINK_FILE = "shrink.json"
 SHRUNK_PLAN_FILE = "shrunk-plan.json"
@@ -53,8 +62,24 @@ class ShrinkError(ValueError):
 
 
 def _phase_content(ph: Dict[str, Any]) -> int:
+    """State-changing keys of a phase — what _normalize must never
+    merge away. Membership 'add' (rejoin) events and heal 'members'
+    restores count here (they change the timeline) but NOT as fault
+    content (they heal, the shrinker never targets them)."""
+    return (_fault_content(ph) + len(ph.get("add") or []))
+
+
+def _fault_content(ph: Dict[str, Any], members_fault: bool = True) -> int:
+    """Shrink-targetable content of a phase. A ``members`` key is fault
+    content only when it actually REMOVES a node — callers pass
+    ``members_fault=False`` for the heal/restore phases identified by
+    :func:`spec.membership_heal_phases` (dropping a restore would
+    EXTEND the outage via inheritance, the opposite of shrinking)."""
     return (len(ph.get("crash") or []) + len(ph.get("links") or [])
-            + len(ph.get("skew") or {}))
+            + len(ph.get("skew") or {})
+            + len(ph.get("remove") or [])
+            + (1 if members_fault
+               and ph.get("members") is not None else 0))
 
 
 def _normalize(plan: Dict[str, Any]) -> Dict[str, Any]:
@@ -77,16 +102,33 @@ def _normalize(plan: Dict[str, Any]) -> Dict[str, Any]:
             "phases": out}
 
 
-def _candidates(plan: Dict[str, Any]):
+def _strip_faults(ph: Dict[str, Any],
+                  keep_members: bool = False) -> Dict[str, Any]:
+    """A phase with its fault content removed: the timeline boundary
+    stays, and so does any membership 'add' (rejoin) event or — with
+    ``keep_members`` — a heal/restore ``members`` set; dropping a heal
+    would ENLARGE the fault, not shrink it."""
+    kept = {"until": ph["until"]}
+    if ph.get("add"):
+        kept["add"] = ph["add"]
+    if keep_members and ph.get("members") is not None:
+        kept["members"] = ph["members"]
+    return kept
+
+
+def _candidates(plan: Dict[str, Any], n_nodes=None):
     """Yield reduced candidate plans, most aggressive first: whole
     fault phases dropped, then single victims, then halved durations.
     Each candidate is an independent copy of ``plan``."""
     phases = plan.get("phases", ())
+    # recomputed on every (normalized) reduction — phase indices shift
+    heals = membership_heal_phases(plan, n_nodes)
     fault_idx = [i for i, ph in enumerate(phases)
-                 if _phase_content(ph) > 0]
+                 if _fault_content(ph, members_fault=i not in heals) > 0]
     for i in fault_idx:
         cand = copy.deepcopy(plan)
-        cand["phases"][i] = {"until": phases[i]["until"]}
+        cand["phases"][i] = _strip_faults(phases[i],
+                                          keep_members=i in heals)
         yield f"drop-phase-{i}", cand
     for i in fault_idx:
         ph = phases[i]
@@ -97,6 +139,20 @@ def _candidates(plan: Dict[str, Any]):
             if not cand["phases"][i]["crash"]:
                 del cand["phases"][i]["crash"]
             yield f"phase-{i}-drop-crash-{v}", cand
+        for v in ph.get("remove") or []:
+            # keep a node in the cluster (its later rejoin 'add'
+            # becomes a harmless no-op — membership_walk adds are
+            # idempotent)
+            cand = copy.deepcopy(plan)
+            cand["phases"][i]["remove"] = [
+                x for x in ph["remove"] if x != v]
+            if not cand["phases"][i]["remove"]:
+                del cand["phases"][i]["remove"]
+            yield f"phase-{i}-drop-remove-{v}", cand
+        if ph.get("members") is not None and i not in heals:
+            cand = copy.deepcopy(plan)
+            del cand["phases"][i]["members"]
+            yield f"phase-{i}-drop-members", cand
         for j in range(len(ph.get("links") or [])):
             cand = copy.deepcopy(plan)
             del cand["phases"][i]["links"][j]
@@ -148,19 +204,79 @@ def make_replayer(model, opts: Dict[str, Any], instance_id: int,
     return replay
 
 
+def _drop_phase_set(plan: Dict[str, Any], idxs,
+                    heals=frozenset()) -> Dict[str, Any]:
+    cand = copy.deepcopy(plan)
+    for i in idxs:
+        cand["phases"][i] = _strip_faults(cand["phases"][i],
+                                          keep_members=i in heals)
+    return cand
+
+
+def _ddmin_phases(plan: Dict[str, Any], replay, attempts: int,
+                  max_attempts: int, kept: List[str], n_nodes=None):
+    """ddmin-style complement reduction over the FAULT PHASES: drop
+    whole subsets (halves, then quarters, ...) of the fault-carrying
+    phases in one verified replay each. One kept drop eliminates
+    ``len(phases)/k`` phases for ONE replay — on multi-phase schedules
+    this converges in O(log) replays where the greedy single-phase
+    pass pays one replay per phase. Every kept reduction is
+    replay-verified, exactly like the greedy pass. Returns
+    ``(plan, attempts)``."""
+    current = plan
+    k = 2
+    while attempts < max_attempts:
+        heals = membership_heal_phases(current, n_nodes)
+        fault_idx = [i for i, ph in enumerate(current.get("phases", ()))
+                     if _fault_content(ph, members_fault=i not in heals)
+                     > 0]
+        if len(fault_idx) < 2:
+            break
+        k = min(k, len(fault_idx))
+        chunk = -(-len(fault_idx) // k)
+        subsets = [fault_idx[j:j + chunk]
+                   for j in range(0, len(fault_idx), chunk)]
+        reduced = False
+        for sub in subsets:
+            if attempts >= max_attempts:
+                break
+            cand = _normalize(_drop_phase_set(current, sub, heals))
+            attempts += 1
+            if replay(cand if cand else None):
+                current = cand
+                kept.append("ddmin-drop-phases-" +
+                            ",".join(str(i) for i in sub))
+                k = max(2, k - 1)
+                reduced = True
+                break
+        if not reduced:
+            if k >= len(fault_idx):
+                break          # singleton granularity: greedy takes over
+            k = min(len(fault_idx), 2 * k)
+    return current, attempts
+
+
 def shrink_plan(plan: Dict[str, Any], replay,
-                max_attempts: int = 24) -> Dict[str, Any]:
-    """Greedy delta-debugging to a local minimum: try each candidate
-    reduction, keep any that still fails, restart the pass on the
-    reduced plan; stop at fixpoint or when ``max_attempts`` replays
-    are spent. Returns ``{plan, attempts, kept}``."""
+                max_attempts: int = 24,
+                ddmin: bool = True, n_nodes=None) -> Dict[str, Any]:
+    """Delta-debug to a local minimum: ddmin complement-halving rounds
+    over the fault phases first (``ddmin=False`` skips them — the
+    pre-ddmin greedy-only behavior, kept for A/B), then the greedy
+    candidate pass — try each reduction, keep any that still fails,
+    restart on the reduced plan; stop at fixpoint or when
+    ``max_attempts`` replays are spent. Returns
+    ``{plan, attempts, kept}``."""
     current = _normalize(plan)
     attempts = 0
     kept: List[str] = []
+    if ddmin:
+        current, attempts = _ddmin_phases(current, replay, attempts,
+                                          max_attempts, kept,
+                                          n_nodes=n_nodes)
     progress = True
     while progress and attempts < max_attempts:
         progress = False
-        for label, cand in _candidates(current):
+        for label, cand in _candidates(current, n_nodes=n_nodes):
             if attempts >= max_attempts:
                 break
             cand = _normalize(cand)
@@ -178,20 +294,26 @@ def shrink_instance(model, opts: Dict[str, Any], instance_id: int,
                     params=None,
                     max_attempts: int = 24) -> Dict[str, Any]:
     """The full loop for one flagged instance: reconstruct -> verify ->
-    delta-debug -> verify the minimum. Raises :class:`ShrinkError`
-    when the run is not a fuzz run or the reconstructed plan does not
-    reproduce the failure."""
+    delta-debug -> verify the minimum. Fuzz runs reconstruct the
+    instance's drawn schedule from the seed; deterministic
+    ``--fault-plan`` runs delta-debug the PLAN ITSELF (a hand-built
+    reconfiguration scenario is usually over-specified — extra link
+    edges, over-long phases — and the minimizer applies verbatim).
+    Raises :class:`ShrinkError` when the run carries no fault source
+    or the starting plan does not reproduce the failure."""
     from ..tpu.harness import make_sim_config
 
-    if not opts.get("fault_fuzz"):
+    if not opts.get("fault_fuzz") and not opts.get("fault_plan"):
         raise ShrinkError(
-            "not a fault-fuzz run (no fault_fuzz in the repro opts) — "
-            "deterministic-plan hits are already minimal-by-"
-            "construction inputs for hand-editing")
+            "not a fault run (neither fault_fuzz nor fault_plan in "
+            "the repro opts) — nothing to shrink")
     sim = make_sim_config(model, dict(opts))
     seed = int(opts.get("seed") or 0)
-    plan0 = _fuzz.reconstruct_plan(sim.faults, sim.net.n_nodes, seed,
-                                   instance_id)
+    if opts.get("fault_fuzz"):
+        plan0 = _fuzz.reconstruct_plan(sim.faults, sim.net.n_nodes,
+                                       seed, instance_id)
+    else:
+        plan0 = dict(opts["fault_plan"])
     replay = make_replayer(model, opts, instance_id, params=params)
     if not plan0:
         raise ShrinkError(
@@ -200,19 +322,22 @@ def shrink_instance(model, opts: Dict[str, Any], instance_id: int,
             f"the failure is fault-independent (triage it instead)")
     if not replay(plan0):
         raise ShrinkError(
-            f"instance {instance_id}: the reconstructed deterministic "
-            f"plan does NOT reproduce the violation — the seed -> "
-            f"schedule replay was not bit-exact (this is a bug, "
-            f"report it)")
-    p0, v0 = _fuzz.plan_weight(plan0)
-    res = shrink_plan(plan0, replay, max_attempts=max_attempts)
+            f"instance {instance_id}: the starting deterministic plan "
+            f"does NOT reproduce the violation — for a fuzz run this "
+            f"means the seed -> schedule replay was not bit-exact "
+            f"(a bug, report it); for a plan run the flagged instance "
+            f"is noise-dependent beyond the plan")
+    n_nodes = int(sim.net.n_nodes)
+    p0, v0 = _fuzz.plan_weight(plan0, n_nodes)
+    res = shrink_plan(plan0, replay, max_attempts=max_attempts,
+                      n_nodes=n_nodes)
     shrunk = res["plan"]
     # the reduced plan gets one final CONFIRMING replay (an unreduced
     # plan is plan0, whose replay above already failed) — keeping the
     # gate's `verified` assertion load-bearing rather than a constant
     verified = (True if not res["kept"]
                 else replay(shrunk if shrunk else None))
-    p1, v1 = _fuzz.plan_weight(shrunk)
+    p1, v1 = _fuzz.plan_weight(shrunk, n_nodes)
     return {
         "instance": int(instance_id),
         "seed": seed,
@@ -243,11 +368,12 @@ def shrink_run(run_dir: str, ids: Optional[List[int]] = None,
         raise ShrinkError(str(e))
     opts = dict(info["opts"])
     opts["seed"] = info["seed"]
-    if not opts.get("fault_fuzz"):
+    if not opts.get("fault_fuzz") and not opts.get("fault_plan"):
         raise ShrinkError(
-            f"{info['run-dir']} is not a fault-fuzz run (its heartbeat "
-            f"repro opts carry no fault_fuzz distribution); shrink "
-            f"operates on randomized-schedule hits")
+            f"{info['run-dir']} is not a fault run (its heartbeat "
+            f"repro opts carry neither a fault_fuzz distribution nor "
+            f"a fault_plan); shrink minimizes randomized-schedule "
+            f"hits and over-specified deterministic plans")
     targets = [int(i) for i in (ids if ids else info["flagged"])]
     dropped = max(0, len(targets) - int(max_instances))
     targets = targets[:int(max_instances)]

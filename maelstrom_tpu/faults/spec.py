@@ -8,12 +8,13 @@ phase timeline and, per phase, which fault lanes are active:
 
     {"snapshot_every": 1,
      "phases": [
-       {"until": 300},
+       {"until": 300, "members": [0, 1, 2]},
        {"until": 360, "crash": [0, 1]},
        {"until": 600, "links": [
           {"dst": 1, "src": 0, "block": true},
           {"dst": 0, "src": 1, "delay": 25},
           {"dst": 0, "src": 2, "loss": 0.25}]},
+       {"until": 700, "add": [3, 4]},
        {"until": 900, "skew": {"0": 2.0, "2": 0.75}}
      ]}
 
@@ -28,6 +29,21 @@ phase timeline and, per phase, which fault lanes are active:
   per-mille). One edge may combine delay and loss.
 - ``skew`` — ``{node: rate}`` clock-rate multipliers (0.125..8.0,
   quantized to 64ths; 1.0 is exactly neutral).
+- **membership** — ``members`` (the absolute server member set from
+  this phase on), or ``add``/``remove`` (events relative to the
+  previous phase's set). Unlike the other lanes, membership INHERITS:
+  a phase without a membership key keeps the previous set, the cluster
+  starts at all ``n_nodes`` unless phase 0 says otherwise, and the
+  trailing heal (past the last phase or ``stop_tick``) restores
+  everyone. Non-members are parked like crashed nodes (recv dropped,
+  sends suppressed, state held at the snapshot slab's leave-point
+  row); a node whose membership turns ON re-boots through
+  ``Model.join_row`` (slab recovery + re-provisioned cluster config —
+  the Netherite rejoin idiom), and the current member bitmask threads
+  into the node step so reconfiguration-aware protocols (Raft joint
+  consensus, ``models/raft_core.py``) can run the change through their
+  log. A plan may never empty the cluster or name a node past
+  ``n_nodes`` — both are refused at compile time BY PHASE.
 
 ``generate_fault_plan`` builds the same dict shape from the CLI's
 composable ``--nemesis`` kinds (``crash-restart``, ``link-degrade``,
@@ -53,11 +69,13 @@ from typing import Any, Dict, List, Optional, Sequence
 from .engine import FaultConfig, NEUTRAL_RATE
 
 # the composable --nemesis vocabulary beyond "partition"
-FAULT_KINDS = ("crash-restart", "link-degrade", "clock-skew")
+FAULT_KINDS = ("crash-restart", "link-degrade", "clock-skew",
+               "membership")
 
 MAX_DELAY_TICKS = 1 << 14      # keeps deadlines far inside the 2^20
                                # delivery-priority horizon
 MIN_RATE, MAX_RATE = 0.125, 8.0
+MAX_MEMBER_NODES = 30          # membership bitmasks ride int32 lanes
 
 
 class SpecError(ValueError):
@@ -85,6 +103,80 @@ def _node_id(v, n_nodes: int, what: str) -> int:
     if not 0 <= i < n_nodes:
         raise _err(f"{what} {i} out of range [0, {n_nodes})")
     return i
+
+
+def membership_walk(phases, n_nodes: int):
+    """Resolve the membership lane to one ABSOLUTE member set per phase
+    (inheritance applied), or ``None`` when no phase carries a
+    membership key. Raises :class:`SpecError` — naming the offending
+    phase — on a set that would empty the cluster, a node id past the
+    ``n_nodes`` capacity, or a cluster too wide for the int32 member
+    bitmask."""
+    keys = ("members", "add", "remove")
+    if not any(_get(ph, k) is not None for ph in phases for k in keys):
+        return None
+    if n_nodes > MAX_MEMBER_NODES:
+        raise _err(f"membership lane supports at most "
+                   f"{MAX_MEMBER_NODES} server nodes (int32 member "
+                   f"bitmask), got n_nodes={n_nodes}")
+    current = set(range(n_nodes))
+    out = []
+    for i, ph in enumerate(phases):
+        members = _get(ph, "members")
+        add = _get(ph, "add")
+        remove = _get(ph, "remove")
+        if members is not None and (add is not None
+                                    or remove is not None):
+            raise _err(f"phase {i} mixes 'members' with 'add'/'remove'"
+                       f" — one absolute set or relative events, not "
+                       f"both")
+        if members is not None:
+            current = {_node_id(v, n_nodes, f"phase {i} member")
+                       for v in members}
+        else:
+            current = set(current)
+            for v in (add or []):
+                current.add(_node_id(v, n_nodes, f"phase {i} added "
+                                                 f"node"))
+            for v in (remove or []):
+                current.discard(
+                    _node_id(v, n_nodes, f"phase {i} removed node"))
+        if not current:
+            raise _err(f"phase {i} membership would EMPTY the cluster "
+                       f"(every phase needs >= 1 member)")
+        out.append(tuple(sorted(current)))
+    return out
+
+
+def membership_heal_phases(plan: Dict[str, Any],
+                           n_nodes: Optional[int] = None) -> set:
+    """Indices of phases whose ``members`` key removes NO node relative
+    to the previous phase's resolved set — restores and no-ops. The
+    shrinker and the minimality metric (``fuzz.plan_weight``) treat
+    these as HEALS, exactly like rejoin ``add`` events: dropping one
+    would EXTEND the membership outage (inheritance keeps the reduced
+    set), which is the opposite of shrinking. When ``n_nodes`` is
+    unknown the universe is inferred as the widest node id the plan
+    itself names — a ``members`` set that silently excludes un-named
+    trailing nodes then classifies as heal, which errs CONSERVATIVE
+    (it is merely never offered as a drop candidate)."""
+    phases = list((plan or {}).get("phases") or ())
+    keys = ("members", "add", "remove")
+    if not any(_get(ph, k) is not None for ph in phases for k in keys):
+        return set()
+    if n_nodes is None:
+        named = [int(v) for ph in phases for k in keys
+                 for v in (_get(ph, k) or [])]
+        n_nodes = (max(named) + 1) if named else 1
+    walk = membership_walk(phases, n_nodes)
+    heals = set()
+    prev = set(range(n_nodes))
+    for i, cur in enumerate(walk):
+        cur = set(cur)
+        if _get(phases[i], "members") is not None and prev <= cur:
+            heals.add(i)
+        prev = cur
+    return heals
 
 
 def validate_fault_plan(plan: Dict[str, Any], n_nodes: int) -> None:
@@ -131,6 +223,9 @@ def validate_fault_plan(plan: Dict[str, Any], n_nodes: int) -> None:
             if not MIN_RATE <= r <= MAX_RATE:
                 raise _err(f"phase {i} skew rate {r} out of "
                            f"[{MIN_RATE}, {MAX_RATE}]")
+    # membership: the walk itself validates (empty cluster / capacity
+    # errors name the offending phase)
+    membership_walk(phases, n_nodes)
 
 
 def compile_fault_plan(plan: Optional[Dict[str, Any]], n_nodes: int,
@@ -151,6 +246,7 @@ def compile_fault_plan(plan: Optional[Dict[str, Any]], n_nodes: int,
     crash: List[tuple] = []
     links: List[tuple] = []
     skew: List[tuple] = []
+    members = membership_walk(_get(plan, "phases"), n_nodes)
     for ph in _get(plan, "phases"):
         untils.append(int(_get(ph, "until")))
         crash.append(tuple(sorted(
@@ -167,7 +263,10 @@ def compile_fault_plan(plan: Optional[Dict[str, Any]], n_nodes: int,
     return FaultConfig(enabled=True, stop_tick=int(stop_tick),
                        snapshot_every=every, untils=tuple(untils),
                        crash=tuple(crash), links=tuple(links),
-                       skew=tuple(skew))
+                       skew=tuple(skew),
+                       members=(None if members is None
+                                else tuple(members)),
+                       n_nodes=int(n_nodes))
 
 
 # --- the composable --nemesis generators -----------------------------------
@@ -190,6 +289,12 @@ def generate_fault_plan(kinds: Sequence[str], n_nodes: int,
       (``2 * interval // 5`` extra ticks), one lossy (25%).
     - ``clock-skew`` — one whole-run phase spreading node clock rates
       over 0.75x..1.75x (node ``i`` gets ``(48 + 16 * (i % 5)) / 64``).
+    - ``membership`` — every second phase REMOVES one rotating node
+      (always a minority, so a reconfiguration-aware model must stay
+      correct AND live), and the following heal phase explicitly adds
+      it back — a rolling remove/rejoin churn that drives the Raft
+      joint-consensus machinery through a full ``C_old,new`` ->
+      ``C_new`` round per window.
     """
     kinds = [k for k in kinds if k in FAULT_KINDS]
     if not kinds:
@@ -202,8 +307,7 @@ def generate_fault_plan(kinds: Sequence[str], n_nodes: int,
     # 10s interval vs a 2-3s smoke run is exactly that trap.
     interval = max(1, min(int(interval), horizon // 4 or 1))
     phases: List[Dict[str, Any]] = []
-    if "clock-skew" in kinds and "crash-restart" not in kinds \
-            and "link-degrade" not in kinds:
+    if kinds == ["clock-skew"]:
         # skew alone needs no interval grid: one whole-run phase
         phases.append({"until": max(1, horizon),
                        "skew": _skew_spread(n_nodes)})
@@ -216,6 +320,15 @@ def generate_fault_plan(kinds: Sequence[str], n_nodes: int,
         #                              the partition nemesis's cadence
         if active and "crash-restart" in kinds and n_nodes > 1:
             ph["crash"] = [(p // 2) % n_nodes]
+        if "membership" in kinds and n_nodes > 1:
+            if active:
+                victim = (p // 2) % n_nodes
+                ph["members"] = [i for i in range(n_nodes)
+                                 if i != victim]
+            else:
+                # explicit restore: membership INHERITS, so a heal
+                # phase must say "everyone" to end the removal window
+                ph["members"] = list(range(n_nodes))
         if active and "link-degrade" in kinds and n_nodes > 1:
             a = (p // 2) % n_nodes
             b = (a + 1) % n_nodes

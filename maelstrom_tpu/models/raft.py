@@ -38,7 +38,8 @@ from . import raft_core
 from .raft_core import (ENTRY_LANES, F_CAS, F_READ, F_WRITE, NIL,  # noqa: F401
                         T_APPEND, T_APPEND_REPLY, T_CAS, T_CAS_OK,
                         T_READ, T_READ_OK, T_REQ_VOTE, T_VOTE_REPLY,
-                        T_WRITE, T_WRITE_OK, iclip, sel)
+                        T_WRITE, T_WRITE_OK, full_member_mask, iclip,
+                        sel)
 
 
 class RaftRow(NamedTuple):
@@ -64,6 +65,21 @@ class RaftRow(NamedTuple):
                                       # commit index (impossible in
                                       # correct Raft; the local signature
                                       # of the §5.4.2 commit bug)
+    cfg_boot: jnp.ndarray        # provisioning member bitmask: the
+                                 # cluster config a node with NO config
+                                 # entry in its log uses (the initial
+                                 # membership at init; re-stamped by
+                                 # join_row when a blank node is
+                                 # provisioned mid-run). Full bitmask
+                                 # on membership-free runs.
+    caught_up: jnp.ndarray       # 0 while a joining node lacks the
+                                 # committed prefix (it neither votes
+                                 # nor stands, Raft §6's non-voting
+                                 # learner phase); set sticky by the
+                                 # first AppendEntries accept whose
+                                 # leader-commit fits the local log.
+                                 # 1 from init everywhere membership
+                                 # never changes.
 
 
 class RaftModel(Model):
@@ -94,6 +110,16 @@ class RaftModel(Model):
                                    # unreplicated entries on failover
     apply_uncommitted = False      # True: apply+reply at append, not
                                    # commit (dirty apply — txn mutant)
+    joint_dual_quorum = True       # False: elections/commits during a
+                                   # joint (C_old,new) phase consult
+                                   # ONLY the new config — the single-
+                                   # quorum reconfiguration bug
+    join_requires_catchup = True   # False: a joining node votes and
+                                   # stands for election before it
+                                   # holds the committed prefix (an
+                                   # empty-log joiner elects stale
+                                   # leaders — the votes-before-
+                                   # catchup reconfiguration bug)
 
     def __init__(self, n_nodes_hint: int = 5, log_cap: int = 96,
                  n_keys: int = 8, n_vals: int = 8,
@@ -143,6 +169,8 @@ class RaftModel(Model):
             last_hb=jnp.int32(0),
             leader_hint=jnp.int32(-1),
             truncated_committed=jnp.int32(0),
+            cfg_boot=jnp.int32(full_member_mask(n_nodes)),
+            caught_up=jnp.int32(1),
         )
 
     # --- replicated-state-machine hooks (overridden by txn models) -------
@@ -188,8 +216,10 @@ class RaftModel(Model):
         return raft_core.inbox_step(self, row, node_idx, msg, rng, t,
                                     cfg)
 
-    def fused_tick(self, row, node_idx, t, rng, cfg, params):
-        return raft_core.fused_tick(self, row, node_idx, t, rng, cfg)
+    def fused_tick(self, row, node_idx, t, rng, cfg, params,
+                   m_bits=None):
+        return raft_core.fused_tick(self, row, node_idx, t, rng, cfg,
+                                    m_bits=m_bits)
 
     def apply_entry(self, row, do, entry, cfg):
         """Apply ONE committed log entry to the KV state machine and
@@ -242,7 +272,13 @@ class RaftModel(Model):
 
     DURABLE_LANES = ("term", "voted_for", "log_term", "log_body",
                      "log_len", "kv", "commit_idx", "last_applied",
-                     "truncated_committed")
+                     "truncated_committed", "cfg_boot", "caught_up")
+    # caught_up is durable so the crash and membership lanes COMPOSE:
+    # a joining learner that crashes before its first fitting
+    # AppendEntries accept must restart with caught_up=0 — init_row's
+    # fresh row says 1, and restoring everything BUT the gate would
+    # let a blank joiner vote after any crash window, which is the
+    # VotesBeforeCatchup anomaly in the correct model.
 
     recovers_snapshot = True   # False: restart ignores durable storage
                                # (the forget-snapshot planted bug)
@@ -263,6 +299,35 @@ class RaftModel(Model):
         if not self.recovers_snapshot:
             return fresh     # BUG: cold boot — total state loss
         return fresh._replace(**{k: snap[k] for k in self.DURABLE_LANES})
+
+    # --- membership fault lane (maelstrom_tpu/faults/ membership) --------
+    #
+    # A node whose administrative membership turns ON re-boots through
+    # join_row: the crash-restart recovery path (durable slab state +
+    # re-based timers) plus the two join-specific moves — the CURRENT
+    # target bitmask becomes its provisioning config (a blank machine
+    # is told the member list by the operator; a rejoiner's log-derived
+    # config wins over it, see raft_core.config_view), and a joiner
+    # with an EMPTY log starts as a non-voting learner (caught_up = 0)
+    # until the first AppendEntries proves it holds the committed
+    # prefix. The VotesBeforeCatchup mutant skips that gate.
+
+    def boot_config(self, node_state, m_bits):
+        """Stamp the initial (phase-0) membership as the provisioning
+        config — pure leaf restructuring, applied to BATCHED rows at
+        init in both carry layouts."""
+        return node_state._replace(cfg_boot=jnp.broadcast_to(
+            jnp.asarray(m_bits, jnp.int32),
+            node_state.cfg_boot.shape))
+
+    def join_row(self, n_nodes, node_idx, key, params, snap, t,
+                 m_bits):
+        row = self.restart_row(n_nodes, node_idx, key, params, snap, t)
+        z0 = row.term * 0
+        caught = sel(row.log_len > z0, z0 + 1, z0)
+        if not self.join_requires_catchup:
+            caught = z0 + 1   # BUG: a blank joiner votes immediately
+        return row._replace(cfg_boot=m_bits + z0, caught_up=caught)
 
     # --- on-device invariants --------------------------------------------
 

@@ -37,6 +37,16 @@ compiles to its own specialized graph with the bug baked in.
   jitter): nodes time out in lockstep and livelock with no leader —
   the clock-skew lane's liveness anomaly, flagged by the availability
   checker.
+- :class:`RaftSingleQuorumReconfig` — joint-consensus elections and
+  commits consult only the NEW configuration: a joint-phase leader
+  commits with the new minority while the old majority never heard of
+  the change — under a remove-majority-then-partition plan the two
+  halves commit divergent histories (committed-prefix invariant +
+  linearizability trip). The membership lane's first planted bug.
+- :class:`RaftVotesBeforeCatchup` — a joining node votes (and stands)
+  with an empty log instead of waiting for catch-up: when a majority
+  of blank joiners arrives, they elect a stale/empty leader over the
+  committed history. The membership lane's second planted bug.
 """
 
 from __future__ import annotations
@@ -119,6 +129,38 @@ class RaftEagerCommit(RaftModel):
     commit_quorum = False
 
 
+class RaftSingleQuorumReconfig(RaftModel):
+    """Joint consensus broken (the membership lane's planted bug #1):
+    during a C_old,new phase, elections and commits count ONLY the new
+    configuration's quorum — the old majority loses its veto. Under a
+    remove-majority-then-partition plan the joint-phase leader commits
+    the config change (and client writes) with the tiny new quorum
+    while the removed-then-restored old majority, which never saw the
+    change, elects its own leader and commits a different history at
+    the same indices: the on-device committed-prefix invariant trips,
+    the post-heal truncation sets the sticky witness, and WGL flags
+    the lost writes. Correct joint-consensus Raft under the SAME plan
+    simply stalls the change until both quorums are reachable —
+    unavailable for a window, never unsafe."""
+    name = "lin-kv-bug-single-quorum-reconfig"
+    joint_dual_quorum = False
+
+
+class RaftVotesBeforeCatchup(RaftModel):
+    """Join catch-up broken (the membership lane's planted bug #2): a
+    joining node grants votes and stands for election with an EMPTY
+    log instead of staying a non-voting learner until it holds the
+    committed prefix. Add a majority of blank joiners behind a
+    partition and they elect one of themselves — an empty-log leader
+    that commits fresh entries over indices the old members hold
+    committed (committed-prefix + WGL trip). Correct Raft's learners
+    stay mute until an AppendEntries accept proves catch-up, then the
+    joint-consensus happy path completes the same reconfiguration
+    safely."""
+    name = "lin-kv-bug-votes-before-catchup"
+    join_requires_catchup = False
+
+
 BUGGY_MODELS = {
     "double-vote": RaftDoubleVote,
     "stale-read": RaftStaleRead,
@@ -127,6 +169,8 @@ BUGGY_MODELS = {
     "eager-commit": RaftEagerCommit,
     "forget-snapshot": RaftForgetsSnapshot,
     "fixed-timeout": RaftFixedTimeout,
+    "single-quorum-reconfig": RaftSingleQuorumReconfig,
+    "votes-before-catchup": RaftVotesBeforeCatchup,
 }
 
 

@@ -81,6 +81,15 @@ F_CAS = 3
 
 NIL = -1     # missing KV value
 
+# Joint-consensus configuration entries (Raft §6, the membership fault
+# lane): lane 0 carries this NEGATIVE marker — a client entry's lane 0
+# is always positive (lin-kv stamps the wire type >= 1, the txn models
+# stamp the txn length >= 1), so the marker can never collide — and
+# lanes 1/2 carry the (old, new) member bitmasks. A C_old,new entry has
+# old != new (the JOINT phase: elections and commits need a majority of
+# BOTH); a C_new entry has old == new (the change is complete).
+F_CONFIG = -7
+
 # base log entry body lanes: (f, key, a, b, client, client_msg_id);
 # subclasses widen via the ``entry_lanes`` class attribute
 ENTRY_LANES = 6
@@ -144,20 +153,76 @@ def node_rng(model, mkeys):
 # --- the minimal sequential core -------------------------------------------
 
 
-def _popcount(votes, n_nodes: int, z1):
-    """``popcount(votes) + 1`` — the vote count incl. self. For the
-    usual small clusters a 2^n-entry lookup table (one gather) beats
-    the n-lane shift/mask/reduce. Valid because ``votes`` only ever
-    accumulates bits ``1 << src`` of granted vote replies, and vote
-    replies are emitted exclusively by server nodes (src < n) — so
-    ``votes < 2^n`` is an invariant and the table is total. Falls back
-    to the shift/reduce form for wide clusters."""
+def popcount(x, n_nodes: int, z1):
+    """``popcount(x)`` for an ``n_nodes``-bit bitmask in ``[0, 2^n)``.
+    For the usual small clusters a 2^n-entry lookup table (one gather)
+    beats the n-lane shift/mask/reduce; the table is total because the
+    only bitmasks in the protocol — vote accumulators (bits ``1 <<
+    src`` of server-emitted replies, src < n) and member configs
+    (subsets of ``[0, n)``) — stay below ``2^n``. Falls back to the
+    shift/reduce form for wide clusters."""
     if n_nodes <= 8:
         table = jnp.asarray(
             [bin(v).count("1") for v in range(1 << n_nodes)],
             dtype=jnp.int32)
-        return tget(table, votes) + z1
-    return jnp.sum((votes[None] >> jnp.arange(n_nodes)) & z1) + z1
+        return tget(table, x)
+    return jnp.sum((x[None] >> jnp.arange(n_nodes)) & z1)
+
+
+def full_member_mask(n_nodes: int) -> int:
+    """The all-members int32 bitmask WITHOUT Python-int overflow:
+    clusters wider than the 31 int32 value bits collapse to ``-1``
+    (every bit set), which the arithmetic-shift membership tests
+    (``(mask >> idx) & 1`` in popcount's wide fallback and
+    ``quorum_match``) read as 'member' for EVERY node index — exactly
+    the legacy full-cluster math. The membership lane itself is capped
+    at ``spec.MAX_MEMBER_NODES`` (30) long before this; the sentinel
+    only keeps membership-FREE wide-cluster runs tracing."""
+    return ((1 << n_nodes) - 1) if n_nodes < 32 else -1
+
+
+def has_quorum(vbits, mask, n_nodes: int, z1):
+    """True iff ``vbits`` covers a strict majority of the members of
+    config bitmask ``mask`` — the election-quorum test, evaluated per
+    config (joint consensus evaluates it for BOTH halves). With the
+    full mask this is exactly the legacy ``popcount(votes) + 1 >
+    n // 2`` (the candidate's own bit rides in ``vbits``)."""
+    cnt = popcount(vbits & mask, n_nodes, z1)
+    maj = popcount(mask, n_nodes, z1) // 2 + z1
+    return cnt >= maj
+
+
+def quorum_match(match, mask, n_nodes: int, z0):
+    """The highest log index replicated on a strict majority of config
+    ``mask``'s members (the commit frontier of ONE config): non-members
+    mask to -1, and the majority-th largest of the sorted column is the
+    answer. With the full mask this is value-identical to the legacy
+    ``sort(match)[(n - 1) // 2]`` median."""
+    z1 = z0 + 1
+    member = ((mask >> jnp.arange(n_nodes, dtype=jnp.int32)) & z1) == z1
+    vals = jnp.where(member, match, z0 - 1)
+    maj = popcount(mask, n_nodes, z1) // 2 + z1
+    return tget(jnp.sort(vals), z0 + n_nodes - maj)
+
+
+def config_view(model, row, z0):
+    """The node's current cluster configuration: the LATEST config
+    entry in its log (Raft §6 — a node uses the newest configuration
+    it holds, committed or not; truncation rolls back naturally
+    because the view re-derives from the log), falling back to the
+    provisioning bitmask ``cfg_boot`` (the initial membership at init,
+    re-stamped by ``join_row`` when a blank node is provisioned
+    mid-run). Returns ``(c_old, c_new, cfg_idx, has_cfg)``; the node
+    is in the JOINT phase iff ``c_old != c_new``."""
+    cap = model.log_cap
+    idxs = jnp.arange(cap, dtype=jnp.int32)
+    is_cfg = (row.log_body[:, 0] == F_CONFIG) & (idxs < row.log_len)
+    has = jnp.any(is_cfg)
+    cfg_idx = jnp.max(jnp.where(is_cfg, idxs, -1))
+    crow = tget(row.log_body, iclip(cfg_idx, z0, z0 + (cap - 1)))
+    c_old = sel(has, crow[1], row.cfg_boot)
+    c_new = sel(has, crow[2], row.cfg_boot)
+    return c_old, c_new, cfg_idx, has
 
 
 def inbox_step(model, row, node_idx, msg, jitter, t, cfg):
@@ -231,6 +296,13 @@ def inbox_step(model, row, node_idx, msg, jitter, t, cfg):
         grant = grant & ((voted_for == zm1) | (voted_for == src))
     if model.vote_check_log:
         grant = grant & log_ok
+    if model.join_requires_catchup:
+        # a JOINING node grants no votes until it holds the committed
+        # prefix (Raft §6's non-voting catch-up phase; caught_up is 1
+        # everywhere membership never changes, so this is a no-op on
+        # membership-free runs — the VotesBeforeCatchup mutant skips
+        # it and lets blank joiners elect a stale leader)
+        grant = grant & (row.caught_up > z0)
     voted_for = sel(grant, src, voted_for)
 
     # --- VoteReply
@@ -241,8 +313,21 @@ def inbox_step(model, row, node_idx, msg, jitter, t, cfg):
     # in-range for the range analyzer (lax.clamp: one equation)
     votes = sel(count_it,
                 votes | (z1 << iclip(src, z0, z0 + (n - 1))), votes)
-    n_votes = _popcount(votes, n, z1)
-    win = count_it & (n_votes > n // 2)
+    # election quorum over the node's CURRENT configuration (joint
+    # consensus: a candidate in the joint phase needs a majority of
+    # BOTH configs; with the full/boot config this is value-identical
+    # to the legacy popcount(votes)+1 > n//2 — the candidate's own
+    # vote rides as its own bit)
+    c_old, c_new, _, _ = config_view(model, row, z0)
+    vbits = votes | (z1 << iclip(nid, z0, z0 + (n - 1)))
+    if model.joint_dual_quorum:
+        win = count_it & has_quorum(vbits, c_old, n, z1) \
+            & has_quorum(vbits, c_new, n, z1)
+    else:
+        # BUG (RaftSingleQuorumReconfig): only the NEW config is ever
+        # consulted — during the joint phase the old majority loses
+        # its veto, the classic single-quorum reconfiguration bug
+        win = count_it & has_quorum(vbits, c_new, n, z1)
     role = sel(win, 2, role)
 
     # --- AppendEntries
@@ -277,6 +362,13 @@ def inbox_step(model, row, node_idx, msg, jitter, t, cfg):
     # prev_idx + n_entries lane sum).
     ae_len = sel(conflict, ae_widx + z1, row.log_len)
     match_ack = sel(accept, iclip(prev_idx + n_entries, z0, zcap), z0)
+    # catch-up detection (membership lane): an accepted AppendEntries
+    # whose leader-commit fits inside our post-accept log means we hold
+    # the full committed prefix — a joining node may vote from here on.
+    # Sticky; 1 from init everywhere membership never changes.
+    caught_up = row.caught_up | (accept
+                                 & (l_commit <= match_ack)
+                                 ).astype(jnp.int32)
 
     # --- client request (append to own log as leader, else proxy)
     is_leader = role == 2
@@ -356,7 +448,7 @@ def inbox_step(model, row, node_idx, msg, jitter, t, cfg):
         commit_idx=commit_idx, log_term=log_term, log_body=log_body,
         log_len=log_len, next_idx=next_idx, match_idx=match_idx,
         election_deadline=election_deadline, last_hb=last_hb,
-        leader_hint=leader_hint,
+        leader_hint=leader_hint, caught_up=caught_up,
         truncated_committed=truncated_committed)
 
     # --- the slot's reply row (lane-for-lane the legacy assembly,
@@ -420,14 +512,23 @@ def apply_frontier(model, row):
     return do, tget(row.log_body, row.last_applied)
 
 
-def fused_tick(model, row, node_idx, t, jitter, cfg):
+def fused_tick(model, row, node_idx, t, jitter, cfg, m_bits=None):
     """The per-tick hook, compartmentalized: election timer, leader
     commit advance, ONE table-driven apply body (``apply_max`` trips
     of an unrolled scan over ``Model.apply_entry`` — the legacy models
     traced ``apply_max`` full copies), and the peer-send table (one
     unrolled per-peer body). Value-for-value mirror of the legacy
     ``RaftModel.tick``; replies and peer rows come out SRC/ORIGIN
-    pre-stamped (the fused contract)."""
+    pre-stamped (the fused contract).
+
+    ``m_bits`` (membership fault lane) is the operator's TARGET member
+    bitmask for this tick: a leader whose configuration differs drives
+    the change through joint consensus — one ``C_old,new`` entry,
+    dual-quorum commits while joint, then ``C_new`` once the joint
+    entry commits, stepping down if the committed sole config excludes
+    it. ``None`` — every membership-free run — closes over the full
+    bitmask, and every config branch below is value-identical to the
+    pre-membership tick."""
     n = cfg.n_nodes
     # pooled batched constants (see inbox_step) — derived from a ROW
     # field so they are batched over instances too (node_idx is not)
@@ -438,6 +539,11 @@ def fused_tick(model, row, node_idx, t, jitter, cfg):
 
     # 1) election timeout -> candidacy
     timeout = (row.role != 2) & (tb >= row.election_deadline)
+    if model.join_requires_catchup:
+        # a joining node is a non-voting learner until caught up — it
+        # neither grants (inbox_step) nor stands (no-op when
+        # caught_up == 1, i.e. everywhere membership never changes)
+        timeout = timeout & (row.caught_up > z0)
     row = row._replace(
         term=sel(timeout, row.term + z1, row.term),
         role=sel(timeout, z1, row.role),
@@ -451,12 +557,24 @@ def fused_tick(model, row, node_idx, t, jitter, cfg):
                               row.election_deadline),
     )
 
-    # 2) leader: advance commit to the median match index (current
-    # term only), then apply
+    # 2) leader: advance commit to the highest index replicated on a
+    # quorum of the CURRENT configuration (current term only), then
+    # apply. Joint phase: the frontier is the min over both configs'
+    # quorum frontiers (Raft §6 — C_old AND C_new must both hold it).
+    c_old, c_new, cfg_idx, has_cfg = config_view(model, row, z0)
+    joint = c_old != c_new
     is_leader = row.role == 2
     match = row.match_idx.at[node_idx].set(row.log_len, mode="drop")
     if model.commit_quorum:
-        majority_match = jnp.sort(match)[(n - 1) // 2]  # >= on majority
+        if model.joint_dual_quorum:
+            majority_match = jnp.minimum(
+                quorum_match(match, c_old, n, z0),
+                quorum_match(match, c_new, n, z0))
+        else:
+            # BUG (RaftSingleQuorumReconfig): commits consult only the
+            # NEW config — a joint-phase leader can commit with the
+            # new minority while the old majority never heard of it
+            majority_match = quorum_match(match, c_new, n, z0)
     else:
         # BUG variant: commit at the MAX match index (no majority)
         majority_match = jnp.max(match)
@@ -471,12 +589,25 @@ def fused_tick(model, row, node_idx, t, jitter, cfg):
         majority_match, row.commit_idx)
     row = row._replace(commit_idx=new_commit, match_idx=match)
 
+    # the latest config entry is PENDING until committed: no new
+    # change starts while one is in flight (one at a time, Raft §6)
+    pending = has_cfg & (cfg_idx >= row.commit_idx)
+    # a leader excluded from the COMMITTED sole configuration steps
+    # down (it managed the cluster through the joint phase; C_new is
+    # in effect without it). No-op whenever cfg covers everyone.
+    self_in_new = ((c_new >> iclip(nid, z0, z0 + (n - 1))) & z1) == z1
+    deposed = is_leader & ~joint & ~pending & ~self_in_new
+    row = row._replace(role=sel(deposed, z0, row.role))
+
     # 3) apply up to apply_max committed entries; leader replies.
     # unroll=True: the jaxpr carries the body ONCE, the HLO still
-    # lowers to straight-line (while-free) code.
+    # lowers to straight-line (while-free) code. Config entries pass
+    # through the frontier (last_applied advances) but never touch the
+    # model state machine and never emit a client reply.
     def apply_step(r, _):
         do, entry = apply_frontier(model, r)
-        r, out = model.apply_entry(r, do, entry, cfg)
+        is_cfg_entry = entry[0] == z0 + F_CONFIG
+        r, out = model.apply_entry(r, do & ~is_cfg_entry, entry, cfg)
         return r._replace(last_applied=sel(do, r.last_applied + z1,
                                            r.last_applied)), out
 
@@ -485,6 +616,34 @@ def fused_tick(model, row, node_idx, t, jitter, cfg):
     # pre-stamp the client replies (apply_entry leaves SRC/ORIGIN 0)
     replies = replies.at[:, wire.SRC].set(nid) \
         .at[:, wire.ORIGIN].set(nid)
+
+    # 3b) the reconfiguration driver (membership lane): a leader whose
+    # configuration differs from the operator's target appends ONE
+    # C_old,new entry (entering the joint phase); once that entry
+    # commits it appends C_new (the new config alone). Both appends
+    # replicate through the ordinary AE machinery below. Statically
+    # reduces to nothing-appended when m_bits is None and no config
+    # entry exists (target == cfg_boot == full) — membership-free runs
+    # trace value-identical drop-writes.
+    cap = model.log_cap
+    zcap = z0 + cap
+    m_tgt = (z0 + full_member_mask(n)) if m_bits is None \
+        else (z0 + m_bits)
+    is_leader_now = row.role == 2      # post-deposal
+    want_joint = (is_leader_now & ~joint & (m_tgt != c_new) & ~pending
+                  & (row.log_len < zcap))
+    want_final = (is_leader_now & joint & ~pending
+                  & (row.log_len < zcap))
+    app = want_joint | want_final
+    cfg_body = jnp.zeros((model.entry_lanes,), jnp.int32) \
+        .at[0].set(z0 + F_CONFIG) \
+        .at[1].set(c_new) \
+        .at[2].set(sel(want_joint, m_tgt, c_new))
+    cslot = sel(app, row.log_len, zcap)
+    row = row._replace(
+        log_term=row.log_term.at[cslot].set(row.term, mode="drop"),
+        log_body=row.log_body.at[cslot].set(cfg_body, mode="drop"),
+        log_len=sel(app, row.log_len + z1, row.log_len))
 
     # 4) peer sends: candidates solicit votes (re-solicit on the same
     # cadence to survive loss), leaders replicate. The cadence test is

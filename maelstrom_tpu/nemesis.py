@@ -8,16 +8,22 @@ fault behavior stays comparable line-for-line with upstream Maelstrom.
 Partitions are NOT the only fault in this repo — the device runtimes
 have the fault-plan engine (``maelstrom_tpu/faults/``,
 ``doc/guide/10-faults.md``): composable crash-restart with snapshot
-recovery, asymmetric/slow/lossy links, and per-node clock skew, each
-proven by a planted-bug anomaly — plus per-instance RANDOMIZED fault
-schedules (``--fault-fuzz``, ``faults/fuzz.py``), which are
+recovery, asymmetric/slow/lossy links, per-node clock skew, and
+mid-run MEMBERSHIP change (``--nemesis membership`` / plan
+``members``/``add``/``remove`` phases driving Raft joint consensus),
+each proven by a planted-bug anomaly — plus per-instance RANDOMIZED
+fault schedules (``--fault-fuzz``, ``faults/fuzz.py``), which are
 TPU-runtime-only by construction: the schedule-RNG lane draws one
 schedule per vectorized instance on device, and a host runtime has
 exactly one "instance" (the real cluster) and no schedule-RNG lane to
 draw from — the CLI rejects ``--fault-fuzz`` on host runtimes with a
 pointer here, the same rejection pattern PR 9 set for the fault kinds
-(PARITY.md). New fault vocabulary lands there; this module
-intentionally stays partitions-only, matching the reference.
+(PARITY.md). ``--nemesis membership`` is rejected the same way BY
+NAME: the lane needs the device runtime's parked-node planes, the
+snapshot slab for rejoins, and the joint-consensus Raft kernel —
+host node processes have none of the three (and the reference's
+workloads never reconfigure). New fault vocabulary lands there; this
+module intentionally stays partitions-only, matching the reference.
 
 The nemesis runs on its own thread alongside the client workers: every
 ``interval`` seconds it alternately starts a partition (computing a *grudge*

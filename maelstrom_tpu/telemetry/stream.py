@@ -37,12 +37,15 @@ Record schema (all host-written; one JSON object per line):
   named (``--scan-top-k`` rows; present only when something tripped).
   ``fault`` (fault-plan runs only) is the chunk's fault epoch —
   ``{"phase": p, "phases": P, "crashed": [...], "degraded-edges": n,
-  "skewed-nodes": n}`` or ``{"healthy": true}`` — computed host-side
+  "skewed-nodes": n, "membership": {"members": [...], "joined": [...],
+  "removed": [...]}}`` or ``{"healthy": true}`` — computed host-side
   from the deterministic plan (``faults.engine.span_summary``), zero
   device traffic; the run-start header carries the plan's lane list
-  under ``faults``. Fault-FUZZ runs (per-instance randomized
-  schedules, ``faults/fuzz.py``) carry ``fault-fuzz`` instead —
-  ``{"schedules-active": n, "crash": c, "links": l, "skew": s}``,
+  under ``faults``, and ``watch`` renders the membership epoch as
+  ``membership +joined/-removed``. Fault-FUZZ runs (per-instance
+  randomized schedules, ``faults/fuzz.py``) carry ``fault-fuzz``
+  instead — ``{"schedules-active": n, "crash": c, "links": l,
+  "skew": s, "membership": m}``,
   the count of instances whose drawn fault windows overlap the chunk
   per lane, computed host-side by re-drawing the seed-deterministic
   schedules (``fuzz.span_counters``); their run-start header adds
@@ -352,13 +355,19 @@ def render_chunk_line(rec: Dict[str, Any]) -> str:
             bits.append(f"links {fault['degraded-edges']}")
         if fault.get("skewed-nodes"):
             bits.append(f"skew {fault['skewed-nodes']}")
+        mem = fault.get("membership")
+        if mem and (mem.get("joined") or mem.get("removed")):
+            # joins/removals over the chunk's span: `membership +1/-2`
+            bits.append("membership "
+                        f"+{len(mem.get('joined') or [])}"
+                        f"/-{len(mem.get('removed') or [])}")
         parts.append("fault[" + " ".join(bits) + "]")
     fz = rec.get("fault-fuzz")
     if fz:
         # randomized schedules: instances with a fault window in this
         # chunk, per lane
         bits = [f"{fz.get('schedules-active', 0)} active"]
-        for lane in ("crash", "links", "skew"):
+        for lane in ("crash", "links", "skew", "membership"):
             if fz.get(lane):
                 bits.append(f"{lane} {fz[lane]}")
         parts.append("fuzz[" + " ".join(bits) + "]")

@@ -161,10 +161,12 @@ class Model:
         unrolled scan materializes as one fused [K, L] batch."""
         raise NotImplementedError
 
-    def fused_tick(self, row, node_idx, t, rng, cfg: NetConfig, params
-                   ) -> Tuple[Any, jnp.ndarray]:
+    def fused_tick(self, row, node_idx, t, rng, cfg: NetConfig, params,
+                   m_bits=None) -> Tuple[Any, jnp.ndarray]:
         """Per-tick hook for the fused path: like tick(), but takes
-        the pre-drawn randomness from node_rng instead of a key."""
+        the pre-drawn randomness from node_rng instead of a key.
+        ``m_bits`` is the membership lane's target bitmask for this
+        tick (``None`` on membership-free runs)."""
         raise NotImplementedError
 
     # --- crash-restart fault lane (maelstrom_tpu/faults/) -----------------
@@ -195,6 +197,36 @@ class Model:
         timers must be re-based on ``t``."""
         del snap, t
         return self.init_row(n_nodes, node_idx, key, params)
+
+    # --- membership fault lane (maelstrom_tpu/faults/ membership) --------
+    #
+    # When a plan (or fuzzed schedule) carries a membership lane, the
+    # runtime parks non-members like crash victims — held at join_row
+    # of their snapshot-slab row, recv blocked, sends suppressed — and
+    # clients only target members. The tick's member BITMASK also
+    # threads into the fused node step (``m_bits``), so a protocol
+    # with a real reconfiguration story (Raft joint consensus,
+    # models/raft_core.py) drives the change through its log instead
+    # of by administrative fiat.
+
+    def join_row(self, n_nodes: int, node_idx, key, params, snap, t,
+                 m_bits) -> Any:
+        """Rebuild a node row as of a membership JOIN at (node-local)
+        tick ``t``: ``m_bits`` is the current member bitmask the node
+        is being provisioned into. Default: the crash-restart path
+        (slab recovery / cold boot), ignoring the bitmask — right for
+        models that keep no cluster-config state."""
+        del m_bits
+        return self.restart_row(n_nodes, node_idx, key, params, snap,
+                                t)
+
+    def boot_config(self, node_state, m_bits) -> Any:
+        """Stamp the INITIAL (phase-0) membership bitmask into the
+        model's provisioning config at init time. Must be pure leaf
+        restructuring — it is applied to BATCHED node state in both
+        carry layouts. Default: no-op (no config state)."""
+        del m_bits
+        return node_state
 
     def invariants(self, node_state, cfg: NetConfig, params) -> jnp.ndarray:
         """Cheap whole-cluster safety invariants, evaluated on-device every
@@ -483,7 +515,7 @@ def partition_matrix(nem: NemesisConfig, cfg: NetConfig, t, instance_key
 # --- node phase -----------------------------------------------------------
 
 def node_phase(model: Model, node_state, inbox_nodes, t, key,
-               cfg: NetConfig, params, t_nodes=None):
+               cfg: NetConfig, params, t_nodes=None, m_bits=None):
     """All nodes of one instance handle their inboxes then run tick hooks.
 
     node_state: pytree with leading node axis [N, ...].
@@ -501,7 +533,10 @@ def node_phase(model: Model, node_state, inbox_nodes, t, key,
     each node's LOCAL clock for ``t`` in its timer logic (election
     deadlines, heartbeat cadence); ``None`` — the default and every
     fault-free run — hands every node the global ``t`` through the
-    identical closure the pre-fault runtime used.
+    identical closure the pre-fault runtime used. ``m_bits`` (scalar
+    int32, membership lane) is the tick's target member bitmask,
+    handed to fused models' tick hooks so reconfiguration-aware
+    protocols can drive the change; ``None`` on membership-free runs.
     """
     N = cfg.n_nodes
     L = cfg.lanes
@@ -536,7 +571,7 @@ def node_phase(model: Model, node_state, inbox_nodes, t, key,
                                               tn, cfg, params),
                 row, (inbox_row, slot_rng), unroll=True)
             row, outs_t = model.fused_tick(row, node_idx, tn, tick_rng,
-                                           cfg, params)
+                                           cfg, params, m_bits=m_bits)
             # fused models pre-stamp SRC/ORIGIN on every emitted row
             # (see the fused-protocol contract) — no re-stamp pass
             return row, jnp.concatenate([outs_k, outs_t], axis=0)
@@ -702,11 +737,21 @@ def init_carry(model: Model, sim: SimConfig, seed: int, params,
         _instance_keys(key, _RNG_INIT, instance_ids))
     pool_shape = ((cfg.pool_slots, cfg.lanes, I) if minor
                   else (I, cfg.pool_slots, cfg.lanes))
+    # membership lane: the INITIAL member set (phase 0 of the plan)
+    # provisions the model's boot config — stamped BEFORE the slab
+    # seeds so a restart restores the same provisioning. Fuzzed
+    # membership always starts from the full cluster, which is
+    # init_row's own default.
+    if sim.faults.has_members and not sim.faults.has_fuzz:
+        bits0 = sum(1 << v for v in sim.faults.members[0])
+        node_state = model.boot_config(node_state, bits0)
     # the fault engine's snapshot slab seeds from the init state
     # (snapshot_row is pure leaf selection, so it applies to the
-    # batched node_state in either layout orientation)
+    # batched node_state in either layout orientation; the membership
+    # lane needs it too — joins restore from it)
     snapshots = (model.snapshot_row(node_state)
-                 if sim.faults.has_crash else None)
+                 if (sim.faults.has_crash or sim.faults.has_members)
+                 else None)
     # fuzz runs draw each instance's randomized fault schedule here,
     # once, from the dedicated schedule-RNG purpose — instance-stable,
     # so any subset replays (triage/funnel/shrink) redraw identically
@@ -865,6 +910,49 @@ def make_tick_fn(model: Model, sim: SimConfig, params,
                             model, st, sn, planes.crash, tvec, k, cfg,
                             params))(node_state_in, snapshots,
                                      wipe_keys)
+            # membership lane: non-(stable-)members are parked at
+            # their join_row (slab recovery + the CURRENT target
+            # bitmask) — the park mask covers every non-member tick
+            # plus the join edge itself, so a joining node's final
+            # rebuild is provisioned with the bitmask including it
+            m_bits = None
+            if planes.member is not None:
+                park_keys = _instance_keys(key, _RNG_RESTART,
+                                           instance_ids, t)
+                if fuzz_on:
+                    m_bits = jax.vmap(faults_engine.member_bits)(
+                        planes.member)
+                    park = ~(planes.member & planes.member_prev)
+                    if planes.t_nodes is not None:
+                        node_state_in = jax.vmap(
+                            lambda st, sn, k, pm, mb, tv:
+                            faults_engine.wipe_parked(
+                                model, st, sn, pm, mb, tv, k, cfg,
+                                params))(
+                            node_state_in, snapshots, park_keys, park,
+                            m_bits, planes.t_nodes)
+                    else:
+                        tvec_m = jnp.broadcast_to(t, (N,)) \
+                            .astype(jnp.int32)
+                        node_state_in = jax.vmap(
+                            lambda st, sn, k, pm, mb:
+                            faults_engine.wipe_parked(
+                                model, st, sn, pm, mb, tvec_m, k, cfg,
+                                params))(
+                            node_state_in, snapshots, park_keys, park,
+                            m_bits)
+                else:
+                    m_bits = faults_engine.member_bits(planes.member)
+                    park = ~(planes.member & planes.member_prev)
+                    tvec_m = (planes.t_nodes
+                              if planes.t_nodes is not None
+                              else jnp.broadcast_to(t, (N,))
+                              .astype(jnp.int32))
+                    node_state_in = jax.vmap(
+                        lambda st, sn, k: faults_engine.wipe_parked(
+                            model, st, sn, park, m_bits, tvec_m, k,
+                            cfg, params))(node_state_in, snapshots,
+                                          park_keys)
 
         # nemesis keys are t-INdependent: partition_matrix folds in the
         # phase index itself, so a grudge holds for its whole phase (the
@@ -896,18 +984,33 @@ def make_tick_fn(model: Model, sim: SimConfig, params,
 
         with jax.named_scope("node_phase"):
             node_keys = _instance_keys(key, _RNG_NODE, instance_ids, t)
-            if fuzz_on and planes.t_nodes is not None:
+            fuzz_tn = fuzz_on and planes.t_nodes is not None
+            fuzz_mb = fuzz_on and m_bits is not None
+            if fuzz_tn and fuzz_mb:
+                node_state, node_outs = jax.vmap(
+                    lambda st, ib, k, tn, mb: node_phase(
+                        model, st, ib, t, k, cfg, params, t_nodes=tn,
+                        m_bits=mb))(
+                    node_state_in, inbox[:, :N], node_keys,
+                    planes.t_nodes, m_bits)
+            elif fuzz_tn:
                 # per-instance local clocks under the fuzzed skew lane
                 node_state, node_outs = jax.vmap(
                     lambda st, ib, k, tn: node_phase(
                         model, st, ib, t, k, cfg, params, t_nodes=tn))(
                     node_state_in, inbox[:, :N], node_keys,
                     planes.t_nodes)
+            elif fuzz_mb:
+                node_state, node_outs = jax.vmap(
+                    lambda st, ib, k, mb: node_phase(
+                        model, st, ib, t, k, cfg, params,
+                        t_nodes=planes.t_nodes, m_bits=mb))(
+                    node_state_in, inbox[:, :N], node_keys, m_bits)
             else:
                 node_state, node_outs = jax.vmap(
                     lambda st, ib, k: node_phase(
                         model, st, ib, t, k, cfg, params,
-                        t_nodes=planes.t_nodes))(
+                        t_nodes=planes.t_nodes, m_bits=m_bits))(
                     node_state_in, inbox[:, :N], node_keys)
 
         invoked_prev = carry.client_state.invoked
@@ -926,6 +1029,20 @@ def make_tick_fn(model: Model, sim: SimConfig, params,
                 node_outs = node_outs.at[..., wire.VALID].mul(
                     cmask[:, :, None] if fuzz_on
                     else cmask[None, :, None])
+            if planes.member is not None:
+                # parked non-members send nothing, and clients only
+                # target nodes that exist (identity when all-member)
+                mmask = planes.member.astype(jnp.int32)
+                node_outs = node_outs.at[..., wire.VALID].mul(
+                    mmask[:, :, None] if fuzz_on
+                    else mmask[None, :, None])
+                if fuzz_on:
+                    reqs = jax.vmap(faults_engine.retarget_clients)(
+                        reqs, planes.member)
+                else:
+                    reqs = jax.vmap(
+                        lambda r: faults_engine.retarget_clients(
+                            r, planes.member))(reqs)
             outs = jnp.concatenate(
                 [node_outs.reshape(I, -1, cfg.lanes), reqs], axis=1)
             # stamp network-unique message ids (send-time allocation, the
@@ -953,16 +1070,23 @@ def make_tick_fn(model: Model, sim: SimConfig, params,
 
         if snapshots is not None:
             with jax.named_scope("faults"):
+                # held nodes (crashed or parked) never overwrite their
+                # slab row — it keeps the leave-point state the next
+                # restart/join restores
+                hold = planes.crash
+                if planes.member is not None:
+                    park = ~(planes.member & planes.member_prev)
+                    hold = park if hold is None else (hold | park)
                 if fuzz_on:
                     snapshots = jax.vmap(
                         lambda st, sn, cm:
                         faults_engine.update_snapshots(
                             model, st, sn, cm, t, fx.snapshot_every))(
-                        node_state, snapshots, planes.crash)
+                        node_state, snapshots, hold)
                 else:
                     snapshots = jax.vmap(
                         lambda st, sn: faults_engine.update_snapshots(
-                            model, st, sn, planes.crash, t,
+                            model, st, sn, hold, t,
                             fx.snapshot_every))(node_state, snapshots)
 
         stats = NetStats(
@@ -1047,6 +1171,20 @@ def _make_tick_fn_minor(model: Model, sim: SimConfig, params,
                 node_row = faults_engine.wipe_crashed(
                     model, node_row, snap_row, planes.crash, tvec,
                     wipe_key, cfg, params)
+            m_bits = None
+            if planes.member is not None:
+                m_bits = faults_engine.member_bits(planes.member)
+                park = ~(planes.member & planes.member_prev)
+                tvec_m = (planes.t_nodes
+                          if planes.t_nodes is not None
+                          else jnp.broadcast_to(t, (N,))
+                          .astype(jnp.int32))
+                park_key = jax.random.fold_in(jax.random.fold_in(
+                    jax.random.fold_in(master, _RNG_RESTART), t),
+                    instance_id)
+                node_row = faults_engine.wipe_parked(
+                    model, node_row, snap_row, park, m_bits, tvec_m,
+                    park_key, cfg, params)
         with jax.named_scope("nemesis"):
             nem_key = jax.random.fold_in(
                 jax.random.fold_in(master, _RNG_NEMESIS), instance_id)
@@ -1062,7 +1200,8 @@ def _make_tick_fn_minor(model: Model, sim: SimConfig, params,
                 jax.random.fold_in(master, _RNG_NODE), t), instance_id)
             node_row, node_outs = node_phase(model, node_row, inbox[:N], t,
                                              node_key, cfg, params,
-                                             t_nodes=planes.t_nodes)
+                                             t_nodes=planes.t_nodes,
+                                             m_bits=m_bits)
 
         with jax.named_scope("client_step"):
             client_key = jax.random.fold_in(jax.random.fold_in(
@@ -1076,6 +1215,11 @@ def _make_tick_fn_minor(model: Model, sim: SimConfig, params,
             if planes.crash is not None:
                 node_outs = node_outs.at[..., wire.VALID].mul(
                     (~planes.crash).astype(jnp.int32)[:, None])
+            if planes.member is not None:
+                node_outs = node_outs.at[..., wire.VALID].mul(
+                    planes.member.astype(jnp.int32)[:, None])
+                reqs = faults_engine.retarget_clients(reqs,
+                                                      planes.member)
             outs = jnp.concatenate(
                 [node_outs.reshape(-1, cfg.lanes), reqs], axis=0)
             M = outs.shape[0]
@@ -1089,8 +1233,12 @@ def _make_tick_fn_minor(model: Model, sim: SimConfig, params,
                 edge_loss_pm=planes.loss_pm)
         if snap_row is not None:
             with jax.named_scope("faults"):
+                hold = planes.crash
+                if planes.member is not None:
+                    park = ~(planes.member & planes.member_prev)
+                    hold = park if hold is None else (hold | park)
                 snap_row = faults_engine.update_snapshots(
-                    model, node_row, snap_row, planes.crash, t,
+                    model, node_row, snap_row, hold, t,
                     fx.snapshot_every)
         violated = model.invariants(node_row, cfg, params)
         return (pool, node_row, client_row, snap_row,

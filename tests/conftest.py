@@ -77,6 +77,11 @@ def pytest_configure(config):
                    "vectorized decode identity, checker-farm "
                    "pool-vs-serial identity, kill-fallback "
                    "(tpu/decode.py, checkers/pool.py)")
+    config.addinivalue_line(
+        "markers", "membership: mid-run membership-change fault lane "
+                   "tests — joint-consensus Raft reconfiguration, "
+                   "parked-node semantics, planted reconfig bugs "
+                   "(maelstrom_tpu/faults/, models/raft_core.py)")
 
 
 def pytest_collection_modifyitems(config, items):

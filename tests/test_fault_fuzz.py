@@ -224,9 +224,11 @@ class TestScheduleDraw:
         assert cov["crash-windows"] > 0
         counters = fz.span_counters(win, 0, 500)
         assert counters["schedules-active"] > 0
-        # a span past every window is quiet
+        # a span past every window is quiet (membership joined the
+        # lane roster in PR 15)
         assert fz.span_counters(win, 10_000, 100) == {
-            "schedules-active": 0, "crash": 0, "links": 0, "skew": 0}
+            "schedules-active": 0, "crash": 0, "links": 0, "skew": 0,
+            "membership": 0}
 
 
 # --- bit-identity ----------------------------------------------------------
@@ -412,11 +414,14 @@ class TestShrinker:
         from maelstrom_tpu.faults import validate_fault_plan
         validate_fault_plan(rec["shrunk-plan"], 3)
 
-    def test_shrink_rejects_non_fuzz_runs(self):
+    def test_shrink_rejects_fault_free_runs(self):
+        """No fuzz distribution AND no deterministic plan -> nothing
+        to shrink (plan runs became shrinkable with the membership
+        lane — tests/test_membership.py covers that path)."""
         from maelstrom_tpu.faults.shrink import (ShrinkError,
                                                  shrink_instance)
         model = get_model("lin-kv", 3)
-        with pytest.raises(ShrinkError, match="not a fault-fuzz run"):
+        with pytest.raises(ShrinkError, match="not a fault run"):
             shrink_instance(model, dict(SMALL_OPTS), 0)
 
     @pytest.mark.slow

@@ -186,7 +186,7 @@ class TestSpec:
                 {"dst": 0, "src": 1, "loss": 0.25},
                 {"dst": 0, "src": 1, "block": True}]}]},
             3, stop_tick=600)
-        _, _, block, delay, loss, _ = _planes_np(fx, 3, 2)
+        _, _, block, delay, loss, _, _ = _planes_np(fx, 3, 2)
         assert delay[0, 0, 1] == 20
         assert loss[0, 0, 1] == 250
         assert block[0, 0, 1]

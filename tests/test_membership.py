@@ -1,0 +1,810 @@
+"""Mid-run membership change: the joint-consensus reconfiguration lane.
+
+The fourth fault lane (``maelstrom_tpu/faults/`` membership) changes
+WHO is in the cluster mid-run, and Raft answers with real joint
+consensus (``models/raft_core.py``: C_old,new / C_new log entries,
+dual-quorum election and commit, catch-up-gated joiners). Four legs,
+each pinned here:
+
+1. **Spec** — the inheriting ``members``/``add``/``remove`` dialect
+   resolves to absolute per-phase sets; plans that would EMPTY the
+   cluster or name a node past ``n_nodes`` capacity are refused at
+   compile time (so by ``make_sim_config``) with the offending phase
+   NAMED.
+2. **Bit-identity** — an all-member membership lane (plan AND fuzz) is
+   bit-identical to a fault-free run in both carry layouts; an ACTIVE
+   plan is layout-identical and shard-identical.
+3. **Anomaly matrix** — ``RaftSingleQuorumReconfig`` (joint-phase
+   quorums consult only the new config) trips committed-prefix under
+   the remove-majority-then-partition plan, and
+   ``RaftVotesBeforeCatchup`` (blank joiners vote immediately) trips
+   under the add-majority-behind-a-partition plan — while CORRECT
+   joint-consensus Raft stays checker-valid under the SAME plans
+   across seeds and demonstrably COMPLETES the C_old,new -> C_new
+   round.
+4. **Durability/triage** — checkpoint/resume under an active
+   membership plan is bit-identical across the seam (taken mid-joint-
+   phase), and the funnel's bit-exact replay reproduces the violating
+   instances.
+
+Plus the shrinker's ddmin upgrade (complement-halving rounds beat the
+greedy-only pass on a >= 4-phase planted schedule) and the observatory
+integration (membership fault epochs per chunk, fuzz coverage
+counters, ``watch`` rendering).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from maelstrom_tpu.faults import (SpecError, compile_fault_fuzz,
+                                  compile_fault_plan,
+                                  generate_fault_plan, membership_walk,
+                                  validate_fault_plan)
+from maelstrom_tpu.faults import fuzz as fz
+from maelstrom_tpu.faults.engine import span_summary
+from maelstrom_tpu.models import get_model
+from maelstrom_tpu.models.raft_core import F_CONFIG
+from maelstrom_tpu.tpu.harness import make_sim_config, run_tpu_test
+from maelstrom_tpu.tpu.runtime import canonical_carry, run_sim
+
+pytestmark = pytest.mark.membership
+
+
+# --- shared fixtures -------------------------------------------------------
+
+# remove-majority-then-partition (n=3): commit writes healthy, then
+# target members=[0] while links cut {0} | {1,2}, then restore
+# membership with the partition still up. The single-quorum mutant's
+# joint-phase leader at 0 commits the change (and client writes) alone;
+# the restored {1,2} majority — which never heard of it — elects and
+# commits a DIFFERENT history at the same indices. Correct Raft stalls
+# the change (old-majority veto): unavailable for the window, never
+# unsafe.
+_SPLIT_0 = [{"dst": d, "src": s, "block": True}
+            for d, s in ((0, 1), (1, 0), (0, 2), (2, 0))]
+SQ_PLAN = {"phases": [{"until": 220},
+                      {"until": 400, "members": [0], "links": _SPLIT_0},
+                      {"until": 640, "members": [0, 1, 2],
+                       "links": _SPLIT_0}]}
+SQ_OPTS = dict(node_count=3, concurrency=4, n_instances=16,
+               record_instances=4, time_limit=0.7, rate=300.0,
+               latency=5.0, rpc_timeout=0.08, recovery_time=0.05,
+               fault_plan=SQ_PLAN, heartbeat=False, seed=7,
+               funnel_max=4, inbox_k=2, pool_slots=24)
+
+# add-majority-of-blank-joiners behind a partition (n=5): the 2-of-5
+# initial cluster {0,1} commits writes, then {2,3,4} join while
+# partitioned from {0,1}; buggy joiners vote with empty logs and elect
+# one of themselves over the committed history. When the partition
+# heals, correct Raft catches the learners up and completes the full
+# joint round.
+_SPLIT_01 = ([{"dst": d, "src": s, "block": True}
+              for d in (0, 1) for s in (2, 3, 4)]
+             + [{"dst": d, "src": s, "block": True}
+                for d in (2, 3, 4) for s in (0, 1)])
+VBC_PLAN = {"phases": [{"until": 200, "members": [0, 1]},
+                       {"until": 480, "add": [2, 3, 4],
+                        "links": _SPLIT_01},
+                       {"until": 700}]}
+VBC_OPTS = dict(node_count=5, concurrency=4, n_instances=12,
+                record_instances=4, time_limit=0.75, rate=300.0,
+                latency=5.0, rpc_timeout=0.08, recovery_time=0.05,
+                fault_plan=VBC_PLAN, heartbeat=False, seed=7,
+                funnel_max=4, inbox_k=2, pool_slots=24)
+
+_IDENTITY_OPTS = dict(node_count=3, concurrency=2, n_instances=4,
+                      record_instances=2, time_limit=0.3, rate=200.0,
+                      latency=5.0, p_loss=0.05, nemesis=["partition"],
+                      nemesis_interval=0.05, seed=0, inbox_k=2,
+                      pool_slots=24)
+
+# membership configured but value-neutral: every phase keeps everyone
+# in — the full lane machinery (slab, park select, target threading,
+# client retarget, dual-quorum masks) traces, with values identical to
+# the membership-free path
+_NEUTRAL_PLAN = {"phases": [{"until": 100_000,
+                             "members": [0, 1, 2]}]}
+
+# fuzz distribution with a rate-0 membership lane: present, all draws
+# healthy
+_HEALTHY_DIST = {"windows": [1, 2], "gap": [20, 60],
+                 "duration": [20, 50],
+                 "membership": {"rate": 0.0, "victims": [1, 2]}}
+_ACTIVE_DIST = {"windows": [2, 2], "gap": [60, 160],
+                "duration": [40, 90],
+                "membership": {"rate": 0.8, "victims": [1, 2]}}
+
+
+# --- spec / compile units --------------------------------------------------
+
+
+class TestSpec:
+    def test_walk_resolves_inheritance(self):
+        phases = [{"until": 50, "members": [0, 1]},
+                  {"until": 100},                    # inherits {0,1}
+                  {"until": 150, "add": [2]},
+                  {"until": 200, "remove": [1]}]
+        assert membership_walk(phases, 3) == [
+            (0, 1), (0, 1), (0, 1, 2), (0, 2)]
+
+    def test_walk_none_when_lane_absent(self):
+        assert membership_walk([{"until": 10, "crash": [0]}], 3) is None
+
+    def test_compile_carries_members_and_universe(self):
+        fxx = compile_fault_plan(SQ_PLAN, 3, stop_tick=640)
+        assert fxx.has_members and fxx.active
+        assert fxx.members == ((0, 1, 2), (0,), (0, 1, 2))
+        assert fxx.n_nodes == 3
+
+    @pytest.mark.parametrize("plan,msg", [
+        # emptying the cluster names the phase
+        ({"phases": [{"until": 10, "members": []}]},
+         "phase 0 membership would EMPTY"),
+        ({"phases": [{"until": 10, "members": [0, 1]},
+                     {"until": 20, "remove": [0, 1]}]},
+         "phase 1 membership would EMPTY"),
+        # capacity overflow names the phase
+        ({"phases": [{"until": 10, "add": [7]}]},
+         "phase 0 added node 7 out of range"),
+        ({"phases": [{"until": 10}, {"until": 20, "members": [0, 5]}]},
+         "phase 1 member 5 out of range"),
+        # absolute + relative in one phase is ambiguous
+        ({"phases": [{"until": 10, "members": [0], "add": [1]}]},
+         "mixes 'members' with 'add'/'remove'"),
+    ])
+    def test_validation_rejects_naming_the_phase(self, plan, msg):
+        with pytest.raises(SpecError, match=msg):
+            validate_fault_plan(plan, 3)
+
+    def test_make_sim_config_refuses_bad_membership_plans(self):
+        """The satellite contract: make_sim_config is where the CLI's
+        plan lands, and the refusal must name the offending phase."""
+        model = get_model("lin-kv", 3)
+        bad_empty = {"phases": [{"until": 10, "members": [0]},
+                                {"until": 20, "remove": [0]}]}
+        with pytest.raises(SpecError, match="phase 1 membership would "
+                                            "EMPTY the cluster"):
+            make_sim_config(model, dict(node_count=3,
+                                        fault_plan=bad_empty))
+        bad_cap = {"phases": [{"until": 10, "add": [3]}]}
+        with pytest.raises(SpecError,
+                           match="phase 0 added node 3 out of range"):
+            make_sim_config(model, dict(node_count=3,
+                                        fault_plan=bad_cap))
+
+    def test_fuzz_victims_capped_below_cluster_size(self):
+        with pytest.raises(SpecError, match="membership victims"):
+            compile_fault_fuzz(
+                {"membership": {"rate": 1.0, "victims": [1, 3]}}, 3,
+                stop_tick=100)
+        fxx = compile_fault_fuzz(
+            {"membership": {"rate": 1.0, "victims": [1, 2]}}, 3,
+            stop_tick=100)
+        assert fxx.has_members and fxx.fuzz.has_membership
+
+    def test_generated_membership_kind(self):
+        """--nemesis membership: rotating single-node removal with an
+        explicit all-member restore each heal phase (membership
+        INHERITS, so heals must say so)."""
+        plan = generate_fault_plan(["membership"], 3, 600, 50, 500)
+        fxx = compile_fault_plan(plan, 3, stop_tick=500)
+        assert fxx.has_members
+        for p, members in enumerate(fxx.members):
+            assert len(members) >= 2   # always a minority removed
+            if p % 2 == 1:
+                assert len(members) == 2
+            else:
+                assert members == (0, 1, 2)
+
+    def test_span_summary_membership_epoch(self):
+        fxx = compile_fault_plan(SQ_PLAN, 3, stop_tick=640)
+        mid = span_summary(fxx, 250, 100)      # inside the removal
+        assert mid["membership"]["removed"] == [1, 2]
+        assert mid["membership"]["members"] == [0]
+        rejoin = span_summary(fxx, 380, 100)   # spans the restore edge
+        assert rejoin["membership"]["joined"] == [1, 2]
+        healthy = span_summary(fxx, 660, 40)   # final heal
+        assert healthy.get("healthy") is True
+
+    def test_watch_renders_membership_epoch(self):
+        from maelstrom_tpu.telemetry.stream import render_chunk_line
+        line = render_chunk_line(
+            {"chunk": 3, "t0": 300, "ticks": 100,
+             "fault": {"phase": 2, "phases": 3,
+                       "membership": {"members": [0],
+                                      "joined": [0],
+                                      "removed": [1, 2]}}})
+        assert "membership +1/-2" in line
+        fuzz_line = render_chunk_line(
+            {"chunk": 1, "t0": 0, "ticks": 50,
+             "fault-fuzz": {"schedules-active": 3, "membership": 2}})
+        assert "membership 2" in fuzz_line
+
+
+# --- bit-identity ----------------------------------------------------------
+
+
+class TestBitIdentity:
+    @pytest.mark.parametrize("layout", ["lead", "minor"])
+    def test_all_member_plan_bit_identical(self, layout):
+        """A membership lane that keeps everyone in reproduces the
+        fault-free trajectory bit-for-bit (the machinery — slab, park
+        select, dual-quorum masks, client retarget — is all in the
+        graph)."""
+        model = get_model("lin-kv", 3)
+        sim = make_sim_config(model, {**_IDENTITY_OPTS,
+                                      "layout": layout})
+        fxx = compile_fault_plan(_NEUTRAL_PLAN, 3,
+                                 stop_tick=sim.nemesis.stop_tick)
+        params = model.make_params(3)
+        base_c, base_y = run_sim(model, sim, 0, params)
+        neut_c, neut_y = run_sim(model, sim._replace(faults=fxx), 0,
+                                 params)
+        assert neut_c.snapshots is not None   # the slab really exists
+        for a, b in zip(
+                jax.tree.leaves((base_c.pool, base_c.node_state,
+                                 base_c.client_state, base_c.stats,
+                                 base_c.violations)),
+                jax.tree.leaves((neut_c.pool, neut_c.node_state,
+                                 neut_c.client_state, neut_c.stats,
+                                 neut_c.violations))):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        np.testing.assert_array_equal(np.asarray(base_y.events),
+                                      np.asarray(neut_y.events))
+
+    @pytest.mark.parametrize("layout", ["lead", "minor"])
+    def test_all_healthy_membership_fuzz_bit_identical(self, layout):
+        """A rate-0 membership DISTRIBUTION (schedule lanes drawn and
+        selected per instance every tick) is bit-identical to
+        fault-free."""
+        model = get_model("lin-kv", 3)
+        opts = {**_IDENTITY_OPTS, "nemesis": [], "p_loss": 0.0,
+                "layout": layout}
+        sim = make_sim_config(model, dict(opts))
+        simf = make_sim_config(model, {**opts,
+                                       "fault_fuzz": _HEALTHY_DIST})
+        params = model.make_params(3)
+        bc, by = run_sim(model, sim, 0, params)
+        nc, ny = run_sim(model, simf, 0, params)
+        for a, b in zip(
+                jax.tree.leaves((bc.pool, bc.node_state,
+                                 bc.client_state, bc.stats,
+                                 bc.violations)),
+                jax.tree.leaves((nc.pool, nc.node_state,
+                                 nc.client_state, nc.stats,
+                                 nc.violations))):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        np.testing.assert_array_equal(np.asarray(by.events),
+                                      np.asarray(ny.events))
+
+    def test_active_plan_layout_independent(self):
+        """The remove-majority plan produces bit-identical trajectories
+        in both carry layouts (park wipes, joins, retargeting and the
+        dual-quorum math all ride the shared per-instance code)."""
+        out = {}
+        for layout in ("lead", "minor"):
+            model = get_model("lin-kv", 3)
+            sim = make_sim_config(model, {**SQ_OPTS, "layout": layout})
+            c, y = run_sim(model, sim, 7, model.make_params(3))
+            canon = canonical_carry(c, sim)
+            out[layout] = (jax.tree.leaves(
+                (canon.pool, canon.node_state, canon.client_state,
+                 canon.stats, canon.violations, canon.snapshots)),
+                np.asarray(y.events))
+        for a, b in zip(out["lead"][0], out["minor"][0]):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        np.testing.assert_array_equal(out["lead"][1], out["minor"][1])
+
+    def test_all_member_plan_sharded_bit_identical(self):
+        """Across the shard_map wire: an all-member membership fleet's
+        (stats, violations, events) equal the fault-free sharded run
+        bit-for-bit."""
+        from maelstrom_tpu.parallel.mesh import (make_mesh,
+                                                 run_sim_sharded)
+        model = get_model("lin-kv", 3)
+        opts = dict(node_count=3, concurrency=2, n_instances=4,
+                    record_instances=2, time_limit=0.2, rate=200.0,
+                    latency=5.0, seed=3, inbox_k=2, pool_slots=16)
+        params = model.make_params(3)
+        mesh = make_mesh(2)
+        base = make_sim_config(model, dict(opts))
+        neut = base._replace(faults=compile_fault_plan(
+            _NEUTRAL_PLAN, 3, stop_tick=base.nemesis.stop_tick))
+        s0, v0, e0 = run_sim_sharded(model, base, 3, params, mesh=mesh)
+        s1, v1, e1 = run_sim_sharded(model, neut, 3, params, mesh=mesh)
+        assert jax.tree.map(int, s0) == jax.tree.map(int, s1)
+        np.testing.assert_array_equal(np.asarray(v0), np.asarray(v1))
+        np.testing.assert_array_equal(np.asarray(e0), np.asarray(e1))
+
+    @pytest.mark.slow
+    def test_active_plan_sharded_chunked_matches_oracle(self):
+        """An ACTIVE membership plan through the chunked sharded driver
+        equals the unsharded oracle — the lane survives the shard_map
+        wire and the chunked executor together."""
+        from maelstrom_tpu.parallel.mesh import (make_mesh,
+                                                 run_sim_sharded_chunked,
+                                                 run_sim_unsharded)
+        model = get_model("lin-kv", 3)
+        opts = dict(SQ_OPTS, n_instances=4, record_instances=2,
+                    funnel=False)
+        sim = make_sim_config(model, opts)
+        params = model.make_params(3)
+        mesh = make_mesh(2)
+        s_sh, v_sh, e_sh = run_sim_sharded_chunked(
+            model, sim, 7, params, mesh=mesh, chunk=100)
+        s_un, v_un, e_un = run_sim_unsharded(model, sim, 7, 2, params)
+        assert jax.tree.map(int, s_sh) == jax.tree.map(int, s_un)
+        np.testing.assert_array_equal(np.asarray(v_sh), v_un)
+        np.testing.assert_array_equal(np.asarray(e_sh), e_un)
+
+
+# --- the anomaly matrix ----------------------------------------------------
+
+
+class TestSingleQuorumLane:
+    def test_single_quorum_reconfig_caught_correct_model_survives(self):
+        """The membership lane's planted bug #1 end-to-end: the
+        joint-phase single-quorum commit diverges the two sides of the
+        partition, the on-device committed-prefix invariant trips, and
+        the funnel's bit-exact replay confirms every flagged instance;
+        correct joint-consensus Raft under the SAME plan stalls the
+        change and stays fully valid."""
+        bug = run_tpu_test(
+            get_model("lin-kv-bug-single-quorum-reconfig", 3),
+            dict(SQ_OPTS))
+        assert bug["valid?"] is False
+        assert bug["invariants"]["violating-instances"] >= 4, \
+            bug["invariants"]
+        funnel = bug["funnel"]
+        assert funnel["replayed-violating"] == len(funnel["ids"]) > 0
+
+        ok = run_tpu_test(get_model("lin-kv", 3), dict(SQ_OPTS))
+        assert ok["valid?"] is True
+        assert ok["invariants"]["violating-instances"] == 0
+
+
+class TestVotesBeforeCatchupLane:
+    def test_votes_before_catchup_caught_correct_model_completes(self):
+        """The membership lane's planted bug #2: blank joiners elect an
+        empty-log leader over the committed history — every instance
+        trips. The CORRECT model under the SAME plan keeps the joiners
+        mute until caught up and then COMPLETES the reconfiguration:
+        both config entries (C_old,new with old != new, then C_new with
+        old == new == all) land in every instance's log."""
+        bug = run_tpu_test(
+            get_model("lin-kv-bug-votes-before-catchup", 5),
+            dict(VBC_OPTS))
+        assert bug["valid?"] is False
+        assert bug["invariants"]["violating-instances"] >= 8, \
+            bug["invariants"]
+
+        model = get_model("lin-kv", 5)
+        ok = run_tpu_test(model, dict(VBC_OPTS))
+        assert ok["valid?"] is True
+        assert ok["invariants"]["violating-instances"] == 0
+
+        # the joint-consensus happy path: C_old,new ({0,1} -> all5)
+        # then C_new, on every instance's node-0 log
+        sim = make_sim_config(model, dict(VBC_OPTS))
+        carry, _ = run_sim(model, sim, 7, model.make_params(5))
+        lb = np.asarray(canonical_carry(carry, sim).node_state.log_body)
+        ll = np.asarray(canonical_carry(carry, sim).node_state.log_len)
+        all5 = (1 << 5) - 1
+        for i in range(lb.shape[0]):
+            cfgs = [(int(lb[i, 0, k, 1]), int(lb[i, 0, k, 2]))
+                    for k in range(lb.shape[2])
+                    if k < ll[i, 0] and lb[i, 0, k, 0] == F_CONFIG]
+            assert (0b11, all5) in cfgs, (i, cfgs)     # C_old,new
+            assert (all5, all5) in cfgs, (i, cfgs)     # C_new
+        # joiners came out of learner mode (a single node may still be
+        # mid-catch-up at the horizon — e.g. re-parked by a last
+        # election race — but every instance ends with at least a full
+        # quorum of caught-up voters)
+        caught = np.asarray(canonical_carry(carry,
+                                            sim).node_state.caught_up)
+        assert (caught.sum(axis=1) >= 4).all(), caught
+
+
+class TestWideClusterMask:
+    def test_full_member_mask_no_overflow(self):
+        """Membership-free runs wider than the int32 value bits must
+        still trace: the all-members mask collapses to -1 (every bit
+        set — 'member' for every index under the arithmetic-shift
+        tests) instead of raising OverflowError at ``(1 << n) - 1``.
+        The membership LANE stays capped at MAX_MEMBER_NODES=30 by the
+        spec walk."""
+        import jax.numpy as jnp
+        from maelstrom_tpu.models.raft_core import full_member_mask
+        assert full_member_mask(3) == 0b111
+        assert full_member_mask(31) == (1 << 31) - 1
+        assert full_member_mask(32) == -1
+        assert full_member_mask(64) == -1
+        model = get_model("lin-kv", 33)
+        row = model.init_row(33, jnp.int32(0), jax.random.PRNGKey(0),
+                             model.make_params(33))
+        assert int(row.cfg_boot) == -1
+
+
+class TestLearnerGateDurability:
+    def test_crash_restart_preserves_caught_up(self):
+        """``caught_up`` is DURABLE, so the crash and membership lanes
+        COMPOSE: a joining learner that crashes before its first
+        fitting AppendEntries accept must restart with caught_up=0.
+        init_row's fresh row says 1, and restoring every durable lane
+        BUT the gate would let a blank joiner vote after any crash
+        window — the VotesBeforeCatchup anomaly in the CORRECT
+        model."""
+        import jax.numpy as jnp
+        model = get_model("lin-kv", 3)
+        params = model.make_params(3)
+        key = jax.random.PRNGKey(0)
+        fresh = model.init_row(3, jnp.int32(2), key, params)
+        assert "caught_up" in model.DURABLE_LANES
+        # blank joiner: empty durable log -> non-voting learner
+        joined = model.join_row(3, jnp.int32(2), key, params,
+                                model.snapshot_row(fresh),
+                                jnp.int32(100), jnp.int32(0b111))
+        assert int(joined.caught_up) == 0
+        # crash it before catch-up: the gate survives the reboot
+        rebooted = model.restart_row(3, jnp.int32(2), key, params,
+                                     model.snapshot_row(joined),
+                                     jnp.int32(200))
+        assert int(rebooted.caught_up) == 0
+        # and a caught-up voter stays a voter across a crash
+        voter = joined._replace(caught_up=jnp.int32(1))
+        rebooted = model.restart_row(3, jnp.int32(2), key, params,
+                                     model.snapshot_row(voter),
+                                     jnp.int32(300))
+        assert int(rebooted.caught_up) == 1
+
+
+@pytest.mark.slow
+class TestAnomalyMatrixSweep:
+    """The matrix across extra seeds (>= 3 total with the pinned
+    seed-7 representatives above)."""
+
+    @pytest.mark.parametrize("seed", [11, 13])
+    def test_single_quorum_lane(self, seed):
+        bug = run_tpu_test(
+            get_model("lin-kv-bug-single-quorum-reconfig", 3),
+            dict(SQ_OPTS, seed=seed))
+        ok = run_tpu_test(get_model("lin-kv", 3),
+                          dict(SQ_OPTS, seed=seed))
+        assert bug["valid?"] is False and ok["valid?"] is True
+
+    @pytest.mark.parametrize("seed", [11, 13])
+    def test_votes_before_catchup_lane(self, seed):
+        bug = run_tpu_test(
+            get_model("lin-kv-bug-votes-before-catchup", 5),
+            dict(VBC_OPTS, seed=seed))
+        ok = run_tpu_test(get_model("lin-kv", 5),
+                          dict(VBC_OPTS, seed=seed))
+        assert bug["valid?"] is False and ok["valid?"] is True
+
+    def test_generated_membership_churn_is_survivable(self):
+        """The CLI's generated membership plan (one rotating node
+        removed at a time) must be survivable AND completable by
+        correct Raft — every window drives a full joint round."""
+        opts = dict(node_count=3, concurrency=4, n_instances=8,
+                    record_instances=4, time_limit=0.8, rate=200.0,
+                    latency=5.0, rpc_timeout=0.08, recovery_time=0.15,
+                    nemesis=["membership"], nemesis_interval=0.1,
+                    heartbeat=False, seed=7, inbox_k=2, pool_slots=24)
+        res = run_tpu_test(get_model("lin-kv", 3), opts)
+        assert res["valid?"] is True
+        assert res["invariants"]["violating-instances"] == 0
+
+
+# --- membership fuzz lane --------------------------------------------------
+
+
+class TestMembershipFuzz:
+    def test_distinct_schedules_and_coverage(self):
+        fxx = compile_fault_fuzz(_ACTIVE_DIST, 3, stop_tick=600)
+        win = fz.fleet_windows(fxx, 3, 7, np.arange(16, dtype=np.int32))
+        cov = fz.fleet_coverage(win)
+        assert cov["membership-windows"] >= 4
+        assert cov["distinct-schedules"] >= 4
+        span = fz.span_counters(win, 0, 600)
+        assert span["membership"] >= 4
+
+    def test_reconstructed_plan_rejoins_on_time(self):
+        """The seed -> schedule -> plan path: membership windows lower
+        to remove/add event phases whose compiled planes are
+        value-identical to the drawn schedule at every tick."""
+        import jax.numpy as jnp
+        from maelstrom_tpu.faults.engine import tick_planes
+        fxx = compile_fault_fuzz(_ACTIVE_DIST, 3, stop_tick=600)
+        cfg = make_sim_config(get_model("lin-kv", 3),
+                              dict(node_count=3, time_limit=0.6,
+                                   recovery_time=0.0)).net
+        hits = 0
+        for inst in range(4):
+            sched = fz.reconstruct_schedule(fxx, 3, 7, inst)
+            plan = fz.schedule_to_plan(sched, fxx)
+            pfx = (compile_fault_plan(plan, 3, stop_tick=600)
+                   if plan else None)
+            sched_j = jax.tree.map(jnp.asarray, sched)
+            for t in range(0, 600, 5):
+                fp = fz.schedule_planes(sched_j, fxx, cfg,
+                                        jnp.int32(t))
+                fm = np.asarray(fp.member)
+                if pfx is None or not pfx.has_members:
+                    pm = np.ones(3, bool)
+                else:
+                    pp = tick_planes(pfx, cfg, jnp.int32(t))
+                    pm = np.asarray(pp.member)
+                np.testing.assert_array_equal(fm, pm, err_msg=f"t={t}")
+                hits += int((~fm).any())
+        assert hits > 0    # the sweep actually removed somebody
+
+    def test_membership_fuzz_runs_and_replays(self):
+        """An active membership distribution over correct Raft: runs
+        valid (remove-then-rejoin churn is survivable), and the drawn
+        schedules ride the carry through both layouts identically."""
+        opts = dict(node_count=3, concurrency=2, n_instances=8,
+                    record_instances=2, time_limit=0.5, rate=200.0,
+                    latency=5.0, rpc_timeout=0.08, recovery_time=0.1,
+                    seed=7, inbox_k=2, pool_slots=24, funnel=False,
+                    heartbeat=False, fault_fuzz=_ACTIVE_DIST)
+        out = {}
+        for layout in ("lead", "minor"):
+            model = get_model("lin-kv", 3)
+            sim = make_sim_config(model, {**opts, "layout": layout})
+            c, y = run_sim(model, sim, 7, model.make_params(3))
+            canon = canonical_carry(c, sim)
+            out[layout] = (jax.tree.leaves(
+                (canon.pool, canon.node_state, canon.client_state,
+                 canon.stats, canon.violations, canon.fault_sched)),
+                np.asarray(y.events))
+            assert int(np.asarray(c.violations).sum()) == 0
+        for a, b in zip(out["lead"][0], out["minor"][0]):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        np.testing.assert_array_equal(out["lead"][1], out["minor"][1])
+
+
+# --- checkpoint/resume + triage under an active membership plan ------------
+
+
+class TestDurability:
+    @pytest.mark.parametrize("layout", ["lead", "minor"])
+    def test_checkpoint_resume_mid_joint_phase_bit_identical(
+            self, tmp_path, layout):
+        """Kill at a checkpoint taken INSIDE the membership phase (the
+        joint round is in flight: C_old,new appended, parked nodes
+        held), resume, and the result equals the uninterrupted run."""
+        from maelstrom_tpu.campaign.checkpoint import (load_checkpoint,
+                                                       restore_carry,
+                                                       save_checkpoint)
+        from maelstrom_tpu.tpu.pipeline import (ResumeState,
+                                                _init_pipelined,
+                                                run_sim_pipelined)
+        model = get_model("lin-kv", 3)
+        opts = dict(SQ_OPTS, n_instances=4, record_instances=2,
+                    funnel=False, layout=layout)
+        sim = make_sim_config(model, opts)
+        assert sim.faults.has_members
+        params = model.make_params(3)
+        base = run_sim_pipelined(model, sim, 7, params, chunk=100)
+
+        d = str(tmp_path) + f"-{layout}"
+        os.makedirs(d, exist_ok=True)
+
+        class Killed(Exception):
+            pass
+
+        def cb(state, ticks, host):
+            save_checkpoint(d, kind="pipelined", state=state,
+                            ticks=ticks, chunks=host["chunks"],
+                            compact=tuple(host["compact"]),
+                            journal=tuple(host["journal"]))
+            raise Killed
+
+        with pytest.raises(Killed):
+            # checkpoint_every=3 -> the seam lands at tick 300: inside
+            # the members=[0] phase (220..400), mid-joint-round
+            run_sim_pipelined(model, sim, 7, params, chunk=100,
+                              checkpoint_cb=cb, checkpoint_every=3)
+        ck = load_checkpoint(d)
+        assert 220 < ck["ticks"] < 400     # genuinely mid-phase
+        template = _init_pipelined(model, sim, 7, params,
+                                   np.arange(4, dtype=np.int32))
+        resume = ResumeState(
+            carry=restore_carry(template, ck["carry"]),
+            ticks=ck["ticks"], chunks=ck["chunks"],
+            compact=tuple(ck["compact"]),
+            journal=tuple(ck["journal"]))
+        res = run_sim_pipelined(model, sim, 7, params, chunk=100,
+                                resume=resume)
+        np.testing.assert_array_equal(base.events, res.events)
+        for a, b in zip(jax.tree.leaves(base.carry),
+                        jax.tree.leaves(res.carry)):
+            np.testing.assert_array_equal(np.asarray(a),
+                                          np.asarray(b))
+
+    def test_membership_epochs_ride_the_heartbeat(self, tmp_path):
+        """Chunked membership runs stream their membership epoch per
+        chunk and the run-start header lists the lane — model-agnostic
+        (echo nodes park and cold-boot through the default hooks)."""
+        plan = {"phases": [{"until": 100},
+                           {"until": 160, "remove": [1]},
+                           {"until": 220, "add": [1]}]}
+        opts = dict(node_count=2, concurrency=2, n_instances=8,
+                    record_instances=2, time_limit=0.3, rate=100.0,
+                    latency=5.0, recovery_time=0.05, seed=3,
+                    fault_plan=plan, funnel=False,
+                    store_root=str(tmp_path), pipeline="on",
+                    chunk_ticks=50)
+        run_tpu_test(get_model("echo", 2), opts)
+        from maelstrom_tpu.telemetry.stream import read_heartbeat
+        run_dir = os.path.realpath(
+            os.path.join(str(tmp_path), "echo-tpu", "latest"))
+        hb = read_heartbeat(run_dir)
+        assert "membership" in hb["header"]["faults"]["lanes"]
+        epochs = [rec["fault"].get("membership")
+                  for rec in hb["chunks"] if rec.get("fault")]
+        removed = [m for m in epochs if m and m.get("removed") == [1]]
+        assert removed, epochs
+        joined = [m for m in epochs if m and m.get("joined") == [1]]
+        assert joined, epochs
+
+    def test_triage_repro_opts_carry_the_plan(self):
+        """fault_plan is a repro opt: heartbeat_meta's opts block (what
+        triage/campaign-resume rebuild from) round-trips the membership
+        plan verbatim."""
+        from maelstrom_tpu.tpu.harness import heartbeat_meta
+        model = get_model("lin-kv", 3)
+        sim = make_sim_config(model, dict(SQ_OPTS))
+        meta = heartbeat_meta(model, sim, dict(SQ_OPTS))
+        assert meta["opts"]["fault_plan"] == SQ_PLAN
+        assert "membership" in meta["faults"]["lanes"]
+
+
+# --- ddmin shrinker upgrade ------------------------------------------------
+
+
+def _planted_replay(needed_phase_crash):
+    """Synthetic replay predicate: the plan still 'fails' iff SOME
+    phase still crashes the planted victim set."""
+    def replay(plan):
+        if not plan:
+            return False
+        return any(sorted(ph.get("crash") or []) ==
+                   sorted(needed_phase_crash)
+                   for ph in plan.get("phases", ()))
+    return replay
+
+
+def _wide_plan(n_phases=8, victim=2):
+    """n fault phases, only one of which (index 5) carries the
+    trigger."""
+    phases = []
+    t = 0
+    for i in range(n_phases):
+        t += 50
+        phases.append({"until": t,
+                       "crash": [victim] if i == 5 else [0]})
+    return {"phases": phases}
+
+
+class TestDdminShrink:
+    def test_ddmin_beats_greedy_on_multi_phase_schedule(self):
+        """The satellite's convergence bar: on a >= 4-phase planted
+        schedule (8 phases, one trigger) the complement-halving rounds
+        reach the same-or-smaller minimum in strictly fewer verified
+        replays than the greedy-only pass."""
+        from maelstrom_tpu.faults.fuzz import plan_weight
+        from maelstrom_tpu.faults.shrink import shrink_plan
+        plan = _wide_plan()
+        res_dd = shrink_plan(plan, _planted_replay([2]),
+                             max_attempts=64, ddmin=True)
+        res_gr = shrink_plan(plan, _planted_replay([2]),
+                             max_attempts=64, ddmin=False)
+        assert plan_weight(res_dd["plan"]) <= plan_weight(res_gr["plan"])
+        assert plan_weight(res_dd["plan"]) == (1, 1)
+        assert res_dd["attempts"] < res_gr["attempts"], \
+            (res_dd["attempts"], res_gr["attempts"])
+        assert any(k.startswith("ddmin-drop-phases-")
+                   for k in res_dd["kept"])
+
+    def test_every_kept_reduction_was_verified(self):
+        """The ddmin pass replays every candidate it keeps: the replay
+        log length equals the attempt count, and each kept reduction
+        corresponds to a replay that returned True."""
+        from maelstrom_tpu.faults.shrink import shrink_plan
+        calls = []
+        inner = _planted_replay([2])
+
+        def logging_replay(plan):
+            ok = inner(plan)
+            calls.append(ok)
+            return ok
+
+        res = shrink_plan(_wide_plan(), logging_replay,
+                          max_attempts=64)
+        assert len(calls) == res["attempts"]
+        assert sum(calls) == len(res["kept"])
+
+    def test_membership_candidates_drop_removals_not_heals(self):
+        """The greedy pass targets membership REMOVALS (and absolute
+        members keys) but never rejoin 'add' events — dropping a heal
+        would enlarge the fault."""
+        from maelstrom_tpu.faults.shrink import _candidates
+        plan = {"phases": [{"until": 50, "remove": [1, 2]},
+                           {"until": 100, "add": [1, 2]}]}
+        labels = [label for label, _ in _candidates(plan)]
+        assert "phase-0-drop-remove-1" in labels
+        assert "phase-0-drop-remove-2" in labels
+        assert not any("add" in lb for lb in labels)
+        # drop-phase keeps the heal
+        for label, cand in _candidates(plan):
+            if label == "drop-phase-0":
+                assert "remove" not in cand["phases"][0]
+        # and the heal phase itself is never a drop target
+        assert "drop-phase-1" not in labels
+
+    def test_members_restore_is_heal_not_fault(self):
+        """A ``members`` key that RESTORES (or merely restates) the
+        previous phase's set is HEAL content, like rejoin 'add'
+        events: the shrinker never offers it as a drop candidate
+        (membership INHERITS, so dropping a restore would EXTEND the
+        outage for the rest of the run), drop-phase and the ddmin
+        complement drops keep it, and plan_weight does not count
+        it."""
+        from maelstrom_tpu.faults.fuzz import plan_weight
+        from maelstrom_tpu.faults.shrink import (_candidates,
+                                                 _drop_phase_set)
+        from maelstrom_tpu.faults.spec import membership_heal_phases
+        plan = {"phases": [{"until": 50, "members": [0]},
+                           {"until": 100, "members": [0, 1, 2],
+                            "crash": [1]}]}
+        assert membership_heal_phases(plan, 3) == {1}
+        labels = [label for label, _ in _candidates(plan, n_nodes=3)]
+        assert "phase-0-drop-members" in labels      # the removal
+        assert "phase-1-drop-members" not in labels  # the restore
+        for label, cand in _candidates(plan, n_nodes=3):
+            if label == "drop-phase-1":
+                assert cand["phases"][1]["members"] == [0, 1, 2]
+        stripped = _drop_phase_set(plan, [0, 1],
+                                   membership_heal_phases(plan, 3))
+        assert "members" not in stripped["phases"][0]
+        assert stripped["phases"][1]["members"] == [0, 1, 2]
+        # the minimality metric: the restore weighs nothing
+        assert plan_weight(plan, 3) == (2, 2)
+        assert plan_weight(SQ_PLAN, 3) == (2, 9)   # not (2, 10)
+
+    @pytest.mark.slow
+    def test_shrinks_the_deterministic_single_quorum_plan(self):
+        """shrink generalized to deterministic ``--fault-plan`` runs
+        (the membership smoke's path — tools/lint_gate.sh runs the
+        same loop end-to-end through the CLI): the hand-built
+        remove-majority plan is over-specified — 8 link-edge entries
+        where fewer suffice — and shrink_instance minimizes it to a
+        verified still-failing plan that keeps the membership
+        change. Slow: each candidate replay recompiles the tick."""
+        from maelstrom_tpu.faults.shrink import shrink_instance
+        model = get_model("lin-kv-bug-single-quorum-reconfig", 3)
+        opts = dict(SQ_OPTS, funnel=False, n_instances=16)
+        sim = make_sim_config(model, dict(opts))
+        carry, _ = run_sim(model, sim, 7, model.make_params(3))
+        viol = np.nonzero(np.asarray(carry.violations))[0]
+        assert viol.size > 0
+        rec = shrink_instance(model, dict(opts), int(viol[0]),
+                              max_attempts=8)
+        assert rec["verified"]
+        assert rec["reduced"], rec
+        assert (rec["shrunk-phases"], rec["shrunk-victims"]) \
+            < (rec["original-phases"], rec["original-victims"])
+        # the minimal plan still reconfigures (the trigger is the
+        # membership change, not the decoration around it)
+        assert any(ph.get("members") is not None
+                   or ph.get("remove") or ph.get("add")
+                   for ph in rec["shrunk-plan"]["phases"])
+        assert json.dumps(rec["shrunk-plan"])   # JSON-serializable
+        validate_fault_plan(rec["shrunk-plan"], 3)

@@ -143,9 +143,10 @@ import json
 base = json.load(open("maelstrom_tpu/analysis/cost_baseline.json"))
 raft = [k for k in base["entries"]
         if k.split("/")[0].startswith(("lin-kv", "txn-"))]
-# 12 raft-family models (incl. the fault-engine mutants
-# forget-snapshot + fixed-timeout) x lead/minor
-assert len(raft) == 24, f"expected 24 raft-family entries, got {len(raft)}"
+# 14 raft-family models (incl. the fault-engine mutants
+# forget-snapshot + fixed-timeout and the membership-lane mutants
+# single-quorum-reconfig + votes-before-catchup) x lead/minor
+assert len(raft) == 28, f"expected 28 raft-family entries, got {len(raft)}"
 bad = [k for k in raft if base["entries"][k]["fusion-breakers"] != 0]
 assert not bad, f"raft-family entries with nonzero loop budget: {bad}"
 print(f"{len(raft)} raft-family entries, all fusion-breakers=0")
@@ -346,6 +347,73 @@ print(f"fuzz smoke: instance {rec['instance']} shrank "
       f"{rec['shrunk-phases']}p/{rec['shrunk-victims']}v in "
       f"{rec['attempts']} replays (still failing)")
 PY
+
+echo
+echo "== membership smoke (joint-consensus reconfiguration -> single-quorum bug -> triage + shrink)"
+# the membership lane's anomaly proof end-to-end: the remove-majority-
+# then-partition plan makes the single-quorum-reconfig mutant's
+# joint-phase leader commit the config change (and client writes) with
+# the new minority alone while the restored old majority commits a
+# different history — committed-prefix trips, --fail-fast stops, the
+# run exits 1, triage bundles a flagged instance, and `maelstrom
+# shrink` (generalized to deterministic plan runs) minimizes the
+# over-specified plan to a verified still-failing reconfiguration.
+# Correct joint-consensus Raft under the SAME plan must exit 0.
+cat > "$SMOKE_STORE/membership_plan.json" <<'JSON'
+{"phases": [
+  {"until": 220},
+  {"until": 400, "members": [0], "links": [
+     {"dst": 0, "src": 1, "block": true},
+     {"dst": 1, "src": 0, "block": true},
+     {"dst": 0, "src": 2, "block": true},
+     {"dst": 2, "src": 0, "block": true}]},
+  {"until": 640, "members": [0, 1, 2], "links": [
+     {"dst": 0, "src": 1, "block": true},
+     {"dst": 1, "src": 0, "block": true},
+     {"dst": 0, "src": 2, "block": true},
+     {"dst": 2, "src": 0, "block": true}]}]}
+JSON
+rc=0
+python -m maelstrom_tpu test --runtime tpu -w lin-kv-bug-single-quorum-reconfig \
+    --node-count 3 --concurrency 4 --rate 300 --time-limit 0.7 \
+    --n-instances 16 --record-instances 4 --rpc-timeout 0.08 \
+    --recovery-time 0.05 --fault-plan "$SMOKE_STORE/membership_plan.json" \
+    --pipeline on --chunk-ticks 100 --seed 7 --fail-fast \
+    --store "$SMOKE_STORE" > "$SMOKE_STORE/membership-smoke.json" || rc=$?
+[[ "$rc" == "1" ]] || { echo "expected exit 1 (single-quorum reconfig caught), got $rc"; exit 1; }
+grep -q '"fail-fast"' "$SMOKE_STORE/membership-smoke.json"
+MEMBER_RUN="$SMOKE_STORE"/lin-kv-bug-single-quorum-reconfig-tpu/latest
+grep -q '"membership"' "$MEMBER_RUN"/heartbeat.jsonl  # epochs streamed
+python -m maelstrom_tpu triage "$MEMBER_RUN" --max-instances 1
+ls "$MEMBER_RUN"/triage/instance-*/repro.json
+python -m maelstrom_tpu shrink "$MEMBER_RUN" --max-instances 1 \
+    --max-attempts 8
+ls "$MEMBER_RUN"/triage/instance-*/shrunk-plan.json
+python - "$MEMBER_RUN" <<'PY'
+import glob, json, sys
+rec = json.load(open(glob.glob(sys.argv[1]
+                               + "/triage/instance-*/shrink.json")[0]))
+assert rec["verified"], rec
+assert (rec["shrunk-phases"], rec["shrunk-victims"]) \
+    < (rec["original-phases"], rec["original-victims"]), rec
+plan = json.load(open(rec["shrunk-plan-file"]))
+assert any("members" in ph or "remove" in ph or "add" in ph
+           for ph in plan["phases"]), plan   # still reconfigures
+print(f"membership smoke: shrank "
+      f"{rec['original-phases']}p/{rec['original-victims']}v -> "
+      f"{rec['shrunk-phases']}p/{rec['shrunk-victims']}v in "
+      f"{rec['attempts']} replays (still failing, still a "
+      f"membership change)")
+PY
+rc=0
+python -m maelstrom_tpu test --runtime tpu -w lin-kv \
+    --node-count 3 --concurrency 4 --rate 300 --time-limit 0.7 \
+    --n-instances 16 --record-instances 4 --rpc-timeout 0.08 \
+    --recovery-time 0.05 --fault-plan "$SMOKE_STORE/membership_plan.json" \
+    --pipeline on --chunk-ticks 100 --seed 7 \
+    --store "$SMOKE_STORE" > "$SMOKE_STORE/membership-ok.json" || rc=$?
+[[ "$rc" == "0" ]] || { echo "correct Raft must survive the membership plan, got $rc"; exit 1; }
+echo "membership smoke: correct joint-consensus Raft valid under the same plan"
 
 echo
 echo "== campaign smoke (submit -> SIGKILL mid-run -> resume -> oracle)"
