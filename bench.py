@@ -348,6 +348,28 @@ def child_main(canary: bool = False) -> None:
             except Exception as e:
                 log(TAG, f"phase[{cfg_name}]: tick_range_stats "
                          f"unavailable: {e!r}")
+
+        # sharded-communication cost of this config's production chunk
+        # step (analysis/shard_audit.py — the figures `maelstrom lint
+        # --shard` gates): tick-hot-loop collective count and the
+        # estimated ICI bytes one shard moves per tick on an 8-chip
+        # mesh. Static (one abstract-mesh trace, no devices);
+        # BENCH_SHARD=0 skips.
+        collectives_per_tick = ici_bytes_est = None
+        if os.environ.get("BENCH_SHARD") != "0":
+            try:
+                from maelstrom_tpu.analysis.cost_model import (
+                    tick_shard_stats)
+                _ss = tick_shard_stats(model, sim)
+                collectives_per_tick = _ss["collectives_per_tick"]
+                ici_bytes_est = _ss["ici_bytes_est"]
+                log(TAG, f"phase[{cfg_name}]: shard comms — "
+                         f"{collectives_per_tick} tick collective(s), "
+                         f"~{ici_bytes_est / 1e3:.1f} kB/tick ICI at "
+                         f"8 shards")
+            except Exception as e:
+                log(TAG, f"phase[{cfg_name}]: tick_shard_stats "
+                         f"unavailable: {e!r}")
         log(TAG, f"phase[{cfg_name}]: sim built — {cfg_n_instances} x "
                  f"{sim.net.n_nodes} nodes, {sim.n_ticks} ticks, "
                  f"{bytes_per_instance} B/instance "
@@ -525,6 +547,9 @@ def child_main(canary: bool = False) -> None:
                 rec["lanes_dead_bytes"] = lanes_dead_bytes
             if ovf_margin_bits is not None:
                 rec["ovf_margin_bits"] = ovf_margin_bits
+            if collectives_per_tick is not None:
+                rec["collectives_per_tick"] = collectives_per_tick
+                rec["ici_bytes_est"] = ici_bytes_est
             if bench_pipeline:
                 rec["pipeline"] = True
                 rec["heartbeat"] = bench_heartbeat

@@ -288,6 +288,23 @@ def tick_lane_stats(model, sim, traced=None,
     return lane_stats(model, sim, traced=traced, cost=cost)
 
 
+def tick_shard_stats(model, sim, mesh_size: int = 8,
+                     cache=None) -> Dict[str, int]:
+    """Sharded-communication stats of ``model``'s production chunk
+    step under ``sim`` — ``collectives_per_tick`` (collective count in
+    the scanned tick hot loop) and ``ici_bytes_est`` (estimated
+    inter-chip bytes one shard moves per tick at ``mesh_size`` shards,
+    ring-collective formulas), the figures bench.py prints next to the
+    static-cost fields. Thin delegation so cost consumers need only
+    this module; the analysis itself lives in :mod:`.shard_audit`.
+    ``cache`` is the shared bench/lint trace cache — the sharded
+    census rides it under a ``shard:``-prefixed key (this traces the
+    SHARDED dispatch under an abstract mesh, so the plain
+    :func:`trace_tick` entries cannot serve it)."""
+    from .shard_audit import shard_stats
+    return shard_stats(model, sim, mesh_size=mesh_size, cache=cache)
+
+
 # --- post-compile cost: the thunk count -------------------------------------
 #
 # ``eqns`` measures the tick BEFORE XLA fusion — a deterministic,

@@ -18,11 +18,12 @@ from .findings import (Baseline, DEFAULT_BASELINE, Finding, LintReport,
 ALL_PASSES = ("trace", "contract", "schema")
 
 # opt-in passes: the IR hazard audit, the cost gate, the lane-liveness
-# slice, and the value-range abstract interpreter trace (and, for
-# JXP403, compile) every registered model — tens of seconds to minutes,
-# so they run only when named (`--ir` / `--cost` / `--lanes` /
-# `--ranges` / `--pass ir`), never as part of the default sweep
-EXTRA_PASSES = ("ir", "cost", "lanes", "ranges")
+# slice, the value-range abstract interpreter, and the SPMD shard
+# auditor trace (and, for JXP403/SHD804, compile) every registered
+# model — tens of seconds to minutes, so they run only when named
+# (`--ir` / `--cost` / `--lanes` / `--ranges` / `--shard` /
+# `--pass ir`), never as part of the default sweep
+EXTRA_PASSES = ("ir", "cost", "lanes", "ranges", "shard")
 
 
 def run_lint(repo_root: str = ".",
@@ -36,6 +37,8 @@ def run_lint(repo_root: str = ".",
              range_manifest_path: Optional[str] = None,
              update_range_manifest: bool = False,
              ranges_horizon_log2: Optional[int] = None,
+             shard_manifest_path: Optional[str] = None,
+             update_shard_manifest: bool = False,
              ) -> LintReport:
     """Run the requested passes and fold in the baseline.
 
@@ -51,7 +54,9 @@ def run_lint(repo_root: str = ".",
     (analysis/lane_manifest.json); ``range_manifest_path`` /
     ``update_range_manifest`` / ``ranges_horizon_log2`` the ranges
     pass (analysis/range_manifest.json; the horizon override is the
-    lint_gate canary's synthetic overflow budget).
+    lint_gate canary's synthetic overflow budget);
+    ``shard_manifest_path`` / ``update_shard_manifest`` the shard pass
+    (analysis/shard_manifest.json).
     """
     repo_root = os.path.abspath(repo_root)
     findings: List[Finding] = []
@@ -102,6 +107,13 @@ def run_lint(repo_root: str = ".",
             update_manifest=update_range_manifest,
             trace_cache=trace_cache,
             probe_log2=ranges_horizon_log2))
+    if "shard" in effective:
+        from .shard_audit import run_shard_lint
+        findings.extend(run_shard_lint(
+            repo_root,
+            manifest_path=shard_manifest_path,
+            update_manifest=update_shard_manifest,
+            trace_cache=trace_cache))
 
     baseline = (Baseline.load(baseline_path) if baseline_path
                 else Baseline())

@@ -1,0 +1,883 @@
+"""SPMD partition auditor: ``maelstrom lint --shard`` (pass 8).
+
+The seven existing passes audit the *single-chip* tick exhaustively,
+but none of them ever lowers the SHARDED path with real shardings — a
+shard-unsafe refactor (an accidental cross-shard gather, a silently
+replicated per-instance leaf, a new collective in the hot loop) would
+sail through ``maelstrom lint --strict`` and only surface in a rare
+healthy-TPU window. This pass closes that hole statically, no TPU (and
+no devices at all) required:
+
+- For every registered model x BOTH carry layouts it AOT-lowers the
+  ACTUAL sharded production step — ``parallel/mesh.py::
+  make_sharded_chunk_fn``, the same executable the donation audit
+  compiles (PR-5 precedent) — under an **abstract mesh**
+  (``jax.sharding.AbstractMesh``: carries axis names and sizes, binds
+  no devices, and ``shard_map``/``jit.lower`` trace under it on this
+  toolchain) and takes a **collective census** of the partitioned
+  jaxpr: per-collective counts and payload bytes, split into the tick
+  hot loop (inside the scanned tick body, scan-trip-weighted like the
+  PR-5 cost model) vs per-dispatch plumbing.
+- The census is converted into an **ICI-bytes-per-tick estimate** per
+  mesh size in {1, 2, 4, 8} (ring-algorithm formulas, documented at
+  :func:`ici_bytes_of`) and pinned in the checked-in
+  ``analysis/shard_manifest.json`` — drift beyond the tolerance fails
+  the gate, with the ``toolchain_note`` downgrade when the manifest
+  was recorded under a different jax version.
+- The pass is **load-bearing for cross-mesh resume**: per model x
+  layout it derives the wire carry's per-leaf reshard kinds
+  (``mesh.wire_leaf_kinds`` — the metadata ``campaign/checkpoint.py``
+  records into ``state.npz``) and statically drives
+  ``checkpoint.reshard_carry`` 4 -> 2 -> 4 and 4 -> 1 on zero-filled
+  templates, proving every leaf of a checkpoint written at S shards
+  re-chunks onto S' shards before any real campaign depends on it.
+
+Census mechanics: the partitioned jaxpr of one chunk dispatch is
+mesh-size-INVARIANT in collective structure (the shard body sees the
+same per-shard shapes at any size; only axis-size constants and the
+boundary sharding change), which this pass verifies once per run by
+tracing the donation subject at two sizes and diffing the censuses.
+Each model is therefore traced ONCE (at :data:`CENSUS_TRACE_SIZE`) and
+the per-size manifest entries are derived analytically — and the plain
+tick trace is taken from the shared ``trace_cache``, so the combined
+``lint --ir --cost --lanes --shard`` gate still traces each model x
+layout exactly once.
+
+Rules (SHD8xx):
+
+=======  ==========================  ========  =========================
+rule     name                        severity  what it flags
+=======  ==========================  ========  =========================
+SHD800   shard-audit-failure         error     the sharded step failed
+                                               to lower/trace at all
+SHD801   tick-hot-loop-collective    error     a reduction collective
+                                               (psum/pmax/pmin) inside
+                                               the scanned tick body
+                                               beyond the model's
+                                               pinned budget — ICI
+                                               traffic per tick where
+                                               shards must be
+                                               independent
+SHD802   replicated-per-instance-    error     a params leaf crossing
+         leaf                                  the shard_map boundary
+                                               replicated (``P()``)
+                                               whose leading dim is the
+                                               per-shard instance count
+                                               and size clears the
+                                               floor — O(chips) memory
+                                               for per-instance state
+SHD803   cross-shard-dependence      error     a data-moving collective
+                                               (all_gather / ppermute /
+                                               all_to_all / psum_
+                                               scatter) in the tick hot
+                                               loop — a cross-shard
+                                               data dependence on the
+                                               instance-sharded axis,
+                                               the correctness killer
+SHD804   donation-lost-under-        error     the partitioned
+         sharding                              executable (compiled on
+                                               a real host-device mesh
+                                               when enough devices are
+                                               visible) dropped
+                                               input_output_alias on
+                                               wire-carry leaves
+SHD805   shard-manifest-missing      error     a model x layout x size
+                                               has no manifest entry
+SHD806   shard-manifest-stale        warning   a manifest entry matches
+                                               no registered
+                                               model x layout x size
+SHD807   shard-manifest-drift        error     collective census or
+                                               ICI-bytes estimate
+                                               drifted from the
+                                               manifest (warning + a
+                                               re-record hint under
+                                               jax-version skew)
+SHD808   shard-manifest-updated      info      ``--update-shard-
+                                               manifest`` rewrote the
+                                               manifest
+SHD809   carry-not-reshardable       error     a wire-carry leaf cannot
+                                               be re-chunked across
+                                               shard counts (kind
+                                               metadata missing or
+                                               ``reshard_carry`` fails
+                                               statically) — the
+                                               checkpoint would be
+                                               pinned to its shard
+                                               count
+=======  ==========================  ========  =========================
+
+The shard-hazard fixtures (``models/ir_hazards.py``:
+``SHARD_FIXTURE_MODELS``) are audited alongside the registered models
+on full runs; their findings are carried as status="expected" in
+``analysis/baseline.json`` and asserted by
+``tests/test_analysis_shard.py`` — the planted-bug convention.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import warnings
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+from . import cost_model
+from .findings import Finding, SEV_ERROR, SEV_INFO, SEV_WARNING
+
+PASS_NAME = "shard"
+
+DEFAULT_SHARD_MANIFEST = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "shard_manifest.json")
+
+# the audited mesh sizes: 1 (the degenerate single-chip case must stay
+# collective-free on ICI), 2/4 (host-device test meshes), 8 (one ring)
+MESH_SIZES = (1, 2, 4, 8)
+
+# the census is mesh-size-invariant (verified per run by
+# _verify_size_invariance), so each model traces once at this size
+CENSUS_TRACE_SIZE = 2
+
+# chunk length the census subject is traced at — matches the donation
+# audit so the two passes exercise the same specialization
+CENSUS_CHUNK_LEN = 4
+
+# manifest drift tolerance on the ICI-bytes estimate (collective
+# COUNTS compare exactly — a count change is never noise)
+DEFAULT_TOLERANCE = 0.10
+
+# SHD802 floor: a replicated params leaf smaller than this is not worth
+# flagging even when its leading dim happens to equal the per-shard
+# instance count (tiny per-node tables can collide with n_instances)
+SHD802_FLOOR_BYTES = 16 << 10            # 16 KiB
+
+# collective vocabulary, split by what the rule means: reductions merge
+# values (legitimate at dispatch boundaries, budgeted in the tick);
+# data movers redistribute state across shards (never legitimate in the
+# tick hot loop — instances are independent by construction)
+REDUCTION_COLLECTIVES = ("pmax", "pmin", "psum")
+DATA_COLLECTIVES = ("all_gather", "all_to_all", "pgather", "ppermute",
+                    "psum_scatter", "reduce_scatter")
+ALL_COLLECTIVES = REDUCTION_COLLECTIVES + DATA_COLLECTIVES
+
+# per-model tick-hot-loop reduction budgets (SHD801), keyed by workload
+# family prefix. The vectorized raft family merges heartbeats through
+# detached per-shard snapshots (mesh.py's svec/scan outputs, gathered
+# at the shard_map boundary) rather than in-loop psums, so its pinned
+# set is EMPTY — any reduction collective appearing in a raft tick is
+# new ICI traffic, not the known heartbeat merge.
+TICK_COLLECTIVE_BUDGETS: Dict[str, Dict[str, int]] = {
+    "raft": {},
+}
+
+_MESH_PATH = "maelstrom_tpu/parallel/mesh.py"
+_MANIFEST_REPO_PATH = "maelstrom_tpu/analysis/shard_manifest.json"
+
+
+def _model_path(model) -> str:
+    return type(model).__module__.replace(".", os.sep) + ".py"
+
+
+def _finding(rule, name, severity, path, symbol, message) -> Finding:
+    return Finding(rule=rule, name=name, severity=severity,
+                   pass_name=PASS_NAME, path=path, line=0,
+                   symbol=symbol, message=message)
+
+
+def _abstract_mesh(size: int):
+    """A device-free 1-D mesh of ``size`` shards over the instance
+    axis — traceable on any host, TPU or not."""
+    from jax.sharding import AbstractMesh
+    from ..parallel import mesh as mesh_mod
+    return AbstractMesh(((mesh_mod.AXIS, int(size)),))
+
+
+# --- collective census ------------------------------------------------------
+
+
+def census_of_jaxpr(closed) -> Dict[str, Dict[str, Dict[str, int]]]:
+    """Walk one traced sharded step into ``{"tick": {...},
+    "dispatch": {...}}`` — per-collective ``{"count", "bytes"}``, where
+    ``tick`` holds collectives inside the scanned tick body on a
+    per-tick basis (nested scans below the tick multiply by their trip
+    counts) and ``dispatch`` everything outside any scan (once per
+    chunk dispatch). ``bytes`` is the collective's per-shard operand
+    payload."""
+    tick: Dict[str, Dict[str, int]] = {}
+    dispatch: Dict[str, Dict[str, int]] = {}
+
+    def record(bucket, name, payload, mult):
+        e = bucket.setdefault(name, {"count": 0, "bytes": 0})
+        e["count"] += mult
+        e["bytes"] += payload * mult
+
+    def subs(eqn):
+        out = []
+        for v in eqn.params.values():
+            for sub in (v if isinstance(v, (list, tuple)) else (v,)):
+                inner = getattr(sub, "jaxpr", sub)
+                if hasattr(inner, "eqns"):
+                    out.append(inner)
+        return out
+
+    def walk(jaxpr, in_tick: bool, mult: int) -> None:
+        for eqn in jaxpr.eqns:
+            name = eqn.primitive.name
+            if name in ALL_COLLECTIVES:
+                payload = sum(cost_model._aval_bytes(v)
+                              for v in eqn.invars)
+                record(tick if in_tick else dispatch, name, payload,
+                       mult)
+            if name == "scan":
+                length = int(eqn.params.get("length", 1))
+                for inner in subs(eqn):
+                    # entering the outermost scan switches to the
+                    # per-tick basis (mult 1); scans nested below the
+                    # tick weight by their trip count
+                    walk(inner, True, mult * length if in_tick else 1)
+            else:
+                for inner in subs(eqn):
+                    walk(inner, in_tick, mult)
+
+    walk(closed.jaxpr, False, 1)
+    return {"tick": tick, "dispatch": dispatch}
+
+
+def ici_bytes_of(prim: str, payload: int, size: int) -> int:
+    """Estimated inter-chip bytes ONE shard moves for one collective of
+    per-shard operand payload ``payload`` on a ``size``-shard ring —
+    the standard ring-algorithm figures, deterministic by construction:
+
+    - all-reduce (psum/pmax/pmin): ``2 * b * (S-1) / S`` (reduce-
+      scatter + all-gather phases);
+    - all-gather: ``b * (S-1)`` (the shard receives every other
+      shard's block);
+    - reduce-scatter (psum_scatter): ``b * (S-1) / S``;
+    - all-to-all: ``b * (S-1) / S`` (keeps 1/S locally);
+    - ppermute: ``b`` (one neighbor hop).
+
+    Size 1 moves nothing across ICI regardless of primitive."""
+    if size <= 1:
+        return 0
+    s = int(size)
+    if prim in REDUCTION_COLLECTIVES:
+        return int(2 * payload * (s - 1) / s)
+    if prim in ("all_gather", "pgather"):
+        return int(payload * (s - 1))
+    if prim in ("psum_scatter", "reduce_scatter", "all_to_all"):
+        return int(payload * (s - 1) / s)
+    return int(payload)                  # ppermute and conservatively
+                                         # anything unrecognized
+
+
+def _ici_total(bucket: Dict[str, Dict[str, int]], size: int) -> int:
+    return sum(ici_bytes_of(p, e["bytes"], size)
+               for p, e in bucket.items())
+
+
+def entry_of_census(census, size: int) -> Dict[str, Any]:
+    """One checked-in manifest entry for one model x layout x mesh
+    size. Counts and payload bytes come straight from the (size-
+    invariant) jaxpr census; the ICI estimates apply
+    :func:`ici_bytes_of` at this size."""
+    return {
+        "tick-collectives": {p: census["tick"][p]["count"]
+                             for p in sorted(census["tick"])},
+        "tick-collective-bytes": sum(e["bytes"] for e in
+                                     census["tick"].values()),
+        "dispatch-collectives": {p: census["dispatch"][p]["count"]
+                                 for p in sorted(census["dispatch"])},
+        "ici-bytes-per-tick": _ici_total(census["tick"], size),
+        "ici-bytes-per-dispatch": _ici_total(census["dispatch"], size),
+    }
+
+
+def size_key(workload: str, node_count: int, layout: str,
+             size: int) -> str:
+    return f"{cost_model.entry_key(workload, node_count, layout)}" \
+           f"/s={size}"
+
+
+# --- tracing the sharded subjects -------------------------------------------
+
+
+def trace_sharded_chunk(model, sim, size: int = CENSUS_TRACE_SIZE,
+                        params=None, length: int = CENSUS_CHUNK_LEN):
+    """``jax.make_jaxpr`` of the ACTUAL sharded production dispatch —
+    ``mesh.make_sharded_chunk_fn``'s jitted product — under an
+    abstract ``size``-shard mesh. Returns ``(closed_jaxpr,
+    wire_shapes)`` where ``wire_shapes`` is the gathered wire-carry
+    template the step donates."""
+    import jax
+    import jax.numpy as jnp
+    from ..parallel import mesh as mesh_mod
+
+    if params is None:
+        params = model.make_params(sim.net.n_nodes)
+    if params is None:
+        params = jnp.zeros((), jnp.int32)    # the _prepare convention
+    amesh = _abstract_mesh(size)
+    chunk_fn, _ = mesh_mod.make_sharded_chunk_fn(model, sim, amesh,
+                                                 params)
+    wire = mesh_mod.wire_template(model, sim, amesh)
+    wire_sds = jax.tree.map(
+        lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype), wire)
+    p_sds = jax.tree.map(
+        lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype), params)
+    t_sds = jax.ShapeDtypeStruct((), jnp.int32)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")      # donation under make_jaxpr
+        closed = jax.make_jaxpr(
+            lambda w, t, p: chunk_fn(w, t, p, length=length))(
+            wire_sds, t_sds, p_sds)
+    return closed, wire
+
+
+def trace_sharded_run(model, sim, size: int = CENSUS_TRACE_SIZE,
+                      params=None):
+    """``jax.make_jaxpr`` of the single-dispatch sharded runner body
+    (``mesh._run_sharded``) under an abstract mesh — the subject whose
+    dispatch-level census pins the fleet-stats merge set (one psum per
+    NetStats counter)."""
+    import jax
+    import jax.numpy as jnp
+    from ..parallel import mesh as mesh_mod
+
+    if params is None:
+        params = model.make_params(sim.net.n_nodes)
+    if params is None:
+        params = jnp.zeros((), jnp.int32)
+    amesh = _abstract_mesh(size)
+    p_sds = jax.tree.map(
+        lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype), params)
+    fn = getattr(mesh_mod._run_sharded, "__wrapped__",
+                 mesh_mod._run_sharded)
+    return jax.make_jaxpr(lambda s, p: fn(model, sim, amesh, s, p))(
+        jax.ShapeDtypeStruct((), jnp.int32), p_sds)
+
+
+# --- per-model findings -----------------------------------------------------
+
+
+def _tick_budget(workload: str) -> Dict[str, int]:
+    for prefix, budget in TICK_COLLECTIVE_BUDGETS.items():
+        if workload.startswith(prefix):
+            return budget
+    return {}
+
+
+def hot_loop_findings(model, census, label: str,
+                      workload: str) -> List[Finding]:
+    """SHD801 (budgeted reductions) + SHD803 (data movers) over one
+    tick census."""
+    path = _model_path(model)
+    cls = type(model).__name__
+    budget = _tick_budget(workload)
+    out: List[Finding] = []
+    for prim in sorted(census["tick"]):
+        count = census["tick"][prim]["count"]
+        payload = census["tick"][prim]["bytes"]
+        if prim in DATA_COLLECTIVES:
+            out.append(_finding(
+                "SHD803", "cross-shard-dependence", SEV_ERROR, path,
+                cls,
+                f"[{label}] {prim} x{count} ({payload} B/tick payload) "
+                f"in the tick hot loop — a cross-shard data dependence "
+                f"on the instance-sharded axis; shards must be "
+                f"independent by construction (instances are pure "
+                f"functions of (seed, global id)), so this either "
+                f"changes results with the mesh size or serializes the "
+                f"ring every tick"))
+        elif count > budget.get(prim, 0):
+            out.append(_finding(
+                "SHD801", "tick-hot-loop-collective", SEV_ERROR, path,
+                cls,
+                f"[{label}] {prim} x{count} ({payload} B/tick payload) "
+                f"in the tick hot loop exceeds the model's pinned "
+                f"budget of {budget.get(prim, 0)} — per-tick ICI "
+                f"latency on every chip; merge at the dispatch "
+                f"boundary (the detached-snapshot idiom in "
+                f"parallel/mesh.py) or pin the budget in "
+                f"analysis/shard_audit.py with a justification"))
+    return out
+
+
+def replicated_leaf_findings(model, sim, label: str) -> List[Finding]:
+    """SHD802: params cross the shard_map boundary replicated
+    (``in_specs=P()`` in every sharded executor); a replicated leaf
+    shaped like per-instance state wastes O(chips) memory."""
+    import jax
+
+    params = model.make_params(sim.net.n_nodes)
+    if params is None:
+        return []
+    path = _model_path(model)
+    cls = type(model).__name__
+    out: List[Finding] = []
+    for kp, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        shape = tuple(getattr(leaf, "shape", ()))
+        nbytes = int(getattr(leaf, "nbytes", 0) or 0)
+        if (len(shape) >= 1 and shape[0] == sim.n_instances
+                and nbytes >= SHD802_FLOOR_BYTES):
+            out.append(_finding(
+                "SHD802", "replicated-per-instance-leaf", SEV_ERROR,
+                path, cls,
+                f"[{label}] params leaf "
+                f"{jax.tree_util.keystr(kp) or '<root>'} "
+                f"{shape} ({nbytes} B) is replicated across the mesh "
+                f"(params ride the shard_map boundary as P()) but its "
+                f"leading dim equals the per-shard instance count "
+                f"({sim.n_instances}) — per-instance state belongs in "
+                f"the sharded carry, not replicated params: every chip "
+                f"holds all of it (O(chips) waste) and it silently "
+                f"stops scaling with the fleet"))
+    return out
+
+
+def reshard_findings(model, sim, label: str, params=None,
+                     from_shards: int = 4,
+                     to_shards: Sequence[int] = (2, 1)) -> List[Finding]:
+    """SHD809: statically drive ``checkpoint.reshard_carry`` over this
+    model's wire-carry template — kinds metadata from
+    ``mesh.wire_leaf_kinds`` (what ``state.npz`` records at save time),
+    zero-filled leaves at the gathered ``from_shards`` shapes,
+    re-chunked to each target and round-tripped back. Proves a
+    checkpoint written at S shards is not pinned to S before any
+    campaign depends on it."""
+    import jax
+    import numpy as np
+    from ..campaign import checkpoint as ckpt
+    from ..parallel import mesh as mesh_mod
+
+    path = _model_path(model)
+    cls = type(model).__name__
+
+    def fail(msg):
+        return [_finding("SHD809", "carry-not-reshardable", SEV_ERROR,
+                         path, cls, f"[{label}] {msg}")]
+
+    try:
+        kinds = mesh_mod.wire_leaf_kinds(model, sim, params)
+        wire = mesh_mod.wire_template(model, sim,
+                                      _abstract_mesh(from_shards))
+        leaves = [np.zeros(l.shape, l.dtype)
+                  for l in jax.tree.leaves(wire)]
+    except Exception as e:
+        return fail(f"wire template / leaf kinds failed to build: "
+                    f"{type(e).__name__}: {e}")
+    if len(kinds) != len(leaves):
+        return fail(f"wire_leaf_kinds records {len(kinds)} kinds but "
+                    f"the wire carry has {len(leaves)} leaves — "
+                    f"checkpoints written now cannot be resharded")
+    meta = {"n-shards": from_shards,
+            "instances-per-shard": int(sim.n_instances),
+            "interleaved": True, "leaf-kinds": list(kinds)}
+    for target in to_shards:
+        try:
+            new_leaves, new_meta = ckpt.reshard_carry(leaves, meta,
+                                                      target)
+            back, _ = ckpt.reshard_carry(new_leaves, new_meta,
+                                         from_shards)
+        except Exception as e:
+            return fail(f"reshard_carry {from_shards} -> {target} "
+                        f"raised {type(e).__name__}: {e}")
+        for i, (a, b) in enumerate(zip(leaves, back)):
+            if a.shape != b.shape or a.dtype != b.dtype:
+                return fail(
+                    f"leaf {i} ({kinds[i]}) did not round-trip "
+                    f"{from_shards} -> {target} -> {from_shards}: "
+                    f"{a.shape}/{a.dtype} became {b.shape}/{b.dtype}")
+    return []
+
+
+def _verify_size_invariance(model, sim, workload: str,
+                            sizes: Tuple[int, int]) -> List[Finding]:
+    """The analytic per-size manifest derivation is sound only if the
+    census really is mesh-size-invariant — verified here on the
+    donation subject by tracing at two sizes and diffing."""
+    a = census_of_jaxpr(trace_sharded_chunk(model, sim, sizes[0])[0])
+    b = census_of_jaxpr(trace_sharded_chunk(model, sim, sizes[1])[0])
+    if a == b:
+        return []
+    return [_finding(
+        "SHD800", "shard-audit-failure", SEV_ERROR, _MESH_PATH,
+        "make_sharded_chunk_fn",
+        f"[{workload}] collective census differs between mesh sizes "
+        f"{sizes[0]} and {sizes[1]} ({a} vs {b}) — the census is no "
+        f"longer size-invariant, so the per-size manifest entries "
+        f"derived from a single trace are unsound; shard_audit.py "
+        f"must trace every size explicitly")]
+
+
+# --- SHD804: the partitioned executable -------------------------------------
+
+
+def hlo_collective_census(compiled_text: str) -> Dict[str, int]:
+    """Collective-op census of optimized (partitioned) HLO text — the
+    post-SPMD ground truth next to the jaxpr census. XLA-version-
+    volatile (ops fold/elide per backend), so surfaced, never
+    manifested."""
+    counts: Dict[str, int] = {}
+    for op in ("all-reduce", "all-gather", "reduce-scatter",
+               "collective-permute", "all-to-all"):
+        n = compiled_text.count(f" {op}(")
+        if n:
+            counts[op] = n
+    return counts
+
+
+def compiled_shard_findings(mesh_sizes: Sequence[int] = MESH_SIZES,
+                            chunk_len: int = CENSUS_CHUNK_LEN,
+                            ) -> List[Finding]:
+    """SHD804 over every mesh size the visible devices can host:
+    compile the sharded chunk step on a REAL mesh and verify the wire
+    carry stayed fully aliased (``input_output_alias``) on the
+    partitioned executable — donation silently drops per-sharding, not
+    just per-shape, so the 1-device JXP403 audit cannot stand in for
+    this."""
+    import jax
+    import jax.numpy as jnp
+    from . import ir_lint
+    from ..models import get_model
+    from ..parallel import mesh as mesh_mod
+
+    wl, n = ir_lint.DONATION_WORKLOAD
+    model = get_model(wl, n, "grid")
+    sim = cost_model.audit_sim(model, n, "lead")
+    params = model.make_params(n)
+    if params is None:
+        params = jnp.zeros((), jnp.int32)
+    n_dev = len(jax.devices())
+    findings: List[Finding] = []
+    for size in mesh_sizes:
+        if size > n_dev:
+            continue
+        label = f"{wl}/n={n}/lead/s={size}"
+        try:
+            mesh = mesh_mod.make_mesh(size)
+            chunk_fn, _ = mesh_mod.make_sharded_chunk_fn(
+                model, sim, mesh, params)
+            wire = mesh_mod.wire_template(model, sim, mesh)
+            wire_sds = jax.tree.map(
+                lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype), wire)
+            p_sds = jax.tree.map(
+                lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype),
+                params)
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                compiled = chunk_fn.lower(
+                    wire_sds, jax.ShapeDtypeStruct((), jnp.int32),
+                    p_sds, length=chunk_len).compile()
+        except Exception as e:
+            findings.append(_finding(
+                "SHD804", "donation-lost-under-sharding", SEV_ERROR,
+                _MESH_PATH, "make_sharded_chunk_fn",
+                f"[{label}] compiling the partitioned chunk step "
+                f"raised {type(e).__name__}: {e}"))
+            continue
+        n_leaves = len(jax.tree.leaves(wire))
+        aliased = ir_lint.aliased_params_of(compiled.as_text())
+        missing = sorted(set(range(n_leaves)) - aliased)
+        if missing:
+            findings.append(_finding(
+                "SHD804", "donation-lost-under-sharding", SEV_ERROR,
+                _MESH_PATH, "make_sharded_chunk_fn",
+                f"[{label}] {len(missing)} of {n_leaves} wire-carry "
+                f"leaves lost input_output_alias on the PARTITIONED "
+                f"executable (flat param indices {missing[:8]}"
+                f"{'...' if len(missing) > 8 else ''}) — donation "
+                f"that holds on one device silently drops under "
+                f"sharding and doubles per-chip HBM"))
+    return findings
+
+
+# --- manifest io + drift gate -----------------------------------------------
+
+
+def load_shard_manifest(path: Optional[str] = None) -> Dict[str, Any]:
+    path = path or DEFAULT_SHARD_MANIFEST
+    if not os.path.exists(path):
+        return {"version": 1, "tolerance": DEFAULT_TOLERANCE,
+                "entries": {}}
+    with open(path) as f:
+        data = json.load(f)
+    data.setdefault("tolerance", DEFAULT_TOLERANCE)
+    data.setdefault("entries", {})
+    return data
+
+
+def save_shard_manifest(entries: Dict[str, Dict[str, Any]],
+                        path: Optional[str] = None,
+                        tolerance: float = DEFAULT_TOLERANCE) -> str:
+    import jax
+    path = path or DEFAULT_SHARD_MANIFEST
+    payload = {
+        "version": 1,
+        "_comment": (
+            "Per-model collective census + ICI cost manifest for "
+            "`maelstrom lint --shard` (doc/lint.md). Keys: <workload>/"
+            "n=<nodes>/<layout>/s=<mesh size> (plus run:* for the "
+            "single-dispatch runner subject); tick-collectives = "
+            "collective primitive counts inside the scanned tick body "
+            "of the sharded chunk step (scan-trip-weighted, per tick), "
+            "dispatch-collectives = per-dispatch plumbing outside the "
+            "scan, ici-bytes-per-tick = estimated inter-chip bytes one "
+            "shard moves per tick (ring-collective formulas, "
+            "shard_audit.ici_bytes_of). Counts compare exactly; byte "
+            "estimates drift within `tolerance`. Regenerate after an "
+            "INTENTIONAL sharding change with `maelstrom lint --shard "
+            "--update-shard-manifest`; drift fails the gate (SHD807). "
+            "jax-version records the tracing toolchain: under a "
+            "different jax the gate downgrades drift to a re-record "
+            "warning."),
+        "jax-version": jax.__version__,
+        "tolerance": tolerance,
+        "entries": {k: entries[k] for k in sorted(entries)},
+    }
+    with open(path, "w") as f:
+        json.dump(payload, f, indent=2)
+        f.write("\n")
+    return path
+
+
+def compare_manifest(live: Dict[str, Dict[str, Any]],
+                     manifest: Dict[str, Any],
+                     paths: Dict[str, Tuple[str, str]],
+                     full_universe: bool = True,
+                     errored: Set[str] = frozenset(),
+                     ) -> List[Finding]:
+    """SHD805/806/807 — diff live entries against the checked-in
+    manifest. Collective counts are the safety-relevant fact and
+    compare exactly; the ICI byte estimates tolerate ``tolerance``
+    relative drift."""
+    entries = manifest.get("entries", {})
+    tol = float(manifest.get("tolerance", DEFAULT_TOLERANCE))
+    note = cost_model.toolchain_note(manifest.get("jax-version"),
+                                     "the shard manifest",
+                                     "--update-shard-manifest")
+    findings: List[Finding] = []
+    for key in sorted(live):
+        ent = live[key]
+        path, symbol = paths[key]
+        base = entries.get(key)
+        if base is None:
+            findings.append(_finding(
+                "SHD805", "shard-manifest-missing", SEV_ERROR, path,
+                symbol,
+                f"[{key}] no shard-manifest entry — record one with "
+                f"`maelstrom lint --shard --update-shard-manifest`"))
+            continue
+        drifts = []
+        for field in ("tick-collectives", "dispatch-collectives"):
+            want = base.get(field)
+            if want is not None and want != ent[field]:
+                drifts.append(f"{field}: live {ent[field]} vs manifest "
+                              f"{want}")
+        for field in ("ici-bytes-per-tick", "ici-bytes-per-dispatch",
+                      "tick-collective-bytes"):
+            want = base.get(field)
+            got = ent[field]
+            if want is None:
+                continue
+            if abs(got - want) > max(abs(want), 1) * tol:
+                drifts.append(
+                    f"{field}: live {got} vs manifest {want} "
+                    f"({(got - want) / max(abs(want), 1) * 100:+.0f}%)")
+        if drifts:
+            findings.append(_finding(
+                "SHD807", "shard-manifest-drift",
+                SEV_WARNING if note else SEV_ERROR, path, symbol,
+                f"[{key}] collective census / ICI estimate drifted "
+                f"from the checked-in manifest: {'; '.join(drifts)} — "
+                f"the sharded step's communication pattern changed; if "
+                f"intentional, re-record with --update-shard-manifest "
+                f"and justify it in the PR"
+                + (f" ({note})" if note else "")))
+    if full_universe:
+        for key in sorted(set(entries) - set(live) - set(errored)):
+            findings.append(_finding(
+                "SHD806", "shard-manifest-stale", SEV_WARNING,
+                _MANIFEST_REPO_PATH, "",
+                f"[{key}] manifest entry matches no registered "
+                f"model x layout x mesh size — remove or re-record it"))
+    return findings
+
+
+# --- orchestration ----------------------------------------------------------
+
+
+def run_shard_lint(repo_root: str = ".",
+                   manifest_path: Optional[str] = None,
+                   update_manifest: bool = False,
+                   workloads: Optional[List[Tuple[str, int]]] = None,
+                   layouts: Sequence[str] = cost_model.AUDIT_LAYOUTS,
+                   mesh_sizes: Sequence[int] = MESH_SIZES,
+                   include_fixtures: bool = True,
+                   compiled: bool = True,
+                   trace_cache=None) -> List[Finding]:
+    """The shard pass: census + SHD8xx audit of every registered
+    model x layout (or a restricted list), manifest gate, fixture
+    sweep, reshardability proof, and — devices permitting — the
+    partitioned-executable donation check."""
+    from ..models import get_model
+
+    full = workloads is None
+    specs = cost_model.cost_specs() if full else list(workloads)
+    findings: List[Finding] = []
+    live: Dict[str, Dict[str, Any]] = {}
+    paths: Dict[str, Tuple[str, str]] = {}
+    errored: Set[str] = set()
+
+    for wl, n in specs:
+        try:
+            model = get_model(wl, n, "grid")
+        except Exception as e:
+            findings.append(_finding(
+                "SHD800", "shard-audit-failure", SEV_ERROR,
+                "maelstrom_tpu/models/__init__.py", "get_model",
+                f"get_model({wl!r}, {n}) raised: {e!r}"))
+            errored.update(size_key(wl, n, lay, s)
+                           for lay in layouts for s in mesh_sizes)
+            continue
+        for layout in layouts:
+            base_key = cost_model.entry_key(wl, n, layout)
+            label = base_key
+            sim = cost_model.audit_sim(model, n, layout)
+            # the plain tick trace rides the shared cache — the
+            # combined gate's single-trace-per-model pin (the sharded
+            # chunk trace below embeds the same tick, so no pass
+            # re-traces what another already paid for)
+            if trace_cache is not None:
+                try:
+                    cost_model.trace_tick(model, sim,
+                                          cache=trace_cache)
+                except Exception:
+                    pass
+            census = (trace_cache.get("shard:" + base_key)
+                      if trace_cache is not None else None)
+            if census is None:
+                try:
+                    closed, _wire = trace_sharded_chunk(model, sim)
+                except Exception as e:
+                    findings.append(_finding(
+                        "SHD800", "shard-audit-failure", SEV_ERROR,
+                        _model_path(model), type(model).__name__,
+                        f"[{label}] lowering the sharded chunk step "
+                        f"raised {type(e).__name__}: {e}"))
+                    errored.update(size_key(wl, n, layout, s)
+                                   for s in mesh_sizes)
+                    continue
+                census = census_of_jaxpr(closed)
+                if trace_cache is not None:
+                    trace_cache["shard:" + base_key] = census
+            findings.extend(hot_loop_findings(model, census, label,
+                                              wl))
+            findings.extend(replicated_leaf_findings(model, sim,
+                                                     label))
+            findings.extend(reshard_findings(model, sim, label))
+            for s in mesh_sizes:
+                key = size_key(wl, n, layout, s)
+                live[key] = entry_of_census(census, s)
+                paths[key] = (_model_path(model),
+                              type(model).__name__)
+
+    if full:
+        # the single-dispatch runner subject: its dispatch census pins
+        # the fleet-stats merge set (one psum per NetStats counter) —
+        # an extra collective sneaking into _run_sharded shows up here
+        # as manifest drift
+        from .ir_lint import DONATION_WORKLOAD
+        wl, n = DONATION_WORKLOAD
+        try:
+            model = get_model(wl, n, "grid")
+            sim = cost_model.audit_sim(model, n, "lead")
+            run_census = census_of_jaxpr(trace_sharded_run(model, sim))
+            findings.extend(hot_loop_findings(
+                model, run_census, f"run:{wl}/n={n}/lead", wl))
+            findings.extend(_verify_size_invariance(
+                model, sim, f"{wl}/n={n}", (CENSUS_TRACE_SIZE, 8)))
+            for s in mesh_sizes:
+                key = f"run:{size_key(wl, n, 'lead', s)}"
+                live[key] = entry_of_census(run_census, s)
+                paths[key] = (_MESH_PATH, "_run_sharded")
+        except Exception as e:
+            findings.append(_finding(
+                "SHD800", "shard-audit-failure", SEV_ERROR, _MESH_PATH,
+                "_run_sharded",
+                f"[run:{wl}/n={n}] lowering the sharded runner raised "
+                f"{type(e).__name__}: {e}"))
+
+    if full and include_fixtures:
+        from ..models.ir_hazards import SHARD_FIXTURE_MODELS
+        for kind, cls in sorted(SHARD_FIXTURE_MODELS.items()):
+            model = cls()
+            for layout in layouts:
+                label = f"fixture-{kind}/{layout}"
+                try:
+                    sim = cost_model.audit_sim(model, 2, layout)
+                    closed, _ = trace_sharded_chunk(model, sim)
+                except Exception as e:
+                    findings.append(_finding(
+                        "SHD800", "shard-audit-failure", SEV_ERROR,
+                        _model_path(model), type(model).__name__,
+                        f"[{label}] lowering the fixture chunk step "
+                        f"raised {type(e).__name__}: {e}"))
+                    continue
+                census = census_of_jaxpr(closed)
+                findings.extend(hot_loop_findings(model, census,
+                                                  label, kind))
+                findings.extend(replicated_leaf_findings(model, sim,
+                                                         label))
+
+    if full and compiled:
+        findings.extend(compiled_shard_findings(mesh_sizes))
+
+    if update_manifest:
+        path = save_shard_manifest(live, manifest_path)
+        findings.append(_finding(
+            "SHD808", "shard-manifest-updated", SEV_INFO,
+            os.path.relpath(path, os.path.abspath(repo_root))
+            if os.path.isabs(path) else path, "",
+            f"recorded {len(live)} shard-manifest entr"
+            f"{'y' if len(live) == 1 else 'ies'}"))
+    else:
+        manifest = load_shard_manifest(manifest_path)
+        findings.extend(compare_manifest(live, manifest, paths,
+                                         full_universe=full,
+                                         errored=errored))
+    return findings
+
+
+# --- bench surface ----------------------------------------------------------
+
+
+def shard_stats(model, sim, mesh_size: int = 8,
+                cache=None) -> Dict[str, int]:
+    """One-call sharded-cost stats for bench.py metric lines:
+    ``collectives_per_tick`` (tick-hot-loop collective count of the
+    sharded chunk step under ``sim``) and ``ici_bytes_est`` (the
+    per-tick ICI estimate at ``mesh_size`` shards). ``sim`` describes
+    the per-shard block, so the figures price the configuration the
+    bench measures. ``cache`` is the shared lint/bench trace cache —
+    the sharded census rides it under a ``shard:``-prefixed key (the
+    plain-tick entries cannot serve it: this traces the SHARDED
+    dispatch)."""
+    key = None
+    if cache is not None:
+        key = "shard:" + cost_model.entry_key(
+            getattr(model, "name", type(model).__name__),
+            sim.net.n_nodes, sim.layout)
+        census = cache.get(key)
+        if census is not None:
+            return {
+                "collectives_per_tick": sum(
+                    e["count"] for e in census["tick"].values()),
+                "ici_bytes_est": _ici_total(census["tick"], mesh_size),
+            }
+    closed, _ = trace_sharded_chunk(model, sim)
+    census = census_of_jaxpr(closed)
+    if key is not None:
+        cache[key] = census
+    return {
+        "collectives_per_tick": sum(e["count"]
+                                    for e in census["tick"].values()),
+        "ici_bytes_est": _ici_total(census["tick"], mesh_size),
+    }

@@ -543,7 +543,8 @@ def default_trace_targets(repo_root: str) -> List[str]:
             "maelstrom_tpu/faults/*.py",
             # host-side analysis code, but its verdicts gate traced
             # code — keep the analyzer itself lint-clean
-            "maelstrom_tpu/analysis/absint.py"]
+            "maelstrom_tpu/analysis/absint.py",
+            "maelstrom_tpu/analysis/shard_audit.py"]
     out = []
     for p in pats:
         out.extend(sorted(glob.glob(os.path.join(repo_root, p))))

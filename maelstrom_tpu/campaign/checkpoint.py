@@ -144,14 +144,142 @@ def load_checkpoint(run_dir: str) -> Optional[Dict[str, Any]]:
             "meta": header.get("meta", {}), "path": path}
 
 
-def restore_carry(template: Any, leaves: List[np.ndarray]) -> Any:
+# wire-carry leaf kinds (mirrors parallel/mesh.py's SHARD_LEAF_*; kept
+# as literals here so checkpoint metadata stays loadable without jax)
+_KIND_INSTANCE = "instance"
+_KIND_SUM = "sum"
+_KIND_KEY = "key"
+
+
+def reshard_carry(leaves: List[np.ndarray], shard: Dict[str, Any],
+                  n_shards: int) -> Tuple[List[np.ndarray],
+                                          Dict[str, Any]]:
+    """Re-chunk a sharded wire carry written at S shards onto
+    ``n_shards`` shards, leaf-wise, using the per-leaf kind metadata
+    the sharded executor recorded into ``state.npz`` at save time
+    (``parallel/mesh.wire_leaf_kinds``):
+
+    - ``"instance"`` leaves hold the global instance axis round-robin
+      interleaved shard-major; re-chunking is a pure permutation of the
+      leading axis (de-interleave at S, re-interleave at S') — no
+      instance's state changes, so the global-id RNG derivation keeps
+      every trajectory bit-identical;
+    - ``"sum"`` leaves are additive per-shard partial slots (NetStats,
+      the fleet telemetry series); old slots are folded into the new
+      ones round-robin — integer addition commutes (and wraps), so the
+      fleet totals every consumer reads are unchanged bit-for-bit;
+    - ``"key"`` is the replicated master RNG key: verified identical
+      across the old shards, then tiled to the new count.
+
+    Returns ``(new_leaves, new_shard_meta)``. The shard auditor
+    (``analysis/shard_audit.py`` SHD rules) statically verifies every
+    registered model's wire carry classifies cleanly into these kinds.
+    """
+    S = int(shard.get("n-shards", 0))
+    I = int(shard.get("instances-per-shard", 0))
+    kinds = list(shard.get("leaf-kinds", ()))
+    n_shards = int(n_shards)
+    total = S * I
+    if S <= 0 or I <= 0 or not kinds:
+        raise CheckpointError(
+            "checkpoint lacks per-leaf shard metadata (written before "
+            "reshardable checkpoints) — cannot reshard")
+    if n_shards <= 0 or total % n_shards:
+        raise CheckpointError(
+            f"cannot reshard {total} global instances "
+            f"({S} shards x {I}) onto {n_shards} shards — the global "
+            f"instance count must divide evenly")
+    if len(kinds) != len(leaves):
+        raise CheckpointError(
+            f"shard metadata covers {len(kinds)} leaves but the "
+            f"checkpoint has {len(leaves)}")
+    out: List[np.ndarray] = []
+    for i, (leaf, kind) in enumerate(zip(leaves, kinds)):
+        leaf = np.asarray(leaf)
+        rest = leaf.shape[1:]
+        if kind == _KIND_INSTANCE:
+            if leaf.shape[0] != total:
+                raise CheckpointError(
+                    f"carry leaf {i} ({kind}): leading axis "
+                    f"{leaf.shape[0]} != {total} global instances")
+            g = leaf.reshape((S, I) + rest).swapaxes(0, 1).reshape(
+                leaf.shape)                      # global-id order
+            i2 = total // n_shards
+            out.append(g.reshape((i2, n_shards) + rest)
+                       .swapaxes(0, 1).reshape(leaf.shape).copy())
+        elif kind == _KIND_SUM:
+            if leaf.shape[0] != S:
+                raise CheckpointError(
+                    f"carry leaf {i} ({kind}): leading axis "
+                    f"{leaf.shape[0]} != {S} shard slots")
+            new = np.zeros((n_shards,) + rest, leaf.dtype)
+            for s in range(S):
+                new[s % n_shards] = new[s % n_shards] + leaf[s]
+            out.append(new)
+        elif kind == _KIND_KEY:
+            if leaf.shape[0] != S:
+                raise CheckpointError(
+                    f"carry leaf {i} ({kind}): leading axis "
+                    f"{leaf.shape[0]} != {S} shard slots")
+            if any(not np.array_equal(leaf[0], leaf[s])
+                   for s in range(1, S)):
+                raise CheckpointError(
+                    "master RNG key differs across shards — the "
+                    "checkpoint predates the global-instance-id "
+                    "sharded RNG and cannot be resharded")
+            out.append(np.broadcast_to(
+                leaf[:1], (n_shards,) + rest).copy())
+        else:
+            raise CheckpointError(
+                f"carry leaf {i}: unknown shard kind {kind!r}")
+    meta = dict(shard)
+    meta["n-shards"] = n_shards
+    meta["instances-per-shard"] = total // n_shards
+    return out, meta
+
+
+def _template_shards(t_leaves, kinds) -> Optional[int]:
+    """Infer the resume mesh's shard count from a wire template: the
+    leading axis of any per-shard ("sum"/"key") leaf."""
+    for t, kind in zip(t_leaves, kinds):
+        if kind in (_KIND_SUM, _KIND_KEY) and len(t.shape):
+            return int(t.shape[0])
+    return None
+
+
+def restore_carry(template: Any, leaves: List[np.ndarray],
+                  shard: Optional[Dict[str, Any]] = None) -> Any:
     """Rebuild a device carry from checkpointed leaves using a freshly
     initialized ``template`` pytree (same model/sim/config) for the
     treedef. Shape/dtype mismatches mean the run is being resumed under
-    a different config — refused, not silently reinterpreted."""
+    a different config — refused, not silently reinterpreted — with ONE
+    exception: a sharded checkpoint whose mismatch is a pure
+    shard-count change (``shard`` = the checkpoint's recorded
+    ``meta["shard"]`` block) routes through :func:`reshard_carry`,
+    re-chunking the instance axis onto the template's mesh size."""
     import jax
     import jax.numpy as jnp
     t_leaves, treedef = jax.tree.flatten(template)
+    if shard is not None and len(t_leaves) == len(leaves):
+        ck_shards = int(shard.get("n-shards", 0))
+        ck_per = int(shard.get("instances-per-shard", 0))
+        kinds = list(shard.get("leaf-kinds", ()))
+        new_shards = (_template_shards(t_leaves, kinds)
+                      if len(kinds) == len(t_leaves) else None)
+        if (new_shards is not None and ck_shards > 0
+                and new_shards != ck_shards):
+            total = ck_shards * ck_per
+            t_total = next(
+                (int(t.shape[0]) for t, k in zip(t_leaves, kinds)
+                 if k == _KIND_INSTANCE and len(t.shape)), total)
+            if t_total != total:
+                raise CheckpointError(
+                    f"carry saved at {ck_shards} shards, mesh has "
+                    f"{new_shards} — resharding via reshard_carry "
+                    f"needs the same global fleet, but the checkpoint "
+                    f"holds {total} instances ({ck_shards} x {ck_per}) "
+                    f"and the resume config expects {t_total}")
+            leaves, shard = reshard_carry(leaves, shard, new_shards)
     if len(t_leaves) != len(leaves):
         raise CheckpointError(
             f"checkpoint has {len(leaves)} carry leaves but the "
@@ -182,6 +310,13 @@ def restore_carry(template: Any, leaves: List[np.ndarray]) -> Any:
                     "with the run's recorded wire format "
                     "(heartbeat run-start `wire-format`, the "
                     "netid/journal_instances opts)")
+        elif shard is not None and int(shard.get("n-shards", 0)) > 0:
+            hint = (f" — carry saved at "
+                    f"{int(shard['n-shards'])} shards "
+                    f"({int(shard.get('instances-per-shard', 0))} "
+                    f"instances/shard); a pure mesh-size change "
+                    f"reshards via reshard_carry, anything else is "
+                    f"config drift")
         raise CheckpointError(
             f"carry leaf {i}: checkpoint {vs}/{v.dtype} vs "
             f"rebuilt {ts}/{t.dtype} — the resume config does "
@@ -199,13 +334,18 @@ def make_checkpoint_cb(run_dir: str, *, kind: str,
     """The executor-facing sink: a ``cb(state, ticks, host)`` closure
     for ``run_sim_pipelined``/``run_sim_sharded_chunked``'s
     ``checkpoint_cb`` — ``host`` is the executor's accumulator dict
-    (``compact``/``journal``/``events``/``chunks``)."""
+    (``compact``/``journal``/``events``/``chunks``, plus the sharded
+    executor's per-leaf reshard metadata under ``"shard"``, persisted
+    into the header so ``reshard_carry`` can re-chunk on resume)."""
     def cb(state, ticks, host: Dict[str, Any]) -> None:
+        m = dict(meta or {})
+        if host.get("shard"):
+            m["shard"] = host["shard"]
         save_checkpoint(
             run_dir, kind=kind, state=state, ticks=ticks,
             chunks=int(host.get("chunks", 0)),
             compact=tuple(host.get("compact", ())),
             journal=tuple(host.get("journal", ())),
             events=tuple(host.get("events", ())),
-            meta=meta)
+            meta=m or None)
     return cb

@@ -1164,6 +1164,8 @@ def cmd_lint(args) -> int:
         passes.append("lanes")
     if args.ranges or args.update_ranges:
         passes.append("ranges")
+    if args.shard or args.update_shard_manifest:
+        passes.append("shard")
     baseline = None if args.no_baseline else (args.baseline
                                               or DEFAULT_BASELINE)
     report = run_lint(repo_root=args.root,
@@ -1176,7 +1178,9 @@ def cmd_lint(args) -> int:
                       update_lane_manifest=args.update_manifest,
                       range_manifest_path=args.range_manifest,
                       update_range_manifest=args.update_ranges,
-                      ranges_horizon_log2=args.ranges_horizon_log2)
+                      ranges_horizon_log2=args.ranges_horizon_log2,
+                      shard_manifest_path=args.shard_manifest,
+                      update_shard_manifest=args.update_shard_manifest)
     if args.json:
         print(json.dumps(report.to_json(), indent=2))
     else:
@@ -1390,7 +1394,7 @@ def main(argv=None) -> int:
                         help="machine-readable findings on stdout")
     p_lint.add_argument("--pass", dest="passes", action="append",
                         choices=["trace", "contract", "schema", "ir",
-                                 "cost", "lanes", "ranges"],
+                                 "cost", "lanes", "ranges", "shard"],
                         help="run only the named pass(es); default "
                              "trace+contract+schema (ir/cost are "
                              "opt-in — they trace/compile every "
@@ -1457,6 +1461,28 @@ def main(argv=None) -> int:
                              "(log2; default 24) — the lint_gate "
                              "canary probes 31 so every cumulative "
                              "counter trips ABS701")
+    p_lint.add_argument("--shard", action="store_true",
+                        help="run the SPMD partition pass (SHD8xx): "
+                             "AOT-lower the sharded chunk step of "
+                             "every registered model x both carry "
+                             "layouts under an abstract mesh — "
+                             "collective census, ICI-bytes estimates "
+                             "per mesh size {1,2,4,8}, cross-shard "
+                             "dependence / replicated-leaf / "
+                             "lost-donation audits, and static "
+                             "cross-mesh reshard proofs — gated "
+                             "against analysis/shard_manifest.json "
+                             "(doc/lint.md)")
+    p_lint.add_argument("--update-shard-manifest", action="store_true",
+                        help="re-record analysis/shard_manifest.json "
+                             "from the current tree (implies "
+                             "--shard); commit the result with the PR "
+                             "that changes the sharded communication "
+                             "pattern")
+    p_lint.add_argument("--shard-manifest", default=None,
+                        help="shard-manifest file (default "
+                             "maelstrom_tpu/analysis/shard_manifest"
+                             ".json)")
     p_lint.add_argument("--baseline", default=None,
                         help="baseline file (default "
                              "maelstrom_tpu/analysis/baseline.json)")

@@ -277,3 +277,59 @@ RANGE_FIXTURE_MODELS = {
     "scatter-race": IrScatterRace,
     "oob-gather": IrOobGather,
 }
+
+
+# --- SPMD shard fixtures (analysis/shard_audit.py, SHD8xx) -----------------
+#
+# The sixth fixture family: models that are clean by every single-chip
+# measure but whose SHARDED lowering is hazardous — exactly what the
+# partition auditor exists to catch before a TPU window does. Same
+# convention: never registered, findings carried as status="expected"
+# in analysis/baseline.json, each rule pinned by
+# tests/test_analysis_shard.py in BOTH carry layouts.
+
+
+class IrShardCrossTalk(EchoModel):
+    """SHARD FIXTURE (do not register): the tick gathers every shard's
+    counters across the instance axis and folds a psum of them back
+    into the row — a cross-shard data dependence (SHD803: instances
+    are pure functions of (seed, global id), so results now change
+    with the mesh size) plus an unbudgeted reduction collective in the
+    tick hot loop (SHD801: per-tick ICI latency on every chip). On one
+    chip the lowering is a no-op, so nothing but the partition audit
+    ever sees it."""
+    name = "echo-ir-shard-cross-talk"
+
+    def tick(self, row, node_idx, t, key, cfg, params):
+        # "instances" is the mesh axis the sharded chunk runner maps
+        # over (parallel/mesh.py::AXIS) — binding it here is only legal
+        # inside shard_map, which is exactly where the production tick
+        # runs
+        peers = jax.lax.all_gather(row, "instances")
+        spill = jax.lax.psum(jnp.sum(peers), "instances")
+        return row + spill * 0, jnp.zeros((self.tick_out, cfg.lanes),
+                                          dtype=jnp.int32)
+
+
+class IrShardReplicatedLeaf(EchoModel):
+    """SHARD FIXTURE (do not register): a params table with one row
+    per instance. Params cross the shard_map boundary replicated
+    (``in_specs=P()``), so every chip holds ALL instances' rows —
+    per-instance state smuggled into replicated params is O(chips)
+    memory waste and silently stops scaling with the fleet (SHD802).
+    The leaf clears the audit's 16 KiB floor (4 x 4096 int32 =
+    64 KiB)."""
+    name = "echo-ir-shard-replicated-leaf"
+
+    def make_params(self, n_nodes):
+        # leading dim == the audit sim's per-shard instance count — the
+        # shape signature SHD802 keys on
+        return {"per_instance_cache": jnp.zeros((4, 4096), jnp.int32)}
+
+
+# audited by analysis/shard_audit.py alongside the registered models;
+# intentionally NOT reachable from models.get_model
+SHARD_FIXTURE_MODELS = {
+    "shard-cross-talk": IrShardCrossTalk,
+    "shard-replicated-leaf": IrShardReplicatedLeaf,
+}

@@ -2,10 +2,25 @@
 
 Protocol instances are independent, so the natural parallelism is pure
 data parallelism along the instance axis: each device simulates its own
-block of clusters, RNG streams are decorrelated per shard, and the only
-cross-device communication is a ``psum`` of the fleet-wide net counters —
-which rides ICI. Recorded-instance event tensors stay sharded and are
-gathered once at the end for the host-side checkers.
+block of clusters, and the only cross-device communication is a ``psum``
+of the fleet-wide net counters — which rides ICI. Recorded-instance
+event tensors stay sharded and are gathered once at the end for the
+host-side checkers.
+
+Shard assignment is **round-robin over GLOBAL instance ids** under one
+master RNG key: shard *s* of *S* simulates global ids ``{j*S + s}``, and
+every draw folds ``(purpose, tick, global id)`` into the single
+``PRNGKey(seed)`` (tpu/runtime.py's purity invariant). An instance's
+trajectory is therefore a pure function of ``(seed, global id)`` —
+independent of the shard count — which is what makes a checkpoint
+written at S shards resumable at S' shards bit-identically
+(``campaign/checkpoint.reshard_carry``): re-chunking the instance axis
+moves state between devices but never changes any instance's stream.
+Round-robin (rather than contiguous blocks) keeps the RECORDED instance
+set shard-count-invariant too: the first R locals of every shard are
+exactly global ids ``{0 .. R*S-1}`` for any S. Gathered per-instance
+outputs cross the wire shard-major and are re-ordered to global-id
+order on host (:func:`deinterleave`).
 
 This is the TPU-native replacement for the reference's "scale = more
 processes/threads on one JVM" model (SURVEY §2.4 data-parallelism row):
@@ -42,18 +57,81 @@ def _shard_map(f, mesh, in_specs, out_specs):
     return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
                check_rep=False)
 
-# per-shard RNG decorrelation stride; device i simulates with seed
-# ``seed + i * SEED_STRIDE``. Exposed (with shard_seeds) so equivalence
-# oracles can replay individual shards unsharded.
-SEED_STRIDE = 1_000_003
+# reshard kinds of the wire-carry leaves (checkpointed per leaf so
+# campaign/checkpoint.reshard_carry can re-chunk a wire written at S
+# shards onto S' shards; see wire_leaf_kinds)
+SHARD_LEAF_INSTANCE = "instance"   # chunks along the global instance axis
+SHARD_LEAF_SUM = "sum"             # one additive partial-sum slot per shard
+SHARD_LEAF_KEY = "key"             # the replicated master RNG key
 
 
-def shard_seeds(seed: int, n_shards: int):
-    """The deterministic per-shard seed list used by run_sim_sharded
-    (wrapped into int32 range so huge-but-valid seeds behave the same
-    here and on device)."""
-    return [(seed + i * SEED_STRIDE + 2**31) % 2**32 - 2**31
-            for i in range(n_shards)]
+def _seed32(seed: int) -> int:
+    """Wrap an arbitrary python-int seed into int32 range so huge-but-
+    valid seeds behave the same on host and on device (both sharded
+    paths AND the unsharded oracle derive from this one value)."""
+    return (int(seed) + 2**31) % 2**32 - 2**31
+
+
+def shard_instance_ids(n_instances: int, n_shards: int):
+    """``[n_shards, n_instances]`` GLOBAL instance ids per shard under
+    the round-robin assignment: shard ``s`` simulates global ids
+    ``{j * n_shards + s : j < n_instances}``. The deterministic id
+    layout both sharded runners and the ``run_sim_unsharded`` oracle
+    derive their RNG streams from (``n_instances`` is PER SHARD)."""
+    import numpy as np
+    return np.arange(n_shards * n_instances, dtype=np.int32).reshape(
+        n_instances, n_shards).T.copy()
+
+
+def _shard_index(mesh):
+    """This shard's flat index in [0, mesh.size) — row-major over the
+    mesh axes, matching the order sharded outputs concatenate in under
+    ``P(axes)``. Traced (shard_map body only)."""
+    sizes = dict(mesh.shape)
+    idx = jnp.int32(0)
+    for ax in mesh.axis_names:
+        idx = idx * sizes[ax] + jax.lax.axis_index(ax)
+    return idx
+
+
+def _shard_ids(mesh, n_instances: int):
+    """The executing shard's global instance ids (traced; shard_map
+    body only) — row ``_shard_index(mesh)`` of
+    :func:`shard_instance_ids`."""
+    return (jnp.arange(n_instances, dtype=jnp.int32) * mesh.size
+            + _shard_index(mesh))
+
+
+def deinterleave(x, n_shards: int, axis: int = 0):
+    """Reorder a shard-major gathered axis (shard s's block of I locals
+    at ``[s*I, (s+1)*I)``; local j holding global id ``j*S + s``) into
+    global-id order. Host-side (numpy) — reordering a sharded axis on
+    device would be an all-to-all."""
+    import numpy as np
+    x = np.asarray(x)
+    if n_shards <= 1:
+        return x
+    x = np.moveaxis(x, axis, 0)
+    s = int(n_shards)
+    t = x.shape[0]
+    x = x.reshape((s, t // s) + x.shape[1:]).swapaxes(0, 1).reshape(
+        (t,) + x.shape[1:])
+    return np.moveaxis(x, 0, axis)
+
+
+def interleave(x, n_shards: int, axis: int = 0):
+    """Inverse of :func:`deinterleave`: chunk a global-id-ordered axis
+    into the shard-major round-robin layout the wire uses."""
+    import numpy as np
+    x = np.asarray(x)
+    if n_shards <= 1:
+        return x
+    x = np.moveaxis(x, axis, 0)
+    s = int(n_shards)
+    t = x.shape[0]
+    x = x.reshape((t // s, s) + x.shape[1:]).swapaxes(0, 1).reshape(
+        (t,) + x.shape[1:])
+    return np.moveaxis(x, 0, axis)
 
 
 def make_mesh(n_devices: Optional[int] = None) -> Mesh:
@@ -100,21 +178,25 @@ def merge_unsharded_telemetry(tels):
 
 
 @partial(jax.jit, static_argnames=("model", "sim", "mesh"))
-def _run_sharded(model: Model, sim: SimConfig, mesh: Mesh, seeds, params):
-    """seeds: int32 shaped like ``mesh.devices``; ``sim`` describes the
-    PER-DEVICE shard. Works for any mesh rank — stats psum over every
-    mesh axis, sharded outputs split over all axes jointly (so a 1-D
-    ICI mesh and a 2-D DCN x ICI hybrid mesh share this code path).
+def _run_sharded(model: Model, sim: SimConfig, mesh: Mesh, seed, params):
+    """seed: the replicated int32 master seed; ``sim`` describes the
+    PER-DEVICE shard (each shard derives its own global instance ids
+    from its mesh position). Works for any mesh rank — stats psum over
+    every mesh axis, sharded outputs split over all axes jointly (so a
+    1-D ICI mesh and a 2-D DCN x ICI hybrid mesh share this code path).
     Returns (stats, violations, events, telemetry) where telemetry is
     the MERGED per-instance recorder (instance leaves concatenated over
-    shards, fleet series psum'd) or None when telemetry is off."""
+    shards, fleet series psum'd) or None when telemetry is off;
+    per-instance outputs come back in SHARD-MAJOR order (the wrapper
+    deinterleaves on host)."""
     axes = mesh.axis_names
     with_tel = sim.telemetry.enabled
 
-    def shard_body(seed_shard, params_rep):
+    def shard_body(seed_rep, params_rep):
+        ids = _shard_ids(mesh, sim.n_instances)
         with jax.named_scope("simulate_shard"):
-            carry, ys = simulate(model, sim, seed_shard.reshape(()),
-                                 params_rep)
+            carry, ys = simulate(model, sim, seed_rep.reshape(()),
+                                 params_rep, instance_ids=ids)
         stats = carry.stats
         with jax.named_scope("psum_stats"):
             for ax in axes:
@@ -143,12 +225,31 @@ def _run_sharded(model: Model, sim: SimConfig, mesh: Mesh, seeds, params):
     # carry mix, and everything here is embarrassingly parallel anyway
     out = _shard_map(
         shard_body, mesh=mesh,
-        in_specs=(P(*axes), P()),
+        in_specs=(P(), P()),
         out_specs=out_specs,
-    )(seeds, params)
+    )(seed, params)
     if not with_tel:
         return out + (None,)
     return out
+
+
+def _deinterleave_outputs(violations, events, tel, n_shards: int):
+    """Host-side re-order of the sharded runners' per-instance outputs
+    from shard-major wire order to global-id order (shared by the
+    sharded paths and the run_sim_unsharded oracle so they can never
+    drift)."""
+    violations = deinterleave(violations, n_shards, axis=0)
+    events = deinterleave(events, n_shards, axis=1)
+    if tel is not None:
+        import numpy as np
+        series = np.asarray(tel.series)
+        # the fleet series buffer is psum'd, not instance-batched — keep
+        # it out of the per-instance re-order ('()' has no tree leaves)
+        tel = jax.tree.map(
+            lambda x: deinterleave(x, n_shards, axis=0),
+            tel._replace(series=()))
+        tel = tel._replace(series=series)
+    return violations, events, tel
 
 
 def run_sim_unsharded(model: Model, sim: SimConfig, seed: int,
@@ -156,18 +257,22 @@ def run_sim_unsharded(model: Model, sim: SimConfig, seed: int,
                       return_telemetry: bool = False):
     """The equivalence oracle for :func:`run_sim_sharded`: replay every
     shard's ``simulate`` serially on one device with the identical
-    per-shard seeds and accumulate the same (stats, violations, events)
-    triple — plus, with ``return_telemetry``, the merged per-instance
-    recorder. A sharded run must match this bit-for-bit — shard_map and
-    collective placement may change performance, never results."""
+    master seed and the identical global instance ids
+    (:func:`shard_instance_ids`) and accumulate the same (stats,
+    violations, events) triple — plus, with ``return_telemetry``, the
+    merged per-instance recorder. A sharded run must match this
+    bit-for-bit — shard_map and collective placement may change
+    performance, never results."""
     import numpy as np
 
     if params is None:
         params = model.make_params(sim.net.n_nodes)
-    run_one = jax.jit(lambda s: simulate(model, sim, s, params))
+    run_one = jax.jit(lambda ids: simulate(
+        model, sim, jnp.int32(_seed32(seed)), params, instance_ids=ids))
+    all_ids = shard_instance_ids(sim.n_instances, n_shards)
     stats, viol, evs, tels = None, [], [], []
-    for s in shard_seeds(seed, n_shards):
-        carry_u, ys_u = run_one(jnp.int32(s))
+    for s in range(n_shards):
+        carry_u, ys_u = run_one(jnp.asarray(all_ids[s]))
         st = jax.tree.map(int, carry_u.stats)
         stats = st if stats is None else jax.tree.map(
             lambda a, b: a + b, stats, st)
@@ -177,10 +282,13 @@ def run_sim_unsharded(model: Model, sim: SimConfig, seed: int,
                    else np.asarray(_empty_events(model, sim)))
         if carry_u.telemetry is not None:
             tels.append(carry_u.telemetry)
-    out = (NetStats(*stats), np.concatenate(viol, axis=0),
-           np.concatenate(evs, axis=1))
+    tel = merge_unsharded_telemetry(tels) if tels else None
+    violations, events, tel = _deinterleave_outputs(
+        np.concatenate(viol, axis=0), np.concatenate(evs, axis=1),
+        tel, n_shards)
+    out = (NetStats(*stats), violations, events)
     if return_telemetry:
-        out = out + (merge_unsharded_telemetry(tels) if tels else None,)
+        out = out + (tel,)
     return out
 
 
@@ -238,19 +346,50 @@ def wire_template(model: Model, sim: SimConfig, mesh: Mesh, params=None):
     per-shard wire with every leading axis scaled by the shard count
     (each leaf crosses the shard_map boundary under ``P(axes)``).
     ``campaign/checkpoint.restore_carry`` validates a sharded
-    checkpoint against it on resume — a different mesh size fails the
-    shape check instead of silently mis-sharding."""
+    checkpoint against it on resume — a mesh-size mismatch routes
+    through ``reshard_carry`` (pure shard-count change) or fails the
+    shape check instead of silently mis-sharding. Accepts an
+    ``AbstractMesh`` (the shard auditor's no-device path) — only the
+    mesh size is consumed."""
     if params is None:
         params = model.make_params(sim.net.n_nodes)
     if params is None:
         params = jnp.zeros((), jnp.int32)
-    n = int(mesh.devices.size)
+    n = int(mesh.size)
     shard = jax.eval_shape(
         lambda p: _carry_to_wire(init_carry_abstract(model, sim, p),
                                  sim), params)
     return jax.tree.map(
         lambda s: jax.ShapeDtypeStruct((s.shape[0] * n,) + s.shape[1:],
                                        s.dtype), shard)
+
+
+def wire_leaf_kinds(model: Model, sim: SimConfig, params=None):
+    """Per-leaf reshard kind for the wire carry, in tree-flatten order
+    (aligned with the ``carry/{i}`` arrays a sharded checkpoint
+    stores): ``"instance"`` leaves chunk along the global
+    (round-robin-interleaved) instance axis, ``"sum"`` leaves are
+    additive per-shard partial sums (NetStats slots, the fleet
+    telemetry series), ``"key"`` is the replicated master RNG key.
+    Recorded into ``state.npz`` at save time so
+    ``campaign/checkpoint.reshard_carry`` can re-chunk leaf-wise, and
+    statically cross-checked by the shard auditor
+    (``analysis/shard_audit.py``)."""
+    if params is None:
+        params = model.make_params(sim.net.n_nodes)
+    if params is None:
+        params = jnp.zeros((), jnp.int32)
+    shard = jax.eval_shape(
+        lambda p: _carry_to_wire(init_carry_abstract(model, sim, p),
+                                 sim), params)
+    kinds = jax.tree.map(lambda _: SHARD_LEAF_INSTANCE, shard)
+    kinds = kinds._replace(
+        stats=jax.tree.map(lambda _: SHARD_LEAF_SUM, shard.stats),
+        key=SHARD_LEAF_KEY)
+    if shard.telemetry is not None:
+        kinds = kinds._replace(
+            telemetry=kinds.telemetry._replace(series=SHARD_LEAF_SUM))
+    return list(jax.tree.leaves(kinds))
 
 
 def init_carry_abstract(model: Model, sim: SimConfig, params):
@@ -270,11 +409,12 @@ def make_sharded_chunk_fn(model: Model, sim: SimConfig, mesh: Mesh,
 
     Public because it IS the executable the sharded runner dispatches:
     the IR/cost analyzer (``analysis/ir_lint.py``) lowers and compiles
-    this exact callable to verify donation aliasing (JXP403) and audit
-    the sharded body's IR — not a re-lowered copy."""
+    this exact callable to verify donation aliasing (JXP403), and the
+    shard auditor (``analysis/shard_audit.py``) AOT-lowers it per mesh
+    size for the collective census / ICI manifest — not a re-lowered
+    copy."""
     from ..tpu.pipeline import violation_scan
-    from ..tpu.runtime import default_instance_ids, init_carry, \
-        make_tick_fn
+    from ..tpu.runtime import init_carry, make_tick_fn
 
     axes = mesh.axis_names
     dummy_w = jax.eval_shape(
@@ -285,8 +425,10 @@ def make_sharded_chunk_fn(model: Model, sim: SimConfig, mesh: Mesh,
     @partial(jax.jit, static_argnames=("length",), donate_argnums=0)
     def chunk_fn(wire, t0, params, length):
         def body(w, t0_rep, params_rep):
+            ids = _shard_ids(mesh, sim.n_instances)
             carry = _carry_from_wire(w, sim)
-            tick = make_tick_fn(model, sim, params_rep)
+            tick = make_tick_fn(model, sim, params_rep,
+                                instance_ids=ids)
             carry, ys = jax.lax.scan(
                 tick, carry,
                 t0_rep.reshape(()) + jnp.arange(length, dtype=jnp.int32))
@@ -294,11 +436,11 @@ def make_sharded_chunk_fn(model: Model, sim: SimConfig, mesh: Mesh,
                       else _empty_events(model, sim, length))
             # detached per-shard snapshots ([1, 5] stats / [1, K, 3]
             # scan, shard-leading so they concatenate under P(axes)):
-            # the heartbeat reads them after the wire is donated away
+            # the heartbeat reads them after the wire is donated away.
+            # The scan rows carry GLOBAL instance ids — no host remap.
             svec = jnp.stack(list(carry.stats)).reshape(1, -1)
             scan = violation_scan(
-                carry.violations, carry.telemetry,
-                default_instance_ids(sim), k=scan_k)[None]
+                carry.violations, carry.telemetry, ids, k=scan_k)[None]
             return _carry_to_wire(carry, sim), events, svec, scan
         return _shard_map(
             body, mesh=mesh,
@@ -353,11 +495,15 @@ def run_sim_sharded_chunked(model: Model, sim: SimConfig, seed: int,
     ``checkpoint_cb(wire, ticks, host)``/``checkpoint_every``/``resume``
     are the campaign durability hooks (campaign/checkpoint.py), exactly
     as on :func:`..tpu.pipeline.run_sim_pipelined` — the checkpointed
-    state is the WIRE carry (kind ``"sharded"``), and ``host`` carries
-    the dense per-chunk event blocks under ``"events"``. A resumed
-    sharded run needs the same mesh shape (the wire leaves' leading
-    axis bakes in the shard count; :func:`restore_carry` refuses a
-    mismatch).
+    state is the WIRE carry (kind ``"sharded"``), ``host`` carries the
+    dense per-chunk event blocks under ``"events"`` (already in
+    global-id order) plus the per-leaf reshard metadata under
+    ``"shard"``. A resumed sharded run accepts a DIFFERENT mesh size:
+    :func:`campaign.checkpoint.restore_carry` routes a pure
+    shard-count mismatch through ``reshard_carry`` (re-chunking the
+    instance axis), and the global-id RNG derivation makes the resumed
+    trajectories bit-identical to an uninterrupted run at the new
+    shard count.
     """
     import numpy as np
 
@@ -368,8 +514,8 @@ def run_sim_sharded_chunked(model: Model, sim: SimConfig, seed: int,
                                     scan_to_violations, stats_vec_to_net)
 
     mesh = mesh or make_mesh()
-    mesh, seeds, params = _prepare(model, sim, seed, mesh, params)
-    axes = mesh.axis_names
+    mesh, seed_arr, params = _prepare(model, sim, seed, mesh, params)
+    n_shards = int(mesh.size)
     if scan_k is None:
         scan_k = DEFAULT_SCAN_TOP_K
 
@@ -379,13 +525,15 @@ def run_sim_sharded_chunked(model: Model, sim: SimConfig, seed: int,
                                                 params, scan_k=scan_k)
 
     @jax.jit
-    def init_fn(seeds, params):
-        def body(seed_shard, params_rep):
+    def init_fn(seed_rep, params):
+        def body(seed_rep, params_rep):
+            ids = _shard_ids(mesh, sim.n_instances)
             return _carry_to_wire(init_carry(
-                model, sim, seed_shard.reshape(()), params_rep), sim)
+                model, sim, seed_rep.reshape(()), params_rep,
+                instance_ids=ids), sim)
         return _shard_map(
-            body, mesh=mesh, in_specs=(P(*axes), P()),
-            out_specs=wire_spec)(seeds, params)
+            body, mesh=mesh, in_specs=(P(), P()),
+            out_specs=wire_spec)(seed_rep, params)
 
     events_chunks = ([np.asarray(e) for e in resume.events]
                      if resume else [])
@@ -393,18 +541,15 @@ def run_sim_sharded_chunked(model: Model, sim: SimConfig, seed: int,
     tripped = [False]
 
     # fuzz runs: the heartbeat's fault-fuzz lane (schedules-active per
-    # chunk) comes from one host-side re-draw of every shard's windows
-    # — schedules are pure functions of the shard seeds, zero mid-run
-    # device traffic (faults/fuzz.py)
+    # chunk) comes from one host-side re-draw of the whole fleet's
+    # windows — schedules are pure functions of (master seed, global
+    # instance id), zero mid-run device traffic (faults/fuzz.py)
     fuzz_windows = None
     if heartbeat is not None and sim.faults.has_fuzz:
         from ..faults import fuzz as faults_fuzz
-        wins = [faults_fuzz.fleet_windows(
-                    sim.faults, sim.net.n_nodes, s,
-                    np.arange(sim.n_instances, dtype=np.int32))
-                for s in shard_seeds(seed, mesh.devices.size)]
-        fuzz_windows = {k: np.concatenate([w[k] for w in wins], axis=0)
-                        for k in wins[0]}
+        fuzz_windows = faults_fuzz.fleet_windows(
+            sim.faults, sim.net.n_nodes, _seed32(seed),
+            np.arange(sim.n_instances * n_shards, dtype=np.int32))
 
     def dispatch(w, t0, length):
         w, events, svec, scan = chunk_fn(w, jnp.int32(t0), params,
@@ -413,9 +558,13 @@ def run_sim_sharded_chunked(model: Model, sim: SimConfig, seed: int,
 
     def consume(payload, t0, length):
         events, svec, scan = payload
-        events_chunks.append(np.asarray(events))
-        scan_np = combine_shard_scans(np.asarray(scan),
-                                      sim.n_instances)
+        # dense event blocks cross the wire shard-major; accumulate in
+        # global-id order so the host history is shard-count-invariant
+        # (what lets a resharded resume concatenate with chunks written
+        # at a different mesh size)
+        events_chunks.append(deinterleave(np.asarray(events), n_shards,
+                                          axis=1))
+        scan_np = combine_shard_scans(np.asarray(scan), None)
         if int(scan_np[0, 0]) > 0:
             tripped[0] = True
         if heartbeat is not None:
@@ -435,14 +584,21 @@ def run_sim_sharded_chunked(model: Model, sim: SimConfig, seed: int,
     should_stop = (lambda: tripped[0]) if fail_fast else None
     checkpoint = None
     if checkpoint_cb is not None and checkpoint_every > 0:
+        shard_meta = {
+            "n-shards": n_shards,
+            "instances-per-shard": int(sim.n_instances),
+            "interleaved": True,
+            "leaf-kinds": wire_leaf_kinds(model, sim, params)}
+
         def checkpoint(wire_st, ticks, _chunks):
             checkpoint_cb(wire_st, ticks,
                           {"events": list(events_chunks),
-                           "chunks": chunk_idx[0]})
+                           "chunks": chunk_idx[0],
+                           "shard": shard_meta})
     if resume is not None:
         wire0 = resume.carry
     else:
-        wire0 = init_fn(seeds, params)
+        wire0 = init_fn(seed_arr, params)
     if plans:
         wire, chunk_stats = run_chunked(
             wire0, plans, dispatch, consume, should_stop,
@@ -455,18 +611,24 @@ def run_sim_sharded_chunked(model: Model, sim: SimConfig, seed: int,
         perf.update(chunk_stats)
 
     # final: per-shard stats summed on host (stats crossed the boundary
-    # as [n_shards]-length arrays, one slot per shard)
+    # as [n_shards]-length arrays, one slot per shard; int adds commute,
+    # so the total is invariant to how a reshard regrouped the slots)
     stats = NetStats(*(int(jnp.sum(x)) for x in wire.stats))
-    violations = np.asarray(wire.violations)
+    violations = deinterleave(np.asarray(wire.violations), n_shards,
+                              axis=0)
     out = (stats, violations, np.concatenate(events_chunks, axis=0))
     if return_telemetry:
         tel = wire.telemetry
         if tel is not None:
-            # wire format: per-instance leaves already concatenated
-            # across shards; the series buffer crossed as one
-            # [n_shards, n_windows, lanes] block — fleet-merge it
+            # wire format: per-instance leaves concatenated shard-major
+            # across shards (deinterleave to global-id order); the
+            # series buffer crossed as one [n_shards, n_windows, lanes]
+            # block — fleet-merge it
             tel = jax.tree.map(np.asarray, tel)
-            tel = tel._replace(series=tel.series.sum(axis=0))
+            series = tel.series.sum(axis=0)
+            tel = jax.tree.map(
+                lambda x: deinterleave(x, n_shards, axis=0), tel)
+            tel = tel._replace(series=series)
         out = out + (tel,)
     return out
 
@@ -482,14 +644,17 @@ def run_sim_sharded(model: Model, sim: SimConfig, seed: int, params=None,
     [n_instances * n_devices], events [T, R * n_devices, C, 2,
     2 + model.ev_vals]) — plus, when ``return_telemetry`` is set, the
     merged per-instance flight recorder: instance-axis leaves
-    concatenated across shards ([n_instances * n_devices] like
-    ``violations``), fleet series psum'd over the mesh (None when
-    telemetry is disabled).
+    [n_instances * n_devices] like ``violations``, fleet series psum'd
+    over the mesh (None when telemetry is disabled). Per-instance axes
+    are in GLOBAL instance-id order (host-deinterleaved from the
+    shard-major wire).
     """
     mesh = mesh or make_mesh()
-    mesh, seeds, params = _prepare(model, sim, seed, mesh, params)
+    mesh, seed_arr, params = _prepare(model, sim, seed, mesh, params)
     stats, violations, events, tel = _run_sharded(model, sim, mesh,
-                                                  seeds, params)
+                                                  seed_arr, params)
+    violations, events, tel = _deinterleave_outputs(
+        violations, events, tel, int(mesh.size))
     if return_telemetry:
         return stats, violations, events, tel
     return stats, violations, events
@@ -498,15 +663,18 @@ def run_sim_sharded(model: Model, sim: SimConfig, seed: int, params=None,
 def _prepare(model: Model, sim: SimConfig, seed: int, mesh: Mesh, params):
     """Shared preamble of the sharded runners — MUST stay common so the
     chunked path and the single-scan path (the equivalence oracle's
-    subject) can never drift in seed derivation or params fallback."""
+    subject) can never drift in seed derivation or params fallback.
+    One replicated master seed; per-shard decorrelation comes from the
+    GLOBAL instance ids each shard derives from its mesh position
+    (``_shard_ids``), never from per-shard seeds — the shard-count
+    invariance cross-mesh resume rests on."""
     # the per-message journal is a single-device feature; shard bodies
     # drop TickOutputs.journal_* — refuse silently-ignored config
     assert sim.journal_instances == 0, \
         "journal_instances is not supported under shard_map"
-    seeds = jnp.array(shard_seeds(seed, mesh.devices.size),
-                      dtype=jnp.int32).reshape(mesh.devices.shape)
+    seed_arr = jnp.asarray(_seed32(seed), dtype=jnp.int32)
     if params is None:
         params = model.make_params(sim.net.n_nodes)
     if params is None:
         params = jnp.zeros((), jnp.int32)   # shard_map needs a pytree
-    return mesh, seeds, params
+    return mesh, seed_arr, params

@@ -119,17 +119,20 @@ def scan_to_violations(vec) -> List[Dict[str, int]]:
             for _, tick, inst in rows if int(inst) >= 0]
 
 
-def combine_shard_scans(scans, n_instances_per_shard: int,
+def combine_shard_scans(scans, n_instances_per_shard: Optional[int],
                         k: Optional[int] = None) -> np.ndarray:
     """Host-side merge of per-shard top-K violation scans
     ([n_shards, K, 3]; a legacy [n_shards, 3] input reads as K=1) into
-    one fleet scan [k, 3] (default ``k`` = the per-shard K). Local
-    instance indices become global merged ids
-    (``shard * n_instances_per_shard + local`` — the index convention of
-    the merged ``violations`` array the sharded runners return). Rows
-    are ordered by earliest first-violation tick (ties and unknown
-    ticks break toward the lowest global id); lane 0 of every row is
-    the fleet-wide violating count summed over shards."""
+    one fleet scan [k, 3] (default ``k`` = the per-shard K).
+
+    ``n_instances_per_shard=None`` means the scan rows already carry
+    GLOBAL instance ids (the sharded chunk body passes its round-robin
+    global ids into ``violation_scan`` on device — the current wire
+    convention); an int applies the legacy contiguous-block remap
+    ``shard * n_instances_per_shard + local``. Rows are ordered by
+    earliest first-violation tick (ties and unknown ticks break toward
+    the lowest global id); lane 0 of every row is the fleet-wide
+    violating count summed over shards."""
     scans = np.asarray(scans)
     if scans.ndim == 2:
         scans = scans[:, None, :]
@@ -148,7 +151,8 @@ def combine_shard_scans(scans, n_instances_per_shard: int,
         for _, tick, inst in scans[shard]:
             if int(inst) < 0:
                 continue
-            gid = shard * n_instances_per_shard + int(inst)
+            gid = (int(inst) if n_instances_per_shard is None
+                   else shard * n_instances_per_shard + int(inst))
             rows.append((int(tick) if int(tick) >= 0 else big, gid,
                          int(tick)))
     rows.sort()

@@ -82,6 +82,10 @@ def pytest_configure(config):
                    "tests — joint-consensus Raft reconfiguration, "
                    "parked-node semantics, planted reconfig bugs "
                    "(maelstrom_tpu/faults/, models/raft_core.py)")
+    config.addinivalue_line(
+        "markers", "shard: SPMD partition auditor / shard-manifest / "
+                   "cross-mesh resume tests (analysis/shard_audit.py, "
+                   "campaign/checkpoint.py reshard path)")
 
 
 def pytest_collection_modifyitems(config, items):

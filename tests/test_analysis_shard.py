@@ -1,0 +1,368 @@
+"""SPMD partition-auditor tests (analysis/shard_audit.py).
+
+Pins the PR's acceptance bars: each planted shard fixture trips its
+SHD8xx rule in BOTH carry layouts, the collective census classifies
+tick-hot-loop vs per-dispatch collectives with scan-trip weighting,
+the ICI ring formulas are exact, manifest drift/missing/stale/update
+detection works (including the jax-version skew downgrade), the
+combined gate pays one trace per model x layout through the shared
+cache, the static reshardability proof (SHD809) passes on real models
+and fires on broken metadata, and the checked-in manifest covers the
+whole registry at every audited mesh size.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from maelstrom_tpu.analysis import cost_model, shard_audit
+from maelstrom_tpu.analysis.findings import fingerprint_pass
+from maelstrom_tpu.analysis.shard_audit import (DEFAULT_SHARD_MANIFEST,
+                                                MESH_SIZES,
+                                                census_of_jaxpr,
+                                                compare_manifest,
+                                                entry_of_census,
+                                                ici_bytes_of,
+                                                load_shard_manifest,
+                                                reshard_findings,
+                                                run_shard_lint,
+                                                save_shard_manifest,
+                                                shard_stats, size_key,
+                                                trace_sharded_chunk,
+                                                trace_sharded_run)
+from maelstrom_tpu.models.echo import EchoModel
+from maelstrom_tpu.models.ir_hazards import (SHARD_FIXTURE_MODELS,
+                                             IrShardCrossTalk,
+                                             IrShardReplicatedLeaf)
+
+pytestmark = pytest.mark.shard
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _rules(findings):
+    return {f.rule for f in findings}
+
+
+def _echo_census(layout="lead"):
+    model = EchoModel()
+    sim = cost_model.audit_sim(model, 2, layout)
+    closed, _ = trace_sharded_chunk(model, sim)
+    return model, sim, census_of_jaxpr(closed)
+
+
+# --- the planted fixtures trip their rules ---------------------------------
+
+
+class TestFixturesTrip:
+    @pytest.mark.parametrize("layout", ["lead", "minor"])
+    def test_cross_talk_trips_shd801_and_803(self, layout):
+        model = IrShardCrossTalk()
+        sim = cost_model.audit_sim(model, 2, layout)
+        closed, _ = trace_sharded_chunk(model, sim)
+        census = census_of_jaxpr(closed)
+        fs = shard_audit.hot_loop_findings(model, census, layout,
+                                           "shard-cross-talk")
+        assert {"SHD801", "SHD803"} <= _rules(fs)
+        assert all(f.severity == "error" for f in fs)
+        # the gather and the psum both live in the TICK bucket —
+        # scan-trip-weighted to per-tick, not per-dispatch
+        assert census["tick"]["all_gather"]["count"] == 1
+        assert census["tick"]["psum"]["count"] == 1
+
+    @pytest.mark.parametrize("layout", ["lead", "minor"])
+    def test_replicated_leaf_trips_shd802(self, layout):
+        model = IrShardReplicatedLeaf()
+        sim = cost_model.audit_sim(model, 2, layout)
+        fs = shard_audit.replicated_leaf_findings(model, sim, layout)
+        assert _rules(fs) == {"SHD802"}
+        assert "per_instance_cache" in fs[0].message
+
+    def test_honest_echo_is_clean(self):
+        model, sim, census = _echo_census()
+        assert census["tick"] == {}
+        assert shard_audit.hot_loop_findings(model, census, "lead",
+                                             "echo") == []
+        assert shard_audit.replicated_leaf_findings(model, sim,
+                                                    "lead") == []
+
+    def test_small_per_instance_leaf_is_under_the_floor(self):
+        """A tiny table whose leading dim happens to equal the
+        instance count must NOT flag — the 16 KiB floor."""
+        class SmallLeaf(EchoModel):
+            name = "echo-shard-small-leaf"
+
+            def make_params(self, n_nodes):
+                return {"t": jax.numpy.zeros((4, 8), jax.numpy.int32)}
+
+        model = SmallLeaf()
+        sim = cost_model.audit_sim(model, 2, "lead")
+        assert shard_audit.replicated_leaf_findings(model, sim,
+                                                    "lead") == []
+
+
+# --- census mechanics + ICI formulas ---------------------------------------
+
+
+class TestCensus:
+    def test_run_subject_merges_stats_at_dispatch_not_tick(self):
+        """The fleet-stats psums of the single-dispatch runner sit
+        OUTSIDE the scanned tick body: dispatch-level plumbing, not
+        per-tick ICI traffic (their exact count is pinned by the
+        manifest, not hardcoded here)."""
+        model = EchoModel()
+        sim = cost_model.audit_sim(model, 2, "lead")
+        census = census_of_jaxpr(trace_sharded_run(model, sim))
+        assert census["tick"] == {}
+        assert census["dispatch"]["psum"]["count"] >= 5
+
+    def test_census_is_mesh_size_invariant(self):
+        model = EchoModel()
+        sim = cost_model.audit_sim(model, 2, "lead")
+        a = census_of_jaxpr(trace_sharded_chunk(model, sim, 2)[0])
+        b = census_of_jaxpr(trace_sharded_chunk(model, sim, 8)[0])
+        assert a == b
+
+    def test_ici_ring_formulas(self):
+        b = 1000
+        assert ici_bytes_of("psum", b, 1) == 0
+        assert ici_bytes_of("all_gather", b, 1) == 0
+        assert ici_bytes_of("psum", b, 4) == 2 * b * 3 // 4
+        assert ici_bytes_of("pmax", b, 8) == 2 * b * 7 // 8
+        assert ici_bytes_of("all_gather", b, 4) == 3 * b
+        assert ici_bytes_of("psum_scatter", b, 4) == b * 3 // 4
+        assert ici_bytes_of("all_to_all", b, 8) == b * 7 // 8
+        assert ici_bytes_of("ppermute", b, 4) == b
+
+    def test_entry_of_census_scales_with_mesh_size(self):
+        model = IrShardCrossTalk()
+        sim = cost_model.audit_sim(model, 2, "lead")
+        census = census_of_jaxpr(trace_sharded_chunk(model, sim)[0])
+        e1 = entry_of_census(census, 1)
+        e8 = entry_of_census(census, 8)
+        # counts are size-invariant; the ICI estimate is not
+        assert e1["tick-collectives"] == e8["tick-collectives"]
+        assert e1["ici-bytes-per-tick"] == 0
+        assert e8["ici-bytes-per-tick"] > 0
+
+    def test_shard_stats_surface(self):
+        model = IrShardCrossTalk()
+        sim = cost_model.audit_sim(model, 2, "lead")
+        cache = {}
+        st = shard_stats(model, sim, cache=cache)
+        assert st["collectives_per_tick"] == 2
+        assert st["ici_bytes_est"] > 0
+        # the census rode the shared cache under a shard: key, and a
+        # second call serves from it (no retrace)
+        assert any(k.startswith("shard:") for k in cache)
+        assert shard_stats(model, sim, cache=cache) == st
+        # the cost_model delegation returns the same figures
+        assert cost_model.tick_shard_stats(model, sim,
+                                           cache=cache) == st
+
+
+# --- the manifest gate -----------------------------------------------------
+
+
+def _echo_live():
+    model, sim, census = _echo_census()
+    live, paths = {}, {}
+    for s in MESH_SIZES:
+        key = size_key("echo", 2, "lead", s)
+        live[key] = entry_of_census(census, s)
+        paths[key] = ("maelstrom_tpu/models/echo.py", "EchoModel")
+    return live, paths
+
+
+class TestManifestGate:
+    def test_roundtrip_and_entry_contract(self, tmp_path):
+        live, _ = _echo_live()
+        p = str(tmp_path / "m.json")
+        save_shard_manifest(live, p)
+        data = load_shard_manifest(p)
+        assert data["jax-version"] == jax.__version__
+        assert data["entries"] == live
+        for ent in data["entries"].values():
+            assert set(ent) == {"tick-collectives",
+                                "tick-collective-bytes",
+                                "dispatch-collectives",
+                                "ici-bytes-per-tick",
+                                "ici-bytes-per-dispatch"}
+
+    def test_clean_compare_is_silent(self):
+        live, paths = _echo_live()
+        manifest = {"jax-version": jax.__version__,
+                    "entries": dict(live)}
+        assert compare_manifest(live, manifest, paths) == []
+
+    def test_tampered_ici_bytes_trip_shd807_error(self):
+        live, paths = _echo_live()
+        entries = {k: dict(v) for k, v in live.items()}
+        key = size_key("echo", 2, "lead", 8)
+        entries[key]["ici-bytes-per-dispatch"] = (
+            entries[key]["ici-bytes-per-dispatch"] * 2 + 4096)
+        manifest = {"jax-version": jax.__version__, "entries": entries}
+        fs = compare_manifest(live, manifest, paths)
+        assert [f.rule for f in fs] == ["SHD807"]
+        assert fs[0].severity == "error"
+        assert key in fs[0].message
+
+    def test_count_change_trips_shd807_exactly(self):
+        """Collective COUNTS compare exactly — a new collective is
+        never 'within tolerance'."""
+        live, paths = _echo_live()
+        entries = {k: dict(v) for k, v in live.items()}
+        key = size_key("echo", 2, "lead", 2)
+        entries[key]["tick-collectives"] = {"all_gather": 1}
+        manifest = {"jax-version": jax.__version__, "entries": entries}
+        fs = compare_manifest(live, manifest, paths)
+        assert [f.rule for f in fs] == ["SHD807"]
+        assert "tick-collectives" in fs[0].message
+
+    def test_drift_downgrades_under_toolchain_skew(self):
+        live, paths = _echo_live()
+        entries = {k: dict(v) for k, v in live.items()}
+        key = size_key("echo", 2, "lead", 8)
+        entries[key]["ici-bytes-per-dispatch"] += 10 ** 9
+        manifest = {"jax-version": "0.0.1-not-this-toolchain",
+                    "entries": entries}
+        fs = compare_manifest(live, manifest, paths)
+        assert [f.rule for f in fs] == ["SHD807"]
+        assert fs[0].severity == "warning"
+        assert "--update-shard-manifest" in fs[0].message
+
+    def test_missing_and_stale_entries(self):
+        live, paths = _echo_live()
+        manifest = {"jax-version": jax.__version__,
+                    "entries": {"ghost/n=9/lead/s=2": {}}}
+        fs = compare_manifest(live, manifest, paths,
+                              full_universe=True)
+        assert _rules(fs) == {"SHD805", "SHD806"}
+        # restricted runs never report staleness
+        fs = compare_manifest(live, manifest, paths,
+                              full_universe=False)
+        assert _rules(fs) == {"SHD805"}
+
+    def test_errored_keys_are_not_stale(self):
+        live, paths = _echo_live()
+        key = size_key("echo", 2, "minor", 2)
+        manifest = {"jax-version": jax.__version__,
+                    "entries": {**live, key: {}}}
+        fs = compare_manifest(live, manifest, paths,
+                              full_universe=True, errored={key})
+        assert fs == []
+
+    def test_checked_in_manifest_covers_registry(self):
+        data = load_shard_manifest(DEFAULT_SHARD_MANIFEST)
+        entries = data["entries"]
+        for wl, n in cost_model.cost_specs():
+            for layout in cost_model.AUDIT_LAYOUTS:
+                for s in MESH_SIZES:
+                    assert size_key(wl, n, layout, s) in entries
+        # plus the single-dispatch runner subject
+        assert any(k.startswith("run:") for k in entries)
+
+    def test_restricted_run_gates_clean_against_checked_in(self):
+        fs = run_shard_lint(workloads=[("echo", 2)])
+        assert [f for f in fs if f.severity == "error"] == []
+
+    def test_update_records_and_regates_clean(self, tmp_path):
+        p = str(tmp_path / "m.json")
+        fs = run_shard_lint(workloads=[("echo", 2)], manifest_path=p,
+                            update_manifest=True)
+        assert _rules(fs) == {"SHD808"}
+        fs = run_shard_lint(workloads=[("echo", 2)], manifest_path=p)
+        assert fs == []
+        # tamper: the gate must notice
+        data = json.load(open(p))
+        key = size_key("echo", 2, "lead", 8)
+        data["entries"][key]["ici-bytes-per-dispatch"] += 10 ** 9
+        json.dump(data, open(p, "w"))
+        fs = run_shard_lint(workloads=[("echo", 2)], manifest_path=p)
+        assert "SHD807" in _rules(fs)
+
+
+# --- shared-cache economy + pass wiring ------------------------------------
+
+
+class TestWiring:
+    def test_single_trace_per_model_via_shared_cache(self, monkeypatch):
+        cache = {}
+        run_shard_lint(workloads=[("echo", 2)], trace_cache=cache)
+        # both the plain tick trace and the sharded census landed in
+        # the shared cache, one per layout
+        assert {k for k in cache if k.startswith("shard:")} == {
+            "shard:echo/n=2/lead", "shard:echo/n=2/minor"}
+        assert "echo/n=2/lead" in cache and "echo/n=2/minor" in cache
+
+        def boom(*a, **k):                       # pragma: no cover
+            raise AssertionError("retraced despite warm cache")
+        monkeypatch.setattr(shard_audit, "trace_sharded_chunk", boom)
+        fs = run_shard_lint(workloads=[("echo", 2)],
+                            trace_cache=cache)
+        assert [f for f in fs if f.rule == "SHD800"] == []
+
+    def test_shd_fingerprints_map_to_shard_pass(self):
+        assert fingerprint_pass(
+            "SHD801:maelstrom_tpu/models/ir_hazards.py:"
+            "IrShardCrossTalk") == "shard"
+
+    def test_shard_is_an_extra_pass(self):
+        from maelstrom_tpu.analysis.runner import (ALL_PASSES,
+                                                   EXTRA_PASSES)
+        assert "shard" in EXTRA_PASSES
+        assert "shard" not in ALL_PASSES
+
+    def test_model_failure_trips_shd800(self):
+        fs = run_shard_lint(workloads=[("no-such-workload", 2)])
+        assert "SHD800" in _rules(fs)
+        # its manifest keys are excluded from staleness via `errored`,
+        # and a failed model contributes no live entries
+        assert not any(f.rule == "SHD806" for f in fs)
+
+
+# --- SHD809: static reshardability -----------------------------------------
+
+
+class TestReshardProof:
+    def test_echo_carry_is_reshardable(self):
+        model = EchoModel()
+        sim = cost_model.audit_sim(model, 2, "lead")
+        assert reshard_findings(model, sim, "lead") == []
+
+    def test_broken_kind_metadata_trips_shd809(self, monkeypatch):
+        from maelstrom_tpu.parallel import mesh as mesh_mod
+        model = EchoModel()
+        sim = cost_model.audit_sim(model, 2, "lead")
+        real = mesh_mod.wire_leaf_kinds
+        monkeypatch.setattr(
+            mesh_mod, "wire_leaf_kinds",
+            lambda *a, **k: real(*a, **k)[:-1])
+        fs = reshard_findings(model, sim, "lead")
+        assert _rules(fs) == {"SHD809"}
+        assert "cannot be resharded" in fs[0].message
+
+
+# --- the tunnel-down probe artifact ----------------------------------------
+
+
+class TestMultichipProbe:
+    def test_unreachable_is_a_distinct_status(self, tmp_path):
+        """On a CPU-only host the probe must write an artifact that
+        SAYS the tunnel is down — never a stale healthy-looking one."""
+        out = str(tmp_path / "MULTICHIP_rtest.json")
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools",
+                                          "multichip_probe"),
+             "--round", "test", "--out", out, "--probe-s", "60"],
+            capture_output=True, text=True, timeout=120,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert proc.returncode == 2, proc.stderr
+        rec = json.load(open(out))
+        assert rec["status"] == "unreachable"
+        assert "probe_rc" in rec and "ts" in rec

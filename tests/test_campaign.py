@@ -339,10 +339,114 @@ def test_resume_sharded_bit_identical(tmp_path):
     assert base[0] == res[0]
     assert np.array_equal(base[1], res[1])
     assert np.array_equal(base[2], res[2])
-    # a wrong-size mesh is refused, never silently mis-sharded
+    # without the checkpoint's recorded shard metadata a wrong-size
+    # mesh is still refused, never silently mis-sharded (WITH it the
+    # mismatch reshards — test_cross_mesh_resume_bit_identical)
     with pytest.raises(CheckpointError):
         restore_carry(wire_template(model, sim, make_mesh(4)),
                       ck["carry"])
+
+
+@pytest.mark.shard
+def test_restore_carry_names_shard_counts_on_mismatch():
+    """The reshard route's refusal is actionable: it names both shard
+    counts and the reshard path, not a bare leaf-count complaint."""
+    from maelstrom_tpu.parallel.mesh import (make_mesh, wire_leaf_kinds,
+                                             wire_template)
+    model = EchoModel()
+    sim4 = make_sim_config(model, dict(ECHO_OPTS, n_instances=2))
+    tmpl4 = wire_template(model, sim4, make_mesh(4))
+    leaves = [np.zeros(l.shape, l.dtype)
+              for l in jax.tree.leaves(tmpl4)]
+    shard = {"n-shards": 4, "instances-per-shard": 2,
+             "interleaved": True,
+             "leaf-kinds": wire_leaf_kinds(model, sim4)}
+    # the resume config expects a DIFFERENT global fleet (3 x 2 = 6
+    # instances vs the checkpoint's 4 x 2 = 8): not a pure shard-count
+    # change, so the reshard route must refuse by name
+    sim2 = make_sim_config(model, dict(ECHO_OPTS, n_instances=3))
+    with pytest.raises(CheckpointError) as e:
+        restore_carry(wire_template(model, sim2, make_mesh(2)),
+                      leaves, shard=shard)
+    msg = str(e.value)
+    assert "carry saved at 4 shards, mesh has 2" in msg
+    assert "resharding via reshard_carry" in msg
+    assert "8 instances (4 x 2)" in msg
+
+
+@pytest.mark.shard
+@pytest.mark.slow
+@pytest.mark.parametrize("new_shards", [2, 1])
+def test_cross_mesh_resume_bit_identical(tmp_path, new_shards):
+    """ROADMAP item 1's elastic-resume residual: a checkpoint written
+    at 4 shards resumes at 2 and at 1 shards with fleet stats,
+    per-instance violations, event streams, decoded histories, and
+    checker verdicts all bit-identical to an uninterrupted run at the
+    NEW shard count (global-instance-id RNG + per-leaf reshard kinds;
+    statically verified by `maelstrom lint --shard` SHD809)."""
+    from maelstrom_tpu.parallel.mesh import (make_mesh,
+                                             run_sim_sharded_chunked,
+                                             wire_template)
+    from maelstrom_tpu.tpu.harness import events_to_histories
+    model = EchoModel()
+
+    def sim_at(shards):
+        # the same 8-instance global fleet however it is chunked —
+        # recording ALL of it, so the recorded set (round-robin global
+        # ids) is identical at every shard count
+        return make_sim_config(model, dict(
+            ECHO_OPTS, n_instances=8 // shards,
+            record_instances=8 // shards, time_limit=0.12))
+
+    # uninterrupted oracle at the NEW shard count
+    sim_new = sim_at(new_shards)
+    mesh_new = make_mesh(new_shards)
+    base = run_sim_sharded_chunked(model, sim_new, seed=3,
+                                   mesh=mesh_new, chunk=40)
+
+    # the killed run writes its checkpoint at 4 shards
+    sim4 = sim_at(4)
+    d = str(tmp_path)
+
+    def cb(state, ticks, host):
+        save_checkpoint(d, kind="sharded", state=state, ticks=ticks,
+                        chunks=host["chunks"],
+                        events=tuple(host["events"]),
+                        meta={"shard": host["shard"]})
+        raise Killed
+
+    with pytest.raises(Killed):
+        run_sim_sharded_chunked(model, sim4, seed=3, mesh=make_mesh(4),
+                                chunk=40, checkpoint_cb=cb,
+                                checkpoint_every=1)
+    ck = load_checkpoint(d)
+    assert ck["meta"]["shard"]["n-shards"] == 4
+    assert 0 < ck["ticks"] < sim4.n_ticks
+
+    # resume on the smaller mesh: restore_carry routes the pure
+    # shard-count mismatch through reshard_carry
+    tmpl = wire_template(model, sim_new, mesh_new)
+    resume = ResumeState(
+        carry=restore_carry(tmpl, ck["carry"],
+                            shard=ck["meta"]["shard"]),
+        ticks=ck["ticks"], chunks=ck["chunks"],
+        events=tuple(ck["events"]))
+    res = run_sim_sharded_chunked(model, sim_new, seed=3,
+                                  mesh=mesh_new, chunk=40,
+                                  resume=resume)
+    assert base[0] == res[0]
+    assert np.array_equal(base[1], res[1])
+    assert np.array_equal(base[2], res[2])
+    # the bit-identity carries through decode + checking: same
+    # histories, same verdicts
+    h_base = events_to_histories(model, np.asarray(base[2]))
+    h_res = events_to_histories(model, np.asarray(res[2]))
+    assert h_base == h_res
+    checker = model.checker()
+    opts = dict(ECHO_OPTS, n_instances=8 // new_shards)
+    for hb, hr in zip(h_base, h_res):
+        if hb:
+            assert checker(hb, opts) == checker(hr, opts)
 
 
 def test_triage_on_resumed_run_covers_full_horizon(tmp_path):

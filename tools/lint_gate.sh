@@ -137,6 +137,53 @@ print(f"synthetic horizon: {len(hits)} ABS701 finding(s), e.g. "
 PY2
 
 echo
+echo "== maelstrom lint --shard --strict (SPMD partition audit)"
+# 8 virtual host devices so the SHD804 donation check can compile the
+# partitioned executable at every audited mesh size
+XLA_FLAGS="${XLA_FLAGS:---xla_force_host_platform_device_count=8}" \
+python -m maelstrom_tpu lint --shard --strict
+
+echo
+echo "== shard canary (tampered ICI manifest must fail; planted cross-shard gather must name SHD803)"
+# Simulate (a) a PR that changes the sharded communication pattern
+# without re-recording — inflate one checked-in entry's ICI-bytes
+# estimate, so the live census now drifts past tolerance — and (b) the
+# correctness killer: the planted fixture that gathers across the
+# instance-sharded axis inside the tick must be named SHD803
+# specifically (not merely "some finding"). jax-version is copied
+# through, so (a) also proves same-toolchain drift is a hard error.
+python - "$SMOKE_STORE/shard_tampered.json" <<'PY'
+import json, sys
+man = json.load(open("maelstrom_tpu/analysis/shard_manifest.json"))
+key = next(k for k in sorted(man["entries"]) if k.endswith("/s=8"))
+man["entries"][key]["ici-bytes-per-dispatch"] += 10 ** 9
+json.dump(man, open(sys.argv[1], "w"))
+print(f"tampered entry: {key} (inflated the recorded ICI bytes)")
+PY
+rc=0
+XLA_FLAGS="${XLA_FLAGS:---xla_force_host_platform_device_count=8}" \
+python -m maelstrom_tpu lint --shard --strict \
+    --shard-manifest "$SMOKE_STORE/shard_tampered.json" \
+    > "$SMOKE_STORE/shard-canary.out" || rc=$?
+[[ "$rc" == "1" ]] || { echo "expected exit 1 (ICI drift caught), got $rc"; exit 1; }
+grep -Eq 'ERROR SHD807' "$SMOKE_STORE/shard-canary.out"
+echo "canary caught: $(grep -Ec 'ERROR SHD807' "$SMOKE_STORE/shard-canary.out") SHD807 finding(s)"
+python - <<'PY'
+from maelstrom_tpu.analysis.cost_model import audit_sim
+from maelstrom_tpu.analysis.shard_audit import (census_of_jaxpr,
+                                                hot_loop_findings,
+                                                trace_sharded_chunk)
+from maelstrom_tpu.models.ir_hazards import IrShardCrossTalk
+m = IrShardCrossTalk()
+sim = audit_sim(m, 2, "lead")
+fs = hot_loop_findings(
+    m, census_of_jaxpr(trace_sharded_chunk(m, sim)[0]), "canary",
+    "shard-cross-talk")
+assert any(f.rule == "SHD803" for f in fs), [f.rule for f in fs]
+print(f"planted cross-shard gather named: {sorted(f.rule for f in fs)}")
+PY
+
+echo
 echo "== raft-family fusion budgets hold (fused ticks pin 0 loops)"
 python - <<'PY'
 import json
