@@ -250,6 +250,8 @@ def child_main(canary: bool = False) -> None:
                     nemesis=["partition"], nemesis_interval=0.4,
                     p_loss=0.05, recovery_time=0.3, seed=7,
                     telemetry=bench_telemetry,
+                    check_mode=os.environ.get("BENCH_CHECK_MODE",
+                                              "farm"),
                     **({"netid": True} if bench_wide else {}),
                     **({"fault_fuzz": BENCH_FUZZ_DIST}
                        if bench_fuzz else {}),
@@ -402,6 +404,13 @@ def child_main(canary: bool = False) -> None:
         # BENCH_CHECK=0 skips the stage AND the row retention (a long
         # fleet-scale bench must not accumulate rows it will discard)
         bench_check = os.environ.get("BENCH_CHECK") != "0"
+        # BENCH_CHECK_MODE=farm|device|both A/Bs the device verdict
+        # lanes (checkers/device_summary.py): device/both turn on
+        # Carry.check_summary — the tick pays the lane fold — and
+        # `device` routes ONLY flagged instances into the farm, so the
+        # metric line prices the O(chips) screen against the
+        # O(instances) farm on the same trajectory
+        bench_check_mode = os.environ.get("BENCH_CHECK_MODE", "farm")
         compact_acc = []
         check_stats = {}
         if bench_heartbeat:
@@ -697,10 +706,34 @@ def child_main(canary: bool = False) -> None:
                                  sim.client.final_start, 1, opts, cw)
             for vrows, vn in compact_acc:
                 vp.feed_chunk(vrows, vn, 0, 0)
-            verdicts, _vh, vrec = vp.finish()
+            # device verdict lanes: compute the flagged routing set
+            # from the carry's summary block (device mode farms ONLY
+            # those; farm/both check everything)
+            flagged_route = None
+            summ_np = (np.asarray(carry.check_summary)
+                       if getattr(carry, "check_summary", None)
+                       is not None else None)
+            if summ_np is not None:
+                from maelstrom_tpu.checkers import device_summary
+                fl = np.asarray(device_summary.flagged_mask(
+                    np.asarray(carry.violations), summ_np))
+                check_stats.update(
+                    check_mode=bench_check_mode,
+                    flagged_instances=int(fl.sum()),
+                    summary_bytes_per_tick=device_summary
+                    .summary_bytes_per_tick(sim.n_instances))
+                if bench_check_mode == "device":
+                    flagged_route = [int(i) for i in np.nonzero(fl)[0]
+                                     if i < sim.record_instances]
+            else:
+                check_stats.update(check_mode=bench_check_mode)
+            verdicts, _vh, vrec = vp.finish(flagged=flagged_route)
             check_stats.update(
                 check_workers=vrec["workers"],
-                check_mode=vrec["mode"],
+                check_pool=vrec["mode"],
+                farm_load_fraction=round(
+                    vrec.get("farm-instances", len(verdicts))
+                    / max(1, sim.record_instances), 6),
                 decode_s=vrec["decode-s"],
                 check_s=vrec["check-s"],
                 verdicts_per_s=vrec["verdicts-per-s"],

@@ -1,0 +1,196 @@
+"""Device verdict lanes: O(chips) screening for the host checker farm.
+
+The host verdict stage — even farmed out over worker processes
+(checkers/pool.py) — does O(recorded instances) Python work: decode,
+dict build, check. At the fleet sizes the runtime simulates, a CLEAN
+sweep spends its whole check budget proving nobody misbehaved. This
+module moves that proof on device, the DrJAX map-reduce idiom applied
+to checking: every instance carries a fixed-shape int32 summary row
+(``Carry.check_summary``, [I, N_LANES], batch-LEADING in both carry
+layouts like the telemetry leaves) updated inside the fused tick, and
+the host only ever routes instances whose summary FLAGS lane is nonzero
+(or whose invariants tripped) into the full-oracle farm. Host cost then
+scales with violations found, not instances simulated.
+
+Lane family (all int32; cumulative counters wrap, which is fine — they
+are screening state, not reported figures):
+
+- ``L_FLAGS``    bitmask of device-detected suspicion (``FLAG_*``).
+                 Nonzero = route this instance to the host farm for
+                 full-oracle confirmation. A flag is a *screen*, never
+                 a verdict: false positives cost farm work, and the
+                 committed-prefix / monotonicity lanes are constructed
+                 so the batch anomalies the full checkers catch leave a
+                 device-visible trace.
+- ``L_HASH``     committed-prefix rolling hash — the model's
+                 ``summary_step`` folds an order-sensitive hash of the
+                 reference node's committed prefix, so prefix rewrites
+                 show up as hash churn on a frontier that did not move.
+- ``L_FRONTIER`` the committed watermark (max commit index / committed
+                 offsets / CRDT element count — model-defined), monotone
+                 non-decreasing on every correct trajectory.
+- ``L_READ_FRONTIER`` monotonic max of every frontier observed — the
+                 WGL/stale-read witness: a frontier BELOW it means
+                 committed state regressed.
+- ``L_STALE``    count of regression ticks (forensics: how long the
+                 regression persisted).
+- ``L_OK``/``L_FAIL``/``L_INFO`` availability counter twins folded from
+                 the per-tick event tensor's completion slot — the
+                 prefix-summary counters ROADMAP item 2 names, now per
+                 instance instead of fleet-scalar.
+- ``L_SENT``/``L_DELIVERED`` net-stats counter twins (per-instance send
+                 and delivery deltas summed over the run).
+- ``L_SCRATCH``  model-private scratch state — e.g. the CRDT family's
+                 unsettled-window shift register (reads served while a
+                 replica lagged the acknowledged floor are the
+                 interval-checker anomalies, and the register covers
+                 the reply-flight ticks between serve and completion).
+
+``Model.summary_step`` (tpu/runtime.py) is the per-model hook: given
+one instance's [N_LANES] row, its full per-node state pytree, and its
+[C, 2, 2+V] event rows for the tick, fold this tick's
+frontier/hash/divergence via :func:`fold_frontier`. The
+default is identity — models without a summary lane still get the
+event/net twins, and their flags stay 0 (a clean sweep reports
+``farm_load_fraction=0``).
+
+Everything here is pure per-instance elementwise int32 math: no
+cross-instance (and no cross-shard) communication, so the tick hot
+loop stays ICI-silent under ``maelstrom lint --shard`` and the lanes
+ride the shard_map wire as ordinary instance-sharded leaves.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# lane indices -------------------------------------------------------------
+
+N_LANES = 11
+(L_FLAGS, L_HASH, L_FRONTIER, L_READ_FRONTIER, L_STALE,
+ L_OK, L_FAIL, L_INFO, L_SENT, L_DELIVERED, L_SCRATCH) = range(N_LANES)
+
+# L_FLAGS bits
+FLAG_DIVERGED = 1    # committed-prefix divergence (model summary_step)
+FLAG_REGRESSION = 2  # frontier fell below the read frontier (WGL witness)
+FLAG_MODEL = 4       # model-specific extra condition (e.g. kafka
+                     # committed-past-log, counter views above source)
+
+# event wire constants — the stable history-event encoding
+# (tpu/runtime.py EV_*; mirrored here like native/engine.py does, so
+# this module never imports the runtime it is imported by)
+_EV_TYPE = 0
+_EV_OK, _EV_FAIL, _EV_INFO = 2, 3, 4
+
+# odd multipliers for the rolling hash (int32 wraparound is the
+# intended modulus; constants chosen to fit int32)
+HASH_C1 = jnp.int32(40503)
+HASH_C2 = jnp.int32(999983)
+
+
+def init_summary(n_instances: int) -> jnp.ndarray:
+    """Fresh [I, N_LANES] summary block (batch-LEADING, both layouts)."""
+    return jnp.zeros((n_instances, N_LANES), jnp.int32)
+
+
+def prefix_hash(terms, bodies, in_prefix) -> jnp.ndarray:
+    """Order-sensitive int32 hash of a masked log prefix: ``terms``
+    [LOGN], ``bodies`` [LOGN, E], ``in_prefix`` [LOGN] bool. Position
+    enters through a per-slot odd multiplier, so swapped entries (same
+    multiset, different order) hash differently."""
+    pos = jnp.arange(terms.shape[0], dtype=jnp.int32)
+    contrib = (terms * HASH_C1
+               + jnp.sum(bodies, axis=-1, dtype=jnp.int32) * HASH_C2
+               + pos)
+    return jnp.sum(jnp.where(in_prefix, contrib * ((pos << 1) | 1), 0),
+                   dtype=jnp.int32)
+
+
+def fold_frontier(summ, frontier, hash_val, diverged=None,
+                  model_flag=None) -> jnp.ndarray:
+    """Fold one tick's (frontier, hash[, divergence]) into one
+    instance's [N_LANES] row — the shared lane bookkeeping every model
+    ``summary_step`` delegates to: store the watermark + hash, advance
+    the monotonic read frontier, and raise the regression flag when the
+    watermark fell below anything previously observed."""
+    frontier = jnp.asarray(frontier, jnp.int32)
+    read_f = summ[L_READ_FRONTIER]
+    regressed = frontier < read_f
+    flags = summ[L_FLAGS] | jnp.where(regressed, FLAG_REGRESSION, 0)
+    if diverged is not None:
+        flags = flags | jnp.where(diverged, FLAG_DIVERGED, 0)
+    if model_flag is not None:
+        flags = flags | jnp.where(model_flag, FLAG_MODEL, 0)
+    summ = summ.at[L_FLAGS].set(flags)
+    summ = summ.at[L_HASH].set(jnp.asarray(hash_val, jnp.int32))
+    summ = summ.at[L_FRONTIER].set(frontier)
+    summ = summ.at[L_READ_FRONTIER].set(jnp.maximum(read_f, frontier))
+    summ = summ.at[L_STALE].add(regressed.astype(jnp.int32))
+    return summ
+
+
+def update_summary(model, summ, node_state, events, n_sent, n_del,
+                   cfg, params, state_axis: int = 0) -> jnp.ndarray:
+    """One tick of the whole fleet's summary block ([I, N_LANES]):
+    vmap the model's per-instance ``summary_step`` over the batch, then
+    fold the availability + net-stat counter twins from tensors both
+    tick paths already produce batch-LEADING (the full-fleet event
+    tensor pre-``[:R]`` slice and the per-instance stat deltas).
+
+    ``state_axis`` is the instance axis of ``node_state`` leaves: 0 on
+    the lead layout, -1 on minor. The per-instance trace is the same
+    function either way, so summary lanes are bit-identical across
+    layouts exactly like the trajectories they summarize."""
+    if summ is None:
+        return None
+    with jax.named_scope("check_summary"):
+        summ = jax.vmap(
+            lambda s, st, ev: model.summary_step(s, st, ev, cfg,
+                                                 params),
+            in_axes=(0, state_axis, 0))(summ, node_state, events)
+        # completion-slot event types [I, C]: slot 0 is the completion
+        # row; invocations (slot 1) are not availability outcomes
+        et = events[:, :, 0, _EV_TYPE]
+        counts = jnp.stack(
+            [jnp.sum(et == _EV_OK, axis=1, dtype=jnp.int32),
+             jnp.sum(et == _EV_FAIL, axis=1, dtype=jnp.int32),
+             jnp.sum(et == _EV_INFO, axis=1, dtype=jnp.int32),
+             n_sent.astype(jnp.int32),
+             n_del.astype(jnp.int32)], axis=1)
+        return summ.at[:, L_OK:L_SCRATCH].add(counts)
+
+
+def stale_read_window(summ, events, unsettled, read_f):
+    """CRDT stale-read screen. ``unsettled`` is this tick's "some
+    replica lags the acknowledged floor" witness; shift it into the
+    L_SCRATCH window register (31 ticks) and return ``(summ', stale)``
+    where ``stale`` is True when a read completed this tick with any
+    unsettled tick inside the window. The window covers the reply
+    flight between the serve tick (where the stale value was read) and
+    the completion tick (where the event is recorded) — if every
+    replica held the full acknowledged state at serve time, the read
+    value lands inside the interval checker's acceptable set, so this
+    screens the CRDT family's stale/lost-element anomalies with no
+    false negatives up to the window length."""
+    win = (((summ[L_SCRATCH] << 1) | unsettled.astype(jnp.int32))
+           & 0x7FFFFFFF)
+    read_done = jnp.any((events[:, 0, _EV_TYPE] == _EV_OK)
+                        & (events[:, 0, 1] == read_f))
+    return summ.at[L_SCRATCH].set(win), read_done & (win != 0)
+
+
+def flagged_mask(violations, check_summary) -> jnp.ndarray:
+    """[I] bool — instances needing host confirmation: on-device
+    invariants tripped OR any summary flag raised. Works on device
+    (chunk scans) and on fetched numpy arrays (harness routing)."""
+    flagged = violations > 0
+    if check_summary is not None:
+        flagged = flagged | (check_summary[:, L_FLAGS] != 0)
+    return flagged
+
+
+def summary_bytes_per_tick(n_instances: int) -> int:
+    """HBM traffic the lane family adds per tick (read + write of the
+    block counted once — the reporting convention bench.py uses)."""
+    return int(n_instances) * N_LANES * 4

@@ -179,15 +179,26 @@ def _worker_main(widx: int, spec: Dict[str, Any], task_q,
     death; anything structural reports ``error`` and the parent falls
     back to the serial path."""
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    native = spec.get("engine") == "native"
     try:
-        from ..tpu.decode import materialize_records
-        model = _rebuild_model(spec)
-        checker = model.checker()
-        name = checker_name(model)
-        final_start = spec["final-start"]
-        mpt = spec["ms-per-tick"]
-        check_opts = spec["opts"]
-        inc_cls = INCREMENTAL_CHECKERS.get(spec["workload"])
+        if native:
+            # native-engine farm: histories arrive pre-decoded as
+            # "records" tasks, so there is no model/decode machinery —
+            # just the harness's single-arg per-workload checker
+            from ..native.harness import _checker_for
+            materialize_records = model = None
+            checker = _checker_for(spec["workload"],
+                                   spec.get("consistency"))
+            final_start, mpt, check_opts, inc_cls = 0, 1.0, {}, None
+        else:
+            from ..tpu.decode import materialize_records
+            model = _rebuild_model(spec)
+            checker = model.checker()
+            name = checker_name(model)
+            final_start = spec["final-start"]
+            mpt = spec["ms-per-tick"]
+            check_opts = spec["opts"]
+            inc_cls = INCREMENTAL_CHECKERS.get(spec["workload"])
         result_q.put((_READY, widx, None))
     except BaseException:
         result_q.put((_FAILED, widx, traceback.format_exc()[-2000:]))
@@ -213,9 +224,24 @@ def _worker_main(widx: int, spec: Dict[str, Any], task_q,
                         incremental[inst].feed(records)
                     else:
                         histories.setdefault(inst, []).extend(records)
+            elif kind == "records":
+                # native-engine twin of "chunk": already-materialized
+                # dict records, appended verbatim
+                for inst, records in task[1].items():
+                    histories.setdefault(inst, []).extend(records)
             elif kind == "finalize":
                 verdicts: Dict[int, dict] = {}
                 for inst in task[1]:
+                    if native:
+                        try:
+                            verdicts[inst] = checker(
+                                histories.get(inst, []))
+                        except Exception as e:
+                            # the native harness's error shape — a
+                            # checker blow-up is a failing verdict
+                            verdicts[inst] = {"valid?": False,
+                                              "error": repr(e)}
+                        continue
                     try:
                         if inc_cls is not None:
                             acc = incremental.get(inst)
@@ -329,6 +355,23 @@ class CheckerPool:
             self.broken = True
         self.feed_s += time.monotonic() - t0
 
+    def feed_records(self, records_by_inst: Dict[int, List[dict]]
+                     ) -> None:
+        """Native-engine twin of :meth:`feed`: route already-decoded
+        dict records (whole or partial histories) to their owners."""
+        if self.broken:
+            return
+        t0 = time.monotonic()
+        per_worker: Dict[int, Dict[int, Any]] = {}
+        for inst, records in records_by_inst.items():
+            per_worker.setdefault(self.owner(inst), {})[inst] = records
+        try:
+            for w, batch in per_worker.items():
+                self._task_qs[w].put(("records", batch))
+        except Exception:
+            self.broken = True
+        self.feed_s += time.monotonic() - t0
+
     def finalize(self, instances: List[int],
                  timeout: float = 600.0) -> Optional[Dict[int, dict]]:
         """Ask every worker for its owned verdicts; assemble in
@@ -438,14 +481,26 @@ class VerdictPipeline:
         self.feed_chunk = self.decoder.feed
         self.feed_dense = self.decoder.feed_dense
 
-    def finish(self):
+    def finish(self, flagged=None):
+        """``flagged=None`` checks every recorded instance (farm mode).
+        A list routes ONLY those recorded indices through the farm —
+        the device-verdict screen (``--check-mode device``): unflagged
+        instances were proven clean on device and get a synthesized
+        ``{"valid?": True, "checked-by": "device-summary"}`` verdict
+        without any host checker work. A flagged instance's verdict is
+        byte-identical to farm mode's by construction — same fed
+        slabs, same owner worker, same checker call."""
         histories = self.decoder.finish()
-        checked = list(range(self._R))
+        if flagged is None:
+            checked = list(range(self._R))
+        else:
+            checked = sorted({int(i) for i in flagged
+                              if 0 <= int(i) < self._R})
         mode = "serial"
         verdicts_map = None
         t0 = time.monotonic()
         if self.pool is not None:
-            verdicts_map = self.pool.finalize(checked)
+            verdicts_map = self.pool.finalize(checked) if checked else {}
             mode = ("pooled" if verdicts_map is not None
                     else "pooled-fallback-serial")
         if verdicts_map is None:
@@ -460,14 +515,21 @@ class VerdictPipeline:
                     verdicts_map[inst] = checker_failure(
                         e, checker=name, instance=inst)
         check_s = time.monotonic() - t0
-        verdicts = [verdicts_map[inst] for inst in checked]
+        if flagged is None:
+            verdicts = [verdicts_map[inst] for inst in checked]
+        else:
+            verdicts = [verdicts_map[inst] if inst in verdicts_map
+                        else {"valid?": True,
+                              "checked-by": "device-summary"}
+                        for inst in range(self._R)]
         record = {
             "mode": mode,
             "workers": self.workers if mode == "pooled" else 0,
             "instances": self._R,
+            "farm-instances": len(checked),
             "decode-s": round(self.decoder.decode_s, 4),
             "check-s": round(check_s, 4),
-            "verdicts-per-s": (round(self._R / check_s, 1)
+            "verdicts-per-s": (round(len(checked) / check_s, 1)
                                if check_s > 0 else None),
         }
         if self.pool is not None:
@@ -478,6 +540,42 @@ class VerdictPipeline:
     def close(self) -> None:
         if self.pool is not None:
             self.pool.close()
+
+
+def check_native_histories(workload: str, histories,
+                           consistency: Optional[str] = None,
+                           workers: int = 0) -> List[dict]:
+    """The native engine's serial check loop, farmed: fan the
+    per-instance verdict work of ``native/harness.py`` over the checker
+    pool. Histories arrive already decoded (plain dict records straight
+    from the C++ engine), so workers receive them verbatim via
+    ``"records"`` tasks and run the harness's single-arg per-workload
+    checker. Assembly is instance-ordered, and ANY pool failure falls
+    back to the serial loop — verdicts are byte-identical either way,
+    including the error shape ``{"valid?": False, "error": repr(e)}``
+    for a checker blow-up."""
+    n = len(histories)
+    if workers > 0 and n > 0:
+        pool = CheckerPool({"engine": "native", "workload": workload,
+                            "consistency": consistency}, workers)
+        try:
+            if not pool.broken:
+                pool.feed_records(dict(enumerate(histories)))
+                verdicts = pool.finalize(list(range(n)))
+                if verdicts is not None:
+                    return [verdicts[i] for i in range(n)]
+        finally:
+            pool.close()
+    from ..native.harness import _checker_for
+    checker = _checker_for(workload, consistency)
+    out = []
+    for h in histories:
+        try:
+            v = checker(h)
+        except Exception as e:
+            v = {"valid?": False, "error": repr(e)}
+        out.append(v)
+    return out
 
 
 def check_instances(model, histories, opts: Dict[str, Any],

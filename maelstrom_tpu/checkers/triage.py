@@ -87,6 +87,13 @@ def load_run_info(run_dir: str) -> Dict[str, Any]:
     if results:
         flagged = list(results.get("invariants", {})
                        .get("violating-instance-ids", []))
+        # device verdict lanes (--check-mode device/both) flag
+        # instances beyond the invariant trips — union them in so
+        # triage replays every device-suspect instance too
+        for i in (results.get("check", {})
+                  .get("flagged-instance-ids", [])):
+            if i not in flagged:
+                flagged.append(i)
     if not flagged and hb:
         flagged = flagged_instances(hb)
 

@@ -243,6 +243,18 @@ def add_test_options(p: argparse.ArgumentParser):
                         "pool only for >= 16 recorded instances on a "
                         "multi-core host. Verdicts are identical at "
                         "every setting")
+    p.add_argument("--check-mode", choices=["farm", "device", "both"],
+                   default="farm",
+                   help="TPU runtime: host verdict routing. `farm` "
+                        "checks every recorded instance (the PR-13 "
+                        "pipeline); `device` keeps O(1)-per-instance "
+                        "summary lanes in the fused tick (checkers/"
+                        "device_summary.py) and routes ONLY flagged "
+                        "instances to the farm — O(chips) checking; "
+                        "`both` runs the farm on everything AND "
+                        "audits that every farm-invalid instance was "
+                        "device-flagged (the A/B oracle). Flagged "
+                        "verdicts are byte-identical across modes")
     p.add_argument("--compile-cache", default=".jax_cache",
                    help="persistent XLA compile cache dir (default "
                         ".jax_cache; MAELSTROM_COMPILE_CACHE=0 or "
@@ -419,6 +431,7 @@ def cmd_test(args) -> int:
             nemesis_schedule=schedule,
             n_instances=args.n_instances,
             record_instances=args.record_instances,
+            check_workers=args.check_workers,
             seed=args.seed if args.seed is not None else 0,
             store_root=args.store,
             **({} if args.recovery_time is None
@@ -478,6 +491,7 @@ def cmd_test(args) -> int:
             checkpoint_every=args.checkpoint_every,
             compile_cache=args.compile_cache,
             check_workers=args.check_workers,
+            check_mode=args.check_mode,
             node_count=node_count, concurrency=concurrency,
             rate=args.rate, time_limit=args.time_limit,
             latency=args.latency, latency_dist=args.latency_dist,

@@ -143,6 +143,30 @@ class GossipSetModel(Model):
         return row, gossip_out(row, node_idx, key, cfg, params,
                                self.gossip_prob)
 
+    def summary_step(self, summ, node_state, events, cfg, params):
+        """Grow-only set device lane: frontier = popcount of the
+        N-node union bitmask (a g-set only grows, so the union is
+        monotone on every correct trace — an element vanishing fleet-
+        wide regresses it); hash folds the union words. Stale screen:
+        a read completing while some view still misses an element
+        another node holds may show a lost element to the host
+        checker, so it raises FLAG_MODEL via the unsettled-window
+        register."""
+        from ..checkers import device_summary
+        union = node_state[0]                              # [2] words
+        unsettled = jnp.zeros((), bool)
+        for i in range(1, cfg.n_nodes):
+            union = union | node_state[i]
+            unsettled = unsettled | jnp.any(node_state[i] != node_state[0])
+        frontier = jnp.sum(jax.lax.population_count(union),
+                           dtype=jnp.int32)
+        h = (union[0] * device_summary.HASH_C1
+             + union[1] * device_summary.HASH_C2)
+        summ, stale = device_summary.stale_read_window(
+            summ, events, unsettled, F_READ)
+        return device_summary.fold_frontier(summ, frontier, h,
+                                            model_flag=stale)
+
     # --- client side ------------------------------------------------------
 
     def sample_op(self, key, uniq, cfg, params):
@@ -306,6 +330,31 @@ class PNCounterModel(Model):
     def tick(self, row, node_idx, t, key, cfg, params):
         return row, gossip_out(row.reshape(-1), node_idx, key, cfg, params,
                                self.gossip_prob)
+
+    def summary_step(self, summ, node_state, events, cfg, params):
+        """Counter-slab device lane over the [viewer N, origin N, 2]
+        table: frontier = the per-origin fleet max summed over origins
+        and both polarity lanes — add bumps and max-merges only grow
+        entries, so it is monotone on every correct trace. Model flag:
+        some viewer's entry for origin o exceeds o's OWN entry —
+        impossible when views only propagate by gossip from the
+        origin — or a read completing while some view still LAGS the
+        acknowledged floor (the interval checker's stale-read
+        anomaly), screened via the unsettled-window register."""
+        from ..checkers import device_summary
+        best = jnp.max(node_state, axis=0)                 # [N, 2]
+        frontier = jnp.sum(best, dtype=jnp.int32)
+        n = node_state.shape[0]
+        own = node_state[jnp.arange(n), jnp.arange(n)]     # [N, 2]
+        inflated = jnp.any(node_state > own[None, :, :])
+        unsettled = jnp.any(node_state < own[None, :, :])
+        pos = jnp.arange(best.size, dtype=jnp.int32)
+        h = jnp.sum((best.reshape(-1) * device_summary.HASH_C1 + pos)
+                    * ((pos << 1) | 1), dtype=jnp.int32)
+        summ, stale = device_summary.stale_read_window(
+            summ, events, unsettled, F_READ)
+        return device_summary.fold_frontier(summ, frontier, h,
+                                            model_flag=inflated | stale)
 
     # --- client side ------------------------------------------------------
 

@@ -232,6 +232,30 @@ class KafkaModel(Model):
         # committed offsets never exceed the log end
         return jnp.any(node_state.committed >= node_state.log_len)
 
+    def summary_step(self, summ, node_state: KafkaRow, events, cfg,
+                     params):
+        """Committed-offset device lane: frontier = the committed
+        watermark summed over every (node, key) — per-slot commits only
+        advance on a correct trace (commit_monotonic), so a blind
+        overwrite downward (KafkaCommitRegression) regresses the sum
+        even when some other node still holds a higher offset and a
+        fleet-max watermark would mask it. The hash folds every node's
+        committed log prefix (forensic only: replication catching up
+        legitimately churns it, so no flag keys off it); the model flag
+        mirrors the committed-past-log-end invariant."""
+        from ..checkers import device_summary
+        del events
+        committed = node_state.committed                   # [N, K]
+        frontier = jnp.sum(committed + 1, dtype=jnp.int32)
+        pos = jnp.arange(self.log_cap, dtype=jnp.int32)    # [cap]
+        in_pref = pos[None, None, :] <= committed[:, :, None]
+        contrib = ((node_state.log_vals * device_summary.HASH_C1 + pos)
+                   * ((pos << 1) | 1))
+        h = jnp.sum(jnp.where(in_pref, contrib, 0), dtype=jnp.int32)
+        return device_summary.fold_frontier(
+            summ, frontier, h,
+            model_flag=jnp.any(committed >= node_state.log_len))
+
     # --- client side ------------------------------------------------------
 
     def sample_op(self, key, uniq, cfg, params):

@@ -369,6 +369,41 @@ class RaftModel(Model):
         overwrote = jnp.any(node_state.truncated_committed > 0)
         return two_leaders | log_mismatch | overwrote
 
+    def summary_step(self, summ, node_state: RaftRow, events, cfg,
+                     params):
+        """Committed-prefix device lane: frontier = the fleet's max
+        commit index (monotone — commit_idx is a DURABLE_LANE, so even
+        crash-restart never rolls the max back on a correct trace);
+        hash = the max-commit reference node's committed-prefix rolling
+        hash; divergence = committed-prefix hash disagreement at the
+        fleet MIN commit (every node has committed that far, so on a
+        correct trace all N hashes agree — the O(N·LOGN) shadow of
+        invariants' O(N·LOGN·E) entry diff), the sticky overwrote
+        witness, or an applied-entry truncation: ``last_applied`` never
+        rolls back and on a correct trace applied <= committed <= log
+        end, so a log end BELOW it means an applied entry vanished —
+        exactly the dirty-apply family's lost acked txns (those models
+        reply at apply time), invisible to the committed-prefix lanes
+        because the truncated entries were never committed."""
+        from ..checkers import device_summary
+        del events
+        commit = node_state.commit_idx                     # [N]
+        frontier = jnp.max(commit)
+        ref = jnp.argmax(commit)
+        pos = jnp.arange(self.log_cap, dtype=jnp.int32)
+        h = device_summary.prefix_hash(
+            node_state.log_term[ref], node_state.log_body[ref],
+            pos < frontier)
+        in_lo = pos < jnp.min(commit)                      # [LOGN]
+        hs = jax.vmap(lambda lt, lb: device_summary.prefix_hash(
+            lt, lb, in_lo))(node_state.log_term, node_state.log_body)
+        diverged = (jnp.any(hs != hs[ref])
+                    | jnp.any(node_state.truncated_committed > 0)
+                    | jnp.any(node_state.log_len
+                              < node_state.last_applied))
+        return device_summary.fold_frontier(summ, frontier, h,
+                                            diverged=diverged)
+
     # --- client side ------------------------------------------------------
 
     def sample_op(self, key, uniq, cfg, params):

@@ -319,7 +319,10 @@ def _carry_to_wire(c: Carry, sim: SimConfig) -> Carry:
         stats=jax.tree.map(lambda x: x.reshape(1), c.stats),
         violations=c.violations,
         key=c.key.reshape(1, *c.key.shape),
-        telemetry=tel)
+        telemetry=tel,
+        # device verdict lanes are [I, N_LANES] batch-LEADING in both
+        # layouts — already wire-shaped (an ordinary instance leaf)
+        check_summary=c.check_summary)
 
 
 def _carry_from_wire(w: Carry, sim: SimConfig) -> Carry:
@@ -336,7 +339,8 @@ def _carry_from_wire(w: Carry, sim: SimConfig) -> Carry:
         stats=jax.tree.map(lambda x: x.reshape(()), w.stats),
         violations=w.violations,
         key=w.key.reshape(*w.key.shape[1:]),
-        telemetry=tel)
+        telemetry=tel,
+        check_summary=w.check_summary)
     return carry_from_canonical(c, sim)
 
 
@@ -439,8 +443,14 @@ def make_sharded_chunk_fn(model: Model, sim: SimConfig, mesh: Mesh,
             # the heartbeat reads them after the wire is donated away.
             # The scan rows carry GLOBAL instance ids — no host remap.
             svec = jnp.stack(list(carry.stats)).reshape(1, -1)
+            viol_src = carry.violations
+            if carry.check_summary is not None:
+                from ..checkers import device_summary
+                viol_src = viol_src + (
+                    carry.check_summary[:, device_summary.L_FLAGS]
+                    != 0).astype(jnp.int32)
             scan = violation_scan(
-                carry.violations, carry.telemetry, ids, k=scan_k)[None]
+                viol_src, carry.telemetry, ids, k=scan_k)[None]
             return _carry_to_wire(carry, sim), events, svec, scan
         return _shard_map(
             body, mesh=mesh,
@@ -460,7 +470,8 @@ def run_sim_sharded_chunked(model: Model, sim: SimConfig, seed: int,
                             scan_k: Optional[int] = None,
                             checkpoint_cb=None,
                             checkpoint_every: int = 0,
-                            resume=None):
+                            resume=None, check_mode: Optional[str] = None,
+                            return_check_summary: bool = False):
     """:func:`run_sim_sharded` issued as a sequence of ``chunk``-tick
     device dispatches — the production dispatch pattern (single giant
     dispatches fault the TPU tunnel; see bench.py) — with the carry left
@@ -573,6 +584,12 @@ def run_sim_sharded_chunked(model: Model, sim: SimConfig, seed: int,
                 from ..faults import fuzz as faults_fuzz
                 extra = {"fault-fuzz": faults_fuzz.span_counters(
                     fuzz_windows, t0, length)}
+            if sim.check_summary and check_mode:
+                extra = dict(extra or {})
+                extra["check"] = {
+                    "mode": check_mode,
+                    "flagged": int(scan_np[0, 0]),
+                    "of": sim.n_instances * n_shards}
             heartbeat.record_chunk(
                 chunk=chunk_idx[0], t0=t0, ticks=length,
                 net=stats_vec_to_net(np.asarray(svec).sum(axis=0)),
@@ -630,6 +647,14 @@ def run_sim_sharded_chunked(model: Model, sim: SimConfig, seed: int,
                 lambda x: deinterleave(x, n_shards, axis=0), tel)
             tel = tel._replace(series=series)
         out = out + (tel,)
+    if return_check_summary:
+        cs = wire.check_summary
+        if cs is not None:
+            # an ordinary instance-sharded wire leaf: shard-major
+            # across shards, deinterleave to global-id order like
+            # ``violations``
+            cs = deinterleave(np.asarray(cs), n_shards, axis=0)
+        out = out + (cs,)
     return out
 
 

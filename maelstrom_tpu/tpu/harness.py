@@ -141,6 +141,17 @@ TPU_DEFAULTS = dict(
                               # histories are byte-identical at every
                               # setting, incl. auto-fallback when the
                               # pool dies (tests/test_check_pool.py)
+    check_mode="farm",        # verdict routing (checkers/
+                              # device_summary.py): "farm" checks every
+                              # recorded instance on host (the PR-13
+                              # pipeline); "device" carries per-instance
+                              # summary lanes in the tick and the farm
+                              # confirms ONLY flagged instances (host
+                              # cost scales with violations found, not
+                              # instances simulated); "both" runs the
+                              # full farm AND the lanes and cross-audits
+                              # them (the A/B oracle — verdicts must be
+                              # byte-identical)
     seed=0,
 )
 
@@ -290,6 +301,10 @@ def make_sim_config(model: Model, opts: Dict[str, Any]) -> SimConfig:
                              1), 31),
         stride=stride,
         n_windows=max(1, -(-n_ticks // stride)))
+    check_mode = o.get("check_mode") or "farm"
+    if check_mode not in ("farm", "device", "both"):
+        raise ValueError(f"unknown check_mode {check_mode!r} "
+                         "(expected farm/device/both)")
     return SimConfig(net=net, client=client, nemesis=nemesis,
                      faults=faults,
                      n_instances=o["n_instances"], n_ticks=n_ticks,
@@ -297,7 +312,8 @@ def make_sim_config(model: Model, opts: Dict[str, Any]) -> SimConfig:
                                           o["n_instances"]),
                      journal_instances=journal_instances,
                      layout=resolve_layout(o["layout"]),
-                     telemetry=telemetry)
+                     telemetry=telemetry,
+                     check_summary=check_mode in ("device", "both"))
 
 
 def events_to_histories(model: Model, events: np.ndarray,
@@ -426,7 +442,8 @@ def _pipelined_phase_run(model: Model, sim: SimConfig, seed: int, params,
             # the streaming verdict pipeline consumes the compact
             # chunks directly — never reconstruct the dense tensor
             event_sink=event_sink,
-            dense_events=event_sink is None)
+            dense_events=event_sink is None,
+            check_mode=opts.get("check_mode"))
     finally:
         if profiling:
             try:
@@ -454,7 +471,7 @@ _REPRO_OPT_KEYS = (
     # behavioral knobs `campaign resume` replays from the header so a
     # resumed run re-runs under the SAME policy it started with
     "pipeline", "fail_fast", "scan_top_k", "funnel", "funnel_max",
-    "checkpoint_every", "check_workers",
+    "checkpoint_every", "check_workers", "check_mode",
     # fault-plan engine (maelstrom_tpu/faults/): the plan — or the
     # fuzz distribution whose per-instance schedules derive from the
     # seed — is part of the trajectory, so triage/resume/shrink must
@@ -700,8 +717,31 @@ def run_tpu_test(model: Model, opts: Optional[Dict[str, Any]] = None,
     # decode finalize + per-instance verdicts: pooled (instance-ordered
     # assembly) or serial — byte-identical either way; histories stay
     # lazy column slabs until something (store writer, availability,
-    # journal stats) actually reads the dict records
-    per_instance, histories, check_rec = verdict.finish()
+    # journal stats) actually reads the dict records.
+    # --check-mode device: the device summary lanes + invariants decide
+    # WHICH recorded instances the farm confirms; everything unflagged
+    # was proven clean on device and never costs host checker work
+    check_mode = opts.get("check_mode") or "farm"
+    violations = np.asarray(carry.violations)
+    summ_np = (np.asarray(carry.check_summary)
+               if carry.check_summary is not None else None)
+    flagged_all = violations > 0
+    if summ_np is not None:
+        from ..checkers import device_summary
+        flagged_all = flagged_all | (
+            summ_np[:, device_summary.L_FLAGS] != 0)
+    flagged_ids = np.nonzero(flagged_all)[0]
+    if check_mode == "device":
+        per_instance, histories, check_rec = verdict.finish(
+            flagged=[int(i) for i in flagged_ids
+                     if i < sim.record_instances])
+    else:
+        per_instance, histories, check_rec = verdict.finish()
+    if summ_np is not None:
+        check_rec["check-mode"] = check_mode
+        farm_n = check_rec.get("farm-instances", len(per_instance))
+        check_rec["farm-load-fraction"] = round(
+            farm_n / max(1, sim.record_instances), 6)
     phases["check"] = check_rec
     availability = None
     if opts.get("availability") is not None:
@@ -713,7 +753,6 @@ def run_tpu_test(model: Model, opts: Optional[Dict[str, Any]] = None,
                   if r.get("valid?") in (True, "unknown"))
     stats = carry.stats
     total_msgs = int(stats.delivered)
-    violations = np.asarray(carry.violations)
     n_violating = int((violations > 0).sum())
     # three-valued verdict (reference doc/results.md:58-64); an on-device
     # invariant violation on any instance is a definite failure
@@ -754,6 +793,32 @@ def run_tpu_test(model: Model, opts: Optional[Dict[str, Any]] = None,
             "dropped-overflow": int(stats.dropped_overflow),
         },
     }
+    if summ_np is not None:
+        from ..checkers import device_summary
+        results["check"] = {
+            "mode": check_mode,
+            # fleet-wide (not just recorded): triage replays these
+            "flagged-instances": int(flagged_all.sum()),
+            "flagged-instance-ids": flagged_ids[:1024].tolist(),
+            "farm-instances": check_rec.get("farm-instances",
+                                            len(per_instance)),
+            "farm-load-fraction": check_rec.get("farm-load-fraction",
+                                                1.0),
+            "summary-bytes-per-tick":
+                device_summary.summary_bytes_per_tick(sim.n_instances),
+        }
+        if check_mode == "both":
+            # the A/B oracle: the farm checked EVERYTHING, so any
+            # farm-invalid recorded instance the lanes did NOT flag is
+            # a screening gap — device mode would have synthesized a
+            # clean verdict for it
+            missed = [i for i, r in enumerate(per_instance)
+                      if r.get("valid?") is False
+                      and not bool(flagged_all[i])]
+            results["check"]["device-vs-farm"] = {
+                "complete": not missed, "missed-instance-ids": missed}
+            if missed:
+                results["valid?"] = False
     pipe_stats = phases.get("pipeline")
     # on a fail-fast stop only the dispatched prefix ran — perf must
     # report the ticks that actually executed, not the planned horizon

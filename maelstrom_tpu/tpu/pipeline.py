@@ -460,7 +460,18 @@ def make_chunk_fn(model: Model, sim: SimConfig, params, instance_ids,
         # without touching the carry the NEXT dispatch donates away
         # (bench.py's overlapped metric loop, telemetry/stream.py)
         stats_vec = jnp.stack(list(carry.stats))
-        scan_vec = violation_scan(carry.violations, carry.telemetry,
+        # with device verdict lanes on, the scan counts FLAGGED
+        # instances (invariant trips OR summary flags): the heartbeat's
+        # per-chunk count becomes the farm's prospective workload and
+        # fail-fast trips on any device-detected suspicion — the
+        # summary reduce rides the existing top-K machinery
+        viol_src = carry.violations
+        if carry.check_summary is not None:
+            from ..checkers import device_summary
+            viol_src = viol_src + (
+                carry.check_summary[:, device_summary.L_FLAGS]
+                != 0).astype(jnp.int32)
+        scan_vec = violation_scan(viol_src, carry.telemetry,
                                   jnp.asarray(instance_ids, jnp.int32),
                                   k=scan_k)
         return carry, stats_vec, scan_vec, buf, journal
@@ -482,7 +493,8 @@ def run_sim_pipelined(model: Model, sim: SimConfig, seed: int,
                       scan_k: int = DEFAULT_SCAN_TOP_K,
                       checkpoint_cb=None, checkpoint_every: int = 0,
                       resume: Optional[ResumeState] = None,
-                      event_sink=None, dense_events: bool = True
+                      event_sink=None, dense_events: bool = True,
+                      check_mode: Optional[str] = None
                       ) -> PipelineResult:
     """Chunked, donated, double-buffered replacement for
     :func:`..tpu.runtime.run_sim` + the dense event fetch.
@@ -525,6 +537,12 @@ def run_sim_pipelined(model: Model, sim: SimConfig, seed: int,
     the end-of-run dense-tensor reconstruction (``result.events`` is
     then None) for callers that consume the compact stream directly —
     the vectorized decoder never needs the dense form.
+
+    ``check_mode`` (observational, heartbeat-only): with
+    ``sim.check_summary`` on, each chunk record gains a ``check`` lane
+    — the mode string plus the device-flagged instance count the
+    per-chunk scan already carries (``maelstrom watch`` renders it as
+    ``check[device flagged 3/100k]``).
     """
     if params is None:
         params = model.make_params(sim.net.n_nodes)
@@ -616,6 +634,13 @@ def run_sim_pipelined(model: Model, sim: SimConfig, seed: int,
                 # fault epoch costs no device traffic
                 from ..faults.engine import span_summary
                 extra = {"fault": span_summary(sim.faults, t0, length)}
+            if sim.check_summary and check_mode:
+                # the scan already counts flagged instances (summary
+                # flags fold into its source) — no extra device traffic
+                extra = dict(extra or {})
+                extra["check"] = {"mode": check_mode,
+                                  "flagged": int(scan_np[0, 0]),
+                                  "of": sim.n_instances}
             heartbeat.record_chunk(
                 chunk=chunk_idx[0], t0=t0, ticks=length,
                 net=stats_vec_to_net(svec),

@@ -39,6 +39,7 @@ import jax.numpy as jnp
 
 from . import netsim, wire
 from .netsim import NetConfig, NetStats
+from ..checkers import device_summary
 from ..faults import engine as faults_engine
 from ..faults import fuzz as faults_fuzz
 from ..faults.engine import FaultConfig, NO_PLANES
@@ -235,6 +236,25 @@ class Model:
         instance's full per-node state pytree ([N, ...] leading axis).
         Returns a scalar bool: True = violated this tick."""
         return jnp.bool_(False)
+
+    def summary_step(self, summ, node_state, events, cfg: NetConfig,
+                     params) -> jnp.ndarray:
+        """Device verdict lane hook (checkers/device_summary.py): fold
+        one instance's committed frontier / prefix hash / divergence
+        witness into its [N_LANES] summary row — normally one
+        ``device_summary.fold_frontier`` call. Evaluated on-device
+        every tick for EVERY instance when ``--check-mode device|both``
+        is on; a nonzero FLAGS lane routes the instance into the host
+        checker farm for full-oracle confirmation. Flags are a screen,
+        not a verdict — only tripped ``invariants`` force invalid on
+        their own. ``node_state`` is the instance's full per-node state
+        pytree ([N, ...] leading axis); ``events`` is its [C, 2,
+        2 + ev_vals] event rows for this tick (slot 0 = completions —
+        the read-completion witness the CRDT stale screens use).
+        Default: identity (no model lane; the runtime still folds the
+        availability/net counter twins)."""
+        del node_state, events, cfg, params
+        return summ
 
     # --- client side ------------------------------------------------------
 
@@ -642,6 +662,15 @@ class SimConfig(NamedTuple):
                                  # (disabled) config traces EXACTLY the
                                  # pre-fault tick graph
                                  # (doc/guide/10-faults.md)
+    check_summary: bool = False  # device verdict lanes (checkers/
+                                 # device_summary.py): carry a per-
+                                 # instance [N_LANES] int32 summary row
+                                 # updated inside the fused tick, so
+                                 # the host farm only confirms flagged
+                                 # instances (--check-mode device|both).
+                                 # False removes the leaf entirely
+                                 # (zero-overhead, the telemetry
+                                 # precedent)
 
 
 class TickOutputs(NamedTuple):
@@ -683,6 +712,10 @@ class Carry(NamedTuple):
                                # across ticks — riding the carry keeps
                                # checkpoint/resume and triage replay
                                # bit-exact. None unless the run fuzzes
+    check_summary: Any = None  # device verdict lanes [I, N_LANES] int32
+                               # (checkers/device_summary.py); batch-
+                               # LEADING in BOTH layouts like telemetry,
+                               # None unless sim.check_summary
 
 
 # RNG purpose tags. Every random draw in the simulation derives from
@@ -776,6 +809,8 @@ def init_carry(model: Model, sim: SimConfig, seed: int, params,
         violations=jnp.zeros((I,), jnp.int32),
         key=key,
         telemetry=flight.init_telemetry(I, sim.telemetry),
+        check_summary=(device_summary.init_summary(I)
+                       if sim.check_summary else None),
     )
 
 
@@ -1099,6 +1134,9 @@ def make_tick_fn(model: Model, sim: SimConfig, params,
         )
         violated = jax.vmap(
             lambda st: model.invariants(st, cfg, params))(node_state)
+        summ = device_summary.update_summary(
+            model, carry.check_summary, node_state, events, n_sent,
+            n_del, cfg, params, state_axis=0)
         with jax.named_scope("telemetry"):
             tel = _update_telemetry(
                 carry.telemetry, sim, t, events, invoked_prev,
@@ -1110,7 +1148,8 @@ def make_tick_fn(model: Model, sim: SimConfig, params,
                           violations=carry.violations
                           + violated.astype(jnp.int32),
                           key=key, telemetry=tel, snapshots=snapshots,
-                          fault_sched=carry.fault_sched)
+                          fault_sched=carry.fault_sched,
+                          check_summary=summ)
         J = sim.journal_instances
         R = sim.record_instances
         ys = TickOutputs(
@@ -1279,13 +1318,20 @@ def _make_tick_fn_minor(model: Model, sim: SimConfig, params,
                 jnp.sum(pool[:, wire.VALID, :] & 1, axis=0
                         ).astype(jnp.int32),
                 inbox, deltas, part_active, violated)
+        # summary lanes: node_state is batch-LAST here; the per-instance
+        # summary_step trace is shared with the lead path via the
+        # state_axis vmap spec, so lanes stay layout bit-identical
+        summ = device_summary.update_summary(
+            model, carry.check_summary, node_state, events, n_sent,
+            n_del, cfg, params, state_axis=-1)
         new_carry = Carry(pool=pool, node_state=node_state,
                           client_state=client_state, stats=stats,
                           violations=carry.violations
                           + violated.astype(jnp.int32),
                           key=carry.key, telemetry=tel,
                           snapshots=snapshots,
-                          fault_sched=carry.fault_sched)
+                          fault_sched=carry.fault_sched,
+                          check_summary=summ)
         J = sim.journal_instances
         R = sim.record_instances
         ys = TickOutputs(

@@ -86,6 +86,12 @@ def pytest_configure(config):
         "markers", "shard: SPMD partition auditor / shard-manifest / "
                    "cross-mesh resume tests (analysis/shard_audit.py, "
                    "campaign/checkpoint.py reshard path)")
+    config.addinivalue_line(
+        "markers", "device_check: device verdict-lane tests — "
+                   "summary-lane layout identity, device-vs-farm "
+                   "verdict identity, flagged-set routing, "
+                   "checkpoint/cross-mesh lane stability "
+                   "(checkers/device_summary.py)")
 
 
 def pytest_collection_modifyitems(config, items):

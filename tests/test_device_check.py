@@ -1,0 +1,458 @@
+"""Device verdict lanes (checkers/device_summary.py, ``--check-mode``).
+
+The screening contract, pinned at byte level:
+
+1. **Device-vs-farm verdict identity** — per-instance ``valid?`` fields
+   agree across ``farm``/``device``/``both`` on every workload in both
+   carry layouts (tier-1 runs a representative slice; the full matrix
+   is the slow sweep), and a flagged instance's device-mode verdict is
+   byte-identical to farm mode's (same farm path by construction).
+2. **Planted-mutant routing** — the double-vote mutant's device-flagged
+   set covers the farm-invalid oracle set (no screening gap), the
+   ``both``-mode audit reports complete, and the farm receives exactly
+   the flagged instances.
+3. **Layout identity** — summary lane blocks are bit-identical between
+   the lead and minor carry layouts, like the trajectories they
+   summarize.
+4. **Checkpoint stability** — lanes survive kill/resume bit-identically
+   on the sharded driver, including a cross-mesh 4 -> 2 -> 1 resume.
+5. **Fault composition** — every fault lane (crash/links/skew/
+   membership, plan and fuzz engines) composes with
+   ``--check-mode device``: flagged instances confirm through the farm
+   and verdicts still match the all-instances oracle.
+6. **Clean sweep** — a clean run routes ZERO instances into the farm
+   (``farm_load_fraction=0``), the headline O(chips) property.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pytest
+
+from maelstrom_tpu.checkers import device_summary
+from maelstrom_tpu.models import get_model
+from maelstrom_tpu.models.raft_buggy import RaftDoubleVote
+from maelstrom_tpu.tpu.harness import make_sim_config, run_tpu_test
+from maelstrom_tpu.tpu.runtime import run_sim
+
+pytestmark = pytest.mark.device_check
+
+# dense partition-nemesis config: real traffic, real leader churn — the
+# inbox_k=2 / pool_slots=24 shapes of the fault suite (small compiles)
+BASE_OPTS = dict(node_count=3, concurrency=4, n_instances=16,
+                 record_instances=8, inbox_k=2, pool_slots=24,
+                 time_limit=0.4, rate=200.0, latency=5.0,
+                 rpc_timeout=0.2, recovery_time=0.1, seed=7,
+                 nemesis=["partition"], nemesis_interval=0.05,
+                 p_loss=0.05, telemetry=False, funnel=False)
+
+ALL_WORKLOADS = ["echo", "unique-ids", "broadcast", "g-set",
+                 "pn-counter", "g-counter", "lin-kv", "kafka",
+                 "txn-list-append", "txn-rw-register"]
+
+# tier-1 covers every summary_step implementation (raft family, kafka,
+# g-set family, counter family) plus one default-hook workload,
+# alternating layouts; the rest is the slow sweep
+TIER1_MATRIX = [("lin-kv", "lead"), ("g-set", "minor"),
+                ("kafka", "lead"), ("pn-counter", "minor"),
+                ("unique-ids", "lead")]
+SLOW_MATRIX = [(wl, layout) for wl in ALL_WORKLOADS
+               for layout in ("lead", "minor")
+               if (wl, layout) not in TIER1_MATRIX]
+
+
+def _workload_opts(workload):
+    opts = dict(BASE_OPTS)
+    if workload == "kafka":
+        # single-node, nemesis-free (the pool suite's kafka shape) —
+        # a partitioned cold restart wipes volatile committed offsets,
+        # a known acceptable false-positive source this identity test
+        # keeps out of scope
+        opts.update(node_count=1, nemesis=[], nemesis_interval=0.5)
+    return opts
+
+
+# --- 1. device-vs-farm verdict identity ------------------------------------
+
+
+def _identity_case(workload, layout):
+    opts = {**_workload_opts(workload), "layout": layout}
+
+    def mk():
+        return get_model(workload, opts["node_count"])
+
+    farm = run_tpu_test(mk(), dict(opts, check_mode="farm"))
+    dev = run_tpu_test(mk(), dict(opts, check_mode="device"))
+    both = run_tpu_test(mk(), dict(opts, check_mode="both"))
+
+    # both-mode farms everything: verdicts byte-identical to farm mode,
+    # and the A/B audit must report the screen complete
+    assert both["instances"] == farm["instances"], (workload, layout)
+    assert both["valid?"] == farm["valid?"]
+    assert both["check"]["device-vs-farm"]["complete"], \
+        both["check"]["device-vs-farm"]
+
+    # device mode: same per-instance valid? everywhere; flagged
+    # instances ran the SAME farm path, so their verdicts are
+    # byte-identical; unflagged ones carry the synthesized screen tag
+    flagged = set(dev["check"]["flagged-instance-ids"])
+    assert dev["valid?"] == farm["valid?"]
+    for fv, dv in zip(farm["instances"], dev["instances"]):
+        i = fv["instance"]
+        assert dv["instance"] == i
+        assert dv.get("valid?") == fv.get("valid?"), \
+            (workload, layout, i)
+        if i in flagged:
+            assert dv == fv, (workload, layout, i)
+        else:
+            assert dv.get("checked-by") == "device-summary", \
+                (workload, layout, i)
+
+
+@pytest.mark.parametrize("workload,layout", TIER1_MATRIX)
+def test_device_vs_farm_identity_tier1(workload, layout):
+    _identity_case(workload, layout)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("workload,layout", SLOW_MATRIX)
+def test_device_vs_farm_identity_full(workload, layout):
+    _identity_case(workload, layout)
+
+
+# --- 2. planted-mutant routing ---------------------------------------------
+
+
+# the forensics fixture (test_stream_triage / test_node_fusion): dense
+# partitions + generous rpc_timeout make the double-vote mutant elect
+# two leaders in one term within the 300-tick horizon
+MUTANT_OPTS = dict(node_count=3, concurrency=6, n_instances=32,
+                   record_instances=32, inbox_k=1, pool_slots=16,
+                   time_limit=0.3, rate=200.0, latency=5.0,
+                   rpc_timeout=1.0, nemesis=["partition"],
+                   nemesis_interval=0.04, p_loss=0.05,
+                   recovery_time=0.0, seed=7, telemetry=False,
+                   funnel=False)
+
+
+@pytest.mark.parametrize("layout", ["lead", "minor"])
+def test_double_vote_mutant_flagged_and_routed(layout):
+    """The double-vote mutant diverges committed prefixes; the device
+    lanes must flag instances, every farm-invalid instance must be
+    flagged (screen completeness — the ``both`` audit), the farm must
+    receive exactly the flagged recorded set, and per-instance verdicts
+    must equal the all-instances oracle's byte for byte."""
+    opts = dict(MUTANT_OPTS, layout=layout)
+
+    def mk():
+        return RaftDoubleVote(n_nodes_hint=3, log_cap=64, heartbeat=8)
+
+    dev = run_tpu_test(mk(), dict(opts, check_mode="device"))
+    both = run_tpu_test(mk(), dict(opts, check_mode="both"))
+
+    assert dev["valid?"] is False and both["valid?"] is False
+    flagged = set(dev["check"]["flagged-instance-ids"])
+    assert flagged, "mutant raised no device flags"
+    oracle = {v["instance"] for v in both["instances"]
+              if v.get("valid?") is False}
+    assert oracle <= flagged, f"screen missed {sorted(oracle - flagged)}"
+    assert both["check"]["device-vs-farm"]["complete"], \
+        both["check"]["device-vs-farm"]
+    # the farm checked exactly the flagged recorded instances
+    assert dev["check"]["farm-instances"] == \
+        len([i for i in flagged if i < opts["record_instances"]])
+    by_inst = {v["instance"]: v for v in both["instances"]}
+    for v in dev["instances"]:
+        if v["instance"] in flagged:
+            assert v == by_inst[v["instance"]], v["instance"]
+        else:
+            assert v.get("checked-by") == "device-summary", v
+    assert all(isinstance(i, int) and 0 <= i < 32 for i in flagged)
+    assert dev["check"]["flagged-instances"] == len(flagged)
+    assert dev["check"]["summary-bytes-per-tick"] == \
+        device_summary.summary_bytes_per_tick(32)
+
+
+@pytest.mark.slow
+def test_dirty_apply_farm_invalid_instances_routed():
+    """The strongest routing oracle: the txn dirty-apply mutant under
+    scripted leader isolation produces instances the HOST checker
+    (Elle) rejects — device mode must flag every one of them and hand
+    back byte-identical invalid verdicts (txn models inherit the raft
+    lane, whose applied-truncation witness — log end below
+    ``last_applied`` — is exactly the dirty-apply lost acked txn)."""
+    from maelstrom_tpu.models.txn_raft import TxnDirtyApply
+    from maelstrom_tpu.tpu.runtime import scripted_isolate_groups
+    # test_tpu_txn's leader-isolation schedule, 2 cycles: isolate each
+    # node in turn (400-tick phases, 100-tick heal gaps) so whichever
+    # node is leader gets cut from the majority at some point
+    sched, t = [], 200
+    for _ in range(2):
+        for iso in range(3):
+            others = tuple(sorted({0, 1, 2} - {iso}))
+            sched.append(scripted_isolate_groups(
+                t + 400, [(iso,), others], 3))
+            t += 400
+            sched.append((t + 100, ()))
+            t += 100
+    opts = dict(node_count=3, concurrency=4, n_instances=8,
+                record_instances=8, time_limit=(t + 600) / 1000,
+                rate=60.0, latency=5.0, rpc_timeout=0.8,
+                nemesis=["partition"], nemesis_kind="scripted",
+                nemesis_schedule=tuple(sched), recovery_time=0.5,
+                seed=3, telemetry=False, funnel=False)
+
+    def mk():
+        return TxnDirtyApply(n_nodes_hint=3, log_cap=96)
+
+    farm = run_tpu_test(mk(), dict(opts, check_mode="farm"))
+    dev = run_tpu_test(mk(), dict(opts, check_mode="device"))
+    oracle = {v["instance"] for v in farm["instances"]
+              if v.get("valid?") is False}
+    assert oracle, "mutant failed to trip the host checker"
+    flagged = set(dev["check"]["flagged-instance-ids"])
+    assert oracle <= flagged, f"screen missed {sorted(oracle - flagged)}"
+    by_inst = {v["instance"]: v for v in farm["instances"]}
+    for v in dev["instances"]:
+        if v["instance"] in flagged:
+            assert v == by_inst[v["instance"]], v["instance"]
+    assert dev["valid?"] is False
+
+
+# --- 3. layout identity ----------------------------------------------------
+
+
+@pytest.mark.parametrize("workload", ["lin-kv", "g-set"])
+def test_summary_lanes_layout_bit_identical(workload):
+    """The lane block is folded from the per-instance trace, which is
+    layout-invariant — lead and minor runs must agree bit for bit."""
+    opts = _workload_opts(workload)
+    blocks = {}
+    for layout in ("lead", "minor"):
+        model = get_model(workload, opts["node_count"])
+        sim = make_sim_config(model, {**opts, "layout": layout,
+                                      "check_mode": "device"})
+        carry, _ = run_sim(model, sim, opts["seed"],
+                           model.make_params(sim.net.n_nodes))
+        blocks[layout] = np.asarray(carry.check_summary)
+    assert blocks["lead"].shape == (opts["n_instances"],
+                                    device_summary.N_LANES)
+    assert np.array_equal(blocks["lead"], blocks["minor"])
+
+
+# --- 4. checkpoint / cross-mesh stability ----------------------------------
+
+ECHO_OPTS = dict(node_count=2, concurrency=2, n_instances=8,
+                 record_instances=2, time_limit=0.3, rate=100.0,
+                 latency=5.0, seed=3, funnel=False, pipeline="on",
+                 chunk_ticks=50, check_mode="device")
+
+
+class Killed(BaseException):
+    """Simulated SIGKILL from the checkpoint sink."""
+
+
+def test_summary_lanes_checkpoint_resume_bit_identical(tmp_path):
+    from maelstrom_tpu.campaign.checkpoint import (load_checkpoint,
+                                                   restore_carry,
+                                                   save_checkpoint)
+    from maelstrom_tpu.models.echo import EchoModel
+    from maelstrom_tpu.tpu.pipeline import ResumeState
+    from maelstrom_tpu.parallel.mesh import (make_mesh,
+                                             run_sim_sharded_chunked,
+                                             wire_template)
+    model = EchoModel()
+    opts = dict(ECHO_OPTS, n_instances=4, time_limit=0.12)
+    sim = make_sim_config(model, opts)
+    assert sim.check_summary
+    mesh = make_mesh(2)
+    base = run_sim_sharded_chunked(model, sim, seed=3, mesh=mesh,
+                                   chunk=40, return_check_summary=True)
+    d = str(tmp_path)
+
+    def cb(state, ticks, host):
+        save_checkpoint(d, kind="sharded", state=state, ticks=ticks,
+                        chunks=host["chunks"],
+                        events=tuple(host["events"]))
+        raise Killed
+
+    with pytest.raises(Killed):
+        run_sim_sharded_chunked(model, sim, seed=3, mesh=mesh,
+                                chunk=40, checkpoint_cb=cb,
+                                checkpoint_every=1)
+    ck = load_checkpoint(d)
+    tmpl = wire_template(model, sim, mesh)
+    resume = ResumeState(carry=restore_carry(tmpl, ck["carry"]),
+                         ticks=ck["ticks"], chunks=ck["chunks"],
+                         events=tuple(ck["events"]))
+    res = run_sim_sharded_chunked(model, sim, seed=3, mesh=mesh,
+                                  chunk=40, resume=resume,
+                                  return_check_summary=True)
+    assert base[0] == res[0]
+    assert np.array_equal(base[1], res[1])
+    assert np.array_equal(base[2], res[2])
+    assert base[3] is not None
+    assert np.array_equal(base[3], res[3])
+
+
+@pytest.mark.parametrize("new_shards", [2, 1])
+def test_summary_lanes_cross_mesh_resume(tmp_path, new_shards):
+    """A checkpoint written at 4 shards resumes at 2 and at 1 with the
+    summary lane block bit-identical to an uninterrupted run at the new
+    shard count — the lanes ride the reshard as ordinary
+    instance-sharded leaves."""
+    from maelstrom_tpu.campaign.checkpoint import (load_checkpoint,
+                                                   restore_carry,
+                                                   save_checkpoint)
+    from maelstrom_tpu.models.echo import EchoModel
+    from maelstrom_tpu.tpu.pipeline import ResumeState
+    from maelstrom_tpu.parallel.mesh import (make_mesh,
+                                             run_sim_sharded_chunked,
+                                             wire_template)
+    model = EchoModel()
+
+    def sim_at(shards):
+        return make_sim_config(model, dict(
+            ECHO_OPTS, n_instances=8 // shards,
+            record_instances=8 // shards, time_limit=0.12))
+
+    sim_new = sim_at(new_shards)
+    mesh_new = make_mesh(new_shards)
+    base = run_sim_sharded_chunked(model, sim_new, seed=3,
+                                   mesh=mesh_new, chunk=40,
+                                   return_check_summary=True)
+    sim4 = sim_at(4)
+    d = str(tmp_path)
+
+    def cb(state, ticks, host):
+        save_checkpoint(d, kind="sharded", state=state, ticks=ticks,
+                        chunks=host["chunks"],
+                        events=tuple(host["events"]),
+                        meta={"shard": host["shard"]})
+        raise Killed
+
+    with pytest.raises(Killed):
+        run_sim_sharded_chunked(model, sim4, seed=3, mesh=make_mesh(4),
+                                chunk=40, checkpoint_cb=cb,
+                                checkpoint_every=1)
+    ck = load_checkpoint(d)
+    tmpl = wire_template(model, sim_new, mesh_new)
+    resume = ResumeState(
+        carry=restore_carry(tmpl, ck["carry"],
+                            shard=ck["meta"]["shard"]),
+        ticks=ck["ticks"], chunks=ck["chunks"],
+        events=tuple(ck["events"]))
+    res = run_sim_sharded_chunked(model, sim_new, seed=3,
+                                  mesh=mesh_new, chunk=40,
+                                  resume=resume,
+                                  return_check_summary=True)
+    assert base[0] == res[0]
+    assert np.array_equal(base[1], res[1])
+    assert np.array_equal(base[2], res[2])
+    assert base[3] is not None and base[3].shape == \
+        (8, device_summary.N_LANES)
+    assert np.array_equal(base[3], res[3])
+
+
+# --- 5. fault-lane composition ---------------------------------------------
+
+# one plan per lane, each short enough for tier-1's representative case
+_ISOLATE_2 = [{"dst": 2, "src": 0, "block": True},
+              {"dst": 2, "src": 1, "block": True},
+              {"dst": 0, "src": 2, "block": True},
+              {"dst": 1, "src": 2, "block": True}]
+FAULT_PLANS = {
+    "crash": {"phases": [{"until": 120},
+                         {"until": 180, "crash": [2]},
+                         {"until": 400}]},
+    "links": {"phases": [{"until": 120},
+                         {"until": 260, "links": _ISOLATE_2},
+                         {"until": 400}]},
+    "skew": {"phases": [{"until": 400,
+                         "skew": {"0": 1.5, "1": 1.0, "2": 1.0}}]},
+    "membership": {"phases": [{"until": 150, "members": [0, 1]},
+                              {"until": 400,
+                               "members": [0, 1, 2]}]},
+}
+FUZZ_DIST = {"windows": [1, 2], "gap": [60, 160], "duration": [20, 60],
+             "crash": {"rate": 0.5, "victims": [1, 1]},
+             "links": {"rate": 0.5, "edges": [1, 2], "block": 0.5,
+                       "delay": [0, 10], "loss": [0.0, 0.2]},
+             "skew": {"rate": 0.3, "victims": [1, 1],
+                      "range": [0.75, 1.5]}}
+
+
+def _fault_compose_case(fault_opts):
+    opts = dict(BASE_OPTS, nemesis=[], nemesis_interval=0.5,
+                rpc_timeout=0.08, **fault_opts)
+
+    def mk():
+        return get_model("lin-kv", opts["node_count"])
+
+    dev = run_tpu_test(mk(), dict(opts, check_mode="device"))
+    both = run_tpu_test(mk(), dict(opts, check_mode="both"))
+    assert "check" in dev and "check" in both
+    assert both["check"]["device-vs-farm"]["complete"], \
+        both["check"]["device-vs-farm"]
+    assert dev["valid?"] == both["valid?"]
+    for bv, dv in zip(both["instances"], dev["instances"]):
+        assert dv.get("valid?") == bv.get("valid?"), bv["instance"]
+
+
+@pytest.mark.parametrize("lane", ["links"])
+def test_fault_lane_composes_with_device_mode_tier1(lane):
+    _fault_compose_case({"fault_plan": FAULT_PLANS[lane]})
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("lane", ["crash", "skew", "membership"])
+def test_fault_lane_composes_with_device_mode_full(lane):
+    _fault_compose_case({"fault_plan": FAULT_PLANS[lane]})
+
+
+@pytest.mark.slow
+def test_fault_fuzz_composes_with_device_mode():
+    _fault_compose_case({"fault_fuzz": FUZZ_DIST})
+
+
+# --- 6. clean sweep --------------------------------------------------------
+
+
+def test_clean_sweep_routes_zero_instances_to_farm():
+    """The headline property: a clean echo fleet proves itself on
+    device and the farm receives NOTHING."""
+    opts = dict(node_count=2, concurrency=2, n_instances=16,
+                record_instances=8, time_limit=0.3, rate=100.0,
+                latency=5.0, seed=3, telemetry=False, funnel=False,
+                check_mode="device")
+    r = run_tpu_test(get_model("echo", 2), opts)
+    assert r["valid?"] is True
+    assert r["check"]["mode"] == "device"
+    assert r["check"]["flagged-instances"] == 0
+    assert r["check"]["farm-instances"] == 0
+    assert r["check"]["farm-load-fraction"] == 0.0
+    assert all(v.get("checked-by") == "device-summary"
+               for v in r["instances"])
+
+
+@pytest.mark.slow
+def test_summary_lane_overhead_bounded():
+    """The lane fold must stay a small fraction of tick cost: warm-run
+    wall with lanes on vs off at 512 instances, generous 75% bound
+    (typical is single-digit percent — this pins regressions, not
+    noise)."""
+    walls = {}
+    for mode in ("farm", "device"):
+        model = get_model("lin-kv", 3)
+        opts = dict(BASE_OPTS, n_instances=512, record_instances=1,
+                    time_limit=0.5, check_mode=mode)
+        sim = make_sim_config(model, opts)
+        params = model.make_params(sim.net.n_nodes)
+        run_sim(model, sim, opts["seed"], params)       # compile warm
+        t0 = time.monotonic()
+        carry, _ = run_sim(model, sim, opts["seed"], params)
+        np.asarray(carry.violations)                    # block
+        walls[mode] = time.monotonic() - t0
+    assert walls["device"] <= walls["farm"] * 1.75, walls

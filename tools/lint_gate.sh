@@ -276,6 +276,56 @@ print(f"pooled-check smoke: {n} flagged instance(s), pooled == serial "
 PY
 
 echo
+echo "== device-check smoke (summary lanes route only flagged instances)"
+# the planted double-vote mutant under --check-mode device must exit 1
+# with the farm receiving EXACTLY the flagged recorded instances and
+# flagged verdicts byte-identical to the --check-mode both oracle
+# (which also A/B-audits screen completeness); a clean echo run under
+# device mode must route NOTHING into the farm — the O(chips) headline
+for MODE in device both; do
+    rc=0
+    python -m maelstrom_tpu test --runtime tpu -w lin-kv-bug-double-vote \
+        --node-count 3 --concurrency 6 --rate 200 --time-limit 0.3 \
+        --n-instances 16 --record-instances 4 --nemesis partition \
+        --nemesis-interval 0.04 --recovery-time 0 --p-loss 0.05 \
+        --pipeline on --chunk-ticks 50 --seed 7 --check-mode "$MODE" \
+        > "$SMOKE_STORE/device-smoke-$MODE.json" || rc=$?
+    [[ "$rc" == "1" ]] || { echo "expected exit 1 (mutant caught at check-mode=$MODE), got $rc"; exit 1; }
+done
+rc=0
+python -m maelstrom_tpu test --runtime tpu -w echo --node-count 2 \
+    --time-limit 0.5 --rate 100 --n-instances 8 --record-instances 2 \
+    --seed 3 --check-mode device \
+    > "$SMOKE_STORE/device-smoke-clean.json" || rc=$?
+[[ "$rc" == "0" ]] || { echo "clean echo run must stay valid under device mode, got $rc"; exit 1; }
+python - "$SMOKE_STORE" <<'PY'
+import json, sys
+dec = json.JSONDecoder()
+dev = dec.raw_decode(open(sys.argv[1] + "/device-smoke-device.json").read())[0]
+both = dec.raw_decode(open(sys.argv[1] + "/device-smoke-both.json").read())[0]
+clean = dec.raw_decode(open(sys.argv[1] + "/device-smoke-clean.json").read())[0]
+chk = dev["check"]
+flagged = set(chk["flagged-instance-ids"])
+assert flagged, "mutant raised no device flags"
+rec = {i for i in flagged if i < 4}
+assert chk["farm-instances"] == len(rec), chk
+assert both["check"]["device-vs-farm"]["complete"], both["check"]
+by_inst = {v["instance"]: v for v in both["instances"]}
+for v in dev["instances"]:
+    assert v.get("valid?") == by_inst[v["instance"]].get("valid?"), v
+    if v["instance"] in flagged:
+        assert v == by_inst[v["instance"]], "flagged verdict diverged"
+c = clean["check"]
+assert c["flagged-instances"] == 0 and c["farm-instances"] == 0, c
+assert c["farm-load-fraction"] == 0.0, c
+assert all(v.get("checked-by") == "device-summary"
+           for v in clean["instances"]), clean["instances"]
+print(f"device-check smoke: {chk['flagged-instances']} flagged, farm "
+      f"checked {chk['farm-instances']}/{len(dev['instances'])} "
+      f"recorded; clean run farm-load 0")
+PY
+
+echo
 echo "== fleet-stats smoke (tiny echo run -> telemetry report)"
 python -m maelstrom_tpu test --runtime tpu -w echo --node-count 2 \
     --time-limit 0.5 --rate 100 --n-instances 8 --record-instances 2 \

@@ -97,9 +97,11 @@ def cmd_run(out_path: str) -> None:
         # index-weighted, so this keeps captures comparable across both
         # carry layouts (runtime.SimConfig.layout) and across rounds.
         # The flight recorder is derived state — excluded so digests
-        # stay comparable with pre-telemetry captures in artifacts/
+        # stay comparable with pre-telemetry captures in artifacts/;
+        # ditto the device verdict lanes (check_summary), derived from
+        # the trajectory rather than part of it
         d = digest_tree(canonical_carry(carry, sim)
-                        ._replace(telemetry=None))
+                        ._replace(telemetry=None, check_summary=None))
         checkpoints.append({"tick": t, "digest": d})
         print(f"xval: tick {t}/{n_ticks}", file=sys.stderr, flush=True)
 
