@@ -411,6 +411,20 @@ def child_main(canary: bool = False) -> None:
         # metric line prices the O(chips) screen against the
         # O(instances) farm on the same trajectory
         bench_check_mode = os.environ.get("BENCH_CHECK_MODE", "farm")
+        # device-time A/B (telemetry/profiler.py): BENCH_DEVICE_PROFILE=0
+        # drops the per-chunk capture so the metric line can price the
+        # observatory itself (auto mode syncs only the sampled chunks;
+        # acceptance: within noise of the unprofiled pipelined path).
+        # The profiled lines carry device_ms_per_tick + the per-phase
+        # split next to the host-side msgs/s.
+        bench_device_profile = (bench_pipeline and os.environ.get(
+            "BENCH_DEVICE_PROFILE") != "0")
+        dev_prof = None
+        dev_state = {"idx": 0, "sync": None}
+        if bench_device_profile:
+            from maelstrom_tpu.telemetry.profiler import DeviceProfiler
+            dev_prof = DeviceProfiler("auto", model=model, sim=sim,
+                                      params=params)
         compact_acc = []
         check_stats = {}
         if bench_heartbeat:
@@ -486,7 +500,16 @@ def child_main(canary: bool = False) -> None:
         def step_chunk(c, length: int, t0: int):
             """One dispatch; returns (carry', payload-or-None)."""
             if bench_pipeline:
-                c, svec, scan, buf = chunk_fn(length)(c, jnp.int32(t0))
+                fn = chunk_fn(length)
+                idx = dev_state["idx"]
+                dev_state["idx"] += 1
+                if dev_prof is not None and dev_prof.should_capture(idx):
+                    (c, svec, scan, buf), _ = dev_prof.capture(
+                        fn, (c, jnp.int32(t0)), length,
+                        sync=dev_state["sync"])
+                else:
+                    c, svec, scan, buf = fn(c, jnp.int32(t0))
+                dev_state["sync"] = svec
                 return c, (svec, scan, buf, t0, length)
             return chunk_fn(length)(c, jnp.int32(t0)), None
 
@@ -562,6 +585,14 @@ def child_main(canary: bool = False) -> None:
             if bench_pipeline:
                 rec["pipeline"] = True
                 rec["heartbeat"] = bench_heartbeat
+                rec["device_profile"] = bench_device_profile
+                if dev_prof is not None and dev_prof.records:
+                    ds = dev_prof.summary()
+                    rec["device_ms_per_tick"] = ds["ms-per-tick"]
+                    rec["device_phase_ms_per_tick"] = (
+                        ds["per-phase-ms-per-tick"])
+                    rec["device_source"] = ds["source"]
+                    rec["device_chunks"] = ds["captured-chunks"]
                 if bench_heartbeat:
                     rec["heartbeat_records"] = hb_state["chunk"]
                 rec["event_capacity"] = pipe_bytes.get("cap", 0)

@@ -50,6 +50,18 @@ PHASES = ("nemesis", "deliver", "node_phase", "client_step", "enqueue",
           "telemetry")
 OTHER_PHASE = "other"
 
+# The FULL known named-scope vocabulary — the phase table above plus
+# the scopes that ride specific configs: the fault-engine lanes
+# (``faults``, maelstrom_tpu/faults/) and the device verdict lanes
+# (``check_summary``, checkers/device_summary.py). The device-time
+# profiler (telemetry/profiler.py) attributes against THIS vocabulary;
+# an equation under any other scope root — or under no scope the
+# profiler can name — counts as unattributed, and the per-entry
+# ``unattributed-eqns`` column gates it (COST505): a refactor that
+# drops or renames a jax.named_scope can never silently blind the
+# attribution.
+KNOWN_SCOPES = PHASES + ("faults", "check_summary")
+
 DEFAULT_COST_BASELINE = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "cost_baseline.json")
 
@@ -79,16 +91,31 @@ class CostReport:
                                      # unrolled at lowering (each one
                                      # survives as an XLA while — the
                                      # boundary fusion cannot cross)
+    scopes: Dict[str, int] = field(default_factory=dict)
+                                     # eqn count per RAW named-scope
+                                     # root (KNOWN_SCOPES members plus
+                                     # whatever else the tick carries;
+                                     # scope-less eqns under "")
+    unattributed_eqns: int = 0       # eqns outside every KNOWN_SCOPES
+                                     # scope — the COST505 column
+    unknown_scopes: Tuple[str, ...] = ()
+                                     # scope roots seen but not in
+                                     # KNOWN_SCOPES (a renamed scope
+                                     # shows up here by name)
 
     def to_entry(self) -> Dict[str, Any]:
         """The checked-in baseline representation (stable keys only —
         the op histogram is too jax-version-volatile to pin).
         ``fusion-breakers`` doubles as the model's JXP404 loop budget
         (analysis/ir_lint.py): the refactored raft-family ticks pin 0,
-        legacy-scan models keep their recorded count."""
+        legacy-scan models keep their recorded count.
+        ``unattributed-eqns`` is the COST505 scope-coverage budget —
+        eqns the device-time profiler cannot attribute to a known
+        named scope."""
         return {"eqns": self.eqns,
                 "hbm-bytes-per-tick": self.hbm_bytes,
                 "fusion-breakers": self.loops,
+                "unattributed-eqns": self.unattributed_eqns,
                 "phases": {k: self.phases[k]
                            for k in sorted(self.phases)}}
 
@@ -127,11 +154,11 @@ def _sub_jaxprs(eqn) -> List[Tuple[Any, int]]:
 _TRANSFORM_RE = re.compile(r"^\w+\((.*)\)$")
 
 
-def _phase_of(eqn) -> str:
-    """Phase attribution from the equation's named_scope stack: the
-    first path component, unwrapped of transform markers — under the
+def _scope_root(eqn) -> str:
+    """The equation's raw named_scope root: the first path component of
+    its name stack, unwrapped of transform markers — under the
     batch-minor layout's instance vmap a scope renders as
-    ``vmap(deliver)``. Nested scopes inherit their root phase."""
+    ``vmap(deliver)``. Empty string for scope-less equations."""
     stack = str(eqn.source_info.name_stack)
     root = stack.split("/", 1)[0] if stack else ""
     while True:
@@ -139,6 +166,13 @@ def _phase_of(eqn) -> str:
         if not m:
             break
         root = m.group(1)
+    return root
+
+
+def _phase_of(eqn) -> str:
+    """Phase attribution from the equation's named_scope stack.
+    Nested scopes inherit their root phase."""
+    root = _scope_root(eqn)
     return root if root in PHASES else OTHER_PHASE
 
 
@@ -150,14 +184,21 @@ def cost_of_jaxpr(closed, carry=None) -> CostReport:
 
     phases: Dict[str, int] = {p: 0 for p in PHASES + (OTHER_PHASE,)}
     ops: Dict[str, int] = {}
+    scopes: Dict[str, int] = {}
     totals = {"eqns": 0, "bytes": 0, "max_bcast": 0, "loops": 0}
 
-    def walk(jaxpr, phase: Optional[str], mult: int) -> None:
+    def walk(jaxpr, phase: Optional[str], root: Optional[str],
+             mult: int) -> None:
         for eqn in jaxpr.eqns:
-            ph = phase if phase is not None else _phase_of(eqn)
+            if phase is None:
+                r = _scope_root(eqn)
+                ph = r if r in PHASES else OTHER_PHASE
+            else:
+                ph, r = phase, root
             name = eqn.primitive.name
             totals["eqns"] += 1
             phases[ph] += 1
+            scopes[r] = scopes.get(r, 0) + 1
             ops[name] = ops.get(name, 0) + 1
             out_bytes = sum(_aval_bytes(v) for v in eqn.outvars)
             totals["bytes"] += out_bytes * mult
@@ -175,9 +216,9 @@ def cost_of_jaxpr(closed, carry=None) -> CostReport:
                 if unroll < length:
                     totals["loops"] += 1
             for sub, sub_mult in _sub_jaxprs(eqn):
-                walk(sub, ph, mult * sub_mult)
+                walk(sub, ph, r, mult * sub_mult)
 
-    walk(closed.jaxpr, None, 1)
+    walk(closed.jaxpr, None, None, 1)
     const_sizes = []
     for c in closed.consts:
         try:
@@ -193,6 +234,12 @@ def cost_of_jaxpr(closed, carry=None) -> CostReport:
                 n *= int(d)
             carry_bytes += n * getattr(leaf, "dtype", None).itemsize \
                 if getattr(leaf, "dtype", None) is not None else 0
+    # the COST505 column: equations outside every KNOWN_SCOPES scope —
+    # scope-less ones plus anything under an unknown (renamed) root
+    unattributed = sum(n for r, n in scopes.items()
+                       if r not in KNOWN_SCOPES)
+    unknown = tuple(sorted(r for r in scopes
+                           if r and r not in KNOWN_SCOPES))
     return CostReport(
         eqns=totals["eqns"], hbm_bytes=totals["bytes"],
         phases={k: v for k, v in phases.items() if v},
@@ -200,7 +247,10 @@ def cost_of_jaxpr(closed, carry=None) -> CostReport:
         max_const_bytes=max(const_sizes, default=0),
         carry_bytes=carry_bytes,
         max_broadcast_bytes=totals["max_bcast"],
-        loops=totals["loops"])
+        loops=totals["loops"],
+        scopes={k: scopes[k] for k in sorted(scopes)},
+        unattributed_eqns=unattributed,
+        unknown_scopes=unknown)
 
 
 # --- tracing the tick ------------------------------------------------------
@@ -451,12 +501,16 @@ def save_cost_baseline(entries: Dict[str, Dict[str, Any]],
             "<layout>; eqns = recursive jaxpr equation count of one "
             "fused tick, hbm-bytes-per-tick = summed intermediate "
             "output bytes (scan bodies weighted by trip count), phases "
-            "= eqn count per jax.named_scope phase. Regenerate after "
+            "= eqn count per jax.named_scope phase, unattributed-eqns "
+            "= eqns outside every KNOWN_SCOPES named scope (the "
+            "COST505 scope-coverage budget — device-time profiler "
+            "attribution goes blind past it). Regenerate after "
             "an INTENTIONAL cost change with `maelstrom lint --cost "
             "--update-baseline`; a PR that regresses any entry by more "
-            "than `tolerance` fails the gate (COST501). jax-version "
-            "records the tracing toolchain: under a different jax the "
-            "gate downgrades drift to a re-record warning."),
+            "than `tolerance` fails the gate (COST501/COST505). "
+            "jax-version records the tracing toolchain: under a "
+            "different jax the gate downgrades drift to a re-record "
+            "warning."),
         "jax-version": jax.__version__,
         "tolerance": tolerance,
         "entries": {k: entries[k] for k in sorted(entries)},

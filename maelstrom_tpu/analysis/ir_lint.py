@@ -61,6 +61,14 @@ COST503  cost-baseline-stale      warning   a baseline entry matches no
 COST504  cost-improvement         info      a model got > tolerance
                                             CHEAPER — refresh the baseline
                                             to bank the win
+COST505  scope-coverage-regression error    fused-tick eqns outside every
+                                            known named scope grew past
+                                            the baseline unattributed-eqns
+                                            budget — a refactor dropped or
+                                            renamed a jax.named_scope, so
+                                            device-time profiler
+                                            attribution (telemetry/
+                                            profiler.py) went blind there
 =======  =======================  ========  ===============================
 
 The IR-hazard fixtures (``models/ir_hazards.py``) are audited alongside
@@ -522,6 +530,31 @@ def compare_costs(live: Dict[str, CostReport],
                 f"[{key}] tick got cheaper: eqns {rep.eqns} vs baseline "
                 f"{base['eqns']} — run --update-baseline to bank the "
                 f"win", pass_name=PASS_COST))
+        # the scope-coverage gate (COST505): eqns the device-time
+        # profiler cannot attribute to a known named scope must not
+        # grow past the recorded budget — that's how a refactor that
+        # drops/renames a jax.named_scope gets caught statically.
+        # Entries recorded before the column existed carry no budget
+        # and are skipped (re-record with --update-baseline).
+        ua_base = base.get("unattributed-eqns")
+        if ua_base is not None \
+                and rep.unattributed_eqns > ua_base * (1 + tol):
+            renamed = (f"; unknown scope roots seen: "
+                       f"{', '.join(rep.unknown_scopes)}"
+                       if rep.unknown_scopes else "")
+            findings.append(_finding(
+                "COST505", "scope-coverage-regression",
+                SEV_WARNING if note else SEV_ERROR, path, symbol,
+                f"[{key}] {rep.unattributed_eqns} fused-tick eqns "
+                f"outside every known named scope vs baseline budget "
+                f"{ua_base} (+{rep.unattributed_eqns - ua_base}) — a "
+                f"refactor likely dropped or renamed a "
+                f"jax.named_scope, so device-time attribution "
+                f"(telemetry/profiler.py) goes blind there{renamed}; "
+                f"restore the scope or re-record with "
+                f"--update-baseline and justify it in the PR"
+                + (f" ({note})" if note else ""),
+                pass_name=PASS_COST))
     if full_universe:
         for key in sorted(set(entries) - set(live)):
             findings.append(_finding(

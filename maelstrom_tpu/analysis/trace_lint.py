@@ -537,6 +537,7 @@ def default_trace_targets(repo_root: str) -> List[str]:
             "maelstrom_tpu/ops/delivery.py",
             "maelstrom_tpu/telemetry/recorder.py",
             "maelstrom_tpu/telemetry/stream.py",
+            "maelstrom_tpu/telemetry/profiler.py",
             "maelstrom_tpu/checkers/triage.py",
             "maelstrom_tpu/checkers/pool.py",
             "maelstrom_tpu/campaign/*.py",

@@ -51,6 +51,20 @@ def _heartbeat_live(run_dir: Optional[str]) -> Dict[str, Any]:
                                 for r in hb["chunks"])
         if last.get("net"):
             out["net"] = last["net"]
+        # the device-time lane (telemetry/profiler.py): hot scope of
+        # the most recent captured chunk — old heartbeats simply lack
+        # the key
+        for rec in reversed(hb["chunks"]):
+            dev = rec.get("device-ms")
+            if dev:
+                from ..telemetry.profiler import hot_scope
+                hot = hot_scope(dev)
+                if hot:
+                    out["device-hot"] = {
+                        "scope": hot[0],
+                        "ms-per-tick": round(
+                            hot[1] / max(rec.get("ticks", 1), 1), 4)}
+                break
     v = first_violation_of(hb)
     if v:
         out["first-violation"] = v
@@ -105,6 +119,11 @@ def render_status(status: Dict[str, Any]) -> str:
         net = live.get("net") or {}
         if net:
             progress += f"  delivered {net.get('delivered', 0)}"
+        hot = live.get("device-hot")
+        if hot:
+            # the merged table's device-ms hot-scope column
+            progress += (f"  dev[{hot.get('scope', '?')} "
+                         f"{hot.get('ms-per-tick', 0):.2f}/tick]")
         if live.get("resumes"):
             progress += f"  resumes {live['resumes']}"
         verdict = ("" if r.get("valid?") is None
@@ -150,6 +169,22 @@ def _static_cost(workload: str, opts: Dict[str, Any],
     return est
 
 
+def _device_phases(run_dir: Optional[str]) -> Optional[Dict[str, Any]]:
+    """A completed item's device-time roll-up from its results.json
+    (``perf.phases.device``, telemetry/profiler.py) — None when the
+    run predates the profiler or ran with it off; never allowed to
+    kill the report."""
+    if not run_dir:
+        return None
+    try:
+        with open(os.path.join(run_dir, "results.json")) as fh:
+            dev = (json.load(fh).get("perf", {}).get("phases", {})
+                   .get("device"))
+        return dev if isinstance(dev, dict) else None
+    except Exception:
+        return None
+
+
 def campaign_report(cdir: str, static_cost: bool = True,
                     write: bool = True) -> Dict[str, Any]:
     """Aggregate completed items into the trend summary (and write it
@@ -179,6 +214,10 @@ def campaign_report(cdir: str, static_cost: bool = True,
         if static_cost and item.get("workload"):
             row["ir-bytes-est"] = _static_cost(item["workload"], opts,
                                                cost_cache)
+        dev = _device_phases(item.get("run-dir"))
+        if dev:
+            row["device-ms-per-tick"] = dev.get("ms-per-tick")
+            row["device-phases"] = dev.get("per-phase-ms-per-tick")
         rows.append(row)
     # per-workload trend rows: the cross-item aggregation the Pulsar
     # methodology tracks per configuration
@@ -188,9 +227,13 @@ def campaign_report(cdir: str, static_cost: bool = True,
         t = trends.setdefault(wl, {
             "runs": 0, "done": 0, "failed": 0, "valid": 0, "invalid": 0,
             "violating-instances": 0, "msgs-per-sec": [],
-            "_ir_bytes": []})
+            "_ir_bytes": [], "_dev_mpt": [], "_dev_phases": []})
         if row.get("ir-bytes-est") is not None:
             t["_ir_bytes"].append(row["ir-bytes-est"])
+        if row.get("device-ms-per-tick") is not None:
+            t["_dev_mpt"].append(row["device-ms-per-tick"])
+        if row.get("device-phases"):
+            t["_dev_phases"].append(row["device-phases"])
         t["runs"] += 1
         if row["status"] == q.DONE:
             t["done"] += 1
@@ -216,6 +259,22 @@ def campaign_report(cdir: str, static_cost: bool = True,
         t["ir-bytes-est"] = (None if not ib else
                              ib[0] if len(set(ib)) == 1 else
                              f"{min(ib)}-{max(ib)}")
+        # per-phase device-time trend rows (telemetry/profiler.py):
+        # mean ms/tick over the workload's profiled items
+        mpt = t.pop("_dev_mpt")
+        devp = t.pop("_dev_phases")
+        t["device-ms-per-tick-mean"] = (
+            round(sum(mpt) / len(mpt), 5) if mpt else None)
+        if devp:
+            acc: Dict[str, float] = {}
+            for d in devp:
+                for ph, ms in d.items():
+                    acc[ph] = acc.get(ph, 0.0) + float(ms)
+            t["device-phases-mean"] = {
+                ph: round(ms / len(devp), 5)
+                for ph, ms in sorted(acc.items())}
+        else:
+            t["device-phases-mean"] = None
     done = [r for r in rows if r["status"] == q.DONE]
     summary = {
         "name": meta.get("name"),
@@ -264,4 +323,13 @@ def render_report(summary: Dict[str, Any]) -> str:
             f"msgs/s mean {t['msgs-per-sec-mean']} "
             f"max {t['msgs-per-sec-max']} "
             f"ir-bytes {t.get('ir-bytes-est')}")
+        devp = t.get("device-phases-mean")
+        if devp:
+            # the per-phase device-time trend row
+            lines.append(
+                f"  {'':<18} device ms/tick "
+                f"{t.get('device-ms-per-tick-mean')} — " + " ".join(
+                    f"{ph} {ms:.4f}"
+                    for ph, ms in sorted(devp.items(),
+                                         key=lambda kv: -kv[1])))
     return "\n".join(lines)

@@ -264,6 +264,16 @@ def add_test_options(p: argparse.ArgumentParser):
     p.add_argument("--profile-dir", default=None,
                    help="TPU runtime: capture a jax.profiler trace of "
                         "the run into this directory")
+    p.add_argument("--device-profile", default="auto",
+                   choices=["auto", "on", "off"],
+                   help="per-chunk device-time attribution (telemetry/"
+                        "profiler.py): auto (default) captures the "
+                        "first chunks then every Nth, on captures "
+                        "every chunk, off disables. Captured chunks "
+                        "gain the heartbeat device-ms lane and feed "
+                        "results.perf.phases.device + `maelstrom "
+                        "profile`; purely observational — "
+                        "trajectories are bit-identical either way")
 
 
 def _availability(v):
@@ -510,6 +520,7 @@ def cmd_test(args) -> int:
             chunk_ticks=args.chunk_ticks,
             event_capacity=args.event_capacity,
             profile_dir=args.profile_dir,
+            device_profile=args.device_profile,
             store_root=args.store,
             seed=args.seed or 0)
         if args.recovery_time is not None:
@@ -928,6 +939,26 @@ def cmd_fleet_stats(args) -> int:
     return 0
 
 
+def cmd_profile(args) -> int:
+    """Render a stored run's per-phase device-time table — the
+    heartbeat's ``device-ms`` chunk lanes plus the results.json
+    ``perf.phases.device`` roll-up — and name the hot scope
+    (telemetry/profiler.py). Exit 2 when the run carries no device
+    time (profiling off, or a pre-profiler run dir)."""
+    from .telemetry.profiler import render_profile_report
+
+    path = os.path.realpath(args.path)
+    report = render_profile_report(path)
+    if report is None:
+        print(f"error: no device-time records at {args.path} — "
+              f"device time is captured by chunked TPU-runtime runs "
+              f"unless --device-profile off was passed (old run dirs "
+              f"predate the lane)", file=sys.stderr)
+        return 2
+    print(report)
+    return 0
+
+
 def _watch_campaign(args) -> int:
     """``watch --campaign``: tail EVERY item of a campaign dir — the
     merged live table re-rendered each poll until the queue settles
@@ -1271,6 +1302,16 @@ def main(argv=None) -> int:
     p_fleet.add_argument("--no-svg", action="store_true",
                          help="text report only")
 
+    p_profile = sub.add_parser(
+        "profile", help="render a stored run's per-phase device-time "
+                        "table and name the hot scope "
+                        "(doc/observability.md)")
+    p_profile.add_argument("path",
+                           help="a store run dir (e.g. store/echo-tpu/"
+                                "latest) with heartbeat device-ms "
+                                "lanes and/or a results.json "
+                                "perf.phases.device roll-up")
+
     p_watch = sub.add_parser(
         "watch", help="tail a run's streaming heartbeat.jsonl into a "
                       "live terminal report (doc/observability.md)")
@@ -1512,6 +1553,7 @@ def main(argv=None) -> int:
                 "doc": cmd_doc, "check": cmd_check,
                 "export": cmd_export, "lint": cmd_lint,
                 "fleet-stats": cmd_fleet_stats, "watch": cmd_watch,
+                "profile": cmd_profile,
                 "triage": cmd_triage, "shrink": cmd_shrink,
                 "campaign": cmd_campaign}[args.command](args)
     except ValueError as e:

@@ -471,7 +471,8 @@ def run_sim_sharded_chunked(model: Model, sim: SimConfig, seed: int,
                             checkpoint_cb=None,
                             checkpoint_every: int = 0,
                             resume=None, check_mode: Optional[str] = None,
-                            return_check_summary: bool = False):
+                            return_check_summary: bool = False,
+                            profiler=None):
     """:func:`run_sim_sharded` issued as a sequence of ``chunk``-tick
     device dispatches — the production dispatch pattern (single giant
     dispatches fault the TPU tunnel; see bench.py) — with the carry left
@@ -515,6 +516,14 @@ def run_sim_sharded_chunked(model: Model, sim: SimConfig, seed: int,
     instance axis), and the global-id RNG derivation makes the resumed
     trajectories bit-identical to an uninterrupted run at the new
     shard count.
+
+    ``profiler`` (a :class:`..telemetry.profiler.DeviceProfiler`,
+    observational, same contract as on
+    :func:`..tpu.pipeline.run_sim_pipelined`): captured chunks
+    dispatch under device-time measurement — the measured wall covers
+    the whole sharded dispatch including the tick-loop-free stat
+    collectives — and their heartbeat records gain the ``device-ms``
+    per-phase lane. Trajectories bit-identical on or off.
     """
     import numpy as np
 
@@ -562,13 +571,29 @@ def run_sim_sharded_chunked(model: Model, sim: SimConfig, seed: int,
             sim.faults, sim.net.n_nodes, _seed32(seed),
             np.arange(sim.n_instances * n_shards, dtype=np.int32))
 
+    # profiler state: dispatch-side chunk cursor + the previous
+    # dispatch's detached stats block (see run_sim_pipelined — syncing
+    # on it keeps a captured chunk's measurement clean while uncaptured
+    # chunks keep the fetch/compute overlap)
+    dispatch_idx = [resume.chunks if resume else 0]
+    sync_ref = [None]
+
     def dispatch(w, t0, length):
-        w, events, svec, scan = chunk_fn(w, jnp.int32(t0), params,
-                                         length)
-        return w, (events, svec, scan)
+        idx = dispatch_idx[0]
+        dispatch_idx[0] += 1
+        prof_rec = None
+        if profiler is not None and profiler.should_capture(idx):
+            (w, events, svec, scan), prof_rec = profiler.capture(
+                chunk_fn, (w, jnp.int32(t0), params, length), length,
+                sync=sync_ref[0])
+        else:
+            w, events, svec, scan = chunk_fn(w, jnp.int32(t0), params,
+                                             length)
+        sync_ref[0] = svec
+        return w, (events, svec, scan, prof_rec)
 
     def consume(payload, t0, length):
-        events, svec, scan = payload
+        events, svec, scan, prof_rec = payload
         # dense event blocks cross the wire shard-major; accumulate in
         # global-id order so the host history is shard-count-invariant
         # (what lets a resharded resume concatenate with chunks written
@@ -590,11 +615,17 @@ def run_sim_sharded_chunked(model: Model, sim: SimConfig, seed: int,
                     "mode": check_mode,
                     "flagged": int(scan_np[0, 0]),
                     "of": sim.n_instances * n_shards}
+            if prof_rec is not None:
+                extra = dict(extra or {})
+                extra["device-ms"] = prof_rec["per-phase-ms"]
+                extra["device-source"] = prof_rec["source"]
             heartbeat.record_chunk(
                 chunk=chunk_idx[0], t0=t0, ticks=length,
                 net=stats_vec_to_net(np.asarray(svec).sum(axis=0)),
                 violation=scan_to_violation(scan_np),
                 violations=scan_to_violations(scan_np),
+                device_s=(prof_rec["device-s"]
+                          if prof_rec is not None else None),
                 extra=extra)
         chunk_idx[0] += 1
 
@@ -626,6 +657,8 @@ def run_sim_sharded_chunked(model: Model, sim: SimConfig, seed: int,
                        resume.ticks if resume else 0}
     if perf is not None:
         perf.update(chunk_stats)
+        if profiler is not None and profiler.records:
+            perf["device"] = profiler.summary()
 
     # final: per-shard stats summed on host (stats crossed the boundary
     # as [n_shards]-length arrays, one slot per shard; int adds commute,

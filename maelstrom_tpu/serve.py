@@ -90,6 +90,16 @@ def _index(store: str) -> bytes:
     return _page("maelstrom-tpu results", body)
 
 
+def _device_phases_cell(t: dict) -> str:
+    """Per-phase device ms/tick trend, hottest first, for one trend row
+    (empty when the campaign ran unprofiled)."""
+    devp = t.get("device-phases-mean")
+    if not devp:
+        return "-"
+    return " ".join(f"{ph} {ms:.4f}" for ph, ms in
+                    sorted(devp.items(), key=lambda kv: -kv[1]))
+
+
 def _campaign_tables(d: str) -> str:
     """The trend-store view of a campaign dir: per-item rows + the
     per-workload trend aggregation from summary.json (written by
@@ -102,8 +112,8 @@ def _campaign_tables(d: str) -> str:
                 "<code>maelstrom campaign report</code>)</p>")
     parts = ["<h2>Items</h2><table><tr><th>item</th><th>workload</th>"
              "<th>seed</th><th>status</th><th>valid?</th><th>viol</th>"
-             "<th>msgs/s</th><th>ir bytes/tick</th><th>resumed</th>"
-             "<th>run</th></tr>"]
+             "<th>msgs/s</th><th>ir bytes/tick</th><th>dev ms/tick</th>"
+             "<th>resumed</th><th>run</th></tr>"]
     for r in s.get("items", ()):
         v = r.get("valid?")
         run_dir = r.get("run-dir") or ""
@@ -123,13 +133,15 @@ def _campaign_tables(d: str) -> str:
             f"<td>{v}</td><td>{r.get('violating-instances') or 0}</td>"
             f"<td>{r.get('msgs-per-sec') or '-'}</td>"
             f"<td>{r.get('ir-bytes-est') or '-'}</td>"
+            f"<td>{r.get('device-ms-per-tick') or '-'}</td>"
             f"<td>{'yes' if r.get('resumed') else '-'}</td>"
             f"<td>{link}</td></tr>")
     parts.append("</table><h2>Trends (per workload)</h2><table>"
                  "<tr><th>workload</th><th>runs</th><th>done</th>"
                  "<th>valid</th><th>invalid</th><th>failed</th>"
                  "<th>viol</th><th>msgs/s mean</th><th>msgs/s max</th>"
-                 "<th>ir bytes/tick</th></tr>")
+                 "<th>ir bytes/tick</th><th>dev ms/tick</th>"
+                 "<th>device phases</th></tr>")
     for wl in sorted(s.get("trends", {})):
         t = s["trends"][wl]
         cls = ("valid" if t["invalid"] == 0 and t["failed"] == 0
@@ -142,7 +154,9 @@ def _campaign_tables(d: str) -> str:
             f"<td>{t['failed']}</td><td>{t['violating-instances']}</td>"
             f"<td>{t['msgs-per-sec-mean']}</td>"
             f"<td>{t['msgs-per-sec-max']}</td>"
-            f"<td>{t.get('ir-bytes-est') or '-'}</td></tr>")
+            f"<td>{t.get('ir-bytes-est') or '-'}</td>"
+            f"<td>{t.get('device-ms-per-tick-mean') or '-'}</td>"
+            f"<td>{html.escape(_device_phases_cell(t))}</td></tr>")
     parts.append("</table>")
     return "".join(parts)
 

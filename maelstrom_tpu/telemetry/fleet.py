@@ -266,4 +266,16 @@ def render_report(metrics: Dict, phases: Optional[Dict] = None) -> str:
         lines.append("phases: " + ", ".join(
             f"{k.replace('-s', '')} {v:.2f}s"
             for k, v in phases.items() if isinstance(v, (int, float))))
+        # the device-time roll-up (telemetry/profiler.py), when the run
+        # was profiled — old results.json files simply lack the key
+        dev = phases.get("device")
+        if isinstance(dev, dict) and dev.get("per-phase-ms-per-tick"):
+            per = dev["per-phase-ms-per-tick"]
+            lines.append(
+                f"device time ({dev.get('source', '?')}, "
+                f"{dev.get('captured-chunks', '?')} chunks): "
+                f"{dev.get('ms-per-tick', 0):.4f} ms/tick — " + ", ".join(
+                    f"{ph} {ms:.4f}"
+                    for ph, ms in sorted(per.items(),
+                                         key=lambda kv: -kv[1])))
     return "\n".join(lines)

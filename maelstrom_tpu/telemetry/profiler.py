@@ -1,0 +1,402 @@
+"""Per-chunk on-device time attribution — the device-time observatory.
+
+Every perf number the harness reported before this module was host
+wall-clock: ``perf.phases`` times dispatch/fetch from the host side, so
+the fused-tick and narrow-width wins that XLA:CPU undersells (and the
+TPU realizes) were invisible. :class:`DeviceProfiler` closes that gap
+per chunk: an opt-in capture (``--device-profile auto|on|off``, default
+``auto`` = first :data:`~DeviceProfiler.AUTO_FIRST_K` chunks then every
+:data:`~DeviceProfiler.AUTO_EVERY_N`-th) measures the chunk's device
+execution wall and attributes it across the fused tick's named scopes —
+the phases PR 2 planted (``nemesis``/``deliver``/``node_phase``/
+``client_step``/``enqueue``/``telemetry``) plus the fault lanes and
+PR 18's ``check_summary``.
+
+Two attribution sources, best-effort in order:
+
+``trace``
+    A programmatic ``jax.profiler.start_trace``/``stop_trace`` window
+    around the dispatch, parsed host-side from any trace-viewer JSON
+    the backend emits, scope durations summed per phase. Attempted only
+    where a parseable trace is plausible (non-CPU backends, or
+    ``MAELSTROM_DEVICE_TRACE=1`` to force); ANY failure — including a
+    harness-level ``--profile-dir`` trace already being active — latches
+    a process-wide fallback so the cost is paid at most once.
+
+``timed``
+    The fallback that keeps CPU CI honest: sync the previous chunk's
+    detached stats (so the timing window starts clean), dispatch, stamp
+    AFTER the dispatch call returns (the jit compile is synchronous
+    inside the call, so compile time never pollutes chunk 0), block on
+    the outputs, and split the measured device wall across phases by
+    the fused tick's static per-phase eqn weights (the cost model's
+    abstract trace, cached process-wide per config). The per-phase sum
+    equals the measured wall by construction.
+
+Profiling is purely observational: the capture never touches the
+donated carry, and trajectories are bit-identical with profiling on or
+off in both carry layouts and under the sharded driver
+(``tests/test_profiler.py``). The records stream everywhere the
+observatory already reaches: heartbeat chunk records gain a
+``device-ms`` lane (``maelstrom watch`` renders ``dev[node 0.41 ...]``),
+``results.perf.phases.device`` lands next to the host timers, and
+``maelstrom profile <run-dir>`` renders the per-phase table
+(:func:`render_profile_report`).
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import shutil
+import tempfile
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+# short lane labels for the heartbeat's dev[...] rendering (stream.py)
+# and the profile report — keyed by the runtime's named_scope vocabulary
+PHASE_LABELS = {
+    "nemesis": "nem",
+    "deliver": "net",
+    "node_phase": "node",
+    "client_step": "client",
+    "enqueue": "enq",
+    "telemetry": "tel",
+    "faults": "fault",
+    "check_summary": "check",
+    "other": "other",
+}
+
+# process-wide latch: once a real-trace attempt fails (no backend, no
+# parseable output, or a --profile-dir trace already active), every
+# later profiler in the process goes straight to the timed fallback —
+# the failed attempt is paid at most once, not once per run
+_TRACE_FAILED = [False]
+
+# static per-phase eqn weights of the fused tick, keyed per config —
+# the abstract trace costs a jaxpr lowering, so tier-1's many small
+# pipelined runs must share it
+_WEIGHT_CACHE: Dict[Any, Dict[str, float]] = {}
+
+
+def _trace_wanted() -> bool:
+    """Whether a real ``jax.profiler`` trace attempt is worth making.
+    CPU backends emit ``.xplane.pb`` only (no trace-viewer JSON without
+    the tensorboard toolchain), so CI goes straight to the timed
+    fallback unless ``MAELSTROM_DEVICE_TRACE=1`` forces the attempt."""
+    env = os.environ.get("MAELSTROM_DEVICE_TRACE", "")
+    if env == "0":
+        return False
+    if env:
+        return True
+    try:
+        import jax
+        return jax.default_backend() != "cpu"
+    except Exception:
+        return False
+
+
+def _weights_key(model, sim) -> Optional[Any]:
+    try:
+        return (type(model).__module__, type(model).__qualname__,
+                getattr(model, "name", ""), repr(sim))
+    except Exception:
+        return None
+
+
+def phase_weights(model, sim, params=None) -> Dict[str, float]:
+    """Static per-phase fractions of the fused tick's eqn count — the
+    timed fallback's attribution key. Derived from the cost model's
+    abstract trace (:func:`..analysis.cost_model.trace_tick`), so the
+    same named-scope vocabulary the COST505 coverage gate audits is
+    what the profiler attributes against. Falls back to an opaque
+    ``{"other": 1.0}`` if the tick cannot be traced (the run itself
+    never depends on the instrumentation)."""
+    key = _weights_key(model, sim)
+    if key is not None and key in _WEIGHT_CACHE:
+        return _WEIGHT_CACHE[key]
+    try:
+        from ..analysis import cost_model
+        closed, _, _ = cost_model.trace_tick(model, sim, params)
+        rep = cost_model.cost_of_jaxpr(closed)
+        # collapse raw scope roots onto the known vocabulary — the one
+        # COST505 audits — with everything else (incl. scope-less
+        # eqns) under "other"
+        counts: Dict[str, float] = {}
+        for root, n in rep.scopes.items():
+            key = (root if root in cost_model.KNOWN_SCOPES
+                   else cost_model.OTHER_PHASE)
+            counts[key] = counts.get(key, 0) + n
+        total = sum(counts.values())
+        weights = ({ph: n / total for ph, n in sorted(counts.items())
+                    if n > 0} if total > 0 else {"other": 1.0})
+    except Exception:
+        weights = {"other": 1.0}
+    if key is not None:
+        _WEIGHT_CACHE[key] = weights
+    return weights
+
+
+def _parse_trace_dir(trace_dir: str,
+                     phases) -> Optional[Dict[str, float]]:
+    """Sum trace-viewer event durations per named scope from whatever
+    JSON the profiler emitted under ``trace_dir``. Returns ms per phase,
+    or None when no parseable trace exists (the usual case on CPU:
+    jax writes ``.xplane.pb`` only)."""
+    paths = (glob.glob(os.path.join(trace_dir, "**", "*.trace.json.gz"),
+                       recursive=True)
+             + glob.glob(os.path.join(trace_dir, "**", "*.trace.json"),
+                         recursive=True))
+    if not paths:
+        return None
+    per_phase: Dict[str, float] = {}
+    try:
+        for path in paths:
+            opener = gzip.open if path.endswith(".gz") else open
+            with opener(path, "rt") as fh:
+                doc = json.load(fh)
+            for ev in doc.get("traceEvents", []):
+                name = ev.get("name", "")
+                dur = ev.get("dur")
+                if not name or not dur:
+                    continue
+                for ph in phases:
+                    if ph in name:
+                        per_phase[ph] = (per_phase.get(ph, 0.0)
+                                         + float(dur) / 1000.0)
+                        break
+    except Exception:
+        return None
+    return per_phase or None
+
+
+class DeviceProfiler:
+    """Per-chunk device-time capture for the chunked executors.
+
+    ``mode``: ``"on"`` captures every chunk, ``"auto"`` (the default)
+    the first :data:`AUTO_FIRST_K` chunks then every
+    :data:`AUTO_EVERY_N`-th — enough samples for a stable per-phase
+    profile without syncing away the executor's fetch/compute overlap
+    on every chunk. (``"off"`` is resolved by the caller: no profiler
+    is constructed.)
+
+    The executor calls :meth:`should_capture` with the absolute chunk
+    index (resume-aware) and, on capture chunks, routes the dispatch
+    through :meth:`capture`; every other chunk dispatches untouched.
+    """
+
+    MODES = ("auto", "on", "off")
+    AUTO_FIRST_K = 3
+    AUTO_EVERY_N = 8
+
+    def __init__(self, mode: str = "auto", model=None, sim=None,
+                 params=None):
+        if mode not in self.MODES:
+            raise ValueError(f"device-profile mode {mode!r} not in "
+                             f"{self.MODES}")
+        self.mode = mode
+        self._model, self._sim, self._params = model, sim, params
+        self._weights: Optional[Dict[str, float]] = None
+        self._try_trace = mode != "off" and _trace_wanted()
+        self.records: List[Dict[str, Any]] = []
+
+    def should_capture(self, idx: int) -> bool:
+        if self.mode == "off":
+            return False
+        if self.mode == "on":
+            return True
+        return idx < self.AUTO_FIRST_K or idx % self.AUTO_EVERY_N == 0
+
+    def _phase_fractions(self) -> Dict[str, float]:
+        if self._weights is None:
+            self._weights = phase_weights(self._model, self._sim,
+                                          self._params)
+        return self._weights
+
+    def capture(self, fn, args: Tuple, ticks: int,
+                sync=None) -> Tuple[Any, Dict[str, Any]]:
+        """Dispatch ``fn(*args)`` under measurement; returns
+        ``(outputs, record)``. ``sync`` is the previous chunk's detached
+        output (blocked on first, so the timing window contains only
+        this chunk's device work). The trace is ALWAYS stopped on the
+        way out — an ``fn`` blow-up mid-capture must not leave the
+        process-wide trace open (the teardown regression,
+        ``tests/test_profiler.py``)."""
+        import jax
+
+        if sync is not None:
+            try:
+                jax.block_until_ready(sync)
+            except Exception:
+                pass
+        traced_ms = None
+        dt = None
+        if self._try_trace and not _TRACE_FAILED[0]:
+            tdir = tempfile.mkdtemp(prefix="maelstrom-devprof-")
+            started = False
+            try:
+                try:
+                    jax.profiler.start_trace(tdir)
+                    started = True
+                except Exception:
+                    _TRACE_FAILED[0] = True
+                if started:
+                    try:
+                        out = fn(*args)
+                        t0 = time.monotonic()
+                        jax.block_until_ready(out)
+                        dt = time.monotonic() - t0
+                    finally:
+                        # the teardown contract: stop on the exception
+                        # path too, or every later trace start fails
+                        # with "already active"
+                        try:
+                            jax.profiler.stop_trace()
+                        except Exception:
+                            pass
+                    traced_ms = _parse_trace_dir(
+                        tdir, tuple(PHASE_LABELS))
+                    if traced_ms is None:
+                        _TRACE_FAILED[0] = True
+            finally:
+                shutil.rmtree(tdir, ignore_errors=True)
+        if dt is None:
+            # timed fallback (or the trace never started): stamp AFTER
+            # the dispatch call returns — compile happens synchronously
+            # inside it, so chunk 0 is not skewed — then block on the
+            # outputs; dt is the device execution wall
+            out = fn(*args)
+            t0 = time.monotonic()
+            jax.block_until_ready(out)
+            dt = time.monotonic() - t0
+        total_ms = dt * 1000.0
+        if traced_ms is not None:
+            source = "trace"
+            per_phase = {ph: round(ms, 4)
+                         for ph, ms in sorted(traced_ms.items())}
+        else:
+            source = "timed"
+            per_phase = {ph: round(total_ms * w, 4)
+                         for ph, w in self._phase_fractions().items()}
+        record = {
+            "per-phase-ms": per_phase,
+            "ms-per-tick": round(total_ms / max(ticks, 1), 5),
+            "device-s": round(dt, 5),
+            "ticks": int(ticks),
+            "source": source,
+        }
+        self.records.append(record)
+        return out, record
+
+    def summary(self) -> Optional[Dict[str, Any]]:
+        """The run-level roll-up for ``results.perf.phases.device``:
+        per-phase ms/tick averaged over the captured chunks."""
+        if not self.records:
+            return None
+        ticks = sum(r["ticks"] for r in self.records) or 1
+        per_phase: Dict[str, float] = {}
+        for r in self.records:
+            for ph, ms in r["per-phase-ms"].items():
+                per_phase[ph] = per_phase.get(ph, 0.0) + ms
+        total_ms = sum(r["device-s"] for r in self.records) * 1000.0
+        return {
+            "mode": self.mode,
+            "source": self.records[-1]["source"],
+            "captured-chunks": len(self.records),
+            "ms-per-tick": round(total_ms / ticks, 5),
+            "per-phase-ms-per-tick": {
+                ph: round(ms / ticks, 5)
+                for ph, ms in sorted(per_phase.items())},
+        }
+
+
+def hot_scope(per_phase: Dict[str, float]
+              ) -> Optional[Tuple[str, float]]:
+    """The dominant named scope of a per-phase ms dict (the watch
+    column and the profile report's verdict line)."""
+    if not per_phase:
+        return None
+    ph = max(per_phase, key=lambda k: per_phase[k])
+    return ph, per_phase[ph]
+
+
+def load_device_records(run_dir: str) -> Dict[str, Any]:
+    """Collect everything device-time a stored run has: heartbeat chunk
+    records carrying the ``device-ms`` lane plus the results.json
+    ``perf.phases.device`` roll-up. Both optional — old runs and
+    profiling-off runs yield empty fields, never an error."""
+    from .stream import read_heartbeat
+
+    chunks: List[Dict[str, Any]] = []
+    hb_path = os.path.join(run_dir, "heartbeat.jsonl")
+    if os.path.exists(hb_path):
+        try:
+            for rec in read_heartbeat(hb_path)["chunks"]:
+                if rec.get("device-ms"):
+                    chunks.append(rec)
+        except Exception:
+            pass
+    summary = None
+    res_path = os.path.join(run_dir, "results.json")
+    if os.path.exists(res_path):
+        try:
+            with open(res_path) as fh:
+                results = json.load(fh)
+            summary = (results.get("perf", {}).get("phases", {})
+                       .get("device"))
+        except Exception:
+            pass
+    return {"chunks": chunks, "summary": summary}
+
+
+def render_profile_report(run_dir: str) -> Optional[str]:
+    """The ``maelstrom profile <run-dir>`` body: per-phase device
+    ms/tick table + the hot scope. None when the run carries no device
+    time at all (the CLI exits 2 and says how to get some)."""
+    data = load_device_records(run_dir)
+    chunks, summary = data["chunks"], data["summary"]
+    if not chunks and not summary:
+        return None
+
+    per_phase: Dict[str, float] = {}
+    ticks = 0
+    source = None
+    if chunks:
+        for rec in chunks:
+            for ph, ms in rec["device-ms"].items():
+                per_phase[ph] = per_phase.get(ph, 0.0) + ms
+            ticks += int(rec.get("ticks", 0))
+            source = rec.get("device-source", source)
+        per_tick = {ph: ms / max(ticks, 1)
+                    for ph, ms in per_phase.items()}
+    else:
+        per_tick = dict(summary.get("per-phase-ms-per-tick", {}))
+    if summary:
+        source = summary.get("source", source)
+
+    total = sum(per_tick.values())
+    lines = [f"device time — {run_dir}"]
+    bits = []
+    if summary:
+        bits.append(f"mode {summary.get('mode', '?')}")
+    if source:
+        bits.append(f"source {source}")
+    if chunks:
+        bits.append(f"{len(chunks)} captured chunks / {ticks} ticks")
+    if bits:
+        lines.append("  " + " · ".join(bits))
+    lines.append("")
+    lines.append(f"  {'phase':<14} {'ms/tick':>9} {'share':>7}")
+    for ph, ms in sorted(per_tick.items(), key=lambda kv: -kv[1]):
+        share = ms / total if total > 0 else 0.0
+        lines.append(f"  {ph:<14} {ms:>9.4f} {share:>6.0%}")
+    lines.append(f"  {'total':<14} {total:>9.4f}")
+    hot = hot_scope(per_tick)
+    if hot:
+        share = hot[1] / total if total > 0 else 0.0
+        lines.append("")
+        lines.append(f"hot scope: {hot[0]} "
+                     f"({hot[1]:.4f} ms/tick, {share:.0%})")
+    return "\n".join(lines)

@@ -384,6 +384,16 @@ def render_chunk_line(rec: Dict[str, Any]) -> str:
                 else str(of))
         parts.append(f"check[{chk.get('mode', '?')} flagged "
                      f"{chk.get('flagged', 0)}/{of_s}]")
+    dev = rec.get("device-ms")
+    if dev:
+        # the device-time lane (telemetry/profiler.py): top scopes by
+        # ms/tick this chunk — `dev[node 0.41 net 0.22 /tick]`
+        from .profiler import PHASE_LABELS
+        ticks = rec.get("ticks") or 1
+        top = sorted(dev.items(), key=lambda kv: -kv[1])[:3]
+        bits = [f"{PHASE_LABELS.get(ph, ph)} {ms / ticks:.2f}"
+                for ph, ms in top]
+        parts.append("dev[" + " ".join(bits) + " /tick]")
     parts.append("OVERFLOW" if rec.get("events-overflowed") else "")
     n_lanes = len(rec.get("violations") or ())
     more = f", +{n_lanes - 1} more named" if v and n_lanes > 1 else ""

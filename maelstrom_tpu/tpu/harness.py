@@ -74,6 +74,15 @@ TPU_DEFAULTS = dict(
                               # <= 256 windows whatever the horizon)
     telemetry_hist_buckets=16,  # log2 ticks-to-ack histogram lanes
     profile_dir=None,         # jax.profiler trace capture directory
+    device_profile="auto",    # per-chunk device-time attribution
+                              # (telemetry/profiler.py): "auto" captures
+                              # the first K chunks then every Nth, "on"
+                              # every chunk, "off" none. Captured chunks
+                              # gain the heartbeat device-ms lane and
+                              # feed results.perf.phases.device; purely
+                              # observational — trajectories are
+                              # bit-identical at every setting
+                              # (tests/test_profiler.py)
     pipeline="auto",          # chunked donated executor (tpu/pipeline.py):
                               # "auto" uses it whenever the horizon spans
                               # multiple chunks; "on"/"off" force it. The
@@ -427,6 +436,14 @@ def _pipelined_phase_run(model: Model, sim: SimConfig, seed: int, params,
             profiling = True
         except Exception as e:
             phases["profile-error"] = repr(e)[:160]
+    # per-chunk device-time attribution (telemetry/profiler.py):
+    # observational, bit-identical on/off; "off" skips construction
+    # entirely so the cost-model weight trace is never paid
+    prof = None
+    mode = str(opts.get("device_profile") or "auto")
+    if mode != "off":
+        from ..telemetry.profiler import DeviceProfiler
+        prof = DeviceProfiler(mode, model=model, sim=sim, params=params)
     t0 = time.monotonic()
     try:
         res = run_sim_pipelined(
@@ -443,7 +460,8 @@ def _pipelined_phase_run(model: Model, sim: SimConfig, seed: int, params,
             # chunks directly — never reconstruct the dense tensor
             event_sink=event_sink,
             dense_events=event_sink is None,
-            check_mode=opts.get("check_mode"))
+            check_mode=opts.get("check_mode"),
+            profiler=prof)
     finally:
         if profiling:
             try:
@@ -452,6 +470,9 @@ def _pipelined_phase_run(model: Model, sim: SimConfig, seed: int, params,
                 pass
     phases["total-s"] = round(time.monotonic() - t0, 4)
     phases["pipeline"] = res.perf
+    if prof is not None and prof.records:
+        # device ms/tick per named scope, next to the host timers
+        phases["device"] = prof.summary()
     return res, phases
 
 

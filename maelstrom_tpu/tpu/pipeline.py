@@ -494,7 +494,8 @@ def run_sim_pipelined(model: Model, sim: SimConfig, seed: int,
                       checkpoint_cb=None, checkpoint_every: int = 0,
                       resume: Optional[ResumeState] = None,
                       event_sink=None, dense_events: bool = True,
-                      check_mode: Optional[str] = None
+                      check_mode: Optional[str] = None,
+                      profiler=None
                       ) -> PipelineResult:
     """Chunked, donated, double-buffered replacement for
     :func:`..tpu.runtime.run_sim` + the dense event fetch.
@@ -543,6 +544,16 @@ def run_sim_pipelined(model: Model, sim: SimConfig, seed: int,
     — the mode string plus the device-flagged instance count the
     per-chunk scan already carries (``maelstrom watch`` renders it as
     ``check[device flagged 3/100k]``).
+
+    ``profiler`` (a :class:`..telemetry.profiler.DeviceProfiler`,
+    observational): captured chunks dispatch under device-time
+    measurement and their heartbeat records gain the ``device-ms``
+    per-phase lane + ``device-s``; uncaptured chunks dispatch
+    untouched. The capture's trace window is torn down on the
+    exception path too (try/finally inside
+    :meth:`~..telemetry.profiler.DeviceProfiler.capture`), so a
+    mid-run checker blow-up never leaves the process-wide trace open.
+    Trajectories are bit-identical with profiling on or off.
     """
     if params is None:
         params = model.make_params(sim.net.n_nodes)
@@ -594,13 +605,31 @@ def run_sim_pipelined(model: Model, sim: SimConfig, seed: int,
         fuzz_windows = faults_fuzz.fleet_windows(
             sim.faults, sim.net.n_nodes, seed, instance_ids)
 
+    # profiler state: the dispatch-side chunk cursor (consume's
+    # chunk_idx lags one chunk behind) and the previous dispatch's
+    # detached stats block — syncing on it before a captured dispatch
+    # empties the device queue so the measurement covers only the
+    # captured chunk (uncaptured chunks keep the fetch/compute overlap)
+    dispatch_idx = [resume.chunks if resume else 0]
+    sync_ref = [None]
+
     def dispatch(carry_st, t0, length):
-        c, svec, scan, buf, journal = chunk_fn(carry_st, jnp.int32(t0),
-                                               length)
-        return c, (svec, scan, buf, journal)
+        idx = dispatch_idx[0]
+        dispatch_idx[0] += 1
+        prof_rec = None
+        if profiler is not None and profiler.should_capture(idx):
+            (c, svec, scan, buf, journal), prof_rec = profiler.capture(
+                chunk_fn, (carry_st, jnp.int32(t0), length), length,
+                sync=sync_ref[0])
+        else:
+            c, svec, scan, buf, journal = chunk_fn(carry_st,
+                                                   jnp.int32(t0),
+                                                   length)
+        sync_ref[0] = svec
+        return c, (svec, scan, buf, journal, prof_rec)
 
     def consume(payload, t0, length):
-        svec, scan, buf, journal = payload
+        svec, scan, buf, journal, prof_rec = payload
         t_f = time.monotonic()
         ovf = False
         if buf is not None:
@@ -641,12 +670,22 @@ def run_sim_pipelined(model: Model, sim: SimConfig, seed: int,
                 extra["check"] = {"mode": check_mode,
                                   "flagged": int(scan_np[0, 0]),
                                   "of": sim.n_instances}
+            if prof_rec is not None:
+                # the device-time lane (telemetry/profiler.py): per-
+                # phase ms for THIS chunk; `maelstrom watch` renders
+                # it as dev[node 0.41 net 0.22 ...]
+                extra = dict(extra or {})
+                extra["device-ms"] = prof_rec["per-phase-ms"]
+                extra["device-source"] = prof_rec["source"]
             heartbeat.record_chunk(
                 chunk=chunk_idx[0], t0=t0, ticks=length,
                 net=stats_vec_to_net(svec),
                 violation=scan_to_violation(scan_np),
                 violations=scan_to_violations(scan_np),
-                overflowed=bool(ovf), extra=extra)
+                overflowed=bool(ovf),
+                device_s=(prof_rec["device-s"] if prof_rec is not None
+                          else None),
+                extra=extra)
         chunk_idx[0] += 1
         fetch_s[0] += time.monotonic() - t_f
 
@@ -700,6 +739,11 @@ def run_sim_pipelined(model: Model, sim: SimConfig, seed: int,
         "fetch-reduction-x": round(dense_bytes / fetched_bytes[0], 1)
         if fetched_bytes[0] else None,
         "overflowed-chunks": overflowed[0],
+        # the device-time roll-up (telemetry/profiler.py): per-phase
+        # ms/tick over the captured chunks; the harness mirrors it to
+        # results.perf.phases.device
+        **({"device": profiler.summary()}
+           if profiler is not None and profiler.records else {}),
         **({"resumed-from-ticks": resume.ticks} if resume else {}),
         **{k: round(v, 4) if isinstance(v, float) else v
            for k, v in stats.items() if k != "consume-s"},

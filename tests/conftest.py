@@ -92,6 +92,11 @@ def pytest_configure(config):
                    "verdict identity, flagged-set routing, "
                    "checkpoint/cross-mesh lane stability "
                    "(checkers/device_summary.py)")
+    config.addinivalue_line(
+        "markers", "profiler: device-time observatory tests — "
+                   "profiling on/off bit-identity, heartbeat device-ms "
+                   "schema, trace teardown, fallback attribution "
+                   "(telemetry/profiler.py)")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,292 @@
+"""The device-time observatory (telemetry/profiler.py): profiling must
+be purely observational — trajectories bit-identical with it on or off
+in both carry layouts and under the sharded driver — while the captured
+records keep their schema contracts: heartbeat ``device-ms`` lanes,
+the ``results.perf.phases.device`` roll-up, the ``maelstrom profile``
+report, timed-fallback attribution that sums to the measured dispatch
+wall, and the trace-teardown guarantee (an exception mid-capture must
+never leave the process-wide ``jax.profiler`` trace open).
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from maelstrom_tpu import cli
+from maelstrom_tpu.campaign.checkpoint import (load_checkpoint,
+                                               restore_carry,
+                                               save_checkpoint)
+from maelstrom_tpu.models.echo import EchoModel
+from maelstrom_tpu.telemetry import profiler as profiler_mod
+from maelstrom_tpu.telemetry.profiler import (PHASE_LABELS,
+                                              DeviceProfiler, hot_scope,
+                                              phase_weights,
+                                              render_profile_report)
+from maelstrom_tpu.telemetry.stream import read_heartbeat, render_chunk_line
+from maelstrom_tpu.tpu.harness import (make_sim_config, run_tpu_test)
+from maelstrom_tpu.tpu.pipeline import (ResumeState, _init_pipelined,
+                                        make_chunk_fn, run_sim_pipelined)
+
+pytestmark = pytest.mark.profiler
+
+# the shared tiny echo config: 300 ticks / chunk 50 = 6 chunks
+ECHO_OPTS = dict(node_count=2, concurrency=2, n_instances=8,
+                 record_instances=2, time_limit=0.3, rate=100.0,
+                 latency=5.0, seed=3, funnel=False, pipeline="on",
+                 chunk_ticks=50)
+
+
+class Killed(Exception):
+    pass
+
+
+def _trees_equal(a, b):
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        assert np.array_equal(np.asarray(x), np.asarray(y))
+
+
+# --- observational purity --------------------------------------------------
+
+@pytest.mark.parametrize("layout", ["lead", "minor"])
+def test_pipelined_bit_identity_on_off(layout):
+    model = EchoModel()
+    sim = make_sim_config(model, {**ECHO_OPTS, "layout": layout})
+    params = model.make_params(sim.net.n_nodes)
+    off = run_sim_pipelined(model, sim, 3, params, chunk=50)
+    prof = DeviceProfiler("on", model=model, sim=sim, params=params)
+    on = run_sim_pipelined(model, sim, 3, params, chunk=50,
+                           profiler=prof)
+    _trees_equal(off.carry, on.carry)
+    assert np.array_equal(off.events, on.events)
+    # and it really profiled: every chunk captured in "on" mode
+    assert len(prof.records) == on.perf["chunks"]
+    assert on.perf["device"]["captured-chunks"] == len(prof.records)
+
+
+def test_sharded_bit_identity_on_off():
+    from maelstrom_tpu.parallel.mesh import (make_mesh,
+                                             run_sim_sharded_chunked)
+    model = EchoModel()
+    opts = dict(ECHO_OPTS, n_instances=4, time_limit=0.12)
+    sim = make_sim_config(model, opts)
+    mesh = make_mesh(2)
+    off = run_sim_sharded_chunked(model, sim, seed=3, mesh=mesh,
+                                  chunk=40)
+    prof = DeviceProfiler("on", model=model, sim=sim)
+    perf = {}
+    on = run_sim_sharded_chunked(model, sim, seed=3, mesh=mesh,
+                                 chunk=40, perf=perf, profiler=prof)
+    assert off[0] == on[0]                      # psum'd NetStats
+    assert np.array_equal(off[1], on[1])        # violations
+    assert np.array_equal(off[2], on[2])        # events
+    assert prof.records and perf["device"]["captured-chunks"] > 0
+
+
+def test_auto_mode_samples_not_every_chunk():
+    p = DeviceProfiler("auto")
+    expect = [i < DeviceProfiler.AUTO_FIRST_K
+              or i % DeviceProfiler.AUTO_EVERY_N == 0 for i in range(40)]
+    assert [p.should_capture(i) for i in range(40)] == expect
+    assert sum(expect) < 40                     # auto really skips
+    with pytest.raises(ValueError):
+        DeviceProfiler("sometimes")
+
+
+# --- the streamed schema ---------------------------------------------------
+
+@pytest.fixture(scope="module")
+def profiled_run(tmp_path_factory):
+    """One stored chunked echo run with --device-profile on."""
+    store = str(tmp_path_factory.mktemp("prof-store"))
+    results = run_tpu_test(EchoModel(),
+                           dict(ECHO_OPTS, store_root=store,
+                                device_profile="on"))
+    return results, results["store-dir"]
+
+
+def test_heartbeat_device_ms_schema(profiled_run):
+    _, run_dir = profiled_run
+    hb = read_heartbeat(os.path.join(run_dir, "heartbeat.jsonl"))
+    dev_chunks = [c for c in hb["chunks"] if c.get("device-ms")]
+    assert len(dev_chunks) == len(hb["chunks"])   # "on" = every chunk
+    for rec in dev_chunks:
+        assert set(rec["device-ms"]) <= set(PHASE_LABELS)
+        assert all(isinstance(v, float) and v >= 0.0
+                   for v in rec["device-ms"].values())
+        assert rec["device-source"] in ("timed", "trace")
+        assert rec["device-s"] > 0.0
+        # the watch lane renders from exactly these keys
+        assert "dev[" in render_chunk_line(rec)
+
+
+def test_results_device_rollup_schema(profiled_run):
+    results, run_dir = profiled_run
+    dev = results["perf"]["phases"]["device"]
+    assert dev["mode"] == "on"
+    assert dev["source"] in ("timed", "trace")
+    assert dev["captured-chunks"] == 6            # 300 ticks / chunk 50
+    assert dev["ms-per-tick"] > 0.0
+    per = dev["per-phase-ms-per-tick"]
+    assert per and set(per) <= set(PHASE_LABELS)
+    assert abs(sum(per.values()) - dev["ms-per-tick"]) \
+        <= 0.05 * dev["ms-per-tick"] + 1e-3
+    # the stored results.json carries the same roll-up
+    with open(os.path.join(run_dir, "results.json")) as f:
+        stored = json.load(f)
+    assert stored["perf"]["phases"]["device"] == json.loads(
+        json.dumps(dev))
+
+
+def test_profile_cli_smoke(profiled_run, capsys):
+    _, run_dir = profiled_run
+    assert cli.main(["profile", run_dir]) == 0
+    out = capsys.readouterr().out
+    assert "hot scope:" in out
+    assert "ms/tick" in out
+    # a dir with no device time exits 2, never crashes
+    assert cli.main(["profile", os.path.dirname(run_dir)]) == 2
+
+
+def test_profile_off_leaves_no_lanes(tmp_path):
+    results = run_tpu_test(EchoModel(),
+                           dict(ECHO_OPTS, store_root=str(tmp_path),
+                                device_profile="off"))
+    assert "device" not in results["perf"]["phases"]
+    hb = read_heartbeat(os.path.join(results["store-dir"],
+                                     "heartbeat.jsonl"))
+    assert not any(c.get("device-ms") for c in hb["chunks"])
+    assert render_profile_report(results["store-dir"]) is None
+
+
+# --- timed-fallback attribution --------------------------------------------
+
+def test_fallback_attribution_sums_to_measured_wall():
+    """Each timed capture splits the measured dispatch wall across the
+    cost model's phase weights: the per-phase sum must equal the
+    recorded device wall (by construction, modulo rounding), and the
+    recorded wall must be within tolerance of an external measurement
+    of the same warm dispatch."""
+    import time
+
+    model = EchoModel()
+    sim = make_sim_config(model, ECHO_OPTS)
+    params = model.make_params(sim.net.n_nodes)
+    chunk_fn = make_chunk_fn(model, sim, params,
+                             np.arange(8, dtype=np.int32), 64, 1)
+    st = _init_pipelined(model, sim, jnp.int32(3), params,
+                         jnp.arange(8, dtype=jnp.int32))
+    st = jax.tree.map(lambda x: x.copy(), st)
+    prof = DeviceProfiler("on", model=model, sim=sim, params=params)
+    # warm-up capture: compile happens inside the dispatch call, which
+    # the profiler's post-return stamp excludes from device time
+    (st, *_), warm = prof.capture(chunk_fn,
+                                  (st, jnp.int32(0), 50), 50)
+    t0 = time.monotonic()
+    (st, *_), rec = prof.capture(chunk_fn, (st, jnp.int32(50), 50), 50)
+    external_wall_ms = (time.monotonic() - t0) * 1000.0
+    assert rec["source"] == "timed"
+    phase_sum = sum(rec["per-phase-ms"].values())
+    measured = rec["device-s"] * 1000.0
+    assert measured > 0
+    assert abs(phase_sum - measured) <= 0.25 * measured + 1e-3
+    # the recorded device wall is a real measurement of this dispatch,
+    # not a constant: it cannot exceed the external wall around it
+    assert measured <= external_wall_ms + 1e-6
+
+
+def test_phase_weights_cover_known_scopes():
+    """The fallback attributes against the cost model's named scopes —
+    the vocabulary COST505 audits — and the weights are a partition."""
+    model = EchoModel()
+    sim = make_sim_config(model, ECHO_OPTS)
+    w = phase_weights(model, sim)
+    assert abs(sum(w.values()) - 1.0) < 1e-9
+    assert set(w) <= set(PHASE_LABELS)
+    assert "client_step" in w and "node_phase" in w
+    assert hot_scope(w) is not None
+
+
+# --- checkpoint/resume -----------------------------------------------------
+
+def test_resume_with_profiling_bit_exact(tmp_path):
+    """Kill mid-run, resume WITH profiling on: the concatenated
+    segments equal the uninterrupted unprofiled run, and the resumed
+    profiler's capture schedule continues at the absolute chunk index
+    (no re-burst of the auto mode's first-K chunks)."""
+    model = EchoModel()
+    sim = make_sim_config(model, ECHO_OPTS)
+    params = model.make_params(sim.net.n_nodes)
+    base = run_sim_pipelined(model, sim, 3, params, chunk=50)
+
+    d = str(tmp_path)
+
+    def cb(state, ticks, host):
+        save_checkpoint(d, kind="pipelined", state=state, ticks=ticks,
+                        chunks=host["chunks"],
+                        compact=tuple(host["compact"]),
+                        journal=tuple(host["journal"]))
+        raise Killed
+
+    prof1 = DeviceProfiler("on", model=model, sim=sim, params=params)
+    with pytest.raises(Killed):
+        run_sim_pipelined(model, sim, 3, params, chunk=50,
+                          checkpoint_cb=cb, checkpoint_every=2,
+                          profiler=prof1)
+    ck = load_checkpoint(d)
+    template = _init_pipelined(model, sim, 3, params,
+                               np.arange(8, dtype=np.int32))
+    resume = ResumeState(carry=restore_carry(template, ck["carry"]),
+                         ticks=ck["ticks"], chunks=ck["chunks"],
+                         compact=tuple(ck["compact"]),
+                         journal=tuple(ck["journal"]))
+    prof2 = DeviceProfiler("on", model=model, sim=sim, params=params)
+    res = run_sim_pipelined(model, sim, 3, params, chunk=50,
+                            resume=resume, profiler=prof2)
+    _trees_equal(base.carry, res.carry)
+    assert np.array_equal(base.events, res.events)
+    # the resumed segment captured exactly its own chunks
+    assert len(prof2.records) == 6 - ck["chunks"]
+
+
+# --- trace teardown --------------------------------------------------------
+
+def test_capture_teardown_on_exception(monkeypatch, tmp_path):
+    """An fn blow-up mid-capture must propagate AND stop the
+    process-wide trace — a later ``jax.profiler.start_trace`` must not
+    fail with 'already active' (the regression this pins)."""
+    monkeypatch.setenv("MAELSTROM_DEVICE_TRACE", "1")
+    monkeypatch.setattr(profiler_mod, "_TRACE_FAILED", [False])
+    prof = DeviceProfiler("on")
+    assert prof._try_trace
+
+    class Boom(Exception):
+        pass
+
+    def bad_fn():
+        raise Boom
+
+    with pytest.raises(Boom):
+        prof.capture(bad_fn, (), 1)
+    # the trace was torn down: a fresh window opens and closes cleanly
+    jax.profiler.start_trace(str(tmp_path))
+    jax.profiler.stop_trace()
+
+
+def test_trace_failure_latches_to_timed(monkeypatch):
+    """On this backend the forced trace attempt yields no parseable
+    trace-viewer JSON: the first capture must fall back to timed,
+    latch the process-wide flag, and still record real numbers."""
+    monkeypatch.setenv("MAELSTROM_DEVICE_TRACE", "1")
+    monkeypatch.setattr(profiler_mod, "_TRACE_FAILED", [False])
+    prof = DeviceProfiler("on")
+
+    def fn(x):
+        return jnp.sum(x * 2.0)
+
+    out, rec = prof.capture(fn, (jnp.ones(64),), 4)
+    assert float(out) == 128.0
+    assert rec["source"] == "timed" and rec["device-s"] >= 0.0

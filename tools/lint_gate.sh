@@ -30,9 +30,13 @@ echo "== cost/budget-regression canary (tampered baseline must fail)"
 # (b) re-introduces a fusion-breaking loop — drop kafka's recorded
 # JXP404 loop budget to 0, so its (legal, recorded) loop now exceeds
 # budget exactly like a per-slot scan sneaking back into the fused
-# raft family would. One tampered-baseline run must exit 1 with BOTH
-# COST501 and the JXP404 budget error. This exercises the detection
-# paths end-to-end without editing source.
+# raft family would — plus (c) a scope-coverage regression: zero one
+# entry's recorded unattributed-eqns budget, so its (legal, recorded)
+# scope-less eqns now read as a refactor that dropped a
+# jax.named_scope and blinded device-time attribution. One
+# tampered-baseline run must exit 1 with COST501, the JXP404 budget
+# error, AND COST505. This exercises the detection paths end-to-end
+# without editing source.
 python - "$SMOKE_STORE/cost_tampered.json" <<'PY'
 import json, sys
 base = json.load(open("maelstrom_tpu/analysis/cost_baseline.json"))
@@ -45,8 +49,12 @@ budget_keys = [k for k in base["entries"]
 assert budget_keys, "no loop-carrying entry to tamper"
 for k in budget_keys[:2]:
     base["entries"][k]["fusion-breakers"] = 0
+ua_key = next(k for k in sorted(base["entries"]) if k != key
+              and base["entries"][k].get("unattributed-eqns", 0) > 0)
+base["entries"][ua_key]["unattributed-eqns"] = 0
 json.dump(base, open(sys.argv[1], "w"))
-print(f"tampered entries: {key} (cost), {budget_keys[:2]} (budget)")
+print(f"tampered entries: {key} (cost), {budget_keys[:2]} (budget), "
+      f"{ua_key} (scope coverage)")
 PY
 rc=0
 python -m maelstrom_tpu lint --ir --cost --strict \
@@ -55,7 +63,8 @@ python -m maelstrom_tpu lint --ir --cost --strict \
 [[ "$rc" == "1" ]] || { echo "expected exit 1 (regressions caught), got $rc"; exit 1; }
 grep -q 'COST501' "$SMOKE_STORE/cost-canary.out"
 grep -Eq 'ERROR JXP404.*budget' "$SMOKE_STORE/cost-canary.out"
-echo "canary caught: $(grep -c COST501 "$SMOKE_STORE/cost-canary.out") COST501 + $(grep -Ec 'ERROR JXP404' "$SMOKE_STORE/cost-canary.out") JXP404-budget finding(s)"
+grep -Eq 'ERROR COST505' "$SMOKE_STORE/cost-canary.out"
+echo "canary caught: $(grep -c COST501 "$SMOKE_STORE/cost-canary.out") COST501 + $(grep -Ec 'ERROR JXP404' "$SMOKE_STORE/cost-canary.out") JXP404-budget + $(grep -Ec 'ERROR COST505' "$SMOKE_STORE/cost-canary.out") COST505 finding(s)"
 
 echo
 echo "== lane/width canary (tampered manifest + native width table must fail)"
@@ -214,6 +223,31 @@ python -m maelstrom_tpu test --runtime tpu -w echo --node-count 2 \
     --pipeline on --chunk-ticks 50 --seed 3 --store "$SMOKE_STORE" \
     > "$SMOKE_STORE/pipeline-smoke.json"
 grep -q '"chunk-ticks": 50' "$SMOKE_STORE/pipeline-smoke.json"
+
+echo
+echo "== device-profile smoke (per-phase device-ms lanes + profile report)"
+# a chunked run with --device-profile on must stream the device-ms
+# per-phase lane into every heartbeat chunk record AND roll it up into
+# results.perf.phases.device; `maelstrom profile` must then render the
+# per-phase table and name the hot scope (exit 0)
+python -m maelstrom_tpu test --runtime tpu -w echo --node-count 2 \
+    --time-limit 0.5 --rate 100 --n-instances 8 --record-instances 2 \
+    --pipeline on --chunk-ticks 50 --seed 3 --device-profile on \
+    --store "$SMOKE_STORE" > "$SMOKE_STORE/profile-smoke.json"
+PROFILE_RUN="$SMOKE_STORE"/echo-tpu/latest
+grep -q '"device-ms"' "$PROFILE_RUN"/heartbeat.jsonl
+python - "$SMOKE_STORE/profile-smoke.json" <<'PY'
+import json, sys
+res = json.JSONDecoder().raw_decode(open(sys.argv[1]).read())[0]
+dev = res["perf"]["phases"]["device"]
+assert dev["captured-chunks"] > 0, dev
+assert dev["per-phase-ms-per-tick"], dev
+print(f"profile smoke: {dev['captured-chunks']} captured chunks, "
+      f"{dev['ms-per-tick']} ms/tick ({dev['source']})")
+PY
+python -m maelstrom_tpu profile "$PROFILE_RUN" \
+    > "$SMOKE_STORE/profile-report.out"
+grep -q 'hot scope:' "$SMOKE_STORE/profile-report.out"
 
 echo
 echo "== native narrow-vs-wide smoke (equal checker verdicts)"

@@ -236,6 +236,21 @@ while true; do
         commit_artifacts artifacts/scaling_tpu_partial.jsonl "$HEALTH_LOG"
       fi
     fi
+    # the device-time scaling artifact (telemetry/profiler.py): the same
+    # ladder through the production pipelined + sharded executors with
+    # per-chunk profiling on — one SCALING_rNN.json per healthy window
+    # (the observatory's TPU evidence; doc/observability.md)
+    if ! ls SCALING_r*.json >/dev/null 2>&1; then
+      echo "$(date +%s) scaling: device-time artifact" >> "$HEALTH_LOG"
+      if timeout -k 15 900 python tools/tpu_scaling.py --artifact \
+           4096 16384 32768 \
+           >>/tmp/tpu_scaling_err.log 2>&1 \
+         && ls SCALING_r*.json >/dev/null 2>&1; then
+        echo "$(date +%s) scaling: device-time artifact captured" \
+          >> "$HEALTH_LOG"
+        commit_artifacts SCALING_r*.json "$HEALTH_LOG"
+      fi
+    fi
   else
     # the CPU fine leg needs no tunnel — the abundant down-time funds
     # it, never a healthy window (where it would compete with the

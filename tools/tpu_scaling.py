@@ -15,10 +15,24 @@ tunnel-safe.
 Usage:
     python tools/tpu_scaling.py                 # auto ladder by platform
     python tools/tpu_scaling.py 512 4096 16384  # explicit ladder
+    python tools/tpu_scaling.py --artifact [out.json] [rungs...]
 Env: SCALING_K (inbox_k, default 1), SCALING_POOL (pool_slots, default
 16), SCALING_TICKS (default 1000), SCALING_CHUNK (default 100),
 SCALING_LAYOUTS (comma list of carry layouts per rung; default "auto" —
 set "lead,minor" to A/B the batch-axis position on the accelerator).
+
+``--artifact`` is the device-time observatory's scaling artifact
+(doc/observability.md): the same flagship ladder, but run through the
+PRODUCTION executors — tpu/pipeline.run_sim_pipelined and
+parallel/mesh.run_sim_sharded_chunked — with per-chunk device-time
+profiling on (telemetry/profiler.DeviceProfiler), and written as one
+JSON file ``SCALING_rNN.json`` (next free NN in the repo root, or the
+explicit path) instead of JSONL lines. Each rung records msgs/s over
+the profiled device wall (compile excluded), device ms/tick per named
+scope, and the live-traced per-tick ICI estimate next to the committed
+shard-manifest figure (actual vs manifest — drift here is the SHD807
+story told in perf units). tools/tpu_opportunist.sh captures one per
+healthy TPU window.
 """
 
 from __future__ import annotations
@@ -30,6 +44,138 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
+
+
+def _next_artifact_path(root: str) -> str:
+    """SCALING_rNN.json with the next free NN (r01 on a fresh tree)."""
+    import re
+    taken = set()
+    for name in os.listdir(root):
+        m = re.fullmatch(r"SCALING_r(\d+)\.json", name)
+        if m:
+            taken.add(int(m.group(1)))
+    n = 1
+    while n in taken:
+        n += 1
+    return os.path.join(root, f"SCALING_r{n:02d}.json")
+
+
+def run_artifact(out_path, ladder) -> None:
+    """The ``--artifact`` mode: the ladder through the production
+    chunked executors with device-time profiling on."""
+    import time as _time
+
+    import jax
+
+    from maelstrom_tpu.analysis import shard_audit
+    from maelstrom_tpu.models.raft import RaftModel
+    from maelstrom_tpu.parallel.mesh import (make_mesh,
+                                             run_sim_sharded_chunked)
+    from maelstrom_tpu.telemetry.profiler import DeviceProfiler
+    from maelstrom_tpu.tpu.harness import make_sim_config
+    from maelstrom_tpu.tpu.pipeline import run_sim_pipelined
+
+    platform = jax.devices()[0].platform
+    if ladder is None:
+        ladder = [64, 256] if platform == "cpu" else [4096, 16384, 32768]
+    inbox_k = int(os.environ.get("SCALING_K", 1))
+    pool_slots = int(os.environ.get("SCALING_POOL", 16))
+    n_ticks = int(os.environ.get("SCALING_TICKS", 1000))
+    chunk = int(os.environ.get("SCALING_CHUNK", 100))
+    layouts = [s.strip() for s in
+               os.environ.get("SCALING_LAYOUTS", "auto").split(",")]
+
+    mesh = make_mesh()
+    n_shards = int(mesh.size)
+    manifest = shard_audit.load_shard_manifest()
+    model = RaftModel(n_nodes_hint=3, log_cap=64, heartbeat=8)
+    rungs = []
+    for n in ladder:
+      for layout in layouts:
+        opts = dict(node_count=3, concurrency=6, n_instances=n,
+                    record_instances=1, inbox_k=inbox_k,
+                    pool_slots=pool_slots,
+                    time_limit=n_ticks / 1000.0, rate=200.0, latency=5.0,
+                    rpc_timeout=1.0, nemesis=["partition"],
+                    nemesis_interval=0.4, p_loss=0.05,
+                    recovery_time=0.3, seed=7, layout=layout)
+        sim = make_sim_config(model, opts)
+        params = model.make_params(3)
+        for executor in ("pipelined", "sharded"):
+            prof = DeviceProfiler("on", model=model, sim=sim,
+                                  params=params)
+            t0 = _time.monotonic()
+            if executor == "pipelined":
+                res = run_sim_pipelined(model, sim, 7, params=params,
+                                        chunk=chunk, dense_events=False,
+                                        profiler=prof)
+                delivered = int(res.carry.stats.delivered)
+                total = n
+            else:
+                stats, _viol, _ev = run_sim_sharded_chunked(
+                    model, sim, 7, params=params, mesh=mesh,
+                    chunk=chunk, profiler=prof)
+                delivered = int(stats.delivered)
+                total = n * n_shards
+            wall = _time.monotonic() - t0
+            # compile never pollutes the device wall: the profiler
+            # stamps AFTER each dispatch call returns
+            dev_s = sum(r["device-s"] for r in prof.records)
+            rung = {
+                "executor": executor,
+                "instances": total,
+                "layout": sim.layout,
+                "shards": n_shards if executor == "sharded" else 1,
+                "inbox_k": inbox_k, "pool_slots": pool_slots,
+                "sim_ticks": sim.n_ticks,
+                "delivered": delivered,
+                "msgs_per_sec": (round(delivered / dev_s, 1)
+                                 if dev_s > 0 else None),
+                "wall_s": round(wall, 3),
+                "device": prof.summary(),
+            }
+            # the live-traced per-tick ICI estimate next to what the
+            # committed manifest promises for this config (the perf
+            # face of the SHD807 drift gate)
+            try:
+                live = shard_audit.shard_stats(model, sim,
+                                               mesh_size=n_shards)
+                entries = manifest.get("entries", {})
+                key = (f"{model.name}/n={sim.net.n_nodes}/{sim.layout}"
+                       f"/s={n_shards}")
+                if key not in entries:
+                    # the manifest audits each workload at ONE node
+                    # count — fall back to the same workload/layout/
+                    # mesh-size entry at whatever n it pinned (the ICI
+                    # figures are per-collective, not per-node-count)
+                    key = next(
+                        (k for k in sorted(entries)
+                         if k.startswith(model.name + "/n=")
+                         and k.endswith(f"/{sim.layout}/s={n_shards}")),
+                        key)
+                ent = entries.get(key)
+                rung["ici_bytes_est"] = live["ici_bytes_est"]
+                rung["collectives_per_tick"] = (
+                    live["collectives_per_tick"])
+                rung["ici_manifest_key"] = key
+                rung["ici_bytes_manifest"] = (
+                    ent.get("ici-bytes-per-tick")
+                    if ent is not None else None)
+            except Exception as e:     # the artifact survives a trace
+                rung["ici_error"] = repr(e)[:200]   # failure per rung
+            rungs.append(rung)
+            print(json.dumps(rung), flush=True)
+    payload = {
+        "version": 1,
+        "platform": platform,
+        "iso": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "ticks": n_ticks, "chunk": chunk,
+        "profile_mode": "on",
+        "rungs": rungs,
+    }
+    with open(out_path, "w") as f:
+        json.dump(payload, f, indent=2)
+    print(f"wrote {out_path} ({len(rungs)} rungs)", file=sys.stderr)
 
 
 def main() -> None:
@@ -117,4 +263,13 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    if "--artifact" in sys.argv:
+        argv = [a for a in sys.argv[1:] if a != "--artifact"]
+        out = next((a for a in argv if a.endswith(".json")), None)
+        nums = [int(a) for a in argv if a.isdigit()]
+        if out is None:
+            out = _next_artifact_path(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__))))
+        run_artifact(out, nums or None)
+    else:
+        main()
