@@ -389,6 +389,16 @@ def child_main(canary: bool = False) -> None:
         # A/B. Trajectories are bit-identical either way.
         bench_pipeline = os.environ.get("BENCH_PIPELINE") != "0"
         bench_unroll = int(os.environ.get("BENCH_UNROLL", "1"))
+        # certified AOT executable store A/B (tpu/aot_store.py): warm
+        # runs deserialize the stored chunk executable instead of
+        # re-tracing + re-compiling, so first_dispatch_s prices
+        # seconds-to-first-tick with the store in play. BENCH_AOT=0 is
+        # the cold A/B; --aot-store DIR overrides the compile-cache-
+        # sibling default ('auto'); MAELSTROM_AOT=0 also disables.
+        bench_aot = (bench_pipeline
+                     and os.environ.get("BENCH_AOT") != "0")
+        aot_record = None
+        first_dispatch = {"s": None}
         # run heartbeat A/B (telemetry/stream.py): BENCH_HEARTBEAT=0
         # drops the per-chunk violation-scan fetch + JSONL append so
         # the metric line can price the streaming observability layer
@@ -449,10 +459,25 @@ def child_main(canary: bool = False) -> None:
             # dispatch budget at run time
             pchunk = make_chunk_fn(model, sim, params, None, None,
                                    bench_unroll)
+            dispatch = pchunk
+            if bench_aot:
+                from maelstrom_tpu.tpu.aot_store import (
+                    resolve_store_dir, wrap_pipelined)
+                from maelstrom_tpu.tpu.pipeline import DEFAULT_SCAN_TOP_K
+                aot_fn, aot_record = wrap_pipelined(
+                    pchunk, model=model, sim=sim, params=params,
+                    instance_ids=None, cap=None, unroll=bench_unroll,
+                    scan_k=DEFAULT_SCAN_TOP_K,
+                    store_dir=resolve_store_dir(
+                        _argv_value("--aot-store", "auto")))
+                if aot_fn is not None:
+                    dispatch = aot_fn
+                    log(TAG, f"phase[{cfg_name}]: AOT store at "
+                             f"{aot_record['store']}")
 
             def chunk_fn(length: int):
                 def run(c, t0):
-                    c, svec, scan, buf, _ = pchunk(c, t0, length)
+                    c, svec, scan, buf, _ = dispatch(c, t0, length)
                     return c, svec, scan, buf
                 return run
 
@@ -582,8 +607,22 @@ def child_main(canary: bool = False) -> None:
             if collectives_per_tick is not None:
                 rec["collectives_per_tick"] = collectives_per_tick
                 rec["ici_bytes_est"] = ici_bytes_est
+            if first_dispatch["s"] is not None:
+                # wall from dispatching the first chunk to its stats
+                # landing — trace + compile (cold) or deserialization
+                # (warm store) included; THE seconds-to-first-tick
+                # number the AOT store exists to shrink
+                rec["first_dispatch_s"] = first_dispatch["s"]
             if bench_pipeline:
                 rec["pipeline"] = True
+                rec["aot"] = (False if aot_record is None else {
+                    "hit": aot_record["hit"],
+                    "fingerprint": aot_record["fingerprint"],
+                    "lengths": dict(aot_record["lengths"]),
+                    **({"error": aot_record["error"]}
+                       if "error" in aot_record else {})})
+                if aot_record is not None:
+                    rec["aot_load_s"] = round(aot_record["load-s"], 4)
                 rec["heartbeat"] = bench_heartbeat
                 rec["device_profile"] = bench_device_profile
                 if dev_prof is not None and dev_prof.records:
@@ -634,6 +673,7 @@ def child_main(canary: bool = False) -> None:
         ticks = W
         sent, delivered, ovf = sync_stats(carry, payload)  # blocks
         warm_wall = time.monotonic() - t0
+        first_dispatch["s"] = round(warm_wall, 3)
         log(TAG, f"phase[{cfg_name}]: warm-up chunk done in "
                  f"{warm_wall:.1f}s ({delivered} delivered incl. compile)")
         emit(delivered, delivered, sent, ovf, ticks, warm_wall,

@@ -1,0 +1,603 @@
+"""Certified-executable auditor: ``maelstrom lint --aot`` (pass 9).
+
+The AOT store (``tpu/aot_store.py``) lets the fleet dispatch serialized
+executables without re-tracing — which makes the store itself a new
+attack surface for silent drift: a stored binary whose source has moved
+on, whose donation aliasing was lost in serialization, or whose
+collective census no longer matches what the SPMD auditor certified
+would run WRONG (or wasteful) code with no compile step left to catch
+it. This pass closes that loop statically:
+
+- For the donation subjects (``ir_lint.DONATION_WORKLOAD`` x BOTH carry
+  layouts pipelined, plus the lead layout sharded on a 1-device mesh —
+  the same executables JXP403 and SHD804 already certify) it re-derives
+  the **canonical jaxpr digest** from current source (``aot_store.
+  jaxpr_digest`` of the ACTUAL production chunk dispatch, no compile
+  needed) and pins it in the checked-in, jax-version-stamped
+  ``analysis/aot_manifest.json``.
+- Every entry of the on-disk store (the compile cache's ``.aot``
+  sibling by default, or ``--aot-store DIR``) is audited: payload
+  bytes re-hashed against the recorded sha, recorded toolchain /
+  device kind matched against the running one, the stored fingerprint
+  compared to the digest current source traces to, the executable
+  DESERIALIZED and its ``input_output_alias`` re-verified, and its
+  HLO collective census checked against what ``shard_manifest.json``
+  promises (a collective kind the SPMD auditor never certified must
+  not hide inside a stored binary).
+
+Rules (EXE9xx):
+
+=======  ===========================  ========  ========================
+rule     name                         severity  what it flags
+=======  ===========================  ========  ========================
+EXE900   aot-manifest-updated         info      ``--update-aot``
+                                                rewrote the manifest
+EXE901   executable-fingerprint-      error     a stored / manifested
+         drift                                  fingerprint no longer
+                                                matches the jaxpr the
+                                                current source traces
+                                                to (or a payload whose
+                                                bytes fail their
+                                                recorded sha — tamper)
+EXE902   donation-lost-in-stored-     error     the DESERIALIZED
+         executable                             executable dropped
+                                                input_output_alias on
+                                                donated carry leaves
+EXE903   stored-collective-census-    error     the stored HLO contains
+         drift                                  a collective kind the
+                                                shard manifest never
+                                                certified (pipelined
+                                                entries: any collective
+                                                at all)
+EXE904   toolchain-incompatible-      error     an entry recorded under
+         entry                                  a different jax version
+                                                / platform / device
+                                                kind — refused by name;
+                                                the runtime treats it
+                                                as a miss
+EXE905   aot-manifest-missing         error     an audit subject has no
+                                                manifest entry
+EXE906   aot-manifest-stale           warning   a manifest entry
+                                                matches no audit
+                                                subject
+=======  ===========================  ========  ========================
+
+``--update-aot`` re-records the manifest from current source (traces
+only — cheap); given an explicit ``--aot-store DIR`` it ALSO compiles
+the subjects and populates that store, which is how ``tools/
+lint_gate.sh`` builds the throwaway store its tamper canary then
+corrupts, and how ``tools/tpu_opportunist.sh`` pre-warms a fleet store
+in a healthy-TPU window. A store entry that is merely ABSENT is never a
+finding — the store is a cache and a fresh checkout must lint green.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import warnings
+from typing import Any, Dict, List, Optional, Tuple
+
+from . import cost_model
+from .findings import Finding, SEV_ERROR, SEV_INFO, SEV_WARNING
+
+PASS_NAME = "aot"
+
+DEFAULT_AOT_MANIFEST = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "aot_manifest.json")
+
+# chunk length the audit subjects are lowered at — matches the donation
+# audit (ir_lint) and the shard census so all three passes certify the
+# same specialization
+AOT_CHUNK_LEN = 4
+
+# the donation audit's cap/unroll (ir_lint.audit_pipeline_donation):
+# the subjects ARE that audit's executables, re-derived here
+AOT_CAP = 64
+AOT_UNROLL = 1
+
+# mesh size of the sharded audit subject: 1 device, so the subject
+# compiles (and its store entry populates) on any host — the census
+# structure is size-invariant (verified by shard_audit per run)
+AOT_MESH_SIZE = 1
+
+_PIPELINE_PATH = "maelstrom_tpu/tpu/pipeline.py"
+_MESH_PATH = "maelstrom_tpu/parallel/mesh.py"
+_STORE_PATH = "maelstrom_tpu/tpu/aot_store.py"
+_MANIFEST_REPO_PATH = "maelstrom_tpu/analysis/aot_manifest.json"
+
+# jaxpr collective primitive -> optimized-HLO op kind: the bridge
+# between shard_manifest.json's census (jaxpr names) and a stored
+# executable's census (HLO names). XLA may ELIDE a promised collective
+# (1-device mesh folds all-reduces away), so the gate is one-sided: an
+# HLO kind with no promising primitive is drift, an un-realized promise
+# is not.
+_JAXPR_TO_HLO = {
+    "psum": "all-reduce", "pmax": "all-reduce", "pmin": "all-reduce",
+    "all_gather": "all-gather", "pgather": "all-gather",
+    "psum_scatter": "reduce-scatter", "reduce_scatter": "reduce-scatter",
+    "ppermute": "collective-permute", "all_to_all": "all-to-all",
+}
+
+
+def _finding(rule, name, severity, path, symbol, message) -> Finding:
+    return Finding(rule=rule, name=name, severity=severity,
+                   pass_name=PASS_NAME, path=path, line=0,
+                   symbol=symbol, message=message)
+
+
+# --- the audit subjects -----------------------------------------------------
+
+
+def audit_subjects(layouts=cost_model.AUDIT_LAYOUTS
+                   ) -> List[Dict[str, Any]]:
+    """Build (without tracing) the subject list: per subject the model,
+    sim, label, kind, and the anchor (path, symbol) its findings point
+    at."""
+    from .ir_lint import DONATION_WORKLOAD
+    from ..models import get_model
+
+    wl, n = DONATION_WORKLOAD
+    subjects: List[Dict[str, Any]] = []
+    for layout in layouts:
+        model = get_model(wl, n)
+        sim = cost_model.audit_sim(model, n, layout)
+        subjects.append({
+            "model": model, "sim": sim, "kind": "pipelined",
+            "label": f"{wl}/n={n}/{layout}/pipelined",
+            "path": _PIPELINE_PATH, "symbol": "make_chunk_fn"})
+    model = get_model(wl, n)
+    sim = cost_model.audit_sim(model, n, "lead")
+    subjects.append({
+        "model": model, "sim": sim, "kind": "sharded",
+        "label": f"{wl}/n={n}/lead/sharded/s={AOT_MESH_SIZE}",
+        "path": _MESH_PATH, "symbol": "make_sharded_chunk_fn"})
+    return subjects
+
+
+def _pipelined_lowerable(model, sim):
+    """The jitted pipelined chunk dispatch + its abstract arguments —
+    exactly what ``wrap_pipelined`` keys and compiles."""
+    from . import ir_lint
+    from ..tpu import pipeline
+    from ..tpu.runtime import default_instance_ids
+
+    params, carry_sds, t_sds = ir_lint._donation_args(model, sim)
+    iids = default_instance_ids(sim)
+    chunk_fn = pipeline.make_chunk_fn(model, sim, params, iids,
+                                      AOT_CAP, AOT_UNROLL)
+    from ..tpu.aot_store import pipelined_signature
+    sig = pipelined_signature(model, sim, params, iids, AOT_CAP,
+                              AOT_UNROLL, pipeline.DEFAULT_SCAN_TOP_K,
+                              AOT_CHUNK_LEN, carry_sds)
+    return chunk_fn, (carry_sds, t_sds), sig
+
+
+def _sharded_lowerable(model, sim):
+    """The jitted sharded chunk dispatch on a real 1-device mesh + its
+    abstract arguments — what ``wrap_sharded`` keys and compiles. A
+    real (not abstract) mesh so the traced jaxpr matches what a
+    populate on this host records, and so ``--update-aot`` can
+    actually compile it."""
+    import jax
+    import jax.numpy as jnp
+    from ..parallel import mesh as mesh_mod
+
+    params = model.make_params(sim.net.n_nodes)
+    if params is None:
+        params = jnp.zeros((), jnp.int32)
+    mesh = mesh_mod.make_mesh(AOT_MESH_SIZE)
+    chunk_fn, _ = mesh_mod.make_sharded_chunk_fn(model, sim, mesh,
+                                                 params)
+    wire = mesh_mod.wire_template(model, sim, mesh)
+    wsds = jax.tree.map(
+        lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype), wire)
+    psds = jax.tree.map(
+        lambda l: jax.ShapeDtypeStruct(jnp.shape(l),
+                                       jnp.asarray(l).dtype), params)
+    t_sds = jax.ShapeDtypeStruct((), jnp.int32)
+    from ..tpu.aot_store import sharded_signature
+    sig = sharded_signature(model, sim, mesh, psds,
+                            mesh_mod.DEFAULT_SCAN_TOP_K,
+                            AOT_CHUNK_LEN, wsds)
+    return chunk_fn, (wsds, t_sds, psds), sig
+
+
+def trace_subject(subject: Dict[str, Any]
+                  ) -> Tuple[Any, Tuple[Any, ...], Dict[str, Any], str]:
+    """Lower one subject to ``(chunk_fn, abstract_args, store_sig,
+    jaxpr_digest)`` — trace only, no compile."""
+    import jax
+    from ..tpu.aot_store import jaxpr_digest
+
+    if subject["kind"] == "pipelined":
+        chunk_fn, args, sig = _pipelined_lowerable(subject["model"],
+                                                   subject["sim"])
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            closed = jax.make_jaxpr(
+                lambda c, t: chunk_fn(c, t, length=AOT_CHUNK_LEN))(*args)
+    else:
+        chunk_fn, args, sig = _sharded_lowerable(subject["model"],
+                                                 subject["sim"])
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            closed = jax.make_jaxpr(
+                lambda w, t, p: chunk_fn(w, t, p,
+                                         length=AOT_CHUNK_LEN))(*args)
+    return chunk_fn, args, sig, jaxpr_digest(closed)
+
+
+def live_entries(subjects: Optional[List[Dict[str, Any]]] = None,
+                 trace_cache=None) -> Tuple[Dict[str, Dict[str, Any]],
+                                            Dict[str, Tuple[str, str]],
+                                            List[Finding]]:
+    """Trace every subject into the manifest-shaped live map
+    ``label -> {jaxpr-digest, chunk-length, donated-leaves, kind}``;
+    returns ``(live, anchors, failures)``. The lowered subjects ride
+    ``trace_cache`` under ``aot:<label>`` keys so ``--update-aot`` with
+    a store does not re-trace what this sweep already paid for."""
+    import jax
+
+    subjects = audit_subjects() if subjects is None else subjects
+    live: Dict[str, Dict[str, Any]] = {}
+    anchors: Dict[str, Tuple[str, str]] = {}
+    failures: List[Finding] = []
+    for subject in subjects:
+        label = subject["label"]
+        cached = (trace_cache.get("aot:" + label)
+                  if trace_cache is not None else None)
+        try:
+            if cached is None:
+                cached = trace_subject(subject)
+                if trace_cache is not None:
+                    trace_cache["aot:" + label] = cached
+        except Exception as e:
+            failures.append(_finding(
+                "EXE901", "executable-fingerprint-drift", SEV_ERROR,
+                subject["path"], subject["symbol"],
+                f"[{label}] lowering the audit subject raised "
+                f"{type(e).__name__}: {e} — the production dispatch "
+                f"no longer traces, so no stored executable for it can "
+                f"be certified"))
+            continue
+        _fn, args, _sig, digest = cached
+        live[label] = {
+            "jaxpr-digest": digest,
+            "chunk-length": AOT_CHUNK_LEN,
+            "donated-leaves": len(jax.tree.leaves(args[0])),
+            "kind": subject["kind"],
+        }
+        anchors[label] = (subject["path"], subject["symbol"])
+    return live, anchors, failures
+
+
+# --- manifest io + compare --------------------------------------------------
+
+
+def load_aot_manifest(path: Optional[str] = None) -> Dict[str, Any]:
+    path = path or DEFAULT_AOT_MANIFEST
+    if not os.path.exists(path):
+        return {"version": 1, "entries": {}}
+    with open(path) as f:
+        data = json.load(f)
+    data.setdefault("entries", {})
+    return data
+
+
+def save_aot_manifest(entries: Dict[str, Dict[str, Any]],
+                      path: Optional[str] = None) -> str:
+    import jax
+    path = path or DEFAULT_AOT_MANIFEST
+    payload = {
+        "version": 1,
+        "_comment": (
+            "Canonical jaxpr digests of the AOT-certified production "
+            "dispatch executables for `maelstrom lint --aot` "
+            "(doc/lint.md). Keys: <workload>/n=<nodes>/<layout>/"
+            "<pipelined|sharded>[/s=<mesh>]; jaxpr-digest = "
+            "aot_store.jaxpr_digest of the chunk dispatch traced from "
+            "current source at chunk-length ticks. A stored executable "
+            "(or this manifest) whose digest no longer matches current "
+            "source fails the gate (EXE901). Regenerate after an "
+            "INTENTIONAL dispatch change with `maelstrom lint --aot "
+            "--update-aot`. jax-version records the tracing toolchain: "
+            "under a different jax the gate downgrades drift to a "
+            "re-record warning."),
+        "jax-version": jax.__version__,
+        "entries": {k: entries[k] for k in sorted(entries)},
+    }
+    with open(path, "w") as f:
+        json.dump(payload, f, indent=2)
+        f.write("\n")
+    return path
+
+
+def compare_manifest(live: Dict[str, Dict[str, Any]],
+                     manifest: Dict[str, Any],
+                     anchors: Dict[str, Tuple[str, str]]
+                     ) -> List[Finding]:
+    """EXE905/901/906 — diff the live digests against the checked-in
+    manifest."""
+    entries = manifest.get("entries", {})
+    note = cost_model.toolchain_note(manifest.get("jax-version"),
+                                     "the AOT manifest", "--update-aot")
+    findings: List[Finding] = []
+    for label in sorted(live):
+        path, symbol = anchors[label]
+        base = entries.get(label)
+        if base is None:
+            findings.append(_finding(
+                "EXE905", "aot-manifest-missing", SEV_ERROR, path,
+                symbol,
+                f"[{label}] no AOT-manifest entry — record one with "
+                f"`maelstrom lint --aot --update-aot`"))
+            continue
+        if base.get("jaxpr-digest") != live[label]["jaxpr-digest"]:
+            findings.append(_finding(
+                "EXE901", "executable-fingerprint-drift",
+                SEV_WARNING if note else SEV_ERROR, path, symbol,
+                f"[{label}] the jaxpr current source traces to "
+                f"({live[label]['jaxpr-digest']}) no longer matches "
+                f"the certified manifest digest "
+                f"({base.get('jaxpr-digest')}) — the production "
+                f"dispatch changed; stored executables keyed on the "
+                f"old source would run different code than a fresh "
+                f"compile. If intentional, re-record with "
+                f"--update-aot and justify it in the PR"
+                + (f" ({note})" if note else "")))
+    for label in sorted(set(entries) - set(live)):
+        findings.append(_finding(
+            "EXE906", "aot-manifest-stale", SEV_WARNING,
+            _MANIFEST_REPO_PATH, "",
+            f"[{label}] manifest entry matches no audit subject — "
+            f"remove or re-record it"))
+    return findings
+
+
+# --- the store audit --------------------------------------------------------
+
+
+def _entry_anchor(meta: Dict[str, Any]) -> Tuple[str, str]:
+    if meta.get("kind") == "sharded":
+        return _MESH_PATH, "make_sharded_chunk_fn"
+    return _PIPELINE_PATH, "make_chunk_fn"
+
+
+def _promised_hlo_kinds(meta: Dict[str, Any]) -> Optional[set]:
+    """The HLO collective kinds the shard manifest certifies for this
+    entry (empty set for pipelined entries — a single-device executable
+    has no business containing ICI ops). ``None`` when the entry's
+    sharded config has no shard-manifest entry to judge against."""
+    if meta.get("kind") != "sharded":
+        return set()
+    # entry label <wl>/n=<n>/<layout>/sharded/s=<size> -> shard
+    # manifest key <wl>/n=<n>/<layout>/s=<size>
+    parts = (meta.get("entry") or "").split("/")
+    if len(parts) != 5:
+        return None
+    shard_key = "/".join(parts[:3] + parts[4:])
+    from .shard_audit import load_shard_manifest
+    entry = load_shard_manifest().get("entries", {}).get(shard_key)
+    if entry is None:
+        return None
+    prims = set(entry.get("tick-collectives", {})) \
+        | set(entry.get("dispatch-collectives", {}))
+    return {_JAXPR_TO_HLO[p] for p in prims if p in _JAXPR_TO_HLO}
+
+
+def audit_store(store_dir: str, live: Dict[str, Dict[str, Any]]
+                ) -> List[Finding]:
+    """EXE901/902/903/904 over every entry of one on-disk store."""
+    import jax
+    from .ir_lint import aliased_params_of
+    from ..tpu.aot_store import AotStore, _device_sig
+
+    store = AotStore(store_dir)
+    platform, kind = _device_sig()
+    findings: List[Finding] = []
+    for key, meta in store.entries():
+        entry = meta.get("entry", key)
+        path, symbol = _entry_anchor(meta)
+        where = f"store entry {key} ({entry}) in {store_dir}"
+
+        # EXE904: a foreign toolchain's binary — refused by name, never
+        # deserialized (the runtime face already treats it as a miss)
+        mismatches = [
+            f"{field} {meta.get(field)!r} != {cur!r}"
+            for field, cur in (("jax-version", jax.__version__),
+                               ("platform", platform),
+                               ("device-kind", kind))
+            if meta.get(field) != cur]
+        if mismatches:
+            findings.append(_finding(
+                "EXE904", "toolchain-incompatible-entry", SEV_ERROR,
+                _STORE_PATH, "AotStore",
+                f"{where}: recorded toolchain no longer matches the "
+                f"running one ({'; '.join(mismatches)}) — the runtime "
+                f"refuses this entry by name (treated as a miss); "
+                f"delete it or re-populate with `maelstrom lint --aot "
+                f"--update-aot --aot-store {store_dir}`"))
+            continue
+
+        # EXE901 (payload face): bytes must still hash to the recorded
+        # sha — a flipped byte anywhere in the binary is a tamper
+        triple = store.load_payload(key)
+        if triple is None:
+            findings.append(_finding(
+                "EXE901", "executable-fingerprint-drift", SEV_ERROR,
+                _STORE_PATH, "AotStore",
+                f"{where}: serialized payload is missing, unreadable, "
+                f"or no longer matches its recorded sha256 — the entry "
+                f"was tampered with or truncated; the runtime refuses "
+                f"it, delete and re-populate"))
+            continue
+
+        # EXE901 (source face): the certified fingerprint vs the jaxpr
+        # current source traces to — only decidable for entries lowered
+        # at the audit specialization
+        fp = meta.get("fingerprint", {})
+        subject = live.get(entry)
+        if (subject is not None
+                and fp.get("chunk-length") == subject["chunk-length"]
+                and fp.get("jaxpr-digest")
+                != subject["jaxpr-digest"]):
+            findings.append(_finding(
+                "EXE901", "executable-fingerprint-drift", SEV_ERROR,
+                path, symbol,
+                f"{where}: stored fingerprint "
+                f"{fp.get('jaxpr-digest')} no longer matches the jaxpr "
+                f"current source traces to "
+                f"({subject['jaxpr-digest']}) — the store would "
+                f"dispatch code the current tree does not describe; "
+                f"delete the entry or re-populate with --update-aot"))
+
+        # EXE903: collective kinds in the stored HLO that the SPMD
+        # auditor never certified (one-sided: XLA may elide a promised
+        # collective, it must never ADD one)
+        promised = _promised_hlo_kinds(meta)
+        if promised is not None:
+            smuggled = sorted(set(meta.get("collectives", {}))
+                              - promised)
+            if smuggled:
+                findings.append(_finding(
+                    "EXE903", "stored-collective-census-drift",
+                    SEV_ERROR, path, symbol,
+                    f"{where}: stored executable contains collective "
+                    f"op(s) {smuggled} that "
+                    + ("a single-device pipelined dispatch must not "
+                       "contain at all"
+                       if meta.get("kind") != "sharded" else
+                       "shard_manifest.json does not certify for this "
+                       "config")
+                    + " — new ICI traffic smuggled in through the "
+                      "store; re-run `maelstrom lint --shard` and "
+                      "re-populate"))
+
+        # EXE902: donation on the DESERIALIZED executable — serialize/
+        # deserialize must not drop input_output_alias, or every store
+        # hit silently doubles carry HBM
+        want = int(meta.get("donated-leaves", 0) or 0)
+        if want <= 0:
+            continue
+        try:
+            from jax.experimental import serialize_executable
+            loaded = serialize_executable.deserialize_and_load(*triple)
+            aliased = aliased_params_of(loaded.as_text())
+        except Exception as e:
+            findings.append(_finding(
+                "EXE902", "donation-lost-in-stored-executable",
+                SEV_WARNING, path, symbol,
+                f"{where}: could not deserialize for donation "
+                f"re-verification ({type(e).__name__}: {e}) — "
+                f"delete the entry or re-populate"))
+            continue
+        missing = sorted(set(range(want)) - aliased)
+        if missing:
+            findings.append(_finding(
+                "EXE902", "donation-lost-in-stored-executable",
+                SEV_ERROR, path, symbol,
+                f"{where}: {len(missing)} of {want} donated carry "
+                f"leaves lost input_output_alias in the DESERIALIZED "
+                f"executable (flat param indices {missing[:8]}"
+                f"{'...' if len(missing) > 8 else ''}) — donation "
+                f"certified at compile time does not survive this "
+                f"entry; every store hit would double carry HBM. "
+                f"Delete the entry and re-populate"))
+    return findings
+
+
+# --- populate (--update-aot --aot-store DIR) --------------------------------
+
+
+def populate_store(store_dir: str, subjects: List[Dict[str, Any]],
+                   trace_cache=None) -> Dict[str, str]:
+    """Compile every audit subject and write its store entry —
+    ``lint_gate.sh``'s canary store and ``tpu_opportunist.sh``'s fleet
+    pre-warm both come through here. Returns ``label -> key`` for what
+    was written (a subject whose executable does not serialize on this
+    backend is skipped, not fatal)."""
+    import jax
+    from ..tpu.aot_store import (AotStore, build_meta, entry_label,
+                                 store_key)
+
+    store = AotStore(store_dir)
+    written: Dict[str, str] = {}
+    for subject in subjects:
+        label = subject["label"]
+        cached = (trace_cache.get("aot:" + label)
+                  if trace_cache is not None else None)
+        try:
+            if cached is None:
+                cached = trace_subject(subject)
+                if trace_cache is not None:
+                    trace_cache["aot:" + label] = cached
+            chunk_fn, args, sig, digest = cached
+            from ..tpu.aot_store import _uncached_compile
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                with _uncached_compile():
+                    compiled = chunk_fn.lower(
+                        *args, length=AOT_CHUNK_LEN).compile()
+            key = store_key(sig)
+            meta = build_meta(
+                sig, key,
+                entry_label(subject["model"], subject["sim"],
+                            subject["kind"],
+                            mesh_size=AOT_MESH_SIZE
+                            if subject["kind"] == "sharded" else None),
+                digest, compiled,
+                donated_leaves=len(jax.tree.leaves(args[0])))
+            if store.put(key, compiled, meta):
+                written[label] = key
+        except Exception:
+            continue
+    return written
+
+
+# --- orchestration ----------------------------------------------------------
+
+
+def run_aot_lint(repo_root: str = ".",
+                 manifest_path: Optional[str] = None,
+                 update_manifest: bool = False,
+                 store_path: Optional[str] = None,
+                 trace_cache=None) -> List[Finding]:
+    """The aot pass: trace the audit subjects, gate the checked-in
+    digest manifest (or re-record it under ``update_manifest``), and
+    audit every entry of the resolved store. ``store_path=None`` rides
+    the default compile-cache sibling; an absent store dir audits
+    nothing (the store is a cache — a fresh checkout is green).
+    ``update_manifest`` with an EXPLICIT ``store_path`` also compiles
+    the subjects and populates that store."""
+    from ..tpu.aot_store import resolve_store_dir
+
+    subjects = audit_subjects()
+    live, anchors, findings = live_entries(subjects,
+                                           trace_cache=trace_cache)
+
+    if update_manifest:
+        path = save_aot_manifest(live, manifest_path)
+        n_store = 0
+        resolved = (resolve_store_dir(store_path)
+                    if store_path is not None else None)
+        if resolved is not None:
+            n_store = len(populate_store(resolved, subjects,
+                                         trace_cache=trace_cache))
+        findings.append(_finding(
+            "EXE900", "aot-manifest-updated", SEV_INFO,
+            os.path.relpath(path, os.path.abspath(repo_root))
+            if os.path.isabs(path) else path, "",
+            f"recorded {len(live)} AOT-manifest entr"
+            f"{'y' if len(live) == 1 else 'ies'}"
+            + (f" and populated {n_store} store entr"
+               f"{'y' if n_store == 1 else 'ies'} in {resolved}"
+               if n_store else "")))
+        return findings
+
+    manifest = load_aot_manifest(manifest_path)
+    findings.extend(compare_manifest(live, manifest, anchors))
+    resolved = resolve_store_dir(store_path)
+    if resolved is not None and os.path.isdir(resolved):
+        findings.extend(audit_store(resolved, live))
+    return findings

@@ -85,7 +85,8 @@ class BaselineEntry:
 _RULE_PASS_PREFIXES = (("TRC", "trace"), ("CON", "contract"),
                        ("SCH", "schema"), ("JXP", "ir"),
                        ("COST", "cost"), ("LNE", "lanes"),
-                       ("ABS", "ranges"), ("SHD", "shard"))
+                       ("ABS", "ranges"), ("SHD", "shard"),
+                       ("EXE", "aot"))
 
 
 def fingerprint_pass(fingerprint: str) -> Optional[str]:

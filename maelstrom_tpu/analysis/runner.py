@@ -18,12 +18,13 @@ from .findings import (Baseline, DEFAULT_BASELINE, Finding, LintReport,
 ALL_PASSES = ("trace", "contract", "schema")
 
 # opt-in passes: the IR hazard audit, the cost gate, the lane-liveness
-# slice, the value-range abstract interpreter, and the SPMD shard
-# auditor trace (and, for JXP403/SHD804, compile) every registered
-# model — tens of seconds to minutes, so they run only when named
-# (`--ir` / `--cost` / `--lanes` / `--ranges` / `--shard` /
-# `--pass ir`), never as part of the default sweep
-EXTRA_PASSES = ("ir", "cost", "lanes", "ranges", "shard")
+# slice, the value-range abstract interpreter, the SPMD shard auditor,
+# and the AOT executable-store certifier trace (and, for
+# JXP403/SHD804/EXE902, compile) every registered model — tens of
+# seconds to minutes, so they run only when named (`--ir` / `--cost` /
+# `--lanes` / `--ranges` / `--shard` / `--aot` / `--pass ir`), never
+# as part of the default sweep
+EXTRA_PASSES = ("ir", "cost", "lanes", "ranges", "shard", "aot")
 
 
 def run_lint(repo_root: str = ".",
@@ -39,6 +40,9 @@ def run_lint(repo_root: str = ".",
              ranges_horizon_log2: Optional[int] = None,
              shard_manifest_path: Optional[str] = None,
              update_shard_manifest: bool = False,
+             aot_manifest_path: Optional[str] = None,
+             update_aot_manifest: bool = False,
+             aot_store_path: Optional[str] = None,
              ) -> LintReport:
     """Run the requested passes and fold in the baseline.
 
@@ -56,7 +60,10 @@ def run_lint(repo_root: str = ".",
     pass (analysis/range_manifest.json; the horizon override is the
     lint_gate canary's synthetic overflow budget);
     ``shard_manifest_path`` / ``update_shard_manifest`` the shard pass
-    (analysis/shard_manifest.json).
+    (analysis/shard_manifest.json); ``aot_manifest_path`` /
+    ``update_aot_manifest`` / ``aot_store_path`` the AOT
+    executable-store certifier (analysis/aot_manifest.json; the store
+    path defaults to the compile cache's ``.aot`` sibling).
     """
     repo_root = os.path.abspath(repo_root)
     findings: List[Finding] = []
@@ -113,6 +120,14 @@ def run_lint(repo_root: str = ".",
             repo_root,
             manifest_path=shard_manifest_path,
             update_manifest=update_shard_manifest,
+            trace_cache=trace_cache))
+    if "aot" in effective:
+        from .aot_audit import run_aot_lint
+        findings.extend(run_aot_lint(
+            repo_root,
+            manifest_path=aot_manifest_path,
+            update_manifest=update_aot_manifest,
+            store_path=aot_store_path,
             trace_cache=trace_cache))
 
     baseline = (Baseline.load(baseline_path) if baseline_path

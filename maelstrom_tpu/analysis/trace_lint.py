@@ -545,7 +545,8 @@ def default_trace_targets(repo_root: str) -> List[str]:
             # host-side analysis code, but its verdicts gate traced
             # code — keep the analyzer itself lint-clean
             "maelstrom_tpu/analysis/absint.py",
-            "maelstrom_tpu/analysis/shard_audit.py"]
+            "maelstrom_tpu/analysis/shard_audit.py",
+            "maelstrom_tpu/analysis/aot_audit.py"]
     out = []
     for p in pats:
         out.extend(sorted(glob.glob(os.path.join(repo_root, p))))

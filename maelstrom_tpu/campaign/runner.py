@@ -108,6 +108,25 @@ def resume_run(run_dir: str,
     opts["seed"] = info["seed"]
     opts.update(opts_override or {})
     model = build_model(info["workload"], opts, info["model-config"])
+    # certified-store drift gate: the run-start record carries the
+    # executable fingerprint the run dispatched under; if the traced
+    # sources changed since, the resumed suffix would run DIFFERENT
+    # code than the checkpointed prefix — refuse by name (EXE901)
+    recorded = ((info.get("heartbeat") or {}).get("header") or {}
+                ).get("aot-fingerprint")
+    if recorded:
+        from ..tpu.harness import aot_fingerprint_for
+        current = aot_fingerprint_for(model, opts)
+        if current is not None and current != recorded:
+            raise CheckpointError(
+                f"EXE901: executable fingerprint drifted since this "
+                f"run was checkpointed (recorded {recorded}, current "
+                f"{current}) — the traced sources or run config "
+                f"changed, so the resumed suffix would not be "
+                f"bit-identical to the prefix. Re-run from scratch "
+                f"(and re-record with `maelstrom lint --aot "
+                f"--update-aot`), or set MAELSTROM_AOT=0 to resume "
+                f"without the certified store")
     return run_tpu_test(model, opts, resume_from=run_dir)
 
 

@@ -200,6 +200,27 @@ def triage_run(run_dir: str, ids: Optional[List[int]] = None,
         return summary
 
     model = _resolve_model(info)
+    # certified-store drift gate: the replay contract is bit-identity
+    # with the original dispatch. If the run-start record carries an
+    # executable fingerprint and the one current source keys to
+    # differs, the traced code moved since the run — a replay would
+    # "explain" a trajectory the original executable never produced.
+    # Refuse by name (EXE901); MAELSTROM_AOT=0 skips the gate.
+    recorded = ((info.get("heartbeat") or {}).get("header") or {}
+                ).get("aot-fingerprint")
+    if recorded:
+        from ..tpu.harness import aot_fingerprint_for
+        current = aot_fingerprint_for(model, info["opts"])
+        if current is not None and current != recorded:
+            raise TriageError(
+                f"EXE901: executable fingerprint drifted since this "
+                f"run (recorded {recorded}, current {current}) — the "
+                f"traced sources or run config changed, so a replay "
+                f"would not be bit-identical to the original "
+                f"dispatch. Triage from the matching checkout (and "
+                f"re-certify with `maelstrom lint --aot "
+                f"--update-aot`), or set MAELSTROM_AOT=0 to replay "
+                f"anyway")
     K = len(targets)
     sub_opts = {**info["opts"], "n_instances": K, "record_instances": K,
                 "journal_instances": K}

@@ -261,6 +261,14 @@ def add_test_options(p: argparse.ArgumentParser):
                         "--compile-cache 0 disables) — resumed/queued "
                         "runs skip recompiles; perf.phases records "
                         "hit/miss counts")
+    p.add_argument("--aot-store", default="auto",
+                   help="certified AOT executable store dir (default "
+                        "auto = the compile cache's .aot sibling; "
+                        "'off' or MAELSTROM_AOT=0 disables) — a store "
+                        "hit dispatches the serialized executable and "
+                        "skips trace+compile entirely; "
+                        "perf.phases.aot records hit/load-s/"
+                        "fingerprint")
     p.add_argument("--profile-dir", default=None,
                    help="TPU runtime: capture a jax.profiler trace of "
                         "the run into this directory")
@@ -500,6 +508,7 @@ def cmd_test(args) -> int:
             scan_top_k=args.scan_top_k,
             checkpoint_every=args.checkpoint_every,
             compile_cache=args.compile_cache,
+            aot_store=args.aot_store,
             check_workers=args.check_workers,
             check_mode=args.check_mode,
             node_count=node_count, concurrency=concurrency,
@@ -1146,6 +1155,8 @@ def cmd_campaign(args) -> int:
                 overrides["checkpoint_every"] = args.checkpoint_every
             if args.compile_cache is not None:
                 overrides["compile_cache"] = args.compile_cache
+            if args.aot_store is not None:
+                overrides["aot_store"] = args.aot_store
             summary = run_campaign(
                 args.path, max_items=args.max_items,
                 overrides=overrides, triage_invalid=args.triage)
@@ -1211,6 +1222,8 @@ def cmd_lint(args) -> int:
         passes.append("ranges")
     if args.shard or args.update_shard_manifest:
         passes.append("shard")
+    if args.aot or args.update_aot:
+        passes.append("aot")
     baseline = None if args.no_baseline else (args.baseline
                                               or DEFAULT_BASELINE)
     report = run_lint(repo_root=args.root,
@@ -1225,7 +1238,10 @@ def cmd_lint(args) -> int:
                       update_range_manifest=args.update_ranges,
                       ranges_horizon_log2=args.ranges_horizon_log2,
                       shard_manifest_path=args.shard_manifest,
-                      update_shard_manifest=args.update_shard_manifest)
+                      update_shard_manifest=args.update_shard_manifest,
+                      aot_manifest_path=args.aot_manifest,
+                      update_aot_manifest=args.update_aot,
+                      aot_store_path=args.aot_store)
     if args.json:
         print(json.dumps(report.to_json(), indent=2))
     else:
@@ -1403,6 +1419,12 @@ def main(argv=None) -> int:
                             "(default .jax_cache; an explicit flag "
                             "also overrides per-item spec settings; "
                             "MAELSTROM_COMPILE_CACHE=0 disables)")
+    c_run.add_argument("--aot-store", default=None,
+                       help="certified AOT executable store dir "
+                            "(default auto = the compile cache's .aot "
+                            "sibling; an explicit flag also overrides "
+                            "per-item spec settings; 'off' or "
+                            "MAELSTROM_AOT=0 disables)")
     c_run.add_argument("--triage", action="store_true",
                        help="auto-run `maelstrom triage` on each "
                             "invalid item's run dir")
@@ -1449,7 +1471,8 @@ def main(argv=None) -> int:
                         help="machine-readable findings on stdout")
     p_lint.add_argument("--pass", dest="passes", action="append",
                         choices=["trace", "contract", "schema", "ir",
-                                 "cost", "lanes", "ranges", "shard"],
+                                 "cost", "lanes", "ranges", "shard",
+                                 "aot"],
                         help="run only the named pass(es); default "
                              "trace+contract+schema (ir/cost are "
                              "opt-in — they trace/compile every "
@@ -1538,6 +1561,33 @@ def main(argv=None) -> int:
                         help="shard-manifest file (default "
                              "maelstrom_tpu/analysis/shard_manifest"
                              ".json)")
+    p_lint.add_argument("--aot", action="store_true",
+                        help="run the certified-executable pass "
+                             "(EXE9xx): re-derive the canonical jaxpr "
+                             "digest of the production chunk "
+                             "dispatches from current source, gate it "
+                             "against analysis/aot_manifest.json, and "
+                             "audit every entry of the AOT executable "
+                             "store — payload integrity, fingerprint "
+                             "drift, deserialized donation aliasing, "
+                             "collective census, toolchain match "
+                             "(doc/lint.md)")
+    p_lint.add_argument("--update-aot", action="store_true",
+                        help="re-record analysis/aot_manifest.json "
+                             "from the current tree (implies --aot); "
+                             "with an explicit --aot-store DIR also "
+                             "compiles the audit subjects and "
+                             "populates that store; commit the "
+                             "manifest with the PR that changes the "
+                             "dispatch")
+    p_lint.add_argument("--aot-manifest", default=None,
+                        help="AOT-manifest file (default "
+                             "maelstrom_tpu/analysis/aot_manifest"
+                             ".json)")
+    p_lint.add_argument("--aot-store", default=None,
+                        help="AOT executable store to audit/populate "
+                             "(default: the compile cache's .aot "
+                             "sibling; 'off' skips the store audit)")
     p_lint.add_argument("--baseline", default=None,
                         help="baseline file (default "
                              "maelstrom_tpu/analysis/baseline.json)")

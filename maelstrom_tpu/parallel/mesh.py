@@ -472,7 +472,8 @@ def run_sim_sharded_chunked(model: Model, sim: SimConfig, seed: int,
                             checkpoint_every: int = 0,
                             resume=None, check_mode: Optional[str] = None,
                             return_check_summary: bool = False,
-                            profiler=None):
+                            profiler=None,
+                            aot_store: Optional[str] = None):
     """:func:`run_sim_sharded` issued as a sequence of ``chunk``-tick
     device dispatches — the production dispatch pattern (single giant
     dispatches fault the TPU tunnel; see bench.py) — with the carry left
@@ -524,6 +525,13 @@ def run_sim_sharded_chunked(model: Model, sim: SimConfig, seed: int,
     the whole sharded dispatch including the tick-loop-free stat
     collectives — and their heartbeat records gain the ``device-ms``
     per-phase lane. Trajectories bit-identical on or off.
+
+    ``aot_store`` (a directory, or None): the certified AOT executable
+    store (``tpu/aot_store.py``), exactly as on
+    :func:`..tpu.pipeline.run_sim_pipelined` — a warm store
+    deserializes the sharded chunk executable instead of tracing and
+    compiling it; the outcome lands under ``perf["aot"]``.
+    Trajectories are bit-identical warm or cold.
     """
     import numpy as np
 
@@ -543,6 +551,14 @@ def run_sim_sharded_chunked(model: Model, sim: SimConfig, seed: int,
 
     chunk_fn, wire_spec = make_sharded_chunk_fn(model, sim, mesh,
                                                 params, scan_k=scan_k)
+    aot_rec = None
+    if aot_store is not None:
+        from ..tpu.aot_store import wrap_sharded
+        wrapped, aot_rec = wrap_sharded(
+            chunk_fn, model=model, sim=sim, mesh=mesh, params=params,
+            scan_k=scan_k, store_dir=aot_store)
+        if wrapped is not None:
+            chunk_fn = wrapped
 
     @jax.jit
     def init_fn(seed_rep, params):
@@ -659,6 +675,9 @@ def run_sim_sharded_chunked(model: Model, sim: SimConfig, seed: int,
         perf.update(chunk_stats)
         if profiler is not None and profiler.records:
             perf["device"] = profiler.summary()
+        if aot_rec is not None:
+            from ..tpu.aot_store import finalize_record
+            perf["aot"] = finalize_record(aot_rec)
 
     # final: per-shard stats summed on host (stats crossed the boundary
     # as [n_shards]-length arrays, one slot per shard; int adds commute,

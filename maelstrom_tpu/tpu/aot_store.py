@@ -1,0 +1,715 @@
+"""Certified AOT executable store — seconds-to-first-tick for the fleet.
+
+The production dispatch callables (``tpu/pipeline.py::make_chunk_fn``
+and ``parallel/mesh.py::make_sharded_chunk_fn`` — the same surfaces
+JXP403 and the SHD8xx auditor already certify) are AOT-lowered,
+compiled, and serialized (``jax.experimental.serialize_executable``)
+into a content-addressed on-disk store. ``run_tpu_test``,
+``run_sim_sharded_chunked``, bench.py, and the campaign runner consult
+the store BEFORE tracing: a hit deserializes the executable and skips
+trace+compile entirely, a miss compiles once and populates the entry.
+
+Keying is two-tier, because a hit must never pay a trace:
+
+* the **store key** (the dispatch-time lookup) is a sha256 over facts
+  the host knows without tracing — the canonical sim/model config, the
+  carry/wire leaf avals (layout x wire width x instance count), the
+  static chunk arguments, the mesh shape, a digest of the traced source
+  files, the jax version, and the device kind. Any of those drifting is
+  a safe miss (recompile + repopulate), never a wrong executable.
+* the **canonical jaxpr digest** (the certificate) is recorded in the
+  entry's sidecar meta at populate time and re-verified by ``maelstrom
+  lint --aot`` (analysis/aot_audit.py): EXE901 fires when a stored
+  fingerprint no longer matches the jaxpr the current source traces to,
+  EXE902 when the DESERIALIZED executable lost its donation aliasing,
+  EXE903 when its collective census drifted from shard_manifest.json,
+  EXE904 when the recorded toolchain/device-kind no longer matches.
+
+``MAELSTROM_AOT=0`` is the kill switch; ``--aot-store DIR|off`` picks
+the directory (default: the resolved compile-cache dir + ``.aot`` —
+``.jax_cache`` gets a ``.jax_cache.aot`` sibling). Loads are refused —
+by name, not silently — when the entry's recorded jax version or
+device kind differs from the running toolchain (the runtime face of
+EXE904), and a payload whose bytes no longer match their recorded
+sha256 is treated as a miss (the runtime face of EXE901). Every other
+failure degrades to the ordinary jit path: the store is an
+accelerator, never a correctness dependency.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import pickle
+import re
+import tempfile
+import time
+import warnings
+from typing import Any, Dict, Iterator, Optional, Tuple
+
+ENV_VAR = "MAELSTROM_AOT"
+STORE_VERSION = 1
+DEFAULT_SUFFIX = ".aot"
+
+# the packages whose source feeds the traced chunk computation; the
+# digest over them is the cheap (no-trace) drift guard in the store key
+# — analysis/, campaign/, telemetry/, cli never enter the jaxpr
+_SOURCE_PACKAGES = ("tpu", "parallel", "models", "faults", "checkers")
+
+# HLO collective ops counted in the stored executable's census
+# (EXE903); mirrors analysis/shard_audit.hlo_collective_census
+_HLO_COLLECTIVES = ("all-reduce", "all-gather", "reduce-scatter",
+                    "collective-permute", "all-to-all")
+
+_HEX = re.compile(r"0x[0-9a-fA-F]+")
+_WS = re.compile(r"\s+")
+
+_src_cache: Dict[str, str] = {}
+
+
+class _uncached_compile:
+    """Bypass the persistent XLA compile cache for one populate
+    compile. An executable SERVED by that cache serializes into a
+    payload whose jitted symbols are missing at deserialize time
+    (``Symbols not found`` on CPU) — the store must only ever hold
+    binaries from a real compile, so populate pays one even when the
+    XLA cache has the entry. Flipping the config flag alone is not
+    enough: ``is_cache_used`` latches its verdict at the first compile
+    of the process, so the latch must be reset around the flip (and
+    reset again after, so the restored flag re-initializes cleanly)."""
+
+    def __enter__(self):
+        import jax
+        try:
+            from jax._src import compilation_cache as cc
+            self._prev = jax.config.jax_enable_compilation_cache
+            cc.reset_cache()
+            jax.config.update("jax_enable_compilation_cache", False)
+        except Exception:
+            self._prev = None
+        return self
+
+    def __exit__(self, *exc):
+        if self._prev is not None:
+            import jax
+            from jax._src import compilation_cache as cc
+            jax.config.update("jax_enable_compilation_cache",
+                              self._prev)
+            cc.reset_cache()
+        return False
+
+
+def aot_enabled() -> bool:
+    """``MAELSTROM_AOT=0`` kills every store consultation."""
+    return os.environ.get(ENV_VAR, "").strip() != "0"
+
+
+def resolve_store_dir(flag: Optional[str],
+                      compile_cache_flag: Optional[str] = None
+                      ) -> Optional[str]:
+    """The effective store dir, or ``None`` when disabled.
+
+    ``flag``: ``None``/``"auto"`` rides the compile cache (the resolved
+    cache dir + ``.aot``; a disabled compile cache disables the store
+    too), ``"off"``/``"0"``/``""`` disables, anything else is the
+    directory. The ``MAELSTROM_AOT=0`` kill switch wins over all."""
+    if not aot_enabled():
+        return None
+    if flag is not None and str(flag).strip() in ("off", "0", ""):
+        return None
+    if flag is not None and str(flag).strip() != "auto":
+        return os.path.abspath(str(flag))
+    from ..utils.compile_cache import DEFAULT_DIR, resolve_cache_dir
+    cache = resolve_cache_dir(DEFAULT_DIR if compile_cache_flag is None
+                              else compile_cache_flag)
+    if cache is None:
+        return None
+    return os.path.abspath(cache) + DEFAULT_SUFFIX
+
+
+def source_digest() -> str:
+    """sha256 over every traced-surface source file (tpu/, parallel/,
+    models/, faults/, checkers/). Part of the store key: an edited
+    source is a guaranteed store MISS before any trace happens — the
+    cheap runtime face of the EXE901 gate. Cached per process."""
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cached = _src_cache.get(pkg_root)
+    if cached is not None:
+        return cached
+    h = hashlib.sha256()
+    for sub in _SOURCE_PACKAGES:
+        base = os.path.join(pkg_root, sub)
+        for dirpath, dirnames, filenames in sorted(os.walk(base)):
+            dirnames.sort()
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fn)
+                h.update(os.path.relpath(path, pkg_root).encode())
+                with open(path, "rb") as f:
+                    h.update(f.read())
+    digest = h.hexdigest()[:16]
+    _src_cache[pkg_root] = digest
+    return digest
+
+
+def _canon(x: Any) -> Any:
+    """Canonical JSON-able form of a config value: dataclasses and
+    namedtuples flatten field-by-field, arrays become
+    (dtype, shape, value-hash), callables their qualname — so the store
+    key covers every Python constant the trace would bake in."""
+    import numpy as np
+    if x is None or isinstance(x, (bool, int, str)):
+        return x
+    if isinstance(x, float):
+        return repr(x)
+    if dataclasses.is_dataclass(x) and not isinstance(x, type):
+        return {"__dc__": type(x).__name__,
+                **{f.name: _canon(getattr(x, f.name))
+                   for f in dataclasses.fields(x)}}
+    if isinstance(x, tuple) and hasattr(x, "_fields"):
+        return {"__nt__": type(x).__name__,
+                **{k: _canon(v) for k, v in zip(x._fields, x)}}
+    if isinstance(x, dict):
+        return {"__d__": sorted(
+            ([str(k), _canon(v)] for k, v in x.items()),
+            key=lambda kv: kv[0])}
+    if isinstance(x, (list, tuple, set, frozenset)):
+        items = [_canon(v) for v in x]
+        return sorted(map(json.dumps, items)) \
+            if isinstance(x, (set, frozenset)) else items
+    if hasattr(x, "shape") and hasattr(x, "dtype"):
+        try:
+            arr = np.asarray(x)
+        except Exception:   # abstract value: shapes/dtypes only
+            return {"__s__": [str(x.dtype), list(x.shape)]}
+        return {"__a__": [str(arr.dtype), list(arr.shape),
+                          hashlib.sha256(arr.tobytes()).hexdigest()[:16]]}
+    if callable(x):
+        return {"__f__": f"{getattr(x, '__module__', '?')}."
+                         f"{getattr(x, '__qualname__', repr(x))}"}
+    return {"__r__": repr(x)}
+
+
+def _aval_sig(tree: Any) -> Dict[str, Any]:
+    """Tree structure + per-leaf (dtype, shape) — the carry/wire shape
+    face of the fingerprint (layout, wire width, instance count)."""
+    import jax
+    leaves, treedef = jax.tree.flatten(tree)
+    return {"treedef": str(treedef),
+            "leaves": [[str(getattr(l, "dtype", "?")),
+                        list(getattr(l, "shape", ()))] for l in leaves]}
+
+
+def store_key(sig: Dict[str, Any]) -> str:
+    """Content address of one executable: sha256 of the canonical
+    signature."""
+    blob = json.dumps(_canon(sig), sort_keys=True,
+                      separators=(",", ":"))
+    return hashlib.sha256(blob.encode()).hexdigest()[:32]
+
+
+def _device_sig() -> Tuple[str, str]:
+    import jax
+    dev = jax.devices()[0]
+    return dev.platform, getattr(dev, "device_kind", dev.platform)
+
+
+def pipelined_signature(model, sim, params, instance_ids, cap,
+                        unroll: int, scan_k: int, length: int,
+                        carry) -> Dict[str, Any]:
+    """Everything that determines the single-device chunk executable.
+    ``params`` and ``instance_ids`` are hashed BY VALUE — the pipelined
+    chunk fn closes over them, so they are burned into the binary."""
+    import jax
+    platform, kind = _device_sig()
+    return {
+        "store-version": STORE_VERSION, "kind": "pipelined",
+        "model": getattr(model, "name", type(model).__name__),
+        "model-config": {k: v for k, v in vars(model).items()
+                         if not k.startswith("_")},
+        "sim": sim, "params": params, "instance-ids": instance_ids,
+        "cap": cap, "unroll": unroll, "scan-k": scan_k,
+        "length": length, "carry": _aval_sig(carry),
+        "jax": jax.__version__, "platform": platform,
+        "device-kind": kind, "n-devices": jax.device_count(),
+        "src": source_digest(),
+    }
+
+
+def sharded_signature(model, sim, mesh, params, scan_k: int,
+                      length: int, wire) -> Dict[str, Any]:
+    """Everything that determines the sharded chunk executable.
+    ``params`` cross the wire as an argument, so only their avals
+    matter; the mesh shape and device census are part of the key."""
+    import jax
+    platform, kind = _device_sig()
+    return {
+        "store-version": STORE_VERSION, "kind": "sharded",
+        "model": getattr(model, "name", type(model).__name__),
+        "model-config": {k: v for k, v in vars(model).items()
+                         if not k.startswith("_")},
+        "sim": sim, "params": _aval_sig(params),
+        "scan-k": scan_k, "length": length, "wire": _aval_sig(wire),
+        "mesh": [[str(n), int(s)] for n, s in
+                 zip(mesh.axis_names, mesh.devices.shape)],
+        "jax": jax.__version__, "platform": platform,
+        "device-kind": kind, "n-devices": jax.device_count(),
+        "src": source_digest(),
+    }
+
+
+def jaxpr_digest(closed) -> str:
+    """The canonical fingerprint of a traced computation: sha256 of the
+    jaxpr's pretty-printed text with addresses and whitespace scrubbed
+    (stable across processes and repeated traces — pinned by
+    tests/test_aot.py). This is the certificate ``maelstrom lint
+    --aot`` re-derives from source and compares against every stored
+    entry (EXE901)."""
+    txt = _WS.sub(" ", _HEX.sub("0x", str(closed)))
+    return hashlib.sha256(txt.encode()).hexdigest()[:32]
+
+
+def hlo_collective_census(compiled_text: str) -> Dict[str, int]:
+    """Count ICI collective ops in compiled HLO text (the stored-HLO
+    half of the EXE903 drift gate; shard_audit has the jaxpr half)."""
+    return {op: n for op in _HLO_COLLECTIVES
+            if (n := compiled_text.count(f" {op}(")) > 0}
+
+
+def entry_label(model, sim, kind: str,
+                mesh_size: Optional[int] = None) -> str:
+    """The coarse, content-independent identity of an entry —
+    ``<workload>/n=<nodes>/<layout>/<kind>[/s=<mesh>]`` — what the lint
+    pass uses to pair store entries with its audit subjects even after
+    the content hash drifted (EXE901 needs to NAME the drifted entry,
+    not merely fail to find it)."""
+    base = (f"{getattr(model, 'name', type(model).__name__)}"
+            f"/n={sim.net.n_nodes}/{sim.layout}/{kind}")
+    if mesh_size is not None:
+        base += f"/s={mesh_size}"
+    return base
+
+
+# --------------------------------------------------------------------
+# the on-disk store
+# --------------------------------------------------------------------
+
+class AotStore:
+    """Content-addressed executable store: ``<key>.bin`` holds the
+    pickled (payload, in_tree, out_tree) triple from
+    ``serialize_executable.serialize``, ``<key>.json`` the audit
+    sidecar (fingerprint, donation aliases, collective census,
+    toolchain). Writes are atomic (tempfile + rename) so a killed
+    populate never leaves a half-entry."""
+
+    def __init__(self, root: str):
+        self.root = root
+
+    def _bin(self, key: str) -> str:
+        return os.path.join(self.root, key + ".bin")
+
+    def _meta(self, key: str) -> str:
+        return os.path.join(self.root, key + ".json")
+
+    def meta(self, key: str) -> Optional[Dict[str, Any]]:
+        try:
+            with open(self._meta(key)) as f:
+                return json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return None
+
+    def entries(self) -> Iterator[Tuple[str, Dict[str, Any]]]:
+        """(key, meta) for every readable entry, key-sorted."""
+        try:
+            names = sorted(os.listdir(self.root))
+        except OSError:
+            return
+        for name in names:
+            if not name.endswith(".json"):
+                continue
+            meta = self.meta(name[:-5])
+            if meta is not None:
+                yield name[:-5], meta
+
+    def load_payload(self, key: str) -> Optional[Tuple[bytes, Any, Any]]:
+        """The raw (payload, in_tree, out_tree) triple — integrity-
+        checked against the sidecar's payload sha but NOT toolchain-
+        gated (the lint pass needs to load entries it will then refuse
+        by name)."""
+        meta = self.meta(key)
+        if meta is None:
+            return None
+        try:
+            with open(self._bin(key), "rb") as f:
+                blob = f.read()
+        except OSError:
+            return None
+        if (hashlib.sha256(blob).hexdigest()
+                != meta.get("payload-sha256")):
+            return None   # tampered/truncated payload: never load it
+        try:
+            payload, in_tree, out_tree = pickle.loads(blob)
+        except Exception:
+            return None
+        return payload, in_tree, out_tree
+
+    def load(self, key: str):
+        """Deserialize an entry into a callable Compiled, or ``None``
+        on any miss: absent, integrity-failed, or recorded for a
+        different jax version / device kind (the runtime face of
+        EXE904 — a foreign binary is refused, not loaded)."""
+        import jax
+        meta = self.meta(key)
+        if meta is None:
+            return None
+        platform, kind = _device_sig()
+        if (meta.get("jax-version") != jax.__version__
+                or meta.get("device-kind") != kind
+                or meta.get("platform") != platform):
+            return None
+        triple = self.load_payload(key)
+        if triple is None:
+            return None
+        try:
+            from jax.experimental import serialize_executable
+            return serialize_executable.deserialize_and_load(*triple)
+        except Exception:
+            return None
+
+    def put(self, key: str, compiled, meta: Dict[str, Any]) -> bool:
+        """Serialize + write one entry atomically. Returns False (and
+        stores nothing) when the executable does not serialize on this
+        backend."""
+        try:
+            from jax.experimental import serialize_executable
+            triple = serialize_executable.serialize(compiled)
+            blob = pickle.dumps(triple)
+            # round-trip self-check: a payload this process cannot
+            # load back (e.g. serialized from a persistent-cache-
+            # served executable) must never be stored — every entry
+            # on disk is loadable by construction
+            serialize_executable.deserialize_and_load(
+                *pickle.loads(blob))
+        except Exception:
+            return False
+        os.makedirs(self.root, exist_ok=True)
+        meta = dict(meta, **{"payload-sha256":
+                             hashlib.sha256(blob).hexdigest()})
+        for path, data, mode in ((self._bin(key), blob, "wb"),
+                                 (self._meta(key),
+                                  json.dumps(meta, indent=1,
+                                             sort_keys=True) + "\n",
+                                  "w")):
+            fd, tmp = tempfile.mkstemp(dir=self.root, suffix=".tmp")
+            try:
+                with os.fdopen(fd, mode) as f:
+                    f.write(data)
+                os.replace(tmp, path)
+            except BaseException:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
+        return True
+
+
+def build_meta(sig: Dict[str, Any], cache_key: str, entry: str,
+               digest: Optional[str], compiled,
+               donated_leaves: int) -> Dict[str, Any]:
+    """The audit sidecar of one entry: everything ``maelstrom lint
+    --aot`` checks without re-deserializing, plus the canonical
+    fingerprint EXE901 compares."""
+    import jax
+    try:
+        text = compiled.as_text()
+    except Exception:
+        text = ""
+    try:
+        from ..analysis.ir_lint import aliased_params_of
+        aliased = sorted(aliased_params_of(text))
+    except Exception:
+        aliased = []
+    platform, kind = _device_sig()
+    return {
+        "version": STORE_VERSION,
+        "key": cache_key,
+        "entry": entry,
+        "kind": sig["kind"],
+        "model": sig["model"],
+        "fingerprint": {
+            "jaxpr-digest": digest,
+            "src-digest": sig["src"],
+            "carry-layout": getattr(sig.get("sim"), "layout", None),
+            "chunk-length": sig["length"],
+            "mesh": sig.get("mesh"),
+        },
+        "jax-version": jax.__version__,
+        "platform": platform,
+        "device-kind": kind,
+        "donated-leaves": donated_leaves,
+        "aliased-params": aliased,
+        "collectives": hlo_collective_census(text),
+        "created": time.strftime("%Y-%m-%dT%H:%M:%S"),
+    }
+
+
+# --------------------------------------------------------------------
+# dispatch wrappers (the production integration points)
+# --------------------------------------------------------------------
+
+def _fresh_record(store_dir: str) -> Dict[str, Any]:
+    return {"store": store_dir, "hit": False, "load-s": 0.0,
+            "fingerprint": None, "lengths": {}}
+
+
+def finalize_record(rec: Optional[Dict[str, Any]]
+                    ) -> Optional[Dict[str, Any]]:
+    if rec is not None:
+        rec["load-s"] = round(rec["load-s"], 4)
+    return rec
+
+
+def _note_aot(hit: bool) -> None:
+    from ..utils.compile_cache import note_aot
+    note_aot(hit)
+
+
+def wrap_pipelined(chunk_fn, *, model, sim, params, instance_ids, cap,
+                   unroll: int, scan_k: int, store_dir: Optional[str]):
+    """Wrap the jitted single-device ``chunk_fn(carry, t0, length)``
+    with the store: per static chunk length, a hit deserializes the
+    stored executable (no trace, no compile), a miss AOT-compiles
+    through ``chunk_fn.lower`` and populates the entry. Any store
+    failure falls back to the plain jit path for that length — the
+    returned callable is drop-in and trajectories are bit-identical
+    either way. Returns ``(wrapped, record)``; ``(None, None)`` when
+    the store is disabled."""
+    if store_dir is None:
+        return None, None
+    import jax
+    import jax.numpy as jnp
+    from .runtime import default_instance_ids
+    if instance_ids is None:
+        instance_ids = default_instance_ids(sim)
+    store = AotStore(store_dir)
+    record = _fresh_record(store_dir)
+    per_length: Dict[int, Any] = {}
+
+    def _resolve(template, length: int):
+        try:
+            sds = jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+                template)
+            sig = pipelined_signature(model, sim, params, instance_ids,
+                                      cap, unroll, scan_k, length, sds)
+            key = store_key(sig)
+            if record["fingerprint"] is None:
+                record["fingerprint"] = key
+            t0 = time.monotonic()
+            compiled = store.load(key)
+            if compiled is not None:
+                record["load-s"] += time.monotonic() - t0
+                record["hit"] = True
+                record["lengths"][str(length)] = "hit"
+                _note_aot(True)
+                return compiled
+            _note_aot(False)
+            record["lengths"][str(length)] = "miss"
+            tsds = jax.ShapeDtypeStruct((), jnp.int32)
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                with _uncached_compile():
+                    compiled = chunk_fn.lower(sds, tsds,
+                                              length=length).compile()
+                closed = jax.make_jaxpr(
+                    lambda c, t: chunk_fn(c, t, length=length))(sds, tsds)
+            meta = build_meta(sig, key,
+                              entry_label(model, sim, "pipelined"),
+                              jaxpr_digest(closed), compiled,
+                              donated_leaves=len(jax.tree.leaves(sds)))
+            if store.put(key, compiled, meta):
+                record["lengths"][str(length)] = "populated"
+            return compiled
+        except Exception as e:
+            record["lengths"][str(length)] = "error"
+            record["error"] = repr(e)[:200]
+            return lambda c, t: chunk_fn(c, t, length=length)
+
+    def wrapped(carry, t0, length):
+        fn = per_length.get(length)
+        if fn is None:
+            fn = per_length[length] = _resolve(carry, length)
+        return fn(carry, t0)
+
+    return wrapped, record
+
+
+def wrap_sharded(chunk_fn, *, model, sim, mesh, params, scan_k: int,
+                 store_dir: Optional[str]):
+    """The sharded twin of :func:`wrap_pipelined`: wraps the jitted
+    ``chunk_fn(wire, t0, params, length)`` from
+    ``make_sharded_chunk_fn``. The mesh shape and device census join
+    the key, and params stay a runtime argument (only their avals are
+    fingerprinted). Returns ``(wrapped, record)`` or ``(None, None)``
+    when disabled."""
+    if store_dir is None:
+        return None, None
+    import jax
+    import jax.numpy as jnp
+    store = AotStore(store_dir)
+    record = _fresh_record(store_dir)
+    per_length: Dict[int, Any] = {}
+    mesh_size = int(mesh.size)
+
+    def _resolve(template, length: int):
+        try:
+            wsds = jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+                template)
+            psds = jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(jnp.shape(x),
+                                               jnp.asarray(x).dtype),
+                params)
+            sig = sharded_signature(model, sim, mesh, psds, scan_k,
+                                    length, wsds)
+            key = store_key(sig)
+            if record["fingerprint"] is None:
+                record["fingerprint"] = key
+            t0 = time.monotonic()
+            compiled = store.load(key)
+            if compiled is not None:
+                record["load-s"] += time.monotonic() - t0
+                record["hit"] = True
+                record["lengths"][str(length)] = "hit"
+                _note_aot(True)
+                return compiled
+            _note_aot(False)
+            record["lengths"][str(length)] = "miss"
+            tsds = jax.ShapeDtypeStruct((), jnp.int32)
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                with _uncached_compile():
+                    compiled = chunk_fn.lower(wsds, tsds, psds,
+                                              length=length).compile()
+                closed = jax.make_jaxpr(
+                    lambda w, t, p: chunk_fn(w, t, p, length=length))(
+                        wsds, tsds, psds)
+            meta = build_meta(sig, key,
+                              entry_label(model, sim, "sharded",
+                                          mesh_size=mesh_size),
+                              jaxpr_digest(closed), compiled,
+                              donated_leaves=len(jax.tree.leaves(wsds)))
+            if store.put(key, compiled, meta):
+                record["lengths"][str(length)] = "populated"
+            return compiled
+        except Exception as e:
+            record["lengths"][str(length)] = "error"
+            record["error"] = repr(e)[:200]
+            return lambda w, t, p: chunk_fn(w, t, p, length=length)
+
+    def wrapped(wire, t0, params_arg, length):
+        fn = per_length.get(length)
+        if fn is None:
+            fn = per_length[length] = _resolve(wire, length)
+        return fn(wire, t0, params_arg)
+
+    return wrapped, record
+
+
+# --------------------------------------------------------------------
+# provenance (heartbeat / campaign resume)
+# --------------------------------------------------------------------
+
+def pipelined_fingerprint(model, sim, params=None, chunk: int = 100,
+                          event_cap: Optional[int] = None,
+                          unroll: int = 1, scan_k: int = 8,
+                          instance_ids=None) -> str:
+    """The store key of a run's PRIMARY chunk executable, computed the
+    way ``run_sim_pipelined`` would — but via ``eval_shape`` only, no
+    trace, no compile. The heartbeat run-start record carries it;
+    campaign resume and triage recompute it and refuse a drifted
+    executable by name (EXE901)."""
+    import jax
+    from .pipeline import event_capacity, plan_chunks
+    from .runtime import default_instance_ids, init_carry
+    if params is None:
+        params = model.make_params(sim.net.n_nodes)
+    if instance_ids is None:
+        instance_ids = default_instance_ids(sim)
+    full_plans = plan_chunks(sim.n_ticks, chunk)
+    cap = (event_capacity(sim, model, full_plans[0][1])
+           if not event_cap else int(event_cap))
+    carry = jax.eval_shape(
+        lambda: init_carry(model, sim, 0, params, instance_ids))
+    sig = pipelined_signature(model, sim, params, instance_ids, cap,
+                              unroll, scan_k, full_plans[0][1], carry)
+    return store_key(sig)
+
+
+def prewarm_pipelined(model, sim, store_dir: str, params=None,
+                      chunk: int = 100, event_cap: Optional[int] = None,
+                      unroll: int = 1, scan_k: Optional[int] = None,
+                      instance_ids=None) -> Dict[str, str]:
+    """AOT-compile and store every chunk executable a
+    ``run_sim_pipelined(model, sim, chunk=chunk)`` run would dispatch,
+    without running the simulation (shape templates only — no carry is
+    ever materialized, so a 98k-instance rung prewarm's costs one
+    compile, zero device memory). The seconds-to-first-tick prewarm:
+    ``tools/tpu_opportunist.sh`` runs it for the scaling-ladder configs
+    during healthy TPU windows, so the ladder's first real dispatch
+    deserializes instead of compiling. Returns ``{length: "hit" |
+    "populated" | "error: ..."}`` per distinct chunk length in the
+    plan; an already-stored length is left untouched."""
+    import jax
+    import jax.numpy as jnp
+    from .pipeline import (DEFAULT_SCAN_TOP_K, event_capacity,
+                           make_chunk_fn, plan_chunks)
+    from .runtime import default_instance_ids, init_carry
+    if params is None:
+        params = model.make_params(sim.net.n_nodes)
+    if instance_ids is None:
+        instance_ids = default_instance_ids(sim)
+    if scan_k is None:
+        scan_k = DEFAULT_SCAN_TOP_K
+    plans = plan_chunks(sim.n_ticks, chunk)
+    cap = (event_capacity(sim, model, plans[0][1])
+           if not event_cap else int(event_cap))
+    chunk_fn = make_chunk_fn(model, sim, params, instance_ids, cap,
+                             unroll, scan_k=scan_k)
+    store = AotStore(store_dir)
+    carry_sds = jax.eval_shape(
+        lambda: init_carry(model, sim, 0, params, instance_ids))
+    tsds = jax.ShapeDtypeStruct((), jnp.int32)
+    out: Dict[str, str] = {}
+    for length in sorted({ln for _, ln in plans}):
+        try:
+            sig = pipelined_signature(model, sim, params, instance_ids,
+                                      cap, unroll, scan_k, length,
+                                      carry_sds)
+            cache_key = store_key(sig)
+            if store.meta(cache_key) is not None:
+                out[str(length)] = "hit"
+                continue
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                with _uncached_compile():
+                    compiled = chunk_fn.lower(carry_sds, tsds,
+                                              length=length).compile()
+                closed = jax.make_jaxpr(
+                    lambda c, t: chunk_fn(c, t, length=length))(
+                        carry_sds, tsds)
+            meta = build_meta(sig, cache_key,
+                              entry_label(model, sim, "pipelined"),
+                              jaxpr_digest(closed), compiled,
+                              donated_leaves=len(
+                                  jax.tree.leaves(carry_sds)))
+            out[str(length)] = ("populated"
+                                if store.put(cache_key, compiled, meta)
+                                else "error: store write failed")
+        except Exception as e:
+            out[str(length)] = f"error: {repr(e)[:160]}"
+    return out

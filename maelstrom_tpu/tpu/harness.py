@@ -139,6 +139,15 @@ TPU_DEFAULTS = dict(
                               # (resumed/queued runs skip recompiles;
                               # MAELSTROM_COMPILE_CACHE=0 disables,
                               # perf.phases gains hit/miss counts)
+    aot_store="auto",         # certified AOT executable store (tpu/
+                              # aot_store.py): "auto" rides the compile
+                              # cache's sibling (.jax_cache.aot), a dir
+                              # pins it, "off" (or MAELSTROM_AOT=0)
+                              # disables. A warm store deserializes the
+                              # chunk executable and skips trace+compile
+                              # entirely (perf.phases.aot.hit); entries
+                              # are certified by `maelstrom lint --aot`
+                              # (EXE9xx, doc/lint.md)
     check_workers=None,       # host verdict pipeline (checkers/
                               # pool.py): checker-farm worker processes
                               # running the per-instance workload
@@ -461,7 +470,8 @@ def _pipelined_phase_run(model: Model, sim: SimConfig, seed: int, params,
             event_sink=event_sink,
             dense_events=event_sink is None,
             check_mode=opts.get("check_mode"),
-            profiler=prof)
+            profiler=prof,
+            aot_store=_resolve_aot_dir(opts))
     finally:
         if profiling:
             try:
@@ -470,10 +480,48 @@ def _pipelined_phase_run(model: Model, sim: SimConfig, seed: int, params,
                 pass
     phases["total-s"] = round(time.monotonic() - t0, 4)
     phases["pipeline"] = res.perf
+    if "aot" in res.perf:
+        # the certified-store outcome surfaces as its own phase
+        # ({hit, load-s, fingerprint}, doc/observability.md)
+        phases["aot"] = res.perf["aot"]
     if prof is not None and prof.records:
         # device ms/tick per named scope, next to the host timers
         phases["device"] = prof.summary()
     return res, phases
+
+
+def _resolve_aot_dir(opts: Dict[str, Any]) -> Optional[str]:
+    """The run's effective AOT store dir (None = disabled); defaults
+    ride the compile cache's ``.aot`` sibling."""
+    from .aot_store import resolve_store_dir
+    return resolve_store_dir(opts.get("aot_store", "auto"),
+                             opts.get("compile_cache"))
+
+
+def aot_fingerprint_for(model: Model, opts: Dict[str, Any],
+                        params=None) -> Optional[str]:
+    """Recompute the store key of a run's primary chunk executable from
+    (model, opts) alone — eval_shape only, no trace or compile. The
+    heartbeat run-start record carries it; `maelstrom triage` and
+    ``campaign.runner.resume_run`` recompute it and refuse a drifted
+    executable BY NAME (EXE901) instead of silently replaying against
+    different code. Returns None when the store is disabled or the
+    fingerprint cannot be derived."""
+    full = {**TPU_DEFAULTS, **(opts or {})}
+    if _resolve_aot_dir(full) is None:
+        return None
+    try:
+        from .aot_store import pipelined_fingerprint
+        sim = make_sim_config(model, full)
+        if params is None:
+            params = model.make_params(sim.net.n_nodes)
+        return pipelined_fingerprint(
+            model, sim, params,
+            chunk=int(full.get("chunk_ticks") or 100),
+            event_cap=int(full.get("event_capacity") or 0) or None,
+            scan_k=int(full.get("scan_top_k") or 1))
+    except Exception:
+        return None
 
 
 # opts that fully determine a run's trajectory (plus the model identity)
@@ -493,6 +541,10 @@ _REPRO_OPT_KEYS = (
     # resumed run re-runs under the SAME policy it started with
     "pipeline", "fail_fast", "scan_top_k", "funnel", "funnel_max",
     "checkpoint_every", "check_workers", "check_mode",
+    # the certified executable store (tpu/aot_store.py): a resumed run
+    # must consult the SAME store — and the recorded fingerprint gates
+    # the resume on source drift (EXE901)
+    "aot_store",
     # fault-plan engine (maelstrom_tpu/faults/): the plan — or the
     # fuzz distribution whose per-instance schedules derive from the
     # seed — is part of the trajectory, so triage/resume/shrink must
@@ -638,9 +690,16 @@ def run_tpu_test(model: Model, opts: Optional[Dict[str, Any]] = None,
                 meta={"workload": model.name,
                       "chunks-done": resume.chunks})
         else:
+            # the executable fingerprint (tpu/aot_store.py) rides the
+            # run-start record: triage / campaign resume recompute it
+            # and refuse a drifted executable by name (EXE901)
+            aot_fp = (aot_fingerprint_for(model, opts, params)
+                      if use_pipe else None)
             hb = HeartbeatWriter(
                 run_dir, meta=dict(heartbeat_meta(model, sim, opts),
-                                   pipeline=bool(use_pipe)))
+                                   pipeline=bool(use_pipe),
+                                   **({"aot-fingerprint": aot_fp}
+                                      if aot_fp else {})))
     checkpoint_cb = None
     if int(opts.get("checkpoint_every") or 0) > 0:
         if run_dir and use_pipe:

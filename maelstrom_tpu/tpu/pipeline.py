@@ -495,7 +495,7 @@ def run_sim_pipelined(model: Model, sim: SimConfig, seed: int,
                       resume: Optional[ResumeState] = None,
                       event_sink=None, dense_events: bool = True,
                       check_mode: Optional[str] = None,
-                      profiler=None
+                      profiler=None, aot_store: Optional[str] = None
                       ) -> PipelineResult:
     """Chunked, donated, double-buffered replacement for
     :func:`..tpu.runtime.run_sim` + the dense event fetch.
@@ -554,6 +554,14 @@ def run_sim_pipelined(model: Model, sim: SimConfig, seed: int,
     :meth:`~..telemetry.profiler.DeviceProfiler.capture`), so a
     mid-run checker blow-up never leaves the process-wide trace open.
     Trajectories are bit-identical with profiling on or off.
+
+    ``aot_store`` (a directory, or None): consult the certified AOT
+    executable store (``tpu/aot_store.py``) before dispatching — a hit
+    deserializes the stored chunk executable and skips trace+compile
+    entirely, a miss AOT-compiles and populates the entry. The store
+    outcome lands under ``perf["aot"]`` ({hit, load-s, fingerprint});
+    trajectories are bit-identical with the store on, off, warm, or
+    cold.
     """
     if params is None:
         params = model.make_params(sim.net.n_nodes)
@@ -566,6 +574,15 @@ def run_sim_pipelined(model: Model, sim: SimConfig, seed: int,
            if not event_cap else int(event_cap))
     chunk_fn = make_chunk_fn(model, sim, params, instance_ids, cap,
                              unroll, scan_k=scan_k)
+    aot_rec = None
+    if aot_store is not None:
+        from .aot_store import wrap_pipelined
+        wrapped, aot_rec = wrap_pipelined(
+            chunk_fn, model=model, sim=sim, params=params,
+            instance_ids=instance_ids, cap=cap, unroll=unroll,
+            scan_k=scan_k, store_dir=aot_store)
+        if wrapped is not None:
+            chunk_fn = wrapped
 
     t_init = time.monotonic()
     if resume is not None:
@@ -744,6 +761,11 @@ def run_sim_pipelined(model: Model, sim: SimConfig, seed: int,
         # results.perf.phases.device
         **({"device": profiler.summary()}
            if profiler is not None and profiler.records else {}),
+        # the certified-store outcome (tpu/aot_store.py): hit means the
+        # dispatched executable was deserialized, never traced/compiled
+        **({"aot": dict(aot_rec,
+                        **{"load-s": round(aot_rec["load-s"], 4)})}
+           if aot_rec is not None else {}),
         **({"resumed-from-ticks": resume.ticks} if resume else {}),
         **{k: round(v, 4) if isinstance(v, float) else v
            for k, v in stats.items() if k != "consume-s"},

@@ -10,9 +10,18 @@ instead of recompiling its chunk functions (the ROADMAP item-3
 ``MAELSTROM_COMPILE_CACHE`` overrides everything: ``0`` disables, any
 other value is the cache directory; otherwise the caller's
 ``--compile-cache`` flag (default ``.jax_cache``) wins. Hit/miss counts
-come from jax's own monitoring events
-(``/jax/compilation_cache/cache_hits|cache_misses``) via a process-wide
-listener, and land in ``results.perf.phases["compile-cache"]``.
+land in ``results.perf.phases["compile-cache"]`` and are kept PER
+SOURCE: the persistent XLA cache's own monitoring events
+(``/jax/compilation_cache/cache_hits|cache_misses``) under
+``persistent-*``, and the certified AOT executable store's lookups
+(``tpu/aot_store.py``, via :func:`note_aot`) under ``aot-*``. The two
+sources can both fire around one logical compile (an AOT miss falls
+through to a compile the XLA cache may then serve), so folding them
+into a single hit counter double-counted — the legacy ``hits``/
+``misses`` keys now alias the persistent counters only, and
+``phase_record`` names which source actually served the run
+(``aot-hit`` / ``xla-cache-hit`` / ``cold-compile`` /
+``warm-process``); pinned by tests/test_aot.py.
 """
 
 from __future__ import annotations
@@ -27,7 +36,8 @@ DEFAULT_DIR = ".jax_cache"
 _HIT_EVENT = "/jax/compilation_cache/cache_hits"
 _MISS_EVENT = "/jax/compilation_cache/cache_misses"
 
-_counts = {"hits": 0, "misses": 0}
+_counts = {"persistent-hits": 0, "persistent-misses": 0,
+           "aot-hits": 0, "aot-misses": 0}
 _lock = threading.Lock()
 _listener_installed = False
 
@@ -35,10 +45,18 @@ _listener_installed = False
 def _listener(event: str, **kw: Any) -> None:
     if event == _HIT_EVENT:
         with _lock:
-            _counts["hits"] += 1
+            _counts["persistent-hits"] += 1
     elif event == _MISS_EVENT:
         with _lock:
-            _counts["misses"] += 1
+            _counts["persistent-misses"] += 1
+
+
+def note_aot(hit: bool) -> None:
+    """One AOT-store lookup (tpu/aot_store.py): counted under its own
+    source so a store miss that falls through to an XLA-cache-served
+    compile is never double-counted as two hits."""
+    with _lock:
+        _counts["aot-hits" if hit else "aot-misses"] += 1
 
 
 def resolve_cache_dir(flag: Optional[str]) -> Optional[str]:
@@ -71,13 +89,17 @@ def enable_compile_cache(flag: Optional[str] = DEFAULT_DIR
                           1.0)
     except Exception:
         return None   # ancient jax without the cache knobs: degrade
-    if not _listener_installed:
-        try:
-            from jax._src import monitoring
-            monitoring.register_event_listener(_listener)
-            _listener_installed = True
-        except Exception:
-            pass   # counters stay 0; the cache itself still works
+    with _lock:
+        # the guard lives UNDER the lock: two threads racing the first
+        # enable_compile_cache used to both register the listener, and
+        # every event then counted twice
+        if not _listener_installed:
+            try:
+                from jax._src import monitoring
+                monitoring.register_event_listener(_listener)
+                _listener_installed = True
+            except Exception:
+                pass   # counters stay 0; the cache itself still works
     return cache_dir
 
 
@@ -86,12 +108,32 @@ class CacheStats:
 
     def __init__(self) -> None:
         with _lock:
-            self._h0, self._m0 = _counts["hits"], _counts["misses"]
+            self._base = dict(_counts)
 
     def delta(self) -> Dict[str, int]:
         with _lock:
-            return {"hits": _counts["hits"] - self._h0,
-                    "misses": _counts["misses"] - self._m0}
+            d = {k: _counts[k] - self._base[k] for k in _counts}
+        # legacy keys alias the persistent-cache source only — the AOT
+        # store reports under aot-*, never folded in (the double-count
+        # this module's docstring describes)
+        d["hits"] = d["persistent-hits"]
+        d["misses"] = d["persistent-misses"]
+        return d
+
+
+def compile_source(delta: Dict[str, int]) -> str:
+    """Name which source served a run's compiles: ``aot-hit`` (the
+    executable store skipped trace+compile), ``xla-cache-hit`` (traced,
+    but the persistent cache served every compile), ``cold-compile``
+    (at least one real XLA compile ran), ``warm-process`` (no events at
+    all — jax's in-process jit cache served everything)."""
+    if delta.get("aot-hits"):
+        return "aot-hit"
+    if delta.get("persistent-misses"):
+        return "cold-compile"
+    if delta.get("persistent-hits"):
+        return "xla-cache-hit"
+    return "warm-process"
 
 
 def phase_record(flag: Optional[str], stats: Optional[CacheStats]
@@ -102,5 +144,7 @@ def phase_record(flag: Optional[str], stats: Optional[CacheStats]
         return None
     rec: Dict[str, Any] = {"dir": os.path.abspath(cache_dir)}
     if stats is not None:
-        rec.update(stats.delta())
+        d = stats.delta()
+        rec.update(d)
+        rec["source"] = compile_source(d)
     return rec

@@ -27,6 +27,16 @@ _CACHE_DIR = os.path.join(os.path.dirname(os.path.dirname(
 jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
+# The certified AOT executable store (tpu/aot_store.py) is OFF for the
+# suite: populating it is deliberately expensive (a populate compile
+# bypasses the persistent XLA cache above, so every store miss is a
+# REAL compile), and any source edit re-keys the whole store — letting
+# the ~700 incidental run_tpu_test calls repopulate it would blow the
+# tier-1 wall-clock budget on every first run after a change.
+# tests/test_aot.py re-enables it per-module and exercises the store
+# deliberately with explicit store dirs.
+os.environ.setdefault("MAELSTROM_AOT", "0")
+
 
 def pytest_configure(config):
     config.addinivalue_line(
@@ -97,6 +107,11 @@ def pytest_configure(config):
                    "profiling on/off bit-identity, heartbeat device-ms "
                    "schema, trace teardown, fallback attribution "
                    "(telemetry/profiler.py)")
+    config.addinivalue_line(
+        "markers", "aot: certified AOT executable-store tests — "
+                   "store-key stability, cold/warm bit-identity, "
+                   "prewarm key-compat, EXE9xx audit rules "
+                   "(tpu/aot_store.py, analysis/aot_audit.py)")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,535 @@
+"""Certified AOT executable store + ``maelstrom lint --aot`` (pass 9).
+
+Acceptance bars pinned here:
+
+- the store key (``pipelined_fingerprint``) is stable per config and
+  sensitive to every static knob that changes the compiled executable
+  (chunk length, scan-k, carry layout, event cap, fleet size);
+- cold -> warm roundtrips through ``run_sim_pipelined`` and
+  ``run_sim_sharded_chunked`` are bit-identical to the storeless path,
+  and the warm record proves every length was served from the store;
+- ``prewarm_pipelined`` populates exactly the keys a production run
+  later reads (shape templates only — key-compatibility is the whole
+  point of the prewarm);
+- a tampered payload or foreign-toolchain entry is refused by the
+  runtime (miss, never a wrong executable) AND named by the audit:
+  every EXE9xx rule fires on its fixture, and a freshly populated
+  store + manifest lints green;
+- the compile-cache counters keep the AOT source separate from the
+  persistent-XLA source (the double-count regression: an AOT lookup
+  must never leak into the legacy ``hits``/``misses`` keys).
+
+Every store populate is a REAL compile by design (the populate path
+bypasses the persistent XLA cache), so the compile-heavy roundtrips
+beyond the lead-layout representative are ``slow``-marked to protect
+the tier-1 wall-clock budget — ``-m aot`` runs the full set.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import jax.tree_util as tu
+import numpy as np
+import pytest
+
+from maelstrom_tpu.analysis.aot_audit import (load_aot_manifest,
+                                              run_aot_lint)
+from maelstrom_tpu.analysis.findings import SEV_ERROR, SEV_WARNING
+from maelstrom_tpu.models import get_model
+from maelstrom_tpu.tpu.aot_store import (AotStore, aot_enabled,
+                                         jaxpr_digest,
+                                         pipelined_fingerprint,
+                                         prewarm_pipelined,
+                                         resolve_store_dir, store_key,
+                                         wrap_pipelined)
+from maelstrom_tpu.tpu.harness import make_sim_config
+from maelstrom_tpu.tpu.pipeline import plan_chunks, run_sim_pipelined
+from maelstrom_tpu.tpu.runtime import canonical_carry
+
+pytestmark = pytest.mark.aot
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _aot_enabled():
+    """conftest.py kills the store suite-wide (MAELSTROM_AOT=0 — an
+    incidental populate is a real, cache-bypassing compile); this
+    module IS the store's coverage, so re-enable it here."""
+    prev = os.environ.pop("MAELSTROM_AOT", None)
+    yield
+    if prev is not None:
+        os.environ["MAELSTROM_AOT"] = prev
+
+# audit-sized echo fleet: the same scale the lint pass traces, so every
+# compile in this file is a few seconds on CPU
+OPTS = dict(node_count=2, concurrency=2, time_limit=0.25, rate=50.0,
+            latency=5.0, n_instances=4, record_instances=2,
+            journal_instances=0, seed=3)
+
+# one trace of the three audit subjects, shared by every lint call in
+# this module (run_aot_lint re-traces per call otherwise)
+TRACE_CACHE = {}
+
+
+def _setup(layout="lead", **over):
+    model = get_model("echo", 2)
+    sim = make_sim_config(model, {**OPTS, "layout": layout, **over})
+    return model, sim, model.make_params(sim.net.n_nodes)
+
+
+def _assert_trees_equal(a, b):
+    for (path, x), (_, y) in zip(tu.tree_flatten_with_path(a)[0],
+                                 tu.tree_flatten_with_path(b)[0]):
+        name = "/".join(str(p) for p in path)
+        assert x.shape == y.shape, (name, x.shape, y.shape)
+        assert (np.asarray(x) == np.asarray(y)).all(), name
+
+
+def _lint(store, manifest):
+    return run_aot_lint(repo_root=REPO, manifest_path=manifest,
+                        store_path=store, trace_cache=TRACE_CACHE)
+
+
+def _errors(findings):
+    return [f for f in findings if f.severity == SEV_ERROR]
+
+
+@pytest.fixture(scope="module")
+def fresh_store(tmp_path_factory):
+    """One populated store + matching manifest (the three audit
+    subjects, compiled once); tamper tests copy it, never mutate it."""
+    d = tmp_path_factory.mktemp("aot")
+    store, manifest = str(d / "store"), str(d / "manifest.json")
+    findings = run_aot_lint(repo_root=REPO, manifest_path=manifest,
+                            update_manifest=True, store_path=store,
+                            trace_cache=TRACE_CACHE)
+    assert [f.rule for f in findings] == ["EXE900"]
+    assert len(list(AotStore(store).entries())) == 3
+    return store, manifest
+
+
+def _copy_store(fresh, tmp_path):
+    dst = str(tmp_path / "store")
+    shutil.copytree(fresh[0], dst)
+    return dst
+
+
+def _edit_meta(store, pick, mutate):
+    """Rewrite the sidecar of the first entry ``pick`` accepts; returns
+    its key."""
+    for key, meta in AotStore(store).entries():
+        if not pick(meta):
+            continue
+        mutate(meta)
+        with open(os.path.join(store, key + ".json"), "w") as f:
+            json.dump(meta, f)
+        return key
+    raise AssertionError("no entry matched")
+
+
+# --- keying ----------------------------------------------------------------
+
+
+def test_fingerprint_stable():
+    model, sim, params = _setup()
+    a = pipelined_fingerprint(model, sim, params=params)
+    b = pipelined_fingerprint(model, sim, params=params)
+    assert a == b
+    assert len(a) == 32
+    int(a, 16)  # hex
+
+
+def test_fingerprint_sensitive_to_static_knobs():
+    model, sim, params = _setup()
+    base = pipelined_fingerprint(model, sim, params=params)
+    variants = {
+        "chunk": pipelined_fingerprint(model, sim, params=params,
+                                       chunk=7),
+        "scan-k": pipelined_fingerprint(model, sim, params=params,
+                                        scan_k=9),
+        "event-cap": pipelined_fingerprint(model, sim, params=params,
+                                           event_cap=48),
+        "unroll": pipelined_fingerprint(model, sim, params=params,
+                                        unroll=2),
+    }
+    model2, sim2, params2 = _setup(layout="minor")
+    variants["layout"] = pipelined_fingerprint(model2, sim2,
+                                               params=params2)
+    model3, sim3, params3 = _setup(n_instances=8)
+    variants["fleet"] = pipelined_fingerprint(model3, sim3,
+                                              params=params3)
+    for knob, key in variants.items():
+        assert key != base, knob
+    assert len(set(variants.values())) == len(variants)
+
+
+def test_store_key_canonicalization():
+    # dict insertion order never changes the content address...
+    assert store_key({"b": 1, "a": 2}) == store_key({"a": 2, "b": 1})
+    # ...but array VALUES do (pipelined params are burned into the
+    # binary, so they are hashed by value, not aval)
+    assert store_key({"x": np.arange(3)}) == store_key({"x": np.arange(3)})
+    assert store_key({"x": np.arange(3)}) != store_key(
+        {"x": np.arange(3) + 1})
+
+
+def test_jaxpr_digest_stable_across_traces():
+    f = lambda x: jnp.cumsum(x * 2)
+    sds = jax.ShapeDtypeStruct((8,), jnp.float32)
+    a = jaxpr_digest(jax.make_jaxpr(f)(sds))
+    b = jaxpr_digest(jax.make_jaxpr(f)(sds))
+    assert a == b
+    g = lambda x: jnp.cumsum(x * 3)
+    assert jaxpr_digest(jax.make_jaxpr(g)(sds)) != a
+
+
+# --- resolution / kill switch ----------------------------------------------
+
+
+def test_resolve_store_dir(tmp_path, monkeypatch):
+    monkeypatch.delenv("MAELSTROM_AOT", raising=False)
+    monkeypatch.delenv("MAELSTROM_COMPILE_CACHE", raising=False)
+    d = str(tmp_path / "s")
+    assert resolve_store_dir(d) == os.path.abspath(d)
+    for off in ("off", "0", ""):
+        assert resolve_store_dir(off) is None
+    # auto rides the compile cache: resolved dir + .aot
+    assert resolve_store_dir("auto", str(tmp_path / "cc")) \
+        == os.path.abspath(str(tmp_path / "cc")) + ".aot"
+    # a disabled compile cache disables the auto store too
+    monkeypatch.setenv("MAELSTROM_COMPILE_CACHE", "0")
+    assert resolve_store_dir("auto") is None
+    assert resolve_store_dir(None) is None
+
+
+def test_kill_switch_wins_over_everything(tmp_path, monkeypatch):
+    monkeypatch.setenv("MAELSTROM_AOT", "0")
+    assert not aot_enabled()
+    assert resolve_store_dir(str(tmp_path)) is None
+    assert resolve_store_dir("auto") is None
+    # and the wrapper face: a disabled store is (None, None), the
+    # caller keeps the plain jit path
+    assert wrap_pipelined(
+        None, model=None, sim=None, params=None, instance_ids=None,
+        cap=None, unroll=1, scan_k=8, store_dir=None) == (None, None)
+
+
+# --- roundtrips ------------------------------------------------------------
+
+
+@pytest.mark.parametrize("layout", [
+    "lead",
+    pytest.param("minor", marks=pytest.mark.slow)])
+def test_pipelined_cold_warm_bit_identity(layout, tmp_path):
+    model, sim, params = _setup(layout)
+    store = str(tmp_path / "store")
+    base = run_sim_pipelined(model, sim, 3, params, chunk=10_000)
+    cold = run_sim_pipelined(model, sim, 3, params, chunk=10_000,
+                             aot_store=store)
+    warm = run_sim_pipelined(model, sim, 3, params, chunk=10_000,
+                             aot_store=store)
+    rc, rw = cold.perf["aot"], warm.perf["aot"]
+    assert rc["hit"] is False
+    assert set(rc["lengths"].values()) == {"populated"}
+    assert rw["hit"] is True
+    assert set(rw["lengths"].values()) == {"hit"}
+    assert rw["load-s"] > 0
+    assert rc["fingerprint"] == rw["fingerprint"]
+    # the heartbeat/campaign provenance key IS the dispatch key
+    assert rc["fingerprint"] == pipelined_fingerprint(
+        model, sim, params=params, chunk=10_000)
+    for res in (cold, warm):
+        _assert_trees_equal(canonical_carry(base.carry, sim),
+                            canonical_carry(res.carry, sim))
+        assert (np.asarray(base.events)
+                == np.asarray(res.events)).all()
+
+
+@pytest.mark.slow
+def test_sharded_cold_warm_bit_identity(tmp_path):
+    from maelstrom_tpu.parallel.mesh import (make_mesh,
+                                             run_sim_sharded_chunked)
+    model, sim, _params = _setup()
+    mesh = make_mesh(2)
+    store = str(tmp_path / "store")
+    base = run_sim_sharded_chunked(model, sim, 3, mesh=mesh,
+                                   chunk=10_000)
+    pc, pw = {}, {}
+    cold = run_sim_sharded_chunked(model, sim, 3, mesh=mesh,
+                                   chunk=10_000, perf=pc,
+                                   aot_store=store)
+    warm = run_sim_sharded_chunked(model, sim, 3, mesh=mesh,
+                                   chunk=10_000, perf=pw,
+                                   aot_store=store)
+    assert pc["aot"]["hit"] is False
+    assert set(pc["aot"]["lengths"].values()) == {"populated"}
+    assert pw["aot"]["hit"] is True
+    assert set(pw["aot"]["lengths"].values()) == {"hit"}
+    assert base[0] == cold[0] == warm[0]
+    assert np.array_equal(base[1], cold[1])
+    assert np.array_equal(base[1], warm[1])
+    assert np.array_equal(base[2], cold[2])
+    assert np.array_equal(base[2], warm[2])
+
+
+@pytest.mark.slow
+def test_multi_length_plan_fully_served(tmp_path):
+    model, sim, params = _setup()
+    n = sim.n_ticks
+    chunk = next(c for c in range(n - 1, 1, -1)
+                 if len({ln for _, ln in plan_chunks(n, c)}) == 2)
+    store = str(tmp_path / "store")
+    cold = run_sim_pipelined(model, sim, 3, params, chunk=chunk,
+                             aot_store=store)
+    warm = run_sim_pipelined(model, sim, 3, params, chunk=chunk,
+                             aot_store=store)
+    assert len(cold.perf["aot"]["lengths"]) == 2
+    assert set(cold.perf["aot"]["lengths"].values()) == {"populated"}
+    assert set(warm.perf["aot"]["lengths"].values()) == {"hit"}
+    _assert_trees_equal(canonical_carry(cold.carry, sim),
+                        canonical_carry(warm.carry, sim))
+
+
+@pytest.mark.slow
+def test_store_failure_degrades_to_jit(tmp_path):
+    # store dir is a FILE: every put fails, the run must fall back to
+    # the plain jit path and stay bit-identical (the store is an
+    # accelerator, never a correctness dependency)
+    bad = tmp_path / "not-a-dir"
+    bad.write_text("x")
+    model, sim, params = _setup()
+    base = run_sim_pipelined(model, sim, 3, params, chunk=10_000)
+    res = run_sim_pipelined(model, sim, 3, params, chunk=10_000,
+                            aot_store=str(bad))
+    rec = res.perf["aot"]
+    assert set(rec["lengths"].values()) == {"error"}
+    assert "error" in rec
+    _assert_trees_equal(canonical_carry(base.carry, sim),
+                        canonical_carry(res.carry, sim))
+
+
+# --- prewarm ---------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_prewarm_populates_the_production_keys(tmp_path):
+    model, sim, _params = _setup()
+    store = str(tmp_path / "store")
+    out = prewarm_pipelined(model, sim, store, chunk=10_000)
+    assert set(out.values()) == {"populated"}
+    # the run never compiles: every length the plan dispatches was
+    # prewarmed under the exact key the wrapper recomputes
+    res = run_sim_pipelined(model, sim, 3, chunk=10_000,
+                            aot_store=store)
+    rec = res.perf["aot"]
+    assert rec["hit"] is True
+    assert set(rec["lengths"].values()) == {"hit"}
+    assert set(rec["lengths"]) == set(out)
+    # idempotent: a second prewarm touches nothing
+    assert set(prewarm_pipelined(model, sim, store,
+                                 chunk=10_000).values()) == {"hit"}
+
+
+# --- runtime refusal faces -------------------------------------------------
+
+
+def test_tampered_payload_refused_at_load(fresh_store, tmp_path):
+    store = _copy_store(fresh_store, tmp_path)
+    key = next(iter(AotStore(store).entries()))[0]
+    path = os.path.join(store, key + ".bin")
+    blob = bytearray(open(path, "rb").read())
+    blob[-1] ^= 0xFF
+    open(path, "wb").write(bytes(blob))
+    s = AotStore(store)
+    assert s.load_payload(key) is None
+    assert s.load(key) is None  # a tampered entry is a miss, never code
+
+
+def test_foreign_toolchain_refused_at_load(fresh_store, tmp_path):
+    store = _copy_store(fresh_store, tmp_path)
+    key = _edit_meta(store, lambda m: True,
+                     lambda m: m.update({"jax-version": "0.0.0"}))
+    s = AotStore(store)
+    assert s.load(key) is None
+    # the bytes themselves are intact — only the toolchain gate refused
+    assert s.load_payload(key) is not None
+
+
+# --- the audit (EXE9xx) ----------------------------------------------------
+
+
+def test_fresh_store_lints_green(fresh_store):
+    assert _lint(*fresh_store) == []
+
+
+def test_payload_tamper_is_exe901(fresh_store, tmp_path):
+    store = _copy_store(fresh_store, tmp_path)
+    key = next(iter(AotStore(store).entries()))[0]
+    path = os.path.join(store, key + ".bin")
+    blob = bytearray(open(path, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF
+    open(path, "wb").write(bytes(blob))
+    hits = [f for f in _errors(_lint(store, fresh_store[1]))
+            if f.rule == "EXE901"]
+    assert len(hits) == 1
+    assert "tamper" in hits[0].message
+
+
+def test_fingerprint_drift_is_exe901(fresh_store, tmp_path):
+    store = _copy_store(fresh_store, tmp_path)
+
+    def drift(meta):
+        d = meta["fingerprint"]["jaxpr-digest"]
+        meta["fingerprint"]["jaxpr-digest"] = \
+            ("0" if d[0] != "0" else "1") + d[1:]
+
+    _edit_meta(store, lambda m: m["kind"] == "pipelined", drift)
+    hits = [f for f in _errors(_lint(store, fresh_store[1]))
+            if f.rule == "EXE901"]
+    assert len(hits) == 1
+    assert "no longer matches the jaxpr" in hits[0].message
+    assert hits[0].symbol == "make_chunk_fn"
+
+
+def test_donation_lost_is_exe902(fresh_store, tmp_path):
+    store = _copy_store(fresh_store, tmp_path)
+    _edit_meta(store, lambda m: m["kind"] == "pipelined",
+               lambda m: m.update({"donated-leaves": 9999}))
+    hits = [f for f in _errors(_lint(store, fresh_store[1]))
+            if f.rule == "EXE902"]
+    assert len(hits) == 1
+    assert "input_output_alias" in hits[0].message
+
+
+def test_smuggled_collective_is_exe903(fresh_store, tmp_path):
+    store = _copy_store(fresh_store, tmp_path)
+    _edit_meta(store, lambda m: m["kind"] == "pipelined",
+               lambda m: m.update({"collectives": {"all-to-all": 2}}))
+    hits = [f for f in _errors(_lint(store, fresh_store[1]))
+            if f.rule == "EXE903"]
+    assert len(hits) == 1
+    assert "all-to-all" in hits[0].message
+
+
+def test_foreign_toolchain_is_exe904(fresh_store, tmp_path):
+    store = _copy_store(fresh_store, tmp_path)
+    _edit_meta(store, lambda m: True,
+               lambda m: m.update({"jax-version": "0.0.0"}))
+    findings = _lint(store, fresh_store[1])
+    hits = [f for f in _errors(findings) if f.rule == "EXE904"]
+    assert len(hits) == 1
+    assert "jax-version" in hits[0].message
+    # refusal is by name and FINAL: no other rule piles onto the entry
+    assert len(_errors(findings)) == 1
+
+
+def test_missing_manifest_is_exe905(tmp_path):
+    findings = _lint("off", str(tmp_path / "absent.json"))
+    hits = [f for f in findings if f.rule == "EXE905"]
+    assert len(hits) == 3  # one per audit subject
+    assert all(f.severity == SEV_ERROR for f in hits)
+
+
+def test_stale_manifest_entry_is_exe906(fresh_store, tmp_path):
+    data = load_aot_manifest(fresh_store[1])
+    data["entries"]["ghost/n=9/lead/pipelined"] = {
+        "jaxpr-digest": "0" * 32, "chunk-length": 4,
+        "donated-leaves": 1, "kind": "pipelined"}
+    path = str(tmp_path / "manifest.json")
+    with open(path, "w") as f:
+        json.dump(data, f)
+    findings = _lint("off", path)
+    hits = [f for f in findings if f.rule == "EXE906"]
+    assert len(hits) == 1
+    assert hits[0].severity == SEV_WARNING
+    assert "ghost/n=9" in hits[0].message
+    assert not _errors(findings)
+
+
+def test_checked_in_manifest_matches_current_source():
+    """The repo's own aot_manifest.json certifies current source — a
+    dispatch change without --update-aot fails here first."""
+    findings = run_aot_lint(repo_root=REPO, store_path="off",
+                            trace_cache=TRACE_CACHE)
+    assert findings == []
+
+
+# --- compile-cache source accounting ---------------------------------------
+
+
+def test_compile_cache_counts_aot_separately():
+    from maelstrom_tpu.utils.compile_cache import (CacheStats,
+                                                   compile_source,
+                                                   note_aot)
+    snap = CacheStats()
+    note_aot(True)
+    note_aot(False)
+    note_aot(False)
+    d = snap.delta()
+    assert d["aot-hits"] == 1 and d["aot-misses"] == 2
+    # the double-count regression: AOT lookups never leak into the
+    # legacy keys, which alias the persistent-XLA source only
+    assert d["hits"] == d["persistent-hits"]
+    assert d["misses"] == d["persistent-misses"]
+    snap2 = CacheStats()
+    note_aot(True)
+    d2 = snap2.delta()
+    assert d2["aot-hits"] == 1
+    assert d2["hits"] == 0 and d2["misses"] == 0
+    # source classification: the store outranks the XLA cache outranks
+    # a cold compile outranks a silent in-process warm run
+    assert compile_source({"aot-hits": 1,
+                           "persistent-misses": 1}) == "aot-hit"
+    assert compile_source({"persistent-misses": 2,
+                           "persistent-hits": 1}) == "cold-compile"
+    assert compile_source({"persistent-hits": 3}) == "xla-cache-hit"
+    assert compile_source({}) == "warm-process"
+
+
+# --- cross-process ---------------------------------------------------------
+
+
+_CHILD = """
+import json, os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_compilation_cache_dir", sys.argv[2])
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+from maelstrom_tpu.models import get_model
+from maelstrom_tpu.tpu.harness import make_sim_config
+from maelstrom_tpu.tpu.pipeline import run_sim_pipelined
+model = get_model("echo", 2)
+sim = make_sim_config(model, json.loads(sys.argv[3]))
+res = run_sim_pipelined(model, sim, 3, chunk=10_000,
+                        aot_store=sys.argv[1])
+print(json.dumps({"aot": res.perf["aot"],
+                  "delivered": int(res.carry.stats.delivered)}))
+"""
+
+
+@pytest.mark.slow
+def test_cross_process_warm_start(tmp_path):
+    """The store's whole reason to exist: a SECOND process (fresh jit
+    caches) deserializes instead of compiling, bit-identically."""
+    store = str(tmp_path / "store")
+    legs = []
+    for _ in range(2):
+        out = subprocess.run(
+            [sys.executable, "-c", _CHILD, store,
+             os.path.join(REPO, ".jax_cache"), json.dumps(OPTS)],
+            cwd=REPO, capture_output=True, text=True, timeout=600)
+        assert out.returncode == 0, out.stderr[-2000:]
+        legs.append(json.loads(out.stdout.strip().splitlines()[-1]))
+    cold, warm = legs
+    assert cold["aot"]["hit"] is False
+    assert set(cold["aot"]["lengths"].values()) == {"populated"}
+    assert warm["aot"]["hit"] is True
+    assert set(warm["aot"]["lengths"].values()) == {"hit"}
+    assert cold["aot"]["fingerprint"] == warm["aot"]["fingerprint"]
+    assert cold["delivered"] == warm["delivered"]

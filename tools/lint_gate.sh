@@ -193,6 +193,62 @@ print(f"planted cross-shard gather named: {sorted(f.rule for f in fs)}")
 PY
 
 echo
+echo "== maelstrom lint --aot --strict (certified AOT executable audit)"
+python -m maelstrom_tpu lint --aot --strict
+
+echo
+echo "== AOT canary (tampered store fingerprint must fail; drifted source must fail)"
+# Simulate the two failure modes the executable certification exists to
+# catch: (a) an on-disk executable whose recorded jaxpr fingerprint no
+# longer matches what the production factory lowers — populate a
+# throwaway store from the live source via --update-aot, then flip one
+# hex digit of a stored entry's jaxpr-digest — and (b) the silent
+# drift: edit a traced source (the violation scan's tie-breaking sort
+# stability — a semantics change invisible to every shape-based check)
+# without re-recording the checked-in manifest. Each strict run must
+# exit 1 naming EXE901 specifically. jax-version is copied through on
+# both, so this also proves same-toolchain drift is a hard error, not
+# the re-record warning.
+python -m maelstrom_tpu lint --update-aot \
+    --aot-store "$SMOKE_STORE/aot-canary-store" \
+    --aot-manifest "$SMOKE_STORE/aot_manifest.json" \
+    > "$SMOKE_STORE/aot-populate.out"
+python - "$SMOKE_STORE/aot-canary-store" <<'PY'
+import glob, json, sys
+metas = sorted(glob.glob(sys.argv[1] + "/*.json"))
+assert metas, "populate wrote no store entries"
+m = json.load(open(metas[0]))
+d = m["fingerprint"]["jaxpr-digest"]
+m["fingerprint"]["jaxpr-digest"] = ("0" if d[0] != "0" else "1") + d[1:]
+json.dump(m, open(metas[0], "w"))
+print(f"tampered entry: {m['entry']} (flipped a fingerprint byte)")
+PY
+rc=0
+python -m maelstrom_tpu lint --aot --strict \
+    --aot-store "$SMOKE_STORE/aot-canary-store" \
+    --aot-manifest "$SMOKE_STORE/aot_manifest.json" \
+    > "$SMOKE_STORE/aot-canary.out" || rc=$?
+[[ "$rc" == "1" ]] || { echo "expected exit 1 (store tamper caught), got $rc"; exit 1; }
+grep -Eq 'ERROR EXE901' "$SMOKE_STORE/aot-canary.out"
+echo "canary caught: $(grep -Ec 'ERROR EXE901' "$SMOKE_STORE/aot-canary.out") EXE901 tamper finding(s)"
+cp -p maelstrom_tpu/tpu/pipeline.py "$SMOKE_STORE/pipeline.py.orig"
+# an interrupt mid-canary must not strand the drifted source: restore
+# pipeline.py BEFORE the smoke store (and its pristine backup) goes
+trap 'cp -p "$SMOKE_STORE/pipeline.py.orig" maelstrom_tpu/tpu/pipeline.py \
+      2>/dev/null || true; rm -rf "$SMOKE_STORE"' EXIT
+sed -i 's/jnp.argsort(key, stable=True)/jnp.argsort(key, stable=False)/' \
+    maelstrom_tpu/tpu/pipeline.py
+grep -q 'argsort(key, stable=False)' maelstrom_tpu/tpu/pipeline.py
+rc=0
+python -m maelstrom_tpu lint --aot --strict --aot-store off \
+    > "$SMOKE_STORE/aot-drift.out" || rc=$?
+cp -p "$SMOKE_STORE/pipeline.py.orig" maelstrom_tpu/tpu/pipeline.py
+trap 'rm -rf "$SMOKE_STORE"' EXIT   # source restored — plain cleanup
+[[ "$rc" == "1" ]] || { echo "expected exit 1 (source drift caught), got $rc"; exit 1; }
+grep -Eq 'ERROR EXE901' "$SMOKE_STORE/aot-drift.out"
+echo "canary caught: $(grep -Ec 'ERROR EXE901' "$SMOKE_STORE/aot-drift.out") EXE901 drift finding(s)"
+
+echo
 echo "== raft-family fusion budgets hold (fused ticks pin 0 loops)"
 python - <<'PY'
 import json
@@ -223,6 +279,35 @@ python -m maelstrom_tpu test --runtime tpu -w echo --node-count 2 \
     --pipeline on --chunk-ticks 50 --seed 3 --store "$SMOKE_STORE" \
     > "$SMOKE_STORE/pipeline-smoke.json"
 grep -q '"chunk-ticks": 50' "$SMOKE_STORE/pipeline-smoke.json"
+
+echo
+echo "== warm AOT-store smoke (second run hits the store, never re-traces)"
+# two identical echo runs against the same throwaway store: run 1
+# populates it (cold), run 2 must deserialize the certified executable
+# (perf.phases.aot.hit == true, every length a hit), never trace
+# ("trace-s" absent from phases), and agree on verdict + traffic
+for LEG in cold warm; do
+    python -m maelstrom_tpu test --runtime tpu -w echo --node-count 2 \
+        --time-limit 0.5 --rate 100 --n-instances 8 \
+        --record-instances 2 --pipeline on --chunk-ticks 50 --seed 3 \
+        --aot-store "$SMOKE_STORE/aot-smoke-store" \
+        > "$SMOKE_STORE/aot-smoke-$LEG.json"
+done
+python - "$SMOKE_STORE" <<'PY'
+import json, sys
+dec = json.JSONDecoder()
+cold = dec.raw_decode(open(sys.argv[1] + "/aot-smoke-cold.json").read())[0]
+warm = dec.raw_decode(open(sys.argv[1] + "/aot-smoke-warm.json").read())[0]
+ca, wa = cold["perf"]["phases"]["aot"], warm["perf"]["phases"]["aot"]
+assert not ca["hit"] and "populated" in ca["lengths"].values(), ca
+assert wa["hit"] and set(wa["lengths"].values()) == {"hit"}, wa
+assert "trace-s" not in warm["perf"]["phases"], warm["perf"]["phases"]
+assert wa["fingerprint"] == ca["fingerprint"], (ca, wa)
+assert cold["net"] == warm["net"], (cold["net"], warm["net"])
+assert cold["valid?"] is True and warm["valid?"] is True
+print(f"aot smoke: warm hit on fingerprint {wa['fingerprint']}, "
+      f"load {wa['load-s']}s, identical traffic")
+PY
 
 echo
 echo "== device-profile smoke (per-phase device-ms lanes + profile report)"

@@ -16,10 +16,20 @@ Usage:
     python tools/tpu_scaling.py                 # auto ladder by platform
     python tools/tpu_scaling.py 512 4096 16384  # explicit ladder
     python tools/tpu_scaling.py --artifact [out.json] [rungs...]
+    python tools/tpu_scaling.py --prewarm-aot [rungs...]
 Env: SCALING_K (inbox_k, default 1), SCALING_POOL (pool_slots, default
 16), SCALING_TICKS (default 1000), SCALING_CHUNK (default 100),
 SCALING_LAYOUTS (comma list of carry layouts per rung; default "auto" —
-set "lead,minor" to A/B the batch-axis position on the accelerator).
+set "lead,minor" to A/B the batch-axis position on the accelerator),
+SCALING_AOT_STORE (certified AOT store dir for --artifact/--prewarm-aot;
+default "auto" = the compile cache's .aot sibling, "off" disables).
+
+``--prewarm-aot`` AOT-compiles and stores the ladder's production
+pipelined chunk executables (tpu/aot_store.prewarm_pipelined) without
+running a single tick — shape templates only, so it is cheap enough to
+run at the START of a healthy TPU window (tools/tpu_opportunist.sh
+does) and every later ladder/artifact dispatch deserializes in
+milliseconds instead of burning window seconds on XLA compiles.
 
 ``--artifact`` is the device-time observatory's scaling artifact
 (doc/observability.md): the same flagship ladder, but run through the
@@ -89,6 +99,12 @@ def run_artifact(out_path, ladder) -> None:
     n_shards = int(mesh.size)
     manifest = shard_audit.load_shard_manifest()
     model = RaftModel(n_nodes_hint=3, log_cap=64, heartbeat=8)
+    # certified AOT store: a prewarmed window (--prewarm-aot) makes
+    # every pipelined rung's first dispatch a deserialization instead
+    # of a compile; each rung reports the store outcome
+    from maelstrom_tpu.tpu.aot_store import resolve_store_dir
+    aot_dir = resolve_store_dir(
+        os.environ.get("SCALING_AOT_STORE", "auto"))
     rungs = []
     for n in ladder:
       for layout in layouts:
@@ -105,18 +121,23 @@ def run_artifact(out_path, ladder) -> None:
             prof = DeviceProfiler("on", model=model, sim=sim,
                                   params=params)
             t0 = _time.monotonic()
+            aot_rec = None
             if executor == "pipelined":
                 res = run_sim_pipelined(model, sim, 7, params=params,
                                         chunk=chunk, dense_events=False,
-                                        profiler=prof)
+                                        profiler=prof, aot_store=aot_dir)
                 delivered = int(res.carry.stats.delivered)
                 total = n
+                aot_rec = res.perf.get("aot")
             else:
+                sh_perf = {}
                 stats, _viol, _ev = run_sim_sharded_chunked(
                     model, sim, 7, params=params, mesh=mesh,
-                    chunk=chunk, profiler=prof)
+                    chunk=chunk, profiler=prof, perf=sh_perf,
+                    aot_store=aot_dir)
                 delivered = int(stats.delivered)
                 total = n * n_shards
+                aot_rec = sh_perf.get("aot")
             wall = _time.monotonic() - t0
             # compile never pollutes the device wall: the profiler
             # stamps AFTER each dispatch call returns
@@ -133,6 +154,7 @@ def run_artifact(out_path, ladder) -> None:
                                  if dev_s > 0 else None),
                 "wall_s": round(wall, 3),
                 "device": prof.summary(),
+                **({"aot": aot_rec} if aot_rec is not None else {}),
             }
             # the live-traced per-tick ICI estimate next to what the
             # committed manifest promises for this config (the perf
@@ -176,6 +198,58 @@ def run_artifact(out_path, ladder) -> None:
     with open(out_path, "w") as f:
         json.dump(payload, f, indent=2)
     print(f"wrote {out_path} ({len(rungs)} rungs)", file=sys.stderr)
+
+
+def run_prewarm(ladder) -> None:
+    """The ``--prewarm-aot`` mode: populate the certified AOT store
+    with the ladder's production pipelined chunk executables — shape
+    templates only, no simulation runs, no fleet-sized carry is ever
+    allocated. One JSON line per rung reports per-length outcomes."""
+    import jax
+
+    from maelstrom_tpu.models.raft import RaftModel
+    from maelstrom_tpu.tpu.aot_store import (prewarm_pipelined,
+                                             resolve_store_dir)
+    from maelstrom_tpu.tpu.harness import make_sim_config
+
+    platform = jax.devices()[0].platform
+    if ladder is None:
+        ladder = ([64, 256] if platform == "cpu"
+                  else [4096, 16384, 32768, 65536, 98304])
+    store_dir = resolve_store_dir(
+        os.environ.get("SCALING_AOT_STORE", "auto"))
+    if store_dir is None:
+        print("aot store disabled (MAELSTROM_AOT=0, SCALING_AOT_STORE="
+              "off, or no compile cache) — nothing to prewarm",
+              file=sys.stderr)
+        return
+    inbox_k = int(os.environ.get("SCALING_K", 1))
+    pool_slots = int(os.environ.get("SCALING_POOL", 16))
+    n_ticks = int(os.environ.get("SCALING_TICKS", 1000))
+    chunk = int(os.environ.get("SCALING_CHUNK", 100))
+    layouts = [s.strip() for s in
+               os.environ.get("SCALING_LAYOUTS", "auto").split(",")]
+    model = RaftModel(n_nodes_hint=3, log_cap=64, heartbeat=8)
+    for n in ladder:
+      for layout in layouts:
+        # EXACTLY the run_artifact rung config — a prewarm keyed on a
+        # drifted config would be a silent no-op, not a head start
+        opts = dict(node_count=3, concurrency=6, n_instances=n,
+                    record_instances=1, inbox_k=inbox_k,
+                    pool_slots=pool_slots,
+                    time_limit=n_ticks / 1000.0, rate=200.0, latency=5.0,
+                    rpc_timeout=1.0, nemesis=["partition"],
+                    nemesis_interval=0.4, p_loss=0.05,
+                    recovery_time=0.3, seed=7, layout=layout)
+        sim = make_sim_config(model, opts)
+        t0 = time.monotonic()
+        out = prewarm_pipelined(model, sim, store_dir, chunk=chunk)
+        print(json.dumps({
+            "prewarm": "pipelined", "platform": platform,
+            "instances": n, "layout": sim.layout, "store": store_dir,
+            "lengths": out,
+            "wall_s": round(time.monotonic() - t0, 2),
+        }), flush=True)
 
 
 def main() -> None:
@@ -271,5 +345,8 @@ if __name__ == "__main__":
             out = _next_artifact_path(os.path.dirname(
                 os.path.dirname(os.path.abspath(__file__))))
         run_artifact(out, nums or None)
+    elif "--prewarm-aot" in sys.argv:
+        nums = [int(a) for a in sys.argv[1:] if a.isdigit()]
+        run_prewarm(nums or None)
     else:
         main()
